@@ -1,0 +1,29 @@
+"""horaedb_tpu — a TPU-native distributed time-series database framework.
+
+A ground-up re-design of the capabilities of Apache HoraeDB (incubating)
+(/root/reference, Rust+Go) for TPU hardware: queries compile to fused
+JAX/XLA kernels (scan → filter → time-bucket → group-by → aggregate in one
+jit program), compaction's k-way merge-dedup runs as a device sort kernel,
+and distributed execution is expressed as sharded partial aggregation over a
+``jax.sharding.Mesh`` with XLA collectives instead of gRPC-shipped plans.
+
+Layer map (mirrors reference SURVEY layer map, re-architected TPU-first):
+
+    server/     HTTP front end                  (ref: src/server)
+    proxy/      request orchestration, routing  (ref: src/proxy)
+    query/      SQL front end -> Plan -> interpreters -> executor
+                (ref: src/query_frontend, src/interpreters, src/query_engine)
+    ops/        the TPU compute path: fused scan/agg, merge-dedup sort
+                (ref: DataFusion's vectorized operators, re-built on XLA)
+    table_engine/  Table/TableEngine abstraction, partition rules
+                (ref: src/table_engine, src/partition_table_engine)
+    engine/     analytic LSM storage engine: memtable, SST, WAL, manifest,
+                flush, compaction                (ref: src/analytic_engine)
+    parallel/   device mesh, sharded distributed aggregation
+                (ref: src/df_engine_extensions dist push-down)
+    cluster/    shard membership, routing        (ref: src/cluster, src/router)
+    utils/      object store, codecs, config, metrics, runtime
+                (ref: src/components/*)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,276 @@
+"""Table schema (ref: src/common_types/src/schema.rs).
+
+Model (same as the reference's):
+
+- every table has exactly one TIMESTAMP KEY column;
+- columns marked TAG form the series identity; a ``tsid`` uint64 column is
+  auto-generated (hash of the tag values) when the user doesn't spell out a
+  primary key, and the default primary key is ``(tsid, timestamp)``
+  (ref: schema.rs:226,638-722);
+- everything else is a field column.
+
+TPU-first difference: tag columns are *dictionary encoded* at ingest time
+(string -> int32 code) so that series identity and group-by keys are dense
+integers on device; the string dictionary only exists at the edges
+(SST metadata, query results).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import xxhash
+
+from .datum import DatumKind
+
+TSID_COLUMN = "tsid"
+
+
+@dataclass(frozen=True, slots=True)
+class ColumnSchema:
+    name: str
+    kind: DatumKind
+    is_nullable: bool = True
+    is_tag: bool = False
+    is_dictionary: bool = False
+    comment: str = ""
+    default_value: Optional[Any] = None
+
+    def to_arrow_field(self) -> pa.Field:
+        t = self.kind.arrow_type
+        if self.is_tag and self.kind is DatumKind.STRING:
+            t = pa.dictionary(pa.int32(), pa.string())
+        meta = {}
+        if self.is_tag:
+            meta[b"horaedb_tpu::tag"] = b"1"
+        return pa.field(self.name, t, nullable=self.is_nullable, metadata=meta or None)
+
+
+class Schema:
+    """Immutable table schema with key/tag bookkeeping.
+
+    ``columns`` always start with the primary-key columns:
+    ``[tsid, timestamp, ...tags..., ...fields...]`` in the auto-tsid layout.
+    """
+
+    def __init__(
+        self,
+        columns: Sequence[ColumnSchema],
+        timestamp_index: int,
+        primary_key_indexes: Sequence[int],
+        version: int = 1,
+    ) -> None:
+        names = [c.name for c in columns]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate column names in schema: {names}")
+        if not (0 <= timestamp_index < len(columns)):
+            raise ValueError("timestamp_index out of range")
+        if columns[timestamp_index].kind is not DatumKind.TIMESTAMP:
+            raise ValueError("timestamp column must be TIMESTAMP kind")
+        for i in primary_key_indexes:
+            if not columns[i].kind.is_key_kind:
+                raise ValueError(
+                    f"column {columns[i].name} ({columns[i].kind}) cannot be a key"
+                )
+        self.columns: tuple[ColumnSchema, ...] = tuple(columns)
+        self.timestamp_index = timestamp_index
+        self.primary_key_indexes: tuple[int, ...] = tuple(primary_key_indexes)
+        self.version = version
+        self._index = {c.name: i for i, c in enumerate(self.columns)}
+
+    # ---- construction --------------------------------------------------
+    @staticmethod
+    def build(
+        columns: Sequence[ColumnSchema],
+        timestamp_column: str,
+        primary_key: Sequence[str] | None = None,
+        version: int = 1,
+    ) -> "Schema":
+        """Build a schema the way CREATE TABLE does.
+
+        With no explicit primary key, prepends an auto-generated ``tsid``
+        column and uses ``(tsid, timestamp)`` (ref: schema.rs enable_tsid
+        path). Tag string columns get dictionary encoding.
+        """
+        cols = [
+            ColumnSchema(
+                name=c.name,
+                kind=c.kind,
+                is_nullable=c.is_nullable and c.name != timestamp_column and not c.is_tag,
+                is_tag=c.is_tag,
+                is_dictionary=c.is_tag and c.kind is DatumKind.STRING,
+                comment=c.comment,
+                default_value=c.default_value,
+            )
+            for c in columns
+        ]
+        names = [c.name for c in cols]
+        if timestamp_column not in names:
+            raise ValueError(f"timestamp column {timestamp_column!r} not defined")
+        if primary_key is None:
+            if TSID_COLUMN in names:
+                raise ValueError("tsid is a reserved column name")
+            cols.insert(
+                0,
+                ColumnSchema(TSID_COLUMN, DatumKind.UINT64, is_nullable=False),
+            )
+            # tsid first, then timestamp right after (canonical key order).
+            ts_i = [c.name for c in cols].index(timestamp_column)
+            if ts_i != 1:
+                ts_col = cols.pop(ts_i)
+                cols.insert(1, ts_col)
+            pk_idx = (0, 1)
+        else:
+            for k in primary_key:
+                if k not in names:
+                    raise ValueError(f"primary key column {k!r} not defined")
+            pk_idx = tuple([c.name for c in cols].index(k) for k in primary_key)
+        ts_index = [c.name for c in cols].index(timestamp_column)
+        return Schema(cols, ts_index, pk_idx, version=version)
+
+    # ---- lookups -------------------------------------------------------
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def timestamp_name(self) -> str:
+        return self.columns[self.timestamp_index].name
+
+    @property
+    def tsid_index(self) -> Optional[int]:
+        return self._index.get(TSID_COLUMN)
+
+    @property
+    def tag_indexes(self) -> tuple[int, ...]:
+        return tuple(i for i, c in enumerate(self.columns) if c.is_tag)
+
+    @property
+    def tag_names(self) -> tuple[str, ...]:
+        return tuple(c.name for c in self.columns if c.is_tag)
+
+    @property
+    def field_indexes(self) -> tuple[int, ...]:
+        """Non-key, non-tag, non-timestamp columns (the measured values)."""
+        skip = set(self.primary_key_indexes) | set(self.tag_indexes)
+        skip.add(self.timestamp_index)
+        return tuple(i for i in range(len(self.columns)) if i not in skip)
+
+    def index_of(self, name: str) -> int:
+        try:
+            return self._index[name]
+        except KeyError:
+            raise KeyError(f"no such column: {name!r}") from None
+
+    def column(self, name: str) -> ColumnSchema:
+        return self.columns[self.index_of(name)]
+
+    def has_column(self, name: str) -> bool:
+        return name in self._index
+
+    def names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    # ---- evolution -----------------------------------------------------
+    def with_added_column(self, col: ColumnSchema) -> "Schema":
+        """ALTER TABLE ADD COLUMN — appends a nullable field column."""
+        if col.name in self._index:
+            raise ValueError(f"column {col.name!r} already exists")
+        if col.is_tag:
+            raise ValueError("cannot add a tag column after table creation")
+        return Schema(
+            (*self.columns, col),
+            self.timestamp_index,
+            self.primary_key_indexes,
+            version=self.version + 1,
+        )
+
+    # ---- interop -------------------------------------------------------
+    def to_arrow(self) -> pa.Schema:
+        return pa.schema([c.to_arrow_field() for c in self.columns])
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "timestamp_index": self.timestamp_index,
+            "primary_key_indexes": list(self.primary_key_indexes),
+            "columns": [
+                {
+                    "name": c.name,
+                    "kind": c.kind.value,
+                    "is_nullable": c.is_nullable,
+                    "is_tag": c.is_tag,
+                    "is_dictionary": c.is_dictionary,
+                    "comment": c.comment,
+                    "default_value": c.default_value,
+                }
+                for c in self.columns
+            ],
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Schema":
+        cols = [
+            ColumnSchema(
+                name=c["name"],
+                kind=DatumKind(c["kind"]),
+                is_nullable=c["is_nullable"],
+                is_tag=c["is_tag"],
+                is_dictionary=c.get("is_dictionary", False),
+                comment=c.get("comment", ""),
+                default_value=c.get("default_value"),
+            )
+            for c in d["columns"]
+        ]
+        return Schema(
+            cols,
+            d["timestamp_index"],
+            d["primary_key_indexes"],
+            version=d["version"],
+        )
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, Schema)
+            and self.columns == other.columns
+            and self.timestamp_index == other.timestamp_index
+            and self.primary_key_indexes == other.primary_key_indexes
+        )
+
+    def __repr__(self) -> str:
+        cols = ", ".join(
+            f"{c.name}:{c.kind.value}{'[tag]' if c.is_tag else ''}" for c in self.columns
+        )
+        return f"Schema(v{self.version}, ts={self.timestamp_name}, [{cols}])"
+
+
+def compute_tsid(tag_arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Vectorized series-id hash over tag value columns.
+
+    The reference hashes tag bytes into a u64 ``tsid`` per row
+    (schema.rs TSID). Here: xxhash64 over the utf-8 of each tag value,
+    combined across tag columns with the 64-bit FNV-style mix so that the id
+    is order-sensitive and stable across processes.
+    """
+    if not tag_arrays:
+        return np.zeros(0, dtype=np.uint64)
+    n = len(tag_arrays[0])
+    out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
+    prime = np.uint64(0x100000001B3)
+    for arr in tag_arrays:
+        col_hash = np.empty(n, dtype=np.uint64)
+        if arr.dtype == object:
+            for i, v in enumerate(arr):
+                b = v.encode() if isinstance(v, str) else (v if isinstance(v, bytes) else str(v).encode())
+                col_hash[i] = xxhash.xxh64_intdigest(b)
+        else:
+            data = np.ascontiguousarray(arr)
+            itemsize = data.dtype.itemsize
+            raw = data.tobytes()
+            for i in range(n):
+                col_hash[i] = xxhash.xxh64_intdigest(raw[i * itemsize : (i + 1) * itemsize])
+        out = (out ^ col_hash) * prime
+    return out

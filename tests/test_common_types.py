@@ -1,0 +1,190 @@
+"""Tests for the type/schema core (ref test model: common_types inline tests)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from horaedb_tpu.common_types import (
+    ColumnSchema,
+    DatumKind,
+    RowGroup,
+    Schema,
+    TimeRange,
+    TSID_COLUMN,
+    compute_tsid,
+)
+
+
+def demo_schema() -> Schema:
+    # The README demo table: CREATE TABLE demo (name string TAG,
+    #   value double, t timestamp KEY) (ref README.md:55-88)
+    return Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+class TestDatumKind:
+    def test_sql_round_trip(self):
+        assert DatumKind.from_sql_type("double") is DatumKind.DOUBLE
+        assert DatumKind.from_sql_type("VARCHAR") is DatumKind.STRING
+        assert DatumKind.from_sql_type("Timestamp") is DatumKind.TIMESTAMP
+        assert DatumKind.from_sql_type("bigint") is DatumKind.INT64
+        with pytest.raises(ValueError):
+            DatumKind.from_sql_type("blob")
+
+    def test_key_kinds(self):
+        assert DatumKind.STRING.is_key_kind
+        assert DatumKind.TIMESTAMP.is_key_kind
+        assert not DatumKind.DOUBLE.is_key_kind
+
+    def test_numpy_dtypes(self):
+        assert DatumKind.TIMESTAMP.numpy_dtype == np.int64
+        assert DatumKind.DOUBLE.numpy_dtype == np.float64
+
+
+class TestSchema:
+    def test_auto_tsid_layout(self):
+        s = demo_schema()
+        # auto-tsid: [tsid, t, name, value]
+        assert s.names()[:2] == [TSID_COLUMN, "t"]
+        assert s.primary_key_indexes == (0, 1)
+        assert s.timestamp_name == "t"
+        assert s.tag_names == ("name",)
+        assert s.column("name").is_dictionary
+
+    def test_explicit_primary_key(self):
+        s = Schema.build(
+            [
+                ColumnSchema("host", DatumKind.STRING, is_tag=True),
+                ColumnSchema("ts", DatumKind.TIMESTAMP),
+                ColumnSchema("v", DatumKind.DOUBLE),
+            ],
+            timestamp_column="ts",
+            primary_key=["host", "ts"],
+        )
+        assert s.tsid_index is None
+        assert [s.columns[i].name for i in s.primary_key_indexes] == ["host", "ts"]
+
+    def test_timestamp_must_be_timestamp_kind(self):
+        with pytest.raises(ValueError):
+            Schema.build(
+                [ColumnSchema("ts", DatumKind.INT64)],
+                timestamp_column="ts",
+            )
+
+    def test_add_column_bumps_version(self):
+        s = demo_schema()
+        s2 = s.with_added_column(ColumnSchema("v2", DatumKind.DOUBLE))
+        assert s2.version == s.version + 1
+        assert s2.has_column("v2")
+        with pytest.raises(ValueError):
+            s2.with_added_column(ColumnSchema("v2", DatumKind.DOUBLE))
+
+    def test_dict_round_trip(self):
+        s = demo_schema()
+        assert Schema.from_dict(s.to_dict()) == s
+
+    def test_arrow_schema_tags_are_dictionary(self):
+        a = demo_schema().to_arrow()
+        assert pa.types.is_dictionary(a.field("name").type)
+
+
+class TestTimeRange:
+    def test_overlap_half_open(self):
+        a = TimeRange(0, 10)
+        assert a.overlaps(TimeRange(9, 20))
+        assert not a.overlaps(TimeRange(10, 20))
+        assert a.contains(0) and not a.contains(10)
+
+    def test_bucket_alignment_negative(self):
+        b = TimeRange.bucket_of(-1, 1000)
+        assert b == TimeRange(-1000, 0)
+
+    def test_buckets(self):
+        bs = TimeRange(500, 2500).buckets(1000)
+        assert [b.inclusive_start for b in bs] == [0, 1000, 2000]
+
+    def test_intersect(self):
+        assert TimeRange(0, 10).intersect(TimeRange(5, 20)) == TimeRange(5, 10)
+        assert TimeRange(0, 10).intersect(TimeRange(10, 20)).is_empty()
+
+
+class TestTsid:
+    def test_deterministic_and_tag_sensitive(self):
+        a = compute_tsid([np.array(["h1", "h2", "h1"], dtype=object)])
+        assert a[0] == a[2] != a[1]
+        b = compute_tsid([np.array(["h1"], dtype=object)])
+        assert b[0] == a[0]
+
+    def test_order_sensitive_across_columns(self):
+        ab = compute_tsid(
+            [np.array(["a"], dtype=object), np.array(["b"], dtype=object)]
+        )
+        ba = compute_tsid(
+            [np.array(["b"], dtype=object), np.array(["a"], dtype=object)]
+        )
+        assert ab[0] != ba[0]
+
+
+class TestRowGroup:
+    def rows(self):
+        return [
+            {"name": "h2", "value": 2.0, "t": 2000},
+            {"name": "h1", "value": 1.0, "t": 1000},
+            {"name": "h1", "value": 3.0, "t": 3000},
+        ]
+
+    def test_from_rows_computes_tsid(self):
+        rg = RowGroup.from_rows(demo_schema(), self.rows())
+        assert len(rg) == 3
+        tsid = rg.column(TSID_COLUMN)
+        assert tsid[1] == tsid[2] != tsid[0]
+        assert rg.time_range() == TimeRange(1000, 3001)
+
+    def test_nulls(self):
+        rg = RowGroup.from_rows(demo_schema(), [{"name": "h", "value": None, "t": 1}])
+        assert not rg.valid_mask("value")[0]
+        assert rg.to_pylist()[0]["value"] is None
+
+    def test_null_in_non_nullable_rejected(self):
+        with pytest.raises(ValueError):
+            RowGroup.from_rows(demo_schema(), [{"name": "h", "value": 1.0, "t": None}])
+
+    def test_sorted_by_key(self):
+        rg = RowGroup.from_rows(demo_schema(), self.rows()).sorted_by_key()
+        tsid = rg.column(TSID_COLUMN)
+        ts = rg.timestamps
+        keys = list(zip(tsid.tolist(), ts.tolist()))
+        assert keys == sorted(keys)
+
+    def test_seq_breaks_ties_newest_first(self):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(
+            schema,
+            [
+                {"name": "h", "value": 1.0, "t": 1000},
+                {"name": "h", "value": 2.0, "t": 1000},
+            ],
+        )
+        out = rg.sorted_by_key(seq=np.array([1, 2], dtype=np.uint64))
+        assert out.column("value")[0] == 2.0
+
+    def test_arrow_round_trip(self):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(schema, self.rows())
+        back = RowGroup.from_arrow(schema, rg.to_arrow())
+        assert back.to_pylist() == rg.to_pylist()
+
+    def test_concat_filter_slice(self):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(schema, self.rows())
+        cat = RowGroup.concat([rg, rg])
+        assert len(cat) == 6
+        flt = cat.filter(cat.column("value") > 1.5)
+        assert len(flt) == 4
+        assert len(cat.slice(1, 3)) == 2
