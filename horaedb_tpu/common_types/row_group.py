@@ -66,7 +66,7 @@ class RowGroup:
                 validity[col.name] = valid
         if schema.tsid_index is not None:
             tags = [columns[schema.columns[i].name] for i in schema.tag_indexes]
-            columns[TSID_COLUMN] = compute_tsid(tags)
+            columns[TSID_COLUMN] = compute_tsid(tags, num_rows=n)
         return RowGroup(schema, columns, validity)
 
     @staticmethod
@@ -74,7 +74,15 @@ class RowGroup:
         columns: dict[str, np.ndarray] = {}
         validity: dict[str, np.ndarray] = {}
         for col in schema.columns:
-            arr = batch.column(batch.schema.get_field_index(col.name))
+            idx = batch.schema.get_field_index(col.name)
+            if idx < 0:
+                # Column added by ALTER after this batch was written: all-NULL.
+                n = batch.num_rows
+                fill = col.kind.default_value()
+                columns[col.name] = np.full(n, fill, dtype=col.kind.numpy_dtype)
+                validity[col.name] = np.zeros(n, dtype=np.bool_)
+                continue
+            arr = batch.column(idx)
             if isinstance(arr, pa.ChunkedArray):
                 arr = arr.combine_chunks()
             if pa.types.is_dictionary(arr.type):

@@ -247,7 +247,32 @@ class Schema:
         return f"Schema(v{self.version}, ts={self.timestamp_name}, [{cols}])"
 
 
-def compute_tsid(tag_arrays: Sequence[np.ndarray]) -> np.ndarray:
+def project_schema(schema: "Schema", projection: Sequence[str] | None) -> "Schema":
+    """Sub-schema for a projected read.
+
+    The timestamp and primary-key columns are always force-included: every
+    storage read needs them for time filtering and merge ordering. Shared by
+    the SST reader and the memtable/merge path so both sides of a scan agree
+    on the projected layout.
+    """
+    if projection is None:
+        return schema
+    names = list(dict.fromkeys(projection))
+    if schema.timestamp_name not in names:
+        names.insert(0, schema.timestamp_name)
+    for i in reversed(schema.primary_key_indexes):
+        pk = schema.columns[i].name
+        if pk not in names:
+            names.insert(0, pk)
+    cols = [schema.column(n) for n in names]
+    ts_index = names.index(schema.timestamp_name)
+    pk_indexes = tuple(
+        names.index(schema.columns[i].name) for i in schema.primary_key_indexes
+    )
+    return Schema(cols, ts_index, pk_indexes, version=schema.version)
+
+
+def compute_tsid(tag_arrays: Sequence[np.ndarray], num_rows: int | None = None) -> np.ndarray:
     """Vectorized series-id hash over tag value columns.
 
     The reference hashes tag bytes into a u64 ``tsid`` per row
@@ -256,7 +281,8 @@ def compute_tsid(tag_arrays: Sequence[np.ndarray]) -> np.ndarray:
     is order-sensitive and stable across processes.
     """
     if not tag_arrays:
-        return np.zeros(0, dtype=np.uint64)
+        # Tag-less table: every row is the same (only) series, id 0.
+        return np.zeros(num_rows or 0, dtype=np.uint64)
     n = len(tag_arrays[0])
     out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
     prime = np.uint64(0x100000001B3)

@@ -1,0 +1,124 @@
+"""Flush: freeze memtables and dump them as time-bucketed L0 SSTs
+(ref: analytic_engine/src/instance/flush_compaction.rs:199-717).
+
+Pipeline (``FlushTask::run`` → ``dump_memtables`` in the reference):
+
+1. freeze the mutable memtable (version switch, cheap pointer swap);
+2. gather frozen rows + per-row sequences, sort by (primary key, seq desc)
+   — one vectorized lexsort over dense columns instead of the reference's
+   DataFusion reorder stream (reorder_memtable.rs);
+3. auto-pick ``segment_duration`` on the first flush from the observed time
+   span (ref: sampler.rs suggest_duration) and persist it via the manifest;
+4. split rows into aligned segment buckets; write one sorted L0 SST per
+   non-empty bucket;
+5. append manifest edits (AddFile* + Flushed) durably, then swap the new
+   files into the version and retire the flushed memtables.
+
+Crash safety: steps 1-4 leave orphan SSTs at worst (collected by purge);
+the version only changes after the manifest append succeeds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..common_types.row_group import RowGroup
+from .manifest import AddFile, AlterOptions, Flushed, MetaEdit
+from .memtable import ColumnarMemTable
+from .options import TableOptions, UpdateMode, suggest_segment_duration
+from .sst.manager import FileHandle
+from .sst.writer import SstWriter, WriteOptions
+from .table_data import TableData
+
+
+@dataclass
+class FlushResult:
+    files_added: int
+    rows_flushed: int
+    flushed_sequence: int
+
+
+class Flusher:
+    def __init__(self, table: TableData) -> None:
+        self.table = table
+
+    def flush(self) -> FlushResult:
+        """Flush everything currently in memory. Serialized per table."""
+        table = self.table
+        with table.serial_lock:
+            table.version.switch_memtable()
+            frozen = table.version.immutables()
+            if not frozen:
+                return FlushResult(0, 0, table.version.flushed_sequence)
+            return self._dump_memtables(frozen)
+
+    def _dump_memtables(self, memtables: list[ColumnarMemTable]) -> FlushResult:
+        table = self.table
+        parts: list[RowGroup] = []
+        seqs: list[np.ndarray] = []
+        max_seq = 0
+        for m in memtables:
+            rows, seq = m.scan()
+            if len(rows):
+                parts.append(rows)
+                seqs.append(seq)
+            max_seq = max(max_seq, m.last_sequence)
+        if not parts:
+            table.version.retire_immutables([m.id for m in memtables], max_seq)
+            return FlushResult(0, 0, table.version.flushed_sequence)
+
+        all_rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
+        all_seq = np.concatenate(seqs)
+
+        # Auto-pick segment duration on first flush.
+        edits: list[MetaEdit] = []
+        seg_ms = table.options.segment_duration_ms
+        if seg_ms is None:
+            tr = all_rows.time_range()
+            seg_ms = suggest_segment_duration(tr.exclusive_end - tr.inclusive_start)
+            table.options = TableOptions.from_dict(
+                {**table.options.to_dict(), "segment_duration_ms": seg_ms}
+            )
+            edits.append(AlterOptions({"segment_duration_ms": seg_ms}))
+
+        sorted_rows = all_rows.sorted_by_key(seq=all_seq)
+        if table.options.update_mode is UpdateMode.OVERWRITE:
+            # Collapse intra-flush duplicates now so SSTs are dup-free runs;
+            # the merge read path relies on file-granularity versioning.
+            from .merge import dedup_sorted
+
+            sorted_rows = dedup_sorted(sorted_rows)
+
+        writer = SstWriter(
+            table.store,
+            WriteOptions(
+                num_rows_per_row_group=table.options.num_rows_per_row_group,
+                compression=table.options.compression,
+            ),
+        )
+
+        # Segment split: bucket ids per row, then contiguous slices after a
+        # stable sort by bucket (keeps key order within each bucket).
+        ts = sorted_rows.timestamps
+        buckets = ts // seg_ms
+        new_handles: list[FileHandle] = []
+        rows_flushed = 0
+        for b in np.unique(buckets):
+            idx = np.nonzero(buckets == b)[0]
+            part = sorted_rows.take(idx)
+            fid = table.alloc_file_id()
+            path = table.sst_object_path(fid)
+            meta = writer.write(path, fid, part, max_sequence=max_seq)
+            edits.append(AddFile(0, meta, path))
+            new_handles.append(FileHandle(meta, path, 0))
+            rows_flushed += len(part)
+
+        edits.append(Flushed(max_seq))
+        table.manifest.append_edits(edits)
+
+        for h in new_handles:
+            table.version.levels.add_file(0, h)
+        table.version.retire_immutables([m.id for m in memtables], max_seq)
+        return FlushResult(len(new_handles), rows_flushed, max_seq)

@@ -1,0 +1,186 @@
+"""Engine instance — the storage engine facade
+(ref: analytic_engine/src/instance/mod.rs, instance/engine.rs).
+
+Owns every open table's runtime state and implements the table lifecycle
+(create/open/drop) plus the write and read entry points. WAL durability is
+layered in by the caller-supplied ``WalManager`` (None = the reference's
+``disable_data_wal`` semantics, setup.rs:122-127 — memtable contents are
+lost on crash, SSTs are not).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema
+from ..table_engine.predicate import Predicate
+from ..utils.object_store import ObjectStore
+from .flush import FlushResult, Flusher
+from .manifest import AlterOptions, AlterSchema, Manifest
+from .merge import merge_read
+from .options import TableOptions
+from .table_data import TableData
+
+
+@dataclass
+class EngineConfig:
+    # Space-level write buffer: flush the biggest table when the sum of
+    # memtable bytes passes this (ref: space.rs should_flush_space).
+    space_write_buffer_size: int = 256 << 20
+
+
+class Instance:
+    def __init__(
+        self,
+        store: ObjectStore,
+        config: EngineConfig | None = None,
+        wal=None,  # Optional[WalManager]; wired in engine/wal
+    ) -> None:
+        self.store = store
+        self.config = config or EngineConfig()
+        self.wal = wal
+        self._tables: dict[tuple[int, int], TableData] = {}
+        self._lock = threading.RLock()
+
+    # ---- lifecycle -----------------------------------------------------
+    def create_table(
+        self,
+        space_id: int,
+        table_id: int,
+        name: str,
+        schema: Schema,
+        options: TableOptions | None = None,
+    ) -> TableData:
+        options = options or TableOptions()
+        with self._lock:
+            key = (space_id, table_id)
+            if key in self._tables:
+                raise ValueError(f"table already open: {name} ({key})")
+            manifest = Manifest(self.store, space_id, table_id)
+            if manifest.exists():
+                raise ValueError(f"table already exists in storage: {name} ({key})")
+            manifest.append_edits(
+                [AlterSchema(schema), AlterOptions(options.to_dict())]
+            )
+            table = TableData(space_id, table_id, name, schema, options, manifest, self.store)
+            self._tables[key] = table
+            return table
+
+    def open_table(self, space_id: int, table_id: int, name: str) -> Optional[TableData]:
+        with self._lock:
+            key = (space_id, table_id)
+            if key in self._tables:
+                return self._tables[key]
+            manifest = Manifest(self.store, space_id, table_id)
+            if not manifest.exists():
+                return None
+            state = manifest.load()
+            if state.schema is None:
+                return None
+            options = TableOptions.from_dict(state.options)
+            table = TableData(
+                space_id, table_id, name, state.schema, options, manifest, self.store,
+                recovered_state=state,
+            )
+            self._tables[key] = table
+            if self.wal is not None:
+                self._replay_wal(table)
+            return table
+
+    def close_table(self, table: TableData, flush: bool = True) -> None:
+        # Lock order is always serial_lock -> _lock (flush_table takes the
+        # table's serial_lock); never hold _lock across a flush.
+        if flush:
+            self.flush_table(table)
+        with self._lock:
+            self._tables.pop((table.space_id, table.table_id), None)
+
+    def drop_table(self, table: TableData) -> None:
+        with table.serial_lock:
+            table.dropped = True
+            for h in table.version.levels.all_files():
+                self.store.delete(h.path)
+            table.manifest.destroy()
+            if self.wal is not None:
+                self.wal.delete_table(table.table_id)
+            with self._lock:
+                self._tables.pop((table.space_id, table.table_id), None)
+
+    def open_tables(self) -> list[TableData]:
+        with self._lock:
+            return list(self._tables.values())
+
+    # ---- write path ----------------------------------------------------
+    def write(self, table: TableData, rows: RowGroup) -> int:
+        """Durable (WAL) write into the memtable; returns the sequence.
+
+        Serialized per table (ref: single-writer discipline,
+        serial_executor.rs). Triggers a synchronous flush when the table's
+        write buffer fills (background flush arrives with the runtime
+        layer).
+        """
+        if table.dropped:
+            raise ValueError(f"table dropped: {table.name}")
+        if rows.schema.version != table.schema.version:
+            raise ValueError(
+                f"schema mismatch: table {table.name} v{table.schema.version}, "
+                f"write v{rows.schema.version}"
+            )
+        with table.serial_lock:
+            seq = table.alloc_sequence()
+            if self.wal is not None:
+                self.wal.append(table.table_id, seq, rows)
+            table.put_rows(rows, seq)
+            if table.should_flush():
+                self.flush_table(table)
+            return seq
+
+    # ---- read path -----------------------------------------------------
+    def read(
+        self,
+        table: TableData,
+        predicate: Predicate | None = None,
+        projection: Optional[Sequence[str]] = None,
+    ) -> RowGroup:
+        predicate = predicate or Predicate.all_time()
+        view = table.version.pick_read_view(predicate.time_range)
+        return merge_read(
+            view,
+            table.schema,
+            predicate,
+            self.store,
+            table.options.update_mode,
+            projection=projection,
+        )
+
+    # ---- maintenance ---------------------------------------------------
+    def flush_table(self, table: TableData) -> FlushResult:
+        result = Flusher(table).flush()
+        if self.wal is not None and result.flushed_sequence:
+            self.wal.mark_flushed(table.table_id, result.flushed_sequence)
+        self._purge(table)
+        return result
+
+    def alter_schema(self, table: TableData, schema: Schema) -> None:
+        with table.serial_lock:
+            if schema.version <= table.schema.version:
+                raise ValueError(
+                    f"stale schema version {schema.version} <= {table.schema.version}"
+                )
+            # Freeze old-schema rows, flush them, then install the new schema.
+            self.flush_table(table)
+            table.version.alter_schema(schema)
+            table.manifest.append_edits([AlterSchema(schema)])
+
+    def _replay_wal(self, table: TableData) -> None:
+        """Re-apply WAL entries newer than the flushed sequence."""
+        for seq, rows in self.wal.read_from(table.table_id, table.version.flushed_sequence + 1):
+            table.put_rows(rows, seq)
+            table.set_last_sequence(seq)
+
+    def _purge(self, table: TableData) -> None:
+        for h in table.version.levels.drain_purge_queue():
+            self.store.delete(h.path)

@@ -1,0 +1,117 @@
+"""Columnar memtable (ref: analytic_engine/src/memtable/).
+
+The reference offers three memtable kinds — skiplist (row-ordered),
+columnar, layered (memtable/mod.rs:193) — because its scan path wants
+key-ordered row iterators. The TPU scan path wants dense column buffers, so
+the native memtable here is columnar and *unordered*: appends are O(1)
+chunk appends with a sequence number per row, and ordering is imposed
+lazily (a device sort at flush/merge time, where it can batch). That trades
+the skiplist's per-row insert cost for zero-cost ingest plus one vectorized
+sort — the right trade when sorting is a TPU kernel.
+
+Concurrency: appends take a lock (the engine already serializes writers
+per table, ref: serial_executor.rs); scans snapshot the chunk list without
+blocking writers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema
+from ..common_types.time_range import TimeRange
+from ..table_engine.predicate import Predicate
+
+
+class ColumnarMemTable:
+    def __init__(self, schema: Schema, id_: int = 0) -> None:
+        self.schema = schema
+        self.id = id_
+        self._chunks: list[RowGroup] = []
+        self._seq_chunks: list[np.ndarray] = []
+        self._lock = threading.Lock()
+        self._num_rows = 0
+        self._approx_bytes = 0
+        self._min_ts: Optional[int] = None
+        self._max_ts: Optional[int] = None
+        self._min_seq: Optional[int] = None
+        self._max_seq = 0
+
+    # ---- writes --------------------------------------------------------
+    def put(self, rows: RowGroup, sequence: int) -> None:
+        """Append a row group under one WAL sequence number."""
+        if rows.schema.version != self.schema.version:
+            raise ValueError(
+                f"schema version mismatch: memtable v{self.schema.version}, "
+                f"rows v{rows.schema.version}"
+            )
+        n = len(rows)
+        if n == 0:
+            return
+        seq_arr = np.full(n, sequence, dtype=np.uint64)
+        tr = rows.time_range()
+        size = sum(
+            a.nbytes if a.dtype != object else sum(len(str(v)) for v in a)
+            for a in rows.columns.values()
+        )
+        with self._lock:
+            self._chunks.append(rows)
+            self._seq_chunks.append(seq_arr)
+            self._num_rows += n
+            self._approx_bytes += size + seq_arr.nbytes
+            self._min_ts = tr.inclusive_start if self._min_ts is None else min(self._min_ts, tr.inclusive_start)
+            self._max_ts = tr.exclusive_end if self._max_ts is None else max(self._max_ts, tr.exclusive_end)
+            self._min_seq = sequence if self._min_seq is None else min(self._min_seq, sequence)
+            self._max_seq = max(self._max_seq, sequence)
+
+    # ---- reads ---------------------------------------------------------
+    def scan(self, predicate: Predicate | None = None) -> tuple[RowGroup, np.ndarray]:
+        """Snapshot matching rows -> (rows, per-row sequence numbers).
+
+        Rows come back in insertion order; coarse time-range filtering only
+        (exact predicate evaluation belongs to the execution kernel).
+        """
+        with self._lock:
+            chunks = list(self._chunks)
+            seqs = list(self._seq_chunks)
+        if not chunks:
+            empty = {
+                c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in self.schema.columns
+            }
+            return RowGroup(self.schema, empty), np.empty(0, dtype=np.uint64)
+        rows = RowGroup.concat(chunks)
+        seq = np.concatenate(seqs)
+        if predicate is not None and not predicate.time_range.covers(self.time_range()):
+            ts = rows.timestamps
+            mask = (ts >= predicate.time_range.inclusive_start) & (
+                ts < predicate.time_range.exclusive_end
+            )
+            idx = np.nonzero(mask)[0]
+            rows, seq = rows.take(idx), seq[idx]
+        return rows, seq
+
+    # ---- stats ---------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def approx_bytes(self) -> int:
+        return self._approx_bytes
+
+    @property
+    def last_sequence(self) -> int:
+        return self._max_seq
+
+    def is_empty(self) -> bool:
+        return self._num_rows == 0
+
+    def time_range(self) -> TimeRange:
+        with self._lock:
+            if self._min_ts is None:
+                return TimeRange.empty()
+            return TimeRange(self._min_ts, self._max_ts)

@@ -1,0 +1,123 @@
+"""Merge-dedup read path (ref: analytic_engine/src/row_iter/{merge.rs,dedup.rs,chain.rs}).
+
+The reference streams rows through a BinaryHeap k-way merge with a dedup
+iterator on top (merge.rs:134-181). Re-designed for TPU: every overlapping
+source (memtables + SSTs) is materialized as dense columns, concatenated,
+and sorted ONCE by (primary key, version desc), then duplicates collapse
+with a shift-compare mask. Sort+mask is exactly what accelerators are good
+at, and it's the same algorithm compaction uses on device (ops/merge_dedup).
+
+Version ordering across sources (matching the reference's sequence rules):
+memtable rows carry their true per-row WAL sequence; SST rows carry the
+file's ``max_sequence`` (flush already collapsed intra-file duplicates, so
+file-granularity versioning is exact — newer files always beat older ones
+for the same key).
+
+APPEND-mode tables skip sort+dedup entirely (ref: chain.rs no-sort
+concatenation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema, project_schema
+from ..table_engine.predicate import Predicate
+from ..utils.object_store import ObjectStore
+from .options import UpdateMode
+from .sst.reader import SstReader
+from .version import ReadView
+
+
+def dedup_sorted(rows: RowGroup) -> RowGroup:
+    """Collapse duplicate primary keys, keeping the FIRST row of each run.
+
+    Requires rows sorted by primary key with the winning version first
+    (``RowGroup.sorted_by_key(seq=...)`` produces exactly that order).
+    """
+    n = len(rows)
+    if n <= 1:
+        return rows
+    keep = np.ones(n, dtype=np.bool_)
+    same = np.ones(n - 1, dtype=np.bool_)
+    for i in rows.schema.primary_key_indexes:
+        col = rows.columns[rows.schema.columns[i].name]
+        same &= col[1:] == col[:-1]
+    keep[1:] = ~same
+    if keep.all():
+        return rows
+    return rows.filter(keep)
+
+
+def scan_sources(
+    view: ReadView,
+    schema: Schema,
+    predicate: Predicate,
+    store: ObjectStore,
+    projection: Optional[Sequence[str]] = None,
+) -> tuple[list[RowGroup], list[np.ndarray]]:
+    """Materialize every source in the view as (rows, per-row version)."""
+    parts: list[RowGroup] = []
+    versions: list[np.ndarray] = []
+    for handle in view.ssts:
+        reader = SstReader(store, handle.path)
+        rows = reader.read(schema, predicate, projection=projection)
+        if len(rows):
+            parts.append(rows)
+            versions.append(np.full(len(rows), handle.meta.max_sequence, dtype=np.uint64))
+    proj_schema = project_schema(schema, projection)
+    for mem in view.memtables:
+        rows, seq = mem.scan(predicate)
+        if len(rows):
+            if projection is not None:
+                keep = proj_schema.names()
+                rows = RowGroup(
+                    proj_schema,
+                    {k: rows.columns[k] for k in keep},
+                    {k: v for k, v in rows.validity.items() if k in keep},
+                )
+            parts.append(rows)
+            versions.append(seq)
+    return parts, versions
+
+
+def merge_read(
+    view: ReadView,
+    schema: Schema,
+    predicate: Predicate,
+    store: ObjectStore,
+    update_mode: UpdateMode,
+    projection: Optional[Sequence[str]] = None,
+) -> RowGroup:
+    """Read a consistent, time-filtered, deduplicated row set.
+
+    Column filters from the predicate are NOT applied — they run in the
+    execution kernel AFTER dedup (an overwritten row version must not
+    resurface just because the newest version fails the filter).
+    """
+    parts, versions = scan_sources(view, schema, predicate, store, projection)
+    out_schema = parts[0].schema if parts else project_schema(schema, projection)
+    if not parts:
+        empty = {c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in out_schema.columns}
+        return RowGroup(out_schema, empty)
+
+    rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
+    version = np.concatenate(versions)
+
+    # Exact time filter (timestamp is a key column: safe before dedup).
+    tr = predicate.time_range
+    ts = rows.timestamps
+    mask = (ts >= tr.inclusive_start) & (ts < tr.exclusive_end)
+    if not mask.all():
+        idx = np.nonzero(mask)[0]
+        rows, version = rows.take(idx), version[idx]
+
+    if update_mode is UpdateMode.APPEND:
+        return rows
+    if len(parts) == 1 and len(view.memtables) == 0:
+        # Single SST: flush/compaction already deduped it.
+        return rows
+    return dedup_sorted(rows.sorted_by_key(seq=version))

@@ -1,0 +1,94 @@
+"""SST level management (ref: analytic_engine/src/sst/manager.rs, file.rs).
+
+Exactly two levels, like the reference (file.rs:66-69):
+
+- L0: freshly flushed, time-bucketed but *overlapping* sorted runs;
+- L1: compacted, non-overlapping within a time window.
+
+``LevelsController`` owns file handles per level, answers time-range picks
+for reads, collects TTL-expired files, and queues removed files for purge.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ...common_types.time_range import TimeRange
+from .meta import SstMeta
+
+MAX_LEVEL = 1  # levels are 0 and 1
+
+
+@dataclass(frozen=True)
+class FileHandle:
+    meta: SstMeta
+    path: str
+    level: int
+
+    @property
+    def file_id(self) -> int:
+        return self.meta.file_id
+
+    @property
+    def time_range(self) -> TimeRange:
+        return self.meta.time_range
+
+
+class LevelsController:
+    def __init__(self) -> None:
+        self._levels: list[dict[int, FileHandle]] = [{} for _ in range(MAX_LEVEL + 1)]
+        self._purge_queue: list[FileHandle] = []
+        self._lock = threading.RLock()
+
+    # ---- mutation ------------------------------------------------------
+    def add_file(self, level: int, handle: FileHandle) -> None:
+        if not (0 <= level <= MAX_LEVEL):
+            raise ValueError(f"invalid level {level}")
+        with self._lock:
+            self._levels[level][handle.file_id] = handle
+
+    def remove_files(self, level: int, file_ids: list[int]) -> None:
+        with self._lock:
+            for fid in file_ids:
+                h = self._levels[level].pop(fid, None)
+                if h is not None:
+                    self._purge_queue.append(h)
+
+    def drain_purge_queue(self) -> list[FileHandle]:
+        with self._lock:
+            out, self._purge_queue = self._purge_queue, []
+            return out
+
+    # ---- queries -------------------------------------------------------
+    def files_at(self, level: int) -> list[FileHandle]:
+        with self._lock:
+            return sorted(
+                self._levels[level].values(),
+                key=lambda h: (h.time_range.inclusive_start, h.file_id),
+            )
+
+    def all_files(self) -> list[FileHandle]:
+        return [h for lvl in range(MAX_LEVEL + 1) for h in self.files_at(lvl)]
+
+    def pick_overlapping(self, time_range: TimeRange) -> list[FileHandle]:
+        """Read view: every SST whose range overlaps, L0 first (newer data).
+
+        L0 runs may overlap each other; L1 runs don't. The merge path uses
+        `meta.max_sequence` for conflict resolution, so order here is only
+        a grouping convenience.
+        """
+        return [h for h in self.all_files() if h.time_range.overlaps(time_range)]
+
+    def expired_files(self, now_ms: int, ttl_ms: int) -> list[FileHandle]:
+        """Files entirely older than the TTL horizon (ref: TTL purge,
+        sst/manager.rs:100-118)."""
+        horizon = now_ms - ttl_ms
+        return [h for h in self.all_files() if h.time_range.exclusive_end <= horizon]
+
+    def total_size_bytes(self) -> int:
+        return sum(h.meta.size_bytes for h in self.all_files())
+
+    def max_sequence(self) -> int:
+        files = self.all_files()
+        return max((h.meta.max_sequence for h in files), default=0)

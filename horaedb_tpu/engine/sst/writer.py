@@ -1,0 +1,117 @@
+"""Parquet SST writer (ref: analytic_engine/src/sst/parquet/writer.rs).
+
+Differences from the reference, by design for the TPU read path:
+
+- rows are written already sorted by primary key (the flush path sorts on
+  device or host before handing rows here), so SSTs are sorted runs the
+  merge kernel can consume directly;
+- tag columns are dictionary encoded in the Parquet schema (the reference
+  *samples* data to decide encodings, writer.rs:553-614 — here tags are
+  always dictionaries because the device kernels want integer codes);
+- zstd compression, configurable rows per row group
+  (`num_rows_per_row_group`, ref table_options.rs).
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ...common_types.row_group import RowGroup
+from ...common_types.schema import Schema
+from ...utils.object_store import ObjectStore
+from .meta import SST_META_KEY, SstMeta
+
+import json
+
+
+@dataclass
+class WriteOptions:
+    num_rows_per_row_group: int = 8192
+    compression: str = "zstd"
+
+
+class SstWriter:
+    def __init__(self, store: ObjectStore, options: WriteOptions | None = None) -> None:
+        self.store = store
+        self.options = options or WriteOptions()
+
+    def write(
+        self,
+        path: str,
+        file_id: int,
+        data: RowGroup,
+        max_sequence: int,
+    ) -> SstMeta:
+        """Serialize a (key-sorted) row group to a Parquet SST and store it."""
+        schema = data.schema
+        batch = data.to_arrow()
+        table = pa.Table.from_batches([batch])
+
+        column_ranges = _column_ranges(data)
+        tr = data.time_range()
+
+        meta = SstMeta(
+            file_id=file_id,
+            time_range=tr,
+            max_sequence=max_sequence,
+            num_rows=len(data),
+            size_bytes=0,  # patched below once serialized
+            schema_version=schema.version,
+            column_ranges=column_ranges,
+        )
+        existing = table.schema.metadata or {}
+        table = table.replace_schema_metadata(
+            {**existing, SST_META_KEY: json.dumps(meta.to_dict()).encode()}
+        )
+
+        buf = io.BytesIO()
+        pq.write_table(
+            table,
+            buf,
+            row_group_size=self.options.num_rows_per_row_group,
+            compression=self.options.compression,
+            use_dictionary=True,
+            write_statistics=True,
+        )
+        raw = buf.getvalue()
+        self.store.put(path, raw)
+        return SstMeta(
+            file_id=meta.file_id,
+            time_range=meta.time_range,
+            max_sequence=meta.max_sequence,
+            num_rows=meta.num_rows,
+            size_bytes=len(raw),
+            schema_version=meta.schema_version,
+            column_ranges=meta.column_ranges,
+        )
+
+
+def _column_ranges(data: RowGroup) -> dict:
+    """File-level min/max per numeric + string column for manifest pruning."""
+    out = {}
+    if len(data) == 0:
+        return out
+    for col in data.schema.columns:
+        arr = data.columns[col.name]
+        mask = data.valid_mask(col.name)
+        if not mask.any():
+            continue
+        vals = arr[mask]
+        try:
+            if arr.dtype == object:
+                lo, hi = min(vals), max(vals)
+                # Footer meta is JSON; bytes ranges aren't representable
+                # there, and pruning on varbinary isn't worth the encode.
+                if isinstance(lo, bytes) or isinstance(hi, bytes):
+                    continue
+                out[col.name] = (lo, hi)
+            else:
+                out[col.name] = (vals.min().item(), vals.max().item())
+        except (TypeError, ValueError):
+            continue
+    return out

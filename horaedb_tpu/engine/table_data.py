@@ -1,0 +1,102 @@
+"""Per-table runtime state (ref: analytic_engine/src/table/data.rs).
+
+Owns everything one table needs at runtime: schema/options, the MVCC
+version, the manifest, id allocation, and the single-writer discipline
+(one lock per table serializes write/flush/alter — ref: the per-table
+``TableOpSerialExecutor``, instance/serial_executor.rs:78-143).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema
+from ..utils.object_store import ObjectStore
+from .manifest import AlterOptions, AlterSchema, Manifest, TableManifestState
+from .options import TableOptions
+from .sst.meta import sst_path
+from .version import TableVersion
+
+
+class TableData:
+    def __init__(
+        self,
+        space_id: int,
+        table_id: int,
+        name: str,
+        schema: Schema,
+        options: TableOptions,
+        manifest: Manifest,
+        store: ObjectStore,
+        recovered_state: Optional[TableManifestState] = None,
+    ) -> None:
+        self.space_id = space_id
+        self.table_id = table_id
+        self.name = name
+        self.options = options
+        self.manifest = manifest
+        self.store = store
+        self.serial_lock = threading.RLock()  # single-writer per table
+
+        if recovered_state is not None:
+            self.version = TableVersion(schema, recovered_state.levels)
+            self.version.flushed_sequence = recovered_state.flushed_sequence
+            self._next_file_id = recovered_state.next_file_id
+            self._last_sequence = max(
+                recovered_state.flushed_sequence, recovered_state.levels.max_sequence()
+            )
+        else:
+            self.version = TableVersion(schema)
+            self._next_file_id = 1
+            self._last_sequence = 0
+        self.dropped = False
+
+    # ---- id / sequence allocation -------------------------------------
+    def alloc_file_id(self) -> int:
+        with self.serial_lock:
+            fid = self._next_file_id
+            self._next_file_id += 1
+            return fid
+
+    def alloc_sequence(self) -> int:
+        with self.serial_lock:
+            self._last_sequence += 1
+            return self._last_sequence
+
+    @property
+    def last_sequence(self) -> int:
+        return self._last_sequence
+
+    def set_last_sequence(self, seq: int) -> None:
+        """WAL replay fast-forwards the sequence counter."""
+        with self.serial_lock:
+            self._last_sequence = max(self._last_sequence, seq)
+
+    # ---- schema --------------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self.version.schema
+
+    def sst_object_path(self, file_id: int) -> str:
+        return sst_path(self.space_id, self.table_id, file_id)
+
+    # ---- write ---------------------------------------------------------
+    def put_rows(self, rows: RowGroup, sequence: int) -> None:
+        self.version.mutable.put(rows, sequence)
+
+    def should_flush(self) -> bool:
+        return self.version.mutable_bytes() >= self.options.write_buffer_size
+
+    def metrics(self) -> dict:
+        return {
+            "table": self.name,
+            "memtable_bytes": self.version.total_memtable_bytes(),
+            "num_ssts": len(self.version.levels.all_files()),
+            "sst_bytes": self.version.levels.total_size_bytes(),
+            "last_sequence": self._last_sequence,
+            "flushed_sequence": self.version.flushed_sequence,
+        }

@@ -1,0 +1,82 @@
+"""Scan predicates (ref: src/table_engine/src/predicate.rs).
+
+A ``Predicate`` is the filter contract between the query layer and storage:
+a time range (always extracted — it drives segment/SST/row-group pruning)
+plus a conjunction of simple column filters. Storage uses it for min-max
+pruning; the TPU scan kernel evaluates the exact filters on device.
+
+Filters are deliberately first-order (col op literal): that is what can be
+pushed below the scan and compiled into the fused kernel. Anything richer
+stays in the executor's post-filter.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from ..common_types.time_range import TimeRange
+
+
+class FilterOp(enum.Enum):
+    EQ = "="
+    NE = "!="
+    LT = "<"
+    LE = "<="
+    GT = ">"
+    GE = ">="
+    IN = "in"
+
+
+@dataclass(frozen=True)
+class ColumnFilter:
+    column: str
+    op: FilterOp
+    value: Any  # literal, or tuple of literals for IN
+
+    def evaluate_min_max(self, lo: Any, hi: Any) -> bool:
+        """Can any row with column values in [lo, hi] satisfy this filter?
+
+        Used for row-group pruning; must never return False for a group
+        that contains a matching row (pruning is only an optimization).
+        """
+        if lo is None or hi is None:
+            return True
+        try:
+            if self.op is FilterOp.EQ:
+                return lo <= self.value <= hi
+            if self.op is FilterOp.NE:
+                return not (lo == hi == self.value)
+            if self.op is FilterOp.LT:
+                return lo < self.value
+            if self.op is FilterOp.LE:
+                return lo <= self.value
+            if self.op is FilterOp.GT:
+                return hi > self.value
+            if self.op is FilterOp.GE:
+                return hi >= self.value
+            if self.op is FilterOp.IN:
+                return any(lo <= v <= hi for v in self.value)
+        except TypeError:
+            return True  # incomparable types: don't prune
+        return True
+
+
+@dataclass(frozen=True)
+class Predicate:
+    time_range: TimeRange = field(default_factory=TimeRange.min_to_max)
+    filters: tuple[ColumnFilter, ...] = ()
+
+    @staticmethod
+    def all_time(filters: Sequence[ColumnFilter] = ()) -> "Predicate":
+        return Predicate(TimeRange.min_to_max(), tuple(filters))
+
+    def with_time_range(self, tr: TimeRange) -> "Predicate":
+        return Predicate(tr, self.filters)
+
+    def filters_on(self, column: str) -> list[ColumnFilter]:
+        return [f for f in self.filters if f.column == column]
+
+    def referenced_columns(self) -> set[str]:
+        return {f.column for f in self.filters}

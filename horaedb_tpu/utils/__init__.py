@@ -1,0 +1,1 @@
+"""Cross-cutting components (ref: src/components/*)."""

@@ -1,0 +1,207 @@
+"""Object store abstraction (ref: src/components/object_store).
+
+The reference re-exports the Rust ``object_store`` crate and layers caches on
+top (mem_cache.rs, disk_cache.rs). Here the trait is a small ABC with the
+operations the engine actually needs — whole/range reads, atomic-ish puts,
+listing, delete — with three impls:
+
+- ``MemoryStore``      — tests / ephemeral
+- ``LocalDiskStore``   — standalone deployments (write-to-temp + rename)
+- ``MemCacheStore``    — sharded-LRU read-through page cache wrapper
+                         (ref: mem_cache.rs partitioned LRU)
+
+S3/OSS-style remote backends slot in behind the same ABC in a later round
+(zero-egress image: nothing to talk to here).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from abc import ABC, abstractmethod
+from collections import OrderedDict
+from typing import Iterator, Optional
+
+
+class ObjectStore(ABC):
+    @abstractmethod
+    def put(self, path: str, data: bytes) -> None: ...
+
+    @abstractmethod
+    def get(self, path: str) -> bytes: ...
+
+    @abstractmethod
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        """Bytes in [start, end) — the SST reader's footer/page reads."""
+
+    @abstractmethod
+    def head(self, path: str) -> int:
+        """Size in bytes; raises FileNotFoundError if absent."""
+
+    @abstractmethod
+    def delete(self, path: str) -> None: ...
+
+    @abstractmethod
+    def list(self, prefix: str = "") -> Iterator[str]: ...
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.head(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+
+class MemoryStore(ObjectStore):
+    def __init__(self) -> None:
+        self._objects: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._objects[path] = bytes(data)
+
+    def get(self, path: str) -> bytes:
+        try:
+            return self._objects[path]
+        except KeyError:
+            raise FileNotFoundError(path) from None
+
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        return self.get(path)[start:end]
+
+    def head(self, path: str) -> int:
+        return len(self.get(path))
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._objects.pop(path, None)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        with self._lock:
+            keys = sorted(self._objects)
+        return iter([k for k in keys if k.startswith(prefix)])
+
+
+class LocalDiskStore(ObjectStore):
+    """Filesystem-backed store; puts are atomic via temp-file + rename."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path))
+        if not p.startswith(self.root):
+            raise ValueError(f"path escapes store root: {path!r}")
+        return p
+
+    def put(self, path: str, data: bytes) -> None:
+        dst = self._abs(path)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, dst)
+
+    def get(self, path: str) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            return f.read()
+
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            f.seek(start)
+            return f.read(end - start)
+
+    def head(self, path: str) -> int:
+        return os.path.getsize(self._abs(path))
+
+    def delete(self, path: str) -> None:
+        try:
+            os.remove(self._abs(path))
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                rel = rel.replace(os.sep, "/")
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return iter(sorted(out))
+
+    def local_path(self, path: str) -> str:
+        """Direct filesystem path — lets pyarrow mmap SSTs instead of
+        round-tripping bytes through Python."""
+        return self._abs(path)
+
+
+class MemCacheStore(ObjectStore):
+    """Read-through whole-object LRU cache over another store.
+
+    Sharded like the reference's partitioned LRU (mem_cache.rs:64-158) to
+    keep lock contention off the scan path.
+    """
+
+    SHARDS = 16
+
+    def __init__(self, inner: ObjectStore, capacity_bytes: int) -> None:
+        self.inner = inner
+        self._shard_cap = max(1, capacity_bytes // self.SHARDS)
+        self._shards = [OrderedDict() for _ in range(self.SHARDS)]
+        self._sizes = [0] * self.SHARDS
+        self._locks = [threading.Lock() for _ in range(self.SHARDS)]
+        self.hits = 0
+        self.misses = 0
+
+    def _shard(self, path: str) -> int:
+        return hash(path) % self.SHARDS
+
+    def get(self, path: str) -> bytes:
+        i = self._shard(path)
+        with self._locks[i]:
+            cached = self._shards[i].get(path)
+            if cached is not None:
+                self._shards[i].move_to_end(path)
+                self.hits += 1
+                return cached
+        self.misses += 1
+        data = self.inner.get(path)
+        with self._locks[i]:
+            if path not in self._shards[i]:
+                self._shards[i][path] = data
+                self._sizes[i] += len(data)
+                while self._sizes[i] > self._shard_cap and len(self._shards[i]) > 1:
+                    _, evicted = self._shards[i].popitem(last=False)
+                    self._sizes[i] -= len(evicted)
+        return data
+
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        return self.get(path)[start:end]
+
+    def put(self, path: str, data: bytes) -> None:
+        self.inner.put(path, data)
+        self._invalidate(path)
+
+    def delete(self, path: str) -> None:
+        self.inner.delete(path)
+        self._invalidate(path)
+
+    def _invalidate(self, path: str) -> None:
+        i = self._shard(path)
+        with self._locks[i]:
+            old = self._shards[i].pop(path, None)
+            if old is not None:
+                self._sizes[i] -= len(old)
+
+    def head(self, path: str) -> int:
+        return self.inner.head(path)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        return self.inner.list(prefix)

@@ -1,0 +1,278 @@
+"""Engine integration tests, in-process
+(ref model: analytic_engine/src/tests/{read_write_test,alter_test,drop_test,open_test}.rs
+driven by the TestEnv fixture in tests/util.rs).
+"""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema, TimeRange
+from horaedb_tpu.engine.instance import Instance
+from horaedb_tpu.engine.options import TableOptions, UpdateMode
+from horaedb_tpu.table_engine import Predicate
+from horaedb_tpu.utils.object_store import MemoryStore
+
+
+def demo_schema():
+    return Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+class TestEnv:
+    """Reusable engine fixture (ref: tests/util.rs TestEnv/TestContext)."""
+
+    def __init__(self, store=None):
+        self.store = store or MemoryStore()
+        self.instance = Instance(self.store)
+
+    def create_demo(self, table_id=1, **opt_kv):
+        opts = TableOptions.from_kv(opt_kv) if opt_kv else TableOptions()
+        return self.instance.create_table(0, table_id, "demo", demo_schema(), opts)
+
+    def write_rows(self, table, rows):
+        return self.instance.write(table, RowGroup.from_rows(table.schema, rows))
+
+    def reopen(self):
+        """Simulate restart: fresh Instance over the same store."""
+        self.instance = Instance(self.store)
+        return self.instance
+
+
+def rows_named(table, result):
+    return sorted((r["name"], r["t"], r["value"]) for r in result.to_pylist())
+
+
+class TestWriteRead:
+    def test_write_read_memtable_only(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [
+            {"name": "h1", "value": 1.0, "t": 1000},
+            {"name": "h2", "value": 2.0, "t": 1000},
+        ])
+        out = env.instance.read(t)
+        assert rows_named(t, out) == [("h1", 1000, 1.0), ("h2", 1000, 2.0)]
+
+    def test_flush_then_read(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        res = env.instance.flush_table(t)
+        assert res.files_added == 1 and res.rows_flushed == 1
+        assert t.version.immutables() == []
+        env.write_rows(t, [{"name": "h1", "value": 2.0, "t": 2000}])
+        out = env.instance.read(t)
+        assert rows_named(t, out) == [("h1", 1000, 1.0), ("h1", 2000, 2.0)]
+
+    def test_overwrite_dedup_across_flush(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.instance.flush_table(t)
+        # Same primary key (same series, same timestamp) -> newest wins.
+        env.write_rows(t, [{"name": "h1", "value": 9.0, "t": 1000}])
+        out = env.instance.read(t)
+        assert rows_named(t, out) == [("h1", 1000, 9.0)]
+        # ...even after the newer version is flushed into its own SST.
+        env.instance.flush_table(t)
+        out = env.instance.read(t)
+        assert rows_named(t, out) == [("h1", 1000, 9.0)]
+
+    def test_overwrite_dedup_within_memtable(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.write_rows(t, [{"name": "h1", "value": 2.0, "t": 1000}])
+        out = env.instance.read(t)
+        assert rows_named(t, out) == [("h1", 1000, 2.0)]
+
+    def test_append_mode_keeps_duplicates(self):
+        env = TestEnv()
+        t = env.create_demo(update_mode="append")
+        assert t.options.update_mode is UpdateMode.APPEND
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.write_rows(t, [{"name": "h1", "value": 2.0, "t": 1000}])
+        out = env.instance.read(t)
+        assert len(out) == 2
+
+    def test_time_range_read(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [
+            {"name": "h1", "value": float(i), "t": i * 1000} for i in range(10)
+        ])
+        env.instance.flush_table(t)
+        out = env.instance.read(t, Predicate(time_range=TimeRange(3000, 6000)))
+        assert sorted(r["t"] for r in out.to_pylist()) == [3000, 4000, 5000]
+
+    def test_projection(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.instance.flush_table(t)
+        out = env.instance.read(t, projection=["value"])
+        assert "value" in out.columns and "name" not in out.columns
+
+    def test_write_buffer_triggers_flush(self):
+        env = TestEnv()
+        t = env.create_demo(write_buffer_size="1kb")
+        for i in range(20):
+            env.write_rows(t, [
+                {"name": f"h{j}", "value": float(j), "t": i * 1000} for j in range(10)
+            ])
+        assert len(t.version.levels.files_at(0)) > 0
+
+
+class TestEdgeSchemas:
+    def test_tagless_table_single_series(self):
+        s = Schema.build(
+            [ColumnSchema("v", DatumKind.DOUBLE), ColumnSchema("t", DatumKind.TIMESTAMP)],
+            timestamp_column="t",
+        )
+        env = TestEnv()
+        t = env.instance.create_table(0, 5, "tagless", s)
+        env.write_rows(t, [{"v": 1.0, "t": 1}, {"v": 2.0, "t": 2}])
+        env.instance.flush_table(t)
+        out = env.instance.read(t)
+        assert sorted(r["v"] for r in out.to_pylist()) == [1.0, 2.0]
+
+    def test_varbinary_column_flush(self):
+        s = Schema.build(
+            [
+                ColumnSchema("k", DatumKind.STRING, is_tag=True),
+                ColumnSchema("payload", DatumKind.VARBINARY),
+                ColumnSchema("t", DatumKind.TIMESTAMP),
+            ],
+            timestamp_column="t",
+        )
+        env = TestEnv()
+        t = env.instance.create_table(0, 6, "bin", s)
+        env.instance.write(t, RowGroup.from_rows(s, [{"k": "a", "payload": b"\x00\xff", "t": 1}]))
+        assert env.instance.flush_table(t).files_added == 1
+        assert env.instance.read(t).to_pylist()[0]["payload"] == b"\x00\xff"
+
+
+class TestSegmentSplit:
+    def test_flush_splits_by_segment_and_sets_duration(self):
+        env = TestEnv()
+        t = env.create_demo(segment_duration="1h")
+        hour = 3_600_000
+        env.write_rows(t, [
+            {"name": "h1", "value": 1.0, "t": 100},
+            {"name": "h1", "value": 2.0, "t": hour + 100},
+            {"name": "h1", "value": 3.0, "t": 2 * hour + 100},
+        ])
+        res = env.instance.flush_table(t)
+        assert res.files_added == 3
+        files = t.version.levels.files_at(0)
+        assert all(
+            f.time_range.exclusive_end - f.time_range.inclusive_start <= hour
+            for f in files
+        )
+
+    def test_auto_segment_duration_sampled(self):
+        env = TestEnv()
+        t = env.create_demo()
+        assert t.options.segment_duration_ms is None
+        env.write_rows(t, [
+            {"name": "h1", "value": 1.0, "t": 0},
+            {"name": "h1", "value": 2.0, "t": 3 * 3_600_000},
+        ])
+        env.instance.flush_table(t)
+        assert t.options.segment_duration_ms == 4 * 3_600_000
+
+
+class TestRecovery:
+    def test_reopen_reads_flushed_data(self):
+        env = TestEnv()
+        t = env.create_demo(segment_duration="2h")
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.instance.flush_table(t)
+        inst = env.reopen()
+        t2 = inst.open_table(0, 1, "demo")
+        assert t2 is not None
+        assert t2.schema == t.schema
+        assert t2.options.segment_duration_ms == 2 * 3_600_000
+        out = inst.read(t2)
+        assert rows_named(t2, out) == [("h1", 1000, 1.0)]
+
+    def test_unflushed_data_lost_without_wal(self):
+        # disable_data_wal semantics (ref: setup.rs:122-127 warning).
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        inst = env.reopen()
+        t2 = inst.open_table(0, 1, "demo")
+        assert len(inst.read(t2)) == 0
+
+    def test_open_missing_table_returns_none(self):
+        env = TestEnv()
+        assert env.instance.open_table(0, 99, "nope") is None
+
+    def test_sequence_continues_after_reopen(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.instance.flush_table(t)
+        last = t.last_sequence
+        inst = env.reopen()
+        t2 = inst.open_table(0, 1, "demo")
+        seq = inst.write(t2, RowGroup.from_rows(t2.schema, [
+            {"name": "h1", "value": 2.0, "t": 2000}
+        ]))
+        assert seq > last
+
+
+class TestDDL:
+    def test_create_duplicate_rejected(self):
+        env = TestEnv()
+        env.create_demo()
+        with pytest.raises(ValueError):
+            env.create_demo()
+
+    def test_drop_table_removes_storage(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        env.instance.flush_table(t)
+        env.instance.drop_table(t)
+        assert list(env.store.list()) == []
+        assert env.reopen().open_table(0, 1, "demo") is None
+
+    def test_alter_schema_add_column(self):
+        env = TestEnv()
+        t = env.create_demo()
+        env.write_rows(t, [{"name": "h1", "value": 1.0, "t": 1000}])
+        new_schema = t.schema.with_added_column(
+            ColumnSchema("v2", DatumKind.DOUBLE)
+        )
+        env.instance.alter_schema(t, new_schema)
+        env.write_rows(t, [{"name": "h1", "value": 2.0, "v2": 7.0, "t": 2000}])
+        out = env.instance.read(t)
+        by_t = {r["t"]: r for r in out.to_pylist()}
+        assert by_t[2000]["v2"] == 7.0
+        # Row flushed under schema v1 reads back with NULL for the new column.
+        assert by_t[1000]["v2"] is None
+        # Old rows surface NULL for the new column after reopen too.
+        env.instance.flush_table(t)
+        inst = env.reopen()
+        t2 = inst.open_table(0, 1, "demo")
+        assert t2.schema.version == new_schema.version
+
+    def test_write_with_stale_schema_rejected(self):
+        env = TestEnv()
+        t = env.create_demo()
+        old_schema = t.schema
+        env.instance.alter_schema(
+            t, t.schema.with_added_column(ColumnSchema("v2", DatumKind.DOUBLE))
+        )
+        with pytest.raises(ValueError):
+            env.instance.write(t, RowGroup.from_rows(old_schema, [
+                {"name": "h1", "value": 1.0, "t": 1000}
+            ]))

@@ -1,0 +1,257 @@
+"""Storage slice tests: object store, Parquet SSTs, levels, manifest.
+
+Mirrors the reference's test strategy: round-trips (parquet writer tests,
+sst/parquet/writer.rs:653-964), manifest recovery with in-memory stores
+(manifest/details.rs:926-1389).
+"""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema, TimeRange
+from horaedb_tpu.engine.manifest import (
+    AddFile,
+    AlterSchema,
+    Flushed,
+    Manifest,
+    RemoveFile,
+    TableManifestState,
+)
+from horaedb_tpu.engine.sst import FileHandle, LevelsController, SstReader, SstWriter
+from horaedb_tpu.engine.sst.meta import SstMeta, sst_path
+from horaedb_tpu.engine.sst.writer import WriteOptions
+from horaedb_tpu.table_engine import ColumnFilter, FilterOp, Predicate
+from horaedb_tpu.utils.object_store import LocalDiskStore, MemCacheStore, MemoryStore
+
+
+def demo_schema() -> Schema:
+    return Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+def make_rows(n, t0=0, step=1000, hosts=("h1", "h2")):
+    return [
+        {"name": hosts[i % len(hosts)], "value": float(i), "t": t0 + i * step}
+        for i in range(n)
+    ]
+
+
+class TestObjectStores:
+    @pytest.mark.parametrize("kind", ["memory", "disk", "cache"])
+    def test_basic_ops(self, kind, tmp_path):
+        if kind == "memory":
+            store = MemoryStore()
+        elif kind == "disk":
+            store = LocalDiskStore(str(tmp_path))
+        else:
+            store = MemCacheStore(MemoryStore(), capacity_bytes=1 << 20)
+        store.put("a/b/one", b"hello world")
+        store.put("a/two", b"xy")
+        assert store.get("a/b/one") == b"hello world"
+        assert store.get_range("a/b/one", 6, 11) == b"world"
+        assert store.head("a/two") == 2
+        assert list(store.list("a/")) == ["a/b/one", "a/two"]
+        assert store.exists("a/two")
+        store.delete("a/two")
+        assert not store.exists("a/two")
+        with pytest.raises(FileNotFoundError):
+            store.get("a/two")
+
+    def test_disk_put_is_atomic_no_tmp_listed(self, tmp_path):
+        store = LocalDiskStore(str(tmp_path))
+        store.put("x", b"1" * 1024)
+        assert list(store.list()) == ["x"]
+
+    def test_disk_path_escape_rejected(self, tmp_path):
+        store = LocalDiskStore(str(tmp_path))
+        with pytest.raises(ValueError):
+            store.put("../evil", b"x")
+
+    def test_cache_hits(self):
+        inner = MemoryStore()
+        store = MemCacheStore(inner, capacity_bytes=1 << 20)
+        store.put("k", b"v" * 100)
+        store.get("k")
+        store.get("k")
+        assert store.hits >= 1
+
+
+class TestSstRoundTrip:
+    def test_write_read_meta(self, tmp_store):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(schema, make_rows(100)).sorted_by_key()
+        writer = SstWriter(tmp_store, WriteOptions(num_rows_per_row_group=32))
+        path = sst_path(0, 1, 7)
+        meta = writer.write(path, 7, rg, max_sequence=42)
+        assert meta.num_rows == 100
+        assert meta.max_sequence == 42
+        assert meta.size_bytes > 0
+        assert meta.time_range == TimeRange(0, 99_001)
+
+        reader = SstReader(tmp_store, path)
+        assert reader.read_meta().to_dict() == meta.to_dict()
+        back = reader.read(schema)
+        assert len(back) == 100
+        assert sorted(back.to_pylist(), key=lambda r: r["t"]) == sorted(
+            rg.to_pylist(), key=lambda r: r["t"]
+        )
+
+    def test_row_group_pruning_by_time(self, tmp_store):
+        schema = demo_schema()
+        # 4 row groups of 25 rows each, times 0..99_000
+        rg = RowGroup.from_rows(schema, make_rows(100)).sorted_by_key()
+        # sort by key interleaves hosts; re-sort by time for deterministic
+        # row-group time spans in this test
+        order = np.argsort(rg.timestamps, kind="stable")
+        rg = rg.take(order)
+        writer = SstWriter(tmp_store, WriteOptions(num_rows_per_row_group=25))
+        path = sst_path(0, 1, 1)
+        writer.write(path, 1, rg, max_sequence=1)
+        reader = SstReader(tmp_store, path)
+        pred = Predicate(time_range=TimeRange(0, 25_000))
+        kept = reader.prune_row_groups(schema, pred)
+        assert kept == [0]
+        out = reader.read(schema, pred)
+        assert len(out) == 25
+
+    def test_row_group_pruning_by_filter(self, tmp_store):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(schema, make_rows(100)).sorted_by_key()
+        order = np.argsort(rg.column("value"), kind="stable")
+        rg = rg.take(order)
+        writer = SstWriter(tmp_store, WriteOptions(num_rows_per_row_group=50))
+        path = sst_path(0, 1, 2)
+        writer.write(path, 2, rg, max_sequence=1)
+        reader = SstReader(tmp_store, path)
+        pred = Predicate.all_time([ColumnFilter("value", FilterOp.GT, 80.0)])
+        kept = reader.prune_row_groups(schema, pred)
+        assert kept == [1]
+
+    def test_projection_keeps_keys(self, tmp_store):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(schema, make_rows(10)).sorted_by_key()
+        writer = SstWriter(tmp_store)
+        path = sst_path(0, 1, 3)
+        writer.write(path, 3, rg, max_sequence=1)
+        out = SstReader(tmp_store, path).read(schema, projection=["value"])
+        # tsid + t force-included
+        assert set(out.schema.names()) == {"tsid", "t", "value"}
+
+    def test_empty_result_when_fully_pruned(self, tmp_store):
+        schema = demo_schema()
+        rg = RowGroup.from_rows(schema, make_rows(10)).sorted_by_key()
+        writer = SstWriter(tmp_store)
+        path = sst_path(0, 1, 4)
+        writer.write(path, 4, rg, max_sequence=1)
+        out = SstReader(tmp_store, path).read(
+            schema, Predicate(time_range=TimeRange(1_000_000, 2_000_000))
+        )
+        assert len(out) == 0
+
+
+def mk_meta(fid, lo, hi, seq=1, rows=10):
+    return SstMeta(
+        file_id=fid,
+        time_range=TimeRange(lo, hi),
+        max_sequence=seq,
+        num_rows=rows,
+        size_bytes=100,
+        schema_version=1,
+        column_ranges={},
+    )
+
+
+class TestLevelsController:
+    def test_add_pick_remove(self):
+        lc = LevelsController()
+        lc.add_file(0, FileHandle(mk_meta(1, 0, 100), "p1", 0))
+        lc.add_file(0, FileHandle(mk_meta(2, 50, 150), "p2", 0))
+        lc.add_file(1, FileHandle(mk_meta(3, 0, 200, seq=0), "p3", 1))
+        assert [h.file_id for h in lc.pick_overlapping(TimeRange(120, 130))] == [2, 3]
+        assert lc.max_sequence() == 1
+        lc.remove_files(0, [1])
+        assert [h.file_id for h in lc.all_files()] == [2, 3]
+        purged = lc.drain_purge_queue()
+        assert [h.file_id for h in purged] == [1]
+        assert lc.drain_purge_queue() == []
+
+    def test_expired_files(self):
+        lc = LevelsController()
+        lc.add_file(0, FileHandle(mk_meta(1, 0, 100), "p1", 0))
+        lc.add_file(0, FileHandle(mk_meta(2, 5000, 6000), "p2", 0))
+        expired = lc.expired_files(now_ms=10_000, ttl_ms=5_000)
+        assert [h.file_id for h in expired] == [1]
+
+    def test_invalid_level(self):
+        with pytest.raises(ValueError):
+            LevelsController().add_file(5, FileHandle(mk_meta(1, 0, 1), "p", 5))
+
+
+class TestManifest:
+    def edits(self):
+        return [
+            AlterSchema(demo_schema()),
+            AddFile(0, mk_meta(1, 0, 100, seq=10), "0/1/1.sst"),
+            Flushed(10),
+        ]
+
+    @pytest.mark.parametrize("store_kind", ["memory", "disk"])
+    def test_append_and_recover(self, store_kind, tmp_path):
+        store = MemoryStore() if store_kind == "memory" else LocalDiskStore(str(tmp_path))
+        m = Manifest(store, 0, 1)
+        m.append_edits(self.edits())
+        m.append_edits([AddFile(0, mk_meta(2, 100, 200, seq=20), "0/1/2.sst"), Flushed(20)])
+
+        # Fresh Manifest object = process restart.
+        m2 = Manifest(store, 0, 1)
+        st = m2.load()
+        assert st.schema == demo_schema()
+        assert [h.file_id for h in st.levels.files_at(0)] == [1, 2]
+        assert st.flushed_sequence == 20
+        assert st.next_file_id == 3
+
+    def test_snapshot_compacts_logs(self):
+        store = MemoryStore()
+        m = Manifest(store, 0, 1)
+        m.append_edits(self.edits())
+        for i in range(2, 40):
+            m.append_edits([AddFile(0, mk_meta(i, 0, 100), f"0/1/{i}.sst")])
+        logs = [p for p in store.list("manifest/0/1/") if "log." in p]
+        assert len(logs) < 39  # snapshots pruned covered logs
+        st = Manifest(store, 0, 1).load()
+        assert len(st.levels.files_at(0)) == 39
+
+    def test_remove_file_after_snapshot(self):
+        store = MemoryStore()
+        m = Manifest(store, 0, 1)
+        m.append_edits(self.edits())
+        m.snapshot()
+        m.append_edits([RemoveFile(0, 1)])
+        st = Manifest(store, 0, 1).load()
+        assert st.levels.files_at(0) == []
+
+    def test_destroy(self):
+        store = MemoryStore()
+        m = Manifest(store, 0, 1)
+        m.append_edits(self.edits())
+        assert m.exists()
+        m.destroy()
+        assert not m.exists()
+        assert not Manifest(store, 0, 1).exists()
+
+    def test_append_after_recover_no_collision(self):
+        """Log seq must continue after the highest recovered seq."""
+        store = MemoryStore()
+        m = Manifest(store, 0, 1)
+        m.append_edits(self.edits())
+        m2 = Manifest(store, 0, 1)
+        m2.load()
+        m2.append_edits([Flushed(30)])
+        st = Manifest(store, 0, 1).load()
+        assert st.flushed_sequence == 30
