@@ -1,0 +1,37 @@
+"""The TPU compute path.
+
+Where the reference executes queries through DataFusion's vectorized CPU
+operators and compaction through a BinaryHeap merge iterator, this package
+compiles the same work into XLA programs:
+
+- ``scan_agg``     — ONE fused jit kernel for filter -> time-bucket ->
+                     group-by -> aggregate (the north-star insertion point:
+                     plans whose leaves are SST scans with agg on top).
+- ``merge_dedup``  — device sort-based k-way merge + duplicate collapse
+                     (compaction's hot loop, ref row_iter/merge.rs).
+- ``encoding``     — host-side prep: dense series codes, time buckets,
+                     padding to compile-friendly shapes.
+
+Everything here obeys XLA's rules: static shapes (inputs padded to shape
+buckets), no data-dependent control flow, masks instead of branches.
+"""
+
+from .encoding import (
+    PaddedBatch,
+    encode_group_codes,
+    pad_to_bucket,
+    shape_bucket,
+)
+from .scan_agg import AGG_OPS, ScanAggSpec, scan_aggregate
+from .merge_dedup import merge_dedup_permutation
+
+__all__ = [
+    "PaddedBatch",
+    "encode_group_codes",
+    "pad_to_bucket",
+    "shape_bucket",
+    "AGG_OPS",
+    "ScanAggSpec",
+    "scan_aggregate",
+    "merge_dedup_permutation",
+]

@@ -1,0 +1,95 @@
+"""Device k-way merge + dedup as one sort kernel
+(ref: analytic_engine/src/row_iter/{merge.rs,dedup.rs} and the compaction
+runner's merge loop — the BASELINE.json "k-way merge-dedup lifted onto TPU").
+
+The reference merges k sorted runs with a BinaryHeap, comparing rows one at
+a time. On TPU the same job is a data-parallel sort: concatenate the runs,
+sort by (primary key asc, sequence desc), and collapse duplicate keys with
+a shift-compare mask. ``lax.sort`` lowers to an efficient multi-operand
+device sort, and the dedup mask is one vectorized compare — no per-row
+control flow anywhere.
+
+64-bit keys without enabling x64: tsid/timestamp/sequence are split into
+order-preserving (hi, lo) uint32 pairs on host (ops.encoding.split_*), and
+the device sorts by the pair lexicographically. Padding rows carry an
+explicit is_pad key that sorts strictly after every real row, so the valid
+prefix of the output is exactly the merged result.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import pad_to_bucket, shape_bucket, split_i64_sortable, split_u64
+
+
+@functools.partial(jax.jit, static_argnames=("dedup",))
+def _merge_dedup_kernel(
+    is_pad, tsid_hi, tsid_lo, ts_hi, ts_lo, negseq_hi, negseq_lo, *, dedup: bool
+):
+    n = is_pad.shape[0]
+    iota = jax.lax.iota(jnp.int32, n)
+    sorted_ops = jax.lax.sort(
+        (is_pad, tsid_hi, tsid_lo, ts_hi, ts_lo, negseq_hi, negseq_lo, iota),
+        num_keys=7,
+        is_stable=True,
+    )
+    s_pad, s_tsid_hi, s_tsid_lo, s_ts_hi, s_ts_lo, _, _, perm = sorted_ops
+    if dedup:
+        same = (
+            (s_tsid_hi[1:] == s_tsid_hi[:-1])
+            & (s_tsid_lo[1:] == s_tsid_lo[:-1])
+            & (s_ts_hi[1:] == s_ts_hi[:-1])
+            & (s_ts_lo[1:] == s_ts_lo[:-1])
+        )
+        keep = jnp.concatenate([jnp.ones(1, dtype=jnp.bool_), ~same])
+    else:
+        keep = jnp.ones(n, dtype=jnp.bool_)
+    keep = keep & (s_pad == 0)
+    return perm, keep
+
+
+def merge_dedup_permutation(
+    tsid: np.ndarray,
+    ts: np.ndarray,
+    seq: np.ndarray,
+    dedup: bool = True,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Merge-sort order + survivor mask for concatenated sorted runs.
+
+    Returns ``(perm, keep)`` of length == len(input): ``perm`` is the row
+    permutation sorting by (tsid, ts, seq desc); ``keep[i]`` says whether
+    sorted position i survives dedup (first — i.e. newest-sequence — row of
+    each (tsid, ts) key). Apply as ``rows.take(perm[keep])``.
+
+    The device does all comparison work; callers gather payload columns
+    host-side (string columns can't live on device anyway).
+    """
+    n = len(tsid)
+    if n == 0:
+        return np.empty(0, dtype=np.int32), np.empty(0, dtype=np.bool_)
+
+    tsid_hi, tsid_lo = split_u64(tsid)
+    ts_hi, ts_lo = split_i64_sortable(ts)
+    # Bitwise NOT of the unsigned sequence sorts descending (newest first).
+    negseq = ~seq.astype(np.uint64)
+    negseq_hi, negseq_lo = split_u64(negseq)
+
+    is_pad = pad_to_bucket(np.zeros(n, dtype=np.uint32), n, fill=1)
+    args = [
+        is_pad,
+        pad_to_bucket(tsid_hi, n),
+        pad_to_bucket(tsid_lo, n),
+        pad_to_bucket(ts_hi, n),
+        pad_to_bucket(ts_lo, n),
+        pad_to_bucket(negseq_hi, n),
+        pad_to_bucket(negseq_lo, n),
+    ]
+    perm, keep = _merge_dedup_kernel(*(jnp.asarray(a) for a in args), dedup=dedup)
+    perm = np.asarray(perm)[:n]
+    keep = np.asarray(keep)[:n]
+    return perm, keep

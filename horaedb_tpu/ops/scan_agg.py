@@ -1,0 +1,172 @@
+"""The fused scan/filter/time-bucket/group-by/aggregate kernel.
+
+This is the north-star insertion point (BASELINE.json): a plan whose leaves
+are SST scans with filter + group-by-time + aggregate on top compiles into
+ONE XLA program. The reference executes the same shape of work as a
+DataFusion operator pipeline (filter -> repartition -> partial agg -> final
+agg, survey §3.2); here XLA fuses mask computation, bucketing, and segment
+reductions into a single device launch over dense column buffers.
+
+Layout contract (prepared by ops.encoding on host):
+
+- ``group_codes`` int32[N]: dense group index per row;
+- ``bucket_ids``  int32[N]: time bucket per row;
+- ``mask``        bool[N]:  validity & tag-filter & pad mask;
+- ``values``      f32[F, N]: field columns (agg fields first, then any
+                  fields referenced only by numeric filters);
+- numeric filters evaluate ON DEVICE: ops are static (part of the jit
+  key), literals are traced scalars (no recompile when the constant
+  changes).
+
+Aggregation state is the classic monoid (count, sum, min, max): partials
+from different batches/SSTs/devices combine associatively — the same
+combine drives multi-batch scans, distributed partial aggregation over a
+mesh (psum), and final agg after dedup.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import PaddedBatch, next_pow2
+
+AGG_OPS = ("count", "sum", "min", "max", "avg")
+
+# Numeric filter ops, by static code (part of the jit cache key).
+_FILTER_OPS = {"=": 0, "!=": 1, "<": 2, "<=": 3, ">": 4, ">=": 5}
+
+
+@dataclass(frozen=True)
+class ScanAggSpec:
+    """Static shape/op configuration — the jit cache key."""
+
+    n_groups: int  # padded
+    n_buckets: int  # padded
+    n_agg_fields: int
+    # ((value_row_index, op_str), ...) evaluated on device against literals
+    numeric_filters: tuple[tuple[int, str], ...] = ()
+
+    def padded(self) -> "ScanAggSpec":
+        return ScanAggSpec(
+            n_groups=next_pow2(self.n_groups, floor=8),
+            n_buckets=next_pow2(self.n_buckets, floor=1),
+            n_agg_fields=self.n_agg_fields,
+            numeric_filters=self.numeric_filters,
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
+)
+def _fused_scan_agg(
+    group_codes,
+    bucket_ids,
+    mask,
+    values,
+    literals,
+    *,
+    n_groups: int,
+    n_buckets: int,
+    n_agg_fields: int,
+    numeric_filters: tuple[tuple[int, int], ...],
+):
+    m = mask
+    for i, (field_idx, op_code) in enumerate(numeric_filters):
+        v = values[field_idx]
+        lit = literals[i]
+        if op_code == 0:
+            m = m & (v == lit)
+        elif op_code == 1:
+            m = m & (v != lit)
+        elif op_code == 2:
+            m = m & (v < lit)
+        elif op_code == 3:
+            m = m & (v <= lit)
+        elif op_code == 4:
+            m = m & (v > lit)
+        else:
+            m = m & (v >= lit)
+
+    n_seg = n_groups * n_buckets
+    seg = group_codes * n_buckets + bucket_ids
+    seg = jnp.where(m, seg, n_seg)  # masked rows land in a dump slot
+
+    counts = jax.ops.segment_sum(
+        m.astype(jnp.int32), seg, num_segments=n_seg + 1
+    )[:n_seg].reshape(n_groups, n_buckets)
+
+    if n_agg_fields:
+        agg_vals = values[:n_agg_fields]  # (F, N)
+        mf = m.astype(agg_vals.dtype)
+        sums = jax.ops.segment_sum(
+            (agg_vals * mf).T, seg, num_segments=n_seg + 1
+        )[:n_seg].T.reshape(n_agg_fields, n_groups, n_buckets)
+        big = jnp.asarray(jnp.inf, dtype=agg_vals.dtype)
+        mins = jax.ops.segment_min(
+            jnp.where(m, agg_vals, big).T, seg, num_segments=n_seg + 1
+        )[:n_seg].T.reshape(n_agg_fields, n_groups, n_buckets)
+        maxs = jax.ops.segment_max(
+            jnp.where(m, agg_vals, -big).T, seg, num_segments=n_seg + 1
+        )[:n_seg].T.reshape(n_agg_fields, n_groups, n_buckets)
+    else:
+        zero = jnp.zeros((0, n_groups, n_buckets), dtype=values.dtype)
+        sums = mins = maxs = zero
+    return counts, sums, mins, maxs
+
+
+@dataclass
+class AggState:
+    """Combinable partial aggregates (numpy, on host after device exit)."""
+
+    counts: np.ndarray  # (G, B) int
+    sums: np.ndarray  # (F, G, B)
+    mins: np.ndarray  # (F, G, B)
+    maxs: np.ndarray  # (F, G, B)
+
+    def combine(self, other: "AggState") -> "AggState":
+        return AggState(
+            counts=self.counts + other.counts,
+            sums=self.sums + other.sums,
+            mins=np.minimum(self.mins, other.mins),
+            maxs=np.maximum(self.maxs, other.maxs),
+        )
+
+
+def scan_aggregate(
+    batch: PaddedBatch,
+    spec: ScanAggSpec,
+    filter_literals: Sequence[float] = (),
+) -> AggState:
+    """Run the fused kernel on one padded batch; returns host partials.
+
+    ``spec`` should already be ``.padded()`` — callers slice the outputs
+    back down to true group/bucket counts after combining partials.
+    """
+    static_filters = tuple(
+        (fi, _FILTER_OPS[op]) for fi, op in spec.numeric_filters
+    )
+    lits = jnp.asarray(np.asarray(filter_literals, dtype=np.float32))
+    counts, sums, mins, maxs = _fused_scan_agg(
+        jnp.asarray(batch.group_codes),
+        jnp.asarray(batch.bucket_ids),
+        jnp.asarray(batch.mask),
+        jnp.asarray(batch.values),
+        lits,
+        n_groups=spec.n_groups,
+        n_buckets=spec.n_buckets,
+        n_agg_fields=spec.n_agg_fields,
+        numeric_filters=static_filters,
+    )
+    return AggState(
+        counts=np.asarray(counts),
+        sums=np.asarray(sums, dtype=np.float64),
+        mins=np.asarray(mins, dtype=np.float64),
+        maxs=np.asarray(maxs, dtype=np.float64),
+    )

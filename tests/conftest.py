@@ -7,12 +7,22 @@ collectives). Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU platform for tests. The env var alone is NOT enough: the
+# TPU plugin's registration hook (sitecustomize) sets the jax config value
+# directly, which wins over JAX_PLATFORMS. The TPU tunnel is single-client;
+# a test run that initialized it would remote-compile every kernel AND
+# starve any other process of the chip. Tests always use the virtual
+# 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
