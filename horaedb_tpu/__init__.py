@@ -27,3 +27,7 @@ Layer map (mirrors reference SURVEY layer map, re-architected TPU-first):
 """
 
 __version__ = "0.1.0"
+
+from .db import Connection, connect  # noqa: E402
+
+__all__ = ["Connection", "connect", "__version__"]

@@ -1,0 +1,152 @@
+"""Catalog: name -> table resolution, persisted in object storage
+(ref: src/catalog + src/catalog_impls TableBasedManager for standalone mode
+— the reference persists catalog entries in system tables; here the
+registry is one msgpack object with atomic replace, which gives the same
+durability on a LocalDiskStore without bootstrapping a sys table).
+
+Single default catalog/schema namespace ("horaedb"."public") for the
+standalone build; the cluster build adds shard-backed volatile catalogs
+(ref: catalog_impls/volatile.rs) in a later round.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import msgpack
+
+from ..common_types.schema import Schema
+from ..engine.instance import Instance
+from ..engine.options import TableOptions
+from ..engine.table_data import TableData
+from ..utils.object_store import ObjectStore
+
+DEFAULT_CATALOG = "horaedb"
+DEFAULT_SCHEMA = "public"
+
+_REGISTRY_PATH = "catalog/registry"
+
+
+@dataclass
+class TableEntry:
+    name: str
+    table_id: int
+    space_id: int
+    partition_info: Optional[dict] = None
+
+
+class Catalog:
+    """Table registry + lifecycle orchestration over the engine."""
+
+    def __init__(self, store: ObjectStore, instance: Instance) -> None:
+        self.store = store
+        self.instance = instance
+        self._lock = threading.RLock()
+        self._entries: dict[str, TableEntry] = {}
+        self._next_table_id = 1
+        self._open_tables: dict[str, TableData] = {}
+        self._load()
+
+    # ---- persistence -----------------------------------------------------
+    def _load(self) -> None:
+        try:
+            raw = msgpack.unpackb(self.store.get(_REGISTRY_PATH), raw=False)
+        except FileNotFoundError:
+            return
+        self._next_table_id = raw["next_table_id"]
+        for t in raw["tables"]:
+            self._entries[t["name"]] = TableEntry(
+                t["name"], t["table_id"], t["space_id"], t.get("partition_info")
+            )
+
+    def _persist_locked(self) -> None:
+        body = msgpack.packb(
+            {
+                "next_table_id": self._next_table_id,
+                "tables": [
+                    {
+                        "name": e.name,
+                        "table_id": e.table_id,
+                        "space_id": e.space_id,
+                        "partition_info": e.partition_info,
+                    }
+                    for e in self._entries.values()
+                ],
+            },
+            use_bin_type=True,
+        )
+        self.store.put(_REGISTRY_PATH, body)
+
+    # ---- lookup ------------------------------------------------------------
+    def table_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def schema_of(self, name: str) -> Optional[Schema]:
+        t = self.open_table(name)
+        return t.schema if t is not None else None
+
+    def open_table(self, name: str) -> Optional[TableData]:
+        with self._lock:
+            t = self._open_tables.get(name)
+            if t is not None:
+                return t
+            e = self._entries.get(name)
+            if e is None:
+                return None
+            t = self.instance.open_table(e.space_id, e.table_id, name)
+            if t is None:
+                raise RuntimeError(
+                    f"catalog entry for {name!r} exists but table storage is missing"
+                )
+            self._open_tables[name] = t
+            return t
+
+    # ---- DDL -----------------------------------------------------------------
+    def create_table(
+        self,
+        name: str,
+        schema: Schema,
+        options: TableOptions,
+        if_not_exists: bool = False,
+        partition_info: Optional[dict] = None,
+    ) -> Optional[TableData]:
+        with self._lock:
+            if name in self._entries:
+                if if_not_exists:
+                    return self.open_table(name)
+                raise ValueError(f"table already exists: {name}")
+            table_id = self._next_table_id
+            self._next_table_id += 1
+            table = self.instance.create_table(0, table_id, name, schema, options)
+            self._entries[name] = TableEntry(name, table_id, 0, partition_info)
+            self._persist_locked()
+            self._open_tables[name] = table
+            return table
+
+    def drop_table(self, name: str, if_exists: bool = False) -> bool:
+        with self._lock:
+            e = self._entries.get(name)
+            if e is None:
+                if if_exists:
+                    return False
+                raise ValueError(f"table not found: {name}")
+            table = self.open_table(name)
+            if table is not None:
+                self.instance.drop_table(table)
+            self._entries.pop(name, None)
+            self._open_tables.pop(name, None)
+            self._persist_locked()
+            return True
+
+    def close(self) -> None:
+        with self._lock:
+            for t in list(self._open_tables.values()):
+                self.instance.close_table(t)
+            self._open_tables.clear()

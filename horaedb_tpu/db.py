@@ -1,0 +1,60 @@
+"""Embedded database facade — the minimum end-to-end surface.
+
+    import horaedb_tpu
+    db = horaedb_tpu.connect("/path/to/data")   # or None for in-memory
+    db.execute("CREATE TABLE demo (name string TAG, value double, "
+               "t timestamp NOT NULL, TIMESTAMP KEY(t)) ENGINE=Analytic")
+    db.execute("INSERT INTO demo (name, value, t) VALUES ('h1', 0.5, 1000)")
+    rows = db.execute("SELECT avg(value) FROM demo GROUP BY name").to_pylist()
+
+The server layer (HTTP /sql etc.) drives exactly this object; in the
+reference the equivalent stack is proxy -> Frontend -> interpreters
+(SURVEY §3.2).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .catalog import Catalog
+from .engine.instance import EngineConfig, Instance
+from .engine.wal import LocalDiskWal
+from .query.frontend import Frontend
+from .query.interpreters import AffectedRows, InterpreterFactory, Output
+from .query.executor import ResultSet
+from .utils.object_store import LocalDiskStore, MemoryStore, ObjectStore
+
+
+class Connection:
+    def __init__(self, store: ObjectStore, wal=None, config: EngineConfig | None = None) -> None:
+        self.store = store
+        self.instance = Instance(store, config=config, wal=wal)
+        self.catalog = Catalog(store, self.instance)
+        self.frontend = Frontend(self.catalog.schema_of)
+        self.interpreters = InterpreterFactory(self.catalog)
+
+    def execute(self, sql: str) -> Output:
+        plan = self.frontend.sql_to_plan(sql)
+        return self.interpreters.execute(plan)
+
+    def execute_many(self, sql: str) -> list[Output]:
+        return [
+            self.interpreters.execute(self.frontend.statement_to_plan(s))
+            for s in self.frontend.parse_sql_many(sql)
+        ]
+
+    def flush_all(self) -> None:
+        for t in self.instance.open_tables():
+            self.instance.flush_table(t)
+
+    def close(self) -> None:
+        self.catalog.close()
+
+
+def connect(path: Optional[str] = None, wal: bool = True) -> Connection:
+    """Open (or create) a database. ``path=None`` -> in-memory, no WAL."""
+    if path is None:
+        return Connection(MemoryStore())
+    store = LocalDiskStore(path)
+    wal_mgr = LocalDiskWal(f"{path}/wal") if wal else None
+    return Connection(store, wal=wal_mgr)

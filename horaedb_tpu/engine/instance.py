@@ -176,8 +176,16 @@ class Instance:
             table.manifest.append_edits([AlterSchema(schema)])
 
     def _replay_wal(self, table: TableData) -> None:
-        """Re-apply WAL entries newer than the flushed sequence."""
-        for seq, rows in self.wal.read_from(table.table_id, table.version.flushed_sequence + 1):
+        """Re-apply WAL entries newer than the flushed sequence.
+
+        Batches decode with the table's CURRENT schema: rows logged before
+        an ALTER come back with NULL-filled new columns (same convention
+        as reading pre-ALTER SSTs).
+        """
+        for seq, batch in self.wal.read_from(
+            table.table_id, table.version.flushed_sequence + 1
+        ):
+            rows = RowGroup.from_arrow(table.schema, batch)
             table.put_rows(rows, seq)
             table.set_last_sequence(seq)
 

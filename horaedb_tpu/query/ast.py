@@ -1,0 +1,218 @@
+"""SQL AST nodes (expressions + statements).
+
+Kept deliberately small: the expression grammar covers what the engine can
+execute (arithmetic, comparisons, boolean logic, function calls, literals,
+columns); statements cover the reference's Plan surface (plan.rs:67):
+query, insert, create/drop/describe/alter/show/exists.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+# ---- expressions -------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Expr:
+    pass
+
+
+@dataclass(frozen=True)
+class Column(Expr):
+    name: str
+
+    def __str__(self) -> str:
+        return self.name
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any  # int | float | str | bool | None
+
+    def __str__(self) -> str:
+        if isinstance(self.value, str):
+            return f"'{self.value}'"
+        return str(self.value)
+
+
+@dataclass(frozen=True)
+class BinaryOp(Expr):
+    op: str  # + - * / % = != < <= > >= AND OR
+    left: Expr
+    right: Expr
+
+    def __str__(self) -> str:
+        return f"({self.left} {self.op} {self.right})"
+
+
+@dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # NOT, -
+    operand: Expr
+
+    def __str__(self) -> str:
+        return f"({self.op} {self.operand})"
+
+
+@dataclass(frozen=True)
+class FuncCall(Expr):
+    name: str  # lowercased
+    args: tuple[Expr, ...]
+    distinct: bool = False
+
+    def __str__(self) -> str:
+        inner = ", ".join(str(a) for a in self.args)
+        return f"{self.name}({'DISTINCT ' if self.distinct else ''}{inner})"
+
+
+@dataclass(frozen=True)
+class Star(Expr):
+    def __str__(self) -> str:
+        return "*"
+
+
+@dataclass(frozen=True)
+class InList(Expr):
+    expr: Expr
+    values: tuple[Expr, ...]
+    negated: bool = False
+
+    def __str__(self) -> str:
+        vals = ", ".join(str(v) for v in self.values)
+        return f"({self.expr} {'NOT ' if self.negated else ''}IN ({vals}))"
+
+
+@dataclass(frozen=True)
+class Between(Expr):
+    expr: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Expr):
+    expr: Expr
+    negated: bool = False
+
+
+# ---- statements --------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+    @property
+    def output_name(self) -> str:
+        return self.alias if self.alias else str(self.expr)
+
+
+@dataclass(frozen=True)
+class OrderItem:
+    expr: Expr
+    ascending: bool = True
+
+
+@dataclass(frozen=True)
+class Select:
+    items: tuple[SelectItem, ...]
+    table: Optional[str]
+    where: Optional[Expr] = None
+    group_by: tuple[Expr, ...] = ()
+    order_by: tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class ColumnDef:
+    name: str
+    type_name: str
+    is_tag: bool = False
+    is_timestamp_key: bool = False
+    not_null: bool = False
+    comment: str = ""
+
+
+@dataclass(frozen=True)
+class CreateTable:
+    table: str
+    columns: tuple[ColumnDef, ...]
+    timestamp_key: Optional[str]  # from inline `timestamp KEY` or TIMESTAMP KEY(col)
+    primary_key: Optional[tuple[str, ...]]
+    engine: str = "Analytic"
+    options: dict[str, str] = field(default_factory=dict)
+    if_not_exists: bool = False
+    partition_by: Optional["PartitionBy"] = None
+
+
+@dataclass(frozen=True)
+class PartitionBy:
+    """PARTITION BY KEY(cols) PARTITIONS n — ref: parser.rs partition DDL."""
+
+    method: str  # "key" | "hash"
+    columns: tuple[str, ...]
+    num_partitions: int
+
+
+@dataclass(frozen=True)
+class Insert:
+    table: str
+    columns: tuple[str, ...]
+    values: tuple[tuple[Any, ...], ...]  # literal rows
+
+
+@dataclass(frozen=True)
+class DropTable:
+    table: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Describe:
+    table: str
+
+
+@dataclass(frozen=True)
+class ShowTables:
+    pass
+
+
+@dataclass(frozen=True)
+class ShowCreateTable:
+    table: str
+
+
+@dataclass(frozen=True)
+class ExistsTable:
+    table: str
+
+
+@dataclass(frozen=True)
+class AlterTableAddColumn:
+    table: str
+    columns: tuple[ColumnDef, ...]
+
+
+@dataclass(frozen=True)
+class AlterTableSetOptions:
+    table: str
+    options: dict[str, str]
+
+
+Statement = (
+    Select
+    | CreateTable
+    | Insert
+    | DropTable
+    | Describe
+    | ShowTables
+    | ShowCreateTable
+    | ExistsTable
+    | AlterTableAddColumn
+    | AlterTableSetOptions
+)

@@ -1,0 +1,187 @@
+"""Interpreters: Plan -> effect (ref: src/interpreters, factory.rs:70).
+
+One interpreter per plan variant, dispatched by ``InterpreterFactory``;
+outputs are either a ``ResultSet`` (queries, SHOW/DESCRIBE) or an affected
+row count (writes, DDL) — mirroring the reference's ``Output`` enum.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Union
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..common_types.row_group import RowGroup
+from ..engine.options import format_duration
+from .executor import Executor, ResultSet
+from .plan import (
+    AlterTablePlan,
+    CreateTablePlan,
+    DescribePlan,
+    DropTablePlan,
+    ExistsPlan,
+    InsertPlan,
+    Plan,
+    QueryPlan,
+    ShowCreatePlan,
+    ShowTablesPlan,
+)
+
+
+@dataclass(frozen=True)
+class AffectedRows:
+    count: int
+
+
+Output = Union[ResultSet, AffectedRows]
+
+
+class InterpreterError(ValueError):
+    pass
+
+
+class InterpreterFactory:
+    def __init__(self, catalog: Catalog) -> None:
+        self.catalog = catalog
+        self.executor = Executor(catalog.instance)
+
+    def execute(self, plan: Plan) -> Output:
+        if isinstance(plan, QueryPlan):
+            return self._select(plan)
+        if isinstance(plan, InsertPlan):
+            return self._insert(plan)
+        if isinstance(plan, CreateTablePlan):
+            return self._create(plan)
+        if isinstance(plan, DropTablePlan):
+            dropped = self.catalog.drop_table(plan.table, plan.if_exists)
+            return AffectedRows(1 if dropped else 0)
+        if isinstance(plan, DescribePlan):
+            return self._describe(plan)
+        if isinstance(plan, ShowTablesPlan):
+            names = self.catalog.table_names()
+            return ResultSet(["Tables"], [np.array(names, dtype=object)])
+        if isinstance(plan, ShowCreatePlan):
+            return self._show_create(plan)
+        if isinstance(plan, ExistsPlan):
+            return ResultSet(
+                ["result"], [np.array([1 if self.catalog.exists(plan.table) else 0])]
+            )
+        if isinstance(plan, AlterTablePlan):
+            return self._alter(plan)
+        raise InterpreterError(f"no interpreter for {type(plan).__name__}")
+
+    # ---- variants -----------------------------------------------------------
+    def _select(self, plan: QueryPlan) -> ResultSet:
+        table = self.catalog.open_table(plan.table)
+        if table is None:
+            raise InterpreterError(f"table not found: {plan.table}")
+        return self.executor.execute(plan, table)
+
+    def _insert(self, plan: InsertPlan) -> AffectedRows:
+        table = self.catalog.open_table(plan.table)
+        if table is None:
+            raise InterpreterError(f"table not found: {plan.table}")
+        rows = RowGroup.from_rows(table.schema, list(plan.rows))
+        self.catalog.instance.write(table, rows)
+        return AffectedRows(len(rows))
+
+    def _create(self, plan: CreateTablePlan) -> AffectedRows:
+        partition_info = None
+        if plan.partition_by is not None:
+            partition_info = {
+                "method": plan.partition_by.method,
+                "columns": list(plan.partition_by.columns),
+                "num_partitions": plan.partition_by.num_partitions,
+            }
+        self.catalog.create_table(
+            plan.table,
+            plan.schema,
+            plan.options,
+            if_not_exists=plan.if_not_exists,
+            partition_info=partition_info,
+        )
+        return AffectedRows(0)
+
+    def _describe(self, plan: DescribePlan) -> ResultSet:
+        table = self.catalog.open_table(plan.table)
+        if table is None:
+            raise InterpreterError(f"table not found: {plan.table}")
+        schema = table.schema
+        names, types, keys, tags, nullables = [], [], [], [], []
+        for i, c in enumerate(schema.columns):
+            names.append(c.name)
+            types.append(c.kind.value)
+            keys.append(i in schema.primary_key_indexes)
+            tags.append(c.is_tag)
+            nullables.append(c.is_nullable)
+        return ResultSet(
+            ["name", "type", "is_primary", "is_nullable", "is_tag"],
+            [
+                np.array(names, dtype=object),
+                np.array(types, dtype=object),
+                np.array(keys),
+                np.array(nullables),
+                np.array(tags),
+            ],
+        )
+
+    def _show_create(self, plan: ShowCreatePlan) -> ResultSet:
+        table = self.catalog.open_table(plan.table)
+        if table is None:
+            raise InterpreterError(f"table not found: {plan.table}")
+        schema = table.schema
+        cols = []
+        for i, c in enumerate(schema.columns):
+            parts = [f"`{c.name}` {c.kind.value}"]
+            if c.is_tag:
+                parts.append("TAG")
+            if not c.is_nullable:
+                parts.append("NOT NULL")
+            if c.comment:
+                parts.append(f"COMMENT '{c.comment}'")
+            cols.append(" ".join(parts))
+        cols.append(f"TIMESTAMP KEY({schema.timestamp_name})")
+        opts = table.options
+        with_parts = [
+            f"update_mode='{opts.update_mode.value.upper()}'",
+            f"enable_ttl='{str(opts.enable_ttl).lower()}'",
+        ]
+        if opts.segment_duration_ms:
+            with_parts.insert(0, f"segment_duration='{format_duration(opts.segment_duration_ms)}'")
+        sql = (
+            f"CREATE TABLE `{plan.table}` ({', '.join(cols)}) "
+            f"ENGINE=Analytic WITH ({', '.join(with_parts)})"
+        )
+        return ResultSet(
+            ["Table", "Create Table"],
+            [np.array([plan.table], dtype=object), np.array([sql], dtype=object)],
+        )
+
+    def _alter(self, plan: AlterTablePlan) -> AffectedRows:
+        table = self.catalog.open_table(plan.table)
+        if table is None:
+            raise InterpreterError(f"table not found: {plan.table}")
+        if plan.add_columns:
+            schema = table.schema
+            for c in plan.add_columns:
+                schema = schema.with_added_column(c)
+            self.catalog.instance.alter_schema(table, schema)
+        if plan.set_options:
+            from ..engine.options import TableOptions
+
+            merged = {**table.options.to_dict()}
+            new = TableOptions.from_kv(plan.set_options).to_dict()
+            for k, v in plan.set_options.items():
+                key = {
+                    "segment_duration": "segment_duration_ms",
+                    "ttl": "ttl_ms",
+                }.get(k.lower(), k.lower())
+                if key in new:
+                    merged[key] = new[key]
+            table.options = TableOptions.from_dict(merged)
+            from ..engine.manifest import AlterOptions
+
+            table.manifest.append_edits([AlterOptions(table.options.to_dict())])
+        return AffectedRows(0)

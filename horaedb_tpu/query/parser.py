@@ -1,0 +1,553 @@
+"""SQL tokenizer + recursive-descent parser.
+
+Covers the reference's extended SQL dialect (ref: query_frontend/src/
+parser.rs:140-363 — standard SQL plus ``TAG`` column modifiers,
+``TIMESTAMP KEY``, ``ENGINE = Analytic``, ``WITH (k='v')`` table options,
+``PARTITION BY KEY(...) PARTITIONS n``). Hand-rolled because the image has
+no SQL parsing library — and the dialect is small enough that a tight
+tokenizer + precedence-climbing expression parser is clearer than bending
+a general parser around the extensions.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from . import ast
+
+
+class ParseError(ValueError):
+    def __init__(self, msg: str, pos: int = -1, sql: str = "") -> None:
+        ctx = ""
+        if sql and pos >= 0:
+            ctx = f" near: {sql[max(0, pos - 10):pos + 20]!r}"
+        super().__init__(f"{msg}{ctx}")
+
+
+# ---- tokenizer ---------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>--[^\n]*)
+  | (?P<number>\d+(\.\d+)?([eE][+-]?\d+)?)
+  | (?P<qident>"[^"]*"|`[^`]*`)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<name>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><=|>=|!=|<>|==|[-+*/%(),.=<>;])
+    """,
+    re.VERBOSE,
+)
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind: str, text: str, pos: int) -> None:
+        self.kind = kind  # number|string|name|op|qident
+        self.text = text
+        self.pos = pos
+
+    def __repr__(self) -> str:
+        return f"Token({self.kind}, {self.text!r})"
+
+
+def tokenize(sql: str) -> list[Token]:
+    out: list[Token] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise ParseError(f"unexpected character {sql[i]!r}", i, sql)
+        kind = m.lastgroup
+        if kind not in ("ws", "comment"):
+            out.append(Token(kind, m.group(), i))
+        i = m.end()
+    return out
+
+
+_PRECEDENCE = {
+    "OR": 1,
+    "AND": 2,
+    "=": 4, "!=": 4, "<>": 4, "<": 4, "<=": 4, ">": 4, ">=": 4,
+    "+": 5, "-": 5,
+    "*": 6, "/": 6, "%": 6,
+}
+
+
+class Parser:
+    """One statement per parse() call; parse_many() splits on ';'."""
+
+    def __init__(self, sql: str) -> None:
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # ---- cursor helpers -------------------------------------------------
+    def _peek(self) -> Optional[Token]:
+        return self.tokens[self.i] if self.i < len(self.tokens) else None
+
+    def _next(self) -> Token:
+        t = self._peek()
+        if t is None:
+            raise ParseError("unexpected end of input", len(self.sql), self.sql)
+        self.i += 1
+        return t
+
+    def _peek_ahead_is(self, kw: str) -> bool:
+        nxt = self.i + 1
+        return nxt < len(self.tokens) and self.tokens[nxt].text.upper() == kw
+
+    def _at_kw(self, *kws: str) -> bool:
+        t = self._peek()
+        return t is not None and t.kind == "name" and t.text.upper() in kws
+
+    def _eat_kw(self, *kws: str) -> bool:
+        if self._at_kw(*kws):
+            self.i += 1
+            return True
+        return False
+
+    def _expect_kw(self, kw: str) -> None:
+        if not self._eat_kw(kw):
+            t = self._peek()
+            raise ParseError(
+                f"expected {kw}, found {t.text if t else 'end of input'}",
+                t.pos if t else len(self.sql),
+                self.sql,
+            )
+
+    def _at_op(self, op: str) -> bool:
+        t = self._peek()
+        return t is not None and t.kind == "op" and t.text == op
+
+    def _eat_op(self, op: str) -> bool:
+        if self._at_op(op):
+            self.i += 1
+            return True
+        return False
+
+    def _expect_op(self, op: str) -> None:
+        if not self._eat_op(op):
+            t = self._peek()
+            raise ParseError(
+                f"expected {op!r}, found {t.text if t else 'end of input'}",
+                t.pos if t else len(self.sql),
+                self.sql,
+            )
+
+    def _ident(self) -> str:
+        t = self._next()
+        if t.kind == "name":
+            return t.text
+        if t.kind == "qident":
+            return t.text[1:-1]
+        raise ParseError(f"expected identifier, found {t.text!r}", t.pos, self.sql)
+
+    # ---- entry points ---------------------------------------------------
+    def parse(self) -> ast.Statement:
+        stmt = self._statement()
+        self._eat_op(";")
+        t = self._peek()
+        if t is not None:
+            raise ParseError(f"unexpected trailing input {t.text!r}", t.pos, self.sql)
+        return stmt
+
+    def parse_many(self) -> list[ast.Statement]:
+        out = []
+        while self._peek() is not None:
+            out.append(self._statement())
+            if not self._eat_op(";"):
+                break
+        t = self._peek()
+        if t is not None:
+            raise ParseError(f"unexpected trailing input {t.text!r}", t.pos, self.sql)
+        return out
+
+    # ---- statements ------------------------------------------------------
+    def _statement(self) -> ast.Statement:
+        if self._at_kw("SELECT"):
+            return self._select()
+        if self._at_kw("CREATE"):
+            return self._create_table()
+        if self._at_kw("INSERT"):
+            return self._insert()
+        if self._at_kw("DROP"):
+            return self._drop()
+        if self._at_kw("DESCRIBE", "DESC"):
+            self.i += 1
+            self._eat_kw("TABLE")
+            return ast.Describe(self._ident())
+        if self._at_kw("SHOW"):
+            return self._show()
+        if self._at_kw("EXISTS"):
+            self.i += 1
+            self._eat_kw("TABLE")
+            return ast.ExistsTable(self._ident())
+        if self._at_kw("ALTER"):
+            return self._alter()
+        t = self._peek()
+        raise ParseError(f"unsupported statement start {t.text!r}", t.pos, self.sql)
+
+    def _select(self) -> ast.Select:
+        self._expect_kw("SELECT")
+        items = [self._select_item()]
+        while self._eat_op(","):
+            items.append(self._select_item())
+        table = None
+        if self._eat_kw("FROM"):
+            table = self._ident()
+        where = None
+        if self._eat_kw("WHERE"):
+            where = self._expr()
+        group_by: tuple = ()
+        if self._eat_kw("GROUP"):
+            self._expect_kw("BY")
+            gb = [self._expr()]
+            while self._eat_op(","):
+                gb.append(self._expr())
+            group_by = tuple(gb)
+        order_by: list[ast.OrderItem] = []
+        if self._eat_kw("ORDER"):
+            self._expect_kw("BY")
+            while True:
+                e = self._expr()
+                asc = True
+                if self._eat_kw("DESC"):
+                    asc = False
+                elif self._eat_kw("ASC"):
+                    pass
+                order_by.append(ast.OrderItem(e, asc))
+                if not self._eat_op(","):
+                    break
+        limit = None
+        if self._eat_kw("LIMIT"):
+            t = self._next()
+            if t.kind != "number":
+                raise ParseError("LIMIT expects a number", t.pos, self.sql)
+            limit = int(t.text)
+        return ast.Select(
+            items=tuple(items),
+            table=table,
+            where=where,
+            group_by=group_by,
+            order_by=tuple(order_by),
+            limit=limit,
+        )
+
+    def _select_item(self) -> ast.SelectItem:
+        if self._at_op("*"):
+            self.i += 1
+            return ast.SelectItem(ast.Star())
+        e = self._expr()
+        alias = None
+        if self._eat_kw("AS"):
+            alias = self._ident()
+        elif (t := self._peek()) is not None and t.kind in ("name", "qident") and t.text.upper() not in (
+            "FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
+        ):
+            alias = self._ident()
+        return ast.SelectItem(e, alias)
+
+    def _create_table(self) -> ast.CreateTable:
+        self._expect_kw("CREATE")
+        self._expect_kw("TABLE")
+        if_not_exists = False
+        if self._eat_kw("IF"):
+            self._expect_kw("NOT")
+            self._expect_kw("EXISTS")
+            if_not_exists = True
+        name = self._ident()
+        self._expect_op("(")
+        columns: list[ast.ColumnDef] = []
+        timestamp_key: Optional[str] = None
+        primary_key: Optional[tuple[str, ...]] = None
+        while True:
+            if self._at_kw("TIMESTAMP") and self._peek_ahead_is("KEY"):
+                self.i += 2
+                self._expect_op("(")
+                timestamp_key = self._ident()
+                self._expect_op(")")
+            elif self._at_kw("PRIMARY"):
+                self.i += 1
+                self._expect_kw("KEY")
+                self._expect_op("(")
+                pk = [self._ident()]
+                while self._eat_op(","):
+                    pk.append(self._ident())
+                self._expect_op(")")
+                primary_key = tuple(pk)
+            else:
+                columns.append(self._column_def())
+                if columns[-1].is_timestamp_key:
+                    timestamp_key = columns[-1].name
+            if not self._eat_op(","):
+                break
+        self._expect_op(")")
+        engine = "Analytic"
+        partition_by = None
+        options: dict[str, str] = {}
+        while True:
+            if self._eat_kw("ENGINE"):
+                self._expect_op("=")
+                engine = self._ident()
+            elif self._at_kw("PARTITION"):
+                partition_by = self._partition_by()
+            elif self._eat_kw("WITH"):
+                self._expect_op("(")
+                while True:
+                    k = self._ident()
+                    self._expect_op("=")
+                    v = self._next()
+                    options[k] = v.text[1:-1].replace("''", "'") if v.kind == "string" else v.text
+                    if not self._eat_op(","):
+                        break
+                self._expect_op(")")
+            else:
+                break
+        return ast.CreateTable(
+            table=name,
+            columns=tuple(columns),
+            timestamp_key=timestamp_key,
+            primary_key=primary_key,
+            engine=engine,
+            options=options,
+            if_not_exists=if_not_exists,
+            partition_by=partition_by,
+        )
+
+    def _partition_by(self) -> ast.PartitionBy:
+        self._expect_kw("PARTITION")
+        self._expect_kw("BY")
+        method = self._ident().lower()
+        if method not in ("key", "hash"):
+            raise ParseError(f"unsupported partition method {method!r}")
+        self._expect_op("(")
+        cols = [self._ident()]
+        while self._eat_op(","):
+            cols.append(self._ident())
+        self._expect_op(")")
+        self._expect_kw("PARTITIONS")
+        t = self._next()
+        if t.kind != "number":
+            raise ParseError("PARTITIONS expects a number", t.pos, self.sql)
+        return ast.PartitionBy(method, tuple(cols), int(t.text))
+
+    def _column_def(self) -> ast.ColumnDef:
+        name = self._ident()
+        type_name = self._ident()
+        is_tag = False
+        is_ts_key = False
+        not_null = False
+        comment = ""
+        while True:
+            if self._eat_kw("TAG"):
+                is_tag = True
+            elif self._eat_kw("KEY"):
+                is_ts_key = True
+            elif self._at_kw("TIMESTAMP") and self._peek_ahead_is("KEY"):
+                self.i += 2
+                is_ts_key = True
+            elif self._eat_kw("NOT"):
+                self._expect_kw("NULL")
+                not_null = True
+            elif self._eat_kw("NULL"):
+                pass
+            elif self._eat_kw("COMMENT"):
+                t = self._next()
+                if t.kind != "string":
+                    raise ParseError("COMMENT expects a string", t.pos, self.sql)
+                comment = t.text[1:-1].replace("''", "'")
+            else:
+                break
+        return ast.ColumnDef(name, type_name, is_tag, is_ts_key, not_null, comment)
+
+    def _insert(self) -> ast.Insert:
+        self._expect_kw("INSERT")
+        self._expect_kw("INTO")
+        table = self._ident()
+        columns: tuple[str, ...] = ()
+        if self._eat_op("("):
+            cols = [self._ident()]
+            while self._eat_op(","):
+                cols.append(self._ident())
+            self._expect_op(")")
+            columns = tuple(cols)
+        self._expect_kw("VALUES")
+        rows = []
+        while True:
+            self._expect_op("(")
+            vals = [self._literal_value()]
+            while self._eat_op(","):
+                vals.append(self._literal_value())
+            self._expect_op(")")
+            rows.append(tuple(vals))
+            if not self._eat_op(","):
+                break
+        return ast.Insert(table, columns, tuple(rows))
+
+    def _literal_value(self) -> Any:
+        e = self._expr()
+        return _fold_literal(e, self.sql)
+
+    def _drop(self) -> ast.DropTable:
+        self._expect_kw("DROP")
+        self._expect_kw("TABLE")
+        if_exists = False
+        if self._eat_kw("IF"):
+            self._expect_kw("EXISTS")
+            if_exists = True
+        return ast.DropTable(self._ident(), if_exists)
+
+    def _show(self) -> ast.Statement:
+        self._expect_kw("SHOW")
+        if self._eat_kw("TABLES"):
+            return ast.ShowTables()
+        if self._eat_kw("CREATE"):
+            self._expect_kw("TABLE")
+            return ast.ShowCreateTable(self._ident())
+        t = self._peek()
+        raise ParseError(
+            f"unsupported SHOW {t.text if t else ''}", t.pos if t else -1, self.sql
+        )
+
+    def _alter(self) -> ast.Statement:
+        self._expect_kw("ALTER")
+        self._expect_kw("TABLE")
+        table = self._ident()
+        if self._eat_kw("ADD"):
+            self._eat_kw("COLUMN")
+            cols = [self._column_def()]
+            while self._eat_op(","):
+                self._eat_kw("COLUMN")
+                cols.append(self._column_def())
+            return ast.AlterTableAddColumn(table, tuple(cols))
+        if self._eat_kw("MODIFY"):
+            self._expect_kw("SETTING")
+            opts: dict[str, str] = {}
+            while True:
+                k = self._ident()
+                self._expect_op("=")
+                v = self._next()
+                opts[k] = v.text[1:-1].replace("''", "'") if v.kind == "string" else v.text
+                if not self._eat_op(","):
+                    break
+            return ast.AlterTableSetOptions(table, opts)
+        t = self._peek()
+        raise ParseError(
+            f"unsupported ALTER action {t.text if t else ''}", t.pos if t else -1, self.sql
+        )
+
+    # ---- expressions ------------------------------------------------------
+    def _expr(self, min_prec: int = 0) -> ast.Expr:
+        left = self._unary()
+        while True:
+            t = self._peek()
+            if t is None:
+                return left
+            op = t.text.upper() if t.kind == "name" else t.text
+            # NOT IN / NOT BETWEEN / IS [NOT] NULL / IN / BETWEEN
+            if t.kind == "name" and op in ("IN", "BETWEEN", "IS", "NOT"):
+                left = self._postfix_predicate(left)
+                continue
+            prec = _PRECEDENCE.get(op)
+            if prec is None or prec < min_prec:
+                return left
+            self.i += 1
+            if op == "<>":
+                op = "!="
+            right = self._expr(prec + 1)
+            left = ast.BinaryOp(op, left, right)
+
+    def _postfix_predicate(self, left: ast.Expr) -> ast.Expr:
+        negated = self._eat_kw("NOT")
+        if self._eat_kw("IN"):
+            self._expect_op("(")
+            vals = [self._expr()]
+            while self._eat_op(","):
+                vals.append(self._expr())
+            self._expect_op(")")
+            return ast.InList(left, tuple(vals), negated)
+        if self._eat_kw("BETWEEN"):
+            low = self._expr(_PRECEDENCE["AND"] + 1)
+            self._expect_kw("AND")
+            high = self._expr(_PRECEDENCE["AND"] + 1)
+            return ast.Between(left, low, high, negated)
+        if not negated and self._eat_kw("IS"):
+            neg = self._eat_kw("NOT")
+            self._expect_kw("NULL")
+            return ast.IsNull(left, neg)
+        t = self._peek()
+        raise ParseError(
+            f"unexpected token {t.text if t else ''}", t.pos if t else -1, self.sql
+        )
+
+    def _unary(self) -> ast.Expr:
+        if self._eat_kw("NOT"):
+            return ast.UnaryOp("NOT", self._unary())
+        if self._eat_op("-"):
+            inner = self._unary()
+            # Fold negative number literals so every downstream consumer
+            # (predicate extraction, residual filters) sees plain Literals.
+            if isinstance(inner, ast.Literal) and isinstance(inner.value, (int, float)):
+                return ast.Literal(-inner.value)
+            return ast.UnaryOp("-", inner)
+        if self._eat_op("+"):
+            return self._unary()
+        return self._primary()
+
+    def _primary(self) -> ast.Expr:
+        t = self._next()
+        if t.kind == "number":
+            text = t.text
+            if "." in text or "e" in text or "E" in text:
+                return ast.Literal(float(text))
+            return ast.Literal(int(text))
+        if t.kind == "string":
+            return ast.Literal(t.text[1:-1].replace("''", "'"))
+        if t.kind == "op" and t.text == "(":
+            e = self._expr()
+            self._expect_op(")")
+            return e
+        if t.kind == "op" and t.text == "*":
+            return ast.Star()
+        if t.kind in ("name", "qident"):
+            upper = t.text.upper()
+            if upper == "TRUE":
+                return ast.Literal(True)
+            if upper == "FALSE":
+                return ast.Literal(False)
+            if upper == "NULL":
+                return ast.Literal(None)
+            name = t.text if t.kind == "name" else t.text[1:-1]
+            if self._at_op("("):
+                self.i += 1
+                distinct = self._eat_kw("DISTINCT")
+                args: list[ast.Expr] = []
+                if not self._at_op(")"):
+                    args.append(self._expr())
+                    while self._eat_op(","):
+                        args.append(self._expr())
+                self._expect_op(")")
+                return ast.FuncCall(name.lower(), tuple(args), distinct)
+            return ast.Column(name)
+        raise ParseError(f"unexpected token {t.text!r}", t.pos, self.sql)
+
+
+def _fold_literal(e: ast.Expr, sql: str) -> Any:
+    """INSERT values must be constants; folds unary minus."""
+    if isinstance(e, ast.Literal):
+        return e.value
+    if isinstance(e, ast.UnaryOp) and e.op == "-":
+        v = _fold_literal(e.operand, sql)
+        return -v
+    raise ParseError(f"expected literal in VALUES, found {e}", -1, sql)
+
+
+def parse_sql(sql: str) -> ast.Statement:
+    return Parser(sql).parse()
+
+
+def parse_many(sql: str) -> list[ast.Statement]:
+    return Parser(sql).parse_many()

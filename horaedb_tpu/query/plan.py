@@ -1,0 +1,125 @@
+"""Plan sum type (ref: query_frontend/src/plan.rs:67).
+
+Each variant carries everything its interpreter needs; ``QueryPlan``
+additionally carries the extracted pushdown ``Predicate`` (time range +
+simple filters — ref: table_engine predicate extraction) and a priority
+decision (ref: plan.rs:105 ``decide_query_priority`` — long-time-range
+queries are demoted to the low-priority runtime).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..common_types.schema import Schema
+from ..common_types.time_range import TimeRange
+from ..engine.options import TableOptions
+from ..table_engine.predicate import Predicate
+from . import ast
+
+
+class QueryPriority(enum.Enum):
+    HIGH = "high"
+    LOW = "low"
+
+
+# Queries spanning more than this are "expensive" and run at low priority
+# (the reference's threshold is config-driven; same default spirit).
+EXPENSIVE_QUERY_RANGE_MS = 24 * 3_600_000
+
+
+@dataclass(frozen=True)
+class AggCall:
+    """One aggregate in the select list."""
+
+    func: str  # count | sum | min | max | avg
+    column: Optional[str]  # None for count(*)
+    output_name: str
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class GroupKey:
+    """A group-by key: a plain column or time_bucket(ts, interval)."""
+
+    column: Optional[str] = None  # plain column grouping
+    time_bucket_ms: Optional[int] = None  # time_bucket grouping width
+    output_name: str = ""
+
+
+@dataclass(frozen=True)
+class QueryPlan:
+    table: str
+    schema: Schema
+    select: ast.Select
+    predicate: Predicate
+    # Aggregation shape, filled when the query is scan+group+agg:
+    aggs: tuple[AggCall, ...] = ()
+    group_keys: tuple[GroupKey, ...] = ()
+    is_aggregate: bool = False
+    priority: QueryPriority = QueryPriority.HIGH
+
+
+@dataclass(frozen=True)
+class InsertPlan:
+    table: str
+    schema: Schema
+    rows: tuple[dict, ...]
+
+
+@dataclass(frozen=True)
+class CreateTablePlan:
+    table: str
+    schema: Schema
+    options: TableOptions
+    raw_options: dict[str, str]
+    if_not_exists: bool = False
+    partition_by: Optional[ast.PartitionBy] = None
+
+
+@dataclass(frozen=True)
+class DropTablePlan:
+    table: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DescribePlan:
+    table: str
+
+
+@dataclass(frozen=True)
+class ShowTablesPlan:
+    pass
+
+
+@dataclass(frozen=True)
+class ShowCreatePlan:
+    table: str
+
+
+@dataclass(frozen=True)
+class ExistsPlan:
+    table: str
+
+
+@dataclass(frozen=True)
+class AlterTablePlan:
+    table: str
+    add_columns: tuple = ()
+    set_options: dict[str, str] = field(default_factory=dict)
+
+
+Plan = (
+    QueryPlan
+    | InsertPlan
+    | CreateTablePlan
+    | DropTablePlan
+    | DescribePlan
+    | ShowTablesPlan
+    | ShowCreatePlan
+    | ExistsPlan
+    | AlterTablePlan
+)

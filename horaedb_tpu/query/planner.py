@@ -1,0 +1,405 @@
+"""Planner: AST -> Plan (ref: query_frontend/src/planner.rs).
+
+Besides shape-checking against the schema, the planner does the two
+analyses the TPU executor depends on:
+
+- predicate extraction: WHERE conjuncts on the timestamp column become the
+  scan ``TimeRange``; ``col op literal`` conjuncts become pushable filters
+  (ref: table_engine/src/predicate.rs time-range extraction);
+- aggregation shape: aggregate calls + group keys (plain columns or
+  ``time_bucket``) are lifted out of the select list so the executor can
+  route the query to the fused device kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..common_types.datum import DatumKind
+from ..common_types.schema import ColumnSchema, Schema
+from ..common_types.time_range import MAX_TIMESTAMP, MIN_TIMESTAMP, TimeRange
+from ..engine.options import TableOptions, parse_duration_ms
+from ..table_engine.predicate import ColumnFilter, FilterOp, Predicate
+from . import ast
+from .plan import (
+    AggCall,
+    AlterTablePlan,
+    CreateTablePlan,
+    DescribePlan,
+    DropTablePlan,
+    EXPENSIVE_QUERY_RANGE_MS,
+    ExistsPlan,
+    GroupKey,
+    InsertPlan,
+    Plan,
+    QueryPlan,
+    QueryPriority,
+    ShowCreatePlan,
+    ShowTablesPlan,
+)
+
+AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
+
+
+class PlanError(ValueError):
+    pass
+
+
+class Planner:
+    """``schema_of(table) -> Schema | None`` is the MetaProvider analog
+    (ref: query_frontend/src/provider.rs)."""
+
+    def __init__(self, schema_of: Callable[[str], Optional[Schema]]) -> None:
+        self.schema_of = schema_of
+
+    def plan(self, stmt: ast.Statement) -> Plan:
+        if isinstance(stmt, ast.Select):
+            return self._plan_select(stmt)
+        if isinstance(stmt, ast.CreateTable):
+            return self._plan_create(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._plan_insert(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return DropTablePlan(stmt.table, stmt.if_exists)
+        if isinstance(stmt, ast.Describe):
+            self._require_schema(stmt.table)
+            return DescribePlan(stmt.table)
+        if isinstance(stmt, ast.ShowTables):
+            return ShowTablesPlan()
+        if isinstance(stmt, ast.ShowCreateTable):
+            self._require_schema(stmt.table)
+            return ShowCreatePlan(stmt.table)
+        if isinstance(stmt, ast.ExistsTable):
+            return ExistsPlan(stmt.table)
+        if isinstance(stmt, ast.AlterTableAddColumn):
+            schema = self._require_schema(stmt.table)
+            cols = tuple(
+                ColumnSchema(
+                    c.name,
+                    DatumKind.from_sql_type(c.type_name),
+                    is_nullable=not c.not_null,
+                    is_tag=c.is_tag,
+                    comment=c.comment,
+                )
+                for c in stmt.columns
+            )
+            for c in cols:
+                if c.is_tag:
+                    raise PlanError("cannot ADD a TAG column")
+                if not c.is_nullable:
+                    # Existing rows can only surface NULL for the new column.
+                    raise PlanError("added columns must be nullable")
+                if schema.has_column(c.name):
+                    raise PlanError(f"column {c.name!r} already exists")
+            return AlterTablePlan(stmt.table, add_columns=cols)
+        if isinstance(stmt, ast.AlterTableSetOptions):
+            self._require_schema(stmt.table)
+            return AlterTablePlan(stmt.table, set_options=dict(stmt.options))
+        raise PlanError(f"unsupported statement: {type(stmt).__name__}")
+
+    def _require_schema(self, table: str) -> Schema:
+        schema = self.schema_of(table)
+        if schema is None:
+            raise PlanError(f"table not found: {table}")
+        return schema
+
+    # ---- CREATE ----------------------------------------------------------
+    def _plan_create(self, stmt: ast.CreateTable) -> CreateTablePlan:
+        if stmt.engine.lower() != "analytic":
+            raise PlanError(f"unsupported engine {stmt.engine!r}")
+        if stmt.timestamp_key is None:
+            raise PlanError("CREATE TABLE requires a TIMESTAMP KEY column")
+        cols = []
+        for c in stmt.columns:
+            kind = DatumKind.from_sql_type(c.type_name)
+            if c.is_tag and not kind.is_key_kind:
+                raise PlanError(f"column {c.name}: {c.type_name} cannot be TAG")
+            cols.append(
+                ColumnSchema(
+                    c.name,
+                    kind,
+                    is_nullable=not c.not_null,
+                    is_tag=c.is_tag,
+                    comment=c.comment,
+                )
+            )
+        schema = Schema.build(
+            cols,
+            timestamp_column=stmt.timestamp_key,
+            primary_key=list(stmt.primary_key) if stmt.primary_key else None,
+        )
+        options = TableOptions.from_kv(stmt.options)
+        return CreateTablePlan(
+            table=stmt.table,
+            schema=schema,
+            options=options,
+            raw_options=dict(stmt.options),
+            if_not_exists=stmt.if_not_exists,
+            partition_by=stmt.partition_by,
+        )
+
+    # ---- INSERT ----------------------------------------------------------
+    def _plan_insert(self, stmt: ast.Insert) -> InsertPlan:
+        schema = self._require_schema(stmt.table)
+        columns = stmt.columns
+        if not columns:
+            # positional: all non-generated columns in schema order
+            columns = tuple(
+                c.name
+                for c in schema.columns
+                if schema.tsid_index is None or c.name != schema.columns[schema.tsid_index].name
+            )
+        for c in columns:
+            if not schema.has_column(c):
+                raise PlanError(f"unknown column {c!r} in INSERT")
+        rows = []
+        for vals in stmt.values:
+            if len(vals) != len(columns):
+                raise PlanError(
+                    f"INSERT arity mismatch: {len(columns)} columns, {len(vals)} values"
+                )
+            rows.append(dict(zip(columns, vals)))
+        return InsertPlan(stmt.table, schema, tuple(rows))
+
+    # ---- SELECT ----------------------------------------------------------
+    def _plan_select(self, stmt: ast.Select) -> QueryPlan:
+        if stmt.table is None:
+            raise PlanError("SELECT without FROM is not supported")
+        schema = self._require_schema(stmt.table)
+        self._check_columns(stmt, schema)
+
+        predicate = extract_predicate(stmt.where, schema)
+        aggs, group_keys, is_agg = self._agg_shape(stmt, schema)
+
+        tr = predicate.time_range
+        span = tr.exclusive_end - tr.inclusive_start
+        priority = (
+            QueryPriority.LOW if span > EXPENSIVE_QUERY_RANGE_MS else QueryPriority.HIGH
+        )
+        return QueryPlan(
+            table=stmt.table,
+            schema=schema,
+            select=stmt,
+            predicate=predicate,
+            aggs=aggs,
+            group_keys=group_keys,
+            is_aggregate=is_agg,
+            priority=priority,
+        )
+
+    def _check_columns(self, stmt: ast.Select, schema: Schema) -> None:
+        aliases = {item.alias for item in stmt.items if item.alias}
+        for item in stmt.items:
+            for e in _walk(item.expr):
+                if isinstance(e, ast.Column) and not schema.has_column(e.name):
+                    raise PlanError(f"unknown column {e.name!r}")
+        for src in (stmt.where, *stmt.group_by):
+            if src is None:
+                continue
+            for e in _walk(src):
+                if isinstance(e, ast.Column) and not schema.has_column(e.name):
+                    raise PlanError(f"unknown column {e.name!r}")
+        # ORDER BY may reference select aliases as well as table columns.
+        for o in stmt.order_by:
+            for e in _walk(o.expr):
+                if (
+                    isinstance(e, ast.Column)
+                    and not schema.has_column(e.name)
+                    and e.name not in aliases
+                ):
+                    raise PlanError(f"unknown column {e.name!r}")
+
+    def _agg_shape(
+        self, stmt: ast.Select, schema: Schema
+    ) -> tuple[tuple[AggCall, ...], tuple[GroupKey, ...], bool]:
+        aggs: list[AggCall] = []
+        has_agg = any(
+            isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS
+            for item in stmt.items
+            for e in _walk(item.expr)
+        )
+        if not has_agg:
+            if stmt.group_by:
+                raise PlanError("GROUP BY without aggregates is not supported")
+            return (), (), False
+
+        group_keys: list[GroupKey] = []
+        for g in stmt.group_by:
+            group_keys.append(_group_key(g, schema))
+        group_names = {k.output_name for k in group_keys}
+
+        for item in stmt.items:
+            e = item.expr
+            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+                col = None
+                if e.args and not isinstance(e.args[0], ast.Star):
+                    if not isinstance(e.args[0], ast.Column):
+                        raise PlanError(
+                            f"aggregate over expression not supported: {e}"
+                        )
+                    col = e.args[0].name
+                if e.name != "count" and col is None:
+                    raise PlanError(f"{e.name} requires a column argument")
+                if e.name in ("sum", "avg") and col is not None:
+                    if not schema.column(col).kind.is_numeric:
+                        raise PlanError(f"{e.name}({col}) requires a numeric column")
+                aggs.append(AggCall(e.name, col, item.output_name, e.distinct))
+            elif isinstance(e, ast.Column):
+                if e.name not in group_names:
+                    raise PlanError(
+                        f"column {e.name!r} must appear in GROUP BY or an aggregate"
+                    )
+            elif isinstance(e, ast.FuncCall) and e.name == "time_bucket":
+                key = _group_key(e, schema)
+                if key.output_name not in {k.output_name for k in group_keys}:
+                    raise PlanError("time_bucket in SELECT must also be in GROUP BY")
+            else:
+                raise PlanError(f"unsupported select item in aggregate query: {e}")
+        return tuple(aggs), tuple(group_keys), True
+
+
+def _group_key(e: ast.Expr, schema: Schema) -> GroupKey:
+    if isinstance(e, ast.Column):
+        return GroupKey(column=e.name, output_name=e.name)
+    if isinstance(e, ast.FuncCall) and e.name == "time_bucket":
+        if len(e.args) != 2:
+            raise PlanError("time_bucket(timestamp_col, 'interval') expects 2 args")
+        col, interval = e.args
+        if not isinstance(col, ast.Column) or col.name != schema.timestamp_name:
+            raise PlanError("time_bucket must be applied to the timestamp key column")
+        if not isinstance(interval, ast.Literal) or not isinstance(interval.value, str):
+            raise PlanError("time_bucket interval must be a string literal like '1h'")
+        return GroupKey(
+            time_bucket_ms=parse_duration_ms(interval.value),
+            output_name=str(e),
+        )
+    raise PlanError(f"unsupported GROUP BY expression: {e}")
+
+
+# ---- predicate extraction ----------------------------------------------
+
+_CMP_TO_FILTER = {
+    "=": FilterOp.EQ,
+    "!=": FilterOp.NE,
+    "<": FilterOp.LT,
+    "<=": FilterOp.LE,
+    ">": FilterOp.GT,
+    ">=": FilterOp.GE,
+}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+
+
+def extract_predicate(where: Optional[ast.Expr], schema: Schema) -> Predicate:
+    """Time range + pushable filters from the WHERE conjunction.
+
+    Only top-level AND conjuncts are pushable (a disjunct can't narrow the
+    scan). Conjuncts that don't fit ``col op literal`` remain in the
+    executor's exact post-filter — extraction here is sound, not complete.
+    """
+    if where is None:
+        return Predicate.all_time()
+    ts_name = schema.timestamp_name
+    lo, hi = MIN_TIMESTAMP, MAX_TIMESTAMP
+    filters: list[ColumnFilter] = []
+    for conj in _conjuncts(where):
+        simple = _as_simple_cmp(conj)
+        if simple is None:
+            if isinstance(conj, ast.Between) and not conj.negated:
+                col = conj.expr
+                if (
+                    isinstance(col, ast.Column)
+                    and isinstance(conj.low, ast.Literal)
+                    and isinstance(conj.high, ast.Literal)
+                ):
+                    if col.name == ts_name:
+                        lo = max(lo, int(conj.low.value))
+                        hi = min(hi, int(conj.high.value) + 1)
+                    else:
+                        filters.append(ColumnFilter(col.name, FilterOp.GE, conj.low.value))
+                        filters.append(ColumnFilter(col.name, FilterOp.LE, conj.high.value))
+            elif isinstance(conj, ast.InList) and not conj.negated:
+                col = conj.expr
+                if isinstance(col, ast.Column) and all(
+                    isinstance(v, ast.Literal) for v in conj.values
+                ):
+                    filters.append(
+                        ColumnFilter(
+                            col.name,
+                            FilterOp.IN,
+                            tuple(v.value for v in conj.values),
+                        )
+                    )
+            continue
+        col, op, value = simple
+        if col == ts_name:
+            v = int(value)
+            if op == "=":
+                lo, hi = max(lo, v), min(hi, v + 1)
+            elif op == "<":
+                hi = min(hi, v)
+            elif op == "<=":
+                hi = min(hi, v + 1)
+            elif op == ">":
+                lo = max(lo, v + 1)
+            elif op == ">=":
+                lo = max(lo, v)
+            else:  # != — not range-expressible; leave to post-filter
+                filters.append(ColumnFilter(col, FilterOp.NE, v))
+        else:
+            filters.append(ColumnFilter(col, _CMP_TO_FILTER[op], value))
+    if hi < lo:
+        return Predicate(TimeRange.empty(), tuple(filters))
+    return Predicate(TimeRange(lo, hi), tuple(filters))
+
+
+def _conjuncts(e: ast.Expr) -> list[ast.Expr]:
+    if isinstance(e, ast.BinaryOp) and e.op.upper() == "AND":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _as_simple_cmp(e: ast.Expr) -> Optional[tuple[str, str, Any]]:
+    if not isinstance(e, ast.BinaryOp) or e.op not in _CMP_TO_FILTER:
+        return None
+    l, r = e.left, e.right
+    if isinstance(l, ast.Column) and isinstance(r, ast.Literal):
+        return l.name, e.op, r.value
+    if isinstance(l, ast.Literal) and isinstance(r, ast.Column):
+        return r.name, _FLIP[e.op], l.value
+    # fold unary minus literals
+    if isinstance(l, ast.Column) and isinstance(r, ast.UnaryOp) and r.op == "-" and isinstance(r.operand, ast.Literal):
+        return l.name, e.op, -r.operand.value
+    return None
+
+
+def _walk(e: ast.Expr):
+    yield e
+    if isinstance(e, ast.BinaryOp):
+        yield from _walk(e.left)
+        yield from _walk(e.right)
+    elif isinstance(e, ast.UnaryOp):
+        yield from _walk(e.operand)
+    elif isinstance(e, ast.FuncCall):
+        for a in e.args:
+            yield from _walk(a)
+    elif isinstance(e, ast.InList):
+        yield from _walk(e.expr)
+        for v in e.values:
+            yield from _walk(v)
+    elif isinstance(e, ast.Between):
+        yield from _walk(e.expr)
+        yield from _walk(e.low)
+        yield from _walk(e.high)
+    elif isinstance(e, ast.IsNull):
+        yield from _walk(e.expr)
+
+
+def _walk_exprs(stmt: ast.Select):
+    for item in stmt.items:
+        yield from _walk(item.expr)
+    if stmt.where is not None:
+        yield from _walk(stmt.where)
+    for g in stmt.group_by:
+        yield from _walk(g)
+    for o in stmt.order_by:
+        yield from _walk(o.expr)

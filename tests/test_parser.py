@@ -1,0 +1,217 @@
+"""SQL parser + planner tests (ref model: query_frontend inline tests)."""
+
+import pytest
+
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, Schema
+from horaedb_tpu.common_types.time_range import MAX_TIMESTAMP, MIN_TIMESTAMP
+from horaedb_tpu.query import ast
+from horaedb_tpu.query.parser import ParseError, parse_many, parse_sql
+from horaedb_tpu.query.plan import CreateTablePlan, InsertPlan, QueryPlan, QueryPriority
+from horaedb_tpu.query.planner import PlanError, Planner, extract_predicate
+from horaedb_tpu.table_engine.predicate import FilterOp
+
+
+def demo_schema():
+    return Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+def planner():
+    schemas = {"demo": demo_schema()}
+    return Planner(lambda n: schemas.get(n))
+
+
+class TestParser:
+    def test_create_table_reference_syntax(self):
+        # The README demo DDL shape (ref: README.md:55-66).
+        stmt = parse_sql(
+            "CREATE TABLE demo (name string TAG, value double NOT NULL, "
+            "t timestamp NOT NULL, TIMESTAMP KEY(t)) "
+            "ENGINE=Analytic with (enable_ttl='false')"
+        )
+        assert isinstance(stmt, ast.CreateTable)
+        assert stmt.timestamp_key == "t"
+        assert stmt.columns[0].is_tag
+        assert stmt.columns[1].not_null
+        assert stmt.options == {"enable_ttl": "false"}
+        assert stmt.engine == "Analytic"
+
+    def test_create_inline_timestamp_key(self):
+        stmt = parse_sql("CREATE TABLE x (ts timestamp KEY, v double)")
+        assert stmt.timestamp_key == "ts"
+
+    def test_create_partition_by(self):
+        stmt = parse_sql(
+            "CREATE TABLE p (h string TAG, t timestamp KEY, v double) "
+            "PARTITION BY KEY(h) PARTITIONS 4 ENGINE=Analytic"
+        )
+        assert stmt.partition_by.method == "key"
+        assert stmt.partition_by.columns == ("h",)
+        assert stmt.partition_by.num_partitions == 4
+
+    def test_insert_multi_row(self):
+        stmt = parse_sql(
+            "INSERT INTO demo (name, value, t) VALUES ('h1', 0.5, 1000), ('h2', -2, 2000)"
+        )
+        assert stmt.values == (("h1", 0.5, 1000), ("h2", -2, 2000))
+
+    def test_select_full_clause(self):
+        stmt = parse_sql(
+            "SELECT name, avg(value) AS a FROM demo "
+            "WHERE t >= 100 AND t < 200 AND name != 'x' "
+            "GROUP BY name ORDER BY a DESC LIMIT 10"
+        )
+        assert isinstance(stmt, ast.Select)
+        assert stmt.items[1].alias == "a"
+        assert len(stmt.group_by) == 1
+        assert not stmt.order_by[0].ascending
+        assert stmt.limit == 10
+
+    def test_select_time_bucket(self):
+        stmt = parse_sql(
+            "SELECT time_bucket(t, '1h'), max(value) FROM demo GROUP BY time_bucket(t, '1h')"
+        )
+        fn = stmt.items[0].expr
+        assert isinstance(fn, ast.FuncCall) and fn.name == "time_bucket"
+
+    def test_operator_precedence(self):
+        stmt = parse_sql("SELECT * FROM demo WHERE value > 1 + 2 * 3 OR name = 'a' AND value < 5")
+        w = stmt.where
+        assert isinstance(w, ast.BinaryOp) and w.op == "OR"
+        left = w.left
+        assert isinstance(left, ast.BinaryOp) and left.op == ">"
+        assert isinstance(left.right, ast.BinaryOp) and left.right.op == "+"
+
+    def test_in_between_is_null(self):
+        stmt = parse_sql(
+            "SELECT * FROM demo WHERE name IN ('a','b') AND value BETWEEN 1 AND 2 "
+            "AND value IS NOT NULL AND name NOT IN ('c')"
+        )
+        assert stmt.where is not None
+
+    def test_statements_split(self):
+        stmts = parse_many("SHOW TABLES; DROP TABLE IF EXISTS x; DESCRIBE demo")
+        assert [type(s).__name__ for s in stmts] == ["ShowTables", "DropTable", "Describe"]
+
+    def test_errors_are_located(self):
+        with pytest.raises(ParseError, match="near"):
+            parse_sql("SELECT FROM demo WHERE")
+        with pytest.raises(ParseError):
+            parse_sql("CREATE TABLE t (a double) ENGINE =")
+        with pytest.raises(ParseError, match="trailing"):
+            parse_sql("SHOW TABLES garbage garbage")
+
+    def test_quoted_identifiers_and_comments(self):
+        stmt = parse_sql('SELECT `value` FROM demo -- trailing comment\nWHERE "name" = \'x\'')
+        assert isinstance(stmt.items[0].expr, ast.Column)
+
+    def test_alter(self):
+        stmt = parse_sql("ALTER TABLE demo ADD COLUMN v2 double")
+        assert stmt.columns[0].name == "v2"
+        stmt = parse_sql("ALTER TABLE demo MODIFY SETTING write_buffer_size='1mb'")
+        assert stmt.options == {"write_buffer_size": "1mb"}
+
+
+class TestPlanner:
+    def test_create_plan_builds_schema(self):
+        plan = planner().plan(
+            parse_sql(
+                "CREATE TABLE demo2 (host string TAG, v double, ts timestamp KEY) "
+                "WITH (segment_duration='2h', update_mode='APPEND')"
+            )
+        )
+        assert isinstance(plan, CreateTablePlan)
+        assert plan.schema.tag_names == ("host",)
+        assert plan.options.segment_duration_ms == 2 * 3_600_000
+
+    def test_create_requires_timestamp_key(self):
+        with pytest.raises(PlanError, match="TIMESTAMP KEY"):
+            planner().plan(parse_sql("CREATE TABLE bad (v double)"))
+
+    def test_insert_plan_positional_columns(self):
+        plan = planner().plan(parse_sql("INSERT INTO demo VALUES (1000, 'h1', 0.5)"))
+        assert isinstance(plan, InsertPlan)
+        # positional order is schema order minus tsid: [t, name, value]
+        assert plan.rows[0] == {"t": 1000, "name": "h1", "value": 0.5}
+
+    def test_insert_arity_checked(self):
+        with pytest.raises(PlanError, match="arity"):
+            planner().plan(parse_sql("INSERT INTO demo (name) VALUES ('a', 1)"))
+
+    def test_unknown_column_rejected(self):
+        with pytest.raises(PlanError, match="unknown column"):
+            planner().plan(parse_sql("SELECT nope FROM demo"))
+
+    def test_unknown_table_rejected(self):
+        with pytest.raises(PlanError, match="not found"):
+            planner().plan(parse_sql("SELECT * FROM missing"))
+
+    def test_agg_shape(self):
+        plan = planner().plan(
+            parse_sql(
+                "SELECT name, time_bucket(t, '1m'), avg(value), count(*) FROM demo "
+                "GROUP BY name, time_bucket(t, '1m')"
+            )
+        )
+        assert isinstance(plan, QueryPlan) and plan.is_aggregate
+        assert [a.func for a in plan.aggs] == ["avg", "count"]
+        assert plan.group_keys[0].column == "name"
+        assert plan.group_keys[1].time_bucket_ms == 60_000
+
+    def test_bare_column_outside_group_by_rejected(self):
+        with pytest.raises(PlanError, match="GROUP BY"):
+            planner().plan(parse_sql("SELECT value, avg(value) FROM demo GROUP BY name"))
+
+    def test_priority_by_time_range(self):
+        p1 = planner().plan(parse_sql("SELECT * FROM demo WHERE t >= 0 AND t < 1000"))
+        assert p1.priority is QueryPriority.HIGH
+        p2 = planner().plan(parse_sql("SELECT * FROM demo"))
+        assert p2.priority is QueryPriority.LOW
+
+
+class TestPredicateExtraction:
+    def pred(self, where_sql):
+        stmt = parse_sql(f"SELECT * FROM demo WHERE {where_sql}")
+        return extract_predicate(stmt.where, demo_schema())
+
+    def test_time_range_conjuncts(self):
+        p = self.pred("t >= 100 AND t < 200")
+        assert p.time_range.inclusive_start == 100
+        assert p.time_range.exclusive_end == 200
+
+    def test_time_point(self):
+        p = self.pred("t = 150")
+        assert (p.time_range.inclusive_start, p.time_range.exclusive_end) == (150, 151)
+
+    def test_between_on_timestamp(self):
+        p = self.pred("t BETWEEN 100 AND 200")
+        assert (p.time_range.inclusive_start, p.time_range.exclusive_end) == (100, 201)
+
+    def test_flipped_literal(self):
+        p = self.pred("100 <= t AND 200 > t")
+        assert (p.time_range.inclusive_start, p.time_range.exclusive_end) == (100, 200)
+
+    def test_column_filters(self):
+        p = self.pred("value > 90 AND name = 'host_5'")
+        ops = {(f.column, f.op) for f in p.filters}
+        assert ("value", FilterOp.GT) in ops and ("name", FilterOp.EQ) in ops
+
+    def test_or_not_pushed(self):
+        p = self.pred("t > 100 OR value > 5")
+        assert p.time_range.inclusive_start == MIN_TIMESTAMP
+        assert p.filters == ()
+
+    def test_empty_range(self):
+        p = self.pred("t > 200 AND t < 100")
+        assert p.time_range.is_empty()
+
+    def test_in_list_pushed(self):
+        p = self.pred("name IN ('a', 'b')")
+        assert p.filters[0].op is FilterOp.IN
+        assert p.filters[0].value == ("a", "b")
