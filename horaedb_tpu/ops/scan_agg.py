@@ -61,11 +61,7 @@ class ScanAggSpec:
         )
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
-)
-def _fused_scan_agg(
+def scan_agg_body(
     group_codes,
     bucket_ids,
     mask,
@@ -75,8 +71,10 @@ def _fused_scan_agg(
     n_groups: int,
     n_buckets: int,
     n_agg_fields: int,
-    numeric_filters: tuple[tuple[int, int], ...],
+    numeric_filters: tuple[tuple[int, int], ...] = (),
 ):
+    """Pure kernel body — also the per-shard program inside shard_map
+    (parallel/dist_agg.py wraps it with psum/pmin/pmax collectives)."""
     m = mask
     for i, (field_idx, op_code) in enumerate(numeric_filters):
         v = values[field_idx]
@@ -121,6 +119,12 @@ def _fused_scan_agg(
     return counts, sums, mins, maxs
 
 
+_fused_scan_agg = functools.partial(
+    jax.jit,
+    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
+)(scan_agg_body)
+
+
 @dataclass
 class AggState:
     """Combinable partial aggregates (numpy, on host after device exit)."""
@@ -149,21 +153,32 @@ def scan_aggregate(
     ``spec`` should already be ``.padded()`` — callers slice the outputs
     back down to true group/bucket counts after combining partials.
     """
-    static_filters = tuple(
-        (fi, _FILTER_OPS[op]) for fi, op in spec.numeric_filters
-    )
-    lits = jnp.asarray(np.asarray(filter_literals, dtype=np.float32))
     counts, sums, mins, maxs = _fused_scan_agg(
         jnp.asarray(batch.group_codes),
         jnp.asarray(batch.bucket_ids),
         jnp.asarray(batch.mask),
         jnp.asarray(batch.values),
-        lits,
+        coerce_literals(filter_literals),
         n_groups=spec.n_groups,
         n_buckets=spec.n_buckets,
         n_agg_fields=spec.n_agg_fields,
-        numeric_filters=static_filters,
+        numeric_filters=encode_filter_ops(spec.numeric_filters),
     )
+    return state_to_host(counts, sums, mins, maxs)
+
+
+def encode_filter_ops(
+    filters: tuple[tuple[int, str], ...]
+) -> tuple[tuple[int, int], ...]:
+    """Op strings -> the static integer codes scan_agg_body branches on."""
+    return tuple((fi, _FILTER_OPS[op]) for fi, op in filters)
+
+
+def coerce_literals(filter_literals: Sequence[float]):
+    return jnp.asarray(np.asarray(filter_literals, dtype=np.float32))
+
+
+def state_to_host(counts, sums, mins, maxs) -> AggState:
     return AggState(
         counts=np.asarray(counts),
         sums=np.asarray(sums, dtype=np.float64),

@@ -1,0 +1,15 @@
+"""Distributed execution over a device mesh.
+
+Where the reference distributes queries by shipping serialized DataFusion
+subplans over gRPC to remote nodes and merging arrow streams back
+(SURVEY §2.5, df_engine_extensions dist push-down), the TPU-native design
+expresses the same partial-aggregate/final-aggregate split as ONE SPMD
+program: rows are sharded across a ``jax.sharding.Mesh`` axis, every device
+runs the fused scan/agg kernel on its shard, and XLA collectives (psum /
+pmin / pmax over ICI) do the final combine. No plan codec, no RPC on the
+data path.
+"""
+
+from .dist_agg import dist_scan_aggregate, make_dist_scan_agg
+
+__all__ = ["dist_scan_aggregate", "make_dist_scan_agg"]

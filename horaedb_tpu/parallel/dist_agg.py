@@ -1,0 +1,110 @@
+"""Sharded scan/aggregate: the distributed query step
+(ref: df_engine_extensions/src/dist_sql_query — partial agg pushed to data
+nodes, final agg at the coordinator; resolver.rs:76-120).
+
+TPU-native re-expression: ``shard_map`` over a 1-D mesh axis ``"shard"``.
+Each device runs the SAME fused scan/agg body on its row shard (rows are
+sharded along axis 0 / the trailing row axis of values), then the
+aggregation monoid combines across devices with XLA collectives:
+
+    counts, sums -> psum        mins -> pmin        maxs -> pmax
+
+which ride ICI inside a slice and DCN across slices — XLA picks the
+collective implementation; the program is identical from 1 to N devices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.encoding import PaddedBatch
+from ..ops.scan_agg import (
+    AggState,
+    ScanAggSpec,
+    coerce_literals,
+    encode_filter_ops,
+    scan_agg_body,
+    state_to_host,
+)
+
+SHARD_AXIS = "shard"
+
+# Compiled steps keyed by (mesh, spec): jax.jit caches by function identity,
+# so rebuilding the shard_map closure per call would re-compile every time.
+_STEP_CACHE: dict = {}
+
+
+def make_dist_scan_agg(mesh: Mesh, spec: ScanAggSpec) -> Callable:
+    """Compile (or fetch cached) the sharded scan/agg step for ``spec``.
+
+    Returns ``step(group_codes, bucket_ids, mask, values, literals)`` where
+    row-dimension inputs are sharded over the mesh axis and the output
+    aggregate state is replicated (fully combined) on every device.
+    """
+    cache_key = (mesh, spec)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    static_filters = encode_filter_ops(spec.numeric_filters)
+
+    def per_shard(group_codes, bucket_ids, mask, values, literals):
+        counts, sums, mins, maxs = scan_agg_body(
+            group_codes,
+            bucket_ids,
+            mask,
+            values,
+            literals,
+            n_groups=spec.n_groups,
+            n_buckets=spec.n_buckets,
+            n_agg_fields=spec.n_agg_fields,
+            numeric_filters=static_filters,
+        )
+        # Final aggregate: the monoid combine as mesh collectives.
+        counts = jax.lax.psum(counts, SHARD_AXIS)
+        sums = jax.lax.psum(sums, SHARD_AXIS)
+        mins = jax.lax.pmin(mins, SHARD_AXIS)
+        maxs = jax.lax.pmax(maxs, SHARD_AXIS)
+        return counts, sums, mins, maxs
+
+    sharded = shard_map(
+        per_shard,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(None, SHARD_AXIS), P(None)),
+        out_specs=(P(), P(), P(), P()),
+    )
+    step = jax.jit(sharded)
+    _STEP_CACHE[cache_key] = step
+    return step
+
+
+def dist_scan_aggregate(
+    mesh: Mesh,
+    batch: PaddedBatch,
+    spec: ScanAggSpec,
+    filter_literals=(),
+) -> AggState:
+    """Convenience wrapper: pad the batch to a multiple of the mesh size,
+    run the sharded step, return host-side combined partials."""
+    n_dev = mesh.devices.size
+    padded = batch.padded_len
+    if padded % n_dev:
+        raise ValueError(
+            f"padded batch length {padded} not divisible by mesh size {n_dev} "
+            "(shape buckets are powers of two; use a power-of-two mesh)"
+        )
+    step = make_dist_scan_agg(mesh, spec)
+    counts, sums, mins, maxs = step(
+        jnp.asarray(batch.group_codes),
+        jnp.asarray(batch.bucket_ids),
+        jnp.asarray(batch.mask),
+        jnp.asarray(batch.values),
+        coerce_literals(filter_literals),
+    )
+    return state_to_host(counts, sums, mins, maxs)
