@@ -1,0 +1,254 @@
+"""Compaction: L0 -> L1 with the device merge-dedup kernel
+(ref: analytic_engine/src/compaction/{mod,picker,scheduler}.rs and
+runner/local_runner.rs).
+
+Pickers (host-side policy, same two strategies as the reference):
+
+- ``TimeWindowPicker`` (default, picker.rs:498): bucket L0 files by aligned
+  segment window; any window with >1 file (or any L0 file overlapping an
+  L1 file in its window) compacts into that window's single L1 run.
+- ``SizeTieredPicker`` (picker.rs:211): within a window, group files of
+  similar size; compact groups of >= min_threshold files.
+
+The runner replaces the reference's BinaryHeap merge loop with the
+``ops.merge_dedup`` device sort: concatenate the input runs, one
+``lax.sort`` over (tsid, ts, seq desc), shift-compare dedup mask, host
+gather of payload columns, write one L1 SST per window. TTL-expired files
+are dropped without rewriting (ref: sst/manager.rs:100-118).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..common_types.row_group import RowGroup
+from ..common_types.time_range import TimeRange
+from ..ops import merge_dedup_permutation
+from .manifest import AddFile, MetaEdit, RemoveFile
+from .merge import dedup_sorted
+from .options import UpdateMode
+from .sst.manager import FileHandle
+from .sst.reader import SstReader
+from .sst.writer import SstWriter, WriteOptions
+from .table_data import TableData
+
+
+@dataclass(frozen=True)
+class CompactionTask:
+    """One unit of work: merge ``inputs`` into one L1 SST for ``window``."""
+
+    window: TimeRange
+    inputs: tuple[FileHandle, ...]  # L0 + overlapping L1
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(h.meta.size_bytes for h in self.inputs)
+
+
+@dataclass
+class CompactionResult:
+    tasks_run: int = 0
+    files_removed: int = 0
+    files_added: int = 0
+    rows_written: int = 0
+    expired_dropped: int = 0
+
+
+# ---- pickers -----------------------------------------------------------
+
+
+def bucket_by_window(
+    files: list[FileHandle], seg_ms: int
+) -> dict[int, list[FileHandle]]:
+    """Group files by the aligned segment window of their start timestamp.
+
+    THE window-assignment rule — the auto-compaction trigger
+    (instance.maybe_compact) and both pickers must agree on it.
+    """
+    windows: dict[int, list[FileHandle]] = {}
+    for h in files:
+        start = (h.time_range.inclusive_start // seg_ms) * seg_ms
+        windows.setdefault(start, []).append(h)
+    return windows
+
+
+class TimeWindowPicker:
+    """Default picker: compact every window where L0 has anything to fold."""
+
+    def pick(self, table: TableData) -> list[CompactionTask]:
+        seg_ms = table.options.segment_duration_ms
+        if not seg_ms:
+            return []
+        levels = table.version.levels
+        l0 = levels.files_at(0)
+        l1 = levels.files_at(1)
+        if not l0:
+            return []
+        tasks = []
+        for start, files in sorted(bucket_by_window(l0, seg_ms).items()):
+            window = TimeRange(start, start + seg_ms)
+            overlapping_l1 = [h for h in l1 if h.time_range.overlaps(window)]
+            # A single L0 run with no L1 partner needs no rewrite.
+            if len(files) + len(overlapping_l1) < 2:
+                continue
+            tasks.append(CompactionTask(window, tuple(files + overlapping_l1)))
+        return tasks
+
+
+class SizeTieredPicker:
+    """Similar-size grouping within a window (ref picker.rs:211).
+
+    SOUNDNESS CONSTRAINT: dedup resolves conflicting keys by FILE
+    max_sequence (merge.py), so a merged group must be CONTIGUOUS in the
+    sequence order of all files in the window — merging {seq 10, 40, 50}
+    while seq 20 stays behind would stamp the old seq-10 rows with
+    max_sequence 50 and resurrect stale values. Files are therefore walked
+    in max_sequence order (L1 included) and groups only ever span a
+    contiguous seq range; size similarity decides where groups break.
+    """
+
+    def __init__(self, min_threshold: int = 4, bucket_low: float = 0.5, bucket_high: float = 1.5):
+        self.min_threshold = min_threshold
+        self.bucket_low = bucket_low
+        self.bucket_high = bucket_high
+
+    def pick(self, table: TableData) -> list[CompactionTask]:
+        seg_ms = table.options.segment_duration_ms
+        if not seg_ms:
+            return []
+        levels = table.version.levels
+        l0 = levels.files_at(0)
+        l1 = levels.files_at(1)
+        if not l0:
+            return []
+        tasks = []
+        for start, files in sorted(bucket_by_window(l0, seg_ms).items()):
+            window = TimeRange(start, start + seg_ms)
+            in_window = files + [h for h in l1 if h.time_range.overlaps(window)]
+            in_window.sort(key=lambda h: h.meta.max_sequence)
+            group: list[FileHandle] = []
+            for h in in_window:
+                if not group:
+                    group = [h]
+                    continue
+                avg = sum(g.meta.size_bytes for g in group) / len(group)
+                if self.bucket_low * avg <= h.meta.size_bytes <= self.bucket_high * avg:
+                    group.append(h)
+                else:
+                    if len(group) >= self.min_threshold:
+                        tasks.append(CompactionTask(window, tuple(group)))
+                    group = [h]
+            if len(group) >= self.min_threshold:
+                tasks.append(CompactionTask(window, tuple(group)))
+        return tasks
+
+
+def make_picker(strategy: str):
+    if strategy == "size_tiered":
+        return SizeTieredPicker()
+    return TimeWindowPicker()
+
+
+# ---- runner ------------------------------------------------------------
+
+
+class Compactor:
+    def __init__(self, table: TableData) -> None:
+        self.table = table
+
+    def compact(self, now_ms: int | None = None) -> CompactionResult:
+        """Pick + run all pending compactions for this table (serialized)."""
+        table = self.table
+        result = CompactionResult()
+        with table.serial_lock:
+            self._drop_expired(result, now_ms)
+            picker = make_picker(table.options.compaction_strategy)
+            for task in picker.pick(table):
+                self._run_task(task, result)
+                result.tasks_run += 1
+        return result
+
+    def _drop_expired(self, result: CompactionResult, now_ms: int | None) -> None:
+        table = self.table
+        if not table.options.enable_ttl:
+            return
+        now = now_ms if now_ms is not None else int(time.time() * 1000)
+        expired = table.version.levels.expired_files(now, table.options.ttl_ms)
+        if not expired:
+            return
+        edits: list[MetaEdit] = [RemoveFile(h.level, h.file_id) for h in expired]
+        table.manifest.append_edits(edits)
+        for h in expired:
+            table.version.levels.remove_files(h.level, [h.file_id])
+        result.expired_dropped += len(expired)
+
+    def _run_task(self, task: CompactionTask, result: CompactionResult) -> None:
+        table = self.table
+        schema = table.schema
+
+        parts: list[RowGroup] = []
+        versions: list[np.ndarray] = []
+        max_seq = 0
+        for h in task.inputs:
+            rows = SstReader(table.store, h.path).read(schema)
+            if len(rows):
+                parts.append(rows)
+                versions.append(
+                    np.full(len(rows), h.meta.max_sequence, dtype=np.uint64)
+                )
+            max_seq = max(max_seq, h.meta.max_sequence)
+        if not parts:
+            merged = None
+        else:
+            rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
+            seq = np.concatenate(versions)
+            merged = self._device_merge(rows, seq)
+
+        edits: list[MetaEdit] = []
+        new_handles: list[FileHandle] = []
+        if merged is not None and len(merged):
+            writer = SstWriter(
+                table.store,
+                WriteOptions(
+                    num_rows_per_row_group=table.options.num_rows_per_row_group,
+                    compression=table.options.compression,
+                ),
+            )
+            fid = table.alloc_file_id()
+            path = table.sst_object_path(fid)
+            meta = writer.write(path, fid, merged, max_sequence=max_seq)
+            edits.append(AddFile(1, meta, path))
+            new_handles.append(FileHandle(meta, path, 1))
+            result.rows_written += len(merged)
+        for h in task.inputs:
+            edits.append(RemoveFile(h.level, h.file_id))
+        table.manifest.append_edits(edits)
+
+        for nh in new_handles:
+            table.version.levels.add_file(1, nh)
+        for h in task.inputs:
+            table.version.levels.remove_files(h.level, [h.file_id])
+        result.files_added += len(new_handles)
+        result.files_removed += len(task.inputs)
+        # Purge replaced objects.
+        for h in table.version.levels.drain_purge_queue():
+            table.store.delete(h.path)
+
+    def _device_merge(self, rows: RowGroup, seq: np.ndarray) -> RowGroup:
+        """The hot loop on device: sort + dedup permutation, host gather."""
+        table = self.table
+        schema = rows.schema
+        tsid_idx = schema.tsid_index
+        dedup = table.options.update_mode is UpdateMode.OVERWRITE
+        if tsid_idx is not None:
+            tsid = rows.columns[schema.columns[tsid_idx].name]
+            perm, keep = merge_dedup_permutation(
+                tsid, rows.timestamps.astype(np.int64), seq, dedup=dedup
+            )
+            return rows.take(perm[keep])
+        # Explicit primary keys (no tsid): host lexsort fallback.
+        srt = rows.sorted_by_key(seq=seq)
+        return dedup_sorted(srt) if dedup else srt

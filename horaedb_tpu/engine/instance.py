@@ -30,6 +30,9 @@ class EngineConfig:
     # Space-level write buffer: flush the biggest table when the sum of
     # memtable bytes passes this (ref: space.rs should_flush_space).
     space_write_buffer_size: int = 256 << 20
+    # Auto-compact after flush once any segment window holds this many L0
+    # files (ref: the compaction scheduler's background picking loop).
+    compaction_l0_trigger: int = 4
 
 
 class Instance:
@@ -134,9 +137,13 @@ class Instance:
             if self.wal is not None:
                 self.wal.append(table.table_id, seq, rows)
             table.put_rows(rows, seq)
-            if table.should_flush():
-                self.flush_table(table)
-            return seq
+            needs_flush = table.should_flush()
+        # Flush (and any triggered compaction) runs OUTSIDE the write
+        # critical section — it takes the serial lock itself, and other
+        # writers shouldn't queue behind a compaction rewrite.
+        if needs_flush:
+            self.flush_table(table)
+        return seq
 
     # ---- read path -----------------------------------------------------
     def read(
@@ -162,7 +169,28 @@ class Instance:
         if self.wal is not None and result.flushed_sequence:
             self.wal.mark_flushed(table.table_id, result.flushed_sequence)
         self._purge(table)
+        self.maybe_compact(table)
         return result
+
+    def maybe_compact(self, table: TableData) -> None:
+        """Compact when some segment window accumulated enough L0 runs.
+
+        Runs inline for now; the runtime layer moves this onto a background
+        executor (ref: compaction/scheduler.rs background loop).
+        """
+        seg_ms = table.options.segment_duration_ms
+        if not seg_ms:
+            return
+        from .compaction import bucket_by_window
+
+        windows = bucket_by_window(table.version.levels.files_at(0), seg_ms)
+        if windows and max(len(v) for v in windows.values()) >= self.config.compaction_l0_trigger:
+            self.compact_table(table)
+
+    def compact_table(self, table: TableData):
+        from .compaction import Compactor
+
+        return Compactor(table).compact()
 
     def alter_schema(self, table: TableData, schema: Schema) -> None:
         with table.serial_lock:

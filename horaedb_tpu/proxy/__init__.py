@@ -1,0 +1,121 @@
+"""Proxy: request orchestration in front of the query stack
+(ref: src/proxy — Proxy::handle_*, Context, limiter.rs, the slow-query log
+in read.rs:177-183, and hotspot tracking).
+
+Round-1 standalone scope: request ids, per-request timing + metrics,
+a block-list limiter (the reference's ``/admin/block`` surface), a slow
+query log with a runtime-adjustable threshold, and hotspot (table read/
+write rate) tracking. Routing/forwarding joins when cluster mode lands.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+import time
+from collections import Counter as TallyCounter
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..db import Connection
+from ..query.interpreters import AffectedRows, Output
+from ..query.plan import InsertPlan, QueryPlan
+from ..utils.metrics import REGISTRY
+
+logger = logging.getLogger("horaedb_tpu.proxy")
+
+
+class BlockedError(RuntimeError):
+    pass
+
+
+@dataclass
+class RequestContext:
+    request_id: int
+    sql: str
+    start: float = field(default_factory=time.perf_counter)
+
+
+class Limiter:
+    """Table block-list (ref: proxy/src/limiter.rs + /admin/block)."""
+
+    def __init__(self) -> None:
+        self._blocked: set[str] = set()
+        self._lock = threading.Lock()
+
+    def block(self, tables) -> None:
+        with self._lock:
+            self._blocked.update(tables)
+
+    def unblock(self, tables) -> None:
+        with self._lock:
+            self._blocked.difference_update(tables)
+
+    def blocked(self) -> list[str]:
+        with self._lock:
+            return sorted(self._blocked)
+
+    def check(self, table: Optional[str]) -> None:
+        if table is None:
+            return
+        with self._lock:
+            if table in self._blocked:
+                raise BlockedError(f"table blocked by limiter: {table}")
+
+
+class Hotspot:
+    """Per-table op tallies (ref: proxy/src/hotspot.rs)."""
+
+    def __init__(self) -> None:
+        self.reads: TallyCounter = TallyCounter()
+        self.writes: TallyCounter = TallyCounter()
+        self._lock = threading.Lock()
+
+    def record(self, table: str, is_write: bool) -> None:
+        with self._lock:
+            (self.writes if is_write else self.reads)[table] += 1
+
+    def top(self, n: int = 10) -> dict:
+        with self._lock:
+            return {
+                "reads": dict(self.reads.most_common(n)),
+                "writes": dict(self.writes.most_common(n)),
+            }
+
+
+class Proxy:
+    def __init__(self, conn: Connection, slow_threshold_s: float = 1.0) -> None:
+        self.conn = conn
+        self.limiter = Limiter()
+        self.hotspot = Hotspot()
+        self.slow_threshold_s = slow_threshold_s
+        self._req_ids = itertools.count(1)
+        self._m_queries = REGISTRY.counter("horaedb_queries_total", "SQL statements handled")
+        self._m_errors = REGISTRY.counter("horaedb_query_errors_total", "SQL statements failed")
+        self._m_latency = REGISTRY.histogram(
+            "horaedb_query_duration_seconds", "SQL statement latency"
+        )
+
+    def handle_sql(self, sql: str) -> Output:
+        ctx = RequestContext(next(self._req_ids), sql)
+        self._m_queries.inc()
+        try:
+            plan = self.conn.frontend.sql_to_plan(sql)
+            table = getattr(plan, "table", None)
+            self.limiter.check(table)
+            if table:
+                self.hotspot.record(table, isinstance(plan, InsertPlan))
+            out = self.conn.interpreters.execute(plan)
+            return out
+        except Exception:
+            self._m_errors.inc()
+            raise
+        finally:
+            elapsed = time.perf_counter() - ctx.start
+            self._m_latency.observe(elapsed)
+            if elapsed >= self.slow_threshold_s:
+                logger.warning(
+                    "slow query (request %d, %.3fs): %s",
+                    ctx.request_id, elapsed, sql[:500],
+                )

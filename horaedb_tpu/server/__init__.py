@@ -1,0 +1,11 @@
+"""Serving layer (ref: src/server — warp HTTP routes, http.rs:214-713).
+
+Round-1 surface: the HTTP listener with the reference's core routes
+(``/sql``, ``/write``, ``/metrics``, ``/route/{table}``, ``/debug/*``,
+``/admin/block``). gRPC storage service + wire protocols (MySQL/PG/
+InfluxDB/OpenTSDB/Prom) layer on in later rounds behind the same proxy.
+"""
+
+from .http import create_app, run_server
+
+__all__ = ["create_app", "run_server"]

@@ -1,0 +1,233 @@
+"""HTTP front end (ref: src/server/src/http.rs routes :214-713).
+
+Routes (default port 5440, matching the reference's http default,
+config.rs:176):
+
+    POST /sql            {"query": "..."}            -> {"rows": [...]}
+                         or {"affected_rows": N} for writes/DDL
+    POST /write          {"table": t, "rows": [{...}]} JSON bulk write
+    GET  /metrics        Prometheus text
+    GET  /route/{table}  routing info (standalone: self)
+    GET  /debug/config   engine + server config dump
+    GET  /debug/tables   per-table metrics (memtable/sst bytes, seqs)
+    GET  /debug/hotspot  hottest tables by reads/writes
+    PUT  /debug/slow_threshold/{seconds}  live slow-log threshold
+    POST /admin/block    {"tables": [...]} / DELETE to unblock
+    GET  /health         liveness
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any, Optional
+
+import numpy as np
+from aiohttp import web
+
+from ..db import Connection, connect
+from ..proxy import BlockedError, Proxy
+from ..query.executor import ResultSet
+from ..query.interpreters import AffectedRows
+from ..utils.metrics import REGISTRY
+
+logger = logging.getLogger("horaedb_tpu.server")
+
+DEFAULT_HTTP_PORT = 5440  # ref: config.rs:176
+
+
+def _json_default(v: Any):
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    raise TypeError(f"not JSON serializable: {type(v)}")
+
+
+def _dumps(obj: Any) -> str:
+    return json.dumps(obj, default=_json_default)
+
+
+def create_app(conn: Connection) -> web.Application:
+    proxy = Proxy(conn)
+    app = web.Application()
+    app["conn"] = conn
+    app["proxy"] = proxy
+
+    # ---- core ----------------------------------------------------------
+    async def sql(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        query = body.get("query")
+        if not isinstance(query, str) or not query.strip():
+            return web.json_response({"error": "missing 'query'"}, status=400)
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, proxy.handle_sql, query
+            )
+        except BlockedError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        except Exception as e:  # parse/plan/execution errors -> 422 like ref
+            return web.json_response({"error": str(e)}, status=422)
+        if isinstance(out, AffectedRows):
+            return web.json_response({"affected_rows": out.count})
+        return web.Response(
+            text=_dumps({"rows": out.to_pylist()}), content_type="application/json"
+        )
+
+    async def write(request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            table = body["table"]
+            rows = body["rows"]
+        except Exception:
+            body, table, rows = None, None, None
+        if not isinstance(table, str) or not isinstance(rows, list) or not rows \
+                or not all(isinstance(r, dict) for r in rows):
+            return web.json_response(
+                {"error": "body must be {'table': t, 'rows': [{...}]}"}, status=400
+            )
+        conn_ = request.app["conn"]
+
+        def do_write():
+            proxy.limiter.check(table)
+            t = conn_.catalog.open_table(table)
+            if t is None:
+                raise ValueError(f"table not found: {table}")
+            from ..common_types.row_group import RowGroup
+
+            rg = RowGroup.from_rows(t.schema, rows)
+            conn_.instance.write(t, rg)
+            proxy.hotspot.record(table, True)
+            return len(rg)
+
+        try:
+            n = await asyncio.get_running_loop().run_in_executor(None, do_write)
+        except BlockedError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response({"affected_rows": n})
+
+    # ---- observability -------------------------------------------------
+    async def metrics(request: web.Request) -> web.Response:
+        return web.Response(text=REGISTRY.expose(), content_type="text/plain")
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def route(request: web.Request) -> web.Response:
+        table = request.match_info["table"]
+        if not conn.catalog.exists(table):
+            return web.json_response({"error": f"table not found: {table}"}, status=404)
+        # Standalone: this node owns everything (cluster routing later).
+        return web.json_response(
+            {"table": table, "routes": [{"endpoint": "local", "shard_id": 0}]}
+        )
+
+    async def debug_config(request: web.Request) -> web.Response:
+        inst = conn.instance
+        return web.json_response(
+            {
+                "engine": {
+                    "space_write_buffer_size": inst.config.space_write_buffer_size,
+                    "compaction_l0_trigger": inst.config.compaction_l0_trigger,
+                    "wal": type(inst.wal).__name__ if inst.wal else None,
+                },
+                "slow_threshold_s": proxy.slow_threshold_s,
+            }
+        )
+
+    async def debug_tables(request: web.Request) -> web.Response:
+        def collect():
+            # open_table may do manifest load + WAL replay for cold tables:
+            # real blocking IO, so this runs off the event loop.
+            out = {}
+            for name in conn.catalog.table_names():
+                try:
+                    t = conn.catalog.open_table(name)
+                except Exception as e:
+                    out[name] = {"error": str(e)}
+                    continue
+                if t is not None:
+                    out[name] = t.metrics()
+            return out
+
+        out = await asyncio.get_running_loop().run_in_executor(None, collect)
+        return web.Response(text=_dumps(out), content_type="application/json")
+
+    async def debug_hotspot(request: web.Request) -> web.Response:
+        return web.json_response(proxy.hotspot.top())
+
+    async def slow_threshold(request: web.Request) -> web.Response:
+        try:
+            proxy.slow_threshold_s = float(request.match_info["seconds"])
+        except ValueError:
+            return web.json_response({"error": "bad threshold"}, status=400)
+        return web.json_response({"slow_threshold_s": proxy.slow_threshold_s})
+
+    async def admin_block(request: web.Request) -> web.Response:
+        try:
+            tables = (await request.json())["tables"]
+        except Exception:
+            tables = None
+        if not isinstance(tables, list) or not all(isinstance(t, str) for t in tables):
+            return web.json_response(
+                {"error": "body must be {'tables': ['name', ...]}"}, status=400
+            )
+        if request.method == "POST":
+            proxy.limiter.block(tables)
+        else:
+            proxy.limiter.unblock(tables)
+        return web.json_response({"blocked": proxy.limiter.blocked()})
+
+    app.router.add_post("/sql", sql)
+    app.router.add_post("/write", write)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/health", health)
+    app.router.add_get("/route/{table}", route)
+    app.router.add_get("/debug/config", debug_config)
+    app.router.add_get("/debug/tables", debug_tables)
+    app.router.add_get("/debug/hotspot", debug_hotspot)
+    app.router.add_put("/debug/slow_threshold/{seconds}", slow_threshold)
+    app.router.add_post("/admin/block", admin_block)
+    app.router.add_delete("/admin/block", admin_block)
+    return app
+
+
+def run_server(
+    data_dir: Optional[str] = None,
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_HTTP_PORT,
+) -> None:
+    conn = connect(data_dir)
+    app = create_app(conn)
+    logger.info("horaedb_tpu http listening on %s:%d (data: %s)", host, port, data_dir)
+    try:
+        web.run_app(app, host=host, port=port, print=None)
+    finally:
+        conn.close()
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="horaedb_tpu server")
+    p.add_argument("--data-dir", default=None, help="storage dir (default: in-memory)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=DEFAULT_HTTP_PORT)
+    p.add_argument("--log-level", default="info")
+    args = p.parse_args()
+    logging.basicConfig(level=args.log_level.upper())
+    run_server(args.data_dir, args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

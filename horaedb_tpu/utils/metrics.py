@@ -1,0 +1,107 @@
+"""Minimal Prometheus-style metrics registry
+(ref: lazy-static prometheus registries in nearly every reference crate,
+exposed at /metrics — server/src/http.rs:532).
+
+Counters and histograms only (what the serving path needs); text
+exposition format compatible with Prometheus scraping.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Optional, Sequence
+
+
+class Counter:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self._value}\n"
+        )
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._counts[bisect_right(self.buckets, v)] += 1
+            self._sum += v
+            self._total += 1
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    def expose(self) -> str:
+        with self._lock:  # consistent snapshot: buckets must sum to count
+            counts = list(self._counts)
+            total = self._total
+            sum_ = self._sum
+        out = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        acc = 0
+        for le, c in zip(self.buckets, counts):
+            acc += c
+            out.append(f'{self.name}_bucket{{le="{le}"}} {acc}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {sum_}")
+        out.append(f"{self.name}_count {total}")
+        return "\n".join(out) + "\n"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, Counter | Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Counter(name, help_)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, buckets)
+                self._metrics[name] = m
+            return m  # type: ignore[return-value]
+
+    def expose(self) -> str:
+        with self._lock:
+            return "".join(m.expose() for m in self._metrics.values())
+
+
+REGISTRY = Registry()

@@ -1,0 +1,168 @@
+"""Compaction tests (ref model: analytic_engine tests/compaction_test.rs)."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema, TimeRange
+from horaedb_tpu.engine.compaction import Compactor, SizeTieredPicker, TimeWindowPicker
+from horaedb_tpu.engine.instance import EngineConfig, Instance
+from horaedb_tpu.engine.options import TableOptions
+from horaedb_tpu.utils.object_store import MemoryStore
+
+HOUR = 3_600_000
+
+
+def demo_schema():
+    return Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+def env(**opts):
+    inst = Instance(MemoryStore(), EngineConfig(compaction_l0_trigger=1000))
+    table = inst.create_table(
+        0, 1, "demo", demo_schema(),
+        TableOptions.from_kv({"segment_duration": "1h", **opts}),
+    )
+    return inst, table
+
+
+def write_flush(inst, table, rows):
+    inst.write(table, RowGroup.from_rows(table.schema, rows))
+    # flush without triggering auto-compaction (trigger set high in env())
+    from horaedb_tpu.engine.flush import Flusher
+
+    Flusher(table).flush()
+
+
+class TestPickers:
+    def test_time_window_picks_multi_file_windows(self):
+        inst, t = env()
+        for i in range(3):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        write_flush(inst, t, [{"name": "h", "value": 9.0, "t": HOUR + 5}])
+        tasks = TimeWindowPicker().pick(t)
+        assert len(tasks) == 1  # only window 0 has >1 file
+        assert len(tasks[0].inputs) == 3
+
+    def test_time_window_includes_overlapping_l1(self):
+        inst, t = env()
+        for i in range(2):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        Compactor(t).compact()
+        assert len(t.version.levels.files_at(1)) == 1
+        # New L0 in the same window: task must pull the L1 run back in.
+        write_flush(inst, t, [{"name": "h", "value": 5.0, "t": 50}])
+        tasks = TimeWindowPicker().pick(t)
+        assert len(tasks) == 1 and len(tasks[0].inputs) == 2
+
+    def test_size_tiered_groups_similar_sizes(self):
+        inst, t = env(compaction_strategy="size_tiered")
+        for i in range(4):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        tasks = SizeTieredPicker(min_threshold=4).pick(t)
+        assert len(tasks) == 1 and len(tasks[0].inputs) == 4
+
+
+class TestCompaction:
+    def test_merge_dedup_newest_wins(self):
+        inst, t = env()
+        write_flush(inst, t, [{"name": "h", "value": 1.0, "t": 100}])
+        write_flush(inst, t, [{"name": "h", "value": 2.0, "t": 100}])  # overwrite
+        write_flush(inst, t, [{"name": "h", "value": 3.0, "t": 200}])
+        res = Compactor(t).compact()
+        assert res.tasks_run == 1
+        assert res.files_removed == 3 and res.files_added == 1
+        assert [h.level for h in t.version.levels.all_files()] == [1]
+        out = inst.read(t)
+        got = sorted((r["t"], r["value"]) for r in out.to_pylist())
+        assert got == [(100, 2.0), (200, 3.0)]
+
+    def test_append_mode_keeps_all_rows(self):
+        inst, t = env(update_mode="append")
+        write_flush(inst, t, [{"name": "h", "value": 1.0, "t": 100}])
+        write_flush(inst, t, [{"name": "h", "value": 2.0, "t": 100}])
+        Compactor(t).compact()
+        assert len(inst.read(t)) == 2
+
+    def test_compacted_files_purged_from_store(self):
+        inst, t = env()
+        for i in range(3):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        paths_before = {h.path for h in t.version.levels.files_at(0)}
+        Compactor(t).compact()
+        for p in paths_before:
+            assert not inst.store.exists(p)
+
+    def test_survives_reopen(self):
+        store = MemoryStore()
+        inst = Instance(store, EngineConfig(compaction_l0_trigger=1000))
+        t = inst.create_table(
+            0, 1, "demo", demo_schema(), TableOptions.from_kv({"segment_duration": "1h"})
+        )
+        for i in range(3):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100}])
+        Compactor(t).compact()
+        inst2 = Instance(store)
+        t2 = inst2.open_table(0, 1, "demo")
+        assert [h.level for h in t2.version.levels.all_files()] == [1]
+        out = inst2.read(t2)
+        assert len(out) == 1  # same key overwritten 3x
+        assert out.to_pylist()[0]["value"] == 2.0
+
+    def test_ttl_drops_expired_without_rewrite(self):
+        inst, t = env(ttl="1h")
+        write_flush(inst, t, [{"name": "h", "value": 1.0, "t": 100}])
+        write_flush(inst, t, [{"name": "h", "value": 2.0, "t": 10 * HOUR}])
+        res = Compactor(t).compact(now_ms=10 * HOUR + HOUR // 2)
+        assert res.expired_dropped == 1
+        out = inst.read(t)
+        assert [r["t"] for r in out.to_pylist()] == [10 * HOUR]
+
+    def test_multi_window_tasks(self):
+        inst, t = env()
+        for w in range(2):
+            for i in range(2):
+                write_flush(
+                    inst, t, [{"name": "h", "value": float(i), "t": w * HOUR + i}]
+                )
+        res = Compactor(t).compact()
+        assert res.tasks_run == 2
+        l1 = t.version.levels.files_at(1)
+        assert len(l1) == 2
+        # windows don't overlap after compaction
+        assert not l1[0].time_range.overlaps(l1[1].time_range)
+
+    def test_auto_compact_triggered_by_flush(self):
+        inst = Instance(MemoryStore(), EngineConfig(compaction_l0_trigger=3))
+        t = inst.create_table(
+            0, 1, "demo", demo_schema(), TableOptions.from_kv({"segment_duration": "1h"})
+        )
+        for i in range(3):
+            inst.write(t, RowGroup.from_rows(t.schema, [{"name": "h", "value": float(i), "t": 100 + i}]))
+            inst.flush_table(t)
+        assert len(t.version.levels.files_at(0)) == 0
+        assert len(t.version.levels.files_at(1)) == 1
+
+    def test_large_randomized_dedup_correctness(self):
+        inst, t = env()
+        rng = np.random.default_rng(11)
+        expect = {}
+        for run in range(6):
+            rows = []
+            for _ in range(500):
+                ts = int(rng.integers(0, HOUR))
+                name = f"h{rng.integers(0, 5)}"
+                v = float(rng.random())
+                rows.append({"name": name, "value": v, "t": ts})
+                expect[(name, ts)] = v  # later runs overwrite
+            write_flush(inst, t, rows)
+        Compactor(t).compact()
+        out = inst.read(t)
+        got = {(r["name"], r["t"]): r["value"] for r in out.to_pylist()}
+        assert got == expect

@@ -1,0 +1,147 @@
+"""HTTP server tests via aiohttp's test utilities (ref model: the protocol
+suites under integration_tests/ that drive a running server).
+
+No async pytest plugin in the image, so each test runs its own event loop.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import horaedb_tpu
+from horaedb_tpu.server import create_app
+
+DDL = (
+    "CREATE TABLE demo (name string TAG, value double NOT NULL, "
+    "t timestamp NOT NULL, TIMESTAMP KEY(t)) ENGINE=Analytic"
+)
+
+
+def with_client(coro_fn):
+    """Run an async test body against a live in-memory server."""
+
+    async def runner():
+        conn = horaedb_tpu.connect(None)
+        app = create_app(conn)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await coro_fn(client)
+        finally:
+            await client.close()
+            conn.close()
+
+    asyncio.run(runner())
+
+
+async def post_sql(client, query):
+    resp = await client.post("/sql", json={"query": query})
+    return resp.status, await resp.json()
+
+
+class TestSqlRoute:
+    def test_ddl_insert_select(self):
+        async def body(client):
+            status, b = await post_sql(client, DDL)
+            assert status == 200 and b == {"affected_rows": 0}
+            _, b = await post_sql(
+                client,
+                "INSERT INTO demo (name, value, t) VALUES ('h1', 1.0, 1000), ('h2', 2.0, 2000)",
+            )
+            assert b == {"affected_rows": 2}
+            status, b = await post_sql(
+                client, "SELECT name, avg(value) AS a FROM demo GROUP BY name ORDER BY name"
+            )
+            assert status == 200
+            assert b == {"rows": [{"name": "h1", "a": 1.0}, {"name": "h2", "a": 2.0}]}
+
+        with_client(body)
+
+    def test_error_statuses(self):
+        async def body(client):
+            status, b = await post_sql(client, "SELEC 1")
+            assert status == 422 and "SELEC" in b["error"]
+            resp = await client.post("/sql", data=b"not json")
+            assert resp.status == 400
+            resp = await client.post("/sql", json={"nope": 1})
+            assert resp.status == 400
+            status, b = await post_sql(client, "SELECT * FROM ghost")
+            assert status == 422 and "not found" in b["error"]
+
+        with_client(body)
+
+
+class TestWriteRoute:
+    def test_bulk_write(self):
+        async def body(client):
+            await post_sql(client, DDL)
+            resp = await client.post(
+                "/write",
+                json={"table": "demo", "rows": [
+                    {"name": "h1", "value": 5.0, "t": 1000},
+                    {"name": "h1", "value": 6.0, "t": 2000},
+                ]},
+            )
+            assert (await resp.json()) == {"affected_rows": 2}
+            _, b = await post_sql(client, "SELECT count(*) AS c FROM demo")
+            assert b["rows"] == [{"c": 2}]
+            resp = await client.post("/write", json={"table": "demo"})
+            assert resp.status == 400
+            resp = await client.post(
+                "/write", json={"table": "ghost", "rows": [{"t": 1}]}
+            )
+            assert resp.status == 422
+
+        with_client(body)
+
+
+class TestAdminAndDebug:
+    def test_block_body_validation(self):
+        async def body(client):
+            resp = await client.post("/admin/block", json={"tables": "users"})
+            assert resp.status == 400  # a string must not block per-character
+            resp = await client.post("/admin/block", json={"tables": 5})
+            assert resp.status == 400
+
+        with_client(body)
+
+    def test_block_unblock(self):
+        async def body(client):
+            await post_sql(client, DDL)
+            resp = await client.post("/admin/block", json={"tables": ["demo"]})
+            assert (await resp.json())["blocked"] == ["demo"]
+            status, b = await post_sql(client, "SELECT * FROM demo")
+            assert status == 403 and "blocked" in b["error"]
+            resp = await client.delete("/admin/block", json={"tables": ["demo"]})
+            assert (await resp.json())["blocked"] == []
+            status, _ = await post_sql(client, "SELECT * FROM demo")
+            assert status == 200
+
+        with_client(body)
+
+    def test_metrics_route_health_debug(self):
+        async def body(client):
+            await post_sql(client, DDL)
+            await post_sql(client, "INSERT INTO demo (name, value, t) VALUES ('h', 1.0, 1)")
+            await post_sql(client, "SELECT * FROM demo")
+
+            text = await (await client.get("/metrics")).text()
+            assert "horaedb_queries_total" in text
+            assert "horaedb_query_duration_seconds_bucket" in text
+
+            resp = await client.get("/route/demo")
+            assert (await resp.json())["routes"][0]["endpoint"] == "local"
+            assert (await client.get("/route/ghost")).status == 404
+            assert (await (await client.get("/health")).json()) == {"status": "ok"}
+
+            tables = await (await client.get("/debug/tables")).json()
+            assert "demo" in tables and tables["demo"]["last_sequence"] == 1
+            cfg = await (await client.get("/debug/config")).json()
+            assert "engine" in cfg
+            hot = await (await client.get("/debug/hotspot")).json()
+            assert hot["writes"].get("demo") == 1
+            resp = await client.put("/debug/slow_threshold/0.5")
+            assert (await resp.json())["slow_threshold_s"] == 0.5
+
+        with_client(body)
