@@ -21,6 +21,8 @@ from ..common_types.schema import Schema
 from ..engine.instance import Instance
 from ..engine.options import TableOptions
 from ..engine.table_data import TableData
+from ..table_engine.partition import PartitionedTable, make_rule, sub_table_name
+from ..table_engine.table import AnalyticTable, Table
 from ..utils.object_store import ObjectStore
 
 DEFAULT_CATALOG = "horaedb"
@@ -35,6 +37,7 @@ class TableEntry:
     table_id: int
     space_id: int
     partition_info: Optional[dict] = None
+    sub_table_ids: Optional[list[int]] = None
 
 
 class Catalog:
@@ -46,7 +49,7 @@ class Catalog:
         self._lock = threading.RLock()
         self._entries: dict[str, TableEntry] = {}
         self._next_table_id = 1
-        self._open_tables: dict[str, TableData] = {}
+        self._open_tables: dict[str, Table] = {}
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -58,7 +61,11 @@ class Catalog:
         self._next_table_id = raw["next_table_id"]
         for t in raw["tables"]:
             self._entries[t["name"]] = TableEntry(
-                t["name"], t["table_id"], t["space_id"], t.get("partition_info")
+                t["name"],
+                t["table_id"],
+                t["space_id"],
+                t.get("partition_info"),
+                t.get("sub_table_ids"),
             )
 
     def _persist_locked(self) -> None:
@@ -71,6 +78,7 @@ class Catalog:
                         "table_id": e.table_id,
                         "space_id": e.space_id,
                         "partition_info": e.partition_info,
+                        "sub_table_ids": e.sub_table_ids,
                     }
                     for e in self._entries.values()
                 ],
@@ -89,24 +97,44 @@ class Catalog:
             return name in self._entries
 
     def schema_of(self, name: str) -> Optional[Schema]:
-        t = self.open_table(name)
+        t = self.open(name)
         return t.schema if t is not None else None
 
-    def open_table(self, name: str) -> Optional[TableData]:
+    def open(self, name: str) -> Optional[Table]:
+        """Open a table behind the Table interface (the query layer's view)."""
         with self._lock:
-            t = self._open_tables.get(name)
-            if t is not None:
-                return t
+            cached = self._open_tables.get(name)
+            if cached is not None:
+                return cached
             e = self._entries.get(name)
             if e is None:
                 return None
-            t = self.instance.open_table(e.space_id, e.table_id, name)
-            if t is None:
-                raise RuntimeError(
-                    f"catalog entry for {name!r} exists but table storage is missing"
+            if e.partition_info is not None:
+                rule = make_rule(
+                    e.partition_info["method"],
+                    e.partition_info["columns"],
+                    e.partition_info["num_partitions"],
                 )
-            self._open_tables[name] = t
-            return t
+                subs: list[Table] = []
+                for i, sub_id in enumerate(e.sub_table_ids or []):
+                    data = self.instance.open_table(
+                        e.space_id, sub_id, sub_table_name(name, i)
+                    )
+                    if data is None:
+                        raise RuntimeError(
+                            f"partition {i} of {name!r} missing from storage"
+                        )
+                    subs.append(AnalyticTable(self.instance, data))
+                table: Table = PartitionedTable(name, rule, subs)
+            else:
+                data = self.instance.open_table(e.space_id, e.table_id, name)
+                if data is None:
+                    raise RuntimeError(
+                        f"catalog entry for {name!r} exists but table storage is missing"
+                    )
+                table = AnalyticTable(self.instance, data)
+            self._open_tables[name] = table
+            return table
 
     # ---- DDL -----------------------------------------------------------------
     def create_table(
@@ -116,16 +144,39 @@ class Catalog:
         options: TableOptions,
         if_not_exists: bool = False,
         partition_info: Optional[dict] = None,
-    ) -> Optional[TableData]:
+    ) -> Optional[Table]:
         with self._lock:
             if name in self._entries:
                 if if_not_exists:
-                    return self.open_table(name)
+                    return self.open(name)
                 raise ValueError(f"table already exists: {name}")
-            table_id = self._next_table_id
-            self._next_table_id += 1
-            table = self.instance.create_table(0, table_id, name, schema, options)
-            self._entries[name] = TableEntry(name, table_id, 0, partition_info)
+            if partition_info is not None:
+                n = partition_info["num_partitions"]
+                rule = make_rule(
+                    partition_info["method"], partition_info["columns"], n
+                )
+                sub_ids = []
+                subs: list[Table] = []
+                for i in range(n):
+                    sub_id = self._next_table_id
+                    self._next_table_id += 1
+                    data = self.instance.create_table(
+                        0, sub_id, sub_table_name(name, i), schema, options
+                    )
+                    sub_ids.append(sub_id)
+                    subs.append(AnalyticTable(self.instance, data))
+                logical_id = self._next_table_id
+                self._next_table_id += 1
+                self._entries[name] = TableEntry(
+                    name, logical_id, 0, partition_info, sub_ids
+                )
+                table: Table = PartitionedTable(name, rule, subs)
+            else:
+                table_id = self._next_table_id
+                self._next_table_id += 1
+                data = self.instance.create_table(0, table_id, name, schema, options)
+                self._entries[name] = TableEntry(name, table_id, 0)
+                table = AnalyticTable(self.instance, data)
             self._persist_locked()
             self._open_tables[name] = table
             return table
@@ -137,9 +188,10 @@ class Catalog:
                 if if_exists:
                     return False
                 raise ValueError(f"table not found: {name}")
-            table = self.open_table(name)
+            table = self.open(name)
             if table is not None:
-                self.instance.drop_table(table)
+                for data in table.physical_datas():
+                    self.instance.drop_table(data)
             self._entries.pop(name, None)
             self._open_tables.pop(name, None)
             self._persist_locked()
@@ -148,5 +200,6 @@ class Catalog:
     def close(self) -> None:
         with self._lock:
             for t in list(self._open_tables.values()):
-                self.instance.close_table(t)
+                for data in t.physical_datas():
+                    self.instance.close_table(data)
             self._open_tables.clear()

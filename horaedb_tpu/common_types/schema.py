@@ -276,9 +276,13 @@ def compute_tsid(tag_arrays: Sequence[np.ndarray], num_rows: int | None = None) 
     """Vectorized series-id hash over tag value columns.
 
     The reference hashes tag bytes into a u64 ``tsid`` per row
-    (schema.rs TSID). Here: xxhash64 over the utf-8 of each tag value,
-    combined across tag columns with the 64-bit FNV-style mix so that the id
-    is order-sensitive and stable across processes.
+    (schema.rs TSID). Values are CANONICALIZED before hashing so that the
+    same logical value hashes identically whether it arrives as a typed
+    numpy column (write path), an object array, or a bare Python literal
+    (partition-rule locate path): strings -> utf-8, bytes -> raw, bools ->
+    one byte, every integer kind -> 8-byte little-endian two's complement.
+    Per-column hashes combine with a 64-bit FNV-style mix (order-sensitive,
+    stable across processes).
     """
     if not tag_arrays:
         # Tag-less table: every row is the same (only) series, id 0.
@@ -290,8 +294,15 @@ def compute_tsid(tag_arrays: Sequence[np.ndarray], num_rows: int | None = None) 
         col_hash = np.empty(n, dtype=np.uint64)
         if arr.dtype == object:
             for i, v in enumerate(arr):
-                b = v.encode() if isinstance(v, str) else (v if isinstance(v, bytes) else str(v).encode())
-                col_hash[i] = xxhash.xxh64_intdigest(b)
+                col_hash[i] = xxhash.xxh64_intdigest(_canonical_bytes(v))
+        elif arr.dtype == np.bool_:
+            for i, v in enumerate(arr):
+                col_hash[i] = xxhash.xxh64_intdigest(b"\x01" if v else b"\x00")
+        elif np.issubdtype(arr.dtype, np.integer):
+            canon = arr.astype(np.int64, copy=False).view(np.uint64) if arr.dtype != np.uint64 else arr
+            raw = np.ascontiguousarray(canon).tobytes()
+            for i in range(n):
+                col_hash[i] = xxhash.xxh64_intdigest(raw[i * 8 : (i + 1) * 8])
         else:
             data = np.ascontiguousarray(arr)
             itemsize = data.dtype.itemsize
@@ -300,3 +311,17 @@ def compute_tsid(tag_arrays: Sequence[np.ndarray], num_rows: int | None = None) 
                 col_hash[i] = xxhash.xxh64_intdigest(raw[i * itemsize : (i + 1) * itemsize])
         out = (out ^ col_hash) * prime
     return out
+
+
+def _canonical_bytes(v) -> bytes:
+    """Type-canonical byte encoding — must agree with the typed-array
+    branches of compute_tsid for every key kind."""
+    if isinstance(v, str):
+        return v.encode()
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, (bool, np.bool_)):
+        return b"\x01" if v else b"\x00"
+    if isinstance(v, (int, np.integer)):
+        return (int(v) & 0xFFFFFFFFFFFFFFFF).to_bytes(8, "little")
+    return str(v).encode()

@@ -27,9 +27,7 @@ import numpy as np
 from ..common_types.row_group import RowGroup
 from ..common_types.schema import Schema
 from ..common_types.time_range import MAX_TIMESTAMP, MIN_TIMESTAMP
-from ..engine.instance import Instance
 from ..engine.options import parse_duration_ms
-from ..engine.table_data import TableData
 from ..ops import ScanAggSpec, encode_group_codes, scan_aggregate
 from ..ops.encoding import build_padded_batch, time_buckets
 from ..table_engine.predicate import FilterOp, Predicate
@@ -174,16 +172,16 @@ def _eval_func(e: ast.FuncCall, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]
 
 
 class Executor:
-    """Executes QueryPlans against an engine Instance."""
+    """Executes QueryPlans against Tables (AnalyticTable / PartitionedTable
+    / MemoryTable — anything behind the table_engine.Table interface)."""
 
-    def __init__(self, instance: Instance) -> None:
-        self.instance = instance
+    def __init__(self) -> None:
         # observability: which path ran last ("device" | "host")
         self.last_path: str = ""
 
-    def execute(self, plan: QueryPlan, table: TableData) -> ResultSet:
+    def execute(self, plan: QueryPlan, table) -> ResultSet:
         projection = self._projection(plan)
-        rows = self.instance.read(table, plan.predicate, projection=projection)
+        rows = table.read(plan.predicate, projection=projection)
         if plan.is_aggregate and self._device_capable(plan, rows):
             self.last_path = "device"
             return self._execute_agg_device(plan, rows)

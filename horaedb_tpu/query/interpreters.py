@@ -45,7 +45,7 @@ class InterpreterError(ValueError):
 class InterpreterFactory:
     def __init__(self, catalog: Catalog) -> None:
         self.catalog = catalog
-        self.executor = Executor(catalog.instance)
+        self.executor = Executor()
 
     def execute(self, plan: Plan) -> Output:
         if isinstance(plan, QueryPlan):
@@ -74,17 +74,17 @@ class InterpreterFactory:
 
     # ---- variants -----------------------------------------------------------
     def _select(self, plan: QueryPlan) -> ResultSet:
-        table = self.catalog.open_table(plan.table)
+        table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
         return self.executor.execute(plan, table)
 
     def _insert(self, plan: InsertPlan) -> AffectedRows:
-        table = self.catalog.open_table(plan.table)
+        table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
         rows = RowGroup.from_rows(table.schema, list(plan.rows))
-        self.catalog.instance.write(table, rows)
+        table.write(rows)
         return AffectedRows(len(rows))
 
     def _create(self, plan: CreateTablePlan) -> AffectedRows:
@@ -105,7 +105,7 @@ class InterpreterFactory:
         return AffectedRows(0)
 
     def _describe(self, plan: DescribePlan) -> ResultSet:
-        table = self.catalog.open_table(plan.table)
+        table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
         schema = table.schema
@@ -128,7 +128,7 @@ class InterpreterFactory:
         )
 
     def _show_create(self, plan: ShowCreatePlan) -> ResultSet:
-        table = self.catalog.open_table(plan.table)
+        table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
         schema = table.schema
@@ -160,28 +160,25 @@ class InterpreterFactory:
         )
 
     def _alter(self, plan: AlterTablePlan) -> AffectedRows:
-        table = self.catalog.open_table(plan.table)
+        table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
         if plan.add_columns:
             schema = table.schema
             for c in plan.add_columns:
                 schema = schema.with_added_column(c)
-            self.catalog.instance.alter_schema(table, schema)
+            table.alter_schema(schema)
         if plan.set_options:
             from ..engine.options import TableOptions
 
             merged = {**table.options.to_dict()}
             new = TableOptions.from_kv(plan.set_options).to_dict()
-            for k, v in plan.set_options.items():
+            for k in plan.set_options:
                 key = {
                     "segment_duration": "segment_duration_ms",
                     "ttl": "ttl_ms",
                 }.get(k.lower(), k.lower())
                 if key in new:
                     merged[key] = new[key]
-            table.options = TableOptions.from_dict(merged)
-            from ..engine.manifest import AlterOptions
-
-            table.manifest.append_edits([AlterOptions(table.options.to_dict())])
+            table.alter_options(TableOptions.from_dict(merged))
         return AffectedRows(0)

@@ -128,6 +128,23 @@ class Planner:
             timestamp_column=stmt.timestamp_key,
             primary_key=list(stmt.primary_key) if stmt.primary_key else None,
         )
+        if stmt.partition_by is not None:
+            for c in stmt.partition_by.columns:
+                if not schema.has_column(c):
+                    raise PlanError(f"partition column {c!r} not defined")
+                if not schema.column(c).kind.is_key_kind:
+                    raise PlanError(f"partition column {c!r} must be a key kind")
+            if stmt.partition_by.method == "hash":
+                if len(stmt.partition_by.columns) != 1:
+                    raise PlanError("PARTITION BY HASH takes exactly one column")
+                kind = schema.column(stmt.partition_by.columns[0]).kind
+                if not kind.is_integer:
+                    raise PlanError(
+                        "PARTITION BY HASH requires an integer column; "
+                        "use PARTITION BY KEY for strings"
+                    )
+            if stmt.partition_by.num_partitions < 1:
+                raise PlanError("PARTITIONS must be >= 1")
         options = TableOptions.from_kv(stmt.options)
         return CreateTablePlan(
             table=stmt.table,

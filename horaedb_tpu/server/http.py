@@ -98,13 +98,13 @@ def create_app(conn: Connection) -> web.Application:
 
         def do_write():
             proxy.limiter.check(table)
-            t = conn_.catalog.open_table(table)
+            t = conn_.catalog.open(table)
             if t is None:
                 raise ValueError(f"table not found: {table}")
             from ..common_types.row_group import RowGroup
 
             rg = RowGroup.from_rows(t.schema, rows)
-            conn_.instance.write(t, rg)
+            t.write(rg)
             proxy.hotspot.record(table, True)
             return len(rg)
 
@@ -152,7 +152,7 @@ def create_app(conn: Connection) -> web.Application:
             out = {}
             for name in conn.catalog.table_names():
                 try:
-                    t = conn.catalog.open_table(name)
+                    t = conn.catalog.open(name)
                 except Exception as e:
                     out[name] = {"error": str(e)}
                     continue

@@ -1,0 +1,171 @@
+"""Table interface (ref: src/table_engine/src/table.rs Table trait
+:512-569 and engine.rs TableEngine :323-363).
+
+The query layer programs against ``Table``; implementations:
+
+- ``AnalyticTable``     — the LSM engine (engine/), the real thing
+- ``PartitionedTable``  — virtual table fanning out to sub-tables by a
+                          partition rule (ref: partition_table_engine)
+- ``MemoryTable``       — dict-backed fake for tests / system tables
+                          (ref: table_engine/src/memory.rs)
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema, project_schema
+from ..engine.options import TableOptions
+from .predicate import Predicate
+
+
+class Table(ABC):
+    @property
+    @abstractmethod
+    def name(self) -> str: ...
+
+    @property
+    @abstractmethod
+    def schema(self) -> Schema: ...
+
+    @property
+    @abstractmethod
+    def options(self) -> TableOptions: ...
+
+    @abstractmethod
+    def write(self, rows: RowGroup) -> int:
+        """Durable write; returns number of rows written."""
+
+    @abstractmethod
+    def read(
+        self,
+        predicate: Predicate | None = None,
+        projection: Optional[Sequence[str]] = None,
+    ) -> RowGroup: ...
+
+    @abstractmethod
+    def flush(self) -> None: ...
+
+    @abstractmethod
+    def compact(self) -> None: ...
+
+    @abstractmethod
+    def alter_schema(self, schema: Schema) -> None: ...
+
+    def alter_options(self, options: TableOptions) -> None:
+        raise NotImplementedError
+
+    def physical_datas(self) -> list:
+        """Engine-level TableData handles backing this table (empty for
+        non-engine tables). Catalog close/drop iterate these uniformly."""
+        return []
+
+    def metrics(self) -> dict:
+        return {"table": self.name}
+
+
+class AnalyticTable(Table):
+    """The storage engine behind the Table interface."""
+
+    def __init__(self, instance, data) -> None:
+        self.instance = instance
+        self.data = data
+
+    @property
+    def name(self) -> str:
+        return self.data.name
+
+    @property
+    def schema(self) -> Schema:
+        return self.data.schema
+
+    @property
+    def options(self) -> TableOptions:
+        return self.data.options
+
+    def write(self, rows: RowGroup) -> int:
+        self.instance.write(self.data, rows)
+        return len(rows)
+
+    def read(self, predicate=None, projection=None) -> RowGroup:
+        return self.instance.read(self.data, predicate, projection=projection)
+
+    def flush(self) -> None:
+        self.instance.flush_table(self.data)
+
+    def compact(self) -> None:
+        self.instance.compact_table(self.data)
+
+    def alter_schema(self, schema: Schema) -> None:
+        self.instance.alter_schema(self.data, schema)
+
+    def alter_options(self, options: TableOptions) -> None:
+        from ..engine.manifest import AlterOptions
+
+        with self.data.serial_lock:
+            self.data.options = options
+            self.data.manifest.append_edits([AlterOptions(options.to_dict())])
+
+    def physical_datas(self) -> list:
+        return [self.data]
+
+    def metrics(self) -> dict:
+        return self.data.metrics()
+
+
+class MemoryTable(Table):
+    """Unordered in-memory fake (ref: table_engine/src/memory.rs)."""
+
+    def __init__(self, name: str, schema: Schema, options: TableOptions | None = None):
+        self._name = name
+        self._schema = schema
+        self._options = options or TableOptions()
+        self._parts: list[RowGroup] = []
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def options(self) -> TableOptions:
+        return self._options
+
+    def write(self, rows: RowGroup) -> int:
+        self._parts.append(rows)
+        return len(rows)
+
+    def read(self, predicate=None, projection=None) -> RowGroup:
+        schema = project_schema(self._schema, projection)
+        if not self._parts:
+            empty = {c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in schema.columns}
+            return RowGroup(schema, empty)
+        rows = RowGroup.concat(self._parts)
+        if predicate is not None:
+            ts = rows.timestamps
+            tr = predicate.time_range
+            rows = rows.filter((ts >= tr.inclusive_start) & (ts < tr.exclusive_end))
+        if projection is not None:
+            names = schema.names()
+            rows = RowGroup(
+                schema,
+                {k: rows.columns[k] for k in names},
+                {k: v for k, v in rows.validity.items() if k in names},
+            )
+        return rows
+
+    def flush(self) -> None:
+        pass
+
+    def compact(self) -> None:
+        pass
+
+    def alter_schema(self, schema: Schema) -> None:
+        self._schema = schema

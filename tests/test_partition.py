@@ -1,0 +1,204 @@
+"""Partitioned table tests
+(ref model: partition_table_engine + table_engine/partition rule tests)."""
+
+import numpy as np
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+from horaedb_tpu.table_engine import ColumnFilter, FilterOp, Predicate
+from horaedb_tpu.table_engine.partition import HashRule, KeyRule, make_rule
+
+
+def demo_schema():
+    return Schema.build(
+        [
+            ColumnSchema("host", DatumKind.STRING, is_tag=True),
+            ColumnSchema("v", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+class TestRules:
+    def rows(self, n=100):
+        return RowGroup.from_rows(
+            demo_schema(),
+            [{"host": f"h{i % 10}", "v": float(i), "t": i} for i in range(n)],
+        )
+
+    def test_key_rule_deterministic_and_balanced(self):
+        rule = KeyRule(("host",), 4)
+        p1 = rule.partition_of_rows(self.rows())
+        p2 = rule.partition_of_rows(self.rows())
+        np.testing.assert_array_equal(p1, p2)
+        assert set(p1.tolist()) <= {0, 1, 2, 3}
+        # same host -> same partition
+        hosts = self.rows().column("host")
+        for h in set(hosts):
+            assert len(set(p1[hosts == h])) == 1
+
+    def test_key_rule_prune_eq(self):
+        rule = KeyRule(("host",), 4)
+        pred = Predicate.all_time([ColumnFilter("host", FilterOp.EQ, "h3")])
+        keep = rule.prune(pred)
+        assert keep is not None and len(keep) == 1
+        assert keep[0] == rule.partition_of_values(["h3"])
+
+    def test_key_rule_prune_in_list(self):
+        rule = KeyRule(("host",), 8)
+        pred = Predicate.all_time([ColumnFilter("host", FilterOp.IN, ("h1", "h2"))])
+        keep = rule.prune(pred)
+        expected = {rule.partition_of_values(["h1"]), rule.partition_of_values(["h2"])}
+        assert set(keep) == expected
+
+    def test_key_rule_no_prune_without_eq(self):
+        rule = KeyRule(("host",), 4)
+        assert rule.prune(Predicate.all_time()) is None
+        assert rule.prune(
+            Predicate.all_time([ColumnFilter("host", FilterOp.GT, "h")])
+        ) is None
+
+    def test_hash_rule_negative_values(self):
+        rule = HashRule(("t",), 4)
+        rows = RowGroup.from_rows(
+            demo_schema(), [{"host": "h", "v": 1.0, "t": -7}]
+        )
+        p = rule.partition_of_rows(rows)
+        assert 0 <= p[0] < 4
+
+    def test_make_rule_unknown(self):
+        with pytest.raises(ValueError):
+            make_rule("bogus", ("a",), 2)
+
+    def test_integer_key_prune_matches_write_routing(self):
+        """Typed int64 column (write path) and Python literal (prune path)
+        must hash to the SAME partition — review regression."""
+        schema = Schema.build(
+            [
+                ColumnSchema("rid", DatumKind.INT64, is_tag=True),
+                ColumnSchema("v", DatumKind.DOUBLE),
+                ColumnSchema("t", DatumKind.TIMESTAMP),
+            ],
+            timestamp_column="t",
+        )
+        rule = KeyRule(("rid",), 4)
+        rows = RowGroup.from_rows(
+            schema, [{"rid": i, "v": 1.0, "t": 1} for i in range(20)]
+        )
+        write_parts = rule.partition_of_rows(rows)
+        for i in range(20):
+            assert rule.partition_of_values([i]) == write_parts[i], i
+
+    def test_hash_rule_rejects_multi_column(self):
+        with pytest.raises(ValueError):
+            HashRule(("a", "b"), 2)
+
+
+class TestPartitionedSQL:
+    DDL = (
+        "CREATE TABLE cpu (host string TAG, v double NOT NULL, "
+        "t timestamp NOT NULL, TIMESTAMP KEY(t)) "
+        "PARTITION BY KEY(host) PARTITIONS 4 ENGINE=Analytic"
+    )
+
+    @pytest.fixture()
+    def db(self):
+        conn = horaedb_tpu.connect(None)
+        yield conn
+        conn.close()
+
+    def seed(self, db, n=200):
+        vals = ", ".join(
+            f"('h{i % 10}', {float(i)}, {i * 1000})" for i in range(n)
+        )
+        db.execute(f"INSERT INTO cpu (host, v, t) VALUES {vals}")
+
+    def test_scatter_write_gather_read(self, db):
+        db.execute(self.DDL)
+        self.seed(db)
+        rows = db.execute("SELECT count(*) AS c FROM cpu").to_pylist()
+        assert rows == [{"c": 200}]
+        # sub-tables actually hold disjoint shards
+        subs = db.catalog.open("cpu").sub_tables
+        counts = [len(s.read()) for s in subs]
+        assert sum(counts) == 200 and all(c > 0 for c in counts)
+
+    def test_agg_across_partitions(self, db):
+        db.execute(self.DDL)
+        self.seed(db)
+        rows = db.execute(
+            "SELECT host, sum(v) AS s FROM cpu GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert len(rows) == 10
+        expect_h1 = sum(float(i) for i in range(200) if i % 10 == 1)
+        got = {r["host"]: r["s"] for r in rows}
+        assert got["h1"] == pytest.approx(expect_h1)
+
+    def test_eq_filter_prunes_partitions(self, db):
+        db.execute(self.DDL)
+        self.seed(db)
+        table = db.catalog.open("cpu")
+        pred = Predicate.all_time([ColumnFilter("host", FilterOp.EQ, "h7")])
+        keep = table.rule.prune(pred)
+        assert keep is not None and len(keep) == 1
+        rows = db.execute("SELECT count(*) AS c FROM cpu WHERE host = 'h7'").to_pylist()
+        assert rows == [{"c": 20}]
+
+    def test_overwrite_lands_same_partition(self, db):
+        db.execute(self.DDL)
+        db.execute("INSERT INTO cpu (host, v, t) VALUES ('a', 1.0, 500)")
+        db.execute("INSERT INTO cpu (host, v, t) VALUES ('a', 9.0, 500)")
+        rows = db.execute("SELECT v FROM cpu WHERE host = 'a'").to_pylist()
+        assert rows == [{"v": 9.0}]
+
+    def test_persistence_across_reconnect(self, tmp_path):
+        path = str(tmp_path / "db")
+        db1 = horaedb_tpu.connect(path)
+        db1.execute(self.DDL)
+        db1.execute("INSERT INTO cpu (host, v, t) VALUES ('a', 1.0, 500), ('b', 2.0, 600)")
+        db1.flush_all()
+        db1.close()
+        db2 = horaedb_tpu.connect(path)
+        assert db2.execute("SELECT count(*) AS c FROM cpu").to_pylist() == [{"c": 2}]
+        # SHOW TABLES lists only the logical table, not __cpu_N
+        assert db2.execute("SHOW TABLES").to_pylist() == [{"Tables": "cpu"}]
+        db2.close()
+
+    def test_drop_removes_all_partitions(self, db):
+        db.execute(self.DDL)
+        self.seed(db, 50)
+        db.execute("DROP TABLE cpu")
+        assert db.execute("SHOW TABLES").to_pylist() == []
+        assert list(db.store.list("manifest/")) == []
+
+    def test_alter_propagates_to_partitions(self, db):
+        db.execute(self.DDL)
+        db.execute("INSERT INTO cpu (host, v, t) VALUES ('a', 1.0, 500)")
+        db.execute("ALTER TABLE cpu ADD COLUMN v2 double")
+        db.execute("INSERT INTO cpu (host, v, v2, t) VALUES ('zz', 2.0, 3.0, 600)")
+        rows = db.execute("SELECT host, v2 FROM cpu ORDER BY host").to_pylist()
+        assert rows == [{"host": "a", "v2": None}, {"host": "zz", "v2": 3.0}]
+
+    def test_partition_validation(self, db):
+        with pytest.raises(ValueError, match="not defined"):
+            db.execute(
+                "CREATE TABLE bad (host string TAG, t timestamp KEY) "
+                "PARTITION BY KEY(nope) PARTITIONS 2"
+            )
+        with pytest.raises(ValueError, match="key kind"):
+            db.execute(
+                "CREATE TABLE bad (host string TAG, v double, t timestamp KEY) "
+                "PARTITION BY KEY(v) PARTITIONS 2"
+            )
+        with pytest.raises(ValueError, match="integer"):
+            db.execute(
+                "CREATE TABLE bad (host string TAG, t timestamp KEY) "
+                "PARTITION BY HASH(host) PARTITIONS 2"
+            )
+        with pytest.raises(ValueError, match="one column"):
+            db.execute(
+                "CREATE TABLE bad (a bigint TAG, b bigint TAG, t timestamp KEY) "
+                "PARTITION BY HASH(a, b) PARTITIONS 2"
+            )
