@@ -22,7 +22,6 @@ from typing import Any, Iterable, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
-import xxhash
 
 from .datum import DatumKind
 
@@ -284,32 +283,30 @@ def compute_tsid(tag_arrays: Sequence[np.ndarray], num_rows: int | None = None) 
     Per-column hashes combine with a 64-bit FNV-style mix (order-sensitive,
     stable across processes).
     """
+    from ..utils import native
+
     if not tag_arrays:
         # Tag-less table: every row is the same (only) series, id 0.
         return np.zeros(num_rows or 0, dtype=np.uint64)
     n = len(tag_arrays[0])
     out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
-    prime = np.uint64(0x100000001B3)
     for arr in tag_arrays:
-        col_hash = np.empty(n, dtype=np.uint64)
         if arr.dtype == object:
-            for i, v in enumerate(arr):
-                col_hash[i] = xxhash.xxh64_intdigest(_canonical_bytes(v))
+            encoded = [_canonical_bytes(v) for v in arr]
+            offsets = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(np.fromiter((len(b) for b in encoded), np.int64, count=n), out=offsets[1:])
+            col_hash = native.hash_var(b"".join(encoded), offsets)
         elif arr.dtype == np.bool_:
-            for i, v in enumerate(arr):
-                col_hash[i] = xxhash.xxh64_intdigest(b"\x01" if v else b"\x00")
+            col_hash = native.hash_fixed(arr.astype(np.uint8))
         elif np.issubdtype(arr.dtype, np.integer):
-            canon = arr.astype(np.int64, copy=False).view(np.uint64) if arr.dtype != np.uint64 else arr
-            raw = np.ascontiguousarray(canon).tobytes()
-            for i in range(n):
-                col_hash[i] = xxhash.xxh64_intdigest(raw[i * 8 : (i + 1) * 8])
+            canon = (
+                arr if arr.dtype == np.uint64
+                else arr.astype(np.int64, copy=False).view(np.uint64)
+            )
+            col_hash = native.hash_fixed(canon)
         else:
-            data = np.ascontiguousarray(arr)
-            itemsize = data.dtype.itemsize
-            raw = data.tobytes()
-            for i in range(n):
-                col_hash[i] = xxhash.xxh64_intdigest(raw[i * itemsize : (i + 1) * itemsize])
-        out = (out ^ col_hash) * prime
+            col_hash = native.hash_fixed(arr)
+        native.fnv_mix(out, col_hash)
     return out
 
 

@@ -1,0 +1,54 @@
+"""Native library tests: build, bit-exactness vs python-xxhash, fallback."""
+
+import numpy as np
+import pytest
+import xxhash
+
+from horaedb_tpu.utils import native
+
+
+class TestNativeHashing:
+    def test_builds_and_loads(self):
+        lib = native.load()
+        assert lib is not None, "g++ is in the image; native build should succeed"
+
+    def test_var_hash_matches_xxhash(self):
+        values = [b"", b"a", b"hello world", b"x" * 31, b"y" * 32, b"z" * 1000]
+        data = b"".join(values)
+        offsets = np.zeros(len(values) + 1, dtype=np.int64)
+        np.cumsum([len(v) for v in values], out=offsets[1:])
+        got = native.hash_var(data, offsets)
+        expect = [xxhash.xxh64_intdigest(v) for v in values]
+        assert got.tolist() == expect
+
+    def test_fixed_hash_matches_xxhash(self):
+        arr = np.arange(100, dtype=np.uint64)
+        got = native.hash_fixed(arr)
+        raw = arr.tobytes()
+        expect = [xxhash.xxh64_intdigest(raw[i * 8:(i + 1) * 8]) for i in range(100)]
+        assert got.tolist() == expect
+
+    def test_fnv_mix_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        acc = rng.integers(0, 2**63, 1000, dtype=np.uint64)
+        col = rng.integers(0, 2**63, 1000, dtype=np.uint64)
+        expect = (acc ^ col) * np.uint64(0x100000001B3)
+        native.fnv_mix(acc, col)
+        np.testing.assert_array_equal(acc, expect)
+
+    def test_tsid_same_with_and_without_native(self, monkeypatch):
+        from horaedb_tpu.common_types.schema import compute_tsid
+
+        tags = [
+            np.array(["h1", "h2", "hé"], dtype=object),
+            np.array([1, -5, 2**40], dtype=np.int64),
+        ]
+        with_native = compute_tsid(tags)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_tried", True)
+        without = compute_tsid(tags)
+        np.testing.assert_array_equal(with_native, without)
+
+    def test_empty_inputs(self):
+        assert len(native.hash_var(b"", np.zeros(1, dtype=np.int64))) == 0
+        assert len(native.hash_fixed(np.empty(0, dtype=np.uint64))) == 0
