@@ -1,0 +1,76 @@
+"""Auto-create / auto-evolve tables on write
+(ref: proxy/src/write.rs:176-263 — the write path creates missing tables
+and adds missing columns before executing the insert plan).
+
+Shared by the InfluxDB and OpenTSDB write handlers: given the observed
+tags/fields of a batch, ensure a table exists whose schema covers them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping
+
+from ..catalog import Catalog
+from ..common_types.datum import DatumKind
+from ..common_types.schema import ColumnSchema, Schema
+from ..engine.options import TableOptions
+from ..table_engine.table import Table
+
+_ddl_lock = threading.Lock()
+
+
+def _kind_of_value(v) -> DatumKind:
+    if isinstance(v, bool):
+        return DatumKind.BOOLEAN
+    if isinstance(v, int):
+        return DatumKind.INT64
+    if isinstance(v, float):
+        return DatumKind.DOUBLE
+    if isinstance(v, bytes):
+        return DatumKind.VARBINARY
+    return DatumKind.STRING
+
+
+def ensure_table(
+    catalog: Catalog,
+    name: str,
+    tag_names: list[str],
+    field_samples: Mapping[str, object],
+    timestamp_column: str,
+) -> Table:
+    """Open ``name``, creating it or adding missing field columns.
+
+    Field kinds are inferred from sample values (ints arriving in a double
+    column stay double — widening only happens at creation time here).
+    """
+    with _ddl_lock:
+        table = catalog.open(name)
+        if table is None:
+            cols = [ColumnSchema(t, DatumKind.STRING, is_tag=True) for t in tag_names]
+            for f, v in field_samples.items():
+                kind = _kind_of_value(v)
+                if kind is DatumKind.INT64:
+                    kind = DatumKind.DOUBLE  # numeric fields default to double
+                cols.append(ColumnSchema(f, kind))
+            cols.append(ColumnSchema(timestamp_column, DatumKind.TIMESTAMP))
+            schema = Schema.build(cols, timestamp_column=timestamp_column)
+            return catalog.create_table(name, schema, TableOptions())
+
+        schema = table.schema
+        missing_tags = [t for t in tag_names if not schema.has_column(t)]
+        if missing_tags:
+            raise ValueError(
+                f"table {name!r} exists without tag column(s) {missing_tags}; "
+                "tags cannot be added after creation"
+            )
+        new_schema = schema
+        for f, v in field_samples.items():
+            if not new_schema.has_column(f):
+                kind = _kind_of_value(v)
+                if kind is DatumKind.INT64:
+                    kind = DatumKind.DOUBLE
+                new_schema = new_schema.with_added_column(ColumnSchema(f, kind))
+        if new_schema is not schema:
+            table.alter_schema(new_schema)
+        return table

@@ -1,0 +1,241 @@
+"""InfluxDB line protocol ingestion (ref: proxy/src/influxdb/mod.rs:52-61).
+
+Parses the v1 line protocol:
+
+    measurement[,tag_key=tag_val...] field_key=field_val[,...] [timestamp]
+
+with the standard escaping rules (``\\,`` ``\\ `` ``\\=`` in identifiers,
+quoted string field values with ``\\"``), field typing (``i`` suffix =
+integer, ``t``/``f``/``true``/``false`` = boolean, quoted = string, bare =
+float), and write precision ns/us/ms/s (default ns). Each measurement maps
+to a table (auto-created; tags TAG, fields typed, time column ``time``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..catalog import Catalog
+from ..common_types.row_group import RowGroup
+from .auto_create import ensure_table
+
+TIME_COLUMN = "time"
+
+# precision -> (multiplier, divisor) applied as ts * mul // div, all in
+# exact integer arithmetic (ns values exceed float53 precision).
+_PRECISION_SCALE = {
+    "n": (1, 1_000_000),
+    "ns": (1, 1_000_000),
+    "u": (1, 1_000),
+    "us": (1, 1_000),
+    "ms": (1, 1),
+    "s": (1_000, 1),
+    "m": (60_000, 1),
+    "h": (3_600_000, 1),
+}
+
+
+class LineProtocolError(ValueError):
+    pass
+
+
+@dataclass
+class Point:
+    measurement: str
+    tags: dict[str, str]
+    fields: dict[str, object]
+    timestamp_ms: Optional[int]
+
+
+def _split_unescaped(s: str, sep: str) -> list[str]:
+    out, cur, i = [], [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(c)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == sep:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _split_fields(s: str) -> list[str]:
+    """Split the field section on commas outside quoted string values."""
+    out, cur = [], []
+    in_quotes = False
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            cur.append(c)
+            cur.append(s[i + 1])
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+            cur.append(c)
+        elif c == "," and not in_quotes:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _unescape(s: str) -> str:
+    return (
+        s.replace("\\,", ",").replace("\\ ", " ").replace("\\=", "=")
+    )
+
+
+def _split_line(line: str) -> tuple[str, str, Optional[str]]:
+    """-> (measurement+tags, fields, timestamp?) splitting on unescaped
+    spaces while respecting quoted field values."""
+    parts: list[str] = []
+    cur: list[str] = []
+    in_quotes = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == "\\" and i + 1 < len(line):
+            cur.append(c)
+            cur.append(line[i + 1])
+            i += 2
+            continue
+        if c == '"':
+            in_quotes = not in_quotes
+            cur.append(c)
+        elif c == " " and not in_quotes:
+            if cur:
+                parts.append("".join(cur))
+                cur = []
+        else:
+            cur.append(c)
+        i += 1
+    if in_quotes:
+        raise LineProtocolError(f"unterminated quote: {line!r}")
+    if cur:
+        parts.append("".join(cur))
+    if len(parts) < 2 or len(parts) > 3:
+        raise LineProtocolError(f"expected 2-3 space-separated sections: {line!r}")
+    return parts[0], parts[1], parts[2] if len(parts) == 3 else None
+
+
+def _find_unescaped_eq(s: str) -> int:
+    """Index of the first '=' outside escapes (the key/value separator —
+    '=' inside a quoted VALUE is fine because the key comes first)."""
+    i = 0
+    while i < len(s):
+        if s[i] == "\\":
+            i += 2
+            continue
+        if s[i] == "=":
+            return i
+        i += 1
+    return -1
+
+
+def _parse_field_value(raw: str):
+    if raw.startswith('"'):
+        if not raw.endswith('"') or len(raw) < 2:
+            raise LineProtocolError(f"bad string field: {raw!r}")
+        return raw[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    low = raw.lower()
+    if low in ("t", "true"):
+        return True
+    if low in ("f", "false"):
+        return False
+    if raw.endswith(("i", "u")):
+        try:
+            return int(raw[:-1])
+        except ValueError:
+            raise LineProtocolError(f"bad integer field: {raw!r}") from None
+    try:
+        return float(raw)
+    except ValueError:
+        raise LineProtocolError(f"bad field value: {raw!r}") from None
+
+
+def parse_lines(body: str, precision: str = "ns") -> list[Point]:
+    scale = _PRECISION_SCALE.get(precision)
+    if scale is None:
+        raise LineProtocolError(f"unknown precision {precision!r}")
+    mul, div = scale
+    points = []
+    for lineno, line in enumerate(body.splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            head, fields_raw, ts_raw = _split_line(line)
+            head_parts = _split_unescaped(head, ",")
+            measurement = _unescape(head_parts[0])
+            if not measurement:
+                raise LineProtocolError("empty measurement")
+            tags = {}
+            for t in head_parts[1:]:
+                kv = _split_unescaped(t, "=")
+                if len(kv) != 2 or not kv[0]:
+                    raise LineProtocolError(f"bad tag: {t!r}")
+                tags[_unescape(kv[0])] = _unescape(kv[1])
+            fields: dict[str, object] = {}
+            for f in _split_fields(fields_raw):
+                eq = _find_unescaped_eq(f)
+                if eq <= 0:
+                    raise LineProtocolError(f"bad field: {f!r}")
+                fields[_unescape(f[:eq])] = _parse_field_value(f[eq + 1:])
+            if not fields:
+                raise LineProtocolError("at least one field required")
+            ts_ms = None
+            if ts_raw is not None:
+                ts_ms = int(ts_raw) * mul // div
+            if TIME_COLUMN in fields or TIME_COLUMN in tags:
+                raise LineProtocolError(
+                    f"{TIME_COLUMN!r} is reserved for the timestamp column"
+                )
+            points.append(Point(measurement, tags, fields, ts_ms))
+        except LineProtocolError as e:
+            raise LineProtocolError(f"line {lineno}: {e}") from None
+    return points
+
+
+def write_points(catalog: Catalog, points: list[Point], now_ms: int) -> int:
+    """Group points by measurement, auto-create/evolve, write. -> row count."""
+    by_table: dict[str, list[Point]] = {}
+    for p in points:
+        by_table.setdefault(p.measurement, []).append(p)
+    written = 0
+    for name, pts in by_table.items():
+        tag_names = sorted({k for p in pts for k in p.tags})
+        field_samples: dict[str, object] = {}
+        for p in pts:
+            for k, v in p.fields.items():
+                field_samples.setdefault(k, v)
+        clash = set(tag_names) & set(field_samples)
+        if clash:
+            raise LineProtocolError(
+                f"{name}: name(s) {sorted(clash)} used as both tag and field"
+            )
+        table = ensure_table(catalog, name, tag_names, field_samples, TIME_COLUMN)
+        rows = []
+        for p in pts:
+            row: dict[str, object] = {TIME_COLUMN: p.timestamp_ms if p.timestamp_ms is not None else now_ms}
+            for t in tag_names:
+                row[t] = p.tags.get(t, "")
+            row.update(p.fields)
+            rows.append(row)
+        table.write(RowGroup.from_rows(table.schema, rows))
+        written += len(rows)
+    return written

@@ -1,0 +1,91 @@
+"""OpenTSDB /api/put ingestion (ref: proxy/src/opentsdb/mod.rs:50-108).
+
+Accepts the OpenTSDB JSON put format — one datapoint or an array:
+
+    {"metric": "sys.cpu.user", "timestamp": 1356998400, "value": 42.5,
+     "tags": {"host": "web01", "dc": "lga"}}
+
+Seconds vs milliseconds timestamps are disambiguated by magnitude exactly
+like OpenTSDB (values < 10^12 are seconds). Each metric maps to a table
+(auto-created) with the tags as TAG columns and a single ``value`` field.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..catalog import Catalog
+from ..common_types.row_group import RowGroup
+from .auto_create import ensure_table
+
+TIME_COLUMN = "timestamp"
+VALUE_COLUMN = "value"
+
+
+class OpenTsdbError(ValueError):
+    pass
+
+
+def _normalize_ts(ts) -> int:
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool):
+        raise OpenTsdbError(f"bad timestamp: {ts!r}")
+    ts = int(ts)
+    return ts * 1000 if abs(ts) < 10**12 else ts
+
+
+def parse_put(body: Any) -> list[dict]:
+    """Validate the decoded JSON body -> list of datapoint dicts."""
+    points = body if isinstance(body, list) else [body]
+    out = []
+    for i, p in enumerate(points):
+        if not isinstance(p, dict):
+            raise OpenTsdbError(f"datapoint {i}: not an object")
+        metric = p.get("metric")
+        if not isinstance(metric, str) or not metric:
+            raise OpenTsdbError(f"datapoint {i}: missing metric")
+        value = p.get("value")
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise OpenTsdbError(f"datapoint {i}: missing numeric value")
+        tags = p.get("tags", {})
+        if not isinstance(tags, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in tags.items()
+        ):
+            raise OpenTsdbError(f"datapoint {i}: tags must be string->string")
+        reserved = {TIME_COLUMN, VALUE_COLUMN} & set(tags)
+        if reserved:
+            raise OpenTsdbError(
+                f"datapoint {i}: tag name(s) {sorted(reserved)} are reserved"
+            )
+        out.append(
+            {
+                "metric": metric,
+                "timestamp": _normalize_ts(p.get("timestamp")),
+                "value": float(value),
+                "tags": tags,
+            }
+        )
+    return out
+
+
+def write_points(catalog: Catalog, points: list[dict]) -> int:
+    by_metric: dict[str, list[dict]] = {}
+    for p in points:
+        by_metric.setdefault(p["metric"], []).append(p)
+    written = 0
+    for metric, pts in by_metric.items():
+        tag_names = sorted({k for p in pts for k in p["tags"]})
+        table = ensure_table(
+            catalog, metric, tag_names, {VALUE_COLUMN: 1.0}, TIME_COLUMN
+        )
+        rows = []
+        for p in pts:
+            row: dict[str, object] = {
+                TIME_COLUMN: p["timestamp"],
+                VALUE_COLUMN: p["value"],
+            }
+            for t in tag_names:
+                row[t] = p["tags"].get(t, "")
+            rows.append(row)
+        table.write(RowGroup.from_rows(table.schema, rows))
+        written += len(rows)
+    return written

@@ -432,6 +432,25 @@ class Executor:
             v, m = eval_expr(residual, rows)
             rows = rows.filter(v.astype(bool) & m)
 
+        # Sort BEFORE projecting: ORDER BY may reference any table column
+        # or expression, not just select-list outputs. Select aliases are
+        # resolved back to their expressions first.
+        stmt = plan.select
+        if stmt.order_by and len(rows):
+            aliases = {
+                item.alias: item.expr for item in stmt.items if item.alias
+            }
+            keys = []
+            for o in reversed(stmt.order_by):
+                expr = o.expr
+                if isinstance(expr, ast.Column) and expr.name in aliases and not rows.schema.has_column(expr.name):
+                    expr = aliases[expr.name]
+                kv, _ = eval_expr(expr, rows)
+                keys.append(kv if o.ascending else _desc_key(kv))
+            rows = rows.take(np.lexsort(tuple(keys)))
+        if stmt.limit is not None:
+            rows = rows.slice(0, stmt.limit)
+
         names: list[str] = []
         columns: list[np.ndarray] = []
         nulls: dict[str, np.ndarray] = {}
@@ -449,8 +468,7 @@ class Executor:
             columns.append(v)
             if not m.all():
                 nulls[item.output_name] = ~m
-        result = ResultSet(names, columns, nulls or None)
-        return _order_and_limit(result, plan)
+        return ResultSet(names, columns, nulls or None)
 
 
 def _empty_ungrouped_agg_row(plan: QueryPlan) -> ResultSet:
@@ -541,6 +559,16 @@ def _host_agg(
     raise ExprError(f"unknown aggregate {a.func}")
 
 
+def _desc_key(arr: np.ndarray) -> np.ndarray:
+    """A lexsort key sorting ``arr`` descending (strings via code negate)."""
+    if arr.dtype == object:
+        _, inv = np.unique(arr, return_inverse=True)
+        return -inv
+    if arr.dtype.kind in "fiu":
+        return -arr.astype(np.float64)
+    return arr  # bool/other: DESC not meaningfully supported
+
+
 def _order_and_limit(result: ResultSet, plan: QueryPlan) -> ResultSet:
     stmt = plan.select
     if stmt.order_by and result.num_rows:
@@ -562,15 +590,7 @@ def _order_and_limit(result: ResultSet, plan: QueryPlan) -> ResultSet:
                         break
             if key_src is None:
                 raise ExprError(f"ORDER BY expression not in select list: {o.expr}")
-            if not o.ascending:
-                if key_src.dtype == object:
-                    # lexsort can't negate strings; sort by codes
-                    _, inv = np.unique(key_src, return_inverse=True)
-                    keys.append(-inv)
-                else:
-                    keys.append(-key_src.astype(np.float64) if key_src.dtype.kind in "fiu" else key_src)
-            else:
-                keys.append(key_src)
+            keys.append(key_src if o.ascending else _desc_key(key_src))
         order = np.lexsort(tuple(keys))
         result = ResultSet(
             result.names,

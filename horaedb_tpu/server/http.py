@@ -116,6 +116,63 @@ def create_app(conn: Connection) -> web.Application:
             return web.json_response({"error": str(e)}, status=422)
         return web.json_response({"affected_rows": n})
 
+    # ---- protocol front ends -------------------------------------------
+    async def influx_write(request: web.Request) -> web.Response:
+        from ..proxy.influxdb import LineProtocolError, parse_lines, write_points
+
+        precision = request.query.get("precision", "ns")
+        body = (await request.read()).decode("utf-8", "replace")
+
+        def do():
+            import time as _time
+
+            points = parse_lines(body, precision)
+            # Same limiter/hotspot discipline as the /sql and /write paths.
+            for m in {p.measurement for p in points}:
+                proxy.limiter.check(m)
+            n = write_points(conn.catalog, points, now_ms=int(_time.time() * 1000))
+            for m in {p.measurement for p in points}:
+                proxy.hotspot.record(m, True)
+            return n
+
+        try:
+            n = await asyncio.get_running_loop().run_in_executor(None, do)
+        except LineProtocolError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except BlockedError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        # Influx v1 returns 204 No Content on success.
+        return web.Response(status=204, headers={"X-Written-Rows": str(n)})
+
+    async def opentsdb_put(request: web.Request) -> web.Response:
+        from ..proxy.opentsdb import OpenTsdbError, parse_put, write_points as otsdb_write
+
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+
+        def do():
+            points = parse_put(body)
+            for m in {p["metric"] for p in points}:
+                proxy.limiter.check(m)
+            n = otsdb_write(conn.catalog, points)
+            for m in {p["metric"] for p in points}:
+                proxy.hotspot.record(m, True)
+            return n
+
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, do)
+        except OpenTsdbError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        except BlockedError as e:
+            return web.json_response({"error": str(e)}, status=403)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.Response(status=204)
+
     # ---- observability -------------------------------------------------
     async def metrics(request: web.Request) -> web.Response:
         return web.Response(text=REGISTRY.expose(), content_type="text/plain")
@@ -190,6 +247,8 @@ def create_app(conn: Connection) -> web.Application:
 
     app.router.add_post("/sql", sql)
     app.router.add_post("/write", write)
+    app.router.add_post("/influxdb/v1/write", influx_write)
+    app.router.add_post("/opentsdb/api/put", opentsdb_put)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/health", health)
     app.router.add_get("/route/{table}", route)

@@ -1,0 +1,204 @@
+"""InfluxDB line protocol + OpenTSDB put tests
+(ref model: proxy influxdb/opentsdb unit tests + protocol suites)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import horaedb_tpu
+from horaedb_tpu.proxy.influxdb import LineProtocolError, parse_lines
+from horaedb_tpu.proxy.opentsdb import OpenTsdbError, parse_put
+from horaedb_tpu.server import create_app
+
+
+class TestLineProtocolParser:
+    def test_basic(self):
+        pts = parse_lines("cpu,host=h1,region=west usage=0.5,idle=99i 1700000000000", "ms")
+        p = pts[0]
+        assert p.measurement == "cpu"
+        assert p.tags == {"host": "h1", "region": "west"}
+        assert p.fields == {"usage": 0.5, "idle": 99}
+        assert p.timestamp_ms == 1700000000000
+
+    def test_precision_conversion(self):
+        assert parse_lines("m v=1 1700000000000000000", "ns")[0].timestamp_ms == 1700000000000
+        assert parse_lines("m v=1 1700000000", "s")[0].timestamp_ms == 1700000000000
+
+    def test_escapes_and_quotes(self):
+        pts = parse_lines(r'my\ table,ta\,g=va\=l msg="hello, \"world\"",ok=t', "ns")
+        p = pts[0]
+        assert p.measurement == "my table"
+        assert p.tags == {"ta,g": "va=l"}
+        assert p.fields == {"msg": 'hello, "world"', "ok": True}
+        assert p.timestamp_ms is None
+
+    def test_multi_line_and_comments(self):
+        body = "# comment\ncpu v=1\n\ncpu v=2 100\n"
+        pts = parse_lines(body, "ms")
+        assert len(pts) == 2 and pts[1].timestamp_ms == 100
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "cpu",  # no fields
+            "cpu v=",  # empty value
+            'cpu v="unterminated',  # quote
+            "cpu, v=1",  # empty tag
+            "cpu v=1 2 3",  # too many sections
+            "cpu v=abc",  # bad value
+        ],
+    )
+    def test_errors_located(self, bad):
+        with pytest.raises(LineProtocolError, match="line 1"):
+            parse_lines(bad, "ns")
+
+
+class TestOpenTsdbParser:
+    def test_single_and_batch(self):
+        one = parse_put({"metric": "m", "timestamp": 1356998400, "value": 1.5, "tags": {"h": "a"}})
+        assert one[0]["timestamp"] == 1356998400000  # seconds -> ms
+        two = parse_put([
+            {"metric": "m", "timestamp": 1700000000000, "value": 2, "tags": {}},
+            {"metric": "m2", "timestamp": 1700000000, "value": 3, "tags": {"x": "y"}},
+        ])
+        assert two[0]["timestamp"] == 1700000000000  # already ms
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            {"timestamp": 1, "value": 1},  # no metric
+            {"metric": "m", "timestamp": 1},  # no value
+            {"metric": "m", "timestamp": "x", "value": 1},  # bad ts
+            {"metric": "m", "timestamp": 1, "value": True},  # bool value
+            {"metric": "m", "timestamp": 1, "value": 1, "tags": {"a": 1}},  # non-str tag
+        ],
+    )
+    def test_errors(self, bad):
+        with pytest.raises(OpenTsdbError):
+            parse_put(bad)
+
+
+def with_client(coro_fn):
+    async def runner():
+        conn = horaedb_tpu.connect(None)
+        app = create_app(conn)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await coro_fn(client, conn)
+        finally:
+            await client.close()
+            conn.close()
+
+    asyncio.run(runner())
+
+
+class TestInfluxEndpoint:
+    def test_write_auto_creates_and_queries(self):
+        async def body(client, conn):
+            lines = (
+                "cpu,host=h1 usage=0.5,idle=10i 1700000000000\n"
+                "cpu,host=h2 usage=0.7 1700000001000\n"
+                "mem,host=h1 used=123.0 1700000000000\n"
+            )
+            resp = await client.post("/influxdb/v1/write?precision=ms", data=lines)
+            assert resp.status == 204
+            out = await client.post(
+                "/sql", json={"query": "SELECT host, usage FROM cpu ORDER BY host"}
+            )
+            rows = (await out.json())["rows"]
+            assert rows == [
+                {"host": "h1", "usage": 0.5},
+                {"host": "h2", "usage": 0.7},
+            ]
+            out = await client.post("/sql", json={"query": "SELECT count(*) AS c FROM mem"})
+            assert (await out.json())["rows"] == [{"c": 1}]
+
+        with_client(body)
+
+    def test_schema_evolves_for_new_fields(self):
+        async def body(client, conn):
+            await client.post("/influxdb/v1/write?precision=ms", data="m,h=a v=1 100")
+            await client.post("/influxdb/v1/write?precision=ms", data="m,h=a v=2,extra=9 200")
+            out = await client.post(
+                "/sql", json={"query": "SELECT extra FROM m ORDER BY time"}
+            )
+            rows = (await out.json())["rows"]
+            assert rows == [{"extra": None}, {"extra": 9.0}]
+
+        with_client(body)
+
+    def test_bad_lines_rejected(self):
+        async def body(client, conn):
+            resp = await client.post("/influxdb/v1/write", data="cpu nofields")
+            assert resp.status == 400
+            assert "line 1" in (await resp.json())["error"]
+
+        with_client(body)
+
+    def test_ns_precision_exact(self):
+        # ns values exceed float53: must use integer floor-div (review regression)
+        pts = parse_lines("m v=1 1700000000189000029", "ns")
+        assert pts[0].timestamp_ms == 1700000000189
+        assert parse_lines("m v=1 28333333", "m")[0].timestamp_ms == 28333333 * 60_000
+
+    def test_reserved_time_name_rejected(self):
+        with pytest.raises(LineProtocolError, match="reserved"):
+            parse_lines("cpu time=5 100", "ms")
+        with pytest.raises(LineProtocolError, match="reserved"):
+            parse_lines("cpu,time=x v=5 100", "ms")
+
+    def test_blocked_table_rejected_on_protocol_writes(self):
+        async def body(client, conn):
+            await client.post("/influxdb/v1/write?precision=ms", data="cpu v=1 100")
+            await client.post("/admin/block", json={"tables": ["cpu"]})
+            resp = await client.post("/influxdb/v1/write?precision=ms", data="cpu v=2 200")
+            assert resp.status == 403
+            resp = await client.post(
+                "/opentsdb/api/put",
+                json={"metric": "cpu", "timestamp": 1, "value": 1.0, "tags": {}},
+            )
+            assert resp.status == 403
+
+        with_client(body)
+
+    def test_order_by_alias_still_works(self):
+        async def body(client, conn):
+            await client.post("/influxdb/v1/write?precision=ms", data="m v=3 100\nm v=1 200\nm v=2 300")
+            out = await client.post(
+                "/sql", json={"query": "SELECT v AS x FROM m ORDER BY x DESC"}
+            )
+            rows = (await out.json())["rows"]
+            assert [r["x"] for r in rows] == [3.0, 2.0, 1.0]
+
+        with_client(body)
+
+
+class TestOpenTsdbEndpoint:
+    def test_put_and_query(self):
+        async def body(client, conn):
+            resp = await client.post(
+                "/opentsdb/api/put",
+                json=[
+                    {"metric": "sys.cpu", "timestamp": 1356998400, "value": 42.5,
+                     "tags": {"host": "web01"}},
+                    {"metric": "sys.cpu", "timestamp": 1356998460, "value": 43.0,
+                     "tags": {"host": "web01"}},
+                ],
+            )
+            assert resp.status == 204
+            out = await client.post(
+                "/sql",
+                json={"query": 'SELECT avg(value) AS a FROM "sys.cpu" GROUP BY host'},
+            )
+            assert (await out.json())["rows"] == [{"a": 42.75}]
+
+        with_client(body)
+
+    def test_bad_put(self):
+        async def body(client, conn):
+            resp = await client.post("/opentsdb/api/put", json={"metric": "m"})
+            assert resp.status == 400
+
+        with_client(body)
