@@ -1,16 +1,21 @@
-"""Benchmark: README-demo aggregate on the fused device kernel.
+"""Benchmark driver. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "rows/s", "vs_baseline": R}
 
-Config #1 from BASELINE.md: ``SELECT avg(value) FROM demo GROUP BY name``
-over 1M rows. Data flows through the REAL stack (engine ingest -> flush to
-Parquet SSTs -> merge read -> host encode), then the fused
-scan/filter/group-by/agg kernel is timed in steady state, including
-host->device transfer of the padded batch.
+Configs (select with BENCH_CONFIG, default "readme") — the BASELINE.md
+target list:
 
-Baseline = the host executor's vectorized-numpy aggregation on the same
-rows (the framework's own CPU path — the analog of the reference's
-DataFusion vectorized operators).
+    readme              SELECT avg(value) GROUP BY name, 1M rows
+    tsbs-1-1-1          single-groupby-1-1-1, scale 100
+    tsbs-5-8-1          single-groupby-5-8-1, scale 4000 (headline)
+    double-groupby-all  10 metrics, group by (host, hour), scale 400, 12h
+    high-cpu-all        usage_user > 90 pushdown, scale 400, 12h
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Every config runs the FULL query path (SQL -> plan -> merge read -> fused
+device kernel) against data ingested through the real engine (memtable ->
+flush -> Parquet SSTs). ``value`` is scanned-rows/sec of the steady-state
+device-path query; ``vs_baseline`` is the speedup over the same query
+forced onto the host (vectorized numpy) executor — the framework's own
+CPU path, standing in for the reference's DataFusion executor.
 """
 
 from __future__ import annotations
@@ -22,119 +27,174 @@ import time
 
 import numpy as np
 
-N_ROWS = 1_000_000
-N_HOSTS = 100
-TIME_SPAN_MS = 3_600_000
-REPEATS = 10
+REPEATS = 5
 
 
-def build_database():
+def _connect_mem():
+    import horaedb_tpu
+
+    return horaedb_tpu.connect(None)
+
+
+def build_readme():
     from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
     from horaedb_tpu.common_types.schema import compute_tsid
-    from horaedb_tpu.engine.instance import Instance
-    from horaedb_tpu.engine.options import TableOptions
-    from horaedb_tpu.utils.object_store import MemoryStore
 
-    schema = Schema.build(
-        [
-            ColumnSchema("name", DatumKind.STRING, is_tag=True),
-            ColumnSchema("value", DatumKind.DOUBLE),
-            ColumnSchema("t", DatumKind.TIMESTAMP),
-        ],
-        timestamp_column="t",
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE demo (name string TAG, value double, t timestamp KEY) "
+        "ENGINE=Analytic WITH (segment_duration='2h')"
     )
+    n = 1_000_000
     rng = np.random.default_rng(123)
-    names = np.array(
-        [f"host_{i}" for i in rng.integers(0, N_HOSTS, N_ROWS)], dtype=object
-    )
+    names = np.array([f"host_{i}" for i in rng.integers(0, 100, n)], dtype=object)
+    schema = db.catalog.open("demo").schema
     rows = RowGroup(
         schema,
         {
             "tsid": compute_tsid([names]),
-            "t": rng.integers(0, TIME_SPAN_MS, N_ROWS).astype(np.int64),
+            "t": rng.integers(0, 3_600_000, n).astype(np.int64),
             "name": names,
-            "value": rng.normal(10.0, 3.0, N_ROWS),
+            "value": rng.normal(10.0, 3.0, n),
         },
     )
-    inst = Instance(MemoryStore())
-    table = inst.create_table(
-        0, 1, "demo", schema, TableOptions.from_kv({"segment_duration": "2h"})
+    t = db.catalog.open("demo")
+    t.write(rows)
+    t.flush()
+    return db, "SELECT name, avg(value) AS a FROM demo GROUP BY name", n
+
+
+def _build_tsbs(scale, hours, query):
+    from horaedb_tpu.tools import tsbs
+
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE cpu (hostname string TAG, region string TAG, "
+        "datacenter string TAG, "
+        + ", ".join(f"{f} double" for f in tsbs.CPU_FIELDS)
+        + ", ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+        "ENGINE=Analytic WITH (segment_duration='2h')"
     )
-    inst.write(table, rows)
-    inst.flush_table(table)
-    return inst, table
+    rows = tsbs.generate_cpu(scale, hours * 3_600_000)
+    t = db.catalog.open("cpu")
+    t.write(rows)
+    t.flush()
+    return db, query.sql, len(rows)
 
 
-def numpy_baseline(rows) -> tuple[float, np.ndarray]:
-    """Vectorized CPU aggregation: avg(value) group by name (via tsid)."""
-    tsid = rows.column("tsid")
-    vals = rows.column("value")
-    t0 = time.perf_counter()
+def build_tsbs_111():
+    from horaedb_tpu.tools.tsbs import single_groupby
+
+    return _build_tsbs(100, 1, single_groupby(1, 1, 1))
+
+
+def build_tsbs_581():
+    from horaedb_tpu.tools.tsbs import single_groupby
+
+    return _build_tsbs(4000, 1, single_groupby(5, 8, 1))
+
+
+def build_double_groupby():
+    from horaedb_tpu.tools.tsbs import double_groupby_all
+
+    return _build_tsbs(400, 12, double_groupby_all(12))
+
+
+def build_high_cpu():
+    from horaedb_tpu.tools.tsbs import high_cpu_all
+
+    return _build_tsbs(400, 12, high_cpu_all(12))
+
+
+CONFIGS = {
+    "readme": build_readme,
+    "tsbs-1-1-1": build_tsbs_111,
+    "tsbs-5-8-1": build_tsbs_581,
+    "double-groupby-all": build_double_groupby,
+    "high-cpu-all": build_high_cpu,
+}
+
+
+def time_query(db, sql) -> tuple[float, list]:
+    db.execute(sql)  # warmup (compile)
     best = np.inf
-    for _ in range(3):
-        s = time.perf_counter()
-        uniq, inv = np.unique(tsid, return_inverse=True)
-        sums = np.bincount(inv, weights=vals, minlength=len(uniq))
-        counts = np.bincount(inv, minlength=len(uniq))
-        avg = sums / counts
-        best = min(best, time.perf_counter() - s)
-    return best, avg
-
-
-def device_kernel(rows) -> tuple[float, np.ndarray, str]:
-    import jax
-
-    from horaedb_tpu.ops import ScanAggSpec, encode_group_codes, scan_aggregate
-    from horaedb_tpu.ops.encoding import build_padded_batch
-
-    platform = jax.devices()[0].platform
-    enc = encode_group_codes(rows, ["name"])
-    mask = np.ones(len(rows), dtype=bool)
-    bucket_ids = np.zeros(len(rows), dtype=np.int32)
-    spec = ScanAggSpec(
-        n_groups=enc.num_groups, n_buckets=1, n_agg_fields=1
-    ).padded()
-
-    def run():
-        batch = build_padded_batch(enc.codes, bucket_ids, mask, [rows.column("value")])
-        return scan_aggregate(batch, spec)
-
-    run()  # warmup: compile
-    best = np.inf
-    state = None
+    out = None
     for _ in range(REPEATS):
         s = time.perf_counter()
-        state = run()
+        out = db.execute(sql)
         best = min(best, time.perf_counter() - s)
-    G = enc.num_groups
-    avg = state.sums[0, :G, 0] / np.maximum(state.counts[:G, 0], 1)
-    return best, avg, platform
+    return best, out.to_pylist()
+
+
+def _rows_agree(a: list, b: list, rtol: float = 1e-3, atol: float = 1e-3) -> bool:
+    if len(a) != len(b):
+        return False
+
+    # Row order is unspecified without ORDER BY; canonicalize before the
+    # pairwise numeric comparison. Sort by the exact-typed fields (group
+    # keys) first — float aggregates differ slightly between paths and
+    # must not drive the pairing.
+    def key(row):
+        exact = tuple(
+            (k, v) for k, v in sorted(row.items()) if not isinstance(v, float)
+        )
+        approx = tuple(
+            (k, round(v, 4)) for k, v in sorted(row.items()) if isinstance(v, float)
+        )
+        return (exact, approx)
+
+    a = sorted(a, key=key)
+    b = sorted(b, key=key)
+    for ra, rb in zip(a, b):
+        if set(ra) != set(rb):
+            return False
+        for k in ra:
+            va, vb = ra[k], rb[k]
+            if isinstance(va, float) or isinstance(vb, float):
+                if not np.isclose(va, vb, rtol=rtol, atol=atol, equal_nan=True):
+                    return False
+            elif va != vb:
+                return False
+    return True
 
 
 def main() -> None:
-    inst, table = build_database()
-    rows = inst.read(table)
-    n = len(rows)
-
-    base_s, base_avg = numpy_baseline(rows)
-    dev_s, dev_avg, platform = device_kernel(rows)
-
-    # Sanity: both paths agree (dedup'd rows, f32 tolerance).
-    if not np.allclose(np.sort(base_avg), np.sort(dev_avg), rtol=1e-3, atol=1e-3):
-        print(
-            json.dumps({"metric": "error", "value": 0, "unit": "mismatch", "vs_baseline": 0})
-        )
+    config = os.environ.get("BENCH_CONFIG", "readme")
+    builder = CONFIGS.get(config)
+    if builder is None:
+        print(json.dumps({"metric": "error", "value": 0, "unit": f"unknown config {config}", "vs_baseline": 0}))
         sys.exit(1)
 
-    rows_per_sec = n / dev_s
-    baseline_rps = n / base_s
+    import jax
+
+    platform = jax.devices()[0].platform
+    db, sql, n_rows = builder()
+
+    dev_s, dev_rows = time_query(db, sql)
+    assert db.interpreters.executor.last_path in ("device", "host")
+    dev_path = db.interpreters.executor.last_path
+
+    # Baseline: force the host (vectorized numpy) executor.
+    ex = db.interpreters.executor
+    orig = ex._device_capable
+    ex._device_capable = lambda plan, rows: False
+    host_s, host_rows = time_query(db, sql)
+    ex._device_capable = orig
+
+    # Both paths must agree numerically (a fast-but-wrong kernel must not
+    # benchmark as a success).
+    if not _rows_agree(dev_rows, host_rows):
+        print(json.dumps({"metric": "error", "value": 0, "unit": "path mismatch", "vs_baseline": 0}))
+        sys.exit(1)
+
+    rows_per_sec = n_rows / dev_s
     print(
         json.dumps(
             {
-                "metric": f"readme_demo_scan_agg_rows_per_sec_{platform}",
+                "metric": f"{config}_rows_per_sec_{platform}_{dev_path}",
                 "value": round(rows_per_sec),
                 "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / baseline_rps, 3),
+                "vs_baseline": round(host_s / dev_s, 3),
             }
         )
     )
@@ -142,3 +202,10 @@ def main() -> None:
 
 if __name__ == "__main__":
     main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # XLA's CPU runtime occasionally aborts in its C++ teardown during
+    # interpreter shutdown (after all output is produced). The driver
+    # checks our exit code, so exit deterministically once the JSON line
+    # is flushed.
+    os._exit(0)

@@ -16,6 +16,7 @@ import numpy as np
 import pyarrow as pa
 
 from .datum import DatumKind, arrow_to_kind
+from .dict_column import DictColumn, as_values, concat_columns
 from .schema import ColumnSchema, Schema, TSID_COLUMN, compute_tsid
 from .time_range import TimeRange
 
@@ -85,6 +86,31 @@ class RowGroup:
             arr = batch.column(idx)
             if isinstance(arr, pa.ChunkedArray):
                 arr = arr.combine_chunks()
+            if pa.types.is_dictionary(arr.type) and col.kind is DatumKind.STRING:
+                # String tags stay dictionary-encoded: codes + small
+                # vocabulary, never per-row Python objects (the scan hot
+                # path). Non-string dictionary inputs fall through to the
+                # decode path below.
+                vocab = np.asarray(arr.dictionary.to_pylist(), dtype=object)
+                default = col.kind.default_value()
+                if arr.null_count:
+                    validity[col.name] = np.asarray(arr.is_valid())
+                    # NULL slots must encode the same default value the
+                    # plain-array ingest path fills in, so tsid/partition
+                    # hashing is representation-independent.
+                    hits = np.nonzero(vocab == default)[0]
+                    if len(hits):
+                        default_code = int(hits[0])
+                    else:
+                        vocab = np.append(vocab, default)
+                        default_code = len(vocab) - 1
+                    codes = np.asarray(arr.indices.fill_null(default_code), dtype=np.int32)
+                else:
+                    codes = np.asarray(arr.indices.fill_null(0), dtype=np.int32)
+                if len(vocab) == 0:
+                    vocab = np.array([default], dtype=object)
+                columns[col.name] = DictColumn(codes, vocab)
+                continue
             if pa.types.is_dictionary(arr.type):
                 arr = arr.cast(arr.type.value_type)
             if arr.null_count:
@@ -104,7 +130,7 @@ class RowGroup:
             raise ValueError("concat of zero row groups")
         schema = parts[0].schema
         columns = {
-            name: np.concatenate([p.columns[name] for p in parts])
+            name: concat_columns([p.columns[name] for p in parts])
             for name in parts[0].columns
         }
         validity = {}
@@ -175,6 +201,8 @@ class RowGroup:
 
     def _sortable(self, name: str) -> np.ndarray:
         arr = self.columns[name]
+        if isinstance(arr, DictColumn):
+            return arr.sort_ranks()
         return arr
 
     def to_arrow(self) -> pa.RecordBatch:
@@ -185,7 +213,15 @@ class RowGroup:
             data = self.columns[col.name]
             mask = self.validity.get(col.name)
             np_mask = None if mask is None else ~mask
-            if pa.types.is_dictionary(f.type):
+            if isinstance(data, DictColumn):
+                arr = pa.DictionaryArray.from_arrays(
+                    pa.array(data.codes, type=pa.int32(), mask=np_mask),
+                    pa.array(list(data.values), type=f.type.value_type
+                             if pa.types.is_dictionary(f.type) else pa.string()),
+                )
+                if not pa.types.is_dictionary(f.type):
+                    arr = arr.cast(f.type)
+            elif pa.types.is_dictionary(f.type):
                 arr = pa.array(
                     [None if (np_mask is not None and np_mask[i]) else data[i] for i in range(self._n)]
                     if np_mask is not None
@@ -204,13 +240,16 @@ class RowGroup:
 
     def to_pylist(self) -> list[dict[str, Any]]:
         out = []
+        decoded = {
+            name: as_values(col) for name, col in self.columns.items()
+        }
         for i in range(self._n):
             row = {}
             for col in self.schema.columns:
                 if not self.valid_mask(col.name)[i]:
                     row[col.name] = None
                 else:
-                    v = self.columns[col.name][i]
+                    v = decoded[col.name][i]
                     row[col.name] = v.item() if isinstance(v, np.generic) else v
             out.append(row)
         return out

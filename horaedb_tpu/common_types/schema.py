@@ -291,23 +291,36 @@ def compute_tsid(tag_arrays: Sequence[np.ndarray], num_rows: int | None = None) 
     n = len(tag_arrays[0])
     out = np.full(n, 0xCBF29CE484222325, dtype=np.uint64)
     for arr in tag_arrays:
-        if arr.dtype == object:
-            encoded = [_canonical_bytes(v) for v in arr]
-            offsets = np.zeros(n + 1, dtype=np.int64)
-            np.cumsum(np.fromiter((len(b) for b in encoded), np.int64, count=n), out=offsets[1:])
-            col_hash = native.hash_var(b"".join(encoded), offsets)
-        elif arr.dtype == np.bool_:
-            col_hash = native.hash_fixed(arr.astype(np.uint8))
-        elif np.issubdtype(arr.dtype, np.integer):
-            canon = (
-                arr if arr.dtype == np.uint64
-                else arr.astype(np.int64, copy=False).view(np.uint64)
-            )
-            col_hash = native.hash_fixed(canon)
-        else:
-            col_hash = native.hash_fixed(arr)
-        native.fnv_mix(out, col_hash)
+        native.fnv_mix(out, _column_hash(arr))
     return out
+
+
+def _column_hash(arr) -> np.ndarray:
+    """Raw per-row XXH64 of one column's canonical bytes.
+
+    Dictionary columns hash the vocabulary once and gather through codes —
+    identical results to hashing decoded values, O(|vocab|) work.
+    """
+    from ..utils import native
+    from .dict_column import DictColumn
+
+    if isinstance(arr, DictColumn):
+        return _column_hash(arr.values)[arr.codes]
+    n = len(arr)
+    if arr.dtype == object:
+        encoded = [_canonical_bytes(v) for v in arr]
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(np.fromiter((len(b) for b in encoded), np.int64, count=n), out=offsets[1:])
+        return native.hash_var(b"".join(encoded), offsets)
+    if arr.dtype == np.bool_:
+        return native.hash_fixed(arr.astype(np.uint8))
+    if np.issubdtype(arr.dtype, np.integer):
+        canon = (
+            arr if arr.dtype == np.uint64
+            else arr.astype(np.int64, copy=False).view(np.uint64)
+        )
+        return native.hash_fixed(canon)
+    return native.hash_fixed(arr)
 
 
 def _canonical_bytes(v) -> bytes:

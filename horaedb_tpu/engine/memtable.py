@@ -54,10 +54,14 @@ class ColumnarMemTable:
             return
         seq_arr = np.full(n, sequence, dtype=np.uint64)
         tr = rows.time_range()
-        size = sum(
-            a.nbytes if a.dtype != object else sum(len(str(v)) for v in a)
-            for a in rows.columns.values()
-        )
+        from ..common_types.dict_column import DictColumn
+
+        size = 0
+        for a in rows.columns.values():
+            if isinstance(a, DictColumn) or a.dtype != object:
+                size += a.nbytes
+            else:
+                size += sum(len(str(v)) for v in a)
         with self._lock:
             self._chunks.append(rows)
             self._seq_chunks.append(seq_arr)

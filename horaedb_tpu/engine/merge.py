@@ -23,6 +23,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ..common_types.dict_column import DictColumn
 from ..common_types.row_group import RowGroup
 from ..common_types.schema import Schema, project_schema
 from ..table_engine.predicate import Predicate
@@ -45,6 +46,8 @@ def dedup_sorted(rows: RowGroup) -> RowGroup:
     same = np.ones(n - 1, dtype=np.bool_)
     for i in rows.schema.primary_key_indexes:
         col = rows.columns[rows.schema.columns[i].name]
+        if isinstance(col, DictColumn):
+            col = col.codes  # same RowGroup => shared vocab => codes compare
         same &= col[1:] == col[:-1]
     keep[1:] = ~same
     if keep.all():

@@ -93,6 +93,8 @@ class SstWriter:
 
 def _column_ranges(data: RowGroup) -> dict:
     """File-level min/max per numeric + string column for manifest pruning."""
+    from ...common_types.dict_column import DictColumn
+
     out = {}
     if len(data) == 0:
         return out
@@ -100,6 +102,11 @@ def _column_ranges(data: RowGroup) -> dict:
         arr = data.columns[col.name]
         mask = data.valid_mask(col.name)
         if not mask.any():
+            continue
+        if isinstance(arr, DictColumn):
+            lo, hi = arr.min_max(mask)
+            if lo is not None and not isinstance(lo, bytes):
+                out[col.name] = (lo, hi)
             continue
         vals = arr[mask]
         try:

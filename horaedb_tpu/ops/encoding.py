@@ -20,6 +20,7 @@ from typing import Sequence
 
 import numpy as np
 
+from ..common_types.dict_column import as_values, unique_inverse
 from ..common_types.row_group import RowGroup
 
 # Shape buckets: powers of two from 4k up. Anything smaller pads to 4096;
@@ -89,7 +90,7 @@ def encode_group_codes(
             tsid, return_index=True, return_inverse=True
         )
         # Key values per unique series (small arrays).
-        series_keys = [rows.columns[c][first_idx] for c in group_columns]
+        series_keys = [as_values(rows.columns[c][first_idx]) for c in group_columns]
         series_group, key_values = _codes_from_columns(series_keys)
         codes = series_group[inverse].astype(np.int32)
         return GroupEncoding(codes, len(key_values[0]) if key_values else 1, key_values)
@@ -99,22 +100,20 @@ def encode_group_codes(
     return GroupEncoding(codes64.astype(np.int32), len(key_values[0]) if key_values else 1, key_values)
 
 
-def _codes_from_columns(cols: list[np.ndarray]) -> tuple[np.ndarray, tuple[np.ndarray, ...]]:
+def _codes_from_columns(cols: list) -> tuple[np.ndarray, tuple[np.ndarray, ...]]:
     """(codes, unique key values per column) for composite keys."""
     if len(cols) == 1:
-        uniq, codes = np.unique(cols[0], return_inverse=True)
+        uniq, codes = unique_inverse(cols[0])
         return codes, (uniq,)
     # Composite: successive refinement — code each column, then combine.
     combined = np.zeros(len(cols[0]), dtype=np.int64)
-    per_col_codes = []
     for c in cols:
-        u, inv = np.unique(c, return_inverse=True)
-        per_col_codes.append((u, inv))
+        u, inv = unique_inverse(c)
         combined = combined * (len(u) + 1) + inv
     uniq_comb, first_idx, codes = np.unique(
         combined, return_index=True, return_inverse=True
     )
-    key_values = tuple(c[first_idx] for c in cols)
+    key_values = tuple(as_values(c[first_idx]) for c in cols)
     return codes, key_values
 
 

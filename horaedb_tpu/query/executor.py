@@ -24,6 +24,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from ..common_types.dict_column import DictColumn, as_values, unique_inverse
 from ..common_types.row_group import RowGroup
 from ..common_types.schema import Schema
 from ..common_types.time_range import MAX_TIMESTAMP, MIN_TIMESTAMP
@@ -98,10 +99,17 @@ def eval_expr(e: ast.Expr, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
         return _eval_func(e, rows)
     if isinstance(e, ast.InList):
         v, m = eval_expr(e.expr, rows)
-        hit = np.zeros(n, dtype=bool)
-        for lit in e.values:
-            lv, _ = eval_expr(lit, rows)
-            hit |= v == lv
+        lits = [
+            lit.value for lit in e.values if isinstance(lit, ast.Literal)
+        ]
+        if isinstance(v, DictColumn) and len(lits) == len(e.values):
+            hit = v.map_values(lambda vals: np.isin(vals, lits))
+        else:
+            v = as_values(v)
+            hit = np.zeros(n, dtype=bool)
+            for lit in e.values:
+                lv, _ = eval_expr(lit, rows)
+                hit |= v == as_values(lv)
         if e.negated:
             hit = ~hit
         return hit, m
@@ -124,6 +132,18 @@ def _eval_binary(e: ast.BinaryOp, rows: RowGroup) -> tuple[np.ndarray, np.ndarra
     op = e.op.upper()
     lv, lm = eval_expr(e.left, rows)
     rv, rm = eval_expr(e.right, rows)
+    # Dictionary fast path: compare the VOCABULARY against the literal and
+    # gather through codes (O(|vocab|) compares instead of O(n)).
+    if op in ("=", "!=", "<", "<=", ">", ">="):
+        fn = {
+            "=": np.equal, "!=": np.not_equal, "<": np.less,
+            "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
+        }[op]
+        if isinstance(lv, DictColumn) and isinstance(e.right, ast.Literal):
+            return lv.map_values(lambda vals: fn(vals, e.right.value)), lm & rm
+        if isinstance(rv, DictColumn) and isinstance(e.left, ast.Literal):
+            return rv.map_values(lambda vals: fn(e.left.value, vals)), lm & rm
+    lv, rv = as_values(lv), as_values(rv)
     if op == "AND":
         # NULL AND false == false: a side that is definitively false wins.
         l = lv.astype(bool) & lm
@@ -375,7 +395,7 @@ class Executor:
             rows = rows.filter(v.astype(bool) & m)
 
         # Group keys as value arrays.
-        key_arrays: list[np.ndarray] = []
+        key_arrays: list = []
         key_names: list[str] = []
         for k in plan.group_keys:
             if k.column is not None:
@@ -387,10 +407,8 @@ class Executor:
         n = len(rows)
         if key_arrays:
             combined = np.zeros(n, dtype=np.int64)
-            uniques = []
             for arr in key_arrays:
-                u, inv = np.unique(arr, return_inverse=True)
-                uniques.append(u)
+                u, inv = unique_inverse(arr)
                 combined = combined * (len(u) + 1) + inv
             uniq_comb, first_idx, codes = np.unique(
                 combined, return_index=True, return_inverse=True
@@ -413,7 +431,7 @@ class Executor:
                 isinstance(e, ast.FuncCall) and e.name == "time_bucket"
             ):
                 ki = key_names.index(out_name if isinstance(e, ast.Column) else str(e))
-                columns.append(key_arrays[ki][first_idx])
+                columns.append(as_values(key_arrays[ki][first_idx]))
                 names.append(out_name)
             else:
                 agg_i = [a.output_name for a in plan.aggs].index(out_name)
@@ -446,6 +464,8 @@ class Executor:
                 if isinstance(expr, ast.Column) and expr.name in aliases and not rows.schema.has_column(expr.name):
                     expr = aliases[expr.name]
                 kv, _ = eval_expr(expr, rows)
+                if isinstance(kv, DictColumn):
+                    kv = kv.sort_ranks()
                 keys.append(kv if o.ascending else _desc_key(kv))
             rows = rows.take(np.lexsort(tuple(keys)))
         if stmt.limit is not None:
@@ -458,14 +478,14 @@ class Executor:
             if isinstance(item.expr, ast.Star):
                 for c in rows.schema.columns:
                     names.append(c.name)
-                    columns.append(rows.column(c.name))
+                    columns.append(as_values(rows.column(c.name)))
                     vm = rows.valid_mask(c.name)
                     if not vm.all():
                         nulls[c.name] = ~vm
                 continue
             v, m = eval_expr(item.expr, rows)
             names.append(item.output_name)
-            columns.append(v)
+            columns.append(as_values(v))
             if not m.all():
                 nulls[item.output_name] = ~m
         return ResultSet(names, columns, nulls or None)
@@ -515,7 +535,7 @@ def _host_agg(
 ) -> tuple[np.ndarray, Optional[np.ndarray]]:
     if a.func == "count" and a.column is None:
         return np.bincount(codes, minlength=group_count).astype(np.int64), None
-    col = rows.column(a.column)
+    col = as_values(rows.column(a.column))
     valid = rows.valid_mask(a.column)
     if a.distinct:
         if a.func != "count":

@@ -1,0 +1,1 @@
+"""Operational tools (ref: src/tools, src/benchmarks, scripts/run-tsbs.sh)."""

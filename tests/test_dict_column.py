@@ -1,0 +1,144 @@
+"""DictColumn tests: code/vocab semantics, concat union, fast-path equivalences."""
+
+import numpy as np
+import pytest
+
+from horaedb_tpu.common_types.dict_column import (
+    DictColumn,
+    as_values,
+    concat_columns,
+    unique_inverse,
+)
+
+
+def dc(values, codes):
+    return DictColumn(np.asarray(codes, dtype=np.int32), np.asarray(values, dtype=object))
+
+
+class TestDictColumn:
+    def test_basic_semantics(self):
+        c = dc(["a", "b", "c"], [2, 0, 1, 0])
+        assert len(c) == 4
+        assert c[0] == "c" and c[3] == "a"
+        np.testing.assert_array_equal(c.decode(), np.array(["c", "a", "b", "a"], dtype=object))
+        sub = c[np.array([1, 2])]
+        assert isinstance(sub, DictColumn)
+        np.testing.assert_array_equal(as_values(sub), np.array(["a", "b"], dtype=object))
+
+    def test_encode_round_trip(self):
+        arr = np.array(["x", "y", "x", "z"], dtype=object)
+        c = DictColumn.encode(arr)
+        np.testing.assert_array_equal(c.decode(), arr)
+        assert len(c.values) == 3
+
+    def test_map_values_matches_decoded(self):
+        c = dc(["aa", "b", "cc"], [0, 1, 2, 1, 0])
+        fast = c.map_values(lambda vs: vs == "b")
+        slow = c.decode() == "b"
+        np.testing.assert_array_equal(fast, slow)
+
+    def test_sort_ranks_order_like_values(self):
+        c = dc(["m", "a", "z"], [0, 1, 2, 1])
+        order_fast = np.argsort(c.sort_ranks(), kind="stable")
+        order_slow = np.argsort(c.decode(), kind="stable")
+        np.testing.assert_array_equal(order_fast, order_slow)
+
+    def test_min_max_respects_mask(self):
+        c = dc(["a", "b", "z"], [2, 0, 1])
+        assert c.min_max() == ("a", "z")
+        assert c.min_max(np.array([True, False, True])) == ("b", "z")
+        assert c.min_max(np.zeros(3, dtype=bool)) == (None, None)
+
+    def test_concat_union_vocab(self):
+        a = dc(["a", "b"], [0, 1])
+        b = dc(["b", "c"], [1, 0])
+        out = concat_columns([a, b])
+        assert isinstance(out, DictColumn)
+        np.testing.assert_array_equal(
+            out.decode(), np.array(["a", "b", "c", "b"], dtype=object)
+        )
+        assert sorted(out.values.tolist()) == ["a", "b", "c"]
+
+    def test_concat_mixed_plain_and_dict(self):
+        a = dc(["a", "b"], [0, 1])
+        b = np.array(["c", "a"], dtype=object)
+        out = concat_columns([a, b])
+        np.testing.assert_array_equal(
+            out.decode(), np.array(["a", "b", "c", "a"], dtype=object)
+        )
+
+    def test_concat_all_plain_stays_plain(self):
+        out = concat_columns([np.array([1, 2]), np.array([3])])
+        assert isinstance(out, np.ndarray)
+
+    def test_concat_single_part_unsorted_vocab_unchanged(self):
+        # Review regression: first-occurrence (unsorted) vocabularies from
+        # Parquet must NOT be remapped via searchsorted for single parts.
+        c = dc(["host_0", "host_1", "host_2", "host_10"], [3, 0, 1, 2, 3])
+        out = concat_columns([c])
+        np.testing.assert_array_equal(
+            out.decode(),
+            np.array(["host_10", "host_0", "host_1", "host_2", "host_10"], dtype=object),
+        )
+
+    def test_concat_multi_part_unsorted_vocabs(self):
+        a = dc(["host_2", "host_10"], [0, 1])
+        b = dc(["host_10", "host_1"], [0, 1])
+        out = concat_columns([a, b])
+        np.testing.assert_array_equal(
+            out.decode(),
+            np.array(["host_2", "host_10", "host_10", "host_1"], dtype=object),
+        )
+
+    def test_unique_inverse_equivalence(self):
+        # Unused vocab entry 'z' (code 2 never appears): uniques cover only
+        # PRESENT values; reconstruction must equal the decoded column.
+        c = dc(["b", "a", "z"], [0, 1, 0, 0])
+        u_fast, inv_fast = unique_inverse(c)
+        assert sorted(u_fast.tolist()) == ["a", "b"]
+        np.testing.assert_array_equal(u_fast[inv_fast], c.decode())
+
+    def test_tsid_hash_equivalence(self):
+        from horaedb_tpu.common_types.schema import compute_tsid
+
+        vals = np.array(["h1", "h2", "h3"], dtype=object)
+        codes = np.array([2, 0, 1, 0], dtype=np.int32)
+        via_dict = compute_tsid([DictColumn(codes, vals)])
+        via_plain = compute_tsid([vals[codes]])
+        np.testing.assert_array_equal(via_dict, via_plain)
+
+
+class TestDictColumnThroughEngine:
+    def test_sst_round_trip_stays_encoded_and_queries_match(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE t (host string TAG, v double, ts timestamp KEY) "
+            "WITH (segment_duration='1h')"
+        )
+        vals = ", ".join(f"('h{i % 5}', {float(i)}, {i})" for i in range(100))
+        db.execute(f"INSERT INTO t (host, v, ts) VALUES {vals}")
+        db.flush_all()
+        table = db.catalog.open("t")
+        rows = table.read()
+        assert isinstance(rows.column("host"), DictColumn)
+        # filters, group-by, order-by on the encoded column
+        out = db.execute(
+            "SELECT host, count(*) AS c FROM t WHERE host != 'h0' GROUP BY host ORDER BY host DESC"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["h4", "h3", "h2", "h1"]
+        assert all(r["c"] == 20 for r in out)
+        db.close()
+
+    def test_memtable_sst_mixed_scan(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute("CREATE TABLE t (host string TAG, v double, ts timestamp KEY)")
+        db.execute("INSERT INTO t (host, v, ts) VALUES ('a', 1.0, 1)")
+        db.flush_all()
+        db.execute("INSERT INTO t (host, v, ts) VALUES ('b', 2.0, 2)")
+        out = db.execute("SELECT host, v FROM t ORDER BY ts").to_pylist()
+        assert out == [{"host": "a", "v": 1.0}, {"host": "b", "v": 2.0}]
+        db.close()
