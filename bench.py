@@ -171,15 +171,18 @@ def main() -> None:
     db, sql, n_rows = builder()
 
     dev_s, dev_rows = time_query(db, sql)
-    assert db.interpreters.executor.last_path in ("device", "host")
     dev_path = db.interpreters.executor.last_path
+    assert dev_path in ("device-cached", "device", "host"), dev_path
 
-    # Baseline: force the host (vectorized numpy) executor.
+    # Baseline: force the host (vectorized numpy) executor — disable both
+    # the device path and the device-resident cache.
     ex = db.interpreters.executor
-    orig = ex._device_capable
+    orig_cap, orig_cached = ex._device_capable, ex._try_cached_agg
     ex._device_capable = lambda plan, rows: False
+    ex._try_cached_agg = lambda plan, table: None
     host_s, host_rows = time_query(db, sql)
-    ex._device_capable = orig
+    ex._device_capable = orig_cap
+    ex._try_cached_agg = orig_cached
 
     # Both paths must agree numerically (a fast-but-wrong kernel must not
     # benchmark as a success).

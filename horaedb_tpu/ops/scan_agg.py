@@ -125,6 +125,52 @@ _fused_scan_agg = functools.partial(
 )(scan_agg_body)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
+)
+def cached_scan_agg(
+    series_codes,  # int32[N] (padded rows carry code == n_series)
+    ts_rel,  # int32[N], ms relative to the cache's min timestamp
+    values,  # f32[F, N] device-resident value columns
+    group_of_series,  # int32[S+1]; last entry is the pad series' dump group
+    allowed_series,  # bool[S+1];  last entry False (pad rows masked out)
+    literals,  # f32[n_filters]
+    lo_rel,  # int32 scalar: inclusive range start (relative)
+    hi_rel,  # int32 scalar: exclusive range end (relative)
+    t0_rel,  # int32 scalar: bucket origin (relative, <= lo_rel)
+    bucket_ms,  # int32 scalar: bucket width (1 when not bucketing)
+    *,
+    n_groups: int,
+    n_buckets: int,
+    n_agg_fields: int,
+    numeric_filters: tuple[tuple[int, int], ...],
+):
+    """The steady-state serving kernel over HBM-resident columns.
+
+    Everything per-query is SMALL: the series->group map, the series
+    allow-list (tag filters evaluated per series on host), scalar time
+    bounds, and filter literals. The big arrays (series codes, relative
+    timestamps, value columns) stay on device across queries — uploads are
+    O(series + scalars), not O(rows).
+    """
+    mask = allowed_series[series_codes]
+    mask = mask & (ts_rel >= lo_rel) & (ts_rel < hi_rel)
+    bucket = jnp.clip((ts_rel - t0_rel) // bucket_ms, 0, n_buckets - 1).astype(jnp.int32)
+    group_codes = group_of_series[series_codes]
+    return scan_agg_body(
+        group_codes,
+        bucket,
+        mask,
+        values,
+        literals,
+        n_groups=n_groups,
+        n_buckets=n_buckets,
+        n_agg_fields=n_agg_fields,
+        numeric_filters=numeric_filters,
+    )
+
+
 @dataclass
 class AggState:
     """Combinable partial aggregates (numpy, on host after device exit)."""

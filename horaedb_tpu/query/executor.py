@@ -196,10 +196,19 @@ class Executor:
     / MemoryTable — anything behind the table_engine.Table interface)."""
 
     def __init__(self) -> None:
-        # observability: which path ran last ("device" | "host")
+        # observability: which path ran last
+        # ("device-cached" | "device" | "host")
         self.last_path: str = ""
+        from .scan_cache import ScanCache
+
+        self.scan_cache = ScanCache()
 
     def execute(self, plan: QueryPlan, table) -> ResultSet:
+        if plan.is_aggregate:
+            cached = self._try_cached_agg(plan, table)
+            if cached is not None:
+                self.last_path = "device-cached"
+                return cached
         projection = self._projection(plan)
         rows = table.read(plan.predicate, projection=projection)
         if plan.is_aggregate and self._device_capable(plan, rows):
@@ -264,45 +273,41 @@ class Executor:
         return out
 
     # ---- device path -------------------------------------------------------
-    def _device_capable(self, plan: QueryPlan, rows: RowGroup) -> bool:
+    def _agg_device_shape(self, plan: QueryPlan):
+        """(tag_keys, bucket_key, agg_cols) when the aggregation shape fits
+        the device kernels, else None. Shared by the cached and uncached
+        device paths — eligibility rules live HERE only."""
         schema = plan.schema
         tag_names = set(schema.tag_names)
         bucket_keys = [k for k in plan.group_keys if k.time_bucket_ms is not None]
         if len(bucket_keys) > 1:
-            return False
+            return None
         for k in plan.group_keys:
             if k.column is not None and k.column not in tag_names:
-                return False
+                return None
         for a in plan.aggs:
             if a.distinct:
-                return False
-            if a.column is not None:
-                kind = schema.column(a.column).kind
-                if not kind.is_numeric:
-                    return False
-                # One shared device mask can't express per-field NULL sets;
-                # a NULL in any aggregated column routes to the host path
-                # where aggregates skip NULLs per field.
-                if not rows.valid_mask(a.column).all():
-                    return False
-        return True
-
-    def _execute_agg_device(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
-        schema = plan.schema
+                return None
+            if a.column is not None and not schema.column(a.column).kind.is_numeric:
+                return None
         tag_keys = [k for k in plan.group_keys if k.column is not None]
-        bucket_key = next(
-            (k for k in plan.group_keys if k.time_bucket_ms is not None), None
-        )
-
-        # Split filters: simple numeric field filters -> device; the rest of
-        # the residual WHERE -> host mask.
         agg_cols = list(dict.fromkeys(a.column for a in plan.aggs if a.column))
+        return tag_keys, (bucket_keys[0] if bucket_keys else None), agg_cols
+
+    def _split_residual_filters(self, plan: QueryPlan):
+        """Residual WHERE conjuncts -> (numeric device filters, the rest).
+
+        Shared classification: a conjunct becomes a device filter when it
+        is ``float_column op numeric_literal``; everything else stays an
+        AST conjunct for the caller to evaluate (host mask, or per-series
+        for the cached path)."""
+        from .planner import _as_simple_cmp, _conjuncts
+
+        schema = plan.schema
         device_filters: list[tuple[str, str, float]] = []
-        host_residue: list[ast.Expr] = []
+        other: list[ast.Expr] = []
         residual = self._residual_where(plan)
         if residual is not None:
-            from .planner import _as_simple_cmp, _conjuncts
-
             for conj in _conjuncts(residual):
                 simple = _as_simple_cmp(conj)
                 if (
@@ -313,7 +318,24 @@ class Executor:
                 ):
                     device_filters.append(simple)
                 else:
-                    host_residue.append(conj)
+                    other.append(conj)
+        return device_filters, other
+
+    def _device_capable(self, plan: QueryPlan, rows: RowGroup) -> bool:
+        if self._agg_device_shape(plan) is None:
+            return False
+        for a in plan.aggs:
+            # One shared device mask can't express per-field NULL sets; a
+            # NULL in any aggregated column routes to the host path where
+            # aggregates skip NULLs per field.
+            if a.column is not None and not rows.valid_mask(a.column).all():
+                return False
+        return True
+
+    def _execute_agg_device(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
+        tag_keys, bucket_key, agg_cols = self._agg_device_shape(plan)
+        # Numeric field filters -> device; the rest -> host row mask.
+        device_filters, host_residue = self._split_residual_filters(plan)
 
         n = len(rows)
         mask = np.ones(n, dtype=bool)
@@ -352,7 +374,14 @@ class Executor:
         ).padded()
         state = scan_aggregate(batch, spec, [lit for _, _, lit in device_filters])
 
-        G, B = max(enc.num_groups, 1), n_buckets
+        return self._assemble_agg_result(
+            plan, tag_keys, enc.key_values, agg_cols, state,
+            max(enc.num_groups, 1), n_buckets, t0, width,
+        )
+
+    def _assemble_agg_result(
+        self, plan, tag_keys, key_values, agg_cols, state, G, B, t0, width
+    ) -> ResultSet:
         counts = state.counts[:G, :B]
         sums = state.sums[:, :G, :B]
         mins = state.mins[:, :G, :B]
@@ -367,13 +396,12 @@ class Executor:
 
         names: list[str] = []
         columns: list[np.ndarray] = []
-        nulls: dict[str, np.ndarray] = {}
         for item in plan.select.items:
             out_name = item.output_name
             e = item.expr
             if isinstance(e, ast.Column):
                 ki = [k.column for k in tag_keys].index(e.name)
-                columns.append(np.asarray(enc.key_values[ki])[g_idx])
+                columns.append(np.asarray(key_values[ki])[g_idx])
                 names.append(out_name)
             elif isinstance(e, ast.FuncCall) and e.name == "time_bucket":
                 columns.append(t0 + b_idx.astype(np.int64) * (width or 1))
@@ -384,8 +412,138 @@ class Executor:
                 col = _agg_output(a, agg_cols, counts, sums, mins, maxs, g_idx, b_idx)
                 columns.append(col)
                 names.append(out_name)
-        result = ResultSet(names, columns, nulls or None)
+        result = ResultSet(names, columns, None)
         return _order_and_limit(result, plan)
+
+    # ---- device-cached path (HBM-resident columns) ---------------------------
+    def _try_cached_agg(self, plan: QueryPlan, table) -> Optional[ResultSet]:
+        """Serve an aggregate from device-resident scan state, or None.
+
+        Ships only O(series)+O(1) data per query; see query/scan_cache.py.
+        """
+        import jax.numpy as jnp
+
+        from ..ops.scan_agg import cached_scan_agg, coerce_literals, encode_filter_ops, state_to_host
+
+        schema = plan.schema
+        if schema.tsid_index is None or not table.physical_datas():
+            return None
+        shape = self._agg_device_shape(plan)
+        if shape is None:
+            return None
+        tag_keys, bucket_key, agg_cols = shape
+        if bucket_key is not None and bucket_key.time_bucket_ms > 2**31 - 1:
+            return None  # relative-int32 bucket math can't express it
+
+        # Residual conjuncts must all be numeric device filters or
+        # series-level (tag-only) filters; anything else -> uncached paths.
+        tag_names = set(schema.tag_names)
+        device_filters, other = self._split_residual_filters(plan)
+        series_filters: list = []
+        for conj in other:
+            if _is_series_conjunct(conj, tag_names):
+                series_filters.append(conj)
+            else:
+                return None
+
+        filter_cols = [f[0] for f in device_filters]
+        value_names = list(dict.fromkeys(agg_cols + filter_cols))
+
+        entry = self.scan_cache.get(
+            table, value_names, read_rows=lambda: table.read(Predicate.all_time())
+        )
+        if entry is None:
+            return None
+        # NULL agg inputs need per-field masks — not expressible here.
+        for c in agg_cols:
+            if not entry.rows.valid_mask(c).all():
+                return None
+
+        # Series-level small arrays (one row per unique series); validity
+        # slices carry over so NULL-tag semantics match the host path.
+        S = entry.n_series
+        series_rows = None
+        if tag_keys or series_filters:
+            series_rows = RowGroup(
+                schema,
+                {
+                    c.name: entry.rows.columns[c.name][entry.series_first_idx]
+                    for c in schema.columns
+                },
+                {
+                    name: mask[entry.series_first_idx]
+                    for name, mask in entry.rows.validity.items()
+                },
+            )
+        if tag_keys:
+            from ..ops.encoding import _codes_from_columns
+
+            series_group, key_values = _codes_from_columns(
+                [series_rows.columns[k.column] for k in tag_keys]
+            )
+            num_groups = len(key_values[0])
+        else:
+            series_group = np.zeros(S, dtype=np.int64)
+            key_values = ()
+            num_groups = 1
+        allowed = np.ones(S, dtype=bool)
+        for conj in series_filters:
+            v, m = eval_expr(conj, series_rows)
+            allowed &= np.asarray(as_values(v)).astype(bool) & m
+
+        # Time range + bucketing, relative to the cache origin. An empty
+        # intersection keeps rel bounds at (0, 0) — NOT raw epoch deltas,
+        # which overflow int32.
+        tr = plan.predicate.time_range
+        lo = max(tr.inclusive_start, entry.min_ts)
+        hi = min(tr.exclusive_end, entry.max_ts + 1)
+        empty_range = hi <= lo
+        width = bucket_key.time_bucket_ms if bucket_key is not None else None
+        if empty_range:
+            t0 = entry.min_ts
+            lo = hi = entry.min_ts
+            n_buckets = 1
+        elif width is not None:
+            t0 = (lo // width) * width
+            n_buckets = max(1, -(-(hi - t0) // width))
+        else:
+            t0 = lo
+            n_buckets = 1
+
+        spec = ScanAggSpec(
+            n_groups=max(num_groups, 1),
+            n_buckets=n_buckets,
+            n_agg_fields=len(agg_cols),
+            numeric_filters=tuple(
+                (value_names.index(col), op) for col, op, _ in device_filters
+            ),
+        ).padded()
+
+        gos = np.append(series_group, 0).astype(np.int32)  # pad series -> masked
+        allow = np.append(allowed, False)
+        out = cached_scan_agg(
+            entry.series_codes_dev,
+            entry.ts_rel_dev,
+            entry.values_for(value_names)
+            if value_names
+            else jnp.zeros((0, len(entry.series_codes_dev)), dtype=jnp.float32),
+            jnp.asarray(gos),
+            jnp.asarray(allow),
+            coerce_literals([lit for _, _, lit in device_filters]),
+            np.int32(lo - entry.min_ts),
+            np.int32(hi - entry.min_ts),
+            np.int32(max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0),
+            np.int32(width if width else 1),
+            n_groups=spec.n_groups,
+            n_buckets=spec.n_buckets,
+            n_agg_fields=spec.n_agg_fields,
+            numeric_filters=encode_filter_ops(spec.numeric_filters),
+        )
+        state = state_to_host(*out)
+        return self._assemble_agg_result(
+            plan, tag_keys, key_values, agg_cols, state,
+            max(num_groups, 1), n_buckets, t0, width,
+        )
 
     # ---- host fallback -----------------------------------------------------
     def _execute_agg_host(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
@@ -489,6 +647,13 @@ class Executor:
             if not m.all():
                 nulls[item.output_name] = ~m
         return ResultSet(names, columns, nulls or None)
+
+
+def _is_series_conjunct(conj: ast.Expr, tag_names: set) -> bool:
+    """True when the conjunct only references tag columns — its value is
+    constant per series, so it can evaluate on the (small) series set."""
+    cols = _columns_of(conj)
+    return bool(cols) and all(c.name in tag_names for c in cols)
 
 
 def _empty_ungrouped_agg_row(plan: QueryPlan) -> ResultSet:

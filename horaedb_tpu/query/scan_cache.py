@@ -1,0 +1,176 @@
+"""Device-resident scan cache — the HBM-fed serving path.
+
+The reference keeps hot SST pages in a memory cache (mem_cache.rs) so
+repeated scans skip object storage. The TPU-native equivalent goes
+further: after the first scan of a table state, the dense scan inputs live
+in device HBM —
+
+    per-row series codes (int32), relative timestamps (int32),
+    value columns (f32)
+
+— and every subsequent aggregate query ships only O(series)+O(1) data:
+a series->group map, a series allow-list (tag filters evaluated per
+series on host), time-range scalars, and filter literals. The fused
+kernel (ops.scan_agg.cached_scan_agg) does the rest on device.
+
+Invalidation: entries key on a table fingerprint (last/flushed sequence +
+SST file ids per physical table); any write or compaction changes it.
+Eligibility: aggregate plans whose residual filters decompose into tag
+EQ/IN (series-level) + numeric field comparisons (device literals), and
+whose data span fits int32 relative milliseconds (~24 days).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common_types.dict_column import as_values
+from ..common_types.row_group import RowGroup
+from ..ops.encoding import pad_to_bucket, shape_bucket
+from ..table_engine.predicate import Predicate
+
+_I32_MAX = 2**31 - 1
+
+
+@dataclass
+class CachedTableScan:
+    """Device-resident state for one table fingerprint."""
+
+    fingerprint: tuple
+    rows: RowGroup  # merged host rows (kept for fallbacks/series lookups)
+    n_valid: int
+    min_ts: int
+    max_ts: int
+    # per-series (small, host): unique tsids + first-row index
+    series_first_idx: np.ndarray
+    n_series: int
+    # device arrays (padded): series codes, relative ts
+    series_codes_dev: "jnp.ndarray"
+    ts_rel_dev: "jnp.ndarray"
+    # device value columns by name, shape (padded,)
+    value_cols_dev: dict
+
+    def values_for(self, names: list[str]):
+        return jnp.stack([self.value_cols_dev[n] for n in names])
+
+
+class ScanCache:
+    def __init__(self, max_entries: int = 4) -> None:
+        self._entries: dict[str, CachedTableScan] = {}
+        # fingerprint last seen per table: a cache build is only worth the
+        # full-table read once the data has been STABLE across two
+        # consecutive eligible queries (a write-heavy table would otherwise
+        # rebuild — full read + upload — on every single query).
+        self._candidate: dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self.max_entries = max_entries
+        self.hits = 0
+        self.misses = 0
+
+    def get(
+        self,
+        table,
+        value_columns: list[str],
+        read_rows,
+    ) -> Optional[CachedTableScan]:
+        """Cached scan state for ``table``, building/refreshing as needed.
+
+        ``read_rows()`` materializes the full-table merged rows on miss.
+        Returns None when the table's shape doesn't fit the cached-kernel
+        contract (span overflow, empty table), or when the data hasn't been
+        stable long enough to justify a build.
+        """
+        fp = _fingerprint(table)
+        with self._lock:
+            entry = self._entries.get(table.name)
+            if entry is not None and entry.fingerprint == fp:
+                if all(c in entry.value_cols_dev for c in value_columns):
+                    self.hits += 1
+                    return entry
+                # same data, new columns: extend the entry in place
+                self._extend(entry, value_columns)
+                self.hits += 1
+                return entry
+            if self._candidate.get(table.name) != fp:
+                # first sighting of this table state: don't build yet
+                self._candidate[table.name] = fp
+                self.misses += 1
+                return None
+        rows = read_rows()
+        n = len(rows)
+        if n == 0:
+            return None
+        ts = rows.timestamps
+        min_ts, max_ts = int(ts.min()), int(ts.max())
+        if max_ts - min_ts >= _I32_MAX:
+            return None
+        entry = self._build(fp, rows, min_ts, max_ts, value_columns)
+        with self._lock:
+            self.misses += 1
+            if table.name not in self._entries and len(self._entries) >= self.max_entries:
+                self._entries.pop(next(iter(self._entries)))
+            self._entries[table.name] = entry
+        return entry
+
+    def _build(
+        self, fp, rows: RowGroup, min_ts: int, max_ts: int, value_columns: list[str]
+    ) -> CachedTableScan:
+        n = len(rows)
+        schema = rows.schema
+        tsid = rows.columns[schema.columns[schema.tsid_index].name]
+        uniq, first_idx, inverse = np.unique(tsid, return_index=True, return_inverse=True)
+        n_series = len(uniq)
+        # pad rows carry series code n_series -> masked out by the kernel
+        codes = pad_to_bucket(inverse.astype(np.int32), n, fill=n_series)
+        ts_rel = pad_to_bucket(
+            (rows.timestamps - min_ts).astype(np.int32), n, fill=np.int32(-1)
+        )
+        entry = CachedTableScan(
+            fingerprint=fp,
+            rows=rows,
+            n_valid=n,
+            min_ts=min_ts,
+            max_ts=max_ts,
+            series_first_idx=first_idx,
+            n_series=n_series,
+            series_codes_dev=jnp.asarray(codes),
+            ts_rel_dev=jnp.asarray(ts_rel),
+            value_cols_dev={},
+        )
+        self._extend(entry, value_columns)
+        return entry
+
+    def _extend(self, entry: CachedTableScan, value_columns: list[str]) -> None:
+        for c in value_columns:
+            if c not in entry.value_cols_dev:
+                arr = as_values(entry.rows.column(c)).astype(np.float32, copy=False)
+                entry.value_cols_dev[c] = jnp.asarray(
+                    pad_to_bucket(arr, entry.n_valid)
+                )
+
+    def invalidate(self, table_name: str) -> None:
+        with self._lock:
+            self._entries.pop(table_name, None)
+
+
+def _fingerprint(table) -> tuple:
+    parts = []
+    for data in table.physical_datas():
+        files = tuple(
+            (h.level, h.file_id) for h in data.version.levels.all_files()
+        )
+        parts.append(
+            (
+                data.table_id,
+                data.schema.version,  # ALTER invalidates even with no writes
+                data.last_sequence,
+                data.version.flushed_sequence,
+                files,
+            )
+        )
+    return tuple(parts)

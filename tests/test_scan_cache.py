@@ -1,0 +1,101 @@
+"""Device-resident scan cache tests incl. review regressions."""
+
+import numpy as np
+import pytest
+
+import horaedb_tpu
+
+
+@pytest.fixture()
+def db():
+    conn = horaedb_tpu.connect(None)
+    yield conn
+    conn.close()
+
+
+DDL = (
+    "CREATE TABLE t (host string TAG, v double, ts timestamp KEY) "
+    "WITH (segment_duration='1h')"
+)
+
+
+def seed(db, n=200, t_base=1_700_000_000_000):
+    db.execute(DDL)
+    vals = ", ".join(
+        f"('h{i % 5}', {float(i)}, {t_base + i * 1000})" for i in range(n)
+    )
+    db.execute(f"INSERT INTO t (host, v, ts) VALUES {vals}")
+    db.flush_all()
+
+
+def warm(db, sql):
+    """Two runs: first records the fingerprint candidate, second builds."""
+    db.execute(sql)
+    return db.execute(sql)
+
+
+class TestScanCache:
+    def test_builds_on_second_stable_query(self, db):
+        seed(db)
+        ex = db.interpreters.executor
+        sql = "SELECT host, count(*) AS c FROM t GROUP BY host"
+        db.execute(sql)
+        assert ex.last_path == "device"  # first sighting: no build
+        db.execute(sql)
+        assert ex.last_path == "device-cached"  # second: builds + serves
+        db.execute(sql)
+        assert ex.last_path == "device-cached"  # third: pure HBM hit
+        assert ex.scan_cache.hits >= 1
+
+    def test_write_invalidates_immediately(self, db):
+        seed(db)
+        sql = "SELECT count(*) AS c FROM t"
+        warm(db, sql)
+        db.execute("INSERT INTO t (host, v, ts) VALUES ('hX', 1.0, 1700000000000)")
+        out = db.execute(sql).to_pylist()
+        assert out == [{"c": 201}]
+
+    def test_alter_invalidates_without_writes(self, db):
+        # Review regression: schema version is part of the fingerprint.
+        seed(db)
+        warm(db, "SELECT count(*) AS c FROM t")
+        db.execute("ALTER TABLE t ADD COLUMN v2 double")
+        out = db.execute("SELECT count(v2) AS c FROM t").to_pylist()
+        assert out == [{"c": 0}]
+
+    def test_empty_range_epoch_timestamps_no_overflow(self, db):
+        # Review regression: epoch-ms data + out-of-range query used to
+        # overflow np.int32 after the empty-range reset.
+        seed(db, t_base=1_700_000_000_000)
+        sql = "SELECT count(*) AS c FROM t WHERE ts >= 1900000000000"
+        warm(db, "SELECT count(*) AS c FROM t")  # build cache
+        out = db.execute(sql).to_pylist()
+        assert out == [{"c": 0}]
+
+    def test_huge_bucket_width_falls_back(self, db):
+        # Review regression: 30d bucket overflows int32 ms; must fall back.
+        seed(db)
+        sql = (
+            "SELECT time_bucket(ts, '30d') AS b, count(*) AS c FROM t "
+            "GROUP BY time_bucket(ts, '30d')"
+        )
+        db.execute(sql)
+        out = db.execute(sql)
+        assert db.interpreters.executor.last_path == "device"  # not cached
+        assert out.to_pylist()[0]["c"] == 200
+
+    def test_time_sliced_query_on_cached_data(self, db):
+        seed(db)
+        t0 = 1_700_000_000_000
+        warm(db, "SELECT count(*) AS c FROM t")
+        sql = f"SELECT count(*) AS c FROM t WHERE ts >= {t0 + 50_000} AND ts < {t0 + 100_000}"
+        out = db.execute(sql).to_pylist()
+        assert out == [{"c": 50}]
+        assert db.interpreters.executor.last_path == "device-cached"
+
+    def test_tag_filter_series_level(self, db):
+        seed(db)
+        warm(db, "SELECT count(*) AS c FROM t")
+        out = db.execute("SELECT count(*) AS c FROM t WHERE host IN ('h1', 'h3')").to_pylist()
+        assert out == [{"c": 80}]
+        assert db.interpreters.executor.last_path == "device-cached"
