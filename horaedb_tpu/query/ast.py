@@ -204,6 +204,12 @@ class AlterTableSetOptions:
     options: dict[str, str]
 
 
+@dataclass(frozen=True)
+class Explain:
+    inner: "Select"
+    analyze: bool = False
+
+
 Statement = (
     Select
     | CreateTable

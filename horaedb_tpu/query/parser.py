@@ -167,6 +167,12 @@ class Parser:
 
     # ---- statements ------------------------------------------------------
     def _statement(self) -> ast.Statement:
+        if self._peek() is None:
+            raise ParseError("empty statement", 0, self.sql)
+        if self._at_kw("EXPLAIN"):
+            self.i += 1
+            analyze = self._eat_kw("ANALYZE")
+            return ast.Explain(self._select(), analyze=analyze)
         if self._at_kw("SELECT"):
             return self._select()
         if self._at_kw("CREATE"):

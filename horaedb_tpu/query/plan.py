@@ -106,6 +106,12 @@ class ExistsPlan:
 
 
 @dataclass(frozen=True)
+class ExplainPlan:
+    inner: "QueryPlan"
+    analyze: bool = False
+
+
+@dataclass(frozen=True)
 class AlterTablePlan:
     table: str
     add_columns: tuple = ()
@@ -122,4 +128,5 @@ Plan = (
     | ShowCreatePlan
     | ExistsPlan
     | AlterTablePlan
+    | ExplainPlan
 )

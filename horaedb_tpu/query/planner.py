@@ -53,6 +53,10 @@ class Planner:
         self.schema_of = schema_of
 
     def plan(self, stmt: ast.Statement) -> Plan:
+        if isinstance(stmt, ast.Explain):
+            from .plan import ExplainPlan
+
+            return ExplainPlan(self._plan_select(stmt.inner), analyze=stmt.analyze)
         if isinstance(stmt, ast.Select):
             return self._plan_select(stmt)
         if isinstance(stmt, ast.CreateTable):

@@ -1,0 +1,158 @@
+"""sqlness-style case runner (ref: integration_tests/ + the `sqlness`
+crate — .sql case files diffed against committed .result files).
+
+A case file holds ``;``-separated statements (``--`` comments allowed).
+Each statement's output renders to a stable text form; the concatenation is
+compared byte-for-byte against the sibling ``.result`` file.
+
+    python -m horaedb_tpu.tools.sqlness CASE_DIR [--update]
+
+``--update`` (re)writes the .result files — the reference workflow for
+blessing new expected output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import os
+import sys
+
+import numpy as np
+
+
+def format_output(out) -> str:
+    from ..query.executor import ResultSet
+    from ..query.interpreters import AffectedRows
+
+    if isinstance(out, AffectedRows):
+        return f"affected_rows: {out.count}\n"
+    assert isinstance(out, ResultSet)
+    lines = ["\t".join(out.names)]
+    nulls = out.nulls or {}
+    for i in range(out.num_rows):
+        cells = []
+        for name, col in zip(out.names, out.columns):
+            m = nulls.get(name)
+            if m is not None and m[i]:
+                cells.append("NULL")
+                continue
+            v = col[i]
+            if isinstance(v, (float, np.floating)):
+                cells.append(f"{float(v):.6g}")
+            elif isinstance(v, (np.integer,)):
+                cells.append(str(int(v)))
+            elif isinstance(v, (np.bool_, bool)):
+                cells.append("true" if v else "false")
+            else:
+                cells.append(str(v))
+        lines.append("\t".join(cells))
+    return "\n".join(lines) + "\n"
+
+
+def run_case(conn, sql_text: str) -> str:
+    """Execute a case file's statements; render outputs + errors."""
+    from ..query.parser import ParseError
+
+    chunks = []
+    for stmt_sql in _split_statements(sql_text):
+        chunks.append(f"-- SQL: {_collapse(stmt_sql)}\n")
+        try:
+            out = conn.execute(stmt_sql)
+            chunks.append(format_output(out))
+        except Exception as e:
+            chunks.append(f"Error: {e}\n")
+        chunks.append("\n")
+    return "".join(chunks)
+
+
+def _collapse(sql: str) -> str:
+    return " ".join(sql.split())
+
+
+def _split_statements(text: str) -> list[str]:
+    """Split on top-level ';' using the REAL SQL tokenizer (comments,
+    quoted strings/identifiers all handled exactly like the parser)."""
+    from ..query.parser import tokenize
+
+    tokens = tokenize(text)
+    out = []
+    start = 0  # raw-text offset of current statement start
+    seen_token = False
+    for t in tokens:
+        if t.kind == "op" and t.text == ";":
+            if seen_token:
+                out.append(_strip_comment_lines(text[start:t.pos]))
+            start = t.pos + 1
+            seen_token = False
+        else:
+            seen_token = True
+    if seen_token:
+        out.append(_strip_comment_lines(text[start:]))
+    return out
+
+
+def _strip_comment_lines(stmt: str) -> str:
+    """Drop full-line comments from a statement slice (display hygiene —
+    the parser would skip them anyway)."""
+    kept = [
+        line for line in stmt.splitlines() if not line.strip().startswith("--")
+    ]
+    return "\n".join(kept).strip()
+
+
+def run_dir(case_dir: str, update: bool = False) -> list[str]:
+    """Run every .sql case; returns list of failure descriptions."""
+    import horaedb_tpu
+
+    failures = []
+    for name in sorted(os.listdir(case_dir)):
+        if not name.endswith(".sql"):
+            continue
+        sql_path = os.path.join(case_dir, name)
+        result_path = sql_path[:-4] + ".result"
+        conn = horaedb_tpu.connect(None)
+        try:
+            got = run_case(conn, open(sql_path).read())
+        finally:
+            conn.close()
+        if update:
+            with open(result_path, "w") as f:
+                f.write(got)
+            continue
+        if not os.path.exists(result_path):
+            failures.append(f"{name}: missing {os.path.basename(result_path)}")
+            continue
+        expected = open(result_path).read()
+        if got != expected:
+            diff = "\n".join(
+                difflib.unified_diff(
+                    expected.splitlines(), got.splitlines(),
+                    "expected", "got", lineterm="", n=2,
+                )
+            )
+            failures.append(f"{name}:\n{diff}")
+    return failures
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="sqlness-style case runner")
+    p.add_argument("case_dir")
+    p.add_argument("--update", action="store_true", help="bless current output")
+    args = p.parse_args()
+    if not os.path.isdir(args.case_dir):
+        print(f"error: case dir not found: {args.case_dir}", file=sys.stderr)
+        sys.exit(2)
+    failures = run_dir(args.case_dir, update=args.update)
+    if args.update:
+        print("results updated")
+        return
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}\n")
+        sys.exit(1)
+    print("all cases passed")
+
+
+if __name__ == "__main__":
+    main()

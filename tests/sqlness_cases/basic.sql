@@ -1,0 +1,23 @@
+-- Minimum end-to-end slice: DDL, insert, aggregate (ref README demo)
+CREATE TABLE demo (name string TAG, value double NOT NULL,
+                   t timestamp NOT NULL, TIMESTAMP KEY(t))
+ENGINE=Analytic WITH (segment_duration='2h');
+
+INSERT INTO demo (name, value, t) VALUES
+  ('host1', 0.32, 1695348000000),
+  ('host2', 0.61, 1695348000005),
+  ('host1', 0.44, 1695348001000);
+
+SELECT name, value, t FROM demo ORDER BY t;
+
+SELECT name, avg(value) AS a, count(*) AS c FROM demo GROUP BY name ORDER BY name;
+
+SHOW TABLES;
+
+DESCRIBE demo;
+
+EXISTS TABLE demo;
+
+DROP TABLE demo;
+
+SHOW TABLES;

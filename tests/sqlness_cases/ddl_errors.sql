@@ -1,0 +1,27 @@
+-- DDL edge cases and error surfaces
+CREATE TABLE t1 (ts timestamp KEY, v double);
+
+CREATE TABLE t1 (ts timestamp KEY, v double);
+
+CREATE TABLE IF NOT EXISTS t1 (ts timestamp KEY, v double);
+
+CREATE TABLE bad (v double);
+
+CREATE TABLE bad (host string TAG, ts timestamp KEY)
+  PARTITION BY HASH(host) PARTITIONS 2;
+
+ALTER TABLE t1 ADD COLUMN v2 double;
+
+ALTER TABLE t1 ADD COLUMN v2 double;
+
+INSERT INTO t1 (ts, v, v2) VALUES (100, 1.5, 2.5);
+
+SELECT * FROM t1;
+
+DROP TABLE missing;
+
+DROP TABLE IF EXISTS missing;
+
+SELECT nope FROM t1;
+
+SELECT sum(v) FROM t1 GROUP BY v2;

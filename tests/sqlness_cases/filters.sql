@@ -1,0 +1,22 @@
+-- Predicates: time range, tags, numeric, IN/BETWEEN, NULL semantics
+CREATE TABLE m (host string TAG, region string TAG, v double,
+                ts timestamp NOT NULL, TIMESTAMP KEY(ts));
+
+INSERT INTO m (host, region, v, ts) VALUES
+  ('a', 'east', 1.0, 1000), ('a', 'east', 2.0, 2000),
+  ('b', 'west', 3.0, 1500), ('b', 'west', NULL, 2500),
+  ('c', 'east', 5.0, 3000);
+
+SELECT host, v FROM m WHERE ts >= 1000 AND ts < 2500 ORDER BY ts;
+
+SELECT count(*) AS c FROM m WHERE host IN ('a', 'c');
+
+SELECT host FROM m WHERE v BETWEEN 2 AND 5 ORDER BY host;
+
+SELECT count(v) AS non_null, count(*) AS total FROM m;
+
+SELECT host, v FROM m WHERE v IS NULL;
+
+SELECT count(*) AS c FROM m WHERE region = 'east' AND v > 1.5;
+
+SELECT host, max(v) AS m FROM m WHERE ts > 0 GROUP BY host ORDER BY host;

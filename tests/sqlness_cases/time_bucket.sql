@@ -1,0 +1,16 @@
+-- time_bucket aggregation + EXPLAIN
+CREATE TABLE cpu (host string TAG, usage double,
+                  ts timestamp NOT NULL, TIMESTAMP KEY(ts))
+WITH (segment_duration='1h');
+
+INSERT INTO cpu (host, usage, ts) VALUES
+  ('h1', 10.0, 0), ('h1', 20.0, 30000), ('h1', 30.0, 60000),
+  ('h2', 5.0, 0), ('h2', 15.0, 90000);
+
+SELECT time_bucket(ts, '1m') AS minute, count(*) AS c, sum(usage) AS s
+FROM cpu GROUP BY time_bucket(ts, '1m') ORDER BY minute;
+
+SELECT host, time_bucket(ts, '1m') AS minute, max(usage) AS peak
+FROM cpu GROUP BY host, time_bucket(ts, '1m') ORDER BY host, minute;
+
+EXPLAIN SELECT host, avg(usage) FROM cpu WHERE ts >= 0 AND ts < 60000 GROUP BY host;

@@ -1,0 +1,13 @@
+"""Run the sqlness case corpus as part of the test suite
+(ref: integration_tests sqlness harness)."""
+
+import os
+
+from horaedb_tpu.tools.sqlness import run_dir
+
+CASE_DIR = os.path.join(os.path.dirname(__file__), "sqlness_cases")
+
+
+def test_all_sqlness_cases():
+    failures = run_dir(CASE_DIR)
+    assert not failures, "\n\n".join(failures)
