@@ -1,0 +1,22 @@
+"""Cluster mode: shard membership + routing + forwarding
+(ref: src/cluster, src/router, proxy/src/forward.rs).
+
+Round-1 scope is the data plane of static clustering:
+
+- ``shard``  — the Shard/ShardSet state machine {INIT, OPENING, READY,
+               FROZEN} with version fencing (ref: shard_set.rs:38-228);
+- ``router`` — table -> node routing; ``RuleBasedRouter`` from static
+               config (ref: rule_based.rs), hash fallback for unlisted
+               tables;
+- HTTP forwarding in the server: a request for a table owned by another
+  node proxies to the owner with loop protection (ref: forward.rs).
+
+The coordinator (horaemeta analog: heartbeats, shard scheduling, etcd
+leases) is round-2 work; the interfaces here are shaped so it slots in as
+a ``ClusterBasedRouter`` + shard-event handlers.
+"""
+
+from .router import Route, Router, RuleBasedRouter
+from .shard import Shard, ShardSet, ShardState
+
+__all__ = ["Route", "Router", "RuleBasedRouter", "Shard", "ShardSet", "ShardState"]

@@ -1,0 +1,77 @@
+"""Table -> node routing (ref: src/router — Router trait lib.rs:80,
+RuleBasedRouter rule_based.rs, hash.rs).
+
+``RuleBasedRouter``: static config assigns tables to endpoints explicitly;
+unlisted tables hash onto the endpoint list (stable, like the reference's
+hash router), so a fixed topology needs no per-table configuration.
+``ClusterBasedRouter`` (meta-driven, cached routes) arrives with the
+coordinator in a later round behind the same interface.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import xxhash
+
+
+@dataclass(frozen=True)
+class Route:
+    table: str
+    endpoint: str  # "host:port"
+    is_local: bool
+
+
+class Router(ABC):
+    @abstractmethod
+    def route(self, table: str) -> Route: ...
+
+    def endpoints(self) -> list[str]:
+        return []
+
+
+class LocalOnlyRouter(Router):
+    """Standalone mode: this node owns everything."""
+
+    def __init__(self, self_endpoint: str = "local") -> None:
+        self.self_endpoint = self_endpoint
+
+    def route(self, table: str) -> Route:
+        return Route(table, self.self_endpoint, True)
+
+    def endpoints(self) -> list[str]:
+        return [self.self_endpoint]
+
+
+class RuleBasedRouter(Router):
+    def __init__(
+        self,
+        self_endpoint: str,
+        endpoints: Sequence[str],
+        table_rules: Optional[dict[str, str]] = None,
+    ) -> None:
+        """``endpoints``: every node in the topology (must include self).
+        ``table_rules``: explicit table -> endpoint pins."""
+        if self_endpoint not in endpoints:
+            raise ValueError(
+                f"self endpoint {self_endpoint!r} not in topology {list(endpoints)}"
+            )
+        self.self_endpoint = self_endpoint
+        self._endpoints = list(endpoints)
+        self._rules = dict(table_rules or {})
+        for t, ep in self._rules.items():
+            if ep not in self._endpoints:
+                raise ValueError(f"rule for {t!r} targets unknown endpoint {ep!r}")
+
+    def route(self, table: str) -> Route:
+        ep = self._rules.get(table)
+        if ep is None:
+            # Stable hash over the table name onto the endpoint ring.
+            idx = xxhash.xxh64_intdigest(table.encode()) % len(self._endpoints)
+            ep = self._endpoints[idx]
+        return Route(table, ep, ep == self.self_endpoint)
+
+    def endpoints(self) -> list[str]:
+        return list(self._endpoints)

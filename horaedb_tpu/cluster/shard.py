@@ -1,0 +1,137 @@
+"""Shard state machine (ref: cluster/src/shard_set.rs:38-228).
+
+A shard is the unit of table placement and failover. States and the legal
+transitions mirror the reference:
+
+    INIT -> OPENING -> READY -> FROZEN
+                 \\______________/
+                  (close: any -> INIT)
+
+Version fencing: every mutation carries the shard version; stale updates
+(version <= current) are rejected (ref: cluster/src/lib.rs:145-158 —
+without this, a node that lost its lease could double-apply changes).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class ShardState(enum.Enum):
+    INIT = "init"
+    OPENING = "opening"
+    READY = "ready"
+    FROZEN = "frozen"
+
+
+class ShardError(RuntimeError):
+    pass
+
+
+@dataclass
+class ShardInfo:
+    shard_id: int
+    version: int = 0
+    table_ids: tuple[int, ...] = ()
+
+
+class Shard:
+    def __init__(self, info: ShardInfo) -> None:
+        self._info = info
+        self._state = ShardState.INIT
+        self._lock = threading.Lock()
+        # Bootstrap sentinel: only a shard created at version 0 may accept
+        # its first update unfenced; after that every update must advance
+        # the version (ref: shard-version checks, cluster/src/lib.rs:145).
+        self._installed = info.version > 0
+
+    @property
+    def shard_id(self) -> int:
+        return self._info.shard_id
+
+    @property
+    def state(self) -> ShardState:
+        return self._state
+
+    @property
+    def version(self) -> int:
+        return self._info.version
+
+    @property
+    def table_ids(self) -> tuple[int, ...]:
+        return self._info.table_ids
+
+    # ---- transitions ----------------------------------------------------
+    def begin_open(self) -> None:
+        with self._lock:
+            if self._state is not ShardState.INIT:
+                raise ShardError(f"shard {self.shard_id}: open from {self._state}")
+            self._state = ShardState.OPENING
+
+    def finish_open(self) -> None:
+        with self._lock:
+            if self._state is not ShardState.OPENING:
+                raise ShardError(
+                    f"shard {self.shard_id}: finish_open from {self._state}"
+                )
+            self._state = ShardState.READY
+
+    def freeze(self) -> None:
+        """Stop serving writes ahead of a transfer (ref: Frozen state)."""
+        with self._lock:
+            if self._state is not ShardState.READY:
+                raise ShardError(f"shard {self.shard_id}: freeze from {self._state}")
+            self._state = ShardState.FROZEN
+
+    def close(self) -> None:
+        with self._lock:
+            self._state = ShardState.INIT
+
+    def ensure_writable(self) -> None:
+        if self._state is not ShardState.READY:
+            raise ShardError(
+                f"shard {self.shard_id} not writable (state={self._state.value})"
+            )
+
+    # ---- version-fenced updates ----------------------------------------
+    def apply_update(self, new_info: ShardInfo) -> None:
+        """Install new membership; stale versions are fenced off."""
+        with self._lock:
+            if self._installed and new_info.version <= self._info.version:
+                raise ShardError(
+                    f"stale shard update: v{new_info.version} <= v{self._info.version}"
+                )
+            self._info = new_info
+            self._installed = True
+
+
+class ShardSet:
+    """All shards this node serves (ref: shard_set.rs ShardSet)."""
+
+    def __init__(self) -> None:
+        self._shards: dict[int, Shard] = {}
+        self._lock = threading.Lock()
+
+    def insert(self, shard: Shard) -> None:
+        with self._lock:
+            if shard.shard_id in self._shards:
+                raise ShardError(f"shard {shard.shard_id} already present")
+            self._shards[shard.shard_id] = shard
+
+    def get(self, shard_id: int) -> Optional[Shard]:
+        with self._lock:
+            return self._shards.get(shard_id)
+
+    def remove(self, shard_id: int) -> Optional[Shard]:
+        with self._lock:
+            return self._shards.pop(shard_id, None)
+
+    def all_shards(self) -> list[Shard]:
+        with self._lock:
+            return list(self._shards.values())
+
+    def ready_count(self) -> int:
+        return sum(1 for s in self.all_shards() if s.state is ShardState.READY)

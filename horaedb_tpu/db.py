@@ -51,10 +51,14 @@ class Connection:
         self.catalog.close()
 
 
-def connect(path: Optional[str] = None, wal: bool = True) -> Connection:
+def connect(
+    path: Optional[str] = None,
+    wal: bool = True,
+    engine_config: EngineConfig | None = None,
+) -> Connection:
     """Open (or create) a database. ``path=None`` -> in-memory, no WAL."""
     if path is None:
-        return Connection(MemoryStore())
+        return Connection(MemoryStore(), config=engine_config)
     store = LocalDiskStore(path)
     wal_mgr = LocalDiskWal(f"{path}/wal") if wal else None
-    return Connection(store, wal=wal_mgr)
+    return Connection(store, wal=wal_mgr, config=engine_config)

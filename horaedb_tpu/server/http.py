@@ -37,6 +37,33 @@ logger = logging.getLogger("horaedb_tpu.server")
 DEFAULT_HTTP_PORT = 5440  # ref: config.rs:176
 
 
+async def _client_session(app: web.Application):
+    """One pooled forwarding session per app (keep-alive to peers)."""
+    import aiohttp
+
+    session = app.get("forward_session")
+    if session is None or session.closed:
+        session = aiohttp.ClientSession()
+        app["forward_session"] = session
+
+        async def _close(app_):
+            s = app_.get("forward_session")
+            if s is not None and not s.closed:
+                await s.close()
+
+        app.on_cleanup.append(_close)
+    return session
+
+
+def _table_of_statement(stmt) -> Optional[str]:
+    """The table a statement targets, for routing (None = node-local)."""
+    from ..query import ast
+
+    if isinstance(stmt, ast.Explain):
+        stmt = stmt.inner
+    return getattr(stmt, "table", None)
+
+
 def _json_default(v: Any):
     if isinstance(v, (np.integer,)):
         return int(v)
@@ -53,11 +80,65 @@ def _dumps(obj: Any) -> str:
     return json.dumps(obj, default=_json_default)
 
 
-def create_app(conn: Connection) -> web.Application:
+FORWARD_HEADER = "X-HoraeDB-Forwarded"
+
+
+def create_app(conn: Connection, router=None) -> web.Application:
     proxy = Proxy(conn)
     app = web.Application()
     app["conn"] = conn
     app["proxy"] = proxy
+    app["router"] = router
+
+    async def _forward_if_remote(request: web.Request, table) -> Optional[web.Response]:
+        """Proxy the raw request to the owning node (ref: forward.rs).
+
+        Returns None when the table is local (or routing is off). A request
+        that has already been forwarded once is never forwarded again —
+        misconfigured topologies surface as an error, not a loop.
+        """
+        if router is None or table is None:
+            return None
+        route = router.route(table)
+        if route.is_local:
+            return None
+        if request.headers.get(FORWARD_HEADER):
+            return web.json_response(
+                {
+                    "error": (
+                        f"routing loop: {table!r} routed to {route.endpoint} "
+                        "but this node also received it forwarded"
+                    )
+                },
+                status=502,
+            )
+        import aiohttp
+
+        body = await request.read()
+        url = f"http://{route.endpoint}{request.path_qs}"
+        try:
+            session = await _client_session(request.app)
+            async with session.post(
+                url,
+                data=body,
+                headers={
+                    FORWARD_HEADER: "1",
+                    "Content-Type": request.headers.get(
+                        "Content-Type", "application/json"
+                    ),
+                },
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as resp:
+                payload = await resp.read()
+                return web.Response(
+                    body=payload,
+                    status=resp.status,
+                    content_type=resp.content_type,
+                )
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"error": f"forward to {route.endpoint} failed: {e}"}, status=502
+            )
 
     # ---- core ----------------------------------------------------------
     async def sql(request: web.Request) -> web.Response:
@@ -68,6 +149,18 @@ def create_app(conn: Connection) -> web.Application:
         query = body.get("query")
         if not isinstance(query, str) or not query.strip():
             return web.json_response({"error": "missing 'query'"}, status=400)
+        if router is not None:
+            # Routing needs the target table before execution. The parse
+            # here is routing-only; standalone mode skips it entirely.
+            try:
+                stmt = conn.frontend.parse_sql(query)
+            except Exception as e:
+                proxy._m_queries.inc()
+                proxy._m_errors.inc()
+                return web.json_response({"error": str(e)}, status=422)
+            forwarded = await _forward_if_remote(request, _table_of_statement(stmt))
+            if forwarded is not None:
+                return forwarded
         try:
             out = await asyncio.get_running_loop().run_in_executor(
                 None, proxy.handle_sql, query
@@ -94,6 +187,9 @@ def create_app(conn: Connection) -> web.Application:
             return web.json_response(
                 {"error": "body must be {'table': t, 'rows': [{...}]}"}, status=400
             )
+        forwarded = await _forward_if_remote(request, table)
+        if forwarded is not None:
+            return forwarded
         conn_ = request.app["conn"]
 
         def do_write():
@@ -181,12 +277,27 @@ def create_app(conn: Connection) -> web.Application:
         return web.json_response({"status": "ok"})
 
     async def route(request: web.Request) -> web.Response:
+        """One payload shape in both modes:
+        routes[i] = {endpoint, is_local, shard_id|null}."""
         table = request.match_info["table"]
+        if router is not None:
+            r = router.route(table)
+            return web.json_response(
+                {
+                    "table": table,
+                    "routes": [
+                        {"endpoint": r.endpoint, "is_local": r.is_local, "shard_id": None}
+                    ],
+                }
+            )
         if not conn.catalog.exists(table):
             return web.json_response({"error": f"table not found: {table}"}, status=404)
-        # Standalone: this node owns everything (cluster routing later).
+        # Standalone: this node owns everything.
         return web.json_response(
-            {"table": table, "routes": [{"endpoint": "local", "shard_id": 0}]}
+            {
+                "table": table,
+                "routes": [{"endpoint": "local", "is_local": True, "shard_id": 0}],
+            }
         )
 
     async def debug_config(request: web.Request) -> web.Response:
@@ -263,11 +374,44 @@ def create_app(conn: Connection) -> web.Application:
 
 def run_server(
     data_dir: Optional[str] = None,
-    host: str = "127.0.0.1",
-    port: int = DEFAULT_HTTP_PORT,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+    config=None,
 ) -> None:
-    conn = connect(data_dir)
-    app = create_app(conn)
+    """One precedence rule: an explicit argument wins over ``config``,
+    which wins over the defaults. (The CLI resolves its flags into the
+    config before calling; programmatic callers can pass either form.)"""
+    from ..engine.instance import EngineConfig
+
+    engine_cfg = None
+    slow_threshold = 1.0
+    if config is not None:
+        data_dir = data_dir if data_dir is not None else config.engine.data_dir
+        host = host if host is not None else config.server.host
+        port = port if port is not None else config.server.http_port
+        engine_cfg = EngineConfig(
+            space_write_buffer_size=config.engine.space_write_buffer_size,
+            compaction_l0_trigger=config.engine.compaction_l0_trigger,
+        )
+        slow_threshold = config.limits.slow_threshold_s
+    host = host if host is not None else "127.0.0.1"
+    port = port if port is not None else DEFAULT_HTTP_PORT
+    conn = connect(
+        data_dir,
+        wal=(config.engine.wal if config is not None else True),
+        engine_config=engine_cfg,
+    )
+    router = None
+    if config is not None and config.cluster.enabled:
+        from ..cluster import RuleBasedRouter
+
+        router = RuleBasedRouter(
+            config.cluster.self_endpoint,
+            config.cluster.endpoints,
+            config.cluster.rules,
+        )
+    app = create_app(conn, router=router)
+    app["proxy"].slow_threshold_s = slow_threshold
     logger.info("horaedb_tpu http listening on %s:%d (data: %s)", host, port, data_dir)
     try:
         web.run_app(app, host=host, port=port, print=None)
@@ -278,14 +422,25 @@ def run_server(
 def main() -> None:
     import argparse
 
+    from ..utils.config import Config
+
     p = argparse.ArgumentParser(description="horaedb_tpu server")
+    p.add_argument("--config", default=None, help="TOML config file")
     p.add_argument("--data-dir", default=None, help="storage dir (default: in-memory)")
-    p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=DEFAULT_HTTP_PORT)
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None)
     p.add_argument("--log-level", default="info")
     args = p.parse_args()
     logging.basicConfig(level=args.log_level.upper())
-    run_server(args.data_dir, args.host, args.port)
+    cfg = Config.load(args.config)
+    # CLI flags override config file + env.
+    if args.data_dir is not None:
+        cfg.engine.data_dir = args.data_dir
+    if args.host is not None:
+        cfg.server.host = args.host
+    if args.port is not None:
+        cfg.server.http_port = args.port
+    run_server(config=cfg)
 
 
 if __name__ == "__main__":

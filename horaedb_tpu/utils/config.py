@@ -1,0 +1,146 @@
+"""Server/engine configuration (ref: src/horaedb/src/config.rs).
+
+One TOML file -> typed ``Config`` with unknown-key rejection (the
+reference's serde ``deny_unknown_fields``), plus environment-variable
+overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
+:89-102 overrides addr/meta/cluster from env).
+
+    [server]
+    http_port = 5440
+    host = "127.0.0.1"
+
+    [engine]
+    data_dir = "/data/horaedb"
+    wal = true                      # false = disable_data_wal semantics
+    space_write_buffer_size = "256mb"
+    compaction_l0_trigger = 4
+
+    [limits]
+    slow_threshold = "1s"
+
+Env overrides: HORAEDB_HTTP_PORT, HORAEDB_HOST, HORAEDB_DATA_DIR.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..engine.options import parse_duration_ms, parse_size_bytes
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ServerConfig:
+    host: str = "127.0.0.1"
+    http_port: int = 5440  # ref default, config.rs:176
+
+
+@dataclass
+class EngineSection:
+    data_dir: Optional[str] = None  # None = in-memory
+    wal: bool = True
+    space_write_buffer_size: int = 256 << 20
+    compaction_l0_trigger: int = 4
+
+
+@dataclass
+class LimitsConfig:
+    slow_threshold_s: float = 1.0
+
+
+@dataclass
+class ClusterSection:
+    enabled: bool = False
+    self_endpoint: str = ""
+    endpoints: list[str] = field(default_factory=list)
+    # explicit table -> endpoint pins; unlisted tables hash over endpoints
+    rules: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Config:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    engine: EngineSection = field(default_factory=EngineSection)
+    limits: LimitsConfig = field(default_factory=LimitsConfig)
+    cluster: ClusterSection = field(default_factory=ClusterSection)
+
+    @staticmethod
+    def load(path: Optional[str] = None) -> "Config":
+        raw: dict[str, Any] = {}
+        if path is not None:
+            with open(path, "rb") as f:
+                raw = tomllib.load(f)
+        cfg = Config()
+        _apply(cfg, raw)
+        _apply_env(cfg)
+        return cfg
+
+
+_KNOWN = {
+    "server": {"host", "http_port"},
+    "engine": {"data_dir", "wal", "space_write_buffer_size", "compaction_l0_trigger"},
+    "limits": {"slow_threshold"},
+    "cluster": {"self_endpoint", "endpoints", "rules"},
+}
+
+
+def _apply(cfg: Config, raw: dict) -> None:
+    unknown_sections = set(raw) - set(_KNOWN)
+    if unknown_sections:
+        raise ConfigError(f"unknown config section(s): {sorted(unknown_sections)}")
+    for section, keys in raw.items():
+        if not isinstance(keys, dict):
+            raise ConfigError(f"section [{section}] must be a table")
+        unknown = set(keys) - _KNOWN[section]
+        if unknown:
+            raise ConfigError(
+                f"unknown key(s) in [{section}]: {sorted(unknown)}"
+            )
+    s = raw.get("server", {})
+    if "host" in s:
+        cfg.server.host = str(s["host"])
+    if "http_port" in s:
+        cfg.server.http_port = int(s["http_port"])
+    e = raw.get("engine", {})
+    if "data_dir" in e:
+        cfg.engine.data_dir = str(e["data_dir"]) or None
+    if "wal" in e:
+        if not isinstance(e["wal"], bool):
+            raise ConfigError("engine.wal must be a boolean")
+        cfg.engine.wal = e["wal"]
+    if "space_write_buffer_size" in e:
+        cfg.engine.space_write_buffer_size = parse_size_bytes(e["space_write_buffer_size"])
+    if "compaction_l0_trigger" in e:
+        cfg.engine.compaction_l0_trigger = int(e["compaction_l0_trigger"])
+    l = raw.get("limits", {})
+    if "slow_threshold" in l:
+        cfg.limits.slow_threshold_s = parse_duration_ms(l["slow_threshold"]) / 1000.0
+    c = raw.get("cluster", {})
+    if c:
+        cfg.cluster.enabled = True
+        cfg.cluster.self_endpoint = str(c.get("self_endpoint", ""))
+        eps = c.get("endpoints", [])
+        if not isinstance(eps, list) or not all(isinstance(e, str) for e in eps):
+            raise ConfigError("cluster.endpoints must be a list of strings")
+        cfg.cluster.endpoints = eps
+        rules = c.get("rules", {})
+        if not isinstance(rules, dict):
+            raise ConfigError("cluster.rules must be a table of table -> endpoint")
+        cfg.cluster.rules = {str(k): str(v) for k, v in rules.items()}
+        if not cfg.cluster.self_endpoint:
+            raise ConfigError("cluster.self_endpoint is required in [cluster]")
+
+
+def _apply_env(cfg: Config) -> None:
+    if v := os.environ.get("HORAEDB_HTTP_PORT"):
+        cfg.server.http_port = int(v)
+    if v := os.environ.get("HORAEDB_HOST"):
+        cfg.server.host = v
+    if v := os.environ.get("HORAEDB_DATA_DIR"):
+        cfg.engine.data_dir = v

@@ -1,0 +1,58 @@
+"""Config loading tests (ref: config.rs deny_unknown_fields + env overrides)."""
+
+import pytest
+
+from horaedb_tpu.utils.config import Config, ConfigError
+
+
+def write(tmp_path, text):
+    p = tmp_path / "config.toml"
+    p.write_text(text)
+    return str(p)
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = Config.load(None)
+        assert cfg.server.http_port == 5440
+        assert cfg.engine.wal is True
+
+    def test_full_file(self, tmp_path):
+        cfg = Config.load(write(tmp_path, """
+[server]
+host = "0.0.0.0"
+http_port = 6000
+
+[engine]
+data_dir = "/tmp/x"
+wal = false
+space_write_buffer_size = "64mb"
+compaction_l0_trigger = 8
+
+[limits]
+slow_threshold = "500ms"
+"""))
+        assert cfg.server.host == "0.0.0.0"
+        assert cfg.server.http_port == 6000
+        assert cfg.engine.data_dir == "/tmp/x"
+        assert cfg.engine.wal is False
+        assert cfg.engine.space_write_buffer_size == 64 << 20
+        assert cfg.engine.compaction_l0_trigger == 8
+        assert cfg.limits.slow_threshold_s == 0.5
+
+    def test_unknown_key_rejected(self, tmp_path):
+        with pytest.raises(ConfigError, match="unknown key"):
+            Config.load(write(tmp_path, "[server]\nhttp_prot = 1\n"))
+        with pytest.raises(ConfigError, match="unknown config section"):
+            Config.load(write(tmp_path, "[nope]\nx = 1\n"))
+
+    def test_env_overrides(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HORAEDB_HTTP_PORT", "7777")
+        monkeypatch.setenv("HORAEDB_DATA_DIR", "/tmp/envdir")
+        cfg = Config.load(write(tmp_path, "[server]\nhttp_port = 6000\n"))
+        assert cfg.server.http_port == 7777  # env wins over file
+        assert cfg.engine.data_dir == "/tmp/envdir"
+
+    def test_bad_types_rejected(self, tmp_path):
+        with pytest.raises(ConfigError, match="boolean"):
+            Config.load(write(tmp_path, "[engine]\nwal = 'yes'\n"))
