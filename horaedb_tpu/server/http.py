@@ -38,21 +38,25 @@ DEFAULT_HTTP_PORT = 5440  # ref: config.rs:176
 
 
 async def _client_session(app: web.Application):
-    """One pooled forwarding session per app (keep-alive to peers)."""
+    """One pooled forwarding session per app (keep-alive to peers).
+
+    Lazily created (must be born inside the running event loop); the
+    cleanup hook is registered at create_app time — aiohttp freezes the
+    signal lists once the app starts.
+    """
     import aiohttp
 
     session = app.get("forward_session")
     if session is None or session.closed:
         session = aiohttp.ClientSession()
         app["forward_session"] = session
-
-        async def _close(app_):
-            s = app_.get("forward_session")
-            if s is not None and not s.closed:
-                await s.close()
-
-        app.on_cleanup.append(_close)
     return session
+
+
+async def _close_client_session(app: web.Application):
+    s = app.get("forward_session")
+    if s is not None and not s.closed:
+        await s.close()
 
 
 def _table_of_statement(stmt) -> Optional[str]:
@@ -89,6 +93,7 @@ def create_app(conn: Connection, router=None) -> web.Application:
     app["conn"] = conn
     app["proxy"] = proxy
     app["router"] = router
+    app.on_cleanup.append(_close_client_session)
 
     async def _forward_if_remote(request: web.Request, table) -> Optional[web.Response]:
         """Proxy the raw request to the owning node (ref: forward.rs).
