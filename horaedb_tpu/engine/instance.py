@@ -10,6 +10,7 @@ lost on crash, SSTs are not).
 
 from __future__ import annotations
 
+import concurrent.futures as cf
 import threading
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -120,10 +121,11 @@ class Instance:
     def write(self, table: TableData, rows: RowGroup) -> int:
         """Durable (WAL) write into the memtable; returns the sequence.
 
-        Serialized per table (ref: single-writer discipline,
-        serial_executor.rs). Triggers a synchronous flush when the table's
-        write buffer fills (background flush arrives with the runtime
-        layer).
+        Concurrent same-schema writers MERGE: one writer becomes the
+        leader, drains the pending queue, and commits the whole group with
+        ONE WAL append/fsync and one memtable insert (ref: the
+        PendingWriteQueue, table/mod.rs:147-358). Writers of other schema
+        versions fail fast, exactly like the single-writer path did.
         """
         if table.dropped:
             raise ValueError(f"table dropped: {table.name}")
@@ -132,18 +134,75 @@ class Instance:
                 f"schema mismatch: table {table.name} v{table.schema.version}, "
                 f"write v{rows.schema.version}"
             )
-        with table.serial_lock:
-            seq = table.alloc_sequence()
-            if self.wal is not None:
-                self.wal.append(table.table_id, seq, rows)
-            table.put_rows(rows, seq)
-            needs_flush = table.should_flush()
-        # Flush (and any triggered compaction) runs OUTSIDE the write
-        # critical section — it takes the serial lock itself, and other
-        # writers shouldn't queue behind a compaction rewrite.
-        if needs_flush:
-            self.flush_table(table)
-        return seq
+        entry = (rows, cf.Future())
+        with table.pending_lock:
+            table.pending_writes.append(entry)
+            if table.writer_active:
+                follower = True
+            else:
+                follower = False
+                table.writer_active = True
+        if follower:
+            return entry[1].result()
+
+        try:
+            while True:
+                with table.pending_lock:
+                    batch = table.pending_writes
+                    table.pending_writes = []
+                    if not batch:
+                        table.writer_active = False
+                        break
+                if self._commit_write_group(table, batch):
+                    # Flush as soon as the buffer trips — sustained writer
+                    # pressure must not grow the memtable unboundedly while
+                    # the leader keeps draining (flush takes its own locks;
+                    # new writers keep queueing meanwhile).
+                    self.flush_table(table)
+        except BaseException:
+            with table.pending_lock:
+                table.writer_active = False
+            raise
+        return entry[1].result()
+
+    def _commit_write_group(self, table: TableData, batch: list) -> bool:
+        """One WAL append + memtable insert per schema-version group.
+
+        EVERY future in ``batch`` is resolved before returning — a failure
+        anywhere (including merge itself) becomes that group's exception,
+        never a hung follower.
+        """
+        groups: dict[int, list] = {}
+        for rows, fut in batch:
+            groups.setdefault(rows.schema.version, []).append((rows, fut))
+        needs_flush = False
+        for _, entries in groups.items():
+            try:
+                merged = (
+                    entries[0][0]
+                    if len(entries) == 1
+                    else RowGroup.concat([rows for rows, _ in entries])
+                )
+                with table.serial_lock:
+                    if table.dropped:
+                        raise ValueError(f"table dropped: {table.name}")
+                    if merged.schema.version != table.schema.version:
+                        raise ValueError(
+                            f"schema changed mid-write for {table.name}"
+                        )
+                    seq = table.alloc_sequence()
+                    if self.wal is not None:
+                        self.wal.append(table.table_id, seq, merged)
+                    table.put_rows(merged, seq)
+                    needs_flush |= table.should_flush()
+            except BaseException as e:
+                for _, fut in entries:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for _, fut in entries:
+                fut.set_result(seq)
+        return needs_flush
 
     # ---- read path -----------------------------------------------------
     def read(

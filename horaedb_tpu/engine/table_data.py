@@ -41,6 +41,11 @@ class TableData:
         self.manifest = manifest
         self.store = store
         self.serial_lock = threading.RLock()  # single-writer per table
+        # Pending-write queue: concurrent writers merge into one WAL batch
+        # (ref: table/mod.rs:147-358 PendingWriteQueue).
+        self.pending_lock = threading.Lock()
+        self.pending_writes: list = []
+        self.writer_active = False
 
         if recovered_state is not None:
             self.version = TableVersion(schema, recovered_state.levels)

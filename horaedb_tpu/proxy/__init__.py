@@ -22,6 +22,7 @@ from ..db import Connection
 from ..query.interpreters import AffectedRows, Output
 from ..query.plan import InsertPlan, QueryPlan
 from ..utils.metrics import REGISTRY
+from ..utils.runtime import PriorityRuntime
 
 logger = logging.getLogger("horaedb_tpu.proxy")
 
@@ -90,12 +91,18 @@ class Proxy:
         self.limiter = Limiter()
         self.hotspot = Hotspot()
         self.slow_threshold_s = slow_threshold_s
+        # Expensive (long-range) queries run on the small low-priority pool
+        # (ref: SelectInterpreter spawning on the priority runtime).
+        self.runtime = PriorityRuntime()
         self._req_ids = itertools.count(1)
         self._m_queries = REGISTRY.counter("horaedb_queries_total", "SQL statements handled")
         self._m_errors = REGISTRY.counter("horaedb_query_errors_total", "SQL statements failed")
         self._m_latency = REGISTRY.histogram(
             "horaedb_query_duration_seconds", "SQL statement latency"
         )
+
+    def close(self) -> None:
+        self.runtime.shutdown()
 
     def handle_sql(self, sql: str) -> Output:
         ctx = RequestContext(next(self._req_ids), sql)
@@ -106,8 +113,12 @@ class Proxy:
             self.limiter.check(table)
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))
-            out = self.conn.interpreters.execute(plan)
-            return out
+            if isinstance(plan, QueryPlan):
+                return self.runtime.run(
+                    plan.priority.value,
+                    lambda: self.conn.interpreters.execute(plan),
+                )
+            return self.conn.interpreters.execute(plan)
         except Exception:
             self._m_errors.inc()
             raise

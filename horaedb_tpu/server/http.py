@@ -95,6 +95,11 @@ def create_app(conn: Connection, router=None) -> web.Application:
     app["router"] = router
     app.on_cleanup.append(_close_client_session)
 
+    async def _close_proxy(app_):
+        app_["proxy"].close()
+
+    app.on_cleanup.append(_close_proxy)
+
     async def _forward_if_remote(request: web.Request, table) -> Optional[web.Response]:
         """Proxy the raw request to the owning node (ref: forward.rs).
 

@@ -1,0 +1,54 @@
+"""Priority runtimes (ref: components/runtime priority_runtime.rs:57-100).
+
+The reference runs expensive (long-time-range) queries on a separate,
+smaller tokio runtime so they can't starve cheap queries. Same shape here:
+two thread pools; the planner's priority decision picks the pool. The low
+pool is intentionally small — expensive queries queue among themselves.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+from typing import Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class PriorityRuntime:
+    def __init__(self, high_workers: int = 4, low_workers: int = 2) -> None:
+        self._high = cf.ThreadPoolExecutor(
+            max_workers=high_workers, thread_name_prefix="query-high"
+        )
+        self._low = cf.ThreadPoolExecutor(
+            max_workers=low_workers, thread_name_prefix="query-low"
+        )
+        self.submitted_high = 0
+        self.submitted_low = 0
+        self._lock = threading.Lock()
+
+    def submit(self, priority: str, fn: Callable[[], T]) -> "cf.Future[T]":
+        pool = self._low if priority == "low" else self._high
+        with self._lock:
+            if priority == "low":
+                self.submitted_low += 1
+            else:
+                self.submitted_high += 1
+        return pool.submit(fn)
+
+    def run(self, priority: str, fn: Callable[[], T]) -> T:
+        """Run on the priority pool, blocking the caller until done.
+
+        When the caller already sits on the TARGET pool's own thread,
+        run inline instead — submitting would deadlock once the pool is
+        saturated with blocked callers.
+        """
+        name = threading.current_thread().name
+        target_prefix = "query-low" if priority == "low" else "query-high"
+        if name.startswith(target_prefix):
+            return fn()
+        return self.submit(priority, fn).result()
+
+    def shutdown(self) -> None:
+        self._high.shutdown(wait=False, cancel_futures=True)
+        self._low.shutdown(wait=False, cancel_futures=True)
