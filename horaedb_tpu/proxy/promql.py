@@ -1,0 +1,335 @@
+"""PromQL subset: parse + translate to the SQL engine
+(ref: query_frontend/src/promql/{convert,pushdown}.rs — the reference
+translates PromQL into DataFusion plans; here PromQL translates into the
+same Plan/executor pipeline SQL uses, so prom queries ride the fused
+device kernels).
+
+Supported grammar (the TSBS/dashboard workhorse subset):
+
+    expr     := agg 'by' '(' labels ')' '(' expr ')'
+              | agg '(' expr ')'
+              | func '(' selector ')'
+              | selector
+    agg      := sum | avg | min | max | count
+    func     := rate | increase | avg_over_time | min_over_time | max_over_time
+    selector := metric '{' matcher (',' matcher)* '}' [ '[' duration ']' ]
+              | metric [ '[' duration ']' ]
+    matcher  := label ('=' | '!=') 'value'
+
+Semantics notes:
+- the metric name maps to a table; its single DOUBLE field (or a column
+  literally named ``value``) is the sample value, the timestamp key is
+  the sample time — exactly the shape OpenTSDB/Influx ingestion creates;
+- ``rate``/``increase`` approximate Prometheus by (max-min) per step
+  bucket (no counter-reset correction) — documented divergence;
+- range queries evaluate per aligned ``step`` bucket; instant queries use
+  a 5m lookback window.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..engine.options import parse_duration_ms
+
+AGG_FUNCS = {"sum", "avg", "min", "max", "count"}
+RANGE_FUNCS = {"rate", "increase", "avg_over_time", "min_over_time", "max_over_time"}
+
+
+class PromQLError(ValueError):
+    pass
+
+
+@dataclass
+class PromQuery:
+    metric: str
+    matchers: list[tuple[str, str, str]] = field(default_factory=list)  # (label, op, value)
+    range_ms: Optional[int] = None
+    func: Optional[str] = None  # RANGE_FUNCS
+    agg: Optional[str] = None  # AGG_FUNCS
+    by_labels: Optional[list[str]] = None  # None = per-series
+
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:.]*"
+_TOKENS = re.compile(
+    rf"""\s*(?:
+      (?P<name>{_NAME})
+    | (?P<dur>\d+(?:ms|s|m|h|d))
+    | (?P<string>'(?:[^'])*'|"(?:[^"])*")
+    | (?P<op>!=|=~|!~|[={{}}()\[\],])
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(q: str):
+    out, i = [], 0
+    while i < len(q):
+        m = _TOKENS.match(q, i)
+        if not m:
+            if q[i:].strip() == "":
+                break
+            raise PromQLError(f"unexpected character {q[i]!r} at {i}")
+        if m.lastgroup:
+            out.append((m.lastgroup, m.group().strip()))
+        i = m.end()
+    return out
+
+
+class _Parser:
+    def __init__(self, q: str) -> None:
+        self.q = q
+        self.toks = _tokenize(q)
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else (None, None)
+
+    def next(self):
+        t = self.peek()
+        if t[0] is None:
+            raise PromQLError(f"unexpected end of query: {self.q!r}")
+        self.i += 1
+        return t
+
+    def expect(self, text: str):
+        kind, tok = self.next()
+        if tok != text:
+            raise PromQLError(f"expected {text!r}, found {tok!r} in {self.q!r}")
+
+    def parse(self) -> PromQuery:
+        pq = self.expr()
+        if self.peek()[0] is not None:
+            raise PromQLError(f"trailing input after query: {self.q!r}")
+        return pq
+
+    def expr(self) -> PromQuery:
+        kind, tok = self.peek()
+        if kind == "name" and tok in AGG_FUNCS:
+            self.next()
+            by = None
+            k2, t2 = self.peek()
+            if k2 == "name" and t2 == "by":
+                self.next()
+                self.expect("(")
+                by = [self._ident()]
+                while self.peek()[1] == ",":
+                    self.next()
+                    by.append(self._ident())
+                self.expect(")")
+            self.expect("(")
+            inner = self.expr()
+            self.expect(")")
+            if inner.agg is not None:
+                raise PromQLError("nested aggregations are not supported")
+            inner.agg = tok
+            inner.by_labels = by
+            return inner
+        if kind == "name" and tok in RANGE_FUNCS:
+            self.next()
+            self.expect("(")
+            inner = self.selector()
+            self.expect(")")
+            if tok in ("rate", "increase") and inner.range_ms is None:
+                raise PromQLError(f"{tok}() requires a range selector like [5m]")
+            inner.func = tok
+            return inner
+        return self.selector()
+
+    def _ident(self) -> str:
+        kind, tok = self.next()
+        if kind != "name":
+            raise PromQLError(f"expected identifier, found {tok!r}")
+        return tok
+
+    def selector(self) -> PromQuery:
+        metric = self._ident()
+        if metric in AGG_FUNCS or metric in RANGE_FUNCS:
+            raise PromQLError(f"{metric!r} used as a metric name")
+        pq = PromQuery(metric=metric)
+        if self.peek()[1] == "{":
+            self.next()
+            while True:
+                label = self._ident()
+                kind, op = self.next()
+                if op not in ("=", "!="):
+                    raise PromQLError(f"unsupported matcher op {op!r} (=~/!~ not supported)")
+                skind, sval = self.next()
+                if skind != "string":
+                    raise PromQLError(f"matcher value must be quoted: {sval!r}")
+                pq.matchers.append((label, op, sval[1:-1]))
+                kind, tok = self.next()
+                if tok == "}":
+                    break
+                if tok != ",":
+                    raise PromQLError(f"expected ',' or '}}', found {tok!r}")
+        if self.peek()[1] == "[":
+            self.next()
+            kind, dur = self.next()
+            if kind != "dur":
+                raise PromQLError(f"expected a duration like 5m, found {dur!r}")
+            pq.range_ms = parse_duration_ms(dur)
+            self.expect("]")
+        return pq
+
+
+def parse_promql(query: str) -> PromQuery:
+    return _Parser(query).parse()
+
+
+# ---- evaluation ---------------------------------------------------------
+
+
+def _value_column(schema) -> str:
+    if schema.has_column("value"):
+        return "value"
+    fields = [schema.columns[i] for i in schema.field_indexes]
+    doubles = [c.name for c in fields if c.kind.value in ("double", "float")]
+    if len(doubles) == 1:
+        return doubles[0]
+    raise PromQLError(
+        f"metric table needs a 'value' column or exactly one double field; "
+        f"found {doubles}"
+    )
+
+
+_QUOTE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _q(name: str) -> str:
+    return name if _QUOTE.match(name) else f'"{name}"'
+
+
+def evaluate_range(
+    conn,
+    pq: PromQuery,
+    start_ms: int,
+    end_ms: int,
+    step_ms: int,
+) -> list[dict]:
+    """-> prom 'matrix' result list for [start, end] at step resolution."""
+    table = conn.catalog.open(pq.metric)
+    if table is None:
+        return []
+    schema = table.schema
+    value_col = _value_column(schema)
+    tag_names = list(schema.tag_names)
+
+    for label, _, _ in pq.matchers:
+        if label not in tag_names:
+            raise PromQLError(f"unknown label {label!r} on metric {pq.metric!r}")
+    # Stage 1 (SQL, device kernels): per-SERIES temporal aggregation per
+    # step bucket — always at full tag granularity, exactly prom's model.
+    # Stage 2 (host, tiny): cross-series combine onto the by-labels.
+    if pq.by_labels is not None:
+        out_labels = list(pq.by_labels)
+    elif pq.agg is not None:
+        out_labels = []  # bare sum(...)/avg(...) collapses every label
+    else:
+        out_labels = tag_names
+    for lbl in out_labels:
+        if lbl not in tag_names:
+            raise PromQLError(f"unknown grouping label {lbl!r}")
+    group_labels = tag_names  # stage-1 grouping
+
+    # Inner temporal aggregation per step bucket.
+    func = pq.func
+    agg = pq.agg
+    if func in ("rate", "increase"):
+        sel = f"min({_q(value_col)}) AS lo, max({_q(value_col)}) AS hi"
+    elif func == "min_over_time":
+        sel = f"min({_q(value_col)}) AS v"
+    elif func == "max_over_time":
+        sel = f"max({_q(value_col)}) AS v"
+    else:  # raw selector / avg_over_time: average within the bucket
+        sel = f"avg({_q(value_col)}) AS v"
+
+    where = [f"{_q(schema.timestamp_name)} >= {start_ms}",
+             f"{_q(schema.timestamp_name)} <= {end_ms}"]
+    for label, op, val in pq.matchers:
+        sval = val.replace("'", "''")
+        where.append(f"{_q(label)} {'=' if op == '=' else '!='} '{sval}'")
+
+    keys = [f"time_bucket({_q(schema.timestamp_name)}, '{step_ms}ms')"] + [
+        _q(l) for l in group_labels
+    ]
+    label_sel = ", ".join(_q(l) for l in group_labels)
+    sql = (
+        f"SELECT {keys[0]} AS bucket"
+        + (f", {label_sel}" if group_labels else "")
+        + f", {sel} FROM {_q(pq.metric)} WHERE {' AND '.join(where)} "
+        + f"GROUP BY {', '.join(keys)}"
+    )
+    rows = conn.execute(sql).to_pylist()
+
+    # Stage 1 results: per-series value per bucket.
+    per_series: dict[tuple, dict[int, float]] = {}
+    for r in rows:
+        key = tuple((l, r[l]) for l in group_labels)
+        bucket = r["bucket"]
+        if func in ("rate", "increase"):
+            delta = r["hi"] - r["lo"]
+            v = delta / (step_ms / 1000.0) if func == "rate" else delta
+        else:
+            v = r["v"]
+        per_series.setdefault(key, {})[bucket] = v
+
+    # Stage 2: combine series sharing the same by-label subset.
+    if agg is None and pq.by_labels is None:
+        combined = per_series
+    else:
+        combined = {}
+        bucketed: dict[tuple, dict[int, list[float]]] = {}
+        for key, points in per_series.items():
+            sub = tuple((l, v) for l, v in key if l in out_labels)
+            dst = bucketed.setdefault(sub, {})
+            for b, v in points.items():
+                dst.setdefault(b, []).append(v)
+        fn = {
+            None: lambda vs: sum(vs) / len(vs),  # bare by-less func: avg
+            "sum": sum,
+            "avg": lambda vs: sum(vs) / len(vs),
+            "min": min,
+            "max": max,
+            "count": len,
+        }[agg]
+        for sub, buckets in bucketed.items():
+            combined[sub] = {b: fn(vs) for b, vs in buckets.items()}
+
+    out = []
+    for key, points in sorted(combined.items()):
+        out.append(
+            {
+                "metric": {"__name__": pq.metric, **{l: v for l, v in key}},
+                "values": [
+                    # repr = shortest round-trip form (full precision,
+                    # like prom's Go 'g' formatting)
+                    [b / 1000.0, repr(float(points[b]))] for b in sorted(points)
+                ],
+            }
+        )
+    return out
+
+
+DEFAULT_LOOKBACK_MS = 5 * 60_000  # prom's 5m instant lookback
+
+
+def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
+    """-> prom 'vector': latest resolvable value per series in the lookback
+    (steps at scrape-ish resolution so 'latest' means latest, not a
+    whole-window average)."""
+    window = pq.range_ms or DEFAULT_LOOKBACK_MS
+    # Any range function aggregates over its WHOLE window; only a raw
+    # selector / cross-series agg walks in scrape-resolution steps to find
+    # the latest sample.
+    step = window if pq.func is not None else min(window, 60_000)
+    matrix = evaluate_range(conn, pq, time_ms - window, time_ms, step)
+    out = []
+    for series in matrix:
+        if not series["values"]:
+            continue
+        ts, val = series["values"][-1]
+        out.append({"metric": series["metric"], "value": [time_ms / 1000.0, val]})
+    return out

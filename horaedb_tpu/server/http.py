@@ -279,6 +279,86 @@ def create_app(conn: Connection, router=None) -> web.Application:
             return web.json_response({"error": str(e)}, status=422)
         return web.Response(status=204)
 
+    async def prom_query(request: web.Request) -> web.Response:
+        """Prometheus HTTP API subset (ref: /prom/v1/* routes, http.rs).
+
+        /prom/v1/query_range: query, start, end (unix seconds), step
+        /prom/v1/query:       query, time (unix seconds)
+        """
+        from ..proxy.promql import (
+            PromQLError,
+            evaluate_instant,
+            evaluate_range,
+            parse_promql,
+        )
+
+        params = dict(request.query)
+        if request.method == "POST":
+            params.update(await request.post())
+        q = params.get("query", "")
+        if not q:
+            return web.json_response(
+                {"status": "error", "error": "missing 'query'"}, status=400
+            )
+        is_range = request.path.endswith("query_range")
+        try:
+            pq = parse_promql(q)
+        except PromQLError as e:
+            return web.json_response({"status": "error", "error": str(e)}, status=400)
+        # Same routing + limiter/hotspot/metrics discipline as /sql.
+        forwarded = await _forward_if_remote(request, pq.metric)
+        if forwarded is not None:
+            return forwarded
+        try:
+            proxy._m_queries.inc()
+            proxy.limiter.check(pq.metric)
+            proxy.hotspot.record(pq.metric, False)
+
+            def run():
+                if is_range:
+                    for p in ("start", "end"):
+                        if p not in params:
+                            raise PromQLError(f"missing parameter {p!r}")
+                    start = int(float(params["start"]) * 1000)
+                    end = int(float(params["end"]) * 1000)
+                    step_raw = params.get("step", "60")
+                    from ..engine.options import parse_duration_ms
+
+                    step = (
+                        parse_duration_ms(step_raw)
+                        if not step_raw.replace(".", "").isdigit()
+                        else int(float(step_raw) * 1000)
+                    )
+                    if step <= 0:
+                        raise PromQLError("step must be positive")
+                    result = evaluate_range(conn, pq, start, end, step)
+                    return {"resultType": "matrix", "result": result}
+                import time as _time
+
+                # Prometheus defaults the evaluation time to "now".
+                t = int(float(params.get("time", _time.time())) * 1000)
+                result = evaluate_instant(conn, pq, t)
+                return {"resultType": "vector", "result": result}
+
+            data = await asyncio.get_running_loop().run_in_executor(None, run)
+        except BlockedError as e:
+            proxy._m_errors.inc()
+            return web.json_response({"status": "error", "error": str(e)}, status=403)
+        except (PromQLError, KeyError, ValueError) as e:
+            proxy._m_errors.inc()
+            return web.json_response(
+                {"status": "error", "error": str(e)}, status=400
+            )
+        except Exception as e:
+            proxy._m_errors.inc()
+            return web.json_response(
+                {"status": "error", "error": str(e)}, status=422
+            )
+        return web.Response(
+            text=_dumps({"status": "success", "data": data}),
+            content_type="application/json",
+        )
+
     # ---- observability -------------------------------------------------
     async def metrics(request: web.Request) -> web.Response:
         return web.Response(text=REGISTRY.expose(), content_type="text/plain")
@@ -370,6 +450,10 @@ def create_app(conn: Connection, router=None) -> web.Application:
     app.router.add_post("/write", write)
     app.router.add_post("/influxdb/v1/write", influx_write)
     app.router.add_post("/opentsdb/api/put", opentsdb_put)
+    app.router.add_get("/prom/v1/query_range", prom_query)
+    app.router.add_post("/prom/v1/query_range", prom_query)
+    app.router.add_get("/prom/v1/query", prom_query)
+    app.router.add_post("/prom/v1/query", prom_query)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/health", health)
     app.router.add_get("/route/{table}", route)

@@ -1,0 +1,151 @@
+"""horaectl — admin CLI over the server's HTTP API
+(ref: the horaectl Rust CLI: cluster list/diagnose/query ops against the
+admin HTTP surface, horaectl/src/).
+
+    python -m horaedb_tpu.tools.ctl [--endpoint HOST:PORT] COMMAND
+
+Commands:
+    tables                  per-table storage metrics
+    query SQL               run a statement, print rows as a table
+    route TABLE             show table routing
+    block TABLE [...]       add tables to the limiter block-list
+    unblock TABLE [...]     remove tables from the block-list
+    metrics                 raw Prometheus metrics
+    config                  server config dump
+    hotspot                 hottest tables by reads/writes
+    diagnose                health + config + table summary in one shot
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+DEFAULT_ENDPOINT = "127.0.0.1:5440"
+
+
+class CtlError(RuntimeError):
+    pass
+
+
+def _get(endpoint: str, path: str) -> str:
+    try:
+        with urllib.request.urlopen(f"http://{endpoint}{path}", timeout=10) as r:
+            return r.read().decode()
+    except urllib.error.URLError as e:
+        raise CtlError(f"GET {path} failed: {e}") from None
+
+
+def _post(endpoint: str, path: str, payload: dict, method: str = "POST") -> str:
+    req = urllib.request.Request(
+        f"http://{endpoint}{path}",
+        json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+        method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.read().decode()
+    except urllib.error.HTTPError as e:
+        body = e.read().decode()
+        raise CtlError(f"{path} -> {e.code}: {body}") from None
+    except urllib.error.URLError as e:
+        raise CtlError(f"POST {path} failed: {e}") from None
+
+
+def _print_rows(rows: list[dict]) -> None:
+    if not rows:
+        print("(empty)")
+        return
+    cols = list(rows[0].keys())
+    widths = {
+        c: max(len(c), *(len(str(r.get(c))) for r in rows)) for c in cols
+    }
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    print("  ".join("-" * widths[c] for c in cols))
+    for r in rows:
+        print("  ".join(str(r.get(c)).ljust(widths[c]) for c in cols))
+
+
+def cmd_tables(ep: str, args) -> None:
+    data = json.loads(_get(ep, "/debug/tables"))
+    rows = [
+        {"table": name, **{k: v for k, v in m.items() if k != "table"}}
+        for name, m in sorted(data.items())
+    ]
+    _print_rows(rows)
+
+
+def cmd_query(ep: str, args) -> None:
+    out = json.loads(_post(ep, "/sql", {"query": args.sql}))
+    if "rows" in out:
+        _print_rows(out["rows"])
+    else:
+        print(out)
+
+
+def cmd_route(ep: str, args) -> None:
+    print(_get(ep, f"/route/{args.table}"))
+
+
+def cmd_block(ep: str, args) -> None:
+    print(_post(ep, "/admin/block", {"tables": args.tables}))
+
+
+def cmd_unblock(ep: str, args) -> None:
+    print(_post(ep, "/admin/block", {"tables": args.tables}, method="DELETE"))
+
+
+def cmd_metrics(ep: str, args) -> None:
+    print(_get(ep, "/metrics"), end="")
+
+
+def cmd_config(ep: str, args) -> None:
+    print(_get(ep, "/debug/config"))
+
+
+def cmd_hotspot(ep: str, args) -> None:
+    print(_get(ep, "/debug/hotspot"))
+
+
+def cmd_diagnose(ep: str, args) -> None:
+    print("health:  ", _get(ep, "/health").strip())
+    print("config:  ", _get(ep, "/debug/config").strip())
+    data = json.loads(_get(ep, "/debug/tables"))
+    print(f"tables:   {len(data)}")
+    for name, m in sorted(data.items()):
+        print(f"  {name}: {m}")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="horaectl", description=__doc__)
+    p.add_argument("--endpoint", default=DEFAULT_ENDPOINT)
+    sub = p.add_subparsers(dest="command", required=True)
+    sub.add_parser("tables")
+    q = sub.add_parser("query")
+    q.add_argument("sql")
+    r = sub.add_parser("route")
+    r.add_argument("table")
+    b = sub.add_parser("block")
+    b.add_argument("tables", nargs="+")
+    u = sub.add_parser("unblock")
+    u.add_argument("tables", nargs="+")
+    sub.add_parser("metrics")
+    sub.add_parser("config")
+    sub.add_parser("hotspot")
+    sub.add_parser("diagnose")
+    args = p.parse_args(argv)
+    handler = globals()[f"cmd_{args.command}"]
+    try:
+        handler(args.endpoint, args)
+    except CtlError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,162 @@
+"""PromQL subset tests: parser + translation + HTTP endpoint
+(ref model: query_frontend promql tests)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import horaedb_tpu
+from horaedb_tpu.proxy.promql import (
+    PromQLError,
+    evaluate_instant,
+    evaluate_range,
+    parse_promql,
+)
+from horaedb_tpu.server import create_app
+
+MIN = 60_000
+
+
+class TestParser:
+    def test_selector_with_matchers(self):
+        pq = parse_promql('cpu{host="h1", region!="west"}')
+        assert pq.metric == "cpu"
+        assert pq.matchers == [("host", "=", "h1"), ("region", "!=", "west")]
+        assert pq.func is None and pq.agg is None
+
+    def test_range_func(self):
+        pq = parse_promql('rate(requests{host="a"}[5m])')
+        assert pq.func == "rate" and pq.range_ms == 5 * MIN
+
+    def test_agg_by(self):
+        pq = parse_promql('sum by (host) (rate(cpu[1m]))')
+        assert pq.agg == "sum" and pq.by_labels == ["host"] and pq.func == "rate"
+
+    def test_agg_without_by(self):
+        pq = parse_promql("avg(cpu)")
+        assert pq.agg == "avg" and pq.by_labels is None
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "rate(cpu)",  # range required
+            'cpu{host=~"h.*"}',  # regex matchers unsupported
+            "sum(avg(cpu))",  # nested agg
+            "cpu{host=h1}",  # unquoted value
+            "cpu} garbage",
+        ],
+    )
+    def test_errors(self, bad):
+        with pytest.raises(PromQLError):
+            parse_promql(bad)
+
+
+@pytest.fixture()
+def db():
+    conn = horaedb_tpu.connect(None)
+    conn.execute(
+        "CREATE TABLE cpu (host string TAG, region string TAG, "
+        "value double NOT NULL, ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+    )
+    rows = []
+    for minute in range(4):
+        for host, region, base in (("h1", "e", 10.0), ("h2", "e", 20.0), ("h3", "w", 40.0)):
+            rows.append(f"('{host}', '{region}', {base + minute}, {minute * MIN})")
+    conn.execute(f"INSERT INTO cpu (host, region, value, ts) VALUES {', '.join(rows)}")
+    yield conn
+    conn.close()
+
+
+class TestEvaluation:
+    def test_raw_selector_matrix(self, db):
+        out = evaluate_range(db, parse_promql("cpu"), 0, 4 * MIN, MIN)
+        assert len(out) == 3  # one series per (host, region)
+        h1 = next(s for s in out if s["metric"]["host"] == "h1")
+        assert h1["metric"]["__name__"] == "cpu"
+        assert [v for _, v in h1["values"]] == ["10.0", "11.0", "12.0", "13.0"]
+
+    def test_matcher_filters_series(self, db):
+        out = evaluate_range(db, parse_promql('cpu{region="e"}'), 0, 4 * MIN, MIN)
+        assert {s["metric"]["host"] for s in out} == {"h1", "h2"}
+
+    def test_sum_by_region(self, db):
+        out = evaluate_range(
+            db, parse_promql("sum by (region) (cpu)"), 0, 4 * MIN, MIN
+        )
+        by_region = {s["metric"]["region"]: s["values"] for s in out}
+        # east = h1 + h2 = 30 + 2*minute
+        assert [v for _, v in by_region["e"]] == ["30.0", "32.0", "34.0", "36.0"]
+        assert [v for _, v in by_region["w"]] == ["40.0", "41.0", "42.0", "43.0"]
+
+    def test_global_avg(self, db):
+        out = evaluate_range(db, parse_promql("avg(cpu)"), 0, 4 * MIN, MIN)
+        assert len(out) == 1
+        # values serialize at %g (6 sig digits)
+        assert float(out[0]["values"][0][1]) == pytest.approx((10 + 20 + 40) / 3, rel=1e-4)
+
+    def test_increase_and_rate(self, db):
+        # per-series increase within each 2-minute bucket: values rise by 1
+        out = evaluate_range(
+            db, parse_promql('increase(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
+        )
+        assert [v for _, v in out[0]["values"]] == ["1.0", "1.0"]
+        out = evaluate_range(
+            db, parse_promql('rate(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
+        )
+        assert [v for _, v in out[0]["values"]] == [repr(1/120), repr(1/120)]
+
+    def test_instant_vector(self, db):
+        out = evaluate_instant(db, parse_promql('cpu{host="h2"}'), 4 * MIN)
+        assert len(out) == 1
+        assert out[0]["value"][1] == "23.0"  # latest sample in lookback
+
+    def test_unknown_metric_empty(self, db):
+        assert evaluate_range(db, parse_promql("nope"), 0, MIN, MIN) == []
+
+    def test_unknown_label_rejected(self, db):
+        with pytest.raises(PromQLError, match="unknown label"):
+            evaluate_range(db, parse_promql('cpu{bogus="x"}'), 0, MIN, MIN)
+
+
+class TestHttpEndpoint:
+    def test_query_range_and_instant(self):
+        async def body(client):
+            await client.post("/sql", json={"query": (
+                "CREATE TABLE m (host string TAG, value double NOT NULL, "
+                "ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+            )})
+            await client.post("/sql", json={"query": (
+                "INSERT INTO m (host, value, ts) VALUES "
+                "('a', 1.0, 0), ('a', 3.0, 60000), ('b', 10.0, 0)"
+            )})
+            resp = await client.get(
+                "/prom/v1/query_range",
+                params={"query": 'm{host="a"}', "start": "0", "end": "120", "step": "60"},
+            )
+            body_ = await resp.json()
+            assert body_["status"] == "success"
+            assert body_["data"]["resultType"] == "matrix"
+            vals = body_["data"]["result"][0]["values"]
+            assert [v for _, v in vals] == ["1.0", "3.0"]
+
+            resp = await client.get(
+                "/prom/v1/query", params={"query": "sum(m)", "time": "120"}
+            )
+            body_ = await resp.json()
+            assert body_["data"]["resultType"] == "vector"
+
+            resp = await client.get("/prom/v1/query_range", params={"query": "rate(m)"})
+            assert resp.status == 400  # range selector required
+
+        async def runner():
+            conn = horaedb_tpu.connect(None)
+            client = TestClient(TestServer(create_app(conn)))
+            await client.start_server()
+            try:
+                await body(client)
+            finally:
+                await client.close()
+                conn.close()
+
+        asyncio.run(runner())
