@@ -179,7 +179,7 @@ def main() -> None:
     ex = db.interpreters.executor
     orig_cap, orig_cached = ex._device_capable, ex._try_cached_agg
     ex._device_capable = lambda plan, rows: False
-    ex._try_cached_agg = lambda plan, table: None
+    ex._try_cached_agg = lambda plan, table, m: None
     host_s, host_rows = time_query(db, sql)
     ex._device_capable = orig_cap
     ex._try_cached_agg = orig_cached

@@ -92,7 +92,25 @@ class Instance:
             self._tables[key] = table
             if self.wal is not None:
                 self._replay_wal(table)
-            return table
+        # Outside the instance lock: sweeping walks the table's store
+        # prefix and must not serialize other table opens behind it.
+        self._sweep_orphan_ssts(table)
+        return table
+
+    def _sweep_orphan_ssts(self, table: TableData) -> None:
+        """Delete SST objects not tracked by the manifest.
+
+        A crash between SST write and manifest append leaves orphans
+        (flush is crash-safe BECAUSE it writes data before metadata); they
+        are never read, but without a sweep they leak storage forever.
+        Runs at open, when the manifest is authoritative and no concurrent
+        flush can be mid-write for this table.
+        """
+        prefix = f"{table.space_id}/{table.table_id}/"
+        tracked = {h.path for h in table.version.levels.all_files()}
+        for path in list(self.store.list(prefix)):
+            if path.endswith(".sst") and path not in tracked:
+                self.store.delete(path)
 
     def close_table(self, table: TableData, flush: bool = True) -> None:
         # Lock order is always serial_lock -> _lock (flush_table takes the

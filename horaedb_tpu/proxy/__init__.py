@@ -14,7 +14,7 @@ import itertools
 import logging
 import threading
 import time
-from collections import Counter as TallyCounter
+from collections import Counter as TallyCounter, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -94,6 +94,9 @@ class Proxy:
         # Expensive (long-range) queries run on the small low-priority pool
         # (ref: SelectInterpreter spawning on the priority runtime).
         self.runtime = PriorityRuntime()
+        # Recent per-query metric trees (ref: trace_metric; surfaced at
+        # /debug/queries).
+        self.recent_queries: deque = deque(maxlen=64)
         self._req_ids = itertools.count(1)
         self._m_queries = REGISTRY.counter("horaedb_queries_total", "SQL statements handled")
         self._m_errors = REGISTRY.counter("horaedb_query_errors_total", "SQL statements failed")
@@ -114,10 +117,19 @@ class Proxy:
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))
             if isinstance(plan, QueryPlan):
-                return self.runtime.run(
+                out = self.runtime.run(
                     plan.priority.value,
                     lambda: self.conn.interpreters.execute(plan),
                 )
+                self.recent_queries.append(
+                    {
+                        "request_id": ctx.request_id,
+                        "sql": sql[:200],
+                        "priority": plan.priority.value,
+                        **(getattr(out, "metrics", None) or {}),
+                    }
+                )
+                return out
             return self.conn.interpreters.execute(plan)
         except Exception:
             self._m_errors.inc()

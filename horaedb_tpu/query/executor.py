@@ -42,6 +42,8 @@ class ResultSet:
     names: list[str]
     columns: list[np.ndarray]
     nulls: dict[str, np.ndarray] | None = None
+    # per-request metric tree, attached by the executor (ref: trace_metric)
+    metrics: dict | None = None
 
     @property
     def num_rows(self) -> int:
@@ -199,25 +201,55 @@ class Executor:
         # observability: which path ran last
         # ("device-cached" | "device" | "host")
         self.last_path: str = ""
+        # per-request metric tree (ref: trace_metric MetricsCollector —
+        # stage timings threaded through the read path)
+        self.last_metrics: dict = {}
         from .scan_cache import ScanCache
 
         self.scan_cache = ScanCache()
 
     def execute(self, plan: QueryPlan, table) -> ResultSet:
+        import time as _time
+
+        t_start = _time.perf_counter()
+        # Per-call dict threaded through the stages and attached to the
+        # RESULT — concurrent queries never share mutable metric state.
+        m: dict = {"table": plan.table}
         if plan.is_aggregate:
-            cached = self._try_cached_agg(plan, table)
+            cached = self._try_cached_agg(plan, table, m)
             if cached is not None:
-                self.last_path = "device-cached"
-                return cached
+                path = "device-cached"
+                return self._finish_metrics(m, t_start, path, cached)
+        t_scan = _time.perf_counter()
         projection = self._projection(plan)
         rows = table.read(plan.predicate, projection=projection)
+        m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
+        m["rows_scanned"] = len(rows)
         if plan.is_aggregate and self._device_capable(plan, rows):
-            self.last_path = "device"
-            return self._execute_agg_device(plan, rows)
-        self.last_path = "host"
-        if plan.is_aggregate:
-            return self._execute_agg_host(plan, rows)
-        return self._execute_projection(plan, rows)
+            path = "device"
+            out = self._execute_agg_device(plan, rows)
+        elif plan.is_aggregate:
+            path = "host"
+            out = self._execute_agg_host(plan, rows)
+        else:
+            path = "host"
+            out = self._execute_projection(plan, rows)
+        return self._finish_metrics(m, t_start, path, out)
+
+    def _finish_metrics(
+        self, m: dict, t_start: float, path: str, out: ResultSet
+    ) -> ResultSet:
+        import time as _time
+
+        m["path"] = path
+        m["result_rows"] = out.num_rows
+        m["total_ms"] = round((_time.perf_counter() - t_start) * 1000, 3)
+        out.metrics = m
+        # Observability conveniences; atomic rebinds (read-only snapshots
+        # for tests/dashboards — per-request truth travels on the result).
+        self.last_path = path
+        self.last_metrics = m
+        return out
 
     # ---- common ----------------------------------------------------------
     def _projection(self, plan: QueryPlan) -> Optional[list[str]]:
@@ -416,7 +448,7 @@ class Executor:
         return _order_and_limit(result, plan)
 
     # ---- device-cached path (HBM-resident columns) ---------------------------
-    def _try_cached_agg(self, plan: QueryPlan, table) -> Optional[ResultSet]:
+    def _try_cached_agg(self, plan: QueryPlan, table, m: dict) -> Optional[ResultSet]:
         """Serve an aggregate from device-resident scan state, or None.
 
         Ships only O(series)+O(1) data per query; see query/scan_cache.py.
@@ -449,7 +481,7 @@ class Executor:
         filter_cols = [f[0] for f in device_filters]
         value_names = list(dict.fromkeys(agg_cols + filter_cols))
 
-        entry = self.scan_cache.get(
+        entry, built = self.scan_cache.get(
             table, value_names, read_rows=lambda: table.read(Predicate.all_time())
         )
         if entry is None:
@@ -458,6 +490,10 @@ class Executor:
         for c in agg_cols:
             if not entry.rows.valid_mask(c).all():
                 return None
+        # Eligibility confirmed: only now record cache facts (a bail-out
+        # above must not leave 'cache' lying in a host-path metric tree).
+        m["cache"] = "build" if built else "hit"
+        m["rows_scanned"] = entry.n_valid
 
         # Series-level small arrays (one row per unique series); validity
         # slices carry over so NULL-tag semantics match the host path.

@@ -130,6 +130,12 @@ class InterpreterFactory:
                 f"  Analyzed: path={self.executor.last_path} "
                 f"rows={out.num_rows} elapsed={elapsed:.2f}ms"
             )
+            m = out.metrics or {}
+            detail = ", ".join(
+                f"{k}={v}" for k, v in m.items() if k not in ("table", "path")
+            )
+            if detail:
+                lines.append(f"  Metrics: {detail}")
         return ResultSet(
             ["plan"], [np.array(lines, dtype=object)]
         )

@@ -77,11 +77,11 @@ class ScanCache:
         table,
         value_columns: list[str],
         read_rows,
-    ) -> Optional[CachedTableScan]:
-        """Cached scan state for ``table``, building/refreshing as needed.
+    ) -> tuple[Optional[CachedTableScan], bool]:
+        """(cached scan state, was_built_this_call) for ``table``.
 
         ``read_rows()`` materializes the full-table merged rows on miss.
-        Returns None when the table's shape doesn't fit the cached-kernel
+        Entry is None when the table's shape doesn't fit the cached-kernel
         contract (span overflow, empty table), or when the data hasn't been
         stable long enough to justify a build.
         """
@@ -91,31 +91,31 @@ class ScanCache:
             if entry is not None and entry.fingerprint == fp:
                 if all(c in entry.value_cols_dev for c in value_columns):
                     self.hits += 1
-                    return entry
+                    return entry, False
                 # same data, new columns: extend the entry in place
                 self._extend(entry, value_columns)
                 self.hits += 1
-                return entry
+                return entry, False
             if self._candidate.get(table.name) != fp:
                 # first sighting of this table state: don't build yet
                 self._candidate[table.name] = fp
                 self.misses += 1
-                return None
+                return None, False
         rows = read_rows()
         n = len(rows)
         if n == 0:
-            return None
+            return None, False
         ts = rows.timestamps
         min_ts, max_ts = int(ts.min()), int(ts.max())
         if max_ts - min_ts >= _I32_MAX:
-            return None
+            return None, False
         entry = self._build(fp, rows, min_ts, max_ts, value_columns)
         with self._lock:
             self.misses += 1
             if table.name not in self._entries and len(self._entries) >= self.max_entries:
                 self._entries.pop(next(iter(self._entries)))
             self._entries[table.name] = entry
-        return entry
+        return entry, True
 
     def _build(
         self, fp, rows: RowGroup, min_ts: int, max_ts: int, value_columns: list[str]

@@ -424,6 +424,12 @@ def create_app(conn: Connection, router=None) -> web.Application:
     async def debug_hotspot(request: web.Request) -> web.Response:
         return web.json_response(proxy.hotspot.top())
 
+    async def debug_queries(request: web.Request) -> web.Response:
+        """Recent per-query metric trees (ref: trace_metric surfaces)."""
+        return web.Response(
+            text=_dumps(list(proxy.recent_queries)), content_type="application/json"
+        )
+
     async def slow_threshold(request: web.Request) -> web.Response:
         try:
             proxy.slow_threshold_s = float(request.match_info["seconds"])
@@ -460,6 +466,7 @@ def create_app(conn: Connection, router=None) -> web.Application:
     app.router.add_get("/debug/config", debug_config)
     app.router.add_get("/debug/tables", debug_tables)
     app.router.add_get("/debug/hotspot", debug_hotspot)
+    app.router.add_get("/debug/queries", debug_queries)
     app.router.add_put("/debug/slow_threshold/{seconds}", slow_threshold)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)

@@ -125,8 +125,14 @@ class LocalDiskStore(ObjectStore):
             pass
 
     def list(self, prefix: str = "") -> Iterator[str]:
+        # Start the walk at the deepest directory the prefix pins down —
+        # a per-table prefix must not traverse the whole store.
+        base_rel = prefix if prefix.endswith("/") else os.path.dirname(prefix)
+        start = os.path.join(self.root, base_rel.rstrip("/")) if base_rel else self.root
+        if not os.path.isdir(start):
+            return iter([])
         out = []
-        for dirpath, _dirs, files in os.walk(self.root):
+        for dirpath, _dirs, files in os.walk(start):
             for name in files:
                 if name.endswith(".tmp"):
                     continue

@@ -1,0 +1,89 @@
+"""Trace metrics + orphan sweep tests."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+import horaedb_tpu
+from horaedb_tpu.server import create_app
+
+
+class TestQueryMetrics:
+    def test_executor_records_stages(self):
+        db = horaedb_tpu.connect(None)
+        db.execute("CREATE TABLE t (h string TAG, v double, ts timestamp KEY)")
+        db.execute("INSERT INTO t (h, v, ts) VALUES ('a', 1.0, 1), ('b', 2.0, 2)")
+        db.execute("SELECT h, sum(v) FROM t GROUP BY h")
+        m = db.interpreters.executor.last_metrics
+        assert m["table"] == "t" and m["result_rows"] == 2
+        assert m["path"].startswith("device") or m["path"] == "host"
+        assert m["total_ms"] > 0
+        db.close()
+
+    def test_cache_hit_recorded(self):
+        db = horaedb_tpu.connect(None)
+        db.execute("CREATE TABLE t (h string TAG, v double, ts timestamp KEY)")
+        db.execute("INSERT INTO t (h, v, ts) VALUES ('a', 1.0, 1)")
+        sql = "SELECT count(*) AS c FROM t"
+        db.execute(sql)  # candidate
+        db.execute(sql)  # build
+        assert db.interpreters.executor.last_metrics.get("cache") == "build"
+        db.execute(sql)  # hit
+        assert db.interpreters.executor.last_metrics.get("cache") == "hit"
+        db.close()
+
+    def test_debug_queries_endpoint_and_explain_metrics(self):
+        async def body(client):
+            await client.post("/sql", json={"query": "CREATE TABLE t (h string TAG, v double, ts timestamp KEY)"})
+            await client.post("/sql", json={"query": "INSERT INTO t (h, v, ts) VALUES ('a', 1.0, 1)"})
+            await client.post("/sql", json={"query": "SELECT h, sum(v) FROM t GROUP BY h"})
+            recent = await (await client.get("/debug/queries")).json()
+            assert recent and recent[-1]["table"] == "t"
+            assert "total_ms" in recent[-1] and "sql" in recent[-1]
+            out = await client.post(
+                "/sql", json={"query": "EXPLAIN ANALYZE SELECT count(*) FROM t"}
+            )
+            plan_lines = [r["plan"] for r in (await out.json())["rows"]]
+            assert any(l.strip().startswith("Metrics:") for l in plan_lines)
+
+        async def runner():
+            conn = horaedb_tpu.connect(None)
+            client = TestClient(TestServer(create_app(conn)))
+            await client.start_server()
+            try:
+                await body(client)
+            finally:
+                await client.close()
+                conn.close()
+
+        asyncio.run(runner())
+
+
+class TestOrphanSweep:
+    def test_untracked_sst_removed_at_open(self, tmp_path):
+        from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+        from horaedb_tpu.engine.instance import Instance
+        from horaedb_tpu.utils.object_store import LocalDiskStore
+
+        store = LocalDiskStore(str(tmp_path))
+        schema = Schema.build(
+            [ColumnSchema("h", DatumKind.STRING, is_tag=True),
+             ColumnSchema("v", DatumKind.DOUBLE),
+             ColumnSchema("ts", DatumKind.TIMESTAMP)],
+            timestamp_column="ts",
+        )
+        inst = Instance(store)
+        t = inst.create_table(0, 1, "t", schema)
+        inst.write(t, RowGroup.from_rows(schema, [{"h": "a", "v": 1.0, "ts": 1}]))
+        inst.flush_table(t)
+        tracked = {h.path for h in t.version.levels.all_files()}
+        # crash artifact: an SST that never made the manifest
+        store.put("0/1/999.sst", b"garbage")
+
+        inst2 = Instance(store)
+        t2 = inst2.open_table(0, 1, "t")
+        assert not store.exists("0/1/999.sst")  # swept
+        for p in tracked:
+            assert store.exists(p)  # real data untouched
+        assert len(inst2.read(t2)) == 1

@@ -232,7 +232,7 @@ class TestDeviceHostEquivalence:
         ex = db.interpreters.executor
         orig_cap, orig_cached = ex._device_capable, ex._try_cached_agg
         ex._device_capable = lambda plan, rows: False
-        ex._try_cached_agg = lambda plan, table: None
+        ex._try_cached_agg = lambda plan, table, m: None
         host = q(db, sql)
         assert db.interpreters.executor.last_path == "host"
         ex._device_capable = orig_cap
