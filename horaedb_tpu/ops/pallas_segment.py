@@ -1,0 +1,109 @@
+"""Pallas TPU kernel: segment reduction as one-hot MXU matmuls.
+
+``jax.ops.segment_sum`` lowers to scatter-add, which runs on the VPU and
+serializes on segment collisions. For the scan/agg shape — few thousand
+live segments, millions of rows — the MXU formulation is the TPU-native
+alternative (SURVEY §7 / pallas guide "quantization kernels" pattern):
+
+    onehot[i, s] = (seg_ids[i] == s) & mask[i]          # (TILE, S) f32
+    sums   += values_tile @ onehot                      # (F, S) MXU matmul
+    counts += ones @ onehot                             # row of the same
+
+The grid walks row tiles; the output block is constant across steps and
+accumulates in VMEM (initialized on the first step). Segments are padded
+to a multiple of 128 (lane width), rows to the f32 tile height.
+
+Status: validated against jax.ops.segment_sum in INTERPRET MODE only (the
+chip tunnel was down all round; the native Mosaic lowering has NOT run).
+Standalone op in round 1: the executor keeps XLA's segment ops until the
+scatter-vs-matmul tradeoff is profiled on a real chip — measure, don't
+assume, and expect Mosaic to demand layout tweaks interpret mode forgives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+ROW_TILE = 1024  # rows per grid step (multiple of the 8-row f32 sublane)
+
+
+def _kernel(seg_ref, mask_ref, values_ref, counts_ref, sums_ref, *, n_seg: int):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+
+    seg = seg_ref[:]  # (TILE,) int32
+    mask = mask_ref[:]  # (TILE,) bool
+    # Zero masked rows BEFORE the matmul: 0-weight in onehot does not save
+    # us from NaN/Inf in masked/padding rows (0 * NaN = NaN).
+    values = values_ref[:] * mask[None, :].astype(jnp.float32)  # (F, TILE)
+
+    # One-hot on the fly: (TILE, S). Masked/dump rows match no segment.
+    seg_col = seg[:, None]
+    seg_ids = jax.lax.broadcasted_iota(jnp.int32, (ROW_TILE, n_seg), 1)
+    onehot = ((seg_col == seg_ids) & mask[:, None]).astype(jnp.float32)
+
+    sums_ref[:] += jax.lax.dot_general(
+        values,
+        onehot,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    counts_ref[:] += jnp.sum(onehot, axis=0, keepdims=True)
+
+
+def _use_interpret() -> bool:
+    # Pallas compiles natively only on TPU (the axon plugin canonicalizes
+    # to tpu); everywhere else (tests on CPU) run the interpreter.
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+@functools.partial(jax.jit, static_argnames=("n_seg", "interpret"))
+def _segment_sum_matmul(seg_ids, mask, values, *, n_seg: int, interpret: bool):
+    """(counts f32[1, S], sums f32[F, S]) via MXU one-hot matmuls.
+
+    ``seg_ids`` int32[N], ``mask`` bool[N], ``values`` f32[F, N]; N must be
+    a multiple of ROW_TILE (ops.encoding's shape buckets are), S a multiple
+    of 128. Rows with out-of-range ids must be masked by the caller.
+    """
+    n = seg_ids.shape[0]
+    f = values.shape[0]
+    assert n % ROW_TILE == 0, f"rows {n} not a multiple of {ROW_TILE}"
+    assert n_seg % 128 == 0, f"segments {n_seg} not a multiple of 128"
+    grid = (n // ROW_TILE,)
+    return pl.pallas_call(
+        functools.partial(_kernel, n_seg=n_seg),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_TILE,), lambda i: (i,)),
+            pl.BlockSpec((ROW_TILE,), lambda i: (i,)),
+            pl.BlockSpec((f, ROW_TILE), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_seg), lambda i: (0, 0)),
+            pl.BlockSpec((f, n_seg), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n_seg), jnp.float32),
+            jax.ShapeDtypeStruct((f, n_seg), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seg_ids, mask, values)
+
+
+def segment_sum_matmul(seg_ids, mask, values, *, n_seg: int):
+    """See module docstring; interpret-mode off-TPU, native on chip."""
+    return _segment_sum_matmul(
+        seg_ids, mask, values, n_seg=n_seg, interpret=_use_interpret()
+    )
+
+
+def pad_segments(n_seg: int) -> int:
+    return ((n_seg + 127) // 128) * 128
