@@ -184,8 +184,8 @@ class RowGroup:
             {k: v[start:stop] for k, v in self.validity.items()},
         )
 
-    def sorted_by_key(self, seq: Optional[np.ndarray] = None) -> "RowGroup":
-        """Stable sort by primary key columns (ascending).
+    def key_sort_permutation(self, seq: Optional[np.ndarray] = None) -> np.ndarray:
+        """Permutation that sorts rows by primary key columns (ascending).
 
         With ``seq`` given, later sequence numbers win ties *by coming
         first* — matching the merge-iterator's sequence ordering for
@@ -196,8 +196,10 @@ class RowGroup:
             keys.append(-seq.astype(np.int64))
         for i in reversed(self.schema.primary_key_indexes):
             keys.append(self._sortable(self.schema.columns[i].name))
-        order = np.lexsort(tuple(keys))
-        return self.take(order)
+        return np.lexsort(tuple(keys))
+
+    def sorted_by_key(self, seq: Optional[np.ndarray] = None) -> "RowGroup":
+        return self.take(self.key_sort_permutation(seq=seq))
 
     def _sortable(self, name: str) -> np.ndarray:
         arr = self.columns[name]

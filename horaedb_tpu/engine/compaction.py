@@ -28,7 +28,7 @@ from ..common_types.row_group import RowGroup
 from ..common_types.time_range import TimeRange
 from ..ops import merge_dedup_permutation
 from .manifest import AddFile, MetaEdit, RemoveFile
-from .merge import dedup_sorted
+from .merge import dedup_keep_mask
 from .options import UpdateMode
 from .sst.manager import FileHandle
 from .sst.reader import SstReader
@@ -166,8 +166,18 @@ class Compactor:
         with table.serial_lock:
             self._drop_expired(result, now_ms)
             picker = make_picker(table.options.compaction_strategy)
+            # A file can land in two picked tasks (an L1 run spans several
+            # windows after ALTER shrank segment_duration). Running both
+            # would duplicate its rows across two L1 outputs and emit the
+            # RemoveFile edit twice — skip any task touching an already
+            # consumed input; the window is re-picked on the next pass.
+            consumed: set[tuple[int, int]] = set()
             for task in picker.pick(table):
+                keys = {(h.level, h.file_id) for h in task.inputs}
+                if keys & consumed:
+                    continue
                 self._run_task(task, result)
+                consumed |= keys
                 result.tasks_run += 1
         return result
 
@@ -201,11 +211,11 @@ class Compactor:
                 )
             max_seq = max(max_seq, h.meta.max_sequence)
         if not parts:
-            merged = None
+            merged, merged_seq = None, None
         else:
             rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
             seq = np.concatenate(versions)
-            merged = self._device_merge(rows, seq)
+            merged, merged_seq = self._device_merge(rows, seq)
 
         edits: list[MetaEdit] = []
         new_handles: list[FileHandle] = []
@@ -217,12 +227,23 @@ class Compactor:
                     compression=table.options.compression,
                 ),
             )
-            fid = table.alloc_file_id()
-            path = table.sst_object_path(fid)
-            meta = writer.write(path, fid, merged, max_sequence=max_seq)
-            edits.append(AddFile(1, meta, path))
-            new_handles.append(FileHandle(meta, path, 1))
-            result.rows_written += len(merged)
+            # One output per segment window. An input (an L1 run written
+            # before ALTER shrank segment_duration) may span several
+            # current windows; folding its cross-window rows into ONE
+            # output stamped with the task-wide max sequence would let a
+            # stale version beat a genuinely newer row when the other
+            # window compacts later. Splitting by window and stamping each
+            # output with the max sequence of ITS OWN rows keeps
+            # file-granularity versioning exact.
+            for w_rows, w_seq in self._split_by_window(merged, merged_seq):
+                fid = table.alloc_file_id()
+                path = table.sst_object_path(fid)
+                meta = writer.write(
+                    path, fid, w_rows, max_sequence=int(w_seq.max())
+                )
+                edits.append(AddFile(1, meta, path))
+                new_handles.append(FileHandle(meta, path, 1))
+                result.rows_written += len(w_rows)
         for h in task.inputs:
             edits.append(RemoveFile(h.level, h.file_id))
         table.manifest.append_edits(edits)
@@ -237,8 +258,31 @@ class Compactor:
         for h in table.version.levels.drain_purge_queue():
             table.store.delete(h.path)
 
-    def _device_merge(self, rows: RowGroup, seq: np.ndarray) -> RowGroup:
-        """The hot loop on device: sort + dedup permutation, host gather."""
+    def _split_by_window(
+        self, rows: RowGroup, seq: np.ndarray
+    ) -> list[tuple[RowGroup, np.ndarray]]:
+        """Bucket merged output rows by aligned segment window."""
+        seg_ms = self.table.options.segment_duration_ms
+        ts = rows.timestamps
+        if not seg_ms or len(rows) == 0:
+            return [(rows, seq)]
+        starts = (ts // seg_ms) * seg_ms
+        uniq = np.unique(starts)
+        if len(uniq) == 1:
+            return [(rows, seq)]
+        out = []
+        for s in uniq:
+            idx = np.nonzero(starts == s)[0]
+            out.append((rows.take(idx), seq[idx]))
+        return out
+
+    def _device_merge(
+        self, rows: RowGroup, seq: np.ndarray
+    ) -> tuple[RowGroup, np.ndarray]:
+        """The hot loop on device: sort + dedup permutation, host gather.
+
+        Returns the merged rows plus each surviving row's input-file
+        sequence (needed for per-window output stamping)."""
         table = self.table
         schema = rows.schema
         tsid_idx = schema.tsid_index
@@ -248,7 +292,12 @@ class Compactor:
             perm, keep = merge_dedup_permutation(
                 tsid, rows.timestamps.astype(np.int64), seq, dedup=dedup
             )
-            return rows.take(perm[keep])
+            sel = perm[keep]
+            return rows.take(sel), seq[sel]
         # Explicit primary keys (no tsid): host lexsort fallback.
-        srt = rows.sorted_by_key(seq=seq)
-        return dedup_sorted(srt) if dedup else srt
+        order = rows.key_sort_permutation(seq=seq)
+        srt, srt_seq = rows.take(order), seq[order]
+        if not dedup:
+            return srt, srt_seq
+        keep = dedup_keep_mask(srt)
+        return srt.filter(keep), srt_seq[keep]

@@ -103,14 +103,25 @@ class Instance:
         A crash between SST write and manifest append leaves orphans
         (flush is crash-safe BECAUSE it writes data before metadata); they
         are never read, but without a sweep they leak storage forever.
-        Runs at open, when the manifest is authoritative and no concurrent
-        flush can be mid-write for this table.
+
+        The table is already visible in ``_tables`` when this runs, so a
+        concurrent flush could be mid-write (SST persisted, manifest edit
+        not yet appended). Holding ``serial_lock`` excludes flushes for
+        THIS table (it is per-table, so other table opens don't serialize
+        behind the sweep), and listing the store before computing the
+        tracked set means anything written after the listing is invisible
+        to the sweep either way.
         """
         prefix = f"{table.space_id}/{table.table_id}/"
-        tracked = {h.path for h in table.version.levels.all_files()}
-        for path in list(self.store.list(prefix)):
-            if path.endswith(".sst") and path not in tracked:
-                self.store.delete(path)
+        with table.serial_lock:
+            listed = list(self.store.list(prefix))
+            levels = table.version.levels
+            # Purge-queued files are referenced (a pinned read may still
+            # hold them) — referenced, not orphaned.
+            tracked = {h.path for h in levels.all_files()} | levels.pending_purge_paths()
+            for path in listed:
+                if path.endswith(".sst") and path not in tracked:
+                    self.store.delete(path)
 
     def close_table(self, table: TableData, flush: bool = True) -> None:
         # Lock order is always serial_lock -> _lock (flush_table takes the
@@ -230,15 +241,18 @@ class Instance:
         projection: Optional[Sequence[str]] = None,
     ) -> RowGroup:
         predicate = predicate or Predicate.all_time()
-        view = table.version.pick_read_view(predicate.time_range)
-        return merge_read(
-            view,
-            table.schema,
-            predicate,
-            self.store,
-            table.options.update_mode,
-            projection=projection,
-        )
+        # The pin keeps SSTs in the view on disk even if a concurrent
+        # compaction replaces them mid-read (deferred purge, sst/manager).
+        with table.version.levels.read_pin():
+            view = table.version.pick_read_view(predicate.time_range)
+            return merge_read(
+                view,
+                table.schema,
+                predicate,
+                self.store,
+                table.options.update_mode,
+                projection=projection,
+            )
 
     # ---- maintenance ---------------------------------------------------
     def flush_table(self, table: TableData) -> FlushResult:

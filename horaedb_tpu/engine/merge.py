@@ -33,16 +33,16 @@ from .sst.reader import SstReader
 from .version import ReadView
 
 
-def dedup_sorted(rows: RowGroup) -> RowGroup:
-    """Collapse duplicate primary keys, keeping the FIRST row of each run.
+def dedup_keep_mask(rows: RowGroup) -> np.ndarray:
+    """Mask keeping the FIRST row of each primary-key run.
 
     Requires rows sorted by primary key with the winning version first
     (``RowGroup.sorted_by_key(seq=...)`` produces exactly that order).
     """
     n = len(rows)
-    if n <= 1:
-        return rows
     keep = np.ones(n, dtype=np.bool_)
+    if n <= 1:
+        return keep
     same = np.ones(n - 1, dtype=np.bool_)
     for i in rows.schema.primary_key_indexes:
         col = rows.columns[rows.schema.columns[i].name]
@@ -50,6 +50,12 @@ def dedup_sorted(rows: RowGroup) -> RowGroup:
             col = col.codes  # same RowGroup => shared vocab => codes compare
         same &= col[1:] == col[:-1]
     keep[1:] = ~same
+    return keep
+
+
+def dedup_sorted(rows: RowGroup) -> RowGroup:
+    """Collapse duplicate primary keys, keeping the FIRST row of each run."""
+    keep = dedup_keep_mask(rows)
     if keep.all():
         return rows
     return rows.filter(keep)
@@ -99,9 +105,26 @@ def merge_read(
 
     Column filters from the predicate are NOT applied — they run in the
     execution kernel AFTER dedup (an overwritten row version must not
-    resurface just because the newest version fails the filter).
+    resurface just because the newest version fails the filter). For the
+    same reason, value-filter ROW-GROUP PRUNING is disabled on dedup scans
+    spanning multiple sources: pruning a group holding the newest version
+    of a key would let an older version in another source survive dedup.
+    Time-range pruning stays on everywhere (timestamp is a key column).
     """
-    parts, versions = scan_sources(view, schema, predicate, store, projection)
+    dedup_scan = update_mode is not UpdateMode.APPEND and (
+        len(view.ssts) + len(view.memtables) > 1
+    )
+    if dedup_scan:
+        # Key-column filters stay: every version of a key shares its key
+        # values, so pruning by them can never separate versions. Only
+        # value-column filters can hide the newest version of a key.
+        key_cols = {
+            schema.columns[i].name for i in schema.primary_key_indexes
+        }
+        scan_pred = predicate.restricted_to(key_cols)
+    else:
+        scan_pred = predicate
+    parts, versions = scan_sources(view, schema, scan_pred, store, projection)
     out_schema = parts[0].schema if parts else project_schema(schema, projection)
     if not parts:
         empty = {c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in out_schema.columns}

@@ -7,10 +7,17 @@ Exactly two levels, like the reference (file.rs:66-69):
 
 ``LevelsController`` owns file handles per level, answers time-range picks
 for reads, collects TTL-expired files, and queues removed files for purge.
+
+Purge safety (ref: the reference's ref-counted FileHandles + FilePurger,
+sst/file.rs:64-113): a removed file may still be held by an in-flight read
+whose ReadView predates the removal. Removals are stamped with an epoch;
+reads pin the epoch they started at; ``drain_purge_queue`` only releases
+files removed strictly before every active read began.
 """
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from dataclasses import dataclass, field
 
@@ -38,8 +45,31 @@ class FileHandle:
 class LevelsController:
     def __init__(self) -> None:
         self._levels: list[dict[int, FileHandle]] = [{} for _ in range(MAX_LEVEL + 1)]
-        self._purge_queue: list[FileHandle] = []
+        self._purge_queue: list[tuple[int, FileHandle]] = []  # (removal epoch, handle)
+        self._epoch = 0
+        self._active_reads: dict[int, int] = {}  # start epoch -> count
         self._lock = threading.RLock()
+
+    # ---- read pinning --------------------------------------------------
+    @contextlib.contextmanager
+    def read_pin(self):
+        """Pin the current epoch for the duration of a read.
+
+        Files removed at or after the pinned epoch stay on disk until the
+        pin is released (a ReadView picked before a concurrent compaction's
+        version swap must still find its SSTs)."""
+        with self._lock:
+            epoch = self._epoch
+            self._active_reads[epoch] = self._active_reads.get(epoch, 0) + 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                n = self._active_reads[epoch] - 1
+                if n:
+                    self._active_reads[epoch] = n
+                else:
+                    del self._active_reads[epoch]
 
     # ---- mutation ------------------------------------------------------
     def add_file(self, level: int, handle: FileHandle) -> None:
@@ -50,14 +80,32 @@ class LevelsController:
 
     def remove_files(self, level: int, file_ids: list[int]) -> None:
         with self._lock:
+            stamped = False
             for fid in file_ids:
                 h = self._levels[level].pop(fid, None)
                 if h is not None:
-                    self._purge_queue.append(h)
+                    self._purge_queue.append((self._epoch, h))
+                    stamped = True
+            if stamped:
+                # Reads starting after the removal can't see these files,
+                # so a later epoch means "safe once current pins drain".
+                self._epoch += 1
+
+    def pending_purge_paths(self) -> set[str]:
+        """Paths queued for purge but not yet released — still REFERENCED
+        (a pinned read may hold them); the orphan sweep must not treat
+        them as untracked garbage."""
+        with self._lock:
+            return {h.path for _, h in self._purge_queue}
 
     def drain_purge_queue(self) -> list[FileHandle]:
+        """Handles that are provably unreachable by any in-flight read."""
         with self._lock:
-            out, self._purge_queue = self._purge_queue, []
+            # Stamps are always < the post-removal epoch, so with no pins
+            # everything drains; with pins, only pre-pin removals do.
+            min_active = min(self._active_reads, default=self._epoch)
+            out = [h for e, h in self._purge_queue if e < min_active]
+            self._purge_queue = [(e, h) for e, h in self._purge_queue if e >= min_active]
             return out
 
     # ---- queries -------------------------------------------------------

@@ -524,8 +524,8 @@ class Executor:
             num_groups = 1
         allowed = np.ones(S, dtype=bool)
         for conj in series_filters:
-            v, m = eval_expr(conj, series_rows)
-            allowed &= np.asarray(as_values(v)).astype(bool) & m
+            v, valid = eval_expr(conj, series_rows)
+            allowed &= np.asarray(as_values(v)).astype(bool) & valid
 
         # Time range + bucketing, relative to the cache origin. An empty
         # intersection keeps rel bounds at (0, 0) — NOT raw epoch deltas,

@@ -75,6 +75,19 @@ class Predicate:
     def with_time_range(self, tr: TimeRange) -> "Predicate":
         return Predicate(tr, self.filters)
 
+    def restricted_to(self, columns: set[str]) -> "Predicate":
+        """Keep only filters on the given columns (plus the time range).
+
+        Used by dedup scans: pruning a row group by a VALUE filter may drop
+        the newest version of a key while an older version survives in an
+        unpruned group, resurfacing overwritten data. Key-column filters
+        (and the time range — the timestamp is a key column) can never
+        separate two versions of the same key, so they remain safe."""
+        kept = tuple(f for f in self.filters if f.column in columns)
+        if len(kept) == len(self.filters):
+            return self
+        return Predicate(self.time_range, kept)
+
     def filters_on(self, column: str) -> list[ColumnFilter]:
         return [f for f in self.filters if f.column == column]
 

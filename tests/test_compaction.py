@@ -166,3 +166,151 @@ class TestCompaction:
         out = inst.read(t)
         got = {(r["name"], r["t"]): r["value"] for r in out.to_pylist()}
         assert got == expect
+
+
+class TestAdviceRegressions:
+    """Regressions for the round-1 advisor findings (ADVICE.md)."""
+
+    def test_purge_deferred_while_read_pinned(self):
+        # A reader holding a view picked before compaction's version swap
+        # must still find the replaced SSTs on disk (deferred purge).
+        inst, t = env()
+        for i in range(3):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        paths_before = {h.path for h in t.version.levels.files_at(0)}
+        with t.version.levels.read_pin():
+            Compactor(t).compact()
+            for p in paths_before:
+                assert inst.store.exists(p), "SST purged under an active read pin"
+        # Pin released: the next maintenance drain deletes them.
+        inst._purge(t)
+        for p in paths_before:
+            assert not inst.store.exists(p)
+
+    def test_purge_drains_fully_without_readers(self):
+        inst, t = env()
+        for i in range(2):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        paths_before = {h.path for h in t.version.levels.files_at(0)}
+        Compactor(t).compact()
+        for p in paths_before:
+            assert not inst.store.exists(p)
+
+    def test_file_spanning_windows_not_double_compacted(self):
+        # After segment_duration shrinks, an L1 run spanning two new windows
+        # is picked into both window tasks; only one may consume it.
+        import dataclasses
+
+        inst, t = env(update_mode="append", segment_duration="2h")
+        for _ in range(2):
+            write_flush(
+                inst,
+                t,
+                [
+                    {"name": "h", "value": 1.0, "t": 100},
+                    {"name": "h", "value": 2.0, "t": HOUR + 100},
+                ],
+            )
+        Compactor(t).compact()
+        assert len(t.version.levels.files_at(1)) == 1  # spans [0, 2h)
+        t.options = dataclasses.replace(t.options, segment_duration_ms=HOUR)
+        write_flush(inst, t, [{"name": "h", "value": 3.0, "t": 200}])
+        write_flush(inst, t, [{"name": "h", "value": 4.0, "t": HOUR + 200}])
+        Compactor(t).compact()
+        out = inst.read(t)
+        # APPEND mode: every written row exactly once (6 writes total);
+        # double consumption would duplicate the 4 L1 rows.
+        assert len(out) == 6
+        ts = sorted(r["t"] for r in out.to_pylist())
+        assert ts == [100, 100, 200, HOUR + 100, HOUR + 100, HOUR + 200]
+
+
+class TestDedupPruningRegression:
+    def test_value_filter_pruning_cannot_resurface_overwritten_row(self):
+        # SST1 holds (h,100)=1.0; SST2 overwrites with 100.0. A scan whose
+        # predicate has value<50 must NOT prune SST2's row group and hand
+        # back the stale 1.0 (merge_read leaves value filtering to the
+        # executor, so the correct result here is the newest row).
+        from horaedb_tpu.table_engine.predicate import (
+            ColumnFilter,
+            FilterOp,
+            Predicate,
+        )
+
+        inst, t = env()
+        write_flush(inst, t, [{"name": "h", "value": 1.0, "t": 100}])
+        write_flush(inst, t, [{"name": "h", "value": 100.0, "t": 100}])
+        pred = Predicate.all_time([ColumnFilter("value", FilterOp.LT, 50.0)])
+        out = inst.read(t, pred)
+        vals = [r["value"] for r in out.to_pylist()]
+        assert vals == [100.0], f"stale overwritten row resurfaced: {vals}"
+
+    def test_sweep_respects_purge_queue_under_pin(self):
+        # Purge-queued (pin-protected) SSTs are referenced, not orphans;
+        # the open-time sweep must not delete them out from under a reader.
+        inst, t = env()
+        for i in range(2):
+            write_flush(inst, t, [{"name": "h", "value": float(i), "t": 100 + i}])
+        paths_before = {h.path for h in t.version.levels.files_at(0)}
+        with t.version.levels.read_pin():
+            Compactor(t).compact()
+            inst._sweep_orphan_ssts(t)
+            for p in paths_before:
+                assert inst.store.exists(p), "sweep deleted a pin-protected SST"
+        inst._purge(t)
+        for p in paths_before:
+            assert not inst.store.exists(p)
+
+    def test_cross_window_rows_keep_their_own_sequence(self):
+        # OVERWRITE table: an L1 run spanning two windows (after ALTER
+        # shrank segment_duration) is compacted with window A; its window-B
+        # rows must NOT get stamped with window A's newer sequence, or a
+        # later window-B compaction resurrects the stale value.
+        import dataclasses
+
+        inst, t = env(segment_duration="2h")
+        K = HOUR + 100  # the contested key's timestamp (window B under 1h)
+        write_flush(
+            inst, t,
+            [{"name": "h", "value": 10.0, "t": 100},
+             {"name": "h", "value": 1.0, "t": K}],
+        )
+        write_flush(inst, t, [{"name": "h", "value": 11.0, "t": 150}])
+        Compactor(t).compact()
+        assert len(t.version.levels.files_at(1)) == 1  # spans [0, 2h)
+        # Newer write overwrites the contested key; stays in its own L0.
+        write_flush(inst, t, [{"name": "h", "value": 2.0, "t": K}])
+        t.options = dataclasses.replace(t.options, segment_duration_ms=HOUR)
+        # Trigger a window-A task that consumes the spanning L1 run.
+        write_flush(inst, t, [{"name": "h", "value": 12.0, "t": 200}])
+        Compactor(t).compact()
+        Compactor(t).compact()  # window B (skipped last pass) compacts now
+        got = {r["t"]: r["value"] for r in inst.read(t).to_pylist()}
+        assert got[K] == 2.0, f"stale overwritten value resurrected: {got[K]}"
+
+    def test_explicit_primary_key_fallback_dedup(self):
+        # No-tsid table (explicit PRIMARY KEY): compaction's host lexsort
+        # fallback path, with duplicate keys across runs.
+        from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+        from horaedb_tpu.utils.object_store import MemoryStore
+
+        schema = Schema.build(
+            [
+                ColumnSchema("name", DatumKind.STRING, is_tag=True),
+                ColumnSchema("value", DatumKind.DOUBLE),
+                ColumnSchema("t", DatumKind.TIMESTAMP),
+            ],
+            timestamp_column="t",
+            primary_key=["name", "t"],
+        )
+        assert schema.tsid_index is None
+        inst = Instance(MemoryStore(), EngineConfig(compaction_l0_trigger=1000))
+        t = inst.create_table(
+            0, 1, "pk", schema, TableOptions.from_kv({"segment_duration": "1h"})
+        )
+        write_flush(inst, t, [{"name": "h", "value": 1.0, "t": 100}])
+        write_flush(inst, t, [{"name": "h", "value": 2.0, "t": 100}])
+        res = Compactor(t).compact()
+        assert res.tasks_run == 1
+        out = inst.read(t)
+        assert [(r["t"], r["value"]) for r in out.to_pylist()] == [(100, 2.0)]
