@@ -158,21 +158,51 @@ def _rows_agree(a: list, b: list, rtol: float = 1e-3, atol: float = 1e-3) -> boo
     return True
 
 
+def _backend_usable() -> bool:
+    """Probe the JAX backend in a SUBPROCESS with a timeout.
+
+    The axon TPU tunnel is single-client: if another process holds the
+    chip, ``jax.devices()`` hangs indefinitely rather than raising — an
+    in-process probe would wedge the whole bench. A probe child that
+    answers promptly means the backend is usable; a hang/crash means fall
+    back to CPU (and say so in the output instead of exiting non-zero).
+    """
+    import subprocess
+
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True,
+            timeout=120,
+        )
+        return p.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj))
+
+
 def main() -> None:
     config = os.environ.get("BENCH_CONFIG", "readme")
     builder = CONFIGS.get(config)
     if builder is None:
-        print(json.dumps({"metric": "error", "value": 0, "unit": f"unknown config {config}", "vs_baseline": 0}))
-        sys.exit(1)
+        _emit({"metric": f"{config}_error", "value": 0, "unit": f"unknown config {config}",
+               "vs_baseline": 0, "platform": "none"})
+        return
 
     import jax
 
+    if not _backend_usable():
+        # Backend unavailable/wedged: a labeled CPU number beats rc=1.
+        jax.config.update("jax_platforms", "cpu")
     platform = jax.devices()[0].platform
     db, sql, n_rows = builder()
 
     dev_s, dev_rows = time_query(db, sql)
     dev_path = db.interpreters.executor.last_path
-    assert dev_path in ("device-cached", "device", "host"), dev_path
+    assert dev_path in ("device-cached", "device-dist", "device", "host"), dev_path
 
     # Baseline: force the host (vectorized numpy) executor — disable both
     # the device path and the device-resident cache.
@@ -187,24 +217,33 @@ def main() -> None:
     # Both paths must agree numerically (a fast-but-wrong kernel must not
     # benchmark as a success).
     if not _rows_agree(dev_rows, host_rows):
-        print(json.dumps({"metric": "error", "value": 0, "unit": "path mismatch", "vs_baseline": 0}))
-        sys.exit(1)
+        _emit({"metric": f"{config}_error", "value": 0, "unit": "path mismatch",
+               "vs_baseline": 0, "platform": platform})
+        return
 
     rows_per_sec = n_rows / dev_s
-    print(
-        json.dumps(
-            {
-                "metric": f"{config}_rows_per_sec_{platform}_{dev_path}",
-                "value": round(rows_per_sec),
-                "unit": "rows/s",
-                "vs_baseline": round(host_s / dev_s, 3),
-            }
-        )
+    _emit(
+        {
+            "metric": f"{config}_rows_per_sec_{dev_path}",
+            "value": round(rows_per_sec),
+            "unit": "rows/s",
+            "vs_baseline": round(host_s / dev_s, 3),
+            "platform": platform,
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # a labeled number beats rc!=0; ^C still aborts
+        print(json.dumps({
+            "metric": f"{os.environ.get('BENCH_CONFIG', 'readme')}_error",
+            "value": 0,
+            "unit": f"{type(e).__name__}: {e}"[:200],
+            "vs_baseline": 0,
+            "platform": "unknown",
+        }))
     sys.stdout.flush()
     sys.stderr.flush()
     # XLA's CPU runtime occasionally aborts in its C++ teardown during
