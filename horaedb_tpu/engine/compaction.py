@@ -170,15 +170,21 @@ class Compactor:
             # windows after ALTER shrank segment_duration). Running both
             # would duplicate its rows across two L1 outputs and emit the
             # RemoveFile edit twice — skip any task touching an already
-            # consumed input; the window is re-picked on the next pass.
-            consumed: set[tuple[int, int]] = set()
-            for task in picker.pick(table):
-                keys = {(h.level, h.file_id) for h in task.inputs}
-                if keys & consumed:
-                    continue
-                self._run_task(task, result)
-                consumed |= keys
-                result.tasks_run += 1
+            # consumed input and RE-PICK until a pass completes without
+            # skips (nothing else schedules a retry on an idle table).
+            while True:
+                consumed: set[tuple[int, int]] = set()
+                skipped = False
+                for task in picker.pick(table):
+                    keys = {(h.level, h.file_id) for h in task.inputs}
+                    if keys & consumed:
+                        skipped = True
+                        continue
+                    self._run_task(task, result)
+                    consumed |= keys
+                    result.tasks_run += 1
+                if not (skipped and consumed):
+                    break
         return result
 
     def _drop_expired(self, result: CompactionResult, now_ms: int | None) -> None:

@@ -125,11 +125,7 @@ _fused_scan_agg = functools.partial(
 )(scan_agg_body)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
-)
-def cached_scan_agg(
+def cached_scan_agg_body(
     series_codes,  # int32[N] (padded rows carry code == n_series)
     ts_rel,  # int32[N], ms relative to the cache's min timestamp
     values,  # f32[F, N] device-resident value columns
@@ -153,6 +149,10 @@ def cached_scan_agg(
     bounds, and filter literals. The big arrays (series codes, relative
     timestamps, value columns) stay on device across queries — uploads are
     O(series + scalars), not O(rows).
+
+    Pure body: also the per-shard program when the cache is sharded over a
+    mesh (parallel/dist_agg.make_cached_dist_scan_agg wraps it with
+    psum/pmin/pmax collectives).
     """
     mask = allowed_series[series_codes]
     mask = mask & (ts_rel >= lo_rel) & (ts_rel < hi_rel)
@@ -169,6 +169,12 @@ def cached_scan_agg(
         n_agg_fields=n_agg_fields,
         numeric_filters=numeric_filters,
     )
+
+
+cached_scan_agg = functools.partial(
+    jax.jit,
+    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
+)(cached_scan_agg_body)
 
 
 @dataclass

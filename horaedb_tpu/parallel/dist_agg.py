@@ -28,6 +28,7 @@ from ..ops.encoding import PaddedBatch
 from ..ops.scan_agg import (
     AggState,
     ScanAggSpec,
+    cached_scan_agg_body,
     coerce_literals,
     encode_filter_ops,
     scan_agg_body,
@@ -41,6 +42,43 @@ SHARD_AXIS = "shard"
 _STEP_CACHE: dict = {}
 
 
+def _combine(state):
+    """The aggregation monoid as mesh collectives (final aggregate)."""
+    counts, sums, mins, maxs = state
+    return (
+        jax.lax.psum(counts, SHARD_AXIS),
+        jax.lax.psum(sums, SHARD_AXIS),
+        jax.lax.pmin(mins, SHARD_AXIS),
+        jax.lax.pmax(maxs, SHARD_AXIS),
+    )
+
+
+def _build_step(mesh: Mesh, spec: ScanAggSpec, tag: str, body, in_specs) -> Callable:
+    """shard_map(body)+combine, jitted and cached per (mesh, spec, tag)."""
+    cache_key = (mesh, spec, tag)
+    cached = _STEP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
+    static_filters = encode_filter_ops(spec.numeric_filters)
+
+    def per_shard(*args):
+        return _combine(
+            body(
+                *args,
+                n_groups=spec.n_groups,
+                n_buckets=spec.n_buckets,
+                n_agg_fields=spec.n_agg_fields,
+                numeric_filters=static_filters,
+            )
+        )
+
+    step = jax.jit(
+        shard_map(per_shard, mesh=mesh, in_specs=in_specs, out_specs=(P(), P(), P(), P()))
+    )
+    _STEP_CACHE[cache_key] = step
+    return step
+
+
 def make_dist_scan_agg(mesh: Mesh, spec: ScanAggSpec) -> Callable:
     """Compile (or fetch cached) the sharded scan/agg step for ``spec``.
 
@@ -48,40 +86,46 @@ def make_dist_scan_agg(mesh: Mesh, spec: ScanAggSpec) -> Callable:
     row-dimension inputs are sharded over the mesh axis and the output
     aggregate state is replicated (fully combined) on every device.
     """
-    cache_key = (mesh, spec)
-    cached = _STEP_CACHE.get(cache_key)
-    if cached is not None:
-        return cached
-    static_filters = encode_filter_ops(spec.numeric_filters)
-
-    def per_shard(group_codes, bucket_ids, mask, values, literals):
-        counts, sums, mins, maxs = scan_agg_body(
-            group_codes,
-            bucket_ids,
-            mask,
-            values,
-            literals,
-            n_groups=spec.n_groups,
-            n_buckets=spec.n_buckets,
-            n_agg_fields=spec.n_agg_fields,
-            numeric_filters=static_filters,
-        )
-        # Final aggregate: the monoid combine as mesh collectives.
-        counts = jax.lax.psum(counts, SHARD_AXIS)
-        sums = jax.lax.psum(sums, SHARD_AXIS)
-        mins = jax.lax.pmin(mins, SHARD_AXIS)
-        maxs = jax.lax.pmax(maxs, SHARD_AXIS)
-        return counts, sums, mins, maxs
-
-    sharded = shard_map(
-        per_shard,
-        mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(None, SHARD_AXIS), P(None)),
-        out_specs=(P(), P(), P(), P()),
+    return _build_step(
+        mesh,
+        spec,
+        "scan",
+        scan_agg_body,
+        in_specs=(
+            P(SHARD_AXIS),  # group codes (rows)
+            P(SHARD_AXIS),  # bucket ids (rows)
+            P(SHARD_AXIS),  # mask (rows)
+            P(None, SHARD_AXIS),  # value columns (fields, rows)
+            P(None),  # filter literals
+        ),
     )
-    step = jax.jit(sharded)
-    _STEP_CACHE[cache_key] = step
-    return step
+
+
+def make_cached_dist_scan_agg(mesh: Mesh, spec: ScanAggSpec) -> Callable:
+    """Sharded version of the HBM-resident cached kernel.
+
+    The cache's big per-row arrays (series codes, relative timestamps,
+    value columns) live SHARDED across the mesh (scan_cache places them
+    with ``P("shard")``); per-query small inputs (series→group map, allow
+    list, literals, time scalars) are replicated. Each device aggregates
+    its row shard, then the monoid combines via collectives — the default
+    serving path on a multi-chip mesh, not a demo path.
+    """
+    return _build_step(
+        mesh,
+        spec,
+        "cached",
+        cached_scan_agg_body,
+        in_specs=(
+            P(SHARD_AXIS),  # series codes (rows)
+            P(SHARD_AXIS),  # relative timestamps (rows)
+            P(None, SHARD_AXIS),  # value columns (fields, rows)
+            P(None),  # series -> group map (replicated)
+            P(None),  # series allow list (replicated)
+            P(None),  # filter literals
+            P(), P(), P(), P(),  # time-range / bucket scalars
+        ),
+    )
 
 
 def dist_scan_aggregate(
@@ -94,17 +138,25 @@ def dist_scan_aggregate(
     run the sharded step, return host-side combined partials."""
     n_dev = mesh.devices.size
     padded = batch.padded_len
-    if padded % n_dev:
-        raise ValueError(
-            f"padded batch length {padded} not divisible by mesh size {n_dev} "
-            "(shape buckets are powers of two; use a power-of-two mesh)"
-        )
+    group_codes, bucket_ids, mask, values = (
+        batch.group_codes, batch.bucket_ids, batch.mask, batch.values,
+    )
+    rem = padded % n_dev
+    if rem:
+        # Shape buckets are powers of two, so this only triggers on
+        # non-power-of-two meshes. Pad rows are masked out, so they never
+        # touch the aggregates.
+        extra = n_dev - rem
+        group_codes = np.pad(group_codes, (0, extra))
+        bucket_ids = np.pad(bucket_ids, (0, extra))
+        mask = np.pad(mask, (0, extra))  # False fill
+        values = np.pad(values, ((0, 0), (0, extra)))
     step = make_dist_scan_agg(mesh, spec)
     counts, sums, mins, maxs = step(
-        jnp.asarray(batch.group_codes),
-        jnp.asarray(batch.bucket_ids),
-        jnp.asarray(batch.mask),
-        jnp.asarray(batch.values),
+        jnp.asarray(group_codes),
+        jnp.asarray(bucket_ids),
+        jnp.asarray(mask),
+        jnp.asarray(values),
         coerce_literals(filter_literals),
     )
     return state_to_host(counts, sums, mins, maxs)

@@ -215,7 +215,10 @@ class Executor:
         # Per-call dict threaded through the stages and attached to the
         # RESULT — concurrent queries never share mutable metric state.
         m: dict = {"table": plan.table}
-        if plan.is_aggregate:
+        import os as _os
+
+        cache_on = _os.environ.get("HORAEDB_SCAN_CACHE", "1") != "0"
+        if plan.is_aggregate and cache_on:
             cached = self._try_cached_agg(plan, table, m)
             if cached is not None:
                 path = "device-cached"
@@ -226,8 +229,8 @@ class Executor:
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)
         if plan.is_aggregate and self._device_capable(plan, rows):
-            path = "device"
-            out = self._execute_agg_device(plan, rows)
+            out = self._execute_agg_device(plan, rows, m)
+            path = "device-dist" if "mesh_devices" in m else "device"
         elif plan.is_aggregate:
             path = "host"
             out = self._execute_agg_host(plan, rows)
@@ -364,7 +367,9 @@ class Executor:
                 return False
         return True
 
-    def _execute_agg_device(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
+    def _execute_agg_device(
+        self, plan: QueryPlan, rows: RowGroup, m: dict | None = None
+    ) -> ResultSet:
         tag_keys, bucket_key, agg_cols = self._agg_device_shape(plan)
         # Numeric field filters -> device; the rest -> host row mask.
         device_filters, host_residue = self._split_residual_filters(plan)
@@ -404,7 +409,23 @@ class Executor:
                 (value_names.index(col), op) for col, op, _ in device_filters
             ),
         ).padded()
-        state = scan_aggregate(batch, spec, [lit for _, _, lit in device_filters])
+        literals = [lit for _, _, lit in device_filters]
+
+        # Large scans shard over the device mesh (partial agg per device,
+        # monoid combine via psum/pmin/pmax collectives); small ones stay
+        # single-device where dispatch overhead dominates. SAME kernel
+        # body either way (parallel/dist_agg wraps ops/scan_agg).
+        from ..parallel.mesh import dist_min_rows, serving_mesh
+
+        mesh = serving_mesh()
+        if mesh is not None and batch.n_valid >= dist_min_rows():
+            from ..parallel.dist_agg import dist_scan_aggregate
+
+            state = dist_scan_aggregate(mesh, batch, spec, literals)
+            if m is not None:
+                m["mesh_devices"] = int(mesh.devices.size)
+        else:
+            state = scan_aggregate(batch, spec, literals)
 
         return self._assemble_agg_result(
             plan, tag_keys, enc.key_values, agg_cols, state,
@@ -557,12 +578,15 @@ class Executor:
 
         gos = np.append(series_group, 0).astype(np.int32)  # pad series -> masked
         allow = np.append(allowed, False)
-        out = cached_scan_agg(
-            entry.series_codes_dev,
-            entry.ts_rel_dev,
+        values_dev = (
             entry.values_for(value_names)
             if value_names
-            else jnp.zeros((0, len(entry.series_codes_dev)), dtype=jnp.float32),
+            else jnp.zeros((0, len(entry.series_codes_dev)), dtype=jnp.float32)
+        )
+        args = (
+            entry.series_codes_dev,
+            entry.ts_rel_dev,
+            values_dev,
             jnp.asarray(gos),
             jnp.asarray(allow),
             coerce_literals([lit for _, _, lit in device_filters]),
@@ -570,11 +594,24 @@ class Executor:
             np.int32(hi - entry.min_ts),
             np.int32(max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0),
             np.int32(width if width else 1),
-            n_groups=spec.n_groups,
-            n_buckets=spec.n_buckets,
-            n_agg_fields=spec.n_agg_fields,
-            numeric_filters=encode_filter_ops(spec.numeric_filters),
         )
+        if entry.mesh is not None:
+            # Sharded entry: the big arrays live split across the mesh —
+            # run the shard_map cached kernel (the DEFAULT multi-device
+            # serving path; single-device deployments take the else arm).
+            from ..parallel.dist_agg import make_cached_dist_scan_agg
+
+            step = make_cached_dist_scan_agg(entry.mesh, spec)
+            out = step(*args)
+            m["mesh_devices"] = int(entry.mesh.devices.size)
+        else:
+            out = cached_scan_agg(
+                *args,
+                n_groups=spec.n_groups,
+                n_buckets=spec.n_buckets,
+                n_agg_fields=spec.n_agg_fields,
+                numeric_filters=encode_filter_ops(spec.numeric_filters),
+            )
         state = state_to_host(*out)
         return self._assemble_agg_result(
             plan, tag_keys, key_values, agg_cols, state,

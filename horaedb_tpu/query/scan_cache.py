@@ -54,9 +54,27 @@ class CachedTableScan:
     ts_rel_dev: "jnp.ndarray"
     # device value columns by name, shape (padded,)
     value_cols_dev: dict
+    # the mesh the big arrays are sharded over (None = single device);
+    # queries on a sharded entry MUST use the shard_map cached kernel.
+    mesh: object = None
+    # stacked (F, padded) value arrays per column tuple — stacking is a
+    # device op, so reuse the result across steady-state queries.
+    _stacks: dict = None
 
     def values_for(self, names: list[str]):
-        return jnp.stack([self.value_cols_dev[n] for n in names])
+        key = tuple(names)
+        if self._stacks is None:
+            self._stacks = {}
+        out = self._stacks.get(key)
+        if out is None:
+            out = jnp.stack([self.value_cols_dev[n] for n in names])
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                import jax
+
+                out = jax.device_put(out, NamedSharding(self.mesh, P(None, "shard")))
+            self._stacks[key] = out
+        return out
 
 
 class ScanCache:
@@ -86,8 +104,16 @@ class ScanCache:
         stable long enough to justify a build.
         """
         fp = _fingerprint(table)
+        from ..parallel.mesh import serving_mesh
+
+        mesh_now = serving_mesh()
         with self._lock:
             entry = self._entries.get(table.name)
+            if entry is not None and entry.mesh is not None and entry.mesh is not mesh_now:
+                # Device set changed (mesh rebuilt): sharded arrays are
+                # placed on the old mesh — rebuild from scratch.
+                self._entries.pop(table.name, None)
+                entry = None
             if entry is not None and entry.fingerprint == fp:
                 if all(c in entry.value_cols_dev for c in value_columns):
                     self.hits += 1
@@ -130,6 +156,30 @@ class ScanCache:
         ts_rel = pad_to_bucket(
             (rows.timestamps - min_ts).astype(np.int32), n, fill=np.int32(-1)
         )
+        # Multi-device: the big row arrays live SHARDED across the mesh so
+        # steady-state serving is itself distributed (each chip holds and
+        # scans 1/Nth of the table; combine rides the collectives). Small
+        # tables stay single-device — same threshold as the uncached path
+        # (collective dispatch would dominate).
+        from ..parallel.mesh import dist_min_rows, serving_mesh
+
+        mesh = serving_mesh() if n >= dist_min_rows() else None
+        place = None
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            if len(codes) % n_dev:
+                extra = n_dev - len(codes) % n_dev
+                codes = np.pad(codes, (0, extra), constant_values=n_series)
+                ts_rel = np.pad(ts_rel, (0, extra), constant_values=-1)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            place = NamedSharding(mesh, P("shard"))
+            codes_dev = jax.device_put(codes, place)
+            ts_dev = jax.device_put(ts_rel, place)
+        else:
+            codes_dev = jnp.asarray(codes)
+            ts_dev = jnp.asarray(ts_rel)
         entry = CachedTableScan(
             fingerprint=fp,
             rows=rows,
@@ -138,20 +188,31 @@ class ScanCache:
             max_ts=max_ts,
             series_first_idx=first_idx,
             n_series=n_series,
-            series_codes_dev=jnp.asarray(codes),
-            ts_rel_dev=jnp.asarray(ts_rel),
+            series_codes_dev=codes_dev,
+            ts_rel_dev=ts_dev,
             value_cols_dev={},
+            mesh=mesh,
         )
         self._extend(entry, value_columns)
         return entry
 
     def _extend(self, entry: CachedTableScan, value_columns: list[str]) -> None:
+        target = len(entry.series_codes_dev)  # includes any mesh padding
+        place = None
+        if entry.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            place = NamedSharding(entry.mesh, P("shard"))
         for c in value_columns:
             if c not in entry.value_cols_dev:
                 arr = as_values(entry.rows.column(c)).astype(np.float32, copy=False)
-                entry.value_cols_dev[c] = jnp.asarray(
-                    pad_to_bucket(arr, entry.n_valid)
-                )
+                padded = np.pad(arr, (0, target - len(arr)))
+                if place is not None:
+                    entry.value_cols_dev[c] = jax.device_put(padded, place)
+                else:
+                    entry.value_cols_dev[c] = jnp.asarray(padded)
+                entry._stacks = None  # stale stacked views
 
     def invalidate(self, table_name: str) -> None:
         with self._lock:

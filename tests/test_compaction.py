@@ -283,8 +283,9 @@ class TestDedupPruningRegression:
         t.options = dataclasses.replace(t.options, segment_duration_ms=HOUR)
         # Trigger a window-A task that consumes the spanning L1 run.
         write_flush(inst, t, [{"name": "h", "value": 12.0, "t": 200}])
+        # ONE call: the re-pick loop compacts window B (skipped in the
+        # first pass because window A consumed the spanning L1 run) too.
         Compactor(t).compact()
-        Compactor(t).compact()  # window B (skipped last pass) compacts now
         got = {r["t"]: r["value"] for r in inst.read(t).to_pylist()}
         assert got[K] == 2.0, f"stale overwritten value resurrected: {got[K]}"
 

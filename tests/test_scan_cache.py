@@ -99,3 +99,69 @@ class TestScanCache:
         out = db.execute("SELECT count(*) AS c FROM t WHERE host IN ('h1', 'h3')").to_pylist()
         assert out == [{"c": 80}]
         assert db.interpreters.executor.last_path == "device-cached"
+
+
+class TestShardedCache:
+    """The cached serving path itself shards over the mesh (round 2):
+    entry arrays live split across devices, the shard_map cached kernel
+    combines with collectives — the DEFAULT multi-device serving path."""
+
+    def test_cached_path_runs_on_mesh(self, db, monkeypatch):
+        monkeypatch.setenv("HORAEDB_DIST_MIN_ROWS", "1")
+        seed(db, n=500)
+        ex = db.interpreters.executor
+        sql = (
+            "SELECT host, count(*) AS c, avg(v) AS a, min(v) AS lo, "
+            "max(v) AS hi FROM t GROUP BY host"
+        )
+        out = warm(db, sql)
+        assert ex.last_path == "device-cached"
+        assert ex.last_metrics.get("mesh_devices") == 8
+        entry = ex.scan_cache._entries["t"]
+        assert entry.mesh is not None
+        assert not entry.series_codes_dev.sharding.is_fully_replicated
+        cached_rows = {r["host"]: r for r in out.to_pylist()}
+
+        orig_cap, orig_cached = ex._device_capable, ex._try_cached_agg
+        ex._device_capable = lambda plan, rows: False
+        ex._try_cached_agg = lambda plan, table, m: None
+        host = db.execute(sql)
+        ex._device_capable, ex._try_cached_agg = orig_cap, orig_cached
+        host_rows = {r["host"]: r for r in host.to_pylist()}
+        assert set(cached_rows) == set(host_rows)
+        for k in host_rows:
+            assert cached_rows[k]["c"] == host_rows[k]["c"]
+            for f in ("a", "lo", "hi"):
+                np.testing.assert_allclose(
+                    cached_rows[k][f], host_rows[k][f], rtol=1e-4, atol=1e-5
+                )
+
+    def test_sharded_cache_with_filters(self, db, monkeypatch):
+        monkeypatch.setenv("HORAEDB_DIST_MIN_ROWS", "1")
+        seed(db, n=500)
+        ex = db.interpreters.executor
+        sql = (
+            "SELECT host, count(*) AS c FROM t "
+            "WHERE v > 100 AND host = 'h1' GROUP BY host"
+        )
+        out = warm(db, sql)
+        assert ex.last_path == "device-cached"
+        assert ex.last_metrics.get("mesh_devices") == 8
+        rows = out.to_pylist()
+        # h1 rows: i % 5 == 1 and v=i > 100 -> i in {101..499}: 80 rows
+        assert rows == [{"host": "h1", "c": 80}]
+
+    def test_small_table_cache_stays_single_device(self, db):
+        # Below the dist threshold the cache builds unsharded even when a
+        # mesh exists — collective dispatch would dominate tiny tables.
+        seed(db, n=300)
+        ex = db.interpreters.executor
+        sql = "SELECT host, count(*) AS c FROM t GROUP BY host"
+        warm(db, sql)
+        assert ex.last_path == "device-cached"
+        assert "mesh_devices" not in ex.last_metrics
+        assert ex.scan_cache._entries["t"].mesh is None
+        # and the unsharded entry is NOT invalidated by the live mesh
+        db.execute(sql)
+        assert ex.last_path == "device-cached"
+        assert ex.scan_cache.hits >= 1
