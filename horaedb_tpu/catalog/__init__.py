@@ -87,6 +87,25 @@ class Catalog:
         )
         self.store.put(_REGISTRY_PATH, body)
 
+    def reload(self) -> None:
+        """Re-read the persisted registry (cluster mode: another node may
+        have created tables in the SHARED object store since we loaded).
+        Keeps open handles; only the name->entry map refreshes."""
+        with self._lock:
+            self._entries.clear()
+            self._load()
+
+    def forget(self, name: str) -> None:
+        """Drop the open handle + entry WITHOUT touching storage (shard
+        moved away: the table lives on, owned by another node)."""
+        with self._lock:
+            self._open_tables.pop(name, None)
+            self._entries.pop(name, None)
+
+    def entry(self, name: str) -> Optional[TableEntry]:
+        with self._lock:
+            return self._entries.get(name)
+
     # ---- lookup ------------------------------------------------------------
     def table_names(self) -> list[str]:
         with self._lock:

@@ -1,22 +1,40 @@
 """Cluster mode: shard membership + routing + forwarding
 (ref: src/cluster, src/router, proxy/src/forward.rs).
 
-Round-1 scope is the data plane of static clustering:
-
-- ``shard``  — the Shard/ShardSet state machine {INIT, OPENING, READY,
-               FROZEN} with version fencing (ref: shard_set.rs:38-228);
-- ``router`` — table -> node routing; ``RuleBasedRouter`` from static
-               config (ref: rule_based.rs), hash fallback for unlisted
-               tables;
+- ``shard``        — the Shard/ShardSet state machine {INIT, OPENING,
+                     READY, FROZEN} with version fencing
+                     (ref: shard_set.rs:38-228);
+- ``router``       — table -> node routing; ``RuleBasedRouter`` from
+                     static config (ref: rule_based.rs), hash fallback for
+                     unlisted tables; ``ClusterBasedRouter`` from the
+                     coordinator with a TTL route cache
+                     (ref: cluster_based.rs);
+- ``meta_client``  — HTTP client to the coordinator with endpoint
+                     failover (ref: meta_client/src/lib.rs:100-116);
+- ``cluster_impl`` — the node's heartbeat loop + shard reconciliation +
+                     lease-fenced write barrier
+                     (ref: cluster_impl.rs, shard_lock_manager.rs);
 - HTTP forwarding in the server: a request for a table owned by another
   node proxies to the owner with loop protection (ref: forward.rs).
 
-The coordinator (horaemeta analog: heartbeats, shard scheduling, etcd
-leases) is round-2 work; the interfaces here are shaped so it slots in as
-a ``ClusterBasedRouter`` + shard-event handlers.
+The coordinator itself lives in ``horaedb_tpu.meta``.
 """
 
-from .router import Route, Router, RuleBasedRouter
-from .shard import Shard, ShardSet, ShardState
+from .cluster_impl import ClusterImpl
+from .meta_client import MetaClient, MetaError
+from .router import ClusterBasedRouter, Route, Router, RuleBasedRouter
+from .shard import Shard, ShardError, ShardSet, ShardState
 
-__all__ = ["Route", "Router", "RuleBasedRouter", "Shard", "ShardSet", "ShardState"]
+__all__ = [
+    "ClusterBasedRouter",
+    "ClusterImpl",
+    "MetaClient",
+    "MetaError",
+    "Route",
+    "Router",
+    "RuleBasedRouter",
+    "Shard",
+    "ShardError",
+    "ShardSet",
+    "ShardState",
+]

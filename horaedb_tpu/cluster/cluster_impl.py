@@ -1,0 +1,223 @@
+"""Data-node cluster runtime
+(ref: src/cluster/src/cluster_impl.rs:59-116 — the heartbeat loop;
+shard_operator.rs:123-404 — open/close/create-table shard ops;
+shard_lock_manager.rs — lease-fenced single-writer discipline).
+
+``ClusterImpl`` owns the node's shard set and reconciles it against the
+coordinator's declarative orders, delivered two ways (both feed
+``apply_shard_order``): heartbeat replies, and direct /meta_event pushes.
+
+Fencing: every order carries a shard version (stale ones rejected by the
+Shard state machine) and a lease TTL; the lease deadline renews on every
+successful heartbeat. Writes check ``ensure_table_writable`` — shard READY
+and lease unexpired — so a node cut off from the coordinator stops
+accepting writes after one TTL, BEFORE the coordinator hands the shard to
+someone else (lease_ttl < heartbeat_timeout).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from .meta_client import MetaClient, MetaError
+from .shard import Shard, ShardError, ShardInfo, ShardSet, ShardState
+
+logger = logging.getLogger("horaedb_tpu.cluster")
+
+
+class ClusterImpl:
+    def __init__(
+        self,
+        conn,  # db.Connection — DDL replay + table close on shard moves
+        self_endpoint: str,
+        meta_client: MetaClient,
+        heartbeat_interval_s: float = 2.0,
+    ) -> None:
+        self.conn = conn
+        self.self_endpoint = self_endpoint
+        self.meta = meta_client
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self.shard_set = ShardSet()
+        self._table_shard: dict[str, int] = {}  # table name -> shard id
+        self._lease_deadline: dict[int, float] = {}  # shard id -> monotonic
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        # Best-effort eager registration; a temporarily unreachable
+        # coordinator must not abort node startup (the loop keeps
+        # retrying — the node serves what it can meanwhile).
+        try:
+            self._heartbeat_once()
+        except MetaError as e:
+            logger.warning("initial heartbeat failed (will retry): %s", e)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="cluster-heartbeat"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval_s):
+            try:
+                self._heartbeat_once()
+            except MetaError as e:
+                logger.warning("heartbeat failed: %s", e)
+            except Exception:
+                logger.exception("heartbeat loop error")
+
+    def _heartbeat_once(self) -> None:
+        resp = self.meta.heartbeat(self.self_endpoint)
+        desired = resp.get("desired", [])
+        desired_ids = {o["shard_id"] for o in desired}
+        for order in desired:
+            try:
+                self.apply_shard_order(order)
+            except ShardError as e:
+                logger.warning("shard order rejected: %s", e)
+        # Shards the coordinator no longer grants us: close them.
+        for shard in self.shard_set.all_shards():
+            if shard.shard_id not in desired_ids:
+                self.close_shard(shard.shard_id, version=None)
+
+    # ---- shard orders (heartbeat reply or /meta_event push) -------------
+    def apply_shard_order(self, order: dict) -> None:
+        """Reconcile one declarative shard order (idempotent)."""
+        shard_id = int(order["shard_id"])
+        version = int(order["version"])
+        ttl = float(order.get("lease_ttl_s", 5.0))
+        tables = order.get("tables", [])
+        with self._lock:
+            shard = self.shard_set.get(shard_id)
+            if shard is None:
+                shard = Shard(ShardInfo(shard_id, version=0))
+                self.shard_set.insert(shard)
+                shard.begin_open()
+                try:
+                    self._open_tables_of_shard(tables)
+                except Exception:
+                    # Failed first open: remove the half-open shard so the
+                    # next order starts clean instead of wedging OPENING.
+                    self.shard_set.remove(shard_id)
+                    raise
+                shard.finish_open()
+                shard.apply_update(
+                    ShardInfo(shard_id, version, tuple(t["table_id"] for t in tables))
+                )
+            elif version > shard.version:
+                # Membership changed (table create/drop or reassignment).
+                self._open_tables_of_shard(tables)
+                shard.apply_update(
+                    ShardInfo(shard_id, version, tuple(t["table_id"] for t in tables))
+                )
+            elif version < shard.version:
+                raise ShardError(
+                    f"stale order for shard {shard_id}: v{version} < v{shard.version}"
+                )
+            self._lease_deadline[shard_id] = time.monotonic() + ttl
+            for t in tables:
+                self._table_shard[t["name"]] = shard_id
+
+    def _open_tables_of_shard(self, tables: list[dict]) -> None:
+        """Make every table of the shard servable locally.
+
+        Tables created elsewhere exist in the SHARED object store; reload
+        the catalog registry, then replay create_sql for any still missing
+        (first assignment of a brand-new table)."""
+        if not tables:
+            return
+        missing = [t for t in tables if not self.conn.catalog.exists(t["name"])]
+        if missing:
+            reload_fn = getattr(self.conn.catalog, "reload", None)
+            if reload_fn is not None:
+                reload_fn()
+        for t in tables:
+            if not self.conn.catalog.exists(t["name"]):
+                try:
+                    self.conn.execute(t["create_sql"])
+                except Exception as e:
+                    logger.warning("replaying DDL for %s failed: %s", t["name"], e)
+            else:
+                # Ensure open (manifest load + WAL replay happen here).
+                self.conn.catalog.open(t["name"])
+
+    def close_shard(self, shard_id: int, version: Optional[int]) -> None:
+        with self._lock:
+            shard = self.shard_set.get(shard_id)
+            if shard is None:
+                return
+            if version is not None and version < shard.version:
+                raise ShardError(
+                    f"stale close for shard {shard_id}: v{version} < v{shard.version}"
+                )
+            dropped_tables = [
+                name for name, sid in self._table_shard.items() if sid == shard_id
+            ]
+            for name in dropped_tables:
+                self._table_shard.pop(name, None)
+                try:
+                    t = self.conn.catalog.open(name)
+                    if t is not None:
+                        for data in t.physical_datas():
+                            self.conn.instance.close_table(data)
+                    self.conn.catalog.forget(name)
+                except Exception:
+                    logger.exception("closing table %s of shard %d", name, shard_id)
+            self._lease_deadline.pop(shard_id, None)
+            self.shard_set.remove(shard_id)
+
+    def create_table_on_shard(self, shard_id: int, name: str, create_sql: str) -> int:
+        """Meta-dispatched DDL; returns the catalog table id (idempotent)."""
+        with self._lock:
+            # The registry lives in the SHARED store: another node may have
+            # persisted tables since we loaded. Reload before a
+            # read-modify-write persist, or we'd clobber their entries.
+            self.conn.catalog.reload()
+            if not self.conn.catalog.exists(name):
+                self.conn.execute(create_sql)
+            self._table_shard[name] = shard_id
+            entry = self.conn.catalog.entry(name)
+            return entry.table_id
+
+    def drop_table_on_shard(self, shard_id: int, name: str) -> None:
+        with self._lock:
+            self._table_shard.pop(name, None)
+            self.conn.catalog.reload()
+            if self.conn.catalog.exists(name):
+                self.conn.catalog.drop_table(name, if_exists=True)
+
+    # ---- serving checks --------------------------------------------------
+    def owns_table(self, table: str) -> bool:
+        with self._lock:
+            return table in self._table_shard
+
+    def shard_of_table(self, table: str) -> Optional[int]:
+        with self._lock:
+            return self._table_shard.get(table)
+
+    def ensure_table_writable(self, table: str) -> None:
+        """Raise unless this node holds a live, READY shard for the table
+        (the lease-fencing write barrier, ref: shard_lock_manager.rs)."""
+        with self._lock:
+            shard_id = self._table_shard.get(table)
+            if shard_id is None:
+                raise ShardError(f"table {table!r} not served by this node")
+            shard = self.shard_set.get(shard_id)
+            if shard is None:
+                raise ShardError(f"shard {shard_id} not open on this node")
+            shard.ensure_writable()
+            deadline = self._lease_deadline.get(shard_id, 0.0)
+            if time.monotonic() > deadline:
+                raise ShardError(
+                    f"shard {shard_id} lease expired — write fenced "
+                    "(node cut off from coordinator)"
+                )

@@ -1,0 +1,85 @@
+"""Data-node client for the meta service
+(ref: src/meta_client/src/lib.rs:100-116 — the MetaClient trait:
+send_heartbeat / create_table / drop_table / route_tables / get_nodes —
+and load_balance.rs round-robin over meta endpoints).
+
+Synchronous HTTP with failover: calls rotate through the configured meta
+endpoints; the first answering endpoint is remembered until it fails.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Optional, Sequence
+
+
+class MetaError(RuntimeError):
+    pass
+
+
+class MetaClient:
+    def __init__(self, endpoints: Sequence[str], timeout_s: float = 5.0) -> None:
+        if not endpoints:
+            raise ValueError("meta endpoints must not be empty")
+        self.endpoints = list(endpoints)
+        self.timeout_s = timeout_s
+        self._preferred = 0
+        self._lock = threading.Lock()
+
+    # ---- transport ------------------------------------------------------
+    def _call(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        last_err: Exception | None = None
+        with self._lock:
+            start = self._preferred
+        n = len(self.endpoints)
+        for i in range(n):
+            idx = (start + i) % n
+            ep = self.endpoints[idx]
+            try:
+                data = json.dumps(payload).encode() if payload is not None else None
+                req = urllib.request.Request(
+                    f"http://{ep}{path}",
+                    data=data,
+                    headers={"Content-Type": "application/json"},
+                    method=method,
+                )
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    body = json.loads(resp.read().decode() or "{}")
+                with self._lock:
+                    self._preferred = idx
+                return body
+            except urllib.error.HTTPError as e:
+                # Application-level error from a live meta: no failover.
+                try:
+                    detail = json.loads(e.read().decode()).get("error", str(e))
+                except Exception:
+                    detail = str(e)
+                if e.code == 404:
+                    raise MetaError(f"not found: {detail}") from e
+                raise MetaError(detail) from e
+            except Exception as e:  # connection refused / timeout -> next
+                last_err = e
+        raise MetaError(f"no meta endpoint reachable: {last_err}")
+
+    # ---- API ------------------------------------------------------------
+    def heartbeat(self, endpoint: str) -> dict:
+        return self._call("POST", "/meta/v1/node/heartbeat", {"endpoint": endpoint})
+
+    def create_table(self, name: str, create_sql: str) -> dict:
+        return self._call(
+            "POST", "/meta/v1/table/create", {"name": name, "create_sql": create_sql}
+        )
+
+    def drop_table(self, name: str) -> dict:
+        return self._call("POST", "/meta/v1/table/drop", {"name": name})
+
+    def route(self, table: str) -> Optional[dict]:
+        try:
+            return self._call("GET", f"/meta/v1/route/{table}")
+        except MetaError as e:
+            if "not found" in str(e):
+                return None
+            raise

@@ -4,8 +4,8 @@ RuleBasedRouter rule_based.rs, hash.rs).
 ``RuleBasedRouter``: static config assigns tables to endpoints explicitly;
 unlisted tables hash onto the endpoint list (stable, like the reference's
 hash router), so a fixed topology needs no per-table configuration.
-``ClusterBasedRouter`` (meta-driven, cached routes) arrives with the
-coordinator in a later round behind the same interface.
+``ClusterBasedRouter``: meta-driven routes with a TTL cache
+(ref: cluster_based.rs + the route cache config, router/src/lib.rs:100).
 """
 
 from __future__ import annotations
@@ -22,6 +22,14 @@ class Route:
     table: str
     endpoint: str  # "host:port"
     is_local: bool
+    # Where the answer came from — the write path treats these
+    # differently (see server/http.py write fencing):
+    #   "owned"        this node's shard set says local
+    #   "meta"         the coordinator answered
+    #   "meta-unknown" the coordinator answered: no such table
+    #   "static"       rule/hash config (static clustering, standalone)
+    #   "fallback"     coordinator UNREACHABLE — not authoritative
+    source: str = "static"
 
 
 class Router(ABC):
@@ -43,6 +51,69 @@ class LocalOnlyRouter(Router):
 
     def endpoints(self) -> list[str]:
         return [self.self_endpoint]
+
+
+class ClusterBasedRouter(Router):
+    """Routes via the coordinator's table->shard->node map, cached with a
+    TTL. The local shard set short-circuits: tables this node serves are
+    local without a meta round-trip (and stay correct through failover —
+    shard orders update the cluster impl before routes matter)."""
+
+    def __init__(
+        self,
+        cluster,
+        meta_client,
+        cache_ttl_s: float = 5.0,
+        negative_ttl_s: float = 1.0,
+    ) -> None:
+        import time
+
+        self.cluster = cluster
+        self.meta = meta_client
+        self.cache_ttl_s = cache_ttl_s
+        # Unknown-table and coordinator-down answers are also cached
+        # (briefly): without this, an outage makes EVERY request pay the
+        # full meta endpoint sweep with connect timeouts.
+        self.negative_ttl_s = negative_ttl_s
+        self._cache: dict[str, tuple[float, Route]] = {}
+        self._time = time.monotonic
+
+    @property
+    def self_endpoint(self) -> str:
+        return self.cluster.self_endpoint
+
+    def route(self, table: str) -> Route:
+        if self.cluster.owns_table(table):
+            return Route(table, self.self_endpoint, True, source="owned")
+        now = self._time()
+        hit = self._cache.get(table)
+        if hit is not None:
+            ttl = (
+                self.cache_ttl_s if hit[1].source == "meta" else self.negative_ttl_s
+            )
+            if now - hit[0] < ttl:
+                return hit[1]
+        try:
+            info = self.meta.route(table)
+        except Exception:
+            # Coordinator unreachable. Reads degrade to local (owned
+            # tables keep serving; others produce table-not-found); the
+            # write path sees source="fallback" and REFUSES — accepting a
+            # write here could make two nodes write one table.
+            r = Route(table, self.self_endpoint, True, source="fallback")
+            self._cache[table] = (now, r)
+            return r
+        if info is None or not info.get("node"):
+            r = Route(table, self.self_endpoint, True, source="meta-unknown")
+            self._cache[table] = (now, r)
+            return r
+        ep = info["node"]
+        r = Route(table, ep, ep == self.self_endpoint, source="meta")
+        self._cache[table] = (now, r)
+        return r
+
+    def invalidate(self, table: str) -> None:
+        self._cache.pop(table, None)
 
 
 class RuleBasedRouter(Router):

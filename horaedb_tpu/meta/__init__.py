@@ -1,0 +1,32 @@
+"""Cluster coordinator — the horaemeta analog
+(ref: /root/reference/horaemeta/server/).
+
+The reference's coordinator is a Go service built on embedded etcd:
+topology + table metadata in etcd KV, leader election + shard locks via
+etcd leases, a persisted procedure state machine, and periodic schedulers
+(static / rebalanced / reopen) that converge shard placement
+(ref: horaemeta/server/server.go:47-148, coordinator/).
+
+This package re-expresses that control plane for the TPU build:
+
+- ``kv``         lease-capable KV with a file-backed impl (etcd-shaped
+                 interface; a real etcd backend can slot in unchanged)
+- ``topology``   nodes, shards, tables — versioned cluster state
+- ``procedure``  persisted state machine with retry (create/drop table,
+                 transfer shard)
+- ``scheduler``  static / rebalanced / reopen placement loops + the node
+                 inspector (heartbeat-lapse offline detection)
+- ``service``    the aiohttp meta server + event dispatch to data nodes
+"""
+
+from .kv import FileKV, LeaseKV, MemoryKV
+from .topology import NodeInfo, ShardView, TopologyManager
+
+__all__ = [
+    "FileKV",
+    "LeaseKV",
+    "MemoryKV",
+    "NodeInfo",
+    "ShardView",
+    "TopologyManager",
+]

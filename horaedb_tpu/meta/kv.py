@@ -1,0 +1,195 @@
+"""Lease-capable KV — the coordinator's storage + lock substrate
+(ref: horaemeta embeds etcd for exactly this, server.go:47-68; the data
+node's shard locks are etcd leases, cluster/src/shard_lock_manager.rs:23-60).
+
+The interface is deliberately etcd-shaped (put/get/range, compare-and-swap,
+leases with TTL + keepalive, keys bound to leases die with the lease) so a
+real etcd client could back it unchanged. Two impls:
+
+- ``MemoryKV``: in-process (unit tests, embedded meta).
+- ``FileKV``: every mutation journals to an append-only msgpack log with
+  periodic compaction — the meta server's procedures and topology survive
+  a restart, which is what makes procedure retry meaningful.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import msgpack
+
+
+@dataclass
+class _Lease:
+    lease_id: int
+    ttl_s: float
+    expires_at: float  # monotonic deadline
+    keys: set
+
+
+class LeaseKV:
+    """Shared logic; subclasses provide persistence hooks."""
+
+    def __init__(self) -> None:
+        self._data: dict[str, Any] = {}
+        self._versions: dict[str, int] = {}  # per-key mod revision
+        self._leases: dict[int, _Lease] = {}
+        self._lease_ids = itertools.count(1)
+        self._lock = threading.RLock()
+
+    # ---- persistence hooks (FileKV overrides) ---------------------------
+    def _journal(self, op: tuple) -> None:  # pragma: no cover - trivial
+        pass
+
+    # ---- leases ---------------------------------------------------------
+    def grant_lease(self, ttl_s: float) -> int:
+        with self._lock:
+            lid = next(self._lease_ids)
+            self._leases[lid] = _Lease(lid, ttl_s, time.monotonic() + ttl_s, set())
+            return lid
+
+    def keepalive(self, lease_id: int) -> bool:
+        """Extend the lease; False when it already expired (fencing!)."""
+        with self._lock:
+            self._expire_locked()
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return False
+            lease.expires_at = time.monotonic() + lease.ttl_s
+            return True
+
+    def revoke(self, lease_id: int) -> None:
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is not None:
+                for k in list(lease.keys):
+                    self._delete_locked(k)
+
+    def lease_alive(self, lease_id: int) -> bool:
+        with self._lock:
+            self._expire_locked()
+            return lease_id in self._leases
+
+    def _expire_locked(self) -> None:
+        now = time.monotonic()
+        dead = [l for l in self._leases.values() if l.expires_at <= now]
+        for lease in dead:
+            del self._leases[lease.lease_id]
+            for k in list(lease.keys):
+                self._delete_locked(k)
+
+    # ---- KV -------------------------------------------------------------
+    def put(self, key: str, value: Any, lease_id: Optional[int] = None) -> None:
+        with self._lock:
+            self._expire_locked()
+            if lease_id is not None:
+                lease = self._leases.get(lease_id)
+                if lease is None:
+                    raise KeyError(f"lease {lease_id} expired or unknown")
+                lease.keys.add(key)
+            self._data[key] = value
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._journal(("put", key, value))
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            self._expire_locked()
+            return self._data.get(key)
+
+    def get_prefix(self, prefix: str) -> dict[str, Any]:
+        with self._lock:
+            self._expire_locked()
+            return {k: v for k, v in self._data.items() if k.startswith(prefix)}
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            self._expire_locked()
+            return self._delete_locked(key)
+
+    def _delete_locked(self, key: str) -> bool:
+        existed = key in self._data
+        self._data.pop(key, None)
+        if existed:
+            self._versions[key] = self._versions.get(key, 0) + 1
+            self._journal(("del", key))
+        return existed
+
+    def cas(self, key: str, expect: Any, value: Any, lease_id: Optional[int] = None) -> bool:
+        """Atomic compare-and-swap on the VALUE (etcd txn analog); the
+        election/lock primitive. ``expect=None`` means "key must be absent"."""
+        with self._lock:
+            self._expire_locked()
+            current = self._data.get(key)
+            if current != expect:
+                return False
+            self.put(key, value, lease_id=lease_id)
+            return True
+
+
+class MemoryKV(LeaseKV):
+    pass
+
+
+class FileKV(LeaseKV):
+    """Append-only msgpack journal with load-time replay + compaction.
+
+    Leases are NOT persisted (a meta restart loses in-flight leases, just
+    like an etcd leader change expires keepalives in practice) — lease-
+    bound keys are re-established by the next heartbeat/keepalive cycle.
+    """
+
+    _COMPACT_EVERY = 4096  # journal ops between compactions
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self.path = path
+        self._ops_since_compact = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._load()
+        self._fh = open(self.path, "ab")
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            unpacker = msgpack.Unpacker(f, raw=False, strict_map_key=False)
+            for op in unpacker:
+                try:
+                    kind, key = op[0], op[1]
+                    if kind == "put":
+                        self._data[key] = op[2]
+                    elif kind == "del":
+                        self._data.pop(key, None)
+                except (IndexError, TypeError):
+                    break  # torn tail from a crash mid-append: stop replay
+
+    def _journal(self, op: tuple) -> None:
+        self._fh.write(msgpack.packb(list(op)))
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._ops_since_compact += 1
+        if self._ops_since_compact >= self._COMPACT_EVERY:
+            self._compact()
+
+    def _compact(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            for k, v in self._data.items():
+                f.write(msgpack.packb(["put", k, v]))
+            f.flush()
+            os.fsync(f.fileno())
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "ab")
+        self._ops_since_compact = 0
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except Exception:
+            pass

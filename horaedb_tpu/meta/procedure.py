@@ -1,0 +1,188 @@
+"""Persisted procedure state machine with retry
+(ref: horaemeta/server/coordinator/procedure/procedure.go:30-104 — states
+{init, running, finished, failed, cancelled}; kinds TransferLeader /
+CreateTable / DropTable /...; persisted in etcd storage.go; retried via a
+delay queue, manager_impl.go + delay_queue.go).
+
+A procedure is a small idempotent step list that mutates topology and
+dispatches shard events to data nodes. Every state transition persists to
+the KV BEFORE side effects continue, so a meta restart resumes (retries)
+unfinished procedures instead of forgetting them.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .kv import LeaseKV
+
+logger = logging.getLogger("horaedb_tpu.meta.procedure")
+
+_K_PROC = "procedure/"
+
+
+class ProcState(enum.Enum):
+    INIT = "init"
+    RUNNING = "running"
+    FINISHED = "finished"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class Procedure:
+    proc_id: int
+    kind: str  # "create_table" | "drop_table" | "transfer_shard"
+    params: dict
+    state: ProcState = ProcState.INIT
+    attempts: int = 0
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "proc_id": self.proc_id,
+            "kind": self.kind,
+            "params": self.params,
+            "state": self.state.value,
+            "attempts": self.attempts,
+            "error": self.error,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Procedure":
+        return Procedure(
+            proc_id=int(d["proc_id"]),
+            kind=d["kind"],
+            params=d["params"],
+            state=ProcState(d["state"]),
+            attempts=int(d.get("attempts", 0)),
+            error=d.get("error", ""),
+        )
+
+
+class ProcedureManager:
+    """Runs procedures; persists every transition; retries failures.
+
+    ``handlers[kind](proc) -> None`` performs the work (raises on failure).
+    Retry is a bounded-backoff delay queue: a failed procedure re-enters
+    RUNNING after ``retry_delay_s * attempts`` until ``max_attempts``.
+    """
+
+    def __init__(
+        self,
+        kv: LeaseKV,
+        handlers: dict[str, Callable[[Procedure], None]],
+        max_attempts: int = 5,
+        retry_delay_s: float = 0.5,
+    ) -> None:
+        self.kv = kv
+        self.handlers = handlers
+        self.max_attempts = max_attempts
+        self.retry_delay_s = retry_delay_s
+        self._lock = threading.RLock()
+        self._procs: dict[int, Procedure] = {}
+        self._retry_at: dict[int, float] = {}
+        self._executing: set[int] = set()
+        max_id = 0
+        for _, v in kv.get_prefix(_K_PROC).items():
+            p = Procedure.from_dict(v)
+            self._procs[p.proc_id] = p
+            max_id = max(max_id, p.proc_id)
+            if p.state in (ProcState.INIT, ProcState.RUNNING):
+                # Crash mid-procedure: resume on the next tick.
+                self._retry_at[p.proc_id] = 0.0
+        self._ids = itertools.count(max_id + 1)
+
+    def submit(self, kind: str, params: dict, defer: bool = True) -> Procedure:
+        """``defer=False``: the caller will _execute inline — do NOT also
+        schedule it for tick(), or the loop thread races the caller and
+        runs the handler twice concurrently."""
+        with self._lock:
+            p = Procedure(next(self._ids), kind, params)
+            self._procs[p.proc_id] = p
+            self._persist(p)
+            if defer:
+                self._retry_at[p.proc_id] = 0.0
+            return p
+
+    def run_sync(self, kind: str, params: dict) -> Procedure:
+        """Submit and execute inline (the create-table RPC path: the caller
+        wants the result now; retry still covers later failures)."""
+        p = self.submit(kind, params, defer=False)
+        self._execute(p)
+        return p
+
+    def tick(self) -> None:
+        """Drive pending/failed procedures whose retry delay elapsed."""
+        now = time.monotonic()
+        with self._lock:
+            due = [
+                pid
+                for pid, at in self._retry_at.items()
+                if at <= now
+                and self._procs[pid].state in (ProcState.INIT, ProcState.RUNNING)
+            ]
+        for pid in due:
+            self._execute(self._procs[pid])
+
+    def _execute(self, p: Procedure) -> None:
+        handler = self.handlers.get(p.kind)
+        if handler is None:
+            self._transition(p, ProcState.FAILED, error=f"no handler for {p.kind}")
+            return
+        with self._lock:
+            # One executor at a time per procedure (tick thread vs RPC
+            # thread); a lost race simply skips — the winner persists the
+            # outcome and failure re-queues via _retry_at.
+            if p.proc_id in self._executing or p.state in (
+                ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED,
+            ):
+                return
+            self._executing.add(p.proc_id)
+            self._retry_at.pop(p.proc_id, None)
+        try:
+            self._run_guarded(p, handler)
+        finally:
+            with self._lock:
+                self._executing.discard(p.proc_id)
+
+    def _run_guarded(self, p: Procedure, handler) -> None:
+        self._transition(p, ProcState.RUNNING)
+        p.attempts += 1
+        try:
+            handler(p)
+        except Exception as e:
+            logger.warning("procedure %s #%d failed (attempt %d): %s",
+                           p.kind, p.proc_id, p.attempts, e)
+            if p.attempts >= self.max_attempts:
+                self._transition(p, ProcState.FAILED, error=str(e))
+            else:
+                p.error = str(e)
+                self._persist(p)
+                with self._lock:
+                    self._retry_at[p.proc_id] = (
+                        time.monotonic() + self.retry_delay_s * p.attempts
+                    )
+            return
+        self._transition(p, ProcState.FINISHED)
+
+    def _transition(self, p: Procedure, state: ProcState, error: str = "") -> None:
+        with self._lock:
+            p.state = state
+            p.error = error
+            self._persist(p)
+            if state in (ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED):
+                self._retry_at.pop(p.proc_id, None)
+
+    def _persist(self, p: Procedure) -> None:
+        self.kv.put(f"{_K_PROC}{p.proc_id}", p.to_dict())
+
+    def list(self) -> list[Procedure]:
+        with self._lock:
+            return list(self._procs.values())

@@ -1,0 +1,115 @@
+"""Shard placement schedulers + node inspector
+(ref: horaemeta/server/coordinator/scheduler/{static,rebalanced,reopen}/
+scheduler.go and inspector/node_inspector.go:40-68).
+
+Each scheduler inspects topology and emits transfer decisions; the meta
+server turns decisions into transfer_shard procedures. All three run on
+the coordinator's periodic tick:
+
+- inspector:  nodes silent past the heartbeat timeout go offline;
+- reopen:     shards on offline nodes are reassigned to online nodes;
+- static:     unassigned shards go to the least-loaded online node;
+- rebalanced: when load skew exceeds one shard, move one from the most-
+              to the least-loaded node (one move per tick keeps churn low;
+              the reference's bounded-loads consistent hashing has the
+              same goal — placement stability under small changes).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from .topology import TopologyManager
+
+
+@dataclass(frozen=True)
+class Transfer:
+    shard_id: int
+    to_node: Optional[str]  # None = leave unassigned (no online nodes)
+    reason: str
+
+
+class NodeInspector:
+    def __init__(self, topology: TopologyManager, heartbeat_timeout_s: float = 10.0):
+        self.topology = topology
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+
+    def inspect(self) -> list[str]:
+        """Mark silent nodes offline; returns newly offline endpoints."""
+        now = time.monotonic()
+        newly = []
+        for n in self.topology.nodes():
+            if n.online and now - n.last_heartbeat > self.heartbeat_timeout_s:
+                self.topology.mark_offline(n.endpoint)
+                newly.append(n.endpoint)
+        return newly
+
+
+def _load(topology: TopologyManager) -> dict[str, int]:
+    load = {n.endpoint: 0 for n in topology.online_nodes()}
+    for s in topology.shards():
+        if s.node in load:
+            load[s.node] += 1
+    return load
+
+
+class StaticScheduler:
+    """Assign every unassigned shard to the least-loaded online node."""
+
+    def __init__(self, topology: TopologyManager) -> None:
+        self.topology = topology
+
+    def schedule(self) -> list[Transfer]:
+        load = _load(self.topology)
+        if not load:
+            return []
+        out = []
+        for s in self.topology.shards():
+            if s.node is None or s.node not in load:
+                target = min(load, key=lambda e: (load[e], e))
+                load[target] += 1
+                out.append(Transfer(s.shard_id, target, "static: unassigned"))
+        return out
+
+
+class ReopenScheduler:
+    """Move shards off offline nodes (failover)."""
+
+    def __init__(self, topology: TopologyManager) -> None:
+        self.topology = topology
+
+    def schedule(self) -> list[Transfer]:
+        online = {n.endpoint for n in self.topology.online_nodes()}
+        if not online:
+            return []
+        load = _load(self.topology)
+        out = []
+        for s in self.topology.shards():
+            if s.node is not None and s.node not in online:
+                target = min(load, key=lambda e: (load[e], e))
+                load[target] += 1
+                out.append(Transfer(s.shard_id, target, f"reopen: {s.node} offline"))
+        return out
+
+
+class RebalancedScheduler:
+    """One move per tick from the most- to the least-loaded node when the
+    skew exceeds one shard."""
+
+    def __init__(self, topology: TopologyManager) -> None:
+        self.topology = topology
+
+    def schedule(self) -> list[Transfer]:
+        load = _load(self.topology)
+        if len(load) < 2:
+            return []
+        hot = max(load, key=lambda e: (load[e], e))
+        cold = min(load, key=lambda e: (load[e], e))
+        if load[hot] - load[cold] <= 1:
+            return []
+        for s in self.topology.shards():
+            if s.node == hot:
+                return [Transfer(s.shard_id, cold, f"rebalance: {hot} -> {cold}")]
+        return []

@@ -1,0 +1,364 @@
+"""The meta server: HTTP service + coordination loop
+(ref: horaemeta/server/service/grpc/service.go:72-449 for the RPC surface,
+server/coordinator/ for the loop; transport here is HTTP+JSON — the
+framework's DCN protocol for control traffic).
+
+Endpoints (prefix /meta/v1):
+
+    POST /node/heartbeat   {endpoint, shards:[{shard_id, version}]}
+                           -> {desired:[ShardOrder...], lease_ttl_s}
+    POST /table/create     {name, create_sql} -> {table_id, shard_id, node}
+    POST /table/drop       {name} -> {dropped}
+    GET  /route/{table}    -> {node, shard_id, version}
+    GET  /nodes | /shards | /procedures | /health      (diagnostics)
+
+Placement loop (one background thread): inspector marks silent nodes
+offline -> reopen scheduler moves their shards -> static scheduler assigns
+fresh shards -> optional rebalance -> procedure retries tick.
+
+Heartbeats are DECLARATIVE: the reply carries the node's full desired
+shard set (with versions, fencing leases, and the tables on each shard);
+the node reconciles. Event dispatch (meta -> node POST) makes transfers
+prompt; a missed event heals on the next heartbeat. The reference splits
+these into MetaEventService pushes + heartbeat state sync — same design,
+two delivery paths, reconciliation wins.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from aiohttp import web
+
+from .kv import FileKV, LeaseKV, MemoryKV
+from .procedure import ProcedureManager, Procedure
+from .scheduler import (
+    NodeInspector,
+    RebalancedScheduler,
+    ReopenScheduler,
+    StaticScheduler,
+    Transfer,
+)
+from .topology import TopologyManager
+
+logger = logging.getLogger("horaedb_tpu.meta")
+
+DEFAULT_META_PORT = 2379  # etcd's default client port — familiar territory
+
+
+def _post(endpoint: str, path: str, payload: dict, timeout: float = 5.0) -> dict:
+    req = urllib.request.Request(
+        f"http://{endpoint}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode() or "{}")
+
+
+class MetaServer:
+    def __init__(
+        self,
+        kv: Optional[LeaseKV] = None,
+        num_shards: int = 8,
+        lease_ttl_s: float = 5.0,
+        heartbeat_timeout_s: float = 6.0,
+        rebalance: bool = True,
+    ) -> None:
+        self.kv = kv if kv is not None else MemoryKV()
+        self.topology = TopologyManager(self.kv, num_shards=num_shards)
+        self.lease_ttl_s = lease_ttl_s
+        self.inspector = NodeInspector(self.topology, heartbeat_timeout_s)
+        self.schedulers = [ReopenScheduler(self.topology), StaticScheduler(self.topology)]
+        if rebalance:
+            self.schedulers.append(RebalancedScheduler(self.topology))
+        self.procedures = ProcedureManager(
+            self.kv,
+            handlers={
+                "create_table": self._run_create_table,
+                "drop_table": self._run_drop_table,
+                "transfer_shard": self._run_transfer_shard,
+            },
+        )
+        # One mutation at a time: the reference gets global DDL ordering
+        # from raft; a single-process meta gets it from this lock (it also
+        # serializes the shared catalog registry's read-modify-write).
+        self._ddl_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ------------------------------------------------------
+    def start_loop(self, interval_s: float = 1.0) -> None:
+        def run():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    logger.exception("meta tick failed")
+
+        self._loop_thread = threading.Thread(target=run, daemon=True, name="meta-loop")
+        self._loop_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+
+    # ---- coordination tick ----------------------------------------------
+    def tick(self) -> None:
+        newly_offline = self.inspector.inspect()
+        for ep in newly_offline:
+            logger.warning("node %s marked offline (heartbeat lapsed)", ep)
+        transfers: list[Transfer] = []
+        for sched in self.schedulers:
+            transfers.extend(sched.schedule())
+        for tr in transfers:
+            self.procedures.run_sync(
+                "transfer_shard",
+                {"shard_id": tr.shard_id, "to_node": tr.to_node, "reason": tr.reason},
+            )
+        self.procedures.tick()
+
+    # ---- procedure bodies ----------------------------------------------
+    def _run_transfer_shard(self, p: Procedure) -> None:
+        shard_id = p.params["shard_id"]
+        to_node = p.params["to_node"]
+        shard = self.topology.shard(shard_id)
+        old_node = shard.node if shard else None
+        lease_id = self.kv.grant_lease(self.lease_ttl_s)
+        view = self.topology.assign_shard(shard_id, to_node, lease_id=lease_id)
+        # Best-effort close on the old owner (it may be dead — that's WHY
+        # we're transferring; its lease expiry fences any straggler writes).
+        if old_node and old_node != to_node:
+            try:
+                _post(old_node, "/meta_event/close_shard",
+                      {"shard_id": shard_id, "version": view.version})
+            except Exception:
+                pass
+        if to_node:
+            _post(to_node, "/meta_event/open_shard", self._shard_order(view))
+
+    def _run_create_table(self, p: Procedure) -> None:
+        name, create_sql = p.params["name"], p.params["create_sql"]
+        shard_id = p.params["shard_id"]
+        shard = self.topology.shard(shard_id)
+        if shard is None or shard.node is None:
+            raise RuntimeError(f"shard {shard_id} unassigned; retrying")
+        resp = _post(
+            shard.node,
+            "/meta_event/create_table_on_shard",
+            {"shard_id": shard_id, "name": name, "create_sql": create_sql,
+             "version": shard.version},
+        )
+        table_id = int(resp["table_id"])
+        if self.topology.table(name) is None:
+            self.topology.add_table(name, table_id, shard_id, create_sql)
+
+    def _run_drop_table(self, p: Procedure) -> None:
+        name = p.params["name"]
+        tm = self.topology.table(name)
+        if tm is None:
+            return
+        shard = self.topology.shard(tm.shard_id)
+        if shard is not None and shard.node:
+            _post(shard.node, "/meta_event/drop_table_on_shard",
+                  {"shard_id": tm.shard_id, "name": name})
+        self.topology.drop_table(name)
+
+    # ---- RPC bodies ------------------------------------------------------
+    def _shard_order(self, view) -> dict:
+        """The declarative per-shard order sent to a data node."""
+        return {
+            "shard_id": view.shard_id,
+            "version": view.version,
+            "lease_id": view.lease_id,
+            "lease_ttl_s": self.lease_ttl_s,
+            "tables": [
+                {"name": t.name, "table_id": t.table_id, "create_sql": t.create_sql}
+                for t in self.topology.tables_of_shard(view.shard_id)
+            ],
+        }
+
+    def handle_heartbeat(self, endpoint: str) -> dict:
+        self.topology.heartbeat(endpoint)
+        desired = []
+        for view in self.topology.shards_of_node(endpoint):
+            # Renew the fencing lease while the owner heartbeats.
+            if view.lease_id and not self.kv.keepalive(view.lease_id):
+                # Lease lapsed (e.g. meta restarted): issue a fresh one so
+                # the owner keeps serving without a spurious transfer.
+                lease_id = self.kv.grant_lease(self.lease_ttl_s)
+                view = self.topology.assign_shard(
+                    view.shard_id, endpoint, lease_id=lease_id
+                )
+            desired.append(self._shard_order(view))
+        return {"desired": desired, "lease_ttl_s": self.lease_ttl_s}
+
+    def handle_create_table(self, name: str, create_sql: str) -> dict:
+        with self._ddl_lock:
+            existing = self.topology.table(name)
+            if existing is not None:
+                shard = self.topology.shard(existing.shard_id)
+                return {
+                    "table_id": existing.table_id,
+                    "shard_id": existing.shard_id,
+                    "node": shard.node if shard else None,
+                    "existed": True,
+                }
+            shard_id = self.topology.pick_shard_for_table()
+            p = self.procedures.run_sync(
+                "create_table",
+                {"name": name, "create_sql": create_sql, "shard_id": shard_id},
+            )
+            if p.state.value != "finished":
+                raise RuntimeError(f"create_table failed: {p.error}")
+            tm = self.topology.table(name)
+            shard = self.topology.shard(tm.shard_id)
+            return {
+                "table_id": tm.table_id,
+                "shard_id": tm.shard_id,
+                "node": shard.node if shard else None,
+                "existed": False,
+            }
+
+    def handle_drop_table(self, name: str) -> dict:
+        with self._ddl_lock:
+            p = self.procedures.run_sync("drop_table", {"name": name})
+            if p.state.value != "finished":
+                raise RuntimeError(f"drop_table failed: {p.error}")
+            return {"dropped": True}
+
+    def handle_route(self, table: str) -> Optional[dict]:
+        hit = self.topology.route(table)
+        if hit is None:
+            return None
+        tm, shard = hit
+        return {
+            "table": table,
+            "node": shard.node,
+            "shard_id": shard.shard_id,
+            "version": shard.version,
+        }
+
+
+def create_meta_app(server: MetaServer) -> web.Application:
+    app = web.Application()
+    app["meta"] = server
+
+    async def heartbeat(request: web.Request) -> web.Response:
+        body = await request.json()
+        ep = body.get("endpoint")
+        if not isinstance(ep, str) or not ep:
+            return web.json_response({"error": "missing 'endpoint'"}, status=400)
+        return web.json_response(server.handle_heartbeat(ep))
+
+    async def create_table(request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            import asyncio
+
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, server.handle_create_table, body["name"], body["create_sql"]
+            )
+            return web.json_response(out)
+        except KeyError as e:
+            return web.json_response({"error": f"missing {e}"}, status=400)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+
+    async def drop_table(request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            import asyncio
+
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, server.handle_drop_table, body["name"]
+            )
+            return web.json_response(out)
+        except KeyError as e:
+            return web.json_response({"error": f"missing {e}"}, status=400)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+
+    async def route(request: web.Request) -> web.Response:
+        out = server.handle_route(request.match_info["table"])
+        if out is None:
+            return web.json_response({"error": "table not found"}, status=404)
+        return web.json_response(out)
+
+    async def nodes(request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "nodes": [
+                    {
+                        "endpoint": n.endpoint,
+                        "online": n.online,
+                        "shard_ids": list(n.shard_ids),
+                    }
+                    for n in server.topology.nodes()
+                ]
+            }
+        )
+
+    async def shards(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"shards": [s.to_dict() for s in server.topology.shards()]}
+        )
+
+    async def procedures(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"procedures": [p.to_dict() for p in server.procedures.list()]}
+        )
+
+    async def health(request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    app.router.add_post("/meta/v1/node/heartbeat", heartbeat)
+    app.router.add_post("/meta/v1/table/create", create_table)
+    app.router.add_post("/meta/v1/table/drop", drop_table)
+    app.router.add_get("/meta/v1/route/{table}", route)
+    app.router.add_get("/meta/v1/nodes", nodes)
+    app.router.add_get("/meta/v1/shards", shards)
+    app.router.add_get("/meta/v1/procedures", procedures)
+    app.router.add_get("/health", health)
+    return app
+
+
+def main() -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="horaedb_tpu meta server (coordinator)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=DEFAULT_META_PORT)
+    p.add_argument("--data-dir", default=None, help="meta state dir (default: memory)")
+    p.add_argument("--num-shards", type=int, default=8)
+    p.add_argument("--lease-ttl", type=float, default=5.0)
+    p.add_argument("--heartbeat-timeout", type=float, default=6.0)
+    p.add_argument("--tick-interval", type=float, default=1.0)
+    p.add_argument("--log-level", default="info")
+    args = p.parse_args()
+    logging.basicConfig(level=args.log_level.upper())
+    kv = FileKV(f"{args.data_dir}/meta.kv") if args.data_dir else MemoryKV()
+    server = MetaServer(
+        kv,
+        num_shards=args.num_shards,
+        lease_ttl_s=args.lease_ttl,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+    )
+    server.start_loop(args.tick_interval)
+    app = create_meta_app(server)
+    logger.info("meta server on %s:%d", args.host, args.port)
+    try:
+        web.run_app(app, host=args.host, port=args.port, print=None)
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

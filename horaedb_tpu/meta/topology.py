@@ -1,0 +1,241 @@
+"""Cluster topology: nodes, shards, tables
+(ref: horaemeta/server/cluster/metadata/{cluster_metadata,topology_manager,
+table_manager}.go).
+
+State model (all persisted through the KV, versioned):
+
+- ``NodeInfo``     endpoint + liveness (heartbeat timestamps live in
+                   memory; the KV holds registration only)
+- ``ShardView``    shard -> owning node, version-fenced; version bumps on
+                   every reassignment so data nodes can reject stale
+                   updates (ref: topology_manager.go shard versions,
+                   cluster/src/lib.rs:145-158)
+- tables           name -> (table_id, shard_id, create SQL); shard picked
+                   at create time by least-loaded (ref: the coordinator's
+                   persist_shard_picker.go)
+
+The meta service serializes all mutations through one lock — horaemeta
+gets this from raft/etcd single-writer semantics; a single-process meta
+gets it from a mutex. Multi-meta HA would layer leader election on
+``LeaseKV.cas`` (same primitive the reference uses).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .kv import LeaseKV
+
+_K_NODE = "node/"
+_K_SHARD = "shard/"
+_K_TABLE = "table/"
+_K_IDS = "meta/next_table_id"
+
+
+@dataclass
+class NodeInfo:
+    endpoint: str
+    online: bool = True
+    last_heartbeat: float = 0.0  # monotonic
+    shard_ids: tuple[int, ...] = ()
+
+
+@dataclass
+class ShardView:
+    shard_id: int
+    node: Optional[str]  # owning endpoint, None = unassigned
+    version: int = 0
+    table_ids: tuple[int, ...] = ()
+    lease_id: int = 0  # fencing token handed to the owning node
+
+    def to_dict(self) -> dict:
+        return {
+            "shard_id": self.shard_id,
+            "node": self.node,
+            "version": self.version,
+            "table_ids": list(self.table_ids),
+            "lease_id": self.lease_id,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ShardView":
+        return ShardView(
+            shard_id=int(d["shard_id"]),
+            node=d.get("node"),
+            version=int(d.get("version", 0)),
+            table_ids=tuple(d.get("table_ids", ())),
+            lease_id=int(d.get("lease_id", 0)),
+        )
+
+
+@dataclass
+class TableMeta:
+    name: str
+    table_id: int
+    shard_id: int
+    create_sql: str
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "table_id": self.table_id,
+            "shard_id": self.shard_id,
+            "create_sql": self.create_sql,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TableMeta":
+        return TableMeta(d["name"], int(d["table_id"]), int(d["shard_id"]), d["create_sql"])
+
+
+class TopologyManager:
+    def __init__(self, kv: LeaseKV, num_shards: int = 8) -> None:
+        self.kv = kv
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeInfo] = {}  # liveness is memory-only
+        self._shards: dict[int, ShardView] = {}
+        self._tables: dict[str, TableMeta] = {}
+        self._load()
+        if not self._shards:
+            for sid in range(num_shards):
+                self._shards[sid] = ShardView(sid, None)
+                self.kv.put(f"{_K_SHARD}{sid}", self._shards[sid].to_dict())
+
+    def _load(self) -> None:
+        for k, v in self.kv.get_prefix(_K_SHARD).items():
+            sv = ShardView.from_dict(v)
+            self._shards[sv.shard_id] = sv
+        for k, v in self.kv.get_prefix(_K_TABLE).items():
+            tm = TableMeta.from_dict(v)
+            self._tables[tm.name] = tm
+        for k, v in self.kv.get_prefix(_K_NODE).items():
+            # Registered nodes come back OFFLINE until they heartbeat.
+            self._nodes[v["endpoint"]] = NodeInfo(v["endpoint"], online=False)
+
+    # ---- nodes ----------------------------------------------------------
+    def register_node(self, endpoint: str) -> NodeInfo:
+        with self._lock:
+            node = self._nodes.get(endpoint)
+            if node is None:
+                node = NodeInfo(endpoint)
+                self._nodes[endpoint] = node
+                self.kv.put(f"{_K_NODE}{endpoint}", {"endpoint": endpoint})
+            node.online = True
+            node.last_heartbeat = time.monotonic()
+            return node
+
+    def heartbeat(self, endpoint: str) -> NodeInfo:
+        return self.register_node(endpoint)
+
+    def mark_offline(self, endpoint: str) -> None:
+        with self._lock:
+            node = self._nodes.get(endpoint)
+            if node is not None:
+                node.online = False
+
+    def nodes(self) -> list[NodeInfo]:
+        with self._lock:
+            out = []
+            for n in self._nodes.values():
+                n.shard_ids = tuple(
+                    s.shard_id for s in self._shards.values() if s.node == n.endpoint
+                )
+                out.append(n)
+            return out
+
+    def online_nodes(self) -> list[NodeInfo]:
+        return [n for n in self.nodes() if n.online]
+
+    # ---- shards ----------------------------------------------------------
+    def shards(self) -> list[ShardView]:
+        with self._lock:
+            return [ShardView(**vars(s)) for s in self._shards.values()]
+
+    def shard(self, shard_id: int) -> Optional[ShardView]:
+        with self._lock:
+            s = self._shards.get(shard_id)
+            return None if s is None else ShardView(**vars(s))
+
+    def assign_shard(self, shard_id: int, node: Optional[str], lease_id: int = 0) -> ShardView:
+        """(Re)assign a shard; bumps the version (the fencing token)."""
+        with self._lock:
+            s = self._shards[shard_id]
+            s.node = node
+            s.version += 1
+            s.lease_id = lease_id
+            self.kv.put(f"{_K_SHARD}{shard_id}", s.to_dict())
+            return ShardView(**vars(s))
+
+    def shards_of_node(self, endpoint: str) -> list[ShardView]:
+        with self._lock:
+            return [
+                ShardView(**vars(s))
+                for s in self._shards.values()
+                if s.node == endpoint
+            ]
+
+    # ---- tables ----------------------------------------------------------
+    def pick_shard_for_table(self) -> int:
+        """Least-loaded ASSIGNED shard; falls back to least-loaded overall
+        (ref: shard_picker.go picks by table count)."""
+        with self._lock:
+            assigned = [s for s in self._shards.values() if s.node is not None]
+            pool = assigned or list(self._shards.values())
+            return min(pool, key=lambda s: (len(s.table_ids), s.shard_id)).shard_id
+
+    def alloc_table_id(self) -> int:
+        with self._lock:
+            nxt = int(self.kv.get(_K_IDS) or 1)
+            self.kv.put(_K_IDS, nxt + 1)
+            return nxt
+
+    def add_table(self, name: str, table_id: int, shard_id: int, create_sql: str) -> TableMeta:
+        with self._lock:
+            if name in self._tables:
+                raise ValueError(f"table exists: {name}")
+            tm = TableMeta(name, table_id, shard_id, create_sql)
+            self._tables[name] = tm
+            self.kv.put(f"{_K_TABLE}{name}", tm.to_dict())
+            s = self._shards[shard_id]
+            s.table_ids = (*s.table_ids, table_id)
+            s.version += 1
+            self.kv.put(f"{_K_SHARD}{shard_id}", s.to_dict())
+            return tm
+
+    def drop_table(self, name: str) -> Optional[TableMeta]:
+        with self._lock:
+            tm = self._tables.pop(name, None)
+            if tm is None:
+                return None
+            self.kv.delete(f"{_K_TABLE}{name}")
+            s = self._shards.get(tm.shard_id)
+            if s is not None:
+                s.table_ids = tuple(t for t in s.table_ids if t != tm.table_id)
+                s.version += 1
+                self.kv.put(f"{_K_SHARD}{s.shard_id}", s.to_dict())
+            return tm
+
+    def table(self, name: str) -> Optional[TableMeta]:
+        with self._lock:
+            return self._tables.get(name)
+
+    def tables(self) -> list[TableMeta]:
+        with self._lock:
+            return list(self._tables.values())
+
+    def tables_of_shard(self, shard_id: int) -> list[TableMeta]:
+        with self._lock:
+            return [t for t in self._tables.values() if t.shard_id == shard_id]
+
+    def route(self, table_name: str) -> Optional[tuple[TableMeta, ShardView]]:
+        with self._lock:
+            tm = self._tables.get(table_name)
+            if tm is None:
+                return None
+            s = self._shards.get(tm.shard_id)
+            if s is None:
+                return None
+            return tm, ShardView(**vars(s))

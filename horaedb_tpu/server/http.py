@@ -87,12 +87,58 @@ def _dumps(obj: Any) -> str:
 FORWARD_HEADER = "X-HoraeDB-Forwarded"
 
 
-def create_app(conn: Connection, router=None) -> web.Application:
+def _check_writable(cluster, table: str) -> Optional[web.Response]:
+    """None when writes may proceed; a 503 when the shard lease fences
+    them off (ref: shard_lock_manager single-writer guarantee)."""
+    from ..cluster import ShardError
+
+    try:
+        cluster.ensure_table_writable(table)
+    except ShardError as e:
+        return web.json_response({"error": str(e)}, status=503)
+    return None
+
+
+def _write_fence(cluster, router, table: str) -> Optional[web.Response]:
+    """Single-writer discipline for the write paths (cluster mode).
+
+    None = safe to proceed (execute locally or forward); a Response = the
+    write must be refused NOW. The catalog registry lives in shared
+    storage, so "the table opens locally" proves nothing about ownership —
+    only the shard set + a live lease (or an authoritative remote route)
+    makes a write safe.
+    """
+    if cluster is None:
+        return None
+    if cluster.owns_table(table):
+        return _check_writable(cluster, table)
+    r = router.route(table)
+    if not r.is_local:
+        return None  # forwarded to the owner below
+    if r.source == "fallback":
+        return web.json_response(
+            {"error": f"coordinator unreachable; cannot safely accept writes for {table!r}"},
+            status=503,
+        )
+    if r.source == "meta":
+        # Coordinator says this node owns it, but the shard isn't open
+        # here yet (transfer in flight) — retryable, never unfenced.
+        return web.json_response(
+            {"error": f"shard for {table!r} is opening on this node; retry"},
+            status=503,
+        )
+    return None  # meta-unknown: local execution yields table-not-found
+
+
+def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
+    """``cluster``: a ClusterImpl when this node runs under a coordinator;
+    adds the /meta_event endpoints, meta-driven DDL, and write fencing."""
     proxy = Proxy(conn)
     app = web.Application()
     app["conn"] = conn
     app["proxy"] = proxy
     app["router"] = router
+    app["cluster"] = cluster
     app.on_cleanup.append(_close_client_session)
 
     async def _close_proxy(app_):
@@ -168,6 +214,32 @@ def create_app(conn: Connection, router=None) -> web.Application:
                 proxy._m_queries.inc()
                 proxy._m_errors.inc()
                 return web.json_response({"error": str(e)}, status=422)
+            from ..query import ast as _ast
+
+            if cluster is not None and isinstance(
+                stmt, (_ast.CreateTable, _ast.DropTable)
+            ):
+                # Cluster DDL goes through the coordinator: IT picks the
+                # owning shard/node and dispatches the actual create
+                # (ref: meta_based TableManipulator, write.rs:176-263).
+                def ddl():
+                    if isinstance(stmt, _ast.CreateTable):
+                        return cluster.meta.create_table(stmt.table, query)
+                    return cluster.meta.drop_table(stmt.table)
+
+                try:
+                    await asyncio.get_running_loop().run_in_executor(None, ddl)
+                except Exception as e:
+                    # The coordinator already implements IF NOT EXISTS /
+                    # IF EXISTS leniency (existed=True / silent drop), so
+                    # any error surfacing here is a REAL failure — never
+                    # report success for DDL that happened nowhere.
+                    return web.json_response({"error": str(e)}, status=422)
+                return web.json_response({"affected_rows": 0})
+            if cluster is not None and isinstance(stmt, _ast.Insert):
+                fence = _write_fence(cluster, router, stmt.table)
+                if fence is not None:
+                    return fence
             forwarded = await _forward_if_remote(request, _table_of_statement(stmt))
             if forwarded is not None:
                 return forwarded
@@ -197,6 +269,10 @@ def create_app(conn: Connection, router=None) -> web.Application:
             return web.json_response(
                 {"error": "body must be {'table': t, 'rows': [{...}]}"}, status=400
             )
+        if cluster is not None:
+            fence = _write_fence(cluster, router, table)
+            if fence is not None:
+                return fence
         forwarded = await _forward_if_remote(request, table)
         if forwarded is not None:
             return forwarded
@@ -452,6 +528,65 @@ def create_app(conn: Connection, router=None) -> web.Application:
             proxy.limiter.unblock(tables)
         return web.json_response({"blocked": proxy.limiter.blocked()})
 
+    # ---- meta events (coordinator -> data node; ref: MetaEventService,
+    # grpc/meta_event_service/mod.rs:638-696) ----------------------------
+    async def meta_open_shard(request: web.Request) -> web.Response:
+        if cluster is None:
+            return web.json_response({"error": "not in cluster mode"}, status=400)
+        order = await request.json()
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, cluster.apply_shard_order, order
+            )
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response({"ok": True})
+
+    async def meta_close_shard(request: web.Request) -> web.Response:
+        if cluster is None:
+            return web.json_response({"error": "not in cluster mode"}, status=400)
+        body = await request.json()
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, cluster.close_shard, int(body["shard_id"]), body.get("version")
+            )
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response({"ok": True})
+
+    async def meta_create_table(request: web.Request) -> web.Response:
+        if cluster is None:
+            return web.json_response({"error": "not in cluster mode"}, status=400)
+        body = await request.json()
+        try:
+            table_id = await asyncio.get_running_loop().run_in_executor(
+                None,
+                cluster.create_table_on_shard,
+                int(body["shard_id"]),
+                body["name"],
+                body["create_sql"],
+            )
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response({"table_id": table_id})
+
+    async def meta_drop_table(request: web.Request) -> web.Response:
+        if cluster is None:
+            return web.json_response({"error": "not in cluster mode"}, status=400)
+        body = await request.json()
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, cluster.drop_table_on_shard, int(body["shard_id"]), body["name"]
+            )
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response({"ok": True})
+
+    app.router.add_post("/meta_event/open_shard", meta_open_shard)
+    app.router.add_post("/meta_event/close_shard", meta_close_shard)
+    app.router.add_post("/meta_event/create_table_on_shard", meta_create_table)
+    app.router.add_post("/meta_event/drop_table_on_shard", meta_drop_table)
+
     app.router.add_post("/sql", sql)
     app.router.add_post("/write", write)
     app.router.add_post("/influxdb/v1/write", influx_write)
@@ -503,16 +638,36 @@ def run_server(
         engine_config=engine_cfg,
     )
     router = None
+    cluster = None
     if config is not None and config.cluster.enabled:
-        from ..cluster import RuleBasedRouter
+        if config.cluster.meta_endpoints:
+            # Coordinator mode (ref: setup.rs build_with_meta).
+            from ..cluster import ClusterBasedRouter, ClusterImpl, MetaClient
 
-        router = RuleBasedRouter(
-            config.cluster.self_endpoint,
-            config.cluster.endpoints,
-            config.cluster.rules,
-        )
-    app = create_app(conn, router=router)
+            meta_client = MetaClient(config.cluster.meta_endpoints)
+            cluster = ClusterImpl(conn, config.cluster.self_endpoint, meta_client)
+            router = ClusterBasedRouter(cluster, meta_client)
+        else:
+            from ..cluster import RuleBasedRouter
+
+            router = RuleBasedRouter(
+                config.cluster.self_endpoint,
+                config.cluster.endpoints,
+                config.cluster.rules,
+            )
+    app = create_app(conn, router=router, cluster=cluster)
     app["proxy"].slow_threshold_s = slow_threshold
+    if cluster is not None:
+        # Heartbeats begin only once we LISTEN: the coordinator may
+        # dispatch open_shard the moment we register.
+        async def _start_cluster(app_):
+            await asyncio.get_running_loop().run_in_executor(None, cluster.start)
+
+        async def _stop_cluster(app_):
+            cluster.stop()
+
+        app.on_startup.append(_start_cluster)
+        app.on_cleanup.append(_stop_cluster)
     logger.info("horaedb_tpu http listening on %s:%d (data: %s)", host, port, data_dir)
     try:
         web.run_app(app, host=host, port=port, print=None)

@@ -61,6 +61,8 @@ class ClusterSection:
     endpoints: list[str] = field(default_factory=list)
     # explicit table -> endpoint pins; unlisted tables hash over endpoints
     rules: dict[str, str] = field(default_factory=dict)
+    # coordinator mode: meta server endpoints (overrides static routing)
+    meta_endpoints: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -86,7 +88,7 @@ _KNOWN = {
     "server": {"host", "http_port"},
     "engine": {"data_dir", "wal", "space_write_buffer_size", "compaction_l0_trigger"},
     "limits": {"slow_threshold"},
-    "cluster": {"self_endpoint", "endpoints", "rules"},
+    "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
 }
 
 
@@ -133,8 +135,17 @@ def _apply(cfg: Config, raw: dict) -> None:
         if not isinstance(rules, dict):
             raise ConfigError("cluster.rules must be a table of table -> endpoint")
         cfg.cluster.rules = {str(k): str(v) for k, v in rules.items()}
+        meps = c.get("meta_endpoints", [])
+        if not isinstance(meps, list) or not all(isinstance(e, str) for e in meps):
+            raise ConfigError("cluster.meta_endpoints must be a list of strings")
+        cfg.cluster.meta_endpoints = meps
         if not cfg.cluster.self_endpoint:
             raise ConfigError("cluster.self_endpoint is required in [cluster]")
+        if not meps and not eps:
+            raise ConfigError(
+                "[cluster] needs either meta_endpoints (coordinator mode) "
+                "or endpoints (static mode)"
+            )
 
 
 def _apply_env(cfg: Config) -> None:

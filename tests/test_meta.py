@@ -1,0 +1,230 @@
+"""Coordinator unit tests (ref model: horaemeta's per-package Go tests —
+topology_manager, procedure manager, schedulers, inspector)."""
+
+import time
+
+import pytest
+
+from horaedb_tpu.meta.kv import FileKV, MemoryKV
+from horaedb_tpu.meta.procedure import ProcedureManager, ProcState
+from horaedb_tpu.meta.scheduler import (
+    NodeInspector,
+    RebalancedScheduler,
+    ReopenScheduler,
+    StaticScheduler,
+)
+from horaedb_tpu.meta.topology import TopologyManager
+
+
+class TestLeaseKV:
+    def test_put_get_delete(self):
+        kv = MemoryKV()
+        kv.put("a", {"x": 1})
+        assert kv.get("a") == {"x": 1}
+        assert kv.get_prefix("a") == {"a": {"x": 1}}
+        assert kv.delete("a")
+        assert kv.get("a") is None
+
+    def test_lease_expiry_deletes_keys(self):
+        kv = MemoryKV()
+        lid = kv.grant_lease(0.05)
+        kv.put("locked", 1, lease_id=lid)
+        assert kv.get("locked") == 1
+        time.sleep(0.08)
+        assert kv.get("locked") is None
+        assert not kv.lease_alive(lid)
+
+    def test_keepalive_extends(self):
+        kv = MemoryKV()
+        lid = kv.grant_lease(0.1)
+        kv.put("k", 1, lease_id=lid)
+        for _ in range(3):
+            time.sleep(0.05)
+            assert kv.keepalive(lid)
+        assert kv.get("k") == 1
+
+    def test_keepalive_after_expiry_fails(self):
+        kv = MemoryKV()
+        lid = kv.grant_lease(0.03)
+        time.sleep(0.06)
+        assert not kv.keepalive(lid)
+
+    def test_cas(self):
+        kv = MemoryKV()
+        assert kv.cas("leader", None, "n1")
+        assert not kv.cas("leader", None, "n2")  # already taken
+        assert kv.cas("leader", "n1", "n2")
+        assert kv.get("leader") == "n2"
+
+    def test_filekv_survives_restart(self, tmp_path):
+        path = str(tmp_path / "meta.kv")
+        kv = FileKV(path)
+        kv.put("a", {"v": 1})
+        kv.put("b", 2)
+        kv.delete("b")
+        kv.close()
+        kv2 = FileKV(path)
+        assert kv2.get("a") == {"v": 1}
+        assert kv2.get("b") is None
+        kv2.close()
+
+    def test_filekv_compaction_keeps_state(self, tmp_path):
+        path = str(tmp_path / "meta.kv")
+        kv = FileKV(path)
+        kv._COMPACT_EVERY = 10
+        for i in range(25):
+            kv.put(f"k{i % 3}", i)
+        kv.close()
+        kv2 = FileKV(path)
+        assert kv2.get("k0") == 24
+        kv2.close()
+
+
+def topo(num_shards=4, nodes=()):
+    t = TopologyManager(MemoryKV(), num_shards=num_shards)
+    for n in nodes:
+        t.register_node(n)
+    return t
+
+
+class TestTopology:
+    def test_shards_initialized(self):
+        t = topo(num_shards=4)
+        assert len(t.shards()) == 4
+        assert all(s.node is None for s in t.shards())
+
+    def test_assign_bumps_version(self):
+        t = topo(nodes=["n1:1"])
+        v0 = t.shard(0).version
+        s = t.assign_shard(0, "n1:1", lease_id=7)
+        assert s.version == v0 + 1 and s.node == "n1:1" and s.lease_id == 7
+
+    def test_table_lifecycle(self):
+        t = topo(nodes=["n1:1"])
+        t.assign_shard(0, "n1:1")
+        tid = t.alloc_table_id()
+        t.add_table("demo", tid, 0, "CREATE TABLE demo ...")
+        tm, shard = t.route("demo")
+        assert tm.table_id == tid and shard.node == "n1:1"
+        assert tid in t.shard(0).table_ids
+        t.drop_table("demo")
+        assert t.route("demo") is None
+        assert tid not in t.shard(0).table_ids
+
+    def test_pick_shard_least_loaded(self):
+        t = topo(num_shards=2, nodes=["n1:1"])
+        t.assign_shard(0, "n1:1")
+        t.assign_shard(1, "n1:1")
+        t.add_table("a", t.alloc_table_id(), 0, "sql")
+        assert t.pick_shard_for_table() == 1
+
+    def test_persistence_roundtrip(self, tmp_path):
+        kv = FileKV(str(tmp_path / "m.kv"))
+        t = TopologyManager(kv, num_shards=2)
+        t.register_node("n1:1")
+        t.assign_shard(0, "n1:1", lease_id=3)
+        t.add_table("demo", t.alloc_table_id(), 0, "sql")
+        kv.close()
+        kv2 = FileKV(str(tmp_path / "m.kv"))
+        t2 = TopologyManager(kv2, num_shards=2)
+        assert t2.shard(0).node == "n1:1"
+        assert t2.table("demo") is not None
+        # registered nodes come back offline until they heartbeat
+        assert all(not n.online for n in t2.nodes())
+        kv2.close()
+
+
+class TestSchedulers:
+    def test_static_assigns_unassigned(self):
+        t = topo(num_shards=4, nodes=["n1:1", "n2:2"])
+        moves = StaticScheduler(t).schedule()
+        assert len(moves) == 4
+        targets = [m.to_node for m in moves]
+        assert targets.count("n1:1") == 2 and targets.count("n2:2") == 2
+
+    def test_reopen_moves_off_offline(self):
+        t = topo(num_shards=2, nodes=["n1:1", "n2:2"])
+        t.assign_shard(0, "n1:1")
+        t.assign_shard(1, "n2:2")
+        t.mark_offline("n1:1")
+        moves = ReopenScheduler(t).schedule()
+        assert [ (m.shard_id, m.to_node) for m in moves ] == [(0, "n2:2")]
+
+    def test_rebalance_one_move_when_skewed(self):
+        t = topo(num_shards=4, nodes=["n1:1", "n2:2"])
+        for sid in range(4):
+            t.assign_shard(sid, "n1:1")
+        moves = RebalancedScheduler(t).schedule()
+        assert len(moves) == 1 and moves[0].to_node == "n2:2"
+
+    def test_rebalance_quiet_when_even(self):
+        t = topo(num_shards=4, nodes=["n1:1", "n2:2"])
+        t.assign_shard(0, "n1:1")
+        t.assign_shard(1, "n1:1")
+        t.assign_shard(2, "n2:2")
+        t.assign_shard(3, "n2:2")
+        assert RebalancedScheduler(t).schedule() == []
+
+    def test_inspector_marks_offline(self):
+        t = topo(nodes=["n1:1"])
+        insp = NodeInspector(t, heartbeat_timeout_s=0.05)
+        assert insp.inspect() == []
+        time.sleep(0.08)
+        assert insp.inspect() == ["n1:1"]
+        assert t.online_nodes() == []
+
+
+class TestProcedures:
+    def test_success_path(self):
+        kv = MemoryKV()
+        ran = []
+        pm = ProcedureManager(kv, {"noop": lambda p: ran.append(p.proc_id)})
+        p = pm.run_sync("noop", {})
+        assert p.state is ProcState.FINISHED and ran == [p.proc_id]
+
+    def test_retry_then_success(self):
+        kv = MemoryKV()
+        attempts = []
+
+        def flaky(p):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+
+        pm = ProcedureManager(kv, {"flaky": flaky}, retry_delay_s=0.0)
+        p = pm.run_sync("flaky", {})
+        assert p.state is ProcState.RUNNING
+        pm.tick()
+        pm.tick()
+        assert p.state is ProcState.FINISHED and len(attempts) == 3
+
+    def test_fails_after_max_attempts(self):
+        kv = MemoryKV()
+
+        def bad(p):
+            raise RuntimeError("nope")
+
+        pm = ProcedureManager(kv, {"bad": bad}, max_attempts=2, retry_delay_s=0.0)
+        p = pm.run_sync("bad", {})
+        pm.tick()
+        assert p.state is ProcState.FAILED and "nope" in p.error
+
+    def test_unfinished_procedures_resume_after_restart(self, tmp_path):
+        kv = FileKV(str(tmp_path / "p.kv"))
+        calls = []
+
+        def once(p):
+            calls.append(1)
+            raise RuntimeError("crash before finishing")
+
+        pm = ProcedureManager(kv, {"work": once}, max_attempts=10, retry_delay_s=0.0)
+        pm.run_sync("work", {})
+        kv.close()
+        # "restart": a new manager over the same KV picks the procedure up
+        kv2 = FileKV(str(tmp_path / "p.kv"))
+        done = []
+        pm2 = ProcedureManager(kv2, {"work": lambda p: done.append(p.proc_id)})
+        pm2.tick()
+        assert len(done) == 1
+        assert [p.state for p in pm2.list()] == [ProcState.FINISHED]
+        kv2.close()
