@@ -50,6 +50,11 @@ class Catalog:
         self._entries: dict[str, TableEntry] = {}
         self._next_table_id = 1
         self._open_tables: dict[str, Table] = {}
+        # Cluster hook: (logical_name, index, sub_name, sub_id)
+        # -> Table | None. Returns a RemoteSubTable for partitions owned
+        # by another node; None = open locally (ref: the reference builds
+        # remote handles in PartitionTableImpl via remote_engine_client).
+        self.sub_table_resolver = None
         self._load()
 
     # ---- persistence -----------------------------------------------------
@@ -136,9 +141,13 @@ class Catalog:
                 )
                 subs: list[Table] = []
                 for i, sub_id in enumerate(e.sub_table_ids or []):
-                    data = self.instance.open_table(
-                        e.space_id, sub_id, sub_table_name(name, i)
-                    )
+                    sub_name = sub_table_name(name, i)
+                    if self.sub_table_resolver is not None:
+                        remote = self.sub_table_resolver(name, i, sub_name, sub_id)
+                        if remote is not None:
+                            subs.append(remote)
+                            continue
+                    data = self.instance.open_table(e.space_id, sub_id, sub_name)
                     if data is None:
                         raise RuntimeError(
                             f"partition {i} of {name!r} missing from storage"
@@ -153,6 +162,35 @@ class Catalog:
                     )
                 table = AnalyticTable(self.instance, data)
             self._open_tables[name] = table
+            return table
+
+    def open_sub_table(self, sub_name: str) -> Optional[Table]:
+        """Open ONE partition of a partitioned table by its storage name
+        (``__<table>_<index>``) as a local AnalyticTable.
+
+        The remote-engine service resolves shipped sub-table requests here
+        (the reference's remote engine works on sub tables by name,
+        partition.rs sub table naming)."""
+        if not sub_name.startswith("__") or "_" not in sub_name[2:]:
+            return None
+        logical, _, idx_str = sub_name[2:].rpartition("_")
+        if not idx_str.isdigit():
+            return None
+        idx = int(idx_str)
+        with self._lock:
+            cached = self._open_tables.get(sub_name)
+            if cached is not None:
+                return cached
+            e = self._entries.get(logical)
+            if e is None or e.partition_info is None or e.sub_table_ids is None:
+                return None
+            if not (0 <= idx < len(e.sub_table_ids)):
+                return None
+            data = self.instance.open_table(e.space_id, e.sub_table_ids[idx], sub_name)
+            if data is None:
+                return None
+            table = AnalyticTable(self.instance, data)
+            self._open_tables[sub_name] = table
             return table
 
     # ---- DDL -----------------------------------------------------------------
@@ -179,10 +217,19 @@ class Catalog:
                 for i in range(n):
                     sub_id = self._next_table_id
                     self._next_table_id += 1
-                    data = self.instance.create_table(
-                        0, sub_id, sub_table_name(name, i), schema, options
-                    )
+                    sub_name = sub_table_name(name, i)
+                    # Storage for every partition is created here (shared
+                    # object store), but the SERVING handle respects
+                    # ownership: partitions routed to another node close
+                    # locally and resolve to remote handles.
+                    data = self.instance.create_table(0, sub_id, sub_name, schema, options)
                     sub_ids.append(sub_id)
+                    if self.sub_table_resolver is not None:
+                        remote = self.sub_table_resolver(name, i, sub_name, sub_id)
+                        if remote is not None:
+                            self.instance.close_table(data, flush=False)
+                            subs.append(remote)
+                            continue
                     subs.append(AnalyticTable(self.instance, data))
                 logical_id = self._next_table_id
                 self._next_table_id += 1

@@ -43,6 +43,7 @@ class ClusterImpl:
         self.shard_set = ShardSet()
         self._table_shard: dict[str, int] = {}  # table name -> shard id
         self._lease_deadline: dict[int, float] = {}  # shard id -> monotonic
+        self._order_applied_at: dict[int, float] = {}  # shard id -> monotonic
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -76,6 +77,7 @@ class ClusterImpl:
                 logger.exception("heartbeat loop error")
 
     def _heartbeat_once(self) -> None:
+        t_req = time.monotonic()
         resp = self.meta.heartbeat(self.self_endpoint)
         desired = resp.get("desired", [])
         desired_ids = {o["shard_id"] for o in desired}
@@ -84,10 +86,17 @@ class ClusterImpl:
                 self.apply_shard_order(order)
             except ShardError as e:
                 logger.warning("shard order rejected: %s", e)
-        # Shards the coordinator no longer grants us: close them.
+        # Shards the coordinator no longer grants us: close them — UNLESS
+        # a newer order arrived (direct /meta_event push) while this reply
+        # was in flight; the reply predates it and must not undo it.
         for shard in self.shard_set.all_shards():
-            if shard.shard_id not in desired_ids:
-                self.close_shard(shard.shard_id, version=None)
+            if shard.shard_id in desired_ids:
+                continue
+            with self._lock:
+                applied_at = self._order_applied_at.get(shard.shard_id, 0.0)
+            if applied_at > t_req:
+                continue
+            self.close_shard(shard.shard_id, version=None)
 
     # ---- shard orders (heartbeat reply or /meta_event push) -------------
     def apply_shard_order(self, order: dict) -> None:
@@ -123,7 +132,9 @@ class ClusterImpl:
                 raise ShardError(
                     f"stale order for shard {shard_id}: v{version} < v{shard.version}"
                 )
-            self._lease_deadline[shard_id] = time.monotonic() + ttl
+            now = time.monotonic()
+            self._lease_deadline[shard_id] = now + ttl
+            self._order_applied_at[shard_id] = now
             for t in tables:
                 self._table_shard[t["name"]] = shard_id
 
@@ -173,6 +184,7 @@ class ClusterImpl:
                 except Exception:
                     logger.exception("closing table %s of shard %d", name, shard_id)
             self._lease_deadline.pop(shard_id, None)
+            self._order_applied_at.pop(shard_id, None)
             self.shard_set.remove(shard_id)
 
     def create_table_on_shard(self, shard_id: int, name: str, create_sql: str) -> int:

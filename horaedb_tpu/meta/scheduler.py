@@ -56,7 +56,11 @@ def _load(topology: TopologyManager) -> dict[str, int]:
 
 
 class StaticScheduler:
-    """Assign every unassigned shard to the least-loaded online node."""
+    """Assign every UNASSIGNED shard to the least-loaded online node.
+
+    Shards assigned to offline nodes are the ReopenScheduler's job — if
+    both claimed them, one tick would emit two transfers per shard with
+    independently chosen targets (briefly dual-writable)."""
 
     def __init__(self, topology: TopologyManager) -> None:
         self.topology = topology
@@ -67,7 +71,7 @@ class StaticScheduler:
             return []
         out = []
         for s in self.topology.shards():
-            if s.node is None or s.node not in load:
+            if s.node is None:
                 target = min(load, key=lambda e: (load[e], e))
                 load[target] += 1
                 out.append(Transfer(s.shard_id, target, "static: unassigned"))

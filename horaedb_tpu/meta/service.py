@@ -192,11 +192,17 @@ class MetaServer:
             # Renew the fencing lease while the owner heartbeats.
             if view.lease_id and not self.kv.keepalive(view.lease_id):
                 # Lease lapsed (e.g. meta restarted): issue a fresh one so
-                # the owner keeps serving without a spurious transfer.
+                # the owner keeps serving without a spurious transfer —
+                # but ONLY if it still owns the shard (a concurrent
+                # transfer may have moved it since our snapshot).
                 lease_id = self.kv.grant_lease(self.lease_ttl_s)
-                view = self.topology.assign_shard(
+                refreshed = self.topology.assign_shard_if_owner(
                     view.shard_id, endpoint, lease_id=lease_id
                 )
+                if refreshed is None:
+                    self.kv.revoke(lease_id)
+                    continue  # moved elsewhere: not in this node's desired set
+                view = refreshed
             desired.append(self._shard_order(view))
         return {"desired": desired, "lease_ttl_s": self.lease_ttl_s}
 
@@ -256,7 +262,13 @@ def create_meta_app(server: MetaServer) -> web.Application:
         ep = body.get("endpoint")
         if not isinstance(ep, str) or not ep:
             return web.json_response({"error": "missing 'endpoint'"}, status=400)
-        return web.json_response(server.handle_heartbeat(ep))
+        import asyncio
+
+        # Lease recovery can fsync the KV journal — keep it off the loop.
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, server.handle_heartbeat, ep
+        )
+        return web.json_response(out)
 
     async def create_table(request: web.Request) -> web.Response:
         body = await request.json()

@@ -169,6 +169,18 @@ class TopologyManager:
             self.kv.put(f"{_K_SHARD}{shard_id}", s.to_dict())
             return ShardView(**vars(s))
 
+    def assign_shard_if_owner(
+        self, shard_id: int, expected_node: str, lease_id: int
+    ) -> Optional[ShardView]:
+        """Reassign (re-lease) a shard ONLY if ``expected_node`` still owns
+        it — the heartbeat lease-recovery path must not steal back a shard
+        a concurrent transfer just moved elsewhere."""
+        with self._lock:
+            s = self._shards.get(shard_id)
+            if s is None or s.node != expected_node:
+                return None
+            return self.assign_shard(shard_id, expected_node, lease_id=lease_id)
+
     def shards_of_node(self, endpoint: str) -> list[ShardView]:
         with self._lock:
             return [

@@ -223,6 +223,13 @@ class Executor:
             if cached is not None:
                 path = "device-cached"
                 return self._finish_metrics(m, t_start, path, cached)
+        # Partitioned tables: push the aggregate DOWN to each partition
+        # (local kernel per partition; remote partitions over the wire —
+        # ref: dist_sql_query resolver push-down) and combine partials.
+        if plan.is_aggregate and hasattr(table, "sub_tables"):
+            out = self._try_partitioned_agg(plan, table, m)
+            if out is not None:
+                return self._finish_metrics(m, t_start, "device-partial", out)
         t_scan = _time.perf_counter()
         projection = self._projection(plan)
         rows = table.read(plan.predicate, projection=projection)
@@ -306,6 +313,18 @@ class Executor:
         for c in keep[1:]:
             out = ast.BinaryOp("AND", out, c)
         return out
+
+    def _try_partitioned_agg(self, plan: QueryPlan, table, m: dict) -> Optional[ResultSet]:
+        from .partial import assemble_result, combine_partials, spec_from_plan
+
+        spec = spec_from_plan(self, plan)
+        if spec is None:
+            return None  # shape not pushable: gather-rows fallback below
+        names_arrays = table.partial_agg(spec)
+        combined, n_groups = combine_partials([names_arrays], spec)
+        keep = table.rule.prune(plan.predicate)
+        m["partitions"] = len(keep) if keep is not None else len(table.sub_tables)
+        return assemble_result(plan, combined, n_groups, spec)
 
     # ---- device path -------------------------------------------------------
     def _agg_device_shape(self, plan: QueryPlan):

@@ -1,0 +1,155 @@
+"""Remote engine client + the remote sub-table
+(ref: src/remote_engine_client/src/client.rs:65-484 — typed RPCs over a
+channel pool; cached_router.rs route caching lives in cluster/router).
+
+``RemoteSubTable`` is a full ``Table`` implementation whose owner is
+another node: writes/reads/partial-aggregates cross the wire; everything
+behind the interface (partitioned scatter/gather, the executor's
+push-down) works unchanged — the partition layer cannot tell a local
+AnalyticTable from a remote one, which is exactly the reference's
+PartitionTableImpl + remote_engine_client split.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import grpc
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema
+from ..engine.options import TableOptions
+from ..table_engine.predicate import Predicate
+from ..table_engine.table import Table
+from .codec import (
+    columns_from_ipc,
+    pack,
+    predicate_to_dict,
+    rows_from_ipc,
+    rows_to_ipc,
+    unpack,
+)
+
+GRPC_PORT_OFFSET = 1000
+
+
+def grpc_endpoint_for(http_endpoint: str, offset: int = GRPC_PORT_OFFSET) -> str:
+    """Convention: a node's gRPC port = its HTTP port + offset.
+
+    Routing state (meta, static rules) speaks HTTP endpoints; the remote
+    engine derives the data-plane address from it (the reference instead
+    carries both ports in topology — a future meta field can override)."""
+    host, port = http_endpoint.rsplit(":", 1)
+    return f"{host}:{int(port) + offset}"
+
+
+class _ChannelPool:
+    """One shared channel per endpoint (ref: channel.rs pool)."""
+
+    _channels: dict[str, grpc.Channel] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get(cls, endpoint: str) -> grpc.Channel:
+        with cls._lock:
+            ch = cls._channels.get(endpoint)
+            if ch is None:
+                ch = grpc.insecure_channel(endpoint)
+                cls._channels[endpoint] = ch
+            return ch
+
+
+class RemoteEngineClient:
+    def __init__(self, endpoint: str, timeout_s: float = 30.0) -> None:
+        self.endpoint = endpoint
+        self.timeout_s = timeout_s
+        self._channel = _ChannelPool.get(endpoint)
+
+    def _call(self, method: str, payload: dict) -> dict:
+        fn = self._channel.unary_unary(
+            f"/horaedb.remote_engine/{method}",
+            request_serializer=None,
+            response_deserializer=None,
+        )
+        return unpack(fn(pack(payload), timeout=self.timeout_s))
+
+    def get_table_info(self, table: str) -> dict:
+        return self._call("GetTableInfo", {"table": table})
+
+    def write(self, table: str, rows: RowGroup) -> int:
+        out = self._call("Write", {"table": table, "ipc": rows_to_ipc(rows)})
+        return int(out["affected"])
+
+    def read(
+        self,
+        table: str,
+        schema: Schema,
+        predicate: Optional[Predicate],
+        projection: Optional[Sequence[str]] = None,
+    ) -> RowGroup:
+        from ..common_types.schema import project_schema
+
+        out = self._call(
+            "Read",
+            {
+                "table": table,
+                "predicate": predicate_to_dict(predicate or Predicate.all_time()),
+                "projection": list(projection) if projection is not None else None,
+            },
+        )
+        return rows_from_ipc(project_schema(schema, projection), out["ipc"])
+
+    def partial_agg(self, table: str, spec: dict):
+        out = self._call("PartialAgg", {"table": table, "spec": spec})
+        return columns_from_ipc(out["ipc"])
+
+
+class RemoteSubTable(Table):
+    """A partition owned by another node, behind the Table interface."""
+
+    def __init__(self, name: str, endpoint: str, schema: Schema, options: TableOptions) -> None:
+        self._name = name
+        self._schema = schema
+        self._options = options
+        self.client = RemoteEngineClient(endpoint)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def options(self) -> TableOptions:
+        return self._options
+
+    def write(self, rows: RowGroup) -> int:
+        return self.client.write(self._name, rows)
+
+    def read(self, predicate=None, projection=None) -> RowGroup:
+        return self.client.read(self._name, self._schema, predicate, projection)
+
+    def partial_agg(self, spec: dict):
+        return self.client.partial_agg(self._name, spec)
+
+    # Maintenance is owner-local; remote handles are read/write views.
+    def flush(self) -> None:
+        pass
+
+    def compact(self) -> None:
+        pass
+
+    def alter_schema(self, schema: Schema) -> None:
+        raise NotImplementedError("ALTER runs on the owning node")
+
+    def alter_options(self, options: TableOptions) -> None:
+        raise NotImplementedError("ALTER runs on the owning node")
+
+    def physical_datas(self) -> list:
+        return []
+
+    def metrics(self) -> dict:
+        return {"table": self._name, "remote": self.client.endpoint}

@@ -1,0 +1,103 @@
+"""Wire codec: msgpack envelopes + arrow IPC payloads
+(ref: components/arrow_ext ipc helpers used by the remote engine RPCs).
+
+Everything row-shaped crosses the wire as ONE arrow IPC stream; small
+control structures (predicates, agg specs, schemas) ride msgpack. Partial
+aggregates are themselves a record batch — group key values + bucket
+starts + the (count, sum, min, max) monoid per aggregated column — so the
+final combine is a tiny group-by at the coordinator (the reference ships
+DataFusion partial-agg batches the same way, resolver.rs:76-104).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Optional, Sequence
+
+import msgpack
+import numpy as np
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema
+from ..common_types.time_range import TimeRange
+from ..table_engine.predicate import ColumnFilter, FilterOp, Predicate
+
+
+def pack(obj: dict) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(raw: bytes) -> dict:
+    return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+# ---- RowGroup <-> arrow IPC ---------------------------------------------
+
+
+def rows_to_ipc(rows: RowGroup) -> bytes:
+    batch = rows.to_arrow()
+    sink = io.BytesIO()
+    with ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue()
+
+
+def rows_from_ipc(schema: Schema, raw: bytes) -> RowGroup:
+    with ipc.open_stream(io.BytesIO(raw)) as r:
+        table = r.read_all()
+    return RowGroup.from_arrow(schema, table)
+
+
+# ---- arbitrary column dict <-> arrow IPC (partial aggregates) ------------
+
+
+def columns_to_ipc(names: Sequence[str], arrays: Sequence[np.ndarray]) -> bytes:
+    cols = [pa.array(a) for a in arrays]
+    batch = pa.record_batch(cols, names=list(names))
+    sink = io.BytesIO()
+    with ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue()
+
+
+def columns_from_ipc(raw: bytes) -> tuple[list[str], list[np.ndarray]]:
+    with ipc.open_stream(io.BytesIO(raw)) as r:
+        table = r.read_all()
+    names = list(table.schema.names)
+    arrays = []
+    for i in range(table.num_columns):
+        col = table.column(i)
+        if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
+            arrays.append(np.asarray(col.to_pylist(), dtype=object))
+        else:
+            arrays.append(col.to_numpy(zero_copy_only=False))
+    return names, arrays
+
+
+# ---- predicate ------------------------------------------------------------
+
+
+def predicate_to_dict(p: Predicate) -> dict:
+    return {
+        "time_range": [int(p.time_range.inclusive_start), int(p.time_range.exclusive_end)],
+        "filters": [[f.column, f.op.value, _plain(f.value)] for f in p.filters],
+    }
+
+
+def predicate_from_dict(d: dict) -> Predicate:
+    lo, hi = d["time_range"]
+    filters = tuple(
+        ColumnFilter(c, FilterOp(op), tuple(v) if isinstance(v, list) else v)
+        for c, op, v in d.get("filters", ())
+    )
+    return Predicate(TimeRange(lo, hi), filters)
+
+
+def _plain(v):
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    if isinstance(v, tuple):
+        return [_plain(x) for x in v]
+    return v

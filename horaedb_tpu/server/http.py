@@ -655,8 +655,59 @@ def run_server(
                 config.cluster.endpoints,
                 config.cluster.rules,
             )
+    # gRPC services (remote engine + storage) alongside HTTP — the
+    # reference's primary protocol (grpc/mod.rs:162-198). Port derives
+    # from the HTTP port unless configured; -1 disables.
+    grpc_server = None
+    grpc_cfg = config.server.grpc_port if config is not None else 0
+    if grpc_cfg >= 0:
+        from ..remote import GrpcServer, grpc_endpoint_for
+
+        grpc_port = (
+            grpc_cfg
+            if grpc_cfg > 0
+            else int(grpc_endpoint_for(f"{host}:{port}").rsplit(":", 1)[1])
+        )
+        grpc_server = GrpcServer(conn, host=host, port=grpc_port, cluster=cluster)
+
+    if router is not None and grpc_server is not None:
+        # Partitioned tables resolve non-local partitions to remote
+        # handles over the router (sub-table name -> owning node).
+        from ..remote import RemoteSubTable, grpc_endpoint_for as _gef
+
+        def resolve_sub(logical: str, index: int, sub_name: str, sub_id: int):
+            route = router.route(sub_name)
+            if route.is_local:
+                return None
+            # Schema/options come from the sub-table's manifest in the
+            # SHARED object store — no RPC, and no ordering dependency on
+            # the remote node having loaded its registry yet.
+            from ..engine.manifest import Manifest
+            from ..engine.options import TableOptions as _TableOptions
+
+            state = Manifest(conn.store, 0, sub_id).load()
+            if state.schema is None:
+                raise RuntimeError(f"manifest for {sub_name} missing schema")
+            return RemoteSubTable(
+                sub_name,
+                _gef(route.endpoint),
+                state.schema,
+                _TableOptions.from_dict(state.options),
+            )
+
+        conn.catalog.sub_table_resolver = resolve_sub
+
     app = create_app(conn, router=router, cluster=cluster)
     app["proxy"].slow_threshold_s = slow_threshold
+    if grpc_server is not None:
+        async def _start_grpc(app_):
+            grpc_server.start()
+
+        async def _stop_grpc(app_):
+            grpc_server.stop()
+
+        app.on_startup.append(_start_grpc)
+        app.on_cleanup.append(_stop_grpc)
     if cluster is not None:
         # Heartbeats begin only once we LISTEN: the coordinator may
         # dispatch open_shard the moment we register.

@@ -188,6 +188,33 @@ class PartitionedTable(Table):
             return parts[0]  # empty, right schema — already fetched
         return RowGroup.concat(non_empty)
 
+    def partial_agg(self, spec: dict):
+        """Scatter the pushed-down aggregate to every (unpruned) partition
+        — each runs against its OWN data, remote ones across the wire —
+        and concatenate the partial batches (combining stays associative,
+        so the caller's single final combine still works)."""
+        from ..remote.codec import predicate_from_dict
+        from ..utils.runtime import scatter_pool
+
+        keep = self.rule.prune(predicate_from_dict(spec["predicate"]))
+        targets = (
+            self.sub_tables if keep is None else [self.sub_tables[i] for i in keep]
+        )
+        if len(targets) == 1:
+            return targets[0].partial_agg(spec)
+        parts = list(scatter_pool().map(lambda t: t.partial_agg(spec), targets))
+        names = None
+        merged: dict[str, list] = {}
+        for p_names, p_arrays in parts:
+            if not len(p_arrays) or not len(p_arrays[0]):
+                continue
+            names = p_names
+            for nm, arr in zip(p_names, p_arrays):
+                merged.setdefault(nm, []).append(arr)
+        if names is None:
+            return parts[0]
+        return names, [np.concatenate(merged[nm]) for nm in names]
+
     def flush(self) -> None:
         for t in self.sub_tables:
             t.flush()

@@ -67,6 +67,14 @@ class Table(ABC):
     def metrics(self) -> dict:
         return {"table": self.name}
 
+    def partial_agg(self, spec: dict):
+        """Pushed-down partial aggregate over this table's OWN data
+        (ref: dist_sql_query partial agg below the scan). Runs wherever
+        the data lives — remote handles forward it over the wire."""
+        from ..query.partial import compute_partial
+
+        return compute_partial(self, spec)
+
 
 class AnalyticTable(Table):
     """The storage engine behind the Table interface."""

@@ -39,6 +39,8 @@ class ConfigError(ValueError):
 class ServerConfig:
     host: str = "127.0.0.1"
     http_port: int = 5440  # ref default, config.rs:176
+    # 0 = derive from http_port + remote.GRPC_PORT_OFFSET; -1 = disabled
+    grpc_port: int = 0
 
 
 @dataclass
@@ -85,7 +87,7 @@ class Config:
 
 
 _KNOWN = {
-    "server": {"host", "http_port"},
+    "server": {"host", "http_port", "grpc_port"},
     "engine": {"data_dir", "wal", "space_write_buffer_size", "compaction_l0_trigger"},
     "limits": {"slow_threshold"},
     "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
@@ -109,6 +111,8 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.server.host = str(s["host"])
     if "http_port" in s:
         cfg.server.http_port = int(s["http_port"])
+    if "grpc_port" in s:
+        cfg.server.grpc_port = int(s["grpc_port"])
     e = raw.get("engine", {})
     if "data_dir" in e:
         cfg.engine.data_dir = str(e["data_dir"]) or None

@@ -52,3 +52,20 @@ class PriorityRuntime:
     def shutdown(self) -> None:
         self._high.shutdown(wait=False, cancel_futures=True)
         self._low.shutdown(wait=False, cancel_futures=True)
+
+
+_scatter_pool = None
+_scatter_lock = threading.Lock()
+
+
+def scatter_pool() -> "cf.ThreadPoolExecutor":
+    """Shared pool for partition scatter/gather (partial-agg fan-out,
+    remote reads). One long-lived pool instead of per-query spawn/join —
+    the fan-out sits on the hot serving path."""
+    global _scatter_pool
+    with _scatter_lock:
+        if _scatter_pool is None:
+            _scatter_pool = cf.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="scatter"
+            )
+        return _scatter_pool
