@@ -258,6 +258,12 @@ class Catalog:
             if table is not None:
                 for data in table.physical_datas():
                     self.instance.drop_table(data)
+                # Remote-owned partitions drop on their owning node, or
+                # their storage would orphan in the shared store.
+                for sub in getattr(table, "sub_tables", ()):
+                    drop_remote = getattr(sub, "drop_remote", None)
+                    if drop_remote is not None:
+                        drop_remote()
             self._entries.pop(name, None)
             self._open_tables.pop(name, None)
             self._persist_locked()

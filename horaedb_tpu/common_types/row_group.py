@@ -193,6 +193,10 @@ class RowGroup:
         """
         keys: list[np.ndarray] = []
         if seq is not None:
+            # Least-significant tiebreak: duplicate keys within ONE write
+            # batch share a sequence — later rows win (the reference's
+            # memtable applies rows in order, so last-write-wins).
+            keys.append(-np.arange(len(self), dtype=np.int64))
             keys.append(-seq.astype(np.int64))
         for i in reversed(self.schema.primary_key_indexes):
             keys.append(self._sortable(self.schema.columns[i].name))

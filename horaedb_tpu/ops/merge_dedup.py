@@ -32,13 +32,19 @@ def _merge_dedup_kernel(
     is_pad, tsid_hi, tsid_lo, ts_hi, ts_lo, negseq_hi, negseq_lo, *, dedup: bool
 ):
     n = is_pad.shape[0]
-    iota = jax.lax.iota(jnp.int32, n)
+    iota = jax.lax.iota(jnp.uint32, n)
+    # Ties on (key, seq) — duplicate keys in ONE write batch share a WAL
+    # sequence — resolve to the LAST input row (row order wins, matching
+    # the reference's memtable overwrite-in-order semantics): sort the
+    # NEGATED index as the final key, recover perm as its complement.
+    negidx = jnp.uint32(n - 1) - iota
     sorted_ops = jax.lax.sort(
-        (is_pad, tsid_hi, tsid_lo, ts_hi, ts_lo, negseq_hi, negseq_lo, iota),
-        num_keys=7,
+        (is_pad, tsid_hi, tsid_lo, ts_hi, ts_lo, negseq_hi, negseq_lo, negidx),
+        num_keys=8,
         is_stable=True,
     )
-    s_pad, s_tsid_hi, s_tsid_lo, s_ts_hi, s_ts_lo, _, _, perm = sorted_ops
+    s_pad, s_tsid_hi, s_tsid_lo, s_ts_hi, s_ts_lo, _, _, s_negidx = sorted_ops
+    perm = (jnp.uint32(n - 1) - s_negidx).astype(jnp.int32)
     if dedup:
         same = (
             (s_tsid_hi[1:] == s_tsid_hi[:-1])

@@ -177,6 +177,49 @@ cached_scan_agg = functools.partial(
 )(cached_scan_agg_body)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_groups", "n_buckets", "n_agg_fields", "numeric_filters"),
+)
+def selective_cached_scan_agg(
+    row_idx,  # int32[M] indices into the resident arrays (pad -> pad row)
+    series_codes,
+    ts_rel,
+    values,
+    group_of_series,
+    allowed_series,
+    literals,
+    lo_rel,
+    hi_rel,
+    t0_rel,
+    bucket_ms,
+    *,
+    n_groups: int,
+    n_buckets: int,
+    n_agg_fields: int,
+    numeric_filters: tuple[tuple[int, int], ...],
+):
+    """Cached kernel over a GATHERED subset of the resident rows.
+
+    The cache layout is sorted by (series, ts), so a selective query — a
+    few series out of thousands, the TSBS single-groupby shape — touches
+    only its series' contiguous ranges: the host ships an M-row index
+    (M << N), the device gathers from HBM and aggregates. Full scans keep
+    the plain ``cached_scan_agg``; the executor picks by selectivity.
+    """
+    sc = series_codes[row_idx]
+    tr = ts_rel[row_idx]
+    vals = values[:, row_idx]
+    return cached_scan_agg_body(
+        sc, tr, vals, group_of_series, allowed_series, literals,
+        lo_rel, hi_rel, t0_rel, bucket_ms,
+        n_groups=n_groups,
+        n_buckets=n_buckets,
+        n_agg_fields=n_agg_fields,
+        numeric_filters=numeric_filters,
+    )
+
+
 @dataclass
 class AggState:
     """Combinable partial aggregates (numpy, on host after device exit)."""

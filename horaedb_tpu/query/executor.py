@@ -31,7 +31,7 @@ from ..common_types.time_range import MAX_TIMESTAMP, MIN_TIMESTAMP
 from ..engine.options import parse_duration_ms
 from ..ops import ScanAggSpec, encode_group_codes, scan_aggregate
 from ..ops.encoding import build_padded_batch, time_buckets
-from ..table_engine.predicate import FilterOp, Predicate
+from ..table_engine.predicate import NUMPY_CMP, FilterOp, Predicate
 from . import ast
 from .plan import AggCall, GroupKey, QueryPlan
 
@@ -136,11 +136,8 @@ def _eval_binary(e: ast.BinaryOp, rows: RowGroup) -> tuple[np.ndarray, np.ndarra
     rv, rm = eval_expr(e.right, rows)
     # Dictionary fast path: compare the VOCABULARY against the literal and
     # gather through codes (O(|vocab|) compares instead of O(n)).
-    if op in ("=", "!=", "<", "<=", ">", ">="):
-        fn = {
-            "=": np.equal, "!=": np.not_equal, "<": np.less,
-            "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
-        }[op]
+    if op in NUMPY_CMP:
+        fn = NUMPY_CMP[op]
         if isinstance(lv, DictColumn) and isinstance(e.right, ast.Literal):
             return lv.map_values(lambda vals: fn(vals, e.right.value)), lm & rm
         if isinstance(rv, DictColumn) and isinstance(e.left, ast.Literal):
@@ -170,12 +167,8 @@ def _eval_binary(e: ast.BinaryOp, rows: RowGroup) -> tuple[np.ndarray, np.ndarra
         with np.errstate(divide="ignore", invalid="ignore"):
             out = np.mod(lv, rv)
         return out, valid & (rv != 0)
-    if op in ("=", "!=", "<", "<=", ">", ">="):
-        fn = {
-            "=": np.equal, "!=": np.not_equal, "<": np.less,
-            "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
-        }[op]
-        return fn(lv, rv), valid
+    if op in NUMPY_CMP:
+        return NUMPY_CMP[op](lv, rv), valid
     raise ExprError(f"unknown binary op {e.op}")
 
 
@@ -500,6 +493,13 @@ class Executor:
         schema = plan.schema
         if schema.tsid_index is None or not table.physical_datas():
             return None
+        if hasattr(table, "sub_tables") and len(table.physical_datas()) != len(
+            table.sub_tables
+        ):
+            # Remote partitions: their writes are invisible to the local
+            # fingerprint/delta — caching would serve stale aggregates
+            # forever. The partitioned push-down path handles these.
+            return None
         shape = self._agg_device_shape(plan)
         if shape is None:
             return None
@@ -521,19 +521,25 @@ class Executor:
         filter_cols = [f[0] for f in device_filters]
         value_names = list(dict.fromkeys(agg_cols + filter_cols))
 
-        entry, built = self.scan_cache.get(
+        entry, built, delta = self.scan_cache.get(
             table, value_names, read_rows=lambda: table.read(Predicate.all_time())
         )
-        if entry is None:
+        if entry is None or delta is None:
             return None
         # NULL agg inputs need per-field masks — not expressible here.
         for c in agg_cols:
             if not entry.rows.valid_mask(c).all():
                 return None
+        # Unflushed delta rows fold into the aggregate ON TOP of the HBM
+        # base — but only when provably sound (see _delta_soundness).
+        if len(delta) and not self._delta_soundness(table, entry, delta, agg_cols):
+            return None
         # Eligibility confirmed: only now record cache facts (a bail-out
         # above must not leave 'cache' lying in a host-path metric tree).
-        m["cache"] = "build" if built else "hit"
-        m["rows_scanned"] = entry.n_valid
+        m["cache"] = "build" if built else ("hit+delta" if len(delta) else "hit")
+        m["rows_scanned"] = entry.n_valid + len(delta)
+        if len(delta):
+            m["delta_rows"] = len(delta)
 
         # Series-level small arrays (one row per unique series); validity
         # slices carry over so NULL-tag semantics match the host path.
@@ -569,10 +575,17 @@ class Executor:
 
         # Time range + bucketing, relative to the cache origin. An empty
         # intersection keeps rel bounds at (0, 0) — NOT raw epoch deltas,
-        # which overflow int32.
+        # which overflow int32. Data bounds include the delta (fresh rows
+        # usually extend past the cached max timestamp).
         tr = plan.predicate.time_range
-        lo = max(tr.inclusive_start, entry.min_ts)
-        hi = min(tr.exclusive_end, entry.max_ts + 1)
+        data_min, data_max = entry.min_ts, entry.max_ts
+        if len(delta):
+            # span already validated by _delta_soundness
+            d_ts = delta.timestamps
+            data_min = min(data_min, int(d_ts.min()))
+            data_max = max(data_max, int(d_ts.max()))
+        lo = max(tr.inclusive_start, data_min)
+        hi = min(tr.exclusive_end, data_max + 1)
         empty_range = hi <= lo
         width = bucket_key.time_bucket_ms if bucket_key is not None else None
         if empty_range:
@@ -614,7 +627,24 @@ class Executor:
             np.int32(max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0),
             np.int32(width if width else 1),
         )
-        if entry.mesh is not None:
+        row_idx = (
+            self._selective_row_idx(entry, allowed, lo, hi)
+            if entry.mesh is None and not empty_range
+            else None
+        )
+        if row_idx is not None:
+            from ..ops.scan_agg import selective_cached_scan_agg
+
+            m["cache_rows"] = int((row_idx != entry.n_valid).sum())
+            out = selective_cached_scan_agg(
+                jnp.asarray(row_idx),
+                *args,
+                n_groups=spec.n_groups,
+                n_buckets=spec.n_buckets,
+                n_agg_fields=spec.n_agg_fields,
+                numeric_filters=encode_filter_ops(spec.numeric_filters),
+            )
+        elif entry.mesh is not None:
             # Sharded entry: the big arrays live split across the mesh —
             # run the shard_map cached kernel (the DEFAULT multi-device
             # serving path; single-device deployments take the else arm).
@@ -632,10 +662,123 @@ class Executor:
                 numeric_filters=encode_filter_ops(spec.numeric_filters),
             )
         state = state_to_host(*out)
+        if len(delta) and not empty_range:
+            self._fold_delta(
+                state, delta, entry, plan.schema, gos, allow,
+                agg_cols, value_names, device_filters,
+                lo, hi, t0, width, n_buckets,
+            )
         return self._assemble_agg_result(
             plan, tag_keys, key_values, agg_cols, state,
             max(num_groups, 1), n_buckets, t0, width,
         )
+
+    def _selective_row_idx(
+        self, entry, allowed: np.ndarray, lo: int, hi: int
+    ) -> Optional[np.ndarray]:
+        """Gather indices for a selective query, or None for a full scan.
+
+        Worth it when tag filters keep few series AND those series' rows
+        (narrowed by time inside each sorted series range) are a small
+        fraction of the table — then shipping an M-row index beats making
+        the kernel chew N rows (ref analog: pruning to relevant SSTs).
+        """
+        offsets = entry.series_offsets
+        if offsets is None or entry.built_seqs is None:
+            return None
+        sel = np.nonzero(allowed)[0]
+        S = entry.n_series
+        # All (or most) series selected: the full-scan kernel wins.
+        if len(sel) == 0 or len(sel) > 256 or len(sel) * 4 > S:
+            return None
+        ts_host = entry.rows.timestamps  # sorted within each series range
+        parts = []
+        total = 0
+        for s in sel:
+            s0, s1 = int(offsets[s]), int(offsets[s + 1])
+            a = s0 + int(np.searchsorted(ts_host[s0:s1], lo, "left"))
+            b = s0 + int(np.searchsorted(ts_host[s0:s1], hi, "left"))
+            if b > a:
+                parts.append(np.arange(a, b, dtype=np.int32))
+                total += b - a
+        if total == 0 or total * 4 > entry.n_valid:
+            return None  # selected rows not sparse enough to pay gather
+        from ..ops.encoding import pad_to_bucket
+
+        idx = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        # pad slots point at the explicit pad row (code n_series, masked)
+        return pad_to_bucket(idx, total, fill=np.int32(entry.n_valid))
+
+    def _delta_soundness(self, table, entry, delta, agg_cols) -> bool:
+        """May ``delta`` be ADDED on top of the cached base aggregate?
+
+        Sound when: no NULL agg inputs, every delta series already exists
+        in the base (group mapping is per-series), and — for OVERWRITE
+        tables — no delta row can overwrite a base row (strictly newer
+        timestamps) nor another delta row (unique keys within the delta).
+        """
+        from ..engine.options import UpdateMode
+
+        for c in agg_cols:
+            if not delta.valid_mask(c).all():
+                return False
+        d_ts_all = delta.timestamps
+        if (
+            max(entry.max_ts, int(d_ts_all.max()))
+            - min(entry.min_ts, int(d_ts_all.min()))
+            >= 2**31 - 1
+        ):
+            return False  # delta pushes the span past int32-relative math
+        schema = delta.schema
+        tsid_name = schema.columns[schema.tsid_index].name
+        d_tsid = delta.columns[tsid_name]
+        n_series = len(entry.series_tsids)
+        sidx = np.searchsorted(entry.series_tsids, d_tsid)
+        known = sidx < n_series
+        safe_idx = np.clip(sidx, 0, n_series - 1)
+        known &= entry.series_tsids[safe_idx] == d_tsid
+        if not known.all():
+            return False  # brand-new series: base group mapping can't place it
+        if table.options.update_mode is not UpdateMode.APPEND:
+            d_ts = delta.timestamps
+            if int(d_ts.min()) <= entry.max_ts:
+                return False  # could overwrite a base row
+            pairs = np.stack([d_tsid.astype(np.int64), d_ts.astype(np.int64)])
+            if np.unique(pairs, axis=1).shape[1] != len(delta):
+                return False  # delta overwrites within itself
+        return True
+
+    def _fold_delta(
+        self, state, delta, entry, schema, gos, allow,
+        agg_cols, value_names, device_filters,
+        lo, hi, t0, width, n_buckets,
+    ) -> None:
+        """Accumulate unflushed rows into the kernel's host-side partials.
+
+        The delta is small (one memtable's worth at most), so vectorized
+        numpy accumulation costs microseconds while the many-million-row
+        base stays in HBM untouched."""
+        tsid_name = schema.columns[schema.tsid_index].name
+        sidx = np.searchsorted(entry.series_tsids, delta.columns[tsid_name])
+        d_ts = delta.timestamps
+        mask = allow[sidx] & (d_ts >= lo) & (d_ts < hi)
+        for col, op, lit in device_filters:
+            v = as_values(delta.column(col)).astype(np.float64)
+            mask &= NUMPY_CMP[op](v, lit) & delta.valid_mask(col)
+        if not mask.any():
+            return
+        idx = np.nonzero(mask)[0]
+        g = gos[sidx[idx]].astype(np.int64)
+        if width is not None:
+            b = np.clip((d_ts[idx] - t0) // width, 0, n_buckets - 1).astype(np.int64)
+        else:
+            b = np.zeros(len(idx), dtype=np.int64)
+        np.add.at(state.counts, (g, b), 1)
+        for fi, col in enumerate(agg_cols):
+            v = as_values(delta.column(col))[idx].astype(np.float64)
+            np.add.at(state.sums[fi], (g, b), v)
+            np.minimum.at(state.mins[fi], (g, b), v)
+            np.maximum.at(state.maxs[fi], (g, b), v)
 
     # ---- host fallback -----------------------------------------------------
     def _execute_agg_host(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:

@@ -33,10 +33,7 @@ from ..remote.codec import predicate_from_dict, predicate_to_dict
 from .executor import ResultSet
 from .plan import QueryPlan
 
-_CMP = {
-    "=": np.equal, "!=": np.not_equal, "<": np.less,
-    "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal,
-}
+from ..table_engine.predicate import NUMPY_CMP as _CMP
 
 
 def spec_from_plan(executor, plan: QueryPlan) -> Optional[dict]:

@@ -13,8 +13,14 @@ a series->group map, a series allow-list (tag filters evaluated per
 series on host), time-range scalars, and filter literals. The fused
 kernel (ops.scan_agg.cached_scan_agg) does the rest on device.
 
-Invalidation: entries key on a table fingerprint (last/flushed sequence +
-SST file ids per physical table); any write or compaction changes it.
+Invalidation: entries key on the table's BASE fingerprint — schema
+version, flushed sequence, SST file set. Plain ingest (memtable appends)
+does NOT invalidate: the cache serves base state from HBM and the
+executor folds the small unflushed DELTA (memtable rows with sequence
+above the entry's build point) into the aggregate on the side, so the
+steady state of a TSDB — continuous writes — stays on the device path.
+Flush/compaction/ALTER change the base fingerprint and rebuild.
+
 Eligibility: aggregate plans whose residual filters decompose into tag
 EQ/IN (series-level) + numeric field comparisons (device literals), and
 whose data span fits int32 relative milliseconds (~24 days).
@@ -60,6 +66,15 @@ class CachedTableScan:
     # stacked (F, padded) value arrays per column tuple — stacking is a
     # device op, so reuse the result across steady-state queries.
     _stacks: dict = None
+    # sorted unique tsid values — maps delta rows onto series codes
+    series_tsids: np.ndarray = None
+    # per physical table id: last sequence INCLUDED in this entry; newer
+    # memtable rows are the query-time delta
+    built_seqs: dict = None
+    # rows are SORTED by (series, ts): series i occupies
+    # [series_offsets[i], series_offsets[i+1]) — selective queries gather
+    # just those ranges instead of scanning the whole table
+    series_offsets: np.ndarray = None
 
     def values_for(self, names: list[str]):
         key = tuple(names)
@@ -95,15 +110,17 @@ class ScanCache:
         table,
         value_columns: list[str],
         read_rows,
-    ) -> tuple[Optional[CachedTableScan], bool]:
-        """(cached scan state, was_built_this_call) for ``table``.
+    ) -> tuple[Optional[CachedTableScan], bool, Optional["RowGroup"]]:
+        """(cached scan state, was_built_this_call, delta_rows).
 
         ``read_rows()`` materializes the full-table merged rows on miss.
-        Entry is None when the table's shape doesn't fit the cached-kernel
-        contract (span overflow, empty table), or when the data hasn't been
-        stable long enough to justify a build.
+        ``delta_rows`` (possibly empty) are memtable rows written AFTER the
+        entry was built — the executor folds them into the aggregate so
+        ingest doesn't evict the HBM state. Entry is None when the table's
+        shape doesn't fit the cached-kernel contract (span overflow, empty
+        table), or when the base state hasn't been stable long enough.
         """
-        fp = _fingerprint(table)
+        base_fp = _base_fingerprint(table)
         from ..parallel.mesh import serving_mesh
 
         mesh_now = serving_mesh()
@@ -114,34 +131,53 @@ class ScanCache:
                 # placed on the old mesh — rebuild from scratch.
                 self._entries.pop(table.name, None)
                 entry = None
-            if entry is not None and entry.fingerprint == fp:
-                if all(c in entry.value_cols_dev for c in value_columns):
-                    self.hits += 1
-                    return entry, False
-                # same data, new columns: extend the entry in place
-                self._extend(entry, value_columns)
-                self.hits += 1
-                return entry, False
-            if self._candidate.get(table.name) != fp:
-                # first sighting of this table state: don't build yet
-                self._candidate[table.name] = fp
+            hit = entry is not None and entry.fingerprint == base_fp
+            if not hit and self._candidate.get(table.name) != base_fp:
+                # first sighting of this base state: don't build yet
+                self._candidate[table.name] = base_fp
                 self.misses += 1
-                return None, False
+                return None, False, None
+        if hit:
+            # Delta materialization and column upload run OUTSIDE the
+            # cache lock — they do O(memtable) / O(rows) work and must not
+            # serialize unrelated tables' queries. Entry mutation during
+            # _extend is per-entry idempotent; the fingerprint re-check
+            # catches a racing flush.
+            if not all(c in entry.value_cols_dev for c in value_columns):
+                self._extend(entry, value_columns)
+            delta = _read_delta(table, entry)
+            with self._lock:
+                if delta is not None and _base_fingerprint(table) == base_fp:
+                    self.hits += 1
+                    return entry, False, delta
+                # A flush raced the delta read (or the delta predates the
+                # entry inconsistently): serve nothing from cache.
+                self.misses += 1
+                return None, False, None
+        seq_before = {d.table_id: d.last_sequence for d in table.physical_datas()}
         rows = read_rows()
+        seq_after = {d.table_id: d.last_sequence for d in table.physical_datas()}
+        if seq_before != seq_after or _base_fingerprint(table) != base_fp:
+            # Writes or a flush raced the build read: the entry's exact
+            # row set would be ambiguous (delta double/under-count) —
+            # skip building this time.
+            return None, False, None
         n = len(rows)
         if n == 0:
-            return None, False
+            return None, False, None
         ts = rows.timestamps
         min_ts, max_ts = int(ts.min()), int(ts.max())
         if max_ts - min_ts >= _I32_MAX:
-            return None, False
-        entry = self._build(fp, rows, min_ts, max_ts, value_columns)
+            return None, False, None
+        entry = self._build(base_fp, rows, min_ts, max_ts, value_columns)
+        entry.built_seqs = seq_after
         with self._lock:
             self.misses += 1
             if table.name not in self._entries and len(self._entries) >= self.max_entries:
                 self._entries.pop(next(iter(self._entries)))
             self._entries[table.name] = entry
-        return entry, True
+        empty = rows.slice(0, 0)
+        return entry, True, empty
 
     def _build(
         self, fp, rows: RowGroup, min_ts: int, max_ts: int, value_columns: list[str]
@@ -149,12 +185,29 @@ class ScanCache:
         n = len(rows)
         schema = rows.schema
         tsid = rows.columns[schema.columns[schema.tsid_index].name]
-        uniq, first_idx, inverse = np.unique(tsid, return_index=True, return_inverse=True)
+        uniq, _, inverse = np.unique(tsid, return_index=True, return_inverse=True)
         n_series = len(uniq)
-        # pad rows carry series code n_series -> masked out by the kernel
-        codes = pad_to_bucket(inverse.astype(np.int32), n, fill=n_series)
+        # SORT the resident layout by (series, ts): selective queries (a
+        # handful of series out of thousands — the TSBS single-groupby
+        # shape) become contiguous-range gathers instead of full scans.
+        order = np.lexsort((rows.timestamps, inverse))
+        rows = rows.take(order)
+        inverse = inverse[order]
+        counts = np.bincount(inverse, minlength=n_series)
+        offsets = np.zeros(n_series + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        first_idx = offsets[:-1].copy()
+        # One explicit pad row at index n (series code n_series, allow
+        # masked): selective gathers point their padding here even when n
+        # itself is a power of two.
+        codes = pad_to_bucket(
+            np.append(inverse.astype(np.int32), np.int32(n_series)), n + 1,
+            fill=n_series,
+        )
         ts_rel = pad_to_bucket(
-            (rows.timestamps - min_ts).astype(np.int32), n, fill=np.int32(-1)
+            np.append((rows.timestamps - min_ts).astype(np.int32), np.int32(-1)),
+            n + 1,
+            fill=np.int32(-1),
         )
         # Multi-device: the big row arrays live SHARDED across the mesh so
         # steady-state serving is itself distributed (each chip holds and
@@ -192,6 +245,8 @@ class ScanCache:
             ts_rel_dev=ts_dev,
             value_cols_dev={},
             mesh=mesh,
+            series_tsids=uniq,
+            series_offsets=offsets,
         )
         self._extend(entry, value_columns)
         return entry
@@ -206,6 +261,7 @@ class ScanCache:
             place = NamedSharding(entry.mesh, P("shard"))
         for c in value_columns:
             if c not in entry.value_cols_dev:
+                # entry.rows is already in the sorted resident layout
                 arr = as_values(entry.rows.column(c)).astype(np.float32, copy=False)
                 padded = np.pad(arr, (0, target - len(arr)))
                 if place is not None:
@@ -219,7 +275,11 @@ class ScanCache:
             self._entries.pop(table_name, None)
 
 
-def _fingerprint(table) -> tuple:
+def _base_fingerprint(table) -> tuple:
+    """The FLUSHED state only: schema + flushed sequence + SST file set.
+
+    Plain memtable appends deliberately do NOT change it — they are
+    served as a delta on top of the cached base."""
     parts = []
     for data in table.physical_datas():
         files = tuple(
@@ -229,9 +289,34 @@ def _fingerprint(table) -> tuple:
             (
                 data.table_id,
                 data.schema.version,  # ALTER invalidates even with no writes
-                data.last_sequence,
                 data.version.flushed_sequence,
                 files,
             )
         )
     return tuple(parts)
+
+
+def _read_delta(table, entry: CachedTableScan):
+    """Memtable rows with sequence above the entry's build point, or None
+    when the delta cannot be trusted (entry predates unknown state)."""
+    if entry.built_seqs is None:
+        return None
+    parts = []
+    for data in table.physical_datas():
+        built = entry.built_seqs.get(data.table_id)
+        if built is None:
+            return None  # physical set changed (e.g. partition added)
+        version = data.version
+        for mem in [*version.immutables(), version.mutable]:
+            rows, seq = mem.scan(None)
+            if len(rows) == 0:
+                continue
+            keep = seq > built
+            if keep.any():
+                parts.append(rows if keep.all() else rows.filter(keep))
+    if not parts:
+        # verified clean: an empty RowGroup with the table schema
+        return entry.rows.slice(0, 0)
+    from ..common_types.row_group import RowGroup
+
+    return RowGroup.concat(parts) if len(parts) > 1 else parts[0]

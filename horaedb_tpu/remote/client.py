@@ -104,6 +104,9 @@ class RemoteEngineClient:
         out = self._call("PartialAgg", {"table": table, "spec": spec})
         return columns_from_ipc(out["ipc"])
 
+    def drop_sub(self, table: str) -> bool:
+        return bool(self._call("DropSub", {"table": table}).get("dropped"))
+
 
 class RemoteSubTable(Table):
     """A partition owned by another node, behind the Table interface."""
@@ -134,6 +137,11 @@ class RemoteSubTable(Table):
 
     def partial_agg(self, spec: dict):
         return self.client.partial_agg(self._name, spec)
+
+    def drop_remote(self) -> None:
+        """Delete this partition's storage on its owning node (the
+        logical DROP TABLE calls this for every remote partition)."""
+        self.client.drop_sub(self._name)
 
     # Maintenance is owner-local; remote handles are read/write views.
     def flush(self) -> None:

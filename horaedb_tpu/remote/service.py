@@ -77,6 +77,7 @@ class GrpcServer:
                         "Write": _unary(self._write),
                         "Read": _unary(self._read),
                         "PartialAgg": _unary(self._partial_agg),
+                        "DropSub": _unary(self._drop_sub),
                     },
                 ),
                 grpc.method_handlers_generic_handler(
@@ -148,6 +149,19 @@ class GrpcServer:
         t = self._open(req["table"])
         names, arrays = compute_partial(t, req["spec"])
         return {"ipc": columns_to_ipc(names, arrays)}
+
+    def _drop_sub(self, req: dict) -> dict:
+        """Drop ONE partition's storage on its owning node — the logical
+        DROP TABLE dispatches this for remote-owned partitions so nothing
+        orphans in the shared store."""
+        name = req["table"]
+        t = self.conn.catalog.open_sub_table(name)
+        if t is None:
+            return {"dropped": False}  # already gone: idempotent
+        for data in t.physical_datas():
+            self.conn.instance.drop_table(data)
+        self.conn.catalog.forget(name)
+        return {"dropped": True}
 
     # ---- storage (client-facing) ----------------------------------------
     def _sql_query(self, req: dict) -> dict:

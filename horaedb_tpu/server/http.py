@@ -663,11 +663,16 @@ def run_server(
     if grpc_cfg >= 0:
         from ..remote import GrpcServer, grpc_endpoint_for
 
-        grpc_port = (
-            grpc_cfg
-            if grpc_cfg > 0
-            else int(grpc_endpoint_for(f"{host}:{port}").rsplit(":", 1)[1])
-        )
+        derived = int(grpc_endpoint_for(f"{host}:{port}").rsplit(":", 1)[1])
+        grpc_port = grpc_cfg if grpc_cfg > 0 else derived
+        if grpc_cfg > 0 and grpc_cfg != derived:
+            logger.warning(
+                "grpc_port %d differs from the http_port+%d convention (%d): "
+                "PEERS derive remote-engine endpoints from HTTP endpoints, so "
+                "cross-node reads/writes to this node will fail — use the "
+                "derived port unless every node overrides consistently",
+                grpc_cfg, derived - port, derived,
+            )
         grpc_server = GrpcServer(conn, host=host, port=grpc_port, cluster=cluster)
 
     if router is not None and grpc_server is not None:

@@ -16,7 +16,21 @@ import enum
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
+import numpy as np
+
 from ..common_types.time_range import TimeRange
+
+# THE comparison-op table — every layer that evaluates `col op literal`
+# (host expression eval, delta fold, partial push-down) shares it so
+# filter semantics cannot diverge.
+NUMPY_CMP = {
+    "=": np.equal,
+    "!=": np.not_equal,
+    "<": np.less,
+    "<=": np.less_equal,
+    ">": np.greater,
+    ">=": np.greater_equal,
+}
 
 
 class FilterOp(enum.Enum):

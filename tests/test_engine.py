@@ -276,3 +276,48 @@ class TestDDL:
             env.instance.write(t, RowGroup.from_rows(old_schema, [
                 {"name": "h1", "value": 1.0, "t": 1000}
             ]))
+
+
+class TestDeviceMergeRead:
+    def test_device_merge_matches_host(self, monkeypatch):
+        # Force the device merge path (off by default on the CPU backend)
+        # and diff it against the host merge on an overwrite-heavy view.
+        import numpy as np
+
+        from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+        from horaedb_tpu.engine.instance import EngineConfig, Instance
+        from horaedb_tpu.engine.options import TableOptions
+        from horaedb_tpu.engine.flush import Flusher
+        from horaedb_tpu.utils.object_store import MemoryStore
+
+        schema = Schema.build(
+            [
+                ColumnSchema("name", DatumKind.STRING, is_tag=True),
+                ColumnSchema("value", DatumKind.DOUBLE),
+                ColumnSchema("t", DatumKind.TIMESTAMP),
+            ],
+            timestamp_column="t",
+        )
+        inst = Instance(MemoryStore(), EngineConfig(compaction_l0_trigger=1000))
+        t = inst.create_table(0, 1, "dm", schema, TableOptions.from_kv({}))
+        rng = np.random.default_rng(3)
+        expect = {}
+        for run in range(4):
+            rows = []
+            for _ in range(400):
+                ts = int(rng.integers(0, 50_000))
+                name = f"h{rng.integers(0, 6)}"
+                v = float(rng.random())
+                rows.append({"name": name, "value": v, "t": ts})
+                expect[(name, ts)] = v
+            inst.write(t, RowGroup.from_rows(schema, rows))
+            if run < 3:
+                Flusher(t).flush()  # 3 SSTs + 1 live memtable
+
+        host_out = inst.read(t)
+        monkeypatch.setenv("HORAEDB_DEVICE_MERGE_MIN_ROWS", "1")
+        dev_out = inst.read(t)
+        def as_map(rg):
+            return {(r["name"], r["t"]): r["value"] for r in rg.to_pylist()}
+        assert as_map(host_out) == expect
+        assert as_map(dev_out) == expect

@@ -165,3 +165,119 @@ class TestShardedCache:
         db.execute(sql)
         assert ex.last_path == "device-cached"
         assert ex.scan_cache.hits >= 1
+
+
+class TestIncrementalCache:
+    """Round 2: ingest must NOT evict the HBM base — unflushed rows fold
+    in as a delta on top of the cached kernel output."""
+
+    def test_append_ingest_serves_from_cache_plus_delta(self, db):
+        db.execute(
+            "CREATE TABLE inc (host string TAG, v double, ts timestamp KEY) "
+            "WITH (update_mode='append')"
+        )
+        vals = ", ".join(f"('h{i % 5}', {float(i)}, {1000 + i})" for i in range(200))
+        db.execute(f"INSERT INTO inc (host, v, ts) VALUES {vals}")
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = "SELECT host, count(*) AS c, sum(v) AS s FROM inc GROUP BY host"
+        warm(db, sql)
+        assert ex.last_metrics["cache"] in ("build", "hit")
+        # Ingest MORE rows (existing series, overlapping timestamps — fine
+        # in append mode) without flushing.
+        db.execute(
+            "INSERT INTO inc (host, v, ts) VALUES ('h0', 100.0, 1500), ('h1', 50.0, 900)"
+        )
+        out = db.execute(sql)
+        assert ex.last_path == "device-cached", ex.last_path
+        assert ex.last_metrics["cache"] == "hit+delta"
+        assert ex.last_metrics["delta_rows"] == 2
+        got = {r["host"]: r for r in out.to_pylist()}
+        h0 = [float(i) for i in range(200) if i % 5 == 0] + [100.0]
+        h1 = [float(i) for i in range(200) if i % 5 == 1] + [50.0]
+        assert got["h0"]["c"] == len(h0) and abs(got["h0"]["s"] - sum(h0)) < 1e-6
+        assert got["h1"]["c"] == len(h1) and abs(got["h1"]["s"] - sum(h1)) < 1e-6
+
+    def test_overwrite_newer_rows_serve_as_delta(self, db):
+        seed(db, n=200)  # overwrite mode, ts up to t_base+199_000
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = "SELECT host, count(*) AS c, max(v) AS mx FROM t GROUP BY host"
+        warm(db, sql)
+        # strictly NEWER timestamps on existing series: sound delta
+        t_new = 1_700_000_000_000 + 500_000
+        db.execute(
+            f"INSERT INTO t (host, v, ts) VALUES ('h0', 999.0, {t_new})"
+        )
+        out = db.execute(sql)
+        assert ex.last_metrics.get("cache") == "hit+delta", ex.last_metrics
+        got = {r["host"]: r for r in out.to_pylist()}
+        assert got["h0"]["c"] == 41 and got["h0"]["mx"] == 999.0
+
+    def test_overwrite_of_base_row_falls_back(self, db):
+        seed(db, n=100)
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c FROM t"
+        warm(db, sql)
+        # overwrites a BASE timestamp -> delta unsound -> correct fallback
+        db.execute(
+            "INSERT INTO t (host, v, ts) VALUES ('h0', 5.0, 1700000000000)"
+        )
+        out = db.execute(sql)
+        assert ex.last_metrics.get("cache") != "hit+delta"
+        assert out.to_pylist() == [{"c": 100}]  # overwrite: same key count
+
+    def test_new_series_falls_back(self, db):
+        seed(db, n=100)
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c FROM t"
+        warm(db, sql)
+        db.execute(
+            "INSERT INTO t (host, v, ts) VALUES ('brand_new', 5.0, 1800000000000)"
+        )
+        out = db.execute(sql)
+        assert ex.last_metrics.get("cache") != "hit+delta"
+        assert out.to_pylist() == [{"c": 101}]
+
+    def test_flush_rebuilds_base(self, db):
+        seed(db, n=100)
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c FROM t"
+        warm(db, sql)
+        t_new = 1_700_000_000_000 + 900_000
+        db.execute(f"INSERT INTO t (host, v, ts) VALUES ('h1', 1.0, {t_new})")
+        db.execute(sql)
+        assert ex.last_metrics.get("cache") == "hit+delta"
+        db.flush_all()  # base fingerprint changes
+        db.execute(sql)
+        db.execute(sql)  # stability rule: second sighting builds
+        out = db.execute(sql)
+        assert ex.last_metrics.get("cache") == "hit"
+        assert out.to_pylist() == [{"c": 101}]
+
+    def test_delta_respects_filters_and_buckets(self, db):
+        db.execute(
+            "CREATE TABLE fincr (host string TAG, v double, ts timestamp KEY) "
+            "WITH (update_mode='append')"
+        )
+        vals = ", ".join(f"('a', {float(i)}, {i * 1000})" for i in range(120))
+        db.execute(f"INSERT INTO fincr (host, v, ts) VALUES {vals}")
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = (
+            "SELECT time_bucket(ts, '1m') AS b, count(*) AS c FROM fincr "
+            "WHERE v > 50 GROUP BY time_bucket(ts, '1m')"
+        )
+        warm(db, sql)
+        # delta rows land in a NEW later bucket; one fails the filter
+        db.execute(
+            "INSERT INTO fincr (host, v, ts) VALUES ('a', 60.0, 200000), ('a', 10.0, 201000)"
+        )
+        out = db.execute(sql)
+        assert ex.last_metrics.get("cache") == "hit+delta"
+        got = {r["b"]: r["c"] for r in out.to_pylist()}
+        # base: v>50 -> i in 51..119 at ts=i*1000
+        assert got == {0: 9, 60000: 60, 180000: 1}, got  # delta row filtered
