@@ -1,0 +1,350 @@
+"""InfluxQL query translation
+(ref: src/query_frontend/src/influxql/planner.rs — the reference plans
+InfluxQL through forked IOx crates; here the SELECT subset translates onto
+the existing SQL pipeline, the same trick promql.py uses).
+
+Supported subset (mirrors the reference's influxql corpus,
+integration_tests/cases/env/local/influxql/basic.sql):
+
+    SELECT */cols/agg(col) FROM "m"
+        [WHERE tag = 'v' AND time <op> <lit>[ms|s|u|ns]]
+        [GROUP BY tag, ..., time(<dur>)] [FILL(<num>)]
+        [ORDER BY time [DESC]] [LIMIT n]
+    SHOW MEASUREMENTS
+
+Results render in the InfluxDB v1 HTTP shape: one series per group-by
+tag-set with a ``tags`` object, ``time`` first in columns.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..engine.options import parse_duration_ms
+
+
+class InfluxQLError(ValueError):
+    pass
+
+
+AGG_FUNCS = {"count", "sum", "min", "max", "avg", "mean"}
+
+_TOKEN = re.compile(
+    r"""\s*(?:
+      (?P<dstr>"(?:[^"\\]|\\.)*")
+    | (?P<sstr>'(?:[^'\\]|\\.)*')
+    | (?P<num>-?\d+(?:\.\d+)?(?:ms|s|u|ns)?)
+    | (?P<name>[A-Za-z_][A-Za-z0-9_\.]*)
+    | (?P<op><=|>=|!=|<>|=~|!~|[=<>(),\*])
+    )""",
+    re.VERBOSE,
+)
+
+
+def _tokenize(q: str) -> list[str]:
+    out, i = [], 0
+    while i < len(q):
+        m = _TOKEN.match(q, i)
+        if m is None:
+            if q[i:].strip() in ("", ";"):
+                break
+            raise InfluxQLError(f"cannot tokenize at: {q[i:i+20]!r}")
+        out.append(m.group(0).strip())
+        i = m.end()
+    return out
+
+
+@dataclass
+class InfluxSelect:
+    measurement: str
+    items: list  # ("star",) | ("col", name) | ("agg", func, col)
+    conds: list = field(default_factory=list)  # (col, op, value) 'time' = ts
+    group_tags: list = field(default_factory=list)
+    group_time_ms: Optional[int] = None
+    fill: Optional[float] = None
+    order_desc: bool = False
+    limit: Optional[int] = None
+
+
+class _Parser:
+    def __init__(self, q: str) -> None:
+        self.toks = _tokenize(q)
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> str:
+        t = self.peek()
+        if t is None:
+            raise InfluxQLError("unexpected end of query")
+        self.i += 1
+        return t
+
+    def eat(self, kw: str) -> bool:
+        t = self.peek()
+        if t is not None and t.lower() == kw.lower():
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kw: str) -> None:
+        if not self.eat(kw):
+            raise InfluxQLError(f"expected {kw!r}, found {self.peek()!r}")
+
+    # ---- entry ----------------------------------------------------------
+    def parse(self):
+        if self.eat("show"):
+            self.expect("measurements")
+            return "show_measurements"
+        self.expect("select")
+        items = self._select_items()
+        self.expect("from")
+        measurement = _ident(self.next())
+        sel = InfluxSelect(measurement, items)
+        if self.eat("where"):
+            self._where(sel)
+        if self.eat("group"):
+            self.expect("by")
+            self._group_by(sel)
+        if self.eat("fill"):
+            self.expect("(")
+            tok = self.next()
+            if tok.lower() in ("null", "none"):
+                sel.fill = None
+            else:
+                sel.fill = float(_strip_unit(tok)[0])
+            self.expect(")")
+        if self.eat("order"):
+            self.expect("by")
+            if _ident(self.next()).lower() != "time":
+                raise InfluxQLError("ORDER BY supports only time")
+            if self.eat("desc"):
+                sel.order_desc = True
+            else:
+                self.eat("asc")
+        if self.eat("limit"):
+            sel.limit = int(self.next())
+        if self.peek() is not None:
+            raise InfluxQLError(f"unexpected trailing token {self.peek()!r}")
+        return sel
+
+    def _select_items(self) -> list:
+        items = []
+        while True:
+            t = self.next()
+            if t == "*":
+                items.append(("star",))
+            elif t.lower() in AGG_FUNCS and self.peek() == "(":
+                self.next()
+                arg = self.next()
+                self.expect(")")
+                func = "avg" if t.lower() == "mean" else t.lower()
+                items.append(("agg", func, _ident(arg) if arg != "*" else None))
+            else:
+                items.append(("col", _ident(t)))
+            if not self.eat(","):
+                return items
+
+    def _where(self, sel: InfluxSelect) -> None:
+        while True:
+            col = _ident(self.next())
+            op = self.next()
+            if op in ("=~", "!~"):
+                raise InfluxQLError("regex matchers not supported yet")
+            val_tok = self.next()
+            value, unit_ms = _strip_unit(val_tok)
+            if col.lower() == "time":
+                # bare influx time literals are NANOSECONDS
+                scale = unit_ms if unit_ms is not None else 1e-6
+                value = int(float(value) * scale)
+            sel.conds.append((col, "!=" if op == "<>" else op, value))
+            if not self.eat("and"):
+                return
+
+    def _group_by(self, sel: InfluxSelect) -> None:
+        while True:
+            t = self.next()
+            if t.lower() == "time" and self.peek() == "(":
+                self.next()
+                # durations like 5m tokenize as "5","m" — join until ")"
+                dur = ""
+                while self.peek() not in (")", None):
+                    dur += self.next()
+                sel.group_time_ms = parse_duration_ms(dur)
+                self.expect(")")
+            else:
+                sel.group_tags.append(_ident(t))
+            if not self.eat(","):
+                return
+
+
+def _ident(tok: str) -> str:
+    if tok.startswith('"') and tok.endswith('"'):
+        return tok[1:-1].replace('\\"', '"')
+    if tok.startswith("'") and tok.endswith("'"):
+        return tok[1:-1].replace("\\'", "'")
+    return tok
+
+
+_UNIT_MS = {"ms": 1.0, "s": 1000.0, "u": 1e-3, "ns": 1e-6}
+
+
+def _strip_unit(tok: str):
+    """-> (value, ms-per-unit or None). Strings come back unquoted."""
+    if tok.startswith(("'", '"')):
+        return _ident(tok), None
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(ms|s|u|ns)?", tok)
+    if m is None:
+        return tok, None
+    num = float(m.group(1)) if "." in m.group(1) else int(m.group(1))
+    return num, _UNIT_MS.get(m.group(2)) if m.group(2) else None
+
+
+def parse_influxql(q: str):
+    return _Parser(q).parse()
+
+
+# ---- translation onto the SQL pipeline -----------------------------------
+
+
+def to_sql(sel: InfluxSelect, schema) -> str:
+    """Rewrite the influx statement as horaedb_tpu SQL."""
+    ts = schema.timestamp_name
+    cols: list[str] = []
+    has_agg = any(it[0] == "agg" for it in sel.items)
+    if has_agg:
+        for it in sel.items:
+            if it[0] != "agg":
+                raise InfluxQLError("mixing aggregates and raw columns")
+        for tag in sel.group_tags:
+            cols.append(f"`{tag}`")
+        if sel.group_time_ms:
+            cols.append(f"time_bucket(`{ts}`, '{sel.group_time_ms}ms') AS time")
+        for it in sel.items:
+            _, func, col = it
+            label = "mean" if func == "avg" else func
+            target = f"`{col}`" if col else "*"
+            cols.append(f"{func}({target}) AS `{label}`")
+    else:
+        for it in sel.items:
+            if it[0] == "star":
+                cols.append("*")
+            else:
+                cols.append(f"`{it[1]}`")
+    from .promql import sql_str_literal
+
+    where = []
+    for col, op, value in sel.conds:
+        name = ts if col.lower() == "time" else col
+        lit = sql_str_literal(value) if isinstance(value, str) else repr(value)
+        where.append(f"`{name}` {op} {lit}")
+    sql = f"SELECT {', '.join(cols)} FROM `{sel.measurement}`"
+    if where:
+        sql += " WHERE " + " AND ".join(where)
+    groups = [f"`{t}`" for t in sel.group_tags]
+    if has_agg and sel.group_time_ms:
+        groups.append(f"time_bucket(`{ts}`, '{sel.group_time_ms}ms')")
+    if groups and has_agg:
+        sql += " GROUP BY " + ", ".join(groups)
+    if not has_agg:
+        sql += f" ORDER BY `{ts}`" + (" DESC" if sel.order_desc else "")
+    if sel.limit is not None:
+        sql += f" LIMIT {sel.limit}"
+    return sql
+
+
+def evaluate(conn, query: str) -> dict:
+    """Run one InfluxQL statement -> the v1 /query response body."""
+    sel = parse_influxql(query)
+    if sel == "show_measurements":
+        names = conn.catalog.table_names()
+        return _results(
+            [{"name": "measurements", "columns": ["name"], "values": [[n] for n in names]}]
+        )
+    table = conn.catalog.open(sel.measurement)
+    if table is None:
+        return _results([])
+    schema = table.schema
+    out = conn.execute(to_sql(sel, schema))
+    rows = out.to_pylist()
+    ts = schema.timestamp_name
+    has_agg = any(it[0] == "agg" for it in sel.items)
+
+    if not has_agg:
+        columns = (
+            ["time"]
+            + [c.name for c in schema.columns if c.name not in (ts, "tsid")]
+            if any(it[0] == "star" for it in sel.items)
+            else ["time"] + [it[1] for it in sel.items if it[1] != ts]
+        )
+        values = [
+            [r.get(ts)] + [r.get(c) for c in columns[1:]] for r in rows
+        ]
+        return _results(
+            [{"name": sel.measurement, "columns": columns, "values": values}]
+            if values
+            else []
+        )
+
+    # Aggregate: one series per group-by tag-set (influx shape).
+    agg_labels = [
+        ("mean" if it[1] == "avg" else it[1]) for it in sel.items if it[0] == "agg"
+    ]
+    columns = ["time"] + agg_labels
+    series_map: dict[tuple, list] = {}
+    for r in rows:
+        key = tuple((t, r.get(t)) for t in sel.group_tags)
+        t_val = r.get("time", 0) if sel.group_time_ms else 0
+        series_map.setdefault(key, []).append([t_val] + [r.get(a) for a in agg_labels])
+    series = []
+    for key in sorted(series_map, key=lambda k: tuple(str(v) for _, v in k)):
+        vals = sorted(series_map[key], key=lambda v: v[0])
+        if sel.group_time_ms and sel.fill is not None and vals:
+            vals = _fill_buckets(vals, sel, len(agg_labels))
+        if sel.order_desc:
+            vals = vals[::-1]
+        s: dict[str, Any] = {
+            "name": sel.measurement,
+            "columns": columns,
+            "values": vals,
+        }
+        if key:
+            s["tags"] = {t: v for t, v in key}
+        series.append(s)
+    return _results(series)
+
+
+def _fill_buckets(vals: list, sel: InfluxSelect, n_aggs: int) -> list:
+    """FILL(x): materialize empty time buckets inside the covered range."""
+    width = sel.group_time_ms
+    lo = vals[0][0]
+    hi = vals[-1][0]
+    # a bounded WHERE time range extends the fill to the queried window
+    for col, op, value in sel.conds:
+        if col.lower() != "time" or not isinstance(value, (int, float)):
+            continue
+        if op in (">", ">="):
+            lo = min(lo, (int(value) // width) * width)
+        elif op == "<":
+            hi = max(hi, ((int(value) - 1) // width) * width)
+        elif op == "<=":
+            hi = max(hi, (int(value) // width) * width)
+    have = {v[0] for v in vals}
+    out = list(vals)
+    t = lo
+    while t <= hi:
+        if t not in have:
+            out.append([t] + [sel.fill] * n_aggs)
+        t += width
+    out.sort(key=lambda v: v[0])
+    return out
+
+
+def _results(series: list) -> dict:
+    body: dict[str, Any] = {"statement_id": 0}
+    if series:
+        body["series"] = series
+    return {"results": [body]}

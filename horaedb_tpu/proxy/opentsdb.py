@@ -89,3 +89,115 @@ def write_points(catalog: Catalog, points: list[dict]) -> int:
         table.write(RowGroup.from_rows(table.schema, rows))
         written += len(rows)
     return written
+
+
+def evaluate_query(conn, body: Any) -> list[dict]:
+    """OpenTSDB /api/query (ref: the reference's opentsdb query planner,
+    query_frontend/src/opentsdb/) — POST body:
+
+        {"start": s, "end": e, "queries": [{"metric": m,
+         "aggregator": "sum|avg|max|min|count", "tags": {k: v},
+         "downsample": "60s-avg"}]}
+
+    Returns the classic response: one object per (sub)query with ``dps``
+    mapping epoch-seconds -> value, aggregated across matching series.
+    """
+    import numpy as np
+
+    from ..engine.options import parse_duration_ms
+
+    if not isinstance(body, dict) or "queries" not in body:
+        raise OpenTsdbError("body must be {'start':..,'queries':[...]}")
+    start_ms = _normalize_ts(body.get("start", 0))
+    end_ms = _normalize_ts(body["end"]) if body.get("end") is not None else None
+    out = []
+    for q in body["queries"]:
+        metric = q.get("metric")
+        if not isinstance(metric, str):
+            raise OpenTsdbError("query missing 'metric'")
+        agg = str(q.get("aggregator", "sum")).lower()
+        if agg == "mean":
+            agg = "avg"
+        if agg not in ("sum", "avg", "min", "max", "count"):
+            raise OpenTsdbError(f"unsupported aggregator {agg!r}")
+        table = conn.catalog.open(metric)
+        if table is None:
+            out.append({"metric": metric, "tags": {}, "aggregateTags": [], "dps": {}})
+            continue
+        schema = table.schema
+        tags = q.get("tags") or {}
+        down = q.get("downsample")
+        if down:
+            span, _, dfunc = str(down).partition("-")
+            width = parse_duration_ms(span)
+            dfunc = dfunc or "avg"
+        else:
+            # dps keys are epoch SECONDS: without an explicit downsample,
+            # ms-resolution data still folds per second with the query's
+            # aggregator (else same-second buckets would overwrite).
+            width, dfunc = 1000, agg
+
+        from .promql import sql_str_literal
+
+        conds = " AND ".join(
+            f"`{k}` = {sql_str_literal(v)}" for k, v in tags.items()
+        )
+        time_conds = [f"`{schema.timestamp_name}` >= {start_ms}"]
+        if end_ms is not None:
+            time_conds.append(f"`{schema.timestamp_name}` <= {end_ms}")
+        where = " AND ".join(time_conds + ([conds] if conds else []))
+        sql = f"SELECT * FROM `{metric}` WHERE {where}"
+        rows = conn.execute(sql).to_pylist()
+        ts_name = schema.timestamp_name
+        from .promql import PromQLError, _value_column
+
+        try:
+            value_col = _value_column(schema)
+        except PromQLError as e:
+            raise OpenTsdbError(str(e))
+        ts = np.array([r[ts_name] for r in rows], dtype=np.int64)
+        vals = np.array([r[value_col] for r in rows], dtype=np.float64)
+        if schema.tsid_index is not None and rows:
+            series = np.array(
+                [r[schema.columns[schema.tsid_index].name] for r in rows],
+                dtype=np.uint64,
+            )
+        else:
+            series = np.zeros(len(rows), dtype=np.uint64)
+        # Two-level semantics (opentsdb): downsample WITHIN each series'
+        # time buckets first, then the aggregator merges ACROSS series.
+        bucket = (ts // width) * width if width else ts
+
+        def _apply(fn: str, sel: np.ndarray) -> float:
+            if fn == "avg":
+                return float(sel.mean())
+            if fn == "sum":
+                return float(sel.sum())
+            if fn == "min":
+                return float(sel.min())
+            if fn == "max":
+                return float(sel.max())
+            return float(len(sel))  # count
+
+        per_series: dict[int, dict[int, float]] = {}
+        for s in np.unique(series):
+            smask = series == s
+            sb, sv = bucket[smask], vals[smask]
+            per_series[int(s)] = {
+                int(b): _apply(dfunc or "avg", sv[sb == b]) for b in np.unique(sb)
+            }
+        dps: dict[str, float] = {}
+        all_buckets = sorted({b for d in per_series.values() for b in d})
+        for b in all_buckets:
+            xs = np.array([d[b] for d in per_series.values() if b in d])
+            dps[str(b // 1000)] = _apply(agg, xs)
+        tag_names = [c.name for c in schema.columns if c.is_tag]
+        out.append(
+            {
+                "metric": metric,
+                "tags": {k: str(v) for k, v in tags.items()},
+                "aggregateTags": [t for t in tag_names if t not in tags],
+                "dps": dps,
+            }
+        )
+    return out

@@ -1,0 +1,251 @@
+"""Prometheus remote read (ref: src/proxy/src/grpc/prom_query.rs and the
+reference's remote-read support — Prometheus federates long-term storage
+through this protocol).
+
+Wire protocol: HTTP POST, snappy-block-compressed protobuf. The messages
+used (prompb/remote.proto + types.proto, stable public schema):
+
+    ReadRequest  { repeated Query queries = 1; }
+    Query        { int64 start_timestamp_ms = 1; int64 end_timestamp_ms = 2;
+                   repeated LabelMatcher matchers = 3; }
+    LabelMatcher { enum Type {EQ=0; NEQ=1; RE=2; NRE=3;}
+                   Type type = 1; string name = 2; string value = 3; }
+    ReadResponse { repeated QueryResult results = 1; }
+    QueryResult  { repeated TimeSeries timeseries = 1; }
+    TimeSeries   { repeated Label labels = 1; repeated Sample samples = 2; }
+    Label        { string name = 1; string value = 2; }
+    Sample       { double value = 1; int64 timestamp = 2; }
+
+The tiny wire codec below implements exactly these fields — no protoc
+needed for a fixed, frozen schema.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from typing import Any
+
+import numpy as np
+
+from ..utils.snappy import SnappyError, compress, decompress
+
+
+class RemoteReadError(ValueError):
+    pass
+
+
+# ---- protobuf wire primitives --------------------------------------------
+
+
+def _uvarint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = result = 0
+    while True:
+        if i >= len(buf):
+            raise RemoteReadError("truncated protobuf varint")
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 63:
+            raise RemoteReadError("protobuf varint too long")
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over one message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _uvarint(buf, i)
+        field, wt = key >> 3, key & 0x07
+        if wt == 0:  # varint
+            v, i = _uvarint(buf, i)
+        elif wt == 1:  # 64-bit
+            v = buf[i : i + 8]
+            i += 8
+        elif wt == 2:  # length-delimited
+            ln, i = _uvarint(buf, i)
+            v = buf[i : i + ln]
+            i += ln
+        elif wt == 5:  # 32-bit
+            v = buf[i : i + 4]
+            i += 4
+        else:
+            raise RemoteReadError(f"unsupported wire type {wt}")
+        yield field, wt, v
+
+
+def _emit_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _emit_field(field: int, wt: int, payload: bytes) -> bytes:
+    head = _emit_varint((field << 3) | wt)
+    if wt == 2:
+        return head + _emit_varint(len(payload)) + payload
+    return head + payload
+
+
+def _zigzag_int64(v: int) -> int:
+    # plain int64 varints encode negatives as 10-byte two's complement
+    return v & 0xFFFFFFFFFFFFFFFF
+
+
+# ---- request decode -------------------------------------------------------
+
+
+def decode_read_request(raw: bytes) -> list[dict]:
+    try:
+        buf = decompress(raw)
+    except SnappyError as e:
+        raise RemoteReadError(f"bad snappy body: {e}")
+    queries = []
+    for field, wt, v in _fields(buf):
+        if field == 1 and wt == 2:
+            queries.append(_decode_query(v))
+    return queries
+
+
+def _decode_query(buf: bytes) -> dict:
+    q = {"start_ms": 0, "end_ms": 0, "matchers": []}
+    for field, wt, v in _fields(buf):
+        if field == 1 and wt == 0:
+            q["start_ms"] = _signed(v)
+        elif field == 2 and wt == 0:
+            q["end_ms"] = _signed(v)
+        elif field == 3 and wt == 2:
+            q["matchers"].append(_decode_matcher(v))
+    return q
+
+
+def _signed(v: int) -> int:
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+_MATCHER_OPS = {0: "=", 1: "!=", 2: "=~", 3: "!~"}
+
+
+def _decode_matcher(buf: bytes) -> tuple[str, str, str]:
+    op_code = 0
+    name = value = ""
+    for field, wt, v in _fields(buf):
+        if field == 1 and wt == 0:
+            op_code = v
+        elif field == 2 and wt == 2:
+            name = v.decode("utf-8", "replace")
+        elif field == 3 and wt == 2:
+            value = v.decode("utf-8", "replace")
+    return (_MATCHER_OPS.get(op_code, "="), name, value)
+
+
+# ---- response encode ------------------------------------------------------
+
+
+def encode_read_response(results: list[list[dict]]) -> bytes:
+    body = b"".join(
+        _emit_field(1, 2, _encode_query_result(ts_list)) for ts_list in results
+    )
+    return compress(body)
+
+
+def _encode_query_result(ts_list: list[dict]) -> bytes:
+    return b"".join(_emit_field(1, 2, _encode_timeseries(ts)) for ts in ts_list)
+
+
+def _encode_timeseries(ts: dict) -> bytes:
+    out = bytearray()
+    for name, value in sorted(ts["labels"].items()):
+        label = _emit_field(1, 2, name.encode()) + _emit_field(2, 2, value.encode())
+        out += _emit_field(1, 2, label)
+    for t_ms, val in ts["samples"]:
+        sample = _emit_field(1, 1, struct.pack("<d", float(val))) + _emit_field(
+            2, 0, _emit_varint(_zigzag_int64(int(t_ms)))
+        )
+        out += _emit_field(2, 2, sample)
+    return bytes(out)
+
+
+# ---- evaluation -----------------------------------------------------------
+
+
+def handle_remote_read(conn, raw: bytes) -> bytes:
+    """ReadRequest bytes -> ReadResponse bytes (both snappy-framed)."""
+    queries = decode_read_request(raw)
+    results = []
+    for q in queries:
+        results.append(_run_query(conn, q))
+    return encode_read_response(results)
+
+
+def _run_query(conn, q: dict) -> list[dict]:
+    from .promql import _value_column
+
+    metric = None
+    tag_eq: list[tuple[str, str]] = []
+    post: list[tuple[str, str, str]] = []
+    for op, name, value in q["matchers"]:
+        if name == "__name__" and op == "=":
+            metric = value
+        elif op == "=":
+            tag_eq.append((name, value))
+        else:
+            post.append((op, name, value))
+    if metric is None:
+        raise RemoteReadError("only __name__ equality selection is supported")
+    table = conn.catalog.open(metric)
+    if table is None:
+        return []
+    schema = table.schema
+    ts_name = schema.timestamp_name
+    value_col = _value_column(schema)
+    conds = [f"`{ts_name}` >= {q['start_ms']}", f"`{ts_name}` <= {q['end_ms']}"]
+    for name, value in tag_eq:
+        if schema.has_column(name):
+            from .promql import sql_str_literal
+
+            conds.append(f"`{name}` = {sql_str_literal(value)}")
+        elif value != "":
+            # Prometheus semantics: an equality matcher on a label the
+            # series does not carry matches only the EMPTY value — a
+            # non-empty match against a missing label matches nothing.
+            return []
+    rows = conn.execute(
+        f"SELECT * FROM `{metric}` WHERE {' AND '.join(conds)}"
+    ).to_pylist()
+
+    tag_names = [c.name for c in schema.columns if c.is_tag]
+    series: dict[tuple, dict] = {}
+    for r in rows:
+        labels = {t: str(r.get(t)) for t in tag_names if r.get(t) is not None}
+        if not _post_match(labels, post):
+            continue
+        key = tuple(sorted(labels.items()))
+        s = series.setdefault(
+            key, {"labels": {"__name__": metric, **labels}, "samples": []}
+        )
+        s["samples"].append((r[ts_name], r[value_col]))
+    for s in series.values():
+        s["samples"].sort(key=lambda kv: kv[0])
+    return [series[k] for k in sorted(series)]
+
+
+def _post_match(labels: dict, post: list[tuple[str, str, str]]) -> bool:
+    for op, name, value in post:
+        current = labels.get(name, "")
+        if op == "!=" and current == value:
+            return False
+        if op == "=~" and re.fullmatch(value, current) is None:
+            return False
+        if op == "!~" and re.fullmatch(value, current) is not None:
+            return False
+    return True

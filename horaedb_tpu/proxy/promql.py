@@ -182,6 +182,13 @@ def parse_promql(query: str) -> PromQuery:
 # ---- evaluation ---------------------------------------------------------
 
 
+def sql_str_literal(v: str) -> str:
+    """Quote a string for SQL interpolation (doubling embedded quotes) —
+    EVERY protocol front end that builds WHERE clauses from client data
+    must use this, or apostrophes break the query (and worse)."""
+    return "'" + str(v).replace("'", "''") + "'"
+
+
 def _value_column(schema) -> str:
     if schema.has_column("value"):
         return "value"
@@ -249,7 +256,7 @@ def evaluate_range(
     where = [f"{_q(schema.timestamp_name)} >= {start_ms}",
              f"{_q(schema.timestamp_name)} <= {end_ms}"]
     for label, op, val in pq.matchers:
-        sval = val.replace("'", "''")
+        sval = str(val).replace("'", "''")  # keep in sync w/ sql_str_literal
         where.append(f"{_q(label)} {'=' if op == '=' else '!='} '{sval}'")
 
     keys = [f"time_bucket({_q(schema.timestamp_name)}, '{step_ms}ms')"] + [

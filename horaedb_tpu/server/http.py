@@ -328,6 +328,78 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
         # Influx v1 returns 204 No Content on success.
         return web.Response(status=204, headers={"X-Written-Rows": str(n)})
 
+    async def influx_query(request: web.Request) -> web.Response:
+        """InfluxDB v1 /query endpoint (ref: influxdb/mod.rs:52-61)."""
+        from ..proxy.influxql import InfluxQLError, evaluate
+
+        params = dict(request.query)
+        if request.method == "POST":
+            try:
+                params.update(await request.post())
+            except Exception:
+                pass
+        q = params.get("q", "")
+        if not q:
+            return web.json_response(
+                {"error": "missing query parameter 'q'"}, status=400
+            )
+        try:
+            proxy._m_queries.inc()
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, evaluate, conn, q
+            )
+        except (InfluxQLError, ValueError) as e:
+            proxy._m_errors.inc()
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            proxy._m_errors.inc()
+            return web.json_response({"error": str(e)}, status=422)
+        return web.Response(text=_dumps(data), content_type="application/json")
+
+    async def opentsdb_query(request: web.Request) -> web.Response:
+        """OpenTSDB /api/query (ref: opentsdb/mod.rs read side)."""
+        from ..proxy.opentsdb import OpenTsdbError, evaluate_query
+
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        try:
+            proxy._m_queries.inc()
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, evaluate_query, conn, body
+            )
+        except OpenTsdbError as e:
+            proxy._m_errors.inc()
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            proxy._m_errors.inc()
+            return web.json_response({"error": str(e)}, status=422)
+        return web.Response(text=_dumps(data), content_type="application/json")
+
+    async def prom_remote_read(request: web.Request) -> web.Response:
+        """Prometheus remote-read: snappy-framed protobuf over HTTP POST
+        (ref: the reference's Prom remote query, grpc/prom_query.rs)."""
+        from ..proxy.prom_remote import RemoteReadError, handle_remote_read
+
+        raw = await request.read()
+        try:
+            proxy._m_queries.inc()
+            payload = await asyncio.get_running_loop().run_in_executor(
+                None, handle_remote_read, conn, raw
+            )
+        except RemoteReadError as e:
+            proxy._m_errors.inc()
+            return web.json_response({"error": str(e)}, status=400)
+        except Exception as e:
+            proxy._m_errors.inc()
+            return web.json_response({"error": str(e)}, status=422)
+        return web.Response(
+            body=payload,
+            content_type="application/x-protobuf",
+            headers={"Content-Encoding": "snappy"},
+        )
+
     async def opentsdb_put(request: web.Request) -> web.Response:
         from ..proxy.opentsdb import OpenTsdbError, parse_put, write_points as otsdb_write
 
@@ -590,7 +662,12 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
     app.router.add_post("/sql", sql)
     app.router.add_post("/write", write)
     app.router.add_post("/influxdb/v1/write", influx_write)
+    app.router.add_get("/influxdb/v1/query", influx_query)
+    app.router.add_post("/influxdb/v1/query", influx_query)
     app.router.add_post("/opentsdb/api/put", opentsdb_put)
+    app.router.add_post("/opentsdb/api/query", opentsdb_query)
+    app.router.add_post("/prom/v1/read", prom_remote_read)
+    app.router.add_post("/api/v1/read", prom_remote_read)
     app.router.add_get("/prom/v1/query_range", prom_query)
     app.router.add_post("/prom/v1/query_range", prom_query)
     app.router.add_get("/prom/v1/query", prom_query)

@@ -1,0 +1,119 @@
+"""Minimal snappy BLOCK format codec (pure Python).
+
+Prometheus remote read/write bodies are snappy block-compressed protobuf;
+no snappy library ships in this image, and the format is small enough to
+implement directly (it is a public format: a varint uncompressed length
+followed by literal/copy tagged elements).
+
+- ``decompress`` handles the full tag set real compressors emit
+  (literals + 1/2/4-byte-offset copies).
+- ``compress`` emits ALL-LITERAL output — valid snappy any decoder
+  accepts; we trade compression ratio for zero complexity on the encode
+  side (responses are small aggregates anyway).
+"""
+
+from __future__ import annotations
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def _read_uvarint(buf: bytes, i: int) -> tuple[int, int]:
+    shift = result = 0
+    while True:
+        if i >= len(buf):
+            raise SnappyError("truncated varint")
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 63:
+            raise SnappyError("varint too long")
+
+
+def _write_uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def decompress(buf: bytes) -> bytes:
+    total, i = _read_uvarint(buf, 0)
+    out = bytearray()
+    n = len(buf)
+    while i < n:
+        tag = buf[i]
+        i += 1
+        elem_type = tag & 0x03
+        if elem_type == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                if i + extra > n:
+                    raise SnappyError("truncated literal length")
+                length = int.from_bytes(buf[i : i + extra], "little") + 1
+                i += extra
+            if i + length > n:
+                raise SnappyError("truncated literal")
+            out += buf[i : i + length]
+            i += length
+            continue
+        if elem_type == 1:  # copy, 1-byte offset
+            length = ((tag >> 2) & 0x07) + 4
+            if i >= n:
+                raise SnappyError("truncated copy-1")
+            offset = ((tag >> 5) << 8) | buf[i]
+            i += 1
+        elif elem_type == 2:  # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            if i + 2 > n:
+                raise SnappyError("truncated copy-2")
+            offset = int.from_bytes(buf[i : i + 2], "little")
+            i += 2
+        else:  # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            if i + 4 > n:
+                raise SnappyError("truncated copy-4")
+            offset = int.from_bytes(buf[i : i + 4], "little")
+            i += 4
+        if offset == 0 or offset > len(out):
+            raise SnappyError(f"bad copy offset {offset}")
+        # Copies may overlap themselves (run-length style): byte-at-a-time
+        # when the length exceeds the back-reference distance.
+        start = len(out) - offset
+        if length <= offset:
+            out += out[start : start + length]
+        else:
+            for k in range(length):
+                out.append(out[start + k])
+    if len(out) != total:
+        raise SnappyError(f"decompressed size {len(out)} != header {total}")
+    return bytes(out)
+
+
+def compress(data: bytes) -> bytes:
+    out = bytearray(_write_uvarint(len(data)))
+    i = 0
+    n = len(data)
+    while i < n:
+        chunk = min(n - i, 0x10000)  # literal length fits in 2 extra bytes
+        if chunk <= 60:
+            out.append((chunk - 1) << 2)
+        elif chunk <= 0x100:
+            out.append(60 << 2)
+            out += (chunk - 1).to_bytes(1, "little")
+        else:
+            out.append(61 << 2)
+            out += (chunk - 1).to_bytes(2, "little")
+        out += data[i : i + chunk]
+        i += chunk
+    return bytes(out)

@@ -202,3 +202,327 @@ class TestOpenTsdbEndpoint:
             assert resp.status == 400
 
         with_client(body)
+
+
+class TestInfluxQLQuery:
+    """InfluxQL SELECT subset -> the v1 /query response shape
+    (ref corpus: integration_tests/cases/env/local/influxql/basic.sql)."""
+
+    def _seed(self):
+        async def seed(client, conn):
+            conn.execute(
+                "CREATE TABLE h2o (level string TAG, location string TAG, "
+                "water_level double, time timestamp NOT NULL, "
+                "TIMESTAMP KEY(time)) ENGINE=Analytic"
+            )
+            conn.execute(
+                "INSERT INTO h2o (level, location, water_level, time) VALUES "
+                "('mid', 'coyote_creek', 8.12, 1439827200000), "
+                "('low', 'santa_monica', 2.064, 1439827200000), "
+                "('mid', 'coyote_creek', 8.005, 1439827560000), "
+                "('low', 'santa_monica', 2.116, 1439827560000), "
+                "('mid', 'coyote_creek', 7.887, 1439827620000), "
+                "('low', 'santa_monica', 2.028, 1439827620000)"
+            )
+        return seed
+
+    def test_select_star(self):
+        async def body(client, conn):
+            await self._seed()(client, conn)
+            resp = await client.get(
+                "/influxdb/v1/query", params={"q": 'SELECT * FROM "h2o"'}
+            )
+            assert resp.status == 200
+            series = (await resp.json())["results"][0]["series"][0]
+            assert series["name"] == "h2o"
+            assert series["columns"][0] == "time"
+            assert len(series["values"]) == 6
+
+        with_client(body)
+
+    def test_filter_and_projection(self):
+        async def body(client, conn):
+            await self._seed()(client, conn)
+            resp = await client.get(
+                "/influxdb/v1/query",
+                params={"q": "SELECT water_level FROM h2o WHERE location = 'santa_monica'"},
+            )
+            series = (await resp.json())["results"][0]["series"][0]
+            assert [v[1] for v in series["values"]] == [2.064, 2.116, 2.028]
+
+        with_client(body)
+
+    def test_group_by_tag_count(self):
+        async def body(client, conn):
+            await self._seed()(client, conn)
+            resp = await client.get(
+                "/influxdb/v1/query",
+                params={"q": "SELECT count(water_level) FROM h2o GROUP BY location"},
+            )
+            series = (await resp.json())["results"][0]["series"]
+            got = {s["tags"]["location"]: s["values"][0][1] for s in series}
+            assert got == {"coyote_creek": 3, "santa_monica": 3}
+
+        with_client(body)
+
+    def test_group_by_time_with_fill(self):
+        async def body(client, conn):
+            await self._seed()(client, conn)
+            q = (
+                "SELECT count(water_level) FROM h2o "
+                "WHERE time < 1439828400000ms GROUP BY location, time(5m) FILL(666)"
+            )
+            resp = await client.get("/influxdb/v1/query", params={"q": q})
+            series = (await resp.json())["results"][0]["series"]
+            by_loc = {s["tags"]["location"]: s["values"] for s in series}
+            # window [floor(first bucket) .. bucket before 1439828400000)
+            for loc in ("coyote_creek", "santa_monica"):
+                vals = by_loc[loc]
+                counts = {v[0]: v[1] for v in vals}
+                assert counts[1439827200000] == 1  # 00:00
+                assert counts[1439827500000] == 2  # 00:06 + 00:12
+                assert counts[1439827800000] == 666  # filled
+                assert counts[1439828100000] == 666  # filled
+
+        with_client(body)
+
+    def test_show_measurements(self):
+        async def body(client, conn):
+            await self._seed()(client, conn)
+            resp = await client.get(
+                "/influxdb/v1/query", params={"q": "show measurements"}
+            )
+            series = (await resp.json())["results"][0]["series"][0]
+            assert ["h2o"] in series["values"]
+
+        with_client(body)
+
+    def test_mean_alias_and_limit(self):
+        async def body(client, conn):
+            await self._seed()(client, conn)
+            resp = await client.get(
+                "/influxdb/v1/query",
+                params={"q": "SELECT mean(water_level) FROM h2o GROUP BY location"},
+            )
+            series = (await resp.json())["results"][0]["series"]
+            got = {s["tags"]["location"]: s["values"][0][1] for s in series}
+            assert abs(got["santa_monica"] - (2.064 + 2.116 + 2.028) / 3) < 1e-5
+            assert series[0]["columns"] == ["time", "mean"]
+
+        with_client(body)
+
+    def test_parse_errors(self):
+        async def body(client, conn):
+            resp = await client.get(
+                "/influxdb/v1/query", params={"q": "SELEC nope"}
+            )
+            assert resp.status == 400
+
+        with_client(body)
+
+
+class TestOpenTsdbQuery:
+    def test_downsample_and_aggregate(self):
+        async def body(client, conn):
+            # two series of metric m: h1 and h2
+            put = [
+                {"metric": "m", "timestamp": 1700000000, "value": 1.0, "tags": {"host": "h1"}},
+                {"metric": "m", "timestamp": 1700000010, "value": 3.0, "tags": {"host": "h1"}},
+                {"metric": "m", "timestamp": 1700000000, "value": 10.0, "tags": {"host": "h2"}},
+                {"metric": "m", "timestamp": 1700000070, "value": 5.0, "tags": {"host": "h1"}},
+            ]
+            resp = await client.post("/opentsdb/api/put", json=put)
+            assert resp.status == 204
+            q = {
+                "start": 1699999000,
+                "end": 1700001000,
+                "queries": [
+                    {"metric": "m", "aggregator": "sum", "downsample": "60s-avg"}
+                ],
+            }
+            resp = await client.post("/opentsdb/api/query", json=q)
+            assert resp.status == 200
+            out = (await resp.json())[0]
+            # bucket 1700000000-: h1 avg(1,3)=2, h2 avg(10)=10 -> sum 12
+            # bucket 1700000060-: h1 avg(5)=5
+            b0 = str(1700000000 // 60 * 60)
+            b1 = str(1700000060 // 60 * 60)
+            assert out["dps"][b0] == 12.0
+            assert out["dps"][b1] == 5.0
+            assert out["aggregateTags"] == ["host"]
+
+        with_client(body)
+
+    def test_tag_filter(self):
+        async def body(client, conn):
+            put = [
+                {"metric": "m2", "timestamp": 1700000000, "value": 1.0, "tags": {"host": "a"}},
+                {"metric": "m2", "timestamp": 1700000000, "value": 9.0, "tags": {"host": "b"}},
+            ]
+            await client.post("/opentsdb/api/put", json=put)
+            q = {
+                "start": 1699999000,
+                "queries": [{"metric": "m2", "aggregator": "sum", "tags": {"host": "a"}}],
+            }
+            resp = await client.post("/opentsdb/api/query", json=q)
+            out = (await resp.json())[0]
+            assert list(out["dps"].values()) == [1.0]
+            assert out["tags"] == {"host": "a"}
+
+        with_client(body)
+
+
+class TestPromRemoteRead:
+    def test_round_trip(self):
+        from horaedb_tpu.proxy.prom_remote import (
+            _emit_field,
+            _emit_varint,
+            decode_read_request,
+        )
+        from horaedb_tpu.utils.snappy import compress, decompress
+
+        # build a ReadRequest: one query, __name__ = mm, host != b
+        def matcher(op_code, name, value):
+            return (
+                _emit_field(1, 0, _emit_varint(op_code))
+                + _emit_field(2, 2, name.encode())
+                + _emit_field(3, 2, value.encode())
+            )
+
+        query = (
+            _emit_field(1, 0, _emit_varint(1699999000000))
+            + _emit_field(2, 0, _emit_varint(1700001000000))
+            + _emit_field(3, 2, matcher(0, "__name__", "mm"))
+            + _emit_field(3, 2, matcher(1, "host", "b"))
+        )
+        req = compress(_emit_field(1, 2, query))
+        qs = decode_read_request(req)
+        assert qs[0]["start_ms"] == 1699999000000
+        assert ("!=", "host", "b") in qs[0]["matchers"]
+
+        async def body(client, conn):
+            conn.execute(
+                "CREATE TABLE mm (host string TAG, value double, "
+                "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            conn.execute(
+                "INSERT INTO mm (host, value, ts) VALUES "
+                "('a', 1.5, 1700000000000), ('b', 9.0, 1700000000000), "
+                "('a', 2.5, 1700000060000)"
+            )
+            resp = await client.post("/prom/v1/read", data=req)
+            assert resp.status == 200, await resp.text()
+            raw = await resp.read()
+            body_pb = decompress(raw)
+            # decode response: results(1) -> timeseries(1) -> labels/samples
+            from horaedb_tpu.proxy.prom_remote import _fields
+            import struct as _struct
+
+            series = []
+            for f, wt, v in _fields(body_pb):
+                assert f == 1
+                for f2, _, ts_buf in _fields(v):
+                    labels, samples = {}, []
+                    for f3, _, item in _fields(ts_buf):
+                        if f3 == 1:
+                            kv = {}
+                            for f4, _, x in _fields(item):
+                                kv[f4] = x
+                            labels[kv[1].decode()] = kv[2].decode()
+                        else:
+                            val = t = None
+                            for f4, w4, x in _fields(item):
+                                if f4 == 1:
+                                    val = _struct.unpack("<d", x)[0]
+                                else:
+                                    t = x
+                            samples.append((t, val))
+                    series.append((labels, samples))
+            assert len(series) == 1  # host 'b' excluded by !=
+            labels, samples = series[0]
+            assert labels["host"] == "a" and labels["__name__"] == "mm"
+            assert [(t, v) for t, v in samples] == [
+                (1700000000000, 1.5), (1700000060000, 2.5),
+            ]
+
+        with_client(body)
+
+    def test_snappy_codec_round_trip(self):
+        from horaedb_tpu.utils.snappy import compress, decompress
+
+        for data in (b"", b"x", b"hello world" * 100, bytes(range(256)) * 50):
+            assert decompress(compress(data)) == data
+
+    def test_snappy_copy_ops(self):
+        from horaedb_tpu.utils.snappy import decompress, _write_uvarint
+
+        # hand-built stream using a 1-byte-offset overlapping copy:
+        # literal "ab" then copy len 6 offset 2 -> "abababab"
+        stream = _write_uvarint(8) + bytes([(2 - 1) << 2]) + b"ab" + bytes(
+            [0b001 | ((6 - 4) << 2)]
+        ) + bytes([2])
+        assert decompress(stream) == b"abababab"
+
+
+class TestProtocolReviewRegressions:
+    def test_opentsdb_same_second_ms_points_aggregate(self):
+        async def body(client, conn):
+            put = [
+                {"metric": "ms1", "timestamp": 1700000000100, "value": 1.0, "tags": {"h": "a"}},
+                {"metric": "ms1", "timestamp": 1700000000900, "value": 2.0, "tags": {"h": "a"}},
+            ]
+            await client.post("/opentsdb/api/put", json=put)
+            q = {"start": 1699999000, "queries": [{"metric": "ms1", "aggregator": "sum"}]}
+            resp = await client.post("/opentsdb/api/query", json=q)
+            out = (await resp.json())[0]
+            assert out["dps"] == {"1700000000": 3.0}, out  # both points folded
+
+        with_client(body)
+
+    def test_opentsdb_quote_in_tag_value(self):
+        async def body(client, conn):
+            put = [{"metric": "qt", "timestamp": 1700000000, "value": 1.0, "tags": {"h": "o'brien"}}]
+            await client.post("/opentsdb/api/put", json=put)
+            q = {"start": 1699999000, "queries": [{"metric": "qt", "aggregator": "sum", "tags": {"h": "o'brien"}}]}
+            resp = await client.post("/opentsdb/api/query", json=q)
+            assert resp.status == 200, await resp.text()
+            assert (await resp.json())[0]["dps"] == {"1700000000": 1.0}
+
+        with_client(body)
+
+    def test_prom_remote_read_missing_label_matcher(self):
+        from horaedb_tpu.proxy.prom_remote import _run_query
+
+        async def body(client, conn):
+            conn.execute(
+                "CREATE TABLE t3 (host string TAG, value double, "
+                "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            conn.execute("INSERT INTO t3 (host, value, ts) VALUES ('a', 1.0, 1700000000000)")
+            q = {
+                "start_ms": 0, "end_ms": 2**42,
+                "matchers": [("=", "__name__", "t3"), ("=", "env", "prod")],
+            }
+            assert _run_query(conn, q) == []  # missing label + non-empty value
+            q["matchers"][1] = ("=", "env", "")
+            assert len(_run_query(conn, q)) == 1  # empty value matches missing
+
+        with_client(body)
+
+    def test_influxql_order_desc_on_aggregate(self):
+        async def body(client, conn):
+            conn.execute(
+                "CREATE TABLE od (h string TAG, v double, time timestamp NOT NULL, "
+                "TIMESTAMP KEY(time)) ENGINE=Analytic"
+            )
+            conn.execute(
+                "INSERT INTO od (h, v, time) VALUES ('a', 1.0, 0), ('a', 2.0, 60000)"
+            )
+            resp = await client.get(
+                "/influxdb/v1/query",
+                params={"q": "SELECT mean(v) FROM od GROUP BY time(1m) ORDER BY time DESC"},
+            )
+            series = (await resp.json())["results"][0]["series"][0]
+            assert [v[0] for v in series["values"]] == [60000, 0]
+
+        with_client(body)
