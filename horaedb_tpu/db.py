@@ -55,10 +55,26 @@ def connect(
     path: Optional[str] = None,
     wal: bool = True,
     engine_config: EngineConfig | None = None,
+    wal_backend: str = "disk",
 ) -> Connection:
-    """Open (or create) a database. ``path=None`` -> in-memory, no WAL."""
+    """Open (or create) a database. ``path=None`` -> in-memory, no WAL.
+
+    ``wal_backend``: "disk" (framed local log) or "object_store" (paged
+    log in the same store as the SSTs — a diskless node recovers from
+    shared storage alone)."""
     if path is None:
         return Connection(MemoryStore(), config=engine_config)
     store = LocalDiskStore(path)
-    wal_mgr = LocalDiskWal(f"{path}/wal") if wal else None
+    if not wal:
+        wal_mgr = None
+    elif wal_backend == "object_store":
+        from .engine.wal import ObjectStoreWal
+
+        wal_mgr = ObjectStoreWal(store)
+    elif wal_backend == "disk":
+        wal_mgr = LocalDiskWal(f"{path}/wal")
+    else:
+        raise ValueError(
+            f"unknown wal_backend {wal_backend!r} (use 'disk' or 'object_store')"
+        )
     return Connection(store, wal=wal_mgr, config=engine_config)

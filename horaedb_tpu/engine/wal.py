@@ -198,6 +198,102 @@ class LocalDiskWal(WalManager):
             self._files.clear()
 
 
+class ObjectStoreWal(WalManager):
+    """WAL over the object-store interface — the second real backend
+    proving the trait boundary (ref: the table-KV WAL keeps its log in a
+    remote KV service, wal/src/table_kv_impl/namespace.rs; the TPU-build
+    analog is a paged log in the same object store that holds the SSTs,
+    so a diskless node recovers from shared storage alone).
+
+    Layout: one immutable PAGE object per append group,
+
+        wal/{table_id}/{first_seq:020d}-{last_seq:020d}.page
+
+    using the same framed record encoding as the disk backend. Pages are
+    never rewritten; truncation deletes whole pages whose last sequence is
+    flushed, and a marker object records the flushed watermark.
+    """
+
+    def __init__(self, store, prefix: str = "wal") -> None:
+        self.store = store
+        self.prefix = prefix
+        self._locks: dict[int, threading.Lock] = {}
+        self._guard = threading.Lock()
+
+    def _lock(self, table_id: int) -> threading.Lock:
+        with self._guard:
+            return self._locks.setdefault(table_id, threading.Lock())
+
+    def _dir(self, table_id: int) -> str:
+        return f"{self.prefix}/{table_id}/"
+
+    def _flushed_path(self, table_id: int) -> str:
+        return f"{self.prefix}/{table_id}/flushed"
+
+    def _pages(self, table_id: int) -> list[tuple[int, int, str]]:
+        """Sorted (first_seq, last_seq, path) for every page object."""
+        out = []
+        for path in self.store.list(self._dir(table_id)):
+            name = path.rsplit("/", 1)[-1]
+            if not name.endswith(".page"):
+                continue
+            first, _, last = name[: -len(".page")].partition("-")
+            try:
+                out.append((int(first), int(last), path))
+            except ValueError:
+                continue
+        out.sort()
+        return out
+
+    # ---- WalManager ------------------------------------------------------
+    def append(self, table_id: int, seq: int, rows: RowGroup) -> None:
+        record = _encode_record(seq, rows)
+        path = f"{self.prefix}/{table_id}/{seq:020d}-{seq:020d}.page"
+        with self._lock(table_id):
+            self.store.put(path, record)
+
+    def read_from(
+        self, table_id: int, from_seq: int
+    ) -> Iterator[tuple[int, pa.RecordBatch]]:
+        flushed = self._read_flushed(table_id)
+        for first, last, path in self._pages(table_id):
+            if last < from_seq or last <= flushed:
+                continue
+            raw = self.store.get(path)
+            for seq, batch in _decode_records(raw, path):
+                if seq >= from_seq and seq > flushed:
+                    yield seq, batch
+
+    def mark_flushed(self, table_id: int, seq: int) -> None:
+        with self._lock(table_id):
+            pages = self._pages(table_id)
+            for first, last, path in pages:
+                if last <= seq:
+                    self.store.delete(path)
+            if pages and all(last <= seq for _, last, _ in pages):
+                # fully truncated: the marker may go too
+                try:
+                    self.store.delete(self._flushed_path(table_id))
+                except FileNotFoundError:
+                    pass
+                return
+            self.store.put(self._flushed_path(table_id), str(seq).encode())
+
+    def _read_flushed(self, table_id: int) -> int:
+        try:
+            return int(self.store.get(self._flushed_path(table_id)).decode() or 0)
+        except FileNotFoundError:
+            return 0
+
+    def delete_table(self, table_id: int) -> None:
+        with self._lock(table_id):
+            for path in list(self.store.list(self._dir(table_id))):
+                try:
+                    self.store.delete(path)
+                except FileNotFoundError:
+                    pass
+
+
 class NoopWal(WalManager):
     """``DoNothing`` analog (ref: wal/src/dummy.rs) — explicit no-durability."""
 

@@ -23,6 +23,9 @@ class Expr:
 @dataclass(frozen=True)
 class Column(Expr):
     name: str
+    # table qualifier from ``t.col`` syntax; resolution is by bare name,
+    # but the planner validates the qualifier names a table in the query
+    qualifier: Optional[str] = None
 
     def __str__(self) -> str:
         return self.name
@@ -119,6 +122,15 @@ class OrderItem:
 
 
 @dataclass(frozen=True)
+class Join:
+    """Single-equi-key inner join: JOIN <table> ON <l.col> = <r.col>."""
+
+    table: str
+    left_col: str
+    right_col: str
+
+
+@dataclass(frozen=True)
 class Select:
     items: tuple[SelectItem, ...]
     table: Optional[str]
@@ -126,6 +138,9 @@ class Select:
     group_by: tuple[Expr, ...] = ()
     order_by: tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
+    having: Optional[Expr] = None
+    distinct: bool = False
+    join: Optional[Join] = None
 
 
 @dataclass(frozen=True)

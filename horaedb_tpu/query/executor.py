@@ -173,14 +173,20 @@ def _eval_binary(e: ast.BinaryOp, rows: RowGroup) -> tuple[np.ndarray, np.ndarra
 
 
 def _eval_func(e: ast.FuncCall, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
-    if e.name == "time_bucket":
-        ts, m = eval_expr(e.args[0], rows)
-        width = parse_duration_ms(e.args[1].value)  # type: ignore[union-attr]
-        return (ts // width) * width, m
-    if e.name == "abs":
-        v, m = eval_expr(e.args[0], rows)
-        return np.abs(v), m
-    raise ExprError(f"unsupported function {e.name!r} in row expression")
+    """Scalar function dispatch through the registry (ref: df_operator
+    FunctionRegistry — time_bucket/abs are built-ins, users register more)."""
+    from .functions import REGISTRY
+
+    entry = REGISTRY.scalar(e.name)
+    if entry is None:
+        raise ExprError(f"unsupported function {e.name!r} in row expression")
+    fn, raw_args = entry
+    if raw_args:
+        # first arg evaluated; the rest pass as raw AST (literal params)
+        args = [eval_expr(e.args[0], rows), *e.args[1:]]
+    else:
+        args = [eval_expr(a, rows) for a in e.args]
+    return fn(args, rows)
 
 
 # ---- executor ------------------------------------------------------------
@@ -333,8 +339,8 @@ class Executor:
             if k.column is not None and k.column not in tag_names:
                 return None
         for a in plan.aggs:
-            if a.distinct:
-                return None
+            if a.distinct or a.func not in ("count", "sum", "min", "max", "avg"):
+                return None  # registry aggregates run on the host path
             if a.column is not None and not schema.column(a.column).kind.is_numeric:
                 return None
         tag_keys = [k for k in plan.group_keys if k.column is not None]
@@ -861,7 +867,8 @@ class Executor:
                     kv = kv.sort_ranks()
                 keys.append(kv if o.ascending else _desc_key(kv))
             rows = rows.take(np.lexsort(tuple(keys)))
-        if stmt.limit is not None:
+        if stmt.limit is not None and not stmt.distinct:
+            # DISTINCT must dedupe BEFORE the limit applies
             rows = rows.slice(0, stmt.limit)
 
         names: list[str] = []
@@ -881,7 +888,17 @@ class Executor:
             columns.append(as_values(v))
             if not m.all():
                 nulls[item.output_name] = ~m
-        return ResultSet(names, columns, nulls or None)
+        result = ResultSet(names, columns, nulls or None)
+        if stmt.distinct:
+            result = _distinct_result(result)
+            if stmt.limit is not None and result.num_rows > stmt.limit:
+                k = stmt.limit
+                result = ResultSet(
+                    result.names,
+                    [c[:k] for c in result.columns],
+                    {n: m_[:k] for n, m_ in (result.nulls or {}).items()} or None,
+                )
+        return result
 
 
 def _is_series_conjunct(conj: ast.Expr, tag_names: set) -> bool:
@@ -935,6 +952,13 @@ def _host_agg(
 ) -> tuple[np.ndarray, Optional[np.ndarray]]:
     if a.func == "count" and a.column is None:
         return np.bincount(codes, minlength=group_count).astype(np.int64), None
+    if a.func not in ("count", "sum", "min", "max", "avg"):
+        from .functions import REGISTRY
+
+        agg_fn = REGISTRY.aggregate(a.func)
+        if agg_fn is None:
+            raise ExprError(f"unknown aggregate {a.func}")
+        return agg_fn(rows.column(a.column), rows.valid_mask(a.column), codes, group_count)
     col = as_values(rows.column(a.column))
     valid = rows.valid_mask(a.column)
     if a.distinct:
@@ -989,8 +1013,106 @@ def _desc_key(arr: np.ndarray) -> np.ndarray:
     return arr  # bool/other: DESC not meaningfully supported
 
 
+class _ResultRows:
+    """Row-like shim so eval_expr can run over a ResultSet (HAVING)."""
+
+    def __init__(self, result: ResultSet) -> None:
+        self._r = result
+        self._nulls = result.nulls or {}
+
+    def __len__(self) -> int:
+        return self._r.num_rows
+
+    def column(self, name: str):
+        return self._r.column(name)
+
+    def valid_mask(self, name: str) -> np.ndarray:
+        null = self._nulls.get(name)
+        if null is None:
+            return np.ones(self._r.num_rows, dtype=bool)
+        return ~null
+
+
+def _subst_having(e: ast.Expr, mapping: dict[str, str]) -> ast.Expr:
+    """Rewrite select-list expressions in HAVING into result columns."""
+    key = str(e)
+    if key in mapping:
+        return ast.Column(mapping[key])
+    if isinstance(e, ast.Column) and e.name in mapping:
+        return ast.Column(mapping[e.name])
+    if isinstance(e, ast.BinaryOp):
+        return ast.BinaryOp(
+            e.op, _subst_having(e.left, mapping), _subst_having(e.right, mapping)
+        )
+    if isinstance(e, ast.UnaryOp):
+        return ast.UnaryOp(e.op, _subst_having(e.operand, mapping))
+    if isinstance(e, ast.FuncCall):
+        raise ExprError(
+            f"HAVING references {e} which is not in the SELECT list — "
+            "add it (optionally aliased) to SELECT"
+        )
+    return e
+
+
+def _apply_having(result: ResultSet, plan: QueryPlan) -> ResultSet:
+    having = plan.select.having
+    if having is None or result.num_rows == 0:
+        return result
+    mapping: dict[str, str] = {}
+    for item in plan.select.items:
+        mapping[str(item.expr)] = item.output_name
+        if item.alias:
+            mapping[item.alias] = item.output_name
+    expr = _subst_having(having, mapping)
+    shim = _ResultRows(result)
+    v, m = eval_expr(expr, shim)
+    mask = np.asarray(as_values(v)).astype(bool) & m
+    if mask.all():
+        return result
+    idx = np.nonzero(mask)[0]
+    return ResultSet(
+        result.names,
+        [c[idx] for c in result.columns],
+        {k: n[idx] for k, n in (result.nulls or {}).items()} or None,
+        result.metrics,
+    )
+
+
+def _distinct_result(result: ResultSet) -> ResultSet:
+    """SELECT DISTINCT: drop duplicate output rows, keep first occurrence.
+
+    NULLs participate as their own key bit — a NULL row must not collapse
+    with a real row that happens to hold the null-fill value."""
+    n = result.num_rows
+    if n <= 1:
+        return result
+    nulls = result.nulls or {}
+    combined = np.zeros(n, dtype=np.int64)
+    for name, col in zip(result.names, result.columns):
+        _, inv = unique_inverse(as_values(col))
+        combined = combined * (int(inv.max()) + 2) + inv
+        null = nulls.get(name)
+        combined = combined * 2 + (null.astype(np.int64) if null is not None else 0)
+    _, first = np.unique(combined, return_index=True)
+    idx = np.sort(first)
+    if len(idx) == n:
+        return result
+    return ResultSet(
+        result.names,
+        [c[idx] for c in result.columns],
+        {k: m[idx] for k, m in (result.nulls or {}).items()} or None,
+        result.metrics,
+    )
+
+
 def _order_and_limit(result: ResultSet, plan: QueryPlan) -> ResultSet:
+    result = _apply_having(result, plan)
     stmt = plan.select
+    if stmt.distinct:
+        # Aggregate paths: DISTINCT over the grouped output rows, before
+        # ORDER/LIMIT (group keys are unique, but aggregates may not be
+        # selected alongside them).
+        result = _distinct_result(result)
     if stmt.order_by and result.num_rows:
         keys = []
         for o in reversed(stmt.order_by):

@@ -1,0 +1,116 @@
+"""Function registry — scalar + aggregate UDFs
+(ref: src/df_operator/src/registry.rs:48-65 — FunctionRegistry loaded at
+startup, setup.rs:203; built-ins time_bucket and thetasketch_distinct
+under df_operator/src/udfs/).
+
+Scalar functions evaluate vectorized on host rows (and the planner folds
+``time_bucket`` into the device kernel's bucket stage — registration here
+is the EXTENSIBILITY point, not the fast path). Aggregate functions plug
+into the host aggregation fallback; the (count,sum,min,max,avg) core runs
+fused on device and is not routed through the registry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+
+class FunctionError(ValueError):
+    pass
+
+
+class FunctionRegistry:
+    """name -> implementation, for scalars and aggregates.
+
+    Scalar signature:   fn(args, rows) -> (values, valid_mask)
+        where ``args`` is a list of (values, valid_mask) pairs already
+        evaluated, and ``rows`` the source RowGroup (for length/schema).
+    Aggregate signature: fn(values, valid, codes, n_groups)
+        -> (per-group values, per-group null mask | None)
+    """
+
+    def __init__(self) -> None:
+        self._scalars: dict[str, Callable] = {}
+        self._aggregates: dict[str, Callable] = {}
+        self._lock = threading.Lock()
+
+    # ---- registration ---------------------------------------------------
+    def register_scalar(self, name: str, fn: Callable, raw_args: bool = False) -> None:
+        with self._lock:
+            self._scalars[name.lower()] = (fn, raw_args)
+
+    def register_aggregate(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            self._aggregates[name.lower()] = fn
+
+    # ---- lookup ---------------------------------------------------------
+    def scalar(self, name: str):
+        return self._scalars.get(name.lower())
+
+    def aggregate(self, name: str):
+        return self._aggregates.get(name.lower())
+
+    def aggregate_names(self) -> set[str]:
+        return set(self._aggregates)
+
+
+# ---- built-ins -----------------------------------------------------------
+
+
+def _time_bucket(args, rows):
+    """time_bucket(ts, '1h') — ALSO compiled into the device kernel's
+    bucket stage when it appears as a group key; this host form covers
+    projections and fallbacks."""
+    from ..engine.options import parse_duration_ms
+    from . import ast
+
+    # raw_args: receives the unevaluated exprs for the literal width
+    (ts_vals, ts_valid), width_expr = args
+    if not isinstance(width_expr, ast.Literal):
+        raise FunctionError("time_bucket width must be a literal duration")
+    width = parse_duration_ms(width_expr.value)
+    return (ts_vals // width) * width, ts_valid
+
+
+def _abs(args, rows):
+    v, m = args[0]
+    return np.abs(v), m
+
+
+def _thetasketch_distinct(values, valid, codes, n_groups):
+    """Approximate-distinct analog (ref: udfs/thetasketch_distinct.rs).
+
+    The reference uses a theta sketch to bound memory on huge
+    cardinalities; columnar numpy counts distinct exactly in one
+    sort-unique pass — same answer, no sketch error, acceptable memory at
+    the scales a single node aggregates post-scan."""
+    from ..common_types.dict_column import DictColumn, unique_inverse
+
+    out = np.zeros(n_groups, dtype=np.int64)
+    idx = np.nonzero(valid)[0]
+    if len(idx):
+        if isinstance(values, DictColumn):
+            val_codes = values.codes[idx]
+        else:
+            _, val_codes = unique_inverse(np.asarray(values)[idx])
+        pairs = np.unique(
+            np.stack([codes[idx].astype(np.int64), val_codes.astype(np.int64)]),
+            axis=1,
+        )
+        grp, cnt = np.unique(pairs[0], return_counts=True)
+        out[grp] = cnt
+    return out, None
+
+
+def default_registry() -> FunctionRegistry:
+    reg = FunctionRegistry()
+    reg.register_scalar("time_bucket", _time_bucket, raw_args=True)
+    reg.register_scalar("abs", _abs)
+    reg.register_aggregate("thetasketch_distinct", _thetasketch_distinct)
+    return reg
+
+
+REGISTRY = default_registry()

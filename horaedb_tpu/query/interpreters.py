@@ -142,6 +142,10 @@ class InterpreterFactory:
 
     # ---- variants -----------------------------------------------------------
     def _select(self, plan: QueryPlan) -> ResultSet:
+        if plan.select.join is not None:
+            from .join import execute_join
+
+            return execute_join(self.catalog, self.executor, plan.select)
         table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")

@@ -1,0 +1,148 @@
+"""Single-key inner join on the host path
+(ref: the reference gets JOIN from DataFusion, query_engine/src/
+datafusion_impl/mod.rs:54 — this is the host-path subset: one equi-key,
+inner, two tables).
+
+Vectorized hash-join shape: factorize both key columns into one code
+space, sort the right side by code, then expand match pairs with
+repeat/cumsum arithmetic — no per-row Python. Joined rows feed the
+existing projection/WHERE/ORDER BY/LIMIT machinery over a synthesized
+combined schema.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common_types.dict_column import as_values, unique_inverse
+from ..common_types.row_group import RowGroup
+from ..common_types.schema import ColumnSchema, Schema
+from . import ast
+from .executor import ResultSet
+
+
+class JoinError(ValueError):
+    pass
+
+
+def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
+    join = stmt.join
+    left_t = catalog.open(stmt.table)
+    right_t = catalog.open(join.table)
+    if left_t is None:
+        raise JoinError(f"table not found: {stmt.table}")
+    if right_t is None:
+        raise JoinError(f"table not found: {join.table}")
+    ls, rs = left_t.schema, right_t.schema
+    for s, col, side in ((ls, join.left_col, stmt.table), (rs, join.right_col, join.table)):
+        if not s.has_column(col):
+            raise JoinError(f"join key {col!r} not in {side}")
+
+    # Push the WHERE's time range + simple filters into the LEFT scan
+    # (the output timestamp IS the left one, so its conjuncts are left's;
+    # exact WHERE still evaluates post-join). The right side is typically
+    # a small dimension table — full read.
+    from .planner import extract_predicate
+
+    left = left_t.read(extract_predicate(stmt.where, ls))
+    right = right_t.read(None)
+
+    lk = as_values(left.column(join.left_col))
+    rk = as_values(right.column(join.right_col))
+    li_idx, ri_idx = _inner_match(lk, rk)
+
+    # Combined schema: left columns + right non-key columns; internal tsid
+    # columns stay out; name clashes (other than the key) are an error the
+    # user resolves by renaming — qualified output names are not modeled.
+    def visible(s: Schema) -> list[ColumnSchema]:
+        tsid = s.columns[s.tsid_index].name if s.tsid_index is not None else None
+        return [c for c in s.columns if c.name != tsid]
+
+    cols: list[ColumnSchema] = list(visible(ls))
+    names = {c.name for c in cols}
+    for c in visible(rs):
+        if c.name == join.right_col:
+            continue  # equal to the left key by construction
+        if c.name == rs.timestamp_name:
+            # Every table carries a timestamp; the joined row keeps the
+            # LEFT one (dimension-table joins don't want the right's).
+            continue
+        if c.name in names:
+            raise JoinError(
+                f"ambiguous column {c.name!r} on both sides of the join"
+            )
+        cols.append(c)
+
+    combined_schema = Schema.build(
+        [ColumnSchema(c.name, c.kind, is_tag=c.is_tag) for c in cols],
+        timestamp_column=ls.timestamp_name,
+        primary_key=[join.left_col, ls.timestamp_name],
+    )
+    data = {}
+    validity = {}
+    for c in visible(ls):
+        data[c.name] = as_values(left.column(c.name))[li_idx]
+        m = left.valid_mask(c.name)
+        if not m.all():
+            validity[c.name] = m[li_idx]
+    for c in visible(rs):
+        if c.name == join.right_col or c.name == rs.timestamp_name:
+            continue
+        data[c.name] = as_values(right.column(c.name))[ri_idx]
+        m = right.valid_mask(c.name)
+        if not m.all():
+            validity[c.name] = m[ri_idx]
+    # Schema.build may prepend a tsid column; fill it (unused downstream).
+    if combined_schema.tsid_index is not None:
+        tsid_name = combined_schema.columns[combined_schema.tsid_index].name
+        if tsid_name not in data:
+            data[tsid_name] = np.zeros(len(li_idx), dtype=np.uint64)
+    rows = RowGroup(combined_schema, data, validity)
+
+    # Reuse the projection pipeline: WHERE/ORDER/LIMIT over joined rows.
+    from .plan import QueryPlan
+    from ..table_engine.predicate import Predicate
+
+    plan = QueryPlan(
+        table=f"{stmt.table}⋈{join.table}",
+        schema=combined_schema,
+        select=stmt,
+        predicate=Predicate.all_time(),
+        aggs=(),
+        group_keys=(),
+        is_aggregate=False,
+    )
+    # WHERE evaluates here exactly (the storage predicate never saw the
+    # join): hand the projection a where-less statement so the residual
+    # logic can't drop time conjuncts it assumes storage applied.
+    if stmt.where is not None and len(rows):
+        from .executor import eval_expr
+
+        v, m = eval_expr(stmt.where, rows)
+        rows = rows.filter(np.asarray(as_values(v)).astype(bool) & m)
+    import dataclasses
+
+    plan = dataclasses.replace(plan, select=dataclasses.replace(stmt, where=None))
+    return executor._execute_projection(plan, rows)
+
+
+def _inner_match(lk: np.ndarray, rk: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Index pairs (li, ri) of every equal-key combination."""
+    n_l = len(lk)
+    _, codes = unique_inverse(np.concatenate([lk, rk]))
+    lc, rc = codes[:n_l], codes[n_l:]
+    order_r = np.argsort(rc, kind="stable")
+    rc_sorted = rc[order_r]
+    # for each left row: the contiguous run of matching right rows
+    starts = np.searchsorted(rc_sorted, lc, side="left")
+    ends = np.searchsorted(rc_sorted, lc, side="right")
+    counts = ends - starts
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64)
+    li = np.repeat(np.arange(n_l, dtype=np.int64), counts)
+    # within-run offsets: global arange minus each row's run start
+    run_starts = np.repeat(np.cumsum(counts) - counts, counts)
+    offsets = np.arange(total, dtype=np.int64) - run_starts
+    ri = order_r[np.repeat(starts, counts) + offsets]
+    return li, ri

@@ -198,22 +198,32 @@ class Parser:
 
     def _select(self) -> ast.Select:
         self._expect_kw("SELECT")
+        distinct = bool(self._eat_kw("DISTINCT"))
         items = [self._select_item()]
         while self._eat_op(","):
             items.append(self._select_item())
         table = None
+        join = None
         if self._eat_kw("FROM"):
             table = self._ident()
+            if self._eat_kw("INNER"):
+                self._expect_kw("JOIN")
+                join = self._join_clause(table)
+            elif self._eat_kw("JOIN"):
+                join = self._join_clause(table)
         where = None
         if self._eat_kw("WHERE"):
             where = self._expr()
         group_by: tuple = ()
+        having = None
         if self._eat_kw("GROUP"):
             self._expect_kw("BY")
             gb = [self._expr()]
             while self._eat_op(","):
                 gb.append(self._expr())
             group_by = tuple(gb)
+        if self._eat_kw("HAVING"):
+            having = self._expr()
         order_by: list[ast.OrderItem] = []
         if self._eat_kw("ORDER"):
             self._expect_kw("BY")
@@ -240,7 +250,34 @@ class Parser:
             group_by=group_by,
             order_by=tuple(order_by),
             limit=limit,
+            having=having,
+            distinct=distinct,
+            join=join,
         )
+
+    def _join_clause(self, left_table: str) -> ast.Join:
+        """JOIN t2 ON a.k = b.k — single equi-key inner join
+        (the reference gets richer joins from DataFusion; this is the
+        host-path subset)."""
+        right = self._ident()
+        self._expect_kw("ON")
+        l_tab, l_col = self._qualified()
+        self._expect_op("=")
+        r_tab, r_col = self._qualified()
+        # normalize sides: left table's column first
+        if l_tab == right and r_tab == left_table:
+            l_col, r_col = r_col, l_col
+        elif not (l_tab in (left_table, None) and r_tab in (right, None)):
+            raise ParseError(
+                f"JOIN ON must reference {left_table} and {right}", -1, self.sql
+            )
+        return ast.Join(right, l_col, r_col)
+
+    def _qualified(self) -> tuple[Optional[str], str]:
+        name = self._ident()
+        if self._eat_op("."):
+            return name, self._ident()
+        return None, name
 
     def _select_item(self) -> ast.SelectItem:
         if self._at_op("*"):
@@ -252,6 +289,7 @@ class Parser:
             alias = self._ident()
         elif (t := self._peek()) is not None and t.kind in ("name", "qident") and t.text.upper() not in (
             "FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
+            "HAVING", "JOIN", "INNER", "ON",
         ):
             alias = self._ident()
         return ast.SelectItem(e, alias)
@@ -537,6 +575,11 @@ class Parser:
                         args.append(self._expr())
                 self._expect_op(")")
                 return ast.FuncCall(name.lower(), tuple(args), distinct)
+            if self._at_op("."):
+                # qualified column (t.col) — resolution is by column name;
+                # the planner validates the qualifier
+                self.i += 1
+                return ast.Column(self._ident(), qualifier=name)
             return ast.Column(name)
         raise ParseError(f"unexpected token {t.text!r}", t.pos, self.sql)
 

@@ -41,6 +41,16 @@ from .plan import (
 AGG_FUNCS = {"count", "sum", "min", "max", "avg"}
 
 
+def _is_agg_name(name: str) -> bool:
+    """Core aggregates plus anything in the function registry (UDAFs —
+    ref: df_operator registry.rs; e.g. thetasketch_distinct)."""
+    if name in AGG_FUNCS:
+        return True
+    from .functions import REGISTRY
+
+    return REGISTRY.aggregate(name) is not None
+
+
 class PlanError(ValueError):
     pass
 
@@ -186,6 +196,31 @@ class Planner:
     def _plan_select(self, stmt: ast.Select) -> QueryPlan:
         if stmt.table is None:
             raise PlanError("SELECT without FROM is not supported")
+        if stmt.having is not None and not stmt.group_by:
+            raise PlanError("HAVING requires GROUP BY (use WHERE for row filters)")
+        self._check_qualifiers(stmt)
+        if stmt.join is not None:
+            # Joined queries validate against the COMBINED schema at
+            # execution (query/join.py); the plan is a thin carrier.
+            if stmt.group_by or any(
+                isinstance(e, ast.FuncCall) and _is_agg_name(e.name)
+                for item in stmt.items
+                for e in _walk(item.expr)
+            ):
+                raise PlanError("aggregates over JOIN are not supported yet")
+            schema = self._require_schema(stmt.table)
+            from ..table_engine.predicate import Predicate
+
+            return QueryPlan(
+                table=stmt.table,
+                schema=schema,
+                select=stmt,
+                predicate=Predicate.all_time(),
+                aggs=(),
+                group_keys=(),
+                is_aggregate=False,
+                priority=QueryPriority.HIGH,
+            )
         schema = self._require_schema(stmt.table)
         self._check_columns(stmt, schema)
 
@@ -207,6 +242,27 @@ class Planner:
             is_aggregate=is_agg,
             priority=priority,
         )
+
+    def _check_qualifiers(self, stmt: ast.Select) -> None:
+        """``t.col`` qualifiers must name a table in the query — a silent
+        wrong-table binding would mask user errors."""
+        known = {stmt.table}
+        if stmt.join is not None:
+            known.add(stmt.join.table)
+        sources = [item.expr for item in stmt.items]
+        sources += [e for e in (stmt.where, stmt.having, *stmt.group_by) if e is not None]
+        sources += [o.expr for o in stmt.order_by]
+        for src in sources:
+            for e in _walk(src):
+                if (
+                    isinstance(e, ast.Column)
+                    and e.qualifier is not None
+                    and e.qualifier not in known
+                ):
+                    raise PlanError(
+                        f"unknown table qualifier {e.qualifier!r} for column "
+                        f"{e.name!r}"
+                    )
 
     def _check_columns(self, stmt: ast.Select, schema: Schema) -> None:
         aliases = {item.alias for item in stmt.items if item.alias}
@@ -235,7 +291,7 @@ class Planner:
     ) -> tuple[tuple[AggCall, ...], tuple[GroupKey, ...], bool]:
         aggs: list[AggCall] = []
         has_agg = any(
-            isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS
+            isinstance(e, ast.FuncCall) and _is_agg_name(e.name)
             for item in stmt.items
             for e in _walk(item.expr)
         )
@@ -251,7 +307,7 @@ class Planner:
 
         for item in stmt.items:
             e = item.expr
-            if isinstance(e, ast.FuncCall) and e.name in AGG_FUNCS:
+            if isinstance(e, ast.FuncCall) and _is_agg_name(e.name):
                 col = None
                 if e.args and not isinstance(e.args[0], ast.Star):
                     if not isinstance(e.args[0], ast.Column):

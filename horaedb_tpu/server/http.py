@@ -713,6 +713,7 @@ def run_server(
         data_dir,
         wal=(config.engine.wal if config is not None else True),
         engine_config=engine_cfg,
+        wal_backend=(config.engine.wal_backend if config is not None else "disk"),
     )
     router = None
     cluster = None

@@ -47,6 +47,7 @@ class ServerConfig:
 class EngineSection:
     data_dir: Optional[str] = None  # None = in-memory
     wal: bool = True
+    wal_backend: str = "disk"  # "disk" | "object_store"
     space_write_buffer_size: int = 256 << 20
     compaction_l0_trigger: int = 4
 
@@ -88,7 +89,10 @@ class Config:
 
 _KNOWN = {
     "server": {"host", "http_port", "grpc_port"},
-    "engine": {"data_dir", "wal", "space_write_buffer_size", "compaction_l0_trigger"},
+    "engine": {
+        "data_dir", "wal", "wal_backend",
+        "space_write_buffer_size", "compaction_l0_trigger",
+    },
     "limits": {"slow_threshold"},
     "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
 }
@@ -120,6 +124,12 @@ def _apply(cfg: Config, raw: dict) -> None:
         if not isinstance(e["wal"], bool):
             raise ConfigError("engine.wal must be a boolean")
         cfg.engine.wal = e["wal"]
+    if "wal_backend" in e:
+        if e["wal_backend"] not in ("disk", "object_store"):
+            raise ConfigError(
+                "engine.wal_backend must be 'disk' or 'object_store'"
+            )
+        cfg.engine.wal_backend = str(e["wal_backend"])
     if "space_write_buffer_size" in e:
         cfg.engine.space_write_buffer_size = parse_size_bytes(e["space_write_buffer_size"])
     if "compaction_l0_trigger" in e:
