@@ -87,47 +87,138 @@ def _dumps(obj: Any) -> str:
 FORWARD_HEADER = "X-HoraeDB-Forwarded"
 
 
-def _check_writable(cluster, table: str) -> Optional[web.Response]:
-    """None when writes may proceed; a 503 when the shard lease fences
-    them off (ref: shard_lock_manager single-writer guarantee)."""
-    from ..cluster import ShardError
-
-    try:
-        cluster.ensure_table_writable(table)
-    except ShardError as e:
-        return web.json_response({"error": str(e)}, status=503)
-    return None
-
-
-def _write_fence(cluster, router, table: str) -> Optional[web.Response]:
+def _write_fence(cluster, router, table: str) -> Optional[tuple[int, str]]:
     """Single-writer discipline for the write paths (cluster mode).
 
-    None = safe to proceed (execute locally or forward); a Response = the
-    write must be refused NOW. The catalog registry lives in shared
-    storage, so "the table opens locally" proves nothing about ownership —
-    only the shard set + a live lease (or an authoritative remote route)
-    makes a write safe.
+    None = safe to proceed (execute locally or forward); a (status, msg)
+    pair = the write must be refused NOW. The catalog registry lives in
+    shared storage, so "the table opens locally" proves nothing about
+    ownership — only the shard set + a live lease (or an authoritative
+    remote route) makes a write safe.
     """
     if cluster is None:
         return None
     if cluster.owns_table(table):
-        return _check_writable(cluster, table)
+        from ..cluster import ShardError
+
+        try:
+            cluster.ensure_table_writable(table)
+        except ShardError as e:
+            return 503, str(e)
+        return None
     r = router.route(table)
     if not r.is_local:
         return None  # forwarded to the owner below
     if r.source == "fallback":
-        return web.json_response(
-            {"error": f"coordinator unreachable; cannot safely accept writes for {table!r}"},
-            status=503,
-        )
+        return 503, f"coordinator unreachable; cannot safely accept writes for {table!r}"
     if r.source == "meta":
         # Coordinator says this node owns it, but the shard isn't open
         # here yet (transfer in flight) — retryable, never unfenced.
-        return web.json_response(
-            {"error": f"shard for {table!r} is opening on this node; retry"},
-            status=503,
-        )
+        return 503, f"shard for {table!r} is opening on this node; retry"
     return None  # meta-unknown: local execution yields table-not-found
+
+
+class SqlGateway:
+    """THE routed SQL pipeline — every protocol front end (HTTP /sql,
+    MySQL wire, PostgreSQL wire) funnels through this one path so cluster
+    routing, DDL-via-coordinator, write fencing, and the proxy's
+    limiter/metrics/slow-log apply to ALL protocols, not just HTTP
+    (ref: every listener shares one Proxy in the reference, lib.rs:110).
+
+    ``execute`` returns one of:
+        ("affected", n)
+        ("rows", (names, rows_as_dicts))
+        ("error", (http_status, message))
+    """
+
+    def __init__(self, app: web.Application) -> None:
+        self.app = app
+
+    async def execute(self, query: str, already_forwarded: bool = False):
+        app = self.app
+        conn: Connection = app["conn"]
+        proxy: Proxy = app["proxy"]
+        router = app["router"]
+        cluster = app["cluster"]
+        loop = asyncio.get_running_loop()
+        if router is not None:
+            # Routing needs the target table before execution. The parse
+            # here is routing-only; standalone mode skips it entirely.
+            try:
+                stmt = conn.frontend.parse_sql(query)
+            except Exception as e:
+                proxy._m_queries.inc()
+                proxy._m_errors.inc()
+                return "error", (422, str(e))
+            from ..query import ast as _ast
+
+            if cluster is not None and isinstance(
+                stmt, (_ast.CreateTable, _ast.DropTable)
+            ):
+                # Cluster DDL goes through the coordinator: IT picks the
+                # owning shard/node and dispatches the actual create
+                # (ref: meta_based TableManipulator, write.rs:176-263).
+                def ddl():
+                    if isinstance(stmt, _ast.CreateTable):
+                        return cluster.meta.create_table(stmt.table, query)
+                    return cluster.meta.drop_table(stmt.table)
+
+                try:
+                    await loop.run_in_executor(None, ddl)
+                except Exception as e:
+                    # The coordinator already implements IF NOT EXISTS /
+                    # IF EXISTS leniency, so any error here is REAL —
+                    # never report success for DDL that happened nowhere.
+                    return "error", (422, str(e))
+                return "affected", 0
+            if cluster is not None and isinstance(stmt, _ast.Insert):
+                fence = _write_fence(cluster, router, stmt.table)
+                if fence is not None:
+                    return "error", fence
+            table = _table_of_statement(stmt)
+            if table is not None:
+                route = router.route(table)
+                if not route.is_local:
+                    if already_forwarded:
+                        return "error", (
+                            502,
+                            f"routing loop: {table!r} routed to "
+                            f"{route.endpoint} but this node also received "
+                            "it forwarded",
+                        )
+                    return await self._forward(route.endpoint, query)
+        try:
+            out = await loop.run_in_executor(None, proxy.handle_sql, query)
+        except BlockedError as e:
+            return "error", (403, str(e))
+        except Exception as e:  # parse/plan/execution errors -> 422 like ref
+            return "error", (422, str(e))
+        if isinstance(out, AffectedRows):
+            return "affected", out.count
+        return "rows", (list(out.names), out.to_pylist())
+
+    async def _forward(self, endpoint: str, query: str):
+        """Ship the statement to the owning node's /sql (ref: forward.rs)."""
+        import aiohttp
+
+        try:
+            session = await _client_session(self.app)
+            async with session.post(
+                f"http://{endpoint}/sql",
+                json={"query": query},
+                headers={FORWARD_HEADER: "1"},
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as resp:
+                body = await resp.json(content_type=None)
+        except aiohttp.ClientError as e:
+            return "error", (502, f"forward to {endpoint} failed: {e}")
+        if resp.status != 200:
+            return "error", (resp.status, body.get("error", "forward failed"))
+        if "affected_rows" in body:
+            return "affected", body["affected_rows"]
+        rows = body.get("rows", [])
+        names = list(rows[0].keys()) if rows else []
+        return "rows", (names, rows)
 
 
 def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
@@ -197,6 +288,9 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
             )
 
     # ---- core ----------------------------------------------------------
+    gateway = SqlGateway(app)
+    app["sql_gateway"] = gateway
+
     async def sql(request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -205,56 +299,17 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
         query = body.get("query")
         if not isinstance(query, str) or not query.strip():
             return web.json_response({"error": "missing 'query'"}, status=400)
-        if router is not None:
-            # Routing needs the target table before execution. The parse
-            # here is routing-only; standalone mode skips it entirely.
-            try:
-                stmt = conn.frontend.parse_sql(query)
-            except Exception as e:
-                proxy._m_queries.inc()
-                proxy._m_errors.inc()
-                return web.json_response({"error": str(e)}, status=422)
-            from ..query import ast as _ast
-
-            if cluster is not None and isinstance(
-                stmt, (_ast.CreateTable, _ast.DropTable)
-            ):
-                # Cluster DDL goes through the coordinator: IT picks the
-                # owning shard/node and dispatches the actual create
-                # (ref: meta_based TableManipulator, write.rs:176-263).
-                def ddl():
-                    if isinstance(stmt, _ast.CreateTable):
-                        return cluster.meta.create_table(stmt.table, query)
-                    return cluster.meta.drop_table(stmt.table)
-
-                try:
-                    await asyncio.get_running_loop().run_in_executor(None, ddl)
-                except Exception as e:
-                    # The coordinator already implements IF NOT EXISTS /
-                    # IF EXISTS leniency (existed=True / silent drop), so
-                    # any error surfacing here is a REAL failure — never
-                    # report success for DDL that happened nowhere.
-                    return web.json_response({"error": str(e)}, status=422)
-                return web.json_response({"affected_rows": 0})
-            if cluster is not None and isinstance(stmt, _ast.Insert):
-                fence = _write_fence(cluster, router, stmt.table)
-                if fence is not None:
-                    return fence
-            forwarded = await _forward_if_remote(request, _table_of_statement(stmt))
-            if forwarded is not None:
-                return forwarded
-        try:
-            out = await asyncio.get_running_loop().run_in_executor(
-                None, proxy.handle_sql, query
-            )
-        except BlockedError as e:
-            return web.json_response({"error": str(e)}, status=403)
-        except Exception as e:  # parse/plan/execution errors -> 422 like ref
-            return web.json_response({"error": str(e)}, status=422)
-        if isinstance(out, AffectedRows):
-            return web.json_response({"affected_rows": out.count})
+        kind, payload = await gateway.execute(
+            query, already_forwarded=bool(request.headers.get(FORWARD_HEADER))
+        )
+        if kind == "error":
+            status, msg = payload
+            return web.json_response({"error": msg}, status=status)
+        if kind == "affected":
+            return web.json_response({"affected_rows": payload})
+        _, rows = payload
         return web.Response(
-            text=_dumps({"rows": out.to_pylist()}), content_type="application/json"
+            text=_dumps({"rows": rows}), content_type="application/json"
         )
 
     async def write(request: web.Request) -> web.Response:
@@ -272,7 +327,8 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
         if cluster is not None:
             fence = _write_fence(cluster, router, table)
             if fence is not None:
-                return fence
+                status, msg = fence
+                return web.json_response({"error": msg}, status=status)
         forwarded = await _forward_if_remote(request, table)
         if forwarded is not None:
             return forwarded
@@ -782,6 +838,40 @@ def run_server(
 
     app = create_app(conn, router=router, cluster=cluster)
     app["proxy"].slow_threshold_s = slow_threshold
+
+    # MySQL / PostgreSQL wire listeners (ref: mysql/service.rs:21,
+    # postgresql/service.rs:21; defaults 3307/5433, config.rs:176-179).
+    # Non-overlapping derived bands (+2000 / +3000, like grpc's +1000)
+    # avoid collisions when several nodes share a host. Both speak
+    # through the shared SQL gateway — same routing/fences as HTTP.
+    wire_servers = []
+    gateway = app["sql_gateway"]
+    mysql_cfg = config.server.mysql_port if config is not None else 0
+    pg_cfg = config.server.pg_port if config is not None else 0
+    if mysql_cfg >= 0:
+        from .mysql import MysqlServer
+
+        wire_servers.append(
+            MysqlServer(gateway, host=host, port=mysql_cfg if mysql_cfg > 0 else port + 2000)
+        )
+    if pg_cfg >= 0:
+        from .postgres import PostgresServer
+
+        wire_servers.append(
+            PostgresServer(gateway, host=host, port=pg_cfg if pg_cfg > 0 else port + 3000)
+        )
+    if wire_servers:
+        async def _start_wire(app_):
+            for s in wire_servers:
+                await s.start()
+
+        async def _stop_wire(app_):
+            for s in wire_servers:
+                await s.stop()
+
+        app.on_startup.append(_start_wire)
+        app.on_cleanup.append(_stop_wire)
+
     if grpc_server is not None:
         async def _start_grpc(app_):
             grpc_server.start()

@@ -1,0 +1,215 @@
+"""MySQL wire protocol server
+(ref: src/server/src/mysql/service.rs — the reference serves MySQL via
+opensrv on port 3307, config.rs:176-179; this is a from-scratch asyncio
+implementation of the protocol-41 text subset standard clients use).
+
+Scope mirrors the reference's shim: handshake (any credentials accepted —
+auth parity tracked with the proxy auth layer), COM_QUERY with text
+result sets (every value rendered as a string — the reference's MySQL
+shim also serves text protocol), COM_PING/COM_INIT_DB/COM_QUIT. Prepared
+statements (binary protocol) are not offered; capability flags say so.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import secrets
+from typing import Optional
+
+logger = logging.getLogger("horaedb_tpu.mysql")
+
+DEFAULT_MYSQL_PORT = 3307  # ref: config.rs:176-179
+
+# capability flags
+_CLIENT_LONG_PASSWORD = 0x1
+_CLIENT_PROTOCOL_41 = 0x200
+_CLIENT_SECURE_CONNECTION = 0x8000
+_CLIENT_PLUGIN_AUTH = 0x80000
+_SERVER_CAPS = (
+    _CLIENT_LONG_PASSWORD | _CLIENT_PROTOCOL_41 | _CLIENT_SECURE_CONNECTION | _CLIENT_PLUGIN_AUTH
+)
+_CHARSET_UTF8 = 33
+_TYPE_VAR_STRING = 0xFD
+
+
+def _lenenc_int(n: int) -> bytes:
+    if n < 0xFB:
+        return bytes([n])
+    if n < 0x10000:
+        return b"\xfc" + n.to_bytes(2, "little")
+    if n < 0x1000000:
+        return b"\xfd" + n.to_bytes(3, "little")
+    return b"\xfe" + n.to_bytes(8, "little")
+
+
+def _lenenc_str(s: bytes) -> bytes:
+    return _lenenc_int(len(s)) + s
+
+
+class _Conn:
+    def __init__(self, reader, writer, gateway) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.gateway = gateway
+        self.seq = 0
+
+    async def _read_packet(self) -> Optional[bytes]:
+        # Reassemble multi-frame payloads: a frame of exactly 0xFFFFFF
+        # bytes continues in the next frame (16MB+ COM_QUERYs).
+        payload = b""
+        while True:
+            head = await self.reader.readexactly(4)
+            length = int.from_bytes(head[:3], "little")
+            self.seq = (head[3] + 1) & 0xFF
+            payload += await self.reader.readexactly(length)
+            if length < 0xFFFFFF:
+                return payload
+
+    def _send(self, payload: bytes) -> None:
+        while True:
+            chunk, payload = payload[: 0xFFFFFF], payload[0xFFFFFF:]
+            self.writer.write(len(chunk).to_bytes(3, "little") + bytes([self.seq]) + chunk)
+            self.seq = (self.seq + 1) & 0xFF
+            if len(chunk) < 0xFFFFFF:
+                return
+
+    # ---- packets ---------------------------------------------------------
+    def _handshake(self) -> None:
+        salt = secrets.token_bytes(20)
+        p = bytearray()
+        p += b"\x0a"  # protocol 10
+        p += b"8.0.0-horaedb_tpu\x00"
+        p += (1).to_bytes(4, "little")  # thread id
+        p += salt[:8] + b"\x00"
+        p += (_SERVER_CAPS & 0xFFFF).to_bytes(2, "little")
+        p += bytes([_CHARSET_UTF8])
+        p += (2).to_bytes(2, "little")  # status: autocommit
+        p += ((_SERVER_CAPS >> 16) & 0xFFFF).to_bytes(2, "little")
+        p += bytes([21])  # auth data len
+        p += b"\x00" * 10
+        p += salt[8:] + b"\x00"
+        p += b"mysql_native_password\x00"
+        self.seq = 0
+        self._send(bytes(p))
+
+    def _ok(self, affected: int = 0) -> None:
+        self._send(b"\x00" + _lenenc_int(affected) + _lenenc_int(0)
+                   + (2).to_bytes(2, "little") + (0).to_bytes(2, "little"))
+
+    def _eof(self) -> None:
+        self._send(b"\xfe" + (0).to_bytes(2, "little") + (2).to_bytes(2, "little"))
+
+    def _error(self, msg: str, errno: int = 1105) -> None:
+        self._send(
+            b"\xff" + errno.to_bytes(2, "little") + b"#HY000"
+            + msg.encode("utf-8", "replace")[:400]
+        )
+
+    def _result_set(self, names: list[str], rows: list[list]) -> None:
+        self._send(_lenenc_int(len(names)))
+        for name in names:
+            nb = name.encode()
+            col = (
+                _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
+                + _lenenc_str(b"") + _lenenc_str(nb) + _lenenc_str(nb)
+                + b"\x0c" + _CHARSET_UTF8.to_bytes(2, "little")
+                + (1024).to_bytes(4, "little") + bytes([_TYPE_VAR_STRING])
+                + (0).to_bytes(2, "little") + b"\x00" + b"\x00\x00"
+            )
+            self._send(col)
+        self._eof()
+        for row in rows:
+            out = bytearray()
+            for v in row:
+                if v is None:
+                    out += b"\xfb"
+                else:
+                    out += _lenenc_str(_render(v).encode("utf-8", "replace"))
+            self._send(bytes(out))
+        self._eof()
+
+    # ---- session ---------------------------------------------------------
+    async def run(self) -> None:
+        self._handshake()
+        await self.writer.drain()
+        await self._read_packet()  # handshake response: accept anything
+        self.seq = 2
+        self._ok()
+        await self.writer.drain()
+        while True:
+            try:
+                packet = await self._read_packet()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if not packet:
+                return
+            cmd, body = packet[0], packet[1:]
+            if cmd == 0x01:  # COM_QUIT
+                return
+            if cmd in (0x0E, 0x02):  # COM_PING / COM_INIT_DB
+                self._ok()
+            elif cmd == 0x03:  # COM_QUERY
+                await self._query(body.decode("utf-8", "replace"))
+            else:
+                self._error(f"unsupported command {cmd:#x}", errno=1047)
+            await self.writer.drain()
+
+    async def _query(self, sql: str) -> None:
+        q = sql.strip().rstrip(";")
+        lowered = q.lower()
+        # connector session chatter answers locally (ref: federated.rs —
+        # the reference fakes the same compatibility queries)
+        if lowered.startswith(("set ", "set\t")) or lowered in ("begin", "commit", "rollback"):
+            self._ok()
+            return
+        if lowered in ("select @@version_comment limit 1", "select version()"):
+            self._result_set(["version()"], [["8.0.0-horaedb_tpu"]])
+            return
+        # The shared gateway applies routing, fences, limiter, metrics —
+        # wire traffic gets the same discipline as HTTP /sql.
+        kind, payload = await self.gateway.execute(q)
+        if kind == "error":
+            _, msg = payload
+            self._error(msg)
+        elif kind == "affected":
+            self._ok(payload)
+        else:
+            names, rows = payload
+            self._result_set(names, [[r.get(n) for n in names] for r in rows])
+
+
+def _render(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+class MysqlServer:
+    def __init__(self, gateway, host: str = "127.0.0.1", port: int = DEFAULT_MYSQL_PORT):
+        self.gateway = gateway
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        async def handle(reader, writer):
+            try:
+                await _Conn(reader, writer, self.gateway).run()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+            except Exception:
+                logger.exception("mysql session failed")
+            finally:
+                writer.close()
+
+        self._server = await asyncio.start_server(handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("mysql protocol on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

@@ -1,0 +1,199 @@
+"""PostgreSQL wire protocol server
+(ref: src/server/src/postgresql/service.rs — the reference serves the pg
+wire protocol via pgwire on port 5433, config.rs:176-179; this is an
+asyncio implementation of protocol 3.0's simple-query flow).
+
+Scope mirrors the reference's shim: startup (SSLRequest answered 'N',
+any credentials accepted), simple Query messages with text-format result
+rows (every column typed as TEXT), ErrorResponse + ReadyForQuery error
+recovery, Terminate. The extended (prepare/bind) protocol is not offered.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Optional
+
+logger = logging.getLogger("horaedb_tpu.postgres")
+
+DEFAULT_PG_PORT = 5433  # ref: config.rs:176-179
+
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+_TEXT_OID = 25
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + (len(payload) + 4).to_bytes(4, "big") + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode("utf-8", "replace") + b"\x00"
+
+
+_EXTENDED_TAGS = frozenset(b"PBDEHCFdcf")
+
+
+class _Conn:
+    def __init__(self, reader, writer, gateway) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.gateway = gateway
+
+    async def run(self) -> None:
+        if not await self._startup():
+            return
+        self.writer.write(_msg(b"R", (0).to_bytes(4, "big")))  # AuthenticationOk
+        for k, v in (
+            ("server_version", "14.0 (horaedb_tpu)"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO"),
+        ):
+            self.writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
+        self.writer.write(_msg(b"K", struct.pack("!II", 1, 0)))  # BackendKeyData
+        self._ready()
+        await self.writer.drain()
+        while True:
+            try:
+                tag = await self.reader.readexactly(1)
+                length = int.from_bytes(await self.reader.readexactly(4), "big")
+                body = await self.reader.readexactly(length - 4)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            if tag == b"X":  # Terminate
+                return
+            if tag == b"Q":
+                await self._query(body.rstrip(b"\x00").decode("utf-8", "replace"))
+            elif tag[0:1] in (b"P", b"B", b"D", b"E", b"H", b"C", b"F"):
+                # Extended protocol not offered: per spec, error once and
+                # DISCARD until Sync, then one ReadyForQuery — anything
+                # else desyncs drivers that pipeline Parse..Sync.
+                self._error("extended query protocol not supported; use simple queries")
+                if not await self._skip_until_sync():
+                    return
+                self._ready()
+            else:
+                self._error(f"unsupported message {tag!r}")
+                self._ready()
+            await self.writer.drain()
+
+    async def _startup(self) -> bool:
+        while True:
+            try:
+                length = int.from_bytes(await self.reader.readexactly(4), "big")
+                body = await self.reader.readexactly(length - 4)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return False
+            code = int.from_bytes(body[:4], "big")
+            if code == _SSL_REQUEST:
+                self.writer.write(b"N")  # no TLS; client retries plaintext
+                await self.writer.drain()
+                continue
+            if code == _CANCEL_REQUEST:
+                return False
+            return True  # StartupMessage (params ignored; any user ok)
+
+    def _ready(self) -> None:
+        self.writer.write(_msg(b"Z", b"I"))
+
+    async def _skip_until_sync(self) -> bool:
+        while True:
+            try:
+                tag = await self.reader.readexactly(1)
+                length = int.from_bytes(await self.reader.readexactly(4), "big")
+                await self.reader.readexactly(length - 4)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return False
+            if tag == b"S":
+                return True
+            if tag == b"X":
+                return False
+
+    def _error(self, message: str) -> None:
+        payload = (
+            b"S" + _cstr("ERROR") + b"C" + _cstr("XX000") + b"M" + _cstr(message) + b"\x00"
+        )
+        self.writer.write(_msg(b"E", payload))
+
+    async def _query(self, sql: str) -> None:
+        q = sql.strip().rstrip(";")
+        if not q:
+            self.writer.write(_msg(b"I", b""))  # EmptyQueryResponse
+            self._ready()
+            return
+        lowered = q.lower()
+        if lowered.startswith(("set ", "begin", "commit", "rollback")):
+            self.writer.write(_msg(b"C", _cstr("SET")))
+            self._ready()
+            return
+        # The shared gateway applies routing, fences, limiter, metrics.
+        kind, payload = await self.gateway.execute(q)
+        if kind == "error":
+            _, msg = payload
+            self._error(msg)
+            self._ready()
+            return
+        if kind == "affected":
+            verb = "INSERT 0" if "insert" in lowered[:10] else "OK"
+            self.writer.write(_msg(b"C", _cstr(f"{verb} {payload}")))
+            self._ready()
+            return
+        names, row_dicts = payload
+        desc = len(names).to_bytes(2, "big")
+        for name in names:
+            desc += (
+                _cstr(name)
+                + struct.pack("!IhIhih", 0, 0, _TEXT_OID, -1, -1, 0)
+            )
+        self.writer.write(_msg(b"T", desc))
+        rows = row_dicts
+        for r in rows:
+            payload = len(names).to_bytes(2, "big")
+            for n in names:
+                v = r.get(n)
+                if v is None:
+                    payload += (-1).to_bytes(4, "big", signed=True)
+                else:
+                    b = _render(v).encode("utf-8", "replace")
+                    payload += len(b).to_bytes(4, "big") + b
+            self.writer.write(_msg(b"D", payload))
+        self.writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+        self._ready()
+
+
+def _render(v) -> str:
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+class PostgresServer:
+    def __init__(self, gateway, host: str = "127.0.0.1", port: int = DEFAULT_PG_PORT):
+        self.gateway = gateway
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        async def handle(reader, writer):
+            try:
+                await _Conn(reader, writer, self.gateway).run()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+            except Exception:
+                logger.exception("postgres session failed")
+            finally:
+                writer.close()
+
+        self._server = await asyncio.start_server(handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("postgres protocol on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()

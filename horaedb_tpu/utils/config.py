@@ -41,6 +41,10 @@ class ServerConfig:
     http_port: int = 5440  # ref default, config.rs:176
     # 0 = derive from http_port + remote.GRPC_PORT_OFFSET; -1 = disabled
     grpc_port: int = 0
+    # MySQL / PostgreSQL wire listeners (ref defaults 3307 / 5433).
+    # 0 = derive from http_port (+2000 / +2001); -1 = disabled
+    mysql_port: int = 0
+    pg_port: int = 0
 
 
 @dataclass
@@ -88,7 +92,7 @@ class Config:
 
 
 _KNOWN = {
-    "server": {"host", "http_port", "grpc_port"},
+    "server": {"host", "http_port", "grpc_port", "mysql_port", "pg_port"},
     "engine": {
         "data_dir", "wal", "wal_backend",
         "space_write_buffer_size", "compaction_l0_trigger",
@@ -117,6 +121,10 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.server.http_port = int(s["http_port"])
     if "grpc_port" in s:
         cfg.server.grpc_port = int(s["grpc_port"])
+    if "mysql_port" in s:
+        cfg.server.mysql_port = int(s["mysql_port"])
+    if "pg_port" in s:
+        cfg.server.pg_port = int(s["pg_port"])
     e = raw.get("engine", {})
     if "data_dir" in e:
         cfg.engine.data_dir = str(e["data_dir"]) or None
