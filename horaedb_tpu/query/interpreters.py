@@ -220,6 +220,8 @@ class InterpreterFactory:
             f"update_mode='{opts.update_mode.value.upper()}'",
             f"enable_ttl='{str(opts.enable_ttl).lower()}'",
         ]
+        if opts.enable_ttl and opts.ttl_ms:
+            with_parts.append(f"ttl='{format_duration(opts.ttl_ms)}'")
         if opts.segment_duration_ms:
             with_parts.insert(0, f"segment_duration='{format_duration(opts.segment_duration_ms)}'")
         sql = (

@@ -210,14 +210,16 @@ class SqlGateway:
                 timeout=aiohttp.ClientTimeout(total=30),
             ) as resp:
                 body = await resp.json(content_type=None)
-        except aiohttp.ClientError as e:
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+            # ValueError covers non-JSON bodies; timeouts must map to the
+            # same 502 contract, not unwind wire-protocol sessions.
             return "error", (502, f"forward to {endpoint} failed: {e}")
         if resp.status != 200:
             return "error", (resp.status, body.get("error", "forward failed"))
         if "affected_rows" in body:
             return "affected", body["affected_rows"]
         rows = body.get("rows", [])
-        names = list(rows[0].keys()) if rows else []
+        names = body.get("names") or (list(rows[0].keys()) if rows else [])
         return "rows", (names, rows)
 
 
@@ -307,9 +309,10 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
             return web.json_response({"error": msg}, status=status)
         if kind == "affected":
             return web.json_response({"affected_rows": payload})
-        _, rows = payload
+        names, rows = payload
         return web.Response(
-            text=_dumps({"rows": rows}), content_type="application/json"
+            text=_dumps({"rows": rows, "names": names}),
+            content_type="application/json",
         )
 
     async def write(request: web.Request) -> web.Response:
@@ -863,7 +866,15 @@ def run_server(
     if wire_servers:
         async def _start_wire(app_):
             for s in wire_servers:
-                await s.start()
+                try:
+                    await s.start()
+                except OSError as e:
+                    # A busy derived port must not take down the node's
+                    # HTTP serving — wire listeners are best-effort.
+                    logger.warning(
+                        "wire listener %s failed to bind: %s",
+                        type(s).__name__, e,
+                    )
 
         async def _stop_wire(app_):
             for s in wire_servers:

@@ -107,6 +107,11 @@ class _Conn:
         )
 
     def _result_set(self, names: list[str], rows: list[list]) -> None:
+        if not names:
+            # a zero-column count byte would parse as an OK packet and
+            # desync the session; an empty result IS an OK
+            self._ok()
+            return
         self._send(_lenenc_int(len(names)))
         for name in names:
             nb = name.encode()

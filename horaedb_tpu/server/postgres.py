@@ -66,7 +66,7 @@ class _Conn:
                 return
             if tag == b"Q":
                 await self._query(body.rstrip(b"\x00").decode("utf-8", "replace"))
-            elif tag[0:1] in (b"P", b"B", b"D", b"E", b"H", b"C", b"F"):
+            elif tag[0] in _EXTENDED_TAGS:
                 # Extended protocol not offered: per spec, error once and
                 # DISCARD until Sync, then one ReadyForQuery — anything
                 # else desyncs drivers that pipeline Parse..Sync.
@@ -124,8 +124,11 @@ class _Conn:
             self._ready()
             return
         lowered = q.lower()
-        if lowered.startswith(("set ", "begin", "commit", "rollback")):
-            self.writer.write(_msg(b"C", _cstr("SET")))
+        word = lowered.split()[0] if lowered.split() else ""
+        if word in ("set", "begin", "start", "commit", "rollback"):
+            tag = {"set": "SET", "begin": "BEGIN", "start": "BEGIN",
+                   "commit": "COMMIT", "rollback": "ROLLBACK"}[word]
+            self.writer.write(_msg(b"C", _cstr(tag)))
             self._ready()
             return
         # The shared gateway applies routing, fences, limiter, metrics.

@@ -42,7 +42,7 @@ class ServerConfig:
     # 0 = derive from http_port + remote.GRPC_PORT_OFFSET; -1 = disabled
     grpc_port: int = 0
     # MySQL / PostgreSQL wire listeners (ref defaults 3307 / 5433).
-    # 0 = derive from http_port (+2000 / +2001); -1 = disabled
+    # 0 = derive from http_port (+2000 / +3000); -1 = disabled
     mysql_port: int = 0
     pg_port: int = 0
 

@@ -1,0 +1,10 @@
+-- ALTER TABLE ADD COLUMN; old rows read back NULL-filled
+-- (ref: cases/env/local/ddl/alter_table.sql)
+CREATE TABLE at (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO at (host, v, ts) VALUES ('a', 1.0, 1000);
+ALTER TABLE at ADD COLUMN extra double;
+DESCRIBE at;
+INSERT INTO at (host, v, extra, ts) VALUES ('b', 2.0, 9.5, 2000);
+SELECT host, v, extra FROM at ORDER BY ts;
+ALTER TABLE at ADD COLUMN v double;
+DROP TABLE at;

@@ -1,0 +1,11 @@
+-- Create-table variations (ref: cases/env/local/ddl/create_tables.sql)
+CREATE TABLE t1 (ts timestamp NOT NULL, v double, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE t1 (ts timestamp NOT NULL, v double, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE IF NOT EXISTS t1 (ts timestamp NOT NULL, v double, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE t2 (`ts` timestamp NOT NULL, `tag-1` string TAG, v double, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+SHOW TABLES;
+DESCRIBE t2;
+CREATE TABLE t3 (ts timestamp NOT NULL, v unknown_type, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE t4 (v double) ENGINE=Analytic;
+DROP TABLE t1;
+DROP TABLE t2;

@@ -1,0 +1,9 @@
+-- Partitioned tables: DDL, scatter writes, pruned reads
+-- (ref: partition-table DDL, parser.rs partition extension)
+CREATE TABLE pt (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts))
+PARTITION BY KEY(host) PARTITIONS 4 ENGINE=Analytic;
+INSERT INTO pt (host, v, ts) VALUES ('a', 1.0, 1000), ('b', 2.0, 1000), ('c', 3.0, 1000), ('d', 4.0, 1000);
+SELECT host, v FROM pt ORDER BY host;
+SELECT count(*) AS c FROM pt WHERE host = 'a';
+SELECT host, sum(v) AS s FROM pt GROUP BY host ORDER BY host;
+DROP TABLE pt;

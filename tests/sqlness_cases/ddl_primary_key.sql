@@ -1,0 +1,7 @@
+-- Explicit PRIMARY KEY vs auto-tsid
+CREATE TABLE pk (host string TAG, v double, ts timestamp NOT NULL,
+TIMESTAMP KEY(ts), PRIMARY KEY(host, ts)) ENGINE=Analytic;
+DESCRIBE pk;
+INSERT INTO pk (host, v, ts) VALUES ('a', 1.0, 100), ('a', 2.0, 100);
+SELECT host, v FROM pk;
+DROP TABLE pk;

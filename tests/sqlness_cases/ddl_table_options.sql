@@ -1,0 +1,8 @@
+-- Table options: segment_duration, TTL, update_mode, show create
+CREATE TABLE opts (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts))
+ENGINE=Analytic WITH (segment_duration='2h', ttl='7d', update_mode='append');
+SHOW CREATE TABLE opts;
+ALTER TABLE opts MODIFY SETTING segment_duration='1h';
+SHOW CREATE TABLE opts;
+CREATE TABLE badopt (ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic WITH (nonsense='1');
+DROP TABLE opts;

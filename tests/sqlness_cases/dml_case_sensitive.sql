@@ -1,0 +1,6 @@
+-- identifier case handling (ref: cases/common/dml/case_sensitive.sql)
+CREATE TABLE Cs (Host string TAG, V double, Ts timestamp NOT NULL, TIMESTAMP KEY(Ts)) ENGINE=Analytic;
+INSERT INTO Cs (Host, V, Ts) VALUES ('a', 1.0, 100);
+SELECT Host, V FROM Cs;
+SELECT host FROM Cs;
+DROP TABLE Cs;

@@ -1,0 +1,8 @@
+-- SELECT DISTINCT incl NULL keys
+CREATE TABLE d (host string TAG, region string TAG, x double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO d (host, region, x, ts) VALUES
+  ('a', 'us', 0.0, 1), ('b', 'us', NULL, 2), ('c', 'eu', 0.0, 3), ('d', 'eu', NULL, 4);
+SELECT DISTINCT region FROM d ORDER BY region;
+SELECT DISTINCT x FROM d;
+SELECT DISTINCT region, count(*) AS c FROM d GROUP BY region ORDER BY region;
+DROP TABLE d;

@@ -1,0 +1,8 @@
+-- INSERT validation errors
+CREATE TABLE ie (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO ie (host, v) VALUES ('a', 1.0);
+INSERT INTO ie (host, v, ts) VALUES ('a', 1.0);
+INSERT INTO ie (host, nope, ts) VALUES ('a', 1.0, 100);
+INSERT INTO nosuch (host) VALUES ('a');
+SELECT count(*) AS c FROM ie;
+DROP TABLE ie;

@@ -1,0 +1,12 @@
+-- overwrite vs append semantics (ref: cases/common/dml/insert_mode.sql)
+CREATE TABLE ow (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO ow (host, v, ts) VALUES ('a', 1.0, 100);
+INSERT INTO ow (host, v, ts) VALUES ('a', 2.0, 100);
+SELECT host, v FROM ow;
+CREATE TABLE ap (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts))
+ENGINE=Analytic WITH (update_mode='append');
+INSERT INTO ap (host, v, ts) VALUES ('a', 1.0, 100);
+INSERT INTO ap (host, v, ts) VALUES ('a', 2.0, 100);
+SELECT host, v FROM ap ORDER BY v;
+DROP TABLE ow;
+DROP TABLE ap;

@@ -1,0 +1,10 @@
+-- single-key inner join (host path subset)
+CREATE TABLE m (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE owners (host string TAG, owner string TAG, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO m (host, v, ts) VALUES ('a', 1.0, 100), ('a', 2.0, 200), ('b', 3.0, 100), ('x', 9.0, 100);
+INSERT INTO owners (host, owner, ts) VALUES ('a', 'alice', 1), ('b', 'bob', 1);
+SELECT host, v, owner FROM m JOIN owners ON m.host = owners.host ORDER BY host, v;
+SELECT host, v FROM m JOIN owners ON m.host = owners.host WHERE owner = 'bob';
+SELECT count(*) AS c FROM m JOIN owners ON m.host = owners.host;
+DROP TABLE m;
+DROP TABLE owners;

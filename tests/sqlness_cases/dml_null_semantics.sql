@@ -1,0 +1,9 @@
+-- SQL 3-valued logic + NULL-skipping aggregates
+CREATE TABLE n (host string TAG, x double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO n (host, x, ts) VALUES ('a', 1.0, 1), ('b', NULL, 2), ('c', 3.0, 3);
+SELECT host FROM n WHERE x > 0 ORDER BY host;
+SELECT host FROM n WHERE x IS NULL;
+SELECT host FROM n WHERE x IS NOT NULL ORDER BY host;
+SELECT count(*) AS all_rows, count(x) AS non_null, sum(x) AS s, avg(x) AS a FROM n;
+SELECT min(x) AS lo, max(x) AS hi FROM n;
+DROP TABLE n;

@@ -1,0 +1,5 @@
+-- duplicate keys within ONE insert batch: LAST write wins (row order)
+CREATE TABLE sb (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO sb (host, v, ts) VALUES ('a', 1.0, 100), ('a', 2.0, 100), ('a', 3.0, 100);
+SELECT host, v FROM sb;
+DROP TABLE sb;

@@ -1,0 +1,13 @@
+-- filter shapes (ref: cases/common/dml/select_filter.sql)
+CREATE TABLE f (host string TAG, region string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO f (host, region, v, ts) VALUES
+  ('a', 'us', 1.0, 1000), ('b', 'us', 2.0, 2000), ('c', 'eu', 3.0, 3000), ('d', 'eu', 4.0, 4000);
+SELECT host FROM f WHERE v > 2 ORDER BY host;
+SELECT host FROM f WHERE v >= 2 AND region = 'eu' ORDER BY host;
+SELECT host FROM f WHERE host IN ('a', 'd') ORDER BY host;
+SELECT host FROM f WHERE host NOT IN ('a', 'd') ORDER BY host;
+SELECT host FROM f WHERE v BETWEEN 2 AND 3 ORDER BY host;
+SELECT host FROM f WHERE ts > 1500 AND ts < 3500 ORDER BY host;
+SELECT host FROM f WHERE v > 3 OR region = 'us' ORDER BY host;
+SELECT host FROM f WHERE NOT (v > 2) ORDER BY host;
+DROP TABLE f;

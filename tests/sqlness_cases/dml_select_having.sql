@@ -1,0 +1,8 @@
+-- HAVING (ref: cases/common/dml/select_having.sql)
+CREATE TABLE h (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO h (host, v, ts) VALUES ('a', 1.0, 100), ('a', 2.0, 200), ('b', 9.0, 100), ('c', 1.0, 100);
+SELECT host, count(*) AS c FROM h GROUP BY host HAVING c > 1 ORDER BY host;
+SELECT host, sum(v) AS s FROM h GROUP BY host HAVING s >= 2 ORDER BY host;
+SELECT host, count(*) AS c FROM h GROUP BY host HAVING host != 'a' ORDER BY host;
+SELECT v FROM h HAVING v > 1;
+DROP TABLE h;

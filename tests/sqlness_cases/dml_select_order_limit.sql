@@ -1,0 +1,9 @@
+-- ORDER BY / LIMIT shapes (ref: cases/common/dml/select_order.sql)
+CREATE TABLE o (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO o (host, v, ts) VALUES ('b', 2.0, 200), ('a', 3.0, 100), ('c', 1.0, 300);
+SELECT host, v FROM o ORDER BY v;
+SELECT host, v FROM o ORDER BY v DESC;
+SELECT host, v FROM o ORDER BY host DESC, v;
+SELECT host, v FROM o ORDER BY ts LIMIT 2;
+SELECT host, v * 2 AS dbl FROM o ORDER BY dbl DESC LIMIT 1;
+DROP TABLE o;

@@ -1,0 +1,6 @@
+-- EXPLAIN (plan shape only; ANALYZE timings are non-deterministic)
+CREATE TABLE ex (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO ex (host, v, ts) VALUES ('a', 1.0, 100);
+EXPLAIN SELECT host, avg(v) AS a FROM ex WHERE ts > 50 GROUP BY host;
+EXPLAIN SELECT host, v FROM ex WHERE v > 0.5 ORDER BY ts LIMIT 10;
+DROP TABLE ex;

@@ -1,0 +1,11 @@
+-- aggregate function coverage incl count distinct + UDAF
+CREATE TABLE ag (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO ag (host, v, ts) VALUES
+  ('a', 1.0, 100), ('a', 2.0, 200), ('b', 2.0, 100), ('b', 2.0, 200), ('c', 5.0, 100);
+SELECT count(*) AS c, sum(v) AS s, min(v) AS lo, max(v) AS hi, avg(v) AS a FROM ag;
+SELECT count(DISTINCT host) AS hosts FROM ag;
+SELECT host, count(DISTINCT v) AS dv FROM ag GROUP BY host ORDER BY host;
+SELECT thetasketch_distinct(host) AS d FROM ag;
+SELECT count(*) AS c FROM ag WHERE v > 100;
+SELECT sum(v) AS s FROM ag WHERE v > 100;
+DROP TABLE ag;

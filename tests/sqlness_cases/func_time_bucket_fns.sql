@@ -1,0 +1,9 @@
+-- time_bucket + scalar functions in expressions
+CREATE TABLE tb (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO tb (host, v, ts) VALUES
+  ('a', -1.5, 0), ('a', 2.0, 30000), ('a', 3.0, 60000), ('b', -4.0, 90000);
+SELECT time_bucket(ts, '1m') AS b, count(*) AS c FROM tb GROUP BY time_bucket(ts, '1m') ORDER BY b;
+SELECT host, time_bucket(ts, '1m') AS b, sum(v) AS s FROM tb GROUP BY host, time_bucket(ts, '1m') ORDER BY host, b;
+SELECT host, abs(v) AS av FROM tb WHERE v < 0 ORDER BY host;
+SELECT host, v + 1 AS p, v * 2 AS m FROM tb WHERE host = 'b';
+DROP TABLE tb;

@@ -1,0 +1,9 @@
+-- empty-result shapes: ungrouped agg yields one row, grouped yields none
+CREATE TABLE e (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+SELECT count(*) AS c FROM e;
+SELECT count(*) AS c, sum(v) AS s FROM e;
+SELECT host, count(*) AS c FROM e GROUP BY host;
+SELECT host, v FROM e;
+INSERT INTO e (host, v, ts) VALUES ('a', 1.0, 100);
+SELECT count(*) AS c FROM e WHERE ts > 5000;
+DROP TABLE e;

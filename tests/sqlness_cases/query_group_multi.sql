@@ -1,0 +1,7 @@
+-- multi-key grouping: tags x time buckets
+CREATE TABLE g (host string TAG, region string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO g (host, region, v, ts) VALUES
+  ('a', 'us', 1.0, 0), ('a', 'us', 2.0, 60000), ('b', 'eu', 3.0, 0), ('b', 'us', 4.0, 60000);
+SELECT host, region, count(*) AS c FROM g GROUP BY host, region ORDER BY host, region;
+SELECT region, time_bucket(ts, '1m') AS b, sum(v) AS s FROM g GROUP BY region, time_bucket(ts, '1m') ORDER BY region, b;
+DROP TABLE g;

@@ -1,0 +1,11 @@
+-- SHOW / DESCRIBE / EXISTS surfaces (ref: cases/common/show, system/)
+CREATE TABLE s1 (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE s2 (ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+SHOW TABLES;
+SHOW CREATE TABLE s1;
+DESCRIBE s1;
+EXISTS TABLE s1;
+EXISTS TABLE nope;
+DROP TABLE s2;
+SHOW TABLES;
+DROP TABLE s1;
