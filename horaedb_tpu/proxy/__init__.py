@@ -97,6 +97,9 @@ class Proxy:
         # Recent per-query metric trees (ref: trace_metric; surfaced at
         # /debug/queries).
         self.recent_queries: deque = deque(maxlen=64)
+        # Slow-query ring (ref: the slow log + SlowTimer, read.rs:177-183)
+        # — persists across requests, surfaced at /debug/slow_log.
+        self.slow_queries: deque = deque(maxlen=128)
         self._req_ids = itertools.count(1)
         self._m_queries = REGISTRY.counter("horaedb_queries_total", "SQL statements handled")
         self._m_errors = REGISTRY.counter("horaedb_query_errors_total", "SQL statements failed")
@@ -141,4 +144,12 @@ class Proxy:
                 logger.warning(
                     "slow query (request %d, %.3fs): %s",
                     ctx.request_id, elapsed, sql[:500],
+                )
+                self.slow_queries.append(
+                    {
+                        "request_id": ctx.request_id,
+                        "elapsed_s": round(elapsed, 4),
+                        "sql": sql[:500],
+                        "at": time.time(),
+                    }
                 )

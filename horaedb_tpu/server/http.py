@@ -223,11 +223,31 @@ class SqlGateway:
         return "rows", (names, rows)
 
 
-def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
+@web.middleware
+async def _auth_middleware(request: web.Request, handler):
+    """Bearer-token gate on the admin/debug surface (ref: proxy/src/auth/
+    — the data plane stays open like the reference's default; operators
+    set server.auth_token to lock down the control surface)."""
+    token = request.app.get("auth_token")
+    if token and (
+        request.path.startswith("/admin/") or request.path.startswith("/debug/")
+    ):
+        import hmac
+
+        supplied = request.headers.get("Authorization", "")
+        if not hmac.compare_digest(supplied, f"Bearer {token}"):
+            return web.json_response({"error": "unauthorized"}, status=401)
+    return await handler(request)
+
+
+def create_app(
+    conn: Connection, router=None, cluster=None, auth_token: str = ""
+) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
     adds the /meta_event endpoints, meta-driven DDL, and write fencing."""
     proxy = Proxy(conn)
-    app = web.Application()
+    app = web.Application(middlewares=[_auth_middleware])
+    app["auth_token"] = auth_token
     app["conn"] = conn
     app["proxy"] = proxy
     app["router"] = router
@@ -644,6 +664,71 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
             return web.json_response({"error": "bad threshold"}, status=400)
         return web.json_response({"slow_threshold_s": proxy.slow_threshold_s})
 
+    async def debug_profile_cpu(request: web.Request) -> web.Response:
+        """Sampling CPU profile (ref: /debug/profile/cpu/{sec}, http.rs:539)."""
+        from ..utils.profile import sample_cpu
+
+        try:
+            seconds = float(request.match_info["seconds"])
+        except ValueError:
+            seconds = float("nan")
+        if not (0.0 <= seconds <= 60.0):  # also rejects NaN
+            return web.json_response({"error": "bad duration"}, status=400)
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, sample_cpu, seconds
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+    async def debug_profile_heap(request: web.Request) -> web.Response:
+        """tracemalloc growth profile (ref: /debug/profile/heap/{sec})."""
+        from ..utils.profile import sample_heap
+
+        try:
+            seconds = float(request.match_info["seconds"])
+        except ValueError:
+            seconds = float("nan")
+        if not (0.0 <= seconds <= 60.0):  # also rejects NaN
+            return web.json_response({"error": "bad duration"}, status=400)
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, sample_heap, seconds
+        )
+        return web.Response(text=text, content_type="text/plain")
+
+    async def debug_log_level(request: web.Request) -> web.Response:
+        """Live log-level switch (ref: /debug/log_level/{level}, http.rs:643
+        + the RuntimeLevel in components/logger)."""
+        level = request.match_info["level"].upper()
+        if level not in ("DEBUG", "INFO", "WARNING", "WARN", "ERROR", "CRITICAL"):
+            return web.json_response({"error": f"unknown level {level!r}"}, status=400)
+        logging.getLogger().setLevel("WARNING" if level == "WARN" else level)
+        return web.json_response({"log_level": level})
+
+    async def debug_slow_log(request: web.Request) -> web.Response:
+        """Recent slow queries (ref: the reference's slow-query log file)."""
+        return web.Response(
+            text=_dumps(list(proxy.slow_queries)), content_type="application/json"
+        )
+
+    async def admin_flush(request: web.Request) -> web.Response:
+        """Force a flush (all tables, or ?table=name)."""
+        name = request.query.get("table")
+
+        def do():
+            if name:
+                t = conn.catalog.open(name)
+                if t is None:
+                    raise ValueError(f"table not found: {name}")
+                t.flush()
+                return [name]
+            conn.flush_all()
+            return conn.catalog.table_names()
+
+        try:
+            flushed = await asyncio.get_running_loop().run_in_executor(None, do)
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        return web.json_response({"flushed": flushed})
+
     async def admin_block(request: web.Request) -> web.Response:
         try:
             tables = (await request.json())["tables"]
@@ -739,6 +824,11 @@ def create_app(conn: Connection, router=None, cluster=None) -> web.Application:
     app.router.add_get("/debug/hotspot", debug_hotspot)
     app.router.add_get("/debug/queries", debug_queries)
     app.router.add_put("/debug/slow_threshold/{seconds}", slow_threshold)
+    app.router.add_get("/debug/profile/cpu/{seconds}", debug_profile_cpu)
+    app.router.add_get("/debug/profile/heap/{seconds}", debug_profile_heap)
+    app.router.add_put("/debug/log_level/{level}", debug_log_level)
+    app.router.add_get("/debug/slow_log", debug_slow_log)
+    app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)
     return app
@@ -839,7 +929,12 @@ def run_server(
 
         conn.catalog.sub_table_resolver = resolve_sub
 
-    app = create_app(conn, router=router, cluster=cluster)
+    app = create_app(
+        conn,
+        router=router,
+        cluster=cluster,
+        auth_token=(config.server.auth_token if config is not None else ""),
+    )
     app["proxy"].slow_threshold_s = slow_threshold
 
     # MySQL / PostgreSQL wire listeners (ref: mysql/service.rs:21,

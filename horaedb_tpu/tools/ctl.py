@@ -22,9 +22,18 @@ import argparse
 import json
 import sys
 import urllib.error
+import os
 import urllib.request
 
 DEFAULT_ENDPOINT = "127.0.0.1:5440"
+
+# Admin auth: --token flag or HORAEDB_TOKEN env (the server's
+# server.auth_token gates /admin/* and /debug/*).
+_TOKEN = os.environ.get("HORAEDB_TOKEN", "")
+
+
+def _auth_headers() -> dict:
+    return {"Authorization": f"Bearer {_TOKEN}"} if _TOKEN else {}
 
 
 class CtlError(RuntimeError):
@@ -32,8 +41,9 @@ class CtlError(RuntimeError):
 
 
 def _get(endpoint: str, path: str) -> str:
+    req = urllib.request.Request(f"http://{endpoint}{path}", headers=_auth_headers())
     try:
-        with urllib.request.urlopen(f"http://{endpoint}{path}", timeout=10) as r:
+        with urllib.request.urlopen(req, timeout=10) as r:
             return r.read().decode()
     except urllib.error.URLError as e:
         raise CtlError(f"GET {path} failed: {e}") from None
@@ -43,7 +53,7 @@ def _post(endpoint: str, path: str, payload: dict, method: str = "POST") -> str:
     req = urllib.request.Request(
         f"http://{endpoint}{path}",
         json.dumps(payload).encode(),
-        {"Content-Type": "application/json"},
+        {"Content-Type": "application/json", **_auth_headers()},
         method=method,
     )
     try:
@@ -123,6 +133,7 @@ def cmd_diagnose(ep: str, args) -> None:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="horaectl", description=__doc__)
     p.add_argument("--endpoint", default=DEFAULT_ENDPOINT)
+    p.add_argument("--token", default=None, help="admin auth token (or HORAEDB_TOKEN env)")
     sub = p.add_subparsers(dest="command", required=True)
     sub.add_parser("tables")
     q = sub.add_parser("query")
@@ -138,6 +149,9 @@ def main(argv=None) -> int:
     sub.add_parser("hotspot")
     sub.add_parser("diagnose")
     args = p.parse_args(argv)
+    if args.token:
+        global _TOKEN
+        _TOKEN = args.token
     handler = globals()[f"cmd_{args.command}"]
     try:
         handler(args.endpoint, args)

@@ -1,0 +1,97 @@
+"""SST inspection tool (ref: src/tools sst-metadata bin — dumps the
+custom metadata + parquet layout of an SST file).
+
+    python -m horaedb_tpu.tools.sst_metadata PATH [PATH...]
+    python -m horaedb_tpu.tools.sst_metadata --dir DATA_DIR  # every .sst
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def describe(path: str) -> dict:
+    import pyarrow.parquet as pq
+
+    from ..engine.sst.meta import SST_META_KEY
+
+    pf = pq.ParquetFile(path, memory_map=True)
+    md = pf.metadata
+    kv = pf.schema_arrow.metadata or {}
+    raw = kv.get(SST_META_KEY)
+    own = json.loads(raw) if raw is not None else None
+    row_groups = []
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        cols = {}
+        for ci in range(g.num_columns):
+            col = g.column(ci)
+            st = col.statistics
+            if st is not None and st.has_min_max:
+                cols[col.path_in_schema] = {
+                    "min": _plain(st.min),
+                    "max": _plain(st.max),
+                    "nulls": st.null_count,
+                }
+        row_groups.append(
+            {
+                "rows": g.num_rows,
+                "bytes": g.total_byte_size,
+                "column_stats": cols,
+            }
+        )
+    return {
+        "path": path,
+        "file_bytes": os.path.getsize(path),
+        "rows": md.num_rows,
+        "row_groups": md.num_row_groups,
+        "columns": [md.schema.column(i).name for i in range(md.num_columns)],
+        "sst_meta": own,
+        "row_group_stats": row_groups,
+    }
+
+
+def _plain(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    if hasattr(v, "isoformat"):
+        return v.isoformat()
+    return v
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="dump horaedb_tpu SST metadata")
+    p.add_argument("paths", nargs="*", help=".sst files")
+    p.add_argument("--dir", default=None, help="scan a data dir for .sst files")
+    p.add_argument("--brief", action="store_true", help="one summary line per file")
+    args = p.parse_args(argv)
+    paths = list(args.paths)
+    if args.dir:
+        for root, _, files in os.walk(args.dir):
+            paths += [os.path.join(root, f) for f in files if f.endswith(".sst")]
+    if not paths:
+        p.error("no SST paths given")
+    for path in paths:
+        try:
+            d = describe(path)
+        except Exception as e:
+            print(f"{path}: ERROR {e}", file=sys.stderr)
+            continue
+        if args.brief:
+            m = d["sst_meta"] or {}
+            print(
+                f"{path}\trows={d['rows']}\tgroups={d['row_groups']}\t"
+                f"bytes={d['file_bytes']}\tfile_id={m.get('file_id')}\t"
+                f"max_seq={m.get('max_sequence')}\t"
+                f"time_range={m.get('time_range')}"
+            )
+        else:
+            print(json.dumps(d, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

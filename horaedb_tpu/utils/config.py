@@ -45,6 +45,9 @@ class ServerConfig:
     # 0 = derive from http_port (+2000 / +3000); -1 = disabled
     mysql_port: int = 0
     pg_port: int = 0
+    # when set, /admin/* and /debug/* require
+    # "Authorization: Bearer <token>" (ref: proxy/src/auth/)
+    auth_token: str = ""
 
 
 @dataclass
@@ -92,7 +95,9 @@ class Config:
 
 
 _KNOWN = {
-    "server": {"host", "http_port", "grpc_port", "mysql_port", "pg_port"},
+    "server": {
+        "host", "http_port", "grpc_port", "mysql_port", "pg_port", "auth_token",
+    },
     "engine": {
         "data_dir", "wal", "wal_backend",
         "space_write_buffer_size", "compaction_l0_trigger",
@@ -125,6 +130,8 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.server.mysql_port = int(s["mysql_port"])
     if "pg_port" in s:
         cfg.server.pg_port = int(s["pg_port"])
+    if "auth_token" in s:
+        cfg.server.auth_token = str(s["auth_token"])
     e = raw.get("engine", {})
     if "data_dir" in e:
         cfg.engine.data_dir = str(e["data_dir"]) or None

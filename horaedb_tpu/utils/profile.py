@@ -1,0 +1,89 @@
+"""On-demand profiling (ref: components/profile/src/lib.rs:91-170 — the
+reference dumps pprof CPU profiles and jemalloc heap stats over
+/debug/profile/{cpu,heap}/{seconds}, server/src/http.rs:539-563).
+
+Python equivalents with no native agent:
+
+- CPU: a sampling wall-clock profiler over ``sys._current_frames()`` —
+  aggregates stack samples across ALL threads (a cProfile attach can't
+  see other threads), the same shape py-spy/pprof reports reduce to.
+- Heap: tracemalloc growth between two snapshots over the window.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+# tracemalloc is process-global state: concurrent heap profiles must
+# serialize, or the first to finish stops tracing under the second.
+_heap_lock = threading.Lock()
+
+
+def sample_cpu(seconds: float, interval_s: float = 0.01, top: int = 40) -> str:
+    """Sample every thread's stack for ``seconds``; text report of the
+    hottest frames (self samples) and hottest whole stacks."""
+    frames: Counter = Counter()
+    stacks: Counter = Counter()
+    deadline = time.monotonic() + seconds
+    n_samples = 0
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            stack = traceback.extract_stack(frame)
+            if not stack:
+                continue
+            # skip the profiler's own frames
+            if any("utils/profile" in f.filename for f in stack[-2:]):
+                continue
+            leaf = stack[-1]
+            frames[f"{leaf.filename}:{leaf.lineno} {leaf.name}"] += 1
+            stacks[
+                " <- ".join(f"{f.name}" for f in reversed(stack[-6:]))
+            ] += 1
+        n_samples += 1
+        time.sleep(interval_s)
+    lines = [f"cpu profile: {n_samples} sampling rounds over {seconds:.1f}s", ""]
+    lines.append("hottest frames (self samples):")
+    for name, count in frames.most_common(top):
+        lines.append(f"  {count:6d}  {name}")
+    lines.append("")
+    lines.append("hottest stacks (leaf <- callers):")
+    for name, count in stacks.most_common(top // 2):
+        lines.append(f"  {count:6d}  {name}")
+    return "\n".join(lines) + "\n"
+
+
+def sample_heap(seconds: float, top: int = 40) -> str:
+    """tracemalloc growth over the window, by allocation site.
+
+    Serialized process-wide (see _heap_lock); concurrent callers queue."""
+    import tracemalloc
+
+    with _heap_lock:
+        return _sample_heap_locked(tracemalloc, seconds, top)
+
+
+def _sample_heap_locked(tracemalloc, seconds: float, top: int) -> str:
+    started_here = not tracemalloc.is_tracing()
+    if started_here:
+        tracemalloc.start(10)
+    try:
+        before = tracemalloc.take_snapshot()
+        time.sleep(seconds)
+        after = tracemalloc.take_snapshot()
+        stats = after.compare_to(before, "lineno")
+        current, peak = tracemalloc.get_traced_memory()
+        lines = [
+            f"heap profile: growth over {seconds:.1f}s "
+            f"(traced current={current >> 10}KiB peak={peak >> 10}KiB)",
+            "",
+        ]
+        for stat in stats[:top]:
+            lines.append(f"  {stat}")
+        return "\n".join(lines) + "\n"
+    finally:
+        if started_here:
+            tracemalloc.stop()

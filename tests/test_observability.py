@@ -87,3 +87,131 @@ class TestOrphanSweep:
         for p in tracked:
             assert store.exists(p)  # real data untouched
         assert len(inst2.read(t2)) == 1
+
+
+from test_server import with_client  # noqa: E402
+
+
+class TestProfilingEndpoints:
+    def test_cpu_profile(self):
+        async def body(client):
+            resp = await client.get("/debug/profile/cpu/0.2")
+            assert resp.status == 200
+            text = await resp.text()
+            assert "cpu profile" in text and "hottest frames" in text
+
+        with_client(body)
+
+    def test_heap_profile(self):
+        async def body(client):
+            resp = await client.get("/debug/profile/heap/0.1")
+            assert resp.status == 200
+            assert "heap profile" in await resp.text()
+
+        with_client(body)
+
+    def test_log_level_switch(self):
+        import logging
+
+        async def body(client):
+            before = logging.getLogger().level
+            try:
+                resp = await client.put("/debug/log_level/debug")
+                assert resp.status == 200
+                assert logging.getLogger().level == logging.DEBUG
+                resp = await client.put("/debug/log_level/bogus")
+                assert resp.status == 400
+            finally:
+                logging.getLogger().setLevel(before)
+
+        with_client(body)
+
+
+class TestSlowLog:
+    def test_slow_queries_recorded(self):
+        async def body(client):
+            app_proxy = client.server.app["proxy"]
+            app_proxy.slow_threshold_s = 0.0  # everything is "slow"
+            await client.post("/sql", json={"query": "SHOW TABLES"})
+            resp = await client.get("/debug/slow_log")
+            entries = await resp.json()
+            assert entries and entries[-1]["sql"].startswith("SHOW TABLES")
+            assert entries[-1]["elapsed_s"] >= 0
+
+        with_client(body)
+
+
+class TestAdminFlushAndAuth:
+    def test_admin_flush(self):
+        async def body(client):
+            conn = client.server.app["conn"]
+            conn.execute(
+                "CREATE TABLE ft (h string TAG, v double, ts timestamp NOT NULL, "
+                "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            conn.execute("INSERT INTO ft (h, v, ts) VALUES ('a', 1.0, 100)")
+            resp = await client.post("/admin/flush?table=ft")
+            assert resp.status == 200
+            assert (await resp.json())["flushed"] == ["ft"]
+            resp = await client.post("/admin/flush?table=nope")
+            assert resp.status == 422
+
+        with_client(body)
+
+    def test_auth_gates_admin_and_debug(self):
+        import horaedb_tpu
+        from horaedb_tpu.server import create_app
+        from aiohttp.test_utils import TestClient, TestServer
+        import asyncio
+
+        async def body():
+            conn = horaedb_tpu.connect(None)
+            app = create_app(conn, auth_token="s3cret")
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.get("/debug/config")
+                assert resp.status == 401
+                resp = await client.post("/admin/flush")
+                assert resp.status == 401
+                resp = await client.get(
+                    "/debug/config", headers={"Authorization": "Bearer s3cret"}
+                )
+                assert resp.status == 200
+                # the data plane stays open (reference default)
+                resp = await client.post("/sql", json={"query": "SHOW TABLES"})
+                assert resp.status == 200
+            finally:
+                await client.close()
+                conn.close()
+
+        asyncio.run(body())
+
+
+class TestSstMetadataTool:
+    def test_describe_and_cli(self, tmp_path, capsys):
+        import horaedb_tpu
+        from horaedb_tpu.tools.sst_metadata import describe, main
+
+        db = horaedb_tpu.connect(str(tmp_path / "d"))
+        db.execute(
+            "CREATE TABLE st (h string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO st (h, v, ts) VALUES ('a', 1.0, 100), ('b', 2.0, 200)")
+        db.flush_all()
+        db.close()
+        ssts = []
+        import os
+
+        for root, _, files in os.walk(tmp_path):
+            ssts += [os.path.join(root, f) for f in files if f.endswith(".sst")]
+        assert ssts
+        d = describe(ssts[0])
+        assert d["rows"] == 2
+        assert d["sst_meta"]["max_sequence"] >= 1
+        assert "ts" in d["columns"]
+        assert d["row_group_stats"][0]["column_stats"]
+        rc = main(["--brief", ssts[0]])
+        assert rc == 0
+        assert "rows=2" in capsys.readouterr().out
