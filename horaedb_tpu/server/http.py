@@ -847,6 +847,7 @@ def run_server(
 
     engine_cfg = None
     slow_threshold = 1.0
+    explicit_data_dir = data_dir  # before config merge: the CALLER's choice
     if config is not None:
         data_dir = data_dir if data_dir is not None else config.engine.data_dir
         host = host if host is not None else config.server.host
@@ -858,12 +859,50 @@ def run_server(
         slow_threshold = config.limits.slow_threshold_s
     host = host if host is not None else "127.0.0.1"
     port = port if port is not None else DEFAULT_HTTP_PORT
-    conn = connect(
-        data_dir,
-        wal=(config.engine.wal if config is not None else True),
-        engine_config=engine_cfg,
-        wal_backend=(config.engine.wal_backend if config is not None else "disk"),
-    )
+    if config is not None and config.s3.bucket and explicit_data_dir is not None:
+        # Precedence rule: an explicit argument wins over config — an
+        # explicitly passed data_dir keeps the node on local storage.
+        logger.warning(
+            "[s3] configured but an explicit data_dir was given; using local "
+            "storage at %s and IGNORING the s3 section", explicit_data_dir,
+        )
+    if config is not None and config.s3.bucket and explicit_data_dir is None:
+        # Cloud storage mode: SSTs, manifests, catalog, AND the WAL all
+        # live in S3 — a diskless node (ref: the reference's cloud-native
+        # deployment over object storage). Reads go through the CRC-paged
+        # disk cache + sharded memory cache when configured.
+        from ..db import Connection
+        from ..engine.wal import ObjectStoreWal
+        from ..utils.object_store import DiskCacheStore, MemCacheStore
+        from ..utils.s3 import S3Store
+
+        store = S3Store(
+            config.s3.bucket,
+            config.s3.endpoint,
+            config.s3.access_key,
+            config.s3.secret_key,
+            region=config.s3.region,
+            prefix=config.s3.prefix,
+        )
+        read_store = store
+        if config.s3.disk_cache_dir:
+            read_store = DiskCacheStore(
+                read_store, config.s3.disk_cache_dir, config.s3.disk_cache_bytes
+            )
+        if config.s3.mem_cache_bytes:
+            read_store = MemCacheStore(read_store, config.s3.mem_cache_bytes)
+        conn = Connection(
+            read_store,
+            wal=(ObjectStoreWal(store) if config.engine.wal else None),
+            config=engine_cfg,
+        )
+    else:
+        conn = connect(
+            data_dir,
+            wal=(config.engine.wal if config is not None else True),
+            engine_config=engine_cfg,
+            wal_backend=(config.engine.wal_backend if config is not None else "disk"),
+        )
     router = None
     cluster = None
     if config is not None and config.cluster.enabled:

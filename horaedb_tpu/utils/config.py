@@ -76,11 +76,30 @@ class ClusterSection:
 
 
 @dataclass
+class S3Section:
+    """Cloud object storage (ref: components/object_store s3.rs). When
+    ``bucket`` is set the engine stores SSTs/manifests (and the
+    object-store WAL) in S3 instead of the local disk; an optional
+    CRC-paged disk cache fronts reads (disk_cache.rs analog)."""
+
+    bucket: str = ""
+    endpoint: str = ""
+    region: str = "us-east-1"
+    access_key: str = ""
+    secret_key: str = ""
+    prefix: str = ""
+    disk_cache_dir: str = ""
+    disk_cache_bytes: int = 1 << 30
+    mem_cache_bytes: int = 256 << 20
+
+
+@dataclass
 class Config:
     server: ServerConfig = field(default_factory=ServerConfig)
     engine: EngineSection = field(default_factory=EngineSection)
     limits: LimitsConfig = field(default_factory=LimitsConfig)
     cluster: ClusterSection = field(default_factory=ClusterSection)
+    s3: S3Section = field(default_factory=S3Section)
 
     @staticmethod
     def load(path: Optional[str] = None) -> "Config":
@@ -104,6 +123,10 @@ _KNOWN = {
     },
     "limits": {"slow_threshold"},
     "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
+    "s3": {
+        "bucket", "endpoint", "region", "access_key", "secret_key", "prefix",
+        "disk_cache_dir", "disk_cache_bytes", "mem_cache_bytes",
+    },
 }
 
 
@@ -152,6 +175,17 @@ def _apply(cfg: Config, raw: dict) -> None:
     l = raw.get("limits", {})
     if "slow_threshold" in l:
         cfg.limits.slow_threshold_s = parse_duration_ms(l["slow_threshold"]) / 1000.0
+    s3 = raw.get("s3", {})
+    if s3:
+        for k in ("bucket", "endpoint", "region", "access_key", "secret_key",
+                  "prefix", "disk_cache_dir"):
+            if k in s3:
+                setattr(cfg.s3, k, str(s3[k]))
+        for k in ("disk_cache_bytes", "mem_cache_bytes"):
+            if k in s3:
+                setattr(cfg.s3, k, parse_size_bytes(s3[k]))
+        if not cfg.s3.bucket or not cfg.s3.endpoint:
+            raise ConfigError("[s3] requires both bucket and endpoint")
     c = raw.get("cluster", {})
     if c:
         cfg.cluster.enabled = True

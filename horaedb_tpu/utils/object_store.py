@@ -148,6 +148,199 @@ class LocalDiskStore(ObjectStore):
         return self._abs(path)
 
 
+class DiskCacheStore(ObjectStore):
+    """Paged on-disk read cache over a (remote) store
+    (ref: components/object_store/src/disk_cache.rs — page-granular
+    caching with CRC integrity, LRU eviction, and request dedup so a cold
+    page is fetched once even under concurrent readers).
+
+    ``get_range`` reads fetch whole aligned PAGES from the inner store and
+    serve slices from disk afterwards; ``get`` caches the whole object as
+    its pages. Each cache file is ``[u32 crc][payload]`` — a torn or
+    corrupted page re-fetches instead of serving garbage.
+    """
+
+    def __init__(
+        self,
+        inner: ObjectStore,
+        cache_dir: str,
+        capacity_bytes: int = 1 << 30,
+        page_size: int = 1 << 20,
+    ) -> None:
+        import zlib
+
+        self._zlib = zlib
+        self.inner = inner
+        self.cache_dir = os.path.abspath(cache_dir)
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.page_size = page_size
+        self.capacity_bytes = capacity_bytes
+        self._lock = threading.Lock()
+        self._lru: "OrderedDict[str, int]" = OrderedDict()  # cache file -> bytes
+        self._bytes = 0
+        self._inflight: dict[str, threading.Event] = {}
+        # object sizes cached too: a warm read must not pay a remote HEAD
+        self._sizes: dict[str, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self._load_index()
+
+    # ---- index -----------------------------------------------------------
+    def _load_index(self) -> None:
+        for name in sorted(os.listdir(self.cache_dir)):
+            p = os.path.join(self.cache_dir, name)
+            if name.endswith(".tmp"):
+                # torn write from a crash mid-_write_cached: reclaim now
+                try:
+                    os.remove(p)
+                except FileNotFoundError:
+                    pass
+                continue
+            if os.path.isfile(p):
+                size = os.path.getsize(p)
+                self._lru[name] = size
+                self._bytes += size
+
+    def _cache_name(self, path: str, page: int) -> str:
+        import hashlib
+
+        digest = hashlib.sha256(path.encode()).hexdigest()[:24]
+        return f"{digest}.{page:06d}"
+
+    # ---- page IO ---------------------------------------------------------
+    def _read_cached(self, name: str) -> Optional[bytes]:
+        p = os.path.join(self.cache_dir, name)
+        try:
+            with open(p, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return None
+        if len(raw) < 4:
+            return None
+        crc = int.from_bytes(raw[:4], "little")
+        payload = raw[4:]
+        if self._zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            # torn/corrupt page: drop it, caller re-fetches
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+            with self._lock:
+                size = self._lru.pop(name, 0)
+                self._bytes -= size
+            return None
+        with self._lock:
+            if name in self._lru:
+                self._lru.move_to_end(name)
+        return payload
+
+    def _write_cached(self, name: str, payload: bytes) -> None:
+        p = os.path.join(self.cache_dir, name)
+        tmp = p + ".tmp"
+        crc = (self._zlib.crc32(payload) & 0xFFFFFFFF).to_bytes(4, "little")
+        with open(tmp, "wb") as f:
+            f.write(crc + payload)
+        os.replace(tmp, p)
+        size = len(payload) + 4
+        evict = []
+        with self._lock:
+            self._lru[name] = size
+            self._lru.move_to_end(name)
+            self._bytes += size
+            while self._bytes > self.capacity_bytes and len(self._lru) > 1:
+                evicted, esize = self._lru.popitem(last=False)
+                self._bytes -= esize
+                evict.append(evicted)
+        for name_ in evict:
+            try:
+                os.remove(os.path.join(self.cache_dir, name_))
+            except FileNotFoundError:
+                pass
+
+    def _fetch_page(self, path: str, page: int, obj_size: int) -> bytes:
+        """One page, cached; concurrent requests for a cold page dedup.
+
+        Followers wait on the current leader's event and retry the cache;
+        a follower whose leader failed loops back and may become the NEXT
+        leader — it never touches an event it didn't register."""
+        name = self._cache_name(path, page)
+        while True:
+            cached = self._read_cached(name)
+            if cached is not None:
+                self.hits += 1
+                return cached
+            with self._lock:
+                ev = self._inflight.get(name)
+                if ev is None:
+                    my_event = threading.Event()
+                    self._inflight[name] = my_event
+                    break  # we are the leader
+            ev.wait(timeout=60)
+        try:
+            self.misses += 1
+            start = page * self.page_size
+            end = min(start + self.page_size, obj_size)
+            payload = self.inner.get_range(path, start, end)
+            self._write_cached(name, payload)
+            return payload
+        finally:
+            with self._lock:
+                if self._inflight.get(name) is my_event:
+                    del self._inflight[name]
+            my_event.set()
+
+    # ---- ObjectStore -----------------------------------------------------
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        size = self.head(path)
+        end = min(end, size)
+        if start >= end:
+            return b""
+        first, last = start // self.page_size, (end - 1) // self.page_size
+        parts = [self._fetch_page(path, p, size) for p in range(first, last + 1)]
+        blob = b"".join(parts)
+        base = first * self.page_size
+        return blob[start - base : end - base]
+
+    def get(self, path: str) -> bytes:
+        return self.get_range(path, 0, self.head(path))
+
+    def head(self, path: str) -> int:
+        with self._lock:
+            size = self._sizes.get(path)
+        if size is not None:
+            return size
+        size = self.inner.head(path)
+        with self._lock:
+            self._sizes[path] = size
+        return size
+
+    def put(self, path: str, data: bytes) -> None:
+        self.inner.put(path, data)
+        self._invalidate(path)
+
+    def delete(self, path: str) -> None:
+        self.inner.delete(path)
+        self._invalidate(path)
+
+    def _invalidate(self, path: str) -> None:
+        import hashlib
+
+        digest = hashlib.sha256(path.encode()).hexdigest()[:24]
+        with self._lock:
+            self._sizes.pop(path, None)
+            stale = [n for n in self._lru if n.startswith(digest + ".")]
+            for n in stale:
+                self._bytes -= self._lru.pop(n)
+        for n in stale:
+            try:
+                os.remove(os.path.join(self.cache_dir, n))
+            except FileNotFoundError:
+                pass
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        return self.inner.list(prefix)
+
+
 class MemCacheStore(ObjectStore):
     """Read-through whole-object LRU cache over another store.
 

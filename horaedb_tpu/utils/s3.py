@@ -1,0 +1,255 @@
+"""S3-compatible object store backend
+(ref: components/object_store/src/{s3.rs,multipart.rs} — the reference's
+cloud backends via the Rust object_store crate; this is a from-scratch
+AWS Signature V4 client over urllib, so any S3-compatible service (AWS,
+MinIO, OSS S3 gateway) works with zero extra dependencies).
+
+Supports: GET (+ Range), PUT, HEAD, DELETE, ListObjectsV2 with
+continuation, and multipart upload above a size threshold (multipart.rs
+analog — SSTs larger than one part stream up in chunks).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import urllib.error
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+from typing import Iterator, Optional
+
+from .object_store import ObjectStore
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+def _sign(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sigv4_headers(
+    method: str,
+    url: str,
+    region: str,
+    access_key: str,
+    secret_key: str,
+    payload_sha256: str,
+    amz_date: Optional[str] = None,
+    extra_headers: Optional[dict] = None,
+) -> dict:
+    """AWS Signature Version 4 headers for one request (public algorithm).
+
+    Exposed as a function (not a method) so the test fake can RE-COMPUTE
+    the expected signature — the round trip proves the signing, not just
+    the plumbing."""
+    parsed = urllib.parse.urlsplit(url)
+    if amz_date is None:
+        amz_date = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    date = amz_date[:8]
+    host = parsed.netloc
+    headers = {"host": host, "x-amz-content-sha256": payload_sha256, "x-amz-date": amz_date}
+    if extra_headers:
+        headers.update({k.lower(): v for k, v in extra_headers.items()})
+    signed_names = ";".join(sorted(headers))
+    canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
+    # canonical query: sorted by key, values URI-encoded
+    q = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='')}={urllib.parse.quote(v, safe='')}"
+        for k, v in sorted(q)
+    )
+    canonical = "\n".join(
+        [
+            method,
+            # S3 canonical URI = the (already percent-encoded) request
+            # path used ONCE — re-quoting would double-encode '%20' etc.
+            # and real services would reject the signature.
+            parsed.path or "/",
+            canonical_query,
+            canonical_headers,
+            signed_names,
+            payload_sha256,
+        ]
+    )
+    scope = f"{date}/{region}/s3/aws4_request"
+    to_sign = "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canonical.encode()).hexdigest(),
+        ]
+    )
+    k = _sign(("AWS4" + secret_key).encode(), date)
+    k = _sign(k, region)
+    k = _sign(k, "s3")
+    k = _sign(k, "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+    out = dict(headers)
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_names}, Signature={signature}"
+    )
+    return out
+
+
+class S3Error(IOError):
+    pass
+
+
+class S3Store(ObjectStore):
+    def __init__(
+        self,
+        bucket: str,
+        endpoint: str,  # e.g. "http://127.0.0.1:9000" or "https://s3.us-east-1.amazonaws.com"
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+        prefix: str = "",
+        multipart_threshold: int = 64 << 20,
+        multipart_part_size: int = 16 << 20,
+        timeout_s: float = 30.0,
+    ) -> None:
+        self.bucket = bucket
+        self.endpoint = endpoint.rstrip("/")
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.prefix = prefix.strip("/")
+        self.multipart_threshold = multipart_threshold
+        self.multipart_part_size = multipart_part_size
+        self.timeout_s = timeout_s
+
+    # ---- plumbing --------------------------------------------------------
+    def _key(self, path: str) -> str:
+        return f"{self.prefix}/{path}" if self.prefix else path
+
+    def _url(self, key: str, query: str = "") -> str:
+        q = f"?{query}" if query else ""
+        return f"{self.endpoint}/{self.bucket}/{urllib.parse.quote(key)}{q}"
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        body: bytes = b"",
+        extra_headers: Optional[dict] = None,
+    ):
+        payload_hash = hashlib.sha256(body).hexdigest() if body else _EMPTY_SHA256
+        headers = sigv4_headers(
+            method, url, self.region, self.access_key, self.secret_key,
+            payload_hash, extra_headers=extra_headers,
+        )
+        req = urllib.request.Request(url, data=body or None, headers=headers, method=method)
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    # ---- ObjectStore -----------------------------------------------------
+    def put(self, path: str, data: bytes) -> None:
+        key = self._key(path)
+        if len(data) > self.multipart_threshold:
+            self._multipart_put(key, data)
+            return
+        with self._request("PUT", self._url(key), body=data):
+            pass
+
+    def _multipart_put(self, key: str, data: bytes) -> None:
+        """Multipart upload (ref: multipart.rs) — big SSTs go up in parts."""
+        with self._request("POST", self._url(key, "uploads=")) as r:
+            upload_id = ET.fromstring(r.read()).findtext(
+                "{*}UploadId"
+            ) or ""
+        if not upload_id:
+            raise S3Error(f"multipart initiate failed for {key}")
+        etags = []
+        try:
+            part = 1
+            for off in range(0, len(data), self.multipart_part_size):
+                chunk = data[off : off + self.multipart_part_size]
+                q = f"partNumber={part}&uploadId={urllib.parse.quote(upload_id)}"
+                with self._request("PUT", self._url(key, q), body=chunk) as r:
+                    etags.append((part, r.headers.get("ETag", "")))
+                part += 1
+            parts_xml = "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+                for n, e in etags
+            )
+            body = f"<CompleteMultipartUpload>{parts_xml}</CompleteMultipartUpload>".encode()
+            q = f"uploadId={urllib.parse.quote(upload_id)}"
+            with self._request("POST", self._url(key, q), body=body):
+                pass
+        except Exception:
+            try:
+                q = f"uploadId={urllib.parse.quote(upload_id)}"
+                with self._request("DELETE", self._url(key, q)):
+                    pass
+            except Exception:
+                pass
+            raise
+
+    def get(self, path: str) -> bytes:
+        try:
+            with self._request("GET", self._url(self._key(path))) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path) from None
+            raise S3Error(f"GET {path}: {e}") from None
+
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        try:
+            with self._request(
+                "GET",
+                self._url(self._key(path)),
+                extra_headers={"range": f"bytes={start}-{end - 1}"},
+            ) as r:
+                return r.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path) from None
+            raise S3Error(f"GET range {path}: {e}") from None
+
+    def head(self, path: str) -> int:
+        try:
+            with self._request("HEAD", self._url(self._key(path))) as r:
+                return int(r.headers.get("Content-Length", 0))
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path) from None
+            raise S3Error(f"HEAD {path}: {e}") from None
+
+    def delete(self, path: str) -> None:
+        try:
+            with self._request("DELETE", self._url(self._key(path))):
+                pass
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise S3Error(f"DELETE {path}: {e}") from None
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        full_prefix = self._key(prefix)
+        token: Optional[str] = None
+        out = []
+        while True:
+            q = "list-type=2&prefix=" + urllib.parse.quote(full_prefix, safe="")
+            if token:
+                q += "&continuation-token=" + urllib.parse.quote(token, safe="")
+            url = f"{self.endpoint}/{self.bucket}?{q}"
+            try:
+                with self._request("GET", url) as r:
+                    root = ET.fromstring(r.read())
+            except urllib.error.HTTPError as e:
+                raise S3Error(f"LIST {prefix}: {e}") from None
+            for c in root.findall("{*}Contents"):
+                key = c.findtext("{*}Key") or ""
+                if self.prefix and key.startswith(self.prefix + "/"):
+                    key = key[len(self.prefix) + 1 :]
+                out.append(key)
+            if (root.findtext("{*}IsTruncated") or "").lower() == "true":
+                token = root.findtext("{*}NextContinuationToken")
+                if not token:
+                    break
+            else:
+                break
+        return iter(sorted(out))
