@@ -12,16 +12,21 @@ Supported grammar (the TSBS/dashboard workhorse subset):
               | selector
     agg      := sum | avg | min | max | count
     func     := rate | increase | avg_over_time | min_over_time | max_over_time
-    selector := metric '{' matcher (',' matcher)* '}' [ '[' duration ']' ]
-              | metric [ '[' duration ']' ]
-    matcher  := label ('=' | '!=') 'value'
+    selector := metric [ '{' matcher (',' matcher)* '}' ]
+                [ '[' duration ']' ] [ 'offset' duration ]
+    matcher  := label ('=' | '!=' | '=~' | '!~') 'value'
 
 Semantics notes:
 - the metric name maps to a table; its single DOUBLE field (or a column
   literally named ``value``) is the sample value, the timestamp key is
   the sample time — exactly the shape OpenTSDB/Influx ingestion creates;
-- ``rate``/``increase`` approximate Prometheus by (max-min) per step
-  bucket (no counter-reset correction) — documented divergence;
+- equality matchers push into the scan; regex matchers (fully anchored,
+  like prom) post-filter the series set host-side;
+- ``rate``/``increase`` fold consecutive raw samples with counter-reset
+  correction (a drop restarts the counter near zero), each delta
+  attributed to the later sample's step bucket;
+- ``offset`` evaluates a window shifted into the past and stamps results
+  back at the requested times;
 - range queries evaluate per aligned ``step`` bucket; instant queries use
   a 5m lookback window.
 """
@@ -50,6 +55,7 @@ class PromQuery:
     func: Optional[str] = None  # RANGE_FUNCS
     agg: Optional[str] = None  # AGG_FUNCS
     by_labels: Optional[list[str]] = None  # None = per-series
+    offset_ms: int = 0  # `offset 1h` shifts the evaluated window back
 
 
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:.]*"
@@ -154,12 +160,18 @@ class _Parser:
             while True:
                 label = self._ident()
                 kind, op = self.next()
-                if op not in ("=", "!="):
-                    raise PromQLError(f"unsupported matcher op {op!r} (=~/!~ not supported)")
+                if op not in ("=", "!=", "=~", "!~"):
+                    raise PromQLError(f"unsupported matcher op {op!r}")
                 skind, sval = self.next()
                 if skind != "string":
                     raise PromQLError(f"matcher value must be quoted: {sval!r}")
-                pq.matchers.append((label, op, sval[1:-1]))
+                value = sval[1:-1]
+                if op in ("=~", "!~"):
+                    try:
+                        re.compile(value)
+                    except re.error as e:
+                        raise PromQLError(f"bad regex {value!r}: {e}")
+                pq.matchers.append((label, op, value))
                 kind, tok = self.next()
                 if tok == "}":
                     break
@@ -172,6 +184,12 @@ class _Parser:
                 raise PromQLError(f"expected a duration like 5m, found {dur!r}")
             pq.range_ms = parse_duration_ms(dur)
             self.expect("]")
+        if self.peek() == ("name", "offset"):
+            self.next()
+            kind, dur = self.next()
+            if kind != "dur":
+                raise PromQLError(f"offset expects a duration, found {dur!r}")
+            pq.offset_ms = parse_duration_ms(dur)
         return pq
 
 
@@ -227,6 +245,14 @@ def evaluate_range(
     for label, _, _ in pq.matchers:
         if label not in tag_names:
             raise PromQLError(f"unknown label {label!r} on metric {pq.metric!r}")
+    # offset: evaluate a window shifted into the past, then stamp results
+    # back at the requested times (prom's `offset` modifier).
+    start_ms -= pq.offset_ms
+    end_ms -= pq.offset_ms
+    # Equality matchers push into the scan; regex matchers post-filter the
+    # (small) series set host-side.
+    push_matchers = [m for m in pq.matchers if m[1] in ("=", "!=")]
+    regex_matchers = [m for m in pq.matchers if m[1] in ("=~", "!~")]
     # Stage 1 (SQL, device kernels): per-SERIES temporal aggregation per
     # step bucket — always at full tag granularity, exactly prom's model.
     # Stage 2 (host, tiny): cross-series combine onto the by-labels.
@@ -244,9 +270,7 @@ def evaluate_range(
     # Inner temporal aggregation per step bucket.
     func = pq.func
     agg = pq.agg
-    if func in ("rate", "increase"):
-        sel = f"min({_q(value_col)}) AS lo, max({_q(value_col)}) AS hi"
-    elif func == "min_over_time":
+    if func == "min_over_time":
         sel = f"min({_q(value_col)}) AS v"
     elif func == "max_over_time":
         sel = f"max({_q(value_col)}) AS v"
@@ -255,33 +279,42 @@ def evaluate_range(
 
     where = [f"{_q(schema.timestamp_name)} >= {start_ms}",
              f"{_q(schema.timestamp_name)} <= {end_ms}"]
-    for label, op, val in pq.matchers:
+    for label, op, val in push_matchers:
         sval = str(val).replace("'", "''")  # keep in sync w/ sql_str_literal
         where.append(f"{_q(label)} {'=' if op == '=' else '!='} '{sval}'")
 
-    keys = [f"time_bucket({_q(schema.timestamp_name)}, '{step_ms}ms')"] + [
-        _q(l) for l in group_labels
-    ]
-    label_sel = ", ".join(_q(l) for l in group_labels)
-    sql = (
-        f"SELECT {keys[0]} AS bucket"
-        + (f", {label_sel}" if group_labels else "")
-        + f", {sel} FROM {_q(pq.metric)} WHERE {' AND '.join(where)} "
-        + f"GROUP BY {', '.join(keys)}"
-    )
-    rows = conn.execute(sql).to_pylist()
+    if func in ("rate", "increase"):
+        # Counter semantics need consecutive samples (reset detection) —
+        # scan raw rows and fold host-side (samples per window are small
+        # next to the table; the fused path keeps serving the rest).
+        per_series = _counter_series(
+            conn, pq, where, schema, value_col, group_labels, step_ms, func
+        )
+    else:
+        keys = [f"time_bucket({_q(schema.timestamp_name)}, '{step_ms}ms')"] + [
+            _q(l) for l in group_labels
+        ]
+        label_sel = ", ".join(_q(l) for l in group_labels)
+        sql = (
+            f"SELECT {keys[0]} AS bucket"
+            + (f", {label_sel}" if group_labels else "")
+            + f", {sel} FROM {_q(pq.metric)} WHERE {' AND '.join(where)} "
+            + f"GROUP BY {', '.join(keys)}"
+        )
+        rows = conn.execute(sql).to_pylist()
 
-    # Stage 1 results: per-series value per bucket.
-    per_series: dict[tuple, dict[int, float]] = {}
-    for r in rows:
-        key = tuple((l, r[l]) for l in group_labels)
-        bucket = r["bucket"]
-        if func in ("rate", "increase"):
-            delta = r["hi"] - r["lo"]
-            v = delta / (step_ms / 1000.0) if func == "rate" else delta
-        else:
-            v = r["v"]
-        per_series.setdefault(key, {})[bucket] = v
+        # Stage 1 results: per-series value per bucket.
+        per_series = {}
+        for r in rows:
+            key = tuple((l, r[l]) for l in group_labels)
+            per_series.setdefault(key, {})[r["bucket"]] = r["v"]
+
+    if regex_matchers:
+        per_series = {
+            key: pts
+            for key, pts in per_series.items()
+            if _regex_match(dict(key), regex_matchers)
+        }
 
     # Stage 2: combine series sharing the same by-label subset.
     if agg is None and pq.by_labels is None:
@@ -312,11 +345,72 @@ def evaluate_range(
                 "metric": {"__name__": pq.metric, **{l: v for l, v in key}},
                 "values": [
                     # repr = shortest round-trip form (full precision,
-                    # like prom's Go 'g' formatting)
-                    [b / 1000.0, repr(float(points[b]))] for b in sorted(points)
+                    # like prom's Go 'g' formatting); offset stamps the
+                    # shifted window back at the requested times
+                    [(b + pq.offset_ms) / 1000.0, repr(float(points[b]))]
+                    for b in sorted(points)
                 ],
             }
         )
+    return out
+
+
+def _regex_match(labels: dict, matchers: list[tuple[str, str, str]]) -> bool:
+    """Prom regex matchers are fully anchored."""
+    for label, op, pattern in matchers:
+        current = str(labels.get(label, ""))
+        hit = re.fullmatch(pattern, current) is not None
+        if op == "=~" and not hit:
+            return False
+        if op == "!~" and hit:
+            return False
+    return True
+
+
+def _counter_series(
+    conn, pq: PromQuery, where: list, schema, value_col: str,
+    group_labels: list, step_ms: int, func: str,
+) -> dict:
+    """Reset-aware rate/increase: fold raw samples per series.
+
+    Prom counters only move up; a drop means the process restarted and
+    the counter began again near zero. increase = Σ over consecutive
+    in-bucket samples of (vᵢ - vᵢ₋₁), with a reset contributing vᵢ (the
+    counter re-accumulated from 0). rate = increase / step_seconds —
+    min/max-based deltas would silently UNDERCOUNT across resets.
+    """
+    label_sel = ", ".join(_q(l) for l in group_labels)
+    sql = (
+        f"SELECT {label_sel + ', ' if group_labels else ''}"
+        f"{_q(schema.timestamp_name)} AS __ts, {_q(value_col)} AS __v "
+        f"FROM {_q(pq.metric)} WHERE {' AND '.join(where)}"
+    )
+    rows = conn.execute(sql).to_pylist()
+    samples: dict[tuple, list] = {}
+    for r in rows:
+        key = tuple((l, r[l]) for l in group_labels)
+        samples.setdefault(key, []).append((r["__ts"], r["__v"]))
+    out: dict[tuple, dict[int, float]] = {}
+    for key, pts in samples.items():
+        pts.sort()
+        buckets: dict[int, float] = {}
+        prev_v = None
+        for ts, v in pts:
+            if prev_v is not None:
+                delta = v - prev_v
+                if delta < 0:
+                    delta = v  # counter reset: it restarted from ~0
+                # every consecutive-sample delta counts ONCE, attributed
+                # to the later sample's bucket — a delta straddling a
+                # bucket boundary must not vanish (scrape intervals
+                # rarely align with steps). A single-sample bucket emits
+                # no point, like prom (two samples make an increase).
+                b = (ts // step_ms) * step_ms
+                buckets[b] = buckets.get(b, 0.0) + delta
+            prev_v = v
+        if func == "rate":
+            buckets = {b: d / (step_ms / 1000.0) for b, d in buckets.items()}
+        out[key] = buckets
     return out
 
 

@@ -41,7 +41,6 @@ class TestParser:
         "bad",
         [
             "rate(cpu)",  # range required
-            'cpu{host=~"h.*"}',  # regex matchers unsupported
             "sum(avg(cpu))",  # nested agg
             "cpu{host=h1}",  # unquoted value
             "cpu} garbage",
@@ -96,15 +95,18 @@ class TestEvaluation:
         assert float(out[0]["values"][0][1]) == pytest.approx((10 + 20 + 40) / 3, rel=1e-4)
 
     def test_increase_and_rate(self, db):
-        # per-series increase within each 2-minute bucket: values rise by 1
+        # Every consecutive-sample delta counts once, attributed to the
+        # later sample's bucket: samples rise by 1/min, so bucket 0 holds
+        # one intra-bucket delta and bucket 2m holds the boundary delta
+        # plus its own intra-bucket delta.
         out = evaluate_range(
             db, parse_promql('increase(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
         )
-        assert [v for _, v in out[0]["values"]] == ["1.0", "1.0"]
+        assert [v for _, v in out[0]["values"]] == ["1.0", "2.0"]
         out = evaluate_range(
             db, parse_promql('rate(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
         )
-        assert [v for _, v in out[0]["values"]] == [repr(1/120), repr(1/120)]
+        assert [v for _, v in out[0]["values"]] == [repr(1/120), repr(2/120)]
 
     def test_instant_vector(self, db):
         out = evaluate_instant(db, parse_promql('cpu{host="h2"}'), 4 * MIN)
@@ -160,3 +162,82 @@ class TestHttpEndpoint:
                 conn.close()
 
         asyncio.run(runner())
+
+
+class TestRound2Features:
+    """Regex matchers, offset, counter-reset-aware rate."""
+
+    def _seed(self, db, rows):
+        db.execute(
+            "CREATE TABLE ctr (host string TAG, value double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        vals = ", ".join(f"('{h}', {v}, {t})" for h, v, t in rows)
+        db.execute(f"INSERT INTO ctr (host, value, ts) VALUES {vals}")
+
+    def test_regex_matcher_filters_series(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+
+        self._seed(db, [("web1", 1.0, 1000), ("web2", 2.0, 1000), ("db1", 9.0, 1000)])
+        pq = parse_promql('ctr{host=~"web.*"}')
+        out = evaluate_range(db, pq, 0, 10_000, 10_000)
+        hosts = sorted(s["metric"]["host"] for s in out)
+        assert hosts == ["web1", "web2"]
+        pq = parse_promql('ctr{host!~"web.*"}')
+        out = evaluate_range(db, pq, 0, 10_000, 10_000)
+        assert [s["metric"]["host"] for s in out] == ["db1"]
+
+    def test_regex_is_anchored(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+
+        self._seed(db, [("web1", 1.0, 1000), ("myweb1x", 2.0, 1000)])
+        pq = parse_promql('ctr{host=~"web."}')  # anchored: matches web1 only
+        out = evaluate_range(db, pq, 0, 10_000, 10_000)
+        assert [s["metric"]["host"] for s in out] == ["web1"]
+
+    def test_offset_shifts_window(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+
+        # old sample at t=1000, new at t=61000
+        self._seed(db, [("a", 5.0, 1000), ("a", 50.0, 61_000)])
+        pq = parse_promql("ctr offset 1m")
+        out = evaluate_range(db, pq, 60_000, 70_000, 10_000)
+        # evaluates [0, 10s] (shifted back 1m) -> sees 5.0, stamped at +1m
+        assert out and out[0]["values"][0][1] == "5.0"
+        assert out[0]["values"][0][0] >= 60.0
+
+    def test_rate_handles_counter_reset(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+
+        # counter: 10, 20, reset to 2, then 5 — all within one bucket
+        self._seed(
+            db,
+            [("a", 10.0, 1000), ("a", 20.0, 2000), ("a", 2.0, 3000), ("a", 5.0, 4000)],
+        )
+        pq = parse_promql("increase(ctr[1m])")
+        out = evaluate_range(db, pq, 0, 59_000, 60_000)
+        # increase = (20-10) + 2 (reset restart) + (5-2) = 15
+        assert out[0]["values"][0][1] == "15.0"
+        pq = parse_promql("rate(ctr[1m])")
+        out = evaluate_range(db, pq, 0, 59_000, 60_000)
+        assert out[0]["values"][0][1] == repr(15.0 / 60.0)
+
+    def test_monotonic_rate_matches_delta(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+
+        self._seed(db, [("a", 10.0, 1000), ("a", 40.0, 31_000)])
+        pq = parse_promql("increase(ctr[1m])")
+        out = evaluate_range(db, pq, 0, 59_000, 60_000)
+        assert out[0]["values"][0][1] == "30.0"
+
+    def test_increase_across_bucket_boundary(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+
+        # delta straddles the 60s bucket boundary: counted in the later
+        # bucket, never dropped (30s scrape vs 60s step shape)
+        self._seed(db, [("a", 10.0, 55_000), ("a", 20.0, 65_000)])
+        pq = parse_promql("increase(ctr[1m])")
+        out = evaluate_range(db, pq, 0, 119_000, 60_000)
+        points = {v[0]: v[1] for v in out[0]["values"]}
+        assert points.get(60.0) == "10.0", points
+        assert 0.0 not in points  # single-sample bucket emits no point
