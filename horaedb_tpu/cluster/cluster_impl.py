@@ -207,6 +207,28 @@ class ClusterImpl:
             if self.conn.catalog.exists(name):
                 self.conn.catalog.drop_table(name, if_exists=True)
 
+    def debug_shard_info(self) -> list[dict]:
+        """Lock-consistent snapshot of this node's shard set for the
+        /debug/shards surface (ref: /debug/shards, http.rs:587)."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for shard in self.shard_set.all_shards():
+                deadline = self._lease_deadline.get(shard.shard_id, 0.0)
+                out.append(
+                    {
+                        "shard_id": shard.shard_id,
+                        "state": shard.state.value,
+                        "version": shard.version,
+                        "lease_remaining_s": round(max(0.0, deadline - now), 2),
+                        "tables": sorted(
+                            t for t, sid in self._table_shard.items()
+                            if sid == shard.shard_id
+                        ),
+                    }
+                )
+        return out
+
     # ---- serving checks --------------------------------------------------
     def owns_table(self, table: str) -> bool:
         with self._lock:

@@ -25,6 +25,9 @@ class SstMeta:
     # Per-column (min, max) for filter pruning at the file level; row-group
     # granularity pruning uses Parquet's own statistics.
     column_ranges: dict[str, tuple[Any, Any]]
+    # Per row group, per tag column: base64 Bloom filter over the group's
+    # values (ref: the xor filters of row_group_pruner.rs:283-288).
+    row_group_filters: list = None
 
     def to_dict(self) -> dict:
         return {
@@ -35,6 +38,7 @@ class SstMeta:
             "size_bytes": self.size_bytes,
             "schema_version": self.schema_version,
             "column_ranges": {k: list(v) for k, v in self.column_ranges.items()},
+            "row_group_filters": self.row_group_filters or [],
         }
 
     @staticmethod
@@ -47,6 +51,7 @@ class SstMeta:
             size_bytes=d["size_bytes"],
             schema_version=d["schema_version"],
             column_ranges={k: (v[0], v[1]) for k, v in d["column_ranges"].items()},
+            row_group_filters=d.get("row_group_filters") or [],
         )
 
 

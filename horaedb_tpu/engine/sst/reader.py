@@ -64,11 +64,52 @@ class SstReader:
         pf = self._parquet_file()
         md = pf.metadata
         ts_name = schema.timestamp_name
+        filters = self._group_filters(predicate)
         keep: list[int] = []
         for rg in range(md.num_row_groups):
-            if self._row_group_may_match(md.row_group(rg), ts_name, predicate):
+            if self._row_group_may_match(
+                md.row_group(rg), ts_name, predicate
+            ) and self._bloom_may_match(filters, rg, predicate):
                 keep.append(rg)
         return keep
+
+    def _group_filters(self, predicate: Predicate) -> list[dict]:
+        """Decoded per-row-group tag Bloom filters, when the predicate has
+        EQ/IN filters that could consult them (ref: the xor filters of
+        row_group_pruner.rs:283-288 — min/max can't prune a
+        high-cardinality tag whose values span every group)."""
+        from ...table_engine.predicate import FilterOp
+
+        if not any(f.op in (FilterOp.EQ, FilterOp.IN) for f in predicate.filters):
+            return []
+        from .filters import decode_filters
+
+        try:
+            return decode_filters(self.read_meta().row_group_filters)
+        except (ValueError, KeyError):
+            return []
+
+    def _bloom_may_match(
+        self, filters: list[dict], rg: int, predicate: Predicate
+    ) -> bool:
+        if rg >= len(filters):
+            return True
+        from ...table_engine.predicate import FilterOp
+
+        from .filters import might_contain
+
+        group = filters[rg]
+        for f in predicate.filters:
+            filt = group.get(f.column)
+            if filt is None:
+                continue
+            if f.op is FilterOp.EQ:
+                if not might_contain(filt, str(f.value)):
+                    return False
+            elif f.op is FilterOp.IN:
+                if not any(might_contain(filt, str(v)) for v in f.value):
+                    return False
+        return True
 
     def _row_group_may_match(self, rg_meta, ts_name: str, predicate: Predicate) -> bool:
         stats_by_col = {}

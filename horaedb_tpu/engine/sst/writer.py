@@ -63,6 +63,9 @@ class SstWriter:
             size_bytes=0,  # patched below once serialized
             schema_version=schema.version,
             column_ranges=column_ranges,
+            row_group_filters=_row_group_filters(
+                data, self.options.num_rows_per_row_group
+            ),
         )
         existing = table.schema.metadata or {}
         table = table.replace_schema_metadata(
@@ -88,6 +91,7 @@ class SstWriter:
             size_bytes=len(raw),
             schema_version=meta.schema_version,
             column_ranges=meta.column_ranges,
+            row_group_filters=meta.row_group_filters,
         )
 
 
@@ -122,3 +126,29 @@ def _column_ranges(data: RowGroup) -> dict:
         except (TypeError, ValueError):
             continue
     return out
+
+
+def _row_group_filters(data: RowGroup, rows_per_group: int) -> list:
+    """Bloom filter per (row group, tag column) for point-lookup pruning
+    (ref: writer.rs row-group xor filters). Tag columns only: numeric
+    fields prune fine via min/max stats."""
+    from ...common_types.dict_column import as_values
+    from .filters import build_filter, encode_filters
+
+    schema = data.schema
+    tag_cols = [schema.columns[i].name for i in schema.tag_indexes]
+    if not tag_cols or len(data) == 0:
+        return []
+    decoded = {
+        col: (as_values(data.columns[col]), data.valid_mask(col))
+        for col in tag_cols
+    }
+    groups: list[dict] = []
+    for start in range(0, len(data), rows_per_group):
+        end = min(start + rows_per_group, len(data))
+        entry = {}
+        for col, (vals, valid) in decoded.items():
+            window = vals[start:end][valid[start:end]]
+            entry[col] = build_filter(str(v) for v in window)
+        groups.append(entry)
+    return encode_filters(groups)

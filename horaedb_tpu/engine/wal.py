@@ -60,6 +60,10 @@ class WalManager(ABC):
     def close(self) -> None:
         pass
 
+    def stats(self) -> dict:
+        """Introspection for /debug/wal_stats (ref: http.rs:587-613)."""
+        return {"backend": type(self).__name__}
+
 
 def _encode_record(seq: int, rows: RowGroup) -> bytes:
     batch = rows.to_arrow()
@@ -191,6 +195,21 @@ class LocalDiskWal(WalManager):
                 except FileNotFoundError:
                     pass
 
+    def stats(self) -> dict:
+        tables = {}
+        for name in sorted(os.listdir(self.root)):
+            if name.endswith(".wal"):
+                tid = name[:-4]
+                try:  # a concurrent flush/drop may remove the log mid-walk
+                    size = os.path.getsize(os.path.join(self.root, name))
+                except FileNotFoundError:
+                    continue
+                tables[tid] = {
+                    "log_bytes": size,
+                    "flushed_seq": self._read_flushed(int(tid)),
+                }
+        return {"backend": "LocalDiskWal", "root": self.root, "tables": tables}
+
     def close(self) -> None:
         with self._guard:
             for f in self._files.values():
@@ -292,6 +311,22 @@ class ObjectStoreWal(WalManager):
                     self.store.delete(path)
                 except FileNotFoundError:
                     pass
+
+    def stats(self) -> dict:
+        tables: dict = {}
+        for path in self.store.list(self.prefix + "/"):
+            parts = path.split("/")
+            if len(parts) < 3:
+                continue
+            tid = parts[1]
+            entry = tables.setdefault(tid, {"pages": 0, "page_bytes": 0})
+            if path.endswith(".page"):
+                entry["pages"] += 1
+                try:
+                    entry["page_bytes"] += self.store.head(path)
+                except FileNotFoundError:
+                    pass
+        return {"backend": "ObjectStoreWal", "tables": tables}
 
 
 class NoopWal(WalManager):

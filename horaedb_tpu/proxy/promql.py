@@ -358,7 +358,7 @@ def evaluate_range(
 def _regex_match(labels: dict, matchers: list[tuple[str, str, str]]) -> bool:
     """Prom regex matchers are fully anchored."""
     for label, op, pattern in matchers:
-        current = str(labels.get(label, ""))
+        current = str(labels.get(label) or "")  # NULL tag == absent label
         hit = re.fullmatch(pattern, current) is not None
         if op == "=~" and not hit:
             return False

@@ -703,6 +703,26 @@ def create_app(
         logging.getLogger().setLevel("WARNING" if level == "WARN" else level)
         return web.json_response({"log_level": level})
 
+    async def debug_shards(request: web.Request) -> web.Response:
+        """This node's shard set (ref: /debug/shards, http.rs:587)."""
+        if cluster is None:
+            return web.json_response({"mode": "standalone", "shards": []})
+        return web.json_response(
+            {
+                "mode": "cluster",
+                "endpoint": cluster.self_endpoint,
+                "shards": cluster.debug_shard_info(),
+            }
+        )
+
+    async def debug_wal_stats(request: web.Request) -> web.Response:
+        """WAL backend introspection (ref: /debug/wal_stats, http.rs:587)."""
+        wal = conn.instance.wal
+        if wal is None:
+            return web.json_response({"backend": None})
+        out = await asyncio.get_running_loop().run_in_executor(None, wal.stats)
+        return web.json_response(out)
+
     async def debug_slow_log(request: web.Request) -> web.Response:
         """Recent slow queries (ref: the reference's slow-query log file)."""
         return web.Response(
@@ -828,6 +848,8 @@ def create_app(
     app.router.add_get("/debug/profile/heap/{seconds}", debug_profile_heap)
     app.router.add_put("/debug/log_level/{level}", debug_log_level)
     app.router.add_get("/debug/slow_log", debug_slow_log)
+    app.router.add_get("/debug/shards", debug_shards)
+    app.router.add_get("/debug/wal_stats", debug_wal_stats)
     app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)

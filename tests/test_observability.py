@@ -215,3 +215,37 @@ class TestSstMetadataTool:
         rc = main(["--brief", ssts[0]])
         assert rc == 0
         assert "rows=2" in capsys.readouterr().out
+
+
+class TestIntrospectionEndpoints:
+    def test_wal_stats_and_shards_standalone(self, tmp_path):
+        import asyncio
+
+        import horaedb_tpu
+        from aiohttp.test_utils import TestClient, TestServer
+        from horaedb_tpu.server import create_app
+
+        async def body():
+            conn = horaedb_tpu.connect(str(tmp_path / "d"))
+            conn.execute(
+                "CREATE TABLE iw (h string TAG, v double, ts timestamp NOT NULL, "
+                "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            conn.execute("INSERT INTO iw (h, v, ts) VALUES ('a', 1.0, 100)")
+            app = create_app(conn)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.get("/debug/wal_stats")
+                stats = await resp.json()
+                assert stats["backend"] == "LocalDiskWal"
+                assert any(
+                    t["log_bytes"] > 0 for t in stats["tables"].values()
+                )
+                resp = await client.get("/debug/shards")
+                assert (await resp.json())["mode"] == "standalone"
+            finally:
+                await client.close()
+                conn.close()
+
+        asyncio.run(body())

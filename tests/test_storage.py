@@ -255,3 +255,76 @@ class TestManifest:
         m2.append_edits([Flushed(30)])
         st = Manifest(store, 0, 1).load()
         assert st.flushed_sequence == 30
+
+
+class TestRowGroupBloomFilters:
+    """Tag point lookups prune row groups min/max stats can't
+    (ref: the xor filters of row_group_pruner.rs:283-288)."""
+
+    def test_filter_unit(self):
+        from horaedb_tpu.engine.sst.filters import build_filter, might_contain
+
+        f = build_filter([f"host_{i}" for i in range(100)])
+        assert all(might_contain(f, f"host_{i}") for i in range(100))
+        misses = sum(might_contain(f, f"absent_{i}") for i in range(1000))
+        assert misses < 60  # ~1-2% FP target, generous bound
+        assert might_contain(b"", "anything")  # absent filter never prunes
+
+    def test_prunes_groups_minmax_cannot(self, tmp_path):
+        import numpy as np
+
+        from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+        from horaedb_tpu.common_types.schema import compute_tsid
+        from horaedb_tpu.engine.sst.reader import SstReader
+        from horaedb_tpu.engine.sst.writer import SstWriter, WriteOptions
+        from horaedb_tpu.table_engine.predicate import ColumnFilter, FilterOp, Predicate
+        from horaedb_tpu.utils.object_store import MemoryStore
+
+        schema = Schema.build(
+            [
+                ColumnSchema("host", DatumKind.STRING, is_tag=True),
+                ColumnSchema("v", DatumKind.DOUBLE),
+                ColumnSchema("ts", DatumKind.TIMESTAMP),
+            ],
+            timestamp_column="ts",
+        )
+        # Each 64-row group holds DISJOINT hosts, but with names chosen so
+        # min/max ranges OVERLAP across groups (a_/z_ mix in every group).
+        n_groups_written = 4
+        rows_per = 64
+        hosts, ts = [], []
+        for g in range(n_groups_written):
+            for i in range(rows_per):
+                prefix = "a" if i % 2 == 0 else "z"
+                hosts.append(f"{prefix}_{g}_{i}")
+                ts.append(g * rows_per + i)
+        hosts = np.array(hosts, dtype=object)
+        data = RowGroup(
+            schema,
+            {
+                "tsid": compute_tsid([hosts]),
+                "host": hosts,
+                "v": np.arange(len(hosts), dtype=np.float64),
+                "ts": np.array(ts, dtype=np.int64),
+            },
+        )
+        store = MemoryStore()
+        writer = SstWriter(store, WriteOptions(num_rows_per_row_group=rows_per))
+        meta = writer.write("t.sst", 1, data, max_sequence=1)
+        assert len(meta.row_group_filters) == n_groups_written
+
+        reader = SstReader(store, "t.sst")
+        target = "a_2_10"  # lives only in group 2
+        pred = Predicate.all_time([ColumnFilter("host", FilterOp.EQ, target)])
+        keep = reader.prune_row_groups(schema, pred)
+        assert keep == [2], f"bloom should prune to group 2, kept {keep}"
+        out = reader.read(schema, pred)
+        assert target in set(out.column("host"))
+
+        # IN across two groups keeps both; absent value prunes everything
+        pred = Predicate.all_time(
+            [ColumnFilter("host", FilterOp.IN, ("a_0_0", "a_3_2"))]
+        )
+        assert set(reader.prune_row_groups(schema, pred)) == {0, 3}
+        pred = Predicate.all_time([ColumnFilter("host", FilterOp.EQ, "nope")])
+        assert reader.prune_row_groups(schema, pred) == []
