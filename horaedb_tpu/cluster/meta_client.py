@@ -27,17 +27,27 @@ class MetaClient:
         self.endpoints = list(endpoints)
         self.timeout_s = timeout_s
         self._preferred = 0
+        self._leader_hint: Optional[str] = None  # advertised leader (HA)
         self._lock = threading.Lock()
 
     # ---- transport ------------------------------------------------------
     def _call(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        from collections import deque
+
         last_err: Exception | None = None
         with self._lock:
             start = self._preferred
+            leader_hint = self._leader_hint
         n = len(self.endpoints)
-        for i in range(n):
-            idx = (start + i) % n
-            ep = self.endpoints[idx]
+        attempts = deque(self.endpoints[(start + i) % n] for i in range(n))
+        hinted: set[str] = set()
+        if leader_hint is not None and leader_hint not in self.endpoints:
+            # a previously learned leader (advertised name differs from
+            # the configured endpoints) goes FIRST — no follower hop tax
+            attempts.appendleft(leader_hint)
+            hinted.add(leader_hint)
+        while attempts:
+            ep = attempts.popleft()
             try:
                 data = json.dumps(payload).encode() if payload is not None else None
                 req = urllib.request.Request(
@@ -49,14 +59,28 @@ class MetaClient:
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     body = json.loads(resp.read().decode() or "{}")
                 with self._lock:
-                    self._preferred = idx
+                    if ep in self.endpoints:
+                        self._preferred = self.endpoints.index(ep)
+                        self._leader_hint = None
+                    else:
+                        self._leader_hint = ep  # remember the real leader
                 return body
             except urllib.error.HTTPError as e:
-                # Application-level error from a live meta: no failover.
                 try:
-                    detail = json.loads(e.read().decode()).get("error", str(e))
+                    detail_body = json.loads(e.read().decode())
+                    detail = detail_body.get("error", str(e))
                 except Exception:
-                    detail = str(e)
+                    detail_body, detail = {}, str(e)
+                if e.code == 421:
+                    # HA mode: a follower names the leader — try it NEXT
+                    # (ref: horaemeta non-leader forwarding); each hint is
+                    # followed at most once to bound the walk.
+                    leader = detail_body.get("leader")
+                    if leader and leader != ep and leader not in hinted:
+                        hinted.add(leader)
+                        attempts.appendleft(leader)
+                    last_err = MetaError(detail)
+                    continue
                 if e.code == 404:
                     raise MetaError(f"not found: {detail}") from e
                 raise MetaError(detail) from e

@@ -7,10 +7,11 @@ Pruning happens at two granularities before any data IO:
 2. row-group level — Parquet footer statistics (min/max per column),
    mirroring ``RowGroupPruner`` (row_group_pruner.rs:68-288).
 
-The reference's xor-filter per row group is replaced by dictionary-code
-pruning for tag columns (a tag EQ filter prunes a row group when the value
-falls outside the group's min/max) — exact filtering happens on device in
-the fused scan kernel anyway.
+Tag EQ/IN filters additionally consult per-row-group Bloom filters from
+the SST footer (sst/filters.py — the reference's xor-filter role,
+row_group_pruner.rs:283-288): min/max stats can't prune high-cardinality
+tags whose values span every group. Exact filtering still happens on
+device in the fused scan kernel.
 """
 
 from __future__ import annotations

@@ -139,16 +139,31 @@ def _row_group_filters(data: RowGroup, rows_per_group: int) -> list:
     tag_cols = [schema.columns[i].name for i in schema.tag_indexes]
     if not tag_cols or len(data) == 0:
         return []
-    decoded = {
-        col: (as_values(data.columns[col]), data.valid_mask(col))
-        for col in tag_cols
-    }
+    import numpy as np
+
+    from ...common_types.dict_column import DictColumn
+
+    prepared = {}
+    for col in tag_cols:
+        arr = data.columns[col]
+        valid = data.valid_mask(col)
+        if isinstance(arr, DictColumn):
+            # hash each window's UNIQUE vocabulary entries, not per row:
+            # per-group distinct tags are tiny next to the row count
+            prepared[col] = ("dict", arr.codes, np.asarray(arr.values, dtype=object), valid)
+        else:
+            prepared[col] = ("raw", as_values(arr), None, valid)
     groups: list[dict] = []
     for start in range(0, len(data), rows_per_group):
         end = min(start + rows_per_group, len(data))
         entry = {}
-        for col, (vals, valid) in decoded.items():
-            window = vals[start:end][valid[start:end]]
-            entry[col] = build_filter(str(v) for v in window)
+        for col, (kind, vals, vocab, valid) in prepared.items():
+            win_valid = valid[start:end]
+            if kind == "dict":
+                codes = np.unique(vals[start:end][win_valid])
+                uniques = vocab[codes]
+            else:
+                uniques = np.unique(vals[start:end][win_valid])
+            entry[col] = build_filter(str(v) for v in uniques)
         groups.append(entry)
     return encode_filters(groups)

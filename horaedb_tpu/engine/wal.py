@@ -314,19 +314,15 @@ class ObjectStoreWal(WalManager):
 
     def stats(self) -> dict:
         tables: dict = {}
+        plen = len(self.prefix) + 1  # table id is the segment AFTER prefix
         for path in self.store.list(self.prefix + "/"):
-            parts = path.split("/")
-            if len(parts) < 3:
+            if not path.endswith(".page"):
                 continue
-            tid = parts[1]
-            entry = tables.setdefault(tid, {"pages": 0, "page_bytes": 0})
-            if path.endswith(".page"):
-                entry["pages"] += 1
-                try:
-                    entry["page_bytes"] += self.store.head(path)
-                except FileNotFoundError:
-                    pass
-        return {"backend": "ObjectStoreWal", "tables": tables}
+            rel = path[plen:]
+            tid = rel.split("/", 1)[0]
+            entry = tables.setdefault(tid, {"pages": 0})
+            entry["pages"] += 1
+        return {"backend": "ObjectStoreWal", "prefix": self.prefix, "tables": tables}
 
 
 class NoopWal(WalManager):

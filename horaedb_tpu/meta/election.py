@@ -1,0 +1,148 @@
+"""Meta leader election over shared storage
+(ref: horaemeta/server/member/member.go:41-283 — CampaignAndKeepLeader
+over an etcd lease; non-leaders forward RPCs to the leader,
+service/grpc/forward.go).
+
+Without etcd in the image, election runs over a LOCK FILE in a directory
+every meta can reach (the same shared disk/bucket the cluster already
+relies on): the file holds (leader endpoint, expiry); acquisition is an
+atomic tmp+rename claiming an expired or absent lock, followed by a
+confirmation re-read after a short settle delay so two simultaneous
+claimants cannot both believe they won. Renewal rewrites the expiry
+before it lapses. The primitive is deliberately etcd-shaped — a real
+etcd lease can replace FileLease behind the same three methods.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from typing import Optional
+
+
+class FileLease:
+    def __init__(self, path: str, self_endpoint: str, ttl_s: float = 10.0) -> None:
+        self.path = path
+        self.self_endpoint = self_endpoint
+        self.ttl_s = ttl_s
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    # ---- file ops --------------------------------------------------------
+    def _read(self) -> Optional[dict]:
+        try:
+            with open(self.path) as f:
+                return json.loads(f.read() or "{}")
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write(self) -> None:
+        tmp = f"{self.path}.{self.self_endpoint.replace(':', '_')}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"leader": self.self_endpoint, "expires_at": time.time() + self.ttl_s},
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # ---- election --------------------------------------------------------
+    @property
+    def _claim_path(self) -> str:
+        return self.path + ".claim"
+
+    def try_acquire(self) -> bool:
+        """Claim leadership if the lock is free, expired, or already ours.
+
+        Takeover goes through an O_CREAT|O_EXCL CLAIM file — atomic on
+        POSIX, so exactly one candidate enters the write section per
+        takeover (a crashed claimant's stale claim is reaped after 2s).
+        The settle re-read then catches the one remaining race (a stale
+        leader's late renew landing inside the window)."""
+        current = self._read()
+        now = time.time()
+        if (
+            current is not None
+            and current.get("leader") != self.self_endpoint
+            and current.get("expires_at", 0) > now
+        ):
+            return False
+        if current is not None and current.get("leader") == self.self_endpoint:
+            return self.renew()
+        # atomic claim: one winner per takeover
+        try:
+            fd = os.open(self._claim_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(now).encode())
+            os.close(fd)
+        except FileExistsError:
+            try:
+                with open(self._claim_path) as f:
+                    claimed_at = float(f.read() or 0)
+            except (FileNotFoundError, ValueError):
+                return False
+            if now - claimed_at > 2.0:  # claimant died mid-claim: reap
+                try:
+                    os.remove(self._claim_path)
+                except FileNotFoundError:
+                    pass
+            return False
+        try:
+            # someone else may have completed between our read and claim
+            current = self._read()
+            if (
+                current is not None
+                and current.get("leader") != self.self_endpoint
+                and current.get("expires_at", 0) > time.time()
+            ):
+                return False
+            self._write()
+            time.sleep(0.05 + random.random() * 0.05)  # settle window
+            confirmed = self._read()
+            return (
+                confirmed is not None
+                and confirmed.get("leader") == self.self_endpoint
+            )
+        finally:
+            try:
+                os.remove(self._claim_path)
+            except FileNotFoundError:
+                pass
+
+    def renew(self) -> bool:
+        """Extend our lease; False if leadership was lost OR already
+        expired — a stalled leader whose lease lapsed must stand down
+        (another meta may have claimed meanwhile), never write."""
+        current = self._read()
+        if (
+            current is None
+            or current.get("leader") != self.self_endpoint
+            or current.get("expires_at", 0) <= time.time()
+        ):
+            return False
+        self._write()
+        return True
+
+    def verify(self) -> bool:
+        """Cheap read-only leadership check for per-mutation fencing."""
+        current = self._read()
+        return (
+            current is not None
+            and current.get("leader") == self.self_endpoint
+            and current.get("expires_at", 0) > time.time()
+        )
+
+    def leader(self) -> Optional[str]:
+        current = self._read()
+        if current is None or current.get("expires_at", 0) <= time.time():
+            return None
+        return current.get("leader")
+
+    def resign(self) -> None:
+        current = self._read()
+        if current is not None and current.get("leader") == self.self_endpoint:
+            try:
+                os.remove(self.path)
+            except FileNotFoundError:
+                pass

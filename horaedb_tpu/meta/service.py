@@ -62,6 +62,12 @@ def _post(endpoint: str, path: str, payload: dict, timeout: float = 5.0) -> dict
         return json.loads(resp.read().decode() or "{}")
 
 
+class NotLeader(RuntimeError):
+    def __init__(self, leader: Optional[str]) -> None:
+        super().__init__(f"not the meta leader (leader: {leader})")
+        self.leader = leader
+
+
 class MetaServer:
     def __init__(
         self,
@@ -70,28 +76,62 @@ class MetaServer:
         lease_ttl_s: float = 5.0,
         heartbeat_timeout_s: float = 6.0,
         rebalance: bool = True,
+        election=None,  # meta.election.FileLease — HA mode
+        kv_factory=None,  # () -> LeaseKV over SHARED storage (HA mode)
     ) -> None:
-        self.kv = kv if kv is not None else MemoryKV()
-        self.topology = TopologyManager(self.kv, num_shards=num_shards)
+        self.num_shards = num_shards
         self.lease_ttl_s = lease_ttl_s
-        self.inspector = NodeInspector(self.topology, heartbeat_timeout_s)
-        self.schedulers = [ReopenScheduler(self.topology), StaticScheduler(self.topology)]
-        if rebalance:
-            self.schedulers.append(RebalancedScheduler(self.topology))
-        self.procedures = ProcedureManager(
-            self.kv,
-            handlers={
-                "create_table": self._run_create_table,
-                "drop_table": self._run_drop_table,
-                "transfer_shard": self._run_transfer_shard,
-            },
-        )
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.rebalance = rebalance
+        self.election = election
+        self.kv_factory = kv_factory
         # One mutation at a time: the reference gets global DDL ordering
         # from raft; a single-process meta gets it from this lock (it also
         # serializes the shared catalog registry's read-modify-write).
         self._ddl_lock = threading.Lock()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
+        self.is_leader = election is None  # single-meta mode leads always
+        self.kv = None
+        self.topology = None
+        if election is None:
+            self._install_state(kv if kv is not None else MemoryKV())
+
+    def _install_state(self, kv: LeaseKV) -> None:
+        """(Re)build coordination state over ``kv`` — on construction, and
+        on every leadership ACQUISITION in HA mode (the journal on shared
+        storage is re-read so a new leader resumes where the old one
+        stopped, ref: horaemeta leaders recovering from etcd)."""
+        old = self.kv
+        self.kv = kv
+        self.topology = TopologyManager(kv, num_shards=self.num_shards)
+        self.inspector = NodeInspector(self.topology, self.heartbeat_timeout_s)
+        self.schedulers = [
+            ReopenScheduler(self.topology), StaticScheduler(self.topology),
+        ]
+        if self.rebalance:
+            self.schedulers.append(RebalancedScheduler(self.topology))
+        self.procedures = ProcedureManager(
+            kv,
+            handlers={
+                "create_table": self._run_create_table,
+                "drop_table": self._run_drop_table,
+                "transfer_shard": self._run_transfer_shard,
+            },
+        )
+        if old is not None and hasattr(old, "close"):
+            old.close()
+
+    def _ensure_leader(self) -> None:
+        if self.election is None:
+            return
+        # Per-MUTATION fencing, not just the cached tick flag: a deposed
+        # leader (stall past TTL) must stop touching the shared journal
+        # the moment another meta holds the lock, or its FileKV compaction
+        # could clobber the new leader's writes.
+        if not self.is_leader or not self.election.verify():
+            self.is_leader = False
+            raise NotLeader(self.election.leader())
 
     # ---- lifecycle ------------------------------------------------------
     def start_loop(self, interval_s: float = 1.0) -> None:
@@ -109,9 +149,37 @@ class MetaServer:
         self._stop.set()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=5)
+        if self.election is not None and self.is_leader:
+            # clean handover: followers take over instantly instead of
+            # waiting out the lease TTL
+            self.election.resign()
+            self._step_down()
+
+    def _step_down(self) -> None:
+        self.is_leader = False
+        if self.kv_factory is not None and self.kv is not None:
+            # stop journaling to the SHARED file — the new leader owns it
+            if hasattr(self.kv, "close"):
+                self.kv.close()
+            self.kv = None
+            self.topology = None
 
     # ---- coordination tick ----------------------------------------------
     def tick(self) -> None:
+        if self.election is not None:
+            if self.is_leader:
+                if not self.election.renew():
+                    logger.warning("meta leadership LOST; standing down")
+                    self._step_down()
+                    return
+            else:
+                if self.election.try_acquire():
+                    logger.warning("meta leadership ACQUIRED; loading state")
+                    if self.kv_factory is not None:
+                        self._install_state(self.kv_factory())
+                    self.is_leader = True
+                else:
+                    return  # follower: nothing to schedule
         newly_offline = self.inspector.inspect()
         for ep in newly_offline:
             logger.warning("node %s marked offline (heartbeat lapsed)", ep)
@@ -186,6 +254,7 @@ class MetaServer:
         }
 
     def handle_heartbeat(self, endpoint: str) -> dict:
+        self._ensure_leader()
         self.topology.heartbeat(endpoint)
         desired = []
         for view in self.topology.shards_of_node(endpoint):
@@ -207,6 +276,7 @@ class MetaServer:
         return {"desired": desired, "lease_ttl_s": self.lease_ttl_s}
 
     def handle_create_table(self, name: str, create_sql: str) -> dict:
+        self._ensure_leader()
         with self._ddl_lock:
             existing = self.topology.table(name)
             if existing is not None:
@@ -234,6 +304,7 @@ class MetaServer:
             }
 
     def handle_drop_table(self, name: str) -> dict:
+        self._ensure_leader()
         with self._ddl_lock:
             p = self.procedures.run_sync("drop_table", {"name": name})
             if p.state.value != "finished":
@@ -241,6 +312,7 @@ class MetaServer:
             return {"dropped": True}
 
     def handle_route(self, table: str) -> Optional[dict]:
+        self._ensure_leader()
         hit = self.topology.route(table)
         if hit is None:
             return None
@@ -257,6 +329,13 @@ def create_meta_app(server: MetaServer) -> web.Application:
     app = web.Application()
     app["meta"] = server
 
+    def _not_leader(e: NotLeader) -> web.Response:
+        # 421 Misdirected Request + leader hint: MetaClient retries there
+        # (ref: non-leader metas forward, horaemeta forward.go).
+        return web.json_response(
+            {"error": str(e), "leader": e.leader}, status=421
+        )
+
     async def heartbeat(request: web.Request) -> web.Response:
         body = await request.json()
         ep = body.get("endpoint")
@@ -264,10 +343,13 @@ def create_meta_app(server: MetaServer) -> web.Application:
             return web.json_response({"error": "missing 'endpoint'"}, status=400)
         import asyncio
 
-        # Lease recovery can fsync the KV journal — keep it off the loop.
-        out = await asyncio.get_running_loop().run_in_executor(
-            None, server.handle_heartbeat, ep
-        )
+        try:
+            # Lease recovery can fsync the KV journal — keep it off the loop.
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, server.handle_heartbeat, ep
+            )
+        except NotLeader as e:
+            return _not_leader(e)
         return web.json_response(out)
 
     async def create_table(request: web.Request) -> web.Response:
@@ -279,6 +361,8 @@ def create_meta_app(server: MetaServer) -> web.Application:
                 None, server.handle_create_table, body["name"], body["create_sql"]
             )
             return web.json_response(out)
+        except NotLeader as e:
+            return _not_leader(e)
         except KeyError as e:
             return web.json_response({"error": f"missing {e}"}, status=400)
         except Exception as e:
@@ -293,18 +377,25 @@ def create_meta_app(server: MetaServer) -> web.Application:
                 None, server.handle_drop_table, body["name"]
             )
             return web.json_response(out)
+        except NotLeader as e:
+            return _not_leader(e)
         except KeyError as e:
             return web.json_response({"error": f"missing {e}"}, status=400)
         except Exception as e:
             return web.json_response({"error": str(e)}, status=422)
 
     async def route(request: web.Request) -> web.Response:
-        out = server.handle_route(request.match_info["table"])
+        try:
+            out = server.handle_route(request.match_info["table"])
+        except NotLeader as e:
+            return _not_leader(e)
         if out is None:
             return web.json_response({"error": "table not found"}, status=404)
         return web.json_response(out)
 
     async def nodes(request: web.Request) -> web.Response:
+        if server.topology is None:
+            return web.json_response({"nodes": [], "role": "follower"})
         return web.json_response(
             {
                 "nodes": [
@@ -319,17 +410,23 @@ def create_meta_app(server: MetaServer) -> web.Application:
         )
 
     async def shards(request: web.Request) -> web.Response:
+        if server.topology is None:
+            return web.json_response({"shards": [], "role": "follower"})
         return web.json_response(
             {"shards": [s.to_dict() for s in server.topology.shards()]}
         )
 
     async def procedures(request: web.Request) -> web.Response:
+        if server.topology is None:
+            return web.json_response({"procedures": [], "role": "follower"})
         return web.json_response(
             {"procedures": [p.to_dict() for p in server.procedures.list()]}
         )
 
     async def health(request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        return web.json_response(
+            {"status": "ok", "leader": server.is_leader}
+        )
 
     app.router.add_post("/meta/v1/node/heartbeat", heartbeat)
     app.router.add_post("/meta/v1/table/create", create_table)
@@ -349,6 +446,15 @@ def main() -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=DEFAULT_META_PORT)
     p.add_argument("--data-dir", default=None, help="meta state dir (default: memory)")
+    p.add_argument(
+        "--ha-dir", default=None,
+        help="SHARED dir for multi-meta HA: leader lock + journal live here",
+    )
+    p.add_argument("--advertise", default=None, help="endpoint peers reach us at")
+    p.add_argument(
+        "--election-ttl", type=float, default=10.0,
+        help="HA leader lease TTL seconds (failover latency bound)",
+    )
     p.add_argument("--num-shards", type=int, default=8)
     p.add_argument("--lease-ttl", type=float, default=5.0)
     p.add_argument("--heartbeat-timeout", type=float, default=6.0)
@@ -356,13 +462,27 @@ def main() -> None:
     p.add_argument("--log-level", default="info")
     args = p.parse_args()
     logging.basicConfig(level=args.log_level.upper())
-    kv = FileKV(f"{args.data_dir}/meta.kv") if args.data_dir else MemoryKV()
-    server = MetaServer(
-        kv,
-        num_shards=args.num_shards,
-        lease_ttl_s=args.lease_ttl,
-        heartbeat_timeout_s=args.heartbeat_timeout,
-    )
+    if args.ha_dir:
+        from .election import FileLease
+
+        advertise = args.advertise or f"{args.host}:{args.port}"
+        server = MetaServer(
+            num_shards=args.num_shards,
+            lease_ttl_s=args.lease_ttl,
+            heartbeat_timeout_s=args.heartbeat_timeout,
+            election=FileLease(
+                f"{args.ha_dir}/leader.lock", advertise, ttl_s=args.election_ttl
+            ),
+            kv_factory=lambda: FileKV(f"{args.ha_dir}/meta.kv"),
+        )
+    else:
+        kv = FileKV(f"{args.data_dir}/meta.kv") if args.data_dir else MemoryKV()
+        server = MetaServer(
+            kv,
+            num_shards=args.num_shards,
+            lease_ttl_s=args.lease_ttl,
+            heartbeat_timeout_s=args.heartbeat_timeout,
+        )
     server.start_loop(args.tick_interval)
     app = create_meta_app(server)
     logger.info("meta server on %s:%d", args.host, args.port)
