@@ -143,15 +143,27 @@ class ClusterImpl:
 
         Tables created elsewhere exist in the SHARED object store; reload
         the catalog registry, then replay create_sql for any still missing
-        (first assignment of a brand-new table)."""
+        (first assignment of a brand-new table). Partition sub-tables
+        (``sub_of`` set) open through their logical parent's registry
+        entry — they have no DDL of their own."""
         if not tables:
             return
-        missing = [t for t in tables if not self.conn.catalog.exists(t["name"])]
-        if missing:
+        missing = [
+            t for t in tables
+            if not t.get("sub_of") and not self.conn.catalog.exists(t["name"])
+        ]
+        subs = [t for t in tables if t.get("sub_of")]
+        if missing or subs:
             reload_fn = getattr(self.conn.catalog, "reload", None)
             if reload_fn is not None:
                 reload_fn()
         for t in tables:
+            if t.get("sub_of"):
+                if self.conn.catalog.open_sub_table(t["name"]) is None:
+                    # storage not visible yet (create in flight on another
+                    # node): the next heartbeat reconcile retries
+                    logger.info("partition %s not openable yet", t["name"])
+                continue
             if not self.conn.catalog.exists(t["name"]):
                 try:
                     self.conn.execute(t["create_sql"])
@@ -187,8 +199,8 @@ class ClusterImpl:
             self._order_applied_at.pop(shard_id, None)
             self.shard_set.remove(shard_id)
 
-    def create_table_on_shard(self, shard_id: int, name: str, create_sql: str) -> int:
-        """Meta-dispatched DDL; returns the catalog table id (idempotent)."""
+    def create_table_on_shard(self, shard_id: int, name: str, create_sql: str) -> dict:
+        """Meta-dispatched DDL; returns catalog ids (idempotent)."""
         with self._lock:
             # The registry lives in the SHARED store: another node may have
             # persisted tables since we loaded. Reload before a
@@ -198,7 +210,10 @@ class ClusterImpl:
                 self.conn.execute(create_sql)
             self._table_shard[name] = shard_id
             entry = self.conn.catalog.entry(name)
-            return entry.table_id
+            return {
+                "table_id": entry.table_id,
+                "sub_table_ids": list(entry.sub_table_ids or []),
+            }
 
     def drop_table_on_shard(self, shard_id: int, name: str) -> None:
         with self._lock:

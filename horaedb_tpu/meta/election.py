@@ -69,8 +69,15 @@ class FileLease:
             and current.get("expires_at", 0) > now
         ):
             return False
-        if current is not None and current.get("leader") == self.self_endpoint:
+        if (
+            current is not None
+            and current.get("leader") == self.self_endpoint
+            and current.get("expires_at", 0) > now
+        ):
             return self.renew()
+        # expired (even if it names us): take the claim path — renew()
+        # refuses lapsed leases by design, so an expired self-lease must
+        # RE-ACQUIRE through the atomic claim like any other candidate
         # atomic claim: one winner per takeover
         try:
             fd = os.open(self._claim_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)

@@ -158,11 +158,12 @@ class MetaServer:
     def _step_down(self) -> None:
         self.is_leader = False
         if self.kv_factory is not None and self.kv is not None:
-            # stop journaling to the SHARED file — the new leader owns it
+            # Stop journaling to the SHARED file — the new leader owns it.
+            # The topology/kv OBJECTS stay referenced so a request that
+            # passed _ensure_leader mid-step-down fails with a clean
+            # closed-file error instead of an AttributeError on None.
             if hasattr(self.kv, "close"):
                 self.kv.close()
-            self.kv = None
-            self.topology = None
 
     # ---- coordination tick ----------------------------------------------
     def tick(self) -> None:
@@ -218,15 +219,61 @@ class MetaServer:
         shard = self.topology.shard(shard_id)
         if shard is None or shard.node is None:
             raise RuntimeError(f"shard {shard_id} unassigned; retrying")
-        resp = _post(
-            shard.node,
-            "/meta_event/create_table_on_shard",
-            {"shard_id": shard_id, "name": name, "create_sql": create_sql,
-             "version": shard.version},
-        )
+        # Partitioned tables: the COORDINATOR places each partition on its
+        # own shard BEFORE dispatching the create, so the creating node's
+        # sub-table resolver routes non-local partitions remotely from the
+        # first moment (no window where one node owns everything).
+        n_partitions = self._partition_count(create_sql)
+        sub_names: list[str] = []
+        if n_partitions and self.topology.table(name) is None:
+            from ..table_engine.partition import sub_table_name
+
+            placements = self.topology.pick_shards_for_partitions(n_partitions)
+            for i, sub_shard in enumerate(placements):
+                sub = sub_table_name(name, i)
+                if self.topology.table(sub) is None:
+                    # UNIQUE provisional id (negative: disjoint from the
+                    # catalog id space) — patched after the node reports
+                    # real ids; two subs on one shard must not collide
+                    self.topology.add_table(
+                        sub, -self.topology.alloc_table_id(), sub_shard, "",
+                        sub_of=name,
+                    )
+                sub_names.append(sub)
+        try:
+            resp = _post(
+                shard.node,
+                "/meta_event/create_table_on_shard",
+                {"shard_id": shard_id, "name": name, "create_sql": create_sql,
+                 "version": shard.version},
+            )
+        except Exception:
+            # Failed dispatch must not leave routable orphan placements
+            # occupying shards; the retry (or a fresh CREATE) re-places.
+            for sub in sub_names:
+                self.topology.drop_table(sub)
+            raise
         table_id = int(resp["table_id"])
+        for i, sub_id in enumerate(resp.get("sub_table_ids") or []):
+            if i < len(sub_names):
+                self.topology.set_table_id(sub_names[i], int(sub_id))
         if self.topology.table(name) is None:
             self.topology.add_table(name, table_id, shard_id, create_sql)
+
+    @staticmethod
+    def _partition_count(create_sql: str) -> int:
+        """PARTITIONS n from the DDL (0 = unpartitioned); parsed with the
+        data nodes' own SQL parser — one grammar, no drift."""
+        try:
+            from ..query import ast
+            from ..query.parser import parse_sql
+
+            stmt = parse_sql(create_sql)
+            if isinstance(stmt, ast.CreateTable) and stmt.partition_by is not None:
+                return stmt.partition_by.num_partitions
+        except Exception:
+            pass
+        return 0
 
     def _run_drop_table(self, p: Procedure) -> None:
         name = p.params["name"]
@@ -248,7 +295,12 @@ class MetaServer:
             "lease_id": view.lease_id,
             "lease_ttl_s": self.lease_ttl_s,
             "tables": [
-                {"name": t.name, "table_id": t.table_id, "create_sql": t.create_sql}
+                {
+                    "name": t.name,
+                    "table_id": t.table_id,
+                    "create_sql": t.create_sql,
+                    "sub_of": t.sub_of,
+                }
                 for t in self.topology.tables_of_shard(view.shard_id)
             ],
         }
@@ -350,6 +402,10 @@ def create_meta_app(server: MetaServer) -> web.Application:
             )
         except NotLeader as e:
             return _not_leader(e)
+        except Exception as e:
+            # mid-step-down: journal closed under the request — clients
+            # fail over on a retryable status, not a blank 500
+            return web.json_response({"error": str(e)}, status=503)
         return web.json_response(out)
 
     async def create_table(request: web.Request) -> web.Response:

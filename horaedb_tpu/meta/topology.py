@@ -77,6 +77,9 @@ class TableMeta:
     table_id: int
     shard_id: int
     create_sql: str
+    # partitions: a sub-table records its logical parent; placement of
+    # each partition is its own TableMeta on its own shard
+    sub_of: Optional[str] = None
 
     def to_dict(self) -> dict:
         return {
@@ -84,11 +87,15 @@ class TableMeta:
             "table_id": self.table_id,
             "shard_id": self.shard_id,
             "create_sql": self.create_sql,
+            "sub_of": self.sub_of,
         }
 
     @staticmethod
     def from_dict(d: dict) -> "TableMeta":
-        return TableMeta(d["name"], int(d["table_id"]), int(d["shard_id"]), d["create_sql"])
+        return TableMeta(
+            d["name"], int(d["table_id"]), int(d["shard_id"]), d["create_sql"],
+            sub_of=d.get("sub_of"),
+        )
 
 
 class TopologyManager:
@@ -198,17 +205,35 @@ class TopologyManager:
             pool = assigned or list(self._shards.values())
             return min(pool, key=lambda s: (len(s.table_ids), s.shard_id)).shard_id
 
+    def pick_shards_for_partitions(self, n: int) -> list[int]:
+        """One shard per partition, spread round-robin from least-loaded
+        (ref: the coordinator scatters partition sub-tables)."""
+        with self._lock:
+            assigned = [s for s in self._shards.values() if s.node is not None]
+            pool = sorted(
+                assigned or list(self._shards.values()),
+                key=lambda s: (len(s.table_ids), s.shard_id),
+            )
+            return [pool[i % len(pool)].shard_id for i in range(n)]
+
     def alloc_table_id(self) -> int:
         with self._lock:
             nxt = int(self.kv.get(_K_IDS) or 1)
             self.kv.put(_K_IDS, nxt + 1)
             return nxt
 
-    def add_table(self, name: str, table_id: int, shard_id: int, create_sql: str) -> TableMeta:
+    def add_table(
+        self,
+        name: str,
+        table_id: int,
+        shard_id: int,
+        create_sql: str,
+        sub_of: Optional[str] = None,
+    ) -> TableMeta:
         with self._lock:
             if name in self._tables:
                 raise ValueError(f"table exists: {name}")
-            tm = TableMeta(name, table_id, shard_id, create_sql)
+            tm = TableMeta(name, table_id, shard_id, create_sql, sub_of=sub_of)
             self._tables[name] = tm
             self.kv.put(f"{_K_TABLE}{name}", tm.to_dict())
             s = self._shards[shard_id]
@@ -217,18 +242,45 @@ class TopologyManager:
             self.kv.put(f"{_K_SHARD}{shard_id}", s.to_dict())
             return tm
 
-    def drop_table(self, name: str) -> Optional[TableMeta]:
+    def set_table_id(self, name: str, table_id: int) -> None:
+        """Patch a placement recorded before the owning node allocated the
+        catalog id (partition placement records names first)."""
         with self._lock:
-            tm = self._tables.pop(name, None)
+            tm = self._tables.get(name)
             if tm is None:
-                return None
-            self.kv.delete(f"{_K_TABLE}{name}")
+                return
             s = self._shards.get(tm.shard_id)
             if s is not None:
-                s.table_ids = tuple(t for t in s.table_ids if t != tm.table_id)
+                ids = list(s.table_ids)
+                if tm.table_id in ids:  # replace exactly ONE occurrence
+                    ids[ids.index(tm.table_id)] = table_id
+                else:
+                    ids.append(table_id)
+                s.table_ids = tuple(ids)
                 s.version += 1
                 self.kv.put(f"{_K_SHARD}{s.shard_id}", s.to_dict())
-            return tm
+            tm.table_id = table_id
+            self.kv.put(f"{_K_TABLE}{name}", tm.to_dict())
+
+    def drop_table(self, name: str) -> Optional[TableMeta]:
+        with self._lock:
+            victims = [name] + [
+                t.name for t in self._tables.values() if t.sub_of == name
+            ]
+            out = None
+            for victim in victims:
+                tm = self._tables.pop(victim, None)
+                if tm is None:
+                    continue
+                if victim == name:
+                    out = tm
+                self.kv.delete(f"{_K_TABLE}{victim}")
+                s = self._shards.get(tm.shard_id)
+                if s is not None:
+                    s.table_ids = tuple(t for t in s.table_ids if t != tm.table_id)
+                    s.version += 1
+                    self.kv.put(f"{_K_SHARD}{s.shard_id}", s.to_dict())
+            return out
 
     def table(self, name: str) -> Optional[TableMeta]:
         with self._lock:

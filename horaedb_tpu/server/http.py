@@ -795,7 +795,7 @@ def create_app(
             return web.json_response({"error": "not in cluster mode"}, status=400)
         body = await request.json()
         try:
-            table_id = await asyncio.get_running_loop().run_in_executor(
+            out = await asyncio.get_running_loop().run_in_executor(
                 None,
                 cluster.create_table_on_shard,
                 int(body["shard_id"]),
@@ -804,7 +804,7 @@ def create_app(
             )
         except Exception as e:
             return web.json_response({"error": str(e)}, status=422)
-        return web.json_response({"table_id": table_id})
+        return web.json_response(out)
 
     async def meta_drop_table(request: web.Request) -> web.Response:
         if cluster is None:

@@ -362,3 +362,75 @@ class TestFencingUnit:
             cluster.apply_shard_order({**order, "version": 3})
         with pytest.raises(ShardError, match="stale"):
             cluster.close_shard(0, version=3)
+
+
+class TestPartitionPlacement:
+    """Coordinator-placed partitions: each sub-table lives on its own
+    shard/node; queries and writes span the cluster transparently."""
+
+    def test_partitioned_table_spreads_and_serves(self, cluster):
+        meta_port, (port_a, port_b), procs, spawn_node = cluster
+        wait_until(lambda: shards_all_assigned(meta_port), desc="assignment")
+        ddl = (
+            "CREATE TABLE ppt (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) PARTITION BY KEY(host) PARTITIONS 4 ENGINE=Analytic"
+        )
+        status, out = sql(port_a, ddl)
+        assert status == 200, out
+
+        # the coordinator placed each partition on its own shard; with two
+        # nodes and 4 shards the partitions span BOTH nodes
+        owners = set()
+        for i in range(4):
+            s, r = http(
+                "GET", f"http://127.0.0.1:{meta_port}/meta/v1/route/__ppt_{i}"
+            )
+            assert s == 200, r
+            owners.add(r["node"])
+        assert len(owners) == 2, f"partitions on one node only: {owners}"
+
+        rows = [f"('h{i % 8}', {float(i)}, {1000 + i})" for i in range(160)]
+
+        def insert_lands():
+            # partition orders propagate via heartbeat (<=2s): writes are
+            # fenced until each owner has opened its sub-tables
+            status, out = sql(
+                port_b, "INSERT INTO ppt (host, v, ts) VALUES " + ", ".join(rows)
+            )
+            return out if status == 200 and out.get("affected_rows") == 160 else None
+
+        wait_until(insert_lands, timeout=20, desc="scattered insert accepted")
+
+        import numpy as np
+
+        expect = {
+            f"h{h}": {
+                "c": len([i for i in range(160) if i % 8 == h]),
+                "s": float(sum(i for i in range(160) if i % 8 == h)),
+            }
+            for h in range(8)
+        }
+
+        def both_nodes_agree():
+            for port in (port_a, port_b):
+                s, out = sql(
+                    port,
+                    "SELECT host, count(*) AS c, sum(v) AS s FROM ppt GROUP BY host",
+                )
+                if s != 200:
+                    return None
+                got = {r["host"]: r for r in out["rows"]}
+                if set(got) != set(expect):
+                    return None
+                for h, e in expect.items():
+                    if got[h]["c"] != e["c"] or abs(got[h]["s"] - e["s"]) > 1e-6:
+                        return None
+            return True
+
+        wait_until(both_nodes_agree, timeout=20, desc="partitioned query both nodes")
+
+        # drop cleans up every partition cluster-wide
+        status, out = sql(port_a, "DROP TABLE ppt")
+        assert status == 200, out
+        s, r = http("GET", f"http://127.0.0.1:{meta_port}/meta/v1/route/__ppt_0")
+        assert s == 404, r
