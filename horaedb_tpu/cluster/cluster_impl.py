@@ -135,6 +135,15 @@ class ClusterImpl:
             now = time.monotonic()
             self._lease_deadline[shard_id] = now + ttl
             self._order_applied_at[shard_id] = now
+            ordered = {t["name"] for t in tables}
+            # PRUNE names this shard no longer carries (dropped tables /
+            # moved partitions) — an add-only map would leave the write
+            # fence open for tables the node no longer owns.
+            for name in [
+                n for n, sid in self._table_shard.items()
+                if sid == shard_id and n not in ordered
+            ]:
+                self._table_shard.pop(name, None)
             for t in tables:
                 self._table_shard[t["name"]] = shard_id
 
@@ -214,6 +223,12 @@ class ClusterImpl:
                 "table_id": entry.table_id,
                 "sub_table_ids": list(entry.sub_table_ids or []),
             }
+
+    def forget_table(self, name: str) -> None:
+        """Remove a table from the serving map WITHOUT touching storage
+        (its partition was dropped or moved; see remote DropSub)."""
+        with self._lock:
+            self._table_shard.pop(name, None)
 
     def drop_table_on_shard(self, shard_id: int, name: str) -> None:
         with self._lock:

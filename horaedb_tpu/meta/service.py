@@ -450,7 +450,9 @@ def create_meta_app(server: MetaServer) -> web.Application:
         return web.json_response(out)
 
     async def nodes(request: web.Request) -> web.Response:
-        if server.topology is None:
+        if server.topology is None or (
+            server.election is not None and not server.is_leader
+        ):
             return web.json_response({"nodes": [], "role": "follower"})
         return web.json_response(
             {
@@ -466,14 +468,18 @@ def create_meta_app(server: MetaServer) -> web.Application:
         )
 
     async def shards(request: web.Request) -> web.Response:
-        if server.topology is None:
+        if server.topology is None or (
+            server.election is not None and not server.is_leader
+        ):
             return web.json_response({"shards": [], "role": "follower"})
         return web.json_response(
             {"shards": [s.to_dict() for s in server.topology.shards()]}
         )
 
     async def procedures(request: web.Request) -> web.Response:
-        if server.topology is None:
+        if server.topology is None or (
+            server.election is not None and not server.is_leader
+        ):
             return web.json_response({"procedures": [], "role": "follower"})
         return web.json_response(
             {"procedures": [p.to_dict() for p in server.procedures.list()]}

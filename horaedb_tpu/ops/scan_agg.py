@@ -158,6 +158,9 @@ def cached_scan_agg_body(
     mask = mask & (ts_rel >= lo_rel) & (ts_rel < hi_rel)
     bucket = jnp.clip((ts_rel - t0_rel) // bucket_ms, 0, n_buckets - 1).astype(jnp.int32)
     group_codes = group_of_series[series_codes]
+    # bf16-resident value columns (HORAEDB_CACHE_DTYPE) upcast here:
+    # accumulation always runs in f32 (no-op when already f32)
+    values = values.astype(jnp.float32)
     return scan_agg_body(
         group_codes,
         bucket,

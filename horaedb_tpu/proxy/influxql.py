@@ -97,8 +97,25 @@ class _Parser:
     # ---- entry ----------------------------------------------------------
     def parse(self):
         if self.eat("show"):
-            self.expect("measurements")
-            return "show_measurements"
+            if self.eat("measurements"):
+                return "show_measurements"
+            if self.eat("tag"):
+                if self.eat("keys"):
+                    m = _ident(self.next()) if self.eat("from") else None
+                    return ("show_tag_keys", m)
+                self.expect("values")
+                m = _ident(self.next()) if self.eat("from") else None
+                self.expect("with")
+                self.expect("key")
+                self.expect("=")
+                return ("show_tag_values", m, _ident(self.next()))
+            if self.eat("field"):
+                self.expect("keys")
+                m = _ident(self.next()) if self.eat("from") else None
+                return ("show_field_keys", m)
+            raise InfluxQLError(
+                "SHOW supports MEASUREMENTS, TAG KEYS, TAG VALUES, FIELD KEYS"
+            )
         self.expect("select")
         items = self._select_items()
         self.expect("from")
@@ -264,6 +281,10 @@ def evaluate(conn, query: str) -> dict:
         return _results(
             [{"name": "measurements", "columns": ["name"], "values": [[n] for n in names]}]
         )
+    if isinstance(sel, tuple) and sel[0] in (
+        "show_tag_keys", "show_field_keys", "show_tag_values",
+    ):
+        return _evaluate_show(conn, sel)
     table = conn.catalog.open(sel.measurement)
     if table is None:
         return _results([])
@@ -341,6 +362,64 @@ def _fill_buckets(vals: list, sel: InfluxSelect, n_aggs: int) -> list:
         t += width
     out.sort(key=lambda v: v[0])
     return out
+
+
+def _evaluate_show(conn, sel: tuple) -> dict:
+    """SHOW TAG KEYS / FIELD KEYS / TAG VALUES (influx schema surfaces —
+    the reference serves these from its influxql planner)."""
+    kind = sel[0]
+    measurement = sel[1]
+    targets = (
+        [measurement] if measurement is not None else conn.catalog.table_names()
+    )
+    series = []
+    for name in targets:
+        table = conn.catalog.open(name)
+        if table is None:
+            continue
+        schema = table.schema
+        if kind == "show_tag_keys":
+            vals = [[t] for t in schema.tag_names]
+            if vals:
+                series.append(
+                    {"name": name, "columns": ["tagKey"], "values": vals}
+                )
+        elif kind == "show_field_keys":
+            vals = [
+                [schema.columns[i].name, _influx_type(schema.columns[i].kind)]
+                for i in schema.field_indexes
+            ]
+            if vals:
+                series.append(
+                    {"name": name, "columns": ["fieldKey", "fieldType"], "values": vals}
+                )
+        else:  # show_tag_values
+            key = sel[2]
+            if measurement is None and (
+                not schema.has_column(key) or key not in schema.tag_names
+            ):
+                continue  # FROM-less form: skip tables lacking the key
+            if not schema.has_column(key) or key not in schema.tag_names:
+                raise InfluxQLError(f"unknown tag key {key!r} on {name!r}")
+            out = conn.execute(f"SELECT DISTINCT `{key}` FROM `{name}`").to_pylist()
+            vals = sorted([key, r[key]] for r in out if r[key] is not None)
+            series.append(
+                {"name": name, "columns": ["key", "value"], "values": vals}
+            )
+    return _results(series)
+
+
+def _influx_type(kind) -> str:
+    """Engine kinds -> InfluxQL fieldType vocabulary
+    ({float, integer, string, boolean} — clients branch on these)."""
+    if kind.is_float:
+        return "float"
+    if kind.is_integer:
+        return "integer"
+    v = kind.value
+    if v in ("bool", "boolean"):
+        return "boolean"
+    return "string"
 
 
 def _results(series: list) -> dict:

@@ -155,6 +155,8 @@ class GrpcServer:
         DROP TABLE dispatches this for remote-owned partitions so nothing
         orphans in the shared store."""
         name = req["table"]
+        if self.cluster is not None:
+            self.cluster.forget_table(name)  # close the write fence NOW
         t = self.conn.catalog.open_sub_table(name)
         if t is None:
             return {"dropped": False}  # already gone: idempotent

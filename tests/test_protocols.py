@@ -526,3 +526,44 @@ class TestProtocolReviewRegressions:
             assert [v[0] for v in series["values"]] == [60000, 0]
 
         with_client(body)
+
+
+class TestInfluxQLShow:
+    def test_show_tag_and_field_keys(self):
+        async def body(client, conn):
+            conn.execute(
+                "CREATE TABLE sm (host string TAG, region string TAG, usage double, "
+                "idle bigint, time timestamp NOT NULL, TIMESTAMP KEY(time)) ENGINE=Analytic"
+            )
+            resp = await client.get(
+                "/influxdb/v1/query", params={"q": "SHOW TAG KEYS FROM sm"}
+            )
+            s = (await resp.json())["results"][0]["series"][0]
+            assert s["values"] == [["host"], ["region"]]
+            resp = await client.get(
+                "/influxdb/v1/query", params={"q": "SHOW FIELD KEYS FROM sm"}
+            )
+            s = (await resp.json())["results"][0]["series"][0]
+            # influx fieldType vocabulary, not engine kind names
+            assert ["usage", "float"] in s["values"]
+            assert ["idle", "integer"] in s["values"]
+
+        with_client(body)
+
+    def test_show_tag_values(self):
+        async def body(client, conn):
+            conn.execute(
+                "CREATE TABLE sv (host string TAG, v double, "
+                "time timestamp NOT NULL, TIMESTAMP KEY(time)) ENGINE=Analytic"
+            )
+            conn.execute(
+                "INSERT INTO sv (host, v, time) VALUES ('b', 1, 1), ('a', 2, 2), ('b', 3, 3)"
+            )
+            resp = await client.get(
+                "/influxdb/v1/query",
+                params={"q": 'SHOW TAG VALUES FROM sv WITH KEY = "host"'},
+            )
+            s = (await resp.json())["results"][0]["series"][0]
+            assert s["values"] == [["host", "a"], ["host", "b"]]
+
+        with_client(body)

@@ -281,3 +281,34 @@ class TestIncrementalCache:
         got = {r["b"]: r["c"] for r in out.to_pylist()}
         # base: v>50 -> i in 51..119 at ts=i*1000
         assert got == {0: 9, 60000: 60, 180000: 1}, got  # delta row filtered
+
+
+class TestBf16Cache:
+    def test_bf16_resident_columns_approximate_host(self, db, monkeypatch):
+        monkeypatch.setenv("HORAEDB_CACHE_DTYPE", "bf16")
+        seed(db, n=400)
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = (
+            "SELECT host, count(*) AS c, sum(v) AS s, avg(v) AS a "
+            "FROM t GROUP BY host"
+        )
+        out = warm(db, sql)
+        assert ex.last_path == "device-cached"
+        entry = ex.scan_cache._entries["t"]
+        import jax.numpy as jnp
+
+        assert entry.value_cols_dev["v"].dtype == jnp.bfloat16
+        got = {r["host"]: r for r in out.to_pylist()}
+
+        orig_cap, orig_cached = ex._device_capable, ex._try_cached_agg
+        ex._device_capable = lambda plan, rows: False
+        ex._try_cached_agg = lambda plan, table, m: None
+        host = {r["host"]: r for r in db.execute(sql).to_pylist()}
+        ex._device_capable, ex._try_cached_agg = orig_cap, orig_cached
+
+        for h in host:
+            assert got[h]["c"] == host[h]["c"]  # counts stay exact
+            # bf16 storage: ~3 significant digits on values
+            assert abs(got[h]["s"] - host[h]["s"]) / max(abs(host[h]["s"]), 1) < 2e-2
+            assert abs(got[h]["a"] - host[h]["a"]) / max(abs(host[h]["a"]), 1) < 2e-2
