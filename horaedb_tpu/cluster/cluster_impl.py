@@ -138,12 +138,14 @@ class ClusterImpl:
             ordered = {t["name"] for t in tables}
             # PRUNE names this shard no longer carries (dropped tables /
             # moved partitions) — an add-only map would leave the write
-            # fence open for tables the node no longer owns.
+            # fence open (and the local handles would keep serving stale
+            # data / flushing stale memtables) for tables the node no
+            # longer owns.
             for name in [
                 n for n, sid in self._table_shard.items()
                 if sid == shard_id and n not in ordered
             ]:
-                self._table_shard.pop(name, None)
+                self._release_table(name)
             for t in tables:
                 self._table_shard[t["name"]] = shard_id
 
@@ -195,15 +197,7 @@ class ClusterImpl:
                 name for name, sid in self._table_shard.items() if sid == shard_id
             ]
             for name in dropped_tables:
-                self._table_shard.pop(name, None)
-                try:
-                    t = self.conn.catalog.open(name)
-                    if t is not None:
-                        for data in t.physical_datas():
-                            self.conn.instance.close_table(data)
-                    self.conn.catalog.forget(name)
-                except Exception:
-                    logger.exception("closing table %s of shard %d", name, shard_id)
+                self._release_table(name)
             self._lease_deadline.pop(shard_id, None)
             self._order_applied_at.pop(shard_id, None)
             self.shard_set.remove(shard_id)
@@ -223,6 +217,29 @@ class ClusterImpl:
                 "table_id": entry.table_id,
                 "sub_table_ids": list(entry.sub_table_ids or []),
             }
+
+    def _release_table(self, name: str) -> None:
+        """Stop serving a table this node no longer owns: fence writes,
+        close local handles, forget catalog entries.
+
+        With a WAL, the close does NOT flush: this node LOST the table —
+        its unflushed rows are durable in the SHARED WAL and the new
+        owner replays them; flushing a stale memtable here would race
+        the new owner's manifest appends (two writers, one log sequence —
+        last writer wins, edits LOST). Without a WAL (explicit
+        no-durability config) flushing on close is the only way to hand
+        the rows over, racy or not."""
+        self._table_shard.pop(name, None)
+        try:
+            t = self.conn.catalog.open(name)
+            if t is not None:
+                for data in t.physical_datas():
+                    self.conn.instance.close_table(
+                        data, flush=self.conn.instance.wal is None
+                    )
+            self.conn.catalog.forget(name)
+        except Exception:
+            logger.exception("releasing table %s", name)
 
     def forget_table(self, name: str) -> None:
         """Remove a table from the serving map WITHOUT touching storage

@@ -308,6 +308,25 @@ def _column_hash(arr) -> np.ndarray:
         return _column_hash(arr.values)[arr.codes]
     n = len(arr)
     if arr.dtype == object:
+        # Fast path: arrow encodes the whole column into ONE contiguous
+        # utf-8 buffer + int64 offsets (C speed), which feeds the native
+        # batch hasher directly — no per-value Python. Mixed-type or
+        # null-bearing columns fall back to the canonical-bytes loop.
+        import pyarrow as pa
+
+        try:
+            pa_arr = pa.array(arr, type=pa.large_string())
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            pa_arr = None
+        if pa_arr is not None and pa_arr.null_count == 0 and pa_arr.offset == 0:
+            offsets = np.frombuffer(pa_arr.buffers()[1], dtype=np.int64)[: n + 1]
+            data_buf = pa_arr.buffers()[2]
+            data = (
+                np.frombuffer(data_buf, dtype=np.uint8)
+                if data_buf is not None
+                else np.empty(0, dtype=np.uint8)
+            )
+            return native.hash_var(data, offsets)
         encoded = [_canonical_bytes(v) for v in arr]
         offsets = np.zeros(n + 1, dtype=np.int64)
         np.cumsum(np.fromiter((len(b) for b in encoded), np.int64, count=n), out=offsets[1:])

@@ -165,6 +165,7 @@ class Manifest:
         self.prefix = f"manifest/{space_id}/{table_id}/"
         self._lock = threading.Lock()
         self._next_log_seq = 0
+        self._append_probed = False
 
     # ---- paths ---------------------------------------------------------
     def _log_path(self, seq: int) -> str:
@@ -188,7 +189,19 @@ class Manifest:
             return
         with self._lock:
             seq = self._next_log_seq
-            self._next_log_seq += 1
+            # Defense in depth for cluster mode: another NODE may have
+            # appended while this handle was idle (shard moved away and
+            # back). Probe for an existing log object once per handle —
+            # after our own first append, WE own the head (single-writer
+            # fencing) — and skip to the first free sequence rather than
+            # overwrite (which would silently lose the other writer's
+            # edits). Exists-then-put is not atomic; the fencing layer is
+            # the real guarantee, this narrows the window.
+            if not self._append_probed:
+                while self.store.exists(self._log_path(seq)):
+                    seq += 1
+                self._append_probed = True
+            self._next_log_seq = seq + 1
             payload = msgpack.packb([_edit_to_dict(e) for e in edits], use_bin_type=True)
             self.store.put(self._log_path(seq), payload)
             if (seq + 1) % self.SNAPSHOT_EVERY_N_LOGS == 0:

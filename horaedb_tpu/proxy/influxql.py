@@ -395,11 +395,9 @@ def _evaluate_show(conn, sel: tuple) -> dict:
                 )
         else:  # show_tag_values
             key = sel[2]
-            if measurement is None and (
-                not schema.has_column(key) or key not in schema.tag_names
-            ):
-                continue  # FROM-less form: skip tables lacking the key
-            if not schema.has_column(key) or key not in schema.tag_names:
+            if key not in schema.tag_names:
+                if measurement is None:
+                    continue  # FROM-less form: skip tables lacking the key
                 raise InfluxQLError(f"unknown tag key {key!r} on {name!r}")
             out = conn.execute(f"SELECT DISTINCT `{key}` FROM `{name}`").to_pylist()
             vals = sorted([key, r[key]] for r in out if r[key] is not None)

@@ -265,7 +265,10 @@ class ScanCache:
         # HORAEDB_CACHE_DTYPE=bf16 halves resident HBM for value columns
         # (the kernels upcast to f32 for accumulation — on TPU the cast is
         # free on the vector units, the win is bandwidth/capacity). Costs
-        # ~3 significant digits on stored samples; default stays f32.
+        # ~3 significant digits on stored samples, INCLUDING values that
+        # numeric filters compare against — rows within bf16 rounding of
+        # a filter threshold may classify differently than the host path.
+        # Default stays f32; opt in where approximate serving is fine.
         dtype = (
             jnp.bfloat16
             if os.environ.get("HORAEDB_CACHE_DTYPE", "f32") == "bf16"

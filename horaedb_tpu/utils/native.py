@@ -87,8 +87,11 @@ def _ptr(a: np.ndarray) -> ctypes.c_void_p:
     return ctypes.c_void_p(a.ctypes.data)
 
 
-def hash_var(data: bytes, offsets: np.ndarray) -> np.ndarray:
-    """XXH64 of each [offsets[i], offsets[i+1]) slice of ``data``."""
+def hash_var(data, offsets: np.ndarray) -> np.ndarray:
+    """XXH64 of each [offsets[i], offsets[i+1]) slice of ``data``.
+
+    ``data`` may be bytes or any uint8 buffer (e.g. a zero-copy view of
+    an arrow string column's data buffer)."""
     lib = load()
     n = len(offsets) - 1
     out = np.empty(n, dtype=np.uint64)
@@ -100,7 +103,11 @@ def hash_var(data: bytes, offsets: np.ndarray) -> np.ndarray:
         for i in range(n):
             out[i] = xxhash.xxh64_intdigest(data[offsets[i]:offsets[i + 1]])
         return out
-    buf = np.frombuffer(data, dtype=np.uint8)
+    buf = (
+        data
+        if isinstance(data, np.ndarray)
+        else np.frombuffer(data, dtype=np.uint8)
+    )
     offs = np.ascontiguousarray(offsets, dtype=np.int64)
     lib.hash_var_xx64(_ptr(buf), _ptr(offs), n, _ptr(out))
     return out

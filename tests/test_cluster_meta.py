@@ -301,13 +301,19 @@ class TestMetaCluster:
         # as the reference's shard moves). The CLUSTER must converge to
         # serving the correct data — and the fenced 666.0 write must have
         # been rejected, not applied.
+        last_seen = {}
+
         def converged():
             status, out = sql(standby_port, "SELECT v FROM fence_t ORDER BY ts")
+            last_seen["r"] = (status, out)
             if status == 200 and [r["v"] for r in out["rows"]] == [1.0, 2.0]:
                 return True
             return None
 
-        wait_until(converged, timeout=20, desc="cluster convergence after rejoin")
+        try:
+            wait_until(converged, timeout=20, desc="cluster convergence after rejoin")
+        except TimeoutError:
+            raise AssertionError(f"no convergence; last={last_seen.get('r')}")
 
 
 class TestFencingUnit:
