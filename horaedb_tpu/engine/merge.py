@@ -19,14 +19,19 @@ concatenation).
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import numpy as np
 
-import os
-
 from ..common_types.dict_column import DictColumn
 from ..common_types.row_group import RowGroup
+from ..common_types.schema import Schema, project_schema
+from ..table_engine.predicate import Predicate
+from ..utils.object_store import ObjectStore
+from .options import UpdateMode
+from .sst.reader import SstReader
+from .version import ReadView
 
 # Measured (2026-07-29, XLA CPU backend): the device merge is 0.2-0.4x
 # numpy's lexsort at every size from 20k to 2M rows — XLA's CPU sort
@@ -48,12 +53,6 @@ def device_merge_min_rows() -> int:
     if jax.default_backend() == "cpu":
         return 1 << 62  # effectively off: host lexsort measured faster
     return DEFAULT_DEVICE_MERGE_MIN_ROWS
-from ..common_types.schema import Schema, project_schema
-from ..table_engine.predicate import Predicate
-from ..utils.object_store import ObjectStore
-from .options import UpdateMode
-from .sst.reader import SstReader
-from .version import ReadView
 
 
 def dedup_keep_mask(rows: RowGroup) -> np.ndarray:
@@ -91,12 +90,34 @@ def scan_sources(
     store: ObjectStore,
     projection: Optional[Sequence[str]] = None,
 ) -> tuple[list[RowGroup], list[np.ndarray]]:
-    """Materialize every source in the view as (rows, per-row version)."""
+    """Materialize every source in the view as (rows, per-row version).
+
+    Multi-SST reads from REMOTE stores fetch concurrently (the
+    prefetchable-stream analog, ref: prefetchable_stream.rs +
+    num_streams_to_prefetch): each SST is an independent network object,
+    so overlap hides latency. Local-disk reads stay sequential — pyarrow
+    already threads the decode and parallel mmap reads measured 0.95x.
+    """
     parts: list[RowGroup] = []
     versions: list[np.ndarray] = []
-    for handle in view.ssts:
-        reader = SstReader(store, handle.path)
-        rows = reader.read(schema, predicate, projection=projection)
+
+    def read_one(handle):
+        return SstReader(store, handle.path).read(
+            schema, predicate, projection=projection
+        )
+
+    from ..utils.object_store import LocalDiskStore, MemoryStore
+
+    remote = not isinstance(store, (LocalDiskStore, MemoryStore))
+    if remote and len(view.ssts) > 1:
+        # the IO pool, NOT scatter_pool: partition scatter tasks call into
+        # this function, and nesting on one bounded pool deadlocks
+        from ..utils.runtime import io_pool
+
+        sst_rows = list(io_pool().map(read_one, view.ssts))
+    else:
+        sst_rows = [read_one(h) for h in view.ssts]
+    for handle, rows in zip(view.ssts, sst_rows):
         if len(rows):
             parts.append(rows)
             versions.append(np.full(len(rows), handle.meta.max_sequence, dtype=np.uint64))

@@ -69,3 +69,21 @@ def scatter_pool() -> "cf.ThreadPoolExecutor":
                 max_workers=8, thread_name_prefix="scatter"
             )
         return _scatter_pool
+
+
+_io_pool = None
+_io_lock = threading.Lock()
+
+
+def io_pool() -> "cf.ThreadPoolExecutor":
+    """Dedicated pool for storage IO fan-out (concurrent SST fetches from
+    remote stores). SEPARATE from scatter_pool: partition scatter tasks
+    trigger SST reads, and nesting both on one bounded pool would
+    deadlock under load."""
+    global _io_pool
+    with _io_lock:
+        if _io_pool is None:
+            _io_pool = cf.ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="sst-io"
+            )
+        return _io_pool

@@ -402,3 +402,29 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+class TestRemoteConcurrentScan:
+    def test_multi_sst_read_from_s3_parallel_and_correct(self, fake_s3):
+        from horaedb_tpu.db import Connection
+        from horaedb_tpu.engine.instance import EngineConfig
+
+        conn = Connection(
+            make_store(fake_s3), config=EngineConfig(compaction_l0_trigger=1000)
+        )
+        conn.execute(
+            "CREATE TABLE par (h string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic WITH (update_mode='append')"
+        )
+        t = conn.catalog.open("par")
+        # several flushes -> several SSTs in the bucket
+        for run in range(4):
+            conn.execute(
+                "INSERT INTO par (h, v, ts) VALUES "
+                + ", ".join(f"('h{i%3}', {run * 100 + i}, {1000 + i})" for i in range(50))
+            )
+            t.flush()
+        assert len(t.physical_datas()[0].version.levels.all_files()) >= 4
+        out = conn.execute("SELECT count(*) AS c, sum(v) AS s FROM par").to_pylist()
+        expect_sum = float(sum(run * 100 + i for run in range(4) for i in range(50)))
+        assert out == [{"c": 200, "s": expect_sum}]
