@@ -319,10 +319,11 @@ class Executor:
         spec = spec_from_plan(self, plan)
         if spec is None:
             return None  # shape not pushable: gather-rows fallback below
-        names_arrays = table.partial_agg(spec)
-        combined, n_groups = combine_partials([names_arrays], spec)
+        names, arrays, stage_metrics = table.partial_agg(spec)
+        combined, n_groups = combine_partials([(names, arrays)], spec)
         keep = table.rule.prune(plan.predicate)
         m["partitions"] = len(keep) if keep is not None else len(table.sub_tables)
+        m["partial_stages"] = stage_metrics
         return assemble_result(plan, combined, n_groups, spec)
 
     # ---- device path -------------------------------------------------------

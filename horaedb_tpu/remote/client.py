@@ -102,7 +102,8 @@ class RemoteEngineClient:
 
     def partial_agg(self, table: str, spec: dict):
         out = self._call("PartialAgg", {"table": table, "spec": spec})
-        return columns_from_ipc(out["ipc"])
+        names, arrays = columns_from_ipc(out["ipc"])
+        return names, arrays, out.get("metrics") or {}
 
     def drop_sub(self, table: str) -> bool:
         return bool(self._call("DropSub", {"table": table}).get("dropped"))
@@ -136,7 +137,12 @@ class RemoteSubTable(Table):
         return self.client.read(self._name, self._schema, predicate, projection)
 
     def partial_agg(self, spec: dict):
-        return self.client.partial_agg(self._name, spec)
+        names, arrays, metrics = self.client.partial_agg(self._name, spec)
+        return names, arrays, [{
+            "partition": self._name,
+            "remote": self.client.endpoint,
+            **metrics,
+        }]
 
     def drop_remote(self) -> None:
         """Delete this partition's storage on its owning node (the

@@ -144,11 +144,22 @@ class GrpcServer:
         return {"ipc": rows_to_ipc(rows)}
 
     def _partial_agg(self, req: dict) -> dict:
+        import time
+
         from ..query.partial import compute_partial
 
+        t0 = time.perf_counter()
         t = self._open(req["table"])
         names, arrays = compute_partial(t, req["spec"])
-        return {"ipc": columns_to_ipc(names, arrays)}
+        return {
+            "ipc": columns_to_ipc(names, arrays),
+            # stage metrics ride home for EXPLAIN ANALYZE (ref: the
+            # reference's RemoteTaskContext.remote_metrics)
+            "metrics": {
+                "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
+                "groups": int(len(arrays[0])) if arrays else 0,
+            },
+        }
 
     def _drop_sub(self, req: dict) -> dict:
         """Drop ONE partition's storage on its owning node — the logical

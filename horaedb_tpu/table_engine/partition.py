@@ -205,15 +205,17 @@ class PartitionedTable(Table):
         parts = list(scatter_pool().map(lambda t: t.partial_agg(spec), targets))
         names = None
         merged: dict[str, list] = {}
-        for p_names, p_arrays in parts:
+        stage_metrics: list = []
+        for p_names, p_arrays, p_metrics in parts:
+            stage_metrics.extend(p_metrics)
             if not len(p_arrays) or not len(p_arrays[0]):
                 continue
             names = p_names
             for nm, arr in zip(p_names, p_arrays):
                 merged.setdefault(nm, []).append(arr)
         if names is None:
-            return parts[0]
-        return names, [np.concatenate(merged[nm]) for nm in names]
+            return parts[0][0], parts[0][1], stage_metrics
+        return names, [np.concatenate(merged[nm]) for nm in names], stage_metrics
 
     def flush(self) -> None:
         for t in self.sub_tables:

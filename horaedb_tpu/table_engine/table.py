@@ -70,10 +70,23 @@ class Table(ABC):
     def partial_agg(self, spec: dict):
         """Pushed-down partial aggregate over this table's OWN data
         (ref: dist_sql_query partial agg below the scan). Runs wherever
-        the data lives — remote handles forward it over the wire."""
+        the data lives — remote handles forward it over the wire.
+
+        Returns (names, arrays, stage_metrics) — the metrics travel back
+        to the coordinator for EXPLAIN ANALYZE (ref: the reference ships
+        remote plan metrics in RemoteTaskContext.remote_metrics)."""
+        import time
+
         from ..query.partial import compute_partial
 
-        return compute_partial(self, spec)
+        t0 = time.perf_counter()
+        names, arrays = compute_partial(self, spec)
+        return names, arrays, [{
+            "partition": self.name,
+            "remote": False,
+            "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
+            "groups": int(len(arrays[0])) if arrays else 0,
+        }]
 
 
 class AnalyticTable(Table):

@@ -103,7 +103,8 @@ class TestGrpcRoundTrip:
             "bucket_ms": 0,
             "agg_cols": ["v"],
         }
-        names, arrays = client.partial_agg("rt", spec)
+        names, arrays, metrics = client.partial_agg("rt", spec)
+        assert metrics.get("elapsed_ms") is not None  # stage metrics ride home
         d = dict(zip(names, arrays))
         assert list(d["__k0"]) == ["a"]
         assert d["__count_rows"][0] == 6  # v in 4..9
