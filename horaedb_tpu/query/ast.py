@@ -89,6 +89,30 @@ class InList(Expr):
 
 
 @dataclass(frozen=True)
+class Subquery(Expr):
+    """Uncorrelated scalar subquery — evaluated once before the outer
+    query and replaced with its single value."""
+
+    select: "Select"
+
+    def __str__(self) -> str:
+        return f"(subquery:{self.select.table})"
+
+
+@dataclass(frozen=True)
+class InSubquery(Expr):
+    """expr [NOT] IN (SELECT col FROM ...) — uncorrelated; materialized
+    into an InList before the outer query runs."""
+
+    expr: Expr
+    select: "Select"
+    negated: bool = False
+
+    def __str__(self) -> str:
+        return f"({self.expr} {'NOT ' if self.negated else ''}IN subquery:{self.select.table})"
+
+
+@dataclass(frozen=True)
 class Between(Expr):
     expr: Expr
     low: Expr

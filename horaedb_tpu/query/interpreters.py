@@ -15,6 +15,7 @@ import numpy as np
 from ..catalog import Catalog
 from ..common_types.row_group import RowGroup
 from ..engine.options import format_duration
+from . import ast
 from .executor import Executor, ResultSet
 from .plan import (
     AlterTablePlan,
@@ -29,6 +30,20 @@ from .plan import (
     ShowCreatePlan,
     ShowTablesPlan,
 )
+
+
+def _walk_all(e):
+    """Generic expression walker that also SEES subquery nodes (does not
+    descend into their inner selects — those are separate scopes)."""
+    yield e
+    for name in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, name)
+        if isinstance(v, ast.Expr):
+            yield from _walk_all(v)
+        elif isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, ast.Expr):
+                    yield from _walk_all(x)
 
 
 @dataclass(frozen=True)
@@ -142,6 +157,9 @@ class InterpreterFactory:
 
     # ---- variants -----------------------------------------------------------
     def _select(self, plan: QueryPlan) -> ResultSet:
+        rewritten = self._materialize_subqueries(plan)
+        if rewritten is not None:
+            plan = rewritten
         if plan.select.join is not None:
             from .join import execute_join
 
@@ -150,6 +168,97 @@ class InterpreterFactory:
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
         return self.executor.execute(plan, table)
+
+    def _materialize_subqueries(self, plan: QueryPlan):
+        """Uncorrelated subqueries run FIRST and substitute as literals
+        (ref: the reference gets subqueries from DataFusion; this is the
+        uncorrelated subset): ``IN (SELECT ...)`` becomes an InList of the
+        inner result's values, a scalar ``(SELECT ...)`` becomes one
+        Literal. Returns a re-planned QueryPlan, or None if the statement
+        has no subqueries."""
+        stmt = plan.select
+        sources = [item.expr for item in stmt.items]
+        sources += [e for e in (stmt.where, stmt.having, *stmt.group_by) if e is not None]
+        sources += [o.expr for o in stmt.order_by]
+        if not any(
+            isinstance(e, (ast.InSubquery, ast.Subquery))
+            for src in sources
+            for e in _walk_all(src)
+        ):
+            return None
+
+        from .planner import Planner
+
+        planner = Planner(self.catalog.schema_of)
+
+        def run_inner(select: ast.Select) -> list:
+            inner = self.execute(planner.plan(select))
+            if not isinstance(inner, ResultSet):
+                raise InterpreterError("subquery must be a SELECT")
+            if len(inner.names) != 1:
+                raise InterpreterError(
+                    f"subquery must return one column, got {inner.names}"
+                )
+            nulls = (inner.nulls or {}).get(inner.names[0])
+            col = inner.columns[0]
+            return [
+                v.item() if isinstance(v, np.generic) else v
+                for i, v in enumerate(col)
+                if nulls is None or not nulls[i]
+            ]
+
+        import dataclasses
+
+        def subst(e: ast.Expr) -> ast.Expr:
+            if isinstance(e, ast.InSubquery):
+                vals = run_inner(e.select)
+                return ast.InList(
+                    subst(e.expr), tuple(ast.Literal(v) for v in vals), e.negated
+                )
+            if isinstance(e, ast.Subquery):
+                vals = run_inner(e.select)
+                if len(vals) > 1:
+                    raise InterpreterError(
+                        f"scalar subquery returned {len(vals)} rows"
+                    )
+                return ast.Literal(vals[0] if vals else None)
+            # Generic rebuild mirroring _walk_all: any Expr-typed field
+            # (or tuple of them) may hide a subquery — FuncCall args,
+            # InList values, IsNull, everything current and future.
+            if dataclasses.is_dataclass(e):
+                changes = {}
+                for name in e.__dataclass_fields__:
+                    v = getattr(e, name)
+                    if isinstance(v, ast.Expr):
+                        nv = subst(v)
+                        if nv is not v:
+                            changes[name] = nv
+                    elif isinstance(v, tuple) and any(
+                        isinstance(x, ast.Expr) for x in v
+                    ):
+                        nv = tuple(
+                            subst(x) if isinstance(x, ast.Expr) else x for x in v
+                        )
+                        if nv != v:
+                            changes[name] = nv
+                if changes:
+                    return dataclasses.replace(e, **changes)
+            return e
+
+        new_stmt = dataclasses.replace(
+            stmt,
+            items=tuple(
+                dataclasses.replace(item, expr=subst(item.expr))
+                for item in stmt.items
+            ),
+            where=subst(stmt.where) if stmt.where is not None else None,
+            having=subst(stmt.having) if stmt.having is not None else None,
+            group_by=tuple(subst(g) for g in stmt.group_by),
+            order_by=tuple(
+                dataclasses.replace(o, expr=subst(o.expr)) for o in stmt.order_by
+            ),
+        )
+        return planner.plan(new_stmt)
 
     def _insert(self, plan: InsertPlan) -> AffectedRows:
         table = self.catalog.open(plan.table)

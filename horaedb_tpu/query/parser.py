@@ -508,6 +508,10 @@ class Parser:
         negated = self._eat_kw("NOT")
         if self._eat_kw("IN"):
             self._expect_op("(")
+            if self._at_kw("SELECT"):
+                inner = self._select()
+                self._expect_op(")")
+                return ast.InSubquery(left, inner, negated)
             vals = [self._expr()]
             while self._eat_op(","):
                 vals.append(self._expr())
@@ -551,6 +555,12 @@ class Parser:
         if t.kind == "string":
             return ast.Literal(t.text[1:-1].replace("''", "'"))
         if t.kind == "op" and t.text == "(":
+            if self._at_kw("SELECT"):
+                # scalar subquery: (SELECT max(v) FROM t) — must be
+                # uncorrelated; the interpreter evaluates it first
+                inner = self._select()
+                self._expect_op(")")
+                return ast.Subquery(inner)
             e = self._expr()
             self._expect_op(")")
             return e

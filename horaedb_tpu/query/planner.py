@@ -463,6 +463,10 @@ def _walk(e: ast.Expr):
         yield from _walk(e.expr)
         for v in e.values:
             yield from _walk(v)
+    elif isinstance(e, ast.InSubquery):
+        # the LEFT side lives in the outer scope; the inner select has its
+        # own table scope and is validated when it is planned
+        yield from _walk(e.expr)
     elif isinstance(e, ast.Between):
         yield from _walk(e.expr)
         yield from _walk(e.low)

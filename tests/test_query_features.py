@@ -180,3 +180,62 @@ class TestReviewRegressions:
     def test_bad_wal_backend_rejected(self, tmp_path):
         with pytest.raises(ValueError, match="wal_backend"):
             horaedb_tpu.connect(str(tmp_path / "x"), wal_backend="objectstore")
+
+
+class TestSubqueries:
+    def test_in_subquery(self, db):
+        db.execute(
+            "CREATE TABLE big (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO big (host, v, ts) VALUES ('a', 100, 1), ('c', 300, 2)"
+        )
+        out = db.execute(
+            "SELECT host, v FROM q WHERE host IN (SELECT host FROM big) ORDER BY v"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["a", "a", "c"]
+        out = db.execute(
+            "SELECT host FROM q WHERE host NOT IN (SELECT host FROM big) "
+            "ORDER BY host"
+        ).to_pylist()
+        assert sorted({r["host"] for r in out}) == ["b"]
+
+    def test_in_subquery_with_inner_filter(self, db):
+        db.execute(
+            "CREATE TABLE big2 (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO big2 (host, v, ts) VALUES ('a', 1, 1), ('b', 9, 2)"
+        )
+        out = db.execute(
+            "SELECT host, count(*) AS c FROM q "
+            "WHERE host IN (SELECT host FROM big2 WHERE v > 5) GROUP BY host"
+        ).to_pylist()
+        assert out == [{"host": "b", "c": 2}]
+
+    def test_scalar_subquery(self, db):
+        out = db.execute(
+            "SELECT host, v FROM q WHERE v > (SELECT avg(v) FROM q) ORDER BY v"
+        ).to_pylist()
+        # avg = 3.0 -> rows with v in {4, 5}
+        assert [r["v"] for r in out] == [4.0, 5.0]
+
+    def test_scalar_subquery_multi_row_errors(self, db):
+        with pytest.raises(Exception, match="scalar subquery"):
+            db.execute("SELECT host FROM q WHERE v > (SELECT v FROM q)")
+
+    def test_subquery_multi_column_errors(self, db):
+        with pytest.raises(Exception, match="one column"):
+            db.execute("SELECT host FROM q WHERE host IN (SELECT host, v FROM q)")
+
+    def test_subquery_in_function_and_select_list(self, db):
+        # nested positions: function args, scalar in the select list
+        out = db.execute(
+            "SELECT host FROM q WHERE abs(v - (SELECT avg(v) FROM q)) < 0.5 "
+            "ORDER BY host"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["b"]  # v=3 vs avg 3.0
+        out = db.execute("SELECT (SELECT max(v) FROM q) AS m FROM q LIMIT 1").to_pylist()
+        assert out == [{"m": 5.0}]
