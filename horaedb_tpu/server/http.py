@@ -1077,8 +1077,18 @@ def main() -> None:
     p.add_argument("--host", default=None)
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--log-level", default="info")
+    p.add_argument(
+        "--log-file", default=None,
+        help="also append logs to this file (ref: the tracing file appender)",
+    )
     args = p.parse_args()
-    logging.basicConfig(level=args.log_level.upper())
+    handlers = None
+    if args.log_file:
+        handlers = [
+            logging.StreamHandler(),
+            logging.FileHandler(args.log_file),
+        ]
+    logging.basicConfig(level=args.log_level.upper(), handlers=handlers)
     cfg = Config.load(args.config)
     # CLI flags override config file + env.
     if args.data_dir is not None:

@@ -121,6 +121,23 @@ def cmd_hotspot(ep: str, args) -> None:
     print(_get(ep, "/debug/hotspot"))
 
 
+def cmd_shards(ep: str, args) -> None:
+    print(_get(ep, "/debug/shards"))
+
+
+def cmd_wal_stats(ep: str, args) -> None:
+    print(_get(ep, "/debug/wal_stats"))
+
+
+def cmd_slow_log(ep: str, args) -> None:
+    print(_get(ep, "/debug/slow_log"))
+
+
+def cmd_flush(ep: str, args) -> None:
+    path = "/admin/flush" + (f"?table={args.table}" if args.table else "")
+    print(_post(ep, path, {}))
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -148,6 +165,11 @@ def main(argv=None) -> int:
     sub.add_parser("config")
     sub.add_parser("hotspot")
     sub.add_parser("diagnose")
+    sub.add_parser("shards")
+    sub.add_parser("wal_stats")
+    sub.add_parser("slow_log")
+    fl = sub.add_parser("flush")
+    fl.add_argument("table", nargs="?", default=None)
     args = p.parse_args(argv)
     if args.token:
         global _TOKEN

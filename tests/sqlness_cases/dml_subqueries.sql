@@ -1,0 +1,11 @@
+-- uncorrelated subqueries: IN (SELECT ...) and scalar (SELECT ...)
+CREATE TABLE sq (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE allow (host string TAG, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO sq (host, v, ts) VALUES ('a', 1.0, 1), ('b', 5.0, 2), ('c', 9.0, 3);
+INSERT INTO allow (host, ts) VALUES ('a', 1), ('c', 1);
+SELECT host, v FROM sq WHERE host IN (SELECT host FROM allow) ORDER BY host;
+SELECT host FROM sq WHERE host NOT IN (SELECT host FROM allow);
+SELECT host, v FROM sq WHERE v > (SELECT avg(v) FROM sq) ORDER BY v;
+SELECT host FROM sq WHERE v > (SELECT v FROM sq);
+DROP TABLE sq;
+DROP TABLE allow;
