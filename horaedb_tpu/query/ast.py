@@ -147,11 +147,12 @@ class OrderItem:
 
 @dataclass(frozen=True)
 class Join:
-    """Single-equi-key inner join: JOIN <table> ON <l.col> = <r.col>."""
+    """Single-equi-key join: [LEFT] JOIN <table> ON <l.col> = <r.col>."""
 
     table: str
     left_col: str
     right_col: str
+    kind: str = "inner"  # "inner" | "left"
 
 
 @dataclass(frozen=True)

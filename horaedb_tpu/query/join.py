@@ -50,6 +50,15 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     lk = as_values(left.column(join.left_col))
     rk = as_values(right.column(join.right_col))
     li_idx, ri_idx = _inner_match(lk, rk)
+    if join.kind == "left":
+        # unmatched left rows survive with NULL right columns
+        matched = np.zeros(len(lk), dtype=bool)
+        matched[li_idx] = True
+        unmatched = np.nonzero(~matched)[0]
+        li_idx = np.concatenate([li_idx, unmatched])
+        ri_idx = np.concatenate(
+            [ri_idx, np.full(len(unmatched), -1, dtype=np.int64)]
+        )
 
     # Combined schema: left columns + right non-key columns; internal tsid
     # columns stay out; name clashes (other than the key) are an error the
@@ -85,13 +94,24 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
         m = left.valid_mask(c.name)
         if not m.all():
             validity[c.name] = m[li_idx]
+    null_right = ri_idx < 0  # LEFT JOIN: rows with no right-side match
+    ri_safe = np.where(null_right, 0, ri_idx)
     for c in visible(rs):
         if c.name == join.right_col or c.name == rs.timestamp_name:
             continue
-        data[c.name] = as_values(right.column(c.name))[ri_idx]
-        m = right.valid_mask(c.name)
+        vals = as_values(right.column(c.name))
+        # NULL slots carry the column kind's default fill (the engine-wide
+        # convention — see RowGroup) so downstream comparisons/sorts see a
+        # well-typed value, never an arbitrary row-0 leak.
+        fill = np.full(len(ri_idx), c.kind.default_value(), dtype=c.kind.numpy_dtype)
+        if len(vals) == 0:
+            data[c.name] = fill
+            validity[c.name] = np.zeros(len(ri_idx), dtype=bool)
+            continue
+        data[c.name] = np.where(null_right, fill, vals[ri_safe])
+        m = right.valid_mask(c.name)[ri_safe] & ~null_right
         if not m.all():
-            validity[c.name] = m[ri_idx]
+            validity[c.name] = m
     # Schema.build may prepend a tsid column; fill it (unused downstream).
     if combined_schema.tsid_index is not None:
         tsid_name = combined_schema.columns[combined_schema.tsid_index].name

@@ -209,6 +209,10 @@ class Parser:
             if self._eat_kw("INNER"):
                 self._expect_kw("JOIN")
                 join = self._join_clause(table)
+            elif self._eat_kw("LEFT"):
+                self._eat_kw("OUTER")
+                self._expect_kw("JOIN")
+                join = self._join_clause(table, kind="left")
             elif self._eat_kw("JOIN"):
                 join = self._join_clause(table)
         where = None
@@ -255,8 +259,8 @@ class Parser:
             join=join,
         )
 
-    def _join_clause(self, left_table: str) -> ast.Join:
-        """JOIN t2 ON a.k = b.k — single equi-key inner join
+    def _join_clause(self, left_table: str, kind: str = "inner") -> ast.Join:
+        """JOIN t2 ON a.k = b.k — single equi-key inner/left join
         (the reference gets richer joins from DataFusion; this is the
         host-path subset)."""
         right = self._ident()
@@ -271,7 +275,7 @@ class Parser:
             raise ParseError(
                 f"JOIN ON must reference {left_table} and {right}", -1, self.sql
             )
-        return ast.Join(right, l_col, r_col)
+        return ast.Join(right, l_col, r_col, kind=kind)
 
     def _qualified(self) -> tuple[Optional[str], str]:
         name = self._ident()
@@ -289,7 +293,7 @@ class Parser:
             alias = self._ident()
         elif (t := self._peek()) is not None and t.kind in ("name", "qident") and t.text.upper() not in (
             "FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
-            "HAVING", "JOIN", "INNER", "ON",
+            "HAVING", "JOIN", "INNER", "ON", "LEFT", "OUTER",
         ):
             alias = self._ident()
         return ast.SelectItem(e, alias)

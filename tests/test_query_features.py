@@ -239,3 +239,66 @@ class TestSubqueries:
         assert [r["host"] for r in out] == ["b"]  # v=3 vs avg 3.0
         out = db.execute("SELECT (SELECT max(v) FROM q) AS m FROM q LIMIT 1").to_pylist()
         assert out == [{"m": 5.0}]
+
+
+class TestLeftJoin:
+    def test_left_join_keeps_unmatched(self, db):
+        db.execute(
+            "CREATE TABLE lo (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO lo (host, owner, ts) VALUES ('a', 'alice', 1)")
+        out = db.execute(
+            "SELECT host, v, owner FROM q LEFT JOIN lo ON q.host = lo.host "
+            "ORDER BY host, v"
+        ).to_pylist()
+        # a matches, b/c have NULL owner
+        assert out[0] == {"host": "a", "v": 1.0, "owner": "alice"}
+        assert out[1] == {"host": "a", "v": 2.0, "owner": "alice"}
+        assert all(r["owner"] is None for r in out if r["host"] != "a")
+        assert len(out) == 5  # every left row survives
+
+    def test_left_outer_join_empty_right(self, db):
+        db.execute(
+            "CREATE TABLE lo2 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        out = db.execute(
+            "SELECT host, owner FROM q LEFT OUTER JOIN lo2 ON q.host = lo2.host"
+        ).to_pylist()
+        assert len(out) == 5 and all(r["owner"] is None for r in out)
+
+    def test_left_join_where_on_right_null(self, db):
+        db.execute(
+            "CREATE TABLE lo3 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO lo3 (host, owner, ts) VALUES ('a', 'x', 1)")
+        out = db.execute(
+            "SELECT DISTINCT host FROM q LEFT JOIN lo3 ON q.host = lo3.host "
+            "WHERE owner IS NULL ORDER BY host"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["b", "c"]
+
+    def test_left_join_null_compare_and_order(self, db):
+        # review regressions: empty-right comparison must not crash on
+        # object-dtype columns, and NULL placement under ORDER BY must not
+        # leak an arbitrary right-side row's value
+        db.execute(
+            "CREATE TABLE lo4 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        out = db.execute(
+            "SELECT host FROM q LEFT JOIN lo4 ON q.host = lo4.host "
+            "WHERE owner > 'a'"
+        ).to_pylist()
+        assert out == []  # all owners NULL -> no row passes
+        db.execute(
+            "INSERT INTO lo4 (host, owner, ts) VALUES ('b', 'zed', 1)"
+        )
+        out = db.execute(
+            "SELECT DISTINCT host, owner FROM q LEFT JOIN lo4 "
+            "ON q.host = lo4.host ORDER BY owner, host"
+        ).to_pylist()
+        # NULL fill is '' (kind default) -> NULL rows sort first, not at 'zed'
+        assert out[0]["owner"] is None and out[-1]["owner"] == "zed"
