@@ -6,5 +6,8 @@ INSERT INTO owners (host, owner, ts) VALUES ('a', 'alice', 1), ('b', 'bob', 1);
 SELECT host, v, owner FROM m JOIN owners ON m.host = owners.host ORDER BY host, v;
 SELECT host, v FROM m JOIN owners ON m.host = owners.host WHERE owner = 'bob';
 SELECT count(*) AS c FROM m JOIN owners ON m.host = owners.host;
+SELECT host, v, owner FROM m LEFT JOIN owners ON m.host = owners.host ORDER BY host, v;
+SELECT host FROM m LEFT OUTER JOIN owners ON m.host = owners.host WHERE owner IS NULL;
+SELECT host, owner FROM m LEFT JOIN owners ON m.host = owners.host ORDER BY owner, host;
 DROP TABLE m;
 DROP TABLE owners;
