@@ -307,3 +307,157 @@ class TestPostgresProtocol:
             s.close()
 
         self._with_server(db, client)
+
+
+class PgExtClient(PgClient):
+    """Extended-protocol pipelining client (psycopg3-style Parse..Sync)."""
+
+    def _send(self, tag: bytes, payload: bytes) -> None:
+        self.sock.sendall(tag + struct.pack("!I", len(payload) + 4) + payload)
+
+    def parse(self, stmt: str, sql: str) -> None:
+        self._send(b"P", stmt.encode() + b"\x00" + sql.encode() + b"\x00" + b"\x00\x00")
+
+    def bind(self, portal: str, stmt: str, params: list) -> None:
+        p = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+        p += struct.pack("!h", 0)  # no param format codes (default text)
+        p += struct.pack("!h", len(params))
+        for v in params:
+            if v is None:
+                p += struct.pack("!i", -1)
+            else:
+                b = str(v).encode()
+                p += struct.pack("!i", len(b)) + b
+        p += struct.pack("!h", 0)  # default (text) result formats
+        self._send(b"B", p)
+
+    def describe(self, what: str, name: str) -> None:
+        self._send(b"D", what.encode() + name.encode() + b"\x00")
+
+    def execute(self, portal: str) -> None:
+        self._send(b"E", portal.encode() + b"\x00" + struct.pack("!i", 0))
+
+    def close_stmt(self, what: str, name: str) -> None:
+        self._send(b"C", what.encode() + name.encode() + b"\x00")
+
+    def sync(self) -> None:
+        self._send(b"S", b"")
+
+    def collect_until_ready(self) -> list:
+        """Drain messages until ReadyForQuery; returns [(tag, body)...]."""
+        out = []
+        while True:
+            tag, body = self.read_msg()
+            out.append((tag, body))
+            if tag == b"Z":
+                return out
+
+
+class TestPostgresExtendedProtocol:
+    def _with_server(self, db, fn):
+        return TestPostgresProtocol._with_server(self, db, fn)
+
+    def test_parse_bind_describe_execute(self, db):
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgExtClient(s)
+            c.startup()
+            # full pipeline in one flush, like a real driver
+            c.parse("s1", "SELECT host, v FROM wt WHERE host = $1 ORDER BY v")
+            c.bind("", "s1", ["a"])
+            c.describe("P", "")
+            c.execute("")
+            c.sync()
+            msgs = c.collect_until_ready()
+            tags = [t for t, _ in msgs]
+            assert tags[:2] == [b"1", b"2"]          # ParseComplete, BindComplete
+            assert b"T" in tags and b"D" in tags      # RowDescription + DataRow
+            dr = [b for t, b in msgs if t == b"D"][0]
+            assert b"a" in dr and b"1.5" in dr
+            cc = [b for t, b in msgs if t == b"C"][0]
+            assert cc.rstrip(b"\x00") == b"SELECT 1"
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_params_quoting_null_and_insert(self, db):
+        db.execute(
+            "CREATE TABLE pe (h string TAG, x double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgExtClient(s)
+            c.startup()
+            c.parse("ins", "INSERT INTO pe (h, x, ts) VALUES ($1, $2, $3)")
+            c.bind("", "ins", ["o'brien", None, "1000"])
+            c.execute("")
+            c.sync()
+            msgs = c.collect_until_ready()
+            cc = [b for t, b in msgs if t == b"C"][0]
+            assert cc.rstrip(b"\x00") == b"INSERT 0 1"
+            # read it back: quoted value round-trips, NULL stays NULL
+            c.parse("", "SELECT h, x FROM pe WHERE h = $1")
+            c.bind("", "", ["o'brien"])
+            c.describe("P", "")
+            c.execute("")
+            c.sync()
+            msgs = c.collect_until_ready()
+            dr = [b for t, b in msgs if t == b"D"][0]
+            assert b"o'brien" in dr
+            assert struct.pack("!i", -1) in dr  # NULL x
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_error_discards_until_sync(self, db):
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgExtClient(s)
+            c.startup()
+            c.bind("", "missing", [])    # errors: unknown statement
+            c.execute("")                # must be discarded
+            c.sync()
+            msgs = c.collect_until_ready()
+            tags = [t for t, _ in msgs]
+            assert tags == [b"E", b"Z"]  # one error, then ReadyForQuery only
+            # session recovers
+            c.parse("", "SELECT count(*) AS c FROM wt")
+            c.bind("", "", [])
+            c.execute("")
+            c.sync()
+            msgs = c.collect_until_ready()
+            assert [t for t, _ in msgs if t == b"D"]
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_describe_statement_and_close(self, db):
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgExtClient(s)
+            c.startup()
+            c.parse("ds", "SELECT v FROM wt WHERE host = $1 AND v > $2")
+            c.describe("S", "ds")
+            c.close_stmt("S", "ds")
+            c.sync()
+            msgs = c.collect_until_ready()
+            tags = [t for t, _ in msgs]
+            # ParseComplete, ParameterDescription, RowDescription (probed
+            # with NULL params — PgJDBC-style describe-before-bind),
+            # CloseComplete, ReadyForQuery
+            assert tags == [b"1", b"t", b"T", b"3", b"Z"], tags
+            pd = [b for t, b in msgs if t == b"t"][0]
+            assert int.from_bytes(pd[:2], "big") == 2  # two parameters
+            rd = [b for t, b in msgs if t == b"T"][0]
+            assert b"v" in rd
+            # a side-effecting statement still describes as NoData
+            c.parse("di", "INSERT INTO wt (host, v, ts) VALUES ($1, $2, $3)")
+            c.describe("S", "di")
+            c.sync()
+            msgs = c.collect_until_ready()
+            assert [t for t, _ in msgs] == [b"1", b"t", b"n", b"Z"]
+            s.close()
+
+        self._with_server(db, client)
