@@ -6,8 +6,14 @@ implementation of the protocol-41 text subset standard clients use).
 Scope mirrors the reference's shim: handshake (any credentials accepted —
 auth parity tracked with the proxy auth layer), COM_QUERY with text
 result sets (every value rendered as a string — the reference's MySQL
-shim also serves text protocol), COM_PING/COM_INIT_DB/COM_QUIT. Prepared
-statements (binary protocol) are not offered; capability flags say so.
+shim also serves text protocol), COM_PING/COM_INIT_DB/COM_QUIT.
+
+Prepared statements are served too: COM_STMT_PREPARE counts ``?``
+placeholders (string-literal-aware), COM_STMT_EXECUTE decodes binary
+parameters (ints, floats, strings, NULL bitmap, temporal types),
+substitutes them as SQL literals, and answers with a binary-protocol
+result set (every column VAR_STRING, like the text path). COM_STMT_CLOSE
+and COM_STMT_RESET round out the lifecycle Connector/J-style clients use.
 """
 
 from __future__ import annotations
@@ -47,12 +53,109 @@ def _lenenc_str(s: bytes) -> bytes:
     return _lenenc_int(len(s)) + s
 
 
+def _take_lenenc(body: bytes, off: int) -> tuple[int, int]:
+    first = body[off]
+    if first < 0xFB:
+        return first, off + 1
+    if first == 0xFC:
+        return int.from_bytes(body[off + 1:off + 3], "little"), off + 3
+    if first == 0xFD:
+        return int.from_bytes(body[off + 1:off + 4], "little"), off + 4
+    return int.from_bytes(body[off + 1:off + 9], "little"), off + 9
+
+
+def _scan_placeholders(sql: str) -> list[int]:
+    """Positions of ``?`` parameter markers outside string literals,
+    quoted identifiers, and ``--`` comments ('' escaping in strings;
+    "..." and `...` are identifier quotes in this dialect — see
+    query/parser.py tokenizer, which also strips -- comments)."""
+    out = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c == "-" and sql.startswith("--", i):
+            end = sql.find("\n", i)
+            i = n if end < 0 else end + 1
+        elif c == "'":
+            i += 1
+            while i < n:
+                if sql[i] == "'":
+                    if i + 1 < n and sql[i + 1] == "'":
+                        i += 2
+                        continue
+                    i += 1
+                    break
+                i += 1
+        elif c in ('"', "`"):
+            end = sql.find(c, i + 1)
+            i = n if end < 0 else end + 1
+        else:
+            if c == "?":
+                out.append(i)
+            i += 1
+    return out
+
+
+class _StmtError(ValueError):
+    """Prepared-statement protocol failure answered with an ERR packet."""
+
+
+def _decode_param(
+    body: bytes, off: int, ptype: int, unsigned: bool = False
+) -> tuple[object, int]:
+    """Decode one binary-protocol parameter value; returns (literal, off).
+    Integer/float types come back as Python numbers, the rest as str."""
+    signed = not unsigned
+    if ptype in (0x01,):  # TINY
+        return int.from_bytes(body[off:off + 1], "little", signed=signed), off + 1
+    if ptype == 0x02:  # SHORT
+        return int.from_bytes(body[off:off + 2], "little", signed=signed), off + 2
+    if ptype == 0x03:  # LONG
+        return int.from_bytes(body[off:off + 4], "little", signed=signed), off + 4
+    if ptype == 0x08:  # LONGLONG
+        return int.from_bytes(body[off:off + 8], "little", signed=signed), off + 8
+    if ptype == 0x04:  # FLOAT
+        import struct as _s
+        return _s.unpack("<f", body[off:off + 4])[0], off + 4
+    if ptype == 0x05:  # DOUBLE
+        import struct as _s
+        return _s.unpack("<d", body[off:off + 8])[0], off + 8
+    if ptype == 0x06:  # NULL (usually signalled via the bitmap instead)
+        return None, off
+    if ptype in (0x0F, 0xFD, 0xFE, 0xFC, 0xFB, 0xFA, 0xF9):  # strings/blobs
+        ln, off = _take_lenenc(body, off)
+        return body[off:off + ln].decode("utf-8", "replace"), off + ln
+    if ptype in (0x07, 0x0A, 0x0C):  # TIMESTAMP / DATE / DATETIME
+        ln = body[off]; off += 1
+        y = mo = d = h = mi = s = 0
+        if ln >= 4:
+            y = int.from_bytes(body[off:off + 2], "little")
+            mo, d = body[off + 2], body[off + 3]
+        if ln >= 7:
+            h, mi, s = body[off + 4], body[off + 5], body[off + 6]
+        off += ln
+        return f"{y:04d}-{mo:02d}-{d:02d} {h:02d}:{mi:02d}:{s:02d}", off
+    raise _StmtError(f"unsupported parameter type {ptype:#x}")
+
+
+def _sql_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    return "'" + str(v).replace("'", "''") + "'"
+
+
 class _Conn:
     def __init__(self, reader, writer, gateway) -> None:
         self.reader = reader
         self.writer = writer
         self.gateway = gateway
         self.seq = 0
+        # prepared statements: id -> {"sql", "nparams", "types"} (types
+        # persist so re-executes with new_params_bound=0 can decode)
+        self._stmts: dict[int, dict] = {}
+        self._next_stmt_id = 1
 
     async def _read_packet(self) -> Optional[bytes]:
         # Reassemble multi-frame payloads: a frame of exactly 0xFFFFFF
@@ -114,15 +217,7 @@ class _Conn:
             return
         self._send(_lenenc_int(len(names)))
         for name in names:
-            nb = name.encode()
-            col = (
-                _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
-                + _lenenc_str(b"") + _lenenc_str(nb) + _lenenc_str(nb)
-                + b"\x0c" + _CHARSET_UTF8.to_bytes(2, "little")
-                + (1024).to_bytes(4, "little") + bytes([_TYPE_VAR_STRING])
-                + (0).to_bytes(2, "little") + b"\x00" + b"\x00\x00"
-            )
-            self._send(col)
+            self._send(self._col_def(name))
         self._eof()
         for row in rows:
             out = bytearray()
@@ -156,6 +251,19 @@ class _Conn:
                 self._ok()
             elif cmd == 0x03:  # COM_QUERY
                 await self._query(body.decode("utf-8", "replace"))
+            elif cmd == 0x16:  # COM_STMT_PREPARE
+                self._stmt_prepare(body.decode("utf-8", "replace"))
+            elif cmd == 0x17:  # COM_STMT_EXECUTE
+                try:
+                    await self._stmt_execute(body)
+                except (_StmtError, IndexError, ValueError) as e:
+                    self._error(str(e) or "malformed COM_STMT_EXECUTE")
+            elif cmd == 0x19:  # COM_STMT_CLOSE — no response by spec
+                if len(body) >= 4:
+                    self._stmts.pop(int.from_bytes(body[:4], "little"), None)
+                continue
+            elif cmd == 0x1A:  # COM_STMT_RESET
+                self._ok()
             else:
                 self._error(f"unsupported command {cmd:#x}", errno=1047)
             await self.writer.drain()
@@ -182,6 +290,101 @@ class _Conn:
         else:
             names, rows = payload
             self._result_set(names, [[r.get(n) for n in names] for r in rows])
+
+
+    # ---- prepared statements (binary protocol) ---------------------------
+
+    def _col_def(self, name: str) -> bytes:
+        nb = name.encode()
+        return (
+            _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
+            + _lenenc_str(b"") + _lenenc_str(nb) + _lenenc_str(nb)
+            + b"\x0c" + _CHARSET_UTF8.to_bytes(2, "little")
+            + (1024).to_bytes(4, "little") + bytes([_TYPE_VAR_STRING])
+            + (0).to_bytes(2, "little") + b"\x00" + b"\x00\x00"
+        )
+
+    def _stmt_prepare(self, sql: str) -> None:
+        spots = _scan_placeholders(sql)
+        nparams = len(spots)
+        stmt_id = self._next_stmt_id
+        self._next_stmt_id += 1
+        self._stmts[stmt_id] = {"sql": sql, "spots": spots, "types": None}
+        # column count is 0: the row shape isn't known until execute, and
+        # the execute response carries its own resultset header anyway
+        self._send(
+            b"\x00" + stmt_id.to_bytes(4, "little")
+            + (0).to_bytes(2, "little") + nparams.to_bytes(2, "little")
+            + b"\x00" + (0).to_bytes(2, "little")
+        )
+        if nparams:
+            for i in range(nparams):
+                self._send(self._col_def(f"?{i + 1}"))
+            self._eof()
+
+    async def _stmt_execute(self, body: bytes) -> None:
+        stmt_id = int.from_bytes(body[:4], "little")
+        st = self._stmts.get(stmt_id)
+        if st is None:
+            raise _StmtError(f"unknown statement id {stmt_id}")
+        off = 9  # id(4) + flags(1) + iteration_count(4)
+        params: list = []
+        spots = st["spots"]
+        n = len(spots)
+        if n:
+            nbm = (n + 7) // 8
+            null_bitmap = body[off:off + nbm]; off += nbm
+            new_bound = body[off]; off += 1
+            if new_bound:
+                # (type, unsigned) per param — flag bit 0x80 marks unsigned
+                st["types"] = [
+                    (body[off + 2 * i], bool(body[off + 2 * i + 1] & 0x80))
+                    for i in range(n)
+                ]
+                off += 2 * n
+            if st["types"] is None:
+                raise _StmtError("parameter types were never bound")
+            for i in range(n):
+                if null_bitmap[i // 8] & (1 << (i % 8)):
+                    params.append(None)
+                    continue
+                ptype, uns = st["types"][i]
+                v, off = _decode_param(body, off, ptype, uns)
+                params.append(v)
+        # splice literals at the scanned positions (right to left so
+        # earlier offsets stay valid)
+        sql = st["sql"]
+        for pos, v in zip(reversed(spots), reversed(params)):
+            sql = sql[:pos] + _sql_literal(v) + sql[pos + 1:]
+        kind, payload = await self.gateway.execute(sql.strip().rstrip(";"))
+        if kind == "error":
+            self._error(payload[1])
+        elif kind == "affected":
+            self._ok(payload)
+        else:
+            names, rows = payload
+            self._binary_result_set(
+                names, [[r.get(c) for c in names] for r in rows]
+            )
+
+    def _binary_result_set(self, names: list[str], rows: list[list]) -> None:
+        if not names:
+            self._ok()
+            return
+        self._send(_lenenc_int(len(names)))
+        for name in names:
+            self._send(self._col_def(name))
+        self._eof()
+        nbm = (len(names) + 9) // 8  # binary-row NULL bitmap, offset 2
+        for row in rows:
+            out = bytearray(b"\x00" + b"\x00" * nbm)
+            for i, v in enumerate(row):
+                if v is None:
+                    out[1 + (i + 2) // 8] |= 1 << ((i + 2) % 8)
+                else:
+                    out += _lenenc_str(_render(v).encode("utf-8", "replace"))
+            self._send(bytes(out))
+        self._eof()
 
 
 def _render(v) -> str:

@@ -461,3 +461,194 @@ class TestPostgresExtendedProtocol:
             s.close()
 
         self._with_server(db, client)
+
+
+class MyPsClient(MyClient):
+    """Prepared-statement (binary protocol) client."""
+
+    def prepare(self, sql: str):
+        self.seq = 0
+        self.send_packet(b"\x16" + sql.encode())
+        first = self.read_packet()
+        if first[0] == 0xFF:
+            return ("err", first[9:].decode())
+        assert first[0] == 0x00
+        stmt_id = int.from_bytes(first[1:5], "little")
+        ncols = int.from_bytes(first[5:7], "little")
+        nparams = int.from_bytes(first[7:9], "little")
+        for _ in range(nparams):
+            self.read_packet()
+        if nparams:
+            assert self.read_packet()[0] == 0xFE  # EOF after param defs
+        for _ in range(ncols):
+            self.read_packet()
+        if ncols:
+            assert self.read_packet()[0] == 0xFE
+        return ("ok", stmt_id, nparams)
+
+    def execute(self, stmt_id: int, params: list):
+        """params: list of (type_byte, python_value_or_None)."""
+        self.seq = 0
+        p = b"\x17" + stmt_id.to_bytes(4, "little") + b"\x00" + (1).to_bytes(4, "little")
+        n = len(params)
+        if n:
+            bitmap = bytearray((n + 7) // 8)
+            for i, (_, v) in enumerate(params):
+                if v is None:
+                    bitmap[i // 8] |= 1 << (i % 8)
+            p += bytes(bitmap) + b"\x01"  # new_params_bound
+            for t, _ in params:
+                p += bytes([t, 0])
+            for t, v in params:
+                if v is None:
+                    continue
+                if t == 0x08:
+                    p += int(v).to_bytes(8, "little", signed=v >= 0)
+                elif t == 0x05:
+                    p += struct.pack("<d", v)
+                elif t == 0xFD:
+                    b = str(v).encode()
+                    p += bytes([len(b)]) + b  # lenenc (short strings)
+                else:
+                    raise AssertionError(f"test client can't encode {t:#x}")
+        self.send_packet(p)
+        first = self.read_packet()
+        if first[0] == 0x00:
+            affected, _ = _lenenc(first, 1)
+            return ("ok", affected)
+        if first[0] == 0xFF:
+            return ("err", first[9:].decode())
+        ncols, _ = _lenenc(first, 0)
+        names = []
+        for _ in range(ncols):
+            col = self.read_packet()
+            i = 0
+            vals = []
+            for _ in range(6):
+                ln, i = _lenenc(col, i)
+                vals.append(col[i : i + ln]); i += ln
+            names.append(vals[4].decode())
+        assert self.read_packet()[0] == 0xFE
+        rows = []
+        nbm = (ncols + 9) // 8
+        while True:
+            pkt = self.read_packet()
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            assert pkt[0] == 0x00
+            bitmap = pkt[1 : 1 + nbm]
+            i = 1 + nbm
+            row = []
+            for c in range(ncols):
+                if bitmap[(c + 2) // 8] & (1 << ((c + 2) % 8)):
+                    row.append(None)
+                    continue
+                ln, i = _lenenc(pkt, i)
+                row.append(pkt[i : i + ln].decode()); i += ln
+            rows.append(row)
+        return ("rows", names, rows)
+
+
+class TestMysqlPreparedStatements:
+    def _with_server(self, db, fn):
+        return TestMysqlProtocol._with_server(self, db, fn)
+
+    def test_prepare_execute_select(self, db):
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyPsClient(s)
+            c.handshake()
+            st = c.prepare("SELECT host, v FROM wt WHERE host = ? AND v < ?")
+            assert st[0] == "ok" and st[2] == 2, st
+            out = c.execute(st[1], [(0xFD, "a"), (0x05, 99.5)])
+            assert out[0] == "rows" and out[1] == ["host", "v"]
+            assert out[2] == [["a", "1.5"]]
+            # re-execute with different params, same statement
+            out = c.execute(st[1], [(0xFD, "b"), (0x05, 99.5)])
+            assert out[2] == [["b", "2.5"]]
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_insert_with_nulls_and_quotes(self, db):
+        db.execute(
+            "CREATE TABLE mp (h string TAG, note string, x double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyPsClient(s)
+            c.handshake()
+            st = c.prepare("INSERT INTO mp (h, note, x, ts) VALUES (?, ?, ?, ?)")
+            assert st[0] == "ok" and st[2] == 4
+            out = c.execute(
+                st[1],
+                [(0xFD, "o'hara"), (0xFD, None), (0x05, None), (0x08, 1000)],
+            )
+            assert out == ("ok", 1), out
+            st2 = c.prepare("SELECT h, note, x FROM mp WHERE h = ?")
+            out = c.execute(st2[1], [(0xFD, "o'hara")])
+            assert out[0] == "rows"
+            assert out[2] == [["o'hara", None, None]], out[2]
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_placeholder_in_literal_and_close(self, db):
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyPsClient(s)
+            c.handshake()
+            # the ? inside the string literal is NOT a parameter
+            st = c.prepare("SELECT host, 'a?b' AS tag FROM wt WHERE host = ?")
+            assert st[0] == "ok" and st[2] == 1, st
+            out = c.execute(st[1], [(0xFD, "a")])
+            assert out[0] == "rows" and out[2] == [["a", "a?b"]]
+            # close, then execute must error (not crash)
+            c.seq = 0
+            c.send_packet(b"\x19" + st[1].to_bytes(4, "little"))  # no response
+            out = c.execute(st[1], [(0xFD, "a")])
+            assert out[0] == "err" and "unknown statement" in out[1]
+            # plain text query still works on the same session
+            out = c.query("SELECT count(*) AS c FROM wt")
+            assert out[0] == "rows" and out[2] == [["2"]]
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_unsigned_param_and_comment_scan(self, db):
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyPsClient(s)
+            c.handshake()
+            # a ? inside a -- comment is NOT a parameter
+            st = c.prepare(
+                "SELECT host FROM wt WHERE ts = ? -- by time?\n ORDER BY host"
+            )
+            assert st[0] == "ok" and st[2] == 1, st
+            # unsigned LONGLONG above int64 range must not wrap negative:
+            # send flag 0x80 with a top-bit-set value; splicing -1 would
+            # error or match nothing differently than the true value
+            big = 2**63 + 5
+            c.seq = 0
+            p = b"\x17" + st[1].to_bytes(4, "little") + b"\x00" + (1).to_bytes(4, "little")
+            p += b"\x00"          # null bitmap (1 param)
+            p += b"\x01"          # new_params_bound
+            p += bytes([0x08, 0x80])  # LONGLONG, unsigned flag
+            p += big.to_bytes(8, "little")
+            c.send_packet(p)
+            first = c.read_packet()
+            # no row has that ts: a clean empty resultset or OK — never a
+            # decode error or negative-wrap match
+            assert first[0] != 0xFF, first
+            if first[0] != 0x00:
+                ncols, _ = _lenenc(first, 0)
+                for _ in range(ncols):
+                    c.read_packet()
+                assert c.read_packet()[0] == 0xFE
+                pkt = c.read_packet()
+                assert pkt[0] == 0xFE and len(pkt) < 9  # zero rows
+            s.close()
+
+        self._with_server(db, client)
