@@ -133,6 +133,18 @@ class SqlGateway:
 
     def __init__(self, app: web.Application) -> None:
         self.app = app
+        # single-flight dedup of identical in-flight reads (ref:
+        # proxy/src/read.rs:89,167 + components/notifier RequestNotifiers —
+        # concurrent identical SELECTs share one execution; followers get
+        # the leader's result instead of re-running the scan). The key
+        # includes a write epoch so a SELECT issued after this node
+        # accepted a write never joins a pre-write execution — same-node
+        # read-your-writes survives the dedup.
+        self._inflight: dict[tuple[int, str], asyncio.Future] = {}
+        self._write_epoch = 0
+        self._m_deduped = REGISTRY.counter(
+            "horaedb_read_dedup_total", "reads served from an in-flight twin"
+        )
 
     async def execute(self, query: str, already_forwarded: bool = False):
         app = self.app
@@ -187,6 +199,32 @@ class SqlGateway:
                             "it forwarded",
                         )
                     return await self._forward(route.endpoint, query)
+        if query.lstrip()[:7].lower().startswith("select"):
+            key = (self._write_epoch, query.strip())
+            running = self._inflight.get(key)
+            if running is not None and not running.done():
+                self._m_deduped.inc()
+                return await asyncio.shield(running)
+            # ensure_future (not a bare await): the shared execution must
+            # outlive a cancelled leader request so followers still get
+            # their result
+            task = asyncio.ensure_future(self._run_local(proxy, query))
+            self._inflight[key] = task
+
+            def _done(t, key=key):
+                if self._inflight.get(key) is t:
+                    self._inflight.pop(key, None)
+
+            task.add_done_callback(_done)
+            return await asyncio.shield(task)
+        # any non-SELECT may change visible state: advance the epoch so
+        # later reads start a fresh execution (conservative — bumped even
+        # if the statement ultimately fails)
+        self._write_epoch += 1
+        return await self._run_local(proxy, query)
+
+    async def _run_local(self, proxy, query: str):
+        loop = asyncio.get_running_loop()
         try:
             out = await loop.run_in_executor(None, proxy.handle_sql, query)
         except BlockedError as e:

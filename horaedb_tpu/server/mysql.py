@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import secrets
+import struct
 from typing import Optional
 
 logger = logging.getLogger("horaedb_tpu.mysql")
@@ -104,29 +105,44 @@ def _decode_param(
     body: bytes, off: int, ptype: int, unsigned: bool = False
 ) -> tuple[object, int]:
     """Decode one binary-protocol parameter value; returns (literal, off).
-    Integer/float types come back as Python numbers, the rest as str."""
+    Integer/float types come back as Python numbers, the rest as str.
+    Bounds are checked explicitly: int.from_bytes on a short slice decodes
+    a WRONG value silently, so truncation must be an error, never data."""
     signed = not unsigned
+
+    def need(k: int) -> None:
+        if off + k > len(body):
+            raise _StmtError("truncated parameter value")
+
     if ptype in (0x01,):  # TINY
+        need(1)
         return int.from_bytes(body[off:off + 1], "little", signed=signed), off + 1
     if ptype == 0x02:  # SHORT
+        need(2)
         return int.from_bytes(body[off:off + 2], "little", signed=signed), off + 2
     if ptype == 0x03:  # LONG
+        need(4)
         return int.from_bytes(body[off:off + 4], "little", signed=signed), off + 4
     if ptype == 0x08:  # LONGLONG
+        need(8)
         return int.from_bytes(body[off:off + 8], "little", signed=signed), off + 8
     if ptype == 0x04:  # FLOAT
-        import struct as _s
-        return _s.unpack("<f", body[off:off + 4])[0], off + 4
+        need(4)
+        return struct.unpack("<f", body[off:off + 4])[0], off + 4
     if ptype == 0x05:  # DOUBLE
-        import struct as _s
-        return _s.unpack("<d", body[off:off + 8])[0], off + 8
+        need(8)
+        return struct.unpack("<d", body[off:off + 8])[0], off + 8
     if ptype == 0x06:  # NULL (usually signalled via the bitmap instead)
         return None, off
     if ptype in (0x0F, 0xFD, 0xFE, 0xFC, 0xFB, 0xFA, 0xF9):  # strings/blobs
+        need(1)
         ln, off = _take_lenenc(body, off)
+        need(ln)
         return body[off:off + ln].decode("utf-8", "replace"), off + ln
     if ptype in (0x07, 0x0A, 0x0C):  # TIMESTAMP / DATE / DATETIME
+        need(1)
         ln = body[off]; off += 1
+        need(ln)
         y = mo = d = h = mi = s = 0
         if ln >= 4:
             y = int.from_bytes(body[off:off + 2], "little")
@@ -256,7 +272,7 @@ class _Conn:
             elif cmd == 0x17:  # COM_STMT_EXECUTE
                 try:
                     await self._stmt_execute(body)
-                except (_StmtError, IndexError, ValueError) as e:
+                except (_StmtError, IndexError, ValueError, struct.error) as e:
                     self._error(str(e) or "malformed COM_STMT_EXECUTE")
             elif cmd == 0x19:  # COM_STMT_CLOSE — no response by spec
                 if len(body) >= 4:

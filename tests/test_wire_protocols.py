@@ -652,3 +652,98 @@ class TestMysqlPreparedStatements:
             s.close()
 
         self._with_server(db, client)
+
+
+class TestReadDedup:
+    """Identical in-flight SELECTs share one execution (single-flight)."""
+
+    def test_concurrent_identical_selects_deduped(self, db):
+        import threading
+
+        gw = gateway_for(db)
+        calls = []
+        gate = threading.Event()
+        orig = type(gw.app["proxy"]).handle_sql
+
+        def slow_handle(self_, sql):
+            calls.append(sql)
+            gate.wait(5)  # park the leader so followers pile up
+            return orig(self_, sql)
+
+        async def body():
+            p = gw.app["proxy"]
+            p.handle_sql = slow_handle.__get__(p)
+            tasks = [
+                asyncio.ensure_future(gw.execute("SELECT count(*) AS c FROM wt"))
+                for _ in range(5)
+            ]
+            await asyncio.sleep(0.3)  # all five enter; one leader executes
+            gate.set()
+            return await asyncio.gather(*tasks)
+
+        results = run(body())
+        assert len(calls) == 1, calls  # one real execution
+        assert all(r == results[0] for r in results)
+        kind, (names, rows) = results[0]
+        assert kind == "rows" and rows[0]["c"] == 2
+
+    def test_writes_never_deduped(self, db):
+        gw = gateway_for(db)
+
+        async def body():
+            outs = await asyncio.gather(
+                gw.execute("INSERT INTO wt (host, v, ts) VALUES ('x', 1.0, 5000)"),
+                gw.execute("INSERT INTO wt (host, v, ts) VALUES ('x', 2.0, 6000)"),
+            )
+            return outs
+
+        outs = run(body())
+        assert all(k == "affected" and n == 1 for k, n in outs)
+        kind, (_, rows) = run(gw.execute("SELECT count(*) AS c FROM wt"))
+        assert rows[0]["c"] == 4  # both writes landed
+
+    def test_sequential_selects_not_shared_after_done(self, db):
+        gw = gateway_for(db)
+        run(gw.execute("INSERT INTO wt (host, v, ts) VALUES ('y', 9.0, 7000)"))
+        k1, (_, r1) = run(gw.execute("SELECT count(*) AS c FROM wt"))
+        run(gw.execute("INSERT INTO wt (host, v, ts) VALUES ('y', 9.5, 8000)"))
+        k2, (_, r2) = run(gw.execute("SELECT count(*) AS c FROM wt"))
+        assert r1[0]["c"] == 3 and r2[0]["c"] == 4  # fresh execution each time
+
+    def test_read_your_writes_after_interleaved_write(self, db):
+        """A SELECT issued after a write never joins a pre-write in-flight
+        execution (the dedup key carries a write epoch)."""
+        import threading
+
+        gw = gateway_for(db)
+        calls = []
+        gate = threading.Event()
+        orig = type(gw.app["proxy"]).handle_sql
+
+        def slow_select(self_, sql):
+            if sql.lstrip().lower().startswith("select"):
+                calls.append(sql)
+                gate.wait(5)
+            return orig(self_, sql)
+
+        async def body():
+            p = gw.app["proxy"]
+            p.handle_sql = slow_select.__get__(p)
+            stale = asyncio.ensure_future(
+                gw.execute("SELECT count(*) AS c FROM wt")
+            )
+            await asyncio.sleep(0.2)  # leader is parked pre-write
+            k, n = await gw.execute(
+                "INSERT INTO wt (host, v, ts) VALUES ('z', 7.0, 9000)"
+            )
+            assert (k, n) == ("affected", 1)
+            fresh = asyncio.ensure_future(
+                gw.execute("SELECT count(*) AS c FROM wt")
+            )
+            await asyncio.sleep(0.2)
+            gate.set()
+            return await stale, await fresh
+
+        (k1, (_, r1)), (k2, (_, r2)) = run(body())
+        assert len(calls) == 2, calls  # post-write SELECT ran fresh
+        assert r2[0]["c"] == 3  # sees its own write
