@@ -26,7 +26,7 @@ import numpy as np
 
 from ..common_types.row_group import RowGroup
 from .manifest import AddFile, AlterOptions, Flushed, MetaEdit
-from .memtable import ColumnarMemTable
+from .memtable import MemTable
 from .options import TableOptions, UpdateMode, suggest_segment_duration
 from .sst.manager import FileHandle
 from .sst.writer import SstWriter, WriteOptions
@@ -54,7 +54,7 @@ class Flusher:
                 return FlushResult(0, 0, table.version.flushed_sequence)
             return self._dump_memtables(frozen)
 
-    def _dump_memtables(self, memtables: list[ColumnarMemTable]) -> FlushResult:
+    def _dump_memtables(self, memtables: list[MemTable]) -> FlushResult:
         table = self.table
         parts: list[RowGroup] = []
         seqs: list[np.ndarray] = []

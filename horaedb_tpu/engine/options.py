@@ -64,6 +64,15 @@ class TableOptions:
     num_rows_per_row_group: int = 8192
     compression: str = "zstd"
     compaction_strategy: str = "time_window"  # or "size_tiered"
+    # "columnar" (default) or "layered" — layered freezes the mutable
+    # head into immutable pre-concatenated segments once it crosses
+    # mutable_segment_switch_threshold, so repeated scans re-convert only
+    # the small head (ref: memtable/layered/, table_options.rs:416,
+    # mutable_segment_switch_threshold lib.rs:94). "skiplist" is accepted
+    # as an alias for columnar: ordering here is imposed lazily by a
+    # device sort, so a row-ordered insert structure buys nothing on TPU.
+    memtable_type: str = "columnar"
+    mutable_segment_switch_threshold: int = 4 << 20
 
     @staticmethod
     def from_kv(kv: dict[str, str]) -> "TableOptions":
@@ -88,6 +97,15 @@ class TableOptions:
                 changes["compression"] = str(value).strip().lower()
             elif key == "compaction_strategy":
                 changes["compaction_strategy"] = str(value).strip().lower()
+            elif key == "memtable_type":
+                mt = str(value).strip().lower()
+                if mt == "skiplist":
+                    mt = "columnar"
+                if mt not in ("columnar", "layered"):
+                    raise ValueError(f"unknown memtable_type: {value!r}")
+                changes["memtable_type"] = mt
+            elif key == "mutable_segment_switch_threshold":
+                changes["mutable_segment_switch_threshold"] = parse_size_bytes(value)
             else:
                 raise ValueError(f"unknown table option: {raw_key!r}")
         return replace(opts, **changes)
@@ -102,6 +120,8 @@ class TableOptions:
             "num_rows_per_row_group": self.num_rows_per_row_group,
             "compression": self.compression,
             "compaction_strategy": self.compaction_strategy,
+            "memtable_type": self.memtable_type,
+            "mutable_segment_switch_threshold": self.mutable_segment_switch_threshold,
         }
 
     @staticmethod
@@ -115,6 +135,10 @@ class TableOptions:
             num_rows_per_row_group=d.get("num_rows_per_row_group", 8192),
             compression=d.get("compression", "zstd"),
             compaction_strategy=d.get("compaction_strategy", "time_window"),
+            memtable_type=d.get("memtable_type", "columnar"),
+            mutable_segment_switch_threshold=d.get(
+                "mutable_segment_switch_threshold", 4 << 20
+            ),
         )
 
 

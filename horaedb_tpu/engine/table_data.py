@@ -48,14 +48,14 @@ class TableData:
         self.writer_active = False
 
         if recovered_state is not None:
-            self.version = TableVersion(schema, recovered_state.levels)
+            self.version = TableVersion(schema, recovered_state.levels, options=options)
             self.version.flushed_sequence = recovered_state.flushed_sequence
             self._next_file_id = recovered_state.next_file_id
             self._last_sequence = max(
                 recovered_state.flushed_sequence, recovered_state.levels.max_sequence()
             )
         else:
-            self.version = TableVersion(schema)
+            self.version = TableVersion(schema, options=options)
             self._next_file_id = 1
             self._last_sequence = 0
         self.dropped = False

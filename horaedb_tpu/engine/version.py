@@ -18,7 +18,7 @@ from dataclasses import dataclass
 
 from ..common_types.schema import Schema
 from ..common_types.time_range import TimeRange
-from .memtable import ColumnarMemTable
+from .memtable import MemTable, make_memtable
 from .sst.manager import FileHandle, LevelsController
 
 
@@ -26,7 +26,7 @@ from .sst.manager import FileHandle, LevelsController
 class ReadView:
     """A consistent snapshot for one scan."""
 
-    memtables: tuple[ColumnarMemTable, ...]  # newest last
+    memtables: tuple[MemTable, ...]  # newest last
     ssts: tuple[FileHandle, ...]
 
     def is_empty(self) -> bool:
@@ -34,14 +34,27 @@ class ReadView:
 
 
 class TableVersion:
-    def __init__(self, schema: Schema, levels: LevelsController | None = None) -> None:
+    def __init__(
+        self,
+        schema: Schema,
+        levels: LevelsController | None = None,
+        options=None,
+    ) -> None:
         self._lock = threading.RLock()
         self._schema = schema
+        self._options = options  # drives memtable_type selection
         self._memtable_ids = itertools.count(1)
-        self._mutable = ColumnarMemTable(schema, next(self._memtable_ids))
-        self._immutables: list[ColumnarMemTable] = []
+        self._mutable = make_memtable(schema, next(self._memtable_ids), options)
+        self._immutables: list[MemTable] = []
         self.levels = levels if levels is not None else LevelsController()
         self.flushed_sequence = 0
+
+    def set_options(self, options) -> None:
+        """Keep option-driven choices (memtable_type, switch threshold)
+        in sync after ALTER TABLE SET options; applies to the NEXT
+        memtable switch, never retroactively."""
+        with self._lock:
+            self._options = options
 
     # ---- schema --------------------------------------------------------
     @property
@@ -49,7 +62,7 @@ class TableVersion:
         with self._lock:
             return self._schema
 
-    def alter_schema(self, schema: Schema) -> ColumnarMemTable | None:
+    def alter_schema(self, schema: Schema) -> MemTable | None:
         """Install a new schema. The mutable memtable holds rows of the old
         schema version, so a non-empty one is frozen for flush first."""
         with self._lock:
@@ -57,29 +70,29 @@ class TableVersion:
             if not self._mutable.is_empty():
                 frozen = self._switch_memtable_locked()
             self._schema = schema
-            self._mutable = ColumnarMemTable(schema, next(self._memtable_ids))
+            self._mutable = make_memtable(schema, next(self._memtable_ids), self._options)
             return frozen
 
     # ---- memtables -----------------------------------------------------
     @property
-    def mutable(self) -> ColumnarMemTable:
+    def mutable(self) -> MemTable:
         with self._lock:
             return self._mutable
 
-    def switch_memtable(self) -> ColumnarMemTable | None:
+    def switch_memtable(self) -> MemTable | None:
         """Freeze the mutable memtable (flush prep). None if empty."""
         with self._lock:
             if self._mutable.is_empty():
                 return None
             return self._switch_memtable_locked()
 
-    def _switch_memtable_locked(self) -> ColumnarMemTable:
+    def _switch_memtable_locked(self) -> MemTable:
         frozen = self._mutable
         self._immutables.append(frozen)
-        self._mutable = ColumnarMemTable(self._schema, next(self._memtable_ids))
+        self._mutable = make_memtable(self._schema, next(self._memtable_ids), self._options)
         return frozen
 
-    def immutables(self) -> list[ColumnarMemTable]:
+    def immutables(self) -> list[MemTable]:
         with self._lock:
             return list(self._immutables)
 

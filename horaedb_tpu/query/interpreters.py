@@ -331,6 +331,8 @@ class InterpreterFactory:
         ]
         if opts.enable_ttl and opts.ttl_ms:
             with_parts.append(f"ttl='{format_duration(opts.ttl_ms)}'")
+        if opts.memtable_type != "columnar":
+            with_parts.append(f"memtable_type='{opts.memtable_type}'")
         if opts.segment_duration_ms:
             with_parts.insert(0, f"segment_duration='{format_duration(opts.segment_duration_ms)}'")
         sql = (

@@ -317,6 +317,15 @@ def _base_fingerprint(table) -> tuple:
     return tuple(parts)
 
 
+def _append_newer(parts: list, rows, seqs, built: int) -> None:
+    """Append the sub-slice of rows written after the build point."""
+    if len(rows) == 0:
+        return
+    keep = seqs > built
+    if keep.any():
+        parts.append(rows if keep.all() else rows.filter(keep))
+
+
 def _read_delta(table, entry: CachedTableScan):
     """Memtable rows with sequence above the entry's build point, or None
     when the delta cannot be trusted (entry predates unknown state)."""
@@ -329,12 +338,16 @@ def _read_delta(table, entry: CachedTableScan):
             return None  # physical set changed (e.g. partition added)
         version = data.version
         for mem in [*version.immutables(), version.mutable]:
-            rows, seq = mem.scan(None)
-            if len(rows) == 0:
-                continue
-            keep = seq > built
-            if keep.any():
-                parts.append(rows if keep.all() else rows.filter(keep))
+            # snapshot() is uniform across memtable kinds: frozen segments
+            # (layered only) + the mutable head. Whole segments at or
+            # below the build point are skipped on their scalar max_seq —
+            # the delta never touches rows older than the cache entry.
+            segments, head_rows, head_seqs = mem.snapshot()
+            for seg in segments:
+                if seg.max_seq <= built:
+                    continue
+                _append_newer(parts, seg.rows, seg.seqs, built)
+            _append_newer(parts, head_rows, head_seqs, built)
     if not parts:
         # verified clean: an empty RowGroup with the table schema
         return entry.rows.slice(0, 0)

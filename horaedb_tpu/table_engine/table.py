@@ -129,6 +129,7 @@ class AnalyticTable(Table):
 
         with self.data.serial_lock:
             self.data.options = options
+            self.data.version.set_options(options)
             self.data.manifest.append_edits([AlterOptions(options.to_dict())])
 
     def physical_datas(self) -> list:
