@@ -6,15 +6,24 @@ device kernels).
 
 Supported grammar (the TSBS/dashboard workhorse subset):
 
-    expr     := agg 'by' '(' labels ')' '(' expr ')'
-              | agg '(' expr ')'
-              | func '(' selector ')'
-              | selector
+    expr     := addexpr
+    addexpr  := mulexpr (('+' | '-') mulexpr)*
+    mulexpr  := unary (('*' | '/' | '%') unary)*
+    unary    := number | '(' expr ')' | vector
+    vector   := agg 'by' '(' labels ')' '(' vector ')'
+              | agg '(' vector ')'          -- agg arg is a vector, not
+              | func '(' selector ')'       -- arithmetic: sum(a*2) is
+              | selector                    -- written sum(a) * 2
     agg      := sum | avg | min | max | count
     func     := rate | increase | avg_over_time | min_over_time | max_over_time
     selector := metric [ '{' matcher (',' matcher)* '}' ]
                 [ '[' duration ']' ] [ 'offset' duration ]
     matcher  := label ('=' | '!=' | '=~' | '!~') 'value'
+
+Binary expressions follow prom's arithmetic semantics: scalar/scalar,
+vector/scalar (applied per sample), and vector/vector one-to-one
+matching on identical label sets (samples without a partner drop out;
+``__name__`` is dropped from arithmetic results, like prom).
 
 Semantics notes:
 - the metric name maps to a table; its single DOUBLE field (or a column
@@ -58,13 +67,34 @@ class PromQuery:
     offset_ms: int = 0  # `offset 1h` shifts the evaluated window back
 
 
+@dataclass
+class PromScalar:
+    """A number literal in an expression (e.g. the 100 in x * 100)."""
+
+    value: float
+
+
+@dataclass
+class PromBin:
+    """Arithmetic over sub-expressions: vector/scalar applies per sample,
+    vector/vector matches one-to-one on identical label sets."""
+
+    op: str  # + - * / %
+    lhs: "PromExpr"
+    rhs: "PromExpr"
+
+
+PromExpr = PromQuery | PromScalar | PromBin
+
+
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:.]*"
 _TOKENS = re.compile(
     rf"""\s*(?:
       (?P<name>{_NAME})
     | (?P<dur>\d+(?:ms|s|m|h|d))
+    | (?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
     | (?P<string>'(?:[^'])*'|"(?:[^"])*")
-    | (?P<op>!=|=~|!~|[={{}}()\[\],])
+    | (?P<op>!=|=~|!~|[={{}}()\[\],+\-*/%])
     )""",
     re.VERBOSE,
 )
@@ -105,11 +135,44 @@ class _Parser:
         if tok != text:
             raise PromQLError(f"expected {text!r}, found {tok!r} in {self.q!r}")
 
-    def parse(self) -> PromQuery:
-        pq = self.expr()
+    def parse(self) -> PromExpr:
+        pq = self.addexpr()
         if self.peek()[0] is not None:
             raise PromQLError(f"trailing input after query: {self.q!r}")
         return pq
+
+    # precedence climbing: * / % bind tighter than + -
+    def addexpr(self) -> PromExpr:
+        node = self.mulexpr()
+        while self.peek() == ("op", "+") or self.peek() == ("op", "-"):
+            op = self.next()[1]
+            node = PromBin(op, node, self.mulexpr())
+        return node
+
+    def mulexpr(self) -> PromExpr:
+        node = self.unary()
+        while self.peek()[1] in ("*", "/", "%") and self.peek()[0] == "op":
+            op = self.next()[1]
+            node = PromBin(op, node, self.unary())
+        return node
+
+    def unary(self) -> PromExpr:
+        kind, tok = self.peek()
+        if kind == "number":
+            self.next()
+            return PromScalar(float(tok))
+        if (kind, tok) == ("op", "-"):
+            self.next()
+            inner = self.unary()
+            if isinstance(inner, PromScalar):
+                return PromScalar(-inner.value)
+            return PromBin("*", PromScalar(-1.0), inner)
+        if (kind, tok) == ("op", "("):
+            self.next()
+            node = self.addexpr()
+            self.expect(")")
+            return node
+        return self.expr()
 
     def expr(self) -> PromQuery:
         kind, tok = self.peek()
@@ -193,7 +256,7 @@ class _Parser:
         return pq
 
 
-def parse_promql(query: str) -> PromQuery:
+def parse_promql(query: str) -> PromExpr:
     return _Parser(query).parse()
 
 
@@ -235,9 +298,35 @@ def evaluate_range(
     step_ms: int,
 ) -> list[dict]:
     """-> prom 'matrix' result list for [start, end] at step resolution."""
+    combined = _range_series(conn, pq, start_ms, end_ms, step_ms)
+    out = []
+    for key, points in sorted(combined.items()):
+        out.append(
+            {
+                "metric": {"__name__": pq.metric, **{l: v for l, v in key}},
+                "values": [
+                    # repr = shortest round-trip form (full precision,
+                    # like prom's Go 'g' formatting)
+                    [b / 1000.0, repr(float(points[b]))]
+                    for b in sorted(points)
+                ],
+            }
+        )
+    return out
+
+
+def _range_series(
+    conn,
+    pq: PromQuery,
+    start_ms: int,
+    end_ms: int,
+    step_ms: int,
+) -> dict[tuple, dict[int, float]]:
+    """Per-series step-bucket values in REQUESTED-time space (offset
+    already stamped back), keyed by ((label, value), ...)."""
     table = conn.catalog.open(pq.metric)
     if table is None:
-        return []
+        return {}
     schema = table.schema
     value_col = _value_column(schema)
     tag_names = list(schema.tag_names)
@@ -338,21 +427,13 @@ def evaluate_range(
         for sub, buckets in bucketed.items():
             combined[sub] = {b: fn(vs) for b, vs in buckets.items()}
 
-    out = []
-    for key, points in sorted(combined.items()):
-        out.append(
-            {
-                "metric": {"__name__": pq.metric, **{l: v for l, v in key}},
-                "values": [
-                    # repr = shortest round-trip form (full precision,
-                    # like prom's Go 'g' formatting); offset stamps the
-                    # shifted window back at the requested times
-                    [(b + pq.offset_ms) / 1000.0, repr(float(points[b]))]
-                    for b in sorted(points)
-                ],
-            }
-        )
-    return out
+    if pq.offset_ms:
+        # offset stamps the shifted window back at the requested times
+        combined = {
+            key: {b + pq.offset_ms: v for b, v in points.items()}
+            for key, points in combined.items()
+        }
+    return combined
 
 
 def _regex_match(labels: dict, matchers: list[tuple[str, str, str]]) -> bool:
@@ -412,6 +493,158 @@ def _counter_series(
             buckets = {b: d / (step_ms / 1000.0) for b, d in buckets.items()}
         out[key] = buckets
     return out
+
+
+# ---- binary expressions --------------------------------------------------
+
+
+def _apply_op(op: str, a: float, b: float) -> float:
+    import math
+
+    try:
+        if op == "+":
+            return a + b
+        if op == "-":
+            return a - b
+        if op == "*":
+            return a * b
+        if op == "/":
+            return a / b
+        if op == "%":
+            if b == 0:
+                return math.nan  # prom: x % 0 -> NaN (fmod would raise)
+            return math.fmod(a, b)
+    except ZeroDivisionError:
+        # prom arithmetic: x/0 -> ±Inf, 0/0 -> NaN (never an error)
+        if a > 0:
+            return math.inf
+        if a < 0:
+            return -math.inf
+        return math.nan
+    raise PromQLError(f"unsupported operator {op!r}")
+
+
+def _eval_series(conn, node: PromExpr, start_ms: int, end_ms: int, step_ms: int):
+    """-> ('scalar', float) or ('vector', {key: {bucket: value}})."""
+    if isinstance(node, PromScalar):
+        return "scalar", node.value
+    if isinstance(node, PromQuery):
+        return "vector", _range_series(conn, node, start_ms, end_ms, step_ms)
+    lk, lv = _eval_series(conn, node.lhs, start_ms, end_ms, step_ms)
+    rk, rv = _eval_series(conn, node.rhs, start_ms, end_ms, step_ms)
+    op = node.op
+    if lk == "scalar" and rk == "scalar":
+        return "scalar", _apply_op(op, lv, rv)
+    if rk == "scalar":
+        return "vector", {
+            key: {b: _apply_op(op, v, rv) for b, v in pts.items()}
+            for key, pts in lv.items()
+        }
+    if lk == "scalar":
+        return "vector", {
+            key: {b: _apply_op(op, lv, v) for b, v in pts.items()}
+            for key, pts in rv.items()
+        }
+    # vector/vector: one-to-one on identical label sets; samples without
+    # a partner (either side) drop out, matching prom's default matching
+    out: dict[tuple, dict[int, float]] = {}
+    for key, lpts in lv.items():
+        rpts = rv.get(key)
+        if rpts is None:
+            continue
+        pts = {
+            b: _apply_op(op, v, rpts[b]) for b, v in lpts.items() if b in rpts
+        }
+        if pts:
+            out[key] = pts
+    return "vector", out
+
+
+def leaf_metrics(node: PromExpr) -> list[str]:
+    """Metric names referenced by an expression, left to right."""
+    if isinstance(node, PromQuery):
+        return [node.metric]
+    if isinstance(node, PromBin):
+        return leaf_metrics(node.lhs) + leaf_metrics(node.rhs)
+    return []
+
+
+def evaluate_expr_range(
+    conn, node: PromExpr, start_ms: int, end_ms: int, step_ms: int
+) -> list[dict]:
+    """Range-evaluate any expression -> prom 'matrix'. Leaf queries keep
+    their metric name; arithmetic results drop __name__ (like prom)."""
+    if isinstance(node, PromQuery):
+        return evaluate_range(conn, node, start_ms, end_ms, step_ms)
+    kind, val = _eval_series(conn, node, start_ms, end_ms, step_ms)
+    if kind == "scalar":
+        # a constant series sampled at each aligned step
+        first = (start_ms // step_ms) * step_ms
+        if first < start_ms:
+            first += step_ms
+        buckets = list(range(first, end_ms + 1, step_ms))
+        return [
+            {
+                "metric": {},
+                "values": [[b / 1000.0, repr(float(val))] for b in buckets],
+            }
+        ]
+    out = []
+    for key, points in sorted(val.items()):
+        out.append(
+            {
+                "metric": {l: v for l, v in key},
+                "values": [
+                    [b / 1000.0, repr(float(points[b]))] for b in sorted(points)
+                ],
+            }
+        )
+    return out
+
+
+def _instant_value(conn, node: PromExpr, time_ms: int):
+    """-> ('scalar', float) or ('vector', {label_key: float}).
+
+    Every metric leaf evaluates with ITS OWN instant semantics (its own
+    range window; rate folds its whole range, raw selectors take the
+    latest sample) — mixing rate(x[4m]) with a raw selector never shrinks
+    the rate's window. Keys exclude __name__, matching prom's one-to-one
+    rule that arithmetic ignores the metric name."""
+    if isinstance(node, PromScalar):
+        return "scalar", node.value
+    if isinstance(node, PromQuery):
+        vec = {}
+        for s in evaluate_instant(conn, node, time_ms):
+            key = tuple(
+                sorted((k, v) for k, v in s["metric"].items() if k != "__name__")
+            )
+            vec[key] = float(s["value"][1])
+        return "vector", vec
+    lk, lv = _instant_value(conn, node.lhs, time_ms)
+    rk, rv = _instant_value(conn, node.rhs, time_ms)
+    op = node.op
+    if lk == "scalar" and rk == "scalar":
+        return "scalar", _apply_op(op, lv, rv)
+    if rk == "scalar":
+        return "vector", {k: _apply_op(op, v, rv) for k, v in lv.items()}
+    if lk == "scalar":
+        return "vector", {k: _apply_op(op, lv, v) for k, v in rv.items()}
+    return "vector", {
+        k: _apply_op(op, v, rv[k]) for k, v in lv.items() if k in rv
+    }
+
+
+def evaluate_expr_instant(conn, node: PromExpr, time_ms: int) -> list[dict]:
+    """Instant-evaluate any expression -> prom 'vector'."""
+    if isinstance(node, PromQuery):
+        return evaluate_instant(conn, node, time_ms)
+    kind, val = _instant_value(conn, node, time_ms)
+    if kind == "scalar":
+        return [{"metric": {}, "value": [time_ms / 1000.0, repr(float(val))]}]
+    return [
+        {"metric": dict(key), "value": [time_ms / 1000.0, repr(float(v))]}
+        for key, v in sorted(val.items())
+    ]
 
 
 DEFAULT_LOOKBACK_MS = 5 * 60_000  # prom's 5m instant lookback

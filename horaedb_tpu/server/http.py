@@ -552,8 +552,9 @@ def create_app(
         """
         from ..proxy.promql import (
             PromQLError,
-            evaluate_instant,
-            evaluate_range,
+            evaluate_expr_instant,
+            evaluate_expr_range,
+            leaf_metrics,
             parse_promql,
         )
 
@@ -571,13 +572,34 @@ def create_app(
         except PromQLError as e:
             return web.json_response({"status": "error", "error": str(e)}, status=400)
         # Same routing + limiter/hotspot/metrics discipline as /sql.
-        forwarded = await _forward_if_remote(request, pq.metric)
-        if forwarded is not None:
-            return forwarded
+        # Expressions route on their leaf metrics: forwarding applies when
+        # every leaf lives on the same (remote) node; mixed-owner
+        # expressions evaluate here over the forwarding SQL layer.
+        metrics = leaf_metrics(pq)
+        if len(set(metrics)) == 1:
+            forwarded = await _forward_if_remote(request, metrics[0])
+            if forwarded is not None:
+                return forwarded
+        elif router is not None and any(
+            not router.route(m).is_local for m in set(metrics)
+        ):
+            # A multi-metric expression whose leaves live on different
+            # nodes would need a cross-node vector join — evaluating it
+            # locally would silently produce empty/partial series, so
+            # refuse loudly instead.
+            return web.json_response(
+                {
+                    "status": "error",
+                    "error": "expression spans tables owned by other nodes; "
+                    "query it against the owning node",
+                },
+                status=400,
+            )
         try:
             proxy._m_queries.inc()
-            proxy.limiter.check(pq.metric)
-            proxy.hotspot.record(pq.metric, False)
+            for m in set(metrics):
+                proxy.limiter.check(m)
+                proxy.hotspot.record(m, False)
 
             def run():
                 if is_range:
@@ -596,13 +618,13 @@ def create_app(
                     )
                     if step <= 0:
                         raise PromQLError("step must be positive")
-                    result = evaluate_range(conn, pq, start, end, step)
+                    result = evaluate_expr_range(conn, pq, start, end, step)
                     return {"resultType": "matrix", "result": result}
                 import time as _time
 
                 # Prometheus defaults the evaluation time to "now".
                 t = int(float(params.get("time", _time.time())) * 1000)
-                result = evaluate_instant(conn, pq, t)
+                result = evaluate_expr_instant(conn, pq, t)
                 return {"resultType": "vector", "result": result}
 
             data = await asyncio.get_running_loop().run_in_executor(None, run)

@@ -446,7 +446,9 @@ class TestPartitionPlacement:
             )
             return out if status == 200 and out.get("affected_rows") == 160 else None
 
-        wait_until(insert_lands, timeout=20, desc="scattered insert accepted")
+        # generous: under full-suite CPU load heartbeat rounds stretch to
+        # seconds and shard orders propagate slowly (passes in ~2s alone)
+        wait_until(insert_lands, timeout=60, desc="scattered insert accepted")
 
         import numpy as np
 
@@ -474,7 +476,7 @@ class TestPartitionPlacement:
                         return None
             return True
 
-        wait_until(both_nodes_agree, timeout=20, desc="partitioned query both nodes")
+        wait_until(both_nodes_agree, timeout=60, desc="partitioned query both nodes")
 
         # drop cleans up every partition cluster-wide
         status, out = sql(port_a, "DROP TABLE ppt")

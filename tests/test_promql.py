@@ -241,3 +241,141 @@ class TestRound2Features:
         points = {v[0]: v[1] for v in out[0]["values"]}
         assert points.get(60.0) == "10.0", points
         assert 0.0 not in points  # single-sample bucket emits no point
+
+
+class TestBinaryExpressions:
+    """Arithmetic over expressions: scalar, vector/scalar, vector/vector
+    one-to-one (ref: the reference supports full PromQL via its planner;
+    this covers prom's arithmetic semantics on the translated subset)."""
+
+    def test_parse_precedence(self):
+        from horaedb_tpu.proxy.promql import PromBin, PromScalar
+
+        e = parse_promql("cpu * 2 + 1")
+        assert isinstance(e, PromBin) and e.op == "+"
+        assert isinstance(e.lhs, PromBin) and e.lhs.op == "*"
+        assert isinstance(e.rhs, PromScalar) and e.rhs.value == 1.0
+        e2 = parse_promql("cpu * (2 + 1)")
+        assert e2.op == "*" and e2.rhs.op == "+"
+        e3 = parse_promql("-3")
+        assert isinstance(e3, PromScalar) and e3.value == -3.0
+
+    def test_vector_times_scalar(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        out = evaluate_expr_range(
+            db, parse_promql('cpu{host="h1"} * 100'), 0, 3 * MIN, MIN
+        )
+        assert len(out) == 1
+        assert out[0]["metric"] == {"host": "h1", "region": "e"}  # __name__ dropped
+        vals = [float(v) for _, v in out[0]["values"]]
+        assert vals == [1000.0, 1100.0, 1200.0, 1300.0]
+
+    def test_scalar_minus_vector_order(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        out = evaluate_expr_range(
+            db, parse_promql('100 - cpu{host="h1"}'), 0, 0, MIN
+        )
+        assert [float(v) for _, v in out[0]["values"]] == [90.0]
+
+    def test_vector_vector_one_to_one(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        # cpu / cpu == 1 for every series/bucket, labels preserved
+        out = evaluate_expr_range(db, parse_promql("cpu / cpu"), 0, 3 * MIN, MIN)
+        assert len(out) == 3
+        for series in out:
+            assert all(float(v) == 1.0 for _, v in series["values"])
+
+    def test_vector_vector_drops_unmatched(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        db.execute(
+            "CREATE TABLE mem (host string TAG, region string TAG, "
+            "value double NOT NULL, ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+        )
+        db.execute(
+            "INSERT INTO mem (host, region, value, ts) VALUES "
+            f"('h1', 'e', 50.0, 0), ('h1', 'e', 50.0, {MIN})"
+        )
+        out = evaluate_expr_range(db, parse_promql("cpu + mem"), 0, 3 * MIN, MIN)
+        # only h1 exists in both; only buckets 0 and 1 match
+        assert len(out) == 1 and out[0]["metric"]["host"] == "h1"
+        assert [float(v) for _, v in out[0]["values"]] == [60.0, 61.0]
+
+    def test_divide_by_zero_inf(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        out = evaluate_expr_range(db, parse_promql('cpu{host="h1"} / 0'), 0, 0, MIN)
+        assert float(out[0]["values"][0][1]) == float("inf")
+
+    def test_scalar_only_range(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        out = evaluate_expr_range(db, parse_promql("3 * 4"), 0, 2 * MIN, MIN)
+        assert out[0]["metric"] == {}
+        assert [float(v) for _, v in out[0]["values"]] == [12.0, 12.0, 12.0]
+
+    def test_instant_expression(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant
+
+        out = evaluate_expr_instant(db, parse_promql('cpu{host="h1"} * 2'), 3 * MIN)
+        assert len(out) == 1
+        assert float(out[0]["value"][1]) == 26.0  # latest (13.0) * 2
+
+    def test_rate_times_scalar_instant(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant
+
+        out = evaluate_expr_instant(
+            db, parse_promql('rate(cpu{host="h1"}[4m]) * 60'), 4 * MIN
+        )
+        # 3 unit increases over the 4m window: rate = 3/240s; *60 = 0.75
+        assert len(out) == 1
+        assert abs(float(out[0]["value"][1]) - 0.75) < 1e-9
+
+    def test_http_endpoint_expression(self):
+        async def run_test():
+            conn = horaedb_tpu.connect(None)
+            conn.execute(
+                "CREATE TABLE m1 (host string TAG, value double NOT NULL, "
+                "ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+            )
+            conn.execute(
+                f"INSERT INTO m1 (host, value, ts) VALUES ('a', 5.0, 0), ('a', 7.0, {MIN})"
+            )
+            app = create_app(conn)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                resp = await client.get(
+                    "/prom/v1/query_range",
+                    params={"query": "m1 * 10 + 5", "start": "0", "end": "60", "step": "60"},
+                )
+                body = await resp.json()
+                assert resp.status == 200, body
+                series = body["data"]["result"]
+                assert [float(v) for _, v in series[0]["values"]] == [55.0, 75.0]
+            finally:
+                await client.close()
+            conn.close()
+
+        asyncio.run(run_test())
+
+    def test_mod_zero_nan(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+        import math
+
+        out = evaluate_expr_range(db, parse_promql('cpu{host="h1"} % 0'), 0, 0, MIN)
+        assert math.isnan(float(out[0]["values"][0][1]))
+
+    def test_instant_mixed_rate_and_raw_keeps_rate_window(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant
+
+        # rate leaf keeps its full 4m window even next to a raw selector:
+        # rate = 3 increases / 240s; raw cpu latest = 13 -> sum = 13.0125
+        out = evaluate_expr_instant(
+            db, parse_promql('rate(cpu{host="h1"}[4m]) + cpu{host="h1"}'), 4 * MIN
+        )
+        assert len(out) == 1
+        assert abs(float(out[0]["value"][1]) - (3 / 240 + 13.0)) < 1e-9
