@@ -17,6 +17,7 @@ from typing import Any
 from ..catalog import Catalog
 from ..common_types.row_group import RowGroup
 from .auto_create import ensure_table
+from .promql import sql_str_literal
 
 TIME_COLUMN = "timestamp"
 VALUE_COLUMN = "value"
@@ -137,8 +138,6 @@ def evaluate_query(conn, body: Any) -> list[dict]:
             # aggregator (else same-second buckets would overwrite).
             width, dfunc = 1000, agg
 
-        from .promql import sql_str_literal
-
         conds = " AND ".join(
             f"`{k}` = {sql_str_literal(v)}" for k, v in tags.items()
         )
@@ -201,3 +200,90 @@ def evaluate_query(conn, body: Any) -> list[dict]:
             }
         )
     return out
+
+
+def suggest(conn, kind: str, q: str = "", max_results: int = 25) -> list[str]:
+    """/api/suggest (ref: the OpenTSDB autocomplete API the reference's
+    opentsdb shim targets): prefix-complete metric names, tag keys, or
+    tag values across every metric table."""
+    catalog = conn.catalog
+    names: set[str] = set()
+    if kind == "metrics":
+        names.update(catalog.table_names())
+    elif kind == "tagk":
+        for t in catalog.table_names():
+            table = catalog.open(t)
+            if table is not None:
+                names.update(table.schema.tag_names)
+    elif kind == "tagv":
+        # prefix pushed into the scan as a range ([q, q+1)) so LIMIT
+        # never truncates matching values hiding past unrelated ones
+        where = ""
+        if q:
+            hi = q[:-1] + chr(ord(q[-1]) + 1)
+            where = (
+                f" WHERE {{tag}} >= {sql_str_literal(q)}"
+                f" AND {{tag}} < {sql_str_literal(hi)}"
+            )
+        for t in catalog.table_names():
+            table = catalog.open(t)
+            if table is None:
+                continue
+            for tag in table.schema.tag_names:
+                rows = conn.execute(
+                    f"SELECT DISTINCT `{tag}` FROM `{t}`"
+                    + where.format(tag=f"`{tag}`")
+                    + f" LIMIT {max_results}"
+                ).to_pylist()
+                names.update(
+                    str(r[tag]) for r in rows if r[tag] is not None
+                )
+    else:
+        raise OpenTsdbError(f"unknown suggest type {kind!r}")
+    hits = sorted(n for n in names if n.startswith(q))
+    return hits[:max_results]
+
+
+def lookup(conn, metric: str, tag_filters: list[dict], limit: int = 25) -> dict:
+    """/api/search/lookup: enumerate time series (tag combinations) of a
+    metric, optionally filtered by tag=value pairs ('*' matches any)."""
+    table = conn.catalog.open(metric)
+    if table is None:
+        raise OpenTsdbError(f"unknown metric {metric!r}")
+    tag_names = list(table.schema.tag_names)
+    for f in tag_filters:
+        if f.get("key") not in tag_names:
+            raise OpenTsdbError(f"unknown tag key {f.get('key')!r} on {metric!r}")
+    if not tag_names:
+        # a tag-less metric is exactly one series
+        return {
+            "type": "LOOKUP",
+            "metric": metric,
+            "limit": limit,
+            "totalResults": 1,
+            "results": [{"metric": metric, "tags": {}}],
+        }
+    where = []
+    for f in tag_filters:
+        key, value = f.get("key"), f.get("value")
+        if value and value != "*":
+            where.append(f"`{key}` = {sql_str_literal(value)}")
+    sql = (
+        "SELECT DISTINCT "
+        + ", ".join(f"`{t}`" for t in tag_names)
+        + f" FROM `{metric}`"
+    )
+    if where:
+        sql += f" WHERE {' AND '.join(where)}"
+    rows = conn.execute(sql).to_pylist()
+    results = [
+        {"metric": metric, "tags": {k: r[k] for k in tag_names if r[k] is not None}}
+        for r in rows[:limit]
+    ]
+    return {
+        "type": "LOOKUP",
+        "metric": metric,
+        "limit": limit,
+        "totalResults": len(rows),
+        "results": results,
+    }

@@ -888,8 +888,97 @@ def create_app(
     app.router.add_post("/influxdb/v1/write", influx_write)
     app.router.add_get("/influxdb/v1/query", influx_query)
     app.router.add_post("/influxdb/v1/query", influx_query)
+    async def opentsdb_suggest(request: web.Request) -> web.Response:
+        """OpenTSDB /api/suggest — metric/tagk/tagv autocomplete."""
+        from ..proxy.opentsdb import OpenTsdbError, suggest
+
+        kind = request.query.get("type", "metrics")
+        q = request.query.get("q", "")
+        try:
+            mx = min(int(request.query.get("max", "25")), 1000)
+        except ValueError:
+            return web.json_response({"error": "bad 'max'"}, status=400)
+        conn_ = request.app["conn"]
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, suggest, conn_, kind, q, mx
+            )
+        except OpenTsdbError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(out)
+
+    async def opentsdb_lookup(request: web.Request) -> web.Response:
+        """OpenTSDB /api/search/lookup — enumerate a metric's series."""
+        from ..proxy.opentsdb import OpenTsdbError, lookup
+
+        try:
+            if request.method == "POST":
+                try:
+                    body = await request.json()
+                except ValueError:
+                    return web.json_response({"error": "invalid JSON"}, status=400)
+                metric = body.get("metric")
+                tag_filters = body.get("tags") or []
+                limit = int(body.get("limit", 25))
+            else:
+                # GET ?m=metric{k=v,k2=*}
+                m = request.query.get("m", "")
+                metric, _, tagspec = m.partition("{")
+                tag_filters = []
+                if tagspec:
+                    if not tagspec.endswith("}"):
+                        return web.json_response(
+                            {"error": f"malformed tag spec in m={m!r}"},
+                            status=400,
+                        )
+                    for pair in filter(None, tagspec[:-1].split(",")):
+                        k, _, v = pair.partition("=")
+                        tag_filters.append({"key": k.strip(), "value": v.strip()})
+                limit = int(request.query.get("limit", "25"))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "bad 'limit'"}, status=400)
+        if not metric:
+            return web.json_response({"error": "missing metric"}, status=400)
+        if router is not None and not router.route(metric).is_local:
+            # forward in canonical POST form — the raw-body forwarder
+            # would POST a GET's empty body and lose the query string
+            route = router.route(metric)
+            if request.headers.get(FORWARD_HEADER):
+                return web.json_response(
+                    {"error": f"routing loop for {metric!r}"}, status=502
+                )
+            import aiohttp
+
+            try:
+                session = await _client_session(request.app)
+                async with session.post(
+                    f"http://{route.endpoint}/opentsdb/api/search/lookup",
+                    json={"metric": metric, "tags": tag_filters, "limit": limit},
+                    headers={FORWARD_HEADER: "1"},
+                    timeout=aiohttp.ClientTimeout(total=30),
+                ) as resp:
+                    return web.json_response(
+                        await resp.json(content_type=None), status=resp.status
+                    )
+            except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+                return web.json_response(
+                    {"error": f"forward to {route.endpoint} failed: {e}"},
+                    status=502,
+                )
+        conn_ = request.app["conn"]
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, lookup, conn_, metric, tag_filters, limit
+            )
+        except OpenTsdbError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(out)
+
     app.router.add_post("/opentsdb/api/put", opentsdb_put)
     app.router.add_post("/opentsdb/api/query", opentsdb_query)
+    app.router.add_get("/opentsdb/api/suggest", opentsdb_suggest)
+    app.router.add_get("/opentsdb/api/search/lookup", opentsdb_lookup)
+    app.router.add_post("/opentsdb/api/search/lookup", opentsdb_lookup)
     app.router.add_post("/prom/v1/read", prom_remote_read)
     app.router.add_post("/api/v1/read", prom_remote_read)
     app.router.add_get("/prom/v1/query_range", prom_query)

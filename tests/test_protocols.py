@@ -567,3 +567,106 @@ class TestInfluxQLShow:
             assert s["values"] == [["host", "a"], ["host", "b"]]
 
         with_client(body)
+
+
+class TestOpenTsdbSuggestLookup:
+    """/api/suggest + /api/search/lookup (ref: the OpenTSDB surface the
+    reference's opentsdb shim targets)."""
+
+    def _seed(self, conn):
+        conn.execute(
+            "CREATE TABLE sys_cpu (host string TAG, dc string TAG, "
+            "value double, ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+        )
+        conn.execute(
+            "CREATE TABLE sys_mem (host string TAG, value double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+        )
+        conn.execute(
+            "INSERT INTO sys_cpu (host, dc, value, ts) VALUES "
+            "('a', 'east', 1.0, 1000), ('b', 'west', 2.0, 1000), "
+            "('a', 'west', 3.0, 2000)"
+        )
+
+    def test_suggest_metrics_tagk_tagv(self):
+        async def body(client, conn):
+            self._seed(conn)
+            resp = await client.get("/opentsdb/api/suggest?type=metrics&q=sys_")
+            assert resp.status == 200
+            assert await resp.json() == ["sys_cpu", "sys_mem"]
+            resp = await client.get("/opentsdb/api/suggest?type=metrics&q=sys_c")
+            assert await resp.json() == ["sys_cpu"]
+            resp = await client.get("/opentsdb/api/suggest?type=tagk")
+            assert set(await resp.json()) == {"host", "dc"}
+            resp = await client.get("/opentsdb/api/suggest?type=tagv&q=e")
+            assert "east" in await resp.json()
+            resp = await client.get("/opentsdb/api/suggest?type=bogus")
+            assert resp.status == 400
+
+        with_client(body)
+
+    def test_lookup_post_and_get(self):
+        async def body(client, conn):
+            self._seed(conn)
+            resp = await client.post(
+                "/opentsdb/api/search/lookup",
+                json={"metric": "sys_cpu", "tags": [{"key": "dc", "value": "west"}]},
+            )
+            assert resp.status == 200
+            out = await resp.json()
+            assert out["metric"] == "sys_cpu" and out["totalResults"] == 2
+            assert all(r["tags"]["dc"] == "west" for r in out["results"])
+            # GET form with m=metric{k=v}
+            resp = await client.get(
+                "/opentsdb/api/search/lookup?m=sys_cpu{host=a}"
+            )
+            out = await resp.json()
+            assert out["totalResults"] == 2
+            assert all(r["tags"]["host"] == "a" for r in out["results"])
+            # wildcard matches everything
+            resp = await client.get("/opentsdb/api/search/lookup?m=sys_cpu{dc=*}")
+            assert (await resp.json())["totalResults"] == 3
+            # unknown metric / tag key -> clean 400
+            resp = await client.get("/opentsdb/api/search/lookup?m=nope")
+            assert resp.status == 400
+            resp = await client.post(
+                "/opentsdb/api/search/lookup",
+                json={"metric": "sys_cpu", "tags": [{"key": "zz", "value": "x"}]},
+            )
+            assert resp.status == 400
+
+        with_client(body)
+
+    def test_dotted_metric_and_edge_cases(self):
+        async def body(client, conn):
+            # dotted metric names (the OpenTSDB convention) via /api/put
+            resp = await client.post(
+                "/opentsdb/api/put",
+                json={"metric": "sys.cpu.user", "timestamp": 1,
+                      "value": 1.5, "tags": {"host": "x"}},
+            )
+            assert resp.status == 204, await resp.text()
+            resp = await client.get("/opentsdb/api/suggest?type=metrics&q=sys.")
+            assert await resp.json() == ["sys.cpu.user"]
+            resp = await client.get("/opentsdb/api/suggest?type=tagv&q=x")
+            assert "x" in await resp.json()
+            resp = await client.get(
+                "/opentsdb/api/search/lookup?m=sys.cpu.user{host=x}"
+            )
+            assert (await resp.json())["totalResults"] == 1
+            # tag-less metric is one series
+            resp = await client.post(
+                "/opentsdb/api/put",
+                json={"metric": "bare", "timestamp": 1, "value": 2.0, "tags": {}},
+            )
+            assert resp.status == 204
+            resp = await client.get("/opentsdb/api/search/lookup?m=bare")
+            out = await resp.json()
+            assert out["totalResults"] == 1 and out["results"][0]["tags"] == {}
+            # malformed tag spec / bad limit -> clean 400s
+            resp = await client.get("/opentsdb/api/search/lookup?m=bare{host=a")
+            assert resp.status == 400
+            resp = await client.get("/opentsdb/api/search/lookup?m=bare&limit=zz")
+            assert resp.status == 400
+
+        with_client(body)
