@@ -126,15 +126,96 @@ def scan_sources(
         rows, seq = mem.scan(predicate)
         if len(rows):
             if projection is not None:
-                keep = proj_schema.names()
-                rows = RowGroup(
-                    proj_schema,
-                    {k: rows.columns[k] for k in keep},
-                    {k: v for k, v in rows.validity.items() if k in keep},
-                )
+                rows = _project_rows(rows, proj_schema)
             parts.append(rows)
             versions.append(seq)
     return parts, versions
+
+
+def _project_rows(rows: RowGroup, proj_schema: Schema) -> RowGroup:
+    """Restrict memtable rows to the projected schema (shared by the
+    full scan and the limited scan — keep the two paths identical)."""
+    keep = proj_schema.names()
+    return RowGroup(
+        proj_schema,
+        {k: rows.columns[k] for k in keep},
+        {k: v for k, v in rows.validity.items() if k in keep},
+    )
+
+
+def _empty_rows(schema: Schema) -> RowGroup:
+    return RowGroup(
+        schema,
+        {c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in schema.columns},
+    )
+
+
+def _limited_append_scan(
+    view: ReadView,
+    schema: Schema,
+    predicate: Predicate,
+    store: ObjectStore,
+    projection: Optional[Sequence[str]] = None,
+) -> RowGroup:
+    """Early-stopping scan for APPEND tables with a pushed-down limit.
+
+    Sources are consumed incrementally — memtables first (already in
+    memory), then SSTs — and reading stops as soon as ``limit`` exact-
+    time-filtered rows are collected, so a LIMIT 10 over a year of SSTs
+    opens one file instead of hundreds. Remote stores fetch SSTs in
+    concurrent batches (same prefetch rationale as scan_sources) so the
+    early stop doesn't trade away latency hiding. May return MORE than
+    limit rows (the executor slices); never fewer than available.
+    """
+    limit = predicate.limit or 0
+    tr = predicate.time_range
+    parts: list[RowGroup] = []
+    total = 0
+
+    def add(rows: RowGroup) -> bool:
+        nonlocal total
+        ts = rows.timestamps
+        mask = (ts >= tr.inclusive_start) & (ts < tr.exclusive_end)
+        if not mask.all():
+            rows = rows.take(np.nonzero(mask)[0])
+        if len(rows):
+            parts.append(rows)
+            total += len(rows)
+        return total >= limit
+
+    proj_schema = project_schema(schema, projection)
+    done = False
+    for mem in view.memtables:
+        rows, _seq = mem.scan(predicate)
+        if projection is not None and len(rows):
+            rows = _project_rows(rows, proj_schema)
+        if add(rows):
+            done = True
+            break
+    if not done:
+        def read_one(handle):
+            return SstReader(store, handle.path).read(
+                schema, predicate, projection=projection
+            )
+
+        from ..utils.object_store import LocalDiskStore, MemoryStore
+
+        remote = not isinstance(store, (LocalDiskStore, MemoryStore))
+        batch = 4 if remote else 1  # overlap network fetches per round
+        ssts = list(view.ssts)
+        for i in range(0, len(ssts), batch):
+            chunk = ssts[i:i + batch]
+            if remote and len(chunk) > 1:
+                from ..utils.runtime import io_pool
+
+                results = list(io_pool().map(read_one, chunk))
+            else:
+                results = [read_one(h) for h in chunk]
+            if any(add(r) for r in results):
+                break
+    if not parts:
+        return _empty_rows(proj_schema)
+    return RowGroup.concat(parts) if len(parts) > 1 else parts[0]
 
 
 def merge_read(
@@ -155,6 +236,11 @@ def merge_read(
     of a key would let an older version in another source survive dedup.
     Time-range pruning stays on everywhere (timestamp is a key column).
     """
+    if update_mode is UpdateMode.APPEND and predicate.limit is not None:
+        # LIMIT pushdown: append tables never dedup, so ANY n matching
+        # rows are a correct answer — stop opening SSTs once collected
+        # (ref: the reference's ScanRequest carries a fetch limit).
+        return _limited_append_scan(view, schema, predicate, store, projection)
     dedup_scan = update_mode is not UpdateMode.APPEND and (
         len(view.ssts) + len(view.memtables) > 1
     )
@@ -171,8 +257,7 @@ def merge_read(
     parts, versions = scan_sources(view, schema, scan_pred, store, projection)
     out_schema = parts[0].schema if parts else project_schema(schema, projection)
     if not parts:
-        empty = {c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in out_schema.columns}
-        return RowGroup(out_schema, empty)
+        return _empty_rows(out_schema)
 
     rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
     version = np.concatenate(versions)

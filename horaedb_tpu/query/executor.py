@@ -231,7 +231,20 @@ class Executor:
                 return self._finish_metrics(m, t_start, "device-partial", out)
         t_scan = _time.perf_counter()
         projection = self._projection(plan)
-        rows = table.read(plan.predicate, projection=projection)
+        predicate = plan.predicate
+        if not plan.is_aggregate and self._limit_pushdown_safe(plan):
+            # LIMIT pushdown: the scan may stop early. Only when no
+            # residual WHERE / ORDER BY / DISTINCT needs the complete set.
+            predicate = predicate.with_limit(plan.select.limit)
+            from ..engine.options import UpdateMode
+
+            if getattr(
+                getattr(table, "options", None), "update_mode", None
+            ) is UpdateMode.APPEND:
+                # only the append scan actually early-stops; don't claim
+                # the optimization on dedup scans that ignore the hint
+                m["limit_pushdown"] = plan.select.limit
+        rows = table.read(predicate, projection=projection)
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)
         if plan.is_aggregate and self._device_capable(plan, rows):
@@ -312,6 +325,19 @@ class Executor:
         for c in keep[1:]:
             out = ast.BinaryOp("AND", out, c)
         return out
+
+    def _limit_pushdown_safe(self, plan: QueryPlan) -> bool:
+        """True when the scan may stop at LIMIT rows without changing the
+        result: no ORDER BY / DISTINCT / join / GROUP BY (those need every
+        row), and no residual WHERE — _residual_where is the single source
+        of truth for "what storage did NOT apply", so a limit pushes down
+        exactly when the projection has nothing left to filter."""
+        sel = plan.select
+        if sel is None or sel.limit is None:
+            return False
+        if sel.order_by or sel.distinct or sel.join is not None or sel.group_by:
+            return False
+        return self._residual_where(plan) is None
 
     def _try_partitioned_agg(self, plan: QueryPlan, table, m: dict) -> Optional[ResultSet]:
         from .partial import assemble_result, combine_partials, spec_from_plan

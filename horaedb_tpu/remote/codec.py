@@ -83,6 +83,7 @@ def predicate_to_dict(p: Predicate) -> dict:
     return {
         "time_range": [int(p.time_range.inclusive_start), int(p.time_range.exclusive_end)],
         "filters": [[f.column, f.op.value, _plain(f.value)] for f in p.filters],
+        "limit": p.limit,
     }
 
 
@@ -92,7 +93,7 @@ def predicate_from_dict(d: dict) -> Predicate:
         ColumnFilter(c, FilterOp(op), tuple(v) if isinstance(v, list) else v)
         for c, op, v in d.get("filters", ())
     )
-    return Predicate(TimeRange(lo, hi), filters)
+    return Predicate(TimeRange(lo, hi), filters, d.get("limit"))
 
 
 def _plain(v):

@@ -81,13 +81,22 @@ class ColumnFilter:
 class Predicate:
     time_range: TimeRange = field(default_factory=TimeRange.min_to_max)
     filters: tuple[ColumnFilter, ...] = ()
+    # Scan hint: the reader may stop once this many matching rows are
+    # collected (LIMIT pushdown, ref: the reference pushes fetch limits
+    # into ScanRequest). Only set when every WHERE conjunct is already
+    # captured by time_range/filters applied AT the scan — a residual
+    # filter evaluated later would silently under-return.
+    limit: "int | None" = None
 
     @staticmethod
     def all_time(filters: Sequence[ColumnFilter] = ()) -> "Predicate":
         return Predicate(TimeRange.min_to_max(), tuple(filters))
 
     def with_time_range(self, tr: TimeRange) -> "Predicate":
-        return Predicate(tr, self.filters)
+        return Predicate(tr, self.filters, self.limit)
+
+    def with_limit(self, n: "int | None") -> "Predicate":
+        return Predicate(self.time_range, self.filters, n)
 
     def restricted_to(self, columns: set[str]) -> "Predicate":
         """Keep only filters on the given columns (plus the time range).

@@ -302,3 +302,60 @@ class TestLeftJoin:
         ).to_pylist()
         # NULL fill is '' (kind default) -> NULL rows sort first, not at 'zed'
         assert out[0]["owner"] is None and out[-1]["owner"] == "zed"
+
+
+class TestLimitPushdown:
+    """LIMIT pushdown into the scan for APPEND tables (any n rows are a
+    correct answer when no residual filter/sort needs the full set)."""
+
+    def _make(self, tmp_path, n_flushes=5):
+        import horaedb_tpu
+
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(
+            "CREATE TABLE ap (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic WITH (update_mode='APPEND')"
+        )
+        t = conn.catalog.open("ap")
+        for k in range(n_flushes):
+            vals = ", ".join(
+                f"('h{i % 4}', {float(k * 100 + i)}, {10_000 * k + i})"
+                for i in range(100)
+            )
+            conn.execute(f"INSERT INTO ap (host, v, ts) VALUES {vals}")
+            conn.instance.flush_table(t.data)
+        return conn
+
+    def test_limit_stops_early_and_is_exact(self, tmp_path):
+        conn = self._make(tmp_path)
+        out = conn.execute("SELECT host, v, ts FROM ap LIMIT 7")
+        assert out.num_rows == 7
+        m = out.metrics
+        assert m["limit_pushdown"] == 7
+        # early stop: scanned far fewer than the 500 stored rows
+        assert m["rows_scanned"] < 500, m
+        # time-only WHERE still pushes down
+        out = conn.execute("SELECT v FROM ap WHERE ts >= 0 AND ts < 50000 LIMIT 3")
+        assert out.num_rows == 3 and out.metrics["limit_pushdown"] == 3
+        conn.close()
+
+    def test_no_pushdown_when_unsafe(self, tmp_path):
+        conn = self._make(tmp_path, n_flushes=2)
+        # tag filter: scan must NOT stop early (filter runs after scan)
+        out = conn.execute("SELECT v FROM ap WHERE host = 'h1' LIMIT 5")
+        assert out.num_rows == 5
+        assert "limit_pushdown" not in (out.metrics or {})
+        # ORDER BY needs the full set
+        out = conn.execute("SELECT v FROM ap ORDER BY v DESC LIMIT 5")
+        assert "limit_pushdown" not in (out.metrics or {})
+        assert [float(v) for v in out.column("v")] == [199.0, 198.0, 197.0, 196.0, 195.0]
+        # OVERWRITE tables keep the full merge (dedup correctness)
+        conn.execute(
+            "CREATE TABLE ow (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        conn.execute("INSERT INTO ow (host, v, ts) VALUES ('a', 1.0, 1)")
+        out = conn.execute("SELECT v FROM ow LIMIT 1")
+        # dedup scans ignore the hint, so the metric must not claim it
+        assert out.num_rows == 1 and "limit_pushdown" not in (out.metrics or {})
+        conn.close()
