@@ -101,14 +101,7 @@ def scan_sources(
     parts: list[RowGroup] = []
     versions: list[np.ndarray] = []
 
-    def read_one(handle):
-        return SstReader(store, handle.path).read(
-            schema, predicate, projection=projection
-        )
-
-    from ..utils.object_store import LocalDiskStore, MemoryStore
-
-    remote = not isinstance(store, (LocalDiskStore, MemoryStore))
+    read_one, remote = _sst_read_fn(store, schema, predicate, projection)
     if remote and len(view.ssts) > 1:
         # the IO pool, NOT scatter_pool: partition scatter tasks call into
         # this function, and nesting on one bounded pool deadlocks
@@ -130,6 +123,21 @@ def scan_sources(
             parts.append(rows)
             versions.append(seq)
     return parts, versions
+
+
+def _sst_read_fn(store, schema, predicate, projection):
+    """(read_one(handle) -> RowGroup, is_remote) — the single definition
+    of how a scan opens an SST and whether fetches should overlap
+    (shared by the full scan and the limited scan)."""
+
+    def read_one(handle):
+        return SstReader(store, handle.path).read(
+            schema, predicate, projection=projection
+        )
+
+    from ..utils.object_store import LocalDiskStore, MemoryStore
+
+    return read_one, not isinstance(store, (LocalDiskStore, MemoryStore))
 
 
 def _project_rows(rows: RowGroup, proj_schema: Schema) -> RowGroup:
@@ -193,19 +201,14 @@ def _limited_append_scan(
             done = True
             break
     if not done:
-        def read_one(handle):
-            return SstReader(store, handle.path).read(
-                schema, predicate, projection=projection
-            )
-
-        from ..utils.object_store import LocalDiskStore, MemoryStore
-
-        remote = not isinstance(store, (LocalDiskStore, MemoryStore))
+        read_one, remote = _sst_read_fn(store, schema, predicate, projection)
         batch = 4 if remote else 1  # overlap network fetches per round
         ssts = list(view.ssts)
         for i in range(0, len(ssts), batch):
             chunk = ssts[i:i + batch]
             if remote and len(chunk) > 1:
+                # io_pool, NOT scatter_pool — same nesting caveat as
+                # scan_sources
                 from ..utils.runtime import io_pool
 
                 results = list(io_pool().map(read_one, chunk))

@@ -17,7 +17,7 @@ Supported grammar (the TSBS/dashboard workhorse subset):
     agg      := sum | avg | min | max | count
     func     := rate | increase | avg_over_time | min_over_time | max_over_time
     selector := metric [ '{' matcher (',' matcher)* '}' ]
-                [ '[' duration ']' ] [ 'offset' duration ]
+                [ '[' duration ']' ] ( 'offset' duration | '@' unix )*
     matcher  := label ('=' | '!=' | '=~' | '!~') 'value'
 
 Binary expressions follow prom's arithmetic semantics: scalar/scalar,
@@ -65,6 +65,7 @@ class PromQuery:
     agg: Optional[str] = None  # AGG_FUNCS
     by_labels: Optional[list[str]] = None  # None = per-series
     offset_ms: int = 0  # `offset 1h` shifts the evaluated window back
+    at_ms: Optional[int] = None  # `@ <unix>` pins the evaluation time
 
 
 @dataclass
@@ -94,7 +95,7 @@ _TOKENS = re.compile(
     | (?P<dur>\d+(?:ms|s|m|h|d))
     | (?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
     | (?P<string>'(?:[^'])*'|"(?:[^"])*")
-    | (?P<op>!=|=~|!~|[={{}}()\[\],+\-*/%])
+    | (?P<op>!=|=~|!~|[={{}}()\[\],+\-*/%@])
     )""",
     re.VERBOSE,
 )
@@ -247,12 +248,22 @@ class _Parser:
                 raise PromQLError(f"expected a duration like 5m, found {dur!r}")
             pq.range_ms = parse_duration_ms(dur)
             self.expect("]")
-        if self.peek() == ("name", "offset"):
-            self.next()
-            kind, dur = self.next()
-            if kind != "dur":
-                raise PromQLError(f"offset expects a duration, found {dur!r}")
-            pq.offset_ms = parse_duration_ms(dur)
+        while True:
+            if self.peek() == ("name", "offset"):
+                self.next()
+                kind, dur = self.next()
+                if kind != "dur":
+                    raise PromQLError(f"offset expects a duration, found {dur!r}")
+                pq.offset_ms = parse_duration_ms(dur)
+                continue
+            if self.peek() == ("op", "@"):
+                self.next()
+                kind, num = self.next()
+                if kind != "number":
+                    raise PromQLError(f"@ expects a unix timestamp, found {num!r}")
+                pq.at_ms = int(float(num) * 1000)
+                continue
+            break
         return pq
 
 
@@ -324,6 +335,8 @@ def _range_series(
 ) -> dict[tuple, dict[int, float]]:
     """Per-series step-bucket values in REQUESTED-time space (offset
     already stamped back), keyed by ((label, value), ...)."""
+    if pq.at_ms is not None:
+        return _at_series(conn, pq, start_ms, end_ms, step_ms)
     table = conn.catalog.open(pq.metric)
     if table is None:
         return {}
@@ -434,6 +447,33 @@ def _range_series(
             for key, points in combined.items()
         }
     return combined
+
+
+def _at_series(
+    conn, pq: PromQuery, start_ms: int, end_ms: int, step_ms: int
+) -> dict[tuple, dict[int, float]]:
+    """``metric @ t``: the value is pinned at ``t`` — one evaluation
+    there, replicated across every requested step (prom's @ modifier
+    semantics: the same sample answers every step)."""
+    import dataclasses
+
+    fixed = dataclasses.replace(pq, at_ms=None, offset_ms=0)
+    at = pq.at_ms - pq.offset_ms  # offset still shifts the pinned time
+    window = pq.range_ms or DEFAULT_LOOKBACK_MS
+    inner_step = window if pq.func is not None else min(window, 60_000)
+    pts = _range_series(conn, fixed, at - window, at, inner_step)
+    # the SAME floor-aligned grid _range_series derives from data
+    # ((ts//step)*step): a ceil-aligned grid would miss the other side's
+    # first bucket in binary expressions when start isn't step-aligned
+    first = (start_ms // step_ms) * step_ms
+    buckets = list(range(first, end_ms + 1, step_ms))
+    out = {}
+    for key, series in pts.items():
+        if not series:
+            continue
+        v = series[max(series)]  # latest resolvable value at the pin
+        out[key] = {b: v for b in buckets}
+    return out
 
 
 def _regex_match(labels: dict, matchers: list[tuple[str, str, str]]) -> bool:

@@ -187,7 +187,7 @@ class _Conn:
         kind, payload = await self.gateway.execute(sql.strip().rstrip(";"))
         if kind == "error":
             raise _ExtError(payload[1])
-        self._portals[portal] = (kind, payload, sql)
+        self._portals[portal] = (kind, payload, sql, 0)  # 0 = row cursor
         self.writer.write(_msg(b"2", b""))  # BindComplete
 
     async def _describe_msg(self, body: bytes) -> None:
@@ -218,7 +218,7 @@ class _Conn:
             return
         if name not in self._portals:
             raise _ExtError(f"portal {name!r} does not exist")
-        kind, payload, _sql = self._portals[name]
+        kind, payload, _sql, _pos = self._portals[name]
         if kind == "rows":
             self._row_description(payload[0])
         else:
@@ -226,18 +226,27 @@ class _Conn:
 
     def _execute_msg(self, body: bytes) -> None:
         name, off = _take_cstr(body, 0)
-        # max-rows field ignored: portals always run to completion
+        max_rows = int.from_bytes(body[off:off + 4], "big", signed=True)
         if name not in self._portals:
             raise _ExtError(f"portal {name!r} does not exist")
-        kind, payload, sql = self._portals[name]
+        kind, payload, sql, pos = self._portals[name]
         if kind == "affected":
             verb = "INSERT 0" if sql.lstrip().lower().startswith("insert") else "OK"
             self.writer.write(_msg(b"C", _cstr(f"{verb} {payload}")))
             return
         names, rows = payload
-        for r in rows:
+        # max_rows > 0: emit a slice and suspend the portal; a later
+        # Execute on the same portal resumes where this one stopped
+        # (cursor-style fetch, per the extended-protocol spec)
+        end = len(rows) if max_rows <= 0 else min(pos + max_rows, len(rows))
+        for r in rows[pos:end]:
             self._data_row(names, r)
-        self.writer.write(_msg(b"C", _cstr(f"SELECT {len(rows)}")))
+        if end < len(rows):
+            self._portals[name] = (kind, payload, sql, end)
+            self.writer.write(_msg(b"s", b""))  # PortalSuspended
+            return
+        self._portals[name] = (kind, payload, sql, end)
+        self.writer.write(_msg(b"C", _cstr(f"SELECT {end - pos}")))
 
     def _close_msg(self, body: bytes) -> None:
         what = body[:1]

@@ -379,3 +379,35 @@ class TestBinaryExpressions:
         )
         assert len(out) == 1
         assert abs(float(out[0]["value"][1]) - (3 / 240 + 13.0)) < 1e-9
+
+
+class TestAtModifier:
+    """`metric @ t` pins the evaluation time (prom's @ modifier)."""
+
+    def test_at_pins_value_across_steps(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        # value at t=60s for h1 is 11.0 -> every step reports 11.0
+        out = evaluate_expr_range(
+            db, parse_promql('cpu{host="h1"} @ 60'), 0, 3 * MIN, MIN
+        )
+        assert len(out) == 1
+        assert [float(v) for _, v in out[0]["values"]] == [11.0] * 4
+
+    def test_at_in_expression(self, db):
+        from horaedb_tpu.proxy.promql import evaluate_expr_range
+
+        # current / pinned-start ratio per step
+        out = evaluate_expr_range(
+            db,
+            parse_promql('cpu{host="h1"} / cpu{host="h1"} @ 0'),
+            0, 3 * MIN, MIN,
+        )
+        vals = [float(v) for _, v in out[0]["values"]]
+        assert vals == [1.0, 1.1, 1.2, 1.3]
+
+    def test_at_parse_errors(self):
+        with pytest.raises(PromQLError):
+            parse_promql("cpu @ 5m")  # duration, not a timestamp
+        with pytest.raises(PromQLError):
+            parse_promql("cpu @")

@@ -747,3 +747,43 @@ class TestReadDedup:
         (k1, (_, r1)), (k2, (_, r2)) = run(body())
         assert len(calls) == 2, calls  # post-write SELECT ran fresh
         assert r2[0]["c"] == 3  # sees its own write
+
+
+class TestPostgresPartialExecute:
+    """Execute with max_rows suspends the portal (cursor-style fetch)."""
+
+    def _with_server(self, db, fn):
+        return TestPostgresProtocol._with_server(self, db, fn)
+
+    def test_portal_suspend_and_resume(self, db):
+        db.execute(
+            "INSERT INTO wt (host, v, ts) VALUES ('c', 3.5, 3000), ('d', 4.5, 4000)"
+        )
+
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            try:
+                # close even on assertion failure: a leaked socket keeps
+                # the server handler alive and wait_closed hangs forever,
+                # masking the real failure
+                c = PgExtClient(s)
+                c.startup()
+                c.parse("", "SELECT host FROM wt ORDER BY host")
+                c.bind("", "", [])
+                # fetch 3 rows, then the rest
+                c._send(b"E", b"\x00" + struct.pack("!i", 3))
+                c._send(b"E", b"\x00" + struct.pack("!i", 0))
+                c.sync()
+                msgs = c.collect_until_ready()
+                tags = [t for t, _ in msgs]
+                # 3 DataRows, PortalSuspended, remaining 1 DataRow, Complete
+                assert tags == [b"1", b"2", b"D", b"D", b"D", b"s", b"D", b"C", b"Z"], tags
+                cc = [b for t, b in msgs if t == b"C"][0]
+                assert cc.rstrip(b"\x00") == b"SELECT 1"
+                # DataRow: int16 ncols + int32 len + utf8 value
+                hosts = [b[6:].decode() for t, b in msgs if t == b"D"]
+                assert hosts == ["a", "b", "c", "d"], hosts
+            finally:
+                s.close()
+
+        self._with_server(db, client)
