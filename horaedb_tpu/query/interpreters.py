@@ -169,7 +169,21 @@ class InterpreterFactory:
             raise InterpreterError(f"table not found: {plan.table}")
         return self.executor.execute(plan, table)
 
-    def _materialize_subqueries(self, plan: QueryPlan):
+    @staticmethod
+    def _expr_sources(select: ast.Select) -> list:
+        """Every expression-bearing position of a Select — the ONE list
+        subquery materialization and correlation checking both walk (a
+        new expr-bearing clause must be added here once, not N times)."""
+        out = [item.expr for item in select.items]
+        out += [
+            e
+            for e in (select.where, select.having, *select.group_by)
+            if e is not None
+        ]
+        out += [o.expr for o in select.order_by]
+        return out
+
+    def _materialize_subqueries(self, plan: QueryPlan, outer_scope=frozenset()):
         """Uncorrelated subqueries run FIRST and substitute as literals
         (ref: the reference gets subqueries from DataFusion; this is the
         uncorrelated subset): ``IN (SELECT ...)`` becomes an InList of the
@@ -177,9 +191,7 @@ class InterpreterFactory:
         Literal. Returns a re-planned QueryPlan, or None if the statement
         has no subqueries."""
         stmt = plan.select
-        sources = [item.expr for item in stmt.items]
-        sources += [e for e in (stmt.where, stmt.having, *stmt.group_by) if e is not None]
-        sources += [o.expr for o in stmt.order_by]
+        sources = self._expr_sources(stmt)
         if not any(
             isinstance(e, (ast.InSubquery, ast.Subquery))
             for src in sources
@@ -190,9 +202,33 @@ class InterpreterFactory:
         from .planner import Planner
 
         planner = Planner(self.catalog.schema_of)
+        # the full outer scope: every enclosing query's tables, so nested
+        # subqueries still get the clear correlation error
+        scope = set(outer_scope) | {
+            t for t in (stmt.table, stmt.join.table if stmt.join else None) if t
+        }
 
         def run_inner(select: ast.Select) -> list:
-            inner = self.execute(planner.plan(select))
+            # A qualifier naming an OUTER-scope table means the subquery
+            # is correlated — say so directly instead of letting the inner
+            # planner report a baffling "unknown qualifier".
+            inner_tables = {t for t in (select.table, select.join.table if select.join else None) if t}
+            for src in self._expr_sources(select):
+                for e in _walk_all(src):
+                    if (
+                        isinstance(e, ast.Column)
+                        and e.qualifier
+                        and e.qualifier in scope
+                        and e.qualifier not in inner_tables
+                    ):
+                        raise InterpreterError(
+                            f"correlated subqueries are not supported: "
+                            f"{e.qualifier}.{e.name} references the outer "
+                            f"query's table {e.qualifier!r}"
+                        )
+            inner_plan = planner.plan(select)
+            nested = self._materialize_subqueries(inner_plan, outer_scope=scope)
+            inner = self.execute(nested if nested is not None else inner_plan)
             if not isinstance(inner, ResultSet):
                 raise InterpreterError("subquery must be a SELECT")
             if len(inner.names) != 1:

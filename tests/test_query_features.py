@@ -359,3 +359,46 @@ class TestLimitPushdown:
         # dedup scans ignore the hint, so the metric must not claim it
         assert out.num_rows == 1 and "limit_pushdown" not in (out.metrics or {})
         conn.close()
+
+
+class TestCorrelatedSubqueryError:
+    def test_clear_error_message(self, db):
+        db.execute(
+            "CREATE TABLE oth (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO oth (host, w, ts) VALUES ('a', 5.0, 1)")
+        with pytest.raises(Exception, match="correlated subqueries"):
+            db.execute(
+                "SELECT host FROM q WHERE v < "
+                "(SELECT max(w) FROM oth WHERE oth.host = q.host)"
+            )
+        # uncorrelated still works
+        out = db.execute(
+            "SELECT host FROM q WHERE v < (SELECT max(w) FROM oth) ORDER BY host, v"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["a", "a", "b", "b"]  # v < 5.0
+
+    def test_nested_correlated_also_clear(self, db):
+        db.execute(
+            "CREATE TABLE oth2 (host string TAG, w2 double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "CREATE TABLE oth3 (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO oth3 (host, w, ts) VALUES ('a', 5.0, 1)")
+        db.execute("INSERT INTO oth2 (host, w2, ts) VALUES ('a', 5.0, 1)")
+        # the correlation is two levels down: still the clear message
+        with pytest.raises(Exception, match="correlated subqueries"):
+            db.execute(
+                "SELECT host FROM q WHERE v < (SELECT max(w) FROM oth3 "
+                "WHERE w IN (SELECT w2 FROM oth2 WHERE oth2.host = q.host))"
+            )
+        # and a legal nested-uncorrelated chain still runs
+        out = db.execute(
+            "SELECT host FROM q WHERE v < (SELECT max(w) FROM oth3 "
+            "WHERE w IN (SELECT w2 FROM oth2)) ORDER BY host, v"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["a", "a", "b", "b"]
