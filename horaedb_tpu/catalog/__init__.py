@@ -143,7 +143,11 @@ class Catalog:
                 for i, sub_id in enumerate(e.sub_table_ids or []):
                     sub_name = sub_table_name(name, i)
                     if self.sub_table_resolver is not None:
-                        remote = self.sub_table_resolver(name, i, sub_name, sub_id)
+                        remote = self.sub_table_resolver(
+                            name, i, sub_name, sub_id,
+                            local_open=lambda sid=sub_id, sn=sub_name, sp=e.space_id:
+                                self.instance.open_table(sp, sid, sn),
+                        )
                         if remote is not None:
                             subs.append(remote)
                             continue
@@ -225,7 +229,11 @@ class Catalog:
                     data = self.instance.create_table(0, sub_id, sub_name, schema, options)
                     sub_ids.append(sub_id)
                     if self.sub_table_resolver is not None:
-                        remote = self.sub_table_resolver(name, i, sub_name, sub_id)
+                        remote = self.sub_table_resolver(
+                            name, i, sub_name, sub_id,
+                            local_open=lambda sid=sub_id, sn=sub_name:
+                                self.instance.open_table(0, sid, sn),
+                        )
                         if remote is not None:
                             self.instance.close_table(data, flush=False)
                             subs.append(remote)
@@ -248,6 +256,11 @@ class Catalog:
             return table
 
     def drop_table(self, name: str, if_exists: bool = False) -> bool:
+        # Unregister under the lock, drop storage AFTER releasing it:
+        # routed partition handles consult the router (which takes the
+        # cluster lock) while the heartbeat thread takes the cluster lock
+        # and then calls back into this catalog — holding self._lock
+        # across the drops would invert that order and deadlock.
         with self._lock:
             e = self._entries.get(name)
             if e is None:
@@ -255,19 +268,32 @@ class Catalog:
                     return False
                 raise ValueError(f"table not found: {name}")
             table = self.open(name)
-            if table is not None:
-                for data in table.physical_datas():
-                    self.instance.drop_table(data)
-                # Remote-owned partitions drop on their owning node, or
-                # their storage would orphan in the shared store.
-                for sub in getattr(table, "sub_tables", ()):
-                    drop_remote = getattr(sub, "drop_remote", None)
-                    if drop_remote is not None:
-                        drop_remote()
             self._entries.pop(name, None)
             self._open_tables.pop(name, None)
             self._persist_locked()
-            return True
+        if table is not None:
+            subs = getattr(table, "sub_tables", None)
+            if subs is None:
+                for data in table.physical_datas():
+                    self.instance.drop_table(data)
+            else:
+                for sub in subs:
+                    drop_storage = getattr(sub, "drop_storage", None)
+                    if drop_storage is not None:
+                        # Routed handle: drops wherever the partition
+                        # lives — locally (even if never opened here)
+                        # or on its owning node.
+                        drop_storage()
+                        continue
+                    for data in sub.physical_datas():
+                        self.instance.drop_table(data)
+                    # Remote-owned partitions drop on their owning
+                    # node, or their storage would orphan in the
+                    # shared store.
+                    drop_remote = getattr(sub, "drop_remote", None)
+                    if drop_remote is not None:
+                        drop_remote()
+        return True
 
     def close(self) -> None:
         with self._lock:

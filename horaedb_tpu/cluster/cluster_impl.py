@@ -43,9 +43,11 @@ class ClusterImpl:
         self.shard_set = ShardSet()
         self._table_shard: dict[str, int] = {}  # table name -> shard id
         self._lease_deadline: dict[int, float] = {}  # shard id -> monotonic
+        self._last_lease_ttl: Optional[float] = None  # learned from heartbeats
         self._order_applied_at: dict[int, float] = {}  # shard id -> monotonic
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        self._poke = threading.Event()  # kick_heartbeat() wakes the loop
         self._thread: Optional[threading.Thread] = None
 
     # ---- lifecycle ------------------------------------------------------
@@ -64,11 +66,22 @@ class ClusterImpl:
 
     def stop(self) -> None:
         self._stop.set()
+        self._poke.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def kick_heartbeat(self) -> None:
+        """Wake the heartbeat loop NOW — called after a /meta_event push
+        applies a lease-less membership order so the lease arrives in
+        milliseconds instead of one renewal interval later."""
+        self._poke.set()
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.heartbeat_interval_s):
+        while True:
+            if self._poke.wait(self._interval()):
+                self._poke.clear()
+            if self._stop.is_set():
+                return
             try:
                 self._heartbeat_once()
             except MetaError as e:
@@ -76,14 +89,30 @@ class ClusterImpl:
             except Exception:
                 logger.exception("heartbeat loop error")
 
+    def _interval(self) -> float:
+        """Renew well inside the lease TTL (~TTL/3, etcd-keepalive style) —
+        a configured interval longer than the TTL would leave the write
+        fence closed between renewals in steady state. The anti-busy-spin
+        floor is small enough to stay under any sane TTL."""
+        ttl = self._last_lease_ttl
+        if ttl is None:
+            return self.heartbeat_interval_s
+        return max(0.02, min(self.heartbeat_interval_s, ttl / 3.0))
+
     def _heartbeat_once(self) -> None:
-        t_req = time.monotonic()
-        resp = self.meta.heartbeat(self.self_endpoint)
+        # Lease deadlines measure from when the successful request was
+        # SENT (stamped per-call by the client): a reply delayed across a
+        # long stall (process suspension, network hiccup) must not renew a
+        # lease the coordinator already considers lapsed — with
+        # arrival-time accounting a pre-transfer reply buffered in the
+        # socket would reopen the write fence on resume (split brain).
+        resp, sent_at = self.meta.heartbeat_timed(self.self_endpoint)
+        self._last_lease_ttl = float(resp.get("lease_ttl_s", 0)) or None
         desired = resp.get("desired", [])
         desired_ids = {o["shard_id"] for o in desired}
         for order in desired:
             try:
-                self.apply_shard_order(order)
+                self.apply_shard_order(order, granted_at=sent_at)
             except ShardError as e:
                 logger.warning("shard order rejected: %s", e)
         # Shards the coordinator no longer grants us: close them — UNLESS
@@ -99,8 +128,17 @@ class ClusterImpl:
             self.close_shard(shard.shard_id, version=None)
 
     # ---- shard orders (heartbeat reply or /meta_event push) -------------
-    def apply_shard_order(self, order: dict) -> None:
-        """Reconcile one declarative shard order (idempotent)."""
+    def apply_shard_order(self, order: dict, granted_at: Optional[float] = None) -> None:
+        """Reconcile one declarative shard order (idempotent).
+
+        ``granted_at``: monotonic instant the grant is valid FROM (the
+        heartbeat request-send time); the lease deadline is measured from
+        there, not from when the reply got processed. ``None`` (the
+        /meta_event push path) applies MEMBERSHIP ONLY and grants no
+        lease: a push buffered in the socket across a long stall could be
+        arbitrarily stale, and unlike a heartbeat there is no local send
+        timestamp to bound its age — so pushes open/seed the shard and an
+        immediate heartbeat (kicked by the caller) fetches the lease."""
         shard_id = int(order["shard_id"])
         version = int(order["version"])
         ttl = float(order.get("lease_ttl_s", 5.0))
@@ -133,7 +171,14 @@ class ClusterImpl:
                     f"stale order for shard {shard_id}: v{version} < v{shard.version}"
                 )
             now = time.monotonic()
-            self._lease_deadline[shard_id] = now + ttl
+            if granted_at is not None:
+                # Never SHORTEN an existing lease: a slow reply racing a
+                # newer grant must not roll the deadline backwards.
+                self._lease_deadline[shard_id] = max(
+                    self._lease_deadline.get(shard_id, 0.0), granted_at + ttl
+                )
+            else:
+                self._lease_deadline.setdefault(shard_id, 0.0)
             self._order_applied_at[shard_id] = now
             ordered = {t["name"] for t in tables}
             # PRUNE names this shard no longer carries (dropped tables /

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Optional, Sequence
@@ -31,7 +32,13 @@ class MetaClient:
         self._lock = threading.Lock()
 
     # ---- transport ------------------------------------------------------
-    def _call(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+    def _call(
+        self,
+        method: str,
+        path: str,
+        payload: Optional[dict] = None,
+        sent_at_out: Optional[dict] = None,
+    ) -> dict:
         from collections import deque
 
         last_err: Exception | None = None
@@ -56,6 +63,11 @@ class MetaClient:
                     headers={"Content-Type": "application/json"},
                     method=method,
                 )
+                # Stamp the send time of THIS attempt (not the start of
+                # the failover walk): lease deadlines derive from it, and
+                # dead-endpoint connect timeouts burned before the
+                # successful attempt must not be charged against the lease.
+                sent_at = time.monotonic()
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
                     body = json.loads(resp.read().decode() or "{}")
                 with self._lock:
@@ -64,6 +76,8 @@ class MetaClient:
                         self._leader_hint = None
                     else:
                         self._leader_hint = ep  # remember the real leader
+                if sent_at_out is not None:
+                    sent_at_out["sent_at"] = sent_at
                 return body
             except urllib.error.HTTPError as e:
                 try:
@@ -91,6 +105,21 @@ class MetaClient:
     # ---- API ------------------------------------------------------------
     def heartbeat(self, endpoint: str) -> dict:
         return self._call("POST", "/meta/v1/node/heartbeat", {"endpoint": endpoint})
+
+    def heartbeat_timed(self, endpoint: str) -> tuple[dict, float]:
+        """Heartbeat plus the monotonic send time of the SUCCESSFUL
+        request attempt — the instant its lease grants are valid from.
+        Returned per-call (not via shared state): any concurrent meta
+        call from another thread must not be able to push a lease
+        deadline later than the coordinator's actual grant."""
+        out: dict = {}
+        body = self._call(
+            "POST",
+            "/meta/v1/node/heartbeat",
+            {"endpoint": endpoint},
+            sent_at_out=out,
+        )
+        return body, out.get("sent_at", time.monotonic())
 
     def create_table(self, name: str, create_sql: str) -> dict:
         return self._call(

@@ -39,6 +39,10 @@ class Router(ABC):
     def endpoints(self) -> list[str]:
         return []
 
+    def invalidate(self, table: str) -> None:
+        """Drop any cached route for ``table`` (no-op for cache-less
+        routers) — called when a caller observes a stale-route error."""
+
 
 class LocalOnlyRouter(Router):
     """Standalone mode: this node owns everything."""

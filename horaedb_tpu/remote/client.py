@@ -109,6 +109,269 @@ class RemoteEngineClient:
         return bool(self._call("DropSub", {"table": table}).get("dropped"))
 
 
+class RoutedSubTable(Table):
+    """A partition handle that RE-RESOLVES its owner through the router on
+    every operation (ref: remote_engine_client/src/cached_router.rs —
+    route caching with eviction on failure).
+
+    A partition's shard can move at any time (rebalance, failover); a
+    handle pinned to the endpoint observed at parent-open time would hit
+    the old owner forever — it rejects with FAILED_PRECONDITION and the
+    scatter write wedges. Instead every call asks the router (TTL-cached,
+    so steady-state cost is a dict lookup), and on a stale-route error
+    (remote FAILED_PRECONDITION/NOT_FOUND/UNAVAILABLE, or the local lease
+    fence) the cached route is invalidated and the call retried once
+    against the fresh owner. Local writes go through the SAME lease fence
+    as remote ones (``cluster.ensure_table_writable``) — without it a
+    node that lost the partition would keep applying scatter writes to
+    shared storage alongside the new owner."""
+
+    # Route sources that authoritatively establish locality: this node's
+    # shard set, static rule config, or a fresh coordinator answer. A
+    # "fallback" (coordinator unreachable) or "meta-unknown" local route
+    # must NEVER open partition storage here — a non-owner would serve a
+    # stale shared-store snapshot alongside the real owner.
+    _AUTHORITATIVE_LOCAL = ("owned", "static", "meta")
+
+    def __init__(
+        self,
+        name: str,
+        schema: Schema,
+        options: TableOptions,
+        router,
+        cluster=None,
+        instance=None,
+        local_open=None,  # () -> engine TableData | None (shared store)
+    ) -> None:
+        self._name = name
+        self._schema = schema
+        self._options = options
+        self.router = router
+        self.cluster = cluster
+        self.instance = instance
+        self.local_open = local_open
+        self._local: Optional[Table] = None
+        self._remote: Optional[RemoteSubTable] = None
+        self._remote_ep: Optional[str] = None
+        self._lock = threading.Lock()
+        self._local_inflight = 0  # ops running against self._local
+        self._close_pending = False
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def options(self) -> TableOptions:
+        return self._options
+
+    # ---- resolution (all under self._lock) -------------------------------
+    def _close_local_locked(self) -> None:
+        """Close the local handle — deferred while operations are running
+        against it (closing a TableData under a concurrent write would
+        drop its rows into a just-closed memtable)."""
+        if self._local is None:
+            return
+        if self._local_inflight > 0:
+            self._close_pending = True
+            return
+        if self.instance is not None:
+            for data in self._local.physical_datas():
+                try:
+                    # Mirrors ClusterImpl._release_table: with a WAL the
+                    # unflushed rows are durable and replayed by the new
+                    # owner; flushing here would race its manifest.
+                    self.instance.close_table(
+                        data, flush=self.instance.wal is None
+                    )
+                except Exception:
+                    pass
+        self._local = None
+        self._close_pending = False
+
+    def _resolve_locked(self, route) -> Table:
+        if route.is_local:
+            if route.source not in self._AUTHORITATIVE_LOCAL:
+                raise RuntimeError(
+                    f"cannot resolve partition {self._name!r}: route is "
+                    f"non-authoritative ({route.source}); refusing to open "
+                    "shared storage on a possible non-owner"
+                )
+            if self._local is None:
+                if self.local_open is None:
+                    raise RuntimeError(
+                        f"partition {self._name!r} routed local but no "
+                        "local opener configured"
+                    )
+                data = self.local_open()
+                if data is None:
+                    raise RuntimeError(
+                        f"partition {self._name!r} missing from storage"
+                    )
+                from ..table_engine.table import AnalyticTable
+
+                self._local = AnalyticTable(self.instance, data)
+            return self._local
+        self._close_local_locked()
+        ep = grpc_endpoint_for(route.endpoint)
+        if self._remote is None or self._remote_ep != ep:
+            self._remote = RemoteSubTable(
+                self._name, ep, self._schema, self._options
+            )
+            self._remote_ep = ep
+        return self._remote
+
+    @staticmethod
+    def _is_stale_route_error(e: Exception, for_write: bool = False) -> bool:
+        if isinstance(e, grpc.RpcError):
+            codes = [
+                grpc.StatusCode.FAILED_PRECONDITION,  # fenced: not applied
+                grpc.StatusCode.NOT_FOUND,            # no table: not applied
+            ]
+            if not for_write:
+                # UNAVAILABLE is ambiguous for writes (the rows may have
+                # been applied before the connection died — retrying
+                # could double-write); reads/aggs are idempotent.
+                codes.append(grpc.StatusCode.UNAVAILABLE)
+            return e.code() in codes
+        from ..cluster.shard import ShardError
+
+        return isinstance(e, ShardError)
+
+    def _call(self, op, fenced: bool = False):
+        """Run ``op(target)`` with one stale-route retry."""
+        for attempt in (0, 1):
+            # route() consults the cluster shard set (cluster._lock) —
+            # resolve BEFORE taking self._lock; holding both would invert
+            # against the heartbeat thread's cluster._lock ->
+            # physical_datas() -> self._lock order.
+            route = self.router.route(self._name)
+            with self._lock:
+                t = self._resolve_locked(route)
+                local = t is self._local
+                if local:
+                    self._local_inflight += 1
+            try:
+                if local and fenced and self.cluster is not None:
+                    self.cluster.ensure_table_writable(self._name)
+                return op(t)
+            except Exception as e:
+                if attempt == 0 and self._is_stale_route_error(
+                    e, for_write=fenced
+                ):
+                    self.router.invalidate(self._name)
+                    continue
+                raise
+            finally:
+                if local:
+                    with self._lock:
+                        self._local_inflight -= 1
+                        if self._close_pending and self._local_inflight == 0:
+                            self._close_local_locked()
+
+    # ---- Table interface -------------------------------------------------
+    def write(self, rows: RowGroup) -> int:
+        return self._call(lambda t: t.write(rows), fenced=True)
+
+    def read(self, predicate=None, projection=None) -> RowGroup:
+        return self._call(lambda t: t.read(predicate, projection))
+
+    def partial_agg(self, spec: dict):
+        return self._call(lambda t: t.partial_agg(spec))
+
+    def drop_storage(self) -> None:
+        """Called by the logical DROP TABLE: drop this partition's storage
+        wherever it lives — on the owning node when remote, or locally
+        (opening it first if this handle never touched it). One
+        stale-route retry: a drop sent to a node the partition just left
+        answers dropped=false (or errors), and giving up there would
+        orphan the partition's SSTs in the shared store forever."""
+        for attempt in (0, 1):
+            route = self.router.route(self._name)
+            if route.is_local:
+                if route.source not in self._AUTHORITATIVE_LOCAL:
+                    raise RuntimeError(
+                        f"cannot drop partition {self._name!r}: route is "
+                        f"non-authoritative ({route.source})"
+                    )
+                with self._lock:
+                    t = self._local
+                    if t is None and self.local_open is not None:
+                        data = self.local_open()
+                        if data is None:
+                            return  # storage already gone
+                        from ..table_engine.table import AnalyticTable
+
+                        t = AnalyticTable(self.instance, data)
+                    if t is None:
+                        return
+                    for data in t.physical_datas():
+                        self.instance.drop_table(data)
+                    self._local = None
+                return
+            try:
+                client = RemoteEngineClient(grpc_endpoint_for(route.endpoint))
+                if client.drop_sub(self._name):
+                    return
+                # The target no longer holds the partition — route moved.
+                if attempt == 0:
+                    self.router.invalidate(self._name)
+                    continue
+                raise RuntimeError(
+                    f"drop of partition {self._name!r} refused by "
+                    f"{route.endpoint} and the refreshed route"
+                )
+            except grpc.RpcError as e:
+                if attempt == 0 and self._is_stale_route_error(e):
+                    self.router.invalidate(self._name)
+                    continue
+                raise
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._local is not None:
+                self._local.flush()
+
+    def compact(self) -> None:
+        with self._lock:
+            if self._local is not None:
+                self._local.compact()
+
+    def alter_schema(self, schema: Schema) -> None:
+        route = self.router.route(self._name)  # outside self._lock, see _call
+        with self._lock:
+            t = self._resolve_locked(route)
+            if t is not self._local:
+                raise NotImplementedError("ALTER runs on the owning node")
+            t.alter_schema(schema)
+            self._schema = schema
+
+    def alter_options(self, options: TableOptions) -> None:
+        route = self.router.route(self._name)  # outside self._lock, see _call
+        with self._lock:
+            t = self._resolve_locked(route)
+            if t is not self._local:
+                raise NotImplementedError("ALTER runs on the owning node")
+            t.alter_options(options)
+            self._options = options
+
+    def physical_datas(self) -> list:
+        # What THIS node holds open locally (close/drop paths walk this);
+        # remote-owned partitions contribute nothing here.
+        with self._lock:
+            return [] if self._local is None else self._local.physical_datas()
+
+    def metrics(self) -> dict:
+        with self._lock:
+            if self._local is not None:
+                return self._local.metrics()
+        return {"table": self._name, "remote": self._remote_ep}
+
+
 class RemoteSubTable(Table):
     """A partition owned by another node, behind the Table interface."""
 

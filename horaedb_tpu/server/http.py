@@ -836,6 +836,10 @@ def create_app(
             )
         except Exception as e:
             return web.json_response({"error": str(e)}, status=422)
+        # Push orders carry no lease (they could be arbitrarily stale —
+        # see apply_shard_order); fetch one via an immediate heartbeat so
+        # the shard is writable in milliseconds, not a renewal later.
+        cluster.kick_heartbeat()
         return web.json_response({"ok": True})
 
     async def meta_close_shard(request: web.Request) -> web.Response:
@@ -1113,14 +1117,16 @@ def run_server(
         grpc_server = GrpcServer(conn, host=host, port=grpc_port, cluster=cluster)
 
     if router is not None and grpc_server is not None:
-        # Partitioned tables resolve non-local partitions to remote
-        # handles over the router (sub-table name -> owning node).
-        from ..remote import RemoteSubTable, grpc_endpoint_for as _gef
+        # Partitioned tables resolve partitions through ROUTED handles:
+        # every operation re-resolves ownership via the router's TTL
+        # cache, so a partition whose shard moves (rebalance, failover)
+        # is followed instead of wedging on a pinned stale endpoint
+        # (ref: remote_engine_client/src/cached_router.rs eviction).
+        from ..remote.client import RoutedSubTable
 
-        def resolve_sub(logical: str, index: int, sub_name: str, sub_id: int):
-            route = router.route(sub_name)
-            if route.is_local:
-                return None
+        def resolve_sub(
+            logical: str, index: int, sub_name: str, sub_id: int, local_open=None
+        ):
             # Schema/options come from the sub-table's manifest in the
             # SHARED object store — no RPC, and no ordering dependency on
             # the remote node having loaded its registry yet.
@@ -1130,11 +1136,14 @@ def run_server(
             state = Manifest(conn.store, 0, sub_id).load()
             if state.schema is None:
                 raise RuntimeError(f"manifest for {sub_name} missing schema")
-            return RemoteSubTable(
+            return RoutedSubTable(
                 sub_name,
-                _gef(route.endpoint),
                 state.schema,
                 _TableOptions.from_dict(state.options),
+                router=router,
+                cluster=cluster,
+                instance=conn.instance,
+                local_open=local_open,
             )
 
         conn.catalog.sub_table_resolver = resolve_sub

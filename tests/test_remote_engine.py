@@ -272,3 +272,127 @@ class TestDistributedPartitions:
         router = RuleBasedRouter(eps[0], eps)
         owners = {router.route(sub_table_name("spread", i)).endpoint for i in range(8)}
         assert len(owners) == 2, "partitions all hashed onto one node"
+
+
+class TestRoutedSubTable:
+    """Dynamic partition handles: re-resolve ownership through the router
+    on every operation, follow moves, refuse non-authoritative local
+    routes (ref: remote_engine_client/src/cached_router.rs eviction)."""
+
+    class _FakeRouter:
+        def __init__(self, route):
+            self._route = route
+            self.invalidated = []
+
+        def set(self, route):
+            self._route = route
+
+        def route(self, table):
+            return self._route
+
+        def invalidate(self, table):
+            self.invalidated.append(table)
+
+    def _mk(self, router, conn=None, sub="__rst_0"):
+        from horaedb_tpu.remote.client import RoutedSubTable
+
+        if conn is None:
+            conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE rst (host string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        t = conn.catalog.open("rst")
+        data = t.physical_datas()[0]
+        return (
+            RoutedSubTable(
+                sub,
+                t.schema,
+                t.options,
+                router=router,
+                instance=conn.instance,
+                local_open=lambda: data,
+            ),
+            conn,
+        )
+
+    def test_local_route_serves_and_nonauthoritative_refused(self):
+        from horaedb_tpu.cluster.router import Route
+        from horaedb_tpu.common_types.row_group import RowGroup
+
+        router = self._FakeRouter(Route("__rst_0", "local", True, source="owned"))
+        rst, conn = self._mk(router)
+        rows = RowGroup.from_rows(
+            rst.schema, [{"host": "a", "v": 1.0, "ts": 1000}]
+        )
+        assert rst.write(rows) == 1
+        assert len(rst.read()) == 1
+        # Coordinator-down fallback must NOT open shared storage locally.
+        router.set(Route("__rst_0", "local", True, source="fallback"))
+        with pytest.raises(RuntimeError, match="non-authoritative"):
+            rst.read()
+        conn.close()
+
+    def test_follows_move_to_remote_owner(self):
+        """Handle starts local, route flips to a live remote owner: the
+        next op crosses the wire instead of touching stale local state."""
+        from horaedb_tpu.cluster.router import Route
+        from horaedb_tpu.common_types.row_group import RowGroup
+
+        # Remote owner: a real in-process gRPC server over its own conn.
+        owner = horaedb_tpu.connect(None)
+        owner.execute(
+            "CREATE TABLE rst (host string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) "
+            "PARTITION BY KEY(host) PARTITIONS 1 ENGINE=Analytic"
+        )
+        server = GrpcServer(owner, port=0)
+        server.start()
+        try:
+            router = self._FakeRouter(
+                Route("__rst_0", "local", True, source="owned")
+            )
+            rst, conn = self._mk(router)
+            rows = RowGroup.from_rows(
+                rst.schema, [{"host": "a", "v": 1.0, "ts": 1000}]
+            )
+            rst.write(rows)
+            # Shard moves: route now names the remote owner's HTTP
+            # endpoint; gRPC port derives via the +1000 convention.
+            http_ep = f"127.0.0.1:{server.bound_port - 1000}"
+            router.set(Route("__rst_0", http_ep, False, source="meta"))
+            rows2 = RowGroup.from_rows(
+                rst.schema, [{"host": "b", "v": 2.0, "ts": 2000}]
+            )
+            assert rst.write(rows2) == 1
+            # The write landed on the OWNER, not the stale local table.
+            got = owner.execute("SELECT v FROM rst")
+            assert [r["v"] for r in got.to_pylist()] == [2.0]
+            conn.close()
+        finally:
+            server.stop()
+            owner.close()
+
+    def test_write_not_retried_on_unavailable(self):
+        """UNAVAILABLE is ambiguous for writes (may have applied before
+        the connection died) — the write must surface the error, not
+        silently double-apply; reads may retry."""
+        from horaedb_tpu.cluster.router import Route
+        from horaedb_tpu.common_types.row_group import RowGroup
+        import grpc as _grpc
+
+        # Remote route to a port nobody listens on -> UNAVAILABLE.
+        router = self._FakeRouter(
+            Route("__rst_0", "127.0.0.1:9", False, source="meta")
+        )
+        rst, conn = self._mk(router)
+        rows = RowGroup.from_rows(
+            rst.schema, [{"host": "a", "v": 1.0, "ts": 1000}]
+        )
+        with pytest.raises(_grpc.RpcError):
+            rst.write(rows)
+        assert router.invalidated == []  # no retry attempted for the write
+        with pytest.raises(_grpc.RpcError):
+            rst.read()
+        assert router.invalidated == ["__rst_0"]  # read DID retry once
+        conn.close()
