@@ -99,6 +99,40 @@ class Subquery(Expr):
         return f"(subquery:{self.select.table})"
 
 
+class _CorrelatedDup:
+    """Sentinel value for a correlation key whose non-aggregate scalar
+    subquery matched more than one inner row. SQL errors only if such a
+    key is actually PROBED by an outer row — so the error is raised at
+    lookup time, not while materializing the inner result."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "<correlated-dup>"
+
+
+CORRELATED_DUP = _CorrelatedDup()
+
+
+@dataclass(frozen=True)
+class CorrelatedLookup(Expr):
+    """INTERNAL (never parsed): the decorrelated form of an
+    equality-correlated scalar subquery. The inner aggregate has been
+    computed once, grouped by its correlation keys; evaluation is a
+    per-outer-row lookup on ``outer_cols`` (real Column nodes, so every
+    expression walker — scan pruning, qualifier validation — sees them).
+    ``default`` fills missing keys AND NULL-keyed outer rows (0 for
+    COUNT, NULL otherwise — SQL's empty-group semantics)."""
+
+    outer_cols: tuple["Column", ...]
+    keys: tuple[tuple, ...]
+    values: tuple
+    default: object = None
+
+    def __str__(self) -> str:
+        return f"(corr-lookup:{','.join(c.name for c in self.outer_cols)})"
+
+
 @dataclass(frozen=True)
 class InSubquery(Expr):
     """expr [NOT] IN (SELECT col FROM ...) — uncorrelated; materialized
@@ -147,11 +181,15 @@ class OrderItem:
 
 @dataclass(frozen=True)
 class Join:
-    """Single-equi-key join: [LEFT] JOIN <table> ON <l.col> = <r.col>."""
+    """Equi-key join: [LEFT] JOIN <table> ON <l.k1> = <r.k1> [AND ...].
+
+    ``left_cols[i]`` pairs with ``right_cols[i]`` (conjunction of
+    equalities; the reference gets arbitrary join conditions from
+    DataFusion — this is the host-path equi-join subset)."""
 
     table: str
-    left_col: str
-    right_col: str
+    left_cols: tuple[str, ...]
+    right_cols: tuple[str, ...]
     kind: str = "inner"  # "inner" | "left"
 
 

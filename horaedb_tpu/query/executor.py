@@ -99,6 +99,8 @@ def eval_expr(e: ast.Expr, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
         return _eval_binary(e, rows)
     if isinstance(e, ast.FuncCall):
         return _eval_func(e, rows)
+    if isinstance(e, ast.CorrelatedLookup):
+        return _eval_correlated_lookup(e, rows)
     if isinstance(e, ast.InList):
         v, m = eval_expr(e.expr, rows)
         lits = [
@@ -128,6 +130,93 @@ def eval_expr(e: ast.Expr, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
         res = m if e.negated else ~m
         return res, np.ones(n, dtype=bool)
     raise ExprError(f"unsupported expression: {e}")
+
+
+def _eval_correlated_lookup(
+    e: "ast.CorrelatedLookup", rows: RowGroup
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-row lookup of a decorrelated scalar subquery's result by the
+    outer correlation columns. Fully vectorized for any key arity via the
+    same composite-code factorization the join uses. Semantics:
+
+    - missing key OR NULL outer key  -> ``default`` (0 for COUNT, else NULL):
+      a NULL key equality matches nothing, i.e. the empty group;
+    - key whose value is NULL        -> NULL;
+    - key marked CORRELATED_DUP      -> error, but ONLY if probed.
+    """
+    n = len(rows)
+    m = len(e.keys)
+    k = len(e.outer_cols)
+
+    vals = list(e.values)
+    null_v = np.array([v is None for v in vals], dtype=bool)
+    dup_v = np.array([v is ast.CORRELATED_DUP for v in vals], dtype=bool)
+    clean = [v for v in vals if v is not None and v is not ast.CORRELATED_DUP]
+    if all(
+        isinstance(v, (int, np.integer)) and not isinstance(v, (bool, np.bool_))
+        for v in clean
+    ) and (e.default is None or isinstance(e.default, int)):
+        dtype = np.dtype(np.int64)
+    elif all(
+        isinstance(v, (int, float, np.number)) and not isinstance(v, (bool, np.bool_))
+        for v in clean
+    ):
+        dtype = np.dtype(np.float64)
+    else:
+        dtype = np.dtype(object)
+    # NULL/missing slots carry a well-typed fill (the engine-wide
+    # convention — see RowGroup): "" for object/string values, 0 for
+    # numerics. An arbitrary 0 inside an object column would break
+    # downstream sorts/uniques with a str-vs-int TypeError.
+    fill = "" if dtype == object else 0
+    val_arr = np.full(m, fill, dtype=dtype)
+    for i, v in enumerate(vals):
+        if not (null_v[i] or dup_v[i]):
+            val_arr[i] = v
+
+    out = np.full(n, fill, dtype=dtype)
+    mask = np.zeros(n, dtype=bool)
+    if n == 0:
+        return out, mask
+
+    valid = np.ones(n, dtype=bool)
+    for c in e.outer_cols:
+        valid &= rows.valid_mask(c.name)
+
+    hit = np.zeros(n, dtype=bool)
+    idx = np.zeros(n, dtype=np.int64)
+    if m:
+        outer_arrays = [
+            np.asarray(as_values(rows.column(c.name)), dtype=object)
+            for c in e.outer_cols
+        ]
+        key_arrays = [
+            np.array([key[j] for key in e.keys], dtype=object) for j in range(k)
+        ]
+        from .join import _composite_codes
+
+        lc, rc = _composite_codes(outer_arrays, key_arrays)
+        order = np.argsort(rc, kind="stable")
+        rc_s = rc[order]
+        pos = np.minimum(np.searchsorted(rc_s, lc, side="left"), m - 1)
+        hit = (rc_s[pos] == lc) & valid
+        idx = order[pos]
+        if dup_v.any():
+            probed_dup = hit & dup_v[idx]
+            if probed_dup.any():
+                j = int(idx[np.nonzero(probed_dup)[0][0]])
+                raise ExprError(
+                    "correlated scalar subquery returned more than one "
+                    f"row for correlation key {e.keys[j]}"
+                )
+        real = hit & ~null_v[idx]
+        out[real] = val_arr[idx[real]]
+        mask[real] = True
+    miss = ~hit
+    if e.default is not None:
+        out[miss] = e.default
+        mask[miss] = True
+    return out, mask
 
 
 def _eval_binary(e: ast.BinaryOp, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
@@ -856,7 +945,23 @@ class Executor:
             if isinstance(e, ast.Column) or (
                 isinstance(e, ast.FuncCall) and e.name == "time_bucket"
             ):
-                ki = key_names.index(out_name if isinstance(e, ast.Column) else str(e))
+                # Resolve by the EXPRESSION, not the select item's output
+                # name: an aliased key (SELECT host AS h ... GROUP BY
+                # host) has output_name 'h' while the GroupKey carries
+                # the column name.
+                if isinstance(e, ast.Column):
+                    ki = next(
+                        (
+                            i
+                            for i, gk in enumerate(plan.group_keys)
+                            if gk.column == e.name
+                        ),
+                        None,
+                    )
+                    if ki is None:
+                        ki = key_names.index(out_name)
+                else:
+                    ki = key_names.index(str(e))
                 columns.append(as_values(key_arrays[ki][first_idx]))
                 names.append(out_name)
             else:

@@ -46,6 +46,42 @@ def _walk_all(e):
                     yield from _walk_all(x)
 
 
+def _flatten_and(e: ast.Expr) -> list:
+    if isinstance(e, ast.BinaryOp) and e.op == "AND":
+        return _flatten_and(e.left) + _flatten_and(e.right)
+    return [e]
+
+
+def _inner_tables_of(select: ast.Select) -> set:
+    return {
+        t
+        for t in (select.table, select.join.table if select.join else None)
+        if t
+    }
+
+
+def _correlated_cols(exprs, scope, inner_tables) -> list:
+    """Columns qualified by an OUTER-scope table (the correlation refs).
+    Unqualified names always resolve inner — outer references must be
+    qualified (documented restriction)."""
+    return [
+        x
+        for src in exprs
+        if src is not None
+        for x in _walk_all(src)
+        if isinstance(x, ast.Column)
+        and x.qualifier
+        and x.qualifier in scope
+        and x.qualifier not in inner_tables
+    ]
+
+
+def _has_correlated_refs(select: ast.Select, scope) -> bool:
+    inner = _inner_tables_of(select)
+    sources = InterpreterFactory._expr_sources(select)
+    return bool(_correlated_cols(sources, scope, inner))
+
+
 @dataclass(frozen=True)
 class AffectedRows:
     count: int
@@ -252,6 +288,10 @@ class InterpreterFactory:
                     subst(e.expr), tuple(ast.Literal(v) for v in vals), e.negated
                 )
             if isinstance(e, ast.Subquery):
+                if _has_correlated_refs(e.select, scope):
+                    # Equality-correlated scalar aggregate: decorrelate
+                    # into one grouped inner query + per-row lookup.
+                    return self._decorrelate_scalar(e.select, scope, planner)
                 vals = run_inner(e.select)
                 if len(vals) > 1:
                     raise InterpreterError(
@@ -295,6 +335,149 @@ class InterpreterFactory:
             ),
         )
         return planner.plan(new_stmt)
+
+    def _decorrelate_scalar(
+        self, select: ast.Select, scope, planner
+    ) -> ast.CorrelatedLookup:
+        """Rewrite an equality-correlated scalar aggregate subquery
+        (ref: DataFusion's scalar-subquery decorrelation; the classic
+        Kim/Neumann unnesting for the equality case):
+
+            (SELECT agg(x) FROM inner
+              WHERE inner.k = outer.k [AND uncorrelated...])
+
+        becomes one grouped inner query ``SELECT k, agg(x) ... GROUP BY
+        k`` run ONCE, substituted as a per-outer-row lookup on the
+        correlation columns. Anything beyond ANDed equality correlation
+        raises the established clear error."""
+        import dataclasses
+
+        inner_tables = _inner_tables_of(select)
+
+        def unsupported(why: str):
+            return InterpreterError(
+                f"correlated subquery not supported: {why} (only a single "
+                "scalar aggregate with ANDed `inner_col = outer.col` "
+                "correlation is decorrelated)"
+            )
+
+        if len(select.items) != 1:
+            raise unsupported("subquery must select exactly one expression")
+        if (
+            select.group_by
+            or select.having is not None
+            or select.order_by
+            or select.limit is not None
+            or select.distinct
+            or select.join is not None
+        ):
+            raise unsupported(
+                "GROUP BY/HAVING/ORDER BY/LIMIT/DISTINCT/JOIN in the subquery"
+            )
+        item = select.items[0]
+        non_where = [item.expr, *select.group_by]
+        if _correlated_cols(non_where, scope, inner_tables):
+            raise unsupported("outer reference outside the WHERE clause")
+
+        pairs: list[tuple[str, ast.Column]] = []  # (inner col, outer Column)
+        residual: list[ast.Expr] = []
+        for conj in _flatten_and(select.where) if select.where is not None else []:
+            corr = _correlated_cols([conj], scope, inner_tables)
+            if not corr:
+                residual.append(conj)
+                continue
+            ok = (
+                isinstance(conj, ast.BinaryOp)
+                and conj.op == "="
+                and isinstance(conj.left, ast.Column)
+                and isinstance(conj.right, ast.Column)
+            )
+            if not ok:
+                raise unsupported(f"non-equality outer reference: {conj}")
+            sides = {True: None, False: None}
+            for col in (conj.left, conj.right):
+                is_outer = bool(
+                    col.qualifier
+                    and col.qualifier in scope
+                    and col.qualifier not in inner_tables
+                )
+                sides[is_outer] = col
+            if sides[True] is None or sides[False] is None:
+                raise unsupported(f"both sides of {conj} bind to one scope")
+            pairs.append((sides[False].name, sides[True]))
+
+        # One grouped query: correlation keys become GROUP BY columns.
+        key_items = tuple(
+            ast.SelectItem(ast.Column(inner_col), alias=f"__ck{i}")
+            for i, (inner_col, _) in enumerate(pairs)
+        )
+        where = None
+        for conj in residual:
+            where = conj if where is None else ast.BinaryOp("AND", where, conj)
+        value_item = dataclasses.replace(item, alias="__cv")
+        grouped = True
+        try:
+            inner_plan = planner.plan(
+                dataclasses.replace(
+                    select,
+                    items=(*key_items, value_item),
+                    where=where,
+                    group_by=tuple(ast.Column(c) for c, _ in pairs),
+                )
+            )
+            grouped = bool(getattr(inner_plan, "is_aggregate", False))
+        except Exception:
+            grouped = False
+        if not grouped:
+            # Non-aggregate correlated scalar (SELECT col FROM ... WHERE
+            # k = outer.k): legal SQL — fails only if some correlated
+            # group yields more than one row (checked below).
+            inner_plan = planner.plan(
+                dataclasses.replace(
+                    select,
+                    items=(*key_items, value_item),
+                    where=where,
+                    group_by=(),
+                )
+            )
+        nested = self._materialize_subqueries(inner_plan, outer_scope=scope)
+        res = self.execute(nested if nested is not None else inner_plan)
+        if not isinstance(res, ResultSet):
+            raise unsupported("subquery must be a SELECT")
+
+        def py(v):
+            return v.item() if isinstance(v, np.generic) else v
+
+        nulls = res.nulls or {}
+        k = len(pairs)
+        key_cols = res.columns[:k]
+        val_col = res.columns[k]
+        val_null = nulls.get(res.names[k])
+        keys, values = [], []
+        keyed: dict = {}
+        for i in range(len(val_col)):
+            key = tuple(py(col[i]) for col in key_cols)
+            if not grouped and key in keyed:
+                # SQL errors only when this key is actually probed by an
+                # outer row — mark it and let the lookup raise then.
+                values[keyed[key]] = ast.CORRELATED_DUP
+                continue
+            keyed[key] = len(keys)
+            keys.append(key)
+            values.append(
+                None if (val_null is not None and val_null[i]) else py(val_col[i])
+            )
+        # SQL empty-group semantics: COUNT over no rows is 0, any other
+        # aggregate is NULL.
+        is_count = (
+            isinstance(item.expr, ast.FuncCall) and item.expr.name == "count"
+        )
+        return ast.CorrelatedLookup(
+            outer_cols=tuple(outer for _, outer in pairs),  # Column nodes
+            keys=tuple(keys),
+            values=tuple(values),
+            default=0 if is_count else None,
+        )
 
     def _insert(self, plan: InsertPlan) -> AffectedRows:
         table = self.catalog.open(plan.table)

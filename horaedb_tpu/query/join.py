@@ -1,16 +1,19 @@
-"""Single-key inner join on the host path
+"""Equi-key inner/left join on the host path
 (ref: the reference gets JOIN from DataFusion, query_engine/src/
-datafusion_impl/mod.rs:54 — this is the host-path subset: one equi-key,
-inner, two tables).
+datafusion_impl/mod.rs:54 — this is the host-path subset: one or more
+equi-keys ANDed, inner/left, two tables).
 
-Vectorized hash-join shape: factorize both key columns into one code
-space, sort the right side by code, then expand match pairs with
-repeat/cumsum arithmetic — no per-row Python. Joined rows feed the
-existing projection/WHERE/ORDER BY/LIMIT machinery over a synthesized
-combined schema.
+Vectorized hash-join shape: factorize each key-column pair into one code
+space, fold multiple keys into a composite code (re-compacted per key so
+the product never overflows), sort the right side by code, then expand
+match pairs with repeat/cumsum arithmetic — no per-row Python. Joined
+rows feed the existing projection/WHERE/ORDER BY/LIMIT machinery over a
+synthesized combined schema.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import numpy as np
 
@@ -34,9 +37,12 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     if right_t is None:
         raise JoinError(f"table not found: {join.table}")
     ls, rs = left_t.schema, right_t.schema
-    for s, col, side in ((ls, join.left_col, stmt.table), (rs, join.right_col, join.table)):
-        if not s.has_column(col):
-            raise JoinError(f"join key {col!r} not in {side}")
+    for col in join.left_cols:
+        if not ls.has_column(col):
+            raise JoinError(f"join key {col!r} not in {stmt.table}")
+    for col in join.right_cols:
+        if not rs.has_column(col):
+            raise JoinError(f"join key {col!r} not in {join.table}")
 
     # Push the WHERE's time range + simple filters into the LEFT scan
     # (the output timestamp IS the left one, so its conjuncts are left's;
@@ -47,8 +53,10 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     left = left_t.read(extract_predicate(stmt.where, ls))
     right = right_t.read(None)
 
-    lk = as_values(left.column(join.left_col))
-    rk = as_values(right.column(join.right_col))
+    lk, rk = _composite_codes(
+        [as_values(left.column(c)) for c in join.left_cols],
+        [as_values(right.column(c)) for c in join.right_cols],
+    )
     li_idx, ri_idx = _inner_match(lk, rk)
     if join.kind == "left":
         # unmatched left rows survive with NULL right columns
@@ -70,8 +78,8 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     cols: list[ColumnSchema] = list(visible(ls))
     names = {c.name for c in cols}
     for c in visible(rs):
-        if c.name == join.right_col:
-            continue  # equal to the left key by construction
+        if c.name in join.right_cols:
+            continue  # equal to the left keys by construction
         if c.name == rs.timestamp_name:
             # Every table carries a timestamp; the joined row keeps the
             # LEFT one (dimension-table joins don't want the right's).
@@ -85,7 +93,7 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     combined_schema = Schema.build(
         [ColumnSchema(c.name, c.kind, is_tag=c.is_tag) for c in cols],
         timestamp_column=ls.timestamp_name,
-        primary_key=[join.left_col, ls.timestamp_name],
+        primary_key=[*join.left_cols, ls.timestamp_name],
     )
     data = {}
     validity = {}
@@ -97,7 +105,7 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     null_right = ri_idx < 0  # LEFT JOIN: rows with no right-side match
     ri_safe = np.where(null_right, 0, ri_idx)
     for c in visible(rs):
-        if c.name == join.right_col or c.name == rs.timestamp_name:
+        if c.name in join.right_cols or c.name == rs.timestamp_name:
             continue
         vals = as_values(right.column(c.name))
         # NULL slots carry the column kind's default fill (the engine-wide
@@ -146,11 +154,37 @@ def execute_join(catalog, executor, stmt: ast.Select) -> ResultSet:
     return executor._execute_projection(plan, rows)
 
 
+def _composite_codes(
+    l_cols: list[np.ndarray], r_cols: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fold N key-column pairs into one integer code per row such that
+    composite codes are equal iff every key column is equal.
+
+    Per key: factorize left+right jointly, then composite = prior * card
+    + code. The composite is RE-COMPACTED after each key (unique over at
+    most n_l + n_r values), so the running product stays bounded by
+    (n_l + n_r) * card and cannot overflow int64 for any realistic input.
+    """
+    n_l = len(l_cols[0])
+    comp: Optional[np.ndarray] = None
+    for lk, rk in zip(l_cols, r_cols):
+        _, codes = unique_inverse(np.concatenate([lk, rk]))
+        codes = codes.astype(np.int64)
+        if comp is None:
+            comp = codes
+            continue
+        card = int(codes.max()) + 1 if len(codes) else 1
+        comp = comp * card + codes
+        _, comp = np.unique(comp, return_inverse=True)
+    assert comp is not None
+    return comp[:n_l], comp[n_l:]
+
+
 def _inner_match(lk: np.ndarray, rk: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """Index pairs (li, ri) of every equal-key combination."""
+    """Index pairs (li, ri) of every equal-code combination; ``lk``/``rk``
+    are already in one shared code space (see _composite_codes)."""
     n_l = len(lk)
-    _, codes = unique_inverse(np.concatenate([lk, rk]))
-    lc, rc = codes[:n_l], codes[n_l:]
+    lc, rc = lk, rk
     order_r = np.argsort(rc, kind="stable")
     rc_sorted = rc[order_r]
     # for each left row: the contiguous run of matching right rows

@@ -260,22 +260,29 @@ class Parser:
         )
 
     def _join_clause(self, left_table: str, kind: str = "inner") -> ast.Join:
-        """JOIN t2 ON a.k = b.k — single equi-key inner/left join
-        (the reference gets richer joins from DataFusion; this is the
-        host-path subset)."""
+        """JOIN t2 ON a.k1 = b.k1 [AND a.k2 = b.k2 ...] — equi-key
+        inner/left join (the reference gets richer joins from DataFusion;
+        this is the host-path equi-join subset)."""
         right = self._ident()
         self._expect_kw("ON")
-        l_tab, l_col = self._qualified()
-        self._expect_op("=")
-        r_tab, r_col = self._qualified()
-        # normalize sides: left table's column first
-        if l_tab == right and r_tab == left_table:
-            l_col, r_col = r_col, l_col
-        elif not (l_tab in (left_table, None) and r_tab in (right, None)):
-            raise ParseError(
-                f"JOIN ON must reference {left_table} and {right}", -1, self.sql
-            )
-        return ast.Join(right, l_col, r_col, kind=kind)
+        left_cols: list[str] = []
+        right_cols: list[str] = []
+        while True:
+            l_tab, l_col = self._qualified()
+            self._expect_op("=")
+            r_tab, r_col = self._qualified()
+            # normalize sides: left table's column first
+            if l_tab == right and r_tab == left_table:
+                l_col, r_col = r_col, l_col
+            elif not (l_tab in (left_table, None) and r_tab in (right, None)):
+                raise ParseError(
+                    f"JOIN ON must reference {left_table} and {right}", -1, self.sql
+                )
+            left_cols.append(l_col)
+            right_cols.append(r_col)
+            if not self._eat_kw("AND"):
+                break
+        return ast.Join(right, tuple(left_cols), tuple(right_cols), kind=kind)
 
     def _qualified(self) -> tuple[Optional[str], str]:
         name = self._ident()

@@ -473,6 +473,11 @@ def _walk(e: ast.Expr):
         yield from _walk(e.high)
     elif isinstance(e, ast.IsNull):
         yield from _walk(e.expr)
+    elif isinstance(e, ast.CorrelatedLookup):
+        # the correlation columns are outer-scope references — scan
+        # pruning and qualifier validation must see them
+        for c in e.outer_cols:
+            yield from _walk(c)
 
 
 def _walk_exprs(stmt: ast.Select):

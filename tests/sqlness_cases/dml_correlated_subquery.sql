@@ -1,0 +1,20 @@
+-- equality-correlated scalar subqueries (decorrelated into one grouped
+-- inner query + per-row lookup; ref: DataFusion scalar decorrelation)
+CREATE TABLE co (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE lim (host string TAG, cap double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO co (host, v, ts) VALUES ('a', 1.0, 1), ('a', 8.0, 2), ('b', 3.0, 1), ('c', 4.0, 1);
+INSERT INTO lim (host, cap, ts) VALUES ('a', 5.0, 1), ('b', 10.0, 1);
+SELECT host, v FROM co WHERE v < (SELECT max(cap) FROM lim WHERE lim.host = co.host) ORDER BY host, v;
+SELECT host, v, (SELECT sum(cap) FROM lim WHERE lim.host = co.host) AS s FROM co ORDER BY host, v;
+SELECT host, v FROM co WHERE (SELECT count(cap) FROM lim WHERE lim.host = co.host) = 0 ORDER BY host;
+SELECT host FROM co WHERE v > (SELECT cap FROM lim WHERE lim.host = co.host);
+DROP TABLE co;
+DROP TABLE lim;
+-- non-aggregate correlated scalar: duplicates in a correlated group error
+CREATE TABLE outerq (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE dup (host string TAG, cap double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO outerq (host, v, ts) VALUES ('a', 9.0, 1);
+INSERT INTO dup (host, cap, ts) VALUES ('a', 1.0, 1), ('a', 2.0, 2);
+SELECT host FROM outerq WHERE v > (SELECT cap FROM dup WHERE dup.host = outerq.host);
+DROP TABLE outerq;
+DROP TABLE dup;

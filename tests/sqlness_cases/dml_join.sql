@@ -11,3 +11,12 @@ SELECT host FROM m LEFT OUTER JOIN owners ON m.host = owners.host WHERE owner IS
 SELECT host, owner FROM m LEFT JOIN owners ON m.host = owners.host ORDER BY owner, host;
 DROP TABLE m;
 DROP TABLE owners;
+-- multi-key equi-join: ON a AND b
+CREATE TABLE m2 (host string TAG, region string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+CREATE TABLE caps (host string TAG, region string TAG, cap double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO m2 (host, region, v, ts) VALUES ('a', 'us', 1.0, 1), ('b', 'us', 2.0, 1), ('b', 'eu', 3.0, 1);
+INSERT INTO caps (host, region, cap, ts) VALUES ('a', 'us', 10.0, 1), ('b', 'eu', 30.0, 1);
+SELECT host, region, v, cap FROM m2 JOIN caps ON m2.host = caps.host AND m2.region = caps.region ORDER BY host, region;
+SELECT host, region, cap FROM m2 LEFT JOIN caps ON m2.host = caps.host AND m2.region = caps.region ORDER BY host, region;
+DROP TABLE m2;
+DROP TABLE caps;

@@ -106,6 +106,50 @@ class TestJoin:
         ).to_pylist()
         assert out == [{"host": "b", "v": 4.0}]
 
+    def test_multi_key_inner_join(self, db):
+        db.execute(
+            "CREATE TABLE caps (host string TAG, region string TAG, "
+            "cap double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+            "ENGINE=Analytic"
+        )
+        # (b, us) and (b, eu) differ only in the SECOND key — a
+        # single-key join on host would cross-match them.
+        db.execute(
+            "INSERT INTO caps (host, region, cap, ts) VALUES "
+            "('a', 'us', 10.0, 1), ('b', 'us', 20.0, 1), ('b', 'eu', 30.0, 1)"
+        )
+        out = db.execute(
+            "SELECT host, region, v, cap FROM q JOIN caps "
+            "ON q.host = caps.host AND q.region = caps.region "
+            "ORDER BY host, region, v"
+        ).to_pylist()
+        assert out == [
+            {"host": "a", "region": "us", "v": 1.0, "cap": 10.0},
+            {"host": "a", "region": "us", "v": 2.0, "cap": 10.0},
+            {"host": "b", "region": "eu", "v": 4.0, "cap": 30.0},
+            {"host": "b", "region": "us", "v": 3.0, "cap": 20.0},
+        ]  # host c: no caps row; (b,eu) matched only the eu cap
+
+    def test_multi_key_left_join(self, db):
+        db.execute(
+            "CREATE TABLE caps2 (host string TAG, region string TAG, "
+            "cap double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+            "ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO caps2 (host, region, cap, ts) VALUES ('a', 'us', 10.0, 1)"
+        )
+        out = db.execute(
+            "SELECT host, region, cap FROM q LEFT JOIN caps2 "
+            "ON q.host = caps2.host AND q.region = caps2.region "
+            "WHERE cap IS NULL ORDER BY host, region"
+        ).to_pylist()
+        assert out == [
+            {"host": "b", "region": "eu", "cap": None},
+            {"host": "b", "region": "us", "cap": None},
+            {"host": "c", "region": "eu", "cap": None},
+        ]
+
     def test_join_aggregate_rejected(self, db):
         db.execute(
             "CREATE TABLE own3 (host string TAG, ts timestamp NOT NULL, "
@@ -361,23 +405,215 @@ class TestLimitPushdown:
         conn.close()
 
 
-class TestCorrelatedSubqueryError:
-    def test_clear_error_message(self, db):
+class TestCorrelatedSubquery:
+    def test_equality_correlated_scalar_executes(self, db):
         db.execute(
             "CREATE TABLE oth (host string TAG, w double, ts timestamp NOT NULL, "
             "TIMESTAMP KEY(ts)) ENGINE=Analytic"
         )
         db.execute("INSERT INTO oth (host, w, ts) VALUES ('a', 5.0, 1)")
-        with pytest.raises(Exception, match="correlated subqueries"):
-            db.execute(
-                "SELECT host FROM q WHERE v < "
-                "(SELECT max(w) FROM oth WHERE oth.host = q.host)"
-            )
+        # Decorrelated: per-host max(w); hosts without an oth row compare
+        # against NULL -> dropped.
+        out = db.execute(
+            "SELECT host, v FROM q WHERE v < "
+            "(SELECT max(w) FROM oth WHERE oth.host = q.host) ORDER BY v"
+        ).to_pylist()
+        assert out == [{"host": "a", "v": 1.0}, {"host": "a", "v": 2.0}]
         # uncorrelated still works
         out = db.execute(
             "SELECT host FROM q WHERE v < (SELECT max(w) FROM oth) ORDER BY host, v"
         ).to_pylist()
         assert [r["host"] for r in out] == ["a", "a", "b", "b"]  # v < 5.0
+
+    def test_correlated_count_defaults_to_zero(self, db):
+        db.execute(
+            "CREATE TABLE ev (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO ev (host, w, ts) VALUES ('a', 1.0, 1), ('a', 2.0, 2)"
+        )
+        # COUNT over an empty correlated group is 0, not NULL: hosts with
+        # no ev rows satisfy '= 0'.
+        out = db.execute(
+            "SELECT DISTINCT host FROM q WHERE "
+            "(SELECT count(w) FROM ev WHERE ev.host = q.host) = 0 "
+            "ORDER BY host"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["b", "c"]
+
+    def test_correlated_in_select_item(self, db):
+        db.execute(
+            "CREATE TABLE sums (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO sums (host, w, ts) VALUES "
+            "('a', 10.0, 1), ('a', 20.0, 2), ('b', 5.0, 1)"
+        )
+        out = db.execute(
+            "SELECT DISTINCT host, "
+            "(SELECT sum(w) FROM sums WHERE sums.host = q.host) AS s "
+            "FROM q ORDER BY host"
+        ).to_pylist()
+        assert out == [
+            {"host": "a", "s": 30.0},
+            {"host": "b", "s": 5.0},
+            {"host": "c", "s": None},
+        ]
+
+    def test_correlated_with_residual_filter(self, db):
+        db.execute(
+            "CREATE TABLE rf (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO rf (host, w, ts) VALUES "
+            "('a', 100.0, 1), ('a', 1.0, 2), ('b', 100.0, 1)"
+        )
+        # the uncorrelated conjunct (w < 50) stays inside the subquery
+        out = db.execute(
+            "SELECT DISTINCT host FROM q WHERE v <= "
+            "(SELECT max(w) FROM rf WHERE rf.host = q.host AND w < 50) "
+            "ORDER BY host"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["a"]  # only a has w<50 rows
+
+    def test_correlation_column_not_otherwise_selected(self, db):
+        """The correlation column appears ONLY inside the subquery; scan
+        pruning must still fetch it for the lookup."""
+        db.execute(
+            "CREATE TABLE ev2 (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO ev2 (host, w, ts) VALUES ('a', 1.0, 1)")
+        out = db.execute(
+            "SELECT v, (SELECT count(w) FROM ev2 WHERE ev2.host = q.host) AS c "
+            "FROM q ORDER BY v"
+        ).to_pylist()
+        assert [r["c"] for r in out] == [1, 1, 0, 0, 0]
+
+    def test_correlation_on_non_tag_column(self, db):
+        """A non-TAG correlation key drives the inner grouped query down
+        the host aggregation path (regression: aliased group keys)."""
+        db.execute(
+            "CREATE TABLE nt (code double, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO nt (code, w, ts) VALUES (1.0, 10.0, 1), (1.0, 20.0, 2)"
+        )
+        out = db.execute(
+            "SELECT v, (SELECT sum(w) FROM nt WHERE nt.code = q.v) AS s "
+            "FROM q WHERE v = 1.0"
+        ).to_pylist()
+        assert out == [{"v": 1.0, "s": 30.0}]
+
+    def test_group_key_alias_host_path(self, db):
+        # pre-existing host-path bug the decorrelation surfaced:
+        # aliased group keys must resolve by expression, not output name
+        ex = db.interpreters.executor
+        orig = ex._device_capable
+        ex._device_capable = lambda plan, rows: False
+        try:
+            out = db.execute(
+                "SELECT host AS h, max(v) AS m FROM q GROUP BY host ORDER BY h"
+            ).to_pylist()
+        finally:
+            ex._device_capable = orig
+        assert out == [
+            {"h": "a", "m": 2.0},
+            {"h": "b", "m": 4.0},
+            {"h": "c", "m": 5.0},
+        ]
+
+    def test_string_valued_correlated_scalar(self, db):
+        db.execute(
+            "CREATE TABLE own (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO own (host, owner, ts) VALUES ('a', 'alice', 1), ('b', 'bob', 1)"
+        )
+        out = db.execute(
+            "SELECT DISTINCT host, "
+            "(SELECT owner FROM own WHERE own.host = q.host) AS o "
+            "FROM q ORDER BY host"
+        ).to_pylist()
+        assert out == [
+            {"host": "a", "o": "alice"},
+            {"host": "b", "o": "bob"},
+            {"host": "c", "o": None},
+        ]
+
+    def test_correlated_count_is_integer(self, db):
+        db.execute(
+            "CREATE TABLE ci (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO ci (host, w, ts) VALUES ('a', 1.0, 1)")
+        out = db.execute(
+            "SELECT DISTINCT host, "
+            "(SELECT count(w) FROM ci WHERE ci.host = q.host) AS c "
+            "FROM q ORDER BY host"
+        ).to_pylist()
+        assert out[0]["c"] == 1 and isinstance(out[0]["c"], int)
+        assert out[2]["c"] == 0 and isinstance(out[2]["c"], int)
+
+    def test_null_outer_key_counts_as_zero(self, db):
+        """A NULL correlation key matches nothing — COUNT over the empty
+        group is 0 (not NULL)."""
+        db.execute(
+            "CREATE TABLE nk (code double, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO nk (code, w, ts) VALUES (1.0, 5.0, 1)")
+        # outer row with NULL v (field columns are nullable)
+        db.execute("INSERT INTO q (host, region, ts) VALUES ('z', 'us', 50)")
+        out = db.execute(
+            "SELECT host, (SELECT count(w) FROM nk WHERE nk.code = q.v) AS c "
+            "FROM q WHERE host = 'z'"
+        ).to_pylist()
+        assert out == [{"host": "z", "c": 0}]
+
+    def test_unprobed_duplicate_key_is_fine(self, db):
+        """Duplicate correlation keys the outer query never probes must
+        not error (SQL errors only on probed keys)."""
+        db.execute(
+            "CREATE TABLE d2 (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        # 'zzz' is duplicated but no outer row has host 'zzz'
+        db.execute(
+            "INSERT INTO d2 (host, w, ts) VALUES "
+            "('a', 9.0, 1), ('zzz', 1.0, 1), ('zzz', 2.0, 2)"
+        )
+        out = db.execute(
+            "SELECT host, v FROM q WHERE v < "
+            "(SELECT w FROM d2 WHERE d2.host = q.host) ORDER BY v"
+        ).to_pylist()
+        assert out == [
+            {"host": "a", "v": 1.0},
+            {"host": "a", "v": 2.0},
+        ]
+        # a PROBED duplicate still errors
+        db.execute("INSERT INTO q (host, region, v, ts) VALUES ('zzz', 'us', 0.0, 9)")
+        with pytest.raises(Exception, match="more than one row"):
+            db.execute(
+                "SELECT host FROM q WHERE v < "
+                "(SELECT w FROM d2 WHERE d2.host = q.host)"
+            )
+
+    def test_unsupported_correlation_shape_clear_error(self, db):
+        db.execute(
+            "CREATE TABLE us (host string TAG, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        with pytest.raises(Exception, match="correlated subquery not supported"):
+            db.execute(
+                "SELECT host FROM q WHERE v < "
+                "(SELECT max(w) FROM us WHERE us.w > q.v)"  # non-equality
+            )
 
     def test_nested_correlated_also_clear(self, db):
         db.execute(
