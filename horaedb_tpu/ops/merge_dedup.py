@@ -95,7 +95,6 @@ def merge_dedup_permutation(
         pad_to_bucket(negseq_hi, n),
         pad_to_bucket(negseq_lo, n),
     ]
-    perm, keep = _merge_dedup_kernel(*(jnp.asarray(a) for a in args), dedup=dedup)
-    perm = np.asarray(perm)[:n]
-    keep = np.asarray(keep)[:n]
-    return perm, keep
+    out = _merge_dedup_kernel(*(jnp.asarray(a) for a in args), dedup=dedup)
+    perm, keep = jax.device_get(out)  # one RTT for both outputs
+    return perm[:n], keep[:n]

@@ -69,6 +69,7 @@ def _build_step(mesh: Mesh, spec: ScanAggSpec, tag: str, body, in_specs) -> Call
                 n_buckets=spec.n_buckets,
                 n_agg_fields=spec.n_agg_fields,
                 numeric_filters=static_filters,
+                need_minmax=spec.need_minmax,
             )
         )
 

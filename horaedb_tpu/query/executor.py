@@ -542,6 +542,7 @@ class Executor:
             numeric_filters=tuple(
                 (value_names.index(col), op) for col, op, _ in device_filters
             ),
+            need_minmax=_plan_needs_minmax(plan),
         ).padded()
         literals = [lit for _, _, lit in device_filters]
 
@@ -728,6 +729,7 @@ class Executor:
             numeric_filters=tuple(
                 (value_names.index(col), op) for col, op, _ in device_filters
             ),
+            need_minmax=_plan_needs_minmax(plan),
         ).padded()
 
         gos = np.append(series_group, 0).astype(np.int32)  # pad series -> masked
@@ -765,6 +767,7 @@ class Executor:
                 n_buckets=spec.n_buckets,
                 n_agg_fields=spec.n_agg_fields,
                 numeric_filters=encode_filter_ops(spec.numeric_filters),
+                need_minmax=spec.need_minmax,
             )
         elif entry.mesh is not None:
             # Sharded entry: the big arrays live split across the mesh —
@@ -782,6 +785,7 @@ class Executor:
                 n_buckets=spec.n_buckets,
                 n_agg_fields=spec.n_agg_fields,
                 numeric_filters=encode_filter_ops(spec.numeric_filters),
+                need_minmax=spec.need_minmax,
             )
         state = state_to_host(*out)
         if len(delta) and not empty_range:
@@ -909,22 +913,32 @@ class Executor:
             v, m = eval_expr(residual, rows)
             rows = rows.filter(v.astype(bool) & m)
 
-        # Group keys as value arrays.
+        # Group keys as value arrays. NULL keys form their own group
+        # (standard SQL) — validity joins the grouping code so NULL never
+        # collapses into the column's fill value.
         key_arrays: list = []
+        key_valids: list = []  # None when every row is valid
         key_names: list[str] = []
         for k in plan.group_keys:
             if k.column is not None:
                 key_arrays.append(rows.column(k.column))
+                vm = rows.valid_mask(k.column)
+                key_valids.append(None if vm.all() else vm)
             else:
                 key_arrays.append((rows.timestamps // k.time_bucket_ms) * k.time_bucket_ms)
+                key_valids.append(None)
             key_names.append(k.output_name)
 
         n = len(rows)
         if key_arrays:
             combined = np.zeros(n, dtype=np.int64)
-            for arr in key_arrays:
+            for arr, vm in zip(key_arrays, key_valids):
                 u, inv = unique_inverse(arr)
-                combined = combined * (len(u) + 1) + inv
+                if vm is not None:
+                    inv = np.where(vm, inv + 1, 0)  # code 0 = the NULL group
+                    combined = combined * (len(u) + 2) + inv
+                else:
+                    combined = combined * (len(u) + 1) + inv
             uniq_comb, first_idx, codes = np.unique(
                 combined, return_index=True, return_inverse=True
             )
@@ -963,6 +977,9 @@ class Executor:
                 else:
                     ki = key_names.index(str(e))
                 columns.append(as_values(key_arrays[ki][first_idx]))
+                vmk = key_valids[ki]
+                if vmk is not None and not vmk[first_idx].all():
+                    nulls[out_name] = ~vmk[first_idx]
                 names.append(out_name)
             else:
                 agg_i = [a.output_name for a in plan.aggs].index(out_name)
@@ -1031,6 +1048,12 @@ class Executor:
                     {n: m_[:k] for n, m_ in (result.nulls or {}).items()} or None,
                 )
         return result
+
+
+def _plan_needs_minmax(plan) -> bool:
+    """False when no aggregate in the plan reads min/max — the device
+    kernel then skips those reductions entirely."""
+    return any(a.func in ("min", "max") for a in plan.aggs)
 
 
 def _is_series_conjunct(conj: ast.Expr, tag_names: set) -> bool:

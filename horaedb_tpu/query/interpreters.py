@@ -453,9 +453,15 @@ class InterpreterFactory:
         key_cols = res.columns[:k]
         val_col = res.columns[k]
         val_null = nulls.get(res.names[k])
+        key_nulls = [nulls.get(res.names[i]) for i in range(k)]
         keys, values = [], []
         keyed: dict = {}
         for i in range(len(val_col)):
+            if any(kn is not None and kn[i] for kn in key_nulls):
+                # `inner.k = outer.k` is NULL (not true) when the inner
+                # key is NULL — such rows can never match any outer row,
+                # and must not surface as their column's fill value.
+                continue
             key = tuple(py(col[i]) for col in key_cols)
             if not grouped and key in keyed:
                 # SQL errors only when this key is actually probed by an

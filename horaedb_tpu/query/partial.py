@@ -30,7 +30,7 @@ from ..ops import ScanAggSpec, encode_group_codes, scan_aggregate
 from ..ops.encoding import build_padded_batch, time_buckets
 from ..table_engine.predicate import ColumnFilter, FilterOp, Predicate
 from ..remote.codec import predicate_from_dict, predicate_to_dict
-from .executor import ResultSet
+from .executor import ResultSet, _plan_needs_minmax
 from .plan import QueryPlan
 
 from ..table_engine.predicate import NUMPY_CMP as _CMP
@@ -65,6 +65,8 @@ def spec_from_plan(executor, plan: QueryPlan) -> Optional[dict]:
         "group_tags": [k.column for k in tag_keys],
         "bucket_ms": bucket_key.time_bucket_ms if bucket_key is not None else 0,
         "agg_cols": agg_cols,
+        # optional (older peers omit it -> treated as True by consumers)
+        "need_minmax": _plan_needs_minmax(plan),
     }
 
 
@@ -142,6 +144,7 @@ def _partial_kernel(rows, mask, spec, t0) -> tuple[list[str], list[np.ndarray]]:
         numeric_filters=tuple(
             (value_names.index(c), op) for c, op, _ in spec["device_filters"]
         ),
+        need_minmax=bool(spec.get("need_minmax", True)),
     ).padded()
 
     from ..parallel.mesh import dist_min_rows, serving_mesh
@@ -165,13 +168,19 @@ def _partial_kernel(rows, mask, spec, t0) -> tuple[list[str], list[np.ndarray]]:
     ]
     arrays.append(t0 + live_b.astype(np.int64) * (bucket_ms or 1))
     arrays.append(counts[live_g, live_b].astype(np.int64))
+    need_minmax = bool(spec.get("need_minmax", True))
+    n_live = len(live_g)
     for fi, _col in enumerate(agg_cols):
         names += [f"__count_{fi}", f"__sum_{fi}", f"__min_{fi}", f"__max_{fi}"]
         arrays += [
             counts[live_g, live_b].astype(np.int64),  # full validity ⇒ same
             state.sums[fi, :G, :B][live_g, live_b],
-            state.mins[fi, :G, :B][live_g, live_b],
-            state.maxs[fi, :G, :B][live_g, live_b],
+            # identity elements when the kernel skipped min/max: the
+            # monoid fold in combine_partials leaves them inert
+            state.mins[fi, :G, :B][live_g, live_b]
+            if need_minmax else np.full(n_live, np.inf),
+            state.maxs[fi, :G, :B][live_g, live_b]
+            if need_minmax else np.full(n_live, -np.inf),
         ]
     return names, arrays
 

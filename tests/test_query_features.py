@@ -576,6 +576,53 @@ class TestCorrelatedSubquery:
         ).to_pylist()
         assert out == [{"host": "z", "c": 0}]
 
+    def test_null_inner_key_never_matches(self, db):
+        """NULL inner correlation keys are not equal to anything — they
+        must not surface as the column's fill value (0.0)."""
+        db.execute(
+            "CREATE TABLE nik (code double, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO nik (w, ts) VALUES (7.0, 1)")  # code NULL
+        db.execute("INSERT INTO q (host, region, v, ts) VALUES ('z', 'us', 0.0, 50)")
+        out = db.execute(
+            "SELECT host, (SELECT w FROM nik WHERE nik.code = q.v) AS s "
+            "FROM q WHERE host = 'z'"
+        ).to_pylist()
+        assert out == [{"host": "z", "s": None}]
+        out = db.execute(
+            "SELECT host, (SELECT count(w) FROM nik WHERE nik.code = q.v) AS c "
+            "FROM q WHERE host = 'z'"
+        ).to_pylist()
+        assert out == [{"host": "z", "c": 0}]
+        # a real 0.0 key still matches (and the NULL row stays invisible)
+        db.execute("INSERT INTO nik (code, w, ts) VALUES (0.0, 5.0, 2)")
+        out = db.execute(
+            "SELECT host, (SELECT w FROM nik WHERE nik.code = q.v) AS s "
+            "FROM q WHERE host = 'z'"
+        ).to_pylist()
+        assert out == [{"host": "z", "s": 5.0}]
+
+    def test_null_group_key_forms_own_group(self, db):
+        """GROUP BY over a nullable column: NULLs form one group reported
+        as NULL (not the fill value)."""
+        db.execute(
+            "CREATE TABLE ng (code double, w double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO ng (code, w, ts) VALUES (0.0, 1.0, 1), (2.0, 3.0, 2)"
+        )
+        db.execute("INSERT INTO ng (w, ts) VALUES (9.0, 3)")  # code NULL
+        rows = db.execute(
+            "SELECT code, count(*) AS c, sum(w) AS s FROM ng GROUP BY code"
+        ).to_pylist()
+        assert len(rows) == 3
+        bykey = {r["code"]: r for r in rows}
+        assert bykey[None] == {"code": None, "c": 1, "s": 9.0}
+        assert bykey[0.0] == {"code": 0.0, "c": 1, "s": 1.0}
+        assert bykey[2.0] == {"code": 2.0, "c": 1, "s": 3.0}
+
     def test_unprobed_duplicate_key_is_fine(self, db):
         """Duplicate correlation keys the outer query never probes must
         not error (SQL errors only on probed keys)."""
