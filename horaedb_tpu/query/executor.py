@@ -293,8 +293,11 @@ class Executor:
         # stage timings threaded through the read path)
         self.last_metrics: dict = {}
         from .scan_cache import ScanCache
+        from .path_router import PathRouter
 
         self.scan_cache = ScanCache()
+        self.path_router = PathRouter()
+        self._adaptive: bool | None = None  # resolved lazily (imports jax)
 
     def execute(self, plan: QueryPlan, table) -> ResultSet:
         import time as _time
@@ -306,7 +309,25 @@ class Executor:
         import os as _os
 
         cache_on = _os.environ.get("HORAEDB_SCAN_CACHE", "1") != "0"
-        if plan.is_aggregate and cache_on:
+        # Adaptive routing: on accelerators with real dispatch latency the
+        # profitable path is an empirical question — serve from whichever
+        # path has measured faster for this query shape (path_router.py).
+        route = None
+        if plan.is_aggregate:
+            if self._adaptive is None:
+                from .path_router import adaptive_enabled
+
+                self._adaptive = adaptive_enabled()
+            # Only shapes the device kernels can serve are worth routing;
+            # everything else goes straight to its natural path.
+            if self._adaptive and self._agg_device_shape(plan) is not None:
+                from .path_router import plan_shape_key
+
+                key = plan_shape_key(plan)
+                route = self.path_router.choose(key)
+                m["_adaptive_key"] = key
+                m["route"] = route
+        if plan.is_aggregate and cache_on and route != "host":
             cached = self._try_cached_agg(plan, table, m)
             if cached is not None:
                 path = "device-cached"
@@ -314,7 +335,7 @@ class Executor:
         # Partitioned tables: push the aggregate DOWN to each partition
         # (local kernel per partition; remote partitions over the wire —
         # ref: dist_sql_query resolver push-down) and combine partials.
-        if plan.is_aggregate and hasattr(table, "sub_tables"):
+        if plan.is_aggregate and hasattr(table, "sub_tables") and route != "host":
             out = self._try_partitioned_agg(plan, table, m)
             if out is not None:
                 return self._finish_metrics(m, t_start, "device-partial", out)
@@ -336,7 +357,7 @@ class Executor:
         rows = table.read(predicate, projection=projection)
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)
-        if plan.is_aggregate and self._device_capable(plan, rows):
+        if plan.is_aggregate and route != "host" and self._device_capable(plan, rows):
             out = self._execute_agg_device(plan, rows, m)
             path = "device-dist" if "mesh_devices" in m else "device"
         elif plan.is_aggregate:
@@ -355,6 +376,11 @@ class Executor:
         m["path"] = path
         m["result_rows"] = out.num_rows
         m["total_ms"] = round((_time.perf_counter() - t_start) * 1000, 3)
+        akey = m.pop("_adaptive_key", None)
+        if akey is not None and m.get("cache") != "build":
+            # one-off cache-build cost must not poison the device estimate
+            kind = "host" if path == "host" else "device"
+            self.path_router.record(akey, kind, _time.perf_counter() - t_start)
         out.metrics = m
         # Observability conveniences; atomic rebinds (read-only snapshots
         # for tests/dashboards — per-request truth travels on the result).

@@ -1,0 +1,124 @@
+"""Adaptive device/host path routing for aggregate queries.
+
+The reference picks execution resources per query with a static rule
+(expensive-query classification by time range -> priority runtime,
+query_frontend/src/plan.rs:105, components/runtime/src/priority_runtime.rs);
+this is the TPU-native generalization: the profitable path depends on the
+accelerator's dispatch latency, which varies by deployment (PCIe-attached
+~us; a tunneled/remote chip ~tens of ms). Instead of a static threshold,
+the router MEASURES both paths per query shape and serves from the winner,
+re-probing the loser on a fixed cadence so it adapts when conditions change
+(scan cache finishes building, data grows, tunnel latency shifts).
+
+Keyed by (table, select-statement shape): repeated dashboard/TSBS-style
+queries converge after one probe of each path. Latencies fold into an EWMA
+so a single GC hiccup or retuned tunnel doesn't flip the decision.
+
+Enabled when the JAX backend is not ``cpu`` (override with
+HORAEDB_ADAPTIVE_PATH=0/1): on the host backend "device" dispatch is
+in-process and the device path's own thresholds already apply.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+PROBE_EVERY = 16  # serve the winner; re-probe the loser every Nth call
+MAX_KEYS = 512  # LRU bound on tracked query shapes
+
+
+def plan_shape_key(plan) -> tuple:
+    """(table, normalized-select) with literal VALUES masked out.
+
+    Rolling-window dashboards re-issue the same query with fresh time/
+    filter literals every refresh; masking literals makes those one shape,
+    so the router's samples accumulate instead of restarting (and the
+    stats table stays bounded)."""
+    return (plan.table, _shape(plan.select))
+
+
+def _shape(node):
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        if type(node).__name__ == "Literal":
+            return ("?",)  # value masked; shape only
+        return (
+            type(node).__name__,
+            *(
+                (f.name, _shape(getattr(node, f.name)))
+                for f in dataclasses.fields(node)
+            ),
+        )
+    if isinstance(node, (tuple, list)):
+        return tuple(_shape(x) for x in node)
+    return node
+
+
+class PathRouter:
+    def __init__(self) -> None:
+        # key -> {"device": s, "host": s, "device_n": int, "calls": int}
+        self._stats: dict = {}
+        self._lock = threading.Lock()
+
+    def _touch(self, key) -> dict:
+        """stats entry for key, LRU-bumped; evicts the oldest past MAX_KEYS
+        (dicts preserve insertion order — re-inserting moves to the back)."""
+        st = self._stats.pop(key, None)
+        if st is None:
+            st = {"calls": 0}
+            if len(self._stats) >= MAX_KEYS:
+                self._stats.pop(next(iter(self._stats)))
+        self._stats[key] = st
+        return st
+
+    def choose(self, key) -> str:
+        """"device" or "host".
+
+        Collects TWO device samples before judging: the first device
+        execution of a query shape pays jit trace+compile, and the second
+        typically absorbs the scan cache's deferred build (scan_cache
+        builds on the second sighting of a stable base state) — neither
+        reflects steady-state serving. Then one host sample, then the
+        measured winner with periodic probes of the loser.
+        """
+        with self._lock:
+            st = self._touch(key)
+            if st.get("device_n", 0) < 2:
+                return "device"
+            if "host" not in st:
+                return "host"
+            st["calls"] += 1
+            winner = "device" if st["device"] <= st["host"] else "host"
+            if st["calls"] % PROBE_EVERY == 0:
+                return "host" if winner == "device" else "device"
+            return winner
+
+    def record(self, key, kind: str, seconds: float) -> None:
+        """Fold a sample in: adapt DOWN instantly (a faster time is proof
+        the path can go that fast), creep UP by 10% per sample (one GC
+        pause or tunnel hiccup must not flip the route)."""
+        with self._lock:
+            st = self._touch(key)
+            prev = st.get(kind)
+            if kind == "device":
+                n = st.get("device_n", 0) + 1
+                st["device_n"] = n
+                if n == 2:
+                    prev = None  # drop the compile-tainted first sample
+            st[kind] = seconds if prev is None else min(seconds, prev * 1.1)
+
+    def stats(self, key) -> dict:
+        with self._lock:
+            return dict(self._stats.get(key, {}))
+
+
+def adaptive_enabled() -> bool:
+    v = os.environ.get("HORAEDB_ADAPTIVE_PATH", "auto")
+    if v in ("0", "off", "false"):
+        return False
+    if v in ("1", "on", "true"):
+        return True
+    import jax
+
+    return jax.default_backend() != "cpu"

@@ -685,3 +685,101 @@ class TestCorrelatedSubquery:
             "WHERE w IN (SELECT w2 FROM oth2)) ORDER BY host, v"
         ).to_pylist()
         assert [r["host"] for r in out] == ["a", "a", "b", "b"]
+
+
+class TestAdaptivePathRouting:
+    def test_router_converges_to_faster_path(self):
+        from horaedb_tpu.query.path_router import PathRouter, PROBE_EVERY
+
+        r = PathRouter()
+        key = ("t", "shape")
+        # collects two device samples (compile + steady) then one host
+        assert r.choose(key) == "device"
+        r.record(key, "device", 2.3)  # jit-compile-tainted
+        assert r.choose(key) == "device"
+        r.record(key, "device", 0.080)  # steady: replaces the first
+        assert r.choose(key) == "host"
+        r.record(key, "host", 0.002)
+        picks = [r.choose(key) for _ in range(PROBE_EVERY * 2)]
+        assert picks.count("host") >= PROBE_EVERY * 2 - 3
+        assert "device" in picks  # loser is still re-probed
+        assert r.stats(key)["device"] == 0.080  # compile sample dropped
+
+    def test_router_adapts_when_loser_improves(self):
+        from horaedb_tpu.query.path_router import PathRouter
+
+        r = PathRouter()
+        key = ("t", "s")
+        r.record(key, "device", 0.100)
+        r.record(key, "device", 0.100)
+        r.record(key, "host", 0.010)
+        assert r.choose(key) == "host"
+        # device improves drastically (e.g. scan cache finished building)
+        r.record(key, "device", 0.001)
+        assert r.choose(key) == "device"
+
+    def test_router_resists_one_off_hiccups(self):
+        from horaedb_tpu.query.path_router import PathRouter
+
+        r = PathRouter()
+        key = ("t", "s")
+        r.record(key, "device", 0.010)
+        r.record(key, "device", 0.010)
+        r.record(key, "host", 0.050)
+        assert r.choose(key) == "device"
+        r.record(key, "device", 1.0)  # single GC pause / tunnel hiccup
+        assert r.choose(key) == "device"  # 10% creep, not a flip
+
+    def test_adaptive_routing_serves_host_when_device_slow(self, db, monkeypatch):
+        """End-to-end: with adaptive routing forced on and a slow device
+        path, repeated queries settle on the host path."""
+        monkeypatch.setenv("HORAEDB_ADAPTIVE_PATH", "1")
+        ex = db.interpreters.executor
+        ex._adaptive = None  # re-resolve from env
+
+        import time as _t
+        orig = ex._try_cached_agg
+
+        def slow_cached(plan, table, m):
+            _t.sleep(0.05)
+            return orig(plan, table, m)
+
+        ex._try_cached_agg = slow_cached
+        sql = "SELECT host, avg(v) AS a FROM q GROUP BY host"
+        paths = []
+        for _ in range(6):
+            out = db.execute(sql)
+            paths.append(out.metrics["path"])
+        assert paths[-1] == "host"
+        # results stay identical across paths
+        assert sorted(db.execute(sql).to_pylist(), key=str) == sorted(
+            out.to_pylist(), key=str
+        )
+        ex._try_cached_agg = orig
+
+    def test_shape_key_masks_literals(self):
+        """Rolling-window refreshes (same query, fresh literals) must share
+        one routing key; different shapes must not."""
+        import horaedb_tpu
+        from horaedb_tpu.query.path_router import plan_shape_key
+
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE sk (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        plan = lambda sql: conn.frontend.statement_to_plan(conn.frontend.parse_sql(sql))
+        k1 = plan_shape_key(plan("SELECT host, avg(v) AS a FROM sk WHERE ts > 1000 GROUP BY host"))
+        k2 = plan_shape_key(plan("SELECT host, avg(v) AS a FROM sk WHERE ts > 99999 GROUP BY host"))
+        k3 = plan_shape_key(plan("SELECT host, max(v) AS a FROM sk WHERE ts > 1000 GROUP BY host"))
+        assert k1 == k2
+        assert k1 != k3
+        conn.close()
+
+    def test_router_lru_bound(self):
+        from horaedb_tpu.query.path_router import MAX_KEYS, PathRouter
+
+        r = PathRouter()
+        for i in range(MAX_KEYS + 50):
+            r.record(("t", i), "host", 0.01)
+        assert len(r._stats) == MAX_KEYS
