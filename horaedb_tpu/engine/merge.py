@@ -55,6 +55,14 @@ def device_merge_min_rows() -> int:
     return DEFAULT_DEVICE_MERGE_MIN_ROWS
 
 
+# Per-row merge rates (seconds/row) fold into the same adaptive router the
+# query paths use; one global key — merge cost scales ~linearly with rows.
+from ..query.path_router import PathRouter as _PathRouter
+
+_MERGE_ROUTER = _PathRouter()
+_MERGE_KEY = ("__merge_dedup__",)
+
+
 def dedup_keep_mask(rows: RowGroup) -> np.ndarray:
     """Mask keeping the FIRST row of each primary-key run.
 
@@ -281,14 +289,41 @@ def merge_read(
     # Device merge-dedup above a size threshold: the same lax.sort +
     # shift-compare kernel compaction uses (ref: the read path IS the
     # merge iterator in the reference, row_iter/merge.rs:134-181 — here
-    # it's one device sort instead of a BinaryHeap).
+    # it's one device sort instead of a BinaryHeap). Above the threshold
+    # an adaptive per-row-rate router picks device vs host: merge inputs
+    # are NOT device-resident, so on a low-bandwidth (tunneled) backend
+    # the upload dominates and the host lexsort wins — measured, not
+    # assumed (same policy as query path routing).
     tsid_idx = out_schema.tsid_index
-    if tsid_idx is not None and len(rows) >= device_merge_min_rows():
+    n = len(rows)
+    route = None
+    if tsid_idx is not None and n >= device_merge_min_rows():
+        from ..ops.merge_dedup import merge_dedup_ready
+        from ..query.path_router import adaptive_enabled
+
+        if not adaptive_enabled():
+            # kill switch pins static behavior: device above the threshold
+            route = "device" if merge_dedup_ready(n) else None
+        else:
+            route = _MERGE_ROUTER.choose(_MERGE_KEY)
+            if route == "device" and not merge_dedup_ready(n):
+                # kernel still compiling in the background (minutes on a
+                # remote backend) — host path for now, sample unrecorded
+                route = None
+
+    import time as _time
+
+    t0 = _time.perf_counter()
+    if route == "device":
         from ..ops import merge_dedup_permutation
 
         tsid = rows.columns[out_schema.columns[tsid_idx].name]
         perm, keep = merge_dedup_permutation(
             tsid, rows.timestamps.astype(np.int64), version, dedup=True
         )
-        return rows.take(perm[keep])
-    return dedup_sorted(rows.sorted_by_key(seq=version))
+        out = rows.take(perm[keep])
+    else:
+        out = dedup_sorted(rows.sorted_by_key(seq=version))
+    if route is not None and adaptive_enabled():
+        _MERGE_ROUTER.record(_MERGE_KEY, route, (_time.perf_counter() - t0) / n)
+    return out

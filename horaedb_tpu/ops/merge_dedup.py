@@ -19,12 +19,71 @@ prefix of the output is exactly the merged result.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .encoding import pad_to_bucket, shape_bucket, split_i64_sortable, split_u64
+
+# Kernel-shape keys ((bucket, dedup) — both are jit cache keys) whose sort
+# kernel has finished compiling, and those with a compile in flight. The
+# 8-operand u32 sort can take MINUTES to compile on a remote/tunneled
+# backend — a foreground read must never eat that stall, so callers check
+# merge_dedup_ready() and fall back to the host merge until the background
+# compile lands. Failed compiles back off _FAIL_RETRY_S before retrying.
+_ready: set[tuple[int, bool]] = set()
+_compiling: set[tuple[int, bool]] = set()
+_failed_at: dict[tuple[int, bool], float] = {}
+_compile_lock = threading.Lock()
+_FAIL_RETRY_S = 60.0
+
+
+def _compile_bucket(key: tuple[int, bool]) -> None:
+    bucket, dedup = key
+    try:
+        zeros = jnp.zeros(bucket, dtype=jnp.uint32)
+        jax.block_until_ready(
+            _merge_dedup_kernel(*([zeros] * 7), dedup=dedup)
+        )
+        with _compile_lock:
+            _ready.add(key)
+            _failed_at.pop(key, None)
+    except Exception:
+        import logging
+        import time
+
+        logging.getLogger(__name__).exception(
+            "background merge-kernel compile failed (bucket=%d dedup=%s); "
+            "retrying after %.0fs", bucket, dedup, _FAIL_RETRY_S,
+        )
+        with _compile_lock:
+            _failed_at[key] = time.time()
+    finally:
+        with _compile_lock:
+            _compiling.discard(key)
+
+
+def merge_dedup_ready(n: int, dedup: bool = True) -> bool:
+    """True when the kernel for ``n``-row merges is compiled; otherwise
+    kicks off (at most one) background compile for that kernel shape and
+    returns False so the caller can take the host path without stalling."""
+    import time
+
+    key = (shape_bucket(n), dedup)
+    with _compile_lock:
+        if key in _ready:
+            return True
+        failed = _failed_at.get(key)
+        if failed is not None and time.time() - failed < _FAIL_RETRY_S:
+            return False
+        if key not in _compiling:
+            _compiling.add(key)
+            threading.Thread(
+                target=_compile_bucket, args=(key,), daemon=True
+            ).start()
+        return False
 
 
 @functools.partial(jax.jit, static_argnames=("dedup",))
@@ -97,4 +156,6 @@ def merge_dedup_permutation(
     ]
     out = _merge_dedup_kernel(*(jnp.asarray(a) for a in args), dedup=dedup)
     perm, keep = jax.device_get(out)  # one RTT for both outputs
+    with _compile_lock:
+        _ready.add((shape_bucket(n), dedup))  # direct callers warm it too
     return perm[:n], keep[:n]

@@ -265,3 +265,45 @@ class TestMergeDedup:
         perm, keep = merge_dedup_permutation(tsid, ts, seq)
         assert keep.sum() == 3
         assert tsid[perm].tolist() == [0, 2**63, 2**64 - 1]
+
+
+class TestMergeDedupReady:
+    def test_background_compile_gate(self):
+        """merge_dedup_ready returns False while compiling, True after;
+        only one compile thread per shape bucket."""
+        import time
+
+        from horaedb_tpu.ops import merge_dedup as md
+
+        n = 1024
+        bucket = __import__("horaedb_tpu.ops.encoding", fromlist=["shape_bucket"]).shape_bucket(n)
+        with md._compile_lock:
+            md._ready.discard((bucket, True))
+        ready = md.merge_dedup_ready(n)
+        # either already-compiled jit cache made it instant on a second
+        # call, or the background thread lands shortly (CPU compile is ms)
+        deadline = time.time() + 30
+        while not ready and time.time() < deadline:
+            time.sleep(0.01)
+            ready = md.merge_dedup_ready(n)
+        assert ready
+
+    def test_direct_call_marks_ready(self):
+        import numpy as np
+
+        from horaedb_tpu.ops import merge_dedup as md
+        from horaedb_tpu.ops.encoding import shape_bucket
+
+        n = 2048
+        with md._compile_lock:
+            md._ready.discard((shape_bucket(n), True))
+        tsid = np.arange(n, dtype=np.uint64)
+        ts = np.zeros(n, dtype=np.int64)
+        seq = np.arange(n, dtype=np.uint64)
+        md.merge_dedup_permutation(tsid, ts, seq)
+        assert md.merge_dedup_ready(n)
+        # dedup=False is a different kernel: not marked ready by the above
+        with md._compile_lock:
+            md._ready.discard((shape_bucket(n), False))
+            ready_false = (shape_bucket(n), False) in md._ready
+        assert not ready_false
