@@ -59,9 +59,12 @@ def connect(
 ) -> Connection:
     """Open (or create) a database. ``path=None`` -> in-memory, no WAL.
 
-    ``wal_backend``: "disk" (framed local log) or "object_store" (paged
-    log in the same store as the SSTs — a diskless node recovers from
-    shared storage alone)."""
+    ``wal_backend``: "disk" (framed local log per table), "object_store"
+    (paged log in the same store as the SSTs — a diskless node recovers
+    from shared storage alone), or "shared_log" (region-based shared log:
+    one segmented log multiplexes every table of a region/shard and shard
+    recovery scans it once — the reference's message-queue WAL layout
+    with RegionBased replay)."""
     if path is None:
         return Connection(MemoryStore(), config=engine_config)
     store = LocalDiskStore(path)
@@ -71,10 +74,15 @@ def connect(
         from .engine.wal import ObjectStoreWal
 
         wal_mgr = ObjectStoreWal(store)
+    elif wal_backend == "shared_log":
+        from .engine.wal import SharedLogWal
+
+        wal_mgr = SharedLogWal(f"{path}/wal")
     elif wal_backend == "disk":
         wal_mgr = LocalDiskWal(f"{path}/wal")
     else:
         raise ValueError(
-            f"unknown wal_backend {wal_backend!r} (use 'disk' or 'object_store')"
+            f"unknown wal_backend {wal_backend!r} "
+            "(use 'disk', 'object_store' or 'shared_log')"
         )
     return Connection(store, wal=wal_mgr, config=engine_config)

@@ -54,7 +54,7 @@ class ServerConfig:
 class EngineSection:
     data_dir: Optional[str] = None  # None = in-memory
     wal: bool = True
-    wal_backend: str = "disk"  # "disk" | "object_store"
+    wal_backend: str = "disk"  # "disk" | "object_store" | "shared_log"
     space_write_buffer_size: int = 256 << 20
     compaction_l0_trigger: int = 4
 
@@ -163,9 +163,9 @@ def _apply(cfg: Config, raw: dict) -> None:
             raise ConfigError("engine.wal must be a boolean")
         cfg.engine.wal = e["wal"]
     if "wal_backend" in e:
-        if e["wal_backend"] not in ("disk", "object_store"):
+        if e["wal_backend"] not in ("disk", "object_store", "shared_log"):
             raise ConfigError(
-                "engine.wal_backend must be 'disk' or 'object_store'"
+                "engine.wal_backend must be 'disk', 'object_store' or 'shared_log'"
             )
         cfg.engine.wal_backend = str(e["wal_backend"])
     if "space_write_buffer_size" in e:
