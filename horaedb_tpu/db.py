@@ -32,6 +32,16 @@ class Connection:
         self.catalog = Catalog(store, self.instance)
         self.frontend = Frontend(self.catalog.schema_of)
         self.interpreters = InterpreterFactory(self.catalog)
+        # Remote partial-agg span ring (ref: RemoteTaskContext.remote_metrics)
+        # — the gRPC service appends, /debug/remote_spans reads; spans carry
+        # the ORIGIN coordinator's request id for cross-node correlation.
+        import threading
+        from collections import deque
+
+        self.remote_spans: deque = deque(maxlen=128)
+        # gRPC workers append while the HTTP debug endpoint snapshots;
+        # deque iteration during a concurrent append raises — lock both.
+        self.remote_spans_lock = threading.Lock()
 
     def execute(self, sql: str) -> Output:
         plan = self.frontend.sql_to_plan(sql)

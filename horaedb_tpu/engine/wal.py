@@ -77,10 +77,11 @@ def _encode_record(seq: int, rows: RowGroup, table_id: Optional[int] = None) -> 
     return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
 
 
-def _iter_frames(raw: bytes, path: str) -> Iterator[tuple[dict, pa.RecordBatch]]:
-    """Decode framed records; stops cleanly at a torn tail (a partial
-    final write is a crash artifact, not corruption), raises on mid-log
-    CRC damage."""
+def _iter_frame_meta(raw: bytes, path: str) -> Iterator[tuple[dict, int]]:
+    """THE frame walk — one copy of the framing invariants. Yields each
+    record's msgpack dict (Arrow payload NOT decoded) and its end offset;
+    stops cleanly at a torn tail (a partial final write is a crash
+    artifact, not corruption), raises on mid-log CRC damage."""
     off = 0
     n = len(raw)
     while off < n:
@@ -94,11 +95,14 @@ def _iter_frames(raw: bytes, path: str) -> Iterator[tuple[dict, pa.RecordBatch]]
         payload = raw[start:end]
         if zlib.crc32(payload) != crc:
             raise WalCorruption(f"{path}: CRC mismatch at offset {off}")
-        rec = msgpack.unpackb(payload, raw=False)
-        with pa.ipc.open_stream(pa.BufferReader(rec["ipc"])) as r:
-            batch = r.read_all().combine_chunks()
-        yield rec, batch
+        yield msgpack.unpackb(payload, raw=False), end
         off = end
+
+
+def _iter_frames(raw: bytes, path: str) -> Iterator[tuple[dict, pa.RecordBatch]]:
+    for rec, _ in _iter_frame_meta(raw, path):
+        with pa.ipc.open_stream(pa.BufferReader(rec["ipc"])) as r:
+            yield rec, r.read_all().combine_chunks()
 
 
 def _decode_records(raw: bytes, path: str) -> Iterator[tuple[int, pa.RecordBatch]]:
@@ -443,19 +447,10 @@ def _decode_region_records(
 
 def _valid_prefix_len(raw: bytes, path: str) -> int:
     """Byte length of the valid frame prefix (where a torn tail starts)."""
-    off = 0
-    n = len(raw)
-    while off < n:
-        if off + _FRAME.size > n:
-            return off
-        length, crc = _FRAME.unpack_from(raw, off)
-        end = off + _FRAME.size + length
-        if end > n:
-            return off
-        if zlib.crc32(raw[off + _FRAME.size : end]) != crc:
-            raise WalCorruption(f"{path}: CRC mismatch at offset {off}")
-        off = end
-    return off
+    end = 0
+    for _, end in _iter_frame_meta(raw, path):
+        pass
+    return end
 
 
 class _SharedRegion:
@@ -561,8 +556,12 @@ class _SharedRegion:
             try:
                 with open(seg_path, "rb") as f:
                     raw = f.read()
-                for tid, seq, _ in _decode_region_records(raw, seg_path):
-                    idx[tid] = max(idx.get(tid, -1), seq)
+                # meta-only walk: {tid: max_seq} without Arrow-decoding
+                # every batch (a reopen's first truncation check would
+                # otherwise re-decode the whole region log)
+                for rec, _ in _iter_frame_meta(raw, seg_path):
+                    tid = rec["tid"]
+                    idx[tid] = max(idx.get(tid, -1), rec["seq"])
             except FileNotFoundError:
                 pass
             self._seg_index[seg_path] = idx

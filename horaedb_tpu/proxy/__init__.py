@@ -113,6 +113,14 @@ class Proxy:
     def handle_sql(self, sql: str) -> Output:
         ctx = RequestContext(next(self._req_ids), sql)
         self._m_queries.inc()
+        # The request id travels by context: priority-pool threads run the
+        # executor inside a COPY of this context, and remote partial-agg
+        # calls ship the id in their wire spec (utils/tracectx.py).
+        import contextvars
+
+        from ..utils.tracectx import reset_request_id, set_request_id
+
+        token = set_request_id(ctx.request_id)
         try:
             plan = self.conn.frontend.sql_to_plan(sql)
             table = getattr(plan, "table", None)
@@ -120,9 +128,10 @@ class Proxy:
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))
             if isinstance(plan, QueryPlan):
+                cctx = contextvars.copy_context()
                 out = self.runtime.run(
                     plan.priority.value,
-                    lambda: self.conn.interpreters.execute(plan),
+                    lambda: cctx.run(self.conn.interpreters.execute, plan),
                 )
                 self.recent_queries.append(
                     {
@@ -138,6 +147,7 @@ class Proxy:
             self._m_errors.inc()
             raise
         finally:
+            reset_request_id(token)
             elapsed = time.perf_counter() - ctx.start
             self._m_latency.observe(elapsed)
             if elapsed >= self.slow_threshold_s:

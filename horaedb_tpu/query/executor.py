@@ -460,6 +460,12 @@ class Executor:
         spec = spec_from_plan(self, plan)
         if spec is None:
             return None  # shape not pushable: gather-rows fallback below
+        from ..utils.tracectx import get_request_id
+
+        rid = get_request_id()
+        if rid is not None:
+            spec["trace"] = {"request_id": rid}
+            m["request_id"] = rid
         names, arrays, stage_metrics = table.partial_agg(spec)
         combined, n_groups = combine_partials([(names, arrays)], spec)
         keep = table.rule.prune(plan.predicate)

@@ -70,12 +70,19 @@ def spec_from_plan(executor, plan: QueryPlan) -> Optional[dict]:
     }
 
 
-def compute_partial(table, spec: dict) -> tuple[list[str], list[np.ndarray]]:
+def compute_partial(
+    table, spec: dict, m: Optional[dict] = None
+) -> tuple[list[str], list[np.ndarray]]:
     """Run the pushed-down partial aggregate against one table/partition.
 
     Runs wherever the data lives: the executor calls it for local
-    partitions, the remote-engine service for shipped ones.
+    partitions, the remote-engine service for shipped ones. ``m`` (when
+    given) collects sub-stage spans — scan time, rows scanned, kernel vs
+    host path — that ride home to the coordinator's EXPLAIN ANALYZE tree
+    (ref: RemoteTaskContext.remote_metrics).
     """
+    import time as _time
+
     pred = predicate_from_dict(spec["predicate"])
     group_tags = list(spec["group_tags"])
     agg_cols = list(spec["agg_cols"])
@@ -90,8 +97,12 @@ def compute_partial(table, spec: dict) -> tuple[list[str], list[np.ndarray]]:
             + group_tags + agg_cols + filter_cols + exact_cols
         )
     )
+    t_scan = _time.perf_counter()
     rows = table.read(pred, projection=projection)
     n = len(rows)
+    if m is not None:
+        m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
+        m["rows_scanned"] = n
 
     mask = np.ones(n, dtype=bool)
     for c, op, v in spec["exact_filters"]:
@@ -115,10 +126,14 @@ def compute_partial(table, spec: dict) -> tuple[list[str], list[np.ndarray]]:
     else:
         t0 = 0
 
+    t_agg = _time.perf_counter()
     if all_valid:
         out = _partial_kernel(rows, mask, spec, t0)
     else:
         out = _partial_host(rows, mask, spec, t0)
+    if m is not None:
+        m["path"] = "kernel" if all_valid else "host"
+        m["agg_ms"] = round((_time.perf_counter() - t_agg) * 1000, 3)
     return out
 
 

@@ -150,15 +150,31 @@ class GrpcServer:
 
         t0 = time.perf_counter()
         t = self._open(req["table"])
-        names, arrays = compute_partial(t, req["spec"])
+        sub: dict = {}
+        names, arrays = compute_partial(t, req["spec"], sub)
+        metrics = {
+            **sub,
+            "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
+            "groups": int(len(arrays[0])) if arrays else 0,
+        }
+        # Span ring keyed by the COORDINATOR'S request id (shipped in the
+        # spec's trace): /debug/remote_spans on this node correlates with
+        # the origin's slow-log/EXPLAIN ANALYZE by that id.
+        trace = (req["spec"] or {}).get("trace") or {}
+        with self.conn.remote_spans_lock:
+            self.conn.remote_spans.append(
+                {
+                    "request_id": trace.get("request_id"),
+                    "table": req["table"],
+                    "at": time.time(),
+                    **metrics,
+                }
+            )
         return {
             "ipc": columns_to_ipc(names, arrays),
             # stage metrics ride home for EXPLAIN ANALYZE (ref: the
             # reference's RemoteTaskContext.remote_metrics)
-            "metrics": {
-                "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
-                "groups": int(len(arrays[0])) if arrays else 0,
-            },
+            "metrics": metrics,
         }
 
     def _drop_sub(self, req: dict) -> dict:

@@ -789,6 +789,14 @@ def create_app(
             text=_dumps(list(proxy.slow_queries)), content_type="application/json"
         )
 
+    async def debug_remote_spans(request: web.Request) -> web.Response:
+        """Remote partial-agg spans served BY this node, keyed by the
+        origin coordinator's request id (ref: RemoteTaskContext
+        .remote_metrics carrying EXPLAIN ANALYZE data across nodes)."""
+        with conn.remote_spans_lock:
+            spans = list(conn.remote_spans)
+        return web.json_response({"spans": spans})
+
     async def admin_flush(request: web.Request) -> web.Response:
         """Force a flush (all tables, or ?table=name)."""
         name = request.query.get("table")
@@ -1003,6 +1011,7 @@ def create_app(
     app.router.add_get("/debug/slow_log", debug_slow_log)
     app.router.add_get("/debug/shards", debug_shards)
     app.router.add_get("/debug/wal_stats", debug_wal_stats)
+    app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)

@@ -12,7 +12,9 @@ Prepared statements are served too: COM_STMT_PREPARE counts ``?``
 placeholders (string-literal-aware), COM_STMT_EXECUTE decodes binary
 parameters (ints, floats, strings, NULL bitmap, temporal types),
 substitutes them as SQL literals, and answers with a binary-protocol
-result set (every column VAR_STRING, like the text path). COM_STMT_CLOSE
+result set with REAL column types (LONGLONG/DOUBLE encoded binary,
+strings lenenc; the text path carries the same typed column defs).
+COM_STMT_CLOSE
 and COM_STMT_RESET round out the lifecycle Connector/J-style clients use.
 """
 
@@ -37,7 +39,32 @@ _SERVER_CAPS = (
     _CLIENT_LONG_PASSWORD | _CLIENT_PROTOCOL_41 | _CLIENT_SECURE_CONNECTION | _CLIENT_PLUGIN_AUTH
 )
 _CHARSET_UTF8 = 33
+_CHARSET_BINARY = 63  # numeric columns use the binary charset
+_TYPE_DOUBLE = 0x05
+_TYPE_LONGLONG = 0x08
 _TYPE_VAR_STRING = 0xFD
+_FLAG_BINARY = 0x80
+_FLAG_NOT_NULL = 0x01
+
+
+def _infer_col_types(rows: list[list], ncols: int) -> list[int]:
+    """MySQL column type per output column, from the Python values (the
+    gateway's rows carry real types: float -> DOUBLE, int/bool ->
+    LONGLONG, everything else -> VAR_STRING; all-NULL -> VAR_STRING)."""
+    types = []
+    for i in range(ncols):
+        t = _TYPE_VAR_STRING
+        for row in rows:
+            v = row[i]
+            if v is None:
+                continue
+            if isinstance(v, bool) or isinstance(v, int):
+                t = _TYPE_LONGLONG
+            elif isinstance(v, float):
+                t = _TYPE_DOUBLE
+            break
+        types.append(t)
+    return types
 
 
 def _lenenc_int(n: int) -> bytes:
@@ -231,9 +258,10 @@ class _Conn:
             # desync the session; an empty result IS an OK
             self._ok()
             return
+        types = _infer_col_types(rows, len(names))
         self._send(_lenenc_int(len(names)))
-        for name in names:
-            self._send(self._col_def(name))
+        for name, t in zip(names, types):
+            self._send(self._col_def(name, t))
         self._eof()
         for row in rows:
             out = bytearray()
@@ -310,14 +338,21 @@ class _Conn:
 
     # ---- prepared statements (binary protocol) ---------------------------
 
-    def _col_def(self, name: str) -> bytes:
+    def _col_def(self, name: str, col_type: int = _TYPE_VAR_STRING) -> bytes:
         nb = name.encode()
+        if col_type == _TYPE_VAR_STRING:
+            charset, length, flags, decimals = _CHARSET_UTF8, 1024, 0, 0
+        else:
+            # numeric columns: binary charset, real lengths, 0x1F decimals
+            # marks a floating DOUBLE (connectors use it for formatting)
+            charset, length, flags = _CHARSET_BINARY, 22, _FLAG_BINARY
+            decimals = 0x1F if col_type == _TYPE_DOUBLE else 0
         return (
             _lenenc_str(b"def") + _lenenc_str(b"") + _lenenc_str(b"")
             + _lenenc_str(b"") + _lenenc_str(nb) + _lenenc_str(nb)
-            + b"\x0c" + _CHARSET_UTF8.to_bytes(2, "little")
-            + (1024).to_bytes(4, "little") + bytes([_TYPE_VAR_STRING])
-            + (0).to_bytes(2, "little") + b"\x00" + b"\x00\x00"
+            + b"\x0c" + charset.to_bytes(2, "little")
+            + length.to_bytes(4, "little") + bytes([col_type])
+            + flags.to_bytes(2, "little") + bytes([decimals]) + b"\x00\x00"
         )
 
     def _stmt_prepare(self, sql: str) -> None:
@@ -387,9 +422,10 @@ class _Conn:
         if not names:
             self._ok()
             return
+        types = _infer_col_types(rows, len(names))
         self._send(_lenenc_int(len(names)))
-        for name in names:
-            self._send(self._col_def(name))
+        for name, t in zip(names, types):
+            self._send(self._col_def(name, t))
         self._eof()
         nbm = (len(names) + 9) // 8  # binary-row NULL bitmap, offset 2
         for row in rows:
@@ -397,6 +433,10 @@ class _Conn:
             for i, v in enumerate(row):
                 if v is None:
                     out[1 + (i + 2) // 8] |= 1 << ((i + 2) % 8)
+                elif types[i] == _TYPE_LONGLONG:
+                    out += int(v).to_bytes(8, "little", signed=True)
+                elif types[i] == _TYPE_DOUBLE:
+                    out += struct.pack("<d", float(v))
                 else:
                     out += _lenenc_str(_render(v).encode("utf-8", "replace"))
             self._send(bytes(out))

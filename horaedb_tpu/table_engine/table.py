@@ -80,10 +80,13 @@ class Table(ABC):
         from ..query.partial import compute_partial
 
         t0 = time.perf_counter()
-        names, arrays = compute_partial(self, spec)
+        sub: dict = {}
+        names, arrays = compute_partial(self, spec, sub)
         return names, arrays, [{
             "partition": self.name,
             "remote": False,
+            **sub,  # scan_ms / rows_scanned / path / agg_ms — same span
+            # shape as remote partitions, so stage trees stay uniform
             "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
             "groups": int(len(arrays[0])) if arrays else 0,
         }]

@@ -1,0 +1,32 @@
+"""Request trace context — the coordinator's request id follows the query
+across threads and nodes (ref: trace_metric's MetricsCollector spans +
+RemoteTaskContext.remote_metrics carrying EXPLAIN ANALYZE data home;
+RequestId in common_types).
+
+A ContextVar holds the current request id; the proxy sets it per SQL
+statement and runs the executor inside a copied context so priority-pool
+threads observe it. Remote partial-agg calls ship it in the wire spec, and
+the owning node tags its span ring with it — so one request id correlates
+the coordinator's slow-log entry with every remote span it fanned out.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Optional
+
+_request_id: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "horaedb_request_id", default=None
+)
+
+
+def set_request_id(rid: Optional[int]) -> contextvars.Token:
+    return _request_id.set(rid)
+
+
+def get_request_id() -> Optional[int]:
+    return _request_id.get()
+
+
+def reset_request_id(token: contextvars.Token) -> None:
+    _request_id.reset(token)

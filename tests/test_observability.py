@@ -249,3 +249,49 @@ class TestIntrospectionEndpoints:
                 conn.close()
 
         asyncio.run(body())
+
+
+class TestRemoteSpans:
+    def test_debug_remote_spans_endpoint(self):
+        """A remote partial-agg leaves a span (keyed by the origin's
+        request id) readable at /debug/remote_spans."""
+        from horaedb_tpu.remote.client import RemoteEngineClient
+        from horaedb_tpu.remote.service import GrpcServer
+
+        async def runner():
+            conn = horaedb_tpu.connect(None)
+            conn.execute(
+                "CREATE TABLE rs (h string TAG, v double, ts timestamp KEY) "
+                "ENGINE=Analytic"
+            )
+            conn.execute("INSERT INTO rs (h, v, ts) VALUES ('a', 1.0, 1)")
+            g = GrpcServer(conn, port=0)
+            g.start()
+            spec = {
+                "predicate": {"time_range": [0, 10**15], "filters": []},
+                "exact_filters": [], "device_filters": [],
+                "group_tags": ["h"], "bucket_ms": 0, "agg_cols": ["v"],
+                "trace": {"request_id": 99},
+            }
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None,
+                lambda: RemoteEngineClient(
+                    f"127.0.0.1:{g.bound_port}"
+                ).partial_agg("rs", spec),
+            )
+            client = TestClient(TestServer(create_app(conn)))
+            await client.start_server()
+            try:
+                spans = (await (await client.get("/debug/remote_spans")).json())[
+                    "spans"
+                ]
+                assert any(s.get("request_id") == 99 for s in spans)
+                span = [s for s in spans if s.get("request_id") == 99][-1]
+                assert span["table"] == "rs" and span["path"] in ("kernel", "host")
+            finally:
+                await client.close()
+                g.stop()
+                conn.close()
+
+        asyncio.run(runner())

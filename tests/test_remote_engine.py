@@ -111,6 +111,37 @@ class TestGrpcRoundTrip:
         assert d["__sum_0"][0] == sum(range(4, 10))
         assert d["__min_0"][0] == 4.0 and d["__max_0"][0] == 9.0
 
+    def test_trace_id_and_substage_metrics_propagate(self, grpc_env):
+        """The coordinator's request id rides the wire spec; the owner
+        records a correlatable span and returns sub-stage metrics
+        (ref: RemoteTaskContext.remote_metrics)."""
+        conn, ep = grpc_env
+        client = RemoteEngineClient(ep)
+        from horaedb_tpu.common_types import RowGroup
+
+        t = conn.catalog.open("rt")
+        t.write(RowGroup.from_rows(
+            t.schema,
+            [{"host": "a", "v": float(i), "ts": 5000 + i} for i in range(4)],
+        ))
+        spec = {
+            "predicate": {"time_range": [0, 10**15], "filters": []},
+            "exact_filters": [],
+            "device_filters": [],
+            "group_tags": ["host"],
+            "bucket_ms": 0,
+            "agg_cols": ["v"],
+            "trace": {"request_id": 4242},
+        }
+        _, _, metrics = client.partial_agg("rt", spec)
+        # sub-stage spans came home
+        assert metrics["path"] in ("kernel", "host")
+        assert "scan_ms" in metrics and "agg_ms" in metrics
+        assert metrics["rows_scanned"] >= 4
+        # the owner's span ring carries the origin's request id
+        spans = [sp for sp in conn.remote_spans if sp.get("request_id") == 4242]
+        assert spans and spans[-1]["table"] == "rt"
+
     def test_table_info_and_not_found(self, grpc_env):
         conn, ep = grpc_env
         client = RemoteEngineClient(ep)

@@ -520,6 +520,7 @@ class MyPsClient(MyClient):
             return ("err", first[9:].decode())
         ncols, _ = _lenenc(first, 0)
         names = []
+        types = []
         for _ in range(ncols):
             col = self.read_packet()
             i = 0
@@ -528,6 +529,8 @@ class MyPsClient(MyClient):
                 ln, i = _lenenc(col, i)
                 vals.append(col[i : i + ln]); i += ln
             names.append(vals[4].decode())
+            # fixed tail: 0x0c filler, charset(2), length(4), TYPE(1)
+            types.append(col[i + 1 + 2 + 4])
         assert self.read_packet()[0] == 0xFE
         rows = []
         nbm = (ncols + 9) // 8
@@ -543,8 +546,16 @@ class MyPsClient(MyClient):
                 if bitmap[(c + 2) // 8] & (1 << ((c + 2) % 8)):
                     row.append(None)
                     continue
-                ln, i = _lenenc(pkt, i)
-                row.append(pkt[i : i + ln].decode()); i += ln
+                t = types[c]
+                if t == 0x08:  # LONGLONG, 8-byte LE
+                    row.append(int.from_bytes(pkt[i : i + 8], "little", signed=True))
+                    i += 8
+                elif t == 0x05:  # DOUBLE, 8-byte LE ieee754
+                    row.append(struct.unpack("<d", pkt[i : i + 8])[0])
+                    i += 8
+                else:
+                    ln, i = _lenenc(pkt, i)
+                    row.append(pkt[i : i + ln].decode()); i += ln
             rows.append(row)
         return ("rows", names, rows)
 
@@ -562,10 +573,28 @@ class TestMysqlPreparedStatements:
             assert st[0] == "ok" and st[2] == 2, st
             out = c.execute(st[1], [(0xFD, "a"), (0x05, 99.5)])
             assert out[0] == "rows" and out[1] == ["host", "v"]
-            assert out[2] == [["a", "1.5"]]
+            assert out[2] == [["a", 1.5]]  # v is a typed DOUBLE now
             # re-execute with different params, same statement
             out = c.execute(st[1], [(0xFD, "b"), (0x05, 99.5)])
-            assert out[2] == [["b", "2.5"]]
+            assert out[2] == [["b", 2.5]]
+            s.close()
+
+        self._with_server(db, client)
+
+    def test_typed_binary_columns(self, db):
+        """Column defs declare real types; numeric values travel binary
+        (LONGLONG/DOUBLE), not as strings."""
+        def client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyPsClient(s)
+            c.handshake()
+            st = c.prepare("SELECT host, v, count(*) AS c FROM wt GROUP BY host, v")
+            out = c.execute(st[1], [])
+            assert out[0] == "rows"
+            byhost = {r[0]: r for r in out[2]}
+            assert byhost["a"] == ["a", 1.5, 1]  # str, float, int — typed
+            assert isinstance(byhost["a"][1], float)
+            assert isinstance(byhost["a"][2], int)
             s.close()
 
         self._with_server(db, client)
