@@ -43,6 +43,8 @@ class Procedure:
     state: ProcState = ProcState.INIT
     attempts: int = 0
     error: str = ""
+    created_at: float = 0.0  # wall clock; 0 on records from old leaders
+    updated_at: float = 0.0
 
     def to_dict(self) -> dict:
         return {
@@ -52,6 +54,8 @@ class Procedure:
             "state": self.state.value,
             "attempts": self.attempts,
             "error": self.error,
+            "created_at": self.created_at,
+            "updated_at": self.updated_at,
         }
 
     @staticmethod
@@ -63,6 +67,8 @@ class Procedure:
             state=ProcState(d["state"]),
             attempts=int(d.get("attempts", 0)),
             error=d.get("error", ""),
+            created_at=float(d.get("created_at", 0.0)),
+            updated_at=float(d.get("updated_at", 0.0)),
         )
 
 
@@ -176,12 +182,37 @@ class ProcedureManager:
         with self._lock:
             p.state = state
             p.error = error
+            p.updated_at = time.time()
             self._persist(p)
             if state in (ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED):
                 self._retry_at.pop(p.proc_id, None)
 
     def _persist(self, p: Procedure) -> None:
         self.kv.put(f"{_K_PROC}{p.proc_id}", p.to_dict())
+
+    def summary(self) -> dict:
+        """Queue health at a glance (ref: horaemeta's HTTP admin
+        procedure listing): per-state counts, pending depth, oldest
+        pending age, total retry attempts — the churn signals."""
+        import time as _t
+
+        with self._lock:
+            procs = list(self._procs.values())
+        by_state: dict[str, int] = {}
+        oldest_pending = None
+        attempts = 0
+        for p in procs:
+            by_state[p.state.value] = by_state.get(p.state.value, 0) + 1
+            attempts += p.attempts
+            if p.state in (ProcState.INIT, ProcState.RUNNING) and p.created_at:
+                age = _t.time() - p.created_at
+                oldest_pending = max(oldest_pending or 0.0, age)
+        return {
+            "by_state": by_state,
+            "queue_depth": by_state.get("init", 0) + by_state.get("running", 0),
+            "oldest_pending_age_s": round(oldest_pending, 3) if oldest_pending else 0.0,
+            "total_attempts": attempts,
+        }
 
     def list(self) -> list[Procedure]:
         with self._lock:

@@ -100,20 +100,51 @@ class ReopenScheduler:
 
 class RebalancedScheduler:
     """One move per tick from the most- to the least-loaded node when the
-    skew exceeds one shard."""
+    skew exceeds one shard — with HYSTERESIS so churn can't oscillate
+    (ref: the reference's bounded-loads consistent hashing exists for the
+    same reason — placement stability under small changes):
 
-    def __init__(self, topology: TopologyManager) -> None:
+    - a rejoining node must be online ``min_target_online_s`` before it
+      attracts rebalance moves (a flapping node would otherwise pull a
+      shard on every blip, then lose it to reopen on the next);
+    - a shard moved by REBALANCE sits out ``shard_cooldown_s`` before it
+      may be rebalanced again (failover transfers are never delayed —
+      reopen/static ignore the cooldown).
+    """
+
+    def __init__(
+        self,
+        topology: TopologyManager,
+        min_target_online_s: float = 30.0,
+        shard_cooldown_s: float = 60.0,
+    ) -> None:
         self.topology = topology
+        self.min_target_online_s = min_target_online_s
+        self.shard_cooldown_s = shard_cooldown_s
+        self._last_move: dict[int, float] = {}  # shard_id -> monotonic
+        # Leader failover resets this map — conservative: a new leader
+        # simply waits one cooldown before its first repeat move.
 
     def schedule(self) -> list[Transfer]:
+        now = time.monotonic()
         load = _load(self.topology)
         if len(load) < 2:
             return []
+        stable_since = {
+            n.endpoint: n.online_since for n in self.topology.online_nodes()
+        }
         hot = max(load, key=lambda e: (load[e], e))
-        cold = min(load, key=lambda e: (load[e], e))
+        eligible_cold = [
+            e for e in load
+            if e != hot and now - stable_since.get(e, now) >= self.min_target_online_s
+        ]
+        if not eligible_cold:
+            return []
+        cold = min(eligible_cold, key=lambda e: (load[e], e))
         if load[hot] - load[cold] <= 1:
             return []
         for s in self.topology.shards():
-            if s.node == hot:
+            if s.node == hot and now - self._last_move.get(s.shard_id, -1e18) >= self.shard_cooldown_s:
+                self._last_move[s.shard_id] = now
                 return [Transfer(s.shard_id, cold, f"rebalance: {hot} -> {cold}")]
         return []

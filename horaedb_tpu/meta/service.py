@@ -482,7 +482,10 @@ def create_meta_app(server: MetaServer) -> web.Application:
         ):
             return web.json_response({"procedures": [], "role": "follower"})
         return web.json_response(
-            {"procedures": [p.to_dict() for p in server.procedures.list()]}
+            {
+                "procedures": [p.to_dict() for p in server.procedures.list()],
+                "summary": server.procedures.summary(),
+            }
         )
 
     async def health(request: web.Request) -> web.Response:

@@ -40,6 +40,7 @@ class NodeInfo:
     endpoint: str
     online: bool = True
     last_heartbeat: float = 0.0  # monotonic
+    online_since: float = 0.0  # monotonic; reset on every offline->online
     shard_ids: tuple[int, ...] = ()
 
 
@@ -126,12 +127,15 @@ class TopologyManager:
     def register_node(self, endpoint: str) -> NodeInfo:
         with self._lock:
             node = self._nodes.get(endpoint)
+            now = time.monotonic()
             if node is None:
-                node = NodeInfo(endpoint)
+                node = NodeInfo(endpoint, online_since=now)
                 self._nodes[endpoint] = node
                 self.kv.put(f"{_K_NODE}{endpoint}", {"endpoint": endpoint})
+            if not node.online:
+                node.online_since = now  # rejoin: stability clock restarts
             node.online = True
-            node.last_heartbeat = time.monotonic()
+            node.last_heartbeat = now
             return node
 
     def heartbeat(self, endpoint: str) -> NodeInfo:
