@@ -326,6 +326,125 @@ def selective_cached_scan_agg(
     )
 
 
+# ---- RTT-minimized packed serving path ------------------------------------
+#
+# On a tunneled/remote accelerator every host->device buffer transfer and
+# every device->host fetch is a network round trip. The un-packed cached
+# kernel ships ~7 small buffers per query (group map, allow list, literals,
+# four scalars, optionally a row index) and fetches four result buffers —
+# each a potential RTT. The packed variants collapse that to:
+#
+#   * ONE per-shape "session" upload (group map + allow list, content-hash
+#     cached on the entry so repeated dashboard queries skip it entirely),
+#   * ONE per-query int32 "dyn" upload (filter literals bitcast to int32,
+#     the four time scalars, and — for the selective kernel — the gathered
+#     row index), and
+#   * ONE packed f32 result fetch (counts bitcast into the same buffer as
+#     sums/mins/maxs).
+#
+# Steady state = 1 upload + 1 execute + 1 fetch. The reference never needs
+# this because DataFusion executes in-process; a tunneled TPU makes dispatch
+# cost a first-class design constraint (BASELINE.md north star).
+
+
+def pack_session(group_of_series: np.ndarray, allowed_series: np.ndarray) -> np.ndarray:
+    """[group map | allow list] as one int32 buffer (one upload)."""
+    return np.concatenate(
+        [group_of_series.astype(np.int32), allowed_series.astype(np.int32)]
+    )
+
+
+def pack_dyn(
+    filter_literals: Sequence[float],
+    lo_rel: int,
+    hi_rel: int,
+    t0_rel: int,
+    bucket_ms: int,
+    row_idx: np.ndarray | None = None,
+) -> np.ndarray:
+    """Per-query dynamic inputs as one int32 buffer (one upload).
+
+    f32 literals travel bitcast (the kernel bitcasts them back); the
+    selective kernel's row index rides the same buffer.
+    """
+    lits = np.asarray(filter_literals, dtype=np.float32).view(np.int32)
+    scalars = np.array([lo_rel, hi_rel, t0_rel, bucket_ms], dtype=np.int32)
+    if row_idx is None:
+        return np.concatenate([lits, scalars])
+    return np.concatenate([lits, scalars, row_idx.astype(np.int32, copy=False)])
+
+
+def _packed_body(
+    series_codes,
+    ts_rel,
+    values,
+    session,  # int32[2*(S+1)]: [group map | allow list]
+    dyn,  # int32[n_f + 4 (+ M)]: [literals(bitcast) | lo,hi,t0,width | idx]
+    *,
+    n_groups: int,
+    n_buckets: int,
+    n_agg_fields: int,
+    numeric_filters: tuple[tuple[int, int], ...],
+    need_minmax: bool,
+    selective: bool,
+):
+    s1 = session.shape[0] // 2
+    gos = session[:s1]
+    allow = session[s1:] != 0
+    n_f = len(numeric_filters)
+    literals = jax.lax.bitcast_convert_type(dyn[:n_f], jnp.float32)
+    lo, hi, t0, width = dyn[n_f], dyn[n_f + 1], dyn[n_f + 2], dyn[n_f + 3]
+    if selective:
+        idx = dyn[n_f + 4 :]
+        series_codes = series_codes[idx]
+        ts_rel = ts_rel[idx]
+        values = values[:, idx]
+    counts, sums, mins, maxs = cached_scan_agg_body(
+        series_codes, ts_rel, values, gos, allow, literals, lo, hi, t0, width,
+        n_groups=n_groups,
+        n_buckets=n_buckets,
+        n_agg_fields=n_agg_fields,
+        numeric_filters=numeric_filters,
+        need_minmax=need_minmax,
+    )
+    parts = [
+        jax.lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
+        sums.reshape(-1),
+    ]
+    if need_minmax:
+        parts.extend([mins.reshape(-1), maxs.reshape(-1)])
+    return jnp.concatenate(parts)
+
+
+cached_scan_agg_packed = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
+        "need_minmax", "selective",
+    ),
+)(_packed_body)
+
+
+def unpack_packed_state(packed, spec: "ScanAggSpec") -> "AggState":
+    """ONE blocking device fetch -> writable host AggState.
+
+    counts travel bitcast as f32; the host views the bytes back as int32.
+    Arrays are copies (``_fold_delta`` accumulates in place).
+    """
+    arr = np.asarray(jax.device_get(packed))
+    G, B, F = spec.n_groups, spec.n_buckets, spec.n_agg_fields
+    gb = G * B
+    counts = arr[:gb].view(np.int32).reshape(G, B).copy()
+    sums = arr[gb : gb + F * gb].astype(np.float64).reshape(F, G, B)
+    if spec.need_minmax and F:
+        mins = arr[gb + F * gb : gb + 2 * F * gb].astype(np.float64).reshape(F, G, B)
+        maxs = arr[gb + 2 * F * gb :].astype(np.float64).reshape(F, G, B)
+    else:
+        mins = np.zeros((F, G, B))
+        maxs = np.zeros((F, G, B))
+    return AggState(counts=counts, sums=sums, mins=mins, maxs=maxs)
+
+
 @dataclass
 class AggState:
     """Combinable partial aggregates (numpy, on host after device exit)."""

@@ -643,7 +643,7 @@ class Executor:
         """
         import jax.numpy as jnp
 
-        from ..ops.scan_agg import cached_scan_agg, coerce_literals, encode_filter_ops, state_to_host
+        from ..ops.scan_agg import coerce_literals, encode_filter_ops, state_to_host
 
         schema = plan.schema
         if schema.tsid_index is None or not table.physical_datas():
@@ -766,60 +766,66 @@ class Executor:
 
         gos = np.append(series_group, 0).astype(np.int32)  # pad series -> masked
         allow = np.append(allowed, False)
-        values_dev = (
-            entry.values_for(value_names)
-            if value_names
-            else jnp.zeros((0, len(entry.series_codes_dev)), dtype=jnp.float32)
-        )
-        args = (
-            entry.series_codes_dev,
-            entry.ts_rel_dev,
-            values_dev,
-            jnp.asarray(gos),
-            jnp.asarray(allow),
-            coerce_literals([lit for _, _, lit in device_filters]),
-            np.int32(lo - entry.min_ts),
-            np.int32(hi - entry.min_ts),
-            np.int32(max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0),
-            np.int32(width if width else 1),
-        )
-        row_idx = (
-            self._selective_row_idx(entry, allowed, lo, hi)
-            if entry.mesh is None and not empty_range
-            else None
-        )
-        if row_idx is not None:
-            from ..ops.scan_agg import selective_cached_scan_agg
-
-            m["cache_rows"] = int((row_idx != entry.n_valid).sum())
-            out = selective_cached_scan_agg(
-                jnp.asarray(row_idx),
-                *args,
-                n_groups=spec.n_groups,
-                n_buckets=spec.n_buckets,
-                n_agg_fields=spec.n_agg_fields,
-                numeric_filters=encode_filter_ops(spec.numeric_filters),
-                need_minmax=spec.need_minmax,
-            )
-        elif entry.mesh is not None:
+        values_dev = entry.values_for(value_names)
+        literals = [lit for _, _, lit in device_filters]
+        lo_rel = lo - entry.min_ts
+        hi_rel = hi - entry.min_ts
+        t0_rel = max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0
+        width_i = width if width else 1
+        if entry.mesh is not None:
             # Sharded entry: the big arrays live split across the mesh —
             # run the shard_map cached kernel (the DEFAULT multi-device
-            # serving path; single-device deployments take the else arm).
+            # serving path; single-device deployments take the packed arm).
             from ..parallel.dist_agg import make_cached_dist_scan_agg
 
             step = make_cached_dist_scan_agg(entry.mesh, spec)
-            out = step(*args)
+            out = step(
+                entry.series_codes_dev,
+                entry.ts_rel_dev,
+                values_dev,
+                jnp.asarray(gos),
+                jnp.asarray(allow),
+                coerce_literals(literals),
+                np.int32(lo_rel),
+                np.int32(hi_rel),
+                np.int32(t0_rel),
+                np.int32(width_i),
+            )
             m["mesh_devices"] = int(entry.mesh.devices.size)
+            state = state_to_host(*out)
         else:
-            out = cached_scan_agg(
-                *args,
+            # Single-device serving: the RTT-minimized packed path — one
+            # content-cached session upload, one dyn upload, one execute,
+            # one packed fetch (ops/scan_agg.py "packed serving path").
+            from ..ops.scan_agg import (
+                cached_scan_agg_packed,
+                pack_dyn,
+                unpack_packed_state,
+            )
+
+            row_idx = (
+                self._selective_row_idx(entry, allowed, lo, hi)
+                if not empty_range
+                else None
+            )
+            if row_idx is not None:
+                m["cache_rows"] = int((row_idx != entry.n_valid).sum())
+            session_dev = entry.session_for(gos, allow)
+            dyn = pack_dyn(literals, lo_rel, hi_rel, t0_rel, width_i, row_idx)
+            packed = cached_scan_agg_packed(
+                entry.series_codes_dev,
+                entry.ts_rel_dev,
+                values_dev,
+                session_dev,
+                jnp.asarray(dyn),
                 n_groups=spec.n_groups,
                 n_buckets=spec.n_buckets,
                 n_agg_fields=spec.n_agg_fields,
                 numeric_filters=encode_filter_ops(spec.numeric_filters),
                 need_minmax=spec.need_minmax,
+                selective=row_idx is not None,
             )
-        state = state_to_host(*out)
+            state = unpack_packed_state(packed, spec)
         if len(delta) and not empty_range:
             self._fold_delta(
                 state, delta, entry, plan.schema, gos, allow,

@@ -76,13 +76,21 @@ class CachedTableScan:
     # just those ranges instead of scanning the whole table
     series_offsets: np.ndarray = None
 
+    # per-(group map, allow list) content -> device-resident upload; a
+    # dashboard re-issuing the same query shape skips the upload entirely
+    # (see ops.scan_agg packed serving path)
+    _sessions: dict = None
+
     def values_for(self, names: list[str]):
         key = tuple(names)
         if self._stacks is None:
             self._stacks = {}
         out = self._stacks.get(key)
         if out is None:
-            out = jnp.stack([self.value_cols_dev[n] for n in names])
+            if not names:
+                out = jnp.zeros((0, len(self.series_codes_dev)), dtype=jnp.float32)
+            else:
+                out = jnp.stack([self.value_cols_dev[n] for n in names])
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 import jax
@@ -90,6 +98,28 @@ class CachedTableScan:
                 out = jax.device_put(out, NamedSharding(self.mesh, P(None, "shard")))
             self._stacks[key] = out
         return out
+
+    def session_for(self, gos: np.ndarray, allow: np.ndarray):
+        """Device handle for the packed [group map | allow list] upload,
+        keyed by CONTENT — repeats of a query shape (the dashboard steady
+        state) reuse the resident buffer and ship zero series-level bytes.
+        Bounded LRU; benign races just upload twice."""
+        from ..ops.scan_agg import pack_session
+
+        key = gos.tobytes() + allow.tobytes()
+        cache = self._sessions
+        if cache is None:
+            cache = self._sessions = {}
+        dev = cache.pop(key, None)
+        if dev is None:
+            if len(cache) >= 32:
+                try:  # racing evictors may target the same oldest key
+                    cache.pop(next(iter(cache)), None)
+                except (StopIteration, RuntimeError):
+                    pass
+            dev = jnp.asarray(pack_session(gos, allow))
+        cache[key] = dev
+        return dev
 
 
 class ScanCache:
