@@ -190,19 +190,60 @@ def _emit(obj: dict) -> None:
     print(json.dumps(obj))
 
 
-def main() -> None:
-    config = os.environ.get("BENCH_CONFIG", "readme")
-    builder = CONFIGS.get(config)
-    if builder is None:
-        _emit({"metric": f"{config}_error", "value": 0, "unit": f"unknown config {config}",
-               "vs_baseline": 0, "platform": "none"})
-        return
+# All-configs order: headline (tsbs-5-8-1) LAST — the driver parses the
+# final stdout line, and every config still gets its own line.
+ALL_CONFIGS = ("readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all", "tsbs-5-8-1")
+PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "900"))
 
+
+def run_all() -> None:
+    """Run every BASELINE config, one subprocess + one JSON line each.
+
+    Subprocess isolation means a config that wedges (the axon tunnel can
+    hang mid-run) or crashes costs only its own line; the rest still
+    report. Emitted lines flush immediately so partial progress survives
+    a driver kill."""
+    import subprocess
+
+    env = dict(os.environ)
+    for config in ALL_CONFIGS:
+        env["BENCH_CONFIG"] = config
+        line = None
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                timeout=PER_CONFIG_TIMEOUT,
+                text=True,
+            )
+            for ln in reversed(p.stdout.strip().splitlines()):
+                if ln.startswith("{"):
+                    line = ln
+                    break
+        except subprocess.TimeoutExpired:
+            pass
+        if line is None:
+            line = json.dumps({
+                "metric": f"{config}_error", "value": 0,
+                "unit": "timeout or no output", "vs_baseline": 0,
+                "platform": "unknown",
+            })
+        print(line)
+        sys.stdout.flush()
+
+
+def run_config(config: str) -> dict:
+    """Build + run one config against the CURRENT jax backend; returns the
+    result dict (never raises for result-shape problems — errors come back
+    as labeled `_error` records so callers always have a line to emit)."""
     import jax
 
-    if not _backend_usable():
-        # Backend unavailable/wedged: a labeled CPU number beats rc=1.
-        jax.config.update("jax_platforms", "cpu")
+    builder = CONFIGS.get(config)
+    if builder is None:
+        return {"metric": f"{config}_error", "value": 0,
+                "unit": f"unknown config {config}", "vs_baseline": 0,
+                "platform": "none"}
     platform = jax.devices()[0].platform
     db, sql, n_rows = builder()
 
@@ -224,20 +265,31 @@ def main() -> None:
     # Both paths must agree numerically (a fast-but-wrong kernel must not
     # benchmark as a success).
     if not _rows_agree(dev_rows, host_rows):
-        _emit({"metric": f"{config}_error", "value": 0, "unit": "path mismatch",
-               "vs_baseline": 0, "platform": platform})
+        return {"metric": f"{config}_error", "value": 0,
+                "unit": "path mismatch", "vs_baseline": 0,
+                "platform": platform}
+
+    return {
+        "metric": f"{config}_rows_per_sec_{dev_path}",
+        "value": round(n_rows / dev_s),
+        "unit": "rows/s",
+        "vs_baseline": round(host_s / dev_s, 3),
+        "platform": platform,
+    }
+
+
+def main() -> None:
+    config = os.environ.get("BENCH_CONFIG")
+    if config is None:
+        run_all()
         return
 
-    rows_per_sec = n_rows / dev_s
-    _emit(
-        {
-            "metric": f"{config}_rows_per_sec_{dev_path}",
-            "value": round(rows_per_sec),
-            "unit": "rows/s",
-            "vs_baseline": round(host_s / dev_s, 3),
-            "platform": platform,
-        }
-    )
+    import jax
+
+    if not _backend_usable():
+        # Backend unavailable/wedged: a labeled CPU number beats rc=1.
+        jax.config.update("jax_platforms", "cpu")
+    _emit(run_config(config))
 
 
 if __name__ == "__main__":

@@ -147,6 +147,33 @@ class InSubquery(Expr):
 
 
 @dataclass(frozen=True)
+class WindowSpec:
+    """OVER (PARTITION BY ... ORDER BY ...) — no explicit frames; with an
+    ORDER BY, aggregate windows use the SQL-default running frame (RANGE
+    UNBOUNDED PRECEDING .. CURRENT ROW, peers included), without one the
+    whole partition (the same defaults DataFusion gives the reference,
+    query_engine/src/datafusion_impl/mod.rs:54)."""
+
+    partition_by: tuple[Expr, ...] = ()
+    order_by: tuple["OrderItem", ...] = ()
+
+
+@dataclass(frozen=True)
+class WindowFunc(Expr):
+    """fn(args) OVER (spec). ``name`` is lowercased: row_number, rank,
+    dense_rank, lag, lead, first_value, last_value, count, sum, avg,
+    min, max."""
+
+    name: str
+    args: tuple[Expr, ...]
+    spec: WindowSpec
+
+    def __str__(self) -> str:
+        inner = ", ".join(str(a) for a in self.args)
+        return f"{self.name}({inner}) OVER (...)"
+
+
+@dataclass(frozen=True)
 class Between(Expr):
     expr: Expr
     low: Expr
@@ -204,6 +231,31 @@ class Select:
     having: Optional[Expr] = None
     distinct: bool = False
     join: Optional[Join] = None
+    # WITH name AS (...) bindings visible to this select (and, through
+    # the interpreter's overlay, to later ctes in the same statement)
+    ctes: tuple[tuple[str, "Select | UnionSelect"], ...] = ()
+
+
+@dataclass(frozen=True)
+class UnionSelect:
+    """s1 UNION [ALL] s2 [UNION ...] — columns align by position, names
+    come from the first branch; a trailing ORDER BY/LIMIT applies to the
+    combined result (standard SQL placement).
+
+    ``all_flags[i]`` is the ALL-ness of the i-th UNION operator (between
+    selects[i] and selects[i+1]); mixed chains evaluate left-to-right —
+    a distinct UNION dedups everything accumulated so far, a UNION ALL
+    appends (standard left-associative semantics)."""
+
+    selects: tuple[Select, ...]
+    all_flags: tuple[bool, ...] = ()
+    order_by: tuple[OrderItem, ...] = ()
+    limit: Optional[int] = None
+    ctes: tuple[tuple[str, "Select | UnionSelect"], ...] = ()
+
+    @property
+    def all(self) -> bool:
+        return all(self.all_flags)
 
 
 @dataclass(frozen=True)
@@ -290,6 +342,7 @@ class Explain:
 
 Statement = (
     Select
+    | UnionSelect
     | CreateTable
     | Insert
     | DropTable

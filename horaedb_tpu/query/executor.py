@@ -97,6 +97,10 @@ def eval_expr(e: ast.Expr, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
         raise ExprError(f"unknown unary op {e.op}")
     if isinstance(e, ast.BinaryOp):
         return _eval_binary(e, rows)
+    if isinstance(e, ast.WindowFunc):
+        from .window import eval_window
+
+        return eval_window(e, rows, eval_expr)
     if isinstance(e, ast.FuncCall):
         return _eval_func(e, rows)
     if isinstance(e, ast.CorrelatedLookup):
@@ -452,6 +456,14 @@ class Executor:
             return False
         if sel.order_by or sel.distinct or sel.join is not None or sel.group_by:
             return False
+        from .planner import _walk
+
+        if any(
+            isinstance(e, ast.WindowFunc)
+            for item in sel.items
+            for e in _walk(item.expr)
+        ):
+            return False  # window frames need the complete row set
         return self._residual_where(plan) is None
 
     def _try_partitioned_agg(self, plan: QueryPlan, table, m: dict) -> Optional[ResultSet]:
@@ -1054,8 +1066,16 @@ class Executor:
                     kv = kv.sort_ranks()
                 keys.append(kv if o.ascending else _desc_key(kv))
             rows = rows.take(np.lexsort(tuple(keys)))
-        if stmt.limit is not None and not stmt.distinct:
-            # DISTINCT must dedupe BEFORE the limit applies
+        from .planner import _walk
+
+        has_window = any(
+            isinstance(e, ast.WindowFunc)
+            for item in stmt.items
+            for e in _walk(item.expr)
+        )
+        if stmt.limit is not None and not stmt.distinct and not has_window:
+            # DISTINCT must dedupe BEFORE the limit applies; window frames
+            # must see the complete (sorted) row set before truncation
             rows = rows.slice(0, stmt.limit)
 
         names: list[str] = []
@@ -1064,6 +1084,8 @@ class Executor:
         for item in plan.select.items:
             if isinstance(item.expr, ast.Star):
                 for c in rows.schema.columns:
+                    if c.name.startswith("__hidden_"):
+                        continue  # cte-internal synthesized columns
                     names.append(c.name)
                     columns.append(as_values(rows.column(c.name)))
                     vm = rows.valid_mask(c.name)
@@ -1078,13 +1100,17 @@ class Executor:
         result = ResultSet(names, columns, nulls or None)
         if stmt.distinct:
             result = _distinct_result(result)
-            if stmt.limit is not None and result.num_rows > stmt.limit:
-                k = stmt.limit
-                result = ResultSet(
-                    result.names,
-                    [c[:k] for c in result.columns],
-                    {n: m_[:k] for n, m_ in (result.nulls or {}).items()} or None,
-                )
+        if (
+            (stmt.distinct or has_window)
+            and stmt.limit is not None
+            and result.num_rows > stmt.limit
+        ):
+            k = stmt.limit
+            result = ResultSet(
+                result.names,
+                [c[:k] for c in result.columns],
+                {n: m_[:k] for n, m_ in (result.nulls or {}).items()} or None,
+            )
         return result
 
 

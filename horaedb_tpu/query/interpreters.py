@@ -19,6 +19,7 @@ from . import ast
 from .executor import Executor, ResultSet
 from .plan import (
     AlterTablePlan,
+    CTEPlan,
     CreateTablePlan,
     DescribePlan,
     DropTablePlan,
@@ -29,6 +30,7 @@ from .plan import (
     QueryPlan,
     ShowCreatePlan,
     ShowTablesPlan,
+    UnionPlan,
 )
 
 
@@ -124,7 +126,60 @@ class InterpreterFactory:
             return self._alter(plan)
         if isinstance(plan, ExplainPlan):
             return self._explain(plan)
+        if isinstance(plan, UnionPlan):
+            return self._union(plan)
+        if isinstance(plan, CTEPlan):
+            return self._cte(plan)
         raise InterpreterError(f"no interpreter for {type(plan).__name__}")
+
+    # ---- UNION / CTE -----------------------------------------------------
+    def _union(self, plan: UnionPlan) -> ResultSet:
+        """Branches execute independently (each on its own best path);
+        results align by position, names from the first branch, folded
+        left-to-right: each distinct UNION dedups everything accumulated
+        so far, each UNION ALL appends (standard left-associative
+        semantics — `a UNION b UNION ALL c` keeps c's duplicates)."""
+        from .executor import _distinct_result
+
+        results = [self._select(b) for b in plan.branches]
+        combined = results[0]
+        for i, res in enumerate(results[1:]):
+            combined = _concat_results([combined, res])
+            if not plan.all_flags[i]:
+                combined = _distinct_result(combined)
+        return _order_limit_result(combined, plan.order_by, plan.limit)
+
+    def _cte(self, plan: CTEPlan) -> Output:
+        """WITH bindings materialize in order into an overlay of in-memory
+        tables (later ctes and the outer statement see earlier ones); the
+        outer statement then plans + executes against the overlay (ref:
+        DataFusion CTEs via LogicalPlan inlining; materialization keeps
+        each cte single-execution like DataFusion's cte work-table)."""
+        from .planner import Planner
+
+        overlay: dict = {}
+
+        def schema_of(name: str):
+            t = overlay.get(name)
+            if t is not None:
+                return t.schema
+            return self.catalog.schema_of(name)
+
+        planner = Planner(schema_of)
+        sub = self._overlay_factory(overlay)
+        for name, stmt in plan.ctes:
+            if name in overlay or self.catalog.exists(name):
+                raise InterpreterError(f"cte name {name!r} shadows an existing table")
+            p = planner.plan(stmt)
+            res = sub.execute(p)
+            overlay[name] = _result_to_table(name, res, p)
+        return sub.execute(planner.plan(plan.inner))
+
+    def _overlay_factory(self, overlay: dict) -> "InterpreterFactory":
+        f = object.__new__(InterpreterFactory)
+        f.catalog = _OverlayCatalog(self.catalog, overlay)
+        f.executor = self.executor  # share scan cache / router state
+        return f
 
     def _explain(self, plan: ExplainPlan) -> ResultSet:
         """Textual plan tree (ref: EXPLAIN over DataFusion plans)."""
@@ -592,3 +647,188 @@ class InterpreterFactory:
                     merged[key] = new[key]
             table.alter_options(TableOptions.from_dict(merged))
         return AffectedRows(0)
+
+
+# ---- UNION / CTE helpers --------------------------------------------------
+
+
+def _concat_results(results: list[ResultSet]) -> ResultSet:
+    """Positional concatenation; names from the first result. Mismatched
+    column dtypes widen to object (SQL's union type coercion, minus the
+    numeric-promotion lattice DataFusion has)."""
+    first = results[0]
+    n_cols = len(first.names)
+    for r in results[1:]:
+        if len(r.names) != n_cols:
+            raise InterpreterError("UNION branches produced different column counts")
+    names = list(first.names)
+    columns: list[np.ndarray] = []
+    nulls: dict[str, np.ndarray] = {}
+    for i in range(n_cols):
+        parts = []
+        mask_parts = []
+        for r in results:
+            col = r.columns[i]
+            parts.append(col)
+            m = (r.nulls or {}).get(r.names[i])
+            mask_parts.append(
+                m if m is not None else np.zeros(len(col), dtype=bool)
+            )
+        try:
+            col = np.concatenate(parts)
+        except (ValueError, TypeError):
+            col = np.concatenate([p.astype(object) for p in parts])
+        if col.dtype.kind not in "OUSb" and any(
+            p.dtype.kind == "f" for p in parts
+        ) and any(p.dtype.kind in "iu" for p in parts):
+            col = col.astype(np.float64)
+        columns.append(col)
+        mask = np.concatenate(mask_parts)
+        if mask.any():
+            nulls[names[i]] = mask
+    return ResultSet(names, columns, nulls or None)
+
+
+def _order_limit_result(result: ResultSet, order_by, limit) -> ResultSet:
+    """ORDER BY/LIMIT over a bare ResultSet (union output): order keys
+    must name output columns of the first branch."""
+    from .executor import _desc_key
+
+    if order_by and result.num_rows:
+        keys = []
+        for o in reversed(order_by):
+            name = o.expr.name if isinstance(o.expr, ast.Column) else str(o.expr)
+            if name not in result.names:
+                raise InterpreterError(
+                    f"ORDER BY column {name!r} is not in the UNION output"
+                )
+            col = result.column(name)
+            keys.append(col if o.ascending else _desc_key(col))
+        order = np.lexsort(tuple(keys))
+        result = ResultSet(
+            result.names,
+            [c[order] for c in result.columns],
+            {k: v[order] for k, v in (result.nulls or {}).items()} or None,
+        )
+    if limit is not None and result.num_rows > limit:
+        result = ResultSet(
+            result.names,
+            [c[:limit] for c in result.columns],
+            {k: v[:limit] for k, v in (result.nulls or {}).items()} or None,
+        )
+    return result
+
+
+_HIDDEN_TS = "__hidden_ts"
+
+
+def _result_to_table(name: str, res: ResultSet, plan):
+    """Materialize a cte's ResultSet as an in-memory table.
+
+    Column kinds come from the source schema when the output column is a
+    plain (possibly aliased) source column, else from the numpy dtype.
+    Derived columns are all plain fields (no tags/tsid — a cte output has
+    no series identity), so queries over it take the host path. A result
+    with no TIMESTAMP column gets a hidden zero timestamp column
+    (schemas require one); SELECT * skips hidden columns.
+    """
+    from ..common_types.datum import DatumKind
+    from ..common_types.dict_column import DictColumn, as_values
+    from ..common_types.schema import ColumnSchema, Schema
+    from ..table_engine.table import MemoryTable
+
+    src_schema = plan.schema if isinstance(plan, QueryPlan) else None
+    src_items: dict[str, ast.Expr] = {}
+    if isinstance(plan, QueryPlan):
+        for item in plan.select.items:
+            if not isinstance(item.expr, ast.Star):
+                src_items[item.output_name] = item.expr
+
+    _DTYPE_KIND = {
+        "f": DatumKind.DOUBLE,
+        "i": DatumKind.INT64,
+        "u": DatumKind.UINT64,
+        "b": DatumKind.BOOLEAN,
+    }
+    cols: list[ColumnSchema] = []
+    data: dict[str, np.ndarray] = {}
+    validity: dict[str, np.ndarray] = {}
+    seen = set()
+    for out_name, col in zip(res.names, res.columns):
+        if out_name in seen:
+            raise InterpreterError(
+                f"cte {name!r} has duplicate output column {out_name!r} "
+                "(alias the expressions uniquely)"
+            )
+        seen.add(out_name)
+        kind = None
+        src = src_items.get(out_name)
+        if (
+            isinstance(src, ast.Column)
+            and src_schema is not None
+            and src_schema.has_column(src.name)
+        ):
+            kind = src_schema.column(src.name).kind
+        elif src_schema is not None and src_schema.has_column(out_name):
+            kind = src_schema.column(out_name).kind
+        elif (
+            isinstance(src, ast.FuncCall)
+            and src.name == "time_bucket"
+        ):
+            kind = DatumKind.TIMESTAMP
+        if kind is None:
+            if isinstance(col, DictColumn):
+                kind = DatumKind.STRING
+            else:
+                kind = _DTYPE_KIND.get(np.asarray(col).dtype.kind, DatumKind.STRING)
+        cols.append(ColumnSchema(out_name, kind, is_nullable=True))
+        data[out_name] = col
+        m = (res.nulls or {}).get(out_name)
+        if m is not None:
+            validity[out_name] = ~m
+    ts_name = next(
+        (c.name for c in cols if c.kind is DatumKind.TIMESTAMP), None
+    )
+    n = res.num_rows
+    if ts_name is None:
+        ts_name = _HIDDEN_TS
+        cols.append(ColumnSchema(ts_name, DatumKind.TIMESTAMP, is_nullable=False))
+        data[ts_name] = np.zeros(n, dtype=np.int64)
+    else:
+        # a NULL timestamp row would break time filtering; coerce to 0
+        vm = validity.get(ts_name)
+        if vm is not None and not vm.all():
+            vals = as_values(data[ts_name]).copy()
+            vals[~vm] = 0
+            data[ts_name] = vals
+    schema = Schema.build(cols, timestamp_column=ts_name, primary_key=(ts_name,))
+    table = MemoryTable(name, schema)
+    if n:
+        table.write(RowGroup(schema, data, validity))
+    return table
+
+
+class _OverlayCatalog:
+    """Catalog view layering cte temp tables over the real catalog —
+    reads resolve overlay-first; everything else passes through."""
+
+    def __init__(self, base, overlay: dict) -> None:
+        self._base = base
+        self._overlay = overlay
+
+    def open(self, name: str):
+        t = self._overlay.get(name)
+        return t if t is not None else self._base.open(name)
+
+    def schema_of(self, name: str):
+        t = self._overlay.get(name)
+        return t.schema if t is not None else self._base.schema_of(name)
+
+    def exists(self, name: str) -> bool:
+        return name in self._overlay or self._base.exists(name)
+
+    def table_names(self) -> list[str]:
+        return sorted(set(self._base.table_names()) | set(self._overlay))
+
+    def __getattr__(self, item):
+        return getattr(self._base, item)

@@ -11,6 +11,7 @@ a general parser around the extensions.
 
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import Any, Optional
 
@@ -173,8 +174,10 @@ class Parser:
             self.i += 1
             analyze = self._eat_kw("ANALYZE")
             return ast.Explain(self._select(), analyze=analyze)
+        if self._at_kw("WITH"):
+            return self._with_statement()
         if self._at_kw("SELECT"):
-            return self._select()
+            return self._select_or_union()
         if self._at_kw("CREATE"):
             return self._create_table()
         if self._at_kw("INSERT"):
@@ -195,6 +198,54 @@ class Parser:
             return self._alter()
         t = self._peek()
         raise ParseError(f"unsupported statement start {t.text!r}", t.pos, self.sql)
+
+    def _with_statement(self) -> ast.Statement:
+        """WITH a AS (select), b AS (select) <select-or-union> — each cte
+        body may itself be a union; later ctes may reference earlier ones
+        (resolved by the interpreter's overlay)."""
+        self._expect_kw("WITH")
+        ctes: list[tuple[str, ast.Select | ast.UnionSelect]] = []
+        while True:
+            name = self._ident()
+            self._expect_kw("AS")
+            self._expect_op("(")
+            body = self._select_or_union()
+            self._expect_op(")")
+            ctes.append((name, body))
+            if not self._eat_op(","):
+                break
+        outer = self._select_or_union()
+        return dataclasses.replace(outer, ctes=tuple(ctes))
+
+    def _select_or_union(self) -> ast.Select | ast.UnionSelect:
+        """SELECT ... [UNION [ALL] SELECT ...]*; a trailing ORDER BY/LIMIT
+        (which ``_select`` greedily attaches to the last branch — the only
+        place SQL allows them un-parenthesized) lifts to the union."""
+        first = self._select()
+        if not self._at_kw("UNION"):
+            return first
+        selects = [first]
+        all_flags: list[bool] = []
+        while self._eat_kw("UNION"):
+            branch_all = bool(self._eat_kw("ALL"))
+            self._eat_kw("DISTINCT")
+            all_flags.append(branch_all)
+            selects.append(self._select())
+        last = selects[-1]
+        order_by, limit = last.order_by, last.limit
+        if order_by or limit is not None:
+            selects[-1] = dataclasses.replace(last, order_by=(), limit=None)
+        n_cols = {len(s.items) for s in selects}
+        if len(n_cols) > 1 and not any(
+            isinstance(i.expr, ast.Star) for s in selects for i in s.items
+        ):
+            raise ParseError("UNION branches have different column counts", -1, self.sql)
+        return ast.UnionSelect(
+            selects=tuple(selects),
+            all_flags=tuple(all_flags),
+            order_by=order_by,
+            limit=limit,
+        )
 
     def _select(self) -> ast.Select:
         self._expect_kw("SELECT")
@@ -301,6 +352,7 @@ class Parser:
         elif (t := self._peek()) is not None and t.kind in ("name", "qident") and t.text.upper() not in (
             "FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
             "HAVING", "JOIN", "INNER", "ON", "LEFT", "OUTER",
+            "UNION", "OVER",
         ):
             alias = self._ident()
         return ast.SelectItem(e, alias)
@@ -595,7 +647,10 @@ class Parser:
                     while self._eat_op(","):
                         args.append(self._expr())
                 self._expect_op(")")
-                return ast.FuncCall(name.lower(), tuple(args), distinct)
+                call = ast.FuncCall(name.lower(), tuple(args), distinct)
+                if self._eat_kw("OVER"):
+                    return self._window(call)
+                return call
             if self._at_op("."):
                 # qualified column (t.col) — resolution is by column name;
                 # the planner validates the qualifier
@@ -603,6 +658,36 @@ class Parser:
                 return ast.Column(self._ident(), qualifier=name)
             return ast.Column(name)
         raise ParseError(f"unexpected token {t.text!r}", t.pos, self.sql)
+
+    def _window(self, call: ast.FuncCall) -> ast.WindowFunc:
+        """fn(...) OVER ( [PARTITION BY e, ...] [ORDER BY e [ASC|DESC], ...] )"""
+        if call.distinct:
+            raise ParseError("DISTINCT is not allowed in window functions", -1, self.sql)
+        self._expect_op("(")
+        partition_by: list[ast.Expr] = []
+        order_by: list[ast.OrderItem] = []
+        if self._eat_kw("PARTITION"):
+            self._expect_kw("BY")
+            partition_by.append(self._expr())
+            while self._eat_op(","):
+                partition_by.append(self._expr())
+        if self._eat_kw("ORDER"):
+            self._expect_kw("BY")
+            while True:
+                e = self._expr()
+                asc = True
+                if self._eat_kw("DESC"):
+                    asc = False
+                elif self._eat_kw("ASC"):
+                    pass
+                order_by.append(ast.OrderItem(e, asc))
+                if not self._eat_op(","):
+                    break
+        self._expect_op(")")
+        return ast.WindowFunc(
+            call.name, call.args,
+            ast.WindowSpec(tuple(partition_by), tuple(order_by)),
+        )
 
 
 def _fold_literal(e: ast.Expr, sql: str) -> Any:

@@ -118,6 +118,31 @@ class AlterTablePlan:
     set_options: dict[str, str] = field(default_factory=dict)
 
 
+@dataclass(frozen=True)
+class UnionPlan:
+    """UNION [ALL]: branch plans executed independently, results aligned
+    by position (names from the first branch), folded left-to-right —
+    ``all_flags[i]`` is the i-th operator's ALL-ness; a distinct UNION
+    dedups the accumulated result — then the union-level ORDER BY/LIMIT
+    (ref: DataFusion's union plan surface,
+    query_engine/src/datafusion_impl/mod.rs:54)."""
+
+    branches: tuple[QueryPlan, ...]
+    all_flags: tuple[bool, ...] = ()
+    order_by: tuple = ()
+    limit: "int | None" = None
+
+
+@dataclass(frozen=True)
+class CTEPlan:
+    """WITH bindings + the outer statement, both UNPLANNED: a cte's output
+    schema only exists once it materializes, so interpreters plan lazily
+    against the overlay of already-materialized ctes."""
+
+    ctes: tuple  # ((name, ast.Select | ast.UnionSelect), ...)
+    inner: object  # ast.Select | ast.UnionSelect (ctes stripped)
+
+
 Plan = (
     QueryPlan
     | InsertPlan
@@ -129,4 +154,6 @@ Plan = (
     | ExistsPlan
     | AlterTablePlan
     | ExplainPlan
+    | UnionPlan
+    | CTEPlan
 )

@@ -67,6 +67,31 @@ class Planner:
             from .plan import ExplainPlan
 
             return ExplainPlan(self._plan_select(stmt.inner), analyze=stmt.analyze)
+        if isinstance(stmt, (ast.Select, ast.UnionSelect)) and stmt.ctes:
+            # CTE bodies and the outer statement plan lazily at execution:
+            # each cte's output schema exists only once it materializes
+            # (interpreters._cte).
+            from .plan import CTEPlan
+            import dataclasses as _dc
+
+            return CTEPlan(ctes=stmt.ctes, inner=_dc.replace(stmt, ctes=()))
+        if isinstance(stmt, ast.UnionSelect):
+            from .plan import UnionPlan
+
+            branches = tuple(self._plan_select(s) for s in stmt.selects)
+            if not any(
+                isinstance(i.expr, ast.Star)
+                for b in branches
+                for i in b.select.items
+            ):
+                if len({len(b.select.items) for b in branches}) > 1:
+                    raise PlanError("UNION branches have different column counts")
+            return UnionPlan(
+                branches=branches,
+                all_flags=stmt.all_flags,
+                order_by=stmt.order_by,
+                limit=stmt.limit,
+            )
         if isinstance(stmt, ast.Select):
             return self._plan_select(stmt)
         if isinstance(stmt, ast.CreateTable):
@@ -223,6 +248,7 @@ class Planner:
             )
         schema = self._require_schema(stmt.table)
         self._check_columns(stmt, schema)
+        self._check_windows(stmt)
 
         predicate = extract_predicate(stmt.where, schema)
         aggs, group_keys, is_agg = self._agg_shape(stmt, schema)
@@ -286,6 +312,69 @@ class Planner:
                 ):
                     raise PlanError(f"unknown column {e.name!r}")
 
+    _WINDOW_FUNCS = {
+        "row_number", "rank", "dense_rank", "lag", "lead",
+        "first_value", "last_value", "count", "sum", "avg", "min", "max",
+    }
+
+    def _check_windows(self, stmt: ast.Select) -> None:
+        """Window functions may appear only in the select list (possibly
+        inside larger expressions) — never in WHERE/GROUP BY/HAVING, and
+        not mixed with grouped aggregation (windows run over scan rows)."""
+        for src, where in (
+            (stmt.where, "WHERE"),
+            (stmt.having, "HAVING"),
+            *((g, "GROUP BY") for g in stmt.group_by),
+        ):
+            if src is None:
+                continue
+            if any(isinstance(e, ast.WindowFunc) for e in _walk(src)):
+                raise PlanError(f"window functions are not allowed in {where}")
+        wfs = [
+            e
+            for item in stmt.items
+            for e in _walk(item.expr)
+            if isinstance(e, ast.WindowFunc)
+        ]
+        if not wfs:
+            return
+        if stmt.group_by or any(
+            isinstance(e, ast.FuncCall) and _is_agg_name(e.name)
+            for item in stmt.items
+            for e in _walk(item.expr)
+        ):
+            raise PlanError(
+                "window functions cannot be mixed with GROUP BY aggregation "
+                "(wrap the aggregate in a WITH cte and window over it)"
+            )
+        for w in wfs:
+            if w.name not in self._WINDOW_FUNCS:
+                raise PlanError(f"unknown window function {w.name!r}")
+            if w.name in ("row_number", "rank", "dense_rank"):
+                if w.args:
+                    raise PlanError(f"{w.name}() takes no arguments")
+                if not w.spec.order_by:
+                    raise PlanError(f"{w.name}() requires ORDER BY in OVER()")
+            elif w.name in ("lag", "lead"):
+                if not 1 <= len(w.args) <= 3:
+                    raise PlanError(f"{w.name}(value[, offset[, default]])")
+                if len(w.args) >= 2 and not (
+                    isinstance(w.args[1], ast.Literal)
+                    and isinstance(w.args[1].value, int)
+                ):
+                    raise PlanError(f"{w.name} offset must be an integer literal")
+                if not w.spec.order_by:
+                    raise PlanError(f"{w.name}() requires ORDER BY in OVER()")
+            elif w.name in ("first_value", "last_value"):
+                if len(w.args) != 1:
+                    raise PlanError(f"{w.name}(value) expects one argument")
+            elif w.name == "count":
+                if len(w.args) > 1:
+                    raise PlanError("count([value]) window expects <= 1 argument")
+            else:  # sum/avg/min/max
+                if len(w.args) != 1:
+                    raise PlanError(f"{w.name}(value) window expects one argument")
+
     def _agg_shape(
         self, stmt: ast.Select, schema: Schema
     ) -> tuple[tuple[AggCall, ...], tuple[GroupKey, ...], bool]:
@@ -310,11 +399,18 @@ class Planner:
             if isinstance(e, ast.FuncCall) and _is_agg_name(e.name):
                 col = None
                 if e.args and not isinstance(e.args[0], ast.Star):
-                    if not isinstance(e.args[0], ast.Column):
+                    if (
+                        e.name == "count"
+                        and isinstance(e.args[0], ast.Literal)
+                        and e.args[0].value is not None
+                    ):
+                        pass  # count(1) == count(*)
+                    elif not isinstance(e.args[0], ast.Column):
                         raise PlanError(
                             f"aggregate over expression not supported: {e}"
                         )
-                    col = e.args[0].name
+                    else:
+                        col = e.args[0].name
                 if e.name != "count" and col is None:
                     raise PlanError(f"{e.name} requires a column argument")
                 if e.name in ("sum", "avg") and col is not None:
@@ -478,6 +574,13 @@ def _walk(e: ast.Expr):
         # pruning and qualifier validation must see them
         for c in e.outer_cols:
             yield from _walk(c)
+    elif isinstance(e, ast.WindowFunc):
+        for a in e.args:
+            yield from _walk(a)
+        for p in e.spec.partition_by:
+            yield from _walk(p)
+        for o in e.spec.order_by:
+            yield from _walk(o.expr)
 
 
 def _walk_exprs(stmt: ast.Select):

@@ -1,0 +1,181 @@
+"""On-chip measurement harness for the tunneled TPU.
+
+The axon tunnel is single-client and flaps: connections succeed in rare
+windows and ``jax.devices()`` hangs outside them. This tool makes one
+PATIENT connection attempt (no timeout — run it in the background) and
+then performs every measurement the repo needs from a real chip in that
+single session, most-valuable-first, appending one JSON line per result
+to the output file (progress survives a mid-run tunnel death):
+
+1. dispatch/RTT microprofile — upload, execute, fetch latencies that the
+   packed serving path (ops/scan_agg.py) is designed around;
+2. the BASELINE.md bench configs, device vs host, via bench.run_config;
+3. segment-reduction A/B: XLA scatter vs MXU one-hot vs the Pallas
+   kernel (ops/pallas_segment.py) across an (n_rows, n_seg) grid — the
+   measured crossover replaces the CPU-guessed _MXU_MAX_SEGMENTS.
+
+Usage:
+    nohup python -m horaedb_tpu.tools.chipbench /tmp/chip_results.jsonl &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main(out_path: str) -> None:
+    out = open(out_path, "a", buffering=1)
+
+    def emit(obj: dict) -> None:
+        obj["t"] = time.strftime("%H:%M:%S")
+        out.write(json.dumps(obj) + "\n")
+
+    t0 = time.time()
+    emit({"stage": "connecting"})
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    emit({
+        "stage": "connected",
+        "devices": str(devs),
+        "platform": platform,
+        "secs": round(time.time() - t0, 1),
+    })
+    if platform == "cpu":
+        emit({"stage": "abort", "reason": "cpu backend — nothing to measure"})
+        return
+
+    def timeit(fn, n=10, warmup=2):
+        for _ in range(warmup):
+            fn()
+        ts = []
+        for _ in range(n):
+            s = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - s)
+        ts.sort()
+        return ts[len(ts) // 2]  # median
+
+    # ---- 1. RTT microprofile --------------------------------------------
+    try:
+        tiny = np.ones(8, np.float32)
+        one_mb = np.ones(1 << 18, np.float32)
+        sixteen_mb = np.ones(1 << 22, np.float32)
+        f = jax.jit(lambda x: x * 2.0 + 1.0)
+        resident = jax.device_put(tiny)
+        f(resident).block_until_ready()  # compile
+        emit({"rtt": "upload_tiny", "ms": round(timeit(
+            lambda: jax.device_put(tiny).block_until_ready()) * 1e3, 3)})
+        emit({"rtt": "exec_tiny", "ms": round(timeit(
+            lambda: f(resident).block_until_ready()) * 1e3, 3)})
+        emit({"rtt": "fetch_tiny", "ms": round(timeit(
+            lambda: jax.device_get(resident)) * 1e3, 3)})
+        emit({"rtt": "upload_exec_fetch", "ms": round(timeit(
+            lambda: jax.device_get(f(jax.device_put(tiny)))) * 1e3, 3)})
+        emit({"rtt": "upload_1mb", "ms": round(timeit(
+            lambda: jax.device_put(one_mb).block_until_ready()) * 1e3, 3)})
+        emit({"rtt": "upload_16mb", "ms": round(timeit(
+            lambda: jax.device_put(sixteen_mb).block_until_ready(), n=5) * 1e3, 3)})
+        r16 = jax.device_put(sixteen_mb)
+        r16.block_until_ready()
+        emit({"rtt": "fetch_16mb", "ms": round(timeit(
+            lambda: jax.device_get(r16), n=5) * 1e3, 3)})
+        del r16
+    except Exception as e:  # keep going — later stages still valuable
+        emit({"stage": "rtt_error", "err": repr(e)[:300]})
+
+    # ---- 2. bench configs, device vs host -------------------------------
+    sys.path.insert(0, os.getcwd())
+    try:
+        import bench
+
+        for cfg in ("readme", "tsbs-5-8-1", "double-groupby-all",
+                    "high-cpu-all", "tsbs-1-1-1"):
+            try:
+                s = time.time()
+                res = bench.run_config(cfg)
+                res["bench_secs"] = round(time.time() - s, 1)
+                emit(res)
+            except Exception as e:
+                emit({"metric": f"{cfg}_error", "err": repr(e)[:300]})
+    except Exception as e:
+        emit({"stage": "bench_error", "err": repr(e)[:300]})
+
+    # ---- 3. segment-reduction A/B ---------------------------------------
+    try:
+        from horaedb_tpu.ops.scan_agg import (
+            _mxu_segment_agg, _scatter_segment_agg,
+        )
+        from horaedb_tpu.ops.pallas_segment import (
+            pad_segments, segment_sum_matmul,
+        )
+
+        rng = np.random.default_rng(0)
+        for n in (1 << 20, 1 << 23):
+            for n_seg_raw in (128, 1024, 8192, 32768, 131072):
+                n_seg = pad_segments(n_seg_raw)
+                seg = jnp.asarray(
+                    rng.integers(0, n_seg, n).astype(np.int32))
+                mask = jnp.asarray(np.ones(n, bool))
+                vals = jnp.asarray(
+                    rng.normal(size=(1, n)).astype(np.float32))
+
+                def run_mxu():
+                    r = _mxu_segment_agg(seg, mask, vals, n_seg, False)
+                    jax.block_until_ready(r[:2])
+
+                def run_scatter():
+                    r = _scatter_segment_agg(seg, mask, vals, n_seg, False)
+                    jax.block_until_ready(r[:2])
+
+                def run_pallas():
+                    r = segment_sum_matmul(seg, mask, vals, n_seg=n_seg)
+                    jax.block_until_ready(r)
+
+                row = {"ab": "segment", "n": n, "n_seg": n_seg}
+                for name, fn in (("mxu", run_mxu),
+                                 ("scatter", run_scatter),
+                                 ("pallas", run_pallas)):
+                    try:
+                        row[f"{name}_ms"] = round(timeit(fn, n=5) * 1e3, 3)
+                    except Exception as e:
+                        row[f"{name}_err"] = repr(e)[:200]
+                emit(row)
+    except Exception as e:
+        emit({"stage": "ab_error", "err": repr(e)[:300]})
+
+    # ---- 4. minmax broadcast-reduce cost (need_minmax=True shapes) ------
+    try:
+        from horaedb_tpu.ops.scan_agg import _mxu_segment_agg
+
+        rng = np.random.default_rng(1)
+        n = 1 << 20
+        for n_seg in (128, 1024, 8192):
+            seg = jnp.asarray(rng.integers(0, n_seg, n).astype(np.int32))
+            mask = jnp.asarray(np.ones(n, bool))
+            vals = jnp.asarray(rng.normal(size=(1, n)).astype(np.float32))
+
+            def run_mm():
+                r = _mxu_segment_agg(seg, mask, vals, n_seg, True)
+                jax.block_until_ready(r)
+
+            try:
+                ms = round(timeit(run_mm, n=5) * 1e3, 3)
+                emit({"ab": "minmax", "n": n, "n_seg": n_seg, "mxu_mm_ms": ms})
+            except Exception as e:
+                emit({"ab": "minmax", "n": n, "n_seg": n_seg,
+                      "err": repr(e)[:200]})
+    except Exception as e:
+        emit({"stage": "minmax_error", "err": repr(e)[:300]})
+
+    emit({"stage": "done", "total_secs": round(time.time() - t0, 1)})
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/chip_results.jsonl")

@@ -1,0 +1,15 @@
+CREATE TABLE cte_src (host string TAG, v double NOT NULL, t timestamp NOT NULL, TIMESTAMP KEY(t)) ENGINE=Analytic;
+
+INSERT INTO cte_src (host, v, t) VALUES ('a', 1.0, 1000), ('a', 2.0, 2000), ('b', 10.0, 1000), ('b', 20.0, 2000), ('c', 5.0, 1500);
+
+WITH recent AS (SELECT host, v, t FROM cte_src WHERE t >= 1500) SELECT host, count(1) AS c FROM recent GROUP BY host ORDER BY host;
+
+WITH per_host AS (SELECT host, avg(v) AS a FROM cte_src GROUP BY host) SELECT host, a FROM per_host WHERE a > 2 ORDER BY a DESC;
+
+WITH per_host AS (SELECT host, avg(v) AS a FROM cte_src GROUP BY host), ranked AS (SELECT host, a, rank() OVER (ORDER BY a DESC) AS rk FROM per_host) SELECT host, rk FROM ranked ORDER BY rk;
+
+WITH lo AS (SELECT host, v FROM cte_src WHERE v < 3), hi AS (SELECT host, v FROM cte_src WHERE v >= 10) SELECT host, v FROM lo UNION ALL SELECT host, v FROM hi ORDER BY v;
+
+WITH w AS (SELECT host, v, t FROM cte_src) SELECT host, sum(v) OVER (PARTITION BY host ORDER BY t) AS s FROM w ORDER BY host, t;
+
+DROP TABLE cte_src;

@@ -1,0 +1,21 @@
+CREATE TABLE u1 (host string TAG, v double NOT NULL, t timestamp NOT NULL, TIMESTAMP KEY(t)) ENGINE=Analytic;
+
+CREATE TABLE u2 (host string TAG, v double NOT NULL, t timestamp NOT NULL, TIMESTAMP KEY(t)) ENGINE=Analytic;
+
+INSERT INTO u1 (host, v, t) VALUES ('a', 1.0, 1000), ('b', 2.0, 2000);
+
+INSERT INTO u2 (host, v, t) VALUES ('b', 2.0, 2000), ('c', 3.0, 3000);
+
+SELECT host, v FROM u1 UNION ALL SELECT host, v FROM u2 ORDER BY v, host;
+
+SELECT host, v FROM u1 UNION SELECT host, v FROM u2 ORDER BY v, host;
+
+SELECT host, v FROM u1 UNION ALL SELECT host, v FROM u2 ORDER BY v DESC LIMIT 2;
+
+SELECT host, avg(v) AS a FROM u1 GROUP BY host UNION ALL SELECT host, avg(v) AS a FROM u2 GROUP BY host ORDER BY host, a;
+
+SELECT host FROM u1 UNION ALL SELECT host FROM u2 UNION ALL SELECT host FROM u1 ORDER BY host;
+
+DROP TABLE u1;
+
+DROP TABLE u2;

@@ -1,0 +1,21 @@
+CREATE TABLE win_demo (host string TAG, v double NOT NULL, t timestamp NOT NULL, TIMESTAMP KEY(t)) ENGINE=Analytic;
+
+INSERT INTO win_demo (host, v, t) VALUES ('a', 1.0, 1000), ('a', 3.0, 2000), ('a', 2.0, 3000), ('b', 10.0, 1000), ('b', 10.0, 2000), ('b', 30.0, 3000);
+
+SELECT host, t, v, row_number() OVER (PARTITION BY host ORDER BY t) AS rn FROM win_demo ORDER BY host, t;
+
+SELECT host, t, v, lag(v) OVER (PARTITION BY host ORDER BY t) AS prev, lead(v) OVER (PARTITION BY host ORDER BY t) AS next FROM win_demo ORDER BY host, t;
+
+SELECT host, t, v, lag(v, 2, -1.0) OVER (PARTITION BY host ORDER BY t) AS prev2 FROM win_demo ORDER BY host, t;
+
+SELECT host, v, rank() OVER (PARTITION BY host ORDER BY v) AS rk, dense_rank() OVER (PARTITION BY host ORDER BY v) AS drk FROM win_demo ORDER BY host, v, t;
+
+SELECT host, t, sum(v) OVER (PARTITION BY host ORDER BY t) AS running, avg(v) OVER (PARTITION BY host) AS part_avg FROM win_demo ORDER BY host, t;
+
+SELECT host, t, first_value(v) OVER (PARTITION BY host ORDER BY t) AS fst, last_value(v) OVER (PARTITION BY host ORDER BY t) AS cur, min(v) OVER (PARTITION BY host ORDER BY t) AS run_min FROM win_demo ORDER BY host, t;
+
+SELECT host, t, v - lag(v) OVER (PARTITION BY host ORDER BY t) AS delta FROM win_demo ORDER BY host, t;
+
+SELECT v, row_number() OVER (ORDER BY v DESC) AS rn FROM win_demo ORDER BY rn LIMIT 3;
+
+DROP TABLE win_demo;

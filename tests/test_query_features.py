@@ -783,3 +783,227 @@ class TestAdaptivePathRouting:
         for i in range(MAX_KEYS + 50):
             r.record(("t", i), "host", 0.01)
         assert len(r._stats) == MAX_KEYS
+
+
+class TestWindowFunctions:
+    """OVER (PARTITION BY .. ORDER BY ..) on the host path (ref parity:
+    DataFusion window functions, query_engine/src/datafusion_impl/mod.rs:54)."""
+
+    @pytest.fixture()
+    def wdb(self, db):
+        db.execute(
+            "CREATE TABLE w (host string TAG, v double, t timestamp KEY) "
+            "ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO w (host, v, t) VALUES "
+            "('a', 1, 1000), ('a', 3, 2000), ('a', 2, 3000), "
+            "('b', 5, 1000), ('b', 5, 2000)"
+        )
+        return db
+
+    def test_row_number_lag_lead(self, wdb):
+        r = wdb.execute(
+            "SELECT host, t, row_number() OVER (PARTITION BY host ORDER BY t) rn, "
+            "lag(v) OVER (PARTITION BY host ORDER BY t) p, "
+            "lead(v) OVER (PARTITION BY host ORDER BY t) nx "
+            "FROM w ORDER BY host, t"
+        ).to_pylist()
+        assert [x["rn"] for x in r] == [1, 2, 3, 1, 2]
+        assert [x["p"] for x in r] == [None, 1.0, 3.0, None, 5.0]
+        assert [x["nx"] for x in r] == [3.0, 2.0, None, 5.0, None]
+
+    def test_lag_offset_default(self, wdb):
+        r = wdb.execute(
+            "SELECT lag(v, 2, 0.0) OVER (PARTITION BY host ORDER BY t) p2 "
+            "FROM w ORDER BY host, t"
+        ).to_pylist()
+        assert [x["p2"] for x in r] == [0.0, 0.0, 1.0, 0.0, 0.0]
+
+    def test_rank_ties_and_desc(self, wdb):
+        r = wdb.execute(
+            "SELECT v, rank() OVER (ORDER BY v DESC) rk, "
+            "dense_rank() OVER (ORDER BY v DESC) dr FROM w ORDER BY rk, t"
+        ).to_pylist()
+        # values desc: 5,5,3,2,1 -> rank 1,1,3,4,5; dense 1,1,2,3,4
+        assert [x["rk"] for x in r] == [1, 1, 3, 4, 5]
+        assert [x["dr"] for x in r] == [1, 1, 2, 3, 4]
+
+    def test_running_and_partition_aggregates(self, wdb):
+        r = wdb.execute(
+            "SELECT host, t, sum(v) OVER (PARTITION BY host ORDER BY t) rs, "
+            "avg(v) OVER (PARTITION BY host) pa, "
+            "min(v) OVER (PARTITION BY host ORDER BY t) rmin, "
+            "count() OVER (PARTITION BY host) pc "
+            "FROM w ORDER BY host, t"
+        ).to_pylist()
+        assert [x["rs"] for x in r] == [1.0, 4.0, 6.0, 5.0, 10.0]
+        assert [x["pa"] for x in r] == [2.0, 2.0, 2.0, 5.0, 5.0]
+        assert [x["rmin"] for x in r] == [1.0, 1.0, 1.0, 5.0, 5.0]
+        assert [x["pc"] for x in r] == [3, 3, 3, 2, 2]
+
+    def test_running_peers_share_frame(self, wdb):
+        # b's two rows tie on v; ordering by v makes them peers: the
+        # running frame (RANGE .. CURRENT ROW) includes both for both.
+        r = wdb.execute(
+            "SELECT host, count() OVER (PARTITION BY host ORDER BY v) c "
+            "FROM w WHERE host = 'b' ORDER BY t"
+        ).to_pylist()
+        assert [x["c"] for x in r] == [2, 2]
+
+    def test_first_last_value(self, wdb):
+        r = wdb.execute(
+            "SELECT host, t, first_value(v) OVER (PARTITION BY host ORDER BY t) f, "
+            "last_value(v) OVER (PARTITION BY host ORDER BY t) l "
+            "FROM w ORDER BY host, t"
+        ).to_pylist()
+        assert [x["f"] for x in r] == [1.0, 1.0, 1.0, 5.0, 5.0]
+        # standard running-frame semantics: last_value == current row
+        assert [x["l"] for x in r] == [1.0, 3.0, 2.0, 5.0, 5.0]
+
+    def test_window_in_expression(self, wdb):
+        r = wdb.execute(
+            "SELECT v - lag(v) OVER (PARTITION BY host ORDER BY t) d "
+            "FROM w WHERE host = 'a' ORDER BY t"
+        ).to_pylist()
+        assert [x["d"] for x in r] == [None, 2.0, -1.0]
+
+    def test_window_limit_sees_all_rows(self, wdb):
+        r = wdb.execute(
+            "SELECT count() OVER () c FROM w LIMIT 2"
+        ).to_pylist()
+        assert [x["c"] for x in r] == [5, 5]
+
+    def test_window_errors(self, wdb):
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="WHERE"):
+            wdb.execute("SELECT v FROM w WHERE rank() OVER (ORDER BY v) = 1")
+        with _pytest.raises(Exception, match="ORDER BY"):
+            wdb.execute("SELECT lag(v) OVER (PARTITION BY host) FROM w")
+        with _pytest.raises(Exception, match="mixed"):
+            wdb.execute(
+                "SELECT host, avg(v), rank() OVER (ORDER BY host) "
+                "FROM w GROUP BY host"
+            )
+        with _pytest.raises(Exception, match="unknown window function"):
+            wdb.execute("SELECT ntile(4) OVER (ORDER BY v) FROM w")
+
+
+class TestUnion:
+    @pytest.fixture()
+    def udb(self, db):
+        db.execute("CREATE TABLE ua (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("CREATE TABLE ub (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("INSERT INTO ua (h, v, t) VALUES ('x', 1, 1), ('y', 2, 2)")
+        db.execute("INSERT INTO ub (h, v, t) VALUES ('y', 2, 2), ('z', 3, 3)")
+        return db
+
+    def test_union_all_and_distinct(self, udb):
+        r = udb.execute("SELECT h, v FROM ua UNION ALL SELECT h, v FROM ub").to_pylist()
+        assert len(r) == 4
+        r = udb.execute("SELECT h, v FROM ua UNION SELECT h, v FROM ub").to_pylist()
+        assert len(r) == 3
+
+    def test_union_order_limit(self, udb):
+        r = udb.execute(
+            "SELECT h, v FROM ua UNION ALL SELECT h, v FROM ub "
+            "ORDER BY v DESC LIMIT 2"
+        ).to_pylist()
+        assert [x["v"] for x in r] == [3.0, 2.0]
+
+    def test_union_aggregate_branches(self, udb):
+        r = udb.execute(
+            "SELECT h, avg(v) a FROM ua GROUP BY h UNION ALL "
+            "SELECT h, avg(v) a FROM ub GROUP BY h ORDER BY h, a"
+        ).to_pylist()
+        assert [x["h"] for x in r] == ["x", "y", "y", "z"]
+
+    def test_union_column_count_mismatch(self, udb):
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="column count"):
+            udb.execute("SELECT h, v FROM ua UNION ALL SELECT h FROM ub")
+
+
+class TestCTE:
+    def test_cte_chain_and_shadowing(self, db):
+        db.execute("CREATE TABLE src (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("INSERT INTO src (h, v, t) VALUES ('a', 1, 1), ('a', 3, 2), ('b', 10, 1)")
+        r = db.execute(
+            "WITH m AS (SELECT h, avg(v) a FROM src GROUP BY h), "
+            "top AS (SELECT h, a FROM m WHERE a > 1) "
+            "SELECT h FROM top ORDER BY h"
+        ).to_pylist()
+        assert [x["h"] for x in r] == ["a", "b"]
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="shadows"):
+            db.execute("WITH src AS (SELECT h FROM src) SELECT h FROM src")
+
+    def test_cte_time_filter_pushes_into_cte_result(self, db):
+        db.execute("CREATE TABLE s2 (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("INSERT INTO s2 (h, v, t) VALUES ('a', 1, 1000), ('a', 2, 2000), ('a', 3, 3000)")
+        r = db.execute(
+            "WITH w AS (SELECT h, v, t FROM s2) "
+            "SELECT count(v) c FROM w WHERE t >= 2000"
+        ).to_pylist()
+        assert r == [{"c": 2}]
+
+    def test_cte_without_timestamp_column(self, db):
+        db.execute("CREATE TABLE s3 (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("INSERT INTO s3 (h, v, t) VALUES ('a', 1, 1), ('b', 2, 2)")
+        r = db.execute(
+            "WITH names AS (SELECT h FROM s3) SELECT h FROM names ORDER BY h"
+        ).to_pylist()
+        assert [x["h"] for x in r] == ["a", "b"]
+        # SELECT * over a ts-less cte must not leak the hidden column
+        r2 = db.execute("WITH names AS (SELECT h FROM s3) SELECT * FROM names")
+        assert r2.names == ["h"]
+
+    def test_cte_union_body(self, db):
+        db.execute("CREATE TABLE s4 (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("INSERT INTO s4 (h, v, t) VALUES ('a', 1, 1), ('b', 5, 2)")
+        r = db.execute(
+            "WITH both AS (SELECT h, v FROM s4 WHERE v < 2 "
+            "UNION ALL SELECT h, v FROM s4 WHERE v > 2) "
+            "SELECT count(v) c FROM both"
+        ).to_pylist()
+        assert r == [{"c": 2}]
+
+
+class TestWindowReviewRegressions:
+    """Fixes from review: count(*) OVER, count over strings, mixed
+    UNION/UNION ALL chains."""
+
+    @pytest.fixture()
+    def rdb(self, db):
+        db.execute("CREATE TABLE rw (h string TAG, v double, t timestamp KEY) ENGINE=Analytic")
+        db.execute("INSERT INTO rw (h, v, t) VALUES ('a', 1, 1), ('a', 2, 2), ('b', 3, 3)")
+        return db
+
+    def test_count_star_over(self, rdb):
+        r = rdb.execute("SELECT count(*) OVER (PARTITION BY h) c FROM rw ORDER BY t").to_pylist()
+        assert [x["c"] for x in r] == [2, 2, 1]
+
+    def test_count_string_column_over(self, rdb):
+        r = rdb.execute("SELECT count(h) OVER () c FROM rw").to_pylist()
+        assert [x["c"] for x in r] == [3, 3, 3]
+
+    def test_min_string_column_clear_error(self, rdb):
+        with pytest.raises(Exception, match="non-numeric"):
+            rdb.execute("SELECT min(h) OVER () FROM rw")
+
+    def test_mixed_union_chain_left_assoc(self, rdb):
+        # distinct UNION first, then ALL: the ALL branch's duplicates stay
+        r = rdb.execute(
+            "SELECT h FROM rw UNION SELECT h FROM rw "
+            "UNION ALL SELECT h FROM rw"
+        ).to_pylist()
+        assert len(r) == 2 + 3  # distinct(a,b) + all 3 rows again
+        # ALL then distinct: everything dedups at the trailing UNION
+        r2 = rdb.execute(
+            "SELECT h FROM rw UNION ALL SELECT h FROM rw "
+            "UNION SELECT h FROM rw"
+        ).to_pylist()
+        assert len(r2) == 2
