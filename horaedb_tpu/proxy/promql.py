@@ -4,21 +4,33 @@ translates PromQL into DataFusion plans; here PromQL translates into the
 same Plan/executor pipeline SQL uses, so prom queries ride the fused
 device kernels).
 
-Supported grammar (the TSBS/dashboard workhorse subset):
+Supported grammar:
 
     expr     := addexpr
     addexpr  := mulexpr (('+' | '-') mulexpr)*
     mulexpr  := unary (('*' | '/' | '%') unary)*
     unary    := number | '(' expr ')' | vector
-    vector   := agg 'by' '(' labels ')' '(' vector ')'
-              | agg '(' vector ')'          -- agg arg is a vector, not
-              | func '(' selector ')'       -- arithmetic: sum(a*2) is
-              | selector                    -- written sum(a) * 2
-    agg      := sum | avg | min | max | count
-    func     := rate | increase | avg_over_time | min_over_time | max_over_time
+    vector   := agg [mod] '(' [param ','] expr ')' [mod]
+              | func '(' [phi ','] selector ')'
+              | vfunc '(' ... )'            -- per-function signature
+              | selector
+    mod      := ('by' | 'without') '(' labels ')'
+    agg      := sum | avg | min | max | count | stddev | stdvar
+              | topk | bottomk | quantile   -- the last three take a param
+    func     := rate | increase
+              | avg_over_time | min_over_time | max_over_time
+              | sum_over_time | count_over_time
+              | quantile_over_time | stddev_over_time | last_over_time
+    vfunc    := histogram_quantile(phi, expr)
+              | label_replace(expr, dst, repl, src, regex)
+              | label_join(expr, dst, sep, src...)
+              | abs | ceil | floor | round | clamp_min | clamp_max
     selector := metric [ '{' matcher (',' matcher)* '}' ]
                 [ '[' duration ']' ] ( 'offset' duration | '@' unix )*
     matcher  := label ('=' | '!=' | '=~' | '!~') 'value'
+
+Aggregations nest (max(sum by (h) (m)) works) and accept both prefix and
+suffix by/without placement, like prom.
 
 Binary expressions follow prom's arithmetic semantics: scalar/scalar,
 vector/scalar (applied per sample), and vector/vector one-to-one
@@ -48,8 +60,20 @@ from typing import Optional
 
 from ..engine.options import parse_duration_ms
 
-AGG_FUNCS = {"sum", "avg", "min", "max", "count"}
-RANGE_FUNCS = {"rate", "increase", "avg_over_time", "min_over_time", "max_over_time"}
+AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev", "stdvar"}
+PARAM_AGGS = {"topk", "bottomk", "quantile"}  # aggregators with a scalar param
+RANGE_FUNCS = {
+    "rate", "increase",
+    "avg_over_time", "min_over_time", "max_over_time",
+    "sum_over_time", "count_over_time",  # push into SQL sum()/count()
+    "quantile_over_time", "stddev_over_time", "last_over_time",  # raw fold
+}
+# funcs over a full evaluated vector (ref surface: promql/udf.rs:50-97 +
+# the IOx function table the reference inherits)
+VECTOR_FUNCS = {
+    "histogram_quantile", "label_replace", "label_join",
+    "abs", "ceil", "floor", "round", "clamp_min", "clamp_max",
+}
 
 
 class PromQLError(ValueError):
@@ -62,10 +86,9 @@ class PromQuery:
     matchers: list[tuple[str, str, str]] = field(default_factory=list)  # (label, op, value)
     range_ms: Optional[int] = None
     func: Optional[str] = None  # RANGE_FUNCS
-    agg: Optional[str] = None  # AGG_FUNCS
-    by_labels: Optional[list[str]] = None  # None = per-series
     offset_ms: int = 0  # `offset 1h` shifts the evaluated window back
     at_ms: Optional[int] = None  # `@ <unix>` pins the evaluation time
+    param: Optional[float] = None  # quantile_over_time's φ
 
 
 @dataclass
@@ -85,7 +108,31 @@ class PromBin:
     rhs: "PromExpr"
 
 
-PromExpr = PromQuery | PromScalar | PromBin
+@dataclass
+class PromAgg:
+    """Cross-series aggregation over a full sub-expression: sum/avg/min/
+    max/count/stddev/stdvar, parameterized quantile/topk/bottomk, with
+    ``by`` (keep listed labels) or ``without`` (drop listed labels)."""
+
+    op: str
+    arg: "PromExpr"
+    param: Optional[float] = None
+    by_labels: Optional[list[str]] = None
+    without_labels: Optional[list[str]] = None
+
+
+@dataclass
+class PromCall:
+    """Vector-transform function: histogram_quantile, label_replace,
+    label_join, and the per-sample math funcs (abs/ceil/floor/round/
+    clamp_min/clamp_max)."""
+
+    name: str
+    arg: "PromExpr"
+    params: tuple = ()  # scalars/strings, meaning depends on name
+
+
+PromExpr = PromQuery | PromScalar | PromBin | PromAgg | PromCall
 
 
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:.]*"
@@ -175,38 +222,138 @@ class _Parser:
             return node
         return self.expr()
 
-    def expr(self) -> PromQuery:
-        kind, tok = self.peek()
-        if kind == "name" and tok in AGG_FUNCS:
-            self.next()
-            by = None
-            k2, t2 = self.peek()
-            if k2 == "name" and t2 == "by":
+    def _label_list(self) -> list[str]:
+        self.expect("(")
+        out = []
+        if self.peek()[1] != ")":
+            out.append(self._ident())
+            while self.peek()[1] == ",":
                 self.next()
-                self.expect("(")
-                by = [self._ident()]
-                while self.peek()[1] == ",":
-                    self.next()
-                    by.append(self._ident())
-                self.expect(")")
+                out.append(self._ident())
+        self.expect(")")
+        return out
+
+    def _number(self) -> float:
+        neg = False
+        if self.peek() == ("op", "-"):
+            self.next()
+            neg = True
+        kind, tok = self.next()
+        if kind != "number":
+            raise PromQLError(f"expected a number, found {tok!r}")
+        return -float(tok) if neg else float(tok)
+
+    def _string(self) -> str:
+        kind, tok = self.next()
+        if kind != "string":
+            raise PromQLError(f"expected a quoted string, found {tok!r}")
+        return tok[1:-1]
+
+    def expr(self) -> PromExpr:
+        kind, tok = self.peek()
+        if kind == "name" and (tok in AGG_FUNCS or tok in PARAM_AGGS):
+            self.next()
+            by = without = None
+            k2, t2 = self.peek()
+            if (k2, t2) == ("name", "by"):
+                self.next()
+                by = self._label_list()
+            elif (k2, t2) == ("name", "without"):
+                self.next()
+                without = self._label_list()
             self.expect("(")
-            inner = self.expr()
+            param = None
+            if tok in PARAM_AGGS:
+                param = self._number()
+                self.expect(",")
+            inner = self.addexpr()
             self.expect(")")
-            if inner.agg is not None:
-                raise PromQLError("nested aggregations are not supported")
-            inner.agg = tok
-            inner.by_labels = by
-            return inner
+            # suffix form: sum(...) by (x) / without (x)
+            if by is None and without is None:
+                k2, t2 = self.peek()
+                if (k2, t2) == ("name", "by"):
+                    self.next()
+                    by = self._label_list()
+                elif (k2, t2) == ("name", "without"):
+                    self.next()
+                    without = self._label_list()
+            if tok in ("topk", "bottomk") and (
+                param is None or param != int(param) or param < 1
+            ):
+                raise PromQLError(f"{tok} expects a positive integer k")
+            return PromAgg(
+                tok, inner, param=param, by_labels=by, without_labels=without
+            )
         if kind == "name" and tok in RANGE_FUNCS:
             self.next()
             self.expect("(")
+            param = None
+            if tok == "quantile_over_time":
+                param = self._number()
+                self.expect(",")
             inner = self.selector()
             self.expect(")")
-            if tok in ("rate", "increase") and inner.range_ms is None:
+            needs_range = tok in ("rate", "increase") or tok in (
+                "quantile_over_time", "stddev_over_time", "last_over_time",
+                "sum_over_time", "count_over_time",
+            )
+            if needs_range and inner.range_ms is None:
                 raise PromQLError(f"{tok}() requires a range selector like [5m]")
             inner.func = tok
+            inner.param = param
             return inner
+        if kind == "name" and tok in VECTOR_FUNCS:
+            return self._vector_func(tok)
         return self.selector()
+
+    def _vector_func(self, name: str) -> PromCall:
+        self.next()
+        self.expect("(")
+        params: list = []
+        if name == "histogram_quantile":
+            params.append(self._number())
+            self.expect(",")
+            arg = self.addexpr()
+        elif name == "label_replace":
+            arg = self.addexpr()
+            for _ in range(4):  # dst, replacement, src, regex
+                self.expect(",")
+                params.append(self._string())
+            try:
+                compiled = re.compile(params[3])
+            except re.error as e:
+                raise PromQLError(f"bad regex {params[3]!r}: {e}")
+            # numeric $N refs must name a real capture group (parse-time
+            # 400, not an evaluation-time 500)
+            for m in _DOLLAR_REF.finditer(params[1]):
+                ref = m.group(1).strip("{}")
+                if ref.isdigit() and int(ref) > compiled.groups:
+                    raise PromQLError(
+                        f"label_replace replacement references group "
+                        f"${ref} but the regex has {compiled.groups}"
+                    )
+        elif name == "label_join":
+            arg = self.addexpr()
+            self.expect(",")
+            params.append(self._string())  # dst
+            self.expect(",")
+            params.append(self._string())  # separator
+            while self.peek()[1] == ",":
+                self.next()
+                params.append(self._string())  # source labels
+        elif name in ("clamp_min", "clamp_max"):
+            arg = self.addexpr()
+            self.expect(",")
+            params.append(self._number())
+        elif name == "round":
+            arg = self.addexpr()
+            if self.peek()[1] == ",":
+                self.next()
+                params.append(self._number())
+        else:  # abs / ceil / floor
+            arg = self.addexpr()
+        self.expect(")")
+        return PromCall(name, arg, tuple(params))
 
     def _ident(self) -> str:
         kind, tok = self.next()
@@ -355,27 +502,21 @@ def _range_series(
     # (small) series set host-side.
     push_matchers = [m for m in pq.matchers if m[1] in ("=", "!=")]
     regex_matchers = [m for m in pq.matchers if m[1] in ("=~", "!~")]
-    # Stage 1 (SQL, device kernels): per-SERIES temporal aggregation per
-    # step bucket — always at full tag granularity, exactly prom's model.
-    # Stage 2 (host, tiny): cross-series combine onto the by-labels.
-    if pq.by_labels is not None:
-        out_labels = list(pq.by_labels)
-    elif pq.agg is not None:
-        out_labels = []  # bare sum(...)/avg(...) collapses every label
-    else:
-        out_labels = tag_names
-    for lbl in out_labels:
-        if lbl not in tag_names:
-            raise PromQLError(f"unknown grouping label {lbl!r}")
-    group_labels = tag_names  # stage-1 grouping
+    # Per-SERIES temporal aggregation per step bucket — always at full tag
+    # granularity, exactly prom's model (cross-series combine is PromAgg's
+    # job, _combine_agg).
+    group_labels = tag_names
 
     # Inner temporal aggregation per step bucket.
     func = pq.func
-    agg = pq.agg
     if func == "min_over_time":
         sel = f"min({_q(value_col)}) AS v"
     elif func == "max_over_time":
         sel = f"max({_q(value_col)}) AS v"
+    elif func == "sum_over_time":
+        sel = f"sum({_q(value_col)}) AS v"
+    elif func == "count_over_time":
+        sel = f"count({_q(value_col)}) AS v"
     else:  # raw selector / avg_over_time: average within the bucket
         sel = f"avg({_q(value_col)}) AS v"
 
@@ -392,6 +533,12 @@ def _range_series(
         per_series = _counter_series(
             conn, pq, where, schema, value_col, group_labels, step_ms, func
         )
+    elif func in ("quantile_over_time", "stddev_over_time", "last_over_time"):
+        # Order statistics / exact last need the raw samples per bucket.
+        per_series = _raw_window_series(
+            conn, pq, where, schema, value_col, group_labels, step_ms, func,
+            pq.param,
+        )
     else:
         keys = [f"time_bucket({_q(schema.timestamp_name)}, '{step_ms}ms')"] + [
             _q(l) for l in group_labels
@@ -405,10 +552,12 @@ def _range_series(
         )
         rows = conn.execute(sql).to_pylist()
 
-        # Stage 1 results: per-series value per bucket.
+        # per-series value per bucket; keys CANONICAL (label-sorted) so
+        # binary-op matching and label-transform outputs line up across
+        # metrics regardless of tag declaration order
         per_series = {}
         for r in rows:
-            key = tuple((l, r[l]) for l in group_labels)
+            key = tuple(sorted((l, r[l]) for l in group_labels))
             per_series.setdefault(key, {})[r["bucket"]] = r["v"]
 
     if regex_matchers:
@@ -417,28 +566,7 @@ def _range_series(
             for key, pts in per_series.items()
             if _regex_match(dict(key), regex_matchers)
         }
-
-    # Stage 2: combine series sharing the same by-label subset.
-    if agg is None and pq.by_labels is None:
-        combined = per_series
-    else:
-        combined = {}
-        bucketed: dict[tuple, dict[int, list[float]]] = {}
-        for key, points in per_series.items():
-            sub = tuple((l, v) for l, v in key if l in out_labels)
-            dst = bucketed.setdefault(sub, {})
-            for b, v in points.items():
-                dst.setdefault(b, []).append(v)
-        fn = {
-            None: lambda vs: sum(vs) / len(vs),  # bare by-less func: avg
-            "sum": sum,
-            "avg": lambda vs: sum(vs) / len(vs),
-            "min": min,
-            "max": max,
-            "count": len,
-        }[agg]
-        for sub, buckets in bucketed.items():
-            combined[sub] = {b: fn(vs) for b, vs in buckets.items()}
+    combined = per_series
 
     if pq.offset_ms:
         # offset stamps the shifted window back at the requested times
@@ -500,17 +628,7 @@ def _counter_series(
     counter re-accumulated from 0). rate = increase / step_seconds —
     min/max-based deltas would silently UNDERCOUNT across resets.
     """
-    label_sel = ", ".join(_q(l) for l in group_labels)
-    sql = (
-        f"SELECT {label_sel + ', ' if group_labels else ''}"
-        f"{_q(schema.timestamp_name)} AS __ts, {_q(value_col)} AS __v "
-        f"FROM {_q(pq.metric)} WHERE {' AND '.join(where)}"
-    )
-    rows = conn.execute(sql).to_pylist()
-    samples: dict[tuple, list] = {}
-    for r in rows:
-        key = tuple((l, r[l]) for l in group_labels)
-        samples.setdefault(key, []).append((r["__ts"], r["__v"]))
+    samples = _series_scan(conn, pq, where, schema, value_col, group_labels)
     out: dict[tuple, dict[int, float]] = {}
     for key, pts in samples.items():
         pts.sort()
@@ -533,6 +651,86 @@ def _counter_series(
             buckets = {b: d / (step_ms / 1000.0) for b, d in buckets.items()}
         out[key] = buckets
     return out
+
+
+def _raw_window_series(
+    conn, pq: PromQuery, where: list, schema, value_col: str,
+    group_labels: list, step_ms: int, func: str, param,
+) -> dict:
+    """quantile_over_time / stddev_over_time / last_over_time: fold raw
+    samples per (series, step bucket). Like prom's: quantile uses linear
+    interpolation, stddev is the population deviation, last takes the
+    newest sample in the bucket."""
+    series = _series_scan(conn, pq, where, schema, value_col, group_labels)
+    out: dict[tuple, dict[int, float]] = {}
+    for key, tv_list in series.items():
+        buckets: dict[int, list] = {}
+        for ts, v in tv_list:
+            buckets.setdefault((ts // step_ms) * step_ms, []).append((ts, v))
+        out[key] = {b: _fold_window(func, param, tv) for b, tv in buckets.items()}
+    return out
+
+
+def _series_scan(
+    conn, pq: PromQuery, where: list, schema, value_col: str, group_labels: list
+) -> dict[tuple, list]:
+    """Raw (ts, value) samples per CANONICAL (label-sorted) series key —
+    the single scan both counter folds and order-statistic folds use."""
+    label_sel = ", ".join(_q(l) for l in group_labels)
+    sql = (
+        f"SELECT {label_sel + ', ' if group_labels else ''}"
+        f"{_q(schema.timestamp_name)} AS __ts, {_q(value_col)} AS __v "
+        f"FROM {_q(pq.metric)} WHERE {' AND '.join(where)}"
+    )
+    rows = conn.execute(sql).to_pylist()
+    samples: dict[tuple, list] = {}
+    for r in rows:
+        key = tuple(sorted((l, r[l]) for l in group_labels))
+        samples.setdefault(key, []).append((r["__ts"], r["__v"]))
+    return samples
+
+
+def _fold_window(func: str, param, tv: list) -> float:
+    """One window's worth of raw (ts, value) samples -> one value."""
+    import math
+
+    vals = [v for _, v in tv]
+    if func == "last_over_time":
+        return max(tv)[1]
+    if func == "stddev_over_time":
+        mean = sum(vals) / len(vals)
+        return math.sqrt(sum((v - mean) ** 2 for v in vals) / len(vals))
+    if func == "quantile_over_time":
+        return _quantile(param, vals)
+    if func == "sum_over_time":
+        return float(sum(vals))
+    if func == "count_over_time":
+        return float(len(vals))
+    if func == "avg_over_time":
+        return sum(vals) / len(vals)
+    if func == "min_over_time":
+        return min(vals)
+    if func == "max_over_time":
+        return max(vals)
+    raise PromQLError(f"unknown window function {func!r}")
+
+
+def _quantile(phi: float, vals: list) -> float:
+    """Prom's φ-quantile: linear interpolation between closest ranks;
+    φ outside [0,1] yields ∓/±Inf like prom."""
+    import math
+
+    if phi < 0:
+        return -math.inf
+    if phi > 1:
+        return math.inf
+    s = sorted(vals)
+    if not s:
+        return math.nan
+    rank = phi * (len(s) - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(s) - 1)
+    return s[lo] + (s[hi] - s[lo]) * (rank - lo)
 
 
 # ---- binary expressions --------------------------------------------------
@@ -570,6 +768,16 @@ def _eval_series(conn, node: PromExpr, start_ms: int, end_ms: int, step_ms: int)
         return "scalar", node.value
     if isinstance(node, PromQuery):
         return "vector", _range_series(conn, node, start_ms, end_ms, step_ms)
+    if isinstance(node, PromAgg):
+        k, vec = _eval_series(conn, node.arg, start_ms, end_ms, step_ms)
+        if k != "vector":
+            raise PromQLError(f"{node.op}() expects a vector argument")
+        return "vector", _combine_agg(node, vec)
+    if isinstance(node, PromCall):
+        k, vec = _eval_series(conn, node.arg, start_ms, end_ms, step_ms)
+        if k != "vector":
+            raise PromQLError(f"{node.name}() expects a vector argument")
+        return "vector", _apply_call(node, vec)
     lk, lv = _eval_series(conn, node.lhs, start_ms, end_ms, step_ms)
     rk, rv = _eval_series(conn, node.rhs, start_ms, end_ms, step_ms)
     op = node.op
@@ -606,7 +814,213 @@ def leaf_metrics(node: PromExpr) -> list[str]:
         return [node.metric]
     if isinstance(node, PromBin):
         return leaf_metrics(node.lhs) + leaf_metrics(node.rhs)
+    if isinstance(node, (PromAgg, PromCall)):
+        return leaf_metrics(node.arg)
     return []
+
+
+def _combine_agg(node: PromAgg, vec: dict) -> dict:
+    """Cross-series combine of {key: {bucket: v}} (ref surface: prom's
+    aggregation operators via the IOx planner the reference forks).
+
+    ``by`` keeps listed labels, ``without`` drops listed labels, neither
+    collapses everything. topk/bottomk differ: they SELECT input series
+    (full original labels survive), per bucket, within each group.
+    """
+    import math
+
+    def out_key(key: tuple) -> tuple:
+        if node.without_labels is not None:
+            drop = set(node.without_labels)
+            return tuple((l, v) for l, v in key if l not in drop)
+        if node.by_labels is not None:
+            keep = set(node.by_labels)
+            return tuple((l, v) for l, v in key if l in keep)
+        return ()
+
+    if node.op in ("topk", "bottomk"):
+        k = int(node.param)
+        largest = node.op == "topk"
+        # group -> bucket -> [(value, key)]
+        ranked: dict[tuple, dict[int, list]] = {}
+        for key, pts in vec.items():
+            g = out_key(key)
+            for b, v in pts.items():
+                ranked.setdefault(g, {}).setdefault(b, []).append((v, key))
+        out: dict[tuple, dict[int, float]] = {}
+        for g, buckets in ranked.items():
+            for b, pairs in buckets.items():
+                pairs.sort(key=lambda t: t[0], reverse=largest)
+                for v, key in pairs[:k]:
+                    out.setdefault(key, {})[b] = v
+        return out
+
+    grouped: dict[tuple, dict[int, list]] = {}
+    for key, pts in vec.items():
+        g = out_key(key)
+        dst = grouped.setdefault(g, {})
+        for b, v in pts.items():
+            dst.setdefault(b, []).append(v)
+
+    def fn(vs: list) -> float:
+        if node.op == "sum":
+            return sum(vs)
+        if node.op == "avg":
+            return sum(vs) / len(vs)
+        if node.op == "min":
+            return min(vs)
+        if node.op == "max":
+            return max(vs)
+        if node.op == "count":
+            return float(len(vs))
+        if node.op in ("stddev", "stdvar"):
+            mean = sum(vs) / len(vs)
+            var = sum((v - mean) ** 2 for v in vs) / len(vs)
+            return var if node.op == "stdvar" else math.sqrt(var)
+        if node.op == "quantile":
+            return _quantile(node.param, vs)
+        raise PromQLError(f"unknown aggregator {node.op!r}")
+
+    return {
+        g: {b: fn(vs) for b, vs in buckets.items()}
+        for g, buckets in grouped.items()
+    }
+
+
+_DOLLAR_REF = re.compile(r"\$(\d+|\{\w+\})")
+
+
+def _apply_call(node: PromCall, vec: dict) -> dict:
+    """histogram_quantile / label manipulation / per-sample math."""
+    import math
+
+    name = node.name
+    if name == "histogram_quantile":
+        return _histogram_quantile(node.params[0], vec)
+    if name in ("label_replace", "label_join"):
+        out: dict = {}
+        for key, pts in vec.items():
+            labels = dict(key)
+            if name == "label_replace":
+                dst, repl, src, pattern = node.params
+                current = str(labels.get(src) or "")
+                m = re.fullmatch(pattern, current)
+                if m is not None:
+                    def _ref(g, _m=m):
+                        ref = g.group(1).strip("{}")
+                        try:
+                            got = _m.group(int(ref) if ref.isdigit() else ref)
+                        except (IndexError, re.error):
+                            raise PromQLError(
+                                f"label_replace: no capture group ${ref}"
+                            )
+                        return got or ""
+
+                    new = _DOLLAR_REF.sub(_ref, repl)
+                    if new:
+                        labels[dst] = new
+                    else:
+                        labels.pop(dst, None)
+            else:
+                dst, sep, *srcs = node.params
+                new = sep.join(str(labels.get(s) or "") for s in srcs)
+                if new:
+                    labels[dst] = new
+                else:
+                    labels.pop(dst, None)
+            new_key = tuple(sorted(labels.items()))
+            if new_key in out:
+                raise PromQLError(
+                    f"{name} produced duplicate series for labels {labels}"
+                )
+            out[new_key] = pts
+        return out
+
+    # per-sample math
+    p = node.params[0] if node.params else None
+    if name == "abs":
+        f = abs
+    elif name == "ceil":
+        f = math.ceil
+    elif name == "floor":
+        f = math.floor
+    elif name == "round":
+        nearest = p if p else 1.0
+        f = lambda v: math.floor(v / nearest + 0.5) * nearest
+    elif name == "clamp_min":
+        f = lambda v: max(v, p)
+    elif name == "clamp_max":
+        f = lambda v: min(v, p)
+    else:
+        raise PromQLError(f"unknown function {name!r}")
+    return {
+        key: {b: float(f(v)) for b, v in pts.items()} for key, pts in vec.items()
+    }
+
+
+def _histogram_quantile(phi: float, vec: dict) -> dict:
+    """Prom's histogram_quantile over conventional `_bucket` series:
+    groups by labels-minus-`le`, linear interpolation inside the target
+    bucket, +Inf bucket answers with the highest finite bound. Bucket
+    counts are made monotone first (float scrapes can jitter)."""
+    import math
+
+    groups: dict[tuple, dict[int, list]] = {}
+    for key, pts in vec.items():
+        labels = dict(key)
+        le = labels.pop("le", None)
+        if le is None:
+            continue  # not a histogram series
+        try:
+            bound = math.inf if str(le) in ("+Inf", "Inf", "inf") else float(le)
+        except ValueError:
+            continue
+        g = tuple(sorted(labels.items()))
+        for b, v in pts.items():
+            groups.setdefault(g, {}).setdefault(b, []).append((bound, v))
+    out: dict[tuple, dict[int, float]] = {}
+    for g, buckets in groups.items():
+        pts = {}
+        for b, pairs in buckets.items():
+            q = _hq_one(phi, pairs)
+            if q is not None:
+                pts[b] = q
+        if pts:
+            out[g] = pts
+    return out
+
+
+def _hq_one(phi: float, pairs: list) -> "float | None":
+    import math
+
+    if phi < 0:
+        return -math.inf
+    if phi > 1:
+        return math.inf
+    pairs.sort()
+    if len(pairs) < 2 or not math.isinf(pairs[-1][0]):
+        return None  # prom requires an +Inf bucket
+    # enforce monotone cumulative counts
+    mono = []
+    prev = 0.0
+    for le, c in pairs:
+        prev = max(prev, c)
+        mono.append((le, prev))
+    total = mono[-1][1]
+    if total == 0:
+        return None
+    rank = phi * total
+    for i, (le, c) in enumerate(mono):
+        if c >= rank:
+            if math.isinf(le):
+                # quantile in the +Inf bucket: highest finite bound
+                return mono[i - 1][0]
+            lower_le = mono[i - 1][0] if i > 0 else 0.0
+            lower_c = mono[i - 1][1] if i > 0 else 0.0
+            if c == lower_c:
+                return le
+            return lower_le + (le - lower_le) * (rank - lower_c) / (c - lower_c)
+    return None
 
 
 def evaluate_expr_range(
@@ -660,6 +1074,20 @@ def _instant_value(conn, node: PromExpr, time_ms: int):
             )
             vec[key] = float(s["value"][1])
         return "vector", vec
+    if isinstance(node, (PromAgg, PromCall)):
+        k, vec = _instant_value(conn, node.arg, time_ms)
+        if k != "vector":
+            raise PromQLError("vector argument expected")
+        # reuse the range combinators through a single synthetic bucket
+        as_pts = {key: {0: v} for key, v in vec.items()}
+        combined = (
+            _combine_agg(node, as_pts)
+            if isinstance(node, PromAgg)
+            else _apply_call(node, as_pts)
+        )
+        return "vector", {
+            key: pts[0] for key, pts in combined.items() if 0 in pts
+        }
     lk, lv = _instant_value(conn, node.lhs, time_ms)
     rk, rv = _instant_value(conn, node.rhs, time_ms)
     op = node.op
@@ -690,14 +1118,23 @@ def evaluate_expr_instant(conn, node: PromExpr, time_ms: int) -> list[dict]:
 DEFAULT_LOOKBACK_MS = 5 * 60_000  # prom's 5m instant lookback
 
 
+_OVER_TIME_FUNCS = frozenset(
+    f for f in RANGE_FUNCS if f.endswith("_over_time")
+)
+
+
 def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
     """-> prom 'vector': latest resolvable value per series in the lookback
     (steps at scrape-ish resolution so 'latest' means latest, not a
-    whole-window average)."""
+    whole-window average). ``*_over_time`` functions fold their EXACT
+    window [t-range, t] (not an epoch-aligned bucket containing t — an
+    aligned bucket would cover a fraction of the window whenever t isn't
+    step-aligned)."""
+    if pq.func in _OVER_TIME_FUNCS:
+        return _instant_over_time(conn, pq, time_ms)
     window = pq.range_ms or DEFAULT_LOOKBACK_MS
-    # Any range function aggregates over its WHOLE window; only a raw
-    # selector / cross-series agg walks in scrape-resolution steps to find
-    # the latest sample.
+    # rate/increase aggregate over their whole window; only a raw selector
+    # walks in scrape-resolution steps to find the latest sample.
     step = window if pq.func is not None else min(window, 60_000)
     matrix = evaluate_range(conn, pq, time_ms - window, time_ms, step)
     out = []
@@ -706,4 +1143,41 @@ def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
             continue
         ts, val = series["values"][-1]
         out.append({"metric": series["metric"], "value": [time_ms / 1000.0, val]})
+    return out
+
+
+def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
+    """One raw fold per series over exactly [t-range, t] (after @/offset)."""
+    table = conn.catalog.open(pq.metric)
+    if table is None:
+        return []
+    schema = table.schema
+    value_col = _value_column(schema)
+    tag_names = list(schema.tag_names)
+    for label, _, _ in pq.matchers:
+        if label not in tag_names:
+            raise PromQLError(f"unknown label {label!r} on metric {pq.metric!r}")
+    t_eval = (pq.at_ms if pq.at_ms is not None else time_ms) - pq.offset_ms
+    window = pq.range_ms or DEFAULT_LOOKBACK_MS
+    where = [
+        f"{_q(schema.timestamp_name)} >= {t_eval - window}",
+        f"{_q(schema.timestamp_name)} <= {t_eval}",
+    ]
+    for label, op, val in pq.matchers:
+        if op in ("=", "!="):
+            sval = str(val).replace("'", "''")
+            where.append(f"{_q(label)} {'=' if op == '=' else '!='} '{sval}'")
+    regex_matchers = [m for m in pq.matchers if m[1] in ("=~", "!~")]
+    series = _series_scan(conn, pq, where, schema, value_col, tag_names)
+    out = []
+    for key, tv in sorted(series.items()):
+        if regex_matchers and not _regex_match(dict(key), regex_matchers):
+            continue
+        v = _fold_window(pq.func, pq.param, tv)
+        out.append(
+            {
+                "metric": {"__name__": pq.metric, **{l: x for l, x in key}},
+                "value": [time_ms / 1000.0, repr(float(v))],
+            }
+        )
     return out

@@ -9,6 +9,8 @@ from aiohttp.test_utils import TestClient, TestServer
 import horaedb_tpu
 from horaedb_tpu.proxy.promql import (
     PromQLError,
+    evaluate_expr_instant,
+    evaluate_expr_range,
     evaluate_instant,
     evaluate_range,
     parse_promql,
@@ -23,7 +25,7 @@ class TestParser:
         pq = parse_promql('cpu{host="h1", region!="west"}')
         assert pq.metric == "cpu"
         assert pq.matchers == [("host", "=", "h1"), ("region", "!=", "west")]
-        assert pq.func is None and pq.agg is None
+        assert pq.func is None
 
     def test_range_func(self):
         pq = parse_promql('rate(requests{host="a"}[5m])')
@@ -31,17 +33,47 @@ class TestParser:
 
     def test_agg_by(self):
         pq = parse_promql('sum by (host) (rate(cpu[1m]))')
-        assert pq.agg == "sum" and pq.by_labels == ["host"] and pq.func == "rate"
+        assert pq.op == "sum" and pq.by_labels == ["host"]
+        assert pq.arg.func == "rate"
 
     def test_agg_without_by(self):
         pq = parse_promql("avg(cpu)")
-        assert pq.agg == "avg" and pq.by_labels is None
+        assert pq.op == "avg" and pq.by_labels is None
+
+    def test_agg_without_modifier(self):
+        pq = parse_promql("sum without (host) (cpu)")
+        assert pq.op == "sum" and pq.without_labels == ["host"]
+
+    def test_agg_suffix_modifier(self):
+        pq = parse_promql("sum(cpu) by (host)")
+        assert pq.op == "sum" and pq.by_labels == ["host"]
+
+    def test_nested_agg(self):
+        pq = parse_promql("max(sum by (host) (cpu))")
+        assert pq.op == "max" and pq.arg.op == "sum"
+
+    def test_param_aggs(self):
+        pq = parse_promql("topk(3, cpu)")
+        assert pq.op == "topk" and pq.param == 3
+        pq = parse_promql("quantile(0.9, cpu)")
+        assert pq.op == "quantile" and pq.param == 0.9
+
+    def test_vector_funcs_parse(self):
+        pq = parse_promql("histogram_quantile(0.95, req_bucket)")
+        assert pq.name == "histogram_quantile" and pq.params == (0.95,)
+        pq = parse_promql(
+            'label_replace(cpu, "dc", "$1", "host", "(\\w+)-.*")'
+        )
+        assert pq.name == "label_replace"
+        pq = parse_promql('label_join(cpu, "hr", "-", "host", "region")')
+        assert pq.name == "label_join"
 
     @pytest.mark.parametrize(
         "bad",
         [
             "rate(cpu)",  # range required
-            "sum(avg(cpu))",  # nested agg
+            "quantile_over_time(0.5, cpu)",  # range required
+            "topk(0, cpu)",  # k must be positive
             "cpu{host=h1}",  # unquoted value
             "cpu} garbage",
         ],
@@ -80,7 +112,7 @@ class TestEvaluation:
         assert {s["metric"]["host"] for s in out} == {"h1", "h2"}
 
     def test_sum_by_region(self, db):
-        out = evaluate_range(
+        out = evaluate_expr_range(
             db, parse_promql("sum by (region) (cpu)"), 0, 4 * MIN, MIN
         )
         by_region = {s["metric"]["region"]: s["values"] for s in out}
@@ -89,7 +121,7 @@ class TestEvaluation:
         assert [v for _, v in by_region["w"]] == ["40.0", "41.0", "42.0", "43.0"]
 
     def test_global_avg(self, db):
-        out = evaluate_range(db, parse_promql("avg(cpu)"), 0, 4 * MIN, MIN)
+        out = evaluate_expr_range(db, parse_promql("avg(cpu)"), 0, 4 * MIN, MIN)
         assert len(out) == 1
         # values serialize at %g (6 sig digits)
         assert float(out[0]["values"][0][1]) == pytest.approx((10 + 20 + 40) / 3, rel=1e-4)
@@ -411,3 +443,186 @@ class TestAtModifier:
             parse_promql("cpu @ 5m")  # duration, not a timestamp
         with pytest.raises(PromQLError):
             parse_promql("cpu @")
+
+
+class TestBreadthFunctions:
+    """Round-3 breadth: topk/bottomk, quantile, without, histogram_quantile,
+    label_replace/label_join, *_over_time, per-sample math
+    (ref surface: query_frontend/src/promql/convert.rs, udf.rs:50-97)."""
+
+    def test_topk_bottomk(self, db):
+        out = evaluate_expr_range(db, parse_promql("topk(2, cpu)"), 0, 0, MIN)
+        hosts = {s["metric"]["host"] for s in out}
+        assert hosts == {"h3", "h2"}  # 40 and 20 beat 10
+        out = evaluate_expr_range(db, parse_promql("bottomk(1, cpu)"), 0, 0, MIN)
+        assert {s["metric"]["host"] for s in out} == {"h1"}
+
+    def test_topk_keeps_series_labels(self, db):
+        out = evaluate_expr_range(db, parse_promql("topk(1, cpu)"), 0, 0, MIN)
+        assert out[0]["metric"]["region"] == "w"
+
+    def test_quantile_agg(self, db):
+        out = evaluate_expr_range(db, parse_promql("quantile(0.5, cpu)"), 0, 0, MIN)
+        assert len(out) == 1
+        assert float(out[0]["values"][0][1]) == 20.0  # median of 10,20,40
+
+    def test_sum_without(self, db):
+        out = evaluate_expr_range(
+            db, parse_promql("sum without (host) (cpu)"), 0, 0, MIN
+        )
+        by_region = {s["metric"]["region"]: s["values"] for s in out}
+        assert float(by_region["e"][0][1]) == 30.0
+        assert float(by_region["w"][0][1]) == 40.0
+        assert "host" not in out[0]["metric"]
+
+    def test_stddev_stdvar(self, db):
+        out = evaluate_expr_range(
+            db, parse_promql("stdvar(cpu)"), 0, 0, MIN
+        )
+        vals = [10.0, 20.0, 40.0]
+        mean = sum(vals) / 3
+        expected = sum((v - mean) ** 2 for v in vals) / 3
+        assert float(out[0]["values"][0][1]) == pytest.approx(expected)
+
+    def test_nested_agg_eval(self, db):
+        out = evaluate_expr_range(
+            db, parse_promql("max(sum by (region) (cpu))"), 0, 0, MIN
+        )
+        assert float(out[0]["values"][0][1]) == 40.0  # max(30, 40)
+
+    def test_over_time_family(self, db):
+        out = evaluate_expr_range(
+            db, parse_promql('sum_over_time(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
+        )
+        # buckets of 2m: (10+11), (12+13)
+        assert [v for _, v in out[0]["values"]] == ["21.0", "25.0"]
+        out = evaluate_expr_range(
+            db, parse_promql('count_over_time(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
+        )
+        assert [v for _, v in out[0]["values"]] == ["2.0", "2.0"]
+        out = evaluate_expr_range(
+            db, parse_promql('last_over_time(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
+        )
+        assert [v for _, v in out[0]["values"]] == ["11.0", "13.0"]
+        out = evaluate_expr_range(
+            db, parse_promql('quantile_over_time(0.5, cpu{host="h1"}[2m])'),
+            0, 4 * MIN, 2 * MIN,
+        )
+        assert [v for _, v in out[0]["values"]] == ["10.5", "12.5"]
+        out = evaluate_expr_range(
+            db, parse_promql('stddev_over_time(cpu{host="h1"}[2m])'),
+            0, 4 * MIN, 2 * MIN,
+        )
+        assert [v for _, v in out[0]["values"]] == ["0.5", "0.5"]
+
+    def test_label_replace(self, db):
+        out = evaluate_expr_range(
+            db,
+            parse_promql('label_replace(cpu, "hid", "$1", "host", "h(\\d+)")'),
+            0, 0, MIN,
+        )
+        ids = {s["metric"]["hid"] for s in out}
+        assert ids == {"1", "2", "3"}
+
+    def test_label_replace_no_match_keeps_series(self, db):
+        out = evaluate_expr_range(
+            db,
+            parse_promql('label_replace(cpu, "x", "$1", "host", "zzz(\\d+)")'),
+            0, 0, MIN,
+        )
+        assert len(out) == 3
+        assert all("x" not in s["metric"] for s in out)
+
+    def test_label_join(self, db):
+        out = evaluate_expr_range(
+            db,
+            parse_promql('label_join(cpu, "hr", "-", "host", "region")'),
+            0, 0, MIN,
+        )
+        joined = {s["metric"]["hr"] for s in out}
+        assert joined == {"h1-e", "h2-e", "h3-w"}
+
+    def test_math_funcs(self, db):
+        out = evaluate_expr_range(
+            db, parse_promql('clamp_max(cpu{host="h3"}, 35)'), 0, 0, MIN
+        )
+        assert float(out[0]["values"][0][1]) == 35.0
+        out = evaluate_expr_range(
+            db, parse_promql('round(cpu{host="h1"} / 3)'), 0, 0, MIN
+        )
+        assert float(out[0]["values"][0][1]) == 3.0
+
+    def test_histogram_quantile(self, db):
+        db.execute(
+            "CREATE TABLE req_bucket (le string TAG, path string TAG, "
+            "value double NOT NULL, ts timestamp NOT NULL, TIMESTAMP KEY(ts))"
+        )
+        rows = []
+        # /api: cumulative counts 10 (<=0.1), 30 (<=0.5), 40 (<=+Inf)
+        for le, c in (("0.1", 10), ("0.5", 30), ("+Inf", 40)):
+            rows.append(f"('{le}', '/api', {c}, 0)")
+        db.execute(
+            "INSERT INTO req_bucket (le, path, value, ts) VALUES " + ", ".join(rows)
+        )
+        out = evaluate_expr_instant(
+            db, parse_promql("histogram_quantile(0.5, req_bucket)"), 0
+        )
+        assert len(out) == 1 and out[0]["metric"]["path"] == "/api"
+        # rank = 20 -> inside (0.1, 0.5]: 0.1 + 0.4 * (20-10)/(30-10) = 0.3
+        assert float(out[0]["value"][1]) == pytest.approx(0.3)
+        # 0.95 falls in +Inf bucket -> highest finite bound
+        out = evaluate_expr_instant(
+            db, parse_promql("histogram_quantile(0.95, req_bucket)"), 0
+        )
+        assert float(out[0]["value"][1]) == pytest.approx(0.5)
+
+    def test_instant_agg_and_call(self, db):
+        out = evaluate_expr_instant(
+            db, parse_promql("topk(1, cpu)"), 3 * MIN
+        )
+        assert len(out) == 1 and out[0]["metric"]["host"] == "h3"
+        out = evaluate_expr_instant(
+            db, parse_promql("sum without (host) (cpu)"), 0
+        )
+        assert {s["metric"]["region"] for s in out} == {"e", "w"}
+
+
+class TestPromReviewRegressions:
+    """Review fixes: canonical key order, instant whole-window folds,
+    $0 / bad group refs, mixed-tag-order matching."""
+
+    def test_label_transform_matches_raw_in_binop(self, db):
+        # no-match label_replace leaves series unchanged; subtracting the
+        # raw vector must pair every series (canonical key order), so the
+        # result is all zeros - not an empty matrix.
+        out = evaluate_expr_range(
+            db,
+            parse_promql('label_replace(cpu, "x", "$1", "host", "zzz(\\d+)") - cpu'),
+            0, 0, MIN,
+        )
+        assert len(out) == 3
+        assert all(float(s["values"][0][1]) == 0.0 for s in out)
+
+    def test_instant_over_time_whole_window(self, db):
+        # t=2.5min, [2m] window covers samples at 1m and 2m -> sum 11+12=23
+        out = evaluate_instant(
+            db, parse_promql('sum_over_time(cpu{host="h1"}[2m])'),
+            int(2.5 * MIN),
+        )
+        assert float(out[0]["value"][1]) == 23.0
+        out = evaluate_instant(
+            db, parse_promql('count_over_time(cpu{host="h1"}[2m])'),
+            int(2.5 * MIN),
+        )
+        assert float(out[0]["value"][1]) == 2.0
+
+    def test_dollar_zero_expands_whole_match(self, db):
+        out = evaluate_expr_range(
+            db, parse_promql('label_replace(cpu, "copy", "$0", "host", "h.*")'),
+            0, 0, MIN,
+        )
+        assert {s["metric"]["copy"] for s in out} == {"h1", "h2", "h3"}
+
+    def test_bad_group_ref_is_parse_error(self):
+        with pytest.raises(PromQLError, match="group"):
+            parse_promql('label_replace(cpu, "d", "$2", "host", "(h.*)")')
