@@ -1,8 +1,24 @@
 """Catalog: name -> table resolution, persisted in object storage
-(ref: src/catalog + src/catalog_impls TableBasedManager for standalone mode
-— the reference persists catalog entries in system tables; here the
-registry is one msgpack object with atomic replace, which gives the same
-durability on a LocalDiskStore without bootstrapping a sys table).
+(ref: src/catalog + src/catalog_impls TableBasedManager for standalone
+mode — the reference persists catalog entries through a system-table WAL,
+catalog_impls/src/table_based.rs, or through meta consensus in cluster
+mode, horaemeta cluster_metadata.go).
+
+Persistence is an EDIT LOG over the object store, not a single
+last-writer-wins blob: every create/drop writes one uniquely-named edit
+object ``catalog/edits/<seq>.<node>`` — two nodes mutating a SHARED
+store concurrently can never clobber each other's entries, because they
+never write the same object. Readers fold the newest snapshot plus every
+edit above its high-water mark, ordered by (seq, node) — deterministic
+on every node. Compaction folds edits into ``catalog/snap.<seq>`` and
+deletes only edits STRICTLY below that seq (same-seq edits from a racing
+node survive and re-apply idempotently).
+
+Known limitation (documented, matches the standalone contract): table
+IDS still allocate from a sequential counter, so two nodes creating
+tables at the same instant can collide on the id (storage paths) even
+though neither catalog ENTRY is lost. Cluster mode routes creates
+through the meta service, which serializes allocation.
 
 Single default catalog/schema namespace ("horaedb"."public") for the
 standalone build; the cluster build adds shard-backed volatile catalogs
@@ -11,7 +27,9 @@ standalone build; the cluster build adds shard-backed volatile catalogs
 
 from __future__ import annotations
 
+import logging
 import threading
+import uuid as _uuid
 from dataclasses import dataclass
 from typing import Optional
 
@@ -25,10 +43,15 @@ from ..table_engine.partition import PartitionedTable, make_rule, sub_table_name
 from ..table_engine.table import AnalyticTable, Table
 from ..utils.object_store import ObjectStore
 
+logger = logging.getLogger("horaedb_tpu.catalog")
+
 DEFAULT_CATALOG = "horaedb"
 DEFAULT_SCHEMA = "public"
 
-_REGISTRY_PATH = "catalog/registry"
+_REGISTRY_PATH = "catalog/registry"  # legacy single-blob registry (read-only)
+_SNAP_PREFIX = "catalog/snap."
+_EDIT_PREFIX = "catalog/edits/"
+_COMPACT_EDITS = 64  # fold into a snapshot past this many live edits
 
 
 @dataclass
