@@ -9,6 +9,8 @@ target list:
     tsbs-5-8-1          single-groupby-5-8-1, scale 4000 (headline)
     double-groupby-all  10 metrics, group by (host, hour), scale 400, 12h
     high-cpu-all        usage_user > 90 pushdown, scale 400, 12h
+    compaction-64       BASELINE config 5: 64 overlapping L0 SSTs through
+                        Compactor._device_merge vs the numpy host merge
 
 Every config runs the FULL query path (SQL -> plan -> merge read -> fused
 device kernel) against data ingested through the real engine (memtable ->
@@ -114,6 +116,154 @@ CONFIGS = {
     "high-cpu-all": build_high_cpu,
 }
 
+# ---- compaction config (BASELINE config 5) -----------------------------
+#
+# 64 overlapping L0 SSTs through Compactor._device_merge vs the same merge
+# forced onto a vectorized-numpy host path. SSTs are written directly via
+# SstWriter (the flush discipline, flush.py:95-120) so the build phase
+# measures SST production, not the WAL/memtable write path.
+
+# BASELINE config 5 is 64 SSTs / 100M rows; the default here is 32M so
+# the config (which builds the table TWICE for the device/host A-B) fits
+# the per-config timeout on this 1-core host — rows/s is steady-state at
+# this size. BENCH_COMPACTION_ROWS=100000000 reproduces the full config.
+COMPACTION_SSTS = int(os.environ.get("BENCH_COMPACTION_SSTS", "64"))
+COMPACTION_ROWS = int(os.environ.get("BENCH_COMPACTION_ROWS", "32000000"))
+
+
+def _build_compaction_db(seed: int):
+    """One table with COMPACTION_SSTS overlapping L0 runs in one window."""
+    from horaedb_tpu.common_types import RowGroup
+    from horaedb_tpu.common_types.schema import compute_tsid
+    from horaedb_tpu.engine.manifest import AddFile, Flushed
+    from horaedb_tpu.engine.sst.manager import FileHandle
+    from horaedb_tpu.engine.sst.writer import SstWriter, WriteOptions
+
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE demo (name string TAG, value double, t timestamp KEY) "
+        "ENGINE=Analytic WITH (segment_duration='2h')"
+    )
+    table = db.catalog.open("demo").physical_datas()[0]
+    seg_ms = table.options.segment_duration_ms
+    n_per = COMPACTION_ROWS // COMPACTION_SSTS
+    n_series = 1000
+    rng = np.random.default_rng(seed)
+    writer = SstWriter(
+        table.store,
+        WriteOptions(
+            num_rows_per_row_group=table.options.num_rows_per_row_group,
+            compression=table.options.compression,
+        ),
+    )
+    # All runs overlap: same key space (series x one segment window), ts
+    # drawn from a pool sized so ~1/3 of keys collide across runs — the
+    # dedup work the merge must do.
+    names_pool = np.array([f"host_{i}" for i in range(n_series)], dtype=object)
+    tsid_pool = compute_tsid([names_pool])
+    ts_space = max(1, (COMPACTION_ROWS // n_series) * 3 // 4)
+    ts_step = max(1, seg_ms // ts_space)
+    edits = []
+    for i in range(COMPACTION_SSTS):
+        sidx = rng.integers(0, n_series, n_per)
+        rows = RowGroup(
+            table.schema,
+            {
+                "tsid": tsid_pool[sidx],
+                "t": ((rng.integers(0, ts_space, n_per) * ts_step) % seg_ms
+                      ).astype(np.int64),
+                "name": names_pool[sidx],
+                "value": rng.normal(10.0, 3.0, n_per),
+            },
+        ).sorted_by_key()
+        fid = table.alloc_file_id()
+        path = table.sst_object_path(fid)
+        meta = writer.write(path, fid, rows, max_sequence=i + 1)
+        edits.append(AddFile(0, meta, path))
+        table.version.levels.add_file(0, FileHandle(meta, path, 0))
+    edits.append(Flushed(COMPACTION_SSTS))
+    table.manifest.append_edits(edits)
+    table.version.flushed_sequence = COMPACTION_SSTS
+    return db, table
+
+
+def _host_merge_permutation(tsid, ts, seq, dedup=True):
+    """Vectorized-numpy merge baseline with the device kernel's exact
+    semantics: sort (tsid, ts, seq desc, input-row desc), keep the first
+    row of each (tsid, ts) key."""
+    n = len(tsid)
+    negseq = ~seq.astype(np.uint64)
+    negidx = np.arange(n - 1, -1, -1, dtype=np.uint64)
+    order = np.lexsort((negidx, negseq, ts, tsid)).astype(np.int32)
+    if not dedup:
+        return order, np.ones(n, dtype=np.bool_)
+    s_tsid, s_ts = tsid[order], ts[order]
+    same = (s_tsid[1:] == s_tsid[:-1]) & (s_ts[1:] == s_ts[:-1])
+    return order, np.concatenate([np.ones(1, dtype=np.bool_), ~same])
+
+
+def run_compaction_config() -> dict:
+    """BASELINE config 5: time Compactor.compact() with the device merge
+    kernel vs the numpy host merge on an identical second table; verify
+    both produce the same compacted data via a post-compaction scan."""
+    import jax
+
+    from horaedb_tpu.engine import compaction as compaction_mod
+    from horaedb_tpu.ops import merge_dedup
+    from horaedb_tpu.ops.encoding import shape_bucket
+
+    platform = jax.devices()[0].platform
+    config = "compaction-64"
+
+    # Device pass. Warm the sort kernel on the exact padded bucket shape
+    # first so compile time (minutes on a tunneled backend) isn't billed
+    # to the merge.
+    db_dev, table_dev = _build_compaction_db(seed=7)
+    n_input = sum(h.meta.num_rows for h in table_dev.version.levels.files_at(0))
+    bucket = shape_bucket(n_input)
+    merge_dedup.merge_dedup_permutation(
+        np.zeros(bucket, dtype=np.uint64),
+        np.zeros(bucket, dtype=np.int64),
+        np.zeros(bucket, dtype=np.uint64),
+    )
+    s = time.perf_counter()
+    res_dev = compaction_mod.Compactor(table_dev).compact()
+    dev_s = time.perf_counter() - s
+    dev_check = db_dev.execute(
+        "SELECT count(1) AS c, avg(value) AS v FROM demo"
+    ).to_pylist()
+
+    # Host pass: identical table (same seed), merge forced onto numpy.
+    db_host, table_host = _build_compaction_db(seed=7)
+    orig = compaction_mod.merge_dedup_permutation
+    compaction_mod.merge_dedup_permutation = _host_merge_permutation
+    try:
+        s = time.perf_counter()
+        res_host = compaction_mod.Compactor(table_host).compact()
+        host_s = time.perf_counter() - s
+    finally:
+        compaction_mod.merge_dedup_permutation = orig
+    host_check = db_host.execute(
+        "SELECT count(1) AS c, avg(value) AS v FROM demo"
+    ).to_pylist()
+
+    if (res_dev.rows_written != res_host.rows_written
+            or not _rows_agree(dev_check, host_check)):
+        return {"metric": f"{config}_error", "value": 0,
+                "unit": "device/host merge mismatch", "vs_baseline": 0,
+                "platform": platform}
+
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+    return {
+        "metric": f"{config}_rows_per_sec_device-merge{suffix}",
+        "value": round(n_input / dev_s),
+        "unit": "rows/s",
+        "vs_baseline": round(host_s / dev_s, 3),
+        "platform": platform,
+        "input_rows": n_input,
+        "ssts": COMPACTION_SSTS,
+    }
+
 
 def time_query(db, sql) -> tuple[float, list, str]:
     db.execute(sql)  # warmup (compile)
@@ -164,24 +314,34 @@ def _rows_agree(a: list, b: list, rtol: float = 1e-3, atol: float = 1e-3) -> boo
     return True
 
 
-def _backend_usable() -> bool:
-    """Probe the JAX backend in a SUBPROCESS with a timeout.
+def _tpu_usable(timeout: int = 120) -> bool:
+    """Probe for a REAL TPU in a SUBPROCESS with a timeout.
 
     The axon TPU tunnel is single-client: if another process holds the
     chip, ``jax.devices()`` hangs indefinitely rather than raising — an
-    in-process probe would wedge the whole bench. A probe child that
-    answers promptly means the backend is usable; a hang/crash means fall
-    back to CPU (and say so in the output instead of exiting non-zero).
-    """
+    in-process probe would wedge the whole bench. True only when the
+    child answers promptly, ran a computation end to end, AND reports
+    platform ``tpu`` — a probe child whose jax silently fell back to
+    XLA-CPU must not count as a chip (that silent fallback is exactly
+    what this round's honesty contract exists to catch)."""
     import subprocess
 
     try:
         p = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "x = jnp.ones((8, 8));"
+                "(x @ x).sum().block_until_ready();"
+                "print(jax.devices()[0].platform)",
+            ],
             capture_output=True,
-            timeout=120,
+            timeout=timeout,
         )
-        return p.returncode == 0
+        if p.returncode != 0 or not p.stdout.strip():
+            return False
+        return p.stdout.strip().splitlines()[-1] == b"tpu"
     except (subprocess.TimeoutExpired, OSError):
         return False
 
@@ -192,8 +352,17 @@ def _emit(obj: dict) -> None:
 
 # All-configs order: headline (tsbs-5-8-1) LAST — the driver parses the
 # final stdout line, and every config still gets its own line.
-ALL_CONFIGS = ("readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all", "tsbs-5-8-1")
-PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "900"))
+ALL_CONFIGS = (
+    "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
+    "compaction-64", "tsbs-5-8-1",
+)
+PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "1200"))
+# TPU probe budget: attempts are spent before configs (until the chip
+# first answers), on mid-run wedge demotions, and before end-of-run chip
+# retries; each attempt is bounded so a wedged tunnel costs minutes, not
+# the run.
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+PROBE_MAX_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "8"))
 
 
 def run_all() -> None:
@@ -202,12 +371,28 @@ def run_all() -> None:
     Subprocess isolation means a config that wedges (the axon tunnel can
     hang mid-run) or crashes costs only its own line; the rest still
     report. Emitted lines flush immediately so partial progress survives
-    a driver kill."""
+    a driver kill.
+
+    TPU honesty contract (VERDICT r3 item 1): a CPU fallback must never
+    masquerade as the round's number. The TPU is probed (bounded, in a
+    subprocess, platform-verified) before each config until it first
+    answers; configs that ran on CPU carry ``_CPU-FALLBACK`` in the
+    metric NAME, not just the platform field. A chip that wedges mid-run
+    is demoted after a failed re-probe so later configs get labeled CPU
+    numbers instead of burning full timeouts. If the chip is up at the
+    end, fallback configs are re-run on it and the chip lines emitted
+    additionally — the un-suffixed metric is the authoritative one for a
+    config; a ``_CPU-FALLBACK`` line records only that a fallback
+    happened."""
     import subprocess
 
-    env = dict(os.environ)
-    for config in ALL_CONFIGS:
+    def _run_one(config: str, force_cpu: bool) -> tuple[str, dict | None]:
+        env = dict(os.environ)
         env["BENCH_CONFIG"] = config
+        if force_cpu:
+            env["BENCH_FORCE_CPU"] = "1"
+        else:
+            env.pop("BENCH_FORCE_CPU", None)
         line = None
         try:
             p = subprocess.run(
@@ -224,12 +409,82 @@ def run_all() -> None:
         except subprocess.TimeoutExpired:
             pass
         if line is None:
-            line = json.dumps({
+            return json.dumps({
                 "metric": f"{config}_error", "value": 0,
                 "unit": "timeout or no output", "vs_baseline": 0,
                 "platform": "unknown",
-            })
+            }), None
+        try:
+            return line, json.loads(line)
+        except json.JSONDecodeError:
+            return line, None
+
+    probes_left = PROBE_MAX_ATTEMPTS
+
+    def probe() -> bool:
+        nonlocal probes_left
+        if probes_left <= 0:
+            return False
+        probes_left -= 1
+        return _tpu_usable(timeout=PROBE_TIMEOUT)
+
+    chip_up = False
+    fallback_configs: list[str] = []
+    results: dict[str, str] = {}
+    last_printed = None
+    for config in ALL_CONFIGS:
+        if not chip_up:
+            chip_up = probe()
+        line, parsed = _run_one(config, force_cpu=not chip_up)
+        hung = parsed is None or parsed.get("unit") == "timeout or no output"
+        too_slow_on_chip = False
+        if chip_up and hung:
+            # Either the chip/tunnel wedged mid-config, or the config is
+            # just slower than PER_CONFIG_TIMEOUT. A fresh bounded probe
+            # distinguishes them: probe OK -> the chip is fine, the config
+            # is too slow — rerunning it (on CPU now or chip later) would
+            # only burn more full timeouts for the same error line. Probe
+            # dead -> demote and get a labeled CPU number instead.
+            chip_up = probe()
+            if chip_up:
+                too_slow_on_chip = True
+            else:
+                line2, parsed2 = _run_one(config, force_cpu=True)
+                if parsed2 is not None:
+                    line, parsed = line2, parsed2
+        results[config] = line
         print(line)
+        last_printed = line
+        sys.stdout.flush()
+        m = (parsed or {}).get("metric", "")
+        if not too_slow_on_chip and (
+            parsed is None or "_CPU-FALLBACK" in m or "_error" in m
+        ):
+            fallback_configs.append(config)
+
+    # Chip reachable at the end: re-run fallback configs on it so every
+    # config gets an authoritative chip line. Each chip-side failure
+    # forces a fresh probe before the next retry, so a wedge here costs
+    # one bounded probe, not N full config timeouts.
+    if fallback_configs:
+        chip_up = probe()
+        for config in fallback_configs:
+            if not chip_up:
+                break
+            line, parsed = _run_one(config, force_cpu=False)
+            m = (parsed or {}).get("metric", "")
+            if parsed is not None and "_error" not in m and "_CPU-FALLBACK" not in m:
+                results[config] = line
+                print(line)
+                last_printed = line
+                sys.stdout.flush()
+            else:
+                chip_up = probe()
+    # Headline config's line must be LAST on stdout (the driver parses the
+    # final line); re-emit it if retries pushed other lines after it.
+    headline = ALL_CONFIGS[-1]
+    if last_printed != results[headline]:
+        print(results[headline])
         sys.stdout.flush()
 
 
@@ -239,6 +494,8 @@ def run_config(config: str) -> dict:
     as labeled `_error` records so callers always have a line to emit)."""
     import jax
 
+    if config == "compaction-64":
+        return run_compaction_config()
     builder = CONFIGS.get(config)
     if builder is None:
         return {"metric": f"{config}_error", "value": 0,
@@ -269,8 +526,12 @@ def run_config(config: str) -> dict:
                 "unit": "path mismatch", "vs_baseline": 0,
                 "platform": platform}
 
+    # Honesty label: the bench targets the TPU; any run that ended up on
+    # XLA-CPU carries the fallback in the metric NAME so it can never be
+    # mistaken for a chip number (VERDICT r3 item 1).
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
     return {
-        "metric": f"{config}_rows_per_sec_{dev_path}",
+        "metric": f"{config}_rows_per_sec_{dev_path}{suffix}",
         "value": round(n_rows / dev_s),
         "unit": "rows/s",
         "vs_baseline": round(host_s / dev_s, 3),
@@ -286,8 +547,16 @@ def main() -> None:
 
     import jax
 
-    if not _backend_usable():
-        # Backend unavailable/wedged: a labeled CPU number beats rc=1.
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # run_all probed and the chip did not answer: run on XLA-CPU.
+        # run_config labels the metric _CPU-FALLBACK from the platform.
+        jax.config.update("jax_platforms", "cpu")
+    elif not _tpu_usable(timeout=PROBE_TIMEOUT):
+        # No real chip answered the bounded probe: run on XLA-CPU rather
+        # than hanging on a wedged tunnel; a labeled CPU number beats
+        # rc=1. (The _CPU-FALLBACK metric suffix comes from the actual
+        # platform in run_config, so this can't masquerade as a chip
+        # number.)
         jax.config.update("jax_platforms", "cpu")
     _emit(run_config(config))
 

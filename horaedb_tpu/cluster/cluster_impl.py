@@ -123,7 +123,7 @@ class ClusterImpl:
                 continue
             with self._lock:
                 applied_at = self._order_applied_at.get(shard.shard_id, 0.0)
-            if applied_at > t_req:
+            if applied_at > sent_at:
                 continue
             self.close_shard(shard.shard_id, version=None)
 
