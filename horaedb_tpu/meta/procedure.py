@@ -124,6 +124,20 @@ class ProcedureManager:
         self._execute(p)
         return p
 
+    def cancel(self, proc_id: int) -> bool:
+        """Pull an unfinished procedure out of the retry queue (an admin
+        RPC that already reported failure to its caller must not keep
+        mutating topology in the background — the caller will re-issue).
+        Returns False if it already reached a terminal state."""
+        with self._lock:
+            p = self._procs.get(proc_id)
+            if p is None or p.state in (
+                ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED,
+            ):
+                return False
+            self._transition(p, ProcState.CANCELLED, error=p.error)
+            return True
+
     def tick(self) -> None:
         """Drive pending/failed procedures whose retry delay elapsed."""
         now = time.monotonic()

@@ -1,22 +1,28 @@
-"""Shard placement schedulers + node inspector
+"""Shard placement schedulers + node inspector + bounded-load hash ring
 (ref: horaemeta/server/coordinator/scheduler/{static,rebalanced,reopen}/
-scheduler.go and inspector/node_inspector.go:40-68).
+scheduler.go, inspector/node_inspector.go:40-68, and
+nodepicker/hash/consistent_uniform.go — consistent hashing with bounded
+loads, research.googleblog.com/2017/04 — reimplemented from the paper's
+recipe, not the Go code).
 
 Each scheduler inspects topology and emits transfer decisions; the meta
 server turns decisions into transfer_shard procedures. All three run on
 the coordinator's periodic tick:
 
 - inspector:  nodes silent past the heartbeat timeout go offline;
-- reopen:     shards on offline nodes are reassigned to online nodes;
-- static:     unassigned shards go to the least-loaded online node;
+- reopen:     shards on offline nodes are reassigned via the hash ring;
+- static:     unassigned shards are placed via the hash ring — the same
+              shard lands on the same node across meta restarts and
+              placement barely shifts when membership changes;
 - rebalanced: when load skew exceeds one shard, move one from the most-
-              to the least-loaded node (one move per tick keeps churn low;
-              the reference's bounded-loads consistent hashing has the
-              same goal — placement stability under small changes).
+              to the least-loaded node (one move per tick keeps churn low).
 """
 
 from __future__ import annotations
 
+import bisect
+import hashlib
+import math
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -29,6 +35,59 @@ class Transfer:
     shard_id: int
     to_node: Optional[str]  # None = leave unassigned (no online nodes)
     reason: str
+
+
+def _hash64(key: str) -> int:
+    """Deterministic 64-bit hash — placement must be stable across meta
+    processes and restarts, which rules out Python's salted hash()."""
+    return int.from_bytes(hashlib.blake2b(key.encode(), digest_size=8).digest(), "big")
+
+
+class BoundedLoadRing:
+    """Consistent hashing with bounded loads (the node picker).
+
+    Members are placed on a ring at ``replication`` points each; a key
+    walks clockwise from its own hash and takes the first member whose
+    current load is under the bound ``ceil((total+1)/n * load_factor)``.
+    Two properties the schedulers rely on (and the unit tests pin):
+
+    - stability: adding/removing one member moves only ~1/n of keys;
+    - balance: no member exceeds the bound, however skewed the raw
+      ring segments are.
+    """
+
+    def __init__(self, members: list[str], replication: int = 127,
+                 load_factor: float = 1.25) -> None:
+        if load_factor <= 1.0:
+            raise ValueError("load_factor must exceed 1.0")
+        self.members = sorted(set(members))
+        self.load_factor = load_factor
+        points: list[tuple[int, str]] = []
+        for m in self.members:
+            for r in range(replication):
+                points.append((_hash64(f"{m}#{r}"), m))
+        points.sort()
+        self._points = points
+
+    def max_load(self, loads: dict[str, int]) -> int:
+        total = sum(loads.get(m, 0) for m in self.members)
+        return math.ceil((total + 1) / max(1, len(self.members)) * self.load_factor)
+
+    def pick(self, key: str, loads: dict[str, int]) -> Optional[str]:
+        """First member clockwise of ``key`` with load under the bound;
+        ``loads`` is mutated by the CALLER between picks (each assignment
+        raises that member's load, which is what bounds the next pick)."""
+        if not self._points:
+            return None
+        bound = self.max_load(loads)
+        h = _hash64(key)
+        start = bisect.bisect_left(self._points, (h, ""))
+        n = len(self._points)
+        for i in range(n):
+            _, m = self._points[(start + i) % n]
+            if loads.get(m, 0) < bound:
+                return m
+        return None  # every member at the bound (can't happen: bound > avg)
 
 
 class NodeInspector:
@@ -56,7 +115,7 @@ def _load(topology: TopologyManager) -> dict[str, int]:
 
 
 class StaticScheduler:
-    """Assign every UNASSIGNED shard to the least-loaded online node.
+    """Assign every UNASSIGNED shard via the bounded-load hash ring.
 
     Shards assigned to offline nodes are the ReopenScheduler's job — if
     both claimed them, one tick would emit two transfers per shard with
@@ -69,17 +128,23 @@ class StaticScheduler:
         load = _load(self.topology)
         if not load:
             return []
+        ring = None  # built lazily: most ticks have nothing unassigned
         out = []
         for s in self.topology.shards():
             if s.node is None:
-                target = min(load, key=lambda e: (load[e], e))
+                if ring is None:
+                    ring = BoundedLoadRing(list(load))
+                target = ring.pick(f"shard/{s.shard_id}", load)
+                if target is None:
+                    continue
                 load[target] += 1
                 out.append(Transfer(s.shard_id, target, "static: unassigned"))
         return out
 
 
 class ReopenScheduler:
-    """Move shards off offline nodes (failover)."""
+    """Move shards off offline nodes (failover), placed via the ring so a
+    node's shards scatter across survivors instead of piling onto one."""
 
     def __init__(self, topology: TopologyManager) -> None:
         self.topology = topology
@@ -89,10 +154,15 @@ class ReopenScheduler:
         if not online:
             return []
         load = _load(self.topology)
+        ring = None  # built lazily: failover ticks are the rare case
         out = []
         for s in self.topology.shards():
             if s.node is not None and s.node not in online:
-                target = min(load, key=lambda e: (load[e], e))
+                if ring is None:
+                    ring = BoundedLoadRing(list(load))
+                target = ring.pick(f"shard/{s.shard_id}", load)
+                if target is None:
+                    continue
                 load[target] += 1
                 out.append(Transfer(s.shard_id, target, f"reopen: {s.node} offline"))
         return out

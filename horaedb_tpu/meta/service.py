@@ -117,6 +117,8 @@ class MetaServer:
                 "create_table": self._run_create_table,
                 "drop_table": self._run_drop_table,
                 "transfer_shard": self._run_transfer_shard,
+                "split_shard": self._run_split_shard,
+                "merge_shards": self._run_merge_shards,
             },
         )
         if old is not None and hasattr(old, "close"):
@@ -212,6 +214,108 @@ class MetaServer:
                 pass
         if to_node:
             _post(to_node, "/meta_event/open_shard", self._shard_order(view))
+
+    def _run_split_shard(self, p: Procedure) -> None:
+        """Subdivide a hot shard: carve a new shard, re-home a subset of
+        its tables onto it, open it on the target node
+        (ref: coordinator/procedure/operation/split/split.go — the FSM
+        CreateNewShardView -> UpdateShardTables -> OpenNewShard, flattened
+        into one idempotent, retryable body)."""
+        shard_id = p.params["shard_id"]
+        source = self.topology.shard(shard_id)
+        if source is None:
+            raise RuntimeError(f"shard {shard_id} does not exist")
+        if source.node is None:
+            raise RuntimeError(f"shard {shard_id} unassigned; retrying")
+        tables = self.topology.tables_of_shard(shard_id)
+        names = p.params.get("table_names")
+        if names:
+            known = {t.name for t in tables}
+            missing = [n for n in names if n not in known]
+            # A retry after a partial move finds the names on the NEW
+            # shard already — that's progress, not an error.
+            new_sid_prev = p.params.get("new_shard_id")
+            if new_sid_prev is not None:
+                moved = {
+                    t.name for t in self.topology.tables_of_shard(new_sid_prev)
+                }
+                missing = [n for n in missing if n not in moved]
+            if missing:
+                raise RuntimeError(f"tables not on shard {shard_id}: {missing}")
+        else:
+            # Default: the second half (by name) of the shard's tables.
+            # PERSISTED into params on the first attempt — a retry after a
+            # partial move must not recompute from the shard's remaining
+            # tables (that would keep halving until the shard is empty).
+            names = sorted(t.name for t in tables)[len(tables) // 2:]
+            p.params["table_names"] = names
+        if not names:
+            raise RuntimeError(f"shard {shard_id} has no tables to split off")
+        # Allocate the new shard ONCE across retries.
+        new_sid = p.params.get("new_shard_id")
+        if new_sid is None or self.topology.shard(new_sid) is None:
+            new_sid = self.topology.add_shard().shard_id
+            p.params["new_shard_id"] = new_sid
+        target = p.params.get("target_node") or source.node
+        for name in names:
+            self.topology.move_table_to_shard(name, new_sid)
+        lease_id = self.kv.grant_lease(self.lease_ttl_s)
+        new_view = self.topology.assign_shard(new_sid, target, lease_id=lease_id)
+        src_view = self.topology.shard(shard_id)
+        if target == source.node:
+            # Same-node split: open the new shard FIRST so its tables are
+            # re-homed locally before the source order prunes them (the
+            # prune skips names already mapped to another shard).
+            _post(target, "/meta_event/open_shard", self._shard_order(new_view))
+            _post(source.node, "/meta_event/open_shard", self._shard_order(src_view))
+        else:
+            # Cross-node split: the source must RELEASE the moved tables
+            # (single-writer over the shared WAL) before the target opens
+            # them.
+            _post(source.node, "/meta_event/open_shard", self._shard_order(src_view))
+            _post(target, "/meta_event/open_shard", self._shard_order(new_view))
+
+    def _run_merge_shards(self, p: Procedure) -> None:
+        """Fold one shard's tables into another and retire it (the inverse
+        of split; ref: procedure.go Kind Merge)."""
+        shard_id = p.params["shard_id"]
+        into_id = p.params["into_shard_id"]
+        if shard_id == into_id:
+            raise RuntimeError("cannot merge a shard into itself")
+        victim = self.topology.shard(shard_id)
+        dst = self.topology.shard(into_id)
+        if victim is None:
+            # Retry after a completed merge: victim already retired.
+            return
+        if dst is None:
+            raise RuntimeError(f"target shard {into_id} does not exist")
+        if dst.node is None:
+            raise RuntimeError(f"target shard {into_id} unassigned; retrying")
+        for t in self.topology.tables_of_shard(shard_id):
+            self.topology.move_table_to_shard(t.name, into_id)
+        dst_view = self.topology.shard(into_id)
+        # The moves bumped the victim's version; the close must carry the
+        # CURRENT one or the node rejects it as stale.
+        victim_now = self.topology.shard(shard_id) or victim
+        if victim.node == dst.node:
+            _post(dst.node, "/meta_event/open_shard", self._shard_order(dst_view))
+            if victim.node:
+                try:
+                    _post(victim.node, "/meta_event/close_shard",
+                          {"shard_id": shard_id, "version": victim_now.version})
+                except Exception:
+                    pass  # heartbeat reconcile closes it
+        else:
+            # Cross-node: release on the victim's owner BEFORE the target
+            # opens the moved tables (single-writer discipline).
+            if victim.node:
+                try:
+                    _post(victim.node, "/meta_event/close_shard",
+                          {"shard_id": shard_id, "version": victim_now.version})
+                except Exception:
+                    pass
+            _post(dst.node, "/meta_event/open_shard", self._shard_order(dst_view))
+        self.topology.remove_shard(shard_id)
 
     def _run_create_table(self, p: Procedure) -> None:
         name, create_sql = p.params["name"], p.params["create_sql"]
@@ -363,6 +467,123 @@ class MetaServer:
                 raise RuntimeError(f"drop_table failed: {p.error}")
             return {"dropped": True}
 
+    def _run_admin_proc(self, kind: str, params: dict) -> "Procedure":
+        """Run an admin-initiated procedure inline; if the inline attempt
+        fails, CANCEL the queued retry before reporting the error — the
+        admin saw a failure and may re-issue, and a background retry
+        racing that re-issue would e.g. carve a second split shard.
+        Partial state is safe to abandon: moved tables stay routed and an
+        allocated-but-unassigned shard is picked up by the static
+        scheduler."""
+        p = self.procedures.run_sync(kind, params)
+        if p.state.value != "finished":
+            self.procedures.cancel(p.proc_id)
+            raise RuntimeError(f"{kind} failed: {p.error}")
+        return p
+
+    def handle_split(
+        self,
+        shard_id: int,
+        table_names: Optional[list[str]] = None,
+        target_node: Optional[str] = None,
+    ) -> dict:
+        """Admin API: split a shard (ref: Kind Split, procedure.go:44)."""
+        self._ensure_leader()
+        with self._ddl_lock:
+            # Permanently-invalid requests fail HERE, not via 5 retries.
+            if self.topology.shard(int(shard_id)) is None:
+                raise RuntimeError(f"shard {shard_id} does not exist")
+            if target_node is not None:
+                online = {n.endpoint for n in self.topology.online_nodes()}
+                if target_node not in online:
+                    raise RuntimeError(f"target node {target_node} not online")
+            params: dict = {"shard_id": int(shard_id)}
+            if table_names:
+                params["table_names"] = list(table_names)
+            if target_node:
+                params["target_node"] = target_node
+            p = self._run_admin_proc("split_shard", params)
+            new_sid = p.params["new_shard_id"]
+            view = self.topology.shard(new_sid)
+            return {
+                "new_shard_id": new_sid,
+                "node": view.node if view else None,
+                "tables_moved": [
+                    t.name for t in self.topology.tables_of_shard(new_sid)
+                ],
+            }
+
+    def handle_merge(self, shard_id: int, into_shard_id: int) -> dict:
+        """Admin API: merge one shard into another (Kind Merge)."""
+        self._ensure_leader()
+        with self._ddl_lock:
+            if int(shard_id) == int(into_shard_id):
+                raise RuntimeError("cannot merge a shard into itself")
+            if self.topology.shard(int(into_shard_id)) is None:
+                raise RuntimeError(f"target shard {into_shard_id} does not exist")
+            self._run_admin_proc(
+                "merge_shards",
+                {"shard_id": int(shard_id), "into_shard_id": int(into_shard_id)},
+            )
+            return {
+                "merged_into": int(into_shard_id),
+                "remaining_shards": len(self.topology.shards()),
+            }
+
+    def handle_migrate(self, shard_id: int, to_node: str) -> dict:
+        """Admin API: move a shard to a NAMED node (Kind Migrate; the
+        schedulers' transfer picks its own target — migrate is explicit).
+        Takes the DDL lock: a migrate racing a split/merge that already
+        snapshotted the shard's owner would dispatch orders to a stale
+        node (dual-open until heartbeat reconcile)."""
+        self._ensure_leader()
+        with self._ddl_lock:
+            online = {n.endpoint for n in self.topology.online_nodes()}
+            if to_node not in online:
+                raise RuntimeError(f"target node {to_node} not online")
+            if self.topology.shard(int(shard_id)) is None:
+                raise RuntimeError(f"shard {shard_id} does not exist")
+            self._run_admin_proc(
+                "transfer_shard",
+                {"shard_id": int(shard_id), "to_node": to_node,
+                 "reason": "migrate"},
+            )
+            return {"shard_id": int(shard_id), "node": to_node}
+
+    def handle_scatter(self, max_moves: Optional[int] = None) -> dict:
+        """Admin API: re-place every assigned shard at its bounded-load
+        hash-ring position (Kind Scatter — used after nodes join so the
+        ring, not history, decides where shards live). DDL lock held for
+        the same dual-open reason as migrate."""
+        from .scheduler import BoundedLoadRing
+
+        self._ensure_leader()
+        with self._ddl_lock:
+            online = sorted(n.endpoint for n in self.topology.online_nodes())
+            if not online:
+                raise RuntimeError("no online nodes")
+            ring = BoundedLoadRing(online)
+            loads = {e: 0 for e in online}
+            moves: list[tuple[int, str]] = []
+            for s in sorted(self.topology.shards(), key=lambda s: s.shard_id):
+                target = ring.pick(f"shard/{s.shard_id}", loads)
+                if target is None:
+                    continue
+                loads[target] += 1
+                if s.node is not None and s.node != target:
+                    moves.append((s.shard_id, target))
+            if max_moves is not None:
+                moves = moves[: int(max_moves)]
+            done = 0
+            for sid, target in moves:
+                p = self.procedures.run_sync(
+                    "transfer_shard",
+                    {"shard_id": sid, "to_node": target, "reason": "scatter"},
+                )
+                if p.state.value == "finished":
+                    done += 1
+            return {"moves": done, "planned": len(moves)}
+
     def handle_route(self, table: str) -> Optional[dict]:
         self._ensure_leader()
         hit = self.topology.route(table)
@@ -449,6 +670,38 @@ def create_meta_app(server: MetaServer) -> web.Application:
             return web.json_response({"error": "table not found"}, status=404)
         return web.json_response(out)
 
+    def _admin_post(handler, *required, **optional):
+        """Shared shape of the shard-operation endpoints: JSON body ->
+        positional required fields + optional kwargs -> executor."""
+
+        async def run(request: web.Request) -> web.Response:
+            body = await request.json()
+            try:
+                args = [body[k] for k in required]
+            except KeyError as e:
+                return web.json_response({"error": f"missing {e}"}, status=400)
+            kwargs = {k: body.get(k, d) for k, d in optional.items()}
+            import asyncio
+
+            try:
+                out = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: handler(*args, **kwargs)
+                )
+                return web.json_response(out)
+            except NotLeader as e:
+                return _not_leader(e)
+            except Exception as e:
+                return web.json_response({"error": str(e)}, status=422)
+
+        return run
+
+    split = _admin_post(
+        server.handle_split, "shard_id", table_names=None, target_node=None
+    )
+    merge = _admin_post(server.handle_merge, "shard_id", "into_shard_id")
+    migrate = _admin_post(server.handle_migrate, "shard_id", "to_node")
+    scatter = _admin_post(server.handle_scatter, max_moves=None)
+
     async def nodes(request: web.Request) -> web.Response:
         if server.topology is None or (
             server.election is not None and not server.is_leader
@@ -496,6 +749,10 @@ def create_meta_app(server: MetaServer) -> web.Application:
     app.router.add_post("/meta/v1/node/heartbeat", heartbeat)
     app.router.add_post("/meta/v1/table/create", create_table)
     app.router.add_post("/meta/v1/table/drop", drop_table)
+    app.router.add_post("/meta/v1/shard/split", split)
+    app.router.add_post("/meta/v1/shard/merge", merge)
+    app.router.add_post("/meta/v1/shard/migrate", migrate)
+    app.router.add_post("/meta/v1/shard/scatter", scatter)
     app.router.add_get("/meta/v1/route/{table}", route)
     app.router.add_get("/meta/v1/nodes", nodes)
     app.router.add_get("/meta/v1/shards", shards)

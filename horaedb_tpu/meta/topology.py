@@ -33,6 +33,7 @@ _K_NODE = "node/"
 _K_SHARD = "shard/"
 _K_TABLE = "table/"
 _K_IDS = "meta/next_table_id"
+_K_SHARD_IDS = "meta/next_shard_id"
 
 
 @dataclass
@@ -191,6 +192,63 @@ class TopologyManager:
             if s is None or s.node != expected_node:
                 return None
             return self.assign_shard(shard_id, expected_node, lease_id=lease_id)
+
+    def add_shard(self) -> ShardView:
+        """Allocate a brand-new shard (the split target). Ids come from a
+        MONOTONIC persisted counter — never reused, even after a merge
+        retires the highest id: a data node may still hold the retired
+        shard's state at a high version, and a reborn id would have its
+        fresh orders rejected as stale (version fencing is per-id)."""
+        with self._lock:
+            sid = max(
+                int(self.kv.get(_K_SHARD_IDS) or 0),
+                max(self._shards, default=-1) + 1,
+            )
+            self.kv.put(_K_SHARD_IDS, sid + 1)
+            self._shards[sid] = ShardView(sid, None)
+            self.kv.put(f"{_K_SHARD}{sid}", self._shards[sid].to_dict())
+            return ShardView(**vars(self._shards[sid]))
+
+    def remove_shard(self, shard_id: int) -> None:
+        """Retire an EMPTY shard (the merge victim). Refuses while tables
+        still reference it — the merge procedure moves them first."""
+        with self._lock:
+            s = self._shards.get(shard_id)
+            if s is None:
+                return
+            holders = [t.name for t in self._tables.values() if t.shard_id == shard_id]
+            if holders:
+                raise ValueError(
+                    f"shard {shard_id} still holds tables: {holders[:5]}"
+                )
+            del self._shards[shard_id]
+            self.kv.delete(f"{_K_SHARD}{shard_id}")
+
+    def move_table_to_shard(self, name: str, to_shard: int) -> Optional[TableMeta]:
+        """Re-home one table between shards; bumps BOTH shard versions so
+        stale orders on either side are fenced. Returns the updated meta
+        (None if the table vanished)."""
+        with self._lock:
+            tm = self._tables.get(name)
+            if tm is None:
+                return None
+            if tm.shard_id == to_shard:
+                return tm
+            src = self._shards.get(tm.shard_id)
+            dst = self._shards[to_shard]
+            if src is not None:
+                ids = list(src.table_ids)
+                if tm.table_id in ids:
+                    ids.remove(tm.table_id)
+                src.table_ids = tuple(ids)
+                src.version += 1
+                self.kv.put(f"{_K_SHARD}{src.shard_id}", src.to_dict())
+            dst.table_ids = (*dst.table_ids, tm.table_id)
+            dst.version += 1
+            self.kv.put(f"{_K_SHARD}{dst.shard_id}", dst.to_dict())
+            tm.shard_id = to_shard
+            self.kv.put(f"{_K_TABLE}{name}", tm.to_dict())
+            return tm
 
     def shards_of_node(self, endpoint: str) -> list[ShardView]:
         with self._lock:

@@ -14,6 +14,14 @@ Commands:
     config                  server config dump
     hotspot                 hottest tables by reads/writes
     diagnose                health + config + table summary in one shot
+
+Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
+
+    split SHARD [--tables a b] [--target NODE]   carve a new shard
+    merge SHARD INTO_SHARD                       fold one into another
+    migrate SHARD NODE                           move to a named node
+    scatter [--max-moves N]                      re-place via hash ring
+    procedures                                   coordinator queue state
 """
 
 from __future__ import annotations
@@ -138,6 +146,36 @@ def cmd_flush(ep: str, args) -> None:
     print(_post(ep, path, {}))
 
 
+def cmd_split(ep: str, args) -> None:
+    payload: dict = {"shard_id": args.shard_id}
+    if args.tables:
+        payload["table_names"] = args.tables
+    if args.target:
+        payload["target_node"] = args.target
+    print(_post(args.meta, "/meta/v1/shard/split", payload))
+
+
+def cmd_merge(ep: str, args) -> None:
+    print(_post(args.meta, "/meta/v1/shard/merge",
+                {"shard_id": args.shard_id, "into_shard_id": args.into_shard_id}))
+
+
+def cmd_migrate(ep: str, args) -> None:
+    print(_post(args.meta, "/meta/v1/shard/migrate",
+                {"shard_id": args.shard_id, "to_node": args.node}))
+
+
+def cmd_scatter(ep: str, args) -> None:
+    payload = {}
+    if args.max_moves is not None:
+        payload["max_moves"] = args.max_moves
+    print(_post(args.meta, "/meta/v1/shard/scatter", payload))
+
+
+def cmd_procedures(ep: str, args) -> None:
+    print(_get(args.meta, "/meta/v1/procedures"))
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -170,6 +208,25 @@ def main(argv=None) -> int:
     sub.add_parser("slow_log")
     fl = sub.add_parser("flush")
     fl.add_argument("table", nargs="?", default=None)
+    meta_default = os.environ.get("HORAEDB_META", "127.0.0.1:2379")
+    sp = sub.add_parser("split")
+    sp.add_argument("shard_id", type=int)
+    sp.add_argument("--tables", nargs="*", default=None)
+    sp.add_argument("--target", default=None)
+    sp.add_argument("--meta", default=meta_default)
+    mg = sub.add_parser("merge")
+    mg.add_argument("shard_id", type=int)
+    mg.add_argument("into_shard_id", type=int)
+    mg.add_argument("--meta", default=meta_default)
+    mi = sub.add_parser("migrate")
+    mi.add_argument("shard_id", type=int)
+    mi.add_argument("node")
+    mi.add_argument("--meta", default=meta_default)
+    sc = sub.add_parser("scatter")
+    sc.add_argument("--max-moves", type=int, default=None)
+    sc.add_argument("--meta", default=meta_default)
+    pr = sub.add_parser("procedures")
+    pr.add_argument("--meta", default=meta_default)
     args = p.parse_args(argv)
     if args.token:
         global _TOKEN
@@ -180,6 +237,11 @@ def main(argv=None) -> int:
     except CtlError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # Piped into head/less and the reader closed first — unix says
+        # exit quietly, not with a traceback.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
     return 0
 
 

@@ -140,7 +140,13 @@ class TestSchedulers:
         moves = StaticScheduler(t).schedule()
         assert len(moves) == 4
         targets = [m.to_node for m in moves]
-        assert targets.count("n1:1") == 2 and targets.count("n2:2") == 2
+        # Ring placement: every shard assigned, nobody past the bounded-
+        # load cap (ceil(avg * 1.25)); exact counts are hash-dependent.
+        assert set(targets) <= {"n1:1", "n2:2"}
+        assert max(targets.count(n) for n in set(targets)) <= 3
+        # Deterministic: the same topology re-schedules identically.
+        again = [m.to_node for m in StaticScheduler(t).schedule()]
+        assert again == targets
 
     def test_reopen_moves_off_offline(self):
         t = topo(num_shards=2, nodes=["n1:1", "n2:2"])
