@@ -49,6 +49,7 @@ class ClusterImpl:
         self._stop = threading.Event()
         self._poke = threading.Event()  # kick_heartbeat() wakes the loop
         self._thread: Optional[threading.Thread] = None
+        self._watch_thread: Optional[threading.Thread] = None
 
     # ---- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -63,12 +64,18 @@ class ClusterImpl:
             target=self._loop, daemon=True, name="cluster-heartbeat"
         )
         self._thread.start()
+        self._watch_thread = threading.Thread(
+            target=self._lease_watch_loop, daemon=True, name="lease-watch"
+        )
+        self._watch_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
         self._poke.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)
 
     def kick_heartbeat(self) -> None:
         """Wake the heartbeat loop NOW — called after a /meta_event push
@@ -126,6 +133,52 @@ class ClusterImpl:
             if applied_at > sent_at:
                 continue
             self.close_shard(shard.shard_id, version=None)
+
+    def _lease_watch_loop(self) -> None:
+        """The lock-loss WATCH (ref: shard_lock_manager.rs:23-60 — etcd
+        watch events freeze the shard the moment the lock is lost, rather
+        than every write path discovering expiry on its own).
+
+        Here the lease is heartbeat-granted, so the watch is a deadline
+        scan at ~TTL/4 cadence: a READY shard whose lease lapsed FREEZES
+        (one state flip, visible in /debug/shards and metrics, fails all
+        writers fast); a FROZEN shard whose owner re-heartbeated before
+        the coordinator moved it THAWS. ensure_table_writable keeps its
+        own deadline check — the watch narrows the fencing gap, it is not
+        the only fence."""
+        while not self._stop.wait(self._watch_interval()):
+            for shard in self.shard_set.all_shards():
+                # Deadline re-read UNDER THE LOCK per shard, at decision
+                # time: freezing from a loop-start snapshot would reject
+                # writes for a whole watch interval after a renewal that
+                # landed mid-scan.
+                now = time.monotonic()
+                with self._lock:
+                    deadline = self._lease_deadline.get(shard.shard_id)
+                if deadline is None or deadline == 0.0:
+                    # 0.0 = just opened via /meta_event push, lease grant
+                    # in flight on the kicked heartbeat. ensure_writable's
+                    # own deadline check fences it; freezing here would
+                    # churn every push-open through FROZEN.
+                    continue
+                try:
+                    if shard.state is ShardState.READY and now > deadline:
+                        shard.freeze()
+                        logger.warning(
+                            "shard %d FROZEN: lease lapsed %.2fs ago",
+                            shard.shard_id, now - deadline,
+                        )
+                    elif shard.state is ShardState.FROZEN and now <= deadline:
+                        shard.thaw()
+                        logger.info(
+                            "shard %d thawed: lease renewed", shard.shard_id
+                        )
+                except ShardError:
+                    pass  # state moved under us (open/close race): benign
+
+    def _watch_interval(self) -> float:
+        ttl = self._last_lease_ttl
+        return max(0.05, (ttl / 4.0) if ttl else 0.5)
 
     # ---- shard orders (heartbeat reply or /meta_event push) -------------
     def apply_shard_order(self, order: dict, granted_at: Optional[float] = None) -> None:

@@ -80,11 +80,20 @@ class Shard:
             self._state = ShardState.READY
 
     def freeze(self) -> None:
-        """Stop serving writes ahead of a transfer (ref: Frozen state)."""
+        """Stop serving writes ahead of a transfer, or on lease loss
+        (ref: Frozen state; shard_lock_manager.rs lock-loss reaction)."""
         with self._lock:
             if self._state is not ShardState.READY:
                 raise ShardError(f"shard {self.shard_id}: freeze from {self._state}")
             self._state = ShardState.FROZEN
+
+    def thaw(self) -> None:
+        """Resume serving after the lease came back (a frozen shard whose
+        owner re-heartbeated before the coordinator moved it)."""
+        with self._lock:
+            if self._state is not ShardState.FROZEN:
+                raise ShardError(f"shard {self.shard_id}: thaw from {self._state}")
+            self._state = ShardState.READY
 
     def close(self) -> None:
         with self._lock:

@@ -111,6 +111,10 @@ def scan_sources(
 
     read_one, remote = _sst_read_fn(store, schema, predicate, projection)
     if remote and len(view.ssts) > 1:
+        # Hint the store's page cache FIRST: while early SSTs decode in
+        # pool slots, later ones stream into the cache in the background
+        # (fetch/decode pipelining on cold scans).
+        store.prefetch([h.path for h in view.ssts])
         # the IO pool, NOT scatter_pool: partition scatter tasks call into
         # this function, and nesting on one bounded pool deadlocks
         from ..utils.runtime import io_pool
@@ -214,6 +218,11 @@ def _limited_append_scan(
         ssts = list(view.ssts)
         for i in range(0, len(ssts), batch):
             chunk = ssts[i:i + batch]
+            if remote:
+                # Stream the NEXT batch into the page cache while this
+                # one decodes; the early stop usually means batches after
+                # that are never read — one batch of lookahead, not all.
+                store.prefetch([h.path for h in ssts[i + batch:i + 2 * batch]])
             if remote and len(chunk) > 1:
                 # io_pool, NOT scatter_pool — same nesting caveat as
                 # scan_sources

@@ -50,7 +50,10 @@ class Procedure:
         return {
             "proc_id": self.proc_id,
             "kind": self.kind,
-            "params": self.params,
+            # Copied: a MemoryKV that stored the live dict by reference
+            # would see post-persist handler mutations "for free" and mask
+            # journaling bugs that a real (serializing) KV exposes.
+            "params": dict(self.params),
             "state": self.state.value,
             "attempts": self.attempts,
             "error": self.error,
@@ -123,6 +126,15 @@ class ProcedureManager:
         p = self.submit(kind, params, defer=False)
         self._execute(p)
         return p
+
+    def checkpoint(self, p: Procedure) -> None:
+        """Persist a procedure's CURRENT params mid-handler — called
+        before side effects that a crash-restart retry must not redo
+        differently (e.g. split_shard journals its chosen table set and
+        allocated shard id before moving anything; the RUNNING-transition
+        persist happened before the handler computed them)."""
+        with self._lock:
+            self._persist(p)
 
     def cancel(self, proc_id: int) -> bool:
         """Pull an unfinished procedure out of the retry queue (an admin

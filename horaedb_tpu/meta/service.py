@@ -88,7 +88,11 @@ class MetaServer:
         # One mutation at a time: the reference gets global DDL ordering
         # from raft; a single-process meta gets it from this lock (it also
         # serializes the shared catalog registry's read-modify-write).
-        self._ddl_lock = threading.Lock()
+        # REENTRANT: admin RPCs hold it around run_sync while the shard-
+        # mutating procedure bodies take it again (they must — the same
+        # bodies also re-execute unlocked-context on the tick thread
+        # after a crash-restart).
+        self._ddl_lock = threading.RLock()
         self._stop = threading.Event()
         self._loop_thread: Optional[threading.Thread] = None
         self.is_leader = election is None  # single-meta mode leads always
@@ -197,10 +201,30 @@ class MetaServer:
         self.procedures.tick()
 
     # ---- procedure bodies ----------------------------------------------
+    # The three shard-mutating procedure bodies take _ddl_lock THEMSELVES
+    # (it's an RLock — the admin RPC paths that already hold it re-enter):
+    # procedures also re-execute on the coordinator tick thread after a
+    # crash-restart, and an unlocked tick retry racing a locked admin op
+    # would snapshot a stale owner and dispatch dual-open orders.
+
     def _run_transfer_shard(self, p: Procedure) -> None:
+        with self._ddl_lock:
+            self._transfer_shard_locked(p)
+
+    def _transfer_shard_locked(self, p: Procedure) -> None:
         shard_id = p.params["shard_id"]
         to_node = p.params["to_node"]
         shard = self.topology.shard(shard_id)
+        if shard is None:
+            return  # retired (merge) between scheduling and execution
+        # A static/unassigned transfer may have queued while a split was
+        # mid-flight (its new shard is visible unassigned between
+        # add_shard and assign_shard; the scheduler tick doesn't hold the
+        # DDL lock). By the time we run, the split assigned it — honoring
+        # the stale decision would yank the shard off the admin's chosen
+        # target. Re-check the premise, not just the lock.
+        if p.params.get("reason", "").startswith("static") and shard.node is not None:
+            return
         old_node = shard.node if shard else None
         lease_id = self.kv.grant_lease(self.lease_ttl_s)
         view = self.topology.assign_shard(shard_id, to_node, lease_id=lease_id)
@@ -221,6 +245,10 @@ class MetaServer:
         (ref: coordinator/procedure/operation/split/split.go — the FSM
         CreateNewShardView -> UpdateShardTables -> OpenNewShard, flattened
         into one idempotent, retryable body)."""
+        with self._ddl_lock:
+            self._split_shard_locked(p)
+
+    def _split_shard_locked(self, p: Procedure) -> None:
         shard_id = p.params["shard_id"]
         source = self.topology.shard(shard_id)
         if source is None:
@@ -243,10 +271,10 @@ class MetaServer:
             if missing:
                 raise RuntimeError(f"tables not on shard {shard_id}: {missing}")
         else:
-            # Default: the second half (by name) of the shard's tables.
-            # PERSISTED into params on the first attempt — a retry after a
-            # partial move must not recompute from the shard's remaining
-            # tables (that would keep halving until the shard is empty).
+            # Default: the second half (by name) of the shard's tables —
+            # journaled below before anything moves; a crash-restart retry
+            # must not recompute from the shard's REMAINING tables (that
+            # would keep halving until the shard is empty).
             names = sorted(t.name for t in tables)[len(tables) // 2:]
             p.params["table_names"] = names
         if not names:
@@ -256,6 +284,12 @@ class MetaServer:
         if new_sid is None or self.topology.shard(new_sid) is None:
             new_sid = self.topology.add_shard().shard_id
             p.params["new_shard_id"] = new_sid
+        # Journal the decisions BEFORE the side effects: the RUNNING-
+        # transition persist happened before the handler computed them,
+        # and a kill -9 between the table moves and the next transition
+        # would otherwise resume with bare {shard_id} params and re-halve
+        # into a second new shard.
+        self.procedures.checkpoint(p)
         target = p.params.get("target_node") or source.node
         for name in names:
             self.topology.move_table_to_shard(name, new_sid)
@@ -278,6 +312,10 @@ class MetaServer:
     def _run_merge_shards(self, p: Procedure) -> None:
         """Fold one shard's tables into another and retire it (the inverse
         of split; ref: procedure.go Kind Merge)."""
+        with self._ddl_lock:
+            self._merge_shards_locked(p)
+
+    def _merge_shards_locked(self, p: Procedure) -> None:
         shard_id = p.params["shard_id"]
         into_id = p.params["into_shard_id"]
         if shard_id == into_id:
@@ -519,6 +557,12 @@ class MetaServer:
         with self._ddl_lock:
             if int(shard_id) == int(into_shard_id):
                 raise RuntimeError("cannot merge a shard into itself")
+            # The victim check lives HERE, not in the procedure body: a
+            # missing victim there means "retry after completion" and
+            # finishes silently — which would turn a typo'd shard id into
+            # a 200.
+            if self.topology.shard(int(shard_id)) is None:
+                raise RuntimeError(f"shard {shard_id} does not exist")
             if self.topology.shard(int(into_shard_id)) is None:
                 raise RuntimeError(f"target shard {into_shard_id} does not exist")
             self._run_admin_proc(
@@ -576,12 +620,19 @@ class MetaServer:
                 moves = moves[: int(max_moves)]
             done = 0
             for sid, target in moves:
-                p = self.procedures.run_sync(
-                    "transfer_shard",
-                    {"shard_id": sid, "to_node": target, "reason": "scatter"},
-                )
-                if p.state.value == "finished":
+                try:
+                    # _run_admin_proc, not bare run_sync: a failed move
+                    # must CANCEL its background retry (which would keep
+                    # re-assigning toward the originally chosen — possibly
+                    # now-dead — target) and just count as not-done; the
+                    # admin re-issues scatter.
+                    self._run_admin_proc(
+                        "transfer_shard",
+                        {"shard_id": sid, "to_node": target, "reason": "scatter"},
+                    )
                     done += 1
+                except RuntimeError:
+                    continue
             return {"moves": done, "planned": len(moves)}
 
     def handle_route(self, table: str) -> Optional[dict]:
@@ -675,12 +726,15 @@ def create_meta_app(server: MetaServer) -> web.Application:
         positional required fields + optional kwargs -> executor."""
 
         async def run(request: web.Request) -> web.Response:
-            body = await request.json()
             try:
+                body = await request.json()
                 args = [body[k] for k in required]
+                kwargs = {k: body.get(k, d) for k, d in optional.items()}
             except KeyError as e:
                 return web.json_response({"error": f"missing {e}"}, status=400)
-            kwargs = {k: body.get(k, d) for k, d in optional.items()}
+            except Exception as e:
+                # malformed JSON, non-dict body, ...: the client's fault
+                return web.json_response({"error": f"bad body: {e}"}, status=400)
             import asyncio
 
             try:
@@ -772,6 +826,11 @@ def main() -> None:
         "--ha-dir", default=None,
         help="SHARED dir for multi-meta HA: leader lock + journal live here",
     )
+    p.add_argument(
+        "--election", default=None,
+        help="leader-lease backend override: etcd://HOST:PORT[/KEY] for an "
+             "external KV, or a lock-file path (default: <ha-dir>/leader.lock)",
+    )
     p.add_argument("--advertise", default=None, help="endpoint peers reach us at")
     p.add_argument(
         "--election-ttl", type=float, default=10.0,
@@ -785,16 +844,15 @@ def main() -> None:
     args = p.parse_args()
     logging.basicConfig(level=args.log_level.upper())
     if args.ha_dir:
-        from .election import FileLease
+        from .lease import make_lease
 
         advertise = args.advertise or f"{args.host}:{args.port}"
+        target = args.election or f"{args.ha_dir}/leader.lock"
         server = MetaServer(
             num_shards=args.num_shards,
             lease_ttl_s=args.lease_ttl,
             heartbeat_timeout_s=args.heartbeat_timeout,
-            election=FileLease(
-                f"{args.ha_dir}/leader.lock", advertise, ttl_s=args.election_ttl
-            ),
+            election=make_lease(target, advertise, ttl_s=args.election_ttl),
             kv_factory=lambda: FileKV(f"{args.ha_dir}/meta.kv"),
         )
     else:

@@ -20,7 +20,7 @@ import os
 import threading
 from abc import ABC, abstractmethod
 from collections import OrderedDict
-from typing import Iterator, Optional
+from typing import Iterator, Optional, Sequence
 
 
 class ObjectStore(ABC):
@@ -43,6 +43,13 @@ class ObjectStore(ABC):
 
     @abstractmethod
     def list(self, prefix: str = "") -> Iterator[str]: ...
+
+    def prefetch(self, paths: Sequence[str]) -> None:
+        """Hint: these objects will be read soon — start pulling them into
+        whatever cache this store has, in the background, without blocking
+        the caller. Default: no cache, nothing to do (the prefetchable-
+        stream analog, ref: analytic_engine/src/prefetchable_stream.rs +
+        num_streams_to_prefetch, lib.rs:109)."""
 
     def exists(self, path: str) -> bool:
         try:
@@ -181,6 +188,9 @@ class DiskCacheStore(ObjectStore):
         self._inflight: dict[str, threading.Event] = {}
         # object sizes cached too: a warm read must not pay a remote HEAD
         self._sizes: dict[str, int] = {}
+        # lazy pools: most stores never see a cold multi-page read
+        self._pool = None
+        self._bg_pool = None
         self.hits = 0
         self.misses = 0
         self._load_index()
@@ -277,6 +287,13 @@ class DiskCacheStore(ObjectStore):
                     break  # we are the leader
             ev.wait(timeout=60)
         try:
+            # Double-check as leader: our first cache miss may predate a
+            # previous leader's write (we raced past its event) — a
+            # redundant remote fetch is wasted inner-store traffic.
+            cached = self._read_cached(name)
+            if cached is not None:
+                self.hits += 1
+                return cached
             self.misses += 1
             start = page * self.page_size
             end = min(start + self.page_size, obj_size)
@@ -290,19 +307,91 @@ class DiskCacheStore(ObjectStore):
             my_event.set()
 
     # ---- ObjectStore -----------------------------------------------------
+    def _fetch_pool(self, background: bool = False):
+        """Store-OWNED pools for cold-page fan-out. Deliberately not the
+        shared io_pool: get_range is often called FROM io_pool tasks
+        (scan_sources overlaps SST reads there), and a bounded pool whose
+        tasks submit to itself and wait deadlocks. Nothing running on
+        these pools ever re-enters them — page fetches call
+        ``inner.get_range`` directly.
+
+        TWO pools, not one: prefetch() queues whole-object pulls on the
+        BACKGROUND pool only, so a foreground read's cold pages never
+        wait behind the hint backlog (the priority inversion a shared
+        FIFO queue would reintroduce). The inflight leader/follower
+        protocol dedups fetches across both pools."""
+        with self._lock:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                n = int(os.environ.get("HORAEDB_CACHE_FETCH_THREADS", "8"))
+                self._pool = ThreadPoolExecutor(
+                    max_workers=n, thread_name_prefix="diskcache-fetch",
+                )
+                self._bg_pool = ThreadPoolExecutor(
+                    max_workers=max(1, n // 2),
+                    thread_name_prefix="diskcache-prefetch",
+                )
+            return self._bg_pool if background else self._pool
+
     def get_range(self, path: str, start: int, end: int) -> bytes:
         size = self.head(path)
         end = min(end, size)
         if start >= end:
             return b""
         first, last = start // self.page_size, (end - 1) // self.page_size
-        parts = [self._fetch_page(path, p, size) for p in range(first, last + 1)]
-        blob = b"".join(parts)
+        pages = range(first, last + 1)
+        # Warm pages are served INLINE from disk — never through the
+        # fetch pool, whose FIFO queue may hold a backlog of whole-object
+        # prefetch pulls that a foreground read must not wait behind.
+        byp: dict[int, bytes] = {}
+        cold: list[int] = []
+        for pg in pages:
+            cached = self._read_cached(self._cache_name(path, pg))
+            if cached is not None:
+                self.hits += 1
+                byp[pg] = cached
+            else:
+                cold.append(pg)
+        if len(cold) > 1:
+            # Cold pages fan out: a 64MB object at 1MB pages would
+            # otherwise serialize 64 round trips to the inner store
+            # (first-read prefetch pipeline); the inflight leader/follower
+            # protocol dedups against concurrent readers and prefetchers.
+            for pg, payload in zip(
+                cold,
+                self._fetch_pool().map(
+                    lambda p: self._fetch_page(path, p, size), cold
+                ),
+            ):
+                byp[pg] = payload
+        else:
+            for pg in cold:
+                byp[pg] = self._fetch_page(path, pg, size)
+        blob = b"".join(byp[pg] for pg in pages)
         base = first * self.page_size
         return blob[start - base : end - base]
 
     def get(self, path: str) -> bytes:
         return self.get_range(path, 0, self.head(path))
+
+    def prefetch(self, paths: Sequence[str]) -> None:
+        """Queue background whole-object pulls into the page cache; the
+        decode loop that follows finds pages warm instead of paying one
+        round trip per page. Bounded by the fetch pool's worker count and
+        the cache's LRU capacity; failures are swallowed (a prefetch is a
+        hint, the read path re-fetches on miss)."""
+
+        def pull(path: str) -> None:
+            try:
+                size = self.head(path)
+                for page in range((size + self.page_size - 1) // self.page_size):
+                    self._fetch_page(path, page, size)
+            except Exception:
+                pass
+
+        for p in paths:
+            self._fetch_pool(background=True).submit(pull, p)
 
     def head(self, path: str) -> int:
         with self._lock:
@@ -401,6 +490,12 @@ class MemCacheStore(ObjectStore):
 
     def head(self, path: str) -> int:
         return self.inner.head(path)
+
+    def prefetch(self, paths: Sequence[str]) -> None:
+        # Forward to the inner (disk) cache: pulling whole objects into
+        # THIS cache on a hint could evict the working set from RAM; the
+        # page cache below is disk-backed and LRU-bounded.
+        self.inner.prefetch(paths)
 
     def list(self, prefix: str = "") -> Iterator[str]:
         return self.inner.list(prefix)

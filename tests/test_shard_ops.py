@@ -282,6 +282,78 @@ class TestSplitMergeHandlers:
         assert moved == chosen and moved < src_tables
 
 
+class TestSplitCrashResume:
+    def test_hard_crash_mid_split_resumes_with_journaled_choices(
+        self, tmp_path, monkeypatch
+    ):
+        """kill -9 simulation over a REAL (serializing) KV: a new
+        MetaServer process resumes the unfinished split and must reuse
+        the journaled table set + shard id — not re-halve the remaining
+        tables into a second new shard (the bug a by-reference MemoryKV
+        hides)."""
+        from horaedb_tpu.meta.kv import FileKV
+
+        next_id = iter(range(1, 100))
+        monkeypatch.setattr(
+            meta_service, "_post",
+            lambda ep, path, payload, timeout=5.0: {
+                "table_id": next(next_id), "sub_table_ids": [],
+            },
+        )
+        kv_path = str(tmp_path / "meta.kv")
+        server = MetaServer(FileKV(kv_path), num_shards=2)
+        server.topology.register_node("127.0.0.1:11")
+        server.tick()
+        for i in range(4):
+            server.handle_create_table(f"t{i}", f"CREATE TABLE t{i} (...)")
+        src = max(server.topology.shards(), key=lambda s: len(s.table_ids))
+        src_tables = {t.name for t in server.topology.tables_of_shard(src.shard_id)}
+
+        # Crash AFTER the moves, before any further persist: the handler
+        # raises SystemExit-like error right at assign time, and we then
+        # abandon this server instance entirely (no cancel, no retry).
+        def crash(shard_id, node, lease_id=0):
+            raise RuntimeError("kill -9")
+
+        real_assign = server.topology.assign_shard
+        monkeypatch.setattr(server.topology, "assign_shard", crash)
+        proc = server.procedures.submit("split_shard", {"shard_id": src.shard_id})
+        server.procedures.tick()
+        assert proc.state.value == "running"
+        chosen = set(proc.params["table_names"])
+        new_sid = proc.params["new_shard_id"]
+        monkeypatch.setattr(server.topology, "assign_shard", real_assign)
+        server.kv.close()
+
+        # "Restart": fresh server over the same journal resumes the
+        # procedure on its first ticks.
+        server2 = MetaServer(FileKV(kv_path), num_shards=2)
+        server2.topology.register_node("127.0.0.1:11")
+        import time as _t
+
+        deadline = _t.monotonic() + 10
+        while _t.monotonic() < deadline:
+            server2.tick()
+            p2 = next(
+                p for p in server2.procedures.list() if p.kind == "split_shard"
+            )
+            if p2.state.value == "finished":
+                break
+            _t.sleep(0.1)
+        assert p2.state.value == "finished", p2.error
+        # Journaled choices reused: same shard id, same table set, and no
+        # third shard ever allocated.
+        assert p2.params["new_shard_id"] == new_sid
+        moved = {t.name for t in server2.topology.tables_of_shard(new_sid)}
+        assert moved == chosen
+        remaining = {
+            t.name for t in server2.topology.tables_of_shard(src.shard_id)
+        }
+        assert remaining == src_tables - chosen and remaining
+        assert len(server2.topology.shards()) == 3  # 2 initial + 1 split
+        server2.kv.close()
+
+
 class TestShardOpsE2E:
     def test_split_migrate_merge_lifecycle(self, cluster):
         meta_port, node_ports, procs, spawn_node = cluster
