@@ -105,6 +105,18 @@ class EtcdLease:
     # ---- LeaderLease -----------------------------------------------------
     def try_acquire(self) -> bool:
         try:
+            if self._lease_id is not None:
+                # A follower never keepalives the lease it granted for a
+                # LOST campaign, so etcd expires it; a txn quoting a dead
+                # lease id is rejected ("requested lease not found") and
+                # the node could never campaign again. Prove liveness
+                # first; grant fresh when it lapsed.
+                alive = self._post(
+                    "/v3/lease/keepalive", {"ID": self._lease_id}
+                )
+                ttl = (alive.get("result") or {}).get("TTL")
+                if ttl is None or int(ttl) <= 0:
+                    self._lease_id = None
             if self._lease_id is None:
                 out = self._post("/v3/lease/grant", {"TTL": int(self.ttl_s)})
                 self._lease_id = out["ID"]

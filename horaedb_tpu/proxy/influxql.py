@@ -1,16 +1,37 @@
 """InfluxQL query translation
 (ref: src/query_frontend/src/influxql/planner.rs — the reference plans
-InfluxQL through forked IOx crates; here the SELECT subset translates onto
-the existing SQL pipeline, the same trick promql.py uses).
+InfluxQL through forked IOx crates (Cargo.toml:127-130); here the
+language translates onto the existing SQL pipeline, the same trick
+promql.py uses, with a host aggregation path for the selector/statistic
+functions SQL doesn't model).
 
-Supported subset (mirrors the reference's influxql corpus,
-integration_tests/cases/env/local/influxql/basic.sql):
+Supported surface (mirrors the reference's influxql corpus,
+integration_tests/cases/env/local/influxql/basic.sql, plus the planner
+breadth real v1 clients — Grafana's InfluxQL datasource above all —
+exercise):
 
-    SELECT */cols/agg(col) FROM "m"
-        [WHERE tag = 'v' AND time <op> <lit>[ms|s|u|ns]]
-        [GROUP BY tag, ..., time(<dur>)] [FILL(<num>)]
-        [ORDER BY time [DESC]] [LIMIT n]
-    SHOW MEASUREMENTS
+    SELECT <items> FROM "m"
+        [WHERE <cond> {AND|OR <cond>} with parentheses,
+         tag = 'v', tag =~ /re/, tag !~ /re/,
+         time <op> <lit>[ms|s|u|ns] | 'RFC3339' | now() [+|- <dur>]]
+        [GROUP BY tag, ..., time(<dur>)]
+        [FILL(<num> | null | none | previous | linear)]
+        [ORDER BY time [DESC]] [LIMIT n] [OFFSET n] [SLIMIT n] [SOFFSET n]
+
+    items: field | * | count/sum/min/max/mean(field)
+         | first/last/median/spread/stddev/distinct(field)
+         | percentile(field, N)
+         | derivative(<agg>(field)[, <dur>]) | non_negative_derivative
+         | difference(<agg>(field)) | moving_average(<agg>(field), N)
+
+    SHOW MEASUREMENTS | DATABASES | RETENTION POLICIES
+    SHOW TAG KEYS [FROM m] | TAG VALUES [FROM m] WITH KEY = k
+    SHOW FIELD KEYS [FROM m]
+
+Multiple ';'-separated statements run in order, one result entry each
+(the v1 wire contract). Not yet modeled: InfluxQL subqueries
+(SELECT FROM (SELECT ...)) and mixed raw+aggregate projections — both
+rejected with clear errors.
 
 Results render in the InfluxDB v1 HTTP shape: one series per group-by
 tag-set with a ``tags`` object, ``time`` first in columns.
@@ -18,9 +39,13 @@ tag-set with a ``tags`` object, ``time`` first in columns.
 
 from __future__ import annotations
 
+import math
 import re
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+import numpy as np
 
 from ..engine.options import parse_duration_ms
 
@@ -29,15 +54,20 @@ class InfluxQLError(ValueError):
     pass
 
 
-AGG_FUNCS = {"count", "sum", "min", "max", "avg", "mean"}
+SIMPLE_AGGS = {"count", "sum", "min", "max", "avg", "mean"}
+HOST_AGGS = {"first", "last", "median", "spread", "stddev", "distinct",
+             "percentile", "mode"}
+TRANSFORMS = {"derivative", "non_negative_derivative", "difference",
+              "moving_average"}
 
 _TOKEN = re.compile(
     r"""\s*(?:
       (?P<dstr>"(?:[^"\\]|\\.)*")
     | (?P<sstr>'(?:[^'\\]|\\.)*')
-    | (?P<num>-?\d+(?:\.\d+)?(?:ms|s|u|ns)?)
+    | (?P<regex>/(?:[^/\\]|\\.)+/)
+    | (?P<num>-?\d+(?:\.\d+)?(?:ms|s|u|ns|m|h|d|w)?)
     | (?P<name>[A-Za-z_][A-Za-z0-9_\.]*)
-    | (?P<op><=|>=|!=|<>|=~|!~|[=<>(),\*])
+    | (?P<op><=|>=|!=|<>|=~|!~|[=<>(),\*;+-])
     )""",
     re.VERBOSE,
 )
@@ -51,26 +81,54 @@ def _tokenize(q: str) -> list[str]:
             if q[i:].strip() in ("", ";"):
                 break
             raise InfluxQLError(f"cannot tokenize at: {q[i:i+20]!r}")
-        out.append(m.group(0).strip())
+        tok = m.group(0).strip()
+        # '/' only opens a regex after a matcher op; elsewhere it can't
+        # appear (no arithmetic in this subset), so the simple rule holds.
+        out.append(tok)
         i = m.end()
     return out
 
 
+# item shapes:
+#   ("star",) | ("col", name) | ("agg", func, col)
+#   ("agg2", func, col, param)              percentile(col, N)
+#   ("transform", tname, inner_item, param) derivative(mean(x), 1s)
 @dataclass
 class InfluxSelect:
     measurement: str
-    items: list  # ("star",) | ("col", name) | ("agg", func, col)
-    conds: list = field(default_factory=list)  # (col, op, value) 'time' = ts
+    items: list
+    # cond tree: ("and"|"or", [children]) | ("cmp", col, op, value)
+    #          | ("regex", col, "=~"|"!~", pattern)
+    where: Optional[tuple] = None
     group_tags: list = field(default_factory=list)
     group_time_ms: Optional[int] = None
-    fill: Optional[float] = None
+    fill: Any = None  # None | float | "previous" | "linear"
     order_desc: bool = False
     limit: Optional[int] = None
+    offset: Optional[int] = None
+    slimit: Optional[int] = None
+    soffset: Optional[int] = None
+
+    def time_conds(self) -> list[tuple]:
+        out = []
+
+        def walk(node):
+            if node is None:
+                return
+            kind = node[0]
+            if kind in ("and", "or"):
+                for c in node[1]:
+                    walk(c)
+            elif kind == "cmp" and node[1].lower() == "time":
+                out.append((node[1], node[2], node[3]))
+
+        walk(self.where)
+        return out
 
 
 class _Parser:
-    def __init__(self, q: str) -> None:
-        self.toks = _tokenize(q)
+    def __init__(self, toks: list[str]) -> None:
+        self.toks = toks
         self.i = 0
 
     def peek(self) -> Optional[str]:
@@ -97,40 +155,30 @@ class _Parser:
     # ---- entry ----------------------------------------------------------
     def parse(self):
         if self.eat("show"):
-            if self.eat("measurements"):
-                return "show_measurements"
-            if self.eat("tag"):
-                if self.eat("keys"):
-                    m = _ident(self.next()) if self.eat("from") else None
-                    return ("show_tag_keys", m)
-                self.expect("values")
-                m = _ident(self.next()) if self.eat("from") else None
-                self.expect("with")
-                self.expect("key")
-                self.expect("=")
-                return ("show_tag_values", m, _ident(self.next()))
-            if self.eat("field"):
-                self.expect("keys")
-                m = _ident(self.next()) if self.eat("from") else None
-                return ("show_field_keys", m)
-            raise InfluxQLError(
-                "SHOW supports MEASUREMENTS, TAG KEYS, TAG VALUES, FIELD KEYS"
-            )
+            return self._show()
         self.expect("select")
         items = self._select_items()
         self.expect("from")
-        measurement = _ident(self.next())
-        sel = InfluxSelect(measurement, items)
+        m = self.next()
+        if m == "(":
+            raise InfluxQLError(
+                "InfluxQL subqueries (SELECT FROM (SELECT ...)) are not "
+                "supported yet; flatten the query or use SQL"
+            )
+        sel = InfluxSelect(_ident(m), items)
         if self.eat("where"):
-            self._where(sel)
+            sel.where = self._cond_or()
         if self.eat("group"):
             self.expect("by")
             self._group_by(sel)
         if self.eat("fill"):
             self.expect("(")
             tok = self.next()
-            if tok.lower() in ("null", "none"):
+            low = tok.lower()
+            if low in ("null", "none"):
                 sel.fill = None
+            elif low in ("previous", "linear"):
+                sel.fill = low
             else:
                 sel.fill = float(_strip_unit(tok)[0])
             self.expect(")")
@@ -144,54 +192,172 @@ class _Parser:
                 self.eat("asc")
         if self.eat("limit"):
             sel.limit = int(self.next())
+        if self.eat("offset"):
+            sel.offset = int(self.next())
+        if self.eat("slimit"):
+            sel.slimit = int(self.next())
+        if self.eat("soffset"):
+            sel.soffset = int(self.next())
         if self.peek() is not None:
             raise InfluxQLError(f"unexpected trailing token {self.peek()!r}")
         return sel
 
+    def _show(self):
+        if self.eat("measurements"):
+            return ("show_measurements",)
+        if self.eat("databases"):
+            return ("show_databases",)
+        if self.eat("retention"):
+            self.expect("policies")
+            if self.eat("on"):
+                self.next()
+            return ("show_retention_policies",)
+        if self.eat("tag"):
+            if self.eat("keys"):
+                m = _ident(self.next()) if self.eat("from") else None
+                return ("show_tag_keys", m)
+            self.expect("values")
+            m = _ident(self.next()) if self.eat("from") else None
+            self.expect("with")
+            self.expect("key")
+            self.expect("=")
+            return ("show_tag_values", m, _ident(self.next()))
+        if self.eat("field"):
+            self.expect("keys")
+            m = _ident(self.next()) if self.eat("from") else None
+            return ("show_field_keys", m)
+        raise InfluxQLError(
+            "SHOW supports MEASUREMENTS, DATABASES, RETENTION POLICIES, "
+            "TAG KEYS, TAG VALUES, FIELD KEYS"
+        )
+
+    # ---- projections ----------------------------------------------------
     def _select_items(self) -> list:
         items = []
         while True:
-            t = self.next()
-            if t == "*":
-                items.append(("star",))
-            elif t.lower() in AGG_FUNCS and self.peek() == "(":
-                self.next()
-                arg = self.next()
-                self.expect(")")
-                func = "avg" if t.lower() == "mean" else t.lower()
-                items.append(("agg", func, _ident(arg) if arg != "*" else None))
-            else:
-                items.append(("col", _ident(t)))
+            items.append(self._one_item())
             if not self.eat(","):
                 return items
 
-    def _where(self, sel: InfluxSelect) -> None:
-        while True:
-            col = _ident(self.next())
-            op = self.next()
-            if op in ("=~", "!~"):
-                raise InfluxQLError("regex matchers not supported yet")
-            val_tok = self.next()
-            value, unit_ms = _strip_unit(val_tok)
-            if col.lower() == "time":
-                # bare influx time literals are NANOSECONDS
-                scale = unit_ms if unit_ms is not None else 1e-6
-                value = int(float(value) * scale)
-            sel.conds.append((col, "!=" if op == "<>" else op, value))
-            if not self.eat("and"):
-                return
+    def _one_item(self):
+        t = self.next()
+        low = t.lower()
+        if t == "*":
+            return ("star",)
+        if low in TRANSFORMS and self.peek() == "(":
+            self.next()
+            inner = self._one_item()
+            if inner[0] not in ("agg", "agg2") or inner[1] == "distinct":
+                raise InfluxQLError(
+                    f"{low}() takes a scalar aggregate argument, e.g. "
+                    f"{low}(mean(field))"
+                )
+            param = None
+            if self.eat(","):
+                if low == "moving_average":
+                    param = int(self.next())
+                else:
+                    dur = ""
+                    while self.peek() not in (")", None):
+                        dur += self.next()
+                    param = parse_duration_ms(dur)
+            self.expect(")")
+            return ("transform", low, inner, param)
+        if (low in SIMPLE_AGGS or low in HOST_AGGS) and self.peek() == "(":
+            self.next()
+            arg = self.next()
+            func = "avg" if low == "mean" else low
+            if low == "percentile":
+                self.expect(",")
+                n = float(_strip_unit(self.next())[0])
+                self.expect(")")
+                return ("agg2", "percentile", _ident(arg), n)
+            self.expect(")")
+            return ("agg", func, _ident(arg) if arg != "*" else None)
+        return ("col", _ident(t))
+
+    # ---- WHERE ----------------------------------------------------------
+    def _cond_or(self):
+        left = self._cond_and()
+        terms = [left]
+        while self.eat("or"):
+            terms.append(self._cond_and())
+        return terms[0] if len(terms) == 1 else ("or", terms)
+
+    def _cond_and(self):
+        left = self._cond_atom()
+        terms = [left]
+        while self.eat("and"):
+            terms.append(self._cond_atom())
+        return terms[0] if len(terms) == 1 else ("and", terms)
+
+    def _cond_atom(self):
+        if self.eat("("):
+            node = self._cond_or()
+            self.expect(")")
+            return node
+        col = _ident(self.next())
+        op = self.next()
+        if op in ("=~", "!~"):
+            pat = self.next()
+            if not (pat.startswith("/") and pat.endswith("/")):
+                raise InfluxQLError(f"{op} needs a /regex/, found {pat!r}")
+            return ("regex", col, op, pat[1:-1].replace("\\/", "/"))
+        if col.lower() == "time":
+            return ("cmp", col, "!=" if op == "<>" else op,
+                    self._time_value())
+        val_tok = self.next()
+        value, _unit = _strip_unit(val_tok)
+        return ("cmp", col, "!=" if op == "<>" else op, value)
+
+    def _time_value(self) -> int:
+        """Epoch-MILLISECOND time bound from: a literal (bare = ns, or
+        unit-suffixed), an RFC3339 string, or now() [+|- duration]."""
+        tok = self.next()
+        if tok.lower() == "now" and self.peek() == "(":
+            self.next()
+            self.expect(")")
+            base = int(_time.time() * 1000)
+            nxt = self.peek()
+            sign, dur = None, ""
+            if nxt in ("+", "-"):
+                sign = 1 if self.next() == "+" else -1
+            elif nxt is not None and re.fullmatch(
+                r"[+-]\d+(?:\.\d+)?(?:ns|u|ms|s|m|h|d|w)?", nxt
+            ):
+                # 'now()-1h' fuses into one '-1h' token (the numeric
+                # pattern owns a leading sign); split it back apart —
+                # real v1 clients emit the unspaced form.
+                self.next()
+                sign = 1 if nxt[0] == "+" else -1
+                dur = nxt[1:]
+            if sign is not None:
+                # duration tokens run until a clause keyword or ')' ends
+                while self.peek() is not None and re.fullmatch(
+                    r"\d+(?:\.\d+)?(?:ns|u|ms|s|m|h|d|w)?|ns|u|ms|s|m|h|d|w",
+                    self.peek(),
+                ):
+                    dur += self.next()
+                base += sign * parse_duration_ms(dur)
+            return base
+        if tok.startswith(("'", '"')):
+            return _rfc3339_ms(_ident(tok))
+        value, unit_ms = _strip_unit(tok)
+        scale = unit_ms if unit_ms is not None else 1e-6  # bare = ns
+        return int(float(value) * scale)
 
     def _group_by(self, sel: InfluxSelect) -> None:
         while True:
             t = self.next()
             if t.lower() == "time" and self.peek() == "(":
                 self.next()
-                # durations like 5m tokenize as "5","m" — join until ")"
                 dur = ""
                 while self.peek() not in (")", None):
                     dur += self.next()
                 sel.group_time_ms = parse_duration_ms(dur)
                 self.expect(")")
+            elif t == "*":
+                sel.group_tags.append("*")
             else:
                 sel.group_tags.append(_ident(t))
             if not self.eat(","):
@@ -206,29 +372,127 @@ def _ident(tok: str) -> str:
     return tok
 
 
-_UNIT_MS = {"ms": 1.0, "s": 1000.0, "u": 1e-3, "ns": 1e-6}
+_UNIT_MS = {"ms": 1.0, "s": 1000.0, "u": 1e-3, "ns": 1e-6,
+            "m": 60_000.0, "h": 3_600_000.0, "d": 86_400_000.0,
+            "w": 604_800_000.0}
 
 
 def _strip_unit(tok: str):
     """-> (value, ms-per-unit or None). Strings come back unquoted."""
     if tok.startswith(("'", '"')):
         return _ident(tok), None
-    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(ms|s|u|ns)?", tok)
+    m = re.fullmatch(r"(-?\d+(?:\.\d+)?)(ms|s|u|ns|m|h|d|w)?", tok)
     if m is None:
         return tok, None
     num = float(m.group(1)) if "." in m.group(1) else int(m.group(1))
     return num, _UNIT_MS.get(m.group(2)) if m.group(2) else None
 
 
+def _rfc3339_ms(s: str) -> int:
+    """'2024-01-02T03:04:05Z' (and date-only / fractional forms) -> ms."""
+    from datetime import datetime, timezone
+
+    txt = s.strip().replace("Z", "+00:00")
+    for fmt in (None, "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            if fmt is None:
+                dt = datetime.fromisoformat(txt)
+            else:
+                dt = datetime.strptime(txt, fmt)
+            if dt.tzinfo is None:
+                dt = dt.replace(tzinfo=timezone.utc)
+            return int(dt.timestamp() * 1000)
+        except ValueError:
+            continue
+    raise InfluxQLError(f"cannot parse time literal {s!r}")
+
+
+def _split_statements(q: str) -> list[list[str]]:
+    toks = _tokenize(q)
+    stmts, cur = [], []
+    for t in toks:
+        if t == ";":
+            if cur:
+                stmts.append(cur)
+            cur = []
+        else:
+            cur.append(t)
+    if cur:
+        stmts.append(cur)
+    return stmts
+
+
 def parse_influxql(q: str):
-    return _Parser(q).parse()
+    stmts = _split_statements(q)
+    if not stmts:
+        raise InfluxQLError("empty query")
+    if len(stmts) > 1:
+        raise InfluxQLError("use evaluate() for multi-statement queries")
+    return _Parser(stmts[0]).parse()
 
 
 # ---- translation onto the SQL pipeline -----------------------------------
 
 
-def to_sql(sel: InfluxSelect, schema) -> str:
-    """Rewrite the influx statement as horaedb_tpu SQL."""
+def _needs_host_path(sel: InfluxSelect) -> bool:
+    return any(it[0] in ("agg2", "transform")
+               or (it[0] == "agg" and it[1] in HOST_AGGS)
+               for it in sel.items)
+
+
+def _resolve_regex(conn, sel: InfluxSelect, schema) -> Optional[tuple]:
+    """Rewrite regex matcher nodes into IN-list compare nodes by matching
+    against the tag's distinct values — the scan then gets an exact,
+    pushdown-friendly predicate (same strategy the reference's planner
+    uses for anchored regexes)."""
+
+    def walk(node):
+        if node is None:
+            return None
+        kind = node[0]
+        if kind in ("and", "or"):
+            return (kind, [walk(c) for c in node[1]])
+        if kind != "regex":
+            return node
+        _, col, op, pattern = node
+        try:
+            rx = re.compile(pattern)
+        except re.error as e:
+            raise InfluxQLError(f"bad regex /{pattern}/: {e}")
+        out = conn.execute(
+            f"SELECT DISTINCT `{col}` FROM `{sel.measurement}`"
+        ).to_pylist()
+        vals = [r[col] for r in out if r[col] is not None]
+        keep = [v for v in vals if bool(rx.search(str(v))) == (op == "=~")]
+        return ("in", col, keep)
+
+    return walk(sel.where)
+
+
+def _cond_sql(node, ts: str) -> str:
+    from .promql import sql_str_literal
+
+    kind = node[0]
+    if kind in ("and", "or"):
+        j = f" {kind.upper()} "
+        return "(" + j.join(_cond_sql(c, ts) for c in node[1]) + ")"
+    if kind == "in":
+        _, col, vals = node
+        if not vals:
+            return "1 = 0"  # regex matched nothing: empty result, not all
+        lits = ", ".join(
+            sql_str_literal(v) if isinstance(v, str) else repr(v) for v in vals
+        )
+        return f"`{col}` IN ({lits})"
+    _, col, op, value = node
+    name = ts if col.lower() == "time" else col
+    lit = sql_str_literal(value) if isinstance(value, str) else repr(value)
+    return f"`{name}` {op} {lit}"
+
+
+def to_sql(sel: InfluxSelect, schema, where: Optional[tuple] = None) -> str:
+    """Rewrite the influx statement as horaedb_tpu SQL (the simple-agg /
+    raw path; host-path items never reach here)."""
     ts = schema.timestamp_name
     cols: list[str] = []
     has_agg = any(it[0] == "agg" for it in sel.items)
@@ -236,7 +500,7 @@ def to_sql(sel: InfluxSelect, schema) -> str:
         for it in sel.items:
             if it[0] != "agg":
                 raise InfluxQLError("mixing aggregates and raw columns")
-        for tag in sel.group_tags:
+        for tag in _expand_tags(sel, schema):
             cols.append(f"`{tag}`")
         if sel.group_time_ms:
             cols.append(f"time_bucket(`{ts}`, '{sel.group_time_ms}ms') AS time")
@@ -251,45 +515,277 @@ def to_sql(sel: InfluxSelect, schema) -> str:
                 cols.append("*")
             else:
                 cols.append(f"`{it[1]}`")
-    from .promql import sql_str_literal
-
-    where = []
-    for col, op, value in sel.conds:
-        name = ts if col.lower() == "time" else col
-        lit = sql_str_literal(value) if isinstance(value, str) else repr(value)
-        where.append(f"`{name}` {op} {lit}")
     sql = f"SELECT {', '.join(cols)} FROM `{sel.measurement}`"
-    if where:
-        sql += " WHERE " + " AND ".join(where)
-    groups = [f"`{t}`" for t in sel.group_tags]
+    where = where if where is not None else sel.where
+    if where is not None:
+        sql += " WHERE " + _cond_sql(where, ts)
+    groups = [f"`{t}`" for t in _expand_tags(sel, schema)]
     if has_agg and sel.group_time_ms:
         groups.append(f"time_bucket(`{ts}`, '{sel.group_time_ms}ms')")
     if groups and has_agg:
         sql += " GROUP BY " + ", ".join(groups)
     if not has_agg:
         sql += f" ORDER BY `{ts}`" + (" DESC" if sel.order_desc else "")
-    if sel.limit is not None:
-        sql += f" LIMIT {sel.limit}"
+        if sel.limit is not None:
+            # The SQL layer has no OFFSET clause: over-fetch by the
+            # offset and let the render slice it off host-side.
+            sql += f" LIMIT {sel.limit + (sel.offset or 0)}"
     return sql
 
 
+def _expand_tags(sel: InfluxSelect, schema) -> list[str]:
+    """GROUP BY * means every tag column."""
+    out = []
+    for t in sel.group_tags:
+        if t == "*":
+            out.extend(n for n in schema.tag_names if n not in out)
+        elif t not in out:
+            out.append(t)
+    return out
+
+
+# ---- host aggregation path ------------------------------------------------
+
+
+def _item_label(it) -> str:
+    if it[0] == "agg":
+        return "mean" if it[1] == "avg" else it[1]
+    if it[0] == "agg2":
+        return it[1]
+    if it[0] == "transform":
+        return it[1]
+    return it[1]
+
+
+def _host_agg(func: str, vals: np.ndarray, ts: np.ndarray, param=None):
+    if len(vals) == 0:
+        return None
+    if func == "count":
+        return int(len(vals))
+    if func == "sum":
+        return float(np.sum(vals))
+    if func == "min":
+        return float(np.min(vals))
+    if func == "max":
+        return float(np.max(vals))
+    if func == "avg":
+        return float(np.mean(vals))
+    if func == "first":
+        return _scalar(vals[np.argmin(ts)])
+    if func == "last":
+        return _scalar(vals[np.argmax(ts)])
+    if func == "median":
+        return float(np.median(vals))
+    if func == "spread":
+        return float(np.max(vals) - np.min(vals))
+    if func == "stddev":
+        return float(np.std(vals, ddof=1)) if len(vals) > 1 else None
+    if func == "mode":
+        uniq, counts = np.unique(vals, return_counts=True)
+        return _scalar(uniq[np.argmax(counts)])
+    if func == "percentile":
+        # influx nearest-rank: the value at ceil(p/100 * n), 1-indexed
+        n = len(vals)
+        rank = max(1, min(n, math.ceil(param / 100.0 * n)))
+        return _scalar(np.sort(vals)[rank - 1])
+    raise InfluxQLError(f"unsupported function {func}()")
+
+
+def _scalar(v):
+    return v.item() if hasattr(v, "item") else v
+
+
+def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
+    """Selector/statistic/transform functions: fetch the raw (tag, time,
+    field) rows through the scan (predicates still push down), aggregate
+    per (tag-set, bucket) in numpy."""
+    ts = schema.timestamp_name
+    tags = _expand_tags(sel, schema)
+
+    # distinct() renders as its own value-per-row series
+    flat: list[tuple] = []  # (label, func, col, param, transform, t_param)
+    for it in sel.items:
+        if it[0] == "agg":
+            flat.append((_item_label(it), it[1], it[2], None, None, None))
+        elif it[0] == "agg2":
+            flat.append((it[1], it[1], it[2], it[3], None, None))
+        elif it[0] == "transform":
+            inner = it[2]
+            func = inner[1]
+            col = inner[2]
+            param = inner[3] if inner[0] == "agg2" else None
+            flat.append((it[1], func, col, param, it[1], it[3]))
+        else:
+            raise InfluxQLError("mixing aggregates and raw columns")
+    need_cols = sorted({f[2] for f in flat if f[2]})
+    proj = [f"`{t}`" for t in tags] + [f"`{ts}`"] + [f"`{c}`" for c in need_cols]
+    sql = f"SELECT {', '.join(proj)} FROM `{sel.measurement}`"
+    if where is not None:
+        sql += " WHERE " + _cond_sql(where, ts)
+    rows = conn.execute(sql).to_pylist()
+    if not rows:
+        return []
+
+    width = sel.group_time_ms
+    groups: dict[tuple, dict[int, list]] = {}
+    for r in rows:
+        key = tuple((t, r.get(t)) for t in tags)
+        bucket = (r[ts] // width) * width if width else 0
+        groups.setdefault(key, {}).setdefault(bucket, []).append(r)
+
+    # distinct is shape-changing (multiple rows per bucket): only alone
+    if any(f[1] == "distinct" for f in flat) and len(flat) > 1:
+        raise InfluxQLError("distinct() cannot combine with other functions")
+
+    labels = [f[0] for f in flat]
+    series = []
+    for key in sorted(groups, key=lambda k: tuple(str(v) for _, v in k)):
+        buckets = groups[key]
+        out_rows: list[list] = []
+        if flat[0][1] == "distinct":
+            col = flat[0][2]
+            for b in sorted(buckets):
+                seen = []
+                for r in buckets[b]:
+                    v = r.get(col)
+                    if v is not None and v not in seen:
+                        seen.append(v)
+                out_rows.extend([b, v] for v in sorted(seen, key=str))
+        else:
+            per_bucket: dict[int, list] = {}
+            for b in sorted(buckets):
+                rs = buckets[b]
+                vals_row = []
+                for label, func, col, param, _tr, _tp in flat:
+                    v_arr = np.array(
+                        [r.get(col) for r in rs if r.get(col) is not None]
+                    )
+                    t_sub = np.array(
+                        [r[ts] for r in rs if r.get(col) is not None]
+                    )
+                    vals_row.append(
+                        _host_agg(func, v_arr, t_sub, param)
+                        if len(v_arr)
+                        else None
+                    )
+                per_bucket[b] = vals_row
+            out_rows = [[b] + per_bucket[b] for b in sorted(per_bucket)]
+            out_rows = _apply_transforms(out_rows, flat, width)
+        s: dict[str, Any] = {
+            "name": sel.measurement,
+            "columns": ["time"] + (["distinct"] if flat[0][1] == "distinct"
+                                   else labels),
+            "values": out_rows,
+        }
+        if key:
+            s["tags"] = {t: v for t, v in key}
+        series.append(s)
+    return series
+
+
+def _apply_transforms(rows: list[list], flat: list, width) -> list[list]:
+    """derivative/difference/moving_average over the bucketed columns."""
+    if not any(f[4] for f in flat):
+        return rows
+    cols = list(zip(*rows)) if rows else []
+    if not cols:
+        return rows
+    t = list(cols[0])
+    new_cols = [t]
+    drop_first = 0
+    for idx, (label, _f, _c, _p, transform, t_param) in enumerate(flat):
+        col = list(cols[idx + 1])
+        if transform is None:
+            new_cols.append(col)
+            continue
+        if transform in ("derivative", "non_negative_derivative"):
+            unit = t_param or 1000
+            out = [None]
+            for i in range(1, len(col)):
+                if col[i] is None or col[i - 1] is None or t[i] == t[i - 1]:
+                    out.append(None)
+                else:
+                    d = (col[i] - col[i - 1]) / ((t[i] - t[i - 1]) / unit)
+                    if transform == "non_negative_derivative" and d < 0:
+                        out.append(None)
+                    else:
+                        out.append(d)
+            drop_first = max(drop_first, 1)
+            new_cols.append(out)
+        elif transform == "difference":
+            out = [None] + [
+                (col[i] - col[i - 1])
+                if col[i] is not None and col[i - 1] is not None else None
+                for i in range(1, len(col))
+            ]
+            drop_first = max(drop_first, 1)
+            new_cols.append(out)
+        elif transform == "moving_average":
+            n = int(t_param or 2)
+            out = []
+            for i in range(len(col)):
+                window = [v for v in col[max(0, i - n + 1):i + 1] if v is not None]
+                out.append(float(np.mean(window)) if len(window) == n else None)
+            drop_first = max(drop_first, n - 1)
+            new_cols.append(out)
+    rows2 = [list(r) for r in zip(*new_cols)]
+    return rows2[drop_first:]
+
+
+# ---- evaluation -----------------------------------------------------------
+
+
 def evaluate(conn, query: str) -> dict:
-    """Run one InfluxQL statement -> the v1 /query response body."""
-    sel = parse_influxql(query)
-    if sel == "show_measurements":
-        names = conn.catalog.table_names()
-        return _results(
-            [{"name": "measurements", "columns": ["name"], "values": [[n] for n in names]}]
-        )
-    if isinstance(sel, tuple) and sel[0] in (
-        "show_tag_keys", "show_field_keys", "show_tag_values",
-    ):
-        return _evaluate_show(conn, sel)
+    """Run InfluxQL -> the v1 /query response body (one results entry per
+    ';'-separated statement, matching the wire contract)."""
+    results = []
+    for sid, toks in enumerate(_split_statements(query)):
+        sel = _Parser(toks).parse()
+        body = _evaluate_one(conn, sel)
+        body["statement_id"] = sid
+        results.append(body)
+    if not results:
+        raise InfluxQLError("empty query")
+    return {"results": results}
+
+
+def _evaluate_one(conn, sel) -> dict:
+    if isinstance(sel, tuple):
+        if sel[0] == "show_measurements":
+            names = conn.catalog.table_names()
+            return _series_body(
+                [{"name": "measurements", "columns": ["name"],
+                  "values": [[n] for n in names]}]
+            )
+        if sel[0] == "show_databases":
+            # one flat namespace, presented under the conventional name
+            return _series_body(
+                [{"name": "databases", "columns": ["name"],
+                  "values": [["public"]]}]
+            )
+        if sel[0] == "show_retention_policies":
+            # TTL is per-table WITH options; the v1 surface expects one
+            # default policy row (clients only check shape + default flag)
+            return _series_body(
+                [{"name": "retention policies",
+                  "columns": ["name", "duration", "shardGroupDuration",
+                              "replicaN", "default"],
+                  "values": [["autogen", "0s", "168h0m0s", 1, True]]}]
+            )
+        return _series_body(_evaluate_show(conn, sel))
+
     table = conn.catalog.open(sel.measurement)
     if table is None:
-        return _results([])
+        return _series_body([])
     schema = table.schema
-    out = conn.execute(to_sql(sel, schema))
+    where = _resolve_regex(conn, sel, schema)
+
+    if _needs_host_path(sel):
+        series = _evaluate_host(conn, sel, schema, where)
+        series = _post_series(series, sel, host=True)
+        return _series_body(series)
+
+    out = conn.execute(to_sql(sel, schema, where=where))
     rows = out.to_pylist()
     ts = schema.timestamp_name
     has_agg = any(it[0] == "agg" for it in sel.items)
@@ -304,29 +800,45 @@ def evaluate(conn, query: str) -> dict:
         values = [
             [r.get(ts)] + [r.get(c) for c in columns[1:]] for r in rows
         ]
-        return _results(
+        if sel.offset:
+            values = values[sel.offset:]
+        if sel.limit is not None:
+            values = values[: sel.limit]
+        series = (
             [{"name": sel.measurement, "columns": columns, "values": values}]
             if values
             else []
         )
+        # Raw queries are one series, but SLIMIT/SOFFSET still apply.
+        if sel.soffset:
+            series = series[sel.soffset:]
+        if sel.slimit is not None:
+            series = series[: sel.slimit]
+        return _series_body(series)
 
     # Aggregate: one series per group-by tag-set (influx shape).
     agg_labels = [
         ("mean" if it[1] == "avg" else it[1]) for it in sel.items if it[0] == "agg"
     ]
+    agg_funcs = [it[1] for it in sel.items if it[0] == "agg"]
     columns = ["time"] + agg_labels
+    tags = _expand_tags(sel, schema)
     series_map: dict[tuple, list] = {}
     for r in rows:
-        key = tuple((t, r.get(t)) for t in sel.group_tags)
+        vals = [r.get(a) for a in agg_labels]
+        # An aggregate over ZERO points yields no row in influx — but SQL
+        # happily returns count=0 / NULL rows for an empty ungrouped scan.
+        if all(
+            v is None or (f == "count" and v == 0)
+            for f, v in zip(agg_funcs, vals)
+        ):
+            continue
+        key = tuple((t, r.get(t)) for t in tags)
         t_val = r.get("time", 0) if sel.group_time_ms else 0
-        series_map.setdefault(key, []).append([t_val] + [r.get(a) for a in agg_labels])
+        series_map.setdefault(key, []).append([t_val] + vals)
     series = []
     for key in sorted(series_map, key=lambda k: tuple(str(v) for _, v in k)):
         vals = sorted(series_map[key], key=lambda v: v[0])
-        if sel.group_time_ms and sel.fill is not None and vals:
-            vals = _fill_buckets(vals, sel, len(agg_labels))
-        if sel.order_desc:
-            vals = vals[::-1]
         s: dict[str, Any] = {
             "name": sel.measurement,
             "columns": columns,
@@ -335,17 +847,52 @@ def evaluate(conn, query: str) -> dict:
         if key:
             s["tags"] = {t: v for t, v in key}
         series.append(s)
-    return _results(series)
+    return _series_body(_post_series(series, sel, host=False))
+
+
+def _post_series(series: list[dict], sel: InfluxSelect, host: bool) -> list[dict]:
+    """Shared per-series post-processing: FILL, ORDER BY time DESC,
+    per-series LIMIT/OFFSET (aggregate semantics), then SLIMIT/SOFFSET."""
+    # distinct() emits MULTIPLE rows per time bucket; bucket-keyed fill
+    # would collapse them to one arbitrary value each. Influx applies
+    # FILL to scalar aggregates only — skip it here.
+    is_distinct = any(
+        it[0] == "agg" and it[1] == "distinct" for it in sel.items
+    )
+    for s in series:
+        vals = s["values"]
+        if (sel.group_time_ms and sel.fill is not None and vals
+                and not is_distinct):
+            n_aggs = len(s["columns"]) - 1
+            vals = _fill_buckets(vals, sel, n_aggs)
+        if sel.order_desc:
+            vals = vals[::-1]
+        if sel.offset and _is_agg_query(sel):
+            vals = vals[sel.offset:]
+        if sel.limit is not None and _is_agg_query(sel):
+            vals = vals[: sel.limit]
+        s["values"] = vals
+    series = [s for s in series if s["values"]]
+    if sel.soffset:
+        series = series[sel.soffset:]
+    if sel.slimit is not None:
+        series = series[: sel.slimit]
+    return series
+
+
+def _is_agg_query(sel: InfluxSelect) -> bool:
+    return any(it[0] in ("agg", "agg2", "transform") for it in sel.items)
 
 
 def _fill_buckets(vals: list, sel: InfluxSelect, n_aggs: int) -> list:
-    """FILL(x): materialize empty time buckets inside the covered range."""
+    """FILL(x | previous | linear): materialize empty time buckets inside
+    the covered range."""
     width = sel.group_time_ms
     lo = vals[0][0]
     hi = vals[-1][0]
     # a bounded WHERE time range extends the fill to the queried window
-    for col, op, value in sel.conds:
-        if col.lower() != "time" or not isinstance(value, (int, float)):
+    for col, op, value in sel.time_conds():
+        if not isinstance(value, (int, float)):
             continue
         if op in (">", ">="):
             lo = min(lo, (int(value) // width) * width)
@@ -353,18 +900,39 @@ def _fill_buckets(vals: list, sel: InfluxSelect, n_aggs: int) -> list:
             hi = max(hi, ((int(value) - 1) // width) * width)
         elif op == "<=":
             hi = max(hi, (int(value) // width) * width)
-    have = {v[0] for v in vals}
-    out = list(vals)
+    have = {v[0]: v for v in vals}
+    filled: list[list] = []
     t = lo
     while t <= hi:
-        if t not in have:
-            out.append([t] + [sel.fill] * n_aggs)
+        if t in have:
+            filled.append(have[t])
+        elif isinstance(sel.fill, float):
+            filled.append([t] + [sel.fill] * n_aggs)
+        else:
+            filled.append([t] + [None] * n_aggs)  # previous/linear patch next
         t += width
-    out.sort(key=lambda v: v[0])
-    return out
+    if sel.fill == "previous":
+        for i in range(1, len(filled)):
+            for c in range(1, n_aggs + 1):
+                if filled[i][c] is None:
+                    filled[i][c] = filled[i - 1][c]
+    elif sel.fill == "linear":
+        for c in range(1, n_aggs + 1):
+            known = [i for i, r in enumerate(filled) if r[c] is not None]
+            for i, r in enumerate(filled):
+                if r[c] is not None:
+                    continue
+                prev = max((k for k in known if k < i), default=None)
+                nxt = min((k for k in known if k > i), default=None)
+                if prev is not None and nxt is not None:
+                    frac = (i - prev) / (nxt - prev)
+                    r[c] = filled[prev][c] + frac * (
+                        filled[nxt][c] - filled[prev][c]
+                    )
+    return filled
 
 
-def _evaluate_show(conn, sel: tuple) -> dict:
+def _evaluate_show(conn, sel: tuple) -> list[dict]:
     """SHOW TAG KEYS / FIELD KEYS / TAG VALUES (influx schema surfaces —
     the reference serves these from its influxql planner)."""
     kind = sel[0]
@@ -404,7 +972,7 @@ def _evaluate_show(conn, sel: tuple) -> dict:
             series.append(
                 {"name": name, "columns": ["key", "value"], "values": vals}
             )
-    return _results(series)
+    return series
 
 
 def _influx_type(kind) -> str:
@@ -420,8 +988,8 @@ def _influx_type(kind) -> str:
     return "string"
 
 
-def _results(series: list) -> dict:
+def _series_body(series: list) -> dict:
     body: dict[str, Any] = {"statement_id": 0}
     if series:
         body["series"] = series
-    return {"results": [body]}
+    return body

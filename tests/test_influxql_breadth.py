@@ -1,0 +1,297 @@
+"""InfluxQL planner breadth: regex matchers, OR/parens, now()/RFC3339
+time bounds, selector + statistic functions, fill(previous|linear),
+derivative-family transforms, SLIMIT/SOFFSET, SHOW DATABASES/RETENTION
+POLICIES, multi-statement queries
+(ref: src/query_frontend/src/influxql/planner.rs — the forked-IOx
+planner surface real v1 clients exercise)."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.proxy.influxql import InfluxQLError, evaluate
+
+
+@pytest.fixture()
+def conn():
+    c = horaedb_tpu.connect(None)
+    c.execute(
+        "CREATE TABLE h2o (level string TAG, location string TAG, "
+        "water_level double, time timestamp NOT NULL, "
+        "TIMESTAMP KEY(time)) ENGINE=Analytic"
+    )
+    c.execute(
+        "INSERT INTO h2o (level, location, water_level, time) VALUES "
+        "('mid', 'coyote_creek', 8.0, 0), "
+        "('mid', 'coyote_creek', 6.0, 60000), "
+        "('mid', 'coyote_creek', 10.0, 120000), "
+        "('mid', 'coyote_creek', 4.0, 180000), "
+        "('low', 'santa_monica', 2.0, 0), "
+        "('low', 'santa_monica', 3.0, 60000), "
+        "('low', 'santa_monica', 7.0, 240000)"
+    )
+    yield c
+    c.close()
+
+
+def one_series(out, i=0):
+    return out["results"][0]["series"][i]
+
+
+class TestWhereBreadth:
+    def test_or_and_parens(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT water_level FROM h2o WHERE "
+            "(location = 'santa_monica' OR location = 'coyote_creek') "
+            "AND time < 60000ms",
+        )
+        assert len(one_series(out)["values"]) == 2
+
+    def test_regex_match_on_tag(self, conn):
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE location =~ /creek$/"
+        )
+        assert one_series(out)["values"][0][1] == 4
+
+    def test_regex_negative_match(self, conn):
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE location !~ /creek$/"
+        )
+        assert one_series(out)["values"][0][1] == 3
+
+    def test_regex_matching_nothing_is_empty_not_everything(self, conn):
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE location =~ /xyzzy/"
+        )
+        assert "series" not in out["results"][0]
+
+    def test_now_arithmetic(self, conn):
+        # everything is decades before now(): now() - 1h excludes all
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE time > now() - 1h"
+        )
+        assert "series" not in out["results"][0]
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE time < now()"
+        )
+        assert one_series(out)["values"][0][1] == 7
+
+    def test_rfc3339_literal(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT count(water_level) FROM h2o "
+            "WHERE time < '1970-01-01T00:02:00Z'",
+        )
+        assert one_series(out)["values"][0][1] == 4  # ts 0 and 60000 per loc
+
+
+class TestHostFunctions:
+    def test_first_last(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT first(water_level), last(water_level) FROM h2o "
+            "GROUP BY location",
+        )
+        by = {s["tags"]["location"]: s["values"][0] for s in
+              out["results"][0]["series"]}
+        assert by["coyote_creek"][1:] == [8.0, 4.0]
+        assert by["santa_monica"][1:] == [2.0, 7.0]
+
+    def test_median_spread_stddev(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT median(water_level), spread(water_level), "
+            "stddev(water_level) FROM h2o WHERE location = 'coyote_creek'",
+        )
+        t, median, spread, stddev = one_series(out)["values"][0]
+        assert median == 7.0
+        assert spread == 6.0
+        assert round(stddev, 4) == round(2.581988897, 4)
+
+    def test_percentile_nearest_rank(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT percentile(water_level, 50) FROM h2o "
+            "WHERE location = 'coyote_creek'",
+        )
+        # sorted [4,6,8,10]; ceil(0.5*4)=2 -> 6.0
+        assert one_series(out)["values"][0][1] == 6.0
+
+    def test_distinct(self, conn):
+        out = evaluate(conn, "SELECT distinct(level) FROM h2o")
+        vals = [v[1] for v in one_series(out)["values"]]
+        assert vals == ["low", "mid"]
+
+    def test_distinct_rejects_combination(self, conn):
+        with pytest.raises(InfluxQLError, match="distinct"):
+            evaluate(conn, "SELECT distinct(level), count(level) FROM h2o")
+
+    def test_host_funcs_with_time_buckets(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT last(water_level) FROM h2o WHERE location = 'coyote_creek' "
+            "GROUP BY time(2m)",
+        )
+        vals = one_series(out)["values"]
+        assert vals == [[0, 6.0], [120000, 4.0]]
+
+
+class TestTransforms:
+    def test_derivative_per_second(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT derivative(mean(water_level), 1m) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m)",
+        )
+        vals = one_series(out)["values"]
+        # means per 1m bucket: 8, 6, 10, 4 -> derivatives -2, +4, -6
+        assert [v[1] for v in vals] == [-2.0, 4.0, -6.0]
+
+    def test_non_negative_derivative_drops_negatives(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT non_negative_derivative(mean(water_level), 1m) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m)",
+        )
+        vals = one_series(out)["values"]
+        assert [v[1] for v in vals] == [None, 4.0, None]
+
+    def test_difference(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT difference(max(water_level)) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m)",
+        )
+        assert [v[1] for v in one_series(out)["values"]] == [-2.0, 4.0, -6.0]
+
+    def test_moving_average(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT moving_average(mean(water_level), 2) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m)",
+        )
+        assert [v[1] for v in one_series(out)["values"]] == [7.0, 8.0, 7.0]
+
+
+class TestFillModes:
+    def test_fill_previous(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT mean(water_level) FROM h2o WHERE location = 'santa_monica' "
+            "GROUP BY time(1m) FILL(previous)",
+        )
+        vals = one_series(out)["values"]
+        # buckets 0,1m have data; 2m,3m filled w/ previous; 4m has data
+        assert [v[1] for v in vals] == [2.0, 3.0, 3.0, 3.0, 7.0]
+
+    def test_fill_linear(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT mean(water_level) FROM h2o WHERE location = 'santa_monica' "
+            "GROUP BY time(1m) FILL(linear)",
+        )
+        vals = one_series(out)["values"]
+        assert [v[1] for v in vals] == [2.0, 3.0, pytest.approx(4.3333, rel=1e-3),
+                                        pytest.approx(5.6667, rel=1e-3), 7.0]
+
+
+class TestSeriesLimits:
+    def test_slimit_soffset(self, conn):
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o GROUP BY location SLIMIT 1"
+        )
+        series = out["results"][0]["series"]
+        assert len(series) == 1 and series[0]["tags"]["location"] == "coyote_creek"
+        out = evaluate(
+            conn,
+            "SELECT count(water_level) FROM h2o GROUP BY location "
+            "SLIMIT 1 SOFFSET 1",
+        )
+        series = out["results"][0]["series"]
+        assert len(series) == 1 and series[0]["tags"]["location"] == "santa_monica"
+
+    def test_aggregate_limit_offset_per_series(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT mean(water_level) FROM h2o WHERE location = 'coyote_creek' "
+            "GROUP BY time(1m) LIMIT 2 OFFSET 1",
+        )
+        assert [v[0] for v in one_series(out)["values"]] == [60000, 120000]
+
+    def test_group_by_star(self, conn):
+        out = evaluate(conn, "SELECT count(water_level) FROM h2o GROUP BY *")
+        series = out["results"][0]["series"]
+        assert all({"level", "location"} <= set(s["tags"]) for s in series)
+
+
+class TestShowAndMeta:
+    def test_show_databases(self, conn):
+        out = evaluate(conn, "SHOW DATABASES")
+        assert one_series(out)["values"] == [["public"]]
+
+    def test_show_retention_policies(self, conn):
+        out = evaluate(conn, "SHOW RETENTION POLICIES")
+        s = one_series(out)
+        assert s["columns"][0] == "name" and s["values"][0][0] == "autogen"
+        assert s["values"][0][-1] is True
+
+    def test_multi_statement(self, conn):
+        out = evaluate(conn, "SHOW DATABASES; SHOW MEASUREMENTS")
+        assert len(out["results"]) == 2
+        assert out["results"][0]["statement_id"] == 0
+        assert out["results"][1]["statement_id"] == 1
+        assert out["results"][1]["series"][0]["values"] == [["h2o"]]
+
+    def test_subquery_rejected_clearly(self, conn):
+        with pytest.raises(InfluxQLError, match="subqueries"):
+            evaluate(conn, "SELECT mean(x) FROM (SELECT 1)")
+
+
+class TestReviewRegressions:
+    def test_unspaced_now_arithmetic(self, conn):
+        """'now()-1h' (no spaces — the form v1 clients actually emit)
+        fuses '-1h' into one token; the parser must split it."""
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE time > now()-1h"
+        )
+        assert "series" not in out["results"][0]
+        out = evaluate(
+            conn, "SELECT count(water_level) FROM h2o WHERE time < now()+1h"
+        )
+        assert one_series(out)["values"][0][1] == 7
+
+    def test_transform_over_distinct_rejected(self, conn):
+        with pytest.raises(InfluxQLError, match="scalar aggregate"):
+            evaluate(
+                conn,
+                "SELECT derivative(distinct(water_level), 1s) FROM h2o "
+                "GROUP BY time(1m)",
+            )
+
+    def test_distinct_with_fill_keeps_all_rows(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT distinct(level) FROM h2o GROUP BY time(5m) FILL(0)",
+        )
+        vals = one_series(out)["values"]
+        assert sorted(v[1] for v in vals) == ["low", "mid"]
+
+    def test_raw_offset_without_limit_unsupported_not_silent(self, conn):
+        # raw OFFSET slices host-side even without LIMIT
+        out_all = evaluate(conn, "SELECT water_level FROM h2o")
+        out_off = evaluate(conn, "SELECT water_level FROM h2o OFFSET 2")
+        assert (len(one_series(out_off)["values"])
+                == len(one_series(out_all)["values"]) - 2)
+
+    def test_raw_limit_offset(self, conn):
+        out = evaluate(conn, "SELECT water_level FROM h2o LIMIT 2 OFFSET 1")
+        all_vals = one_series(evaluate(conn, "SELECT water_level FROM h2o"))["values"]
+        assert one_series(out)["values"] == all_vals[1:3]
+
+    def test_raw_soffset_drops_only_series(self, conn):
+        out = evaluate(conn, "SELECT water_level FROM h2o SOFFSET 1")
+        assert "series" not in out["results"][0]

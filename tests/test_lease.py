@@ -79,9 +79,17 @@ class EtcdStub:
                 for op in ops:
                     if "request_put" in op:
                         put = op["request_put"]
+                        # Real etcd rejects a put quoting a dead lease —
+                        # the stub must too, or stale-lease bugs in the
+                        # client hide behind it.
+                        lid = put.get("lease", "")
+                        if lid and lid not in self.leases:
+                            raise AssertionError(
+                                f"requested lease not found: {lid}"
+                            )
                         self.kv[base64.b64decode(put["key"]).decode()] = (
                             base64.b64decode(put["value"]).decode(),
-                            put.get("lease", ""),
+                            lid,
                         )
                         responses.append({"response_put": {}})
                     elif "request_range" in op:
