@@ -25,7 +25,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..common_types.row_group import RowGroup
-from .manifest import AddFile, AlterOptions, Flushed, MetaEdit
+from .manifest import AddFile, AlterOptions, AlterSchema, Flushed, MetaEdit
 from .memtable import MemTable
 from .options import TableOptions, UpdateMode, suggest_segment_duration
 from .sst.manager import FileHandle
@@ -72,8 +72,25 @@ class Flusher:
         all_rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
         all_seq = np.concatenate(seqs)
 
-        # Auto-pick segment duration on first flush.
         edits: list[MetaEdit] = []
+        # First flush: apply the sampled primary-key order to the SORT and
+        # the manifest edit NOW, but install it into the live version only
+        # after the manifest append succeeds (below) — a failed flush must
+        # not leave the table claiming a sort order its data and manifest
+        # don't have (sampler.rs suggestion applied at
+        # table/version.rs:670-674). The reorder changes only sort
+        # priority — same columns, same uniqueness — so rows re-wrap
+        # under the new schema as-is.
+        suggested = None
+        if table.pk_sampler is not None:
+            suggested = table.pk_sampler.suggest(table.schema)
+            if suggested is not None:
+                edits.append(AlterSchema(suggested))
+                all_rows = RowGroup(
+                    suggested, all_rows.columns, all_rows.validity
+                )
+
+        # Auto-pick segment duration on first flush.
         seg_ms = table.options.segment_duration_ms
         if seg_ms is None:
             tr = all_rows.time_range()
@@ -118,6 +135,11 @@ class Flusher:
         edits.append(Flushed(max_seq))
         table.manifest.append_edits(edits)
 
+        # Durable now: install the sampled key order and retire the
+        # sampler (one-shot — it covers the first segment only).
+        if suggested is not None:
+            table.version.alter_schema(suggested)
+        table.pk_sampler = None
         for h in new_handles:
             table.version.levels.add_file(0, h)
         table.version.retire_immutables([m.id for m in memtables], max_seq)

@@ -54,10 +54,17 @@ class TableData:
             self._last_sequence = max(
                 recovered_state.flushed_sequence, recovered_state.levels.max_sequence()
             )
+            self.pk_sampler = None  # sampling covers the FIRST segment only
         else:
             self.version = TableVersion(schema, options=options)
             self._next_file_id = 1
             self._last_sequence = 0
+            # Brand-new table: sample key cardinalities until first flush
+            # picks the pruning-friendly sort order (sampler.rs:271).
+            from .sampler import PrimaryKeySampler
+
+            sampler = PrimaryKeySampler(schema)
+            self.pk_sampler = sampler if sampler.has_candidates else None
         self.dropped = False
 
     # ---- id / sequence allocation -------------------------------------
@@ -91,6 +98,8 @@ class TableData:
 
     # ---- write ---------------------------------------------------------
     def put_rows(self, rows: RowGroup, sequence: int) -> None:
+        if self.pk_sampler is not None:
+            self.pk_sampler.collect(rows)
         self.version.mutable.put(rows, sequence)
 
     def should_flush(self) -> bool:

@@ -444,7 +444,25 @@ def _resolve_regex(conn, sel: InfluxSelect, schema) -> Optional[tuple]:
     """Rewrite regex matcher nodes into IN-list compare nodes by matching
     against the tag's distinct values — the scan then gets an exact,
     pushdown-friendly predicate (same strategy the reference's planner
-    uses for anchored regexes)."""
+    uses for anchored regexes). The DISTINCT probe carries the query's
+    time bounds (a dashboard's now()-5m query must not scan all history
+    for tag values) and is memoized per column within the statement."""
+    ts = schema.timestamp_name
+    time_where = " AND ".join(
+        f"`{ts}` {op} {int(v)}"
+        for _c, op, v in sel.time_conds()
+        if isinstance(v, (int, float))
+    )
+    distinct_cache: dict[str, list] = {}
+
+    def distinct_values(col: str) -> list:
+        if col not in distinct_cache:
+            sql = f"SELECT DISTINCT `{col}` FROM `{sel.measurement}`"
+            if time_where:
+                sql += f" WHERE {time_where}"
+            out = conn.execute(sql).to_pylist()
+            distinct_cache[col] = [r[col] for r in out if r[col] is not None]
+        return distinct_cache[col]
 
     def walk(node):
         if node is None:
@@ -459,10 +477,7 @@ def _resolve_regex(conn, sel: InfluxSelect, schema) -> Optional[tuple]:
             rx = re.compile(pattern)
         except re.error as e:
             raise InfluxQLError(f"bad regex /{pattern}/: {e}")
-        out = conn.execute(
-            f"SELECT DISTINCT `{col}` FROM `{sel.measurement}`"
-        ).to_pylist()
-        vals = [r[col] for r in out if r[col] is not None]
+        vals = distinct_values(col)
         keep = [v for v in vals if bool(rx.search(str(v))) == (op == "=~")]
         return ("in", col, keep)
 
@@ -504,9 +519,8 @@ def to_sql(sel: InfluxSelect, schema, where: Optional[tuple] = None) -> str:
             cols.append(f"`{tag}`")
         if sel.group_time_ms:
             cols.append(f"time_bucket(`{ts}`, '{sel.group_time_ms}ms') AS time")
-        for it in sel.items:
+        for it, label in zip(sel.items, _unique_labels(sel.items)):
             _, func, col = it
-            label = "mean" if func == "avg" else func
             target = f"`{col}`" if col else "*"
             cols.append(f"{func}({target}) AS `{label}`")
     else:
@@ -557,6 +571,20 @@ def _item_label(it) -> str:
     return it[1]
 
 
+def _unique_labels(items) -> list[str]:
+    """Column labels for the projection, deduplicated the way influx does
+    (mean, mean_1, mean_2, ...) — two aggregates of the same function
+    must not alias to one column (the second would silently render the
+    first's values)."""
+    labels, seen = [], {}
+    for it in items:
+        base = _item_label(it)
+        k = seen.get(base, 0)
+        seen[base] = k + 1
+        labels.append(base if k == 0 else f"{base}_{k}")
+    return labels
+
+
 def _host_agg(func: str, vals: np.ndarray, ts: np.ndarray, param=None):
     if len(vals) == 0:
         return None
@@ -604,19 +632,27 @@ def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
 
     # distinct() renders as its own value-per-row series
     flat: list[tuple] = []  # (label, func, col, param, transform, t_param)
-    for it in sel.items:
+    labels_u = _unique_labels(sel.items)
+    for it, label in zip(sel.items, labels_u):
         if it[0] == "agg":
-            flat.append((_item_label(it), it[1], it[2], None, None, None))
+            flat.append((label, it[1], it[2], None, None, None))
         elif it[0] == "agg2":
-            flat.append((it[1], it[1], it[2], it[3], None, None))
+            flat.append((label, it[1], it[2], it[3], None, None))
         elif it[0] == "transform":
             inner = it[2]
             func = inner[1]
             col = inner[2]
             param = inner[3] if inner[0] == "agg2" else None
-            flat.append((it[1], func, col, param, it[1], it[3]))
+            if col is None:
+                raise InfluxQLError(f"{it[1]}(...(*)) needs a named field")
+            flat.append((label, func, col, param, it[1], it[3]))
         else:
             raise InfluxQLError("mixing aggregates and raw columns")
+    for label, func, col, _p, _tr, _tp in flat:
+        if col is None and func != "count":
+            raise InfluxQLError(
+                f"{func}(*) is not supported; name a field column"
+            )
     need_cols = sorted({f[2] for f in flat if f[2]})
     proj = [f"`{t}`" for t in tags] + [f"`{ts}`"] + [f"`{c}`" for c in need_cols]
     sql = f"SELECT {', '.join(proj)} FROM `{sel.measurement}`"
@@ -657,6 +693,9 @@ def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
                 rs = buckets[b]
                 vals_row = []
                 for label, func, col, param, _tr, _tp in flat:
+                    if col is None:  # count(*): every row counts
+                        vals_row.append(len(rs))
+                        continue
                     v_arr = np.array(
                         [r.get(col) for r in rs if r.get(col) is not None]
                     )
@@ -817,9 +856,7 @@ def _evaluate_one(conn, sel) -> dict:
         return _series_body(series)
 
     # Aggregate: one series per group-by tag-set (influx shape).
-    agg_labels = [
-        ("mean" if it[1] == "avg" else it[1]) for it in sel.items if it[0] == "agg"
-    ]
+    agg_labels = _unique_labels(sel.items)
     agg_funcs = [it[1] for it in sel.items if it[0] == "agg"]
     columns = ["time"] + agg_labels
     tags = _expand_tags(sel, schema)

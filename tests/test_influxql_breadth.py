@@ -295,3 +295,31 @@ class TestReviewRegressions:
     def test_raw_soffset_drops_only_series(self, conn):
         out = evaluate(conn, "SELECT water_level FROM h2o SOFFSET 1")
         assert "series" not in out["results"][0]
+
+    def test_duplicate_agg_functions_get_distinct_columns(self, conn):
+        conn.execute(
+            "CREATE TABLE m2 (g string TAG, a double, b double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        conn.execute(
+            "INSERT INTO m2 (g, a, b, ts) VALUES "
+            "('x', 1.0, 100.0, 0), ('x', 3.0, 300.0, 1000)"
+        )
+        out = evaluate(conn, "SELECT mean(a), mean(b) FROM m2")
+        s = one_series(out)
+        assert s["columns"] == ["time", "mean", "mean_1"]
+        assert s["values"][0][1:] == [2.0, 200.0]
+        # host path too
+        out = evaluate(conn, "SELECT last(a), last(b) FROM m2")
+        s = one_series(out)
+        assert s["columns"] == ["time", "last", "last_1"]
+        assert s["values"][0][1:] == [3.0, 300.0]
+
+    def test_count_star_on_host_path(self, conn):
+        out = evaluate(conn, "SELECT count(*), last(water_level) FROM h2o")
+        s = one_series(out)
+        assert s["values"][0][1] == 7  # row count, not null
+
+    def test_selector_star_rejected(self, conn):
+        with pytest.raises(InfluxQLError, match="name a field"):
+            evaluate(conn, "SELECT first(*) FROM h2o")

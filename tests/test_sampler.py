@@ -1,0 +1,246 @@
+"""Primary-key sampling: low-cardinality-first key order suggested from
+first-segment writes, applied at first flush, persisted via the manifest
+(ref: analytic_engine/src/sampler.rs:271-360 PrimaryKeySampler;
+table/version.rs:670-674 applies the suggestion on first flush)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+from horaedb_tpu.engine.sampler import (
+    MIN_SAMPLE_ROWS,
+    SAMPLE_DISTINCT_CAP,
+    PrimaryKeySampler,
+)
+
+
+def _schema():
+    return Schema.build(
+        [
+            ColumnSchema("region", DatumKind.STRING, is_tag=True),
+            ColumnSchema("host", DatumKind.STRING, is_tag=True),
+            ColumnSchema("v", DatumKind.DOUBLE),
+            ColumnSchema("ts", DatumKind.TIMESTAMP, is_nullable=False),
+        ],
+        timestamp_column="ts",
+        primary_key=["host", "region", "ts"],
+    )
+
+
+def _rows(schema, n, n_hosts, n_regions, seed=0):
+    rng = np.random.default_rng(seed)
+    return RowGroup(
+        schema,
+        {
+            "region": np.array(
+                [f"r{i}" for i in rng.integers(0, n_regions, n)], dtype=object
+            ),
+            "host": np.array(
+                [f"h{i}" for i in rng.integers(0, n_hosts, n)], dtype=object
+            ),
+            "v": rng.normal(0, 1, n),
+            "ts": rng.integers(0, 3_600_000, n).astype(np.int64),
+        },
+    )
+
+
+class TestSamplerUnit:
+    def test_low_cardinality_leads(self):
+        schema = _schema()
+        s = PrimaryKeySampler(schema)
+        assert s.has_candidates
+        s.collect(_rows(schema, 2000, n_hosts=500, n_regions=4))
+        out = s.suggest(schema)
+        assert out is not None
+        names = [out.columns[i].name for i in out.primary_key_indexes]
+        # region (4 values) before host (500), timestamp stays last
+        assert names == ["region", "host", "ts"]
+        assert out.version == schema.version + 1
+
+    def test_too_few_samples_suggests_nothing(self):
+        schema = _schema()
+        s = PrimaryKeySampler(schema)
+        s.collect(_rows(schema, MIN_SAMPLE_ROWS - 1, 10, 2))
+        assert s.suggest(schema) is None
+
+    def test_matching_order_suggests_nothing(self):
+        schema = Schema.build(
+            [
+                ColumnSchema("region", DatumKind.STRING, is_tag=True),
+                ColumnSchema("host", DatumKind.STRING, is_tag=True),
+                ColumnSchema("v", DatumKind.DOUBLE),
+                ColumnSchema("ts", DatumKind.TIMESTAMP, is_nullable=False),
+            ],
+            timestamp_column="ts",
+            primary_key=["region", "host", "ts"],  # already low-card first
+        )
+        s = PrimaryKeySampler(schema)
+        s.collect(_rows(schema, 2000, n_hosts=500, n_regions=4))
+        assert s.suggest(schema) is None
+
+    def test_saturated_column_ranks_last(self):
+        schema = _schema()
+        s = PrimaryKeySampler(schema)
+        n = SAMPLE_DISTINCT_CAP * 2
+        rows = RowGroup(
+            schema,
+            {
+                "region": np.array(["r0", "r1"] * (n // 2), dtype=object),
+                "host": np.array([f"h{i}" for i in range(n)], dtype=object),
+                "v": np.zeros(n),
+                "ts": np.arange(n, dtype=np.int64),
+            },
+        )
+        s.collect(rows)
+        out = s.suggest(schema)
+        names = [out.columns[i].name for i in out.primary_key_indexes]
+        assert names[0] == "region"
+
+    def test_auto_tsid_table_has_no_candidates(self):
+        schema = Schema.build(
+            [
+                ColumnSchema("host", DatumKind.STRING, is_tag=True),
+                ColumnSchema("v", DatumKind.DOUBLE),
+                ColumnSchema("ts", DatumKind.TIMESTAMP, is_nullable=False),
+            ],
+            timestamp_column="ts",
+        )
+        assert not PrimaryKeySampler(schema).has_candidates
+
+
+class TestSamplerE2E:
+    DDL = (
+        "CREATE TABLE pk (region string TAG, host string TAG, v double, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts), "
+        "PRIMARY KEY(host, region, ts)) ENGINE=Analytic "
+        "WITH (segment_duration='2h')"
+    )
+
+    def _seed(self, conn, n=1000):
+        t = conn.catalog.open("pk")
+        rng = np.random.default_rng(7)
+        rows = RowGroup(
+            t.schema,
+            {
+                "region": np.array(
+                    [f"r{i}" for i in rng.integers(0, 3, n)], dtype=object
+                ),
+                "host": np.array(
+                    [f"h{i}" for i in rng.integers(0, 200, n)], dtype=object
+                ),
+                "v": rng.normal(0, 1, n),
+                "ts": rng.integers(0, 3_600_000, n).astype(np.int64),
+            },
+        )
+        t.write(rows)
+        return t
+
+    def test_first_flush_applies_and_persists_suggestion(self, tmp_path):
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(self.DDL)
+        t = self._seed(conn)
+        pk_before = [
+            t.schema.columns[i].name for i in t.schema.primary_key_indexes
+        ]
+        assert pk_before == ["host", "region", "ts"]
+        t.flush()
+        pk_after = [
+            t.schema.columns[i].name for i in t.schema.primary_key_indexes
+        ]
+        assert pk_after == ["region", "host", "ts"]
+        # Reads still answer correctly under the reordered schema.
+        out = conn.execute("SELECT count(1) AS c FROM pk").to_pylist()
+        assert out[0]["c"] == 1000
+        conn.close()
+
+        # Manifest persists the suggestion across reopen.
+        conn2 = horaedb_tpu.connect(str(tmp_path / "db"))
+        t2 = conn2.catalog.open("pk")
+        pk_reopened = [
+            t2.schema.columns[i].name for i in t2.schema.primary_key_indexes
+        ]
+        assert pk_reopened == ["region", "host", "ts"]
+        out = conn2.execute("SELECT count(1) AS c FROM pk").to_pylist()
+        assert out[0]["c"] == 1000
+        conn2.close()
+
+    def test_sst_rows_sorted_by_suggested_order(self, tmp_path):
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(self.DDL)
+        t = self._seed(conn)
+        t.flush()
+        data = t.physical_datas()[0]
+        from horaedb_tpu.engine.sst.reader import SstReader
+
+        files = data.version.levels.all_files()
+        assert files
+        rows = SstReader(data.store, files[0].path).read(t.schema)
+        regions = rows.columns["region"]
+        vals = [regions[i] for i in range(len(rows))]
+        assert vals == sorted(vals)  # region leads the sort now
+        conn.close()
+
+    def test_overwrite_dedup_correct_after_reorder(self, tmp_path):
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(self.DDL)
+        t = self._seed(conn)
+        t.flush()
+        # Overwrite one existing key: dedup must keep the newest.
+        conn.execute(
+            "INSERT INTO pk (region, host, v, ts) VALUES ('r0', 'h1', 99.5, 123)"
+        )
+        conn.execute(
+            "INSERT INTO pk (region, host, v, ts) VALUES ('r0', 'h1', 77.5, 123)"
+        )
+        t.flush()
+        out = conn.execute(
+            "SELECT v FROM pk WHERE host = 'h1' AND region = 'r0' AND ts = 123"
+        ).to_pylist()
+        assert [r["v"] for r in out] == [77.5]
+        conn.close()
+
+    def test_failed_flush_leaves_schema_untouched(self, tmp_path, monkeypatch):
+        """A flush that dies before the manifest append must not install
+        the suggested order (the table would claim a sort its data and
+        manifest don't have); the retry re-suggests and applies."""
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(self.DDL)
+        t = self._seed(conn)
+        data = t.physical_datas()[0]
+        v0 = t.schema.version
+
+        real_append = data.manifest.append_edits
+        boom = {"armed": True}
+
+        def flaky_append(edits):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("store down")
+            return real_append(edits)
+
+        monkeypatch.setattr(data.manifest, "append_edits", flaky_append)
+        with pytest.raises(RuntimeError, match="store down"):
+            t.flush()
+        assert t.schema.version == v0  # nothing installed
+        assert data.pk_sampler is not None  # sampler survives for retry
+        t.flush()  # retry succeeds and applies the suggestion
+        assert [
+            t.schema.columns[i].name for i in t.schema.primary_key_indexes
+        ] == ["region", "host", "ts"]
+        out = conn.execute("SELECT count(1) AS c FROM pk").to_pylist()
+        assert out[0]["c"] == 1000
+        conn.close()
+
+    def test_second_flush_does_not_resample(self, tmp_path):
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(self.DDL)
+        t = self._seed(conn)
+        t.flush()
+        v1 = t.schema.version
+        self._seed(conn)
+        t.flush()
+        assert t.schema.version == v1  # one-shot: no churn after segment 1
+        conn.close()
