@@ -63,10 +63,39 @@ def build_readme():
     t = db.catalog.open("demo")
     t.write(rows)
     t.flush()
-    return db, "SELECT name, avg(value) AS a FROM demo GROUP BY name", n
+
+    def arrow_fn(dset):
+        import pyarrow.compute as pc  # noqa: F401
+
+        t = dset.to_table(columns=["name", "value"])
+        out = t.group_by("name").aggregate([("value", "mean")])
+        return [
+            {"name": n_, "a": a}
+            for n_, a in zip(
+                out["name"].to_pylist(), out["value_mean"].to_pylist()
+            )
+        ]
+
+    return db, "SELECT name, avg(value) AS a FROM demo GROUP BY name", n, arrow_fn
 
 
-def _build_tsbs(scale, hours, query):
+def _bucket(col, width_ms: int):
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    # SSTs store the key as timestamp[ms]; bucket in int64 ms space
+    # (integer divide truncates: floor(ts / w) * w).
+    as_ms = pc.cast(col, pa.int64())
+    return pc.multiply(pc.divide(as_ms, width_ms), width_ms)
+
+
+def _ts_literal(ms: int):
+    import pyarrow as pa
+
+    return pa.scalar(ms, type=pa.timestamp("ms"))
+
+
+def _build_tsbs(scale, hours, query, arrow_fn):
     from horaedb_tpu.tools import tsbs
 
     db = _connect_mem()
@@ -81,31 +110,105 @@ def _build_tsbs(scale, hours, query):
     t = db.catalog.open("cpu")
     t.write(rows)
     t.flush()
-    return db, query.sql, len(rows)
+    return db, query.sql, len(rows), arrow_fn
 
 
 def build_tsbs_111():
-    from horaedb_tpu.tools.tsbs import single_groupby
-
-    return _build_tsbs(100, 1, single_groupby(1, 1, 1))
+    return _build_tsbs(100, 1, _sg(1, 1, 1), _sg_arrow(1, 1, 1))
 
 
 def build_tsbs_581():
+    return _build_tsbs(4000, 1, _sg(5, 8, 1), _sg_arrow(5, 8, 1))
+
+
+def _sg(m, h, hr):
     from horaedb_tpu.tools.tsbs import single_groupby
 
-    return _build_tsbs(4000, 1, single_groupby(5, 8, 1))
+    return single_groupby(m, h, hr)
+
+
+def _sg_arrow(m, h, hr):
+    """single-groupby-{m}-{h}-{hr} as a pyarrow Acero pipeline."""
+
+    def arrow_fn(dset):
+        import pyarrow.compute as pc
+        from horaedb_tpu.tools.tsbs import CPU_FIELDS
+
+        fields = list(CPU_FIELDS[:m])
+        hosts = [f"host_{i}" for i in range(h)]
+        end = hr * 3_600_000
+        t = dset.to_table(
+            columns=["hostname", "ts"] + fields,
+            filter=(
+                pc.field("hostname").isin(hosts)
+                & (pc.field("ts") >= _ts_literal(0))
+                & (pc.field("ts") < _ts_literal(end))
+            ),
+        )
+        t = t.append_column("minute", _bucket(t["ts"], 60_000))
+        out = t.group_by("minute").aggregate([(f, "max") for f in fields])
+        rows = []
+        for i in range(len(out)):
+            r = {"minute": out["minute"][i].as_py()}
+            for f in fields:
+                r[f"max_{f}"] = out[f"{f}_max"][i].as_py()
+            rows.append(r)
+        return rows
+
+    return arrow_fn
 
 
 def build_double_groupby():
-    from horaedb_tpu.tools.tsbs import double_groupby_all
+    from horaedb_tpu.tools.tsbs import CPU_FIELDS, double_groupby_all
 
-    return _build_tsbs(400, 12, double_groupby_all(12))
+    def arrow_fn(dset):
+        import pyarrow.compute as pc
+
+        end = 12 * 3_600_000
+        t = dset.to_table(
+            columns=["hostname", "ts"] + list(CPU_FIELDS),
+            filter=(pc.field("ts") >= _ts_literal(0))
+            & (pc.field("ts") < _ts_literal(end)),
+        )
+        t = t.append_column("hour", _bucket(t["ts"], 3_600_000))
+        out = t.group_by(["hostname", "hour"]).aggregate(
+            [(f, "mean") for f in CPU_FIELDS]
+        )
+        rows = []
+        for i in range(len(out)):
+            r = {
+                "hostname": out["hostname"][i].as_py(),
+                "hour": out["hour"][i].as_py(),
+            }
+            for f in CPU_FIELDS:
+                r[f"avg_{f}"] = out[f"{f}_mean"][i].as_py()
+            rows.append(r)
+        return rows
+
+    return _build_tsbs(400, 12, double_groupby_all(12), arrow_fn)
 
 
 def build_high_cpu():
     from horaedb_tpu.tools.tsbs import high_cpu_all
 
-    return _build_tsbs(400, 12, high_cpu_all(12))
+    def arrow_fn(dset):
+        import pyarrow.compute as pc
+
+        end = 12 * 3_600_000
+        t = dset.to_table(
+            columns=["usage_user"],
+            filter=(
+                (pc.field("usage_user") > 90)
+                & (pc.field("ts") >= _ts_literal(0))
+                & (pc.field("ts") < _ts_literal(end))
+            ),
+        )
+        return [{
+            "c": len(t),
+            "peak": pc.max(t["usage_user"]).as_py(),
+        }]
+
+    return _build_tsbs(400, 12, high_cpu_all(12), arrow_fn)
 
 
 CONFIGS = {
@@ -263,6 +366,40 @@ def run_compaction_config() -> dict:
         "input_rows": n_input,
         "ssts": COMPACTION_SSTS,
     }
+
+
+def time_arrow(db, table_name: str, arrow_fn) -> tuple[float, list]:
+    """External anchor: the same query through pyarrow's Acero (an
+    Arrow-native C++ vectorized engine — the closest runnable stand-in
+    for the reference's DataFusion executor, which cannot run here: the
+    image has no Rust toolchain, no prebuilt horaedb binary, and no
+    network egress; see BASELINE.md). Scans the SAME Parquet SSTs through
+    pyarrow.dataset -> filter -> group_by, exactly DataFusion's scan
+    shape. SST dumping to disk is untimed setup."""
+    import shutil
+    import tempfile
+
+    import pyarrow.dataset as pads
+
+    data = db.catalog.open(table_name).physical_datas()[0]
+    tmp = tempfile.mkdtemp(prefix="bench_arrow_")
+    try:
+        paths = []
+        for i, h in enumerate(data.version.levels.all_files()):
+            p = os.path.join(tmp, f"{i}.parquet")
+            with open(p, "wb") as f:
+                f.write(data.store.get(h.path))
+            paths.append(p)
+        dset = pads.dataset(paths, format="parquet")
+        out = arrow_fn(dset)  # warmup
+        best = np.inf
+        for _ in range(REPEATS):
+            s = time.perf_counter()
+            out = arrow_fn(pads.dataset(paths, format="parquet"))
+            best = min(best, time.perf_counter() - s)
+        return best, out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def time_query(db, sql) -> tuple[float, list, str]:
@@ -502,7 +639,7 @@ def run_config(config: str) -> dict:
                 "unit": f"unknown config {config}", "vs_baseline": 0,
                 "platform": "none"}
     platform = jax.devices()[0].platform
-    db, sql, n_rows = builder()
+    db, sql, n_rows, arrow_fn = builder()
 
     dev_s, dev_rows, dev_path = time_query(db, sql)
     assert dev_path in (
@@ -526,6 +663,20 @@ def run_config(config: str) -> dict:
                 "unit": "path mismatch", "vs_baseline": 0,
                 "platform": platform}
 
+    # External anchor: pyarrow Acero over the same parquet SSTs (the
+    # runnable stand-in for the reference's DataFusion executor). A
+    # result mismatch zeroes the ratio rather than erroring the config —
+    # the anchor must never take down the primary metric.
+    table_name = "demo" if config == "readme" else "cpu"
+    try:
+        arrow_s, arrow_rows = time_arrow(db, table_name, arrow_fn)
+        vs_arrow = (
+            round(arrow_s / dev_s, 3)
+            if _rows_agree(dev_rows, arrow_rows) else 0
+        )
+    except Exception:
+        arrow_s, vs_arrow = None, None
+
     # Honesty label: the bench targets the TPU; any run that ended up on
     # XLA-CPU carries the fallback in the metric NAME so it can never be
     # mistaken for a chip number (VERDICT r3 item 1).
@@ -535,6 +686,7 @@ def run_config(config: str) -> dict:
         "value": round(n_rows / dev_s),
         "unit": "rows/s",
         "vs_baseline": round(host_s / dev_s, 3),
+        "vs_arrow": vs_arrow,
         "platform": platform,
     }
 

@@ -149,6 +149,26 @@ class Catalog:
 
     def open(self, name: str) -> Optional[Table]:
         """Open a table behind the Table interface (the query layer's view)."""
+        if "." in name:
+            # Virtual system-catalog tables (system.public.tables) resolve
+            # here so the whole query layer works on them unchanged
+            # (ref: system_catalog/src/tables.rs). Non-system dotted names
+            # FALL THROUGH: quoted identifiers may contain dots, and
+            # schema-qualified references (public.demo) resolve to their
+            # bare name.
+            from ..table_engine.system import open_system_table
+
+            st = open_system_table(self, name)
+            if st is not None:
+                return st
+            if not self.exists(name):
+                # Only names that are NOT themselves registered get the
+                # qualified-name rewrite — a table literally named
+                # `public.x` must never be shadowed by a sibling `x`.
+                low = name.lower()
+                for prefix in ("horaedb.public.", "public."):
+                    if low.startswith(prefix) and self.exists(name[len(prefix):]):
+                        return self.open(name[len(prefix):])
         with self._lock:
             cached = self._open_tables.get(name)
             if cached is not None:

@@ -256,7 +256,7 @@ class Parser:
         table = None
         join = None
         if self._eat_kw("FROM"):
-            table = self._ident()
+            table = self._table_name()
             if self._eat_kw("INNER"):
                 self._expect_kw("JOIN")
                 join = self._join_clause(table)
@@ -314,18 +314,24 @@ class Parser:
         """JOIN t2 ON a.k1 = b.k1 [AND a.k2 = b.k2 ...] — equi-key
         inner/left join (the reference gets richer joins from DataFusion;
         this is the host-path equi-join subset)."""
-        right = self._ident()
+        right = self._table_name()
         self._expect_kw("ON")
         left_cols: list[str] = []
         right_cols: list[str] = []
+        def names_table(tab: Optional[str], full: str) -> bool:
+            """ON qualifiers may use the full dotted name or its last
+            component (JOIN public.t2 ... ON t1.k = t2.k)."""
+            return tab is None or tab == full or tab == full.rsplit(".", 1)[-1]
+
         while True:
             l_tab, l_col = self._qualified()
             self._expect_op("=")
             r_tab, r_col = self._qualified()
             # normalize sides: left table's column first
-            if l_tab == right and r_tab == left_table:
+            if (l_tab is not None and names_table(l_tab, right)
+                    and r_tab is not None and names_table(r_tab, left_table)):
                 l_col, r_col = r_col, l_col
-            elif not (l_tab in (left_table, None) and r_tab in (right, None)):
+            elif not (names_table(l_tab, left_table) and names_table(r_tab, right)):
                 raise ParseError(
                     f"JOIN ON must reference {left_table} and {right}", -1, self.sql
                 )
@@ -334,6 +340,17 @@ class Parser:
             if not self._eat_kw("AND"):
                 break
         return ast.Join(right, tuple(left_cols), tuple(right_cols), kind=kind)
+
+    def _table_name(self) -> str:
+        """A possibly-qualified table reference. Qualified names
+        (system.public.tables — the system catalog's virtual tables,
+        ref: system_catalog/src/tables.rs; or public.demo) join into one
+        dotted identifier; regular tables stay single-part. Shared by
+        FROM and JOIN targets."""
+        name = self._ident()
+        while self._eat_op("."):
+            name = f"{name}.{self._ident()}"
+        return name
 
     def _qualified(self) -> tuple[Optional[str], str]:
         name = self._ident()

@@ -275,6 +275,11 @@ class Planner:
         known = {stmt.table}
         if stmt.join is not None:
             known.add(stmt.join.table)
+        # Qualified table names may be referenced by their last component
+        # (FROM public.cpu ... WHERE cpu.usage > 0).
+        for full in list(known):
+            if "." in full:
+                known.add(full.rsplit(".", 1)[-1])
         sources = [item.expr for item in stmt.items]
         sources += [e for e in (stmt.where, stmt.having, *stmt.group_by) if e is not None]
         sources += [o.expr for o in stmt.order_by]

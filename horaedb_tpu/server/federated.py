@@ -1,0 +1,153 @@
+"""MySQL "federated" compatibility queries
+(ref: src/server/src/federated.rs — real MySQL clients and connectors
+open with a burst of session probes; the server answers them locally
+with canned shapes instead of erroring, or drivers refuse to connect).
+
+``check(sql)`` classifies one statement:
+
+    None                     not a probe — run it through the real engine
+    ("ok",)                  answer with an OK packet (SET chatter etc.)
+    ("rows", cols, rows)     answer with a tiny canned resultset
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional
+
+SERVER_VERSION = "8.0.26-horaedb_tpu"
+
+# Variables connectors commonly probe (mysql-connector-java's
+# MYSQL_CONN_JAVA burst above all). Unknown @@vars answer "".
+_VARS = {
+    "version_comment": "horaedb_tpu",
+    "version": SERVER_VERSION,
+    "max_allowed_packet": "67108864",
+    "sql_mode": "",
+    "lower_case_table_names": "0",
+    "autocommit": "ON",
+    "auto_increment_increment": "1",
+    "character_set_client": "utf8mb4",
+    "character_set_connection": "utf8mb4",
+    "character_set_results": "utf8mb4",
+    "character_set_server": "utf8mb4",
+    "collation_server": "utf8mb4_0900_ai_ci",
+    "collation_connection": "utf8mb4_0900_ai_ci",
+    "init_connect": "",
+    "interactive_timeout": "28800",
+    "license": "Apache-2.0",
+    "net_buffer_length": "16384",
+    "net_write_timeout": "60",
+    "have_query_cache": "NO",
+    "performance_schema": "OFF",
+    "query_cache_size": "0",
+    "query_cache_type": "OFF",
+    "system_time_zone": "UTC",
+    "time_zone": "SYSTEM",
+    "transaction_isolation": "REPEATABLE-READ",
+    "tx_isolation": "REPEATABLE-READ",
+    "wait_timeout": "28800",
+}
+
+# A probe is ONLY a comma list where every item is an @@variable —
+# 'SELECT @@autocommit, name FROM servers' is a real query that must
+# reach the engine, not get a canned answer.
+_SELECT_VAR = re.compile(
+    r"(?is)^\s*(?:/\*.*?\*/\s*)*select\s+"
+    r"(@@[\w.]+(?:\s*,\s*@@[\w.]+)*)\s*$"
+)
+_SELECT_VERSION = re.compile(r"(?is)^\s*select\s+version\(\s*\)")
+_SELECT_DATABASE = re.compile(r"(?is)^\s*select\s+database\(\s*\)")
+_SELECT_TIMEDIFF = re.compile(
+    r"(?is)^\s*select\s+timediff\(\s*now\(\s*\)\s*,\s*utc_timestamp\(\s*\)\s*\)"
+)
+_SHOW_VARIABLES = re.compile(
+    r"(?is)^\s*(?:/\*.*?\*/\s*)*show\s+(?:session\s+|global\s+)?variables"
+    r"(?:\s+like\s+'([^']*)')?"
+)
+# Statements answered with a bare OK (session chatter, dump headers,
+# replication probes). Anchored, case-insensitive.
+_OK_PATTERNS = [re.compile(p, re.IGNORECASE | re.DOTALL) for p in (
+    r"^\s*set\s",
+    r"^\s*(begin|commit|rollback)\s*$",
+    r"^\s*use\s+\w+\s*$",
+    r"^\s*/\*![0-9]+\s+set.*\*/\s*$",
+    r"^\s*/\*\s*applicationname=.*\*/\s*set\s",
+    r"^\s*flush\s",
+    r"^\s*lock\s+tables",
+    r"^\s*unlock\s+tables",
+    r"^\s*kill\s+query\s",
+)]
+# Statements answered with an EMPTY resultset (shape-only probes).
+_EMPTY_SET_PATTERNS = [re.compile(p, re.IGNORECASE | re.DOTALL) for p in (
+    r"^\s*show\s+collation",
+    r"^\s*show\s+charset",
+    r"^\s*show\s+character\s+set",
+    r"^\s*show\s+warnings",
+    r"^\s*show\s+errors",
+    r"^\s*show\s+engines",
+    r"^\s*show\s+plugins",
+    r"^\s*show\s+procedure\s+status",
+    r"^\s*show\s+function\s+status",
+    r"^\s*show\s+master\s+status",
+    r"^\s*show\s+(all\s+)?slaves?\s+status",
+    r"^\s*select\s+logfile_group_name.*information_schema\.files",
+    r"^\s*/\*\s*applicationname=.*\*/\s*show\s",
+)]
+
+
+def _strip_comment(sql: str) -> str:
+    return re.sub(r"^\s*/\*.*?\*/\s*", "", sql, flags=re.DOTALL)
+
+
+def check(sql: str) -> Optional[tuple]:
+    """Classify a statement; see module docstring for the return shape."""
+    q = sql.strip().rstrip(";").strip()
+    if not q:
+        return ("ok",)
+    # 'SELECT @@version_comment LIMIT 1' — the limit adds nothing to a
+    # one-row canned answer; strip it before classification.
+    q = re.sub(r"(?i)\s+limit\s+\d+\s*$", "", q)
+    for p in _OK_PATTERNS:
+        if p.match(q):
+            return ("ok",)
+    for p in _EMPTY_SET_PATTERNS:
+        if p.match(q):
+            return ("rows", ["Value"], [])
+    m = _SHOW_VARIABLES.match(q)
+    if m:
+        like = m.group(1)
+        if like is None:
+            rows = [[k, v] for k, v in sorted(_VARS.items())]
+        else:
+            rx = re.compile(
+                "^" + re.escape(like).replace("%", ".*").replace("_", ".") + "$",
+                re.IGNORECASE,
+            )
+            rows = [[k, v] for k, v in sorted(_VARS.items()) if rx.match(k)]
+            if not rows and like and "%" not in like:
+                rows = [[like, ""]]  # unknown var: empty value beats error
+        return ("rows", ["Variable_name", "Value"], rows)
+    if _SELECT_VERSION.match(q):
+        return ("rows", ["version()"], [[SERVER_VERSION]])
+    if _SELECT_DATABASE.match(q):
+        return ("rows", ["database()"], [["public"]])
+    if _SELECT_TIMEDIFF.match(q):
+        off = -time.timezone  # server runs a fixed clock; report the skew
+        sign = "-" if off < 0 else ""
+        off = abs(off)
+        return ("rows", ["TIMEDIFF(NOW(), UTC_TIMESTAMP())"],
+                [[f"{sign}{off // 3600:02d}:{(off % 3600) // 60:02d}:{off % 60:02d}"]])
+    m = _SELECT_VAR.match(q)
+    if m:
+        names = [v.strip() for v in m.group(1).split(",") if v.strip()]
+        cols, vals = [], []
+        for raw in names:
+            var = raw.lstrip("@").split()[0].lower()
+            # session./global. prefixes resolve to the same canned table
+            var = var.split(".", 1)[-1]
+            cols.append(raw)
+            vals.append(_VARS.get(var, ""))
+        return ("rows", cols, [vals])
+    return None

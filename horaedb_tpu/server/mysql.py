@@ -225,7 +225,9 @@ class _Conn:
         salt = secrets.token_bytes(20)
         p = bytearray()
         p += b"\x0a"  # protocol 10
-        p += b"8.0.0-horaedb_tpu\x00"
+        from .federated import SERVER_VERSION
+
+        p += SERVER_VERSION.encode() + b"\x00"  # one version everywhere
         p += (1).to_bytes(4, "little")  # thread id
         p += salt[:8] + b"\x00"
         p += (_SERVER_CAPS & 0xFFFF).to_bytes(2, "little")
@@ -314,14 +316,17 @@ class _Conn:
 
     async def _query(self, sql: str) -> None:
         q = sql.strip().rstrip(";")
-        lowered = q.lower()
-        # connector session chatter answers locally (ref: federated.rs —
-        # the reference fakes the same compatibility queries)
-        if lowered.startswith(("set ", "set\t")) or lowered in ("begin", "commit", "rollback"):
-            self._ok()
-            return
-        if lowered in ("select @@version_comment limit 1", "select version()"):
-            self._result_set(["version()"], [["8.0.0-horaedb_tpu"]])
+        # Connector session chatter answers locally with canned shapes
+        # (ref: federated.rs — real clients open with a probe burst and
+        # refuse to connect if any of them errors).
+        from .federated import check as federated_check
+
+        fed = federated_check(q)
+        if fed is not None:
+            if fed[0] == "ok":
+                self._ok()
+            else:
+                self._result_set(fed[1], fed[2])
             return
         # The shared gateway applies routing, fences, limiter, metrics —
         # wire traffic gets the same discipline as HTTP /sql.

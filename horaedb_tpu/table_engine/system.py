@@ -1,0 +1,108 @@
+"""System catalog virtual tables
+(ref: src/system_catalog/src/tables.rs — ``system.public.tables`` lists
+every user table as rows (timestamp, catalog, schema, table_name,
+table_id, engine); served straight from the catalog manager, never
+stored).
+
+The virtual table implements the same ``Table`` interface real tables
+do, so the whole query layer — projections, filters, aggregates, EXPLAIN
+— works on it unchanged. Reads materialize a fresh RowGroup from the
+catalog registry on every scan (the listing IS the current state).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common_types import ColumnSchema, DatumKind, RowGroup, Schema
+from .table import Table, TableOptions
+
+TABLES_NAME = "system.public.tables"
+
+_TABLES_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("catalog", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("schema", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("table_name", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("table_id", DatumKind.UINT64, is_nullable=False),
+        ColumnSchema("engine", DatumKind.STRING, is_nullable=False),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "catalog", "schema", "table_name"],
+)
+
+
+class SystemTablesTable(Table):
+    """``system.public.tables`` (read-only)."""
+
+    def __init__(self, catalog) -> None:
+        self.catalog = catalog
+        self._options = TableOptions()
+
+    @property
+    def name(self) -> str:
+        return TABLES_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _TABLES_SCHEMA
+
+    @property
+    def options(self) -> TableOptions:
+        return self._options
+
+    def write(self, rows) -> int:
+        raise ValueError(f"{TABLES_NAME} is read-only")
+
+    def read(self, predicate=None, projection=None) -> RowGroup:
+        names = sorted(self.catalog.table_names())
+        ids = []
+        for n in names:
+            e = self.catalog.entry(n)
+            ids.append(int(e.table_id) if e is not None else 0)
+        rows = RowGroup(
+            _TABLES_SCHEMA,
+            {
+                "timestamp": np.zeros(len(names), dtype=np.int64),
+                "catalog": np.array(["horaedb"] * len(names), dtype=object),
+                "schema": np.array(["public"] * len(names), dtype=object),
+                "table_name": np.array(names, dtype=object),
+                "table_id": np.array(ids, dtype=np.uint64),
+                "engine": np.array(["Analytic"] * len(names), dtype=object),
+            },
+        )
+        if predicate is not None:
+            # The executor drops timestamp conjuncts from its residual
+            # WHERE on the promise that storage applied the time range
+            # exactly — honor that promise here too.
+            tr = predicate.time_range
+            ts = rows.timestamps
+            mask = (ts >= tr.inclusive_start) & (ts < tr.exclusive_end)
+            if not mask.all():
+                rows = rows.take(np.nonzero(mask)[0])
+        if projection is not None:
+            from ..engine.merge import project_schema
+
+            proj = project_schema(rows.schema, projection)
+            rows = RowGroup(
+                proj, {c.name: rows.columns[c.name] for c in proj.columns}
+            )
+        return rows
+
+    def flush(self) -> None:
+        pass
+
+    def compact(self) -> None:
+        pass
+
+    def alter_schema(self, schema) -> None:
+        raise ValueError(f"{TABLES_NAME} is read-only")
+
+
+def open_system_table(catalog, name: str):
+    """The catalog's virtual-table hook: a Table for system names, else
+    None (regular resolution proceeds)."""
+    if name.lower() == TABLES_NAME:
+        return SystemTablesTable(catalog)
+    return None

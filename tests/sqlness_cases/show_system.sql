@@ -9,3 +9,10 @@ EXISTS TABLE nope;
 DROP TABLE s2;
 SHOW TABLES;
 DROP TABLE s1;
+
+-- system catalog virtual table (ref: system_catalog/src/tables.rs)
+CREATE TABLE s3 (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+SELECT catalog, schema, table_name, engine FROM system.public.tables;
+SELECT count(1) AS n FROM system.public.tables WHERE table_name = 's3';
+DROP TABLE s3;
+SELECT table_name FROM system.public.tables;

@@ -1,0 +1,215 @@
+"""System catalog virtual tables + MySQL federated compatibility probes
+(ref: src/system_catalog/src/tables.rs — system.public.tables;
+src/server/src/federated.rs — connector session-probe answers)."""
+
+from __future__ import annotations
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.server.federated import SERVER_VERSION, check
+
+
+@pytest.fixture()
+def conn():
+    c = horaedb_tpu.connect(None)
+    c.execute(
+        "CREATE TABLE demo (name string TAG, value double, t timestamp KEY) "
+        "ENGINE=Analytic"
+    )
+    c.execute(
+        "CREATE TABLE cpu (host string TAG, usage double, t timestamp KEY) "
+        "ENGINE=Analytic"
+    )
+    yield c
+    c.close()
+
+
+class TestSystemTables:
+    def test_lists_all_tables_with_reference_shape(self, conn):
+        rows = conn.execute(
+            "SELECT timestamp, catalog, schema, table_name, table_id, engine "
+            "FROM system.public.tables"
+        ).to_pylist()
+        assert [r["table_name"] for r in rows] == ["cpu", "demo"]
+        for r in rows:
+            assert r["catalog"] == "horaedb"
+            assert r["schema"] == "public"
+            assert r["engine"] == "Analytic"
+            assert r["table_id"] > 0
+
+    def test_filters_and_aggregates_work(self, conn):
+        out = conn.execute(
+            "SELECT count(1) AS c FROM system.public.tables"
+        ).to_pylist()
+        assert out[0]["c"] == 2
+        out = conn.execute(
+            "SELECT table_name FROM system.public.tables "
+            "WHERE table_name = 'demo'"
+        ).to_pylist()
+        assert [r["table_name"] for r in out] == ["demo"]
+
+    def test_reflects_ddl_immediately(self, conn):
+        conn.execute(
+            "CREATE TABLE extra (a string TAG, v double, t timestamp KEY) "
+            "ENGINE=Analytic"
+        )
+        names = [
+            r["table_name"] for r in conn.execute(
+                "SELECT table_name FROM system.public.tables"
+            ).to_pylist()
+        ]
+        assert "extra" in names
+        conn.execute("DROP TABLE extra")
+        names = [
+            r["table_name"] for r in conn.execute(
+                "SELECT table_name FROM system.public.tables"
+            ).to_pylist()
+        ]
+        assert "extra" not in names
+
+    def test_read_only(self, conn):
+        # INSERT doesn't even parse a dotted target (system tables are
+        # unreachable from the write path); the Table guard backs it up.
+        with pytest.raises(Exception, match="read-only|expected VALUES"):
+            conn.execute(
+                "INSERT INTO system.public.tables (table_name) VALUES ('x')"
+            )
+        from horaedb_tpu.table_engine.system import SystemTablesTable
+
+        with pytest.raises(ValueError, match="read-only"):
+            SystemTablesTable(conn.catalog).write(None)
+
+    def test_unknown_system_table_is_not_found(self, conn):
+        with pytest.raises(Exception, match="not found"):
+            conn.execute("SELECT 1 FROM system.public.nope")
+
+    def test_timestamp_filter_applies(self, conn):
+        # The executor trusts storage for timestamp conjuncts — the
+        # virtual table must actually apply them.
+        out = conn.execute(
+            "SELECT table_name FROM system.public.tables WHERE timestamp > 100"
+        ).to_pylist()
+        assert out == []
+        out = conn.execute(
+            "SELECT table_name FROM system.public.tables WHERE timestamp >= 0"
+        ).to_pylist()
+        assert len(out) == 2
+
+    def test_dotted_user_table_name_still_reachable(self, conn):
+        conn.execute(
+            'CREATE TABLE `a.b` (g string TAG, v double, t timestamp KEY) '
+            "ENGINE=Analytic"
+        )
+        conn.execute('INSERT INTO `a.b` (g, v, t) VALUES (\'x\', 1.5, 10)')
+        out = conn.execute('SELECT v FROM `a.b`').to_pylist()
+        assert [r["v"] for r in out] == [1.5]
+
+    def test_join_with_qualified_table(self, conn):
+        conn.execute(
+            "INSERT INTO demo (name, value, t) VALUES ('a', 1.0, 10)"
+        )
+        conn.execute(
+            "INSERT INTO cpu (host, usage, t) VALUES ('a', 9.0, 10)"
+        )
+        out = conn.execute(
+            "SELECT demo.name, cpu.usage FROM demo "
+            "INNER JOIN public.cpu ON demo.name = cpu.host"
+        ).to_pylist()
+        assert out == [{"name": "a", "usage": 9.0}]
+
+    def test_schema_qualified_name_resolves(self, conn):
+        out = conn.execute("SELECT count(1) AS c FROM public.demo").to_pylist()
+        assert out[0]["c"] == 0
+        out = conn.execute(
+            "SELECT count(1) AS c FROM horaedb.public.demo"
+        ).to_pylist()
+        assert out[0]["c"] == 0
+
+
+class TestFederatedProbes:
+    def test_select_version_comment(self):
+        kind, cols, rows = check("SELECT @@version_comment LIMIT 1")
+        assert cols == ["@@version_comment"]
+        assert rows == [["horaedb_tpu"]]
+
+    def test_select_multiple_vars(self):
+        # the mysql-connector-java opening burst shape
+        kind, cols, rows = check(
+            "SELECT @@session.auto_increment_increment, @@character_set_client, "
+            "@@max_allowed_packet"
+        )
+        assert len(cols) == 3 and len(rows[0]) == 3
+        assert rows[0][2] == "67108864"
+
+    def test_select_version_and_database(self):
+        assert check("SELECT version()")[2] == [[SERVER_VERSION]]
+        assert check("select DATABASE()")[2] == [["public"]]
+
+    def test_timediff_probe(self):
+        kind, cols, rows = check("SELECT TIMEDIFF(NOW(), UTC_TIMESTAMP())")
+        assert kind == "rows" and ":" in rows[0][0]
+
+    def test_show_variables_like(self):
+        kind, cols, rows = check("SHOW VARIABLES LIKE 'lower_case_table_names'")
+        assert cols == ["Variable_name", "Value"]
+        assert rows == [["lower_case_table_names", "0"]]
+        kind, cols, rows = check("SHOW VARIABLES LIKE 'character_set%'")
+        assert len(rows) >= 3
+        kind, cols, rows = check("SHOW VARIABLES")
+        assert len(rows) > 10
+
+    def test_set_and_transaction_chatter_is_ok(self):
+        for q in (
+            "SET NAMES utf8mb4",
+            "SET character_set_results = NULL",
+            "SET autocommit=1",
+            "set sql_mode='STRICT_TRANS_TABLES'",
+            "BEGIN", "COMMIT", "ROLLBACK",
+            "USE public",
+            "/*!40101 SET NAMES utf8 */",
+        ):
+            assert check(q) == ("ok",), q
+
+    def test_shape_only_probes_get_empty_sets(self):
+        for q in (
+            "SHOW COLLATION",
+            "SHOW WARNINGS",
+            "SHOW ENGINES",
+            "SHOW MASTER STATUS",
+            "/* ApplicationName=DBeaver */ SHOW PLUGINS",
+        ):
+            kind, cols, rows = check(q)
+            assert kind == "rows" and rows == [], q
+
+    def test_real_queries_pass_through(self):
+        for q in (
+            "SELECT * FROM demo",
+            "SELECT name, avg(value) FROM demo GROUP BY name",
+            "INSERT INTO demo (name) VALUES ('x')",
+            "CREATE TABLE t (a string TAG, ts timestamp KEY)",
+            "SHOW TABLES",
+            "SETTINGS_TABLE_QUERY",  # name starting with SET must not match
+            # mixing a session var with table data is a REAL query — the
+            # canned answer must not hijack it
+            "SELECT @@autocommit, name FROM servers",
+        ):
+            assert check(q) is None, q
+
+    def test_dotted_table_not_shadowed_by_bare_sibling(self):
+        c = horaedb_tpu.connect(None)
+        c.execute(
+            'CREATE TABLE `public.x` (g string TAG, v double, t timestamp KEY) '
+            "ENGINE=Analytic"
+        )
+        c.execute(
+            "CREATE TABLE x (g string TAG, v double, t timestamp KEY) "
+            "ENGINE=Analytic"
+        )
+        c.execute("INSERT INTO `public.x` (g, v, t) VALUES ('dotted', 1.0, 1)")
+        c.execute("INSERT INTO x (g, v, t) VALUES ('bare', 2.0, 1)")
+        out = c.execute('SELECT g FROM `public.x`').to_pylist()
+        assert [r["g"] for r in out] == ["dotted"]
+        out = c.execute("SELECT g FROM x").to_pylist()
+        assert [r["g"] for r in out] == ["bare"]
+        c.close()
