@@ -28,6 +28,12 @@ from .shard import Shard, ShardError, ShardInfo, ShardSet, ShardState
 logger = logging.getLogger("horaedb_tpu.cluster")
 
 
+def _metrics():
+    from ..utils.metrics import REGISTRY
+
+    return REGISTRY
+
+
 class ClusterImpl:
     def __init__(
         self,
@@ -164,12 +170,20 @@ class ClusterImpl:
                 try:
                     if shard.state is ShardState.READY and now > deadline:
                         shard.freeze()
+                        _metrics().counter(
+                            "cluster_shard_freezes_total",
+                            "shards frozen by the lease watch",
+                        ).inc()
                         logger.warning(
                             "shard %d FROZEN: lease lapsed %.2fs ago",
                             shard.shard_id, now - deadline,
                         )
                     elif shard.state is ShardState.FROZEN and now <= deadline:
                         shard.thaw()
+                        _metrics().counter(
+                            "cluster_shard_thaws_total",
+                            "shards thawed by the lease watch after renewal",
+                        ).inc()
                         logger.info(
                             "shard %d thawed: lease renewed", shard.shard_id
                         )
@@ -230,6 +244,15 @@ class ClusterImpl:
                 self._lease_deadline[shard_id] = max(
                     self._lease_deadline.get(shard_id, 0.0), granted_at + ttl
                 )
+                # Renewal unfences NOW — a shard the watch froze during a
+                # delayed heartbeat must not stay frozen up to a watch
+                # interval after the lease came back.
+                if (shard.state is ShardState.FROZEN
+                        and now <= self._lease_deadline[shard_id]):
+                    try:
+                        shard.thaw()
+                    except ShardError:
+                        pass
             else:
                 self._lease_deadline.setdefault(shard_id, 0.0)
             self._order_applied_at[shard_id] = now

@@ -100,6 +100,13 @@ class Shard:
             self._state = ShardState.INIT
 
     def ensure_writable(self) -> None:
+        if self._state is ShardState.FROZEN:
+            # Frozen IS the fence (lease lapsed, or a transfer in
+            # flight) — say so: operators and clients look for the word.
+            raise ShardError(
+                f"shard {self.shard_id} frozen — write fenced "
+                "(lease lapsed or transfer in progress)"
+            )
         if self._state is not ShardState.READY:
             raise ShardError(
                 f"shard {self.shard_id} not writable (state={self._state.value})"

@@ -173,6 +173,16 @@ class Schema:
     def names(self) -> list[str]:
         return [c.name for c in self.columns]
 
+    def same_columns(self, other: "Schema") -> bool:
+        """Layout-compatible: identical column tuple and timestamp slot.
+        Two such schemas differ only in metadata (primary-key ORDER,
+        version) — rows written against one decode under the other
+        unchanged (the PK-sampler reorder relies on this)."""
+        return (
+            self.columns == other.columns
+            and self.timestamp_index == other.timestamp_index
+        )
+
     # ---- evolution -----------------------------------------------------
     def with_added_column(self, col: ColumnSchema) -> "Schema":
         """ALTER TABLE ADD COLUMN — appends a nullable field column."""

@@ -159,10 +159,16 @@ class Instance:
         if table.dropped:
             raise ValueError(f"table dropped: {table.name}")
         if rows.schema.version != table.schema.version:
-            raise ValueError(
-                f"schema mismatch: table {table.name} v{table.schema.version}, "
-                f"write v{rows.schema.version}"
-            )
+            if table.schema.same_columns(rows.schema):
+                # Metadata-only difference (the sampler's first-flush PK
+                # reorder bumps the version without touching columns):
+                # rewrap instead of failing writers that raced the flush.
+                rows = RowGroup(table.schema, rows.columns, rows.validity)
+            else:
+                raise ValueError(
+                    f"schema mismatch: table {table.name} "
+                    f"v{table.schema.version}, write v{rows.schema.version}"
+                )
         entry = (rows, cf.Future())
         with table.pending_lock:
             table.pending_writes.append(entry)
@@ -216,9 +222,16 @@ class Instance:
                     if table.dropped:
                         raise ValueError(f"table dropped: {table.name}")
                     if merged.schema.version != table.schema.version:
-                        raise ValueError(
-                            f"schema changed mid-write for {table.name}"
-                        )
+                        if table.schema.same_columns(merged.schema):
+                            # first-flush PK reorder raced the queue:
+                            # layout is identical, rewrap and proceed
+                            merged = RowGroup(
+                                table.schema, merged.columns, merged.validity
+                            )
+                        else:
+                            raise ValueError(
+                                f"schema changed mid-write for {table.name}"
+                            )
                     seq = table.alloc_sequence()
                     if self.wal is not None:
                         self.wal.append(table.table_id, seq, merged)

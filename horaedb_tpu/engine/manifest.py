@@ -198,6 +198,13 @@ class Manifest:
             # edits). Exists-then-put is not atomic; the fencing layer is
             # the real guarantee, this narrows the window.
             if not self._append_probed:
+                # The snapshot watermark counts as much as existing log
+                # files: after truncation removed every log, a fresh
+                # handle that appends BEFORE ever loading would otherwise
+                # start at seq 0 and write edits every load skips as
+                # `<= last_log_seq` (the same silent-loss class the
+                # load-path fix covers; caught by the round-trip test).
+                seq = max(seq, self._snapshot_watermark() + 1)
                 while self.store.exists(self._log_path(seq)):
                     seq += 1
                 self._append_probed = True
@@ -210,6 +217,14 @@ class Manifest:
     def snapshot(self) -> None:
         with self._lock:
             self._do_snapshot_locked()
+
+    def _snapshot_watermark(self) -> int:
+        """last_log_seq covered by the persisted snapshot, -1 if none."""
+        try:
+            snap = msgpack.unpackb(self.store.get(self._snapshot_path), raw=False)
+            return int(snap.get("last_log_seq", -1))
+        except FileNotFoundError:
+            return -1
 
     def _do_snapshot_locked(self) -> None:
         state, last_applied = self._load_locked()
@@ -245,7 +260,18 @@ class Manifest:
             for d in msgpack.unpackb(self.store.get(self._log_path(seq)), raw=False):
                 state.apply(_edit_from_dict(d))
             last_applied = seq
-        self._next_log_seq = max(self._next_log_seq, (seqs[-1] + 1) if seqs else 0)
+        # next_log_seq must clear BOTH the surviving log files AND the
+        # snapshot watermark. After a snapshot truncated every log, a
+        # fresh handle that considered only files would restart at seq 0;
+        # its appends would then be `<= last_applied` and silently
+        # SKIPPED by every future load — recovery reverts to the
+        # snapshot, and the orphan sweep deletes the SSTs those invisible
+        # edits referenced (found by the fuzz harness, seed 2).
+        self._next_log_seq = max(
+            self._next_log_seq,
+            (seqs[-1] + 1) if seqs else 0,
+            last_applied + 1,
+        )
         return state, last_applied
 
     def exists(self) -> bool:

@@ -73,10 +73,15 @@ class PrimaryKeySampler:
                 col = rows.columns.get(name)
                 if col is None:
                     continue
-                arr = getattr(col, "codes", None)
-                if arr is not None:
-                    # dict column: distinct CODES == distinct values
-                    seen.update(np.unique(np.asarray(arr)).tolist())
+                codes = getattr(col, "codes", None)
+                if codes is not None:
+                    # Dict column: map codes through THIS batch's vocab —
+                    # code spaces are per-batch and not comparable across
+                    # batches (two batches' code 0 may be different hosts).
+                    vocab = col.values
+                    for c in np.unique(np.asarray(codes)).tolist():
+                        if 0 <= c < len(vocab):
+                            seen.add(vocab[c])
                 else:
                     seen.update(np.unique(np.asarray(col)).tolist())
                 if len(seen) > SAMPLE_DISTINCT_CAP:
@@ -93,7 +98,17 @@ class PrimaryKeySampler:
                 name: (float("inf") if name in self._saturated else len(seen))
                 for name, seen in self._candidates.items()
             }
-        ranked = sorted(counts, key=lambda n: (counts[n], n))
+        # Tie-break by the USER'S declared position, not by name: equal
+        # cardinalities must keep the explicit PRIMARY KEY order (a
+        # reorder with zero pruning benefit would still churn the schema
+        # version).
+        declared = {
+            schema.columns[i].name: pos
+            for pos, i in enumerate(schema.primary_key_indexes)
+        }
+        ranked = sorted(
+            counts, key=lambda n: (counts[n], declared.get(n, 1 << 30))
+        )
         lead = ranked[:MAX_SUGGEST_PRIMARY_KEY_NUM]
         rest = [n for n in ranked if n not in lead]
         tail_idx = [

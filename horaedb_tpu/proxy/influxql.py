@@ -110,6 +110,8 @@ class InfluxSelect:
     soffset: Optional[int] = None
 
     def time_conds(self) -> list[tuple]:
+        """Every time comparison anywhere in the tree (fill-window
+        estimation: widening by OR-branch bounds is safe there)."""
         out = []
 
         def walk(node):
@@ -117,6 +119,26 @@ class InfluxSelect:
                 return
             kind = node[0]
             if kind in ("and", "or"):
+                for c in node[1]:
+                    walk(c)
+            elif kind == "cmp" and node[1].lower() == "time":
+                out.append((node[1], node[2], node[3]))
+
+        walk(self.where)
+        return out
+
+    def guaranteed_time_conds(self) -> list[tuple]:
+        """Time comparisons every matching row MUST satisfy — top-level
+        AND conjuncts only. A bound living under an OR branch constrains
+        only that branch; treating it as global would under-include
+        (e.g. the regex-resolve DISTINCT probe silently dropping rows)."""
+        out = []
+
+        def walk(node):
+            if node is None:
+                return
+            kind = node[0]
+            if kind == "and":
                 for c in node[1]:
                     walk(c)
             elif kind == "cmp" and node[1].lower() == "time":
@@ -448,9 +470,11 @@ def _resolve_regex(conn, sel: InfluxSelect, schema) -> Optional[tuple]:
     time bounds (a dashboard's now()-5m query must not scan all history
     for tag values) and is memoized per column within the statement."""
     ts = schema.timestamp_name
+    # Guaranteed (top-level AND) bounds only: the probe's value set must
+    # be a SUPERSET of what the real query can touch.
     time_where = " AND ".join(
         f"`{ts}` {op} {int(v)}"
-        for _c, op, v in sel.time_conds()
+        for _c, op, v in sel.guaranteed_time_conds()
         if isinstance(v, (int, float))
     )
     distinct_cache: dict[str, list] = {}

@@ -1,0 +1,361 @@
+"""Concurrency fuzz harness — the sanitizer-analog CI target
+(ref model: the reference's ASan/MSan/LSan engine-test builds,
+Makefile:95-114, and its sqlness chaos runs. CPython can't run ASan over
+the engine, so the analog is SYSTEMATIC seeded interleaving stress over
+the FULL stack — SQL/DDL through the connection API down to flush,
+compaction, WAL, recovery — with machine-checked invariants and a
+deadlock watchdog).
+
+    python -m horaedb_tpu.tools.fuzz [--seed N] [--duration S]
+        [--threads K] [--data-dir DIR] [--reopen]
+
+Every run prints ONE JSON line: {"ok": bool, "seed": N, "ops": {...},
+"violations": [...]}. A violation or a watchdog-detected hang exits
+non-zero. The seed makes any failure replayable bit-for-bit.
+
+Invariants:
+- no operation raises outside the ALLOWED set (engine errors that a
+  concurrent interleaving legitimately produces — e.g. dropping a table
+  mid-query — are allowed; TypeError/KeyError/Assertion/segfault-class
+  failures are violations);
+- APPEND accounting: every successfully-inserted row is present at
+  quiesce (no loss, no duplication), even across a --reopen cycle
+  (WAL replay + manifest recovery must conserve rows);
+- readers never observe torn state (a SELECT either errors allowed-ly
+  or returns structurally valid rows).
+"""
+
+from __future__ import annotations
+
+import argparse
+import faulthandler
+import json
+import os
+import sys
+import threading
+import time
+
+
+ALLOWED_ERRORS = (
+    # engine/query errors a legal interleaving can produce
+    "InterpreterError", "ParseError", "PlanError", "ValueError",
+    "ShardError", "FileNotFoundError", "KeyError(",
+)
+
+
+class _ReopenGate:
+    """Shared/exclusive gate: every op runs in SHARED mode; a reopen
+    takes EXCLUSIVE, draining in-flight ops first. Two live engine
+    instances over one data dir is NOT a supported deployment (same
+    single-writer assumption as the reference) — an un-quiesced reopen
+    would fuzz an impossible scenario, not a recovery path. Abrupt-crash
+    recovery (no quiesce) is the subprocess kill -9 suite's job."""
+
+    def __init__(self) -> None:
+        self._c = threading.Condition()
+        self._active = 0
+        self._closed = False
+
+    def __enter__(self):
+        with self._c:
+            while self._closed:
+                self._c.wait()
+            self._active += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self._c:
+            self._active -= 1
+            self._c.notify_all()
+
+    def exclusive(self):
+        gate = self
+
+        class _Excl:
+            def __enter__(self):
+                with gate._c:
+                    while gate._closed:
+                        gate._c.wait()
+                    gate._closed = True
+                    while gate._active:
+                        gate._c.wait()
+                return self
+
+            def __exit__(self, *exc):
+                with gate._c:
+                    gate._closed = False
+                    gate._c.notify_all()
+
+        return _Excl()
+
+
+class Fuzzer:
+    def __init__(self, seed: int, duration_s: float, threads: int,
+                 data_dir: str | None, reopen: bool) -> None:
+        import numpy as np
+
+        self.seed = seed
+        self.duration_s = duration_s
+        self.n_threads = threads
+        self.data_dir = data_dir
+        self.reopen = reopen
+        self.rng = np.random.default_rng(seed)
+        self.stop = threading.Event()
+        self.violations: list[str] = []
+        self.op_counts: dict[str, int] = {}
+        self._counts_lock = threading.Lock()
+        # APPEND accounting: rows successfully inserted per table
+        self.inserted: dict[str, int] = {}
+        self._ins_lock = threading.Lock()
+        self._conn_lock = threading.RLock()  # reopen swaps the connection
+        self._gate = _ReopenGate()  # ops shared / reopen exclusive
+        self.conn = None
+
+    # ---- plumbing --------------------------------------------------------
+    def _record(self, op: str) -> None:
+        with self._counts_lock:
+            self.op_counts[op] = self.op_counts.get(op, 0) + 1
+
+    def _violation(self, msg: str) -> None:
+        with self._counts_lock:
+            self.violations.append(msg[:500])
+
+    def _execute(self, sql: str):
+        with self._conn_lock:
+            conn = self.conn
+        return conn.execute(sql)
+
+    def _guard(self, op: str, fn) -> bool:
+        """Run one op (under the shared gate); classify failures."""
+        try:
+            with self._gate:
+                fn()
+            self._record(op)
+            return True
+        except Exception as e:  # noqa: BLE001 — classification IS the job
+            text = f"{type(e).__name__}: {e}"
+            if any(a in text for a in ALLOWED_ERRORS):
+                self._record(f"{op}_expected_err")
+                return False
+            self._violation(f"{op}: {text}")
+            return False
+
+    # ---- op mix ----------------------------------------------------------
+    def _tables(self) -> list[str]:
+        return [f"fz_{i}" for i in range(4)]
+
+    def _ensure_tables(self) -> None:
+        for t in self._tables():
+            self._execute(
+                f"CREATE TABLE IF NOT EXISTS {t} (host string TAG, "
+                "v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+                "ENGINE=Analytic WITH (update_mode='APPEND', "
+                "segment_duration='2h')"
+            )
+
+    def _op_insert(self, rng) -> None:
+        t = self._tables()[rng.integers(0, 4)]
+        n = int(rng.integers(1, 50))
+        vals = ", ".join(
+            f"('h{rng.integers(0, 8)}', {float(rng.integers(0, 1000))}, "
+            f"{int(rng.integers(0, 7_200_000))})"
+            for _ in range(n)
+        )
+
+        def run():
+            self._execute(f"INSERT INTO {t} (host, v, ts) VALUES {vals}")
+            with self._ins_lock:
+                self.inserted[t] = self.inserted.get(t, 0) + n
+
+        self._guard("insert", run)
+
+    def _op_select(self, rng) -> None:
+        t = self._tables()[rng.integers(0, 4)]
+        q = rng.integers(0, 3)
+        if q == 0:
+            sql = f"SELECT count(1) AS c FROM {t}"
+        elif q == 1:
+            sql = f"SELECT host, avg(v) AS a FROM {t} GROUP BY host"
+        else:
+            sql = f"SELECT v FROM {t} WHERE ts < 3600000 LIMIT 10"
+
+        def run():
+            out = self._execute(sql).to_pylist()
+            assert isinstance(out, list)
+            for r in out:
+                assert isinstance(r, dict) and r, "torn row"
+
+        self._guard("select", run)
+
+    def _op_flush(self, rng) -> None:
+        t = self._tables()[rng.integers(0, 4)]
+
+        def run():
+            with self._conn_lock:
+                conn = self.conn
+            tbl = conn.catalog.open(t)
+            if tbl is not None:
+                tbl.flush()
+
+        self._guard("flush", run)
+
+    def _op_compact(self, rng) -> None:
+        t = self._tables()[rng.integers(0, 4)]
+
+        def run():
+            with self._conn_lock:
+                conn = self.conn
+            tbl = conn.catalog.open(t)
+            if tbl is not None:
+                tbl.compact()
+
+        self._guard("compact", run)
+
+    def _op_ddl_churn(self, rng) -> None:
+        """Create/drop a SCRATCH table (never the accounted ones)."""
+        name = f"fz_scratch_{rng.integers(0, 3)}"
+        if rng.random() < 0.5:
+            self._guard("create", lambda: self._execute(
+                f"CREATE TABLE IF NOT EXISTS {name} (g string TAG, "
+                "v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+                "ENGINE=Analytic"
+            ))
+        else:
+            self._guard("drop", lambda: self._execute(
+                f"DROP TABLE IF EXISTS {name}"
+            ))
+
+    def _op_alter(self, rng) -> None:
+        t = self._tables()[rng.integers(0, 4)]
+        col = f"x{rng.integers(0, 3)}"
+        self._guard("alter", lambda: self._execute(
+            f"ALTER TABLE {t} ADD COLUMN {col} double"
+        ))
+
+    def _op_influx(self, rng) -> None:
+        t = self._tables()[rng.integers(0, 4)]
+
+        def run():
+            from ..proxy.influxql import evaluate
+
+            with self._conn_lock:
+                conn = self.conn
+            evaluate(conn, f'SELECT mean(v) FROM "{t}" GROUP BY time(10m)')
+
+        self._guard("influx", run)
+
+    # ---- reopen cycle ----------------------------------------------------
+    def _op_reopen(self) -> None:
+        """Drain in-flight ops, close, recover, reopen (restart-under-
+        load drill: WAL replay + manifest load while writers keep
+        hammering the moment the gate reopens)."""
+        import horaedb_tpu
+
+        with self._gate.exclusive():
+            with self._conn_lock:
+                try:
+                    self.conn.close()
+                except Exception:
+                    pass
+                self.conn = horaedb_tpu.connect(self.data_dir)
+                self._record("reopen")
+
+    # ---- main loop -------------------------------------------------------
+    def _worker(self, idx: int) -> None:
+        import numpy as np
+
+        rng = np.random.default_rng(self.seed * 1000 + idx)
+        weights = [
+            (0.45, self._op_insert),
+            (0.25, self._op_select),
+            (0.10, self._op_flush),
+            (0.06, self._op_compact),
+            (0.06, self._op_ddl_churn),
+            (0.04, self._op_alter),
+            (0.04, self._op_influx),
+        ]
+        cum = np.cumsum([w for w, _ in weights])
+        while not self.stop.is_set():
+            r = rng.random()
+            for c, (_, fn) in zip(cum, weights):
+                if r <= c:
+                    fn(rng)
+                    break
+
+    def run(self) -> dict:
+        import horaedb_tpu
+
+        # Watchdog: a deadlock anywhere dumps all stacks and kills the
+        # process non-zero (the sanitizer "hang detector").
+        faulthandler.dump_traceback_later(
+            self.duration_s * 3 + 60, exit=True
+        )
+        self.conn = horaedb_tpu.connect(self.data_dir)
+        self._ensure_tables()
+        threads = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True)
+            for i in range(self.n_threads)
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.duration_s
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            if self.reopen and self.data_dir:
+                self._op_reopen()
+        self.stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            if t.is_alive():
+                self._violation(f"worker {t.name} failed to stop (hang)")
+        faulthandler.cancel_dump_traceback_later()
+
+        # Quiesce + invariants.
+        if self.reopen and self.data_dir:
+            self._op_reopen()  # final recovery pass
+        for t in self._tables():
+            try:
+                out = self._execute(f"SELECT count(1) AS c FROM {t}").to_pylist()
+                got = out[0]["c"] if out else 0
+                want = self.inserted.get(t, 0)
+                if got != want:
+                    self._violation(
+                        f"APPEND accounting: {t} has {got} rows, "
+                        f"{want} successfully inserted"
+                    )
+            except Exception as e:  # noqa: BLE001
+                self._violation(f"quiesce count({t}): {type(e).__name__}: {e}")
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        return {
+            "ok": not self.violations,
+            "seed": self.seed,
+            "duration_s": self.duration_s,
+            "threads": self.n_threads,
+            "reopen": bool(self.reopen),
+            "ops": dict(sorted(self.op_counts.items())),
+            "violations": self.violations,
+        }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, default=int(os.environ.get("FUZZ_SEED", "1")))
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--threads", type=int, default=6)
+    p.add_argument("--data-dir", default=None,
+                   help="persistent dir (enables WAL + recovery paths)")
+    p.add_argument("--reopen", action="store_true",
+                   help="cycle close/recover/reopen during the run")
+    args = p.parse_args(argv)
+    out = Fuzzer(
+        args.seed, args.duration, args.threads, args.data_dir, args.reopen
+    ).run()
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

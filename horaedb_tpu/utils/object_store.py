@@ -193,6 +193,22 @@ class DiskCacheStore(ObjectStore):
         self._bg_pool = None
         self.hits = 0
         self.misses = 0
+        # /metrics visibility: prefetch effectiveness is invisible from
+        # timings alone (a useless prefetch just wastes inner-store IO).
+        from .metrics import REGISTRY
+
+        self._m_hits = REGISTRY.counter(
+            "object_store_page_cache_hits_total",
+            "disk page cache hits (all DiskCacheStore instances)",
+        )
+        self._m_misses = REGISTRY.counter(
+            "object_store_page_cache_misses_total",
+            "disk page cache misses (cold fetches from the inner store)",
+        )
+        self._m_prefetch = REGISTRY.counter(
+            "object_store_prefetch_objects_total",
+            "objects queued for background prefetch",
+        )
         self._load_index()
 
     # ---- index -----------------------------------------------------------
@@ -278,6 +294,7 @@ class DiskCacheStore(ObjectStore):
             cached = self._read_cached(name)
             if cached is not None:
                 self.hits += 1
+                self._m_hits.inc()
                 return cached
             with self._lock:
                 ev = self._inflight.get(name)
@@ -293,8 +310,10 @@ class DiskCacheStore(ObjectStore):
             cached = self._read_cached(name)
             if cached is not None:
                 self.hits += 1
+                self._m_hits.inc()
                 return cached
             self.misses += 1
+            self._m_misses.inc()
             start = page * self.page_size
             end = min(start + self.page_size, obj_size)
             payload = self.inner.get_range(path, start, end)
@@ -350,6 +369,7 @@ class DiskCacheStore(ObjectStore):
             cached = self._read_cached(self._cache_name(path, pg))
             if cached is not None:
                 self.hits += 1
+                self._m_hits.inc()
                 byp[pg] = cached
             else:
                 cold.append(pg)
@@ -390,6 +410,7 @@ class DiskCacheStore(ObjectStore):
             except Exception:
                 pass
 
+        self._m_prefetch.inc(len(paths))
         for p in paths:
             self._fetch_pool(background=True).submit(pull, p)
 

@@ -27,8 +27,11 @@ class TestShardStateMachine:
         assert s.state is ShardState.READY
         s.ensure_writable()
         s.freeze()
-        with pytest.raises(ShardError, match="not writable"):
+        with pytest.raises(ShardError, match="write fenced"):
             s.ensure_writable()
+        s.thaw()
+        s.ensure_writable()
+        s.freeze()
         s.close()
         assert s.state is ShardState.INIT
 

@@ -63,6 +63,19 @@ class TestWhereBreadth:
         )
         assert one_series(out)["values"][0][1] == 3
 
+    def test_regex_with_or_time_branches_keeps_all_rows(self, conn):
+        """The DISTINCT probe must use only GUARANTEED time bounds —
+        AND-joining bounds from OR branches yields an empty probe window
+        and silently drops valid rows."""
+        out = evaluate(
+            conn,
+            "SELECT water_level FROM h2o WHERE location =~ /creek/ "
+            "AND (time < 70000ms OR time > 110000ms)",
+        )
+        vals = [v[1] for v in one_series(out)["values"]]
+        # all four creek rows satisfy one branch or the other
+        assert sorted(vals) == [4.0, 6.0, 8.0, 10.0]
+
     def test_regex_matching_nothing_is_empty_not_everything(self, conn):
         out = evaluate(
             conn, "SELECT count(water_level) FROM h2o WHERE location =~ /xyzzy/"

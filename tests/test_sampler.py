@@ -66,6 +66,54 @@ class TestSamplerUnit:
         s.collect(_rows(schema, MIN_SAMPLE_ROWS - 1, 10, 2))
         assert s.suggest(schema) is None
 
+    def test_equal_cardinalities_keep_declared_order(self):
+        """Ties break by the user's declared PK position — a reorder with
+        zero pruning benefit must not churn the schema."""
+        schema = _schema()  # declared: host, region, ts
+        s = PrimaryKeySampler(schema)
+        s.collect(_rows(schema, 2000, n_hosts=4, n_regions=4))  # equal card
+        assert s.suggest(schema) is None
+
+    def test_writes_racing_first_flush_rewrap_not_fail(self, tmp_path):
+        """A write built against schema v1 that lands after the sampler's
+        first-flush reorder installed v2 must be REWRAPPED (same columns,
+        metadata-only change), not rejected."""
+        conn = horaedb_tpu.connect(str(tmp_path / "db"))
+        conn.execute(
+            "CREATE TABLE pk (region string TAG, host string TAG, v double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts), "
+            "PRIMARY KEY(host, region, ts)) ENGINE=Analytic "
+            "WITH (segment_duration='2h')"
+        )
+        t = conn.catalog.open("pk")
+        rng = np.random.default_rng(3)
+        n = 500
+
+        def make_rows(schema):
+            return RowGroup(
+                schema,
+                {
+                    "region": np.array(
+                        [f"r{i}" for i in rng.integers(0, 3, n)], dtype=object
+                    ),
+                    "host": np.array(
+                        [f"h{i}" for i in rng.integers(0, 100, n)], dtype=object
+                    ),
+                    "v": rng.normal(0, 1, n),
+                    "ts": rng.integers(0, 3_600_000, n).astype(np.int64),
+                },
+            )
+
+        v1_schema = t.schema
+        pre_built = make_rows(v1_schema)  # built BEFORE the flush
+        t.write(make_rows(v1_schema))
+        t.flush()  # installs the reordered v2 schema
+        assert t.schema.version == v1_schema.version + 1
+        t.write(pre_built)  # races: v1 rows against v2 table
+        out = conn.execute("SELECT count(1) AS c FROM pk").to_pylist()
+        assert out[0]["c"] == 2 * n
+        conn.close()
+
     def test_matching_order_suggests_nothing(self):
         schema = Schema.build(
             [
@@ -98,6 +146,42 @@ class TestSamplerUnit:
         out = s.suggest(schema)
         names = [out.columns[i].name for i in out.primary_key_indexes]
         assert names[0] == "region"
+
+    def test_dict_columns_count_values_not_codes(self):
+        """Per-batch dict code spaces are not comparable: batch 1's code
+        0 and batch 2's code 0 may be different hosts. Cardinality must
+        come from the mapped VALUES."""
+        from horaedb_tpu.common_types.dict_column import DictColumn
+
+        schema = _schema()
+        s = PrimaryKeySampler(schema)
+        for batch in range(20):
+            n = 50
+            hosts = np.array(
+                [f"h{batch * 10 + i}" for i in range(10)], dtype=object
+            )
+            rows = RowGroup(
+                schema,
+                {
+                    # host: 10 NEW values per batch (200 total), codes 0-9
+                    "host": DictColumn(
+                        np.arange(n, dtype=np.int32) % 10, hosts
+                    ),
+                    # region: the SAME 3 values every batch
+                    "region": DictColumn(
+                        np.arange(n, dtype=np.int32) % 3,
+                        np.array(["r0", "r1", "r2"], dtype=object),
+                    ),
+                    "v": np.zeros(n),
+                    "ts": np.arange(n, dtype=np.int64),
+                },
+            )
+            s.collect(rows)
+        out = s.suggest(schema)
+        names = [out.columns[i].name for i in out.primary_key_indexes]
+        # region (3 values) must lead; code-based counting would have
+        # ranked host at 10 "distinct" and broken the tie wrong
+        assert names == ["region", "host", "ts"]
 
     def test_auto_tsid_table_has_no_candidates(self):
         schema = Schema.build(

@@ -243,7 +243,55 @@ class TestManifest:
         assert m.exists()
         m.destroy()
         assert not m.exists()
-        assert not Manifest(store, 0, 1).exists()
+
+    def test_reopen_after_truncating_snapshot_keeps_new_edits(self):
+        """Silent-data-loss regression (found by tools/fuzz.py seed 2):
+        a snapshot that truncated EVERY log left a fresh handle thinking
+        the next log seq was 0; its appends landed at seqs <= the
+        snapshot watermark and every future load SKIPPED them — recovery
+        reverted to the snapshot and the orphan sweep then deleted the
+        SSTs those invisible edits added."""
+        store = MemoryStore()
+        m = Manifest(store, 0, 1)
+        m.append_edits(self.edits())
+        for i in range(2, 20):  # crosses SNAPSHOT_EVERY_N_LOGS
+            m.append_edits([AddFile(0, mk_meta(i, 0, 100), f"0/1/{i}.sst")])
+        m.snapshot()  # truncates ALL logs; watermark > 0
+
+        # Process restart: new handle must append PAST the watermark.
+        m2 = Manifest(store, 0, 1)
+        st = m2.load()
+        n_before = len(st.levels.files_at(0))
+        m2.append_edits([AddFile(0, mk_meta(100, 0, 100), "0/1/100.sst")])
+        m2.append_edits([RemoveFile(0, 2)])
+
+        # Same handle sees them...
+        st2 = m2.load()
+        assert {h.file_id for h in st2.levels.files_at(0)} == (
+            {h.file_id for h in st.levels.files_at(0)} | {100}
+        ) - {2}
+        # ...and so does the NEXT restart (the bug: these were skipped).
+        st3 = Manifest(store, 0, 1).load()
+        assert len(st3.levels.files_at(0)) == n_before  # +1 added, -1 removed
+        assert 100 in {h.file_id for h in st3.levels.files_at(0)}
+        assert 2 not in {h.file_id for h in st3.levels.files_at(0)}
+
+    def test_snapshot_then_more_snapshots_round_trip(self):
+        """Repeated append/snapshot/reopen cycles never lose edits."""
+        store = MemoryStore()
+        expected: set[int] = set()
+        fid = 1
+        for cycle in range(6):
+            m = Manifest(store, 0, 1)
+            for _ in range(10):
+                m.append_edits([AddFile(0, mk_meta(fid, 0, 100), f"0/1/{fid}.sst")])
+                expected.add(fid)
+                fid += 1
+            if cycle % 2:
+                m.snapshot()
+        st = Manifest(store, 0, 1).load()
+        assert {h.file_id for h in st.levels.files_at(0)} == expected
+        assert Manifest(store, 0, 1).exists()  # snapshot persists
 
     def test_append_after_recover_no_collision(self):
         """Log seq must continue after the highest recovered seq."""
