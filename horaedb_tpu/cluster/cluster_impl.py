@@ -251,6 +251,11 @@ class ClusterImpl:
                         and now <= self._lease_deadline[shard_id]):
                     try:
                         shard.thaw()
+                        # keep freezes - thaws == currently-fenced count
+                        _metrics().counter(
+                            "cluster_shard_thaws_total",
+                            "shards thawed by the lease watch after renewal",
+                        ).inc()
                     except ShardError:
                         pass
             else:

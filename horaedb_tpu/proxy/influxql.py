@@ -28,10 +28,14 @@ exercise):
     SHOW TAG KEYS [FROM m] | TAG VALUES [FROM m] WITH KEY = k
     SHOW FIELD KEYS [FROM m]
 
+    SELECT <aggs|cols> FROM (SELECT ...) [WHERE ...] [GROUP BY ...]
+        — subqueries: the inner statement runs through the normal
+        pipeline; the outer filters/groups/aggregates its output frame
+
 Multiple ';'-separated statements run in order, one result entry each
-(the v1 wire contract). Not yet modeled: InfluxQL subqueries
-(SELECT FROM (SELECT ...)) and mixed raw+aggregate projections — both
-rejected with clear errors.
+(the v1 wire contract). Not yet modeled: mixed raw+aggregate
+projections and transforms over subquery output — rejected with clear
+errors.
 
 Results render in the InfluxDB v1 HTTP shape: one series per group-by
 tag-set with a ``tags`` object, ``time`` first in columns.
@@ -95,8 +99,9 @@ def _tokenize(q: str) -> list[str]:
 #   ("transform", tname, inner_item, param) derivative(mean(x), 1s)
 @dataclass
 class InfluxSelect:
-    measurement: str
+    measurement: Optional[str]  # None when reading FROM a subquery
     items: list
+    sub: Optional["InfluxSelect"] = None  # FROM (SELECT ...)
     # cond tree: ("and"|"or", [children]) | ("cmp", col, op, value)
     #          | ("regex", col, "=~"|"!~", pattern)
     where: Optional[tuple] = None
@@ -178,16 +183,25 @@ class _Parser:
     def parse(self):
         if self.eat("show"):
             return self._show()
+        sel = self.parse_select_only()
+        if self.peek() is not None:
+            raise InfluxQLError(f"unexpected trailing token {self.peek()!r}")
+        return sel
+
+    def parse_select_only(self) -> "InfluxSelect":
         self.expect("select")
         items = self._select_items()
         self.expect("from")
-        m = self.next()
-        if m == "(":
-            raise InfluxQLError(
-                "InfluxQL subqueries (SELECT FROM (SELECT ...)) are not "
-                "supported yet; flatten the query or use SQL"
-            )
-        sel = InfluxSelect(_ident(m), items)
+        if self.peek() == "(":
+            # FROM (SELECT ...): the inner statement runs first; the
+            # outer aggregates over its output frame
+            # (ref: influxql/planner.rs subquery planning).
+            self.next()
+            sub = self.parse_select_only()
+            self.expect(")")
+            sel = InfluxSelect(None, items, sub=sub)
+        else:
+            sel = InfluxSelect(_ident(self.next()), items)
         if self.eat("where"):
             sel.where = self._cond_or()
         if self.eat("group"):
@@ -220,8 +234,6 @@ class _Parser:
             sel.slimit = int(self.next())
         if self.eat("soffset"):
             sel.soffset = int(self.next())
-        if self.peek() is not None:
-            raise InfluxQLError(f"unexpected trailing token {self.peek()!r}")
         return sel
 
     def _show(self):
@@ -812,6 +824,164 @@ def evaluate(conn, query: str) -> dict:
     return {"results": results}
 
 
+def _evaluate_subquery(conn, sel: InfluxSelect) -> dict:
+    """Outer SELECT over the inner statement's output frame.
+
+    The inner runs through the normal pipeline; its series flatten into
+    rows of {tags..., time, value-columns...}. The outer then filters
+    (time / tag / value-column compares), groups by its tags and time
+    buckets, and applies its aggregates over the frame host-side — the
+    reference plans the same shape through nested IOx planners."""
+    # Push the outer's GUARANTEED time bounds into the inner statement —
+    # a dashboard's `... WHERE time > now() - 5m` must not make the inner
+    # GROUP BY scan all history just to have the outer discard it
+    # (reference planners propagate the subquery time range the same way).
+    import dataclasses
+
+    outer_time = [
+        ("cmp", "time", op, v) for _c, op, v in sel.guaranteed_time_conds()
+    ]
+    sub = sel.sub
+    if outer_time:
+        merged = (
+            ("and", [sub.where, *outer_time]) if sub.where is not None
+            else (outer_time[0] if len(outer_time) == 1
+                  else ("and", outer_time))
+        )
+        sub = dataclasses.replace(sub, where=merged)
+    inner_body = _evaluate_one(conn, sub)
+    frame: list[dict] = []
+    tag_keys: set[str] = set()
+    for s in inner_body.get("series", []):
+        tags = s.get("tags", {})
+        tag_keys.update(tags)
+        cols = s["columns"]
+        for row in s["values"]:
+            frame.append({**tags, **dict(zip(cols, row))})
+    name = sel.sub.measurement or (sel.sub.sub and "subquery") or "subquery"
+
+    if not frame:
+        return _series_body([])
+
+    def row_matches(node, r) -> bool:
+        if node is None:
+            return True
+        kind = node[0]
+        if kind == "and":
+            return all(row_matches(c, r) for c in node[1])
+        if kind == "or":
+            return any(row_matches(c, r) for c in node[1])
+        if kind == "regex":
+            _, col, op, pattern = node
+            rx = re.compile(pattern)
+            v = r.get(col)
+            return v is not None and bool(rx.search(str(v))) == (op == "=~")
+        _, col, op, value = node
+        v = r.get("time" if col.lower() == "time" else col)
+        if v is None:
+            return False
+        ops = {
+            "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+            "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+        }
+        try:
+            return ops[op](v, value)
+        except TypeError:
+            return False
+
+    frame = [r for r in frame if row_matches(sel.where, r)]
+    if not frame:
+        return _series_body([])
+
+    # Raw outer projection: passthrough of named columns, one series per
+    # outer GROUP BY tag-set (ungrouped = one untagged series).
+    if not _is_agg_query(sel):
+        cols = [it[1] for it in sel.items if it[0] == "col"]
+        group_tags = [t for t in sel.group_tags if t != "*"]
+        if "*" in sel.group_tags:
+            group_tags = sorted(tag_keys)
+        grouped: dict[tuple, list] = {}
+        for r in frame:
+            key = tuple((t, r.get(t)) for t in group_tags)
+            grouped.setdefault(key, []).append(
+                [r.get("time", 0)] + [r.get(c) for c in cols]
+            )
+        series = []
+        for key in sorted(grouped, key=lambda k: tuple(str(v) for _, v in k)):
+            values = grouped[key]
+            values.sort(key=lambda v: (v[0] is None, v[0]))
+            if sel.order_desc:
+                values = values[::-1]
+            if sel.offset:
+                values = values[sel.offset:]
+            if sel.limit is not None:
+                values = values[: sel.limit]
+            s: dict[str, Any] = {
+                "name": name, "columns": ["time"] + cols, "values": values,
+            }
+            if key:
+                s["tags"] = {t: v for t, v in key}
+            series.append(s)
+        if sel.soffset:
+            series = series[sel.soffset:]
+        if sel.slimit is not None:
+            series = series[: sel.slimit]
+        return _series_body(series)
+
+    flat: list[tuple] = []
+    for it, label in zip(sel.items, _unique_labels(sel.items)):
+        if it[0] == "agg":
+            flat.append((label, it[1], it[2], None))
+        elif it[0] == "agg2":
+            flat.append((label, it[1], it[2], it[3]))
+        else:
+            raise InfluxQLError(
+                "an outer subquery projection must be EITHER all "
+                "aggregates or all raw columns — mixing them (or using "
+                "transforms over subquery output) is not supported"
+            )
+    group_tags = [t for t in sel.group_tags if t != "*"]
+    if "*" in sel.group_tags:
+        group_tags = sorted(tag_keys)
+    width = sel.group_time_ms
+    groups: dict[tuple, dict[int, list]] = {}
+    for r in frame:
+        key = tuple((t, r.get(t)) for t in group_tags)
+        t_val = r.get("time", 0) or 0
+        bucket = (t_val // width) * width if width else 0
+        groups.setdefault(key, {}).setdefault(bucket, []).append(r)
+    labels = [f[0] for f in flat]
+    series = []
+    for key in sorted(groups, key=lambda k: tuple(str(v) for _, v in k)):
+        out_rows = []
+        for b in sorted(groups[key]):
+            rs = groups[key][b]
+            vals = []
+            for label, func, col, param in flat:
+                if col is None and func == "count":
+                    vals.append(len(rs))
+                    continue
+                pairs = [
+                    (r.get(col), r.get("time", 0) or 0)
+                    for r in rs if r.get(col) is not None
+                ]
+                if not pairs:
+                    vals.append(None)
+                    continue
+                v_arr = np.array([p[0] for p in pairs])
+                t_arr = np.array([p[1] for p in pairs])
+                vals.append(_host_agg(func, v_arr, t_arr, param))
+            out_rows.append([b] + vals)
+        s: dict[str, Any] = {
+            "name": name, "columns": ["time"] + labels, "values": out_rows,
+        }
+        if key:
+            s["tags"] = {t: v for t, v in key}
+        series.append(s)
+    return _series_body(_post_series(series, sel, host=True))
+
+
 def _evaluate_one(conn, sel) -> dict:
     if isinstance(sel, tuple):
         if sel[0] == "show_measurements":
@@ -837,6 +1007,8 @@ def _evaluate_one(conn, sel) -> dict:
             )
         return _series_body(_evaluate_show(conn, sel))
 
+    if sel.sub is not None:
+        return _evaluate_subquery(conn, sel)
     table = conn.catalog.open(sel.measurement)
     if table is None:
         return _series_body([])

@@ -259,9 +259,82 @@ class TestShowAndMeta:
         assert out["results"][1]["statement_id"] == 1
         assert out["results"][1]["series"][0]["values"] == [["h2o"]]
 
-    def test_subquery_rejected_clearly(self, conn):
-        with pytest.raises(InfluxQLError, match="subqueries"):
-            evaluate(conn, "SELECT mean(x) FROM (SELECT 1)")
+    def test_subquery_max_of_means(self, conn):
+        """The canonical influx subquery: max over bucketed means."""
+        out = evaluate(
+            conn,
+            "SELECT max(mean) FROM (SELECT mean(water_level) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m))",
+        )
+        # bucket means: 8, 6, 10, 4 -> max 10
+        assert one_series(out)["values"][0][1] == 10.0
+
+    def test_subquery_outer_group_by_tag(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT max(mean) FROM (SELECT mean(water_level) FROM h2o "
+            "GROUP BY location, time(1m)) GROUP BY location",
+        )
+        by = {s["tags"]["location"]: s["values"][0][1]
+              for s in out["results"][0]["series"]}
+        assert by == {"coyote_creek": 10.0, "santa_monica": 7.0}
+
+    def test_subquery_outer_where_on_inner_column(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT count(mean) FROM (SELECT mean(water_level) FROM h2o "
+            "GROUP BY location, time(1m)) WHERE mean > 5",
+        )
+        # creek means 8,6,10 qualify (not 4); monica 7 qualifies (not 2,3)
+        assert one_series(out)["values"][0][1] == 4
+
+    def test_subquery_raw_passthrough(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT mean FROM (SELECT mean(water_level) FROM h2o "
+            "WHERE location = 'santa_monica' GROUP BY time(1m)) LIMIT 2",
+        )
+        assert [v[1] for v in one_series(out)["values"]] == [2.0, 3.0]
+
+    def test_subquery_raw_with_outer_group_by_keeps_tags(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT mean FROM (SELECT mean(water_level) FROM h2o "
+            "GROUP BY location, time(1m)) GROUP BY location",
+        )
+        series = out["results"][0]["series"]
+        tags = {s["tags"]["location"] for s in series}
+        assert tags == {"coyote_creek", "santa_monica"}
+        creek = next(s for s in series if s["tags"]["location"] == "coyote_creek")
+        assert [v[1] for v in creek["values"]] == [8.0, 6.0, 10.0, 4.0]
+
+    def test_subquery_outer_time_bound_pushed_down(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT count(mean) FROM (SELECT mean(water_level) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m)) "
+            "WHERE time < 120000ms",
+        )
+        assert one_series(out)["values"][0][1] == 2  # buckets 0 and 1m only
+
+    def test_subquery_mixed_projection_rejected(self, conn):
+        with pytest.raises(InfluxQLError, match="all aggregates or all raw"):
+            evaluate(
+                conn,
+                "SELECT mean, max(mean) FROM (SELECT mean(water_level) "
+                "FROM h2o GROUP BY time(1m))",
+            )
+
+    def test_subquery_selector_over_inner(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT percentile(mean, 50), spread(mean) FROM "
+            "(SELECT mean(water_level) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m))",
+        )
+        t, p50, spread = one_series(out)["values"][0]
+        # means [4,6,8,10]: nearest-rank p50 = 6, spread = 6
+        assert (p50, spread) == (6.0, 6.0)
 
 
 class TestReviewRegressions:
