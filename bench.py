@@ -498,8 +498,11 @@ PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "1200"))
 # first answers), on mid-run wedge demotions, and before end-of-run chip
 # retries; each attempt is bounded so a wedged tunnel costs minutes, not
 # the run.
-PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
-PROBE_MAX_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "8"))
+# A WEDGED tunnel hangs the probe child for the full timeout, so the
+# worst case burns attempts x timeout of wall clock — keep the product
+# bounded (~10 min) so probing can't eat the driver's bench budget.
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+PROBE_MAX_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
 
 
 def run_all() -> None:

@@ -824,6 +824,25 @@ def evaluate(conn, query: str) -> dict:
     return {"results": results}
 
 
+def _prune_guaranteed_time(node):
+    """Remove top-level-AND time comparisons (the ones guaranteed_time_
+    conds collects and the subquery pushdown consumed); OR subtrees are
+    untouched — they were never pushed."""
+    if node is None:
+        return None
+    kind = node[0]
+    if kind == "cmp" and node[1].lower() == "time":
+        return None
+    if kind == "and":
+        kept = [c for c in (
+            _prune_guaranteed_time(ch) for ch in node[1]
+        ) if c is not None]
+        if not kept:
+            return None
+        return kept[0] if len(kept) == 1 else ("and", kept)
+    return node
+
+
 def _evaluate_subquery(conn, sel: InfluxSelect) -> dict:
     """Outer SELECT over the inner statement's output frame.
 
@@ -842,6 +861,7 @@ def _evaluate_subquery(conn, sel: InfluxSelect) -> dict:
         ("cmp", "time", op, v) for _c, op, v in sel.guaranteed_time_conds()
     ]
     sub = sel.sub
+    outer_where = sel.where
     if outer_time:
         merged = (
             ("and", [sub.where, *outer_time]) if sub.where is not None
@@ -849,6 +869,12 @@ def _evaluate_subquery(conn, sel: InfluxSelect) -> dict:
                   else ("and", outer_time))
         )
         sub = dataclasses.replace(sub, where=merged)
+        # The pushed bounds apply to the inner DATA, influx-style; they
+        # must NOT be re-applied to the inner's output bucket labels — a
+        # partially-covered first bucket (label < the bound) would be
+        # wrongly discarded. Prune exactly the pushed (top-level AND
+        # time) nodes from the outer filter.
+        outer_where = _prune_guaranteed_time(outer_where)
     inner_body = _evaluate_one(conn, sub)
     frame: list[dict] = []
     tag_keys: set[str] = set()
@@ -858,7 +884,7 @@ def _evaluate_subquery(conn, sel: InfluxSelect) -> dict:
         cols = s["columns"]
         for row in s["values"]:
             frame.append({**tags, **dict(zip(cols, row))})
-    name = sel.sub.measurement or (sel.sub.sub and "subquery") or "subquery"
+    name = sel.sub.measurement or "subquery"
 
     if not frame:
         return _series_body([])
@@ -890,14 +916,22 @@ def _evaluate_subquery(conn, sel: InfluxSelect) -> dict:
         except TypeError:
             return False
 
-    frame = [r for r in frame if row_matches(sel.where, r)]
+    frame = [r for r in frame if row_matches(outer_where, r)]
     if not frame:
         return _series_body([])
 
     # Raw outer projection: passthrough of named columns, one series per
     # outer GROUP BY tag-set (ungrouped = one untagged series).
     if not _is_agg_query(sel):
-        cols = [it[1] for it in sel.items if it[0] == "col"]
+        value_cols = sorted(
+            {k for r in frame for k in r} - tag_keys - {"time"}
+        )
+        cols: list[str] = []
+        for it in sel.items:
+            if it[0] == "star":
+                cols.extend(c for c in value_cols if c not in cols)
+            elif it[0] == "col" and it[1] not in cols:
+                cols.append(it[1])
         group_tags = [t for t in sel.group_tags if t != "*"]
         if "*" in sel.group_tags:
             group_tags = sorted(tag_keys)

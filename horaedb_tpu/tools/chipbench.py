@@ -174,6 +174,70 @@ def main(out_path: str) -> None:
     except Exception as e:
         emit({"stage": "minmax_error", "err": repr(e)[:300]})
 
+    # ---- 5. merge-dedup kernel A/B (device sort vs numpy lexsort) -------
+    # Sets HORAEDB_DEVICE_MERGE_MIN_ROWS on real tunnel RTT: the device
+    # wins only when the sort beats upload+fetch+host-lexsort.
+    try:
+        from horaedb_tpu.ops.merge_dedup import merge_dedup_permutation
+
+        rng = np.random.default_rng(2)
+        for n in (1 << 16, 1 << 20, 1 << 23, 1 << 25):
+            tsid = rng.integers(0, max(16, n // 64), n).astype(np.uint64)
+            ts = rng.integers(0, 7_200_000, n).astype(np.int64)
+            seq = rng.integers(1, 64, n).astype(np.uint64)
+
+            def run_device():
+                merge_dedup_permutation(tsid, ts, seq)
+
+            def run_host():
+                negseq = ~seq
+                negidx = np.arange(n - 1, -1, -1, dtype=np.uint64)
+                order = np.lexsort((negidx, negseq, ts, tsid))
+                s_tsid, s_ts = tsid[order], ts[order]
+                same = (s_tsid[1:] == s_tsid[:-1]) & (s_ts[1:] == s_ts[:-1])
+                np.concatenate([np.ones(1, bool), ~same])
+
+            row = {"ab": "merge_dedup", "n": n}
+            for name, fn in (("device", run_device), ("host", run_host)):
+                try:
+                    row[f"{name}_ms"] = round(timeit(fn, n=3) * 1e3, 3)
+                except Exception as e:
+                    row[f"{name}_err"] = repr(e)[:200]
+            emit(row)
+    except Exception as e:
+        emit({"stage": "merge_error", "err": repr(e)[:300]})
+
+    # ---- 6. bf16 vs f32 cache columns (2x HBM capacity candidate) -------
+    # The scan cache stores f32 value columns; bf16 would double resident
+    # capacity IF the fused kernel's accumulate (done in f32 either way)
+    # doesn't slow down and results stay within agg tolerance.
+    try:
+        rng = np.random.default_rng(3)
+        n, n_seg = 1 << 23, 4096
+        seg = jnp.asarray(rng.integers(0, n_seg, n).astype(np.int32))
+        mask = jnp.asarray(np.ones(n, bool))
+        vals32 = rng.normal(size=(1, n)).astype(np.float32)
+        from horaedb_tpu.ops.scan_agg import _mxu_segment_agg
+
+        for dt, label in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
+            dv = jnp.asarray(vals32).astype(dt)
+
+            def run_dt():
+                r = _mxu_segment_agg(
+                    seg, mask, dv.astype(jnp.float32), n_seg, False
+                )
+                jax.block_until_ready(r[:2])
+
+            try:
+                ms = round(timeit(run_dt, n=5) * 1e3, 3)
+                emit({"ab": "cache_dtype", "dtype": label, "n": n,
+                      "n_seg": n_seg, "ms": ms})
+            except Exception as e:
+                emit({"ab": "cache_dtype", "dtype": label,
+                      "err": repr(e)[:200]})
+    except Exception as e:
+        emit({"stage": "dtype_error", "err": repr(e)[:300]})
+
     emit({"stage": "done", "total_secs": round(time.time() - t0, 1)})
 
 

@@ -94,7 +94,8 @@ class _ReopenGate:
 
 class Fuzzer:
     def __init__(self, seed: int, duration_s: float, threads: int,
-                 data_dir: str | None, reopen: bool) -> None:
+                 data_dir: str | None, reopen: bool,
+                 wal_backend: str = "disk") -> None:
         import numpy as np
 
         self.seed = seed
@@ -102,6 +103,7 @@ class Fuzzer:
         self.n_threads = threads
         self.data_dir = data_dir
         self.reopen = reopen
+        self.wal_backend = wal_backend
         self.rng = np.random.default_rng(seed)
         self.stop = threading.Event()
         self.violations: list[str] = []
@@ -259,7 +261,9 @@ class Fuzzer:
                     self.conn.close()
                 except Exception:
                     pass
-                self.conn = horaedb_tpu.connect(self.data_dir)
+                self.conn = horaedb_tpu.connect(
+                    self.data_dir, wal_backend=self.wal_backend
+                )
                 self._record("reopen")
 
     # ---- main loop -------------------------------------------------------
@@ -292,7 +296,7 @@ class Fuzzer:
         faulthandler.dump_traceback_later(
             self.duration_s * 3 + 60, exit=True
         )
-        self.conn = horaedb_tpu.connect(self.data_dir)
+        self.conn = horaedb_tpu.connect(self.data_dir, wal_backend=self.wal_backend)
         self._ensure_tables()
         threads = [
             threading.Thread(target=self._worker, args=(i,), daemon=True)
@@ -324,6 +328,7 @@ class Fuzzer:
                 "duration_s": self.duration_s,
                 "threads": self.n_threads,
                 "reopen": bool(self.reopen),
+                "wal_backend": self.wal_backend,
                 "ops": dict(sorted(self.op_counts.items())),
                 "violations": self.violations,
             }
@@ -354,6 +359,7 @@ class Fuzzer:
             "duration_s": self.duration_s,
             "threads": self.n_threads,
             "reopen": bool(self.reopen),
+            "wal_backend": self.wal_backend,
             "ops": dict(sorted(self.op_counts.items())),
             "violations": self.violations,
         }
@@ -368,9 +374,13 @@ def main(argv=None) -> int:
                    help="persistent dir (enables WAL + recovery paths)")
     p.add_argument("--reopen", action="store_true",
                    help="cycle close/recover/reopen during the run")
+    p.add_argument("--wal-backend", default="disk",
+                   choices=["disk", "object_store", "shared_log"],
+                   help="WAL implementation to fuzz (persistent dirs only)")
     args = p.parse_args(argv)
     out = Fuzzer(
-        args.seed, args.duration, args.threads, args.data_dir, args.reopen
+        args.seed, args.duration, args.threads, args.data_dir, args.reopen,
+        wal_backend=args.wal_backend,
     ).run()
     print(json.dumps(out))
     return 0 if out["ok"] else 1

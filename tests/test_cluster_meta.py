@@ -51,7 +51,9 @@ def sql(port: int, query: str):
     return http("POST", f"http://127.0.0.1:{port}/sql", {"query": query})
 
 
-def wait_until(fn, timeout=30.0, interval=0.2, desc="condition"):
+def wait_until(fn, timeout=60.0, interval=0.2, desc="condition"):
+    # 60s default: on this 1-core host a loaded run stretches process
+    # startup and heartbeat cadence enough that 30s flaked ~1 in 20.
     deadline = time.monotonic() + timeout
     last = None
     while time.monotonic() < deadline:
@@ -213,7 +215,7 @@ class TestMetaCluster:
                 return shards
             return None
 
-        wait_until(all_on_a, timeout=30, desc="failover to node A")
+        wait_until(all_on_a, timeout=60, desc="failover to node A")
 
         def survivors_serve():
             for i, name in enumerate(("t0", "t1", "t2", "t3")):
@@ -259,7 +261,7 @@ class TestMetaCluster:
             )
             return r if int(r["node"].rsplit(":", 1)[1]) == standby_port else None
 
-        wait_until(reassigned, timeout=30, desc="reassignment away from owner")
+        wait_until(reassigned, timeout=60, desc="reassignment away from owner")
 
         # Queue the write WHILE the owner is still stopped (the kernel
         # completes the handshake and buffers the request), then resume:
@@ -470,7 +472,7 @@ class TestPartitionPlacement:
                 return None
             return shards if len({s["node"] for s in shards}) == 2 else None
 
-        wait_until(balanced, timeout=30, desc="shards spread over both nodes")
+        wait_until(balanced, timeout=60, desc="shards spread over both nodes")
         ddl = (
             "CREATE TABLE ppt (host string TAG, v double, ts timestamp NOT NULL, "
             "TIMESTAMP KEY(ts)) PARTITION BY KEY(host) PARTITIONS 4 ENGINE=Analytic"

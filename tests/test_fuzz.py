@@ -51,3 +51,15 @@ class TestFuzzHarness:
         )
         assert out["ok"], out["violations"]
         assert out["ops"].get("reopen", 0) >= 1
+
+    @pytest.mark.parametrize("backend", ["object_store", "shared_log"])
+    def test_alternative_wal_backends(self, tmp_path, backend):
+        """Row conservation across restarts must hold on every WAL
+        implementation, not just the framed local log."""
+        out = run_fuzz(
+            "--seed", "5", "--duration", "3", "--threads", "3",
+            "--data-dir", str(tmp_path / "fz"), "--reopen",
+            "--wal-backend", backend,
+        )
+        assert out["ok"], out["violations"]
+        assert out["wal_backend"] == backend

@@ -317,6 +317,29 @@ class TestShowAndMeta:
         )
         assert one_series(out)["values"][0][1] == 2  # buckets 0 and 1m only
 
+    def test_subquery_select_star_expands_value_columns(self, conn):
+        out = evaluate(
+            conn,
+            "SELECT * FROM (SELECT mean(water_level) FROM h2o "
+            "WHERE location = 'santa_monica' GROUP BY time(1m))",
+        )
+        s = one_series(out)
+        assert s["columns"] == ["time", "mean"]
+        assert [v[1] for v in s["values"]] == [2.0, 3.0, 7.0]
+
+    def test_subquery_time_bound_keeps_partial_first_bucket(self, conn):
+        """The pushed outer bound applies to inner DATA; the epoch-
+        aligned bucket label (< the bound) must not be re-filtered."""
+        out = evaluate(
+            conn,
+            "SELECT count(mean) FROM (SELECT mean(water_level) FROM h2o "
+            "WHERE location = 'coyote_creek' GROUP BY time(1m)) "
+            "WHERE time >= 30000ms",
+        )
+        # rows at 60000, 120000, 180000 remain -> 3 buckets (the 0-bucket
+        # row at ts 0 is excluded by the data bound, not by its label)
+        assert one_series(out)["values"][0][1] == 3
+
     def test_subquery_mixed_projection_rejected(self, conn):
         with pytest.raises(InfluxQLError, match="all aggregates or all raw"):
             evaluate(

@@ -74,7 +74,7 @@ def http(method, url, payload=None, timeout=10.0):
             return e.code, {}
 
 
-def wait_until(fn, timeout=30.0, desc=""):
+def wait_until(fn, timeout=60.0, desc=""):
     deadline = time.monotonic() + timeout
     last = None
     while time.monotonic() < deadline:

@@ -423,7 +423,7 @@ class TestShardOpsE2E:
         def all_counts_ok():
             return all(v == 20 for v in counts().values())
 
-        wait_until(all_counts_ok, timeout=30, desc="post-split data integrity")
+        wait_until(all_counts_ok, timeout=60, desc="post-split data integrity")
 
         # Migrate the new shard back onto the source node.
         s, mig = http(
@@ -431,7 +431,7 @@ class TestShardOpsE2E:
             {"shard_id": new_sid, "to_node": src_node}, timeout=30,
         )
         assert s == 200, mig
-        wait_until(all_counts_ok, timeout=30, desc="post-migrate data integrity")
+        wait_until(all_counts_ok, timeout=60, desc="post-migrate data integrity")
 
         # Merge it back; shard retires, tables fold into the source shard.
         s, mg = http(
@@ -444,4 +444,4 @@ class TestShardOpsE2E:
         for n in moved:
             s, r = http("GET", f"http://127.0.0.1:{meta_port}/meta/v1/route/{n}")
             assert s == 200 and r["shard_id"] == src_sid
-        wait_until(all_counts_ok, timeout=30, desc="post-merge data integrity")
+        wait_until(all_counts_ok, timeout=60, desc="post-merge data integrity")
