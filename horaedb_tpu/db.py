@@ -58,7 +58,17 @@ class Connection:
             self.instance.flush_table(t)
 
     def close(self) -> None:
-        self.catalog.close()
+        # Catalog close flushes every table, and those flushes may
+        # REQUEST compactions — so the scheduler drain must come after,
+        # or a close-time flush would resurrect a scheduler whose merge
+        # then races the next Connection over the same manifest (two
+        # independent log-sequence counters; the loser's edits are
+        # skipped as stale on load while its input purges survive —
+        # found by the fuzz harness, seed 2).
+        try:
+            self.catalog.close()
+        finally:
+            self.instance.close(wait=True)
 
 
 def connect(

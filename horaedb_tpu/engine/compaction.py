@@ -164,6 +164,12 @@ class Compactor:
         table = self.table
         result = CompactionResult()
         with table.serial_lock:
+            if table.dropped or table.retired:
+                # A background-scheduled compaction may fire after DROP
+                # TABLE (files are gone) or after close_table/shard
+                # handover retired the handle (the next owner's manifest
+                # counter must not race a stale writer's).
+                return result
             self._drop_expired(result, now_ms)
             picker = make_picker(table.options.compaction_strategy)
             # A file can land in two picked tasks (an L1 run spans several
@@ -254,10 +260,12 @@ class Compactor:
             edits.append(RemoveFile(h.level, h.file_id))
         table.manifest.append_edits(edits)
 
-        for nh in new_handles:
-            table.version.levels.add_file(1, nh)
-        for h in task.inputs:
-            table.version.levels.remove_files(h.level, [h.file_id])
+        # One atomic swap: readers (which pin but don't take serial_lock)
+        # must never see the L1 output AND the L0 inputs in one view.
+        table.version.levels.swap_files(
+            [(1, nh) for nh in new_handles],
+            [(h.level, h.file_id) for h in task.inputs],
+        )
         result.files_added += len(new_handles)
         result.files_removed += len(task.inputs)
         # Purge replaced objects.

@@ -1,0 +1,76 @@
+"""Background compaction scheduler
+(ref: analytic_engine/src/compaction/scheduler.rs — flush REQUESTS
+compaction; a background worker picks and runs it, keeping the k-way
+merge cost off the write path. The reference bounds concurrency with
+ScheduleRoom tokens; here a small dedicated pool plus per-table
+dedupe gives the same two properties: writes never block on a merge,
+and one table never has two merges racing).
+
+The scheduler is deliberately tiny: pending-set dedupe (a table already
+queued is not queued again), error isolation (a failed compaction logs
+and the NEXT flush re-requests — the trigger condition still holds), and
+a drain-on-close so process shutdown never abandons a half-scheduled
+merge silently."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+logger = logging.getLogger("horaedb_tpu.engine.compaction")
+
+
+class CompactionScheduler:
+    def __init__(self, run_fn: Callable, workers: int = 1) -> None:
+        self._run_fn = run_fn
+        self._lock = threading.Lock()
+        self._pending: set[tuple[int, int]] = set()
+        self._executor = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="compaction"
+        )
+        self._closed = False
+
+    def request(self, table) -> bool:
+        """Queue a compaction for ``table`` unless one is already queued
+        or running; returns True if newly queued."""
+        key = (table.space_id, table.table_id)
+        # Submit under the lock: close() sets _closed under the same lock
+        # before shutting the executor down, so a request that saw
+        # _closed=False cannot race submit against shutdown (which would
+        # raise RuntimeError into the flushing writer).
+        with self._lock:
+            if self._closed or key in self._pending:
+                return False
+            self._pending.add(key)
+            self._executor.submit(self._run, key, table)
+        return True
+
+    def _run(self, key: tuple[int, int], table) -> None:
+        # Release the dedupe slot BEFORE running: a request that arrives
+        # while the merge runs re-queues (the merge may not cover files
+        # flushed after its pick). Discarding after the run instead
+        # would silently swallow that request — if it was the workload's
+        # last flush, the trigger condition persists with no merge ever
+        # scheduled. A re-queued no-op pick is cheap; a lost trigger is
+        # unbounded read amplification.
+        with self._lock:
+            self._pending.discard(key)
+        try:
+            self._run_fn(table)
+        except Exception:
+            logger.exception(
+                "background compaction failed for table %s (will be "
+                "re-requested by the next flush)", table.name,
+            )
+
+    def close(self, wait: bool = True) -> None:
+        """Stop accepting requests and shut the worker down. ``wait``
+        drains everything queued; without it, queued-but-unstarted merges
+        are CANCELLED and only the one in flight is joined. Either way
+        close never returns with a worker still racing the next
+        instance's manifest appends."""
+        with self._lock:
+            self._closed = True
+        self._executor.shutdown(wait=True, cancel_futures=not wait)

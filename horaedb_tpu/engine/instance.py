@@ -34,6 +34,10 @@ class EngineConfig:
     # Auto-compact after flush once any segment window holds this many L0
     # files (ref: the compaction scheduler's background picking loop).
     compaction_l0_trigger: int = 4
+    # Run triggered compactions on the background scheduler (the
+    # reference's scheduler.rs model: writes never block on a merge).
+    # False = inline after flush (deterministic; some tests want it).
+    background_compaction: bool = True
 
 
 class Instance:
@@ -48,6 +52,8 @@ class Instance:
         self.wal = wal
         self._tables: dict[tuple[int, int], TableData] = {}
         self._lock = threading.RLock()
+        self._compactions = None  # lazy CompactionScheduler
+        self._closed = False
 
     # ---- lifecycle -----------------------------------------------------
     def create_table(
@@ -128,6 +134,14 @@ class Instance:
         # table's serial_lock); never hold _lock across a flush.
         if flush:
             self.flush_table(table)
+        # Fence background compaction before the handle is released: the
+        # close-time flush above may have QUEUED a merge. A merge already
+        # running holds serial_lock, so acquiring it here blocks until
+        # that merge completes; one not yet started sees ``retired`` and
+        # bails. Without this, a shard handover's new owner would race
+        # the stale worker's manifest appends (the fuzz-seed-2 loss).
+        with table.serial_lock:
+            table.retired = True
         with self._lock:
             self._tables.pop((table.space_id, table.table_id), None)
 
@@ -277,11 +291,10 @@ class Instance:
         return result
 
     def maybe_compact(self, table: TableData) -> None:
-        """Compact when some segment window accumulated enough L0 runs.
-
-        Runs inline for now; the runtime layer moves this onto a background
-        executor (ref: compaction/scheduler.rs background loop).
-        """
+        """Request compaction when some segment window accumulated enough
+        L0 runs. The merge itself runs on the background scheduler so the
+        flushing writer returns immediately (ref: compaction/scheduler.rs
+        — flush requests, the scheduler's worker runs)."""
         seg_ms = table.options.segment_duration_ms
         if not seg_ms:
             return
@@ -289,12 +302,42 @@ class Instance:
 
         windows = bucket_by_window(table.version.levels.files_at(0), seg_ms)
         if windows and max(len(v) for v in windows.values()) >= self.config.compaction_l0_trigger:
-            self.compact_table(table)
+            if self.config.background_compaction:
+                scheduler = self._compaction_scheduler()
+                if scheduler is not None:
+                    scheduler.request(table)
+                # After close: skip. The trigger condition persists in the
+                # L0 file set, so the next open's first flush re-requests.
+            else:
+                self.compact_table(table)
+
+    def _compaction_scheduler(self):
+        with self._lock:
+            if self._closed:
+                return None
+            if self._compactions is None:
+                from .compaction_scheduler import CompactionScheduler
+
+                self._compactions = CompactionScheduler(self.compact_table)
+            return self._compactions
 
     def compact_table(self, table: TableData):
         from .compaction import Compactor
 
         return Compactor(table).compact()
+
+    def close(self, wait: bool = True) -> None:
+        """Stop background machinery; with ``wait`` drain queued
+        compactions first (a merge is never abandoned silently).
+
+        Close is TERMINAL: maybe_compact after close is a no-op rather
+        than a lazy scheduler rebirth — a resurrected worker would race
+        the next Instance over the same manifests."""
+        with self._lock:
+            self._closed = True
+            scheduler, self._compactions = self._compactions, None
+        if scheduler is not None:
+            scheduler.close(wait=wait)
 
     def alter_schema(self, table: TableData, schema: Schema) -> None:
         with table.serial_lock:

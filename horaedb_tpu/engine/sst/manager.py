@@ -78,6 +78,25 @@ class LevelsController:
         with self._lock:
             self._levels[level][handle.file_id] = handle
 
+    def swap_files(
+        self,
+        adds: list[tuple[int, FileHandle]],
+        removes: list[tuple[int, int]],
+    ) -> None:
+        """Install compaction outputs and retire inputs in ONE lock
+        acquisition: a concurrent read-view pick sees either the
+        pre-compaction or the post-compaction file set, never both.
+        APPEND-mode reads skip dedup, so a torn view (output installed,
+        inputs not yet removed) would double every merged row."""
+        with self._lock:
+            for level, handle in adds:
+                self.add_file(level, handle)
+            by_level: dict[int, list[int]] = {}
+            for level, fid in removes:
+                by_level.setdefault(level, []).append(fid)
+            for level, fids in by_level.items():
+                self.remove_files(level, fids)
+
     def remove_files(self, level: int, file_ids: list[int]) -> None:
         with self._lock:
             stamped = False
@@ -117,7 +136,11 @@ class LevelsController:
             )
 
     def all_files(self) -> list[FileHandle]:
-        return [h for lvl in range(MAX_LEVEL + 1) for h in self.files_at(lvl)]
+        # One lock acquisition for the WHOLE walk — per-level locking
+        # would let a concurrent swap_files land between levels and show
+        # a read view containing both a merge's inputs and its output.
+        with self._lock:
+            return [h for lvl in range(MAX_LEVEL + 1) for h in self.files_at(lvl)]
 
     def pick_overlapping(self, time_range: TimeRange) -> list[FileHandle]:
         """Read view: every SST whose range overlaps, L0 first (newer data).

@@ -66,6 +66,13 @@ class TableData:
             sampler = PrimaryKeySampler(schema)
             self.pk_sampler = sampler if sampler.has_candidates else None
         self.dropped = False
+        # Set (under serial_lock) when this handle is released without a
+        # drop — close_table / shard handover. A background merge queued
+        # against a retired handle must not run: the next owner appends
+        # manifest edits with its own log-sequence counter, and a stale
+        # writer's edits would be skipped on load while its purges
+        # survive (referenced-SST loss).
+        self.retired = False
 
     # ---- id / sequence allocation -------------------------------------
     def alloc_file_id(self) -> int:
