@@ -34,6 +34,20 @@ from .sst.manager import FileHandle
 from .sst.reader import SstReader
 from .sst.writer import SstWriter, WriteOptions
 from .table_data import TableData
+from ..utils.metrics import REGISTRY
+
+# Registered at import so the series exist from the first scrape.
+_M_COMPACT_SECONDS = REGISTRY.histogram(
+    "engine_compaction_duration_seconds",
+    "wall time of one table compaction pass (tasks > 0)",
+)
+_M_COMPACT_TASKS = REGISTRY.counter(
+    "engine_compaction_tasks_total", "compaction merge tasks run"
+)
+_M_COMPACT_ROWS = REGISTRY.counter(
+    "engine_compaction_rows_written_total",
+    "rows written to merged output SSTs",
+)
 
 
 @dataclass(frozen=True)
@@ -178,6 +192,7 @@ class Compactor:
             # RemoveFile edit twice — skip any task touching an already
             # consumed input and RE-PICK until a pass completes without
             # skips (nothing else schedules a retry on an idle table).
+            t0 = time.perf_counter()
             while True:
                 consumed: set[tuple[int, int]] = set()
                 skipped = False
@@ -191,6 +206,10 @@ class Compactor:
                     result.tasks_run += 1
                 if not (skipped and consumed):
                     break
+            if result.tasks_run:
+                _M_COMPACT_SECONDS.observe(time.perf_counter() - t0)
+                _M_COMPACT_TASKS.inc(result.tasks_run)
+                _M_COMPACT_ROWS.inc(result.rows_written)
         return result
 
     def _drop_expired(self, result: CompactionResult, now_ms: int | None) -> None:

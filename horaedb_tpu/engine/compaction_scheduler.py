@@ -7,10 +7,10 @@ dedupe gives the same two properties: writes never block on a merge,
 and one table never has two merges racing).
 
 The scheduler is deliberately tiny: pending-set dedupe (a table already
-queued is not queued again), error isolation (a failed compaction logs
-and the NEXT flush re-requests — the trigger condition still holds), and
-a drain-on-close so process shutdown never abandons a half-scheduled
-merge silently."""
+queued is not queued again; a request landing mid-merge re-queues),
+error isolation (a failed compaction logs and the NEXT flush
+re-requests — the trigger condition still holds), and a drain-on-close
+so process shutdown never abandons a half-scheduled merge silently."""
 
 from __future__ import annotations
 
@@ -19,7 +19,32 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
+from ..utils.metrics import REGISTRY
+
 logger = logging.getLogger("horaedb_tpu.engine.compaction")
+
+# Register at import so every series exists (as 0) from the first scrape;
+# a rate() over an absent series silently shows nothing instead of 0.
+_M_ACCEPTED = REGISTRY.counter(
+    "engine_compaction_requests_total",
+    "background compaction requests accepted",
+)
+_M_DEDUPED = REGISTRY.counter(
+    "engine_compaction_requests_deduped_total",
+    "compaction requests coalesced into an already-queued one",
+)
+_M_REJECTED_CLOSED = REGISTRY.counter(
+    "engine_compaction_requests_rejected_closed_total",
+    "compaction requests dropped because the scheduler was closed",
+)
+_M_FAILURES = REGISTRY.counter(
+    "engine_compaction_failures_total",
+    "background compactions that raised",
+)
+_M_DEPTH = REGISTRY.gauge(
+    "engine_compaction_queue_depth",
+    "background compactions queued or running",
+)
 
 
 class CompactionScheduler:
@@ -27,10 +52,14 @@ class CompactionScheduler:
         self._run_fn = run_fn
         self._lock = threading.Lock()
         self._pending: set[tuple[int, int]] = set()
+        self._running = 0
         self._executor = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="compaction"
         )
         self._closed = False
+
+    def _update_depth_locked(self) -> None:
+        _M_DEPTH.set(len(self._pending) + self._running)
 
     def request(self, table) -> bool:
         """Queue a compaction for ``table`` unless one is already queued
@@ -41,10 +70,16 @@ class CompactionScheduler:
         # _closed=False cannot race submit against shutdown (which would
         # raise RuntimeError into the flushing writer).
         with self._lock:
-            if self._closed or key in self._pending:
+            if self._closed:
+                _M_REJECTED_CLOSED.inc()
+                return False
+            if key in self._pending:
+                _M_DEDUPED.inc()
                 return False
             self._pending.add(key)
+            self._update_depth_locked()
             self._executor.submit(self._run, key, table)
+        _M_ACCEPTED.inc()
         return True
 
     def _run(self, key: tuple[int, int], table) -> None:
@@ -57,13 +92,20 @@ class CompactionScheduler:
         # unbounded read amplification.
         with self._lock:
             self._pending.discard(key)
+            self._running += 1
+            self._update_depth_locked()
         try:
             self._run_fn(table)
         except Exception:
+            _M_FAILURES.inc()
             logger.exception(
                 "background compaction failed for table %s (will be "
                 "re-requested by the next flush)", table.name,
             )
+        finally:
+            with self._lock:
+                self._running -= 1
+                self._update_depth_locked()
 
     def close(self, wait: bool = True) -> None:
         """Stop accepting requests and shut the worker down. ``wait``
@@ -74,3 +116,9 @@ class CompactionScheduler:
         with self._lock:
             self._closed = True
         self._executor.shutdown(wait=True, cancel_futures=not wait)
+        with self._lock:
+            # Cancelled futures never ran _run; don't leave their pending
+            # entries pinned in the depth gauge forever.
+            self._pending.clear()
+            self._running = 0
+            self._update_depth_locked()

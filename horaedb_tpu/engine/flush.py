@@ -21,16 +21,26 @@ the version only changes after the manifest append succeeds.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from time import perf_counter as _perf_counter
 
 import numpy as np
 
 from ..common_types.row_group import RowGroup
+from ..utils.metrics import REGISTRY
 from .manifest import AddFile, AlterOptions, AlterSchema, Flushed, MetaEdit
 from .memtable import MemTable
 from .options import TableOptions, UpdateMode, suggest_segment_duration
 from .sst.manager import FileHandle
 from .sst.writer import SstWriter, WriteOptions
 from .table_data import TableData
+
+# Registered at import so the series exist from the first scrape.
+_M_FLUSH_SECONDS = REGISTRY.histogram(
+    "engine_flush_duration_seconds", "memtable flush wall time"
+)
+_M_FLUSH_ROWS = REGISTRY.counter(
+    "engine_flush_rows_total", "rows written to L0 by flush"
+)
 
 
 @dataclass
@@ -52,7 +62,11 @@ class Flusher:
             frozen = table.version.immutables()
             if not frozen:
                 return FlushResult(0, 0, table.version.flushed_sequence)
-            return self._dump_memtables(frozen)
+            t0 = _perf_counter()
+            result = self._dump_memtables(frozen)
+            _M_FLUSH_SECONDS.observe(_perf_counter() - t0)
+            _M_FLUSH_ROWS.inc(result.rows_flushed)
+            return result
 
     def _dump_memtables(self, memtables: list[MemTable]) -> FlushResult:
         table = self.table

@@ -27,6 +27,12 @@ logger = logging.getLogger("horaedb_tpu.meta.procedure")
 _K_PROC = "procedure/"
 
 
+def _metric(name: str, help_: str, kind: str, **extra: str):
+    from ..utils.metrics import REGISTRY
+
+    return REGISTRY.counter(name, help_, labels={"kind": kind, **extra})
+
+
 class ProcState(enum.Enum):
     INIT = "init"
     RUNNING = "running"
@@ -192,6 +198,11 @@ class ProcedureManager:
         except Exception as e:
             logger.warning("procedure %s #%d failed (attempt %d): %s",
                            p.kind, p.proc_id, p.attempts, e)
+            _metric(
+                "meta_procedure_retries_total",
+                "procedure attempts that raised (terminal or retried)",
+                p.kind,
+            ).inc()
             if p.attempts >= self.max_attempts:
                 self._transition(p, ProcState.FAILED, error=str(e))
             else:
@@ -212,6 +223,13 @@ class ProcedureManager:
             self._persist(p)
             if state in (ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED):
                 self._retry_at.pop(p.proc_id, None)
+        if state in (ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED):
+            _metric(
+                "meta_procedure_terminal_total",
+                "procedures reaching a terminal state, by kind and outcome",
+                p.kind,
+                outcome=state.value,
+            ).inc()
 
     def _persist(self, p: Procedure) -> None:
         self.kv.put(f"{_K_PROC}{p.proc_id}", p.to_dict())

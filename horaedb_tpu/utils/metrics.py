@@ -2,8 +2,10 @@
 (ref: lazy-static prometheus registries in nearly every reference crate,
 exposed at /metrics — server/src/http.rs:532).
 
-Counters and histograms only (what the serving path needs); text
-exposition format compatible with Prometheus scraping.
+Counters, gauges and histograms (what the serving path needs); text
+exposition format compatible with Prometheus scraping. Counters and
+gauges take optional labels — one HELP/TYPE header per family, one
+sample line per label set (how prometheus-client renders families).
 """
 
 from __future__ import annotations
@@ -13,10 +15,28 @@ from bisect import bisect_right
 from typing import Optional, Sequence
 
 
+def _escape_label_value(v: str) -> str:
+    # Prometheus text format: backslash, double-quote and newline must be
+    # escaped or one bad label value fails the ENTIRE scrape.
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_labels(labels: dict[str, str] | None) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
 class Counter:
-    def __init__(self, name: str, help_: str) -> None:
+    TYPE = "counter"
+
+    def __init__(self, name: str, help_: str, labels: dict[str, str] | None = None) -> None:
         self.name = name
         self.help = help_
+        self.labels = dict(labels) if labels else {}
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -28,12 +48,30 @@ class Counter:
     def value(self) -> float:
         return self._value
 
-    def expose(self) -> str:
-        return (
+    def expose_parts(self) -> tuple[str, str]:
+        header = (
             f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} counter\n"
-            f"{self.name} {self._value}\n"
+            f"# TYPE {self.name} {self.TYPE}\n"
         )
+        body = f"{self.name}{_render_labels(self.labels)} {self._value}\n"
+        return header, body
+
+    def expose(self) -> str:
+        header, body = self.expose_parts()
+        return header + body
+
+
+class Gauge(Counter):
+    """A value that can go down (queue depths, in-flight work)."""
+
+    TYPE = "gauge"
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
 
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
@@ -59,15 +97,16 @@ class Histogram:
     def count(self) -> int:
         return self._total
 
-    def expose(self) -> str:
+    def expose_parts(self) -> tuple[str, str]:
         with self._lock:  # consistent snapshot: buckets must sum to count
             counts = list(self._counts)
             total = self._total
             sum_ = self._sum
-        out = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} histogram",
-        ]
+        header = (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} histogram\n"
+        )
+        out = []
         acc = 0
         for le, c in zip(self.buckets, counts):
             acc += c
@@ -75,7 +114,11 @@ class Histogram:
         out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
         out.append(f"{self.name}_sum {sum_}")
         out.append(f"{self.name}_count {total}")
-        return "\n".join(out) + "\n"
+        return header, "\n".join(out) + "\n"
+
+    def expose(self) -> str:
+        header, body = self.expose_parts()
+        return header + body
 
 
 class Registry:
@@ -83,25 +126,54 @@ class Registry:
         self._metrics: dict[str, Counter | Histogram] = {}
         self._lock = threading.Lock()
 
-    def counter(self, name: str, help_: str = "") -> Counter:
+    def _get(self, key: str, factory, cls):
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = Counter(name, help_)
-                self._metrics[name] = m
-            return m  # type: ignore[return-value]
+                m = factory()
+                self._metrics[key] = m
+            elif type(m) is not cls:
+                # A name registered as one kind silently returned as
+                # another would blow up far from the registration site
+                # (.set on a Counter, .observe on a Gauge) — fail HERE.
+                raise TypeError(
+                    f"metric {key!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help_: str = "",
+                labels: dict[str, str] | None = None) -> Counter:
+        key = name + _render_labels(labels)
+        return self._get(key, lambda: Counter(name, help_, labels), Counter)
+
+    def gauge(self, name: str, help_: str = "",
+              labels: dict[str, str] | None = None) -> Gauge:
+        key = name + _render_labels(labels)
+        return self._get(key, lambda: Gauge(name, help_, labels), Gauge)
 
     def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
-        with self._lock:
-            m = self._metrics.get(name)
-            if m is None:
-                m = Histogram(name, help_, buckets)
-                self._metrics[name] = m
-            return m  # type: ignore[return-value]
+        return self._get(name, lambda: Histogram(name, help_, buckets), Histogram)
 
     def expose(self) -> str:
         with self._lock:
-            return "".join(m.expose() for m in self._metrics.values())
+            metrics = list(self._metrics.values())
+        # Group samples by family: labeled children may have registered
+        # interleaved with other metrics, but the exposition format wants
+        # one HELP/TYPE header followed by ALL of that family's samples.
+        order: list[str] = []
+        families: dict[str, list] = {}
+        for m in metrics:
+            if m.name not in families:
+                families[m.name] = []
+                order.append(m.name)
+            families[m.name].append(m)
+        out: list[str] = []
+        for name in order:
+            members = families[name]
+            out.append(members[0].expose_parts()[0])
+            out.extend(m.expose_parts()[1] for m in members)
+        return "".join(out)
 
 
 REGISTRY = Registry()
