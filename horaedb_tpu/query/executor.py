@@ -635,7 +635,7 @@ class Executor:
                 ki = [k.column for k in tag_keys].index(e.name)
                 columns.append(np.asarray(key_values[ki])[g_idx])
                 names.append(out_name)
-            elif isinstance(e, ast.FuncCall) and e.name == "time_bucket":
+            elif isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc"):
                 columns.append(t0 + b_idx.astype(np.int64) * (width or 1))
                 names.append(out_name)
             else:
@@ -1007,7 +1007,7 @@ class Executor:
             out_name = item.output_name
             e = item.expr
             if isinstance(e, ast.Column) or (
-                isinstance(e, ast.FuncCall) and e.name == "time_bucket"
+                isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc")
             ):
                 # Resolve by the EXPRESSION, not the select item's output
                 # name: an aliased key (SELECT host AS h ... GROUP BY
@@ -1174,10 +1174,24 @@ def _host_agg(
     if a.func not in ("count", "sum", "min", "max", "avg"):
         from .functions import REGISTRY
 
+        if a.distinct:
+            # Silent DISTINCT-dropping would be a wrong answer, not a
+            # missing feature.
+            raise ExprError(f"DISTINCT is not supported with {a.func}")
+        binary_fn = REGISTRY.binary_aggregate(a.func)
+        if binary_fn is not None:
+            return binary_fn(
+                as_values(rows.column(a.column)), rows.valid_mask(a.column),
+                as_values(rows.column(a.column2)), rows.valid_mask(a.column2),
+                codes, group_count,
+            )
         agg_fn = REGISTRY.aggregate(a.func)
         if agg_fn is None:
             raise ExprError(f"unknown aggregate {a.func}")
-        return agg_fn(rows.column(a.column), rows.valid_mask(a.column), codes, group_count)
+        return agg_fn(
+            rows.column(a.column), rows.valid_mask(a.column),
+            codes, group_count, *a.params,
+        )
     col = as_values(rows.column(a.column))
     valid = rows.valid_mask(a.column)
     if a.distinct:

@@ -28,13 +28,20 @@ class FunctionRegistry:
     Scalar signature:   fn(args, rows) -> (values, valid_mask)
         where ``args`` is a list of (values, valid_mask) pairs already
         evaluated, and ``rows`` the source RowGroup (for length/schema).
-    Aggregate signature: fn(values, valid, codes, n_groups)
+    Aggregate signature: fn(values, valid, codes, n_groups, *params)
+        -> (per-group values, per-group null mask | None)
+        where ``params`` are trailing LITERAL arguments from the call
+        (e.g. the 0.9 of approx_percentile_cont(v, 0.9)).
+    Binary aggregate signature (two-column aggregates — corr, covar):
+        fn(v1, valid1, v2, valid2, codes, n_groups)
         -> (per-group values, per-group null mask | None)
     """
 
     def __init__(self) -> None:
         self._scalars: dict[str, Callable] = {}
         self._aggregates: dict[str, Callable] = {}
+        self._binary_aggregates: dict[str, Callable] = {}
+        self._numeric_only: set[str] = set()
         self._lock = threading.Lock()
 
     # ---- registration ---------------------------------------------------
@@ -42,9 +49,27 @@ class FunctionRegistry:
         with self._lock:
             self._scalars[name.lower()] = (fn, raw_args)
 
-    def register_aggregate(self, name: str, fn: Callable) -> None:
+    def register_aggregate(
+        self, name: str, fn: Callable, numeric_only: bool = False
+    ) -> None:
         with self._lock:
             self._aggregates[name.lower()] = fn
+            if numeric_only:
+                self._numeric_only.add(name.lower())
+
+    def register_binary_aggregate(
+        self, name: str, fn: Callable, numeric_only: bool = True
+    ) -> None:
+        with self._lock:
+            self._binary_aggregates[name.lower()] = fn
+            if numeric_only:
+                self._numeric_only.add(name.lower())
+
+    def numeric_only(self, name: str) -> bool:
+        """True if the aggregate's column arguments must be numeric — the
+        planner rejects string columns up front instead of letting numpy
+        die mid-execution."""
+        return name.lower() in self._numeric_only
 
     # ---- lookup ---------------------------------------------------------
     def scalar(self, name: str):
@@ -53,17 +78,20 @@ class FunctionRegistry:
     def aggregate(self, name: str):
         return self._aggregates.get(name.lower())
 
+    def binary_aggregate(self, name: str):
+        return self._binary_aggregates.get(name.lower())
+
     def aggregate_names(self) -> set[str]:
-        return set(self._aggregates)
+        return set(self._aggregates) | set(self._binary_aggregates)
 
 
 # ---- built-ins -----------------------------------------------------------
 
 
 def _time_bucket(args, rows):
-    """time_bucket(ts, '1h') — ALSO compiled into the device kernel's
-    bucket stage when it appears as a group key; this host form covers
-    projections and fallbacks."""
+    """time_bucket(ts, '1h' | <ms>) — ALSO compiled into the device
+    kernel's bucket stage when it appears as a group key; this host form
+    covers projections and fallbacks."""
     from ..engine.options import parse_duration_ms
     from . import ast
 
@@ -71,7 +99,41 @@ def _time_bucket(args, rows):
     (ts_vals, ts_valid), width_expr = args
     if not isinstance(width_expr, ast.Literal):
         raise FunctionError("time_bucket width must be a literal duration")
-    width = parse_duration_ms(width_expr.value)
+    if isinstance(width_expr.value, str):
+        width = parse_duration_ms(width_expr.value)
+    else:
+        width = int(width_expr.value)
+    if width <= 0:
+        raise FunctionError("time_bucket width must be positive")
+    return (ts_vals // width) * width, ts_valid
+
+
+def _date_trunc(args, rows):
+    """date_trunc('minute', ts) — the fixed-width units, truncating to the
+    bucket start in ms (the group-key form rides the device bucket stage).
+
+    Registered raw_args: the convention evaluates args[0] and passes the
+    rest as raw AST, so the evaluated unit arrives as a broadcast string
+    array and the timestamp expression is evaluated here."""
+    from . import ast
+    from .executor import eval_expr
+
+    (unit_vals, _), ts_expr = args
+    if len(unit_vals) == 0:
+        # Zero input rows: the unit broadcast is empty too — an empty
+        # result, not a type error.
+        return np.empty(0, dtype=np.int64), np.empty(0, dtype=bool)
+    unit = unit_vals[0]
+    if not isinstance(unit, str):
+        raise FunctionError("date_trunc unit must be a string literal")
+    from .planner import _DATE_TRUNC_MS
+
+    width = _DATE_TRUNC_MS.get(unit.lower())
+    if width is None:
+        raise FunctionError(f"unsupported date_trunc unit {unit!r}")
+    if not isinstance(ts_expr, ast.Column):
+        raise FunctionError("date_trunc expects a timestamp column")
+    ts_vals, ts_valid = eval_expr(ts_expr, rows)
     return (ts_vals // width) * width, ts_valid
 
 
@@ -105,11 +167,139 @@ def _thetasketch_distinct(values, valid, codes, n_groups):
     return out, None
 
 
+# ---- statistical aggregates ----------------------------------------------
+# (ref surface: the reference exposes DataFusion's built-in statistical
+# aggregates through SQL — stddev/variance/median/approx_* families,
+# datafusion/physical-expr aggregates; exact column shapes here since a
+# single node aggregates post-scan.)
+
+
+def _moments(values, valid, codes, n_groups):
+    vals = np.asarray(values, dtype=np.float64)
+    w = valid.astype(np.float64)
+    n = np.bincount(codes, weights=w, minlength=n_groups)
+    s1 = np.bincount(codes, weights=np.where(valid, vals, 0.0), minlength=n_groups)
+    s2 = np.bincount(codes, weights=np.where(valid, vals * vals, 0.0), minlength=n_groups)
+    return n, s1, s2
+
+
+def _variance(values, valid, codes, n_groups, ddof: int):
+    n, s1, s2 = _moments(values, valid, codes, n_groups)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mean = s1 / n
+        # Centered form: E[x^2] - mean^2 scaled to the ddof denominator;
+        # clip the tiny negatives f64 cancellation can produce.
+        var = np.maximum(s2 / n - mean * mean, 0.0) * (n / (n - ddof))
+    null = n <= ddof
+    return np.where(null, np.nan, var), (null if null.any() else None)
+
+
+def _make_variance(ddof: int, sqrt: bool):
+    def agg(values, valid, codes, n_groups):
+        var, null = _variance(values, valid, codes, n_groups, ddof)
+        return (np.sqrt(var) if sqrt else var), null
+
+    return agg
+
+
+def _per_group_reduce(values, valid, codes, n_groups, fn):
+    """``fn`` maps each group's non-empty f64 slice to a scalar. One
+    argsort partitions the rows so total cost is O(n log n + n_groups),
+    not O(n_groups * n) full-array masks per group."""
+    vals = np.asarray(values, dtype=np.float64)
+    out = np.full(n_groups, np.nan)
+    null = np.ones(n_groups, dtype=bool)
+    idx = np.nonzero(valid)[0]
+    if len(idx):
+        c = codes[idx]
+        order = np.argsort(c, kind="stable")
+        sv = vals[idx][order]
+        sc = c[order]
+        gids = np.arange(n_groups)
+        starts = np.searchsorted(sc, gids)
+        ends = np.searchsorted(sc, gids, side="right")
+        for g in gids:
+            if ends[g] > starts[g]:
+                out[g] = fn(sv[starts[g]:ends[g]])
+                null[g] = False
+    return out, (null if null.any() else None)
+
+
+def _median(values, valid, codes, n_groups):
+    return _per_group_reduce(values, valid, codes, n_groups, np.median)
+
+
+def _make_percentile():
+    def agg(values, valid, codes, n_groups, q=0.5):
+        q = float(q)
+        if not 0.0 <= q <= 1.0:
+            raise FunctionError("percentile must be in [0, 1]")
+        return _per_group_reduce(
+            values, valid, codes, n_groups, lambda gv: np.quantile(gv, q)
+        )
+
+    return agg
+
+
+def _covar(v1, valid1, v2, valid2, codes, n_groups, ddof: int):
+    both = valid1 & valid2
+    x = np.asarray(v1, dtype=np.float64)
+    y = np.asarray(v2, dtype=np.float64)
+    w = both.astype(np.float64)
+    n = np.bincount(codes, weights=w, minlength=n_groups)
+    sx = np.bincount(codes, weights=np.where(both, x, 0.0), minlength=n_groups)
+    sy = np.bincount(codes, weights=np.where(both, y, 0.0), minlength=n_groups)
+    sxy = np.bincount(codes, weights=np.where(both, x * y, 0.0), minlength=n_groups)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        cov = (sxy / n - (sx / n) * (sy / n)) * (n / (n - ddof))
+    null = n <= ddof
+    return np.where(null, np.nan, cov), null, (n, sx, sy, sxy, both, x, y)
+
+
+def _make_covar(ddof: int):
+    def agg(v1, valid1, v2, valid2, codes, n_groups):
+        cov, null, _ = _covar(v1, valid1, v2, valid2, codes, n_groups, ddof)
+        return cov, (null if null.any() else None)
+
+    return agg
+
+
+def _corr(v1, valid1, v2, valid2, codes, n_groups):
+    cov, null, (n, sx, sy, sxy, both, x, y) = _covar(
+        v1, valid1, v2, valid2, codes, n_groups, 0
+    )
+    sx2 = np.bincount(codes, weights=np.where(both, x * x, 0.0), minlength=n_groups)
+    sy2 = np.bincount(codes, weights=np.where(both, y * y, 0.0), minlength=n_groups)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        vx = np.maximum(sx2 / n - (sx / n) ** 2, 0.0)
+        vy = np.maximum(sy2 / n - (sy / n) ** 2, 0.0)
+        out = cov / np.sqrt(vx * vy)
+    null = null | ~np.isfinite(out)
+    return np.where(null, np.nan, out), (null if null.any() else None)
+
+
 def default_registry() -> FunctionRegistry:
     reg = FunctionRegistry()
     reg.register_scalar("time_bucket", _time_bucket, raw_args=True)
+    reg.register_scalar("date_trunc", _date_trunc, raw_args=True)
     reg.register_scalar("abs", _abs)
     reg.register_aggregate("thetasketch_distinct", _thetasketch_distinct)
+    # approx_distinct: same exact-count analog (see _thetasketch_distinct
+    # docstring for why exact is the right trade at post-scan scale).
+    reg.register_aggregate("approx_distinct", _thetasketch_distinct)
+    reg.register_aggregate("stddev", _make_variance(1, sqrt=True), numeric_only=True)
+    reg.register_aggregate("stddev_samp", _make_variance(1, sqrt=True), numeric_only=True)
+    reg.register_aggregate("stddev_pop", _make_variance(0, sqrt=True), numeric_only=True)
+    reg.register_aggregate("variance", _make_variance(1, sqrt=False), numeric_only=True)
+    reg.register_aggregate("var_samp", _make_variance(1, sqrt=False), numeric_only=True)
+    reg.register_aggregate("var_pop", _make_variance(0, sqrt=False), numeric_only=True)
+    reg.register_aggregate("median", _median, numeric_only=True)
+    reg.register_aggregate("approx_median", _median, numeric_only=True)
+    reg.register_aggregate("approx_percentile_cont", _make_percentile(), numeric_only=True)
+    reg.register_binary_aggregate("corr", _corr)
+    reg.register_binary_aggregate("covar", _make_covar(1))
+    reg.register_binary_aggregate("covar_samp", _make_covar(1))
+    reg.register_binary_aggregate("covar_pop", _make_covar(0))
     return reg
 
 

@@ -310,7 +310,7 @@ def assemble_result(plan: QueryPlan, combined: dict, n_groups: int, spec: dict) 
         if isinstance(e, ast.Column):
             ki = group_tags.index(e.name)
             columns.append(combined[f"__k{ki}"])
-        elif isinstance(e, ast.FuncCall) and e.name == "time_bucket":
+        elif isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc"):
             columns.append(combined["__bucket"])
         else:
             agg_i = [a.output_name for a in plan.aggs].index(out_name)

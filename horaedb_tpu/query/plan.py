@@ -34,10 +34,14 @@ EXPENSIVE_QUERY_RANGE_MS = 24 * 3_600_000
 class AggCall:
     """One aggregate in the select list."""
 
-    func: str  # count | sum | min | max | avg
+    func: str  # count | sum | min | max | avg | registry UDAF name
     column: Optional[str]  # None for count(*)
     output_name: str
     distinct: bool = False
+    # Second column for binary aggregates (corr/covar: corr(x, y)).
+    column2: Optional[str] = None
+    # Trailing literal arguments (approx_percentile_cont(v, 0.9) -> (0.9,)).
+    params: tuple = ()
 
 
 @dataclass(frozen=True)

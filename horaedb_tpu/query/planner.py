@@ -13,6 +13,7 @@ analyses the TPU executor depends on:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Optional
 
 from ..common_types.datum import DatumKind
@@ -48,7 +49,10 @@ def _is_agg_name(name: str) -> bool:
         return True
     from .functions import REGISTRY
 
-    return REGISTRY.aggregate(name) is not None
+    return (
+        REGISTRY.aggregate(name) is not None
+        or REGISTRY.binary_aggregate(name) is not None
+    )
 
 
 class PlanError(ValueError):
@@ -247,6 +251,7 @@ class Planner:
                 priority=QueryPriority.HIGH,
             )
         schema = self._require_schema(stmt.table)
+        stmt = self._resolve_group_by_aliases(stmt, schema)
         self._check_columns(stmt, schema)
         self._check_windows(stmt)
 
@@ -268,6 +273,34 @@ class Planner:
             is_aggregate=is_agg,
             priority=priority,
         )
+
+    def _resolve_group_by_aliases(self, stmt: ast.Select, schema: Schema) -> ast.Select:
+        """``GROUP BY b`` where ``b`` is a SELECT alias of an expression
+        (``SELECT time_bucket(ts, '1m') AS b ... GROUP BY b``) substitutes
+        the aliased expression — standard SQL/DataFusion behavior. A real
+        schema column of the same name takes precedence (the standard's
+        resolution order), so existing queries never change meaning."""
+        if not stmt.group_by:
+            return stmt
+        alias_map = {
+            item.alias: item.expr for item in stmt.items if item.alias
+        }
+        if not alias_map:
+            return stmt
+        new_gb = tuple(
+            alias_map[g.name]
+            if (
+                isinstance(g, ast.Column)
+                and g.qualifier is None
+                and not schema.has_column(g.name)
+                and g.name in alias_map
+            )
+            else g
+            for g in stmt.group_by
+        )
+        if new_gb == stmt.group_by:
+            return stmt
+        return dataclasses.replace(stmt, group_by=new_gb)
 
     def _check_qualifiers(self, stmt: ast.Select) -> None:
         """``t.col`` qualifiers must name a table in the query — a silent
@@ -399,10 +432,15 @@ class Planner:
             group_keys.append(_group_key(g, schema))
         group_names = {k.output_name for k in group_keys}
 
+        from .functions import REGISTRY as _FN
+
         for item in stmt.items:
             e = item.expr
             if isinstance(e, ast.FuncCall) and _is_agg_name(e.name):
                 col = None
+                col2 = None
+                params: tuple = ()
+                is_binary = _FN.binary_aggregate(e.name) is not None
                 if e.args and not isinstance(e.args[0], ast.Star):
                     if (
                         e.name == "count"
@@ -418,22 +456,53 @@ class Planner:
                         col = e.args[0].name
                 if e.name != "count" and col is None:
                     raise PlanError(f"{e.name} requires a column argument")
-                if e.name in ("sum", "avg") and col is not None:
-                    if not schema.column(col).kind.is_numeric:
-                        raise PlanError(f"{e.name}({col}) requires a numeric column")
-                aggs.append(AggCall(e.name, col, item.output_name, e.distinct))
+                if is_binary:
+                    if len(e.args) != 2 or not isinstance(e.args[1], ast.Column):
+                        raise PlanError(
+                            f"{e.name}(x, y) expects two column arguments"
+                        )
+                    col2 = e.args[1].name
+                elif len(e.args) > 1:
+                    # Trailing literal parameters (approx_percentile_cont).
+                    extra = e.args[1:]
+                    if not all(isinstance(a, ast.Literal) for a in extra):
+                        raise PlanError(
+                            f"extra arguments of {e.name} must be literals"
+                        )
+                    params = tuple(a.value for a in extra)
+                numeric_required = e.name in ("sum", "avg") or _FN.numeric_only(e.name)
+                if numeric_required:
+                    for c in (col, col2):
+                        if c is not None and not schema.column(c).kind.is_numeric:
+                            raise PlanError(
+                                f"{e.name}({c}) requires a numeric column"
+                            )
+                aggs.append(
+                    AggCall(
+                        e.name, col, item.output_name, e.distinct,
+                        column2=col2, params=params,
+                    )
+                )
             elif isinstance(e, ast.Column):
                 if e.name not in group_names:
                     raise PlanError(
                         f"column {e.name!r} must appear in GROUP BY or an aggregate"
                     )
-            elif isinstance(e, ast.FuncCall) and e.name == "time_bucket":
+            elif isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc"):
                 key = _group_key(e, schema)
                 if key.output_name not in {k.output_name for k in group_keys}:
-                    raise PlanError("time_bucket in SELECT must also be in GROUP BY")
+                    raise PlanError(f"{e.name} in SELECT must also be in GROUP BY")
             else:
                 raise PlanError(f"unsupported select item in aggregate query: {e}")
         return tuple(aggs), tuple(group_keys), True
+
+
+# Fixed-width date_trunc units map onto the bucket kernel; month/year are
+# calendar-variable and stay unsupported (clear error beats wrong buckets).
+_DATE_TRUNC_MS = {
+    "millisecond": 1, "second": 1_000, "minute": 60_000, "hour": 3_600_000,
+    "day": 86_400_000, "week": 7 * 86_400_000,
+}
 
 
 def _group_key(e: ast.Expr, schema: Schema) -> GroupKey:
@@ -445,12 +514,38 @@ def _group_key(e: ast.Expr, schema: Schema) -> GroupKey:
         col, interval = e.args
         if not isinstance(col, ast.Column) or col.name != schema.timestamp_name:
             raise PlanError("time_bucket must be applied to the timestamp key column")
-        if not isinstance(interval, ast.Literal) or not isinstance(interval.value, str):
-            raise PlanError("time_bucket interval must be a string literal like '1h'")
-        return GroupKey(
-            time_bucket_ms=parse_duration_ms(interval.value),
-            output_name=str(e),
-        )
+        if isinstance(interval, ast.Literal) and isinstance(interval.value, str):
+            width = parse_duration_ms(interval.value)
+        elif (
+            isinstance(interval, ast.Literal)
+            and isinstance(interval.value, (int, float))
+            and not isinstance(interval.value, bool)
+            and interval.value > 0
+            and int(interval.value) == interval.value
+        ):
+            width = int(interval.value)  # milliseconds (whole ms only —
+            # a fractional width would truncate to a 0-width bucket)
+        else:
+            raise PlanError(
+                "time_bucket interval must be a duration string like '1h' "
+                "or a positive millisecond count"
+            )
+        return GroupKey(time_bucket_ms=width, output_name=str(e))
+    if isinstance(e, ast.FuncCall) and e.name == "date_trunc":
+        if len(e.args) != 2:
+            raise PlanError("date_trunc('unit', timestamp_col) expects 2 args")
+        unit, col = e.args
+        if not isinstance(col, ast.Column) or col.name != schema.timestamp_name:
+            raise PlanError("date_trunc must be applied to the timestamp key column")
+        if not isinstance(unit, ast.Literal) or not isinstance(unit.value, str):
+            raise PlanError("date_trunc unit must be a string literal")
+        width = _DATE_TRUNC_MS.get(unit.value.lower())
+        if width is None:
+            raise PlanError(
+                f"unsupported date_trunc unit {unit.value!r} "
+                f"(supported: {', '.join(sorted(_DATE_TRUNC_MS))})"
+            )
+        return GroupKey(time_bucket_ms=width, output_name=str(e))
     raise PlanError(f"unsupported GROUP BY expression: {e}")
 
 

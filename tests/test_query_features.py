@@ -1007,3 +1007,129 @@ class TestWindowReviewRegressions:
             "UNION SELECT h FROM rw"
         ).to_pylist()
         assert len(r2) == 2
+
+
+class TestStatisticalAggregates:
+    """stddev/variance/median/approx_*/corr/covar families + GROUP BY
+    alias resolution and date_trunc bucket keys (ref surface: DataFusion's
+    built-in statistical aggregates exposed through the reference's SQL;
+    df_operator registry for the UDAF plug point)."""
+
+    def _db(self):
+        import numpy as np
+
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE st (host string TAG, v double, w double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        rng = np.random.default_rng(5)
+        vals = rng.normal(10, 3, 120)
+        ws = vals * 2 + rng.normal(0, 0.5, 120)
+        rows = ", ".join(
+            f"('h{i%3}', {vals[i]}, {ws[i]}, {1000*i})" for i in range(120)
+        )
+        db.execute(f"INSERT INTO st (host, v, w, ts) VALUES {rows}")
+        return db, vals, ws
+
+    def test_moment_aggregates_match_numpy(self):
+        import numpy as np
+
+        db, vals, ws = self._db()
+        for sql, want in [
+            ("SELECT stddev(v) AS s FROM st", np.std(vals, ddof=1)),
+            ("SELECT stddev_pop(v) AS s FROM st", np.std(vals)),
+            ("SELECT variance(v) AS s FROM st", np.var(vals, ddof=1)),
+            ("SELECT var_pop(v) AS s FROM st", np.var(vals)),
+            ("SELECT median(v) AS s FROM st", np.median(vals)),
+            ("SELECT approx_median(v) AS s FROM st", np.median(vals)),
+            ("SELECT approx_percentile_cont(v, 0.9) AS s FROM st", np.quantile(vals, 0.9)),
+            ("SELECT corr(v, w) AS s FROM st", np.corrcoef(vals, ws)[0, 1]),
+            ("SELECT covar(v, w) AS s FROM st", np.cov(vals, ws, ddof=1)[0, 1]),
+            ("SELECT covar_pop(v, w) AS s FROM st", np.cov(vals, ws, ddof=0)[0, 1]),
+            ("SELECT approx_distinct(host) AS s FROM st", 3),
+        ]:
+            got = db.execute(sql).to_pylist()[0]["s"]
+            assert np.isclose(got, want, rtol=1e-6), (sql, got, want)
+
+    def test_grouped_stddev(self):
+        import numpy as np
+
+        db, vals, _ = self._db()
+        out = db.execute(
+            "SELECT host, stddev(v) AS s FROM st GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert len(out) == 3
+        for h, row in enumerate(out):
+            hv = vals[np.arange(120) % 3 == h]
+            assert np.isclose(row["s"], np.std(hv, ddof=1), rtol=1e-6)
+
+    def test_single_value_stddev_is_null(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE one (g string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO one (g, v, ts) VALUES ('a', 5.0, 1)")
+        out = db.execute("SELECT stddev(v) AS s, var_pop(v) AS vp FROM one").to_pylist()
+        assert out[0]["s"] is None  # ddof=1 over 1 row
+        assert out[0]["vp"] == 0.0
+
+    def test_group_by_alias_resolution(self):
+        db, vals, _ = self._db()
+        # expression alias
+        out = db.execute(
+            "SELECT time_bucket(ts, '1m') AS b, count(1) AS c FROM st GROUP BY b ORDER BY b"
+        ).to_pylist()
+        assert [r["b"] for r in out] == [0, 60000] and sum(r["c"] for r in out) == 120
+        # numeric-ms interval
+        out2 = db.execute(
+            "SELECT time_bucket(ts, 60000) AS b, count(1) AS c FROM st GROUP BY b ORDER BY b"
+        ).to_pylist()
+        assert out == out2
+        # plain column alias
+        out3 = db.execute(
+            "SELECT host AS h, count(1) AS c FROM st GROUP BY h ORDER BY h"
+        ).to_pylist()
+        assert [r["h"] for r in out3] == ["h0", "h1", "h2"]
+
+    def test_date_trunc_group_key_and_projection(self):
+        import pytest
+
+        db, _, _ = self._db()
+        out = db.execute(
+            "SELECT date_trunc('minute', ts) AS b, count(1) AS c FROM st GROUP BY b ORDER BY b"
+        ).to_pylist()
+        assert [r["b"] for r in out] == [0, 60000]
+        proj = db.execute(
+            "SELECT date_trunc('second', ts) AS s, v FROM st ORDER BY ts LIMIT 2"
+        ).to_pylist()
+        assert proj[0]["s"] == 0 and proj[1]["s"] == 1000
+        with pytest.raises(Exception, match="unsupported date_trunc unit"):
+            db.execute("SELECT date_trunc('month', ts) AS b, count(1) AS c FROM st GROUP BY b")
+
+    def test_review_edge_cases(self):
+        import pytest
+
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE ec (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO ec (host, v, ts) VALUES ('a',1.0,1),('a',1.0,2),('b',4.0,3)")
+        with pytest.raises(Exception, match="DISTINCT is not supported"):
+            db.execute("SELECT median(DISTINCT v) AS m FROM ec")
+        # empty row set through date_trunc projection
+        assert db.execute(
+            "SELECT date_trunc('second', ts) AS s FROM ec WHERE v > 100"
+        ).to_pylist() == []
+        with pytest.raises(Exception, match="time_bucket interval"):
+            db.execute("SELECT time_bucket(ts, 0.5) AS b, count(1) AS c FROM ec GROUP BY b")
+        with pytest.raises(Exception, match="requires a numeric column"):
+            db.execute("SELECT corr(host, v) AS c FROM ec")
