@@ -65,10 +65,15 @@ class FuncCall(Expr):
     name: str  # lowercased
     args: tuple[Expr, ...]
     distinct: bool = False
+    # agg(col) FILTER (WHERE cond) — standard SQL per-aggregate row filter
+    filter_where: Optional["Expr"] = None
 
     def __str__(self) -> str:
         inner = ", ".join(str(a) for a in self.args)
-        return f"{self.name}({'DISTINCT ' if self.distinct else ''}{inner})"
+        base = f"{self.name}({'DISTINCT ' if self.distinct else ''}{inner})"
+        if self.filter_where is not None:
+            base += f" FILTER (WHERE {self.filter_where})"
+        return base
 
 
 @dataclass(frozen=True)

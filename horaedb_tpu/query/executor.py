@@ -273,6 +273,8 @@ def _eval_func(e: ast.FuncCall, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]
     entry = REGISTRY.scalar(e.name)
     if entry is None:
         raise ExprError(f"unsupported function {e.name!r} in row expression")
+    if e.filter_where is not None:
+        raise ExprError(f"FILTER is only valid on aggregate functions, not {e.name!r}")
     fn, raw_args = entry
     if raw_args:
         # first arg evaluated; the rest pass as raw AST (literal params)
@@ -501,6 +503,8 @@ class Executor:
         for a in plan.aggs:
             if a.distinct or a.func not in ("count", "sum", "min", "max", "avg"):
                 return None  # registry aggregates run on the host path
+            if a.filter_where is not None:
+                return None  # per-aggregate FILTER masks run on the host path
             if a.column is not None and not schema.column(a.column).kind.is_numeric:
                 return None
         tag_keys = [k for k in plan.group_keys if k.column is not None]
@@ -1169,8 +1173,16 @@ def _agg_output(
 def _host_agg(
     a: AggCall, rows: RowGroup, codes: np.ndarray, group_count: int
 ) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    # agg(col) FILTER (WHERE cond): rows failing the per-aggregate filter
+    # are invisible to THIS aggregate only (SQL NULL semantics: a NULL
+    # condition fails the filter).
+    fmask = None
+    if a.filter_where is not None:
+        fv, fm = eval_expr(a.filter_where, rows)
+        fmask = fm & as_values(fv).astype(bool)
     if a.func == "count" and a.column is None:
-        return np.bincount(codes, minlength=group_count).astype(np.int64), None
+        counted = codes if fmask is None else codes[fmask]
+        return np.bincount(counted, minlength=group_count).astype(np.int64), None
     if a.func not in ("count", "sum", "min", "max", "avg"):
         from .functions import REGISTRY
 
@@ -1180,20 +1192,27 @@ def _host_agg(
             raise ExprError(f"DISTINCT is not supported with {a.func}")
         binary_fn = REGISTRY.binary_aggregate(a.func)
         if binary_fn is not None:
+            v1, v2 = rows.valid_mask(a.column), rows.valid_mask(a.column2)
+            if fmask is not None:
+                v1, v2 = v1 & fmask, v2 & fmask
             return binary_fn(
-                as_values(rows.column(a.column)), rows.valid_mask(a.column),
-                as_values(rows.column(a.column2)), rows.valid_mask(a.column2),
+                as_values(rows.column(a.column)), v1,
+                as_values(rows.column(a.column2)), v2,
                 codes, group_count,
             )
         agg_fn = REGISTRY.aggregate(a.func)
         if agg_fn is None:
             raise ExprError(f"unknown aggregate {a.func}")
+        v1 = rows.valid_mask(a.column)
+        if fmask is not None:
+            v1 = v1 & fmask
         return agg_fn(
-            rows.column(a.column), rows.valid_mask(a.column),
-            codes, group_count, *a.params,
+            rows.column(a.column), v1, codes, group_count, *a.params,
         )
     col = as_values(rows.column(a.column))
     valid = rows.valid_mask(a.column)
+    if fmask is not None:
+        valid = valid & fmask
     if a.distinct:
         if a.func != "count":
             raise ExprError("DISTINCT only supported with count")

@@ -198,7 +198,11 @@ class InterpreterFactory:
             lines.append(f"  PushedFilters: {fs}")
         if q.is_aggregate:
             keys = ", ".join(k.output_name for k in q.group_keys) or "(none)"
-            aggs = ", ".join(f"{a.func}({a.column or '*'})" for a in q.aggs)
+            aggs = ", ".join(
+                f"{a.func}({a.column or '*'})"
+                + (f" FILTER (WHERE {a.filter_where})" if a.filter_where is not None else "")
+                for a in q.aggs
+            )
             lines.append(f"  Aggregate: keys=[{keys}] aggs=[{aggs}]")
             shape = self.executor._agg_device_shape(q)
             if shape is not None:

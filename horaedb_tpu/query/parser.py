@@ -665,6 +665,15 @@ class Parser:
                         args.append(self._expr())
                 self._expect_op(")")
                 call = ast.FuncCall(name.lower(), tuple(args), distinct)
+                if self._eat_kw("FILTER"):
+                    # standard SQL: agg(col) FILTER (WHERE cond)
+                    self._expect_op("(")
+                    self._expect_kw("WHERE")
+                    cond = self._expr()
+                    self._expect_op(")")
+                    call = ast.FuncCall(
+                        call.name, call.args, call.distinct, filter_where=cond
+                    )
                 if self._eat_kw("OVER"):
                     return self._window(call)
                 return call
@@ -680,6 +689,10 @@ class Parser:
         """fn(...) OVER ( [PARTITION BY e, ...] [ORDER BY e [ASC|DESC], ...] )"""
         if call.distinct:
             raise ParseError("DISTINCT is not allowed in window functions", -1, self.sql)
+        if call.filter_where is not None:
+            raise ParseError(
+                "FILTER is not supported with window functions", -1, self.sql
+            )
         self._expect_op("(")
         partition_by: list[ast.Expr] = []
         order_by: list[ast.OrderItem] = []

@@ -42,6 +42,9 @@ class AggCall:
     column2: Optional[str] = None
     # Trailing literal arguments (approx_percentile_cont(v, 0.9) -> (0.9,)).
     params: tuple = ()
+    # agg(col) FILTER (WHERE cond) — evaluated per aggregate on the host
+    # path (a filtered aggregate never rides the fused device kernel).
+    filter_where: Optional[ast.Expr] = None
 
 
 @dataclass(frozen=True)

@@ -480,7 +480,7 @@ class Planner:
                 aggs.append(
                     AggCall(
                         e.name, col, item.output_name, e.distinct,
-                        column2=col2, params=params,
+                        column2=col2, params=params, filter_where=e.filter_where,
                     )
                 )
             elif isinstance(e, ast.Column):
@@ -489,6 +489,10 @@ class Planner:
                         f"column {e.name!r} must appear in GROUP BY or an aggregate"
                     )
             elif isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc"):
+                if e.filter_where is not None:
+                    raise PlanError(
+                        f"FILTER is only valid on aggregate functions, not {e.name}"
+                    )
                 key = _group_key(e, schema)
                 if key.output_name not in {k.output_name for k in group_keys}:
                     raise PlanError(f"{e.name} in SELECT must also be in GROUP BY")
@@ -655,6 +659,8 @@ def _walk(e: ast.Expr):
     elif isinstance(e, ast.FuncCall):
         for a in e.args:
             yield from _walk(a)
+        if e.filter_where is not None:
+            yield from _walk(e.filter_where)
     elif isinstance(e, ast.InList):
         yield from _walk(e.expr)
         for v in e.values:

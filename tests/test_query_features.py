@@ -1133,3 +1133,58 @@ class TestStatisticalAggregates:
             db.execute("SELECT time_bucket(ts, 0.5) AS b, count(1) AS c FROM ec GROUP BY b")
         with pytest.raises(Exception, match="requires a numeric column"):
             db.execute("SELECT corr(host, v) AS c FROM ec")
+
+
+class TestAggregateFilterClause:
+    """agg(col) FILTER (WHERE cond) — standard SQL per-aggregate masks
+    (DataFusion exposes these through the reference's SQL surface).
+    Filtered aggregates always run the host path (_agg_device_shape
+    refuses them), so the device kernel shape stays untouched."""
+
+    def _db(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE f (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        rows = ", ".join(f"('h{i%2}', {float(i)}, {i*1000})" for i in range(20))
+        db.execute(f"INSERT INTO f (host, v, ts) VALUES {rows}")
+        return db
+
+    def test_filtered_aggregates(self):
+        db = self._db()
+        out = db.execute(
+            "SELECT count(1) AS n, sum(v) FILTER (WHERE host = 'h0') AS s0, "
+            "count(*) FILTER (WHERE v >= 10) AS big, "
+            "avg(v) FILTER (WHERE v < 10) AS small FROM f"
+        ).to_pylist()[0]
+        assert out == {"n": 20, "s0": 90.0, "big": 10, "small": 4.5}
+
+    def test_filtered_registry_agg_grouped(self):
+        db = self._db()
+        g = db.execute(
+            "SELECT host, median(v) FILTER (WHERE v < 10) AS m FROM f "
+            "GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert g == [{"host": "h0", "m": 4.0}, {"host": "h1", "m": 5.0}]
+
+    def test_empty_filter_null_sum_zero_count(self):
+        db = self._db()
+        e = db.execute(
+            "SELECT sum(v) FILTER (WHERE v > 99) AS s, "
+            "count(*) FILTER (WHERE v > 99) AS c FROM f"
+        ).to_pylist()[0]
+        assert e == {"s": None, "c": 0}
+
+    def test_filter_rejected_outside_aggregates(self):
+        import pytest
+
+        db = self._db()
+        with pytest.raises(Exception, match="only valid on aggregate"):
+            db.execute("SELECT abs(v) FILTER (WHERE v > 1) AS x FROM f")
+        with pytest.raises(Exception, match="not supported with window"):
+            db.execute(
+                "SELECT sum(v) FILTER (WHERE v > 1) OVER (ORDER BY ts) AS x FROM f"
+            )
