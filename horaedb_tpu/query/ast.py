@@ -61,6 +61,50 @@ class UnaryOp(Expr):
 
 
 @dataclass(frozen=True)
+class Case(Expr):
+    """CASE [operand] WHEN w THEN t ... [ELSE e] END.
+
+    Simple CASE (with operand) is normalized by the parser into the
+    searched form (operand = w -> operand IS NOT DISTINCT FROM w is not
+    needed here: SQL simple CASE uses plain equality), so ``whens`` always
+    holds boolean conditions."""
+
+    whens: tuple[tuple[Expr, Expr], ...]  # (condition, result)
+    else_: Optional[Expr] = None
+
+    def __str__(self) -> str:
+        parts = " ".join(f"WHEN {w} THEN {t}" for w, t in self.whens)
+        tail = f" ELSE {self.else_}" if self.else_ is not None else ""
+        return f"CASE {parts}{tail} END"
+
+
+@dataclass(frozen=True)
+class Cast(Expr):
+    """CAST(expr AS type) — type is the SQL name, lowercased."""
+
+    expr: Expr
+    type_name: str
+
+    def __str__(self) -> str:
+        return f"CAST({self.expr} AS {self.type_name})"
+
+
+@dataclass(frozen=True)
+class Like(Expr):
+    """expr [NOT] LIKE 'pattern' — % any run, _ one char; matches are
+    case-sensitive (ILIKE relaxes)."""
+
+    expr: Expr
+    pattern: str
+    negated: bool = False
+    case_insensitive: bool = False
+
+    def __str__(self) -> str:
+        op = ("NOT " if self.negated else "") + ("ILIKE" if self.case_insensitive else "LIKE")
+        return f"({self.expr} {op} '{self.pattern}')"
+
+
+@dataclass(frozen=True)
 class FuncCall(Expr):
     name: str  # lowercased
     args: tuple[Expr, ...]
@@ -209,6 +253,8 @@ class SelectItem:
 class OrderItem:
     expr: Expr
     ascending: bool = True
+    # NULLS FIRST/LAST; None = SQL default (LAST when ASC, FIRST when DESC)
+    nulls_last: Optional[bool] = None
 
 
 @dataclass(frozen=True)
@@ -233,6 +279,7 @@ class Select:
     group_by: tuple[Expr, ...] = ()
     order_by: tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
+    offset: int = 0
     having: Optional[Expr] = None
     distinct: bool = False
     join: Optional[Join] = None
@@ -256,6 +303,7 @@ class UnionSelect:
     all_flags: tuple[bool, ...] = ()
     order_by: tuple[OrderItem, ...] = ()
     limit: Optional[int] = None
+    offset: int = 0
     ctes: tuple[tuple[str, "Select | UnionSelect"], ...] = ()
 
     @property

@@ -133,7 +133,110 @@ def eval_expr(e: ast.Expr, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
         _, m = eval_expr(e.expr, rows)
         res = m if e.negated else ~m
         return res, np.ones(n, dtype=bool)
+    if isinstance(e, ast.Like):
+        return _eval_like(e, rows)
+    if isinstance(e, ast.Case):
+        return _eval_case(e, rows)
+    if isinstance(e, ast.Cast):
+        return _eval_cast(e, rows)
     raise ExprError(f"unsupported expression: {e}")
+
+
+def _eval_like(e: ast.Like, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
+    """LIKE via one compiled regex over the column's UNIQUE values (dict
+    columns match on the dictionary, not the rows)."""
+    import re
+
+    v, m = eval_expr(e.expr, rows)
+    # % -> .*, _ -> . — everything else regex-escaped; anchored both ends.
+    rx = re.compile(
+        "".join(
+            ".*" if ch == "%" else "." if ch == "_" else re.escape(ch)
+            for ch in e.pattern
+        )
+        + r"\Z",
+        re.DOTALL | (re.IGNORECASE if e.case_insensitive else 0),
+    )
+
+    def match_values(vals: np.ndarray) -> np.ndarray:
+        return np.fromiter(
+            (isinstance(x, str) and rx.match(x) is not None for x in vals),
+            dtype=bool,
+            count=len(vals),
+        )
+
+    if isinstance(v, DictColumn):
+        hit = v.map_values(match_values)
+    else:
+        hit = match_values(as_values(v))
+    return (~hit if e.negated else hit), m
+
+
+def _eval_case(e: ast.Case, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
+    """First-match-wins; rows matching no branch (and no ELSE) are NULL."""
+    n = len(rows)
+    taken = np.zeros(n, dtype=bool)
+    out = None
+    valid = np.zeros(n, dtype=bool)
+    branches = list(e.whens) + (
+        [(None, e.else_)] if e.else_ is not None else []
+    )
+    for cond, result in branches:
+        if cond is None:
+            sel = ~taken
+        else:
+            cv, cm = eval_expr(cond, rows)
+            sel = ~taken & cm & as_values(cv).astype(bool)
+        if not sel.any():
+            continue
+        rv, rm = eval_expr(result, rows)
+        rv = as_values(rv)
+        if out is None:
+            # Allocate from the first taken branch's dtype; mixed branch
+            # types promote to object below.
+            out = np.zeros(n, dtype=rv.dtype)
+        if out.dtype != rv.dtype:
+            out = out.astype(object)
+        out[sel] = rv[sel]
+        valid[sel] = rm[sel]
+        taken |= sel
+    if out is None:
+        out = np.zeros(n)
+    return out, valid
+
+
+_CAST_NUMPY = {
+    "bigint": np.int64, "int": np.int64, "integer": np.int64, "int64": np.int64,
+    "smallint": np.int64, "tinyint": np.int64, "uint64": np.int64,
+    "double": np.float64, "float": np.float64, "real": np.float64,
+    "boolean": np.bool_, "bool": np.bool_,
+    "timestamp": np.int64,
+    "string": None, "varchar": None, "text": None,  # None -> str()
+}
+
+
+def _eval_cast(e: ast.Cast, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
+    v, m = eval_expr(e.expr, rows)
+    v = as_values(v)
+    if e.type_name not in _CAST_NUMPY:
+        raise ExprError(f"unsupported CAST target type {e.type_name!r}")
+    target = _CAST_NUMPY[e.type_name]
+    if target is None:
+        out = np.array([str(x) for x in v], dtype=object)
+        return out, m
+    try:
+        if v.dtype == object:
+            # String -> number errors on bad VALID strings (SQL casts are
+            # strict), but NULL rows carry the '' kind-default fill and
+            # are masked out — neutralize them before the strict cast.
+            out = np.where(m, v, "0").astype(np.float64).astype(target)
+        elif target is np.int64 and v.dtype.kind == "f":
+            out = np.trunc(np.where(m, v, 0)).astype(np.int64)
+        else:
+            out = np.where(m, v, 0).astype(target) if v.dtype.kind != "b" else v.astype(target)
+    except (ValueError, TypeError) as ex:
+        raise ExprError(f"CAST failed: {ex}")
+    return out, m
 
 
 def _eval_correlated_lookup(
@@ -351,7 +454,10 @@ class Executor:
         if not plan.is_aggregate and self._limit_pushdown_safe(plan):
             # LIMIT pushdown: the scan may stop early. Only when no
             # residual WHERE / ORDER BY / DISTINCT needs the complete set.
-            predicate = predicate.with_limit(plan.select.limit)
+            # OFFSET rows are still scanned (then skipped in assembly).
+            predicate = predicate.with_limit(
+                plan.select.limit + plan.select.offset
+            )
             from ..engine.options import UpdateMode
 
             if getattr(
@@ -1065,10 +1171,11 @@ class Executor:
                 expr = o.expr
                 if isinstance(expr, ast.Column) and expr.name in aliases and not rows.schema.has_column(expr.name):
                     expr = aliases[expr.name]
-                kv, _ = eval_expr(expr, rows)
+                kv, km = eval_expr(expr, rows)
                 if isinstance(kv, DictColumn):
                     kv = kv.sort_ranks()
                 keys.append(kv if o.ascending else _desc_key(kv))
+                keys.append(_null_rank(km, o))
             rows = rows.take(np.lexsort(tuple(keys)))
         from .planner import _walk
 
@@ -1077,10 +1184,11 @@ class Executor:
             for item in stmt.items
             for e in _walk(item.expr)
         )
-        if stmt.limit is not None and not stmt.distinct and not has_window:
+        if (stmt.limit is not None or stmt.offset) and not stmt.distinct and not has_window:
             # DISTINCT must dedupe BEFORE the limit applies; window frames
             # must see the complete (sorted) row set before truncation
-            rows = rows.slice(0, stmt.limit)
+            stop = (stmt.offset + stmt.limit) if stmt.limit is not None else len(rows)
+            rows = rows.slice(stmt.offset, stop)
 
         names: list[str] = []
         columns: list[np.ndarray] = []
@@ -1104,17 +1212,8 @@ class Executor:
         result = ResultSet(names, columns, nulls or None)
         if stmt.distinct:
             result = _distinct_result(result)
-        if (
-            (stmt.distinct or has_window)
-            and stmt.limit is not None
-            and result.num_rows > stmt.limit
-        ):
-            k = stmt.limit
-            result = ResultSet(
-                result.names,
-                [c[:k] for c in result.columns],
-                {n: m_[:k] for n, m_ in (result.nulls or {}).items()} or None,
-            )
+        if (stmt.distinct or has_window) and (stmt.limit is not None or stmt.offset):
+            result = _slice_result(result, stmt.offset, stmt.limit)
         return result
 
 
@@ -1372,32 +1471,55 @@ def _order_and_limit(result: ResultSet, plan: QueryPlan) -> ResultSet:
             if isinstance(o.expr, ast.Column):
                 name = o.expr.name
             key_src = None
+            resolved = None
             if name is not None and name in result.names:
-                key_src = result.column(name)
+                resolved = name
             elif str(o.expr) in result.names:
-                key_src = result.column(str(o.expr))
+                resolved = str(o.expr)
             else:
                 # order by an alias
                 for item in stmt.items:
                     if item.alias and str(o.expr) == item.alias:
-                        key_src = result.column(item.alias)
+                        resolved = item.alias
                         break
-            if key_src is None:
+            if resolved is None:
                 raise ExprError(f"ORDER BY expression not in select list: {o.expr}")
+            key_src = result.column(resolved)
+            null_mask = (result.nulls or {}).get(resolved)
+            valid = (
+                np.ones(len(key_src), dtype=bool)
+                if null_mask is None
+                else ~null_mask
+            )
             keys.append(key_src if o.ascending else _desc_key(key_src))
+            keys.append(_null_rank(valid, o))
         order = np.lexsort(tuple(keys))
         result = ResultSet(
             result.names,
             [c[order] for c in result.columns],
             {k: v[order] for k, v in (result.nulls or {}).items()} or None,
         )
-    if stmt.limit is not None:
-        result = ResultSet(
-            result.names,
-            [c[: stmt.limit] for c in result.columns],
-            {k: v[: stmt.limit] for k, v in (result.nulls or {}).items()} or None,
-        )
+    if stmt.limit is not None or stmt.offset:
+        result = _slice_result(result, stmt.offset, stmt.limit)
     return result
+
+
+def _null_rank(valid: np.ndarray, o: ast.OrderItem) -> np.ndarray:
+    """Sort key placing NULLs per NULLS FIRST/LAST (SQL default: LAST
+    when ASC, FIRST when DESC). Appended AFTER the value key, so it is
+    the more significant of the pair in np.lexsort."""
+    nulls_last = o.nulls_last if o.nulls_last is not None else o.ascending
+    nullness = (~valid).astype(np.int8)
+    return nullness if nulls_last else -nullness
+
+
+def _slice_result(result: ResultSet, offset: int, limit: Optional[int]) -> ResultSet:
+    stop = (offset + limit) if limit is not None else result.num_rows
+    return ResultSet(
+        result.names,
+        [c[offset:stop] for c in result.columns],
+        {k: v[offset:stop] for k, v in (result.nulls or {}).items()} or None,
+    )
 
 
 def _columns_of(e: ast.Expr) -> list[ast.Column]:

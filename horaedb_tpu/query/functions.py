@@ -142,6 +142,122 @@ def _abs(args, rows):
     return np.abs(v), m
 
 
+def _vals(pair):
+    from ..common_types.dict_column import as_values
+
+    v, m = pair
+    return as_values(v), m
+
+
+def _coalesce(args, rows):
+    """First non-NULL argument per row."""
+    n = len(rows)
+    out = None
+    valid = np.zeros(n, dtype=bool)
+    for pair in args:
+        v, m = _vals(pair)
+        if out is None:
+            out = np.zeros(n, dtype=v.dtype)
+        if out.dtype != v.dtype:
+            out = out.astype(object)
+        take = ~valid & m
+        out[take] = v[take]
+        valid |= m
+        if valid.all():
+            break
+    if out is None:
+        out = np.zeros(n)
+    return out, valid
+
+
+def _make_str_fn(fn):
+    def impl(args, rows):
+        v, m = _vals(args[0])
+        # Non-string VALID values cast implicitly (upper(1.5) -> '1.5',
+        # the common engine behavior); invalid rows keep a placeholder
+        # and stay masked.
+        out = np.array(
+            [fn(x if isinstance(x, str) else str(x)) if ok else ""
+             for x, ok in zip(v, m)],
+            dtype=object,
+        )
+        return out, m
+
+    return impl
+
+
+def _length(args, rows):
+    v, m = _vals(args[0])
+    out = np.fromiter(
+        (len(x if isinstance(x, str) else str(x)) if ok else 0
+         for x, ok in zip(v, m)),
+        dtype=np.int64, count=len(v),
+    )
+    return out, m
+
+
+def _concat(args, rows):
+    """NULL arguments concatenate as empty (Postgres concat semantics);
+    the result is NULL only when every argument is NULL."""
+    n = len(rows)
+    parts = []
+    valids = []
+    for pair in args:
+        v, m = _vals(pair)
+        parts.append([str(x) if ok else "" for x, ok in zip(v, m)])
+        valids.append(m)
+    out = np.array(["".join(p[i] for p in parts) for i in range(n)], dtype=object)
+    valid = np.logical_or.reduce(valids) if valids else np.zeros(n, dtype=bool)
+    return out, valid
+
+
+def _make_math_fn(fn, domain=None):
+    def impl(args, rows):
+        v, m = _vals(args[0])
+        vf = v.astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            out = fn(vf)
+        if domain is not None:
+            m = m & domain(vf)
+        return out, m
+
+    return impl
+
+
+def _round(args, rows):
+    """round(v [, digits]) — registered raw_args, so ``digits`` arrives
+    as raw AST and non-literal precision is rejected loudly instead of
+    silently applying row 0's value to every row."""
+    from . import ast
+
+    (v, m), *rest = args
+    from ..common_types.dict_column import as_values
+
+    v = as_values(v)
+    digits = 0
+    if rest:
+        d = rest[0]
+        if not isinstance(d, ast.Literal) or not isinstance(d.value, int):
+            raise FunctionError("round() digits must be an integer literal")
+        digits = d.value
+    return np.round(v.astype(np.float64), digits), m
+
+
+def _power(args, rows):
+    b, mb = _vals(args[0])
+    e, me = _vals(args[1])
+    with np.errstate(divide="ignore", invalid="ignore"):
+        out = np.power(b.astype(np.float64), e.astype(np.float64))
+    return out, mb & me & np.isfinite(out)
+
+
+def _now(args, rows):
+    import time as _t
+
+    n = len(rows)
+    return np.full(n, int(_t.time() * 1000), dtype=np.int64), np.ones(n, dtype=bool)
+
+
 def _thetasketch_distinct(values, valid, codes, n_groups):
     """Approximate-distinct analog (ref: udfs/thetasketch_distinct.rs).
 
@@ -283,6 +399,25 @@ def default_registry() -> FunctionRegistry:
     reg.register_scalar("time_bucket", _time_bucket, raw_args=True)
     reg.register_scalar("date_trunc", _date_trunc, raw_args=True)
     reg.register_scalar("abs", _abs)
+    reg.register_scalar("coalesce", _coalesce)
+    reg.register_scalar("upper", _make_str_fn(str.upper))
+    reg.register_scalar("lower", _make_str_fn(str.lower))
+    reg.register_scalar("trim", _make_str_fn(str.strip))
+    reg.register_scalar("length", _length)
+    reg.register_scalar("char_length", _length)
+    reg.register_scalar("concat", _concat)
+    reg.register_scalar("round", _round, raw_args=True)
+    reg.register_scalar("floor", _make_math_fn(np.floor))
+    reg.register_scalar("ceil", _make_math_fn(np.ceil))
+    reg.register_scalar("ceiling", _make_math_fn(np.ceil))
+    reg.register_scalar("sqrt", _make_math_fn(np.sqrt, domain=lambda v: v >= 0))
+    reg.register_scalar("exp", _make_math_fn(np.exp))
+    reg.register_scalar("ln", _make_math_fn(np.log, domain=lambda v: v > 0))
+    reg.register_scalar("log10", _make_math_fn(np.log10, domain=lambda v: v > 0))
+    reg.register_scalar("log2", _make_math_fn(np.log2, domain=lambda v: v > 0))
+    reg.register_scalar("power", _power)
+    reg.register_scalar("pow", _power)
+    reg.register_scalar("now", _now)
     reg.register_aggregate("thetasketch_distinct", _thetasketch_distinct)
     # approx_distinct: same exact-count analog (see _thetasketch_distinct
     # docstring for why exact is the right trade at post-scan scale).

@@ -147,7 +147,7 @@ class InterpreterFactory:
             combined = _concat_results([combined, res])
             if not plan.all_flags[i]:
                 combined = _distinct_result(combined)
-        return _order_limit_result(combined, plan.order_by, plan.limit)
+        return _order_limit_result(combined, plan.order_by, plan.limit, plan.offset)
 
     def _cte(self, plan: CTEPlan) -> Output:
         """WITH bindings materialize in order into an overlay of in-memory
@@ -427,11 +427,12 @@ class InterpreterFactory:
             or select.having is not None
             or select.order_by
             or select.limit is not None
+            or select.offset
             or select.distinct
             or select.join is not None
         ):
             raise unsupported(
-                "GROUP BY/HAVING/ORDER BY/LIMIT/DISTINCT/JOIN in the subquery"
+                "GROUP BY/HAVING/ORDER BY/LIMIT/OFFSET/DISTINCT/JOIN in the subquery"
             )
         item = select.items[0]
         non_where = [item.expr, *select.group_by]
@@ -693,10 +694,10 @@ def _concat_results(results: list[ResultSet]) -> ResultSet:
     return ResultSet(names, columns, nulls or None)
 
 
-def _order_limit_result(result: ResultSet, order_by, limit) -> ResultSet:
-    """ORDER BY/LIMIT over a bare ResultSet (union output): order keys
-    must name output columns of the first branch."""
-    from .executor import _desc_key
+def _order_limit_result(result: ResultSet, order_by, limit, offset: int = 0) -> ResultSet:
+    """ORDER BY/LIMIT/OFFSET over a bare ResultSet (union output): order
+    keys must name output columns of the first branch."""
+    from .executor import _desc_key, _null_rank, _slice_result
 
     if order_by and result.num_rows:
         keys = []
@@ -707,19 +708,20 @@ def _order_limit_result(result: ResultSet, order_by, limit) -> ResultSet:
                     f"ORDER BY column {name!r} is not in the UNION output"
                 )
             col = result.column(name)
+            null_mask = (result.nulls or {}).get(name)
+            valid = (
+                np.ones(len(col), dtype=bool) if null_mask is None else ~null_mask
+            )
             keys.append(col if o.ascending else _desc_key(col))
+            keys.append(_null_rank(valid, o))
         order = np.lexsort(tuple(keys))
         result = ResultSet(
             result.names,
             [c[order] for c in result.columns],
             {k: v[order] for k, v in (result.nulls or {}).items()} or None,
         )
-    if limit is not None and result.num_rows > limit:
-        result = ResultSet(
-            result.names,
-            [c[:limit] for c in result.columns],
-            {k: v[:limit] for k, v in (result.nulls or {}).items()} or None,
-        )
+    if limit is not None or offset:
+        result = _slice_result(result, offset, limit)
     return result
 
 

@@ -232,9 +232,11 @@ class Parser:
             all_flags.append(branch_all)
             selects.append(self._select())
         last = selects[-1]
-        order_by, limit = last.order_by, last.limit
-        if order_by or limit is not None:
-            selects[-1] = dataclasses.replace(last, order_by=(), limit=None)
+        order_by, limit, offset = last.order_by, last.limit, last.offset
+        if order_by or limit is not None or offset:
+            selects[-1] = dataclasses.replace(
+                last, order_by=(), limit=None, offset=0
+            )
         n_cols = {len(s.items) for s in selects}
         if len(n_cols) > 1 and not any(
             isinstance(i.expr, ast.Star) for s in selects for i in s.items
@@ -245,6 +247,7 @@ class Parser:
             all_flags=tuple(all_flags),
             order_by=order_by,
             limit=limit,
+            offset=offset,
         )
 
     def _select(self) -> ast.Select:
@@ -289,7 +292,19 @@ class Parser:
                     asc = False
                 elif self._eat_kw("ASC"):
                     pass
-                order_by.append(ast.OrderItem(e, asc))
+                nulls_last = None
+                if self._eat_kw("NULLS"):
+                    if self._eat_kw("LAST"):
+                        nulls_last = True
+                    elif self._eat_kw("FIRST"):
+                        nulls_last = False
+                    else:
+                        t = self._peek()
+                        raise ParseError(
+                            "expected FIRST or LAST after NULLS",
+                            t.pos if t else -1, self.sql,
+                        )
+                order_by.append(ast.OrderItem(e, asc, nulls_last))
                 if not self._eat_op(","):
                     break
         limit = None
@@ -298,6 +313,12 @@ class Parser:
             if t.kind != "number":
                 raise ParseError("LIMIT expects a number", t.pos, self.sql)
             limit = int(t.text)
+        offset = 0
+        if self._eat_kw("OFFSET"):
+            t = self._next()
+            if t.kind != "number":
+                raise ParseError("OFFSET expects a number", t.pos, self.sql)
+            offset = int(t.text)
         return ast.Select(
             items=tuple(items),
             table=table,
@@ -305,6 +326,7 @@ class Parser:
             group_by=group_by,
             order_by=tuple(order_by),
             limit=limit,
+            offset=offset,
             having=having,
             distinct=distinct,
             join=join,
@@ -571,8 +593,8 @@ class Parser:
             if t is None:
                 return left
             op = t.text.upper() if t.kind == "name" else t.text
-            # NOT IN / NOT BETWEEN / IS [NOT] NULL / IN / BETWEEN
-            if t.kind == "name" and op in ("IN", "BETWEEN", "IS", "NOT"):
+            # NOT IN / NOT BETWEEN / IS [NOT] NULL / IN / BETWEEN / [NOT] [I]LIKE
+            if t.kind == "name" and op in ("IN", "BETWEEN", "IS", "NOT", "LIKE", "ILIKE"):
                 left = self._postfix_predicate(left)
                 continue
             prec = _PRECEDENCE.get(op)
@@ -602,6 +624,15 @@ class Parser:
             self._expect_kw("AND")
             high = self._expr(_PRECEDENCE["AND"] + 1)
             return ast.Between(left, low, high, negated)
+        for kw, ci in (("LIKE", False), ("ILIKE", True)):
+            if self._eat_kw(kw):
+                t = self._next()
+                if t.kind != "string":
+                    raise ParseError(
+                        f"{kw} expects a string pattern", t.pos, self.sql
+                    )
+                pattern = t.text[1:-1].replace("''", "'")
+                return ast.Like(left, pattern, negated, case_insensitive=ci)
         if not negated and self._eat_kw("IS"):
             neg = self._eat_kw("NOT")
             self._expect_kw("NULL")
@@ -654,6 +685,17 @@ class Parser:
                 return ast.Literal(False)
             if upper == "NULL":
                 return ast.Literal(None)
+            if upper == "CASE":
+                return self._case()
+            if upper == "CAST" and self._at_op("("):
+                self.i += 1
+                inner = self._expr()
+                self._expect_kw("AS")
+                ty = self._next()
+                if ty.kind != "name":
+                    raise ParseError("CAST expects a type name", ty.pos, self.sql)
+                self._expect_op(")")
+                return ast.Cast(inner, ty.text.lower())
             name = t.text if t.kind == "name" else t.text[1:-1]
             if self._at_op("("):
                 self.i += 1
@@ -684,6 +726,29 @@ class Parser:
                 return ast.Column(self._ident(), qualifier=name)
             return ast.Column(name)
         raise ParseError(f"unexpected token {t.text!r}", t.pos, self.sql)
+
+    def _case(self) -> ast.Case:
+        """CASE [operand] WHEN w THEN t ... [ELSE e] END; the simple form
+        (with operand) normalizes to searched conditions (operand = w)."""
+        operand = None
+        if not self._at_kw("WHEN"):
+            operand = self._expr()
+        whens: list[tuple[ast.Expr, ast.Expr]] = []
+        while self._eat_kw("WHEN"):
+            w = self._expr()
+            self._expect_kw("THEN")
+            t = self._expr()
+            if operand is not None:
+                w = ast.BinaryOp("=", operand, w)
+            whens.append((w, t))
+        if not whens:
+            tk = self._peek()
+            raise ParseError("CASE requires at least one WHEN", tk.pos if tk else -1, self.sql)
+        else_ = None
+        if self._eat_kw("ELSE"):
+            else_ = self._expr()
+        self._expect_kw("END")
+        return ast.Case(tuple(whens), else_)
 
     def _window(self, call: ast.FuncCall) -> ast.WindowFunc:
         """fn(...) OVER ( [PARTITION BY e, ...] [ORDER BY e [ASC|DESC], ...] )"""

@@ -138,6 +138,7 @@ class UnionPlan:
     all_flags: tuple[bool, ...] = ()
     order_by: tuple = ()
     limit: "int | None" = None
+    offset: int = 0
 
 
 @dataclass(frozen=True)

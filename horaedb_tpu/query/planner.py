@@ -95,6 +95,7 @@ class Planner:
                 all_flags=stmt.all_flags,
                 order_by=stmt.order_by,
                 limit=stmt.limit,
+                offset=stmt.offset,
             )
         if isinstance(stmt, ast.Select):
             return self._plan_select(stmt)
@@ -661,6 +662,16 @@ def _walk(e: ast.Expr):
             yield from _walk(a)
         if e.filter_where is not None:
             yield from _walk(e.filter_where)
+    elif isinstance(e, ast.Case):
+        for w, t in e.whens:
+            yield from _walk(w)
+            yield from _walk(t)
+        if e.else_ is not None:
+            yield from _walk(e.else_)
+    elif isinstance(e, ast.Cast):
+        yield from _walk(e.expr)
+    elif isinstance(e, ast.Like):
+        yield from _walk(e.expr)
     elif isinstance(e, ast.InList):
         yield from _walk(e.expr)
         for v in e.values:

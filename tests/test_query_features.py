@@ -344,8 +344,17 @@ class TestLeftJoin:
             "SELECT DISTINCT host, owner FROM q LEFT JOIN lo4 "
             "ON q.host = lo4.host ORDER BY owner, host"
         ).to_pylist()
-        # NULL fill is '' (kind default) -> NULL rows sort first, not at 'zed'
-        assert out[0]["owner"] is None and out[-1]["owner"] == "zed"
+        # SQL default NULL placement: LAST under ASC (explicit _null_rank
+        # keys — no longer the ''-fill artifact that put NULLs first); and
+        # NULL rows surface as None, never an arbitrary right-side value.
+        assert out[0]["owner"] == "zed"
+        assert all(r["owner"] is None for r in out[1:])
+        out_first = db.execute(
+            "SELECT DISTINCT host, owner FROM q LEFT JOIN lo4 "
+            "ON q.host = lo4.host ORDER BY owner NULLS FIRST, host"
+        ).to_pylist()
+        assert out_first[-1]["owner"] == "zed"
+        assert all(r["owner"] is None for r in out_first[:-1])
 
 
 class TestLimitPushdown:
@@ -1188,3 +1197,88 @@ class TestAggregateFilterClause:
             db.execute(
                 "SELECT sum(v) FILTER (WHERE v > 1) OVER (ORDER BY ts) AS x FROM f"
             )
+
+
+class TestExpressionSurface:
+    """CASE / CAST / LIKE / OFFSET / NULLS FIRST-LAST / scalar function
+    library (ref surface: the reference's SQL goes through DataFusion,
+    which provides these; here parser + vectorized host evaluation)."""
+
+    def _db(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE ex (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO ex (host, v, ts) VALUES "
+            "('aa',1.0,1),('ab',2.0,2),('bc',3.0,3),('bd',4.0,4)"
+        )
+        return db
+
+    def test_case_searched_and_simple(self):
+        db = self._db()
+        out = db.execute(
+            "SELECT CASE WHEN v > 2 THEN 'big' ELSE 'small' END AS c, v "
+            "FROM ex ORDER BY v"
+        ).to_pylist()
+        assert [r["c"] for r in out] == ["small", "small", "big", "big"]
+        out = db.execute(
+            "SELECT CASE host WHEN 'aa' THEN 1 WHEN 'ab' THEN 2 END AS c "
+            "FROM ex ORDER BY c NULLS LAST"
+        ).to_pylist()
+        assert [r["c"] for r in out] == [1, 2, None, None]
+
+    def test_cast(self):
+        db = self._db()
+        out = db.execute(
+            "SELECT cast(v AS bigint) AS i, cast(v AS string) AS s FROM ex "
+            "ORDER BY v LIMIT 1"
+        ).to_pylist()[0]
+        assert out == {"i": 1, "s": "1.0"}
+
+    def test_like_ilike(self):
+        db = self._db()
+        assert [r["host"] for r in db.execute(
+            "SELECT host FROM ex WHERE host LIKE 'a%' ORDER BY host"
+        ).to_pylist()] == ["aa", "ab"]
+        assert [r["host"] for r in db.execute(
+            "SELECT host FROM ex WHERE host NOT LIKE '%b%' ORDER BY host"
+        ).to_pylist()] == ["aa"]
+        assert [r["host"] for r in db.execute(
+            "SELECT host FROM ex WHERE host ILIKE 'A_' ORDER BY host"
+        ).to_pylist()] == ["aa", "ab"]
+        # regex metacharacters in the pattern are literal
+        assert db.execute(
+            "SELECT host FROM ex WHERE host LIKE 'a.'"
+        ).to_pylist() == []
+
+    def test_offset_with_and_without_limit(self):
+        db = self._db()
+        assert [r["v"] for r in db.execute(
+            "SELECT v FROM ex ORDER BY v LIMIT 2 OFFSET 1"
+        ).to_pylist()] == [2.0, 3.0]
+        assert [r["v"] for r in db.execute(
+            "SELECT v FROM ex ORDER BY v OFFSET 3"
+        ).to_pylist()] == [4.0]
+        assert [r["v"] for r in db.execute(
+            "SELECT v FROM ex UNION ALL SELECT v FROM ex ORDER BY v LIMIT 3 OFFSET 2"
+        ).to_pylist()] == [2.0, 2.0, 3.0]
+
+    def test_scalar_functions(self):
+        import numpy as np
+
+        db = self._db()
+        out = db.execute(
+            "SELECT upper(host) AS u, length(host) AS n, concat(host, '-x') AS c, "
+            "coalesce(v, 0.0) AS co, round(v + 0.44, 1) AS r, floor(v) AS f, "
+            "ceil(v) AS ce, sqrt(v) AS s, power(v, 2) AS p "
+            "FROM ex ORDER BY v LIMIT 1"
+        ).to_pylist()[0]
+        assert out["u"] == "AA" and out["n"] == 2 and out["c"] == "aa-x"
+        assert out["co"] == 1.0 and out["r"] == 1.4 and out["f"] == 1.0
+        assert out["ce"] == 1.0 and np.isclose(out["s"], 1.0) and out["p"] == 1.0
+        neg = db.execute("SELECT sqrt(v - 2.0) AS s FROM ex ORDER BY v LIMIT 1").to_pylist()[0]
+        assert neg["s"] is None  # out of domain -> NULL
