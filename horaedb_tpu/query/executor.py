@@ -738,10 +738,30 @@ class Executor:
 
         names: list[str] = []
         columns: list[np.ndarray] = []
+        nulls: dict[str, np.ndarray] = {}
+        agg_expr_map = dict(plan.agg_exprs)
+        computed = None
+        if agg_expr_map:
+            base = {
+                k.column: (np.asarray(key_values[ki])[g_idx], None)
+                for ki, k in enumerate(tag_keys)
+            }
+            for a in plan.aggs:
+                base[a.output_name] = (
+                    _agg_output(a, agg_cols, counts, sums, mins, maxs, g_idx, b_idx),
+                    None,
+                )
+            computed = eval_agg_exprs(plan, base)
         for item in plan.select.items:
             out_name = item.output_name
             e = item.expr
-            if isinstance(e, ast.Column):
+            if out_name in agg_expr_map:
+                v, nm = computed[out_name]
+                columns.append(v)
+                if nm is not None:
+                    nulls[out_name] = nm
+                names.append(out_name)
+            elif isinstance(e, ast.Column):
                 ki = [k.column for k in tag_keys].index(e.name)
                 columns.append(np.asarray(key_values[ki])[g_idx])
                 names.append(out_name)
@@ -754,7 +774,7 @@ class Executor:
                 col = _agg_output(a, agg_cols, counts, sums, mins, maxs, g_idx, b_idx)
                 columns.append(col)
                 names.append(out_name)
-        result = ResultSet(names, columns, None)
+        result = ResultSet(names, columns, nulls or None)
         return _order_and_limit(result, plan)
 
     # ---- device-cached path (HBM-resident columns) ---------------------------
@@ -1113,10 +1133,31 @@ class Executor:
         names: list[str] = []
         columns: list[np.ndarray] = []
         nulls: dict[str, np.ndarray] = {}
+        agg_expr_map = dict(plan.agg_exprs)
+        computed = None
+        base: dict = {}
+        if agg_expr_map:
+            for ki, gk in enumerate(plan.group_keys):
+                if gk.column is None:
+                    continue
+                vm = key_valids[ki]
+                base[gk.column] = (
+                    as_values(key_arrays[ki][first_idx]),
+                    None if vm is None else ~vm[first_idx],
+                )
+            for a in plan.aggs:
+                base[a.output_name] = _host_agg(a, rows, codes, group_count)
+            computed = eval_agg_exprs(plan, base)
         for item in plan.select.items:
             out_name = item.output_name
             e = item.expr
-            if isinstance(e, ast.Column) or (
+            if out_name in agg_expr_map:
+                v, nm = computed[out_name]
+                columns.append(v)
+                if nm is not None:
+                    nulls[out_name] = nm
+                names.append(out_name)
+            elif isinstance(e, ast.Column) or (
                 isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc")
             ):
                 # Resolve by the EXPRESSION, not the select item's output
@@ -1144,7 +1185,13 @@ class Executor:
             else:
                 agg_i = [a.output_name for a in plan.aggs].index(out_name)
                 a = plan.aggs[agg_i]
-                col, null = _host_agg(a, rows, codes, group_count)
+                # The agg_exprs base already paid for every aggregate —
+                # don't run _host_agg (O(rows)) a second time.
+                col, null = (
+                    base[out_name]
+                    if out_name in base
+                    else _host_agg(a, rows, codes, group_count)
+                )
                 columns.append(col)
                 if null is not None:
                     nulls[out_name] = null
@@ -1231,11 +1278,31 @@ def _is_series_conjunct(conj: ast.Expr, tag_names: set) -> bool:
 
 
 def _empty_ungrouped_agg_row(plan: QueryPlan) -> ResultSet:
+    agg_expr_map = dict(plan.agg_exprs)
+    computed = None
+    if agg_expr_map:
+        # SQL zero-row defaults per aggregate (count 0, others NULL),
+        # then the expression evaluates over that one row.
+        base = {
+            a.output_name: (
+                (np.array([0], dtype=np.int64), None)
+                if a.func == "count"
+                else (np.array([np.nan]), np.array([True]))
+            )
+            for a in plan.aggs
+        }
+        computed = eval_agg_exprs(plan, base)
     names, columns, nulls = [], [], {}
     for item in plan.select.items:
         out_name = item.output_name
-        agg = next((a for a in plan.aggs if a.output_name == out_name), None)
         names.append(out_name)
+        if out_name in agg_expr_map:
+            v, nm = computed[out_name]
+            columns.append(v)
+            if nm is not None:
+                nulls[out_name] = nm
+            continue
+        agg = next((a for a in plan.aggs if a.output_name == out_name), None)
         if agg is not None and agg.func == "count":
             columns.append(np.array([0], dtype=np.int64))
         else:
@@ -1362,6 +1429,27 @@ def _desc_key(arr: np.ndarray) -> np.ndarray:
     if arr.dtype.kind in "fiu":
         return -arr.astype(np.float64)
     return arr  # bool/other: DESC not meaningfully supported
+
+
+def eval_agg_exprs(
+    plan: QueryPlan, base: dict[str, tuple[np.ndarray, Optional[np.ndarray]]]
+) -> dict[str, tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Evaluate the plan's arithmetic-over-aggregate select items per
+    group. ``base`` maps group-key column names and (hidden + named)
+    aggregate output names to (values, nullmask|None); returns the same
+    shape for each computed output."""
+    names, cols, nulls = [], [], {}
+    for name, (v, nm) in base.items():
+        names.append(name)
+        cols.append(np.asarray(v))
+        if nm is not None:
+            nulls[name] = nm
+    shim = _ResultRows(ResultSet(names, cols, nulls or None))
+    out = {}
+    for name, expr in plan.agg_exprs:
+        v, m = eval_expr(expr, shim)
+        out[name] = (as_values(v), None if m.all() else ~m)
+    return out
 
 
 class _ResultRows:

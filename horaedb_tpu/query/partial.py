@@ -301,48 +301,61 @@ def assemble_result(plan: QueryPlan, combined: dict, n_groups: int, spec: dict) 
         return _order_and_limit(ResultSet.empty(names), plan)
     group_tags = list(spec["group_tags"])
     agg_cols = list(spec["agg_cols"])
+
+    def agg_column(a) -> tuple[np.ndarray, np.ndarray | None]:
+        if a.column is None:  # count(*)
+            return combined["__count_rows"], None
+        fi = agg_cols.index(a.column)
+        cnt = combined[f"__count_{fi}"]
+        empty = cnt == 0
+        null = empty if empty.any() else None
+        if a.func == "count":
+            return cnt, None
+        if a.func == "sum":
+            return combined[f"__sum_{fi}"], null
+        if a.func == "avg":
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return combined[f"__sum_{fi}"] / np.maximum(cnt, 1), null
+        if a.func == "min":
+            return combined[f"__min_{fi}"], null
+        if a.func == "max":
+            return combined[f"__max_{fi}"], null
+        # unreachable: shape check restricts the func set
+        raise ValueError(f"unsupported agg {a.func}")
+
     names: list[str] = []
     columns: list[np.ndarray] = []
     nulls: dict[str, np.ndarray] = {}
+    agg_expr_map = dict(plan.agg_exprs)
+    computed = None
+    if agg_expr_map:
+        from .executor import eval_agg_exprs
+
+        base = {
+            tag: (combined[f"__k{ki}"], None)
+            for ki, tag in enumerate(group_tags)
+        }
+        for a in plan.aggs:
+            base[a.output_name] = agg_column(a)
+        computed = eval_agg_exprs(plan, base)
     for item in plan.select.items:
         out_name = item.output_name
         e = item.expr
-        if isinstance(e, ast.Column):
+        if out_name in agg_expr_map:
+            v, nm = computed[out_name]
+            columns.append(v)
+            if nm is not None:
+                nulls[out_name] = nm
+        elif isinstance(e, ast.Column):
             ki = group_tags.index(e.name)
             columns.append(combined[f"__k{ki}"])
         elif isinstance(e, ast.FuncCall) and e.name in ("time_bucket", "date_trunc"):
             columns.append(combined["__bucket"])
         else:
             agg_i = [a.output_name for a in plan.aggs].index(out_name)
-            a = plan.aggs[agg_i]
-            if a.column is None:  # count(*)
-                columns.append(combined["__count_rows"])
-            else:
-                fi = agg_cols.index(a.column)
-                cnt = combined[f"__count_{fi}"]
-                empty = cnt == 0
-                if a.func == "count":
-                    columns.append(cnt)
-                elif a.func == "sum":
-                    columns.append(combined[f"__sum_{fi}"])
-                    if empty.any():
-                        nulls[out_name] = empty
-                elif a.func == "avg":
-                    with np.errstate(divide="ignore", invalid="ignore"):
-                        columns.append(
-                            combined[f"__sum_{fi}"] / np.maximum(cnt, 1)
-                        )
-                    if empty.any():
-                        nulls[out_name] = empty
-                elif a.func == "min":
-                    columns.append(combined[f"__min_{fi}"])
-                    if empty.any():
-                        nulls[out_name] = empty
-                elif a.func == "max":
-                    columns.append(combined[f"__max_{fi}"])
-                    if empty.any():
-                        nulls[out_name] = empty
-                else:  # unreachable: shape check restricts the func set
-                    raise ValueError(f"unsupported agg {a.func}")
+            col, null = agg_column(plan.aggs[agg_i])
+            columns.append(col)
+            if null is not None:
+                nulls[out_name] = null
         names.append(out_name)
     return _order_and_limit(ResultSet(names, columns, nulls or None), plan)

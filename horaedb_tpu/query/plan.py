@@ -67,6 +67,10 @@ class QueryPlan:
     group_keys: tuple[GroupKey, ...] = ()
     is_aggregate: bool = False
     priority: QueryPriority = QueryPriority.HIGH
+    # Arithmetic-over-aggregate select items: (output_name, expr) where
+    # expr references hidden __aggN result columns; evaluated per group
+    # AFTER aggregation (any path), then the hidden columns are dropped.
+    agg_exprs: tuple[tuple[str, ast.Expr], ...] = ()
 
 
 @dataclass(frozen=True)

@@ -257,7 +257,7 @@ class Planner:
         self._check_windows(stmt)
 
         predicate = extract_predicate(stmt.where, schema)
-        aggs, group_keys, is_agg = self._agg_shape(stmt, schema)
+        aggs, group_keys, is_agg, agg_exprs = self._agg_shape(stmt, schema)
 
         tr = predicate.time_range
         span = tr.exclusive_end - tr.inclusive_start
@@ -273,6 +273,7 @@ class Planner:
             group_keys=group_keys,
             is_aggregate=is_agg,
             priority=priority,
+            agg_exprs=agg_exprs,
         )
 
     def _resolve_group_by_aliases(self, stmt: ast.Select, schema: Schema) -> ast.Select:
@@ -414,9 +415,59 @@ class Planner:
                 if len(w.args) != 1:
                     raise PlanError(f"{w.name}(value) window expects one argument")
 
+    def _make_agg_call(
+        self, e: ast.FuncCall, output_name: str, schema: Schema
+    ) -> AggCall:
+        from .functions import REGISTRY as _FN
+
+        col = None
+        col2 = None
+        params: tuple = ()
+        is_binary = _FN.binary_aggregate(e.name) is not None
+        if e.args and not isinstance(e.args[0], ast.Star):
+            if (
+                e.name == "count"
+                and isinstance(e.args[0], ast.Literal)
+                and e.args[0].value is not None
+            ):
+                pass  # count(1) == count(*)
+            elif not isinstance(e.args[0], ast.Column):
+                raise PlanError(
+                    f"aggregate over expression not supported: {e}"
+                )
+            else:
+                col = e.args[0].name
+        if e.name != "count" and col is None:
+            raise PlanError(f"{e.name} requires a column argument")
+        if is_binary:
+            if len(e.args) != 2 or not isinstance(e.args[1], ast.Column):
+                raise PlanError(
+                    f"{e.name}(x, y) expects two column arguments"
+                )
+            col2 = e.args[1].name
+        elif len(e.args) > 1:
+            # Trailing literal parameters (approx_percentile_cont).
+            extra = e.args[1:]
+            if not all(isinstance(a, ast.Literal) for a in extra):
+                raise PlanError(
+                    f"extra arguments of {e.name} must be literals"
+                )
+            params = tuple(a.value for a in extra)
+        numeric_required = e.name in ("sum", "avg") or _FN.numeric_only(e.name)
+        if numeric_required:
+            for c in (col, col2):
+                if c is not None and not schema.column(c).kind.is_numeric:
+                    raise PlanError(
+                        f"{e.name}({c}) requires a numeric column"
+                    )
+        return AggCall(
+            e.name, col, output_name, e.distinct,
+            column2=col2, params=params, filter_where=e.filter_where,
+        )
+
     def _agg_shape(
         self, stmt: ast.Select, schema: Schema
-    ) -> tuple[tuple[AggCall, ...], tuple[GroupKey, ...], bool]:
+    ) -> tuple[tuple[AggCall, ...], tuple[GroupKey, ...], bool, tuple]:
         aggs: list[AggCall] = []
         has_agg = any(
             isinstance(e, ast.FuncCall) and _is_agg_name(e.name)
@@ -426,64 +477,77 @@ class Planner:
         if not has_agg:
             if stmt.group_by:
                 raise PlanError("GROUP BY without aggregates is not supported")
-            return (), (), False
+            return (), (), False, ()
 
         group_keys: list[GroupKey] = []
         for g in stmt.group_by:
             group_keys.append(_group_key(g, schema))
         group_names = {k.output_name for k in group_keys}
 
-        from .functions import REGISTRY as _FN
+        # Hidden aggregates lifted out of arithmetic-over-aggregate select
+        # items (sum(v) / count(*)); deduped by their SQL rendering.
+        hidden: dict[str, AggCall] = {}
+        agg_exprs: list[tuple[str, ast.Expr]] = []
+
+        def lift(expr: ast.Expr) -> ast.Expr:
+            """Replace aggregate calls with hidden result columns; validate
+            the remaining leaves resolve per-group."""
+            if isinstance(expr, ast.FuncCall) and _is_agg_name(expr.name):
+                key = str(expr)
+                if key not in hidden:
+                    hidden[key] = self._make_agg_call(
+                        expr, f"__agg{len(hidden)}", schema
+                    )
+                return ast.Column(hidden[key].output_name)
+            if isinstance(expr, ast.Column):
+                if expr.name not in group_names:
+                    raise PlanError(
+                        f"column {expr.name!r} must appear in GROUP BY "
+                        f"or an aggregate"
+                    )
+                return expr
+            if isinstance(expr, ast.Literal):
+                return expr
+            if isinstance(expr, ast.BinaryOp):
+                return ast.BinaryOp(expr.op, lift(expr.left), lift(expr.right))
+            if isinstance(expr, ast.UnaryOp):
+                return ast.UnaryOp(expr.op, lift(expr.operand))
+            if isinstance(expr, ast.Cast):
+                return ast.Cast(lift(expr.expr), expr.type_name)
+            if isinstance(expr, ast.Case):
+                return ast.Case(
+                    tuple((lift(w), lift(t)) for w, t in expr.whens),
+                    lift(expr.else_) if expr.else_ is not None else None,
+                )
+            if isinstance(expr, ast.FuncCall):
+                return ast.FuncCall(
+                    expr.name, tuple(lift(a) for a in expr.args), expr.distinct
+                )
+            if isinstance(expr, ast.IsNull):
+                return ast.IsNull(lift(expr.expr), expr.negated)
+            if isinstance(expr, ast.Between):
+                return ast.Between(
+                    lift(expr.expr), lift(expr.low), lift(expr.high), expr.negated
+                )
+            if isinstance(expr, ast.InList):
+                return ast.InList(
+                    lift(expr.expr),
+                    tuple(lift(v) for v in expr.values),
+                    expr.negated,
+                )
+            if isinstance(expr, ast.Like):
+                return ast.Like(
+                    lift(expr.expr), expr.pattern, expr.negated,
+                    expr.case_insensitive,
+                )
+            raise PlanError(
+                f"unsupported expression over aggregates: {expr}"
+            )
 
         for item in stmt.items:
             e = item.expr
             if isinstance(e, ast.FuncCall) and _is_agg_name(e.name):
-                col = None
-                col2 = None
-                params: tuple = ()
-                is_binary = _FN.binary_aggregate(e.name) is not None
-                if e.args and not isinstance(e.args[0], ast.Star):
-                    if (
-                        e.name == "count"
-                        and isinstance(e.args[0], ast.Literal)
-                        and e.args[0].value is not None
-                    ):
-                        pass  # count(1) == count(*)
-                    elif not isinstance(e.args[0], ast.Column):
-                        raise PlanError(
-                            f"aggregate over expression not supported: {e}"
-                        )
-                    else:
-                        col = e.args[0].name
-                if e.name != "count" and col is None:
-                    raise PlanError(f"{e.name} requires a column argument")
-                if is_binary:
-                    if len(e.args) != 2 or not isinstance(e.args[1], ast.Column):
-                        raise PlanError(
-                            f"{e.name}(x, y) expects two column arguments"
-                        )
-                    col2 = e.args[1].name
-                elif len(e.args) > 1:
-                    # Trailing literal parameters (approx_percentile_cont).
-                    extra = e.args[1:]
-                    if not all(isinstance(a, ast.Literal) for a in extra):
-                        raise PlanError(
-                            f"extra arguments of {e.name} must be literals"
-                        )
-                    params = tuple(a.value for a in extra)
-                numeric_required = e.name in ("sum", "avg") or _FN.numeric_only(e.name)
-                if numeric_required:
-                    for c in (col, col2):
-                        if c is not None and not schema.column(c).kind.is_numeric:
-                            raise PlanError(
-                                f"{e.name}({c}) requires a numeric column"
-                            )
-                aggs.append(
-                    AggCall(
-                        e.name, col, item.output_name, e.distinct,
-                        column2=col2, params=params, filter_where=e.filter_where,
-                    )
-                )
+                aggs.append(self._make_agg_call(e, item.output_name, schema))
             elif isinstance(e, ast.Column):
                 if e.name not in group_names:
                     raise PlanError(
@@ -497,9 +561,17 @@ class Planner:
                 key = _group_key(e, schema)
                 if key.output_name not in {k.output_name for k in group_keys}:
                     raise PlanError(f"{e.name} in SELECT must also be in GROUP BY")
+            elif any(
+                isinstance(x, ast.FuncCall) and _is_agg_name(x.name)
+                for x in _walk(e)
+            ):
+                # Arithmetic (or CASE/CAST/scalar calls) over aggregates:
+                # evaluate per group AFTER aggregation.
+                agg_exprs.append((item.output_name, lift(e)))
             else:
                 raise PlanError(f"unsupported select item in aggregate query: {e}")
-        return tuple(aggs), tuple(group_keys), True
+        aggs.extend(hidden.values())
+        return tuple(aggs), tuple(group_keys), True, tuple(agg_exprs)
 
 
 # Fixed-width date_trunc units map onto the bucket kernel; month/year are

@@ -1282,3 +1282,68 @@ class TestExpressionSurface:
         assert out["ce"] == 1.0 and np.isclose(out["s"], 1.0) and out["p"] == 1.0
         neg = db.execute("SELECT sqrt(v - 2.0) AS s FROM ex ORDER BY v LIMIT 1").to_pylist()[0]
         assert neg["s"] is None  # out of domain -> NULL
+
+
+class TestAggregateExpressions:
+    """Arithmetic / CASE / scalar functions over aggregates
+    (sum(v)/count(*)): inner aggregate calls lift into hidden __aggN
+    result columns (still served by the fused device kernel when core),
+    the expression evaluates per group after aggregation on every path
+    (device, host, partitioned partial)."""
+
+    def _db(self, partitioned=False):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        part = "PARTITION BY KEY(host) PARTITIONS 4 " if partitioned else ""
+        db.execute(
+            "CREATE TABLE ae (host string TAG, v double, w double, "
+            f"ts timestamp NOT NULL, TIMESTAMP KEY(ts)) {part}ENGINE=Analytic"
+        )
+        rows = ", ".join(
+            f"('h{i%2}', {float(i)}, {float(i*2)}, {i*1000})" for i in range(10)
+        )
+        db.execute(f"INSERT INTO ae (host, v, w, ts) VALUES {rows}")
+        return db
+
+    def test_basic_shapes(self):
+        db = self._db()
+        assert db.execute("SELECT sum(v) / count(*) AS r FROM ae").to_pylist() == [{"r": 4.5}]
+        assert db.execute("SELECT max(v) - min(v) AS s FROM ae").to_pylist() == [{"s": 9.0}]
+        assert db.execute("SELECT 100 * count(*) AS p FROM ae").to_pylist() == [{"p": 1000}]
+        assert db.execute("SELECT round(avg(v), 1) AS a FROM ae").to_pylist() == [{"a": 4.5}]
+
+    def test_grouped_and_case(self):
+        db = self._db()
+        out = db.execute(
+            "SELECT host, sum(v) / count(*) AS r FROM ae GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert out == [{"host": "h0", "r": 4.0}, {"host": "h1", "r": 5.0}]
+        out = db.execute(
+            "SELECT host, CASE WHEN avg(v) > 4.5 THEN 'hi' ELSE 'lo' END AS b "
+            "FROM ae GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert out == [{"host": "h0", "b": "lo"}, {"host": "h1", "b": "hi"}]
+
+    def test_zero_rows_and_filter(self):
+        db = self._db()
+        assert db.execute(
+            "SELECT sum(v) / count(*) AS r FROM ae WHERE v > 100"
+        ).to_pylist() == [{"r": None}]
+        assert db.execute(
+            "SELECT sum(v) FILTER (WHERE host='h0') / count(*) AS r FROM ae"
+        ).to_pylist() == [{"r": 2.0}]
+
+    def test_partitioned_partial_path(self):
+        db = self._db(partitioned=True)
+        out = db.execute(
+            "SELECT host, sum(v) / count(*) AS r FROM ae GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert out == [{"host": "h0", "r": 4.0}, {"host": "h1", "r": 5.0}]
+
+    def test_non_group_column_rejected(self):
+        import pytest
+
+        db = self._db()
+        with pytest.raises(Exception, match="GROUP BY"):
+            db.execute("SELECT sum(v) + w AS x FROM ae GROUP BY host")
