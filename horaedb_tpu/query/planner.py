@@ -485,19 +485,38 @@ class Planner:
         group_names = {k.output_name for k in group_keys}
 
         # Hidden aggregates lifted out of arithmetic-over-aggregate select
-        # items (sum(v) / count(*)); deduped by their SQL rendering.
+        # items (sum(v) / count(*)); deduped by their SQL rendering, and
+        # against identical SELECT-level aggregates (computed once). Names
+        # must not collide with user aliases — '__aggN' is not reserved
+        # syntax, so probe for a free name instead of assuming.
         hidden: dict[str, AggCall] = {}
         agg_exprs: list[tuple[str, ast.Expr]] = []
+        plain_by_render: dict[str, str] = {
+            str(item.expr): item.output_name
+            for item in stmt.items
+            if isinstance(item.expr, ast.FuncCall) and _is_agg_name(item.expr.name)
+        }
+        used_names = {item.output_name for item in stmt.items}
+
+        def hidden_name() -> str:
+            i = len(hidden)
+            while f"__agg{i}" in used_names:
+                i += 1
+            name = f"__agg{i}"
+            used_names.add(name)
+            return name
 
         def lift(expr: ast.Expr) -> ast.Expr:
             """Replace aggregate calls with hidden result columns; validate
             the remaining leaves resolve per-group."""
             if isinstance(expr, ast.FuncCall) and _is_agg_name(expr.name):
                 key = str(expr)
+                if key in plain_by_render:
+                    # The same aggregate is already a SELECT item — read
+                    # its result column instead of computing it twice.
+                    return ast.Column(plain_by_render[key])
                 if key not in hidden:
-                    hidden[key] = self._make_agg_call(
-                        expr, f"__agg{len(hidden)}", schema
-                    )
+                    hidden[key] = self._make_agg_call(expr, hidden_name(), schema)
                 return ast.Column(hidden[key].output_name)
             if isinstance(expr, ast.Column):
                 if expr.name not in group_names:

@@ -1,0 +1,18 @@
+-- statistical aggregates, FILTER clause, arithmetic over aggregates
+CREATE TABLE ag (host string TAG, v double, w double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO ag (host, v, w, ts) VALUES
+  ('a', 1.0, 2.0, 1), ('a', 2.0, 4.0, 2), ('a', 3.0, 6.0, 3),
+  ('b', 10.0, 5.0, 4), ('b', 20.0, 15.0, 5), ('b', 30.0, 19.0, 6);
+SELECT stddev(v) AS sd, var_pop(v) AS vp FROM ag WHERE host = 'a';
+SELECT host, median(v) AS m FROM ag GROUP BY host ORDER BY host;
+SELECT approx_percentile_cont(v, 0.5) AS p50 FROM ag;
+SELECT corr(v, w) AS c FROM ag WHERE host = 'a';
+SELECT approx_distinct(host) AS hosts FROM ag;
+SELECT count(*) FILTER (WHERE v >= 10) AS big, count(*) FILTER (WHERE v < 10) AS small FROM ag;
+SELECT host, sum(v) FILTER (WHERE w > 4) AS s FROM ag GROUP BY host ORDER BY host;
+SELECT sum(v) / count(*) AS mean, max(v) - min(v) AS spread FROM ag;
+SELECT host, round(sum(w) / sum(v), 3) AS ratio FROM ag GROUP BY host ORDER BY host;
+SELECT CASE WHEN sum(v) IS NULL THEN 0.0 ELSE sum(v) END AS total FROM ag WHERE v > 99;
+SELECT time_bucket(ts, 2) AS b, count(*) AS c FROM ag GROUP BY b ORDER BY b;
+SELECT date_trunc('second', ts) AS s, count(*) AS c FROM ag GROUP BY s ORDER BY s;
+DROP TABLE ag;

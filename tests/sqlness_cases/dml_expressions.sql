@@ -1,0 +1,17 @@
+-- CASE / CAST / LIKE / OFFSET / NULLS placement / scalar functions
+CREATE TABLE fx (host string TAG, lbl string, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO fx (host, lbl, v, ts) VALUES
+  ('aa', 'x', 1.0, 1), ('ab', NULL, 2.0, 2), ('bc', 'y', 3.0, 3), ('bd', 'z', 4.0, 4);
+SELECT CASE WHEN v > 2 THEN 'big' ELSE 'small' END AS size, v FROM fx ORDER BY v;
+SELECT CASE host WHEN 'aa' THEN 1 WHEN 'ab' THEN 2 END AS code FROM fx ORDER BY code NULLS LAST;
+SELECT cast(v AS bigint) AS i, cast(v AS string) AS s FROM fx ORDER BY v LIMIT 2;
+SELECT host FROM fx WHERE host LIKE 'a%' ORDER BY host;
+SELECT host FROM fx WHERE host NOT LIKE '%b%' ORDER BY host;
+SELECT host FROM fx WHERE host ILIKE 'A_' ORDER BY host;
+SELECT v FROM fx ORDER BY v LIMIT 2 OFFSET 1;
+SELECT lbl FROM fx ORDER BY lbl NULLS FIRST, v;
+SELECT lbl FROM fx ORDER BY lbl DESC NULLS LAST, v;
+SELECT upper(host) AS u, length(host) AS n, concat(host, '-x') AS cx FROM fx ORDER BY v LIMIT 1;
+SELECT coalesce(lbl, 'none') AS l FROM fx ORDER BY v;
+SELECT round(v + 0.44, 1) AS r, floor(v) AS f, sqrt(v) AS s FROM fx ORDER BY v LIMIT 1;
+DROP TABLE fx;

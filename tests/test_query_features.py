@@ -1347,3 +1347,20 @@ class TestAggregateExpressions:
         db = self._db()
         with pytest.raises(Exception, match="GROUP BY"):
             db.execute("SELECT sum(v) + w AS x FROM ae GROUP BY host")
+
+    def test_hidden_name_collision_and_dedupe(self):
+        db = self._db()
+        # a user alias may legally be '__agg0' — the hidden name probes
+        # around it (FILTER forces the host path, where the collision bit)
+        out = db.execute(
+            "SELECT host, sum(v) AS __agg0, "
+            "sum(w) FILTER (WHERE w > 0) / count(*) AS r "
+            "FROM ae GROUP BY host ORDER BY host"
+        ).to_pylist()
+        assert out[0]["__agg0"] == 20.0 and out[0]["r"] == 8.0
+        # an aggregate appearing both standalone and inside an expression
+        # is computed once (reuses the select item's result column)
+        plan = db.frontend.sql_to_plan("SELECT avg(v) AS a, avg(v)/2 AS h FROM ae")
+        assert len(plan.aggs) == 1
+        row = db.execute("SELECT avg(v) AS a, avg(v)/2 AS h FROM ae").to_pylist()[0]
+        assert row == {"a": 4.5, "h": 2.25}
