@@ -14,6 +14,20 @@ from ...common_types.time_range import TimeRange
 SST_META_KEY = b"horaedb_tpu.sst_meta"
 
 
+def footer_payload(parquet_file, path: str) -> dict:
+    """The raw JSON payload embedded in an SST's Parquet footer — the ONE
+    place that knows the key and the not-an-SST error. Callers: the
+    engine reader (SstMeta), sst_metadata (inspection) and sst_convert
+    (which also wants the embedded ``schema`` dict)."""
+    import json
+
+    kv = parquet_file.schema_arrow.metadata or {}
+    raw = kv.get(SST_META_KEY)
+    if raw is None:
+        raise ValueError(f"{path}: not a horaedb_tpu SST (missing footer meta)")
+    return json.loads(raw)
+
+
 @dataclass(frozen=True)
 class SstMeta:
     file_id: int

@@ -21,7 +21,6 @@ from typing import Optional, Sequence
 import pyarrow as pa
 import pyarrow.parquet as pq
 
-import json
 
 from ...common_types.row_group import RowGroup
 from ...common_types.schema import Schema, project_schema
@@ -49,11 +48,9 @@ class SstReader:
         return self._pf
 
     def read_meta(self) -> SstMeta:
-        kv = self._parquet_file().schema_arrow.metadata or {}
-        raw = kv.get(SST_META_KEY)
-        if raw is None:
-            raise ValueError(f"{self.path}: not a horaedb_tpu SST (missing footer meta)")
-        d = json.loads(raw)
+        from .meta import footer_payload
+
+        d = footer_payload(self._parquet_file(), self.path)
         # The footer is written before the final file size is known; the
         # store is authoritative for size.
         d["size_bytes"] = self.store.head(self.path)

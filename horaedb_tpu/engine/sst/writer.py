@@ -68,8 +68,19 @@ class SstWriter:
             ),
         )
         existing = table.schema.metadata or {}
+        # The embedded payload also carries the FULL schema (not just its
+        # version) so an SST is self-describing: offline tools (sst_convert,
+        # inspection) and disaster recovery can decode it without the
+        # manifest (ref: the reference's custom parquet meta embeds schema,
+        # sst/parquet/encoding.rs). Readers of the SstMeta dataclass ignore
+        # the extra key — old files without it stay readable.
         table = table.replace_schema_metadata(
-            {**existing, SST_META_KEY: json.dumps(meta.to_dict()).encode()}
+            {
+                **existing,
+                SST_META_KEY: json.dumps(
+                    {**meta.to_dict(), "schema": schema.to_dict()}
+                ).encode(),
+            }
         )
 
         buf = io.BytesIO()

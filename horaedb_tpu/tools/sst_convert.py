@@ -1,0 +1,152 @@
+"""SST conversion tool (ref: src/tools sst-convert bin — rewrites SSTs
+under different storage options).
+
+    python -m horaedb_tpu.tools.sst_convert IN.sst --out OUT.sst \
+        [--compression zstd|lz4|snappy|gzip|none] [--row-group-size N]
+    python -m horaedb_tpu.tools.sst_convert IN.sst --out OUT.parquet \
+        --export-parquet        # plain parquet, custom metadata stripped
+
+Rewriting goes through the REAL SstWriter (flush discipline: sorted rows,
+row-group filters, column ranges, embedded schema), so a converted file
+is byte-format identical to what a fresh flush would produce with those
+options. The schema comes from the SST's own embedded copy; files written
+before schemas were embedded need ``--data-dir`` to resolve it from the
+table's manifest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(path: str):
+    """-> (pa.Table, SstMeta, Schema | None) from a local .sst file.
+    ``schema`` is None for files written before schemas were embedded."""
+    import pyarrow.parquet as pq
+
+    from ..common_types.schema import Schema
+    from ..engine.sst.meta import SstMeta, footer_payload
+
+    pf = pq.ParquetFile(path, memory_map=True)
+    try:
+        payload = footer_payload(pf, path)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    meta = SstMeta.from_dict(payload)
+    schema_dict = payload.get("schema")
+    schema = Schema.from_dict(schema_dict) if schema_dict else None
+    return pf.read(), meta, schema
+
+
+def convert(
+    in_path: str,
+    out_path: str,
+    compression: str = "zstd",
+    row_group_size: int = 8192,
+    export_parquet: bool = False,
+    data_dir: str | None = None,
+) -> dict:
+    from ..common_types.row_group import RowGroup
+    from ..engine.sst.writer import SstWriter, WriteOptions
+    from ..utils.object_store import LocalDiskStore
+
+    table, meta, schema = _load(in_path)
+    if export_parquet:
+        # Raw arrow table straight back out — no columnar decode/re-encode
+        # just to strip metadata.
+        import pyarrow.parquet as pq
+
+        table = table.replace_schema_metadata(None)
+        pq.write_table(
+            table, out_path,
+            row_group_size=row_group_size, compression=compression,
+        )
+        return {
+            "out": out_path, "rows": table.num_rows,
+            "bytes": os.path.getsize(out_path), "format": "parquet",
+        }
+    if schema is None:
+        schema = _schema_from_manifest(in_path, data_dir)
+        if schema.version != meta.schema_version:
+            # Rewriting with a NEWER schema would materialize ALTER-added
+            # columns and re-stamp the footer version while the manifest
+            # still records this file at the old one — refuse rather than
+            # silently diverge.
+            raise SystemExit(
+                f"{in_path}: recorded schema v{meta.schema_version} but the "
+                f"manifest is at v{schema.version} — converting would "
+                "silently upgrade the file's schema; flush/compact the "
+                "table instead"
+            )
+    rows = RowGroup.from_arrow(schema, table)
+    out_dir = os.path.dirname(os.path.abspath(out_path)) or "."
+    store = LocalDiskStore(out_dir)
+    writer = SstWriter(
+        store,
+        WriteOptions(
+            num_rows_per_row_group=row_group_size, compression=compression
+        ),
+    )
+    new_meta = writer.write(
+        os.path.basename(out_path), meta.file_id, rows,
+        max_sequence=meta.max_sequence,
+    )
+    return {
+        "out": out_path, "rows": new_meta.num_rows,
+        "bytes": new_meta.size_bytes, "format": "sst",
+        "file_id": new_meta.file_id, "max_sequence": new_meta.max_sequence,
+    }
+
+
+def _schema_from_manifest(sst_path: str, data_dir: str | None):
+    """Legacy SSTs (no embedded schema): resolve via the table manifest.
+    The SST path layout is {data_dir}/{space}/{table}/{fid}.sst."""
+    if data_dir is None:
+        raise SystemExit(
+            f"{sst_path}: no embedded schema (written before schemas were "
+            "embedded) — pass --data-dir so the manifest can be consulted"
+        )
+    from ..engine.manifest import Manifest
+    from ..utils.object_store import LocalDiskStore
+
+    rel = os.path.relpath(os.path.abspath(sst_path), os.path.abspath(data_dir))
+    parts = rel.split(os.sep)
+    if len(parts) != 3:
+        raise SystemExit(
+            f"{sst_path}: not under the {{space}}/{{table}}/ layout of {data_dir}"
+        )
+    space_id, table_id = int(parts[0]), int(parts[1])
+    state = Manifest(LocalDiskStore(data_dir), space_id, table_id).load()
+    if state.schema is None:
+        raise SystemExit(f"{sst_path}: manifest has no schema for table {table_id}")
+    return state.schema
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="rewrite a horaedb_tpu SST")
+    p.add_argument("path", help="input .sst file")
+    p.add_argument("--out", required=True, help="output path")
+    p.add_argument("--compression", default="zstd",
+                   choices=["zstd", "lz4", "snappy", "gzip", "none"])
+    p.add_argument("--row-group-size", type=int, default=8192)
+    p.add_argument("--export-parquet", action="store_true",
+                   help="write plain parquet (custom metadata stripped)")
+    p.add_argument("--data-dir", default=None,
+                   help="data dir for manifest schema resolution (legacy SSTs)")
+    args = p.parse_args(argv)
+    out = convert(
+        args.path, args.out,
+        compression=args.compression,
+        row_group_size=args.row_group_size,
+        export_parquet=args.export_parquet,
+        data_dir=args.data_dir,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

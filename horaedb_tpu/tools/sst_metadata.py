@@ -16,13 +16,14 @@ import sys
 def describe(path: str) -> dict:
     import pyarrow.parquet as pq
 
-    from ..engine.sst.meta import SST_META_KEY
+    from ..engine.sst.meta import footer_payload
 
     pf = pq.ParquetFile(path, memory_map=True)
     md = pf.metadata
-    kv = pf.schema_arrow.metadata or {}
-    raw = kv.get(SST_META_KEY)
-    own = json.loads(raw) if raw is not None else None
+    try:
+        own = footer_payload(pf, path)
+    except ValueError:
+        own = None
     row_groups = []
     for rg in range(md.num_row_groups):
         g = md.row_group(rg)
