@@ -468,10 +468,39 @@ def parse_influxql(q: str):
 # ---- translation onto the SQL pipeline -----------------------------------
 
 
+# Selector functions attach the SELECTED ROW's other values when mixed
+# with raw columns (InfluxDB 1.x: SELECT max(usage), host FROM cpu
+# returns the max row with its host) — aggregators like mean() stay an
+# error in that mix, same as InfluxDB.
+_SELECTOR_FUNCS = {"first", "last", "max", "min"}
+
+
+def _selector_with_fields(sel: InfluxSelect):
+    """-> (func, col) when the select list is exactly one selector
+    aggregate over a named field plus >=1 raw columns, else None."""
+    aggish = [it for it in sel.items if it[0] in ("agg", "agg2", "transform")]
+    cols = [it for it in sel.items if it[0] == "col"]
+    if (
+        len(aggish) == 1
+        and cols
+        and not any(it[0] == "star" for it in sel.items)
+        and aggish[0][0] == "agg"
+        and aggish[0][1] in _SELECTOR_FUNCS
+        and aggish[0][2] is not None
+    ):
+        return aggish[0][1], aggish[0][2]
+    return None
+
+
 def _needs_host_path(sel: InfluxSelect) -> bool:
-    return any(it[0] in ("agg2", "transform")
-               or (it[0] == "agg" and it[1] in HOST_AGGS)
-               for it in sel.items)
+    return (
+        any(
+            it[0] in ("agg2", "transform")
+            or (it[0] == "agg" and it[1] in HOST_AGGS)
+            for it in sel.items
+        )
+        or _selector_with_fields(sel) is not None
+    )
 
 
 def _resolve_regex(conn, sel: InfluxSelect, schema) -> Optional[tuple]:
@@ -663,6 +692,9 @@ def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
     """Selector/statistic/transform functions: fetch the raw (tag, time,
     field) rows through the scan (predicates still push down), aggregate
     per (tag-set, bucket) in numpy."""
+    swf = _selector_with_fields(sel)
+    if swf is not None:
+        return _evaluate_selector_row(conn, sel, schema, where, *swf)
     ts = schema.timestamp_name
     tags = _expand_tags(sel, schema)
 
@@ -751,6 +783,77 @@ def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
             "columns": ["time"] + (["distinct"] if flat[0][1] == "distinct"
                                    else labels),
             "values": out_rows,
+        }
+        if key:
+            s["tags"] = {t: v for t, v in key}
+        series.append(s)
+    return series
+
+
+def _evaluate_selector_row(
+    conn, sel: InfluxSelect, schema, where, func: str, sel_col: str
+) -> list[dict]:
+    """One selector aggregate + raw columns: per (tag-set, bucket) the
+    selector picks a ROW, and the raw columns report that row's values
+    (InfluxDB selector semantics; ties break on earliest time, like
+    influx's stable scan order)."""
+    ts = schema.timestamp_name
+    tags = _expand_tags(sel, schema)
+    labels = _unique_labels(sel.items)
+    extra_cols = [it[1] for it in sel.items if it[0] == "col"]
+    for c in extra_cols:
+        if not schema.has_column(c):
+            # A typo must error, not render a plausible all-null column.
+            raise InfluxQLError(f"unknown column {c!r}")
+    need = sorted({sel_col, *extra_cols})
+    proj = [f"`{t}`" for t in tags] + [f"`{ts}`"] + [f"`{c}`" for c in need]
+    sql = f"SELECT {', '.join(dict.fromkeys(proj))} FROM `{sel.measurement}`"
+    if where is not None:
+        sql += " WHERE " + _cond_sql(where, ts)
+    rows = conn.execute(sql).to_pylist()
+    if not rows:
+        return []
+
+    width = sel.group_time_ms
+    groups: dict[tuple, dict[int, dict]] = {}
+    for r in rows:
+        if r.get(sel_col) is None:
+            continue  # selector ignores NULL values
+        key = tuple((t, r.get(t)) for t in tags)
+        bucket = (r[ts] // width) * width if width else 0
+        cur = groups.setdefault(key, {}).get(bucket)
+        v, t_ms = r[sel_col], r[ts]
+        if cur is None:
+            groups[key][bucket] = r
+            continue
+        cv, ct = cur[sel_col], cur[ts]
+        if func == "max":
+            better = v > cv or (v == cv and t_ms < ct)
+        elif func == "min":
+            better = v < cv or (v == cv and t_ms < ct)
+        elif func == "first":
+            better = t_ms < ct
+        else:  # last
+            better = t_ms > ct
+        if better:
+            groups[key][bucket] = r
+
+    series = []
+    for key in sorted(groups, key=lambda k: tuple(str(v) for _, v in k)):
+        values = []
+        for b in sorted(groups[key]):
+            r = groups[key][b]
+            row = [b if width else r[ts]]
+            for it in sel.items:
+                if it[0] == "agg":
+                    row.append(r[sel_col])
+                else:
+                    row.append(r.get(it[1]))
+            values.append(row)
+        s: dict[str, Any] = {
+            "name": sel.measurement,
+            "columns": ["time"] + labels,
+            "values": values,
         }
         if key:
             s["tags"] = {t: v for t, v in key}
@@ -1131,7 +1234,18 @@ def _post_series(series: list[dict], sel: InfluxSelect, host: bool) -> list[dict
         if (sel.group_time_ms and sel.fill is not None and vals
                 and not is_distinct):
             n_aggs = len(s["columns"]) - 1
-            vals = _fill_buckets(vals, sel, n_aggs)
+            # Selector-with-fields: FILL applies to the AGGREGATE column
+            # only — companion row values stay null in synthesized
+            # buckets (a numeric fill in a tag column, or linear
+            # interpolation over strings, would corrupt the series).
+            fillable = None
+            if _selector_with_fields(sel) is not None:
+                fillable = {
+                    i + 1
+                    for i, it in enumerate(sel.items)
+                    if it[0] == "agg"
+                }
+            vals = _fill_buckets(vals, sel, n_aggs, fillable)
         if sel.order_desc:
             vals = vals[::-1]
         if sel.offset and _is_agg_query(sel):
@@ -1151,9 +1265,14 @@ def _is_agg_query(sel: InfluxSelect) -> bool:
     return any(it[0] in ("agg", "agg2", "transform") for it in sel.items)
 
 
-def _fill_buckets(vals: list, sel: InfluxSelect, n_aggs: int) -> list:
+def _fill_buckets(
+    vals: list, sel: InfluxSelect, n_aggs: int, fillable: set[int] | None = None
+) -> list:
     """FILL(x | previous | linear): materialize empty time buckets inside
-    the covered range."""
+    the covered range. ``fillable`` restricts which 1-based columns take
+    the fill value (None = all); unlisted columns stay null."""
+    if fillable is None:
+        fillable = set(range(1, n_aggs + 1))
     width = sel.group_time_ms
     lo = vals[0][0]
     hi = vals[-1][0]
@@ -1174,17 +1293,20 @@ def _fill_buckets(vals: list, sel: InfluxSelect, n_aggs: int) -> list:
         if t in have:
             filled.append(have[t])
         elif isinstance(sel.fill, float):
-            filled.append([t] + [sel.fill] * n_aggs)
+            filled.append(
+                [t] + [sel.fill if c in fillable else None
+                       for c in range(1, n_aggs + 1)]
+            )
         else:
             filled.append([t] + [None] * n_aggs)  # previous/linear patch next
         t += width
     if sel.fill == "previous":
         for i in range(1, len(filled)):
-            for c in range(1, n_aggs + 1):
+            for c in fillable:
                 if filled[i][c] is None:
                     filled[i][c] = filled[i - 1][c]
     elif sel.fill == "linear":
-        for c in range(1, n_aggs + 1):
+        for c in sorted(fillable):
             known = [i for i, r in enumerate(filled) if r[c] is not None]
             for i, r in enumerate(filled):
                 if r[c] is not None:

@@ -432,3 +432,80 @@ class TestReviewRegressions:
     def test_selector_star_rejected(self, conn):
         with pytest.raises(InfluxQLError, match="name a field"):
             evaluate(conn, "SELECT first(*) FROM h2o")
+
+
+class TestSelectorWithFields:
+    """InfluxDB 1.x selector semantics: SELECT max(usage), host returns
+    the SELECTED ROW's companion values; aggregators like mean() stay an
+    error in that mix (ref: the forked-IOx planner's selector handling,
+    query_frontend/src/influxql/planner.rs)."""
+
+    def _db(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE cpu (host string TAG, usage double, idle double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO cpu (host, usage, idle, ts) VALUES "
+            "('a',1.0,9.0,1000),('b',5.0,7.0,2000),('a',3.0,8.0,61000)"
+        )
+        return db
+
+    def test_selector_attaches_row_values(self):
+        from horaedb_tpu.proxy.influxql import evaluate
+
+        db = self._db()
+        s = evaluate(db, 'SELECT max(usage), host FROM "cpu"')["results"][0]["series"][0]
+        assert s["columns"] == ["time", "max", "host"]
+        assert s["values"] == [[2000, 5.0, "b"]]
+        s = evaluate(db, 'SELECT first(usage), host, idle FROM "cpu"')["results"][0]["series"][0]
+        assert s["values"] == [[1000, 1.0, "a", 9.0]]
+        s = evaluate(db, 'SELECT last(usage), host FROM "cpu"')["results"][0]["series"][0]
+        assert s["values"] == [[61000, 3.0, "a"]]
+
+    def test_selector_with_time_buckets_and_group_by(self):
+        from horaedb_tpu.proxy.influxql import evaluate
+
+        db = self._db()
+        s = evaluate(db, 'SELECT max(usage), idle FROM "cpu" GROUP BY time(1m)')
+        vals = s["results"][0]["series"][0]["values"]
+        assert vals == [[0, 5.0, 7.0], [60000, 3.0, 8.0]]
+        out = evaluate(db, 'SELECT min(usage), idle FROM "cpu" GROUP BY host')
+        series = out["results"][0]["series"]
+        assert {tuple(s["tags"].items()) for s in series} == {
+            (("host", "a"),), (("host", "b"),)
+        }
+
+    def test_aggregator_mix_still_rejected(self):
+        import pytest
+
+        from horaedb_tpu.proxy.influxql import InfluxQLError, evaluate
+
+        db = self._db()
+        with pytest.raises(InfluxQLError, match="mixing"):
+            evaluate(db, 'SELECT mean(usage), host FROM "cpu"')
+
+    def test_fill_spares_companion_columns(self):
+        from horaedb_tpu.proxy.influxql import evaluate
+
+        db = self._db()
+        # gap bucket at minute 1 (rows at 1s/2s and 61s)
+        out = evaluate(
+            db, 'SELECT max(usage), host FROM "cpu" '
+                'WHERE time < 2h GROUP BY time(2m) fill(0)'
+        )
+        for row in out["results"][0]["series"][0]["values"]:
+            # numeric fill never lands in the string companion column
+            assert row[2] is None or isinstance(row[2], str), row
+
+    def test_unknown_companion_column_errors(self):
+        import pytest
+
+        from horaedb_tpu.proxy.influxql import InfluxQLError, evaluate
+
+        db = self._db()
+        with pytest.raises(InfluxQLError, match="unknown column"):
+            evaluate(db, 'SELECT max(usage), nosuch FROM "cpu"')
