@@ -212,6 +212,30 @@ class Compactor:
                 _M_COMPACT_ROWS.inc(result.rows_written)
         return result
 
+    @staticmethod
+    def needs_work(table: TableData, l0_trigger: int, now_ms: int | None = None) -> bool:
+        """The ONE trigger predicate, shared by the flush path
+        (maybe_compact) and the periodic scheduler loop (ref:
+        scheduler.rs's background picking — flushless tables must still
+        expire TTL data and fold L0). True when the trigger-level L0
+        gate passes AND the table's actual picker would emit a task —
+        gating on file count alone would re-request a size_tiered table
+        whose files never group, running a futile pass every tick."""
+        seg_ms = table.options.segment_duration_ms
+        if seg_ms:
+            windows = bucket_by_window(table.version.levels.files_at(0), seg_ms)
+            if (
+                windows
+                and max(len(v) for v in windows.values()) >= l0_trigger
+                and make_picker(table.options.compaction_strategy).pick(table)
+            ):
+                return True
+        if table.options.enable_ttl:
+            now = now_ms if now_ms is not None else int(time.time() * 1000)
+            if table.version.levels.expired_files(now, table.options.ttl_ms):
+                return True
+        return False
+
     def _drop_expired(self, result: CompactionResult, now_ms: int | None) -> None:
         table = self.table
         if not table.options.enable_ttl:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
@@ -41,6 +42,10 @@ _M_FAILURES = REGISTRY.counter(
     "engine_compaction_failures_total",
     "background compactions that raised",
 )
+_M_BACKOFF = REGISTRY.counter(
+    "engine_compaction_requests_backoff_total",
+    "compaction requests suppressed by per-table failure backoff",
+)
 _M_DEPTH = REGISTRY.gauge(
     "engine_compaction_queue_depth",
     "background compactions queued or running",
@@ -57,6 +62,40 @@ class CompactionScheduler:
             max_workers=workers, thread_name_prefix="compaction"
         )
         self._closed = False
+        self._stop = threading.Event()
+        self._periodic: threading.Thread | None = None
+        # Per-table failure backoff: without it the periodic loop would
+        # retry (and stack-trace-log) a durably failing table every tick
+        # forever. Exponential from 30s, capped at 1h; success clears.
+        self._backoff: dict[tuple[int, int], tuple[int, float]] = {}
+
+    def start_periodic(self, interval_s: float, scan_fn: Callable) -> None:
+        """Background picking loop (ref: scheduler.rs — the scheduler
+        wakes on its own, not only on flush requests): every
+        ``interval_s``, ``scan_fn`` inspects tables and request()s work;
+        a ``False`` return ends the loop (the instance-side weakref
+        wrapper returns it once its instance is collected). Idempotent;
+        the thread dies promptly on close(). The loop closure captures
+        ONLY the stop event — a strong ``self`` would chain thread ->
+        scheduler -> run_fn -> instance and pin an abandoned engine
+        forever."""
+        with self._lock:
+            if self._closed or self._periodic is not None:
+                return
+            stop = self._stop
+
+            def loop():
+                while not stop.wait(interval_s):
+                    try:
+                        if scan_fn() is False:
+                            return
+                    except Exception:
+                        logger.exception("periodic compaction scan failed")
+
+            self._periodic = threading.Thread(
+                target=loop, name="compaction-tick", daemon=True
+            )
+            self._periodic.start()
 
     def _update_depth_locked(self) -> None:
         _M_DEPTH.set(len(self._pending) + self._running)
@@ -75,6 +114,10 @@ class CompactionScheduler:
                 return False
             if key in self._pending:
                 _M_DEDUPED.inc()
+                return False
+            entry = self._backoff.get(key)
+            if entry is not None and time.monotonic() < entry[1]:
+                _M_BACKOFF.inc()
                 return False
             self._pending.add(key)
             self._update_depth_locked()
@@ -96,11 +139,17 @@ class CompactionScheduler:
             self._update_depth_locked()
         try:
             self._run_fn(table)
+            with self._lock:
+                self._backoff.pop(key, None)
         except Exception:
             _M_FAILURES.inc()
+            with self._lock:
+                fails = self._backoff.get(key, (0, 0.0))[0] + 1
+                delay = min(30.0 * (2 ** (fails - 1)), 3600.0)
+                self._backoff[key] = (fails, time.monotonic() + delay)
             logger.exception(
-                "background compaction failed for table %s (will be "
-                "re-requested by the next flush)", table.name,
+                "background compaction failed for table %s (attempt %d; "
+                "suppressed for %.0fs)", table.name, fails, delay,
             )
         finally:
             with self._lock:
@@ -115,6 +164,10 @@ class CompactionScheduler:
         instance's manifest appends."""
         with self._lock:
             self._closed = True
+            periodic = self._periodic
+        self._stop.set()
+        if periodic is not None:
+            periodic.join(timeout=5)
         self._executor.shutdown(wait=True, cancel_futures=not wait)
         with self._lock:
             # Cancelled futures never ran _run; don't leave their pending

@@ -38,6 +38,10 @@ class EngineConfig:
     # reference's scheduler.rs model: writes never block on a merge).
     # False = inline after flush (deterministic; some tests want it).
     background_compaction: bool = True
+    # Periodic background pick (ref: scheduler.rs's loop, not just
+    # flush-triggered): a table that stops receiving writes must still
+    # expire TTL data and fold accumulated L0. 0 disables.
+    compaction_interval_s: float = 60.0
 
 
 class Instance:
@@ -77,6 +81,9 @@ class Instance:
             )
             table = TableData(space_id, table_id, name, schema, options, manifest, self.store)
             self._tables[key] = table
+            # No eager scheduler here: a freshly-created table has no
+            # data to expire or fold; the first flush request (or a
+            # recovered-table open) starts the background machinery.
             return table
 
     def open_table(self, space_id: int, table_id: int, name: str) -> Optional[TableData]:
@@ -101,7 +108,33 @@ class Instance:
         # Outside the instance lock: sweeping walks the table's store
         # prefix and must not serialize other table opens behind it.
         self._sweep_orphan_ssts(table)
+        # A recovered table may hold TTL-expired files or trigger-level
+        # L0 and never see a flush — the periodic loop must be alive.
+        self._ensure_background()
         return table
+
+    def _ensure_background(self) -> None:
+        if self.config.background_compaction and self.config.compaction_interval_s > 0:
+            self._compaction_scheduler()
+
+    def _make_periodic_scan(self):
+        """Weakref-wrapped tick: an Instance abandoned without close()
+        must be collectable — the loop closure holding a strong ``self``
+        would pin the instance (tables, store) and tick forever. The
+        wrapper returns False once the instance is gone, which stops the
+        scheduler's loop thread."""
+        import weakref
+
+        ref = weakref.WeakMethod(self._periodic_scan)
+
+        def scan():
+            fn = ref()
+            if fn is None:
+                return False
+            fn()
+            return True
+
+        return scan
 
     def _sweep_orphan_ssts(self, table: TableData) -> None:
         """Delete SST objects not tracked by the manifest.
@@ -295,13 +328,9 @@ class Instance:
         L0 runs. The merge itself runs on the background scheduler so the
         flushing writer returns immediately (ref: compaction/scheduler.rs
         — flush requests, the scheduler's worker runs)."""
-        seg_ms = table.options.segment_duration_ms
-        if not seg_ms:
-            return
-        from .compaction import bucket_by_window
+        from .compaction import Compactor
 
-        windows = bucket_by_window(table.version.levels.files_at(0), seg_ms)
-        if windows and max(len(v) for v in windows.values()) >= self.config.compaction_l0_trigger:
+        if Compactor.needs_work(table, self.config.compaction_l0_trigger):
             if self.config.background_compaction:
                 scheduler = self._compaction_scheduler()
                 if scheduler is not None:
@@ -319,7 +348,26 @@ class Instance:
                 from .compaction_scheduler import CompactionScheduler
 
                 self._compactions = CompactionScheduler(self.compact_table)
+                if self.config.compaction_interval_s > 0:
+                    self._compactions.start_periodic(
+                        self.config.compaction_interval_s,
+                        self._make_periodic_scan(),
+                    )
             return self._compactions
+
+    def _periodic_scan(self) -> None:
+        """One tick of the background picking loop: request compaction
+        for any open table with trigger-level L0 or TTL-expired files."""
+        from .compaction import Compactor
+
+        scheduler = self._compactions
+        if scheduler is None:
+            return
+        for table in self.open_tables():
+            if table.dropped or table.retired:
+                continue
+            if Compactor.needs_work(table, self.config.compaction_l0_trigger):
+                scheduler.request(table)
 
     def compact_table(self, table: TableData):
         from .compaction import Compactor

@@ -471,3 +471,122 @@ class TestDedupPruningRegression:
         assert res.tasks_run == 1
         out = inst.read(t)
         assert [(r["t"], r["value"]) for r in out.to_pylist()] == [(100, 2.0)]
+
+    def test_periodic_tick_expires_ttl_on_idle_table(self):
+        """The scheduler's own picking loop (ref: scheduler.rs background
+        loop) must expire TTL data and fold L0 on tables that stopped
+        receiving writes — flush-triggered requests alone never would."""
+        import time as _time
+
+        inst = Instance(MemoryStore(), EngineConfig(compaction_interval_s=0.05))
+        now = int(_time.time() * 1000)
+        t = inst.create_table(
+            0, 1, "demo", demo_schema(),
+            TableOptions.from_kv({"segment_duration": "1h", "ttl": "1h"}),
+        )
+        inst.write(t, RowGroup.from_rows(
+            t.schema, [{"name": "h", "value": 1.0, "t": now - 7_200_000}]
+        ))
+        inst.flush_table(t)
+        assert len(t.version.levels.files_at(0)) == 1
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and t.version.levels.files_at(0):
+            _time.sleep(0.02)
+        assert not t.version.levels.files_at(0)
+        inst.close()
+
+    def test_periodic_tick_disabled_by_config(self):
+        import time as _time
+
+        inst = Instance(MemoryStore(), EngineConfig(compaction_interval_s=0))
+        t = inst.create_table(
+            0, 1, "demo", demo_schema(),
+            TableOptions.from_kv({"segment_duration": "1h"}),
+        )
+        assert inst._compactions is None  # no eager scheduler, no thread
+        inst.close()
+
+    def test_size_tiered_trigger_agrees_with_picker(self):
+        """needs_work must not re-request a table whose picker emits no
+        task (size_tiered files that never group) — that loop would run
+        a futile serial_lock-holding pass every tick forever."""
+        from horaedb_tpu.engine.compaction import Compactor
+        from horaedb_tpu.engine.sst.manager import FileHandle
+        from horaedb_tpu.engine.sst.meta import SstMeta
+
+        inst, t = env(compaction_strategy="size_tiered")
+        # wildly different sizes in one window: picker groups nothing
+        for i, size in enumerate([1_000, 50_000, 2_000_000, 80_000_000]):
+            meta = SstMeta(
+                file_id=100 + i, time_range=TimeRange(0, 1000),
+                max_sequence=i + 1, num_rows=10, size_bytes=size,
+                schema_version=1, column_ranges={},
+            )
+            t.version.levels.add_file(0, FileHandle(meta, f"x/{i}.sst", 0))
+        assert not Compactor.needs_work(t, l0_trigger=2)
+        inst.close()
+
+    def test_scheduler_failure_backoff(self):
+        from horaedb_tpu.engine.compaction_scheduler import CompactionScheduler
+
+        calls = []
+
+        def boom(table):
+            calls.append(1)
+            raise RuntimeError("x")
+
+        class T:
+            space_id, table_id, name = 0, 1, "t"
+
+        s = CompactionScheduler(boom)
+        assert s.request(T()) is True
+        import time as _time
+
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and not calls:
+            _time.sleep(0.01)
+        _time.sleep(0.05)  # let the failure register
+        assert s.request(T()) is False  # suppressed by backoff
+        assert len(calls) == 1
+        s.close()
+
+    def test_abandoned_instance_periodic_thread_exits(self):
+        """An Instance dropped without close() must be collectable; its
+        tick thread sees the dead weakref and exits."""
+        import gc
+        import threading
+        import time as _time
+
+        def make():
+            inst = Instance(
+                MemoryStore(),
+                EngineConfig(compaction_l0_trigger=1, compaction_interval_s=0.05),
+            )
+            t = inst.create_table(
+                0, 1, "demo", demo_schema(),
+                TableOptions.from_kv({"segment_duration": "1h"}),
+            )
+            inst.write(t, RowGroup.from_rows(
+                t.schema, [{"name": "h", "value": 1.0, "t": 100}]
+            ))
+            inst.flush_table(t)
+
+        before = {
+            th.ident for th in threading.enumerate()
+            if th.name == "compaction-tick"
+        }
+        make()
+        gc.collect()
+        # Only THIS test's thread (0.05s tick) is expected to exit within
+        # the deadline — other tests' abandoned 60s-interval threads only
+        # notice the dead weakref on their next tick.
+        def mine():
+            return [
+                th for th in threading.enumerate()
+                if th.name == "compaction-tick" and th.ident not in before
+            ]
+
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline and mine():
+            _time.sleep(0.05)
+        assert not mine()
