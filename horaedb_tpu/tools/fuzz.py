@@ -249,6 +249,14 @@ class Fuzzer:
         self._guard("influx", run)
 
     # ---- reopen cycle ----------------------------------------------------
+    def _engine_config(self):
+        """Fast periodic compaction ticks so the scheduler's background
+        picking loop is part of the fuzzed interleavings (at the default
+        60s it would never fire within a fuzz run)."""
+        from horaedb_tpu.engine.instance import EngineConfig
+
+        return EngineConfig(compaction_interval_s=0.2)
+
     def _op_reopen(self) -> None:
         """Drain in-flight ops, close, recover, reopen (restart-under-
         load drill: WAL replay + manifest load while writers keep
@@ -262,7 +270,8 @@ class Fuzzer:
                 except Exception:
                     pass
                 self.conn = horaedb_tpu.connect(
-                    self.data_dir, wal_backend=self.wal_backend
+                    self.data_dir, wal_backend=self.wal_backend,
+                    engine_config=self._engine_config(),
                 )
                 self._record("reopen")
 
@@ -296,7 +305,10 @@ class Fuzzer:
         faulthandler.dump_traceback_later(
             self.duration_s * 3 + 60, exit=True
         )
-        self.conn = horaedb_tpu.connect(self.data_dir, wal_backend=self.wal_backend)
+        self.conn = horaedb_tpu.connect(
+            self.data_dir, wal_backend=self.wal_backend,
+            engine_config=self._engine_config(),
+        )
         self._ensure_tables()
         threads = [
             threading.Thread(target=self._worker, args=(i,), daemon=True)
