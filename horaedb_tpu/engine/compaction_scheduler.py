@@ -156,6 +156,36 @@ class CompactionScheduler:
                 self._running -= 1
                 self._update_depth_locked()
 
+    @classmethod
+    def idle_stats(cls, closed: bool = False) -> dict:
+        """The no-scheduler-yet shape — ONE place defines the key schema
+        for both the live and idle answers of /debug/compaction."""
+        return {
+            "pending": [], "running": 0, "closed": closed,
+            "periodic": False, "backoff": {},
+        }
+
+    def stats(self) -> dict:
+        """Introspection for /debug/compaction and horaectl: what's
+        queued, what's running, which tables are in failure backoff."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "pending": sorted(f"{s}/{t}" for s, t in self._pending),
+                "running": self._running,
+                "closed": self._closed,
+                # liveness, not object presence: a closed or weakref-dead
+                # loop must not report as running
+                "periodic": self._periodic is not None and self._periodic.is_alive(),
+                "backoff": {
+                    f"{s}/{t}": {
+                        "failures": fails,
+                        "retry_in_s": round(max(0.0, retry_at - now), 1),
+                    }
+                    for (s, t), (fails, retry_at) in self._backoff.items()
+                },
+            }
+
     def close(self, wait: bool = True) -> None:
         """Stop accepting requests and shut the worker down. ``wait``
         drains everything queued; without it, queued-but-unstarted merges

@@ -374,6 +374,16 @@ class Instance:
 
         return Compactor(table).compact()
 
+    def compaction_stats(self) -> dict:
+        """Scheduler introspection (no scheduler yet -> an idle shape)."""
+        from .compaction_scheduler import CompactionScheduler
+
+        with self._lock:
+            scheduler = self._compactions
+        if scheduler is None:
+            return CompactionScheduler.idle_stats(closed=self._closed)
+        return scheduler.stats()
+
     def close(self, wait: bool = True) -> None:
         """Stop background machinery; with ``wait`` drain queued
         compactions first (a merge is never abandoned silently).

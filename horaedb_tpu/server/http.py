@@ -783,6 +783,12 @@ def create_app(
         out = await asyncio.get_running_loop().run_in_executor(None, wal.stats)
         return web.json_response(out)
 
+    async def debug_compaction(request: web.Request) -> web.Response:
+        """Background compaction scheduler state: queue, in-flight count,
+        per-table failure backoff (ref model: the reference scheduler's
+        ScheduleRoom/token visibility through its admin surface)."""
+        return web.json_response(conn.instance.compaction_stats())
+
     async def debug_slow_log(request: web.Request) -> web.Response:
         """Recent slow queries (ref: the reference's slow-query log file)."""
         return web.Response(
@@ -1011,6 +1017,7 @@ def create_app(
     app.router.add_get("/debug/slow_log", debug_slow_log)
     app.router.add_get("/debug/shards", debug_shards)
     app.router.add_get("/debug/wal_stats", debug_wal_stats)
+    app.router.add_get("/debug/compaction", debug_compaction)
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)

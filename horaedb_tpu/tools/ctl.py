@@ -141,6 +141,10 @@ def cmd_slow_log(ep: str, args) -> None:
     print(_get(ep, "/debug/slow_log"))
 
 
+def cmd_compaction(ep: str, args) -> None:
+    print(_get(ep, "/debug/compaction"))
+
+
 def cmd_flush(ep: str, args) -> None:
     path = "/admin/flush" + (f"?table={args.table}" if args.table else "")
     print(_post(ep, path, {}))
@@ -206,6 +210,7 @@ def main(argv=None) -> int:
     sub.add_parser("shards")
     sub.add_parser("wal_stats")
     sub.add_parser("slow_log")
+    sub.add_parser("compaction")
     fl = sub.add_parser("flush")
     fl.add_argument("table", nargs="?", default=None)
     meta_default = os.environ.get("HORAEDB_META", "127.0.0.1:2379")

@@ -395,3 +395,36 @@ class TestEngineMetrics:
         assert ok.value == before[0] + 1
         assert fail.value == before[1] + 1
         assert retries.value == before[2] + 2
+
+
+class TestCompactionDebugSurface:
+    def test_debug_compaction_endpoint(self):
+        async def run():
+            conn = horaedb_tpu.connect(None)
+            client = TestClient(TestServer(create_app(conn)))
+            await client.start_server()
+            r = await client.get("/debug/compaction")
+            idle = await r.json()
+            assert idle == {
+                "pending": [], "running": 0, "closed": False,
+                "periodic": False, "backoff": {},
+            }
+            # trigger background compaction, then the scheduler is live
+            await client.post("/sql", json={"query": (
+                "CREATE TABLE dc (host string TAG, v double, ts timestamp "
+                "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+                "WITH (segment_duration='1h')")})
+            for i in range(conn.instance.config.compaction_l0_trigger):
+                await client.post("/sql", json={"query":
+                    f"INSERT INTO dc (host, v, ts) VALUES ('h', {float(i)}, {100+i})"})
+                await client.post("/admin/flush", json={"table": "dc"})
+            # The trigger-level flush created the scheduler synchronously,
+            # periodic loop included.
+            r2 = await client.get("/debug/compaction")
+            live = await r2.json()
+            assert live["periodic"] and not live["closed"]
+            await client.close()
+            conn.close()
+            assert conn.instance.compaction_stats()["closed"] is True
+
+        asyncio.run(run())
