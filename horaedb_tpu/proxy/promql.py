@@ -11,9 +11,14 @@ Supported grammar:
     mulexpr  := unary (('*' | '/' | '%') unary)*
     unary    := number | '(' expr ')' | vector
     vector   := agg [mod] '(' [param ','] expr ')' [mod]
-              | func '(' [phi ','] selector ')'
+              | func '(' [phi ','] (selector | subquery) ')'
               | vfunc '(' ... )'            -- per-function signature
               | selector
+              | subquery
+    subquery := expr '[' duration ':' [duration] ']'
+                ( 'offset' duration | '@' unix )*
+                -- inner expr instant-evaluates at step-aligned times
+                -- within (t-range, t]; must feed a range function
     mod      := ('by' | 'without') '(' labels ')'
     agg      := sum | avg | min | max | count | stddev | stdvar
               | topk | bottomk | quantile   -- the last three take a param
@@ -99,6 +104,21 @@ class PromScalar:
 
 
 @dataclass
+class PromSubquery:
+    """``expr[range:step]`` — the inner expression instant-evaluates at
+    step-aligned times within (t-range, t]; the samples feed the
+    enclosing range function (max_over_time(rate(x[1m])[5m:1m]))."""
+
+    expr: "PromExpr"
+    range_ms: int
+    step_ms: Optional[int] = None  # None -> DEFAULT_SUBQUERY_STEP_MS
+    func: Optional[str] = None  # the enclosing RANGE_FUNC
+    param: Optional[float] = None
+    offset_ms: int = 0
+    at_ms: Optional[int] = None
+
+
+@dataclass
 class PromBin:
     """Arithmetic over sub-expressions: vector/scalar applies per sample,
     vector/vector matches one-to-one on identical label sets."""
@@ -132,7 +152,7 @@ class PromCall:
     params: tuple = ()  # scalars/strings, meaning depends on name
 
 
-PromExpr = PromQuery | PromScalar | PromBin | PromAgg | PromCall
+PromExpr = PromQuery | PromScalar | PromBin | PromAgg | PromCall | PromSubquery
 
 
 _NAME = r"[a-zA-Z_:][a-zA-Z0-9_:.]*"
@@ -219,8 +239,64 @@ class _Parser:
             self.next()
             node = self.addexpr()
             self.expect(")")
-            return node
-        return self.expr()
+            return self._maybe_subquery(node)
+        return self._maybe_subquery(self.expr())
+
+    def _maybe_subquery(self, node: PromExpr) -> PromExpr:
+        """Trailing ``[range:step]`` turns any expression into a
+        subquery (a bare metric's subquery is handled inside selector(),
+        which owns its '[' — this covers functions and parens)."""
+        while self.peek() == ("op", "[") and not (
+            # a RAW range selector (cpu[5m]) takes no second range; a
+            # range FUNCTION result (rate(cpu[5m])) does — that's the
+            # subquery form
+            isinstance(node, PromQuery)
+            and node.range_ms is not None
+            and node.func is None
+        ):
+            self.next()
+            kind, dur = self.next()
+            if kind != "dur":
+                raise PromQLError(f"expected a duration, found {dur!r}")
+            rng = parse_duration_ms(dur)
+            step = self._subquery_step()
+            self.expect("]")
+            node = PromSubquery(node, rng, step)
+            self._selector_modifiers(node)
+        return node
+
+    def _subquery_step(self) -> Optional[int]:
+        """The ':step' tail of a subquery range. The tokenizer fuses
+        ':1m' into one name token (prom metric names may contain colons);
+        a spaced ': 1m' arrives as ':' then a duration."""
+        k, t = self.peek()
+        if k != "name" or not t.startswith(":"):
+            raise PromQLError("expected ':' in subquery range [range:step]")
+        self.next()
+        if len(t) > 1:
+            return parse_duration_ms(t[1:])
+        if self.peek()[0] == "dur":
+            return parse_duration_ms(self.next()[1])
+        return None
+
+    def _selector_modifiers(self, node) -> None:
+        """offset/@ suffixes, shared by selectors and subqueries."""
+        while True:
+            if self.peek() == ("name", "offset"):
+                self.next()
+                kind, dur = self.next()
+                if kind != "dur":
+                    raise PromQLError(f"offset expects a duration, found {dur!r}")
+                node.offset_ms = parse_duration_ms(dur)
+                continue
+            if self.peek() == ("op", "@"):
+                self.next()
+                kind, num = self.next()
+                if kind != "number":
+                    raise PromQLError(f"@ expects a unix timestamp, found {num!r}")
+                node.at_ms = int(float(num) * 1000)
+                continue
+            break
 
     def _label_list(self) -> list[str]:
         self.expect("(")
@@ -291,8 +367,20 @@ class _Parser:
             if tok == "quantile_over_time":
                 param = self._number()
                 self.expect(",")
-            inner = self.selector()
+            inner = self.unary()
             self.expect(")")
+            if not isinstance(inner, (PromQuery, PromSubquery)):
+                raise PromQLError(
+                    f"{tok}() expects a range selector or subquery argument"
+                )
+            if inner.func is not None:
+                # rate(cpu[1m]) is already consumed by rate — silently
+                # overwriting would drop the inner fold. The composable
+                # form is a subquery: max_over_time(rate(cpu[1m])[5m:1m]).
+                raise PromQLError(
+                    f"{tok}() over {inner.func}(...) needs a subquery "
+                    f"range, e.g. {tok}({inner.func}(...)[5m:1m])"
+                )
             needs_range = tok in ("rate", "increase") or tok in (
                 "quantile_over_time", "stddev_over_time", "last_over_time",
                 "sum_over_time", "count_over_time",
@@ -388,30 +476,25 @@ class _Parser:
                     break
                 if tok != ",":
                     raise PromQLError(f"expected ',' or '}}', found {tok!r}")
+        sub = None
         if self.peek()[1] == "[":
             self.next()
             kind, dur = self.next()
             if kind != "dur":
                 raise PromQLError(f"expected a duration like 5m, found {dur!r}")
-            pq.range_ms = parse_duration_ms(dur)
-            self.expect("]")
-        while True:
-            if self.peek() == ("name", "offset"):
-                self.next()
-                kind, dur = self.next()
-                if kind != "dur":
-                    raise PromQLError(f"offset expects a duration, found {dur!r}")
-                pq.offset_ms = parse_duration_ms(dur)
-                continue
-            if self.peek() == ("op", "@"):
-                self.next()
-                kind, num = self.next()
-                if kind != "number":
-                    raise PromQLError(f"@ expects a unix timestamp, found {num!r}")
-                pq.at_ms = int(float(num) * 1000)
-                continue
-            break
-        return pq
+            rng = parse_duration_ms(dur)
+            k2, t2 = self.peek()
+            if k2 == "name" and t2.startswith(":"):
+                # bare-metric subquery: cpu_usage[5m:1m]
+                step = self._subquery_step()
+                self.expect("]")
+                sub = PromSubquery(pq, rng, step)
+            else:
+                pq.range_ms = rng
+                self.expect("]")
+        node = sub if sub is not None else pq
+        self._selector_modifiers(node)
+        return node
 
 
 def parse_promql(query: str) -> PromExpr:
@@ -715,6 +798,83 @@ def _fold_window(func: str, param, tv: list) -> float:
     raise PromQLError(f"unknown window function {func!r}")
 
 
+DEFAULT_SUBQUERY_STEP_MS = 60_000  # prom's default evaluation interval
+
+
+def _subquery_points(
+    conn, node: "PromSubquery", time_ms: int, instant_cache: Optional[dict] = None
+) -> dict:
+    """-> {label_key: [(t, value), ...]} — the inner expression
+    instant-evaluated at step-aligned times within (t-range, t].
+
+    ``instant_cache`` memoizes per aligned instant across calls: a range
+    evaluation's consecutive windows share all but one instant, and
+    re-running the inner expression (>= one SQL scan each) per overlap
+    would multiply the work ~range/step times."""
+    t_eval = (node.at_ms if node.at_ms is not None else time_ms) - node.offset_ms
+    step = node.step_ms or DEFAULT_SUBQUERY_STEP_MS
+    start = t_eval - node.range_ms
+    t = (start // step + 1) * step  # first aligned instant AFTER start
+    out: dict = {}
+    while t <= t_eval:
+        vec = instant_cache.get(t) if instant_cache is not None else None
+        if vec is None:
+            vec = {}
+            for s in evaluate_expr_instant(conn, node.expr, t):
+                key = tuple(
+                    sorted((k, v) for k, v in s["metric"].items() if k != "__name__")
+                )
+                vec[key] = float(s["value"][1])
+            if instant_cache is not None:
+                instant_cache[t] = vec
+        for key, v in vec.items():
+            out.setdefault(key, []).append((t, v))
+        t += step
+    return out
+
+
+def _fold_subquery(func: str, param, tv: list) -> Optional[float]:
+    """Fold one series' subquery samples; None -> no output sample.
+    rate/increase over subquery output get counter semantics over the
+    sampled points (resets folded like prom's extrapolation-free core);
+    *_over_time delegates to the shared window fold."""
+    if not tv:
+        return None
+    if func in ("rate", "increase"):
+        if len(tv) < 2:
+            return None
+        tv = sorted(tv)
+        t0, v0 = tv[0]
+        t1, _ = tv[-1]
+        if t1 == t0:
+            return None
+        inc = 0.0
+        prev = v0
+        for _, v in tv[1:]:
+            inc += (v - prev) if v >= prev else v  # counter reset
+            prev = v
+        if func == "increase":
+            return inc
+        return inc / ((t1 - t0) / 1000.0)
+    return _fold_window(func, param, tv)
+
+
+def _subquery_vector(
+    conn, node: "PromSubquery", time_ms: int, instant_cache: Optional[dict] = None
+) -> dict:
+    if node.func is None:
+        raise PromQLError(
+            "a subquery result must be consumed by a range function "
+            "(e.g. max_over_time(expr[5m:1m]))"
+        )
+    out = {}
+    for key, tv in _subquery_points(conn, node, time_ms, instant_cache).items():
+        v = _fold_subquery(node.func, node.param, tv)
+        if v is not None:
+            out[key] = v
+    return out
+
+
 def _quantile(phi: float, vals: list) -> float:
     """Prom's φ-quantile: linear interpolation between closest ranks;
     φ outside [0,1] yields ∓/±Inf like prom."""
@@ -766,6 +926,16 @@ def _eval_series(conn, node: PromExpr, start_ms: int, end_ms: int, step_ms: int)
     """-> ('scalar', float) or ('vector', {key: {bucket: value}})."""
     if isinstance(node, PromScalar):
         return "scalar", node.value
+    if isinstance(node, PromSubquery):
+        first = (start_ms // step_ms) * step_ms
+        if first < start_ms:
+            first += step_ms
+        vec: dict = {}
+        instant_cache: dict = {}  # consecutive windows share instants
+        for b in range(first, end_ms + 1, step_ms):
+            for key, v in _subquery_vector(conn, node, b, instant_cache).items():
+                vec.setdefault(key, {})[b] = v
+        return "vector", vec
     if isinstance(node, PromQuery):
         return "vector", _range_series(conn, node, start_ms, end_ms, step_ms)
     if isinstance(node, PromAgg):
@@ -816,6 +986,8 @@ def leaf_metrics(node: PromExpr) -> list[str]:
         return leaf_metrics(node.lhs) + leaf_metrics(node.rhs)
     if isinstance(node, (PromAgg, PromCall)):
         return leaf_metrics(node.arg)
+    if isinstance(node, PromSubquery):
+        return leaf_metrics(node.expr)
     return []
 
 
@@ -1066,6 +1238,8 @@ def _instant_value(conn, node: PromExpr, time_ms: int):
     rule that arithmetic ignores the metric name."""
     if isinstance(node, PromScalar):
         return "scalar", node.value
+    if isinstance(node, PromSubquery):
+        return "vector", _subquery_vector(conn, node, time_ms)
     if isinstance(node, PromQuery):
         vec = {}
         for s in evaluate_instant(conn, node, time_ms):

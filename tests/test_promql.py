@@ -626,3 +626,100 @@ class TestPromReviewRegressions:
     def test_bad_group_ref_is_parse_error(self):
         with pytest.raises(PromQLError, match="group"):
             parse_promql('label_replace(cpu, "d", "$2", "host", "(h.*)")')
+
+
+class TestSubqueries:
+    """expr[range:step] (ref: the Prometheus subquery surface the
+    reference serves through its IOx-forked planner)."""
+
+    def _db(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE cpu_usage (host string TAG, value double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        rows = ", ".join(f"('h{i%2}', {float(i)}, {i*15000})" for i in range(80))
+        db.execute(f"INSERT INTO cpu_usage (host, value, ts) VALUES {rows}")
+        return db
+
+    def test_over_time_of_rate_subquery(self):
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant, parse_promql
+
+        db = self._db()
+        out = evaluate_expr_instant(
+            db, parse_promql("max_over_time(rate(cpu_usage[1m])[5m:1m])"), 1_000_000
+        )
+        assert {s["metric"]["host"] for s in out} == {"h0", "h1"}
+        mx = float(out[0]["value"][1])
+        mn = float(evaluate_expr_instant(
+            db, parse_promql("min_over_time(rate(cpu_usage[1m])[5m:1m])"), 1_000_000
+        )[0]["value"][1])
+        assert 0 < mn <= mx
+
+    def test_subquery_over_expression_and_spaced_step(self):
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant, parse_promql
+
+        db = self._db()
+        doubled = evaluate_expr_instant(
+            db, parse_promql("max_over_time((cpu_usage * 2)[5m:1m])"), 1_000_000
+        )
+        plain = evaluate_expr_instant(
+            db, parse_promql("max_over_time(cpu_usage[5m: 1m])"), 1_000_000
+        )
+        by_host = {s["metric"]["host"]: float(s["value"][1]) for s in plain}
+        for s in doubled:
+            assert float(s["value"][1]) == 2 * by_host[s["metric"]["host"]]
+
+    def test_subquery_inside_aggregation_and_range_eval(self):
+        from horaedb_tpu.proxy.promql import (
+            evaluate_expr_instant, evaluate_expr_range, parse_promql,
+        )
+
+        db = self._db()
+        out = evaluate_expr_instant(
+            db, parse_promql("sum(max_over_time(rate(cpu_usage[1m])[5m:1m])) by (host)"),
+            1_000_000,
+        )
+        assert len(out) == 2
+        m = evaluate_expr_range(
+            db, parse_promql("max_over_time(rate(cpu_usage[1m])[5m:1m])"),
+            600_000, 900_000, 150_000,
+        )
+        assert all(len(s["values"]) == 3 for s in m)
+
+    def test_rate_over_subquery_counter_semantics(self):
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant, parse_promql
+
+        db = self._db()
+        out = evaluate_expr_instant(
+            db, parse_promql("rate(cpu_usage[10m:1m])"), 1_000_000
+        )
+        # per-host counter rises 2 per 30s -> ~0.0667/s over sampled points
+        for s in out:
+            assert abs(float(s["value"][1]) - 2 / 30) < 0.01
+
+    def test_bare_subquery_rejected(self):
+        import pytest
+
+        from horaedb_tpu.proxy.promql import (
+            PromQLError, evaluate_expr_instant, parse_promql,
+        )
+
+        db = self._db()
+        with pytest.raises(PromQLError, match="range function"):
+            evaluate_expr_instant(db, parse_promql("cpu_usage[5m:]"), 1_000_000)
+
+    def test_nested_range_func_without_subquery_rejected(self):
+        import pytest
+
+        from horaedb_tpu.proxy.promql import PromQLError, parse_promql
+
+        for bad in (
+            "max_over_time(rate(cpu[1m]))",
+            "increase(rate(cpu[5m]))",
+            "max_over_time(quantile_over_time(0.5, cpu[5m]))",
+        ):
+            with pytest.raises(PromQLError, match="subquery range"):
+                parse_promql(bad)
