@@ -22,7 +22,7 @@ Supported grammar:
     mod      := ('by' | 'without') '(' labels ')'
     agg      := sum | avg | min | max | count | stddev | stdvar
               | topk | bottomk | quantile   -- the last three take a param
-    func     := rate | increase
+    func     := rate | increase | delta
               | avg_over_time | min_over_time | max_over_time
               | sum_over_time | count_over_time
               | quantile_over_time | stddev_over_time | last_over_time
@@ -68,7 +68,7 @@ from ..engine.options import parse_duration_ms
 AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev", "stdvar"}
 PARAM_AGGS = {"topk", "bottomk", "quantile"}  # aggregators with a scalar param
 RANGE_FUNCS = {
-    "rate", "increase",
+    "rate", "increase", "delta",
     "avg_over_time", "min_over_time", "max_over_time",
     "sum_over_time", "count_over_time",  # push into SQL sum()/count()
     "quantile_over_time", "stddev_over_time", "last_over_time",  # raw fold
@@ -381,7 +381,7 @@ class _Parser:
                     f"{tok}() over {inner.func}(...) needs a subquery "
                     f"range, e.g. {tok}({inner.func}(...)[5m:1m])"
                 )
-            needs_range = tok in ("rate", "increase") or tok in (
+            needs_range = tok in ("rate", "increase", "delta") or tok in (
                 "quantile_over_time", "stddev_over_time", "last_over_time",
                 "sum_over_time", "count_over_time",
             )
@@ -616,8 +616,10 @@ def _range_series(
         per_series = _counter_series(
             conn, pq, where, schema, value_col, group_labels, step_ms, func
         )
-    elif func in ("quantile_over_time", "stddev_over_time", "last_over_time"):
-        # Order statistics / exact last need the raw samples per bucket.
+    elif func in ("quantile_over_time", "stddev_over_time", "last_over_time",
+                  "delta"):
+        # Order statistics / exact last / gauge deltas need the raw
+        # samples per bucket.
         per_series = _raw_window_series(
             conn, pq, where, schema, value_col, group_labels, step_ms, func,
             pq.param,
@@ -750,7 +752,12 @@ def _raw_window_series(
         buckets: dict[int, list] = {}
         for ts, v in tv_list:
             buckets.setdefault((ts // step_ms) * step_ms, []).append((ts, v))
-        out[key] = {b: _fold_window(func, param, tv) for b, tv in buckets.items()}
+        folded = {
+            b: v
+            for b, tv in buckets.items()
+            if (v := _fold_window(func, param, tv)) is not None
+        }
+        out[key] = folded
     return out
 
 
@@ -778,6 +785,15 @@ def _fold_window(func: str, param, tv: list) -> float:
     import math
 
     vals = [v for _, v in tv]
+    if func == "delta":
+        # gauge delta: newest minus oldest sample in the window (no
+        # counter-reset folding — deltas of gauges go down legitimately).
+        # <2 samples -> None: NO sample, like prom (a NaN would poison
+        # downstream min/max folds).
+        if len(tv) < 2:
+            return None
+        s = sorted(tv)
+        return s[-1][1] - s[0][1]
     if func == "last_over_time":
         return max(tv)[1]
     if func == "stddev_over_time":
@@ -837,10 +853,11 @@ def _fold_subquery(func: str, param, tv: list) -> Optional[float]:
     """Fold one series' subquery samples; None -> no output sample.
     rate/increase over subquery output get counter semantics over the
     sampled points (resets folded like prom's extrapolation-free core);
-    *_over_time delegates to the shared window fold."""
+    delta gets gauge semantics; *_over_time delegates to the shared
+    window fold."""
     if not tv:
         return None
-    if func in ("rate", "increase"):
+    if func in ("rate", "increase", "delta"):
         if len(tv) < 2:
             return None
         tv = sorted(tv)
@@ -848,6 +865,8 @@ def _fold_subquery(func: str, param, tv: list) -> Optional[float]:
         t1, _ = tv[-1]
         if t1 == t0:
             return None
+        if func == "delta":
+            return tv[-1][1] - v0  # gauge semantics, no reset folding
         inc = 0.0
         prev = v0
         for _, v in tv[1:]:
@@ -1295,6 +1314,10 @@ DEFAULT_LOOKBACK_MS = 5 * 60_000  # prom's 5m instant lookback
 _OVER_TIME_FUNCS = frozenset(
     f for f in RANGE_FUNCS if f.endswith("_over_time")
 )
+# Functions that must fold the EXACT [t-range, t] window at instant
+# evaluation (epoch-aligned buckets cover only a fraction of the window
+# whenever t isn't step-aligned): the *_over_time family plus delta.
+_EXACT_WINDOW_FUNCS = _OVER_TIME_FUNCS | {"delta"}
 
 
 def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
@@ -1304,7 +1327,7 @@ def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
     window [t-range, t] (not an epoch-aligned bucket containing t — an
     aligned bucket would cover a fraction of the window whenever t isn't
     step-aligned)."""
-    if pq.func in _OVER_TIME_FUNCS:
+    if pq.func in _EXACT_WINDOW_FUNCS:
         return _instant_over_time(conn, pq, time_ms)
     window = pq.range_ms or DEFAULT_LOOKBACK_MS
     # rate/increase aggregate over their whole window; only a raw selector
@@ -1348,6 +1371,8 @@ def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
         if regex_matchers and not _regex_match(dict(key), regex_matchers):
             continue
         v = _fold_window(pq.func, pq.param, tv)
+        if v is None:
+            continue  # e.g. delta over a single sample: no output point
         out.append(
             {
                 "metric": {"__name__": pq.metric, **{l: x for l, x in key}},

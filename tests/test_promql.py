@@ -723,3 +723,51 @@ class TestSubqueries:
         ):
             with pytest.raises(PromQLError, match="subquery range"):
                 parse_promql(bad)
+
+    def test_delta_gauge_semantics(self):
+        import horaedb_tpu
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant, parse_promql
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE g (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO g (host, value, ts) VALUES "
+            "('a',10.0,0),('a',4.0,30000),('a',7.0,60000)"
+        )
+        out = evaluate_expr_instant(db, parse_promql("delta(g[2m])"), 90_000)
+        # gauge: newest - oldest, NO counter-reset folding (10 -> 7 = -3)
+        assert float(out[0]["value"][1]) == -3.0
+        out2 = evaluate_expr_instant(
+            db, parse_promql("max_over_time(delta(g[2m])[5m:1m])"), 300_000
+        )
+        assert float(out2[0]["value"][1]) == -3.0
+
+    def test_delta_exact_window_and_sparse_samples(self):
+        import horaedb_tpu
+        from horaedb_tpu.proxy.promql import (
+            evaluate_expr_instant, evaluate_expr_range, parse_promql,
+        )
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE gx (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO gx (host, value, ts) VALUES ('a',5.0,100000),('a',8.0,130000)"
+        )
+        # eval time NOT step-aligned: exact [t-2m, t] window, not epoch buckets
+        out = evaluate_expr_instant(db, parse_promql("delta(gx[2m])"), 150_000)
+        assert float(out[0]["value"][1]) == 3.0
+        # single-sample window: no output point (never NaN)
+        db.execute(
+            "CREATE TABLE gy (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO gy (host, value, ts) VALUES ('a',5.0,100000)")
+        assert evaluate_expr_instant(db, parse_promql("delta(gy[2m])"), 150_000) == []
+        m = evaluate_expr_range(db, parse_promql("delta(gy[1m])"), 0, 200_000, 60_000)
+        assert all("nan" not in str(s["values"]) for s in m)
