@@ -22,7 +22,8 @@ Supported grammar:
     mod      := ('by' | 'without') '(' labels ')'
     agg      := sum | avg | min | max | count | stddev | stdvar
               | topk | bottomk | quantile   -- the last three take a param
-    func     := rate | increase | delta
+    func     := rate | increase | delta | irate | idelta
+              | changes | resets
               | avg_over_time | min_over_time | max_over_time
               | sum_over_time | count_over_time
               | quantile_over_time | stddev_over_time | last_over_time
@@ -67,12 +68,26 @@ from ..engine.options import parse_duration_ms
 
 AGG_FUNCS = {"sum", "avg", "min", "max", "count", "stddev", "stdvar"}
 PARAM_AGGS = {"topk", "bottomk", "quantile"}  # aggregators with a scalar param
-RANGE_FUNCS = {
-    "rate", "increase", "delta",
+# Range-function families — ONE place; the parser's range requirement,
+# the exact-window instant routing and the range dispatch all derive
+# from these (hand-maintained parallel lists drifted once already).
+_COUNTER_FUNCS = frozenset({"rate", "increase"})
+# raw per-window folds: order statistics, gauge deltas, instant
+# variants (last two samples), change/reset counts
+_RAW_FOLD_FUNCS = frozenset({
+    "quantile_over_time", "stddev_over_time", "last_over_time",
+    "delta", "irate", "idelta", "changes", "resets",
+})
+# folds that push into the SQL kernel per step bucket
+_SQL_FOLD_FUNCS = frozenset({
     "avg_over_time", "min_over_time", "max_over_time",
-    "sum_over_time", "count_over_time",  # push into SQL sum()/count()
-    "quantile_over_time", "stddev_over_time", "last_over_time",  # raw fold
-}
+    "sum_over_time", "count_over_time",
+})
+RANGE_FUNCS = _COUNTER_FUNCS | _RAW_FOLD_FUNCS | _SQL_FOLD_FUNCS
+# these three accept a missing [range] (they fold the default lookback)
+_OPTIONAL_RANGE_FUNCS = frozenset(
+    {"avg_over_time", "min_over_time", "max_over_time"}
+)
 # funcs over a full evaluated vector (ref surface: promql/udf.rs:50-97 +
 # the IOx function table the reference inherits)
 VECTOR_FUNCS = {
@@ -381,10 +396,7 @@ class _Parser:
                     f"{tok}() over {inner.func}(...) needs a subquery "
                     f"range, e.g. {tok}({inner.func}(...)[5m:1m])"
                 )
-            needs_range = tok in ("rate", "increase", "delta") or tok in (
-                "quantile_over_time", "stddev_over_time", "last_over_time",
-                "sum_over_time", "count_over_time",
-            )
+            needs_range = tok not in _OPTIONAL_RANGE_FUNCS
             if needs_range and inner.range_ms is None:
                 raise PromQLError(f"{tok}() requires a range selector like [5m]")
             inner.func = tok
@@ -609,20 +621,22 @@ def _range_series(
         sval = str(val).replace("'", "''")  # keep in sync w/ sql_str_literal
         where.append(f"{_q(label)} {'=' if op == '=' else '!='} '{sval}'")
 
-    if func in ("rate", "increase"):
+    if func in _COUNTER_FUNCS:
         # Counter semantics need consecutive samples (reset detection) —
         # scan raw rows and fold host-side (samples per window are small
         # next to the table; the fused path keeps serving the rest).
         per_series = _counter_series(
             conn, pq, where, schema, value_col, group_labels, step_ms, func
         )
-    elif func in ("quantile_over_time", "stddev_over_time", "last_over_time",
-                  "delta"):
-        # Order statistics / exact last / gauge deltas need the raw
-        # samples per bucket.
+    elif func in _RAW_FOLD_FUNCS:
+        # Raw folds evaluate per step over the SLIDING [b-range, b]
+        # window (prom semantics) — the scan must reach back one window
+        # before the first step.
+        window = pq.range_ms or DEFAULT_LOOKBACK_MS
+        raw_where = [f"{_q(schema.timestamp_name)} >= {start_ms - window}"] + where[1:]
         per_series = _raw_window_series(
-            conn, pq, where, schema, value_col, group_labels, step_ms, func,
-            pq.param,
+            conn, pq, raw_where, schema, value_col, group_labels,
+            start_ms, end_ms, step_ms, window, func, pq.param,
         )
     else:
         keys = [f"time_bucket({_q(schema.timestamp_name)}, '{step_ms}ms')"] + [
@@ -740,23 +754,37 @@ def _counter_series(
 
 def _raw_window_series(
     conn, pq: PromQuery, where: list, schema, value_col: str,
-    group_labels: list, step_ms: int, func: str, param,
+    group_labels: list, start_ms: int, end_ms: int, step_ms: int,
+    window_ms: int, func: str, param,
 ) -> dict:
-    """quantile_over_time / stddev_over_time / last_over_time: fold raw
-    samples per (series, step bucket). Like prom's: quantile uses linear
-    interpolation, stddev is the population deviation, last takes the
-    newest sample in the bucket."""
+    """Raw-fold functions (order statistics, gauge deltas, instant
+    variants, change counts): at every aligned step b the fold sees the
+    SLIDING window (b-window, b] — prom's semantics. Step-sized buckets
+    would show each step only its own slice (irate at a step finer than
+    the scrape interval would see < 2 samples and vanish)."""
     series = _series_scan(conn, pq, where, schema, value_col, group_labels)
+    first = (start_ms // step_ms) * step_ms
+    if first < start_ms:
+        first += step_ms
+    steps = list(range(first, end_ms + 1, step_ms))
     out: dict[tuple, dict[int, float]] = {}
     for key, tv_list in series.items():
-        buckets: dict[int, list] = {}
-        for ts, v in tv_list:
-            buckets.setdefault((ts // step_ms) * step_ms, []).append((ts, v))
-        folded = {
-            b: v
-            for b, tv in buckets.items()
-            if (v := _fold_window(func, param, tv)) is not None
-        }
+        tv_list.sort()
+        ts_arr = [t for t, _ in tv_list]
+        import bisect
+
+        folded: dict[int, float] = {}
+        for b in steps:
+            # INCLUSIVE left bound, matching the instant path's exact
+            # window ([t-range, t], _instant_over_time) — one convention
+            # everywhere beats silently differing instant/range answers.
+            lo = bisect.bisect_left(ts_arr, b - window_ms)
+            hi = bisect.bisect_right(ts_arr, b)
+            if lo >= hi:
+                continue
+            v = _fold_window(func, param, tv_list[lo:hi])
+            if v is not None:
+                folded[b] = v
         out[key] = folded
     return out
 
@@ -794,6 +822,40 @@ def _fold_window(func: str, param, tv: list) -> float:
             return None
         s = sorted(tv)
         return s[-1][1] - s[0][1]
+    if func in ("irate", "idelta"):
+        # instant variants: the LAST TWO samples only
+        if len(tv) < 2:
+            return None
+        s = sorted(tv)
+        (t0, v0), (t1, v1) = s[-2], s[-1]
+        if t1 == t0:
+            return None
+        d = v1 - v0
+        if func == "idelta":
+            return d
+        if d < 0:
+            d = v1  # counter reset between the two samples
+        return d / ((t1 - t0) / 1000.0)
+    if func == "changes":
+        # prom compares bit patterns: NaN -> NaN is NO change, NaN <-> x is
+        # one (Python NaN != NaN would count every NaN pair)
+        s = sorted(tv)
+        n = 0
+        for i in range(1, len(s)):
+            a, b = s[i - 1][1], s[i][1]
+            a_nan, b_nan = a != a, b != b
+            if (a_nan and b_nan) or (not a_nan and not b_nan and a == b):
+                continue
+            n += 1
+        return float(n)
+    if func == "resets":
+        s = sorted(tv)
+        return float(sum(
+            1
+            for i in range(1, len(s))
+            if s[i][1] == s[i][1] and s[i - 1][1] == s[i - 1][1]
+            and s[i][1] < s[i - 1][1]
+        ))
     if func == "last_over_time":
         return max(tv)[1]
     if func == "stddev_over_time":
@@ -1317,7 +1379,7 @@ _OVER_TIME_FUNCS = frozenset(
 # Functions that must fold the EXACT [t-range, t] window at instant
 # evaluation (epoch-aligned buckets cover only a fraction of the window
 # whenever t isn't step-aligned): the *_over_time family plus delta.
-_EXACT_WINDOW_FUNCS = _OVER_TIME_FUNCS | {"delta"}
+_EXACT_WINDOW_FUNCS = _OVER_TIME_FUNCS | _RAW_FOLD_FUNCS
 
 
 def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:

@@ -503,17 +503,24 @@ class TestBreadthFunctions:
         out = evaluate_expr_range(
             db, parse_promql('last_over_time(cpu{host="h1"}[2m])'), 0, 4 * MIN, 2 * MIN
         )
-        assert [v for _, v in out[0]["values"]] == ["11.0", "13.0"]
+        # sliding [b-2m, b] windows per step (the old step-bucket
+        # approximation stamped each bucket's last at its start)
+        assert [v for _, v in out[0]["values"]] == ["10.0", "12.0", "13.0"]
         out = evaluate_expr_range(
             db, parse_promql('quantile_over_time(0.5, cpu{host="h1"}[2m])'),
             0, 4 * MIN, 2 * MIN,
         )
-        assert [v for _, v in out[0]["values"]] == ["10.5", "12.5"]
+        # sliding windows: b=0 sees only ts=0 (10), b=2m the median of
+        # 10/11/12 at [0,2m], b=4m the median of 12/13 at [2m,4m]
+        assert [v for _, v in out[0]["values"]] == ["10.0", "11.0", "12.5"]
         out = evaluate_expr_range(
             db, parse_promql('stddev_over_time(cpu{host="h1"}[2m])'),
             0, 4 * MIN, 2 * MIN,
         )
-        assert [v for _, v in out[0]["values"]] == ["0.5", "0.5"]
+        # sliding windows: {10} -> 0, {10,11,12} -> 0.8165, {12,13} -> 0.5
+        got = [float(v) for _, v in out[0]["values"]]
+        import math
+        assert got[0] == 0.0 and abs(got[1] - math.sqrt(2 / 3)) < 1e-9 and got[2] == 0.5
 
     def test_label_replace(self, db):
         out = evaluate_expr_range(
@@ -771,3 +778,67 @@ class TestSubqueries:
         assert evaluate_expr_instant(db, parse_promql("delta(gy[2m])"), 150_000) == []
         m = evaluate_expr_range(db, parse_promql("delta(gy[1m])"), 0, 200_000, 60_000)
         assert all("nan" not in str(s["values"]) for s in m)
+
+    def test_irate_idelta_changes_resets(self):
+        import horaedb_tpu
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant, parse_promql
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE cw (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        # counter with one reset at 90s
+        db.execute(
+            "INSERT INTO cw (host, value, ts) VALUES ('a',1.0,0),"
+            "('a',5.0,30000),('a',9.0,60000),('a',2.0,90000),('a',6.0,120000)"
+        )
+
+        def v(q):
+            out = evaluate_expr_instant(db, parse_promql(q), 150_000)
+            return float(out[0]["value"][1]) if out else None
+
+        assert v("irate(cw[5m])") == (6 - 2) / 30  # last two samples
+        assert v("idelta(cw[5m])") == 4.0
+        assert v("changes(cw[5m])") == 4.0
+        assert v("resets(cw[5m])") == 1.0
+        # irate across a reset folds the reset (value restarts near 0)
+        out = evaluate_expr_instant(db, parse_promql("irate(cw[2m] offset 1m)"), 150_000)
+        assert float(out[0]["value"][1]) == 2.0 / 30  # 9 -> 2 reset: d = 2
+        # single sample -> no point
+        db.execute(
+            "CREATE TABLE cw1 (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO cw1 (host, value, ts) VALUES ('a',1.0,0)")
+        assert evaluate_expr_instant(db, parse_promql("irate(cw1[5m])"), 150_000) == []
+
+    def test_raw_fold_range_queries_use_sliding_windows(self):
+        import horaedb_tpu
+        from horaedb_tpu.proxy.promql import evaluate_expr_range, parse_promql
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE sw (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO sw (host, value, ts) VALUES ('a',1.0,0),"
+            "('a',5.0,30000),('a',9.0,60000),('a',2.0,90000),('a',6.0,120000)"
+        )
+        # step finer than the scrape interval: every step still sees the
+        # full [5m] lookback (step-sized buckets would hold < 2 samples)
+        m = evaluate_expr_range(
+            db, parse_promql("irate(sw[5m])"), 60_000, 150_000, 15_000
+        )
+        assert len(m[0]["values"]) == 7
+        # changes() accumulates over the window per step
+        m2 = evaluate_expr_range(
+            db, parse_promql("changes(sw[5m])"), 60_000, 180_000, 60_000
+        )
+        assert [float(v) for _, v in m2[0]["values"]] == [2.0, 4.0, 4.0]
+        # delta over sliding windows too
+        m3 = evaluate_expr_range(
+            db, parse_promql("delta(sw[2m])"), 120_000, 120_000, 60_000
+        )
+        assert [float(v) for _, v in m3[0]["values"]] == [5.0]  # 6 - 1
