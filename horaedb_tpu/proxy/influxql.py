@@ -60,7 +60,7 @@ class InfluxQLError(ValueError):
 
 SIMPLE_AGGS = {"count", "sum", "min", "max", "avg", "mean"}
 HOST_AGGS = {"first", "last", "median", "spread", "stddev", "distinct",
-             "percentile", "mode"}
+             "percentile", "mode", "top", "bottom"}
 TRANSFORMS = {"derivative", "non_negative_derivative", "difference",
               "moving_average"}
 
@@ -306,6 +306,15 @@ class _Parser:
                 n = float(_strip_unit(self.next())[0])
                 self.expect(")")
                 return ("agg2", "percentile", _ident(arg), n)
+            if low in ("top", "bottom"):
+                self.expect(",")
+                num, unit = _strip_unit(self.next())
+                if unit is not None or not isinstance(num, int) or num < 1:
+                    raise InfluxQLError(
+                        f"{low}() expects a positive integer N"
+                    )
+                self.expect(")")
+                return ("agg2", low, _ident(arg), num)
             self.expect(")")
             return ("agg", func, _ident(arg) if arg != "*" else None)
         return ("col", _ident(t))
@@ -695,6 +704,13 @@ def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
     swf = _selector_with_fields(sel)
     if swf is not None:
         return _evaluate_selector_row(conn, sel, schema, where, *swf)
+    tb = [it for it in sel.items if it[0] == "agg2" and it[1] in ("top", "bottom")]
+    if tb:
+        if len(sel.items) > 1:
+            raise InfluxQLError(
+                f"{tb[0][1]}() cannot combine with other projections"
+            )
+        return _evaluate_top_bottom(conn, sel, schema, where, *tb[0][1:])
     ts = schema.timestamp_name
     tags = _expand_tags(sel, schema)
 
@@ -783,6 +799,56 @@ def _evaluate_host(conn, sel: InfluxSelect, schema, where) -> list[dict]:
             "columns": ["time"] + (["distinct"] if flat[0][1] == "distinct"
                                    else labels),
             "values": out_rows,
+        }
+        if key:
+            s["tags"] = {t: v for t, v in key}
+        series.append(s)
+    return series
+
+
+def _evaluate_top_bottom(
+    conn, sel: InfluxSelect, schema, where, func: str, col: str, n: int
+) -> list[dict]:
+    """top/bottom(field, N): the N largest/smallest SAMPLES per
+    (tag-set, time bucket), each row stamped with its own sample time
+    (InfluxDB's shape-changing selectors — like distinct, only alone)."""
+    ts = schema.timestamp_name
+    tags = _expand_tags(sel, schema)
+    if not schema.has_column(col):
+        raise InfluxQLError(f"unknown column {col!r}")
+    if not schema.column(col).kind.is_numeric:
+        raise InfluxQLError(f"{func}({col}) requires a numeric field")
+    proj = [f"`{t}`" for t in tags] + [f"`{ts}`", f"`{col}`"]
+    sql = f"SELECT {', '.join(dict.fromkeys(proj))} FROM `{sel.measurement}`"
+    if where is not None:
+        sql += " WHERE " + _cond_sql(where, ts)
+    rows = conn.execute(sql).to_pylist()
+    if not rows:
+        return []
+    width = sel.group_time_ms
+    groups: dict[tuple, dict[int, list]] = {}
+    for r in rows:
+        v = r.get(col)
+        if v is None:
+            continue
+        key = tuple((t, r.get(t)) for t in tags)
+        bucket = (r[ts] // width) * width if width else 0
+        groups.setdefault(key, {}).setdefault(bucket, []).append((r[ts], v))
+    series = []
+    for key in sorted(groups, key=lambda k: tuple(str(v) for _, v in k)):
+        values: list[list] = []
+        for b in sorted(groups[key]):
+            tv = groups[key][b]
+            # largest (top) / smallest (bottom) by value; ties break to the
+            # EARLIER sample, like influx's stable scan order
+            pick = sorted(
+                tv, key=lambda p: (-p[1], p[0]) if func == "top" else (p[1], p[0])
+            )[:n]
+            values.extend([t, v] for t, v in sorted(pick))
+        s: dict[str, Any] = {
+            "name": sel.measurement,
+            "columns": ["time", func],
+            "values": values,
         }
         if key:
             s["tags"] = {t: v for t, v in key}
@@ -1226,8 +1292,12 @@ def _post_series(series: list[dict], sel: InfluxSelect, host: bool) -> list[dict
     # distinct() emits MULTIPLE rows per time bucket; bucket-keyed fill
     # would collapse them to one arbitrary value each. Influx applies
     # FILL to scalar aggregates only — skip it here.
+    # distinct() and top/bottom() emit MULTIPLE sample-timestamped rows
+    # per bucket; bucket-keyed fill would drop every off-lattice row.
     is_distinct = any(
-        it[0] == "agg" and it[1] == "distinct" for it in sel.items
+        (it[0] == "agg" and it[1] == "distinct")
+        or (it[0] == "agg2" and it[1] in ("top", "bottom"))
+        for it in sel.items
     )
     for s in series:
         vals = s["values"]

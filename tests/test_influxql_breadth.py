@@ -509,3 +509,72 @@ class TestSelectorWithFields:
         db = self._db()
         with pytest.raises(InfluxQLError, match="unknown column"):
             evaluate(db, 'SELECT max(usage), nosuch FROM "cpu"')
+
+
+class TestTopBottom:
+    """top/bottom(field, N): InfluxDB's shape-changing selectors — the N
+    largest/smallest samples per (tag-set, bucket), each stamped with its
+    own sample time."""
+
+    def _db(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE cpu (host string TAG, usage double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO cpu (host, usage, ts) VALUES ('a',1.0,1000),"
+            "('b',5.0,2000),('a',3.0,3000),('b',2.0,4000),('a',9.0,61000)"
+        )
+        return db
+
+    def test_top_bottom_basic(self):
+        from horaedb_tpu.proxy.influxql import evaluate
+
+        db = self._db()
+        s = evaluate(db, 'SELECT top(usage, 3) FROM "cpu"')["results"][0]["series"][0]
+        assert s["columns"] == ["time", "top"]
+        assert s["values"] == [[2000, 5.0], [3000, 3.0], [61000, 9.0]]
+        s = evaluate(db, 'SELECT bottom(usage, 2) FROM "cpu"')["results"][0]["series"][0]
+        assert s["values"] == [[1000, 1.0], [4000, 2.0]]
+
+    def test_top_grouped_and_bucketed(self):
+        from horaedb_tpu.proxy.influxql import evaluate
+
+        db = self._db()
+        out = evaluate(db, 'SELECT top(usage, 2) FROM "cpu" GROUP BY host')
+        by_tag = {s["tags"]["host"]: s["values"] for s in out["results"][0]["series"]}
+        assert by_tag["a"] == [[3000, 3.0], [61000, 9.0]]
+        assert by_tag["b"] == [[2000, 5.0], [4000, 2.0]]
+        s = evaluate(db, 'SELECT top(usage, 1) FROM "cpu" GROUP BY time(1m)')
+        assert s["results"][0]["series"][0]["values"] == [[2000, 5.0], [61000, 9.0]]
+
+    def test_top_rejects_combinations(self):
+        import pytest
+
+        from horaedb_tpu.proxy.influxql import InfluxQLError, evaluate
+
+        db = self._db()
+        with pytest.raises(InfluxQLError, match="cannot combine"):
+            evaluate(db, 'SELECT top(usage, 2), host FROM "cpu"')
+
+    def test_top_fill_and_argument_validation(self):
+        import pytest
+
+        from horaedb_tpu.proxy.influxql import InfluxQLError, evaluate
+
+        db = self._db()
+        # fill() must not drop shape-changing rows off the bucket lattice
+        s = evaluate(
+            db, 'SELECT top(usage, 1) FROM "cpu" GROUP BY time(1m) fill(0)'
+        )["results"][0]["series"][0]
+        assert s["values"] == [[2000, 5.0], [61000, 9.0]]
+        with pytest.raises(InfluxQLError, match="numeric"):
+            evaluate(db, 'SELECT top(host, 1) FROM "cpu"')
+        for bad in ('SELECT top(usage, 2.5) FROM "cpu"',
+                    "SELECT top(usage, 'x') FROM \"cpu\"",
+                    'SELECT top(usage, 2m) FROM "cpu"'):
+            with pytest.raises(InfluxQLError, match="integer"):
+                evaluate(db, bad)
