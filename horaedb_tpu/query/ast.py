@@ -389,7 +389,7 @@ class AlterTableSetOptions:
 
 @dataclass(frozen=True)
 class Explain:
-    inner: "Select"
+    inner: "Select | UnionSelect"
     analyze: bool = False
 
 

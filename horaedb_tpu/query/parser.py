@@ -173,7 +173,21 @@ class Parser:
         if self._at_kw("EXPLAIN"):
             self.i += 1
             analyze = self._eat_kw("ANALYZE")
-            return ast.Explain(self._select(), analyze=analyze)
+            if self._at_kw("WITH"):
+                t = self._peek()
+                raise ParseError(
+                    "EXPLAIN over WITH is not supported; EXPLAIN the "
+                    "outer statement against materialized tables instead",
+                    t.pos, self.sql,
+                )
+            inner = self._select_or_union()
+            if analyze and isinstance(inner, ast.UnionSelect):
+                t = self._peek()
+                raise ParseError(
+                    "EXPLAIN ANALYZE over UNION is not supported",
+                    t.pos if t else -1, self.sql,
+                )
+            return ast.Explain(inner, analyze=analyze)
         if self._at_kw("WITH"):
             return self._with_statement()
         if self._at_kw("SELECT"):

@@ -118,7 +118,7 @@ class ExistsPlan:
 
 @dataclass(frozen=True)
 class ExplainPlan:
-    inner: "QueryPlan"
+    inner: "QueryPlan | UnionPlan"
     analyze: bool = False
 
 

@@ -70,7 +70,7 @@ class Planner:
         if isinstance(stmt, ast.Explain):
             from .plan import ExplainPlan
 
-            return ExplainPlan(self._plan_select(stmt.inner), analyze=stmt.analyze)
+            return ExplainPlan(self.plan(stmt.inner), analyze=stmt.analyze)
         if isinstance(stmt, (ast.Select, ast.UnionSelect)) and stmt.ctes:
             # CTE bodies and the outer statement plan lazily at execution:
             # each cte's output schema exists only once it materializes

@@ -1364,3 +1364,34 @@ class TestAggregateExpressions:
         assert len(plan.aggs) == 1
         row = db.execute("SELECT avg(v) AS a, avg(v)/2 AS h FROM ae").to_pylist()[0]
         assert row == {"a": 4.5, "h": 2.25}
+
+
+class TestExplainBreadth:
+    def test_explain_union(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE eu (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        out = db.execute(
+            "EXPLAIN SELECT v FROM eu UNION ALL SELECT v FROM eu ORDER BY v LIMIT 5"
+        ).to_pylist()
+        text = "\n".join(r["plan"] for r in out)
+        assert "Union: branches=2" in text and "Branch 1:" in text
+
+    def test_explain_with_and_analyze_union_rejected(self):
+        import pytest
+
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE ew (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        with pytest.raises(Exception, match="EXPLAIN over WITH"):
+            db.execute("EXPLAIN WITH x AS (SELECT v FROM ew) SELECT * FROM x")
+        with pytest.raises(Exception, match="ANALYZE over UNION"):
+            db.execute("EXPLAIN ANALYZE SELECT v FROM ew UNION SELECT v FROM ew")
