@@ -143,10 +143,16 @@ class CompactionScheduler:
                 self._backoff.pop(key, None)
         except Exception:
             _M_FAILURES.inc()
+            # A table retired/dropped mid-merge gets no backoff entry: its
+            # forget() may already have run, and re-inserting here would
+            # recreate exactly the permanent stats() leak forget() fixes.
+            gone = getattr(table, "retired", False) or getattr(table, "dropped", False)
+            fails, delay = 1, 30.0
             with self._lock:
-                fails = self._backoff.get(key, (0, 0.0))[0] + 1
-                delay = min(30.0 * (2 ** (fails - 1)), 3600.0)
-                self._backoff[key] = (fails, time.monotonic() + delay)
+                if not gone:
+                    fails = self._backoff.get(key, (0, 0.0))[0] + 1
+                    delay = min(30.0 * (2 ** (fails - 1)), 3600.0)
+                    self._backoff[key] = (fails, time.monotonic() + delay)
             logger.exception(
                 "background compaction failed for table %s (attempt %d; "
                 "suppressed for %.0fs)", table.name, fails, delay,
@@ -155,6 +161,13 @@ class CompactionScheduler:
             with self._lock:
                 self._running -= 1
                 self._update_depth_locked()
+
+    def forget(self, key: tuple[int, int]) -> None:
+        """Drop a table's failure-backoff entry when the table is dropped
+        or handed off — otherwise a durably-failing table leaves its entry
+        (and stats() row) behind forever."""
+        with self._lock:
+            self._backoff.pop(key, None)
 
     @classmethod
     def idle_stats(cls, closed: bool = False) -> dict:

@@ -177,6 +177,8 @@ class Instance:
             table.retired = True
         with self._lock:
             self._tables.pop((table.space_id, table.table_id), None)
+            if self._compactions is not None:
+                self._compactions.forget((table.space_id, table.table_id))
 
     def drop_table(self, table: TableData) -> None:
         with table.serial_lock:
@@ -188,6 +190,8 @@ class Instance:
                 self.wal.delete_table(table.table_id)
             with self._lock:
                 self._tables.pop((table.space_id, table.table_id), None)
+                if self._compactions is not None:
+                    self._compactions.forget((table.space_id, table.table_id))
 
     def open_tables(self) -> list[TableData]:
         with self._lock:

@@ -329,13 +329,13 @@ class MetaServer:
             raise RuntimeError(f"target shard {into_id} does not exist")
         if dst.node is None:
             raise RuntimeError(f"target shard {into_id} unassigned; retrying")
-        for t in self.topology.tables_of_shard(shard_id):
-            self.topology.move_table_to_shard(t.name, into_id)
-        dst_view = self.topology.shard(into_id)
-        # The moves bumped the victim's version; the close must carry the
-        # CURRENT one or the node rejects it as stale.
-        victim_now = self.topology.shard(shard_id) or victim
         if victim.node == dst.node:
+            for t in self.topology.tables_of_shard(shard_id):
+                self.topology.move_table_to_shard(t.name, into_id)
+            dst_view = self.topology.shard(into_id)
+            # The moves bumped the victim's version; the close must carry
+            # the CURRENT one or the node rejects it as stale.
+            victim_now = self.topology.shard(shard_id) or victim
             _post(dst.node, "/meta_event/open_shard", self._shard_order(dst_view))
             if victim.node:
                 try:
@@ -345,13 +345,20 @@ class MetaServer:
                     pass  # heartbeat reconcile closes it
         else:
             # Cross-node: release on the victim's owner BEFORE the target
-            # opens the moved tables (single-writer discipline).
+            # opens the moved tables (single-writer discipline), and
+            # BEFORE any topology mutation — a failed close must raise
+            # (the victim still holds an unexpired lease, so falling
+            # through to the open would let both nodes accept writes for
+            # up to one TTL), and raising here with the topology untouched
+            # means a procedure that exhausts its retries strands nothing.
+            # Retries are idempotent: the node answers OK for an
+            # already-closed shard, and re-running the moves is a no-op.
             if victim.node:
-                try:
-                    _post(victim.node, "/meta_event/close_shard",
-                          {"shard_id": shard_id, "version": victim_now.version})
-                except Exception:
-                    pass
+                _post(victim.node, "/meta_event/close_shard",
+                      {"shard_id": shard_id, "version": victim.version})
+            for t in self.topology.tables_of_shard(shard_id):
+                self.topology.move_table_to_shard(t.name, into_id)
+            dst_view = self.topology.shard(into_id)
             _post(dst.node, "/meta_event/open_shard", self._shard_order(dst_view))
         self.topology.remove_shard(shard_id)
 

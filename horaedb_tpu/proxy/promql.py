@@ -629,9 +629,10 @@ def _range_series(
             conn, pq, where, schema, value_col, group_labels, step_ms, func
         )
     elif func in _RAW_FOLD_FUNCS:
-        # Raw folds evaluate per step over the SLIDING [b-range, b]
-        # window (prom semantics) — the scan must reach back one window
-        # before the first step.
+        # Raw folds evaluate per step over the SLIDING left-open
+        # (b-range, b] window (prom semantics) — the scan must reach back
+        # one window before the first step (the >= here only over-fetches
+        # the one boundary row the fold then excludes).
         window = pq.range_ms or DEFAULT_LOOKBACK_MS
         raw_where = [f"{_q(schema.timestamp_name)} >= {start_ms - window}"] + where[1:]
         per_series = _raw_window_series(
@@ -775,10 +776,11 @@ def _raw_window_series(
 
         folded: dict[int, float] = {}
         for b in steps:
-            # INCLUSIVE left bound, matching the instant path's exact
-            # window ([t-range, t], _instant_over_time) — one convention
-            # everywhere beats silently differing instant/range answers.
-            lo = bisect.bisect_left(ts_arr, b - window_ms)
+            # LEFT-OPEN window (b-window, b], Prometheus's convention — a
+            # sample landing exactly on a boundary belongs to one window
+            # only. The instant path (_instant_over_time) uses the same
+            # open left bound so instant/range answers agree.
+            lo = bisect.bisect_right(ts_arr, b - window_ms)
             hi = bisect.bisect_right(ts_arr, b)
             if lo >= hi:
                 continue
@@ -1376,7 +1378,7 @@ DEFAULT_LOOKBACK_MS = 5 * 60_000  # prom's 5m instant lookback
 _OVER_TIME_FUNCS = frozenset(
     f for f in RANGE_FUNCS if f.endswith("_over_time")
 )
-# Functions that must fold the EXACT [t-range, t] window at instant
+# Functions that must fold the EXACT (t-range, t] window at instant
 # evaluation (epoch-aligned buckets cover only a fraction of the window
 # whenever t isn't step-aligned): the *_over_time family plus delta.
 _EXACT_WINDOW_FUNCS = _OVER_TIME_FUNCS | _RAW_FOLD_FUNCS
@@ -1386,9 +1388,9 @@ def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
     """-> prom 'vector': latest resolvable value per series in the lookback
     (steps at scrape-ish resolution so 'latest' means latest, not a
     whole-window average). ``*_over_time`` functions fold their EXACT
-    window [t-range, t] (not an epoch-aligned bucket containing t — an
-    aligned bucket would cover a fraction of the window whenever t isn't
-    step-aligned)."""
+    left-open window (t-range, t] (not an epoch-aligned bucket containing
+    t — an aligned bucket would cover a fraction of the window whenever t
+    isn't step-aligned)."""
     if pq.func in _EXACT_WINDOW_FUNCS:
         return _instant_over_time(conn, pq, time_ms)
     window = pq.range_ms or DEFAULT_LOOKBACK_MS
@@ -1406,7 +1408,8 @@ def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
 
 
 def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
-    """One raw fold per series over exactly [t-range, t] (after @/offset)."""
+    """One raw fold per series over exactly (t-range, t] (after @/offset) —
+    Prometheus's left-open window, matching _raw_window_series."""
     table = conn.catalog.open(pq.metric)
     if table is None:
         return []
@@ -1419,7 +1422,7 @@ def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
     t_eval = (pq.at_ms if pq.at_ms is not None else time_ms) - pq.offset_ms
     window = pq.range_ms or DEFAULT_LOOKBACK_MS
     where = [
-        f"{_q(schema.timestamp_name)} >= {t_eval - window}",
+        f"{_q(schema.timestamp_name)} > {t_eval - window}",
         f"{_q(schema.timestamp_name)} <= {t_eval}",
     ]
     for label, op, val in pq.matchers:

@@ -225,16 +225,27 @@ def _eval_cast(e: ast.Cast, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
         out = np.array([str(x) for x in v], dtype=object)
         return out, m
     try:
-        if v.dtype == object:
+        if v.dtype == object or v.dtype.kind in "US":
             # String -> number errors on bad VALID strings (SQL casts are
             # strict), but NULL rows carry the '' kind-default fill and
             # are masked out — neutralize them before the strict cast.
-            out = np.where(m, v, "0").astype(np.float64).astype(target)
+            filled = np.where(m, v, "0")
+            if target is np.int64:
+                # Integer strings above 2^53 lose precision through
+                # float64; parse directly and only route decimal/exponent
+                # forms through the float path. Out-of-range integers must
+                # ERROR (strict cast), not wrap through the float detour.
+                try:
+                    out = filled.astype(np.int64)
+                except (ValueError, TypeError):
+                    out = filled.astype(np.float64).astype(target)
+            else:
+                out = filled.astype(np.float64).astype(target)
         elif target is np.int64 and v.dtype.kind == "f":
             out = np.trunc(np.where(m, v, 0)).astype(np.int64)
         else:
             out = np.where(m, v, 0).astype(target) if v.dtype.kind != "b" else v.astype(target)
-    except (ValueError, TypeError) as ex:
+    except (ValueError, TypeError, OverflowError) as ex:
         raise ExprError(f"CAST failed: {ex}")
     return out, m
 

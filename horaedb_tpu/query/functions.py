@@ -197,18 +197,15 @@ def _length(args, rows):
 
 
 def _concat(args, rows):
-    """NULL arguments concatenate as empty (Postgres concat semantics);
-    the result is NULL only when every argument is NULL."""
+    """NULL arguments concatenate as empty and the result is never NULL
+    (Postgres concat semantics: all-NULL args yield '')."""
     n = len(rows)
     parts = []
-    valids = []
     for pair in args:
         v, m = _vals(pair)
         parts.append([str(x) if ok else "" for x, ok in zip(v, m)])
-        valids.append(m)
     out = np.array(["".join(p[i] for p in parts) for i in range(n)], dtype=object)
-    valid = np.logical_or.reduce(valids) if valids else np.zeros(n, dtype=bool)
-    return out, valid
+    return out, np.ones(n, dtype=bool)
 
 
 def _make_math_fn(fn, domain=None):

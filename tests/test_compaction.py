@@ -548,6 +548,12 @@ class TestDedupPruningRegression:
         _time.sleep(0.05)  # let the failure register
         assert s.request(T()) is False  # suppressed by backoff
         assert len(calls) == 1
+        # Dropping/retiring the table prunes its backoff entry — a
+        # durably-failing table must not leave a stats() row forever.
+        assert "0/1" in s.stats()["backoff"]
+        s.forget((0, 1))
+        assert s.stats()["backoff"] == {}
+        assert s.request(T()) is True  # backoff cleared with the entry
         s.close()
 
     def test_abandoned_instance_periodic_thread_exits(self):

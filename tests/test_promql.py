@@ -510,17 +510,17 @@ class TestBreadthFunctions:
             db, parse_promql('quantile_over_time(0.5, cpu{host="h1"}[2m])'),
             0, 4 * MIN, 2 * MIN,
         )
-        # sliding windows: b=0 sees only ts=0 (10), b=2m the median of
-        # 10/11/12 at [0,2m], b=4m the median of 12/13 at [2m,4m]
-        assert [v for _, v in out[0]["values"]] == ["10.0", "11.0", "12.5"]
+        # sliding LEFT-OPEN (b-2m, b] windows (prom boundary semantics):
+        # b=0 sees only ts=0 (10), b=2m the median of 11/12 (ts=0 is on
+        # the open boundary, excluded), b=4m only 13
+        assert [v for _, v in out[0]["values"]] == ["10.0", "11.5", "13.0"]
         out = evaluate_expr_range(
             db, parse_promql('stddev_over_time(cpu{host="h1"}[2m])'),
             0, 4 * MIN, 2 * MIN,
         )
-        # sliding windows: {10} -> 0, {10,11,12} -> 0.8165, {12,13} -> 0.5
+        # left-open windows: {10} -> 0, {11,12} -> 0.5, {13} -> 0
         got = [float(v) for _, v in out[0]["values"]]
-        import math
-        assert got[0] == 0.0 and abs(got[1] - math.sqrt(2 / 3)) < 1e-9 and got[2] == 0.5
+        assert got == [0.0, 0.5, 0.0]
 
     def test_label_replace(self, db):
         out = evaluate_expr_range(
@@ -750,7 +750,9 @@ class TestSubqueries:
         out2 = evaluate_expr_instant(
             db, parse_promql("max_over_time(delta(g[2m])[5m:1m])"), 300_000
         )
-        assert float(out2[0]["value"][1]) == -3.0
+        # inner eval at t=2m uses the LEFT-OPEN window (0, 2m]: the ts=0
+        # sample is excluded, so delta there is 7-4=3 — the subquery max
+        assert float(out2[0]["value"][1]) == 3.0
 
     def test_delta_exact_window_and_sparse_samples(self):
         import horaedb_tpu
@@ -841,4 +843,5 @@ class TestSubqueries:
         m3 = evaluate_expr_range(
             db, parse_promql("delta(sw[2m])"), 120_000, 120_000, 60_000
         )
-        assert [float(v) for _, v in m3[0]["values"]] == [5.0]  # 6 - 1
+        # left-open (0, 2m] window excludes the ts=0 sample: 6 - 5
+        assert [float(v) for _, v in m3[0]["values"]] == [1.0]

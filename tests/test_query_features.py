@@ -1239,6 +1239,33 @@ class TestExpressionSurface:
         ).to_pylist()[0]
         assert out == {"i": 1, "s": "1.0"}
 
+    def test_cast_big_integer_string_exact(self):
+        # Integer strings above 2^53 must round-trip exactly (a float64
+        # detour would silently lose the low bits); decimal strings still
+        # take the float path.
+        db = self._db()
+        out = db.execute(
+            "SELECT cast('9007199254740993' AS bigint) AS big, "
+            "cast('2.5' AS bigint) AS dec FROM ex LIMIT 1"
+        ).to_pylist()[0]
+        assert out["big"] == 9007199254740993
+        assert out["dec"] == 2
+
+    def test_concat_never_null(self):
+        # Postgres concat(): NULL args concatenate as empty, all-NULL
+        # yields '' — never NULL.
+        db = self._db()
+        db.execute(
+            "CREATE TABLE cnul (host string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO cnul (host, ts) VALUES ('h', 1)")
+        out = db.execute(
+            "SELECT concat(CASE WHEN v > 0 THEN 'x' END, "
+            "CASE WHEN v > 0 THEN 'y' END) AS c FROM cnul"
+        ).to_pylist()[0]
+        assert out["c"] == ""
+
     def test_like_ilike(self):
         db = self._db()
         assert [r["host"] for r in db.execute(
