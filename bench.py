@@ -312,23 +312,16 @@ def run_compaction_config() -> dict:
     import jax
 
     from horaedb_tpu.engine import compaction as compaction_mod
-    from horaedb_tpu.ops import merge_dedup
-    from horaedb_tpu.ops.encoding import shape_bucket
 
     platform = jax.devices()[0].platform
     config = "compaction-64"
 
-    # Device pass. Warm the sort kernel on the exact padded bucket shape
-    # first so compile time (minutes on a tunneled backend) isn't billed
-    # to the merge.
+    # Device pass. Warm the chunked pipeline's sort kernels on their
+    # padded bucket shapes first so compile time (minutes on a tunneled
+    # backend) isn't billed to the merge.
     db_dev, table_dev = _build_compaction_db(seed=7)
     n_input = sum(h.meta.num_rows for h in table_dev.version.levels.files_at(0))
-    bucket = shape_bucket(n_input)
-    merge_dedup.merge_dedup_permutation(
-        np.zeros(bucket, dtype=np.uint64),
-        np.zeros(bucket, dtype=np.int64),
-        np.zeros(bucket, dtype=np.uint64),
-    )
+    compaction_mod.Compactor(table_dev).warm_device_merge(n_input)
     s = time.perf_counter()
     res_dev = compaction_mod.Compactor(table_dev).compact()
     dev_s = time.perf_counter() - s
@@ -336,16 +329,34 @@ def run_compaction_config() -> dict:
         "SELECT count(1) AS c, avg(value) AS v FROM demo"
     ).to_pylist()
 
-    # Host pass: identical table (same seed), merge forced onto numpy.
+    # Host pass: identical table (same seed), merge forced onto numpy by
+    # replacing the WHOLE _merge_stream (the merge engine's single
+    # override point — patching anything narrower would leave the "host"
+    # pass on the device pipeline).
     db_host, table_host = _build_compaction_db(seed=7)
-    orig = compaction_mod.merge_dedup_permutation
-    compaction_mod.merge_dedup_permutation = _host_merge_permutation
+    from horaedb_tpu.common_types import RowGroup as _RG
+    from horaedb_tpu.engine.options import UpdateMode
+
+    def _forced_host_merge(self, parts, versions):
+        rows = _RG.concat(parts) if len(parts) > 1 else parts[0]
+        seq = np.concatenate(versions)
+        schema = rows.schema
+        tsid = rows.columns[schema.columns[schema.tsid_index].name]
+        dedup = self.table.options.update_mode is UpdateMode.OVERWRITE
+        perm, keep = _host_merge_permutation(
+            tsid, rows.timestamps.astype(np.int64), seq, dedup=dedup
+        )
+        sel = perm[keep]
+        yield rows.take(sel), seq[sel]
+
+    orig = compaction_mod.Compactor._merge_stream
+    compaction_mod.Compactor._merge_stream = _forced_host_merge
     try:
         s = time.perf_counter()
         res_host = compaction_mod.Compactor(table_host).compact()
         host_s = time.perf_counter() - s
     finally:
-        compaction_mod.merge_dedup_permutation = orig
+        compaction_mod.Compactor._merge_stream = orig
     host_check = db_host.execute(
         "SELECT count(1) AS c, avg(value) AS v FROM demo"
     ).to_pylist()

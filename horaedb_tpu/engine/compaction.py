@@ -19,6 +19,7 @@ are dropped without rewriting (ref: sst/manager.rs:100-118).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -48,6 +49,17 @@ _M_COMPACT_ROWS = REGISTRY.counter(
     "engine_compaction_rows_written_total",
     "rows written to merged output SSTs",
 )
+
+
+def merge_chunk_count(n_rows: int) -> int:
+    """How many tsid-range chunks the pipelined device merge splits into.
+    One chunk below the target size (pipelining needs enough rows per
+    chunk to amortize a kernel dispatch); capped so tiny chunks don't
+    multiply jit cache keys."""
+    target = int(os.environ.get("HORAEDB_MERGE_CHUNK_ROWS", "4000000"))
+    if target <= 0:
+        return 1
+    return max(1, min(16, n_rows // target))
 
 
 @dataclass(frozen=True)
@@ -250,6 +262,32 @@ class Compactor:
             table.version.levels.remove_files(h.level, [h.file_id])
         result.expired_dropped += len(expired)
 
+    def warm_device_merge(self, n_input: int, dedup: bool = True) -> None:
+        """Pre-compile the merge kernels the chunked pipeline will need
+        for an ``n_input``-row merge (the sort compile can take minutes on
+        a tunneled backend; benches and long-running engines warm it off
+        the critical path). Warms the kernel variant the table's update
+        mode will route to (rk for OVERWRITE+tsid, f32 otherwise)."""
+        from ..ops.encoding import shape_bucket
+
+        ranked = (
+            dedup
+            and self.table.options.update_mode is UpdateMode.OVERWRITE
+            and self.table.schema.tsid_index is not None
+        )
+        n_chunks = merge_chunk_count(n_input)
+        per = -(-n_input // n_chunks)
+        for bucket in {shape_bucket(per), shape_bucket(min(n_input, 2 * per))}:
+            merge_dedup_permutation(
+                np.zeros(bucket, dtype=np.uint64),
+                np.zeros(bucket, dtype=np.int64),
+                np.zeros(bucket, dtype=np.uint64),
+                dedup=dedup,
+                tsid_rank=np.zeros(bucket, dtype=np.uint64) if ranked else None,
+                n_ranks=2 if ranked else 0,
+                unique=ranked,
+            )
+
     def _run_task(self, task: CompactionTask, result: CompactionResult) -> None:
         table = self.table
         schema = table.schema
@@ -265,22 +303,14 @@ class Compactor:
                     np.full(len(rows), h.meta.max_sequence, dtype=np.uint64)
                 )
             max_seq = max(max_seq, h.meta.max_sequence)
-        if not parts:
-            merged, merged_seq = None, None
-        else:
-            rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
-            seq = np.concatenate(versions)
-            merged, merged_seq = self._device_merge(rows, seq)
-
         edits: list[MetaEdit] = []
         new_handles: list[FileHandle] = []
-        if merged is not None and len(merged):
-            writer = SstWriter(
-                table.store,
-                WriteOptions(
-                    num_rows_per_row_group=table.options.num_rows_per_row_group,
-                    compression=table.options.compression,
-                ),
+        if parts:
+            from .sst.writer import SstStreamWriter
+
+            opts = WriteOptions(
+                num_rows_per_row_group=table.options.num_rows_per_row_group,
+                compression=table.options.compression,
             )
             # One output per segment window. An input (an L1 run written
             # before ALTER shrank segment_duration) may span several
@@ -290,15 +320,32 @@ class Compactor:
             # window compacts later. Splitting by window and stamping each
             # output with the max sequence of ITS OWN rows keeps
             # file-granularity versioning exact.
-            for w_rows, w_seq in self._split_by_window(merged, merged_seq):
-                fid = table.alloc_file_id()
-                path = table.sst_object_path(fid)
-                meta = writer.write(
-                    path, fid, w_rows, max_sequence=int(w_seq.max())
-                )
-                edits.append(AddFile(1, meta, path))
-                new_handles.append(FileHandle(meta, path, 1))
-                result.rows_written += len(w_rows)
+            #
+            # The merge STREAMS: _merge_stream yields key-ordered parts
+            # (tsid-range chunks on the device pipeline) and each part's
+            # window slices append to that window's incremental parquet
+            # writer immediately — payload gather and SST encoding of
+            # part i overlap the device sort of parts i+1.. .
+            writers: dict[int, SstStreamWriter] = {}
+            for m_rows, m_seq in self._merge_stream(parts, versions):
+                for w_start, w_rows, w_seq in self._split_by_window(
+                    m_rows, m_seq
+                ):
+                    w = writers.get(w_start)
+                    if w is None:
+                        fid = table.alloc_file_id()
+                        w = SstStreamWriter(
+                            table.store, table.sst_object_path(fid), fid, opts
+                        )
+                        writers[w_start] = w
+                    w.append(w_rows, max_sequence=int(w_seq.max()))
+            for _, w in sorted(writers.items()):
+                meta = w.close()
+                if meta is None:
+                    continue
+                edits.append(AddFile(1, meta, w.path))
+                new_handles.append(FileHandle(meta, w.path, 1))
+                result.rows_written += meta.num_rows
         for h in task.inputs:
             edits.append(RemoveFile(h.level, h.file_id))
         table.manifest.append_edits(edits)
@@ -317,44 +364,196 @@ class Compactor:
 
     def _split_by_window(
         self, rows: RowGroup, seq: np.ndarray
-    ) -> list[tuple[RowGroup, np.ndarray]]:
-        """Bucket merged output rows by aligned segment window."""
+    ) -> list[tuple[int, RowGroup, np.ndarray]]:
+        """Bucket merged output rows by aligned segment window ->
+        (window_start, rows, seq) per window."""
         seg_ms = self.table.options.segment_duration_ms
         ts = rows.timestamps
         if not seg_ms or len(rows) == 0:
-            return [(rows, seq)]
+            start = int(ts[0] // seg_ms * seg_ms) if seg_ms and len(rows) else 0
+            return [(start, rows, seq)]
         starts = (ts // seg_ms) * seg_ms
         uniq = np.unique(starts)
         if len(uniq) == 1:
-            return [(rows, seq)]
+            return [(int(uniq[0]), rows, seq)]
         out = []
         for s in uniq:
             idx = np.nonzero(starts == s)[0]
-            out.append((rows.take(idx), seq[idx]))
+            out.append((int(s), rows.take(idx), seq[idx]))
         return out
 
-    def _device_merge(
-        self, rows: RowGroup, seq: np.ndarray
-    ) -> tuple[RowGroup, np.ndarray]:
-        """The hot loop on device: sort + dedup permutation, host gather.
+    @staticmethod
+    def _rank_tsids(
+        parts: list[RowGroup], schema, full_tsid: np.ndarray | None = None
+    ) -> tuple[np.ndarray | None, int]:
+        """Dense tsid ranks across all inputs, built (nearly) for free
+        from the runs' sortedness: each SST is primary-key sorted, so its
+        distinct tsids fall out of one diff pass — no O(n log n) factorize.
+        The sorted union of the per-run distincts is the rank universe;
+        one vectorized searchsorted ranks every row. Ranks + the
+        deduped-runs/distinct-sequences invariants unlock the packed
+        2-key unstable sort kernel (ops/merge_dedup._ranked_kernel)."""
+        tsid_idx = schema.tsid_index
+        if tsid_idx is None:
+            return None, 0
+        name = schema.columns[tsid_idx].name
+        uniqs = []
+        total_u = 0
+        n_total = 0
+        for part in parts:
+            col = part.columns[name]
+            n_total += len(col)
+            if len(col) == 0:
+                continue
+            change = np.empty(len(col), dtype=bool)
+            change[0] = True
+            np.not_equal(col[1:], col[:-1], out=change[1:])
+            uniqs.append(col[change])
+            total_u += int(change.sum())
+        if not uniqs:
+            return None, 0
+        if total_u > max(65536, n_total // 4):
+            # Grouped-runs assumption didn't hold (or cardinality is a
+            # large fraction of the rows): ranking wouldn't pay for itself.
+            return None, 0
+        union = np.unique(np.concatenate(uniqs))
+        if full_tsid is None:
+            full_tsid = np.concatenate([p.columns[name] for p in parts])
+        ranks = np.searchsorted(union, full_tsid).astype(np.uint64)
+        return ranks, len(union)
 
-        Returns the merged rows plus each surviving row's input-file
-        sequence (needed for per-window output stamping)."""
+    def _merge_stream(self, parts: list[RowGroup], versions: list[np.ndarray]):
+        """Yield key-ordered merged (rows, seq) parts — the compaction
+        merge engine, and the ONE override point for A/B-ing it.
+
+        Large merges are partitioned into tsid-range chunks and PIPELINED:
+        every chunk's sort kernel is dispatched asynchronously (JAX async
+        dispatch), so the host-side payload gather + SST encode of chunk i
+        overlap the device sort of chunks i+1.. — the device sort mostly
+        disappears from the critical path (the reference's BinaryHeap
+        merge, row_iter/merge.rs, is a single serial stream; the chunk
+        split is what a data-parallel device makes natural). Chunks split
+        on tsid VALUE boundaries, so every duplicate key lands in exactly
+        one chunk and per-chunk dedup is globally correct; chunks yield in
+        split order, which is (tsid, ts) order."""
+        table = self.table
+        rows = RowGroup.concat(parts) if len(parts) > 1 else parts[0]
+        seq = np.concatenate(versions)
+        schema = rows.schema
+        tsid_idx = schema.tsid_index
+        dedup = table.options.update_mode is UpdateMode.OVERWRITE
+        n = len(rows)
+        n_chunks = merge_chunk_count(n) if tsid_idx is not None else 1
+        if n_chunks <= 1:
+            tsid_rank, n_ranks = (
+                self._rank_tsids(parts, schema)
+                if tsid_idx is not None
+                else (None, 0)
+            )
+            yield self._device_merge(
+                rows, seq, tsid_rank=tsid_rank, n_ranks=n_ranks
+            )
+            return
+
+        tsid = rows.columns[schema.columns[tsid_idx].name]
+        tsid_rank, n_ranks = self._rank_tsids(parts, schema, full_tsid=tsid)
+        ts64 = rows.timestamps.astype(np.int64)
+        # OVERWRITE inputs are deduped runs with distinct per-file
+        # sequences, so (tsid, ts, seq) is row-unique — the precondition
+        # for the unstable packed kernel. APPEND inputs may repeat it.
+        unique = dedup
+
+        from ..ops.merge_dedup import (
+            merge_dedup_dispatch,
+            merge_dedup_dispatch_packed,
+            pack_ranked_key,
+        )
+
+        packed = (
+            pack_ranked_key(tsid_rank, ts64, seq, n_ranks)
+            if tsid_rank is not None and unique
+            else None
+        )
+        if packed is not None:
+            # Row-count-balanced chunks straight from the rank histogram
+            # (ranks are dense and ordered like tsid, so rank-range
+            # chunks = tsid-range chunks — no sampling pass needed).
+            comp, mask_hi, mask_lo = packed
+            counts = np.bincount(
+                tsid_rank.astype(np.int64), minlength=n_ranks
+            )
+            cum = np.cumsum(counts)
+            targets = [(n * (i + 1)) // n_chunks for i in range(n_chunks - 1)]
+            rank_split = np.searchsorted(cum, targets, side="left")
+            chunk_of_rank = np.searchsorted(
+                rank_split, np.arange(n_ranks), side="right"
+            )
+            cid = chunk_of_rank[tsid_rank.astype(np.int64)]
+        else:
+            # Approximate tsid quantiles from a stride sample (the inputs
+            # are sorted runs, so a stride over the concatenation samples
+            # every run): C-1 split values -> chunk id per row.
+            step = max(1, n // 65536)
+            sample = np.sort(tsid[::step])
+            splits = sample[
+                [min(len(sample) - 1, (len(sample) * (i + 1)) // n_chunks)
+                 for i in range(n_chunks - 1)]
+            ]
+            cid = np.searchsorted(splits, tsid, side="right")
+
+        idxs = [np.flatnonzero(cid == c) for c in range(n_chunks)]
+        # chunks in flight: bounds device memory, keeps overlap
+        window = max(1, int(os.environ.get("HORAEDB_MERGE_WINDOW", "2")))
+        handles: dict[int, object] = {}
+
+        def harvest(c: int):
+            perm, keep = handles.pop(c).get()
+            sel = idxs[c][perm[keep]]
+            return rows.take(sel), seq[sel]
+
+        for c in range(n_chunks):
+            idx = idxs[c]
+            if len(idx):
+                if packed is not None:
+                    handles[c] = merge_dedup_dispatch_packed(
+                        comp[idx], mask_hi, mask_lo, dedup=dedup
+                    )
+                else:
+                    handles[c] = merge_dedup_dispatch(
+                        tsid[idx], ts64[idx], seq[idx], dedup=dedup,
+                    )
+            if c - window + 1 in handles:
+                yield harvest(c - window + 1)
+        for c in sorted(handles):
+            yield harvest(c)
+
+    def _device_merge(
+        self,
+        rows: RowGroup,
+        seq: np.ndarray,
+        tsid_rank: np.ndarray | None = None,
+        n_ranks: int = 0,
+    ) -> tuple[RowGroup, np.ndarray]:
+        """Single-shot merge: sort + dedup permutation on device, host
+        gather. Returns the merged rows plus each surviving row's
+        input-file sequence (needed for per-window output stamping)."""
         table = self.table
         schema = rows.schema
         tsid_idx = schema.tsid_index
         dedup = table.options.update_mode is UpdateMode.OVERWRITE
-        if tsid_idx is not None:
-            tsid = rows.columns[schema.columns[tsid_idx].name]
-            perm, keep = merge_dedup_permutation(
-                tsid, rows.timestamps.astype(np.int64), seq, dedup=dedup
-            )
-            sel = perm[keep]
-            return rows.take(sel), seq[sel]
-        # Explicit primary keys (no tsid): host lexsort fallback.
-        order = rows.key_sort_permutation(seq=seq)
-        srt, srt_seq = rows.take(order), seq[order]
-        if not dedup:
-            return srt, srt_seq
-        keep = dedup_keep_mask(srt)
-        return srt.filter(keep), srt_seq[keep]
+        if tsid_idx is None:
+            # Explicit primary keys (no tsid): host lexsort fallback.
+            order = rows.key_sort_permutation(seq=seq)
+            srt, srt_seq = rows.take(order), seq[order]
+            if not dedup:
+                return srt, srt_seq
+            keep = dedup_keep_mask(srt)
+            return srt.filter(keep), srt_seq[keep]
+
+        tsid = rows.columns[schema.columns[tsid_idx].name]
+        perm, keep = merge_dedup_permutation(
+            tsid, rows.timestamps.astype(np.int64), seq, dedup=dedup,
+            tsid_rank=tsid_rank, n_ranks=n_ranks, unique=dedup,
+        )
+        sel = perm[keep]
+        return rows.take(sel), seq[sel]

@@ -327,10 +327,20 @@ def merge_read(
         from ..ops import merge_dedup_permutation
 
         tsid = rows.columns[out_schema.columns[tsid_idx].name]
-        perm, keep = merge_dedup_permutation(
-            tsid, rows.timestamps.astype(np.int64), version, dedup=True
+        # require_ready: the data's spans may route to a WIDER kernel
+        # than merge_dedup_ready pre-warmed (f64/general); a foreground
+        # read must never eat that compile — fall back to the host merge
+        # while it builds in the background.
+        pk = merge_dedup_permutation(
+            tsid, rows.timestamps.astype(np.int64), version, dedup=True,
+            require_ready=True,
         )
-        out = rows.take(perm[keep])
+        if pk is None:
+            route = None
+            out = dedup_sorted(rows.sorted_by_key(seq=version))
+        else:
+            perm, keep = pk
+            out = rows.take(perm[keep])
     else:
         out = dedup_sorted(rows.sorted_by_key(seq=version))
     if route is not None and adaptive_enabled():

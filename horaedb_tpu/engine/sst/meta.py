@@ -24,6 +24,12 @@ def footer_payload(parquet_file, path: str) -> dict:
     kv = parquet_file.schema_arrow.metadata or {}
     raw = kv.get(SST_META_KEY)
     if raw is None:
+        # Streamed SSTs attach the payload at close via the file-level
+        # key-value metadata (the arrow schema was already serialized by
+        # then); monolithic writes embed it in the schema. Accept both.
+        kv = parquet_file.metadata.metadata or {}
+        raw = kv.get(SST_META_KEY)
+    if raw is None:
         raise ValueError(f"{path}: not a horaedb_tpu SST (missing footer meta)")
     return json.loads(raw)
 

@@ -106,6 +106,118 @@ class SstWriter:
         )
 
 
+class SstStreamWriter:
+    """Incremental SST writer: key-sorted PARTS append as parquet row
+    groups while the producer (the chunked device merge) is still
+    sorting later parts — write time overlaps kernel time instead of
+    serializing after the full merge materializes. Footer metadata
+    (column ranges, bloom filters, counts, time range) accumulates
+    per part and lands at ``close()`` via the parquet file-level
+    key-value metadata (the reference's writer also finalizes its custom
+    meta at close, sst/parquet/writer.rs)."""
+
+    def __init__(
+        self,
+        store: ObjectStore,
+        path: str,
+        file_id: int,
+        options: WriteOptions | None = None,
+    ) -> None:
+        self.store = store
+        self.path = path
+        self.file_id = file_id
+        self.options = options or WriteOptions()
+        self._buf = io.BytesIO()
+        self._writer: pq.ParquetWriter | None = None
+        self._schema: Schema | None = None
+        self._ranges: dict = {}
+        self._filters: list = []
+        self._num_rows = 0
+        self._t_lo: int | None = None
+        self._t_hi: int | None = None
+        self._max_seq = 0
+
+    def append(self, rows: RowGroup, max_sequence: int = 0) -> None:
+        if len(rows) == 0:
+            return
+        self._schema = rows.schema
+        self._max_seq = max(self._max_seq, int(max_sequence))
+        batch = rows.to_arrow()
+        table = pa.Table.from_batches([batch])
+        if self._writer is None:
+            self._writer = pq.ParquetWriter(
+                self._buf,
+                table.schema,
+                compression=self.options.compression,
+                use_dictionary=True,
+                write_statistics=True,
+            )
+        n_per = self.options.num_rows_per_row_group
+        self._writer.write_table(table, row_group_size=n_per)
+        for col, (lo, hi) in _column_ranges(rows).items():
+            prev = self._ranges.get(col)
+            self._ranges[col] = (
+                (lo, hi) if prev is None else (min(prev[0], lo), max(prev[1], hi))
+            )
+        # Per-part grouping matches the parquet row groups exactly: each
+        # write_table call starts fresh groups, so the concatenated filter
+        # list stays aligned with the file's actual row groups.
+        self._filters.extend(_row_group_filters(rows, n_per))
+        self._num_rows += len(rows)
+        tr = rows.time_range()
+        self._t_lo = tr.inclusive_start if self._t_lo is None else min(
+            self._t_lo, tr.inclusive_start
+        )
+        self._t_hi = tr.exclusive_end if self._t_hi is None else max(
+            self._t_hi, tr.exclusive_end
+        )
+
+    @property
+    def num_rows(self) -> int:
+        return self._num_rows
+
+    @property
+    def max_sequence(self) -> int:
+        return self._max_seq
+
+    def close(self) -> SstMeta | None:
+        """Finalize + store; None when nothing was appended."""
+        if self._writer is None:
+            return None
+        from ...common_types.time_range import TimeRange
+
+        meta = SstMeta(
+            file_id=self.file_id,
+            time_range=TimeRange(self._t_lo, self._t_hi),
+            max_sequence=self._max_seq,
+            num_rows=self._num_rows,
+            size_bytes=0,
+            schema_version=self._schema.version,
+            column_ranges=self._ranges,
+            row_group_filters=self._filters,
+        )
+        self._writer.add_key_value_metadata(
+            {
+                SST_META_KEY.decode(): json.dumps(
+                    {**meta.to_dict(), "schema": self._schema.to_dict()}
+                )
+            }
+        )
+        self._writer.close()
+        raw = self._buf.getvalue()
+        self.store.put(self.path, raw)
+        return SstMeta(
+            file_id=meta.file_id,
+            time_range=meta.time_range,
+            max_sequence=meta.max_sequence,
+            num_rows=meta.num_rows,
+            size_bytes=len(raw),
+            schema_version=meta.schema_version,
+            column_ranges=meta.column_ranges,
+            row_group_filters=meta.row_group_filters,
+        )
+
+
 def _column_ranges(data: RowGroup) -> dict:
     """File-level min/max per numeric + string column for manifest pruning."""
     from ...common_types.dict_column import DictColumn

@@ -11,5 +11,6 @@ data path.
 """
 
 from .dist_agg import dist_scan_aggregate, make_dist_scan_agg
+from .dist_merge import dist_merge_dedup
 
-__all__ = ["dist_scan_aggregate", "make_dist_scan_agg"]
+__all__ = ["dist_scan_aggregate", "make_dist_scan_agg", "dist_merge_dedup"]

@@ -459,6 +459,15 @@ class Executor:
             out = self._try_partitioned_agg(plan, table, m)
             if out is not None:
                 return self._finish_metrics(m, t_start, "device-partial", out)
+        # Plan-subtree shipping: window/topk/distinct/full-agg/filter
+        # shapes execute on partition owners instead of pulling raw rows
+        # (ref: dist_sql_query resolver execute_physical_plan push-down).
+        if hasattr(table, "sub_tables"):
+            from .dist_plan import try_dist_plan
+
+            out = try_dist_plan(self, plan, table, m)
+            if out is not None:
+                return self._finish_metrics(m, t_start, "dist-plan", out)
         t_scan = _time.perf_counter()
         projection = self._projection(plan)
         predicate = plan.predicate
@@ -836,7 +845,7 @@ class Executor:
             return None
         # NULL agg inputs need per-field masks — not expressible here.
         for c in agg_cols:
-            if not entry.rows.valid_mask(c).all():
+            if not entry.all_valid.get(c, False):
                 return None
         # Unflushed delta rows fold into the aggregate ON TOP of the HBM
         # base — but only when provably sound (see _delta_soundness).
@@ -854,17 +863,7 @@ class Executor:
         S = entry.n_series
         series_rows = None
         if tag_keys or series_filters:
-            series_rows = RowGroup(
-                schema,
-                {
-                    c.name: entry.rows.columns[c.name][entry.series_first_idx]
-                    for c in schema.columns
-                },
-                {
-                    name: mask[entry.series_first_idx]
-                    for name, mask in entry.rows.validity.items()
-                },
-            )
+            series_rows = entry.series_rows  # derived at build, one row/series
         if tag_keys:
             from ..ops.encoding import _codes_from_columns
 
@@ -1008,13 +1007,17 @@ class Executor:
         # All (or most) series selected: the full-scan kernel wins.
         if len(sel) == 0 or len(sel) > 256 or len(sel) * 4 > S:
             return None
-        ts_host = entry.rows.timestamps  # sorted within each series range
+        # int32 relative timestamps survive the host-rows drop; clamp the
+        # bounds into their domain before searching.
+        ts_rel = entry.ts_rel_host  # sorted within each series range
+        lo_rel = int(np.clip(lo - entry.min_ts, -(2**31) + 1, 2**31 - 1))
+        hi_rel = int(np.clip(hi - entry.min_ts, -(2**31) + 1, 2**31 - 1))
         parts = []
         total = 0
         for s in sel:
             s0, s1 = int(offsets[s]), int(offsets[s + 1])
-            a = s0 + int(np.searchsorted(ts_host[s0:s1], lo, "left"))
-            b = s0 + int(np.searchsorted(ts_host[s0:s1], hi, "left"))
+            a = s0 + int(np.searchsorted(ts_rel[s0:s1], lo_rel, "left"))
+            b = s0 + int(np.searchsorted(ts_rel[s0:s1], hi_rel, "left"))
             if b > a:
                 parts.append(np.arange(a, b, dtype=np.int32))
                 total += b - a

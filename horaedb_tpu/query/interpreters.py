@@ -254,6 +254,16 @@ class InterpreterFactory:
                 f"  Partitions: {table.rule.num_partitions} "
                 f"({table.rule.method}) scan={shown}"
             )
+            from .dist_plan import dist_plan_mode
+
+            mode = dist_plan_mode(self.executor, q, table)
+            if mode is not None:
+                lines.append(
+                    f"  Distributed: ship plan subtree to partition owners "
+                    f"(mode={mode}; remote partitions execute via "
+                    f"/horaedb.remote_engine/ExecutePlan, coordinator "
+                    f"combines + re-applies ORDER/LIMIT)"
+                )
         if analyze:
             # EXPLAIN ANALYZE: actually run the query and report observed
             # execution (ref: EXPLAIN ANALYZE carrying runtime metrics).

@@ -48,7 +48,11 @@ class CachedTableScan:
     """Device-resident state for one table fingerprint."""
 
     fingerprint: tuple
-    rows: RowGroup  # merged host rows (kept for fallbacks/series lookups)
+    # merged host rows. None once dropped under the host-bytes budget —
+    # everything the serving path needs lives in the small derived fields
+    # below (series_rows, ts_rel_host, all_valid); only extending the
+    # entry with a NEW value column needs a re-read (ScanCache._extend).
+    rows: Optional[RowGroup]
     n_valid: int
     min_ts: int
     max_ts: int
@@ -80,6 +84,21 @@ class CachedTableScan:
     # dashboard re-issuing the same query shape skips the upload entirely
     # (see ops.scan_agg packed serving path)
     _sessions: dict = None
+    # Derived host state that SURVIVES dropping ``rows`` (ref analog: the
+    # reference's MemCacheStore keeps bounded bytes, mem_cache.rs:64-158):
+    # one row per series (tags for group maps/filters), the int32
+    # relative timestamps (selective range gathers), per-column
+    # no-NULLs flags, and a 0-row schema carrier for empty deltas.
+    series_rows: Optional[RowGroup] = None
+    ts_rel_host: Optional[np.ndarray] = None
+    all_valid: dict = None
+    empty_rows: Optional[RowGroup] = None
+    # resident-size accounting for the cache's byte budget
+    device_bytes: int = 0
+    host_bytes: int = 0
+
+    def total_bytes(self) -> int:
+        return self.device_bytes + self.host_bytes
 
     def values_for(self, names: list[str]):
         key = tuple(names)
@@ -122,8 +141,43 @@ class CachedTableScan:
         return dev
 
 
+def _rowgroup_bytes(rows: RowGroup) -> int:
+    """Approximate resident bytes of a RowGroup's host columns."""
+    from ..common_types.dict_column import DictColumn
+
+    total = 0
+    for arr in rows.columns.values():
+        if isinstance(arr, DictColumn):
+            total += arr.codes.nbytes
+            total += sum(len(str(v)) + 49 for v in arr.values)  # str overhead
+        elif isinstance(arr, np.ndarray) and arr.dtype == object:
+            total += arr.nbytes + 56 * len(arr)  # pointer + str objects
+        else:
+            total += arr.nbytes
+    for mask in rows.validity.values():
+        total += mask.nbytes
+    return total
+
+
 class ScanCache:
-    def __init__(self, max_entries: int = 4) -> None:
+    """Bounded by BYTES, not entry count (ref: mem_cache.rs:64-158 — the
+    reference budgets its partitioned LRU by capacity): entries are
+    evicted least-recently-used until resident device+host bytes fit
+    ``max_bytes`` (HORAEDB_SCAN_CACHE_MB, default 1024). A single table
+    whose resident state alone exceeds the budget is never built — the
+    host path serves it instead of failing a giant device_put. Entries
+    whose HOST rows exceed HORAEDB_CACHE_HOST_ROWS_MB (default 256) drop
+    the host copy after deriving the small serving-side state; a later
+    query needing a NEW value column re-reads from the SSTs."""
+
+    def __init__(
+        self,
+        max_entries: int = 4,
+        max_bytes: Optional[int] = None,
+        max_host_rows_bytes: Optional[int] = None,
+    ) -> None:
+        import os
+
         self._entries: dict[str, CachedTableScan] = {}
         # fingerprint last seen per table: a cache build is only worth the
         # full-table read once the data has been STABLE across two
@@ -132,8 +186,22 @@ class ScanCache:
         self._candidate: dict[str, tuple] = {}
         self._lock = threading.Lock()
         self.max_entries = max_entries
+        self.max_bytes = (
+            max_bytes
+            if max_bytes is not None
+            else int(os.environ.get("HORAEDB_SCAN_CACHE_MB", "1024")) << 20
+        )
+        self.max_host_rows_bytes = (
+            max_host_rows_bytes
+            if max_host_rows_bytes is not None
+            else int(os.environ.get("HORAEDB_CACHE_HOST_ROWS_MB", "256")) << 20
+        )
         self.hits = 0
         self.misses = 0
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(e.total_bytes() for e in self._entries.values())
 
     def get(
         self,
@@ -174,11 +242,21 @@ class ScanCache:
             # _extend is per-entry idempotent; the fingerprint re-check
             # catches a racing flush.
             if not all(c in entry.value_cols_dev for c in value_columns):
-                self._extend(entry, value_columns)
+                if not self._extend(
+                    entry, value_columns, read_rows=read_rows, table=table
+                ):
+                    # host rows were dropped and the re-read raced a
+                    # write: serve this query from the host path
+                    self.misses += 1
+                    return None, False, None
             delta = _read_delta(table, entry)
             with self._lock:
                 if delta is not None and _base_fingerprint(table) == base_fp:
                     self.hits += 1
+                    # LRU touch: reinsert at the tail
+                    e = self._entries.pop(table.name, None)
+                    if e is not None:
+                        self._entries[table.name] = e
                     return entry, False, delta
                 # A flush raced the delta read (or the delta predates the
                 # entry inconsistently): serve nothing from cache.
@@ -199,14 +277,28 @@ class ScanCache:
         min_ts, max_ts = int(ts.min()), int(ts.max())
         if max_ts - min_ts >= _I32_MAX:
             return None, False, None
+        # A table whose resident state ALONE busts the byte budget never
+        # builds — the host path serves it instead of a failing (or
+        # budget-starving) giant device_put.
+        est = shape_bucket(n + 1) * 4 * (2 + len(value_columns))
+        host_est = min(_rowgroup_bytes(rows), self.max_host_rows_bytes)
+        if est + host_est > self.max_bytes:
+            return None, False, None
         entry = self._build(base_fp, rows, min_ts, max_ts, value_columns)
         entry.built_seqs = seq_after
         with self._lock:
             self.misses += 1
-            if table.name not in self._entries and len(self._entries) >= self.max_entries:
+            self._entries.pop(table.name, None)
+            # Evict least-recently-used until count AND bytes fit.
+            while self._entries and (
+                len(self._entries) >= self.max_entries
+                or sum(e.total_bytes() for e in self._entries.values())
+                + entry.total_bytes()
+                > self.max_bytes
+            ):
                 self._entries.pop(next(iter(self._entries)))
             self._entries[table.name] = entry
-        empty = rows.slice(0, 0)
+        empty = entry.empty_rows
         return entry, True, empty
 
     def _build(
@@ -278,13 +370,81 @@ class ScanCache:
             series_tsids=uniq,
             series_offsets=offsets,
         )
+        # Serving-side state that outlives the host rows: per-series tag
+        # rows, int32 relative timestamps, no-NULL flags, schema carrier.
+        entry.series_rows = RowGroup(
+            schema,
+            {c.name: rows.columns[c.name][first_idx] for c in schema.columns},
+            {name: mask[first_idx] for name, mask in rows.validity.items()},
+        )
+        entry.ts_rel_host = (rows.timestamps - min_ts).astype(np.int32)
+        entry.all_valid = {
+            c.name: bool(rows.valid_mask(c.name).all()) for c in schema.columns
+        }
+        entry.empty_rows = rows.slice(0, 0)
+        entry.device_bytes = len(codes) * 4 * 2
+        entry.host_bytes = (
+            _rowgroup_bytes(rows)
+            + entry.ts_rel_host.nbytes
+            + _rowgroup_bytes(entry.series_rows)
+        )
+        # _extend uploads the value columns and then applies the host
+        # budget: an oversized full host copy is dropped (the derived
+        # state above keeps the device path serving; _extend re-reads
+        # from the SSTs should a new value column ever be requested).
         self._extend(entry, value_columns)
         return entry
 
-    def _extend(self, entry: CachedTableScan, value_columns: list[str]) -> None:
+    def _extend(
+        self,
+        entry: CachedTableScan,
+        value_columns: list[str],
+        read_rows=None,
+        table=None,
+    ) -> bool:
+        """Upload any missing value columns; False when the entry's host
+        rows were dropped and the re-read couldn't reproduce the build
+        state (caller serves from the host path)."""
         import os
 
         import jax
+
+        missing = [c for c in value_columns if c not in entry.value_cols_dev]
+        if missing and entry.rows is None:
+            if read_rows is None or table is None:
+                return False
+            # The re-read must reproduce EXACTLY the build-time row set.
+            # Any write since the build — including an OVERWRITE of an
+            # existing (tsid, ts) key, which changes neither the row
+            # count nor the timestamps — would leak into the uploaded
+            # column AND be re-counted by the delta fold. Same guard the
+            # build path uses: sequences must still equal the build point.
+            def _seqs():
+                return {
+                    d.table_id: d.last_sequence for d in table.physical_datas()
+                }
+
+            if entry.built_seqs is None or _seqs() != entry.built_seqs:
+                return False
+            # Re-derive the EXACT resident layout (same sort: (series,
+            # ts) via the same unique+lexsort) — deterministic for an
+            # unchanged base state.
+            rows = read_rows()
+            if _seqs() != entry.built_seqs:
+                return False  # a write raced the re-read
+            if len(rows) != entry.n_valid:
+                return False
+            schema = rows.schema
+            tsid = rows.columns[schema.columns[schema.tsid_index].name]
+            _, _, inverse = np.unique(tsid, return_index=True, return_inverse=True)
+            order = np.lexsort((rows.timestamps, inverse))
+            rows = rows.take(order)
+            if not np.array_equal(
+                (rows.timestamps - entry.min_ts).astype(np.int32),
+                entry.ts_rel_host,
+            ):
+                return False
+            entry.rows = rows  # keep until the next budget sweep
 
         target = len(entry.series_codes_dev)  # includes any mesh padding
         place = None
@@ -319,7 +479,22 @@ class ScanCache:
                 else:
                     dev = jnp.asarray(padded)
                 entry.value_cols_dev[c] = dev
+                entry.device_bytes += padded.nbytes
                 entry._stacks = None  # stale stacked views
+        self._apply_host_budget(entry)
+        return True
+
+    def _apply_host_budget(self, entry: CachedTableScan) -> None:
+        """Drop the full host rows copy when it exceeds the per-entry
+        budget; the derived serving state stays."""
+        if (
+            entry.rows is not None
+            and _rowgroup_bytes(entry.rows) > self.max_host_rows_bytes
+        ):
+            entry.rows = None
+            entry.host_bytes = entry.ts_rel_host.nbytes + _rowgroup_bytes(
+                entry.series_rows
+            )
 
     def invalidate(self, table_name: str) -> None:
         with self._lock:
@@ -380,7 +555,7 @@ def _read_delta(table, entry: CachedTableScan):
             _append_newer(parts, head_rows, head_seqs, built)
     if not parts:
         # verified clean: an empty RowGroup with the table schema
-        return entry.rows.slice(0, 0)
+        return entry.empty_rows
     from ..common_types.row_group import RowGroup
 
     return RowGroup.concat(parts) if len(parts) > 1 else parts[0]

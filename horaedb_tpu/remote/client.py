@@ -105,6 +105,16 @@ class RemoteEngineClient:
         names, arrays = columns_from_ipc(out["ipc"])
         return names, arrays, out.get("metrics") or {}
 
+    def execute_plan(self, table: str, req: dict):
+        """Execute a shipped plan subtree on the owner (ref:
+        client.rs:484 execute_physical_plan). -> (names, columns, nulls,
+        metrics)."""
+        from .codec import result_from_ipc
+
+        out = self._call("ExecutePlan", {"table": table, **req})
+        names, columns, nulls = result_from_ipc(out["ipc"])
+        return names, columns, nulls, out.get("metrics") or {}
+
     def drop_sub(self, table: str) -> bool:
         return bool(self._call("DropSub", {"table": table}).get("dropped"))
 
@@ -283,6 +293,14 @@ class RoutedSubTable(Table):
     def partial_agg(self, spec: dict):
         return self._call(lambda t: t.partial_agg(spec))
 
+    def execute_plan(self, req: dict):
+        """Ship the plan when the owner is remote; None when the route is
+        local — the coordinator's executor runs it against this handle
+        directly (the local resolution already IS where the data lives)."""
+        return self._call(
+            lambda t: t.execute_plan(req) if isinstance(t, RemoteSubTable) else None
+        )
+
     def drop_storage(self) -> None:
         """Called by the logical DROP TABLE: drop this partition's storage
         wherever it lives — on the owning node when remote, or locally
@@ -406,6 +424,16 @@ class RemoteSubTable(Table):
             "remote": self.client.endpoint,
             **metrics,
         }]
+
+    def execute_plan(self, req: dict):
+        names, columns, nulls, metrics = self.client.execute_plan(
+            self._name, req
+        )
+        return names, columns, nulls, {
+            "partition": self._name,
+            "remote": self.client.endpoint,
+            **metrics,
+        }
 
     def drop_remote(self) -> None:
         """Delete this partition's storage on its owning node (the

@@ -76,6 +76,59 @@ def columns_from_ipc(raw: bytes) -> tuple[list[str], list[np.ndarray]]:
     return names, arrays
 
 
+# ---- result set (named columns + NULL masks) <-> arrow IPC ----------------
+
+
+def result_to_ipc(
+    names: Sequence[str],
+    columns: Sequence[np.ndarray],
+    nulls: Optional[dict] = None,
+) -> bytes:
+    """Arbitrary query output with per-column NULL masks — arrow carries
+    validity natively, so the masks ride in-band (used by ExecutePlan)."""
+    cols = []
+    for name, a in zip(names, columns):
+        mask = (nulls or {}).get(name)
+        if mask is not None:
+            cols.append(pa.array(a, mask=np.asarray(mask, dtype=bool)))
+        else:
+            cols.append(pa.array(a))
+    batch = pa.record_batch(cols, names=list(names))
+    sink = io.BytesIO()
+    with ipc.new_stream(sink, batch.schema) as w:
+        w.write_batch(batch)
+    return sink.getvalue()
+
+
+def result_from_ipc(raw: bytes) -> tuple[list[str], list[np.ndarray], dict]:
+    """-> (names, columns, nulls). NULL slots are filled with the column
+    kind's neutral value and reported through the mask (the ResultSet
+    convention)."""
+    import pyarrow.compute as pc
+
+    with ipc.open_stream(io.BytesIO(raw)) as r:
+        table = r.read_all()
+    names = list(table.schema.names)
+    columns: list[np.ndarray] = []
+    nulls: dict[str, np.ndarray] = {}
+    for i, name in enumerate(names):
+        col = table.column(i).combine_chunks()
+        if col.null_count:
+            nulls[name] = np.asarray(col.is_null())
+        t = col.type
+        if pa.types.is_string(t) or pa.types.is_large_string(t):
+            filled = pc.fill_null(col, "") if col.null_count else col
+            columns.append(np.asarray(filled.to_pylist(), dtype=object))
+        elif pa.types.is_null(t):
+            nulls[name] = np.ones(len(col), dtype=bool)
+            columns.append(np.zeros(len(col), dtype=object))
+        else:
+            fill = False if pa.types.is_boolean(t) else 0
+            filled = pc.fill_null(col, fill) if col.null_count else col
+            columns.append(filled.to_numpy(zero_copy_only=False))
+    return names, columns, nulls
+
+
 # ---- predicate ------------------------------------------------------------
 
 

@@ -77,6 +77,7 @@ class GrpcServer:
                         "Write": _unary(self._write),
                         "Read": _unary(self._read),
                         "PartialAgg": _unary(self._partial_agg),
+                        "ExecutePlan": _unary(self._execute_plan),
                         "DropSub": _unary(self._drop_sub),
                     },
                 ),
@@ -174,6 +175,52 @@ class GrpcServer:
             "ipc": columns_to_ipc(names, arrays),
             # stage metrics ride home for EXPLAIN ANALYZE (ref: the
             # reference's RemoteTaskContext.remote_metrics)
+            "metrics": metrics,
+        }
+
+    def _execute_plan(self, req: dict) -> dict:
+        """Execute a shipped plan subtree against a local table (ref:
+        remote_engine_service handling of execute_physical_plan,
+        server/src/grpc/remote_engine_service/mod.rs:928-1011). The wire
+        carries the planned SELECT tree; this node re-binds it to its
+        local table state and runs the full local execution path (device
+        kernels included) — the coordinator receives finished output
+        rows, not raw partition rows."""
+        import time
+
+        from ..query.planner import Planner
+        from ..remote.plan_codec import select_from_wire
+        from .codec import result_to_ipc
+
+        t0 = time.perf_counter()
+        name = req["table"]
+        t = self._open(name)
+        select = select_from_wire(req["plan"])
+        planner = Planner(
+            lambda n: t.schema if n == name else self.conn.catalog.schema_of(n)
+        )
+        plan = planner.plan(select)
+        executor = self.conn.interpreters.executor
+        rs = executor.execute(plan, t)
+        m = rs.metrics or {}
+        metrics = {
+            "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
+            "rows": rs.num_rows,
+            **{k: m[k] for k in ("path", "scan_ms", "rows_scanned") if k in m},
+        }
+        trace = req.get("trace") or {}
+        with self.conn.remote_spans_lock:
+            self.conn.remote_spans.append(
+                {
+                    "request_id": trace.get("request_id"),
+                    "table": name,
+                    "op": "execute_plan",
+                    "at": time.time(),
+                    **metrics,
+                }
+            )
+        return {
+            "ipc": result_to_ipc(rs.names, rs.columns, rs.nulls),
             "metrics": metrics,
         }
 

@@ -1,0 +1,17 @@
+-- Distributed plan shipping over a partitioned table: window / top-k /
+-- distinct / full-agg / residual-filter shapes execute per partition
+-- owner and combine at the coordinator (ref: dist_sql_query resolver
+-- execute_physical_plan; the 2-node proof lives in test_remote_engine)
+CREATE TABLE dsp (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts))
+PARTITION BY KEY(host) PARTITIONS 4 ENGINE=Analytic;
+INSERT INTO dsp (host, v, ts) VALUES
+  ('a', 5.0, 1000), ('a', 3.0, 2000), ('a', 9.0, 3000),
+  ('b', 2.0, 1000), ('b', 8.0, 2000),
+  ('c', 7.0, 1000), ('c', 1.0, 2000), ('c', 4.0, 3000);
+EXPLAIN SELECT host, ts, row_number() OVER (PARTITION BY host ORDER BY ts) AS rn FROM dsp;
+SELECT host, ts, row_number() OVER (PARTITION BY host ORDER BY ts) AS rn FROM dsp ORDER BY host, ts;
+SELECT host, v FROM dsp ORDER BY v DESC LIMIT 3;
+SELECT DISTINCT host FROM dsp ORDER BY host;
+SELECT host, count(v) FILTER (WHERE v > 4) AS big FROM dsp GROUP BY host ORDER BY host;
+SELECT host, v FROM dsp WHERE v * 2 > 13 ORDER BY host, v;
+DROP TABLE dsp;

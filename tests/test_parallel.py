@@ -172,3 +172,46 @@ class TestServingPathMesh:
         # (inject 0.0) before it ever showed in counts/sums.
         np.testing.assert_allclose(single.mins, dist.mins)
         np.testing.assert_allclose(single.maxs, dist.maxs)
+
+
+class TestDistMergeDedup:
+    """Merge-dedup under shard_map: tsid-range chunks mapped to devices,
+    zero collectives, output in global key order (dryrun leg 5)."""
+
+    def test_matches_host_oracle(self, mesh):
+        from horaedb_tpu.parallel import dist_merge_dedup
+
+        rng = np.random.default_rng(5)
+        n = 5000
+        tsid = rng.integers(0, 2**63, 80, dtype=np.uint64)[
+            rng.integers(0, 80, n)
+        ]
+        ts = rng.integers(0, 500, n).astype(np.int64)
+        seq = rng.integers(1, 7, n).astype(np.uint64)
+        sel = dist_merge_dedup(mesh, tsid, ts, seq)
+        # survivor set: one row per key, newest sequence wins
+        expect: dict = {}
+        for i in range(n):
+            k = (int(tsid[i]), int(ts[i]))
+            # same-seq ties: LAST input row wins (matches the single-chip
+            # kernel's reversal + stable-sort contract)
+            if k not in expect or int(seq[i]) >= int(seq[expect[k]]):
+                expect[k] = i
+        got = {(int(tsid[i]), int(ts[i])): i for i in sel}
+        assert set(got) == set(expect)
+        for k, i in got.items():
+            assert int(seq[i]) == int(seq[expect[k]]), k
+        merged = [(int(tsid[i]), int(ts[i])) for i in sel]
+        assert merged == sorted(merged)
+
+    def test_no_dedup_keeps_all_rows(self, mesh):
+        from horaedb_tpu.parallel import dist_merge_dedup
+
+        rng = np.random.default_rng(6)
+        n = 1000
+        tsid = rng.integers(0, 2**40, n).astype(np.uint64)
+        ts = rng.integers(0, 100, n).astype(np.int64)
+        seq = np.ones(n, dtype=np.uint64)
+        sel = dist_merge_dedup(mesh, tsid, ts, seq, dedup=False)
+        assert len(sel) == n
+        assert np.array_equal(np.sort(sel), np.arange(n))

@@ -101,6 +101,84 @@ class TestScanCache:
         assert db.interpreters.executor.last_path == "device-cached"
 
 
+class TestByteBudget:
+    """VERDICT r4 item 6: the cache is bounded by BYTES (ref:
+    mem_cache.rs:64-158), oversized host copies drop, and a single
+    giant table never builds."""
+
+    def test_dropped_host_rows_still_serve_device_path(self, db):
+        seed(db, n=300)
+        ex = db.interpreters.executor
+        ex.scan_cache.max_host_rows_bytes = 1  # force the drop policy
+        sql = (
+            "SELECT host, count(*) AS c, avg(v) AS a FROM t "
+            "WHERE host = 'h1' GROUP BY host"
+        )
+        out = warm(db, sql)
+        assert ex.last_path == "device-cached"
+        entry = ex.scan_cache._entries["t"]
+        assert entry.rows is None, "host rows copy not dropped"
+        # steady-state hits keep serving (tag filter via series_rows,
+        # selective time gather via ts_rel_host)
+        out = db.execute(sql)
+        assert ex.last_path == "device-cached"
+        row = out.to_pylist()[0]
+        assert row["c"] == 60 and abs(row["a"] - np.mean(
+            [float(i) for i in range(300) if i % 5 == 1]
+        )) < 1e-9
+
+    def test_new_value_column_rereads_after_drop(self, db):
+        seed(db, n=300)
+        ex = db.interpreters.executor
+        ex.scan_cache.max_host_rows_bytes = 1
+        warm(db, "SELECT host, count(v) AS c FROM t GROUP BY host")
+        entry = ex.scan_cache._entries["t"]
+        assert entry.rows is None
+        # a NEW value column forces the re-read path; result exact
+        out = db.execute("SELECT host, sum(v) AS s FROM t GROUP BY host")
+        assert ex.last_path in ("device-cached", "device", "host")
+        got = {r["host"]: r["s"] for r in out.to_pylist()}
+        for h in range(5):
+            assert abs(
+                got[f"h{h}"] - sum(float(i) for i in range(300) if i % 5 == h)
+            ) < 1e-9
+
+    def test_byte_budget_evicts_lru(self, db):
+        ex = db.interpreters.executor
+        for name in ("ta", "tb"):
+            db.execute(
+                f"CREATE TABLE {name} (host string TAG, v double, "
+                "ts timestamp KEY) WITH (segment_duration='1h')"
+            )
+            vals = ", ".join(
+                f"('h{i % 3}', {float(i)}, {1_700_000_000_000 + i * 1000})"
+                for i in range(200)
+            )
+            db.execute(f"INSERT INTO {name} (host, v, ts) VALUES {vals}")
+        db.flush_all()
+        warm(db, "SELECT host, count(*) AS c FROM ta GROUP BY host")
+        assert "ta" in ex.scan_cache._entries
+        a_bytes = ex.scan_cache._entries["ta"].total_bytes()
+        assert a_bytes > 0
+        # budget admits only one entry: building tb evicts ta (LRU)
+        ex.scan_cache.max_bytes = int(a_bytes * 1.5)
+        warm(db, "SELECT host, count(*) AS c FROM tb GROUP BY host")
+        assert "tb" in ex.scan_cache._entries
+        assert "ta" not in ex.scan_cache._entries, "LRU eviction by bytes"
+
+    def test_giant_single_table_never_builds(self, db):
+        seed(db, n=300)
+        ex = db.interpreters.executor
+        ex.scan_cache.max_bytes = 1024  # smaller than any real entry
+        sql = "SELECT host, count(*) AS c FROM t GROUP BY host"
+        out = warm(db, sql)
+        assert ex.last_path != "device-cached"
+        assert "t" not in ex.scan_cache._entries
+        assert {r["host"]: r["c"] for r in out.to_pylist()} == {
+            f"h{i}": 60 for i in range(5)
+        }
+
+
 class TestShardedCache:
     """The cached serving path itself shards over the mesh (round 2):
     entry arrays live split across devices, the shard_map cached kernel
