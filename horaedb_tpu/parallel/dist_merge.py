@@ -59,8 +59,8 @@ def dist_merge_dedup(
 
     # Same packed rest word (and span measurement) as the single-chip
     # fused kernel — ONE implementation; global spans so every device
-    # shares one mask. Wide spans fall back to the host merge (the
-    # dryrun's shapes always fit).
+    # shares one mask. Wide spans RAISE: callers must pre-chunk by time
+    # (a segment-scoped merge always fits).
     kind, packed = _pack_rest(ts64, seq64)
     if kind != "f32":
         raise ValueError(

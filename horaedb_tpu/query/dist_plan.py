@@ -103,8 +103,6 @@ def dist_plan_mode(executor, plan: QueryPlan, table) -> Optional[str]:
         return "distinct"
 
     if stmt.order_by and stmt.limit is not None:
-        if any(isinstance(e, ast.Star) for e in (i.expr for i in stmt.items)):
-            pass  # outputs are schema columns; order still resolvable
         if not _order_resolvable(stmt, plan):
             return None
         return "topk"

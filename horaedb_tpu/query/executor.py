@@ -447,7 +447,20 @@ class Executor:
                 route = self.path_router.choose(key)
                 m["_adaptive_key"] = key
                 m["route"] = route
-        if plan.is_aggregate and cache_on and route != "host":
+        # Memory bound: when pruned SST metadata says the scan would
+        # materialize more than HORAEDB_AGG_MEMORY_MB, aggregate per
+        # segment window through the partial machinery instead — checked
+        # BEFORE the cache path, whose build would materialize the whole
+        # table (ref: instance/read.rs:165-190 streaming reads).
+        bounded = False
+        if plan.is_aggregate and route != "host" and table.physical_datas():
+            from .partial import _agg_memory_cap_bytes, _scan_estimate_bytes
+
+            cap = _agg_memory_cap_bytes()
+            bounded = bool(cap) and _scan_estimate_bytes(
+                table, plan.predicate, self._projection(plan)
+            ) > cap
+        if plan.is_aggregate and cache_on and route != "host" and not bounded:
             cached = self._try_cached_agg(plan, table, m)
             if cached is not None:
                 path = "device-cached"
@@ -456,6 +469,13 @@ class Executor:
         # (local kernel per partition; remote partitions over the wire —
         # ref: dist_sql_query resolver push-down) and combine partials.
         if plan.is_aggregate and hasattr(table, "sub_tables") and route != "host":
+            out = self._try_partitioned_agg(plan, table, m)
+            if out is not None:
+                return self._finish_metrics(m, t_start, "device-partial", out)
+        # Bounded plain-table aggregate: same partial machinery the
+        # partitioned scatter uses (Table.partial_agg -> compute_partial,
+        # which iterates per-window pieces under the cap).
+        if bounded and not hasattr(table, "sub_tables"):
             out = self._try_partitioned_agg(plan, table, m)
             if out is not None:
                 return self._finish_metrics(m, t_start, "device-partial", out)
@@ -608,8 +628,12 @@ class Executor:
             m["request_id"] = rid
         names, arrays, stage_metrics = table.partial_agg(spec)
         combined, n_groups = combine_partials([(names, arrays)], spec)
-        keep = table.rule.prune(plan.predicate)
-        m["partitions"] = len(keep) if keep is not None else len(table.sub_tables)
+        rule = getattr(table, "rule", None)  # plain tables: bounded path
+        if rule is not None:
+            keep = rule.prune(plan.predicate)
+            m["partitions"] = (
+                len(keep) if keep is not None else len(table.sub_tables)
+            )
         m["partial_stages"] = stage_metrics
         return assemble_result(plan, combined, n_groups, spec)
 

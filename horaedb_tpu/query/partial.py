@@ -97,13 +97,63 @@ def compute_partial(
             + group_tags + agg_cols + filter_cols + exact_cols
         )
     )
+    # Memory bound (ref: instance/read.rs:165-190 — the reference streams
+    # N record-batch streams instead of one array): when the pruned file
+    # metadata says the scan would materialize more than the cap, iterate
+    # per-segment-window pieces and CONCATENATE their partial batches —
+    # the caller's single monoid combine treats windows exactly like
+    # extra partitions, and the whole table never sits in host memory.
+    cap_bytes = _agg_memory_cap_bytes()
+    if cap_bytes and _scan_estimate_bytes(table, pred, projection) > cap_bytes:
+        all_names: list[str] | None = None
+        parts: list[list[np.ndarray]] = []
+        windows = 0
+        t_scan = _time.perf_counter()
+        rows_seen = 0
+        for rows in table.read_windows(pred, projection=projection):
+            windows += 1
+            rows_seen += len(rows)
+            names, arrays = _partial_on_rows(rows, spec)
+            if arrays and len(arrays[0]):
+                all_names = names
+                parts.append(arrays)
+        if m is not None:
+            m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
+            m["rows_scanned"] = rows_seen
+            m["bounded_windows"] = windows
+            m["path"] = "kernel-windowed"
+        if all_names is None:
+            return _partial_on_rows(
+                _empty_projected(table, projection), spec
+            )
+        return all_names, [
+            np.concatenate([p[i] for p in parts])
+            for i in range(len(all_names))
+        ]
+
     t_scan = _time.perf_counter()
     rows = table.read(pred, projection=projection)
-    n = len(rows)
     if m is not None:
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
-        m["rows_scanned"] = n
+        m["rows_scanned"] = len(rows)
 
+    t_agg = _time.perf_counter()
+    out = _partial_on_rows(rows, spec, m)
+    if m is not None:
+        m["agg_ms"] = round((_time.perf_counter() - t_agg) * 1000, 3)
+    return out
+
+
+def _partial_on_rows(
+    rows: RowGroup, spec: dict, m: Optional[dict] = None
+) -> tuple[list[str], list[np.ndarray]]:
+    """The partial aggregate over an already-materialized row set — the
+    shared core of the whole-table and per-window (memory-bounded)
+    paths. Bucket origins are absolute-aligned (floor to bucket_ms), so
+    batches from different windows combine on equal "__bucket" values."""
+    agg_cols = list(spec["agg_cols"])
+    bucket_ms = int(spec["bucket_ms"])
+    n = len(rows)
     mask = np.ones(n, dtype=bool)
     for c, op, v in spec["exact_filters"]:
         col = rows.columns[c]
@@ -125,16 +175,50 @@ def compute_partial(
         t0 = int((int(ts.min()) // bucket_ms) * bucket_ms) if n else 0
     else:
         t0 = 0
-
-    t_agg = _time.perf_counter()
-    if all_valid:
-        out = _partial_kernel(rows, mask, spec, t0)
-    else:
-        out = _partial_host(rows, mask, spec, t0)
     if m is not None:
         m["path"] = "kernel" if all_valid else "host"
-        m["agg_ms"] = round((_time.perf_counter() - t_agg) * 1000, 3)
-    return out
+    if all_valid:
+        return _partial_kernel(rows, mask, spec, t0)
+    return _partial_host(rows, mask, spec, t0)
+
+
+def _agg_memory_cap_bytes() -> int:
+    """HORAEDB_AGG_MEMORY_MB: cap on the host working set one aggregate
+    scan may materialize (0 disables bounding; fractions allowed)."""
+    import os
+
+    return int(float(os.environ.get("HORAEDB_AGG_MEMORY_MB", "1024")) * (1 << 20))
+
+
+def _scan_estimate_bytes(table, pred, projection) -> int:
+    """Pre-read size estimate from pruned SST metadata + memtable bytes
+    — no data touched."""
+    tr = pred.time_range
+    total_rows = 0
+    mem_bytes = 0
+    n_cols = (
+        len(projection)
+        if projection is not None
+        else len(table.schema.columns)
+    )
+    for data in table.physical_datas():
+        for h in data.version.levels.all_files():
+            ftr = h.meta.time_range
+            if ftr.inclusive_start < tr.exclusive_end and tr.inclusive_start < ftr.exclusive_end:
+                total_rows += h.meta.num_rows
+        for mem in [*data.version.immutables(), data.version.mutable]:
+            mem_bytes += mem.approx_bytes  # property on both kinds
+    return total_rows * 8 * n_cols + mem_bytes
+
+
+def _empty_projected(table, projection) -> RowGroup:
+    from ..common_types.schema import project_schema
+
+    schema = project_schema(table.schema, projection)
+    return RowGroup(
+        schema,
+        {c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in schema.columns},
+    )
 
 
 def _partial_kernel(rows, mask, spec, t0) -> tuple[list[str], list[np.ndarray]]:

@@ -203,6 +203,21 @@ class ScanCache:
         with self._lock:
             return sum(e.total_bytes() for e in self._entries.values())
 
+    def _evict_over_budget_locked(self, keep: str) -> None:
+        """Evict least-recently-used entries (never ``keep``) until the
+        byte budget holds — the insert path AND the hit path (whose
+        _extend uploads grow entries) both call this."""
+        while len(self._entries) > 1 and (
+            sum(e.total_bytes() for e in self._entries.values())
+            > self.max_bytes
+        ):
+            victim = next(
+                (k for k in self._entries if k != keep), None
+            )
+            if victim is None:
+                return
+            self._entries.pop(victim)
+
     def get(
         self,
         table,
@@ -257,6 +272,9 @@ class ScanCache:
                     e = self._entries.pop(table.name, None)
                     if e is not None:
                         self._entries[table.name] = e
+                    # _extend above may have grown this entry's device
+                    # bytes — the budget holds on the hit path too.
+                    self._evict_over_budget_locked(keep=table.name)
                     return entry, False, delta
                 # A flush raced the delta read (or the delta predates the
                 # entry inconsistently): serve nothing from cache.
@@ -301,20 +319,28 @@ class ScanCache:
         empty = entry.empty_rows
         return entry, True, empty
 
+    @staticmethod
+    def _resident_layout(rows: RowGroup):
+        """THE resident layout: rows sorted by (series, ts). One
+        definition — _build derives it and _extend's re-read (after a
+        host-rows drop) must reproduce it bit-for-bit.
+
+        Selective queries (a handful of series out of thousands — the
+        TSBS single-groupby shape) become contiguous-range gathers
+        instead of full scans because of this sort."""
+        schema = rows.schema
+        tsid = rows.columns[schema.columns[schema.tsid_index].name]
+        uniq, _, inverse = np.unique(tsid, return_index=True, return_inverse=True)
+        order = np.lexsort((rows.timestamps, inverse))
+        return rows.take(order), uniq, inverse[order]
+
     def _build(
         self, fp, rows: RowGroup, min_ts: int, max_ts: int, value_columns: list[str]
     ) -> CachedTableScan:
         n = len(rows)
         schema = rows.schema
-        tsid = rows.columns[schema.columns[schema.tsid_index].name]
-        uniq, _, inverse = np.unique(tsid, return_index=True, return_inverse=True)
+        rows, uniq, inverse = self._resident_layout(rows)
         n_series = len(uniq)
-        # SORT the resident layout by (series, ts): selective queries (a
-        # handful of series out of thousands — the TSBS single-groupby
-        # shape) become contiguous-range gathers instead of full scans.
-        order = np.lexsort((rows.timestamps, inverse))
-        rows = rows.take(order)
-        inverse = inverse[order]
         counts = np.bincount(inverse, minlength=n_series)
         offsets = np.zeros(n_series + 1, dtype=np.int64)
         np.cumsum(counts, out=offsets[1:])
@@ -426,19 +452,14 @@ class ScanCache:
 
             if entry.built_seqs is None or _seqs() != entry.built_seqs:
                 return False
-            # Re-derive the EXACT resident layout (same sort: (series,
-            # ts) via the same unique+lexsort) — deterministic for an
-            # unchanged base state.
+            # Re-derive the EXACT resident layout (the ONE definition in
+            # _resident_layout) — deterministic for an unchanged base.
             rows = read_rows()
             if _seqs() != entry.built_seqs:
                 return False  # a write raced the re-read
             if len(rows) != entry.n_valid:
                 return False
-            schema = rows.schema
-            tsid = rows.columns[schema.columns[schema.tsid_index].name]
-            _, _, inverse = np.unique(tsid, return_index=True, return_inverse=True)
-            order = np.lexsort((rows.timestamps, inverse))
-            rows = rows.take(order)
+            rows, _, _ = self._resident_layout(rows)
             if not np.array_equal(
                 (rows.timestamps - entry.min_ts).astype(np.int32),
                 entry.ts_rel_host,

@@ -67,6 +67,16 @@ class Table(ABC):
     def metrics(self) -> dict:
         return {"table": self.name}
 
+    def read_windows(self, predicate=None, projection=None):
+        """Yield the scan as BOUNDED per-segment-window row sets (the
+        memory-capped aggregate path consumes these one at a time and
+        combines AggStates instead of materializing the whole table; ref:
+        instance/read.rs:165-190 returns N streams, not one array).
+        Correct per window because the primary key includes the
+        timestamp: duplicates of a key can never straddle segment
+        windows. Default: one piece (non-engine tables are small)."""
+        yield self.read(predicate, projection)
+
     def partial_agg(self, spec: dict):
         """Pushed-down partial aggregate over this table's OWN data
         (ref: dist_sql_query partial agg below the scan). Runs wherever
@@ -117,6 +127,53 @@ class AnalyticTable(Table):
 
     def read(self, predicate=None, projection=None) -> RowGroup:
         return self.instance.read(self.data, predicate, projection=projection)
+
+    def read_windows(self, predicate=None, projection=None):
+        """Per-segment-window reads: enumerate the aligned windows the
+        (time-pruned) file set and memtables cover, then run the normal
+        merge read per window — each piece is a complete, deduplicated
+        answer for its time slice, bounded by the window's data size."""
+        from ..common_types.time_range import TimeRange
+        from ..table_engine.predicate import Predicate as P
+
+        predicate = predicate or P.all_time()
+        seg_ms = self.data.options.segment_duration_ms
+        tr = predicate.time_range
+        if not seg_ms:
+            yield self.read(predicate, projection)
+            return
+        starts: set[int] = set()
+        spans: list[tuple[int, int]] = []
+        for h in self.data.version.levels.all_files():
+            ftr = h.meta.time_range
+            spans.append((ftr.inclusive_start, ftr.exclusive_end))
+        for mem in [*self.data.version.immutables(), self.data.version.mutable]:
+            if not mem.is_empty():
+                mtr = mem.time_range()
+                spans.append((mtr.inclusive_start, mtr.exclusive_end))
+        for lo, hi in spans:
+            lo = max(lo, tr.inclusive_start)
+            hi = min(hi, tr.exclusive_end)
+            if hi <= lo:
+                continue
+            w = (lo // seg_ms) * seg_ms
+            while w < hi:
+                starts.add(w)
+                w += seg_ms
+        if not starts:
+            yield self.read(predicate, projection)
+            return
+        for w in sorted(starts):
+            w_pred = P(
+                TimeRange(
+                    max(w, tr.inclusive_start),
+                    min(w + seg_ms, tr.exclusive_end),
+                ),
+                predicate.filters,
+            )
+            rows = self.read(w_pred, projection)
+            if len(rows):
+                yield rows
 
     def flush(self) -> None:
         self.instance.flush_table(self.data)

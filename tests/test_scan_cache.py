@@ -426,3 +426,87 @@ class TestLayeredDelta:
             {"host": "h0", "c": 6, "s": 0 + 2 + 4 + 6 + 8 + 10.0},
             {"host": "h1", "c": 6, "s": 1 + 3 + 5 + 7 + 9 + 11.0},
         ]
+
+
+class TestBoundedAggregateScan:
+    """VERDICT r4 item 6 (second half): a GROUP BY over more data than
+    HORAEDB_AGG_MEMORY_MB completes by aggregating per segment window —
+    the whole table is never materialized in one piece (ref:
+    instance/read.rs:165-190 streaming reads)."""
+
+    def _seed_windows(self, db, hours=4, per_hour=120):
+        db.execute(
+            "CREATE TABLE bw (host string TAG, v double, ts timestamp KEY) "
+            "WITH (segment_duration='1h')"
+        )
+        t0 = 1_700_000_000_000
+        hour = 3_600_000
+        for h in range(hours):
+            vals = ", ".join(
+                f"('h{i % 3}', {float(h * per_hour + i)}, "
+                f"{t0 + h * hour + i * 1000})"
+                for i in range(per_hour)
+            )
+            db.execute(f"INSERT INTO bw (host, v, ts) VALUES {vals}")
+            db.flush_all()
+        return t0, hours, per_hour
+
+    def test_windowed_partials_match_oracle(self, db, monkeypatch):
+        monkeypatch.setenv("HORAEDB_AGG_MEMORY_MB", "0.005")  # tiny cap
+        t0, hours, per_hour = self._seed_windows(db)
+        n = hours * per_hour
+
+        # Spy: no single engine read may return the full row count.
+        from horaedb_tpu.engine.instance import Instance
+
+        read_sizes = []
+        orig = Instance.read
+
+        def spy(self, table, predicate=None, projection=None):
+            out = orig(self, table, predicate, projection=projection)
+            read_sizes.append(len(out))
+            return out
+
+        monkeypatch.setattr(Instance, "read", spy)
+        out = db.execute(
+            "SELECT host, count(v) AS c, sum(v) AS s, min(v) AS lo, "
+            "max(v) AS hi, avg(v) AS a FROM bw GROUP BY host"
+        )
+        ex = db.interpreters.executor
+        assert ex.last_metrics.get("path") == "device-partial", ex.last_metrics
+        stages = ex.last_metrics.get("partial_stages") or []
+        assert stages and stages[0].get("bounded_windows", 0) >= 4, stages
+        assert read_sizes and max(read_sizes) < n, read_sizes
+        got = {r["host"]: r for r in out.to_pylist()}
+        for h in range(3):
+            vals = [
+                float(hh * 120 + i)
+                for hh in range(4)
+                for i in range(120)
+                if i % 3 == h
+            ]
+            assert got[f"h{h}"]["c"] == len(vals)
+            assert abs(got[f"h{h}"]["s"] - sum(vals)) < 1e-6
+            assert got[f"h{h}"]["lo"] == min(vals)
+            assert got[f"h{h}"]["hi"] == max(vals)
+            assert abs(got[f"h{h}"]["a"] - np.mean(vals)) < 1e-9
+
+    def test_time_bucket_groups_align_across_windows(self, db, monkeypatch):
+        monkeypatch.setenv("HORAEDB_AGG_MEMORY_MB", "0.005")
+        t0, hours, per_hour = self._seed_windows(db)
+        out = db.execute(
+            "SELECT time_bucket(ts, '2h') AS b, count(v) AS c FROM bw "
+            "GROUP BY b ORDER BY b"
+        )
+        rows = out.to_pylist()
+        # 4 one-hour windows -> 2 two-hour buckets, each combining TWO
+        # windows' partials on equal absolute bucket starts
+        assert [r["c"] for r in rows] == [240, 240], rows
+
+    def test_cap_disabled_keeps_single_scan(self, db, monkeypatch):
+        monkeypatch.setenv("HORAEDB_AGG_MEMORY_MB", "0")
+        self._seed_windows(db, hours=2)
+        out = db.execute("SELECT host, count(v) AS c FROM bw GROUP BY host")
+        ex = db.interpreters.executor
+        assert "bounded_windows" not in str(ex.last_metrics)
+        assert sum(r["c"] for r in out.to_pylist()) == 240
