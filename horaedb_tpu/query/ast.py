@@ -183,6 +183,19 @@ class CorrelatedLookup(Expr):
 
 
 @dataclass(frozen=True)
+class Exists(Expr):
+    """[NOT handled by UnaryOp] EXISTS (SELECT ...) — a boolean semi-join
+    probe. Uncorrelated: materializes to a constant. Equality-correlated:
+    decorrelates into a distinct-key inner query + per-row membership
+    lookup (the semi-join analog of the scalar decorrelation)."""
+
+    select: "Select"
+
+    def __str__(self) -> str:
+        return f"EXISTS(subquery:{self.select.table})"
+
+
+@dataclass(frozen=True)
 class InSubquery(Expr):
     """expr [NOT] IN (SELECT col FROM ...) — uncorrelated; materialized
     into an InList before the outer query runs."""
@@ -259,16 +272,19 @@ class OrderItem:
 
 @dataclass(frozen=True)
 class Join:
-    """Equi-key join: [LEFT] JOIN <table> ON <l.k1> = <r.k1> [AND ...].
+    """Equi-key join: [LEFT|RIGHT|FULL [OUTER]] JOIN <table> ON
+    <l.k1> = <r.k1> [AND ...].
 
     ``left_cols[i]`` pairs with ``right_cols[i]`` (conjunction of
     equalities; the reference gets arbitrary join conditions from
-    DataFusion — this is the host-path equi-join subset)."""
+    DataFusion — this is the host-path equi-join subset). In a chain,
+    ``left_cols`` may name columns from ANY earlier table (the combined
+    row so far — standard left-to-right join evaluation)."""
 
     table: str
     left_cols: tuple[str, ...]
     right_cols: tuple[str, ...]
-    kind: str = "inner"  # "inner" | "left"
+    kind: str = "inner"  # "inner" | "left" | "right" | "full"
 
 
 @dataclass(frozen=True)
@@ -283,6 +299,10 @@ class Select:
     having: Optional[Expr] = None
     distinct: bool = False
     join: Optional[Join] = None
+    # Joins AFTER the first (>2-table chains, folded left-to-right);
+    # ``join`` stays the first so every `stmt.join is not None` presence
+    # check keeps working.
+    joins: tuple[Join, ...] = ()
     # WITH name AS (...) bindings visible to this select (and, through
     # the interpreter's overlay, to later ctes in the same statement)
     ctes: tuple[tuple[str, "Select | UnionSelect"], ...] = ()

@@ -57,7 +57,10 @@ def dist_plan_mode(executor, plan: QueryPlan, table) -> Optional[str]:
 
     for src in _expr_sources(stmt):
         for e in _walk(src):
-            if isinstance(e, (ast.Subquery, ast.InSubquery, ast.CorrelatedLookup)):
+            if isinstance(
+                e,
+                (ast.Subquery, ast.InSubquery, ast.Exists, ast.CorrelatedLookup),
+            ):
                 return None
 
     windows = [

@@ -474,9 +474,12 @@ class Executor:
                 return self._finish_metrics(m, t_start, "device-partial", out)
         # Bounded plain-table aggregate: same partial machinery the
         # partitioned scatter uses (Table.partial_agg -> compute_partial,
-        # which iterates per-window pieces under the cap).
+        # which iterates per-window pieces under the cap). The hint rides
+        # in the spec so compute_partial neither re-walks the metadata
+        # nor can disagree near the cap boundary; partitioned scatters
+        # never set it — each owner estimates its OWN data.
         if bounded and not hasattr(table, "sub_tables"):
-            out = self._try_partitioned_agg(plan, table, m)
+            out = self._try_partitioned_agg(plan, table, m, bounded_hint=True)
             if out is not None:
                 return self._finish_metrics(m, t_start, "device-partial", out)
         # Plan-subtree shipping: window/topk/distinct/full-agg/filter
@@ -614,12 +617,16 @@ class Executor:
             return False  # window frames need the complete row set
         return self._residual_where(plan) is None
 
-    def _try_partitioned_agg(self, plan: QueryPlan, table, m: dict) -> Optional[ResultSet]:
+    def _try_partitioned_agg(
+        self, plan: QueryPlan, table, m: dict, bounded_hint: bool = False
+    ) -> Optional[ResultSet]:
         from .partial import assemble_result, combine_partials, spec_from_plan
 
         spec = spec_from_plan(self, plan)
         if spec is None:
             return None  # shape not pushable: gather-rows fallback below
+        if bounded_hint:
+            spec["bounded_hint"] = True
         from ..utils.tracectx import get_request_id
 
         rid = get_request_id()

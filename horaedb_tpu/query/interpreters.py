@@ -55,11 +55,13 @@ def _flatten_and(e: ast.Expr) -> list:
 
 
 def _inner_tables_of(select: ast.Select) -> set:
+    # NOTE: keep in sync with the scope computation in
+    # _materialize_subqueries — both must cover the full join chain.
     return {
         t
         for t in (select.table, select.join.table if select.join else None)
         if t
-    }
+    } | {j.table for j in select.joins}
 
 
 def _correlated_cols(exprs, scope, inner_tables) -> list:
@@ -322,7 +324,7 @@ class InterpreterFactory:
         stmt = plan.select
         sources = self._expr_sources(stmt)
         if not any(
-            isinstance(e, (ast.InSubquery, ast.Subquery))
+            isinstance(e, (ast.InSubquery, ast.Subquery, ast.Exists))
             for src in sources
             for e in _walk_all(src)
         ):
@@ -335,13 +337,13 @@ class InterpreterFactory:
         # subqueries still get the clear correlation error
         scope = set(outer_scope) | {
             t for t in (stmt.table, stmt.join.table if stmt.join else None) if t
-        }
+        } | {j.table for j in stmt.joins}
 
         def run_inner(select: ast.Select) -> list:
             # A qualifier naming an OUTER-scope table means the subquery
             # is correlated — say so directly instead of letting the inner
             # planner report a baffling "unknown qualifier".
-            inner_tables = {t for t in (select.table, select.join.table if select.join else None) if t}
+            inner_tables = _inner_tables_of(select)
             for src in self._expr_sources(select):
                 for e in _walk_all(src):
                     if (
@@ -380,6 +382,30 @@ class InterpreterFactory:
                 return ast.InList(
                     subst(e.expr), tuple(ast.Literal(v) for v in vals), e.negated
                 )
+            if isinstance(e, ast.Exists):
+                if _has_correlated_refs(e.select, scope):
+                    # Equality-correlated semi-join: decorrelate into a
+                    # distinct-key inner query + boolean membership lookup.
+                    return self._decorrelate_exists(e.select, scope, planner)
+                # Uncorrelated: EXISTS is a constant — one row after the
+                # subquery's own LIMIT/OFFSET decides it (LIMIT 0 stays
+                # empty; OFFSET is honored by the probe).
+                import dataclasses as _dc
+
+                probe = _dc.replace(
+                    e.select,
+                    limit=1 if e.select.limit is None else min(e.select.limit, 1),
+                )
+                inner_plan = planner.plan(probe)
+                nested = self._materialize_subqueries(
+                    inner_plan, outer_scope=scope
+                )
+                inner = self.execute(
+                    nested if nested is not None else inner_plan
+                )
+                if not isinstance(inner, ResultSet):
+                    raise InterpreterError("EXISTS subquery must be a SELECT")
+                return ast.Literal(inner.num_rows > 0)
             if isinstance(e, ast.Subquery):
                 if _has_correlated_refs(e.select, scope):
                     # Equality-correlated scalar aggregate: decorrelate
@@ -577,6 +603,125 @@ class InterpreterFactory:
             keys=tuple(keys),
             values=tuple(values),
             default=0 if is_count else None,
+        )
+
+    def _decorrelate_exists(
+        self, select: ast.Select, scope, planner
+    ) -> ast.CorrelatedLookup:
+        """Rewrite an equality-correlated EXISTS (the semi-join analog of
+        _decorrelate_scalar): ``EXISTS (SELECT ... WHERE inner.k =
+        outer.k [AND uncorrelated...])`` runs ONE distinct-key inner
+        query and substitutes a per-outer-row boolean membership lookup
+        (present -> True; missing or NULL outer key -> False, which NOT
+        then flips for anti-join semantics)."""
+        import dataclasses
+
+        inner_tables = _inner_tables_of(select)
+
+        def unsupported(why: str):
+            return InterpreterError(
+                f"correlated EXISTS not supported: {why} (only ANDed "
+                "`inner_col = outer.col` correlation in the WHERE is "
+                "decorrelated)"
+            )
+
+        if (
+            select.group_by
+            or select.having is not None
+            or select.join is not None
+            or select.offset
+        ):
+            raise unsupported("GROUP BY/HAVING/JOIN/OFFSET in the subquery")
+        if select.limit is not None and select.limit <= 0:
+            return ast.CorrelatedLookup(
+                outer_cols=(), keys=(), values=(), default=False
+            )
+        from .planner import _is_agg_name, _walk
+
+        if any(
+            isinstance(x, ast.FuncCall) and _is_agg_name(x.name)
+            for item in select.items
+            for x in _walk(item.expr)
+        ):
+            # An ungrouped aggregate subquery yields EXACTLY one row for
+            # every outer row (NULL aggregate over the empty group
+            # included) — EXISTS is unconditionally TRUE.
+            return ast.Literal(True)
+        # The select items are irrelevant to EXISTS; only the WHERE's
+        # correlation matters (outer refs anywhere else are unsupported).
+        if _correlated_cols(
+            [i.expr for i in select.items] + [o.expr for o in select.order_by],
+            scope,
+            inner_tables,
+        ):
+            raise unsupported("outer reference outside the WHERE clause")
+
+        pairs: list[tuple[str, ast.Column]] = []  # (inner col, outer Column)
+        residual: list[ast.Expr] = []
+        for conj in _flatten_and(select.where) if select.where is not None else []:
+            corr = _correlated_cols([conj], scope, inner_tables)
+            if not corr:
+                residual.append(conj)
+                continue
+            ok = (
+                isinstance(conj, ast.BinaryOp)
+                and conj.op == "="
+                and isinstance(conj.left, ast.Column)
+                and isinstance(conj.right, ast.Column)
+            )
+            if not ok:
+                raise unsupported(f"non-equality outer reference: {conj}")
+            sides = {True: None, False: None}
+            for col in (conj.left, conj.right):
+                is_outer = bool(
+                    col.qualifier
+                    and col.qualifier in scope
+                    and col.qualifier not in inner_tables
+                )
+                sides[is_outer] = col
+            if sides[True] is None or sides[False] is None:
+                raise unsupported(f"both sides of {conj} bind to one scope")
+            pairs.append((sides[False].name, sides[True]))
+        if not pairs:
+            raise unsupported("no equality correlation found")
+
+        where = None
+        for conj in residual:
+            where = conj if where is None else ast.BinaryOp("AND", where, conj)
+        inner_plan = planner.plan(
+            dataclasses.replace(
+                select,
+                items=tuple(
+                    ast.SelectItem(ast.Column(c), alias=f"__ek{i}")
+                    for i, (c, _) in enumerate(pairs)
+                ),
+                where=where,
+                group_by=(),
+                order_by=(),
+                limit=None,
+                distinct=True,  # membership needs each key once
+            )
+        )
+        nested = self._materialize_subqueries(inner_plan, outer_scope=scope)
+        res = self.execute(nested if nested is not None else inner_plan)
+        if not isinstance(res, ResultSet):
+            raise unsupported("subquery must be a SELECT")
+
+        def py(v):
+            return v.item() if isinstance(v, np.generic) else v
+
+        nulls = res.nulls or {}
+        key_nulls = [nulls.get(n) for n in res.names]
+        keys = []
+        for i in range(res.num_rows):
+            if any(kn is not None and kn[i] for kn in key_nulls):
+                continue  # NULL inner key matches no outer row
+            keys.append(tuple(py(col[i]) for col in res.columns))
+        return ast.CorrelatedLookup(
+            outer_cols=tuple(outer for _, outer in pairs),
+            keys=tuple(keys),
+            values=(True,) * len(keys),
+            default=False,
         )
 
     def _insert(self, plan: InsertPlan) -> AffectedRows:

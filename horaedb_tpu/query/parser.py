@@ -271,18 +271,33 @@ class Parser:
         while self._eat_op(","):
             items.append(self._select_item())
         table = None
-        join = None
+        joins: list[ast.Join] = []
         if self._eat_kw("FROM"):
             table = self._table_name()
-            if self._eat_kw("INNER"):
-                self._expect_kw("JOIN")
-                join = self._join_clause(table)
-            elif self._eat_kw("LEFT"):
-                self._eat_kw("OUTER")
-                self._expect_kw("JOIN")
-                join = self._join_clause(table, kind="left")
-            elif self._eat_kw("JOIN"):
-                join = self._join_clause(table)
+            prev_tables = [table]
+            while True:
+                if self._eat_kw("INNER"):
+                    self._expect_kw("JOIN")
+                    kind = "inner"
+                elif self._eat_kw("LEFT"):
+                    self._eat_kw("OUTER")
+                    self._expect_kw("JOIN")
+                    kind = "left"
+                elif self._eat_kw("RIGHT"):
+                    self._eat_kw("OUTER")
+                    self._expect_kw("JOIN")
+                    kind = "right"
+                elif self._eat_kw("FULL"):
+                    self._eat_kw("OUTER")
+                    self._expect_kw("JOIN")
+                    kind = "full"
+                elif self._eat_kw("JOIN"):
+                    kind = "inner"
+                else:
+                    break
+                j = self._join_clause(prev_tables, kind=kind)
+                joins.append(j)
+                prev_tables.append(j.table)
         where = None
         if self._eat_kw("WHERE"):
             where = self._expr()
@@ -343,33 +358,40 @@ class Parser:
             offset=offset,
             having=having,
             distinct=distinct,
-            join=join,
+            join=joins[0] if joins else None,
+            joins=tuple(joins[1:]),
         )
 
-    def _join_clause(self, left_table: str, kind: str = "inner") -> ast.Join:
-        """JOIN t2 ON a.k1 = b.k1 [AND a.k2 = b.k2 ...] — equi-key
-        inner/left join (the reference gets richer joins from DataFusion;
-        this is the host-path equi-join subset)."""
+    def _join_clause(self, prev_tables: list[str], kind: str = "inner") -> ast.Join:
+        """JOIN t2 ON a.k1 = b.k1 [AND a.k2 = b.k2 ...] — equi-key join
+        (the reference gets richer joins from DataFusion; this is the
+        host-path equi-join subset). In a chain the left side of each
+        equality may reference ANY earlier table."""
         right = self._table_name()
         self._expect_kw("ON")
         left_cols: list[str] = []
         right_cols: list[str] = []
+
         def names_table(tab: Optional[str], full: str) -> bool:
             """ON qualifiers may use the full dotted name or its last
             component (JOIN public.t2 ... ON t1.k = t2.k)."""
             return tab is None or tab == full or tab == full.rsplit(".", 1)[-1]
 
+        def names_any_prev(tab: Optional[str]) -> bool:
+            return any(names_table(tab, p) for p in prev_tables)
+
         while True:
             l_tab, l_col = self._qualified()
             self._expect_op("=")
             r_tab, r_col = self._qualified()
-            # normalize sides: left table's column first
+            # normalize sides: an earlier table's column first
             if (l_tab is not None and names_table(l_tab, right)
-                    and r_tab is not None and names_table(r_tab, left_table)):
+                    and r_tab is not None and names_any_prev(r_tab)):
                 l_col, r_col = r_col, l_col
-            elif not (names_table(l_tab, left_table) and names_table(r_tab, right)):
+            elif not (names_any_prev(l_tab) and names_table(r_tab, right)):
                 raise ParseError(
-                    f"JOIN ON must reference {left_table} and {right}", -1, self.sql
+                    f"JOIN ON must reference an earlier table "
+                    f"({', '.join(prev_tables)}) and {right}", -1, self.sql
                 )
             left_cols.append(l_col)
             right_cols.append(r_col)
@@ -405,7 +427,7 @@ class Parser:
         elif (t := self._peek()) is not None and t.kind in ("name", "qident") and t.text.upper() not in (
             "FROM", "WHERE", "GROUP", "ORDER", "LIMIT", "AS",
             "HAVING", "JOIN", "INNER", "ON", "LEFT", "OUTER",
-            "UNION", "OVER",
+            "RIGHT", "FULL", "UNION", "OVER",
         ):
             alias = self._ident()
         return ast.SelectItem(e, alias)
@@ -699,6 +721,13 @@ class Parser:
                 return ast.Literal(False)
             if upper == "NULL":
                 return ast.Literal(None)
+            if upper == "EXISTS" and self._at_op("("):
+                # EXISTS (SELECT ...): semi-join probe; NOT EXISTS arrives
+                # via _unary's NOT wrapping.
+                self._expect_op("(")
+                inner = self._select()
+                self._expect_op(")")
+                return ast.Exists(inner)
             if upper == "CASE":
                 return self._case()
             if upper == "CAST" and self._at_op("("):

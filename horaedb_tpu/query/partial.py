@@ -104,7 +104,13 @@ def compute_partial(
     # the caller's single monoid combine treats windows exactly like
     # extra partitions, and the whole table never sits in host memory.
     cap_bytes = _agg_memory_cap_bytes()
-    if cap_bytes and _scan_estimate_bytes(table, pred, projection) > cap_bytes:
+    # "bounded_hint": the LOCAL executor already walked this table's
+    # metadata and decided (plain-table path only — partition scatters
+    # leave it unset so each owner estimates its own data).
+    if cap_bytes and (
+        spec.get("bounded_hint")
+        or _scan_estimate_bytes(table, pred, projection) > cap_bytes
+    ):
         all_names: list[str] | None = None
         parts: list[list[np.ndarray]] = []
         windows = 0

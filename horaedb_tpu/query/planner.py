@@ -310,6 +310,8 @@ class Planner:
         known = {stmt.table}
         if stmt.join is not None:
             known.add(stmt.join.table)
+        for j in stmt.joins:
+            known.add(j.table)
         # Qualified table names may be referenced by their last component
         # (FROM public.cpu ... WHERE cpu.usage > 0).
         for full in list(known):

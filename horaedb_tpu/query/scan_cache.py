@@ -204,11 +204,13 @@ class ScanCache:
             return sum(e.total_bytes() for e in self._entries.values())
 
     def _evict_over_budget_locked(self, keep: str) -> None:
-        """Evict least-recently-used entries (never ``keep``) until the
-        byte budget holds — the insert path AND the hit path (whose
-        _extend uploads grow entries) both call this."""
+        """Evict least-recently-used entries (never ``keep``) until both
+        the entry-count and byte budgets hold — the ONE eviction policy;
+        the insert path and the hit path (whose _extend uploads grow
+        entries) both call it."""
         while len(self._entries) > 1 and (
-            sum(e.total_bytes() for e in self._entries.values())
+            len(self._entries) > self.max_entries
+            or sum(e.total_bytes() for e in self._entries.values())
             > self.max_bytes
         ):
             victim = next(
@@ -307,15 +309,8 @@ class ScanCache:
         with self._lock:
             self.misses += 1
             self._entries.pop(table.name, None)
-            # Evict least-recently-used until count AND bytes fit.
-            while self._entries and (
-                len(self._entries) >= self.max_entries
-                or sum(e.total_bytes() for e in self._entries.values())
-                + entry.total_bytes()
-                > self.max_bytes
-            ):
-                self._entries.pop(next(iter(self._entries)))
             self._entries[table.name] = entry
+            self._evict_over_budget_locked(keep=table.name)
         empty = entry.empty_rows
         return entry, True, empty
 

@@ -1,0 +1,19 @@
+-- Join breadth: RIGHT/FULL OUTER, 3-table chains, EXISTS/NOT EXISTS
+-- (ref: the reference gets these from DataFusion, datafusion_impl/mod.rs:54)
+CREATE TABLE jf (host string TAG, v double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO jf (host, v, ts) VALUES ('a', 1.0, 1000), ('a', 2.0, 2000), ('b', 3.0, 1000), ('c', 5.0, 1000);
+CREATE TABLE jo (host string TAG, owner string TAG, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO jo (host, owner, ts) VALUES ('a', 'alice', 1), ('z', 'zoe', 1);
+CREATE TABLE jt (owner string TAG, team string TAG, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic;
+INSERT INTO jt (owner, team, ts) VALUES ('alice', 'core', 1), ('zoe', 'infra', 1);
+SELECT host, v, owner FROM jf RIGHT JOIN jo ON jf.host = jo.host ORDER BY host, v;
+SELECT host, v, owner FROM jf FULL OUTER JOIN jo ON jf.host = jo.host ORDER BY host NULLS LAST, v;
+SELECT host, v, owner, team FROM jf JOIN jo ON jf.host = jo.host JOIN jt ON jo.owner = jt.owner ORDER BY v;
+SELECT host, owner, team FROM jf LEFT JOIN jo ON jf.host = jo.host JOIN jt ON jo.owner = jt.owner ORDER BY host;
+SELECT host, v FROM jf WHERE EXISTS (SELECT * FROM jo WHERE jo.host = jf.host) ORDER BY v;
+SELECT host, v FROM jf WHERE NOT EXISTS (SELECT * FROM jo WHERE jo.host = jf.host) ORDER BY v;
+SELECT host, v FROM jf WHERE EXISTS (SELECT * FROM jo WHERE ts > 0) ORDER BY host, v;
+SELECT host, v FROM jf WHERE EXISTS (SELECT * FROM jo WHERE ts > 100) ORDER BY host, v;
+DROP TABLE jf;
+DROP TABLE jo;
+DROP TABLE jt;

@@ -150,6 +150,121 @@ class TestJoin:
             {"host": "c", "region": "eu", "cap": None},
         ]
 
+    def test_right_outer_join(self, db):
+        db.execute(
+            "CREATE TABLE own4 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO own4 (host, owner, ts) VALUES "
+            "('a', 'alice', 1), ('z', 'zoe', 1)"
+        )
+        # pandas oracle: q RIGHT JOIN own4 on host
+        import pandas as pd
+
+        q = pd.DataFrame({
+            "host": ["a", "a", "b", "b", "c"],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+        })
+        own = pd.DataFrame({"host": ["a", "z"], "owner": ["alice", "zoe"]})
+        oracle = q.merge(own, on="host", how="right")
+        expect = sorted(
+            (r.host, None if pd.isna(r.v) else r.v, r.owner)
+            for r in oracle.itertuples()
+        )
+        out = db.execute(
+            "SELECT host, v, owner FROM q RIGHT JOIN own4 ON q.host = own4.host"
+        ).to_pylist()
+        got = sorted((r["host"], r["v"], r["owner"]) for r in out)
+        assert got == expect  # 'z' survives with NULL v; b/c dropped
+
+    def test_full_outer_join(self, db):
+        db.execute(
+            "CREATE TABLE own5 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO own5 (host, owner, ts) VALUES "
+            "('a', 'alice', 1), ('z', 'zoe', 1)"
+        )
+        import pandas as pd
+
+        q = pd.DataFrame({
+            "host": ["a", "a", "b", "b", "c"],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+        })
+        own = pd.DataFrame({"host": ["a", "z"], "owner": ["alice", "zoe"]})
+        oracle = q.merge(own, on="host", how="outer")
+        expect = sorted(
+            (
+                r.host,
+                None if pd.isna(r.v) else r.v,
+                None if (isinstance(r.owner, float) and pd.isna(r.owner)) else r.owner,
+            )
+            for r in oracle.itertuples()
+        )
+        out = db.execute(
+            "SELECT host, v, owner FROM q FULL OUTER JOIN own5 "
+            "ON q.host = own5.host"
+        ).to_pylist()
+        got = sorted((r["host"], r["v"], r["owner"]) for r in out)
+        assert got == expect
+
+    def test_three_table_chain(self, db):
+        db.execute(
+            "CREATE TABLE own6 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO own6 (host, owner, ts) VALUES "
+            "('a', 'alice', 1), ('b', 'bob', 1)"
+        )
+        db.execute(
+            "CREATE TABLE teams (owner string TAG, team string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO teams (owner, team, ts) VALUES "
+            "('alice', 'core', 1), ('bob', 'infra', 1)"
+        )
+        out = db.execute(
+            "SELECT host, v, owner, team FROM q "
+            "JOIN own6 ON q.host = own6.host "
+            "JOIN teams ON own6.owner = teams.owner "
+            "ORDER BY host, v"
+        ).to_pylist()
+        assert out == [
+            {"host": "a", "v": 1.0, "owner": "alice", "team": "core"},
+            {"host": "a", "v": 2.0, "owner": "alice", "team": "core"},
+            {"host": "b", "v": 3.0, "owner": "bob", "team": "infra"},
+            {"host": "b", "v": 4.0, "owner": "bob", "team": "infra"},
+        ]
+
+    def test_chain_with_left_then_inner(self, db):
+        db.execute(
+            "CREATE TABLE own7 (host string TAG, owner string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO own7 (host, owner, ts) VALUES ('a', 'alice', 1)")
+        db.execute(
+            "CREATE TABLE teams2 (owner string TAG, team string TAG, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO teams2 (owner, team, ts) VALUES ('alice', 'core', 1)"
+        )
+        # LEFT keeps b/c rows with NULL owner; the following INNER join on
+        # owner then drops them (NULL matches nothing) — SQL semantics.
+        out = db.execute(
+            "SELECT host, owner, team FROM q "
+            "LEFT JOIN own7 ON q.host = own7.host "
+            "JOIN teams2 ON own7.owner = teams2.owner "
+            "ORDER BY host"
+        ).to_pylist()
+        assert {(r["host"], r["owner"], r["team"]) for r in out} == {
+            ("a", "alice", "core")
+        }
+
     def test_join_aggregate_rejected(self, db):
         db.execute(
             "CREATE TABLE own3 (host string TAG, ts timestamp NOT NULL, "
@@ -159,6 +274,92 @@ class TestJoin:
             db.execute(
                 "SELECT count(*) AS c FROM q JOIN own3 ON q.host = own3.host"
             )
+
+
+class TestExists:
+    """[NOT] EXISTS — uncorrelated constants and equality-correlated
+    semi/anti joins (decorrelated like the scalar subqueries)."""
+
+    def _dim(self, db):
+        db.execute(
+            "CREATE TABLE act (host string TAG, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            "INSERT INTO act (host, ts) VALUES ('a', 1), ('c', 1)"
+        )
+
+    def test_correlated_exists_semi_join(self, db):
+        self._dim(db)
+        out = db.execute(
+            "SELECT host, v FROM q WHERE EXISTS "
+            "(SELECT * FROM act WHERE act.host = q.host) ORDER BY host, v"
+        ).to_pylist()
+        assert [(r["host"], r["v"]) for r in out] == [
+            ("a", 1.0), ("a", 2.0), ("c", 5.0)
+        ]
+
+    def test_correlated_not_exists_anti_join(self, db):
+        self._dim(db)
+        out = db.execute(
+            "SELECT host, v FROM q WHERE NOT EXISTS "
+            "(SELECT * FROM act WHERE act.host = q.host) ORDER BY host, v"
+        ).to_pylist()
+        assert [(r["host"], r["v"]) for r in out] == [("b", 3.0), ("b", 4.0)]
+
+    def test_exists_with_residual_inner_filter(self, db):
+        self._dim(db)
+        db.execute("INSERT INTO act (host, ts) VALUES ('b', 5000)")
+        # only act rows with ts >= 5000 count: semi-join keeps just b
+        out = db.execute(
+            "SELECT DISTINCT host FROM q WHERE EXISTS "
+            "(SELECT * FROM act WHERE act.host = q.host AND act.ts >= 5000) "
+            "ORDER BY host"
+        ).to_pylist()
+        assert [r["host"] for r in out] == ["b"]
+
+    def test_uncorrelated_exists_constant(self, db):
+        self._dim(db)
+        assert len(db.execute(
+            "SELECT host FROM q WHERE EXISTS (SELECT * FROM act)"
+        ).to_pylist()) == 5
+        assert db.execute(
+            "SELECT host FROM q WHERE EXISTS "
+            "(SELECT * FROM act WHERE ts > 999999)"
+        ).to_pylist() == []
+        assert len(db.execute(
+            "SELECT host FROM q WHERE NOT EXISTS "
+            "(SELECT * FROM act WHERE ts > 999999)"
+        ).to_pylist()) == 5
+
+    def test_exists_limit_zero_is_false(self, db):
+        self._dim(db)
+        # LIMIT 0 empties the subquery: EXISTS is false, NOT EXISTS true.
+        assert db.execute(
+            "SELECT host FROM q WHERE EXISTS (SELECT * FROM act LIMIT 0)"
+        ).to_pylist() == []
+        assert len(db.execute(
+            "SELECT host FROM q WHERE NOT EXISTS (SELECT * FROM act LIMIT 0)"
+        ).to_pylist()) == 5
+
+    def test_correlated_exists_over_aggregate_always_true(self, db):
+        self._dim(db)
+        # An ungrouped aggregate subquery yields exactly ONE row per
+        # outer row (NULL max over the empty group included): EXISTS is
+        # unconditionally true — even for hosts absent from act.
+        out = db.execute(
+            "SELECT host, v FROM q WHERE EXISTS "
+            "(SELECT max(ts) FROM act WHERE act.host = q.host) ORDER BY v"
+        ).to_pylist()
+        assert len(out) == 5
+
+    def test_exists_combines_with_other_predicates(self, db):
+        self._dim(db)
+        out = db.execute(
+            "SELECT host, v FROM q WHERE v > 1 AND EXISTS "
+            "(SELECT * FROM act WHERE act.host = q.host) ORDER BY v"
+        ).to_pylist()
+        assert [(r["host"], r["v"]) for r in out] == [("a", 2.0), ("c", 5.0)]
 
 
 class TestUdfRegistry:
