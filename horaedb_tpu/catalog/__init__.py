@@ -71,6 +71,12 @@ class Catalog:
         self.instance = instance
         self._lock = threading.RLock()
         self._entries: dict[str, TableEntry] = {}
+        # Bumped on catalog-shape mutations (create/drop/reload/forget) —
+        # connections key their plan caches on it. ALTER does NOT bump it
+        # (it mutates the table, not the catalog); plan-cache hits
+        # additionally verify the planned schema VERSION, which ALTER
+        # does bump.
+        self.ddl_generation = 0
         self._next_table_id = 1
         self._open_tables: dict[str, Table] = {}
         # Cluster hook: (logical_name, index, sub_name, sub_id)
@@ -120,6 +126,7 @@ class Catalog:
         have created tables in the SHARED object store since we loaded).
         Keeps open handles; only the name->entry map refreshes."""
         with self._lock:
+            self.ddl_generation += 1
             self._entries.clear()
             self._load()
 
@@ -127,6 +134,7 @@ class Catalog:
         """Drop the open handle + entry WITHOUT touching storage (shard
         moved away: the table lives on, owned by another node)."""
         with self._lock:
+            self.ddl_generation += 1
             self._open_tables.pop(name, None)
             self._entries.pop(name, None)
 
@@ -294,6 +302,7 @@ class Catalog:
                 data = self.instance.create_table(0, table_id, name, schema, options)
                 self._entries[name] = TableEntry(name, table_id, 0)
                 table = AnalyticTable(self.instance, data)
+            self.ddl_generation += 1
             self._persist_locked()
             self._open_tables[name] = table
             return table
@@ -313,6 +322,7 @@ class Catalog:
             table = self.open(name)
             self._entries.pop(name, None)
             self._open_tables.pop(name, None)
+            self.ddl_generation += 1
             self._persist_locked()
         if table is not None:
             subs = getattr(table, "sub_tables", None)

@@ -42,10 +42,49 @@ class Connection:
         # gRPC workers append while the HTTP debug endpoint snapshots;
         # deque iteration during a concurrent append raises — lock both.
         self.remote_spans_lock = threading.Lock()
+        # Plan cache: dashboards re-issue IDENTICAL query text at high
+        # rate, and at serving latencies (~1ms on the packed cached path)
+        # parse+plan is most of the request. SELECT-family plans are
+        # immutable frozen dataclasses — reusable verbatim. Invalidation:
+        # the catalog DDL generation (create/drop/alter bump it) plus the
+        # planned table's schema version (covers cluster-reload alters).
+        self._plan_cache: dict = {}
+        self._plan_cache_lock = threading.Lock()
+
+    _PLAN_CACHE_MAX = 256
+
+    def _cached_plan(self, sql: str):
+        from .query import plan as plan_mod
+
+        def fresh(p) -> bool:
+            # ALTERs bump schema versions without a catalog persist; a
+            # cached plan binds the schema it was planned against.
+            if isinstance(p, plan_mod.QueryPlan):
+                s = self.catalog.schema_of(p.table)
+                return s is not None and s.version == p.schema.version
+            if isinstance(p, plan_mod.UnionPlan):
+                return all(fresh(b) for b in p.branches)
+            return True  # CTEPlan: inner ASTs re-plan at execute time
+
+        gen = self.catalog.ddl_generation
+        with self._plan_cache_lock:
+            hit = self._plan_cache.get(sql)
+        if hit is not None:
+            plan, cached_gen = hit
+            if cached_gen == gen and fresh(plan):
+                return plan
+        plan = self.frontend.sql_to_plan(sql)
+        if isinstance(
+            plan, (plan_mod.QueryPlan, plan_mod.UnionPlan, plan_mod.CTEPlan)
+        ):
+            with self._plan_cache_lock:
+                if len(self._plan_cache) >= self._PLAN_CACHE_MAX:
+                    self._plan_cache.pop(next(iter(self._plan_cache)))
+                self._plan_cache[sql] = (plan, gen)
+        return plan
 
     def execute(self, sql: str) -> Output:
-        plan = self.frontend.sql_to_plan(sql)
-        return self.interpreters.execute(plan)
+        return self.interpreters.execute(self._cached_plan(sql))
 
     def execute_many(self, sql: str) -> list[Output]:
         return [

@@ -91,6 +91,28 @@ def dedup_sorted(rows: RowGroup) -> RowGroup:
     return rows.filter(keep)
 
 
+def _sources_time_disjoint(view: ReadView, schema: Schema) -> bool:
+    """True when no two sources can hold versions of one key: zero
+    memtable rows (a memtable may hold in-place duplicates), the
+    timestamp IS part of the primary key (an explicit PRIMARY KEY may
+    exclude it, and then one key's versions can live in different time
+    windows), and SST time ranges pairwise disjoint (versions of a
+    ts-keyed key share its exact timestamp)."""
+    if schema.timestamp_index not in schema.primary_key_indexes:
+        return False
+    for mem in view.memtables:
+        if not mem.is_empty():
+            return False
+    spans = sorted(
+        (h.meta.time_range.inclusive_start, h.meta.time_range.exclusive_end)
+        for h in view.ssts
+    )
+    for (_, prev_end), (nxt_start, _) in zip(spans, spans[1:]):
+        if nxt_start < prev_end:
+            return False
+    return True
+
+
 def scan_sources(
     view: ReadView,
     schema: Schema,
@@ -264,6 +286,18 @@ def merge_read(
     dedup_scan = update_mode is not UpdateMode.APPEND and (
         len(view.ssts) + len(view.memtables) > 1
     )
+    disjoint = dedup_scan and _sources_time_disjoint(view, schema)
+    if disjoint:
+        # The flushed/compacted steady state: every SST is internally
+        # deduped (flush and compaction both dedup), there are no
+        # memtable rows, and the SSTs' time ranges are pairwise disjoint
+        # — no key can have versions in two sources, so cross-source
+        # dedup is impossible. That makes VALUE-filter row-group pruning
+        # safe again (the newest version of a key is the only version),
+        # which is exactly what a selective scan like usage_user > 90
+        # needs to skip most pages (ref: row_group_pruner.rs:240-288
+        # prunes with full predicates).
+        dedup_scan = False
     if dedup_scan:
         # Key-column filters stay: every version of a key shares its key
         # values, so pruning by them can never separate versions. Only
@@ -294,6 +328,11 @@ def merge_read(
         return rows
     if len(parts) == 1 and len(view.memtables) == 0:
         # Single SST: flush/compaction already deduped it.
+        return rows
+    if disjoint:
+        # Time-disjoint deduped SSTs (see above): nothing to merge —
+        # rows are per-source concatenations (each key-sorted within its
+        # window), like the APPEND chain.
         return rows
     # Device merge-dedup above a size threshold: the same lax.sort +
     # shift-compare kernel compaction uses (ref: the read path IS the

@@ -122,7 +122,9 @@ class Proxy:
 
         token = set_request_id(ctx.request_id)
         try:
-            plan = self.conn.frontend.sql_to_plan(sql)
+            # The plan cache is what makes repeated dashboard text cheap
+            # at serving latency — the gateway is its target workload.
+            plan = self.conn._cached_plan(sql)
             table = getattr(plan, "table", None)
             self.limiter.check(table)
             if table:

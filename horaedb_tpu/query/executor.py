@@ -910,6 +910,23 @@ class Executor:
         for conj in series_filters:
             v, valid = eval_expr(conj, series_rows)
             allowed &= np.asarray(as_values(v)).astype(bool) & valid
+        # Value-stat series pruning (the cached analog of row-group
+        # min/max pruning): a series none of whose BASE values can pass a
+        # numeric filter is excluded from the scan — but NOT from the
+        # delta fold, whose fresh rows the base stats don't cover; the
+        # delta applies the filters exactly per row.
+        scan_allowed = allowed
+        stats = entry.series_value_stats or {}
+        for col, op, lit in device_filters:
+            st = stats.get(col)
+            if st is None:
+                continue
+            mins, maxs = st
+            could = _series_could_match(mins, maxs, op, lit)
+            if could is not None:
+                if scan_allowed is allowed:
+                    scan_allowed = allowed.copy()
+                scan_allowed &= could
 
         # Time range + bucketing, relative to the cache origin. An empty
         # intersection keeps rel bounds at (0, 0) — NOT raw epoch deltas,
@@ -948,7 +965,15 @@ class Executor:
         ).padded()
 
         gos = np.append(series_group, 0).astype(np.int32)  # pad series -> masked
-        allow = np.append(allowed, False)
+        allow = np.append(allowed, False)  # delta fold: NO value pruning
+        allow_scan = (
+            allow
+            if scan_allowed is allowed
+            else np.append(scan_allowed, False)
+        )
+        if scan_allowed is not allowed:
+            # value-stat prunes only — not series tag filters excluded
+            m["series_pruned"] = int(allowed.sum() - scan_allowed.sum())
         values_dev = entry.values_for(value_names)
         literals = [lit for _, _, lit in device_filters]
         lo_rel = lo - entry.min_ts
@@ -967,7 +992,7 @@ class Executor:
                 entry.ts_rel_dev,
                 values_dev,
                 jnp.asarray(gos),
-                jnp.asarray(allow),
+                jnp.asarray(allow_scan),
                 coerce_literals(literals),
                 np.int32(lo_rel),
                 np.int32(hi_rel),
@@ -987,13 +1012,13 @@ class Executor:
             )
 
             row_idx = (
-                self._selective_row_idx(entry, allowed, lo, hi)
+                self._selective_row_idx(entry, scan_allowed, lo, hi)
                 if not empty_range
                 else None
             )
             if row_idx is not None:
                 m["cache_rows"] = int((row_idx != entry.n_valid).sum())
-            session_dev = entry.session_for(gos, allow)
+            session_dev = entry.session_for(gos, allow_scan)
             dyn = pack_dyn(literals, lo_rel, hi_rel, t0_rel, width_i, row_idx)
             packed = cached_scan_agg_packed(
                 entry.series_codes_dev,
@@ -1307,6 +1332,27 @@ class Executor:
         if (stmt.distinct or has_window) and (stmt.limit is not None or stmt.offset):
             result = _slice_result(result, stmt.offset, stmt.limit)
         return result
+
+
+def _series_could_match(
+    mins: np.ndarray, maxs: np.ndarray, op: str, lit: float
+) -> Optional[np.ndarray]:
+    """Per-series bool: could ANY value in [min, max] satisfy ``op lit``?
+    Conservative (False only when provably no row passes); None for
+    operators without a sound interval rule."""
+    if op == ">":
+        return maxs > lit
+    if op == ">=":
+        return maxs >= lit
+    if op == "<":
+        return mins < lit
+    if op == "<=":
+        return mins <= lit
+    if op in ("=", "=="):
+        return (mins <= lit) & (maxs >= lit)
+    if op in ("!=", "<>"):
+        return ~((mins == lit) & (maxs == lit))
+    return None
 
 
 def _plan_needs_minmax(plan) -> bool:

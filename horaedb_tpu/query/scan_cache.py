@@ -93,6 +93,11 @@ class CachedTableScan:
     ts_rel_host: Optional[np.ndarray] = None
     all_valid: dict = None
     empty_rows: Optional[RowGroup] = None
+    # per-series (min, max) of each resident value column — the cached
+    # path's analog of parquet row-group statistics: a numeric filter no
+    # row of a series can pass excludes the series BEFORE the kernel
+    # (ref: row_group_pruner.rs:240-288 value-stat pruning)
+    series_value_stats: dict = None
     # resident-size accounting for the cache's byte budget
     device_bytes: int = 0
     host_bytes: int = 0
@@ -497,6 +502,22 @@ class ScanCache:
                 entry.value_cols_dev[c] = dev
                 entry.device_bytes += padded.nbytes
                 entry._stacks = None  # stale stacked views
+                # Per-series min/max over the SAME values the kernel sees
+                # — the dtype-CAST values (bf16-resident columns compare
+                # rounded), with fills included and NaN samples ignored
+                # (np.fmin/fmax: a NaN passes no numeric filter, so it
+                # must not poison a series' stats; an all-NaN series
+                # yields NaN stats and correctly prunes). Every series is
+                # non-empty by construction (offsets from bincount of
+                # present rows), so reduceat is well-defined.
+                if entry.series_value_stats is None:
+                    entry.series_value_stats = {}
+                seg = entry.series_offsets[:-1]
+                stat_src = padded[: len(arr)].astype(np.float64)
+                entry.series_value_stats[c] = (
+                    np.fmin.reduceat(stat_src, seg),
+                    np.fmax.reduceat(stat_src, seg),
+                )
         self._apply_host_budget(entry)
         return True
 

@@ -179,6 +179,86 @@ class TestByteBudget:
         }
 
 
+class TestSeriesValueStatPruning:
+    """Cached-path analog of row-group min/max pruning: series no BASE
+    value of which can pass a numeric filter skip the scan; delta rows
+    are exempt (fresh values the base stats don't cover)."""
+
+    def _seed(self, db):
+        db.execute(DDL)
+        # h0: values 0..9 (max 9), h1: values 100..109 (max 109)
+        vals = []
+        for i in range(10):
+            vals.append(f"('h0', {float(i)}, {1_700_000_000_000 + i * 1000})")
+            vals.append(
+                f"('h1', {float(100 + i)}, {1_700_000_000_000 + i * 1000})"
+            )
+        db.execute(f"INSERT INTO t (host, v, ts) VALUES {', '.join(vals)}")
+        db.flush_all()
+
+    def test_filter_prunes_series_and_answers_exactly(self, db):
+        self._seed(db)
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c, max(v) AS peak FROM t WHERE v > 50"
+        out = warm(db, sql)
+        assert ex.last_path == "device-cached"
+        assert ex.last_metrics.get("series_pruned") == 1, ex.last_metrics
+        assert out.to_pylist() == [{"c": 10, "peak": 109.0}]
+
+    def test_delta_rows_escape_base_stat_pruning(self, db):
+        self._seed(db)
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c, max(v) AS peak FROM t WHERE v > 50"
+        warm(db, sql)
+        assert ex.last_path == "device-cached"
+        # h0's base max is 9 (pruned for v > 50) — but a NEW unflushed row
+        # of h0 passes the filter and MUST be counted via the delta fold.
+        db.execute(
+            "INSERT INTO t (host, v, ts) VALUES ('h0', 999.0, 1700000100000)"
+        )
+        out = db.execute(sql)
+        assert ex.last_path == "device-cached", ex.last_path
+        assert out.to_pylist() == [{"c": 11, "peak": 999.0}]
+
+    def test_nan_samples_do_not_poison_series_stats(self, db):
+        """Review repro: a NaN sample (e.g. a Prometheus stale marker)
+        must not prune a series whose real values pass the filter."""
+        db.execute(DDL)
+        db.execute(
+            "INSERT INTO t (host, v, ts) VALUES " + ", ".join(
+                [f"('h0', {float(100 + i)}, {1_700_000_000_000 + (i + 1) * 1000})"
+                 for i in range(9)]
+                + [f"('h1', {float(i)}, {1_700_000_000_000 + i * 1000})"
+                   for i in range(10)]
+            )
+        )
+        # inject a NaN row into h0 through the table layer (SQL literals
+        # don't spell NaN)
+        import numpy as np
+
+        from horaedb_tpu.common_types import RowGroup
+
+        t = db.catalog.open("t")
+        t.write(RowGroup.from_rows(t.schema, [
+            {"host": "h0", "v": float("nan"), "ts": 1_700_000_000_000}
+        ]))
+        db.flush_all()
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c, max(v) AS peak FROM t WHERE v > 50"
+        out = warm(db, sql)
+        assert ex.last_path == "device-cached"
+        assert out.to_pylist() == [{"c": 9, "peak": 108.0}], out.to_pylist()
+
+    def test_equality_filter_uses_interval_rule(self, db):
+        self._seed(db)
+        ex = db.interpreters.executor
+        sql = "SELECT count(*) AS c FROM t WHERE v = 105"
+        out = warm(db, sql)
+        if ex.last_path == "device-cached":
+            assert ex.last_metrics.get("series_pruned") == 1
+        assert out.to_pylist() == [{"c": 1}]
+
+
 class TestShardedCache:
     """The cached serving path itself shards over the mesh (round 2):
     entry arrays live split across devices, the shard_map cached kernel

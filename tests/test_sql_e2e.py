@@ -271,3 +271,46 @@ class TestPersistenceAcrossReconnect:
         assert q(db2, "SHOW TABLES") == [{"Tables": "demo"}]
         assert q(db2, "SELECT count(*) AS c FROM demo") == [{"c": 1}]
         db2.close()
+
+
+class TestPlanCache:
+    """Repeated identical query text skips parse+plan; DDL and ALTER
+    invalidate (generation + schema-version guards)."""
+
+    def test_repeat_hits_and_ddl_invalidates(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE pc (host string TAG, v double, ts timestamp KEY)"
+        )
+        db.execute("INSERT INTO pc (host, v, ts) VALUES ('a', 1.0, 1000)")
+        sql = "SELECT count(*) AS c FROM pc"
+        assert db.execute(sql).to_pylist() == [{"c": 1}]
+        assert sql in db._plan_cache
+        plan1 = db._plan_cache[sql][0]
+        assert db.execute(sql).to_pylist() == [{"c": 1}]
+        assert db._plan_cache[sql][0] is plan1  # reused verbatim
+        # DROP + recreate with different schema: stale plan must not serve
+        db.execute("DROP TABLE pc")
+        db.execute(
+            "CREATE TABLE pc (host string TAG, w double, ts timestamp KEY)"
+        )
+        db.execute("INSERT INTO pc (host, w, ts) VALUES ('a', 2.0, 1000), ('b', 3.0, 2000)")
+        assert db.execute(sql).to_pylist() == [{"c": 2}]
+        db.close()
+
+    def test_alter_invalidates_via_schema_version(self):
+        import horaedb_tpu
+
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE pa (host string TAG, v double, ts timestamp KEY)"
+        )
+        db.execute("INSERT INTO pa (host, v, ts) VALUES ('a', 1.0, 1000)")
+        sql = "SELECT * FROM pa"
+        assert "v2" not in db.execute(sql).to_pylist()[0]
+        db.execute("ALTER TABLE pa ADD COLUMN v2 double")
+        out = db.execute(sql).to_pylist()[0]
+        assert "v2" in out, out  # stale cached projection would miss v2
+        db.close()
