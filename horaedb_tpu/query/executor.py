@@ -1350,8 +1350,9 @@ def _series_could_match(
         return mins <= lit
     if op in ("=", "=="):
         return (mins <= lit) & (maxs >= lit)
-    if op in ("!=", "<>"):
-        return ~((mins == lit) & (maxs == lit))
+    # No != rule: stats ignore NaN samples (fmin/fmax), but the kernel's
+    # IEEE compare counts NaN rows for `v != lit` — a min==max==lit series
+    # holding a NaN would prune rows the unpruned paths return.
     return None
 
 

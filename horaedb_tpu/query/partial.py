@@ -188,12 +188,30 @@ def _partial_on_rows(
     return _partial_host(rows, mask, spec, t0)
 
 
+def _default_budget_mb(floor_mb: int = 1024) -> int:
+    """Default memory budgets scale with the machine: a quarter of
+    physical RAM, never below ``floor_mb`` (a 125GB box should not
+    refuse a 3GB scan the way a 4GB edge node must)."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return max(floor_mb, int(line.split()[1]) // 1024 // 4)
+    except OSError:
+        pass
+    return floor_mb
+
+
 def _agg_memory_cap_bytes() -> int:
     """HORAEDB_AGG_MEMORY_MB: cap on the host working set one aggregate
-    scan may materialize (0 disables bounding; fractions allowed)."""
+    scan may materialize (0 disables bounding; fractions allowed;
+    default: a quarter of physical RAM, min 1GB)."""
     import os
 
-    return int(float(os.environ.get("HORAEDB_AGG_MEMORY_MB", "1024")) * (1 << 20))
+    raw = os.environ.get("HORAEDB_AGG_MEMORY_MB")
+    if raw is None:
+        return _default_budget_mb() << 20
+    return int(float(raw) * (1 << 20))
 
 
 def _scan_estimate_bytes(table, pred, projection) -> int:

@@ -168,7 +168,7 @@ class ScanCache:
     """Bounded by BYTES, not entry count (ref: mem_cache.rs:64-158 — the
     reference budgets its partitioned LRU by capacity): entries are
     evicted least-recently-used until resident device+host bytes fit
-    ``max_bytes`` (HORAEDB_SCAN_CACHE_MB, default 1024). A single table
+    ``max_bytes`` (HORAEDB_SCAN_CACHE_MB, default RAM/4). A single table
     whose resident state alone exceeds the budget is never built — the
     host path serves it instead of failing a giant device_put. Entries
     whose HOST rows exceed HORAEDB_CACHE_HOST_ROWS_MB (default 256) drop
@@ -191,11 +191,16 @@ class ScanCache:
         self._candidate: dict[str, tuple] = {}
         self._lock = threading.Lock()
         self.max_entries = max_entries
-        self.max_bytes = (
-            max_bytes
-            if max_bytes is not None
-            else int(os.environ.get("HORAEDB_SCAN_CACHE_MB", "1024")) << 20
-        )
+        if max_bytes is not None:
+            self.max_bytes = max_bytes
+        else:
+            raw = os.environ.get("HORAEDB_SCAN_CACHE_MB")
+            if raw is None:
+                from .partial import _default_budget_mb
+
+                self.max_bytes = _default_budget_mb() << 20
+            else:
+                self.max_bytes = int(float(raw) * (1 << 20))  # fractions OK
         self.max_host_rows_bytes = (
             max_host_rows_bytes
             if max_host_rows_bytes is not None
