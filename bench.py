@@ -235,10 +235,10 @@ CONFIGS = {
 # SstWriter (the flush discipline, flush.py:95-120) so the build phase
 # measures SST production, not the WAL/memtable write path.
 
-# BASELINE config 5 is 64 SSTs / 100M rows; the default here is 32M so
-# the config (which builds the table TWICE for the device/host A-B) fits
-# the per-config timeout on this 1-core host — rows/s is steady-state at
-# this size. BENCH_COMPACTION_ROWS=100000000 reproduces the full config.
+# BASELINE config 5 blueprint shape IS the default: 64 SSTs / 100M rows
+# (the table builds TWICE for the device/host A-B; ~10 min wall on this
+# 1-core host, inside PER_CONFIG_TIMEOUT). BENCH_COMPACTION_ROWS=32000000
+# reproduces the r4 quick shape.
 COMPACTION_SSTS = int(os.environ.get("BENCH_COMPACTION_SSTS", "64"))
 COMPACTION_ROWS = int(os.environ.get("BENCH_COMPACTION_ROWS", "100000000"))
 

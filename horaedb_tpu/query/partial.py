@@ -188,6 +188,10 @@ def _partial_on_rows(
     return _partial_host(rows, mask, spec, t0)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def _default_budget_mb(floor_mb: int = 1024) -> int:
     """Default memory budgets scale with the machine: a quarter of
     physical RAM, never below ``floor_mb`` (a 125GB box should not
@@ -202,16 +206,22 @@ def _default_budget_mb(floor_mb: int = 1024) -> int:
     return floor_mb
 
 
+def _budget_bytes(env_name: str) -> int:
+    """Env-or-RAM/4 byte budget (fractional MB allowed; 0 disables) —
+    the ONE parse both memory knobs share."""
+    import os
+
+    raw = os.environ.get(env_name)
+    if raw is None:
+        return _default_budget_mb() << 20
+    return int(float(raw) * (1 << 20))
+
+
 def _agg_memory_cap_bytes() -> int:
     """HORAEDB_AGG_MEMORY_MB: cap on the host working set one aggregate
     scan may materialize (0 disables bounding; fractions allowed;
     default: a quarter of physical RAM, min 1GB)."""
-    import os
-
-    raw = os.environ.get("HORAEDB_AGG_MEMORY_MB")
-    if raw is None:
-        return _default_budget_mb() << 20
-    return int(float(raw) * (1 << 20))
+    return _budget_bytes("HORAEDB_AGG_MEMORY_MB")
 
 
 def _scan_estimate_bytes(table, pred, projection) -> int:

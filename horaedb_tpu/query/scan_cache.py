@@ -194,13 +194,9 @@ class ScanCache:
         if max_bytes is not None:
             self.max_bytes = max_bytes
         else:
-            raw = os.environ.get("HORAEDB_SCAN_CACHE_MB")
-            if raw is None:
-                from .partial import _default_budget_mb
+            from .partial import _budget_bytes
 
-                self.max_bytes = _default_budget_mb() << 20
-            else:
-                self.max_bytes = int(float(raw) * (1 << 20))  # fractions OK
+            self.max_bytes = _budget_bytes("HORAEDB_SCAN_CACHE_MB")
         self.max_host_rows_bytes = (
             max_host_rows_bytes
             if max_host_rows_bytes is not None
