@@ -76,6 +76,7 @@ class GrpcServer:
                         "GetTableInfo": _unary(self._get_table_info),
                         "Write": _unary(self._write),
                         "Read": _unary(self._read),
+                        "ReadPage": _unary(self._read_page),
                         "PartialAgg": _unary(self._partial_agg),
                         "ExecutePlan": _unary(self._execute_plan),
                         "DropSub": _unary(self._drop_sub),
@@ -143,6 +144,27 @@ class GrpcServer:
         projection = req.get("projection")
         rows = t.read(pred, projection=projection)
         return {"ipc": rows_to_ipc(rows)}
+
+    def _read_page(self, req: dict) -> dict:
+        """Streaming read, one segment window per RPC (ref: the reference
+        streams arrow IPC batches over the remote engine,
+        server/src/grpc/remote_engine_service/mod.rs:928-1011; grpc
+        generic bytes-in/bytes-out has no server streaming, so the stream
+        is stateless pagination by WINDOW START — same correctness basis
+        as the bounded scan: a key's versions never straddle windows).
+
+        req: {table, predicate?, projection?, after?} — ``after`` is the
+        previous page's ``next`` token (an exclusive window-start lower
+        bound). -> {ipc, next} where next=None terminates the stream."""
+        from ..table_engine.table import read_one_page
+
+        t = self._open(req["table"])
+        pred = predicate_from_dict(req["predicate"]) if req.get("predicate") else None
+        rows, nxt = read_one_page(t, pred, req.get("projection"), req.get("after"))
+        return {
+            "ipc": rows_to_ipc(rows) if rows is not None else None,
+            "next": nxt,
+        }
 
     def _partial_agg(self, req: dict) -> dict:
         import time

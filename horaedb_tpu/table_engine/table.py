@@ -128,20 +128,18 @@ class AnalyticTable(Table):
     def read(self, predicate=None, projection=None) -> RowGroup:
         return self.instance.read(self.data, predicate, projection=projection)
 
-    def read_windows(self, predicate=None, projection=None):
-        """Per-segment-window reads: enumerate the aligned windows the
-        (time-pruned) file set and memtables cover, then run the normal
-        merge read per window — each piece is a complete, deduplicated
-        answer for its time slice, bounded by the window's data size."""
-        from ..common_types.time_range import TimeRange
+    def window_starts(self, predicate=None) -> list[int]:
+        """Aligned segment-window starts the (time-pruned) file set and
+        memtables cover — the unit of both the bounded scan and the
+        remote streaming read. Empty when the table has no segment
+        duration (callers fall back to one whole read)."""
         from ..table_engine.predicate import Predicate as P
 
         predicate = predicate or P.all_time()
         seg_ms = self.data.options.segment_duration_ms
         tr = predicate.time_range
         if not seg_ms:
-            yield self.read(predicate, projection)
-            return
+            return []
         starts: set[int] = set()
         spans: list[tuple[int, int]] = []
         for h in self.data.version.levels.all_files():
@@ -160,18 +158,34 @@ class AnalyticTable(Table):
             while w < hi:
                 starts.add(w)
                 w += seg_ms
+        return sorted(starts)
+
+    def read_window(self, start: int, predicate=None, projection=None) -> RowGroup:
+        """The normal merge read restricted to one aligned window — a
+        complete, deduplicated answer for its time slice."""
+        from ..common_types.time_range import TimeRange
+        from ..table_engine.predicate import Predicate as P
+
+        predicate = predicate or P.all_time()
+        seg_ms = self.data.options.segment_duration_ms
+        tr = predicate.time_range
+        w_pred = P(
+            TimeRange(
+                max(start, tr.inclusive_start),
+                min(start + seg_ms, tr.exclusive_end),
+            ),
+            predicate.filters,
+        )
+        return self.read(w_pred, projection)
+
+    def read_windows(self, predicate=None, projection=None):
+        """Per-segment-window reads (see window_starts/read_window)."""
+        starts = self.window_starts(predicate)
         if not starts:
             yield self.read(predicate, projection)
             return
-        for w in sorted(starts):
-            w_pred = P(
-                TimeRange(
-                    max(w, tr.inclusive_start),
-                    min(w + seg_ms, tr.exclusive_end),
-                ),
-                predicate.filters,
-            )
-            rows = self.read(w_pred, projection)
+        for w in starts:
+            rows = self.read_window(w, predicate, projection)
             if len(rows):
                 yield rows
 
@@ -197,6 +211,34 @@ class AnalyticTable(Table):
 
     def metrics(self) -> dict:
         return self.data.metrics()
+
+
+def read_one_page(table, predicate, projection, after):
+    """ONE page of a stateless windowed read -> (rows | None, next_token).
+
+    The single definition of the pagination protocol: the remote service
+    answers ReadPage with it, and RoutedSubTable drives local resolutions
+    through it page by page (so route retries and close-deferral guards
+    hold per page). ``after`` is the previous page's token (an exclusive
+    window-start lower bound); ``next=None`` terminates the stream.
+    Tables without segment windows are one terminal page."""
+    starts = (
+        table.window_starts(predicate)
+        if isinstance(table, AnalyticTable)
+        else []
+    )
+    if not starts:
+        if after is not None:
+            return None, None
+        return table.read(predicate, projection), None
+    remaining = [w for w in starts if after is None or w > after]
+    if not remaining:
+        return None, None
+    w = remaining[0]
+    return (
+        table.read_window(w, predicate, projection),
+        w if len(remaining) > 1 else None,
+    )
 
 
 class MemoryTable(Table):
