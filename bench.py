@@ -331,12 +331,22 @@ def run_compaction_config() -> dict:
     db_dev, table_dev = _build_compaction_db(seed=7)
     n_input = sum(h.meta.num_rows for h in table_dev.version.levels.files_at(0))
     compaction_mod.Compactor(table_dev).warm_device_merge(n_input)
+    # The 100M-row build leaves GBs of garbage; collect BEFORE timing so
+    # allocator churn lands on neither side of the A/B unevenly.
+    import gc
+
+    gc.collect()
     s = time.perf_counter()
     res_dev = compaction_mod.Compactor(table_dev).compact()
     dev_s = time.perf_counter() - s
     dev_check = db_dev.execute(
         "SELECT count(1) AS c, avg(value) AS v FROM demo"
     ).to_pylist()
+    # Release the device pass's multi-GB MemoryStore before the host
+    # build so both passes run under comparable memory pressure.
+    db_dev.close()
+    del db_dev, table_dev
+    gc.collect()
 
     # Host pass: identical table (same seed), merge forced onto numpy by
     # replacing the WHOLE _merge_stream (the merge engine's single
@@ -361,6 +371,7 @@ def run_compaction_config() -> dict:
     orig = compaction_mod.Compactor._merge_stream
     compaction_mod.Compactor._merge_stream = _forced_host_merge
     try:
+        gc.collect()  # same settle as the device pass
         s = time.perf_counter()
         res_host = compaction_mod.Compactor(table_host).compact()
         host_s = time.perf_counter() - s
