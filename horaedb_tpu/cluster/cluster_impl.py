@@ -171,7 +171,7 @@ class ClusterImpl:
                     if shard.state is ShardState.READY and now > deadline:
                         shard.freeze()
                         _metrics().counter(
-                            "cluster_shard_freezes_total",
+                            "horaedb_cluster_shard_freezes_total",
                             "shards frozen by the lease watch",
                         ).inc()
                         logger.warning(
@@ -181,7 +181,7 @@ class ClusterImpl:
                     elif shard.state is ShardState.FROZEN and now <= deadline:
                         shard.thaw()
                         _metrics().counter(
-                            "cluster_shard_thaws_total",
+                            "horaedb_cluster_shard_thaws_total",
                             "shards thawed by the lease watch after renewal",
                         ).inc()
                         logger.info(
@@ -253,7 +253,7 @@ class ClusterImpl:
                         shard.thaw()
                         # keep freezes - thaws == currently-fenced count
                         _metrics().counter(
-                            "cluster_shard_thaws_total",
+                            "horaedb_cluster_shard_thaws_total",
                             "shards thawed by the lease watch after renewal",
                         ).inc()
                     except ShardError:

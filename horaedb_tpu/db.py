@@ -23,6 +23,7 @@ from .query.frontend import Frontend
 from .query.interpreters import AffectedRows, InterpreterFactory, Output
 from .query.executor import ResultSet
 from .utils.object_store import LocalDiskStore, MemoryStore, ObjectStore
+from .utils.tracectx import annotate
 
 
 class Connection:
@@ -72,7 +73,9 @@ class Connection:
         if hit is not None:
             plan, cached_gen = hit
             if cached_gen == gen and fresh(plan):
+                annotate(plan_cache="hit")
                 return plan
+        annotate(plan_cache="miss")
         plan = self.frontend.sql_to_plan(sql)
         if isinstance(
             plan, (plan_mod.QueryPlan, plan_mod.UnionPlan, plan_mod.CTEPlan)

@@ -39,15 +39,27 @@ from ..utils.metrics import REGISTRY
 
 # Registered at import so the series exist from the first scrape.
 _M_COMPACT_SECONDS = REGISTRY.histogram(
-    "engine_compaction_duration_seconds",
+    "horaedb_compaction_duration_seconds",
     "wall time of one table compaction pass (tasks > 0)",
 )
 _M_COMPACT_TASKS = REGISTRY.counter(
-    "engine_compaction_tasks_total", "compaction merge tasks run"
+    "horaedb_compaction_tasks_total", "compaction merge tasks run"
 )
 _M_COMPACT_ROWS = REGISTRY.counter(
-    "engine_compaction_rows_written_total",
+    "horaedb_compaction_rows_written_total",
     "rows written to merged output SSTs",
+)
+_M_COMPACT_IN_BYTES = REGISTRY.counter(
+    "horaedb_compaction_input_bytes_total",
+    "bytes of input SSTs consumed by compaction merges",
+)
+_M_COMPACT_OUT_BYTES = REGISTRY.counter(
+    "horaedb_compaction_output_bytes_total",
+    "bytes of merged output SSTs written by compaction",
+)
+_M_COMPACT_INFLIGHT = REGISTRY.gauge(
+    "horaedb_compaction_inflight_total",
+    "table compaction passes currently running",
 )
 
 
@@ -204,20 +216,29 @@ class Compactor:
             # RemoveFile edit twice — skip any task touching an already
             # consumed input and RE-PICK until a pass completes without
             # skips (nothing else schedules a retry on an idle table).
+            from ..utils.tracectx import span
+
             t0 = time.perf_counter()
-            while True:
-                consumed: set[tuple[int, int]] = set()
-                skipped = False
-                for task in picker.pick(table):
-                    keys = {(h.level, h.file_id) for h in task.inputs}
-                    if keys & consumed:
-                        skipped = True
-                        continue
-                    self._run_task(task, result)
-                    consumed |= keys
-                    result.tasks_run += 1
-                if not (skipped and consumed):
-                    break
+            _M_COMPACT_INFLIGHT.inc()
+            try:
+                with span("compaction", table=table.name) as sp:
+                    while True:
+                        consumed: set[tuple[int, int]] = set()
+                        skipped = False
+                        for task in picker.pick(table):
+                            keys = {(h.level, h.file_id) for h in task.inputs}
+                            if keys & consumed:
+                                skipped = True
+                                continue
+                            _M_COMPACT_IN_BYTES.inc(task.total_bytes)
+                            self._run_task(task, result)
+                            consumed |= keys
+                            result.tasks_run += 1
+                        if not (skipped and consumed):
+                            break
+                    sp.set(tasks=result.tasks_run, rows=result.rows_written)
+            finally:
+                _M_COMPACT_INFLIGHT.dec()
             if result.tasks_run:
                 _M_COMPACT_SECONDS.observe(time.perf_counter() - t0)
                 _M_COMPACT_TASKS.inc(result.tasks_run)
@@ -346,6 +367,7 @@ class Compactor:
                 edits.append(AddFile(1, meta, w.path))
                 new_handles.append(FileHandle(meta, w.path, 1))
                 result.rows_written += meta.num_rows
+                _M_COMPACT_OUT_BYTES.inc(meta.size_bytes)
         for h in task.inputs:
             edits.append(RemoveFile(h.level, h.file_id))
         table.manifest.append_edits(edits)

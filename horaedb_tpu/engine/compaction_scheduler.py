@@ -27,27 +27,27 @@ logger = logging.getLogger("horaedb_tpu.engine.compaction")
 # Register at import so every series exists (as 0) from the first scrape;
 # a rate() over an absent series silently shows nothing instead of 0.
 _M_ACCEPTED = REGISTRY.counter(
-    "engine_compaction_requests_total",
+    "horaedb_compaction_requests_total",
     "background compaction requests accepted",
 )
 _M_DEDUPED = REGISTRY.counter(
-    "engine_compaction_requests_deduped_total",
+    "horaedb_compaction_requests_deduped_total",
     "compaction requests coalesced into an already-queued one",
 )
 _M_REJECTED_CLOSED = REGISTRY.counter(
-    "engine_compaction_requests_rejected_closed_total",
+    "horaedb_compaction_requests_rejected_closed_total",
     "compaction requests dropped because the scheduler was closed",
 )
 _M_FAILURES = REGISTRY.counter(
-    "engine_compaction_failures_total",
+    "horaedb_compaction_failures_total",
     "background compactions that raised",
 )
 _M_BACKOFF = REGISTRY.counter(
-    "engine_compaction_requests_backoff_total",
+    "horaedb_compaction_requests_backoff_total",
     "compaction requests suppressed by per-table failure backoff",
 )
 _M_DEPTH = REGISTRY.gauge(
-    "engine_compaction_queue_depth",
+    "horaedb_compaction_queue_depth_total",
     "background compactions queued or running",
 )
 

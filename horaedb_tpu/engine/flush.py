@@ -36,10 +36,13 @@ from .table_data import TableData
 
 # Registered at import so the series exist from the first scrape.
 _M_FLUSH_SECONDS = REGISTRY.histogram(
-    "engine_flush_duration_seconds", "memtable flush wall time"
+    "horaedb_flush_duration_seconds", "memtable flush wall time"
 )
 _M_FLUSH_ROWS = REGISTRY.counter(
-    "engine_flush_rows_total", "rows written to L0 by flush"
+    "horaedb_flush_rows_total", "rows written to L0 by flush"
+)
+_M_FLUSH_BYTES = REGISTRY.counter(
+    "horaedb_flush_bytes_total", "bytes written to L0 SSTs by flush"
 )
 
 
@@ -62,8 +65,12 @@ class Flusher:
             frozen = table.version.immutables()
             if not frozen:
                 return FlushResult(0, 0, table.version.flushed_sequence)
+            from ..utils.tracectx import span
+
             t0 = _perf_counter()
-            result = self._dump_memtables(frozen)
+            with span("flush", table=table.name) as sp:
+                result = self._dump_memtables(frozen)
+                sp.set(rows=result.rows_flushed, files=result.files_added)
             _M_FLUSH_SECONDS.observe(_perf_counter() - t0)
             _M_FLUSH_ROWS.inc(result.rows_flushed)
             return result
@@ -145,6 +152,7 @@ class Flusher:
             edits.append(AddFile(0, meta, path))
             new_handles.append(FileHandle(meta, path, 0))
             rows_flushed += len(part)
+            _M_FLUSH_BYTES.inc(meta.size_bytes)
 
         edits.append(Flushed(max_seq))
         table.manifest.append_edits(edits)

@@ -12,18 +12,51 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..common_types.row_group import RowGroup
 from ..common_types.schema import Schema
 from ..table_engine.predicate import Predicate
+from ..utils.metrics import REGISTRY
 from ..utils.object_store import ObjectStore
+from ..utils.tracectx import span
 from .flush import FlushResult, Flusher
 from .manifest import AlterOptions, AlterSchema, Manifest
 from .merge import merge_read
 from .options import TableOptions
 from .table_data import TableData
+
+# Registered at import so the series exist from the first scrape.
+_M_WAL_APPEND_SECONDS = REGISTRY.histogram(
+    "horaedb_wal_append_duration_seconds",
+    "WAL append+fsync latency per commit group (any backend)",
+)
+_M_WAL_APPEND_ROWS = REGISTRY.counter(
+    "horaedb_wal_append_rows_total", "rows made durable through the WAL"
+)
+_M_WAL_REPLAY_SECONDS = REGISTRY.histogram(
+    "horaedb_wal_replay_duration_seconds",
+    "WAL replay wall time per table open",
+)
+_M_WAL_REPLAY_ROWS = REGISTRY.counter(
+    "horaedb_wal_replay_rows_total", "rows re-applied from the WAL at open"
+)
+
+
+def _memtable_gauge(table: TableData):
+    # One labeled gauge per table, cached on the TableData — the write
+    # hot path must not pay a registry lock + label render per commit.
+    g = getattr(table, "_m_memtable_bytes", None)
+    if g is None:
+        g = REGISTRY.gauge(
+            "horaedb_memtable_bytes",
+            "bytes held in mutable + immutable memtables",
+            labels={"table": table.name},
+        )
+        table._m_memtable_bytes = g
+    return g
 
 
 @dataclass
@@ -188,6 +221,10 @@ class Instance:
             table.manifest.destroy()
             if self.wal is not None:
                 self.wal.delete_table(table.table_id)
+            # create/drop churn must not pin stale per-table series in
+            # the registry (and /metrics) forever
+            REGISTRY.remove("horaedb_memtable_bytes", labels={"table": table.name})
+            table._m_memtable_bytes = None
             with self._lock:
                 self._tables.pop((table.space_id, table.table_id), None)
                 if self._compactions is not None:
@@ -285,8 +322,15 @@ class Instance:
                             )
                     seq = table.alloc_sequence()
                     if self.wal is not None:
-                        self.wal.append(table.table_id, seq, merged)
+                        t0 = _time.perf_counter()
+                        with span("wal_append", rows=len(merged)):
+                            self.wal.append(table.table_id, seq, merged)
+                        _M_WAL_APPEND_SECONDS.observe(_time.perf_counter() - t0)
+                        _M_WAL_APPEND_ROWS.inc(len(merged))
                     table.put_rows(merged, seq)
+                    _memtable_gauge(table).set(
+                        table.version.total_memtable_bytes()
+                    )
                     needs_flush |= table.should_flush()
             except BaseException as e:
                 for _, fut in entries:
@@ -323,6 +367,7 @@ class Instance:
         result = Flusher(table).flush()
         if self.wal is not None and result.flushed_sequence:
             self.wal.mark_flushed(table.table_id, result.flushed_sequence)
+        _memtable_gauge(table).set(table.version.total_memtable_bytes())
         self._purge(table)
         self.maybe_compact(table)
         return result
@@ -419,12 +464,19 @@ class Instance:
         an ALTER come back with NULL-filled new columns (same convention
         as reading pre-ALTER SSTs).
         """
-        for seq, batch in self.wal.read_from(
-            table.table_id, table.version.flushed_sequence + 1
-        ):
-            rows = RowGroup.from_arrow(table.schema, batch)
-            table.put_rows(rows, seq)
-            table.set_last_sequence(seq)
+        t0 = _time.perf_counter()
+        replayed = 0
+        with span("wal_replay", table=table.name) as sp:
+            for seq, batch in self.wal.read_from(
+                table.table_id, table.version.flushed_sequence + 1
+            ):
+                rows = RowGroup.from_arrow(table.schema, batch)
+                table.put_rows(rows, seq)
+                table.set_last_sequence(seq)
+                replayed += len(rows)
+            sp.set(rows=replayed)
+        _M_WAL_REPLAY_SECONDS.observe(_time.perf_counter() - t0)
+        _M_WAL_REPLAY_ROWS.inc(replayed)
 
     def _purge(self, table: TableData) -> None:
         for h in table.version.levels.drain_purge_queue():

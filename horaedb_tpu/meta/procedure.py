@@ -199,7 +199,7 @@ class ProcedureManager:
             logger.warning("procedure %s #%d failed (attempt %d): %s",
                            p.kind, p.proc_id, p.attempts, e)
             _metric(
-                "meta_procedure_retries_total",
+                "horaedb_meta_procedure_retries_total",
                 "procedure attempts that raised (terminal or retried)",
                 p.kind,
             ).inc()
@@ -225,7 +225,7 @@ class ProcedureManager:
                 self._retry_at.pop(p.proc_id, None)
         if state in (ProcState.FINISHED, ProcState.FAILED, ProcState.CANCELLED):
             _metric(
-                "meta_procedure_terminal_total",
+                "horaedb_meta_procedure_terminal_total",
                 "procedures reaching a terminal state, by kind and outcome",
                 p.kind,
                 outcome=state.value,

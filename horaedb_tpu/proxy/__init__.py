@@ -113,28 +113,30 @@ class Proxy:
     def handle_sql(self, sql: str) -> Output:
         ctx = RequestContext(next(self._req_ids), sql)
         self._m_queries.inc()
-        # The request id travels by context: priority-pool threads run the
-        # executor inside a COPY of this context, and remote partial-agg
-        # calls ship the id in their wire spec (utils/tracectx.py).
+        # The span tree travels by context: priority-pool threads run the
+        # executor inside a COPY of this context, and remote calls ship
+        # (trace_id, parent_span_id) in their wire spec (utils/tracectx).
         import contextvars
 
-        from ..utils.tracectx import reset_request_id, set_request_id
+        from ..utils.tracectx import finish_trace, span, start_trace
 
-        token = set_request_id(ctx.request_id)
+        trace, handle = start_trace(ctx.request_id, "sql", sql=sql[:200])
         try:
             # The plan cache is what makes repeated dashboard text cheap
             # at serving latency — the gateway is its target workload.
-            plan = self.conn._cached_plan(sql)
+            with span("parse_plan"):
+                plan = self.conn._cached_plan(sql)
             table = getattr(plan, "table", None)
             self.limiter.check(table)
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))
             if isinstance(plan, QueryPlan):
-                cctx = contextvars.copy_context()
-                out = self.runtime.run(
-                    plan.priority.value,
-                    lambda: cctx.run(self.conn.interpreters.execute, plan),
-                )
+                with span("execute", priority=plan.priority.value):
+                    cctx = contextvars.copy_context()
+                    out = self.runtime.run(
+                        plan.priority.value,
+                        lambda: cctx.run(self.conn.interpreters.execute, plan),
+                    )
                 self.recent_queries.append(
                     {
                         "request_id": ctx.request_id,
@@ -144,15 +146,17 @@ class Proxy:
                     }
                 )
                 return out
-            return self.conn.interpreters.execute(plan)
+            with span("execute"):
+                return self.conn.interpreters.execute(plan)
         except Exception:
             self._m_errors.inc()
             raise
         finally:
-            reset_request_id(token)
             elapsed = time.perf_counter() - ctx.start
             self._m_latency.observe(elapsed)
-            if elapsed >= self.slow_threshold_s:
+            slow = elapsed >= self.slow_threshold_s
+            finish_trace(handle, slow=slow)
+            if slow:
                 logger.warning(
                     "slow query (request %d, %.3fs): %s",
                     ctx.request_id, elapsed, sql[:500],
@@ -163,5 +167,8 @@ class Proxy:
                         "elapsed_s": round(elapsed, 4),
                         "sql": sql[:500],
                         "at": time.time(),
+                        # the request's whole span tree rides with the
+                        # slow-log entry (ref: SlowTimer + trace_metric)
+                        "trace": trace.to_dict(),
                     }
                 )

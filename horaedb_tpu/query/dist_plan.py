@@ -140,35 +140,52 @@ def try_dist_plan(executor, plan: QueryPlan, table, m: dict):
     except PlanNotShippable:
         return None
 
-    from ..utils.runtime import scatter_pool
-    from ..utils.tracectx import get_request_id
+    import contextvars
 
-    trace = {"request_id": get_request_id()}
+    from ..utils.runtime import scatter_pool
+    from ..utils.tracectx import span, wire_context
 
     def run_one(sub):
-        wire = select_to_wire(dataclasses.replace(sub_select, table=sub.name))
-        shipped = getattr(sub, "execute_plan", None)
-        if shipped is not None:
-            out = shipped({"plan": wire, "trace": trace})
-            if out is not None:
-                return out  # (names, columns, nulls, metrics)
-        sub_plan = dataclasses.replace(
-            plan,
-            table=sub.name,
-            select=dataclasses.replace(sub_select, table=sub.name),
-        )
-        rs = executor.execute(sub_plan, sub)
-        return rs.names, rs.columns, rs.nulls, {
-            "partition": sub.name,
-            "local": True,
-            **{k: v for k, v in (rs.metrics or {}).items()
-               if k in ("path", "scan_ms", "rows_scanned", "total_ms")},
-        }
+        # Runs inside a COPY of the coordinator's context: the partition
+        # span lands under the dist_fanout span, and the wire context's
+        # parent_span_id points at THIS partition's span — the owner's
+        # subtree grafts back exactly where it belongs.
+        with span("partition", partition=sub.name):
+            wire = select_to_wire(
+                dataclasses.replace(sub_select, table=sub.name)
+            )
+            shipped = getattr(sub, "execute_plan", None)
+            if shipped is not None:
+                out = shipped(
+                    {"plan": wire, "trace": wire_context() or {"request_id": None}}
+                )
+                if out is not None:
+                    return out  # (names, columns, nulls, metrics)
+            sub_plan = dataclasses.replace(
+                plan,
+                table=sub.name,
+                select=dataclasses.replace(sub_select, table=sub.name),
+            )
+            rs = executor.execute(sub_plan, sub)
+            return rs.names, rs.columns, rs.nulls, {
+                "partition": sub.name,
+                "local": True,
+                **{k: v for k, v in (rs.metrics or {}).items()
+                   if k in ("path", "scan_ms", "rows_scanned", "total_ms")},
+            }
 
-    if len(subs) == 1:
-        parts = [run_one(subs[0])]
-    else:
-        parts = list(scatter_pool().map(run_one, subs))
+    with span("dist_fanout", mode=mode, partitions=len(subs)):
+        if len(subs) == 1:
+            parts = [run_one(subs[0])]
+        else:
+            # one context copy per task — a single Context can't be
+            # entered by two pool threads at once
+            ctxs = [contextvars.copy_context() for _ in subs]
+            parts = list(
+                scatter_pool().map(
+                    lambda cs: cs[0].run(run_one, cs[1]), zip(ctxs, subs)
+                )
+            )
 
     from .executor import ResultSet, _order_and_limit
 

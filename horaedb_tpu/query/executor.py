@@ -491,6 +491,8 @@ class Executor:
             out = try_dist_plan(self, plan, table, m)
             if out is not None:
                 return self._finish_metrics(m, t_start, "dist-plan", out)
+        from ..utils.tracectx import span as _span
+
         t_scan = _time.perf_counter()
         projection = self._projection(plan)
         predicate = plan.predicate
@@ -509,18 +511,23 @@ class Executor:
                 # only the append scan actually early-stops; don't claim
                 # the optimization on dedup scans that ignore the hint
                 m["limit_pushdown"] = plan.select.limit
-        rows = table.read(predicate, projection=projection)
+        with _span("scan", table=plan.table) as sp:
+            rows = table.read(predicate, projection=projection)
+            sp.set(rows=len(rows))
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)
         if plan.is_aggregate and route != "host" and self._device_capable(plan, rows):
-            out = self._execute_agg_device(plan, rows, m)
+            with _span("aggregate", path="device"):
+                out = self._execute_agg_device(plan, rows, m)
             path = "device-dist" if "mesh_devices" in m else "device"
         elif plan.is_aggregate:
             path = "host"
-            out = self._execute_agg_host(plan, rows)
+            with _span("aggregate", path="host"):
+                out = self._execute_agg_host(plan, rows)
         else:
             path = "host"
-            out = self._execute_projection(plan, rows)
+            with _span("project"):
+                out = self._execute_projection(plan, rows)
         return self._finish_metrics(m, t_start, path, out)
 
     def _finish_metrics(
@@ -627,22 +634,27 @@ class Executor:
             return None  # shape not pushable: gather-rows fallback below
         if bounded_hint:
             spec["bounded_hint"] = True
-        from ..utils.tracectx import get_request_id
+        from ..utils.tracectx import span as _span, wire_context
 
-        rid = get_request_id()
-        if rid is not None:
-            spec["trace"] = {"request_id": rid}
-            m["request_id"] = rid
-        names, arrays, stage_metrics = table.partial_agg(spec)
-        combined, n_groups = combine_partials([(names, arrays)], spec)
-        rule = getattr(table, "rule", None)  # plain tables: bounded path
-        if rule is not None:
-            keep = rule.prune(plan.predicate)
-            m["partitions"] = (
-                len(keep) if keep is not None else len(table.sub_tables)
-            )
-        m["partial_stages"] = stage_metrics
-        return assemble_result(plan, combined, n_groups, spec)
+        wire = wire_context()
+        if wire is not None:
+            # remote partitions serve under the coordinator's trace id and
+            # ship their span subtree home in the RPC response
+            spec["trace"] = wire
+            m["request_id"] = wire["request_id"]
+        with _span("partial_agg", table=plan.table):
+            names, arrays, stage_metrics = table.partial_agg(spec)
+        with _span("combine") as sp:
+            combined, n_groups = combine_partials([(names, arrays)], spec)
+            sp.set(groups=n_groups)
+            rule = getattr(table, "rule", None)  # plain tables: bounded path
+            if rule is not None:
+                keep = rule.prune(plan.predicate)
+                m["partitions"] = (
+                    len(keep) if keep is not None else len(table.sub_tables)
+                )
+            m["partial_stages"] = stage_metrics
+            return assemble_result(plan, combined, n_groups, spec)
 
     # ---- device path -------------------------------------------------------
     def _agg_device_shape(self, plan: QueryPlan):

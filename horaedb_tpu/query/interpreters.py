@@ -268,22 +268,51 @@ class InterpreterFactory:
                 )
         if analyze:
             # EXPLAIN ANALYZE: actually run the query and report observed
-            # execution (ref: EXPLAIN ANALYZE carrying runtime metrics).
+            # execution (ref: EXPLAIN ANALYZE carrying runtime metrics +
+            # the formatted trace_metric span tree).
             import time as _time
 
-            t0 = _time.perf_counter()
-            out = self.executor.execute(q, table)
-            elapsed = (_time.perf_counter() - t0) * 1000
-            lines.append(
-                f"  Analyzed: path={self.executor.last_path} "
-                f"rows={out.num_rows} elapsed={elapsed:.2f}ms"
+            from ..utils.tracectx import (
+                current_trace,
+                finish_trace,
+                render_tree,
+                span,
+                start_trace,
             )
-            m = out.metrics or {}
-            detail = ", ".join(
-                f"{k}={v}" for k, v in m.items() if k not in ("table", "path")
-            )
-            if detail:
-                lines.append(f"  Metrics: {detail}")
+
+            trace = current_trace()
+            handle = None
+            if trace is None:
+                # direct embedded call (no proxy): own the trace so the
+                # tree still lands in TRACE_STORE / /debug/trace
+                trace, handle = start_trace(
+                    f"explain-{id(q):x}", "explain_analyze", table=q.table
+                )
+            try:
+                t0 = _time.perf_counter()
+                with span("analyze", table=q.table):
+                    out = self.executor.execute(q, table)
+                elapsed = (_time.perf_counter() - t0) * 1000
+                lines.append(
+                    f"  Analyzed: path={self.executor.last_path} "
+                    f"rows={out.num_rows} elapsed={elapsed:.2f}ms"
+                )
+                m = out.metrics or {}
+                detail = ", ".join(
+                    f"{k}={v}" for k, v in m.items() if k not in ("table", "path")
+                )
+                if detail:
+                    lines.append(f"  Metrics: {detail}")
+                if handle is not None:
+                    trace.root.finish()  # owned: closed before rendering
+                tree = trace.to_dict()["root"]
+                lines.append(f"  Trace: request_id={trace.trace_id}")
+                lines.extend("    " + l for l in render_tree(tree, 0))
+            finally:
+                # an execute error must still reset the ContextVars — a
+                # leaked trace would swallow every later query's spans
+                if handle is not None:
+                    finish_trace(handle)
         return lines
 
     # ---- variants -----------------------------------------------------------

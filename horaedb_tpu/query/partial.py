@@ -111,18 +111,22 @@ def compute_partial(
         spec.get("bounded_hint")
         or _scan_estimate_bytes(table, pred, projection) > cap_bytes
     ):
+        from ..utils.tracectx import span
+
         all_names: list[str] | None = None
         parts: list[list[np.ndarray]] = []
         windows = 0
         t_scan = _time.perf_counter()
         rows_seen = 0
-        for rows in table.read_windows(pred, projection=projection):
-            windows += 1
-            rows_seen += len(rows)
-            names, arrays = _partial_on_rows(rows, spec)
-            if arrays and len(arrays[0]):
-                all_names = names
-                parts.append(arrays)
+        with span("partial_windowed", table=table.name) as sp:
+            for rows in table.read_windows(pred, projection=projection):
+                windows += 1
+                rows_seen += len(rows)
+                names, arrays = _partial_on_rows(rows, spec)
+                if arrays and len(arrays[0]):
+                    all_names = names
+                    parts.append(arrays)
+            sp.set(windows=windows, rows=rows_seen)
         if m is not None:
             m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
             m["rows_scanned"] = rows_seen
@@ -137,14 +141,21 @@ def compute_partial(
             for i in range(len(all_names))
         ]
 
+    from ..utils.tracectx import span
+
     t_scan = _time.perf_counter()
-    rows = table.read(pred, projection=projection)
+    with span("scan", table=table.name) as sp:
+        rows = table.read(pred, projection=projection)
+        sp.set(rows=len(rows))
     if m is not None:
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)
 
     t_agg = _time.perf_counter()
-    out = _partial_on_rows(rows, spec, m)
+    with span("partial") as sp:
+        out = _partial_on_rows(rows, spec, m)
+        if m is not None and "path" in m:
+            sp.set(path=m["path"])
     if m is not None:
         m["agg_ms"] = round((_time.perf_counter() - t_agg) * 1000, 3)
     return out

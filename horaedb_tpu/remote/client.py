@@ -22,6 +22,7 @@ from ..common_types.schema import Schema
 from ..engine.options import TableOptions
 from ..table_engine.predicate import Predicate
 from ..table_engine.table import Table
+from ..utils.tracectx import graft, wire_context
 from .codec import (
     columns_from_ipc,
     pack,
@@ -96,12 +97,17 @@ class RemoteEngineClient:
                 "table": table,
                 "predicate": predicate_to_dict(predicate or Predicate.all_time()),
                 "projection": list(projection) if projection is not None else None,
+                "trace": wire_context(),
             },
         )
+        graft(out.get("span"), endpoint=self.endpoint)
         return rows_from_ipc(project_schema(schema, projection), out["ipc"])
 
     def partial_agg(self, table: str, spec: dict):
         out = self._call("PartialAgg", {"table": table, "spec": spec})
+        # the owner's span subtree comes home in the response and grafts
+        # under the coordinator's current span (ref: RemoteTaskContext)
+        graft(out.get("span"), endpoint=self.endpoint)
         names, arrays = columns_from_ipc(out["ipc"])
         return names, arrays, out.get("metrics") or {}
 
@@ -123,8 +129,11 @@ class RemoteEngineClient:
                 "predicate": predicate_to_dict(predicate or Predicate.all_time()),
                 "projection": list(projection) if projection is not None else None,
                 "after": after,
+                "trace": wire_context(),
             },
         )
+        # every page's remote span grafts under the ONE coordinator trace
+        graft(out.get("span"), endpoint=self.endpoint)
         rows = None
         if out.get("ipc") is not None:
             rows = rows_from_ipc(project_schema(schema, projection), out["ipc"])
@@ -158,6 +167,7 @@ class RemoteEngineClient:
         from .codec import result_from_ipc
 
         out = self._call("ExecutePlan", {"table": table, **req})
+        graft(out.get("span"), endpoint=self.endpoint)
         names, columns, nulls = result_from_ipc(out["ipc"])
         return names, columns, nulls, out.get("metrics") or {}
 

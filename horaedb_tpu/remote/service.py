@@ -26,6 +26,7 @@ from typing import Optional
 import grpc
 
 from ..common_types.row_group import RowGroup
+from ..utils.tracectx import root_dict, serving_trace, span
 from .codec import (
     columns_to_ipc,
     pack,
@@ -139,11 +140,16 @@ class GrpcServer:
         return {"affected": len(rows)}
 
     def _read(self, req: dict) -> dict:
-        t = self._open(req["table"])
-        pred = predicate_from_dict(req["predicate"]) if req.get("predicate") else None
-        projection = req.get("projection")
-        rows = t.read(pred, projection=projection)
-        return {"ipc": rows_to_ipc(rows)}
+        with serving_trace(
+            req.get("trace"), "remote_read", table=req["table"]
+        ) as trace:
+            t = self._open(req["table"])
+            pred = predicate_from_dict(req["predicate"]) if req.get("predicate") else None
+            projection = req.get("projection")
+            with span("scan", table=req["table"]) as sp:
+                rows = t.read(pred, projection=projection)
+                sp.set(rows=len(rows))
+        return {"ipc": rows_to_ipc(rows), "span": root_dict(trace)}
 
     def _read_page(self, req: dict) -> dict:
         """Streaming read, one segment window per RPC (ref: the reference
@@ -153,17 +159,27 @@ class GrpcServer:
         is stateless pagination by WINDOW START — same correctness basis
         as the bounded scan: a key's versions never straddle windows).
 
-        req: {table, predicate?, projection?, after?} — ``after`` is the
-        previous page's ``next`` token (an exclusive window-start lower
-        bound). -> {ipc, next} where next=None terminates the stream."""
+        req: {table, predicate?, projection?, after?, trace?} — ``after``
+        is the previous page's ``next`` token (an exclusive window-start
+        lower bound). -> {ipc, next, span} where next=None terminates the
+        stream; ``span`` is this page's span subtree when the caller sent
+        trace context (each page grafts under the ONE coordinator trace)."""
         from ..table_engine.table import read_one_page
 
-        t = self._open(req["table"])
-        pred = predicate_from_dict(req["predicate"]) if req.get("predicate") else None
-        rows, nxt = read_one_page(t, pred, req.get("projection"), req.get("after"))
+        with serving_trace(
+            req.get("trace"), "remote_read_page", table=req["table"]
+        ) as trace:
+            t = self._open(req["table"])
+            pred = predicate_from_dict(req["predicate"]) if req.get("predicate") else None
+            with span("scan_window", after=req.get("after")) as sp:
+                rows, nxt = read_one_page(
+                    t, pred, req.get("projection"), req.get("after")
+                )
+                sp.set(rows=0 if rows is None else len(rows))
         return {
             "ipc": rows_to_ipc(rows) if rows is not None else None,
             "next": nxt,
+            "span": root_dict(trace),
         }
 
     def _partial_agg(self, req: dict) -> dict:
@@ -172,9 +188,13 @@ class GrpcServer:
         from ..query.partial import compute_partial
 
         t0 = time.perf_counter()
-        t = self._open(req["table"])
-        sub: dict = {}
-        names, arrays = compute_partial(t, req["spec"], sub)
+        trace_ctx = (req["spec"] or {}).get("trace")
+        with serving_trace(
+            trace_ctx, "remote_partial_agg", table=req["table"]
+        ) as trace:
+            t = self._open(req["table"])
+            sub: dict = {}
+            names, arrays = compute_partial(t, req["spec"], sub)
         metrics = {
             **sub,
             "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
@@ -183,11 +203,10 @@ class GrpcServer:
         # Span ring keyed by the COORDINATOR'S request id (shipped in the
         # spec's trace): /debug/remote_spans on this node correlates with
         # the origin's slow-log/EXPLAIN ANALYZE by that id.
-        trace = (req["spec"] or {}).get("trace") or {}
         with self.conn.remote_spans_lock:
             self.conn.remote_spans.append(
                 {
-                    "request_id": trace.get("request_id"),
+                    "request_id": (trace_ctx or {}).get("request_id"),
                     "table": req["table"],
                     "at": time.time(),
                     **metrics,
@@ -196,8 +215,10 @@ class GrpcServer:
         return {
             "ipc": columns_to_ipc(names, arrays),
             # stage metrics ride home for EXPLAIN ANALYZE (ref: the
-            # reference's RemoteTaskContext.remote_metrics)
+            # reference's RemoteTaskContext.remote_metrics), and the span
+            # subtree grafts into the coordinator's trace
             "metrics": metrics,
+            "span": root_dict(trace),
         }
 
     def _execute_plan(self, req: dict) -> dict:
@@ -216,25 +237,27 @@ class GrpcServer:
 
         t0 = time.perf_counter()
         name = req["table"]
-        t = self._open(name)
-        select = select_from_wire(req["plan"])
-        planner = Planner(
-            lambda n: t.schema if n == name else self.conn.catalog.schema_of(n)
-        )
-        plan = planner.plan(select)
-        executor = self.conn.interpreters.executor
-        rs = executor.execute(plan, t)
+        with serving_trace(
+            req.get("trace"), "remote_execute_plan", table=name
+        ) as trace:
+            t = self._open(name)
+            select = select_from_wire(req["plan"])
+            planner = Planner(
+                lambda n: t.schema if n == name else self.conn.catalog.schema_of(n)
+            )
+            plan = planner.plan(select)
+            executor = self.conn.interpreters.executor
+            rs = executor.execute(plan, t)
         m = rs.metrics or {}
         metrics = {
             "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
             "rows": rs.num_rows,
             **{k: m[k] for k in ("path", "scan_ms", "rows_scanned") if k in m},
         }
-        trace = req.get("trace") or {}
         with self.conn.remote_spans_lock:
             self.conn.remote_spans.append(
                 {
-                    "request_id": trace.get("request_id"),
+                    "request_id": (req.get("trace") or {}).get("request_id"),
                     "table": name,
                     "op": "execute_plan",
                     "at": time.time(),
@@ -244,6 +267,7 @@ class GrpcServer:
         return {
             "ipc": result_to_ipc(rs.names, rs.columns, rs.nulls),
             "metrics": metrics,
+            "span": root_dict(trace),
         }
 
     def _drop_sub(self, req: dict) -> dict:

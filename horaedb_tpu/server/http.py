@@ -19,6 +19,7 @@ config.rs:176):
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
 from typing import Any, Optional
@@ -87,6 +88,18 @@ def _dumps(obj: Any) -> str:
 FORWARD_HEADER = "X-HoraeDB-Forwarded"
 
 
+@functools.lru_cache(maxsize=None)
+def latency_histogram(protocol: str):
+    """Per-protocol labelset of the ONE front-end latency family —
+    every listener (http/mysql/postgres) passes its protocol to
+    ``SqlGateway.execute`` instead of keeping its own timing wrapper."""
+    return REGISTRY.histogram(
+        "horaedb_request_duration_seconds",
+        "front-end request latency by protocol",
+        labels={"protocol": protocol},
+    )
+
+
 def _write_fence(cluster, router, table: str) -> Optional[tuple[int, str]]:
     """Single-writer discipline for the write paths (cluster mode).
 
@@ -146,7 +159,20 @@ class SqlGateway:
             "horaedb_read_dedup_total", "reads served from an in-flight twin"
         )
 
-    async def execute(self, query: str, already_forwarded: bool = False):
+    async def execute(
+        self,
+        query: str,
+        already_forwarded: bool = False,
+        protocol: str | None = None,
+    ):
+        if protocol is not None:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            try:
+                return await self.execute(query, already_forwarded)
+            finally:
+                latency_histogram(protocol).observe(_time.perf_counter() - t0)
         app = self.app
         conn: Connection = app["conn"]
         proxy: Proxy = app["proxy"]
@@ -360,7 +386,9 @@ def create_app(
         if not isinstance(query, str) or not query.strip():
             return web.json_response({"error": "missing 'query'"}, status=400)
         kind, payload = await gateway.execute(
-            query, already_forwarded=bool(request.headers.get(FORWARD_HEADER))
+            query,
+            already_forwarded=bool(request.headers.get(FORWARD_HEADER)),
+            protocol="http",
         )
         if kind == "error":
             status, msg = payload
@@ -648,7 +676,14 @@ def create_app(
 
     # ---- observability -------------------------------------------------
     async def metrics(request: web.Request) -> web.Response:
-        return web.Response(text=REGISTRY.expose(), content_type="text/plain")
+        # Prometheus exposition content type (version param included —
+        # some scrapers refuse bare text/plain).
+        return web.Response(
+            body=REGISTRY.expose().encode("utf-8"),
+            headers={
+                "Content-Type": "text/plain; version=0.0.4; charset=utf-8"
+            },
+        )
 
     async def health(request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -794,6 +829,32 @@ def create_app(
         return web.Response(
             text=_dumps(list(proxy.slow_queries)), content_type="application/json"
         )
+
+    async def debug_trace_list(request: web.Request) -> web.Response:
+        """Recent + slow trace summaries from the bounded in-process
+        store (ref: trace_metric's collector surfaces)."""
+        from ..utils.tracectx import TRACE_STORE
+
+        return web.Response(
+            text=_dumps({"traces": TRACE_STORE.list()}),
+            content_type="application/json",
+        )
+
+    async def debug_trace_get(request: web.Request) -> web.Response:
+        """Full span tree of one request, by its request/trace id."""
+        from ..utils.tracectx import TRACE_STORE
+
+        raw = request.match_info["request_id"]
+        try:
+            key = int(raw)
+        except ValueError:
+            key = raw
+        entry = TRACE_STORE.get(key)
+        if entry is None:
+            return web.json_response(
+                {"error": f"no trace for request id {raw!r}"}, status=404
+            )
+        return web.Response(text=_dumps(entry), content_type="application/json")
 
     async def debug_remote_spans(request: web.Request) -> web.Response:
         """Remote partial-agg spans served BY this node, keyed by the
@@ -1015,6 +1076,8 @@ def create_app(
     app.router.add_get("/debug/profile/heap/{seconds}", debug_profile_heap)
     app.router.add_put("/debug/log_level/{level}", debug_log_level)
     app.router.add_get("/debug/slow_log", debug_slow_log)
+    app.router.add_get("/debug/trace", debug_trace_list)
+    app.router.add_get("/debug/trace/{request_id}", debug_trace_get)
     app.router.add_get("/debug/shards", debug_shards)
     app.router.add_get("/debug/wal_stats", debug_wal_stats)
     app.router.add_get("/debug/compaction", debug_compaction)
@@ -1198,9 +1261,11 @@ def run_server(
             for s in wire_servers:
                 try:
                     await s.start()
-                except OSError as e:
+                except (OSError, OverflowError, ValueError) as e:
                     # A busy derived port must not take down the node's
                     # HTTP serving — wire listeners are best-effort.
+                    # (OverflowError/ValueError: an HTTP port near the top
+                    # of the range derives a +2000/+3000 port past 65535.)
                     logger.warning(
                         "wire listener %s failed to bind: %s",
                         type(s).__name__, e,

@@ -329,8 +329,9 @@ class _Conn:
                 self._result_set(fed[1], fed[2])
             return
         # The shared gateway applies routing, fences, limiter, metrics —
-        # wire traffic gets the same discipline as HTTP /sql.
-        kind, payload = await self.gateway.execute(q)
+        # wire traffic gets the same discipline as HTTP /sql (including
+        # the per-protocol latency labelset).
+        kind, payload = await self.gateway.execute(q, protocol="mysql")
         if kind == "error":
             _, msg = payload
             self._error(msg)
@@ -412,7 +413,9 @@ class _Conn:
         sql = st["sql"]
         for pos, v in zip(reversed(spots), reversed(params)):
             sql = sql[:pos] + _sql_literal(v) + sql[pos + 1:]
-        kind, payload = await self.gateway.execute(sql.strip().rstrip(";"))
+        kind, payload = await self.gateway.execute(
+            sql.strip().rstrip(";"), protocol="mysql"
+        )
         if kind == "error":
             self._error(payload[1])
         elif kind == "affected":

@@ -184,7 +184,9 @@ class _Conn:
             off += 2
         sql = _substitute(self._stmts[stmt], params)
         # run now so Describe(portal) can answer with the real row shape
-        kind, payload = await self.gateway.execute(sql.strip().rstrip(";"))
+        kind, payload = await self.gateway.execute(
+            sql.strip().rstrip(";"), protocol="postgres"
+        )
         if kind == "error":
             raise _ExtError(payload[1])
         self._portals[portal] = (kind, payload, sql, 0)  # 0 = row cursor
@@ -274,8 +276,9 @@ class _Conn:
             self.writer.write(_msg(b"C", _cstr(tag)))
             self._ready()
             return
-        # The shared gateway applies routing, fences, limiter, metrics.
-        kind, payload = await self.gateway.execute(q)
+        # The shared gateway applies routing, fences, limiter, metrics —
+        # including the per-protocol latency labelset.
+        kind, payload = await self.gateway.execute(q, protocol="postgres")
         if kind == "error":
             _, msg = payload
             self._error(msg)

@@ -202,7 +202,22 @@ class PartitionedTable(Table):
         )
         if len(targets) == 1:
             return targets[0].partial_agg(spec)
-        parts = list(scatter_pool().map(lambda t: t.partial_agg(spec), targets))
+        import contextvars
+
+        from ..utils.tracectx import span
+
+        def one(t):
+            # copied context per task: partition spans (and remote span
+            # grafts from the wire) attach under the coordinator's tree
+            with span("partition", partition=t.name):
+                return t.partial_agg(spec)
+
+        ctxs = [contextvars.copy_context() for _ in targets]
+        parts = list(
+            scatter_pool().map(
+                lambda ct: ct[0].run(one, ct[1]), zip(ctxs, targets)
+            )
+        )
         names = None
         merged: dict[str, list] = {}
         stage_metrics: list = []

@@ -24,15 +24,87 @@ Env overrides: HORAEDB_HTTP_PORT, HORAEDB_HOST, HORAEDB_DATA_DIR.
 from __future__ import annotations
 
 import os
-import tomllib
 from dataclasses import dataclass, field
 from typing import Any, Optional
+
+try:
+    import tomllib  # Python 3.11+
+except ImportError:  # 3.10: fall back to the minimal subset parser below
+    tomllib = None
 
 from ..engine.options import parse_duration_ms, parse_size_bytes
 
 
 class ConfigError(ValueError):
     pass
+
+
+def _strip_toml_comment(line: str) -> str:
+    """Drop a trailing ``# comment`` — only a ``#`` OUTSIDE quoted
+    strings starts one (``"#"`` inside a value must survive)."""
+    quote = None
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in ("'", '"'):
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def _minitoml_value(v: str, lineno: int) -> Any:
+    import json
+
+    if v.startswith("'") and v.endswith("'") and len(v) >= 2:
+        return v[1:-1]  # TOML literal string: no escapes
+    if v.startswith('"') or v.startswith("["):
+        # quoted strings and inline string/number arrays are valid JSON
+        try:
+            return json.loads(v)
+        except json.JSONDecodeError as e:
+            raise ConfigError(f"bad TOML value at line {lineno}: {v!r}") from e
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        raise ConfigError(f"bad TOML value at line {lineno}: {v!r}")
+
+
+def _minitoml_loads(text: str) -> dict:
+    """Minimal TOML subset parser (sections incl. dotted, key = value
+    with strings / ints / floats / booleans / inline arrays) — only used
+    when the stdlib ``tomllib`` is absent (Python < 3.11). Covers every
+    shape this module documents; anything fancier errors loudly."""
+    root: dict[str, Any] = {}
+    cur = root
+    for lineno, raw_line in enumerate(text.splitlines(), 1):
+        line = _strip_toml_comment(raw_line).strip()
+        if not line:
+            continue
+        if line.startswith("["):
+            if not line.endswith("]"):
+                raise ConfigError(f"bad TOML section at line {lineno}")
+            cur = root
+            for part in line[1:-1].strip().split("."):
+                nxt = cur.setdefault(part.strip(), {})
+                if not isinstance(nxt, dict):
+                    raise ConfigError(
+                        f"section {part!r} collides with a value (line {lineno})"
+                    )
+                cur = nxt
+            continue
+        key, eq, value = line.partition("=")
+        if not eq:
+            raise ConfigError(f"bad TOML line {lineno}: {raw_line!r}")
+        cur[key.strip()] = _minitoml_value(value.strip(), lineno)
+    return root
 
 
 @dataclass
@@ -105,8 +177,12 @@ class Config:
     def load(path: Optional[str] = None) -> "Config":
         raw: dict[str, Any] = {}
         if path is not None:
-            with open(path, "rb") as f:
-                raw = tomllib.load(f)
+            if tomllib is not None:
+                with open(path, "rb") as f:
+                    raw = tomllib.load(f)
+            else:
+                with open(path, "r", encoding="utf-8") as f:
+                    raw = _minitoml_loads(f.read())
         cfg = Config()
         _apply(cfg, raw)
         _apply_env(cfg)

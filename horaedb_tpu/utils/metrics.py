@@ -78,9 +78,11 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0)
 
 
 class Histogram:
-    def __init__(self, name: str, help_: str, buckets: Sequence[float] = DEFAULT_BUCKETS):
+    def __init__(self, name: str, help_: str, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 labels: dict[str, str] | None = None):
         self.name = name
         self.help = help_
+        self.labels = dict(labels) if labels else {}
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -106,14 +108,27 @@ class Histogram:
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} histogram\n"
         )
+        # Per-labelset samples: the labels merge INTO the bucket braces
+        # alongside ``le`` (one family header, many labelsets — same
+        # rendering rule the Counter/Gauge families follow).
+        base = sorted(self.labels.items())
+        suffix = _render_labels(self.labels)
         out = []
         acc = 0
         for le, c in zip(self.buckets, counts):
             acc += c
-            out.append(f'{self.name}_bucket{{le="{le}"}} {acc}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        out.append(f"{self.name}_sum {sum_}")
-        out.append(f"{self.name}_count {total}")
+            inner = ",".join(
+                f'{k}="{_escape_label_value(v)}"'
+                for k, v in (*base, ("le", str(le)))
+            )
+            out.append(f"{self.name}_bucket{{{inner}}} {acc}")
+        inner = ",".join(
+            f'{k}="{_escape_label_value(v)}"'
+            for k, v in (*base, ("le", "+Inf"))
+        )
+        out.append(f"{self.name}_bucket{{{inner}}} {total}")
+        out.append(f"{self.name}_sum{suffix} {sum_}")
+        out.append(f"{self.name}_count{suffix} {total}")
         return header, "\n".join(out) + "\n"
 
     def expose(self) -> str:
@@ -152,8 +167,26 @@ class Registry:
         key = name + _render_labels(labels)
         return self._get(key, lambda: Gauge(name, help_, labels), Gauge)
 
-    def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
-        return self._get(name, lambda: Histogram(name, help_, buckets), Histogram)
+    def histogram(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS,
+                  labels: dict[str, str] | None = None) -> Histogram:
+        key = name + _render_labels(labels)
+        return self._get(key, lambda: Histogram(name, help_, buckets, labels), Histogram)
+
+    def remove(self, name: str, labels: dict[str, str] | None = None) -> None:
+        """Unregister one labelset (per-entity series — e.g. a dropped
+        table's memtable gauge — must not pin the registry forever)."""
+        with self._lock:
+            self._metrics.pop(name + _render_labels(labels), None)
+
+    def families(self) -> dict[str, list]:
+        """Live family name -> member metrics (the metrics-name lint and
+        other introspection walk this instead of parsing exposition)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict[str, list] = {}
+        for m in metrics:
+            out.setdefault(m.name, []).append(m)
+        return out
 
     def expose(self) -> str:
         with self._lock:

@@ -198,15 +198,15 @@ class DiskCacheStore(ObjectStore):
         from .metrics import REGISTRY
 
         self._m_hits = REGISTRY.counter(
-            "object_store_page_cache_hits_total",
+            "horaedb_object_store_page_cache_hits_total",
             "disk page cache hits (all DiskCacheStore instances)",
         )
         self._m_misses = REGISTRY.counter(
-            "object_store_page_cache_misses_total",
+            "horaedb_object_store_page_cache_misses_total",
             "disk page cache misses (cold fetches from the inner store)",
         )
         self._m_prefetch = REGISTRY.counter(
-            "object_store_prefetch_objects_total",
+            "horaedb_object_store_prefetch_objects_total",
             "objects queued for background prefetch",
         )
         self._load_index()

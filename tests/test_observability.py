@@ -340,9 +340,9 @@ class TestEngineMetrics:
     def test_flush_and_compaction_metrics_recorded(self, tmp_path):
         from horaedb_tpu.utils.metrics import REGISTRY
 
-        flush_rows = REGISTRY.counter("engine_flush_rows_total")
-        comp_tasks = REGISTRY.counter("engine_compaction_tasks_total")
-        req = REGISTRY.counter("engine_compaction_requests_total")
+        flush_rows = REGISTRY.counter("horaedb_flush_rows_total")
+        comp_tasks = REGISTRY.counter("horaedb_compaction_tasks_total")
+        req = REGISTRY.counter("horaedb_compaction_requests_total")
         before = (flush_rows.value, comp_tasks.value, req.value)
         db = horaedb_tpu.connect(str(tmp_path / "m"))
         db.execute(
@@ -363,8 +363,8 @@ class TestEngineMetrics:
         assert flush_rows.value > before[0]
         assert req.value > before[2]
         assert comp_tasks.value > before[1]
-        assert REGISTRY.histogram("engine_flush_duration_seconds").count > 0
-        assert REGISTRY.histogram("engine_compaction_duration_seconds").count > 0
+        assert REGISTRY.histogram("horaedb_flush_duration_seconds").count > 0
+        assert REGISTRY.histogram("horaedb_compaction_duration_seconds").count > 0
 
     def test_procedure_terminal_metrics(self):
         from horaedb_tpu.meta.kv import MemoryKV
@@ -372,15 +372,15 @@ class TestEngineMetrics:
         from horaedb_tpu.utils.metrics import REGISTRY
 
         ok = REGISTRY.counter(
-            "meta_procedure_terminal_total",
+            "horaedb_meta_procedure_terminal_total",
             labels={"kind": "noop", "outcome": "finished"},
         )
         fail = REGISTRY.counter(
-            "meta_procedure_terminal_total",
+            "horaedb_meta_procedure_terminal_total",
             labels={"kind": "boom", "outcome": "failed"},
         )
         retries = REGISTRY.counter(
-            "meta_procedure_retries_total", labels={"kind": "boom"}
+            "horaedb_meta_procedure_retries_total", labels={"kind": "boom"}
         )
         before = (ok.value, fail.value, retries.value)
         def _boom(p):
@@ -428,3 +428,301 @@ class TestCompactionDebugSurface:
             assert conn.instance.compaction_stats()["closed"] is True
 
         asyncio.run(run())
+
+
+class TestSpanTracing:
+    """Hierarchical span tree (ref: trace_metric MetricsCollector): a
+    ContextVar-carried tree, cheap no-op outside a trace, bounded rings."""
+
+    def test_span_tree_nesting_and_attrs(self):
+        from horaedb_tpu.utils.tracectx import (
+            finish_trace, get_request_id, span, start_trace,
+        )
+
+        trace, handle = start_trace(1234, "sql", sql="SELECT 1")
+        assert get_request_id() == 1234  # legacy flat id still set
+        with span("parse") as p:
+            p.set(plan_cache="miss")
+        with span("execute"):
+            with span("scan") as s:
+                s.set(rows=10)
+        finish_trace(handle)
+        root = trace.to_dict()["root"]
+        assert root["name"] == "sql" and root["duration_ms"] >= 0
+        names = [c["name"] for c in root["children"]]
+        assert names == ["parse", "execute"]
+        scan = root["children"][1]["children"][0]
+        assert scan["name"] == "scan" and scan["attrs"]["rows"] == 10
+        assert scan["parent_id"] == root["children"][1]["span_id"]
+        assert get_request_id() is None  # context restored
+
+    def test_no_trace_is_cheap_noop(self):
+        from horaedb_tpu.utils.tracectx import current_span, span
+
+        assert current_span() is None
+        with span("anything", x=1) as s:
+            s.set(y=2)  # absorbed, nothing recorded anywhere
+        assert current_span() is None
+
+    def test_children_bounded(self):
+        from horaedb_tpu.utils.tracectx import (
+            MAX_CHILDREN, finish_trace, span, start_trace,
+        )
+
+        trace, handle = start_trace(1, "flood")
+        for i in range(MAX_CHILDREN + 7):
+            with span(f"s{i}"):
+                pass
+        finish_trace(handle)
+        root = trace.to_dict()["root"]
+        assert len(root["children"]) == MAX_CHILDREN
+        assert root["dropped_children"] == 7
+
+    def test_graft_marks_remote_origin(self):
+        from horaedb_tpu.utils.tracectx import (
+            finish_trace, graft, start_trace,
+        )
+
+        trace, handle = start_trace(2, "sql")
+        graft(
+            {"name": "remote_partial_agg", "duration_ms": 1.5,
+             "attrs": {"path": "kernel"},
+             "children": [{"name": "scan", "duration_ms": 1.0}]},
+            endpoint="10.0.0.2:8831",
+        )
+        finish_trace(handle)
+        r = trace.to_dict()["root"]["children"][0]
+        assert r["attrs"]["origin"] == "remote"
+        assert r["attrs"]["endpoint"] == "10.0.0.2:8831"
+        assert r["duration_ms"] == 1.5
+        # grafted child keeps remote marking and renumbered parentage
+        assert r["children"][0]["parent_id"] == r["span_id"]
+
+    def test_trace_store_rings_capped(self):
+        from horaedb_tpu.utils.tracectx import Trace, TraceStore
+
+        store = TraceStore(recent=4, slow=8)
+        for i in range(20):
+            store.record(Trace(i, "sql"), slow=(i % 2 == 0))
+        assert len(store._recent) == 4 and len(store._slow) == 8
+        # slow traces stay findable after falling out of the recent ring
+        assert store.get(10) is not None
+        assert store.get(1) is None  # odd (not slow) + evicted
+
+    def test_http_trace_endpoints_and_slow_log_tree(self):
+        async def body(client):
+            client.server.app["proxy"].slow_threshold_s = 0.0
+            await client.post("/sql", json={"query":
+                "CREATE TABLE tt (h string TAG, v double, ts timestamp KEY)"})
+            await client.post("/sql", json={"query":
+                "INSERT INTO tt (h, v, ts) VALUES ('a', 1.0, 1)"})
+            await client.post("/sql", json={"query":
+                "SELECT h, sum(v) FROM tt GROUP BY h"})
+            recent = await (await client.get("/debug/queries")).json()
+            rid = recent[-1]["request_id"]
+            listing = await (await client.get("/debug/trace")).json()
+            assert any(t["trace_id"] == rid for t in listing["traces"])
+            resp = await client.get(f"/debug/trace/{rid}")
+            assert resp.status == 200
+            tree = await resp.json()
+            assert tree["trace_id"] == rid
+            names = {c["name"] for c in tree["root"]["children"]}
+            assert "parse_plan" in names and "execute" in names
+            assert (await client.get("/debug/trace/999999")).status == 404
+            # the slow log carries the same span tree per request
+            slow = await (await client.get("/debug/slow_log")).json()
+            assert slow[-1]["trace"]["root"]["name"] == "sql"
+
+        with_client(body)
+
+    def test_explain_analyze_renders_span_tree(self):
+        from horaedb_tpu.utils.tracectx import TRACE_STORE
+
+        db = horaedb_tpu.connect(None)
+        db.execute("CREATE TABLE ea (h string TAG, v double, ts timestamp KEY)")
+        db.execute("INSERT INTO ea (h, v, ts) VALUES ('a', 1.0, 1)")
+        lines = [
+            r["plan"]
+            for r in db.execute(
+                "EXPLAIN ANALYZE SELECT h, sum(v) FROM ea GROUP BY h"
+            ).to_pylist()
+        ]
+        text = "\n".join(lines)
+        assert "Trace: request_id=" in text
+        rid = text.split("Trace: request_id=")[1].splitlines()[0].strip()
+        assert "analyze" in text
+        # same tree retrievable from the store (what /debug/trace serves)
+        entry = TRACE_STORE.get(rid)
+        assert entry is not None
+        assert entry["root"]["children"][0]["name"] == "analyze"
+        db.close()
+
+
+class TestLabeledHistogram:
+    def test_per_labelset_exposition(self):
+        from horaedb_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        h1 = reg.histogram("req_seconds", "latency", labels={"protocol": "mysql"})
+        h2 = reg.histogram("req_seconds", "latency", labels={"protocol": "pg"})
+        assert reg.histogram("req_seconds", labels={"protocol": "mysql"}) is h1
+        h1.observe(0.002)
+        h1.observe(0.2)
+        h2.observe(5.0)
+        text = reg.expose()
+        # ONE family header, per-labelset bucket/sum/count lines
+        assert text.count("# TYPE req_seconds histogram") == 1
+        assert 'req_seconds_bucket{protocol="mysql",le="+Inf"} 2' in text
+        assert 'req_seconds_bucket{protocol="pg",le="+Inf"} 1' in text
+        assert 'req_seconds_count{protocol="mysql"} 2' in text
+        assert 'req_seconds_sum{protocol="pg"} 5.0' in text
+        # bucket cumulative counts stay correct per labelset
+        assert 'req_seconds_bucket{protocol="mysql",le="0.005"} 1' in text
+
+    def test_histogram_labelset_kind_mismatch(self):
+        import pytest as _pytest
+
+        from horaedb_tpu.utils.metrics import Registry
+
+        reg = Registry()
+        reg.histogram("x_seconds", labels={"a": "1"})
+        with _pytest.raises(TypeError):
+            reg.counter("x_seconds", labels={"a": "1"})
+
+
+class TestPrometheusContentType:
+    def test_metrics_exposition_content_type(self):
+        async def body(client):
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == (
+                "text/plain; version=0.0.4; charset=utf-8"
+            )
+            assert "horaedb_queries_total" in await resp.text()
+
+        with_client(body)
+
+
+class TestWireProtocolLatency:
+    """Front-end parity: MySQL and PostgreSQL record request-latency
+    histograms in the same labeled family the HTTP path uses."""
+
+    def test_mysql_and_pg_request_histograms(self):
+        import socket
+
+        from horaedb_tpu.server.http import latency_histogram
+        from horaedb_tpu.server.mysql import MysqlServer
+        from horaedb_tpu.server.postgres import PostgresServer
+        from test_wire_protocols import MyClient, PgClient, gateway_for
+
+        MY_LAT = latency_histogram("mysql")
+        PG_LAT = latency_histogram("postgres")
+
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE wl (host string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        conn.execute("INSERT INTO wl (host, v, ts) VALUES ('a', 1.5, 1000)")
+        before = (MY_LAT.count, PG_LAT.count)
+
+        def my_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            assert c.query("SELECT host FROM wl")[0] == "rows"
+            s.close()
+
+        def pg_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            names, rows, complete, err = c.query("SELECT host FROM wl")
+            assert err is None and rows == [["a"]]
+            s.close()
+
+        async def body():
+            gw = gateway_for(conn)
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(None, my_client, my.port)
+                await loop.run_in_executor(None, pg_client, pg.port)
+            finally:
+                await my.stop()
+                await pg.stop()
+
+        try:
+            asyncio.run(body())
+        finally:
+            conn.close()
+        assert MY_LAT.count > before[0]
+        assert PG_LAT.count > before[1]
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        text = REGISTRY.expose()
+        assert 'horaedb_request_duration_seconds_count{protocol="mysql"}' in text
+        assert 'horaedb_request_duration_seconds_count{protocol="postgres"}' in text
+
+
+class TestMetricsNameLint:
+    """Metric-name convention lint (satellite): every live family must be
+    horaedb_-prefixed with a unit suffix — prevents the name drift the
+    reference crates suffer from."""
+
+    SUFFIXES = ("_seconds", "_bytes", "_total", "_rows")
+
+    def test_registry_families_follow_convention(self, tmp_path):
+        import re
+
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        # Representative workload: WAL write + flush + query, so the
+        # engine/WAL/query families are all live before the walk.
+        db = horaedb_tpu.connect(str(tmp_path / "lint"))
+        db.execute(
+            "CREATE TABLE lint (h string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute("INSERT INTO lint (h, v, ts) VALUES ('a', 1.0, 100)")
+        db.flush_all()
+        db.execute("SELECT h, sum(v) FROM lint GROUP BY h")
+        db.close()
+
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        bad = []
+        for family in REGISTRY.families():
+            if not pat.match(family) or not family.endswith(self.SUFFIXES):
+                bad.append(family)
+        assert not bad, f"metric families violating naming convention: {bad}"
+
+    def test_engine_families_live_after_flush(self, tmp_path):
+        """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
+        and horaedb_wal_* families after a flush+compaction cycle."""
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        db = horaedb_tpu.connect(str(tmp_path / "fams"))
+        db.execute(
+            "CREATE TABLE fam (h string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+            "WITH (segment_duration='1h')"
+        )
+        for i in range(db.instance.config.compaction_l0_trigger):
+            db.execute(
+                f"INSERT INTO fam (h, v, ts) VALUES ('a', {float(i)}, {100 + i})"
+            )
+            db.catalog.open("fam").flush()
+        db.close()
+        text = REGISTRY.expose()
+        for family in (
+            "horaedb_flush_duration_seconds",
+            "horaedb_flush_bytes_total",
+            "horaedb_compaction_requests_total",
+            "horaedb_wal_append_duration_seconds",
+            "horaedb_memtable_bytes",
+        ):
+            assert f"# TYPE {family}" in text, family
+        assert REGISTRY.histogram("horaedb_wal_append_duration_seconds").count > 0

@@ -444,6 +444,43 @@ class TestDistributedPartitions:
             ])
         assert spans[0] or spans[1], "no ExecutePlan ran on either node"
 
+        # EXPLAIN ANALYZE on the routed query renders the span tree with
+        # at least one remote-origin span, and /debug/trace/{request_id}
+        # on the executing node returns the same tree as JSON.
+        status, out = sql(
+            port_a,
+            "EXPLAIN ANALYZE SELECT host, v, ts FROM wt "
+            "ORDER BY v DESC, ts LIMIT 7",
+        )
+        assert status == 200, out
+        text = "\n".join(r[next(iter(r))] for r in out["rows"])
+        assert "Trace: request_id=" in text, text
+        assert "[remote " in text, text  # remote-origin span rendered
+        rid = text.split("Trace: request_id=")[1].splitlines()[0].strip()
+
+        def walk(node):
+            yield node
+            for c in node.get("children", ()):
+                yield from walk(c)
+
+        found_remote = False
+        for port in (port_a, port_b):  # the statement may have forwarded
+            st, body = http(
+                "GET", f"http://127.0.0.1:{port}/debug/trace/{rid}"
+            )
+            if st != 200:
+                continue
+            remote_nodes = [
+                n for n in walk(body["root"])
+                if (n.get("attrs") or {}).get("origin") == "remote"
+            ]
+            if remote_nodes and all(
+                isinstance(n.get("duration_ms"), (int, float))
+                for n in remote_nodes
+            ):
+                found_remote = True
+        assert found_remote, "no stored trace with remote spans found"
+
     def test_each_node_owns_some_partitions(self, static_cluster, tmp_path):
         port_a, port_b = static_cluster
         ddl = (
@@ -567,6 +604,73 @@ class TestRoutedSubTable:
                 for i in range(4)
             )
             assert got == expect
+        finally:
+            server.stop()
+            owner.close()
+            conn.close()
+
+    def test_read_pages_spans_graft_under_one_trace(self):
+        """Satellite: a routed read_pages stream over multiple windows
+        produces one remote span PER PAGE, all grafted under the ONE
+        coordinator trace id (span context rides every ReadPage RPC)."""
+        from horaedb_tpu.cluster.router import Route
+        from horaedb_tpu.utils.tracectx import (
+            TRACE_STORE, finish_trace, start_trace,
+        )
+
+        router = self._FakeRouter(Route("__rst_0", "local", True, source="owned"))
+        rst, conn = self._mk(router)
+        hour = 3_600_000
+        owner = horaedb_tpu.connect(None)
+        owner.execute(
+            "CREATE TABLE rst (host string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) "
+            "PARTITION BY KEY(host) PARTITIONS 1 ENGINE=Analytic "
+            "WITH (segment_duration='1h')"
+        )
+        owner_rows = [
+            f"('h{i % 2}', {float(w * 100 + i)}, {w * hour + i * 1000})"
+            for w in range(3)
+            for i in range(4)
+        ]
+        owner.execute(
+            "INSERT INTO rst (host, v, ts) VALUES " + ", ".join(owner_rows)
+        )
+        owner.flush_all()
+        server = GrpcServer(owner, port=0)
+        server.start()
+        try:
+            from horaedb_tpu.remote.client import GRPC_PORT_OFFSET
+
+            http_port = server.bound_port - GRPC_PORT_OFFSET
+            router.set(Route(
+                "__rst_0", f"127.0.0.1:{http_port}", False, source="meta"
+            ))
+            trace, handle = start_trace(31337, "sql")
+            pages = list(rst.read_windows())
+            finish_trace(handle)
+            assert len(pages) >= 2, "not paged by window"
+            entry = TRACE_STORE.get(31337)
+            assert entry is not None
+
+            def walk(node):
+                yield node
+                for c in node.get("children", ()):
+                    yield from walk(c)
+
+            remote = [
+                n for n in walk(entry["root"])
+                if (n.get("attrs") or {}).get("origin") == "remote"
+                and n["name"] == "remote_read_page"
+            ]
+            # one remote span per page, each with a measured duration,
+            # all inside the single coordinator tree
+            assert len(remote) >= len(pages)
+            assert all(
+                isinstance(n["duration_ms"], (int, float)) for n in remote
+            )
+            eps = {n["attrs"].get("endpoint") for n in remote}
+            assert eps == {f"127.0.0.1:{server.bound_port}"}
         finally:
             server.stop()
             owner.close()
