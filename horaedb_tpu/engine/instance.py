@@ -353,6 +353,19 @@ class Instance:
         # compaction replaces them mid-read (deferred purge, sst/manager).
         with table.version.levels.read_pin():
             view = table.version.pick_read_view(predicate.time_range)
+            # max(0, ...): the view and the file listing are two lock
+            # acquisitions — a compaction swap between them could make
+            # the difference negative, which must never decrement the
+            # monotonic horaedb_query_sst_pruned_total counter.
+            pruned = max(0, len(table.version.levels.all_files()) - len(view.ssts))
+            if pruned:
+                # ledger + enclosing scan span: files the time range let
+                # the query skip entirely (the "pruned vs read" truth)
+                from ..utils.querystats import record as _qs_record
+                from ..utils.tracectx import annotate
+
+                _qs_record(sst_pruned=pruned)
+                annotate(sst_pruned=pruned)
             return merge_read(
                 view,
                 table.schema,

@@ -139,9 +139,16 @@ def scan_sources(
         store.prefetch([h.path for h in view.ssts])
         # the IO pool, NOT scatter_pool: partition scatter tasks call into
         # this function, and nesting on one bounded pool deadlocks
+        import contextvars
+
         from ..utils.runtime import io_pool
 
-        sst_rows = list(io_pool().map(read_one, view.ssts))
+        # copied context per fetch: the per-request cost ledger (and any
+        # active span) keeps accumulating from pool threads
+        ctxs = [contextvars.copy_context() for _ in view.ssts]
+        sst_rows = list(
+            io_pool().map(lambda ch: ch[0].run(read_one, ch[1]), zip(ctxs, view.ssts))
+        )
     else:
         sst_rows = [read_one(h) for h in view.ssts]
     for handle, rows in zip(view.ssts, sst_rows):
@@ -149,6 +156,7 @@ def scan_sources(
             parts.append(rows)
             versions.append(np.full(len(rows), handle.meta.max_sequence, dtype=np.uint64))
     proj_schema = project_schema(schema, projection)
+    mem_rows = 0
     for mem in view.memtables:
         rows, seq = mem.scan(predicate)
         if len(rows):
@@ -156,6 +164,11 @@ def scan_sources(
                 rows = _project_rows(rows, proj_schema)
             parts.append(rows)
             versions.append(seq)
+            mem_rows += len(rows)
+    if mem_rows:
+        from ..utils.querystats import record as _qs_record
+
+        _qs_record(memtable_rows=mem_rows)
     return parts, versions
 
 
@@ -229,6 +242,10 @@ def _limited_append_scan(
     done = False
     for mem in view.memtables:
         rows, _seq = mem.scan(predicate)
+        if len(rows):
+            from ..utils.querystats import record as _qs_record
+
+            _qs_record(memtable_rows=len(rows))
         if projection is not None and len(rows):
             rows = _project_rows(rows, proj_schema)
         if add(rows):
@@ -277,6 +294,18 @@ def merge_read(
     spanning multiple sources: pruning a group holding the newest version
     of a key would let an older version in another source survive dedup.
     Time-range pruning stays on everywhere (timestamp is a key column).
+
+    ORDERING CONTRACT: the returned rows are NOT globally ordered, and
+    callers must not assume they are. The dedup path happens to return
+    rows sorted by (primary key, version) as a by-product of its sort,
+    but every shortcut return skips that sort: APPEND scans and the
+    single-SST fast path return source order, and the time-disjoint
+    shortcut below returns a per-SST concatenation — each SST is
+    key-sorted WITHIN its own time window, but windows are concatenated
+    in level/file order, so rows of one series arrive as several sorted
+    runs rather than one. Everything above this function (the executor's
+    kernels, host aggregation, ORDER BY) re-groups or re-sorts as needed;
+    a new caller that wants sorted output must sort explicitly.
     """
     if update_mode is UpdateMode.APPEND and predicate.limit is not None:
         # LIMIT pushdown: append tables never dedup, so ANY n matching

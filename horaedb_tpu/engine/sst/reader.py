@@ -151,15 +151,33 @@ class SstReader:
         pf = self._parquet_file()
         keep = self.prune_row_groups(schema, predicate)
 
+        from ...utils.querystats import record as _qs_record
+
         read_schema = project_schema(schema, projection)
         columns = list(read_schema.names()) if projection is not None else None
         if not keep:
             import numpy as np
 
+            # footer read only; every row group pruned
+            _qs_record(sst_read=1)
             empty = {
                 c.name: np.empty(0, dtype=c.kind.numpy_dtype) for c in read_schema.columns
             }
             return RowGroup(read_schema, empty)
+        # ledger: COMPRESSED bytes of the column chunks actually fetched
+        # (kept row groups × projected columns) — what this query pulled
+        # from the object store; pruned groups and unprojected columns
+        # cost nothing on remote stores.
+        md = pf.metadata
+        want = set(columns) if columns is not None else None
+        fetched = 0
+        for rg in keep:
+            rg_meta = md.row_group(rg)
+            for ci in range(rg_meta.num_columns):
+                col = rg_meta.column(ci)
+                if want is None or col.path_in_schema.split(".")[0] in want:
+                    fetched += col.total_compressed_size
+        _qs_record(sst_read=1, store_read_bytes=fetched)
         table = pf.read_row_groups(keep, columns=columns, use_threads=True)
         return RowGroup.from_arrow(read_schema, table)
 

@@ -505,6 +505,11 @@ def scan_aggregate(
     ``spec`` should already be ``.padded()`` — callers slice the outputs
     back down to true group/bucket counts after combining partials.
     """
+    import time as _time
+
+    from ..utils.querystats import note_kernel_dispatch
+
+    t0 = _time.perf_counter()
     counts, sums, mins, maxs = _fused_scan_agg(
         jnp.asarray(batch.group_codes),
         jnp.asarray(batch.bucket_ids),
@@ -517,7 +522,16 @@ def scan_aggregate(
         numeric_filters=encode_filter_ops(spec.numeric_filters),
         need_minmax=spec.need_minmax,
     )
-    return state_to_host(counts, sums, mins, maxs)
+    state = state_to_host(counts, sums, mins, maxs)
+    # Per-query compile accounting: a never-seen static shape's first
+    # dispatch pays the XLA compile — its wall time is the honest cost a
+    # latency cliff needs attributed (ledger jit_* fields).
+    note_kernel_dispatch(
+        ("fused", batch.values.shape, spec.n_groups, spec.n_buckets,
+         spec.n_agg_fields, spec.numeric_filters, spec.need_minmax),
+        _time.perf_counter() - t0,
+    )
+    return state
 
 
 def encode_filter_ops(

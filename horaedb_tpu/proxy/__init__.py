@@ -118,9 +118,15 @@ class Proxy:
         # (trace_id, parent_span_id) in their wire spec (utils/tracectx).
         import contextvars
 
+        from ..utils.querystats import finish_ledger, start_ledger
         from ..utils.tracectx import finish_trace, span, start_trace
 
         trace, handle = start_trace(ctx.request_id, "sql", sql=sql[:200])
+        # The cost ledger rides the same context: every stage the request
+        # touches (scans, cache, kernels, remote fan-out) accounts into
+        # it, and finalization feeds system.public.query_stats + the
+        # horaedb_query_* metric families (utils/querystats).
+        ledger, ltoken = start_ledger(ctx.request_id, sql)
         try:
             # The plan cache is what makes repeated dashboard text cheap
             # at serving latency — the gateway is its target workload.
@@ -156,6 +162,7 @@ class Proxy:
             self._m_latency.observe(elapsed)
             slow = elapsed >= self.slow_threshold_s
             finish_trace(handle, slow=slow)
+            finish_ledger(ledger, ltoken, elapsed)
             if slow:
                 logger.warning(
                     "slow query (request %d, %.3fs): %s",
@@ -170,5 +177,7 @@ class Proxy:
                         # the request's whole span tree rides with the
                         # slow-log entry (ref: SlowTimer + trace_metric)
                         "trace": trace.to_dict(),
+                        # ...and its cost ledger (route + nonzero costs)
+                        "ledger": ledger.to_dict(),
                     }
                 )

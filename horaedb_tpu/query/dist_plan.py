@@ -174,6 +174,9 @@ def try_dist_plan(executor, plan: QueryPlan, table, m: dict):
                    if k in ("path", "scan_ms", "rows_scanned", "total_ms")},
             }
 
+    from ..utils.querystats import record as _qs_record
+
+    _qs_record(fanout=len(subs))
     with span("dist_fanout", mode=mode, partitions=len(subs)):
         if len(subs) == 1:
             parts = [run_one(subs[0])]
@@ -204,7 +207,19 @@ def try_dist_plan(executor, plan: QueryPlan, table, m: dict):
     m["partitions"] = len(subs)
     m["dist_stages"] = stage_metrics
     if names is None:
-        names = [i.output_name for i in plan.select.items]
+        # Every partition pruned away or returned empty: derive the output
+        # shape from the select list, expanding ``*`` against the table
+        # schema exactly as a sub-execution would have — the empty result
+        # must not grow a column literally named "*".
+        names = []
+        for item in plan.select.items:
+            if isinstance(item.expr, ast.Star):
+                names.extend(
+                    c.name for c in plan.schema.columns
+                    if not c.name.startswith("__hidden_")
+                )
+            else:
+                names.append(item.output_name)
     if not col_parts:
         result = ResultSet.empty(list(names))
     else:
@@ -241,6 +256,17 @@ def _concat_aligned(arrays: list[np.ndarray]) -> np.ndarray:
     kinds = {a.dtype.kind for a in arrays}
     if len({a.dtype for a in arrays}) == 1:
         return np.concatenate(arrays)
+    if kinds <= {"i", "u", "b"}:
+        # Pure integer/bool mixes stay exact: routing them through
+        # float64 would corrupt int64 values above 2^53. A uint64 value
+        # past int64's range can't stay exact in EITHER fixed dtype next
+        # to signed values — object preserves it instead of wrapping.
+        if any(
+            a.dtype == np.uint64 and len(a) and a.max() > np.iinfo(np.int64).max
+            for a in arrays
+        ):
+            return np.concatenate([a.astype(object) for a in arrays])
+        return np.concatenate([a.astype(np.int64) for a in arrays])
     if kinds <= {"i", "u", "f", "b"}:
         return np.concatenate([a.astype(np.float64) for a in arrays])
     return np.concatenate([a.astype(object) for a in arrays])

@@ -32,6 +32,7 @@ from ..engine.options import parse_duration_ms
 from ..ops import ScanAggSpec, encode_group_codes, scan_aggregate
 from ..ops.encoding import build_padded_batch, time_buckets
 from ..table_engine.predicate import NUMPY_CMP, FilterOp, Predicate
+from ..utils import querystats
 from . import ast
 from .plan import AggCall, GroupKey, QueryPlan
 
@@ -238,7 +239,22 @@ def _eval_cast(e: ast.Cast, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]:
                 try:
                     out = filled.astype(np.int64)
                 except (ValueError, TypeError):
-                    out = filled.astype(np.float64).astype(target)
+                    # Per-ELEMENT fallback: one decimal/exponent string in
+                    # the column must not send the exact integer strings
+                    # beside it through the lossy float64 detour. A cheap
+                    # digit test (no per-element exceptions) picks the
+                    # exact path; everything else parses as float and
+                    # truncates on store. 'nan'/'inf' strings error here
+                    # (strict cast) — the old whole-array C cast silently
+                    # produced INT64_MIN garbage for them.
+                    out = np.empty(len(filled), dtype=np.int64)
+                    for i, s in enumerate(filled):
+                        t = str(s)
+                        body = t[1:] if t[:1] in "+-" else t
+                        if body.isdigit():
+                            out[i] = int(t)
+                        else:
+                            out[i] = np.float64(s)  # truncating int store
             else:
                 out = filled.astype(np.float64).astype(target)
         elif target is np.int64 and v.dtype.kind == "f":
@@ -516,6 +532,7 @@ class Executor:
             sp.set(rows=len(rows))
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)
+        querystats.record(scan_rows=len(rows))
         if plan.is_aggregate and route != "host" and self._device_capable(plan, rows):
             with _span("aggregate", path="device"):
                 out = self._execute_agg_device(plan, rows, m)
@@ -538,6 +555,9 @@ class Executor:
         m["path"] = path
         m["result_rows"] = out.num_rows
         m["total_ms"] = round((_time.perf_counter() - t_start) * 1000, 3)
+        # The ledger's route is which of the six executor paths actually
+        # served the request (the cost side of the span tree).
+        querystats.set_route(path)
         akey = m.pop("_adaptive_key", None)
         if akey is not None and m.get("cache") != "build":
             # one-off cache-build cost must not poison the device estimate
@@ -885,6 +905,9 @@ class Executor:
             table, value_names, read_rows=lambda: table.read(Predicate.all_time())
         )
         if entry is None or delta is None:
+            # an ELIGIBLE query the cache couldn't serve (first sighting,
+            # raced write, budget refusal) — a miss in the ledger's terms
+            querystats.record(cache_misses=1)
             return None
         # NULL agg inputs need per-field masks — not expressible here.
         for c in agg_cols:
@@ -898,8 +921,14 @@ class Executor:
         # above must not leave 'cache' lying in a host-path metric tree).
         m["cache"] = "build" if built else ("hit+delta" if len(delta) else "hit")
         m["rows_scanned"] = entry.n_valid + len(delta)
+        querystats.record(scan_rows=entry.n_valid + len(delta))
+        if built:
+            querystats.record(cache_misses=1)
+        else:
+            querystats.record(cache_hits=1, cache_bytes=entry.device_bytes)
         if len(delta):
             m["delta_rows"] = len(delta)
+            querystats.record(memtable_rows=len(delta))
 
         # Series-level small arrays (one row per unique series); validity
         # slices carry over so NULL-tag semantics match the host path.
@@ -992,6 +1021,13 @@ class Executor:
         hi_rel = hi - entry.min_ts
         t0_rel = max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0
         width_i = width if width else 1
+        kernel_key = (
+            spec.n_groups, spec.n_buckets, spec.n_agg_fields,
+            spec.numeric_filters, spec.need_minmax,
+        )
+        import time as _time
+
+        t_kernel = _time.perf_counter()
         if entry.mesh is not None:
             # Sharded entry: the big arrays live split across the mesh —
             # run the shard_map cached kernel (the DEFAULT multi-device
@@ -1013,6 +1049,10 @@ class Executor:
             )
             m["mesh_devices"] = int(entry.mesh.devices.size)
             state = state_to_host(*out)
+            querystats.note_kernel_dispatch(
+                ("cached-dist", int(entry.mesh.devices.size), *kernel_key),
+                _time.perf_counter() - t_kernel,
+            )
         else:
             # Single-device serving: the RTT-minimized packed path — one
             # content-cached session upload, one dyn upload, one execute,
@@ -1046,6 +1086,10 @@ class Executor:
                 selective=row_idx is not None,
             )
             state = unpack_packed_state(packed, spec)
+            querystats.note_kernel_dispatch(
+                ("cached-packed", row_idx is not None, *kernel_key),
+                _time.perf_counter() - t_kernel,
+            )
         if len(delta) and not empty_range:
             self._fold_delta(
                 state, delta, entry, plan.schema, gos, allow,

@@ -280,6 +280,13 @@ class InterpreterFactory:
                 start_trace,
             )
 
+            from ..utils.querystats import (
+                current_ledger,
+                finish_ledger,
+                render_ledger,
+                start_ledger,
+            )
+
             trace = current_trace()
             handle = None
             if trace is None:
@@ -288,6 +295,11 @@ class InterpreterFactory:
                 trace, handle = start_trace(
                     f"explain-{id(q):x}", "explain_analyze", table=q.table
                 )
+            # A NESTED ledger scoped to the analyzed execution: what this
+            # one query cost, untangled from the proxy's statement-wide
+            # ledger — then folded back so query_stats stays whole.
+            outer_ledger = current_ledger()
+            qledger, qtoken = start_ledger(trace.trace_id, "explain analyze")
             try:
                 t0 = _time.perf_counter()
                 with span("analyze", table=q.table):
@@ -303,6 +315,7 @@ class InterpreterFactory:
                 )
                 if detail:
                     lines.append(f"  Metrics: {detail}")
+                lines.append(f"  Ledger: {render_ledger(qledger)}")
                 if handle is not None:
                     trace.root.finish()  # owned: closed before rendering
                 tree = trace.to_dict()["root"]
@@ -311,6 +324,11 @@ class InterpreterFactory:
             finally:
                 # an execute error must still reset the ContextVars — a
                 # leaked trace would swallow every later query's spans
+                finish_ledger(qledger, qtoken, 0.0, record_stats=False)
+                if outer_ledger is not None:
+                    outer_ledger.merge_remote(qledger.to_dict())
+                    if qledger.route:
+                        outer_ledger.set_route(qledger.route)
                 if handle is not None:
                     finish_trace(handle)
         return lines

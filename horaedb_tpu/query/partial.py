@@ -127,6 +127,9 @@ def compute_partial(
                     all_names = names
                     parts.append(arrays)
             sp.set(windows=windows, rows=rows_seen)
+        from ..utils.querystats import record as _qs_record
+
+        _qs_record(scan_rows=rows_seen)
         if m is not None:
             m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
             m["rows_scanned"] = rows_seen
@@ -147,6 +150,9 @@ def compute_partial(
     with span("scan", table=table.name) as sp:
         rows = table.read(pred, projection=projection)
         sp.set(rows=len(rows))
+    from ..utils.querystats import record as _qs_record
+
+    _qs_record(scan_rows=len(rows))
     if m is not None:
         m["scan_ms"] = round((_time.perf_counter() - t_scan) * 1000, 3)
         m["rows_scanned"] = len(rows)

@@ -29,7 +29,7 @@ whose data span fits int32 relative milliseconds (~24 days).
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
 
 import jax.numpy as jnp
@@ -101,6 +101,12 @@ class CachedTableScan:
     # resident-size accounting for the cache's byte budget
     device_bytes: int = 0
     host_bytes: int = 0
+    # Serializes _extend against itself for THIS entry only: two hit-path
+    # queries needing a missing value column must not both upload it and
+    # double-count device_bytes. Per-entry, so unrelated tables' extends
+    # never contend (the cache's stated no-cross-table-serialization
+    # design constraint).
+    ext_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def total_bytes(self) -> int:
         return self.device_bytes + self.host_bytes
@@ -431,7 +437,22 @@ class ScanCache:
     ) -> bool:
         """Upload any missing value columns; False when the entry's host
         rows were dropped and the re-read couldn't reproduce the build
-        state (caller serves from the host path)."""
+        state (caller serves from the host path).
+
+        Runs OUTSIDE the cache-wide lock (O(rows) work must not serialize
+        unrelated tables) but UNDER the entry's own lock: concurrent
+        hit-path extends re-check ``value_cols_dev`` after acquiring it,
+        so a column uploads once and ``device_bytes`` counts once."""
+        with entry.ext_lock:
+            return self._extend_locked(entry, value_columns, read_rows, table)
+
+    def _extend_locked(
+        self,
+        entry: CachedTableScan,
+        value_columns: list[str],
+        read_rows=None,
+        table=None,
+    ) -> bool:
         import os
 
         import jax

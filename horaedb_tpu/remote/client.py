@@ -73,7 +73,18 @@ class RemoteEngineClient:
             request_serializer=None,
             response_deserializer=None,
         )
-        return unpack(fn(pack(payload), timeout=self.timeout_s))
+        from ..utils.querystats import merge_remote, record
+
+        req = pack(payload)
+        raw = fn(req, timeout=self.timeout_s)
+        record(remote_rpcs=1, remote_bytes=len(req) + len(raw))
+        out = unpack(raw)
+        if isinstance(out, dict):
+            # the owner's cost ledger rides the response (the accounting
+            # analog of the span subtree) and folds into the
+            # coordinator's — query_stats shows the CLUSTER-wide cost
+            merge_remote(out.get("ledger"))
+        return out
 
     def get_table_info(self, table: str) -> dict:
         return self._call("GetTableInfo", {"table": table})
@@ -329,6 +340,9 @@ class RoutedSubTable(Table):
                 if attempt == 0 and self._is_stale_route_error(
                     e, for_write=fenced
                 ):
+                    from ..utils.querystats import record
+
+                    record(retries=1)
                     self.router.invalidate(self._name)
                     continue
                 raise

@@ -26,6 +26,7 @@ from typing import Optional
 import grpc
 
 from ..common_types.row_group import RowGroup
+from ..utils.querystats import serving_ledger
 from ..utils.tracectx import root_dict, serving_trace, span
 from .codec import (
     columns_to_ipc,
@@ -140,7 +141,12 @@ class GrpcServer:
         return {"affected": len(rows)}
 
     def _read(self, req: dict) -> dict:
-        with serving_trace(
+        # This node's share of the query's cost accounts in a detached
+        # ledger and ships home in the response (the accounting analog of
+        # the span subtree) — the COORDINATOR's merged row is the one
+        # per-query truth, so nothing lands in this node's stats ring.
+        sl = serving_ledger((req.get("trace") or {}).get("request_id"))
+        with sl, serving_trace(
             req.get("trace"), "remote_read", table=req["table"]
         ) as trace:
             t = self._open(req["table"])
@@ -149,7 +155,12 @@ class GrpcServer:
             with span("scan", table=req["table"]) as sp:
                 rows = t.read(pred, projection=projection)
                 sp.set(rows=len(rows))
-        return {"ipc": rows_to_ipc(rows), "span": root_dict(trace)}
+            # NO scan_rows here: raw rows cross the wire and the
+            # coordinator's gather counts them exactly once — recording
+            # them in the shipped ledger too would double-count. The
+            # engine-level costs (sst_read, store bytes, memtable rows)
+            # accrued above DO ship home; only this node sees them.
+        return {"ipc": rows_to_ipc(rows), "span": root_dict(trace), "ledger": sl.wire}
 
     def _read_page(self, req: dict) -> dict:
         """Streaming read, one segment window per RPC (ref: the reference
@@ -166,7 +177,8 @@ class GrpcServer:
         trace context (each page grafts under the ONE coordinator trace)."""
         from ..table_engine.table import read_one_page
 
-        with serving_trace(
+        sl = serving_ledger((req.get("trace") or {}).get("request_id"))
+        with sl, serving_trace(
             req.get("trace"), "remote_read_page", table=req["table"]
         ) as trace:
             t = self._open(req["table"])
@@ -176,10 +188,13 @@ class GrpcServer:
                     t, pred, req.get("projection"), req.get("after")
                 )
                 sp.set(rows=0 if rows is None else len(rows))
+            # scan_rows deliberately NOT recorded (see _read): the
+            # coordinator counts the streamed pages once on arrival.
         return {
             "ipc": rows_to_ipc(rows) if rows is not None else None,
             "next": nxt,
             "span": root_dict(trace),
+            "ledger": sl.wire,
         }
 
     def _partial_agg(self, req: dict) -> dict:
@@ -189,7 +204,8 @@ class GrpcServer:
 
         t0 = time.perf_counter()
         trace_ctx = (req["spec"] or {}).get("trace")
-        with serving_trace(
+        sl = serving_ledger((trace_ctx or {}).get("request_id"))
+        with sl, serving_trace(
             trace_ctx, "remote_partial_agg", table=req["table"]
         ) as trace:
             t = self._open(req["table"])
@@ -216,9 +232,10 @@ class GrpcServer:
             "ipc": columns_to_ipc(names, arrays),
             # stage metrics ride home for EXPLAIN ANALYZE (ref: the
             # reference's RemoteTaskContext.remote_metrics), and the span
-            # subtree grafts into the coordinator's trace
+            # subtree + cost ledger graft into the coordinator's
             "metrics": metrics,
             "span": root_dict(trace),
+            "ledger": sl.wire,
         }
 
     def _execute_plan(self, req: dict) -> dict:
@@ -237,7 +254,8 @@ class GrpcServer:
 
         t0 = time.perf_counter()
         name = req["table"]
-        with serving_trace(
+        sl = serving_ledger((req.get("trace") or {}).get("request_id"))
+        with sl, serving_trace(
             req.get("trace"), "remote_execute_plan", table=name
         ) as trace:
             t = self._open(name)
@@ -268,6 +286,7 @@ class GrpcServer:
             "ipc": result_to_ipc(rs.names, rs.columns, rs.nulls),
             "metrics": metrics,
             "span": root_dict(trace),
+            "ledger": sl.wire,
         }
 
     def _drop_sub(self, req: dict) -> dict:

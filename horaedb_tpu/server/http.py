@@ -214,6 +214,12 @@ class SqlGateway:
                 if fence is not None:
                     return "error", fence
             table = _table_of_statement(stmt)
+            if table is not None and table.lower().startswith("system."):
+                # Virtual introspection tables (system.public.query_stats,
+                # .metrics, .tables) answer about THE NODE YOU ASKED —
+                # forwarding them by name hash would silently serve a
+                # different node's state.
+                table = None
             if table is not None:
                 route = router.route(table)
                 if not route.is_local:
@@ -830,6 +836,16 @@ def create_app(
             text=_dumps(list(proxy.slow_queries)), content_type="application/json"
         )
 
+    async def debug_query_stats(request: web.Request) -> web.Response:
+        """Recent finalized per-query cost ledgers — the same rows the
+        SQL-queryable ``system.public.query_stats`` table serves."""
+        from ..utils.querystats import STATS_STORE
+
+        return web.Response(
+            text=_dumps({"queries": STATS_STORE.list()}),
+            content_type="application/json",
+        )
+
     async def debug_trace_list(request: web.Request) -> web.Response:
         """Recent + slow trace summaries from the bounded in-process
         store (ref: trace_metric's collector surfaces)."""
@@ -1076,6 +1092,7 @@ def create_app(
     app.router.add_get("/debug/profile/heap/{seconds}", debug_profile_heap)
     app.router.add_put("/debug/log_level/{level}", debug_log_level)
     app.router.add_get("/debug/slow_log", debug_slow_log)
+    app.router.add_get("/debug/query_stats", debug_query_stats)
     app.router.add_get("/debug/trace", debug_trace_list)
     app.router.add_get("/debug/trace/{request_id}", debug_trace_get)
     app.router.add_get("/debug/shards", debug_shards)

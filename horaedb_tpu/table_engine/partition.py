@@ -182,6 +182,9 @@ class PartitionedTable(Table):
             if keep is None
             else [self.sub_tables[i] for i in keep]
         )
+        from ..utils.querystats import record as _qs_record
+
+        _qs_record(fanout=len(targets))
         parts = [t.read(predicate, projection) for t in targets]
         non_empty = [p for p in parts if len(p)]
         if not non_empty:
@@ -200,6 +203,9 @@ class PartitionedTable(Table):
         targets = (
             self.sub_tables if keep is None else [self.sub_tables[i] for i in keep]
         )
+        from ..utils.querystats import record as _qs_record
+
+        _qs_record(fanout=len(targets))
         if len(targets) == 1:
             return targets[0].partial_agg(spec)
         import contextvars

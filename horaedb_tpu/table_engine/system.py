@@ -4,10 +4,20 @@ every user table as rows (timestamp, catalog, schema, table_name,
 table_id, engine); served straight from the catalog manager, never
 stored).
 
-The virtual table implements the same ``Table`` interface real tables
-do, so the whole query layer — projections, filters, aggregates, EXPLAIN
-— works on it unchanged. Reads materialize a fresh RowGroup from the
-catalog registry on every scan (the listing IS the current state).
+Virtual tables implement the same ``Table`` interface real tables do, so
+the whole query layer — projections, filters, aggregates, EXPLAIN, every
+wire protocol (HTTP SQL, MySQL, PostgreSQL) — works on them unchanged.
+Reads materialize a fresh RowGroup on every scan (the listing IS the
+current state).
+
+Three tables:
+
+- ``system.public.tables``      — the catalog registry
+- ``system.public.query_stats`` — the bounded ring of finalized per-query
+  cost ledgers (utils/querystats.STATS_STORE), joinable on request_id;
+  one row per recent query with route + every ledger cost field
+- ``system.public.metrics``     — a live snapshot of the Prometheus
+  registry (one row per sample: family, kind, labels, value)
 """
 
 from __future__ import annotations
@@ -15,9 +25,61 @@ from __future__ import annotations
 import numpy as np
 
 from ..common_types import ColumnSchema, DatumKind, RowGroup, Schema
+from ..utils.querystats import FLOAT_FIELDS, NUMERIC_FIELDS, STATS_STORE
 from .table import Table, TableOptions
 
 TABLES_NAME = "system.public.tables"
+QUERY_STATS_NAME = "system.public.query_stats"
+METRICS_NAME = "system.public.metrics"
+
+
+class _VirtualTable(Table):
+    """Read-only table materialized from in-process state on every scan."""
+
+    def __init__(self) -> None:
+        self._options = TableOptions()
+
+    @property
+    def options(self) -> TableOptions:
+        return self._options
+
+    def write(self, rows) -> int:
+        raise ValueError(f"{self.name} is read-only")
+
+    def _materialize(self) -> RowGroup:
+        raise NotImplementedError
+
+    def read(self, predicate=None, projection=None) -> RowGroup:
+        rows = self._materialize()
+        if predicate is not None:
+            # The executor drops timestamp conjuncts from its residual
+            # WHERE on the promise that storage applied the time range
+            # exactly — honor that promise here too.
+            tr = predicate.time_range
+            ts = rows.timestamps
+            mask = (ts >= tr.inclusive_start) & (ts < tr.exclusive_end)
+            if not mask.all():
+                rows = rows.take(np.nonzero(mask)[0])
+        if projection is not None:
+            from ..engine.merge import project_schema
+
+            proj = project_schema(rows.schema, projection)
+            rows = RowGroup(
+                proj, {c.name: rows.columns[c.name] for c in proj.columns},
+                {k: v for k, v in rows.validity.items()
+                 if any(c.name == k for c in proj.columns)},
+            )
+        return rows
+
+    def flush(self) -> None:
+        pass
+
+    def compact(self) -> None:
+        pass
+
+    def alter_schema(self, schema) -> None:
+        raise ValueError(f"{self.name} is read-only")
+
 
 _TABLES_SCHEMA = Schema.build(
     [
@@ -33,12 +95,12 @@ _TABLES_SCHEMA = Schema.build(
 )
 
 
-class SystemTablesTable(Table):
+class SystemTablesTable(_VirtualTable):
     """``system.public.tables`` (read-only)."""
 
     def __init__(self, catalog) -> None:
+        super().__init__()
         self.catalog = catalog
-        self._options = TableOptions()
 
     @property
     def name(self) -> str:
@@ -48,20 +110,13 @@ class SystemTablesTable(Table):
     def schema(self) -> Schema:
         return _TABLES_SCHEMA
 
-    @property
-    def options(self) -> TableOptions:
-        return self._options
-
-    def write(self, rows) -> int:
-        raise ValueError(f"{TABLES_NAME} is read-only")
-
-    def read(self, predicate=None, projection=None) -> RowGroup:
+    def _materialize(self) -> RowGroup:
         names = sorted(self.catalog.table_names())
         ids = []
         for n in names:
             e = self.catalog.entry(n)
             ids.append(int(e.table_id) if e is not None else 0)
-        rows = RowGroup(
+        return RowGroup(
             _TABLES_SCHEMA,
             {
                 "timestamp": np.zeros(len(names), dtype=np.int64),
@@ -72,37 +127,146 @@ class SystemTablesTable(Table):
                 "engine": np.array(["Analytic"] * len(names), dtype=object),
             },
         )
-        if predicate is not None:
-            # The executor drops timestamp conjuncts from its residual
-            # WHERE on the promise that storage applied the time range
-            # exactly — honor that promise here too.
-            tr = predicate.time_range
-            ts = rows.timestamps
-            mask = (ts >= tr.inclusive_start) & (ts < tr.exclusive_end)
-            if not mask.all():
-                rows = rows.take(np.nonzero(mask)[0])
-        if projection is not None:
-            from ..engine.merge import project_schema
 
-            proj = project_schema(rows.schema, projection)
-            rows = RowGroup(
-                proj, {c.name: rows.columns[c.name] for c in proj.columns}
+
+def _query_stats_schema() -> Schema:
+    """Derived from the ledger field registry — a new ledger field gets
+    its column here without a second list to forget."""
+    cols = [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("request_id", DatumKind.UINT64, is_nullable=False),
+        ColumnSchema("sql", DatumKind.STRING),
+        ColumnSchema("route", DatumKind.STRING),
+        ColumnSchema("duration_ms", DatumKind.DOUBLE),
+    ]
+    cols += [ColumnSchema(f, DatumKind.INT64) for f in NUMERIC_FIELDS]
+    cols += [ColumnSchema(f, DatumKind.DOUBLE) for f in FLOAT_FIELDS]
+    return Schema.build(
+        cols,
+        timestamp_column="timestamp",
+        primary_key=["timestamp", "request_id"],
+    )
+
+
+_QUERY_STATS_SCHEMA = _query_stats_schema()
+
+
+class QueryStatsTable(_VirtualTable):
+    """``system.public.query_stats``: recent finalized query ledgers."""
+
+    @property
+    def name(self) -> str:
+        return QUERY_STATS_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _QUERY_STATS_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        entries = STATS_STORE.list()
+        n = len(entries)
+
+        def ints(key, coerce=int) -> np.ndarray:
+            out = np.zeros(n, dtype=np.int64)
+            for i, e in enumerate(entries):
+                v = e.get(key, 0)
+                try:
+                    out[i] = coerce(v)
+                except (TypeError, ValueError):
+                    out[i] = 0
+            return out
+
+        data: dict[str, np.ndarray] = {
+            "timestamp": ints("timestamp"),
+            # request ids are the proxy's integer counter; anything else
+            # (embedded callers) coerces to 0 rather than failing the scan
+            "request_id": ints("request_id").astype(np.uint64),
+            "sql": np.array([str(e.get("sql", "")) for e in entries], dtype=object),
+            "route": np.array([str(e.get("route", "")) for e in entries], dtype=object),
+            "duration_ms": np.array(
+                [float(e.get("duration_ms", 0.0)) for e in entries], dtype=np.float64
+            ),
+        }
+        for f in NUMERIC_FIELDS:
+            data[f] = ints(f)
+        for f in FLOAT_FIELDS:
+            data[f] = np.array(
+                [float(e.get(f, 0.0)) for e in entries], dtype=np.float64
             )
-        return rows
+        return RowGroup(_QUERY_STATS_SCHEMA, data)
 
-    def flush(self) -> None:
-        pass
 
-    def compact(self) -> None:
-        pass
+_METRICS_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("name", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("kind", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("labels", DatumKind.STRING),
+        ColumnSchema("value", DatumKind.DOUBLE),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "name", "labels"],
+)
 
-    def alter_schema(self, schema) -> None:
-        raise ValueError(f"{TABLES_NAME} is read-only")
+
+class MetricsTable(_VirtualTable):
+    """``system.public.metrics``: live registry snapshot as rows.
+
+    Counters/gauges contribute one row each; histograms contribute
+    ``<name>_count`` and ``<name>_sum`` rows (bucket vectors stay on
+    /metrics — SQL dashboards want the scalars)."""
+
+    @property
+    def name(self) -> str:
+        return METRICS_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _METRICS_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        import time
+
+        from ..utils.metrics import Histogram, _render_labels, REGISTRY
+
+        now = int(time.time() * 1000)
+        names, kinds, labels, values = [], [], [], []
+        for family, members in sorted(REGISTRY.families().items()):
+            for m in members:
+                rendered = _render_labels(m.labels)
+                if isinstance(m, Histogram):
+                    with m._lock:
+                        total, sum_ = m._total, m._sum
+                    names += [f"{family}_count", f"{family}_sum"]
+                    kinds += ["histogram", "histogram"]
+                    labels += [rendered, rendered]
+                    values += [float(total), float(sum_)]
+                else:
+                    names.append(family)
+                    kinds.append(m.TYPE)
+                    labels.append(rendered)
+                    values.append(float(m.value))
+        n = len(names)
+        return RowGroup(
+            _METRICS_SCHEMA,
+            {
+                "timestamp": np.full(n, now, dtype=np.int64),
+                "name": np.array(names, dtype=object),
+                "kind": np.array(kinds, dtype=object),
+                "labels": np.array(labels, dtype=object),
+                "value": np.array(values, dtype=np.float64),
+            },
+        )
 
 
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
-    if name.lower() == TABLES_NAME:
+    low = name.lower()
+    if low == TABLES_NAME:
         return SystemTablesTable(catalog)
+    if low == QUERY_STATS_NAME:
+        return QueryStatsTable()
+    if low == METRICS_NAME:
+        return MetricsTable()
     return None

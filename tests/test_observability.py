@@ -558,6 +558,89 @@ class TestSpanTracing:
         db.close()
 
 
+class TestQueryLedger:
+    """Per-query cost ledger mechanics (utils/querystats)."""
+
+    def test_record_noop_outside_request(self):
+        from horaedb_tpu.utils import querystats
+
+        assert querystats.current_ledger() is None
+        querystats.record(scan_rows=5)  # absorbed, nothing anywhere
+        querystats.set_route("host")
+        querystats.merge_remote({"counts": {"scan_rows": 3}})
+        assert querystats.current_ledger() is None
+
+    def test_ledger_accumulates_and_finalizes(self):
+        from horaedb_tpu.utils.querystats import (
+            STATS_STORE, finish_ledger, record, set_route, start_ledger,
+        )
+
+        ledger, token = start_ledger(42, "SELECT 1")
+        record(scan_rows=10, sst_read=2)
+        record(scan_rows=5)
+        set_route("device")
+        # a remote owner's shipped ledger folds in (numeric fields add)
+        ledger.merge_remote({"route": "host", "counts": {"scan_rows": 7, "bogus": 1}})
+        finish_ledger(ledger, token, 0.25)
+        row = STATS_STORE.list()[-1]
+        assert row["request_id"] == 42
+        assert row["scan_rows"] == 22 and row["sst_read"] == 2
+        assert row["route"] == "device"  # remote route never wins
+        assert row["duration_ms"] == 250.0
+
+    def test_serving_ledger_ships_and_never_records(self):
+        from horaedb_tpu.utils.querystats import (
+            STATS_STORE, record, serving_ledger,
+        )
+
+        before = len(STATS_STORE.list())
+        sl = serving_ledger(7)
+        with sl:
+            record(scan_rows=99, remote_bytes=12)
+        assert len(STATS_STORE.list()) == before  # owner ring untouched
+        wire = sl.wire
+        assert wire["counts"]["scan_rows"] == 99
+
+    def test_explain_analyze_renders_ledger(self):
+        db = horaedb_tpu.connect(None)
+        db.execute("CREATE TABLE el (h string TAG, v double, ts timestamp KEY)")
+        db.execute("INSERT INTO el (h, v, ts) VALUES ('a', 1.0, 1)")
+        lines = [
+            r["plan"]
+            for r in db.execute(
+                "EXPLAIN ANALYZE SELECT h, sum(v) FROM el GROUP BY h"
+            ).to_pylist()
+        ]
+        ledger_lines = [l for l in lines if l.strip().startswith("Ledger:")]
+        assert ledger_lines, lines
+        assert "route=" in ledger_lines[0] and "scan_rows=1" in ledger_lines[0]
+        db.close()
+
+    def test_slow_log_carries_ledger(self):
+        async def body(client):
+            client.server.app["proxy"].slow_threshold_s = 0.0
+            await client.post("/sql", json={"query":
+                "CREATE TABLE sl (h string TAG, v double, ts timestamp KEY)"})
+            await client.post("/sql", json={"query":
+                "INSERT INTO sl (h, v, ts) VALUES ('a', 1.0, 1)"})
+            await client.post("/sql", json={"query":
+                "SELECT h, sum(v) FROM sl GROUP BY h"})
+            slow = await (await client.get("/debug/slow_log")).json()
+            entry = slow[-1]
+            assert entry["ledger"]["route"] in (
+                "device", "device-cached", "device-dist", "device-partial",
+                "dist-plan", "host",
+            )
+            assert entry["ledger"]["counts"]["scan_rows"] >= 1
+            # /debug/query_stats serves the same finalized rows
+            qs = await (await client.get("/debug/query_stats")).json()
+            assert any(
+                q["request_id"] == entry["request_id"] for q in qs["queries"]
+            )
+
+        with_client(body)
+
+
 class TestLabeledHistogram:
     def test_per_labelset_exposition(self):
         from horaedb_tpu.utils.metrics import Registry
@@ -698,6 +781,49 @@ class TestMetricsNameLint:
             if not pat.match(family) or not family.endswith(self.SUFFIXES):
                 bad.append(family)
         assert not bad, f"metric families violating naming convention: {bad}"
+
+    def test_ledger_fields_map_to_columns_metrics_and_docs(self):
+        """PR-2 lint extension: every ledger field must have (a) a
+        system.public.query_stats column, (b) a live horaedb_* metric
+        family following the naming convention, and (c) a mention in
+        docs/OBSERVABILITY.md — a new cost counter cannot land silently."""
+        import os
+        import re
+
+        from horaedb_tpu.table_engine.system import _QUERY_STATS_SCHEMA
+        from horaedb_tpu.utils.metrics import REGISTRY
+        from horaedb_tpu.utils.querystats import (
+            LEDGER_FIELDS,
+            finish_ledger,
+            metric_name,
+            start_ledger,
+        )
+
+        # finalize one synthetic ledger so every family is live
+        ledger, token = start_ledger(0, "lint")
+        ledger.add(**{f: 1 for f in LEDGER_FIELDS})
+        ledger.set_route("host")
+        finish_ledger(ledger, token, 0.001)
+
+        columns = {c.name for c in _QUERY_STATS_SCHEMA.columns}
+        docs = open(
+            os.path.join(os.path.dirname(__file__), "..", "docs", "OBSERVABILITY.md")
+        ).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        missing = []
+        for field in LEDGER_FIELDS:
+            fam = metric_name(field)
+            if field not in columns:
+                missing.append(f"{field}: no query_stats column")
+            if fam not in families:
+                missing.append(f"{field}: metric family {fam} not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{field}: family {fam} violates naming lint")
+            if f"`{field}`" not in docs:
+                missing.append(f"{field}: undocumented in docs/OBSERVABILITY.md")
+        assert "horaedb_query_route_total" in families
+        assert not missing, missing
 
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*

@@ -1,0 +1,208 @@
+"""system.public.query_stats over every wire protocol + cluster merge
+(PR-2 acceptance: `SELECT route, scan_rows, store_read_bytes, cache_hits
+FROM system.public.query_stats` returns a row for a just-executed
+distributed query over HTTP SQL, MySQL, and PostgreSQL, with remote
+owners' ledgers merged into the coordinator row)."""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.server import create_app
+from horaedb_tpu.server.mysql import MysqlServer
+from horaedb_tpu.server.postgres import PostgresServer
+
+# raw byte-level protocol clients + the 2-node cluster fixture
+from test_remote_engine import http, sql, static_cluster  # noqa: F401
+from test_wire_protocols import MyClient, PgClient
+
+STATS_SQL = (
+    "SELECT sql, route, scan_rows, store_read_bytes, cache_hits, "
+    "sst_read, fanout FROM system.public.query_stats"
+)
+
+ROUTES = {
+    "device-cached", "device", "device-dist", "device-partial",
+    "dist-plan", "host",
+}
+
+
+def _stats_row(rows: list[dict], needle: str) -> dict:
+    """The most recent query_stats row whose sql matches ``needle``."""
+    hits = [r for r in rows if r["sql"] == needle]
+    assert hits, f"no query_stats row for {needle!r}; got {[r['sql'] for r in rows]}"
+    return hits[-1]
+
+
+class TestQueryStatsAllWires:
+    """One partitioned table, one distributed GROUP BY per protocol, and
+    the ledger row read back over the SAME protocol."""
+
+    @pytest.fixture()
+    def db(self, monkeypatch):
+        # pin the partitioned (distributed) route: the HBM cache would
+        # otherwise serve the repeats and the assertions get path-dependent
+        monkeypatch.setenv("HORAEDB_SCAN_CACHE", "0")
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE qs (host string TAG, v double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) PARTITION BY KEY(host) PARTITIONS 4 ENGINE=Analytic"
+        )
+        rows = ", ".join(
+            f"('h{i % 8}', {float(i)}, {1000 + i})" for i in range(200)
+        )
+        conn.execute(f"INSERT INTO qs (host, v, ts) VALUES {rows}")
+        conn.flush_all()  # SSTs exist -> sst_read / store_read_bytes move
+        yield conn
+        conn.close()
+
+    def test_http_mysql_and_pg_see_ledger_rows(self, db):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        q_http = "SELECT host, sum(v) AS s FROM qs GROUP BY host"
+        q_my = "SELECT host, count(v) AS c FROM qs GROUP BY host"
+        q_pg = "SELECT host, avg(v) AS a FROM qs GROUP BY host"
+
+        def my_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            assert c.query(q_my)[0] == "rows"
+            kind, names, rows = c.query(STATS_SQL)
+            s.close()
+            assert kind == "rows", rows
+            dicts = [dict(zip(names, r)) for r in rows]
+            row = _stats_row(dicts, q_my)
+            assert row["route"] in ROUTES
+            assert int(row["scan_rows"]) == 200
+            assert int(row["fanout"]) == 4
+            assert int(row["sst_read"]) >= 4
+            assert int(row["store_read_bytes"]) > 0
+            assert int(row["cache_hits"]) == 0  # cache pinned off
+
+        def pg_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            names, rows, complete, err = c.query(q_pg)
+            assert err is None and len(rows) == 8
+            names, rows, complete, err = c.query(STATS_SQL)
+            s.close()
+            assert err is None, err
+            dicts = [dict(zip(names, r)) for r in rows]
+            row = _stats_row(dicts, q_pg)
+            assert row["route"] in ROUTES
+            assert int(row["scan_rows"]) == 200
+            assert int(row["store_read_bytes"]) > 0
+
+        async def body():
+            app = create_app(db)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            gw = app["sql_gateway"]
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                # HTTP SQL wire
+                out = await client.post("/sql", json={"query": q_http})
+                assert out.status == 200
+                assert len((await out.json())["rows"]) == 8
+                out = await client.post("/sql", json={"query": STATS_SQL})
+                assert out.status == 200
+                row = _stats_row((await out.json())["rows"], q_http)
+                assert row["route"] in ROUTES
+                assert row["scan_rows"] == 200
+                assert row["fanout"] == 4
+                assert row["store_read_bytes"] > 0
+                # MySQL + PostgreSQL wires (blocking socket clients)
+                await loop.run_in_executor(None, my_client, my.port)
+                await loop.run_in_executor(None, pg_client, pg.port)
+            finally:
+                await my.stop()
+                await pg.stop()
+                await client.close()
+
+        asyncio.run(body())
+
+    def test_metrics_table_over_http(self, db):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def body():
+            app = create_app(db)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                await client.post(
+                    "/sql", json={"query": "SELECT count(1) AS c FROM qs"}
+                )
+                out = await client.post("/sql", json={"query":
+                    "SELECT name, kind, value FROM system.public.metrics "
+                    "WHERE name = 'horaedb_queries_total'"})
+                assert out.status == 200
+                rows = (await out.json())["rows"]
+                assert rows and rows[0]["kind"] == "counter"
+                assert rows[0]["value"] >= 1
+                # aggregates work on the virtual table too
+                out = await client.post("/sql", json={"query":
+                    "SELECT count(1) AS families FROM system.public.metrics"})
+                assert (await out.json())["rows"][0]["families"] > 10
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+
+
+class TestClusterLedgerMerge:
+    def test_remote_owner_ledgers_merge_into_coordinator_row(
+        self, static_cluster  # noqa: F811
+    ):
+        """2-node acceptance: a distributed GROUP BY whose partitions hash
+        over both nodes produces ONE query_stats row on the coordinator
+        whose scan_rows covers BOTH nodes' scans and whose remote_rpcs
+        proves the wire was crossed."""
+        port_a, port_b = static_cluster
+        ddl = (
+            "CREATE TABLE dlt (host string TAG, v double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+            "PARTITION BY KEY(host) PARTITIONS 8 ENGINE=Analytic"
+        )
+        assert sql(port_a, ddl)[0] == 200
+        rows = ", ".join(
+            f"('h{i % 16}', {float(i)}, {1000 + i})" for i in range(400)
+        )
+        assert sql(port_a, f"INSERT INTO dlt (host, v, ts) VALUES {rows}")[0] == 200
+
+        q = "SELECT host, sum(v) AS s FROM dlt GROUP BY host"
+        status, out = sql(port_a, q)
+        assert status == 200 and len(out["rows"]) == 16, out
+
+        # The statement may have been forwarded to the logical owner —
+        # the coordinator row lives on whichever node executed it. The
+        # system.* stats query itself is never forwarded (node-local).
+        found = None
+        for port in (port_a, port_b):
+            status, out = sql(
+                port,
+                "SELECT sql, route, scan_rows, remote_rpcs, remote_bytes, "
+                "fanout, cache_hits FROM system.public.query_stats",
+            )
+            assert status == 200, out
+            hits = [r for r in out["rows"] if r["sql"] == q]
+            if hits:
+                found = hits[-1]
+                break
+        assert found is not None, "no coordinator query_stats row on either node"
+        assert found["route"] in ROUTES
+        # remote owners' ledgers merged in: the row covers ALL 400 rows
+        # even though roughly half were scanned on the peer node
+        assert found["scan_rows"] == 400, found
+        assert found["remote_rpcs"] >= 1, found
+        assert found["remote_bytes"] > 0, found
+        assert found["fanout"] == 8, found
