@@ -2,10 +2,14 @@
 (ref: src/proxy — Proxy::handle_*, Context, limiter.rs, the slow-query log
 in read.rs:177-183, and hotspot tracking).
 
-Round-1 standalone scope: request ids, per-request timing + metrics,
-a block-list limiter (the reference's ``/admin/block`` surface), a slow
-query log with a runtime-adjustable threshold, and hotspot (table read/
-write rate) tracking. Routing/forwarding joins when cluster mode lands.
+The proxy is a workload manager, not just a router: every SQL statement
+passes through the ``wlm`` subsystem — per-tenant/per-table quotas and
+the block-list (wlm/quota), cost-based admission control with weighted
+slots + bounded wait queues (wlm/admission), and single-flight dedup of
+identical in-flight SELECTs (wlm/dedup) — before it reaches the
+priority runtime and the executor. Request ids, per-request
+timing/metrics, the slow-query log, and LRU-bounded hotspot tracking
+ride the same path.
 """
 
 from __future__ import annotations
@@ -14,7 +18,7 @@ import itertools
 import logging
 import threading
 import time
-from collections import Counter as TallyCounter, deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -23,12 +27,27 @@ from ..query.interpreters import AffectedRows, Output
 from ..query.plan import InsertPlan, QueryPlan
 from ..utils.metrics import REGISTRY
 from ..utils.runtime import PriorityRuntime
+from ..wlm import (
+    BlockedError,
+    COST_HISTORY,
+    OverloadedError,
+    QuotaExceededError,
+    WorkloadManager,
+    classify_plan,
+    lane_for,
+    normalize_shape,
+)
+
+__all__ = [
+    "BlockedError",
+    "OverloadedError",
+    "QuotaExceededError",
+    "Hotspot",
+    "Proxy",
+    "RequestContext",
+]
 
 logger = logging.getLogger("horaedb_tpu.proxy")
-
-
-class BlockedError(RuntimeError):
-    pass
 
 
 @dataclass
@@ -38,44 +57,64 @@ class RequestContext:
     start: float = field(default_factory=time.perf_counter)
 
 
-class Limiter:
-    """Table block-list (ref: proxy/src/limiter.rs + /admin/block)."""
+class _LruTally:
+    """Bounded most-recently-bumped tally (the LRU half of
+    hotspot_lru.rs): at most ``capacity`` keys; bumping revives a key,
+    overflow evicts the least-recently-bumped one."""
 
-    def __init__(self) -> None:
-        self._blocked: set[str] = set()
-        self._lock = threading.Lock()
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._counts: "OrderedDict[str, float]" = OrderedDict()
 
-    def block(self, tables) -> None:
-        with self._lock:
-            self._blocked.update(tables)
+    def bump(self, key: str, n: float = 1.0) -> None:
+        self._counts[key] = self._counts.get(key, 0.0) + n
+        self._counts.move_to_end(key)
+        while len(self._counts) > self.capacity:
+            self._counts.popitem(last=False)
 
-    def unblock(self, tables) -> None:
-        with self._lock:
-            self._blocked.difference_update(tables)
+    def decay(self, factor: float) -> None:
+        for k in list(self._counts):
+            v = self._counts[k] * factor
+            if v < 1.0:
+                del self._counts[k]
+            else:
+                self._counts[k] = v
 
-    def blocked(self) -> list[str]:
-        with self._lock:
-            return sorted(self._blocked)
+    def most_common(self, n: int) -> list[tuple[str, int]]:
+        top = sorted(self._counts.items(), key=lambda kv: kv[1], reverse=True)
+        return [(k, int(v)) for k, v in top[:n]]
 
-    def check(self, table: Optional[str]) -> None:
-        if table is None:
-            return
-        with self._lock:
-            if table in self._blocked:
-                raise BlockedError(f"table blocked by limiter: {table}")
+    def __len__(self) -> int:
+        return len(self._counts)
 
 
 class Hotspot:
-    """Per-table op tallies (ref: proxy/src/hotspot.rs)."""
+    """Per-table op tallies, LRU-bounded with periodic decay (ref:
+    proxy/src/hotspot_lru.rs — the reference caps the map and ages
+    counts so high-cardinality table names can't grow it forever and a
+    burst from last week doesn't read as hot today)."""
 
-    def __init__(self) -> None:
-        self.reads: TallyCounter = TallyCounter()
-        self.writes: TallyCounter = TallyCounter()
+    def __init__(
+        self,
+        capacity: int = 512,
+        decay_interval_s: float = 60.0,
+        decay_factor: float = 0.5,
+    ) -> None:
+        self.reads = _LruTally(capacity)
+        self.writes = _LruTally(capacity)
+        self.decay_interval_s = decay_interval_s
+        self.decay_factor = decay_factor
+        self._last_decay = time.monotonic()
         self._lock = threading.Lock()
 
     def record(self, table: str, is_write: bool) -> None:
         with self._lock:
-            (self.writes if is_write else self.reads)[table] += 1
+            now = time.monotonic()
+            if now - self._last_decay >= self.decay_interval_s:
+                self.reads.decay(self.decay_factor)
+                self.writes.decay(self.decay_factor)
+                self._last_decay = now
+            (self.writes if is_write else self.reads).bump(table)
 
     def top(self, n: int = 10) -> dict:
         with self._lock:
@@ -86,13 +125,31 @@ class Hotspot:
 
 
 class Proxy:
-    def __init__(self, conn: Connection, slow_threshold_s: float = 1.0) -> None:
+    def __init__(
+        self,
+        conn: Connection,
+        slow_threshold_s: float = 1.0,
+        limits=None,
+        persist_path: Optional[str] = None,
+    ) -> None:
         self.conn = conn
-        self.limiter = Limiter()
+        if persist_path is None:
+            # operator-applied block/quota state survives a restart when
+            # the node has a data dir to keep it in
+            import os
+
+            root = getattr(conn.store, "root", None)
+            if root:
+                persist_path = os.path.join(root, "wlm_state.json")
+        self.wlm = WorkloadManager.from_limits(limits, persist_path=persist_path)
+        # the old Limiter surface (block/unblock/blocked/check) lives on,
+        # served by the quota manager that subsumed it
+        self.limiter = self.wlm.quota
         self.hotspot = Hotspot()
         self.slow_threshold_s = slow_threshold_s
-        # Expensive (long-range) queries run on the small low-priority pool
-        # (ref: SelectInterpreter spawning on the priority runtime).
+        # Expensive (long-range / history-proven-slow) queries run on the
+        # small low-priority pool (ref: SelectInterpreter spawning on the
+        # priority runtime); the lane now follows the ADMISSION class.
         self.runtime = PriorityRuntime()
         # Recent per-query metric trees (ref: trace_metric; surfaced at
         # /debug/queries).
@@ -109,8 +166,9 @@ class Proxy:
 
     def close(self) -> None:
         self.runtime.shutdown()
+        self.wlm.close()
 
-    def handle_sql(self, sql: str) -> Output:
+    def handle_sql(self, sql: str, tenant: str = "default") -> Output:
         ctx = RequestContext(next(self._req_ids), sql)
         self._m_queries.inc()
         # The span tree travels by context: priority-pool threads run the
@@ -127,6 +185,9 @@ class Proxy:
         # it, and finalization feeds system.public.query_stats + the
         # horaedb_query_* metric families (utils/querystats).
         ledger, ltoken = start_ledger(ctx.request_id, sql)
+        shape = None  # set for executed SELECTs; feeds the EWMA history
+        exec_elapsed: list = [None]  # leader execution seconds (EWMA input)
+        ok = False
         try:
             # The plan cache is what makes repeated dashboard text cheap
             # at serving latency — the gateway is its target workload.
@@ -136,30 +197,70 @@ class Proxy:
             self.limiter.check(table)
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))
+            if isinstance(plan, InsertPlan):
+                self.wlm.quota.charge_write(tenant, plan.table, len(plan.rows))
             if isinstance(plan, QueryPlan):
-                with span("execute", priority=plan.priority.value):
-                    cctx = contextvars.copy_context()
-                    out = self.runtime.run(
-                        plan.priority.value,
-                        lambda: cctx.run(self.conn.interpreters.execute, plan),
-                    )
+                self.wlm.quota.charge_read(tenant, plan.table)
+                shape = normalize_shape(sql)
+                admission_class, est_ms = classify_plan(plan, shape=shape)
+                lane = lane_for(admission_class)
+
+                def run_leader():
+                    # admission wraps only the LEADER: followers coalesce
+                    # onto its slot instead of taking their own
+                    with self.wlm.admission.admit(admission_class):
+                        with span(
+                            "execute", priority=lane, admission=admission_class
+                        ):
+                            cctx = contextvars.copy_context()
+                            t0 = time.perf_counter()
+                            try:
+                                return self.runtime.run(
+                                    lane,
+                                    lambda: cctx.run(
+                                        self.conn.interpreters.execute, plan
+                                    ),
+                                )
+                            finally:
+                                exec_elapsed[0] = time.perf_counter() - t0
+
+                out = self.wlm.dedup.run(sql.strip(), run_leader)
                 self.recent_queries.append(
                     {
                         "request_id": ctx.request_id,
                         "sql": sql[:200],
                         "priority": plan.priority.value,
+                        "admission": admission_class,
                         **(getattr(out, "metrics", None) or {}),
                     }
                 )
+                ok = True
                 return out
-            with span("execute"):
-                return self.conn.interpreters.execute(plan)
+            # any non-SELECT may change visible state: later identical
+            # reads must start a fresh single-flight execution. Bump
+            # AFTER the statement runs (in the finally, so a failed
+            # attempt still invalidates conservatively): bumping before
+            # would let a SELECT issued after this write COMMITS join a
+            # pre-write flight opened in the new epoch.
+            try:
+                with span("execute"):
+                    out = self.conn.interpreters.execute(plan)
+                    ok = True
+                    return out
+            finally:
+                self.wlm.dedup.bump_epoch()
         except Exception:
             self._m_errors.inc()
             raise
         finally:
             elapsed = time.perf_counter() - ctx.start
             self._m_latency.observe(elapsed)
+            if ok and shape is not None and exec_elapsed[0] is not None:
+                # the EWMA only learns from completed LEADER executions —
+                # failures/sheds would teach it queries are "fast", and
+                # queue or follower wait would teach cheap shapes they
+                # are "slow" under load (a self-sustaining demotion)
+                COST_HISTORY.observe(shape, exec_elapsed[0])
             slow = elapsed >= self.slow_threshold_s
             finish_trace(handle, slow=slow)
             finish_ledger(ledger, ltoken, elapsed)

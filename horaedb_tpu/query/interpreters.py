@@ -216,6 +216,14 @@ class InterpreterFactory:
         lines = []
         tr = q.predicate.time_range
         lines.append(f"Query: table={q.table} priority={q.priority.value}")
+        # the workload manager's verdict for this plan shape (wlm/admission)
+        from ..wlm.admission import classify_plan, lane_for
+
+        adm_class, est_ms = classify_plan(q)
+        lines.append(
+            f"  Admission: class={adm_class} lane={lane_for(adm_class)}"
+            + (f" est_ms={est_ms:.1f}" if est_ms is not None else "")
+        )
         lines.append(
             f"  TimeRange: [{tr.inclusive_start}, {tr.exclusive_end})"
         )

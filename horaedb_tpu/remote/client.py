@@ -74,9 +74,38 @@ class RemoteEngineClient:
             response_deserializer=None,
         )
         from ..utils.querystats import merge_remote, record
+        from ..wlm.admission import current_admission
 
+        adm = current_admission()
+        if adm is not None and "admission" not in payload:
+            # the coordinator's admission class rides every envelope
+            # beside the trace/ledger context: the partition owner runs
+            # the work on the matching PriorityRuntime lane and applies
+            # its own gate (wlm/admission)
+            payload["admission"] = adm
         req = pack(payload)
-        raw = fn(req, timeout=self.timeout_s)
+        try:
+            raw = fn(req, timeout=self.timeout_s)
+        except grpc.RpcError as e:
+            from ..wlm.admission import SHED_MARKER
+
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED and \
+                    SHED_MARKER in (e.details() or ""):
+                # the owner's admission gate shed this sub-query (marker
+                # distinguishes it from grpc's own RESOURCE_EXHAUSTED,
+                # e.g. message-size overflow): surface it as the SAME
+                # retryable overload the local gate raises, so the front
+                # ends answer 503/1040/53300 + Retry-After instead of a
+                # generic internal error
+                from ..wlm.admission import OverloadedError
+
+                raise OverloadedError(
+                    f"partition owner {self.endpoint} overloaded: "
+                    f"{e.details()}",
+                    reason="remote_shed",
+                    retry_after_s=1.0,
+                ) from e
+            raise
         record(remote_rpcs=1, remote_bytes=len(req) + len(raw))
         out = unpack(raw)
         if isinstance(out, dict):

@@ -27,7 +27,14 @@ import grpc
 
 from ..common_types.row_group import RowGroup
 from ..utils.querystats import serving_ledger
+from ..utils.runtime import PriorityRuntime
 from ..utils.tracectx import root_dict, serving_trace, span
+from ..wlm.admission import (
+    SHED_MARKER,
+    AdmissionController,
+    OverloadedError,
+    lane_for,
+)
 from .codec import (
     columns_to_ipc,
     pack,
@@ -67,6 +74,13 @@ class GrpcServer:
         self.cluster = cluster
         self.host = host
         self.port = port
+        # Serving-side workload management: the coordinator's admission
+        # class rides the envelope; heavy ops (PartialAgg/ExecutePlan)
+        # run on the matching priority lane behind this node's OWN gate —
+        # a fan-out storm from many coordinators can't starve the owner.
+        self.admission = AdmissionController(total_units=max_workers)
+        self.runtime = PriorityRuntime(high_workers=max(2, max_workers // 2),
+                                       low_workers=2)
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="grpc")
         )
@@ -105,6 +119,30 @@ class GrpcServer:
 
     def stop(self, grace: float = 2.0) -> None:
         self._server.stop(grace)
+        self.runtime.shutdown()
+
+    def _gated(self, admission_class, fn):
+        """Run ``fn`` on the lane matching the shipped admission class,
+        behind this node's own admission gate. The serving ledger/trace
+        follow by context copy; a shed answers RESOURCE_EXHAUSTED (the
+        coordinator surfaces it as a retryable overload)."""
+        import contextvars
+
+        cls = admission_class if admission_class in ("cheap", "normal", "expensive") \
+            else "normal"
+        try:
+            with self.admission.admit(cls):
+                # copy AFTER admit so the admitted class (and the serving
+                # ledger/trace) ride to the pool thread and any nested RPC
+                cctx = contextvars.copy_context()
+                return self.runtime.run(lane_for(cls), lambda: cctx.run(fn))
+        except OverloadedError as e:
+            # SHED_MARKER distinguishes a deliberate shed from grpc's own
+            # RESOURCE_EXHAUSTED uses (e.g. message-size overflow): only
+            # marked errors are retryable overloads on the coordinator
+            raise _RpcError(
+                grpc.StatusCode.RESOURCE_EXHAUSTED, f"{SHED_MARKER}: {e}"
+            )
 
     # ---- table resolution ----------------------------------------------
     def _open(self, name: str):
@@ -210,7 +248,10 @@ class GrpcServer:
         ) as trace:
             t = self._open(req["table"])
             sub: dict = {}
-            names, arrays = compute_partial(t, req["spec"], sub)
+            names, arrays = self._gated(
+                req.get("admission") or (req["spec"] or {}).get("admission"),
+                lambda: compute_partial(t, req["spec"], sub),
+            )
         metrics = {
             **sub,
             "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),
@@ -265,7 +306,9 @@ class GrpcServer:
             )
             plan = planner.plan(select)
             executor = self.conn.interpreters.executor
-            rs = executor.execute(plan, t)
+            rs = self._gated(
+                req.get("admission"), lambda: executor.execute(plan, t)
+            )
         m = rs.metrics or {}
         metrics = {
             "elapsed_ms": round((time.perf_counter() - t0) * 1000, 3),

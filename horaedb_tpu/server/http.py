@@ -11,8 +11,10 @@ config.rs:176):
     GET  /debug/config   engine + server config dump
     GET  /debug/tables   per-table metrics (memtable/sst bytes, seqs)
     GET  /debug/hotspot  hottest tables by reads/writes
+    GET  /debug/workload live admission/dedup/quota state (wlm)
     PUT  /debug/slow_threshold/{seconds}  live slow-log threshold
     POST /admin/block    {"tables": [...]} / DELETE to unblock
+    GET/POST/DELETE /admin/quota  per-tenant/table token buckets
     GET  /health         liveness
 """
 
@@ -28,7 +30,7 @@ import numpy as np
 from aiohttp import web
 
 from ..db import Connection, connect
-from ..proxy import BlockedError, Proxy
+from ..proxy import BlockedError, OverloadedError, Proxy, QuotaExceededError
 from ..query.executor import ResultSet
 from ..query.interpreters import AffectedRows
 from ..utils.metrics import REGISTRY
@@ -141,7 +143,13 @@ class SqlGateway:
     ``execute`` returns one of:
         ("affected", n)
         ("rows", (names, rows_as_dicts))
-        ("error", (http_status, message))
+        ("error", (http_status, message, extra))
+
+    ``extra`` classifies shed/blocked/quota errors for protocol-correct
+    wire mapping: {"kind": "blocked"|"overloaded"|"quota",
+    "retry_after_s": float} — HTTP turns retry_after_s into a
+    Retry-After header; MySQL and PG map kind to their native error
+    code / SQLSTATE instead of a generic internal error.
     """
 
     def __init__(self, app: web.Application) -> None:
@@ -164,13 +172,14 @@ class SqlGateway:
         query: str,
         already_forwarded: bool = False,
         protocol: str | None = None,
+        tenant: str = "default",
     ):
         if protocol is not None:
             import time as _time
 
             t0 = _time.perf_counter()
             try:
-                return await self.execute(query, already_forwarded)
+                return await self.execute(query, already_forwarded, tenant=tenant)
             finally:
                 latency_histogram(protocol).observe(_time.perf_counter() - t0)
         app = self.app
@@ -187,7 +196,7 @@ class SqlGateway:
             except Exception as e:
                 proxy._m_queries.inc()
                 proxy._m_errors.inc()
-                return "error", (422, str(e))
+                return "error", (422, str(e), {})
             from ..query import ast as _ast
 
             if cluster is not None and isinstance(
@@ -207,12 +216,12 @@ class SqlGateway:
                     # The coordinator already implements IF NOT EXISTS /
                     # IF EXISTS leniency, so any error here is REAL —
                     # never report success for DDL that happened nowhere.
-                    return "error", (422, str(e))
+                    return "error", (422, str(e), {})
                 return "affected", 0
             if cluster is not None and isinstance(stmt, _ast.Insert):
                 fence = _write_fence(cluster, router, stmt.table)
                 if fence is not None:
-                    return "error", fence
+                    return "error", (*fence, {})
             table = _table_of_statement(stmt)
             if table is not None and table.lower().startswith("system."):
                 # Virtual introspection tables (system.public.query_stats,
@@ -229,18 +238,25 @@ class SqlGateway:
                             f"routing loop: {table!r} routed to "
                             f"{route.endpoint} but this node also received "
                             "it forwarded",
+                            {},
                         )
                     return await self._forward(route.endpoint, query)
         if query.lstrip()[:7].lower().startswith("select"):
-            key = (self._write_epoch, query.strip())
+            # tenant is part of the key: a follower must not skip ITS
+            # tenant's quota charge by riding another tenant's flight
+            # (the proxy-level dedup charges before coalescing instead)
+            key = (self._write_epoch, tenant, query.strip())
             running = self._inflight.get(key)
             if running is not None and not running.done():
                 self._m_deduped.inc()
+                # count into the wlm dedup family too so the workload
+                # table reflects gateway-level coalescing
+                self.app["proxy"].wlm.dedup.note_coalesced()
                 return await asyncio.shield(running)
             # ensure_future (not a bare await): the shared execution must
             # outlive a cancelled leader request so followers still get
             # their result
-            task = asyncio.ensure_future(self._run_local(proxy, query))
+            task = asyncio.ensure_future(self._run_local(proxy, query, tenant))
             self._inflight[key] = task
 
             def _done(t, key=key):
@@ -250,19 +266,40 @@ class SqlGateway:
             task.add_done_callback(_done)
             return await asyncio.shield(task)
         # any non-SELECT may change visible state: advance the epoch so
-        # later reads start a fresh execution (conservative — bumped even
-        # if the statement ultimately fails)
-        self._write_epoch += 1
-        return await self._run_local(proxy, query)
-
-    async def _run_local(self, proxy, query: str):
-        loop = asyncio.get_running_loop()
+        # later reads start a fresh execution. Bumped AFTER the statement
+        # runs (conservatively even when it fails) — bumping before
+        # would let a post-commit SELECT join a pre-write flight that
+        # became leader under the already-advanced epoch.
         try:
-            out = await loop.run_in_executor(None, proxy.handle_sql, query)
+            return await self._run_local(proxy, query, tenant)
+        finally:
+            self._write_epoch += 1
+
+    async def _run_local(self, proxy, query: str, tenant: str = "default"):
+        loop = asyncio.get_running_loop()
+        if tenant == "default":
+            # positional call keeps handle_sql wrappers/monkeypatches with
+            # the historical (sql) signature working
+            run = functools.partial(proxy.handle_sql, query)
+        else:
+            run = functools.partial(proxy.handle_sql, query, tenant=tenant)
+        try:
+            out = await loop.run_in_executor(None, run)
         except BlockedError as e:
-            return "error", (403, str(e))
+            return "error", (403, str(e), {"kind": "blocked"})
+        except OverloadedError as e:
+            # admission shed: healthy but full — retryable by contract
+            return "error", (
+                503, str(e),
+                {"kind": "overloaded", "retry_after_s": e.retry_after_s},
+            )
+        except QuotaExceededError as e:
+            return "error", (
+                429, str(e),
+                {"kind": "quota", "retry_after_s": e.retry_after_s},
+            )
         except Exception as e:  # parse/plan/execution errors -> 422 like ref
-            return "error", (422, str(e))
+            return "error", (422, str(e), {})
         if isinstance(out, AffectedRows):
             return "affected", out.count
         return "rows", (list(out.names), out.to_pylist())
@@ -283,9 +320,11 @@ class SqlGateway:
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
             # ValueError covers non-JSON bodies; timeouts must map to the
             # same 502 contract, not unwind wire-protocol sessions.
-            return "error", (502, f"forward to {endpoint} failed: {e}")
+            return "error", (502, f"forward to {endpoint} failed: {e}", {})
         if resp.status != 200:
-            return "error", (resp.status, body.get("error", "forward failed"))
+            return "error", (
+                resp.status, body.get("error", "forward failed"), {},
+            )
         if "affected_rows" in body:
             return "affected", body["affected_rows"]
         rows = body.get("rows", [])
@@ -311,11 +350,14 @@ async def _auth_middleware(request: web.Request, handler):
 
 
 def create_app(
-    conn: Connection, router=None, cluster=None, auth_token: str = ""
+    conn: Connection, router=None, cluster=None, auth_token: str = "",
+    limits=None,
 ) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
-    adds the /meta_event endpoints, meta-driven DDL, and write fencing."""
-    proxy = Proxy(conn)
+    adds the /meta_event endpoints, meta-driven DDL, and write fencing.
+    ``limits``: a config LimitsConfig for the workload manager's knobs
+    (admission slots/queue/deadline/memory budget, dedup)."""
+    proxy = Proxy(conn, limits=limits)
     app = web.Application(middlewares=[_auth_middleware])
     app["auth_token"] = auth_token
     app["conn"] = conn
@@ -395,10 +437,20 @@ def create_app(
             query,
             already_forwarded=bool(request.headers.get(FORWARD_HEADER)),
             protocol="http",
+            # per-tenant quota scope (wlm/quota); absent -> "default"
+            tenant=request.headers.get("X-HoraeDB-Tenant", "default"),
         )
         if kind == "error":
-            status, msg = payload
-            return web.json_response({"error": msg}, status=status)
+            status, msg, extra = payload
+            headers = {}
+            if extra.get("retry_after_s") is not None:
+                # shed/quota answers are retryable by contract: say when
+                headers["Retry-After"] = str(
+                    max(1, int(round(extra["retry_after_s"])))
+                )
+            return web.json_response(
+                {"error": msg}, status=status, headers=headers
+            )
         if kind == "affected":
             return web.json_response({"affected_rows": payload})
         names, rows = payload
@@ -431,6 +483,7 @@ def create_app(
 
         def do_write():
             proxy.limiter.check(table)
+            proxy.wlm.quota.charge_write("default", table, len(rows))
             t = conn_.catalog.open(table)
             if t is None:
                 raise ValueError(f"table not found: {table}")
@@ -445,8 +498,17 @@ def create_app(
             n = await asyncio.get_running_loop().run_in_executor(None, do_write)
         except BlockedError as e:
             return web.json_response({"error": str(e)}, status=403)
+        except QuotaExceededError as e:
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
         except Exception as e:
             return web.json_response({"error": str(e)}, status=422)
+        # a raw write changes visible state: later identical SELECTs must
+        # not join a pre-write single-flight execution (either layer)
+        gateway._write_epoch += 1
+        proxy.wlm.dedup.bump_epoch()
         return web.json_response({"affected_rows": n})
 
     # ---- protocol front ends -------------------------------------------
@@ -460,11 +522,18 @@ def create_app(
             import time as _time
 
             points = parse_lines(body, precision)
-            # Same limiter/hotspot discipline as the /sql and /write paths.
-            for m in {p.measurement for p in points}:
+            # Same limiter/quota/hotspot discipline as /sql and /write.
+            measurements: dict[str, int] = {}
+            for p in points:
+                measurements[p.measurement] = measurements.get(p.measurement, 0) + 1
+            for m in measurements:
                 proxy.limiter.check(m)
+            # one all-or-nothing debit: a rejected batch leaves the
+            # tenant and every table bucket untouched, so retries of the
+            # same payload don't drain unrelated allowances
+            proxy.wlm.quota.charge_write_batch("default", measurements)
             n = write_points(conn.catalog, points, now_ms=int(_time.time() * 1000))
-            for m in {p.measurement for p in points}:
+            for m in measurements:
                 proxy.hotspot.record(m, True)
             return n
 
@@ -474,8 +543,14 @@ def create_app(
             return web.json_response({"error": str(e)}, status=400)
         except BlockedError as e:
             return web.json_response({"error": str(e)}, status=403)
+        except QuotaExceededError as e:
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
         except Exception as e:
             return web.json_response({"error": str(e)}, status=422)
+        proxy.wlm.dedup.bump_epoch()
         # Influx v1 returns 204 No Content on success.
         return web.Response(status=204, headers={"X-Written-Rows": str(n)})
 
@@ -561,10 +636,14 @@ def create_app(
 
         def do():
             points = parse_put(body)
-            for m in {p["metric"] for p in points}:
+            metrics_count: dict[str, int] = {}
+            for p in points:
+                metrics_count[p["metric"]] = metrics_count.get(p["metric"], 0) + 1
+            for m in metrics_count:
                 proxy.limiter.check(m)
+            proxy.wlm.quota.charge_write_batch("default", metrics_count)
             n = otsdb_write(conn.catalog, points)
-            for m in {p["metric"] for p in points}:
+            for m in metrics_count:
                 proxy.hotspot.record(m, True)
             return n
 
@@ -574,8 +653,14 @@ def create_app(
             return web.json_response({"error": str(e)}, status=400)
         except BlockedError as e:
             return web.json_response({"error": str(e)}, status=403)
+        except QuotaExceededError as e:
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
         except Exception as e:
             return web.json_response({"error": str(e)}, status=422)
+        proxy.wlm.dedup.bump_epoch()
         return web.Response(status=204)
 
     async def prom_query(request: web.Request) -> web.Response:
@@ -913,7 +998,56 @@ def create_app(
             proxy.limiter.block(tables)
         else:
             proxy.limiter.unblock(tables)
+        # block/unblock persist through the quota manager's state file —
+        # a restarted node comes back with the operator's limits applied
         return web.json_response({"blocked": proxy.limiter.blocked()})
+
+    async def debug_workload(request: web.Request) -> web.Response:
+        """Live workload-manager state: admission slots/queues, dedup
+        flights, quota buckets — the same state served SQL-side by
+        ``system.public.workload``."""
+        return web.Response(
+            text=_dumps(proxy.wlm.snapshot()), content_type="application/json"
+        )
+
+    async def admin_quota(request: web.Request) -> web.Response:
+        """GET: current quotas + block-list. POST: set a token bucket
+        {"scope": "table"|"tenant", "name": ..., "kind":
+        "read_qps"|"write_rows", "rate": r, "burst"?: b}. DELETE: remove
+        one. State persists across restarts via the config layer."""
+        if request.method == "GET":
+            return web.Response(
+                text=_dumps(proxy.wlm.quota.snapshot()),
+                content_type="application/json",
+            )
+        try:
+            body = await request.json()
+            scope = body["scope"]
+            name = body["name"]
+            kind = body["kind"]
+        except Exception:
+            return web.json_response(
+                {"error": "body must be {'scope', 'name', 'kind', ...}"},
+                status=400,
+            )
+        if request.method == "DELETE":
+            removed = proxy.wlm.quota.remove_quota(scope, name, kind)
+            return web.json_response(
+                {"removed": removed, **proxy.wlm.quota.snapshot()}
+            )
+        try:
+            rate = float(body["rate"])
+            burst = body.get("burst")
+            proxy.wlm.quota.set_quota(
+                scope, name, kind, rate,
+                float(burst) if burst is not None else None,
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.Response(
+            text=_dumps(proxy.wlm.quota.snapshot()),
+            content_type="application/json",
+        )
 
     # ---- meta events (coordinator -> data node; ref: MetaEventService,
     # grpc/meta_event_service/mod.rs:638-696) ----------------------------
@@ -1099,9 +1233,13 @@ def create_app(
     app.router.add_get("/debug/wal_stats", debug_wal_stats)
     app.router.add_get("/debug/compaction", debug_compaction)
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
+    app.router.add_get("/debug/workload", debug_workload)
     app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)
+    app.router.add_get("/admin/quota", admin_quota)
+    app.router.add_post("/admin/quota", admin_quota)
+    app.router.add_delete("/admin/quota", admin_quota)
     return app
 
 
@@ -1249,6 +1387,7 @@ def run_server(
         router=router,
         cluster=cluster,
         auth_token=(config.server.auth_token if config is not None else ""),
+        limits=(config.limits if config is not None else None),
     )
     app["proxy"].slow_threshold_s = slow_threshold
 

@@ -248,11 +248,26 @@ class _Conn:
     def _eof(self) -> None:
         self._send(b"\xfe" + (0).to_bytes(2, "little") + (2).to_bytes(2, "little"))
 
-    def _error(self, msg: str, errno: int = 1105) -> None:
+    def _error(self, msg: str, errno: int = 1105, sqlstate: str = "HY000") -> None:
         self._send(
-            b"\xff" + errno.to_bytes(2, "little") + b"#HY000"
+            b"\xff" + errno.to_bytes(2, "little")
+            + b"#" + sqlstate.encode("ascii", "replace")[:5].ljust(5, b"0")
             + msg.encode("utf-8", "replace")[:400]
         )
+
+    def _gateway_error(self, payload) -> None:
+        """Map the gateway's typed error onto native MySQL codes: shed /
+        quota rejections answer 1040 (ER_CON_COUNT_ERROR, SQLSTATE 08004
+        — the standard 'server overloaded, retry' signal); blocked
+        tables answer 1142 (ER_TABLEACCESS_DENIED_ERROR, 42000)."""
+        _status, msg, extra = payload
+        kind = extra.get("kind")
+        if kind in ("overloaded", "quota"):
+            self._error(msg, errno=1040, sqlstate="08004")
+        elif kind == "blocked":
+            self._error(msg, errno=1142, sqlstate="42000")
+        else:
+            self._error(msg)
 
     def _result_set(self, names: list[str], rows: list[list]) -> None:
         if not names:
@@ -333,8 +348,7 @@ class _Conn:
         # the per-protocol latency labelset).
         kind, payload = await self.gateway.execute(q, protocol="mysql")
         if kind == "error":
-            _, msg = payload
-            self._error(msg)
+            self._gateway_error(payload)
         elif kind == "affected":
             self._ok(payload)
         else:
@@ -417,7 +431,7 @@ class _Conn:
             sql.strip().rstrip(";"), protocol="mysql"
         )
         if kind == "error":
-            self._error(payload[1])
+            self._gateway_error(payload)
         elif kind == "affected":
             self._ok(payload)
         else:

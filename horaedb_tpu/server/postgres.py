@@ -51,6 +51,23 @@ _NUMBER_RE = re.compile(r"^-?\d+(\.\d+)?([eE][+-]?\d+)?$")
 class _ExtError(Exception):
     """Extended-protocol failure: error the client, discard until Sync."""
 
+    def __init__(self, message: str, sqlstate: str = "XX000") -> None:
+        super().__init__(message)
+        self.sqlstate = sqlstate
+
+
+def _sqlstate_for(extra: dict) -> str:
+    """Native SQLSTATE for the gateway's typed errors: shed and quota
+    rejections answer 53300 (too_many_connections — class 53,
+    insufficient resources: retryable); blocked tables answer 42501
+    (insufficient_privilege)."""
+    kind = extra.get("kind")
+    if kind in ("overloaded", "quota"):
+        return "53300"
+    if kind == "blocked":
+        return "42501"
+    return "XX000"
+
 
 class _Conn:
     def __init__(self, reader, writer, gateway) -> None:
@@ -100,7 +117,7 @@ class _Conn:
                     except _ExtError as e:
                         # per spec: error once, then discard every
                         # extended message until the next Sync
-                        self._error(str(e))
+                        self._error(str(e), e.sqlstate)
                         self._ext_error = True
                     except (ValueError, IndexError, struct.error):
                         # truncated/NUL-less body: error, never tear down
@@ -188,7 +205,7 @@ class _Conn:
             sql.strip().rstrip(";"), protocol="postgres"
         )
         if kind == "error":
-            raise _ExtError(payload[1])
+            raise _ExtError(payload[1], _sqlstate_for(payload[2]))
         self._portals[portal] = (kind, payload, sql, 0)  # 0 = row cursor
         self.writer.write(_msg(b"2", b""))  # BindComplete
 
@@ -256,9 +273,10 @@ class _Conn:
         (self._stmts if what == b"S" else self._portals).pop(name, None)
         self.writer.write(_msg(b"3", b""))  # CloseComplete
 
-    def _error(self, message: str) -> None:
+    def _error(self, message: str, sqlstate: str = "XX000") -> None:
         payload = (
-            b"S" + _cstr("ERROR") + b"C" + _cstr("XX000") + b"M" + _cstr(message) + b"\x00"
+            b"S" + _cstr("ERROR") + b"C" + _cstr(sqlstate)
+            + b"M" + _cstr(message) + b"\x00"
         )
         self.writer.write(_msg(b"E", payload))
 
@@ -280,8 +298,8 @@ class _Conn:
         # including the per-protocol latency labelset.
         kind, payload = await self.gateway.execute(q, protocol="postgres")
         if kind == "error":
-            _, msg = payload
-            self._error(msg)
+            _status, msg, extra = payload
+            self._error(msg, _sqlstate_for(extra))
             self._ready()
             return
         if kind == "affected":

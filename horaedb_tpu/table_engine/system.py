@@ -10,7 +10,7 @@ wire protocol (HTTP SQL, MySQL, PostgreSQL) — works on them unchanged.
 Reads materialize a fresh RowGroup on every scan (the listing IS the
 current state).
 
-Three tables:
+Four tables:
 
 - ``system.public.tables``      — the catalog registry
 - ``system.public.query_stats`` — the bounded ring of finalized per-query
@@ -18,6 +18,10 @@ Three tables:
   one row per recent query with route + every ledger cost field
 - ``system.public.metrics``     — a live snapshot of the Prometheus
   registry (one row per sample: family, kind, labels, value)
+- ``system.public.workload``    — the workload manager's live state
+  (admission slots/queues, dedup flights, quota buckets) plus every
+  ``horaedb_admission_*`` counter, as (category, name, label, value)
+  rows — the SQL face of /debug/workload
 """
 
 from __future__ import annotations
@@ -31,6 +35,7 @@ from .table import Table, TableOptions
 TABLES_NAME = "system.public.tables"
 QUERY_STATS_NAME = "system.public.query_stats"
 METRICS_NAME = "system.public.metrics"
+WORKLOAD_NAME = "system.public.workload"
 
 
 class _VirtualTable(Table):
@@ -259,6 +264,98 @@ class MetricsTable(_VirtualTable):
         )
 
 
+_WORKLOAD_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("category", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("name", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("label", DatumKind.STRING),
+        ColumnSchema("value", DatumKind.DOUBLE),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "category", "name", "label"],
+)
+
+
+class WorkloadTable(_VirtualTable):
+    """``system.public.workload``: the workload manager's live state as
+    rows, observable over every wire protocol.
+
+    Live gauges (slots in use, queue depths, dedup flights, quota bucket
+    tokens) read from the process's registered WorkloadManagers (summed
+    when several proxies coexist); every ``horaedb_admission_*`` metric
+    family contributes counter rows under category ``counters`` (name =
+    family, so the lint contract 'family -> system-table row' is
+    mechanical). Histogram families surface as ``count``/``sum`` labeled
+    rows under the family name."""
+
+    @property
+    def name(self) -> str:
+        return WORKLOAD_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _WORKLOAD_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        import time
+
+        from ..utils.metrics import Histogram, _render_labels, REGISTRY
+        from ..wlm import registered_managers
+
+        now = int(time.time() * 1000)
+        # (category, name, label) -> summed value
+        rows: dict[tuple[str, str, str], float] = {}
+
+        def add(category: str, name: str, label: str, value: float) -> None:
+            key = (category, name, label)
+            rows[key] = rows.get(key, 0.0) + float(value)
+
+        for mgr in registered_managers():
+            adm = mgr.admission.snapshot()
+            for k in ("total_units", "units_in_use", "memory_budget_bytes",
+                      "memory_in_use_bytes", "expensive_cap", "queue_limit"):
+                add("admission", k, "", adm[k])
+            for cls, units in adm["class_units"].items():
+                add("admission", "class_units", cls, units)
+            for cls, depth in adm["queue_depth"].items():
+                add("admission", "queue_depth", cls, depth)
+            ded = mgr.dedup.snapshot()
+            for k in ("inflight_leaders", "waiting_followers", "write_epoch"):
+                add("dedup", k, "", ded[k])
+            q = mgr.quota.snapshot()
+            for t in q["blocked"]:
+                add("quota", "blocked", t, 1)
+            for b in q["quotas"]:
+                label = f"{b['scope']}:{b['name']}:{b['kind']}"
+                add("quota", "bucket_rate", label, b["rate"])
+                add("quota", "bucket_tokens", label, b["tokens"])
+        for family, members in sorted(REGISTRY.families().items()):
+            if not family.startswith("horaedb_admission_"):
+                continue
+            for m in members:
+                rendered = _render_labels(m.labels)
+                if isinstance(m, Histogram):
+                    with m._lock:
+                        total, sum_ = m._total, m._sum
+                    add("counters", family, "count", total)
+                    add("counters", family, "sum", sum_)
+                else:
+                    add("counters", family, rendered, m.value)
+        keys = sorted(rows)
+        n = len(keys)
+        return RowGroup(
+            _WORKLOAD_SCHEMA,
+            {
+                "timestamp": np.full(n, now, dtype=np.int64),
+                "category": np.array([k[0] for k in keys], dtype=object),
+                "name": np.array([k[1] for k in keys], dtype=object),
+                "label": np.array([k[2] for k in keys], dtype=object),
+                "value": np.array([rows[k] for k in keys], dtype=np.float64),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -269,4 +366,6 @@ def open_system_table(catalog, name: str):
         return QueryStatsTable()
     if low == METRICS_NAME:
         return MetricsTable()
+    if low == WORKLOAD_NAME:
+        return WorkloadTable()
     return None

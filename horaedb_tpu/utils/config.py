@@ -17,6 +17,11 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
 
     [limits]
     slow_threshold = "1s"
+    admission_slots = 8               # weighted admission slot units
+    admission_queue_depth = 32        # bounded per-class wait queue
+    admission_deadline = "5s"         # queue wait before shedding
+    admission_memory_budget = "1gb"  # working-set budget for admits
+    dedup = true                      # single-flight identical reads
 
 Env overrides: HORAEDB_HTTP_PORT, HORAEDB_HOST, HORAEDB_DATA_DIR.
 """
@@ -134,6 +139,13 @@ class EngineSection:
 @dataclass
 class LimitsConfig:
     slow_threshold_s: float = 1.0
+    # workload manager (wlm/): weighted admission slots, bounded wait
+    # queues with a deadline, a memory budget, and read dedup
+    admission_slots: int = 8
+    admission_queue_depth: int = 32
+    admission_deadline_s: float = 5.0
+    admission_memory_budget: int = 1 << 30
+    dedup: bool = True
 
 
 @dataclass
@@ -197,7 +209,10 @@ _KNOWN = {
         "data_dir", "wal", "wal_backend",
         "space_write_buffer_size", "compaction_l0_trigger",
     },
-    "limits": {"slow_threshold"},
+    "limits": {
+        "slow_threshold", "admission_slots", "admission_queue_depth",
+        "admission_deadline", "admission_memory_budget", "dedup",
+    },
     "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
     "s3": {
         "bucket", "endpoint", "region", "access_key", "secret_key", "prefix",
@@ -251,6 +266,22 @@ def _apply(cfg: Config, raw: dict) -> None:
     l = raw.get("limits", {})
     if "slow_threshold" in l:
         cfg.limits.slow_threshold_s = parse_duration_ms(l["slow_threshold"]) / 1000.0
+    if "admission_slots" in l:
+        cfg.limits.admission_slots = int(l["admission_slots"])
+    if "admission_queue_depth" in l:
+        cfg.limits.admission_queue_depth = int(l["admission_queue_depth"])
+    if "admission_deadline" in l:
+        cfg.limits.admission_deadline_s = (
+            parse_duration_ms(l["admission_deadline"]) / 1000.0
+        )
+    if "admission_memory_budget" in l:
+        cfg.limits.admission_memory_budget = parse_size_bytes(
+            l["admission_memory_budget"]
+        )
+    if "dedup" in l:
+        if not isinstance(l["dedup"], bool):
+            raise ConfigError("limits.dedup must be a boolean")
+        cfg.limits.dedup = l["dedup"]
     s3 = raw.get("s3", {})
     if s3:
         for k in ("bucket", "endpoint", "region", "access_key", "secret_key",

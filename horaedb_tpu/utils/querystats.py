@@ -58,11 +58,16 @@ NUMERIC_FIELDS: dict[str, str] = {
     "remote_rpcs": "remote-engine RPCs issued",
     "remote_bytes": "request+response bytes over the remote engine",
     "retries": "stale-route retries during execution",
+    # workload-management roles (wlm/dedup): single-flight reads record
+    # which side of the coalescing they were on
+    "dedup_followers": "identical in-flight twins this leader execution served",
+    "dedup_follower": "1 when this query awaited an identical in-flight leader",
 }
 
-# jit compile wall time is the one non-count cost; seconds, float.
+# wall-time costs; seconds, float.
 FLOAT_FIELDS: dict[str, str] = {
     "jit_compile_seconds": "wall seconds spent compiling new kernel shapes",
+    "admission_wait_seconds": "wall seconds waiting for an admission slot",
 }
 
 LEDGER_FIELDS: dict[str, str] = {**NUMERIC_FIELDS, **FLOAT_FIELDS}

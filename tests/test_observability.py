@@ -825,6 +825,53 @@ class TestMetricsNameLint:
         assert "horaedb_query_route_total" in families
         assert not missing, missing
 
+    def test_admission_families_map_to_workload_rows_and_docs(self):
+        """PR-3 lint extension (same contract): every horaedb_admission_*
+        family declared in wlm.ADMISSION_METRIC_FAMILIES must be (a)
+        registered live, (b) convention-clean, (c) visible as rows of
+        system.public.workload, and (d) documented in docs/WORKLOAD.md —
+        and no stray horaedb_admission_* family may exist outside the
+        declared registry."""
+        import os
+        import re
+
+        from horaedb_tpu.table_engine.system import WorkloadTable
+        from horaedb_tpu.utils.metrics import REGISTRY
+        from horaedb_tpu.wlm import ADMISSION_METRIC_FAMILIES, WorkloadManager
+
+        mgr = WorkloadManager()  # at least one live manager for gauges
+        try:
+            rows = WorkloadTable()._materialize()
+            row_names = set(rows.columns["name"])
+        finally:
+            mgr.close()
+        docs = open(
+            os.path.join(os.path.dirname(__file__), "..", "docs", "WORKLOAD.md")
+        ).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        missing = []
+        for fam in ADMISSION_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{fam}: violates naming lint")
+            if fam not in row_names:
+                missing.append(f"{fam}: no system.public.workload row")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/WORKLOAD.md")
+        for fam in families:
+            if fam.startswith("horaedb_admission_") and \
+                    fam not in ADMISSION_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        # the wlm ledger fields ride the PR-2 lint automatically; pin the
+        # workload doc mention too so the contract is discoverable
+        for field in ("admission_wait_seconds", "dedup_followers",
+                      "dedup_follower"):
+            if f"`{field}`" not in docs:
+                missing.append(f"{field}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
         and horaedb_wal_* families after a flush+compaction cycle."""

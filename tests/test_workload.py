@@ -1,0 +1,693 @@
+"""Workload management (horaedb_tpu/wlm): cost-based admission control,
+in-flight read dedup with ledger roles, per-tenant/per-table quotas,
+wire-error mapping, and the system.public.workload surface."""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.proxy import Proxy
+from horaedb_tpu.server import create_app
+from horaedb_tpu.wlm import WorkloadManager
+from horaedb_tpu.wlm.admission import (
+    AdmissionController,
+    COST_HISTORY,
+    OverloadedError,
+    WEIGHTS,
+    classify_plan,
+    normalize_shape,
+)
+from horaedb_tpu.wlm.quota import QuotaExceededError, QuotaManager, TokenBucket
+
+
+# ---- cost estimator -------------------------------------------------------
+
+
+class TestCostEstimator:
+    def test_normalize_shape_strips_literals(self):
+        a = normalize_shape("SELECT v FROM t WHERE h = 'abc' AND ts > 100")
+        b = normalize_shape("select  v  from t where h = 'zz''q' and ts > 999999")
+        assert a == b
+        assert "?" in a and "abc" not in a
+
+    def test_static_classes(self, tmp_path):
+        conn = horaedb_tpu.connect(None)
+        conn.execute("CREATE TABLE ce (h string TAG, v double, ts timestamp KEY)")
+        cheap = conn._cached_plan("SELECT v FROM ce WHERE ts >= 0 AND ts < 1000")
+        normal = conn._cached_plan(
+            "SELECT h, sum(v) FROM ce WHERE ts >= 0 AND ts < 1000 GROUP BY h"
+        )
+        exp = conn._cached_plan("SELECT v FROM ce")  # unbounded range
+        assert classify_plan(cheap)[0] == "cheap"
+        assert classify_plan(normal)[0] == "normal"
+        assert classify_plan(exp)[0] == "expensive"
+        conn.close()
+
+    def test_ewma_overrides_static(self):
+        conn = horaedb_tpu.connect(None)
+        conn.execute("CREATE TABLE ce2 (h string TAG, v double, ts timestamp KEY)")
+        sql = "SELECT count(*) AS c FROM ce2 WHERE h = 'x'"
+        plan = conn._cached_plan(sql)
+        shape = normalize_shape(sql)
+        assert classify_plan(plan, shape=shape)[0] == "expensive"  # static
+        for _ in range(3):
+            COST_HISTORY.observe(shape, 0.001)  # proven fast
+        cls, est = classify_plan(plan, shape=shape)
+        assert cls == "cheap" and est is not None and est < 50
+        for _ in range(10):
+            COST_HISTORY.observe(shape, 5.0)  # now proven slow
+        assert classify_plan(plan, shape=shape)[0] == "expensive"
+        conn.close()
+
+
+# ---- admission controller -------------------------------------------------
+
+
+class TestAdmissionController:
+    def _hold(self, ctrl, cls, release, entered):
+        def run():
+            with ctrl.admit(cls):
+                entered.append(cls)
+                release.wait(10)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t
+
+    def test_cheap_admits_under_expensive_saturation(self):
+        """The acceptance contract: a saturated expensive lane still
+        admits a cheap query within its deadline."""
+        ctrl = AdmissionController(total_units=8, deadline_s=5.0)
+        release = threading.Event()
+        entered: list = []
+        n_hold = ctrl.expensive_cap // WEIGHTS["expensive"]  # fills the cap
+        threads = [
+            self._hold(ctrl, "expensive", release, entered) for _ in range(n_hold)
+        ]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(entered) < n_hold:
+            time.sleep(0.01)
+        assert len(entered) == n_hold
+        # the expensive lane is at its cap: one more sheds on deadline
+        with pytest.raises(OverloadedError) as ei:
+            with ctrl.admit("expensive", deadline_s=0.1):
+                pass
+        assert ei.value.reason == "deadline" and ei.value.retryable
+        # ...but a cheap query still has its reserved unit
+        t0 = time.perf_counter()
+        with ctrl.admit("cheap", deadline_s=2.0):
+            waited = time.perf_counter() - t0
+        assert waited < 1.0
+        release.set()
+        for t in threads:
+            t.join(5)
+
+    def test_queue_full_sheds_immediately(self):
+        # total_units clamps to WEIGHTS["expensive"] + 1 = 4: two normal
+        # holders (2 units each) saturate it
+        ctrl = AdmissionController(total_units=2, queue_depth=0, deadline_s=5.0)
+        assert ctrl.total_units == 4
+        release = threading.Event()
+        entered: list = []
+        threads = [self._hold(ctrl, "normal", release, entered) for _ in range(2)]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(entered) < 2:
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        with pytest.raises(OverloadedError) as ei:
+            with ctrl.admit("normal"):
+                pass
+        assert ei.value.reason == "queue_full"
+        assert time.perf_counter() - t0 < 1.0  # no deadline wait
+        release.set()
+        for t in threads:
+            t.join(5)
+
+    def test_cheap_admits_under_normal_saturation(self):
+        """A normal-class (dashboard aggregate) storm must not starve
+        cheap point lookups either: non-cheap load collectively stops at
+        total_units - 1."""
+        ctrl = AdmissionController(total_units=8, deadline_s=5.0)
+        release = threading.Event()
+        entered: list = []
+        # 3 normals (6 units) fill the non-cheap cap of 7; a 4th waits
+        threads = [self._hold(ctrl, "normal", release, entered) for _ in range(3)]
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(entered) < 3:
+            time.sleep(0.01)
+        with pytest.raises(OverloadedError):
+            with ctrl.admit("normal", deadline_s=0.1):
+                pass
+        t0 = time.perf_counter()
+        with ctrl.admit("cheap", deadline_s=2.0):
+            waited = time.perf_counter() - t0
+        assert waited < 1.0
+        release.set()
+        for t in threads:
+            t.join(5)
+
+    def test_small_slots_config_still_admits_expensive(self):
+        # admission_slots=2 clamps up so an idle controller can always
+        # admit one expensive query instead of shedding forever
+        ctrl = AdmissionController(total_units=2)
+        with ctrl.admit("expensive", deadline_s=0.5):
+            assert ctrl.snapshot()["units_in_use"] == WEIGHTS["expensive"]
+
+    def test_snapshot_reflects_occupancy(self):
+        ctrl = AdmissionController(total_units=8)
+        with ctrl.admit("normal"):
+            snap = ctrl.snapshot()
+            assert snap["units_in_use"] == WEIGHTS["normal"]
+            assert snap["class_units"]["normal"] == WEIGHTS["normal"]
+            assert snap["memory_in_use_bytes"] > 0
+        assert ctrl.snapshot()["units_in_use"] == 0
+
+
+# ---- proxy-level dedup with ledger roles ----------------------------------
+
+
+class TestDedupLedgerRoles:
+    def test_n_identical_selects_execute_once_with_roles(self):
+        from horaedb_tpu.utils.querystats import STATS_STORE
+
+        conn = horaedb_tpu.connect(None)
+        conn.execute("CREATE TABLE dd (h string TAG, v double, ts timestamp KEY)")
+        conn.execute("INSERT INTO dd (h, v, ts) VALUES ('a', 1.0, 1)")
+        proxy = Proxy(conn)
+        calls: list = []
+        gate = threading.Event()
+        orig = conn.interpreters.execute
+
+        def slow_execute(plan):
+            calls.append(plan)
+            gate.wait(10)  # park the leader so followers pile up
+            return orig(plan)
+
+        conn.interpreters.execute = slow_execute
+        sql = "SELECT count(*) AS c FROM dd WHERE ts >= 0 AND ts < 5000"
+        results: list = [None] * 4
+        errors: list = []
+
+        def run(i):
+            try:
+                results[i] = proxy.handle_sql(sql)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while (
+            time.monotonic() < deadline
+            and proxy.wlm.dedup.snapshot()["waiting_followers"] < 3
+        ):
+            time.sleep(0.01)
+        assert proxy.wlm.dedup.snapshot()["waiting_followers"] == 3
+        gate.set()
+        for t in threads:
+            t.join(10)
+        assert not errors, errors
+        assert len(calls) == 1  # exactly one executor run
+        assert all(r.to_pylist() == results[0].to_pylist() for r in results)
+        rows = [r for r in STATS_STORE.list() if r["sql"] == sql]
+        assert len(rows) == 4
+        leaders = [r for r in rows if r["dedup_followers"] == 3]
+        followers = [r for r in rows if r["dedup_follower"] == 1]
+        assert len(leaders) == 1 and len(followers) == 3
+        proxy.close()
+        conn.close()
+
+    def test_write_bumps_epoch_no_stale_join(self):
+        conn = horaedb_tpu.connect(None)
+        conn.execute("CREATE TABLE de (h string TAG, v double, ts timestamp KEY)")
+        proxy = Proxy(conn)
+        epoch0 = proxy.wlm.dedup.snapshot()["write_epoch"]
+        proxy.handle_sql("INSERT INTO de (h, v, ts) VALUES ('a', 1.0, 1)")
+        assert proxy.wlm.dedup.snapshot()["write_epoch"] == epoch0 + 1
+        proxy.close()
+        conn.close()
+
+
+# ---- saturated lane end-to-end through the proxy --------------------------
+
+
+class TestProxySaturation:
+    def test_cheap_select_completes_while_expensive_lane_held(self):
+        conn = horaedb_tpu.connect(None)
+        conn.execute("CREATE TABLE sat (h string TAG, v double, ts timestamp KEY)")
+        conn.execute("INSERT INTO sat (h, v, ts) VALUES ('a', 1.0, 1)")
+        proxy = Proxy(conn)
+        ctrl = proxy.wlm.admission
+        release = threading.Event()
+        entered: list = []
+
+        def hold():
+            with ctrl.admit("expensive"):
+                entered.append(1)
+                release.wait(10)
+
+        n_hold = ctrl.expensive_cap // WEIGHTS["expensive"]
+        threads = [threading.Thread(target=hold, daemon=True) for _ in range(n_hold)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(entered) < n_hold:
+            time.sleep(0.01)
+        t0 = time.perf_counter()
+        out = proxy.handle_sql("SELECT v FROM sat WHERE ts >= 0 AND ts < 1000")
+        elapsed = time.perf_counter() - t0
+        assert out.to_pylist() == [{"v": 1.0}]
+        assert elapsed < ctrl.deadline_s
+        release.set()
+        for t in threads:
+            t.join(5)
+        proxy.close()
+        conn.close()
+
+
+# ---- quotas ---------------------------------------------------------------
+
+
+class TestQuota:
+    def test_token_bucket_refill_and_zero_rate(self):
+        b = TokenBucket(rate=10.0, burst=2.0)
+        assert b.try_consume() == 0.0
+        assert b.try_consume() == 0.0
+        wait = b.try_consume()
+        assert 0 < wait <= 0.2
+        z = TokenBucket(rate=0.0, burst=0.0)
+        assert z.try_consume() == float("inf")
+
+    def test_charge_read_and_write_scopes(self):
+        q = QuotaManager()
+        q.set_quota("table", "qt", "read_qps", 0.0, burst=0.0)
+        with pytest.raises(QuotaExceededError) as ei:
+            q.charge_read("default", "qt")
+        assert ei.value.retryable and ei.value.retry_after_s > 0
+        q.charge_read("default", "other")  # unlimited table passes
+        q.set_quota("tenant", "acme", "write_rows", 1.0, burst=1.0)
+        q.charge_write("acme", "anytable", 1)
+        with pytest.raises(QuotaExceededError):
+            q.charge_write("acme", "anytable", 5)
+        # runtime adjust: raising the rate unblocks
+        q.set_quota("table", "qt", "read_qps", 100.0)
+        q.charge_read("default", "qt")
+
+    def test_rejection_does_not_drain_other_buckets(self):
+        q = QuotaManager()
+        q.set_quota("tenant", "te", "read_qps", 100.0, burst=100.0)
+        q.set_quota("table", "hot", "read_qps", 0.0, burst=0.0)
+        for _ in range(50):
+            with pytest.raises(QuotaExceededError):
+                q.charge_read("te", "hot")
+        # the rejected attempts must not have consumed tenant allowance
+        for _ in range(100):
+            q.charge_read("te", "cold")
+
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "wlm_state.json")
+        q1 = QuotaManager(persist_path=path)
+        q1.block(["cpu", "mem"])
+        q1.set_quota("table", "cpu", "read_qps", 5.0, burst=7.0)
+        q2 = QuotaManager(persist_path=path)
+        assert q2.blocked() == ["cpu", "mem"]
+        snap = q2.snapshot()
+        assert any(
+            e["name"] == "cpu" and e["kind"] == "read_qps" and e["rate"] == 5.0
+            and e["burst"] == 7.0
+            for e in snap["quotas"]
+        )
+        q2.unblock(["cpu"])
+        q2.remove_quota("table", "cpu", "read_qps")
+        q3 = QuotaManager(persist_path=path)
+        assert q3.blocked() == ["mem"]
+        assert not q3.snapshot()["quotas"]
+
+    def test_proxy_persists_block_across_restart(self, tmp_path):
+        conn = horaedb_tpu.connect(str(tmp_path / "d"))
+        p1 = Proxy(conn)
+        p1.limiter.block(["cpu"])
+        p1.wlm.quota.set_quota("table", "cpu", "read_qps", 9.0)
+        p1.close()
+        p2 = Proxy(conn)  # fresh proxy over the same data dir
+        assert p2.limiter.blocked() == ["cpu"]
+        assert any(
+            e["name"] == "cpu" and e["rate"] == 9.0
+            for e in p2.wlm.quota.snapshot()["quotas"]
+        )
+        p2.close()
+        conn.close()
+
+
+# ---- wire-error mapping + workload table on all three wires ---------------
+
+
+def _mysql_raw_error(client, sql):
+    """(errno, sqlstate, msg) from a COM_QUERY error packet."""
+    client.seq = 0
+    client.send_packet(b"\x03" + sql.encode())
+    pkt = client.read_packet()
+    assert pkt[0] == 0xFF, pkt
+    errno = int.from_bytes(pkt[1:3], "little")
+    sqlstate = pkt[4:9].decode()
+    return errno, sqlstate, pkt[9:].decode()
+
+
+class TestWireErrorsAndWorkloadTable:
+    def test_shed_quota_blocked_codes_and_workload_rows(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server.mysql import MysqlServer
+        from horaedb_tpu.server.postgres import PostgresServer
+        from test_wire_protocols import MyClient, PgClient
+
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE ww (host string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        conn.execute("INSERT INTO ww (host, v, ts) VALUES ('a', 1.5, 1000)")
+        app = create_app(conn)
+        proxy = app["proxy"]
+        gw = app["sql_gateway"]
+        ctrl = proxy.wlm.admission
+
+        def saturate():
+            ctrl.total_units = 0
+            ctrl.queue_depth = 0
+
+        def restore():
+            ctrl.total_units = 8
+            ctrl.queue_depth = 32
+
+        def my_checks(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            # shed -> native 'too many connections' shape
+            saturate()
+            errno, sqlstate, msg = _mysql_raw_error(c, "SELECT v FROM ww")
+            assert (errno, sqlstate) == (1040, "08004"), (errno, sqlstate, msg)
+            restore()
+            # quota -> same retryable shape
+            proxy.wlm.quota.set_quota("table", "ww", "read_qps", 0.0, burst=0.0)
+            errno, sqlstate, _ = _mysql_raw_error(c, "SELECT v FROM ww")
+            assert (errno, sqlstate) == (1040, "08004")
+            proxy.wlm.quota.remove_quota("table", "ww", "read_qps")
+            # blocked -> access denied shape
+            proxy.limiter.block(["ww"])
+            errno, sqlstate, _ = _mysql_raw_error(c, "SELECT v FROM ww")
+            assert (errno, sqlstate) == (1142, "42000")
+            proxy.limiter.unblock(["ww"])
+            # the workload table answers over the MySQL wire
+            kind, names, rows = c.query(
+                "SELECT name FROM system.public.workload "
+                "WHERE category = 'admission'"
+            )
+            assert kind == "rows" and any("total_units" in r[0] for r in rows)
+            s.close()
+
+        def pg_checks(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            saturate()
+            _, _, _, err = c.query("SELECT v FROM ww")
+            assert err is not None and "53300" in err, err
+            restore()
+            proxy.wlm.quota.set_quota("table", "ww", "read_qps", 0.0, burst=0.0)
+            _, _, _, err = c.query("SELECT v FROM ww")
+            assert err is not None and "53300" in err
+            proxy.wlm.quota.remove_quota("table", "ww", "read_qps")
+            proxy.limiter.block(["ww"])
+            _, _, _, err = c.query("SELECT v FROM ww")
+            assert err is not None and "42501" in err
+            proxy.limiter.unblock(["ww"])
+            names, rows, _, err = c.query(
+                "SELECT name, value FROM system.public.workload "
+                "WHERE name = 'horaedb_admission_shed_total'"
+            )
+            assert err is None and rows
+            assert sum(float(r[1]) for r in rows) >= 2  # both wires shed
+            s.close()
+
+        async def body():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                # HTTP: shed -> 503 + Retry-After
+                saturate()
+                resp = await client.post("/sql", json={"query": "SELECT v FROM ww"})
+                assert resp.status == 503
+                assert "Retry-After" in resp.headers
+                restore()
+                # HTTP: quota -> 429 + Retry-After
+                proxy.wlm.quota.set_quota("table", "ww", "read_qps", 0.0, burst=0.0)
+                resp = await client.post("/sql", json={"query": "SELECT v FROM ww"})
+                assert resp.status == 429
+                assert "Retry-After" in resp.headers
+                proxy.wlm.quota.remove_quota("table", "ww", "read_qps")
+                # HTTP: blocked stays 403
+                proxy.limiter.block(["ww"])
+                resp = await client.post("/sql", json={"query": "SELECT v FROM ww"})
+                assert resp.status == 403
+                proxy.limiter.unblock(["ww"])
+                # the other wires, off the event loop
+                await loop.run_in_executor(None, my_checks, my.port)
+                await loop.run_in_executor(None, pg_checks, pg.port)
+                # workload table over HTTP reflects the shed/dedup state
+                resp = await client.post(
+                    "/sql",
+                    json={"query": (
+                        "SELECT category, name, value "
+                        "FROM system.public.workload"
+                    )},
+                )
+                assert resp.status == 200
+                rows = (await resp.json())["rows"]
+                by_name = {}
+                for r in rows:
+                    by_name.setdefault(r["name"], 0.0)
+                    by_name[r["name"]] += r["value"]
+                assert by_name.get("total_units", 0) >= 8
+                assert by_name.get("horaedb_admission_shed_total", 0) >= 3
+                assert "horaedb_admission_dedup_total" in by_name
+                assert "inflight_leaders" in by_name
+            finally:
+                await my.stop()
+                await pg.stop()
+                await client.close()
+
+        try:
+            asyncio.run(body())
+        finally:
+            conn.close()
+
+
+# ---- cross-node admission propagation -------------------------------------
+
+
+class TestRemoteAdmission:
+    def test_admission_class_gates_partial_agg_on_owner(self):
+        """The admission class rides the RPC envelope; the owner applies
+        its own gate (and lane) around PartialAgg."""
+        from horaedb_tpu.remote.client import RemoteEngineClient
+        from horaedb_tpu.remote.service import GrpcServer
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE ra (h string TAG, v double, ts timestamp KEY) "
+            "ENGINE=Analytic"
+        )
+        conn.execute("INSERT INTO ra (h, v, ts) VALUES ('a', 1.0, 1)")
+        g = GrpcServer(conn, port=0)
+        g.start()
+        admitted = REGISTRY.counter(
+            "horaedb_admission_admitted_total", labels={"class": "expensive"}
+        )
+        before = admitted.value
+        spec = {
+            "predicate": {"time_range": [0, 10**15], "filters": []},
+            "exact_filters": [], "device_filters": [],
+            "group_tags": ["h"], "bucket_ms": 0, "agg_cols": ["v"],
+            "trace": {"request_id": 7},
+        }
+        try:
+            client = RemoteEngineClient(f"127.0.0.1:{g.bound_port}")
+            out = client._call(
+                "PartialAgg", {"table": "ra", "spec": spec,
+                               "admission": "expensive"},
+            )
+            assert out.get("ipc") is not None
+            assert admitted.value == before + 1  # the owner's gate ran
+            # the owner's queue wait ships home in the serving ledger
+            assert "admission_wait_seconds" in out["ledger"]["counts"]
+        finally:
+            g.stop()
+            conn.close()
+
+
+# ---- HTTP admin/debug surfaces --------------------------------------------
+
+
+class TestWorkloadEndpoints:
+    def test_debug_workload_and_admin_quota(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        async def body():
+            conn = horaedb_tpu.connect(None)
+            app = create_app(conn)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                snap = await (await client.get("/debug/workload")).json()
+                assert {"admission", "dedup", "quota"} <= set(snap)
+                assert snap["admission"]["total_units"] >= 2
+                resp = await client.post(
+                    "/admin/quota",
+                    json={"scope": "table", "name": "cpu",
+                          "kind": "read_qps", "rate": 50, "burst": 60},
+                )
+                assert resp.status == 200
+                got = await (await client.get("/admin/quota")).json()
+                assert any(
+                    e["name"] == "cpu" and e["rate"] == 50.0
+                    for e in got["quotas"]
+                )
+                resp = await client.delete(
+                    "/admin/quota",
+                    json={"scope": "table", "name": "cpu", "kind": "read_qps"},
+                )
+                assert (await resp.json())["removed"] is True
+                resp = await client.post(
+                    "/admin/quota", json={"scope": "bogus", "name": "x",
+                                          "kind": "read_qps", "rate": 1},
+                )
+                assert resp.status == 400
+                # per-tenant quota reaches the wire via the tenant header
+                resp = await client.post(
+                    "/admin/quota",
+                    json={"scope": "tenant", "name": "acme",
+                          "kind": "read_qps", "rate": 0, "burst": 0},
+                )
+                assert resp.status == 200
+                resp = await client.post(
+                    "/sql", json={"query": "SHOW TABLES"},
+                )
+                assert resp.status == 200  # SHOW isn't a SELECT: uncharged
+                resp = await client.post(
+                    "/sql",
+                    json={"query": "SELECT 1 FROM system.public.tables"},
+                    headers={"X-HoraeDB-Tenant": "acme"},
+                )
+                assert resp.status == 429
+                resp = await client.post(
+                    "/sql",
+                    json={"query": "SELECT 1 FROM system.public.tables"},
+                )
+                assert resp.status == 200  # other tenants unaffected
+            finally:
+                await client.close()
+                conn.close()
+
+        asyncio.run(body())
+
+
+# ---- hotspot LRU + decay --------------------------------------------------
+
+
+class TestHotspotLru:
+    def test_bounded_and_decayed(self):
+        from horaedb_tpu.proxy import Hotspot
+
+        h = Hotspot(capacity=4, decay_interval_s=0.05, decay_factor=0.25)
+        for i in range(100):
+            h.record(f"t{i}", False)
+        assert len(h.reads) <= 4  # unbounded Counter leak is gone
+        for _ in range(8):
+            h.record("hot", False)
+        time.sleep(0.06)
+        h.record("hot", False)  # triggers the periodic decay, then bumps
+        top = h.top()
+        assert top["reads"]["hot"] == 3  # 8 * 0.25 -> 2, +1
+        # sub-1 residues dropped entirely
+        assert all(k == "hot" or v >= 1 for k, v in top["reads"].items())
+
+    def test_writes_and_reads_separate(self):
+        from horaedb_tpu.proxy import Hotspot
+
+        h = Hotspot(capacity=8)
+        h.record("a", True)
+        h.record("a", False)
+        h.record("a", False)
+        top = h.top()
+        assert top["writes"]["a"] == 1 and top["reads"]["a"] == 2
+
+
+# ---- EXPLAIN surface + config knobs ---------------------------------------
+
+
+class TestExplainAndConfig:
+    def test_explain_carries_admission_line(self):
+        conn = horaedb_tpu.connect(None)
+        conn.execute("CREATE TABLE ex (h string TAG, v double, ts timestamp KEY)")
+        conn.execute("INSERT INTO ex (h, v, ts) VALUES ('a', 1.0, 1)")
+        lines = [
+            r["plan"]
+            for r in conn.execute("EXPLAIN SELECT h, sum(v) FROM ex GROUP BY h").to_pylist()
+        ]
+        adm = [l for l in lines if l.strip().startswith("Admission:")]
+        assert adm and "class=expensive" in adm[0] and "lane=low" in adm[0]
+        analyzed = [
+            r["plan"]
+            for r in conn.execute(
+                "EXPLAIN ANALYZE SELECT h, sum(v) FROM ex "
+                "WHERE ts >= 0 AND ts < 1000 GROUP BY h"
+            ).to_pylist()
+        ]
+        assert any("Admission: class=normal lane=high" in l for l in analyzed)
+        conn.close()
+
+    def test_limits_config_knobs(self, tmp_path):
+        from horaedb_tpu.utils.config import Config, ConfigError
+
+        p = tmp_path / "c.toml"
+        p.write_text(
+            "[limits]\n"
+            'slow_threshold = "2s"\n'
+            "admission_slots = 4\n"
+            "admission_queue_depth = 7\n"
+            'admission_deadline = "2s"\n'
+            'admission_memory_budget = "64mb"\n'
+            "dedup = false\n"
+        )
+        cfg = Config.load(str(p))
+        assert cfg.limits.admission_slots == 4
+        assert cfg.limits.admission_queue_depth == 7
+        assert cfg.limits.admission_deadline_s == 2.0
+        assert cfg.limits.admission_memory_budget == 64 << 20
+        assert cfg.limits.dedup is False
+        mgr = WorkloadManager.from_limits(cfg.limits)
+        try:
+            assert mgr.admission.total_units == 4
+            assert mgr.admission.queue_depth == 7
+            assert mgr.dedup.enabled is False
+        finally:
+            mgr.close()
+        bad = tmp_path / "bad.toml"
+        bad.write_text("[limits]\nadmission_bogus = 1\n")
+        with pytest.raises(ConfigError):
+            Config.load(str(bad))
