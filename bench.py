@@ -22,6 +22,7 @@ CPU path, standing in for the reference's DataFusion executor.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -299,6 +300,194 @@ def _build_compaction_db(seed: int):
     return db, table
 
 
+# ---- ingest config (pipelined background flush vs seed baseline) ------
+#
+# N concurrent writers against ONE table with a small memtable budget (so
+# flushes happen DURING the write storm) and a latency-injected object
+# store (every SST put pays a synthetic upload delay — the remote-store
+# shape the pipelined flush exists for). Timestamps spread across several
+# segment buckets so one flush writes several SSTs: the background path
+# writes them concurrently on the io pool while writers keep committing.
+#
+# The baseline pass emulates the PRE-pipeline seed behavior this PR
+# replaced: flush inline on the write leader, ``serial_lock`` held across
+# the ENTIRE dump (so every writer blocks for the full upload), and one
+# bucket uploaded at a time. ``vs_baseline`` is baseline_wall /
+# background_wall; p99 commit latency is reported for both so the "a
+# commit no longer includes the SST upload" claim is visible in the
+# record. The stall bound is raised to match the artificially tiny
+# memtable budget (the default count bound assumes 32mb memtables, not
+# 1mb) so the background pass measures the pipeline, not the stall.
+
+INGEST_WRITERS = int(os.environ.get("BENCH_INGEST_WRITERS", "4"))
+INGEST_BATCHES = int(os.environ.get("BENCH_INGEST_BATCHES", "40"))
+INGEST_BATCH_ROWS = int(os.environ.get("BENCH_INGEST_BATCH_ROWS", "5000"))
+INGEST_PUT_DELAY_S = float(os.environ.get("BENCH_INGEST_PUT_DELAY", "0.02"))
+INGEST_BUCKETS = 8
+
+
+class _LatencySstStore:
+    """ObjectStore wrapper injecting a per-put delay on SST objects only
+    (manifest/WAL appends stay fast — the point is the upload cost)."""
+
+    def __init__(self, inner, delay_s: float) -> None:
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def put(self, path, data):
+        if path.endswith(".sst"):
+            time.sleep(self._delay_s)
+        self._inner.put(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@contextlib.contextmanager
+def _seed_flush_semantics():
+    """Emulate the pre-pipeline flush this PR replaced, for the baseline
+    pass: ``serial_lock`` held across the ENTIRE dump (every writer
+    blocks for the full upload) and one bucket uploaded at a time (the
+    thread rename steers flush.py onto its serial bucket path — the
+    same guard that keeps a flush running ON the io pool from
+    deadlocking against its own slots)."""
+    import threading
+
+    from horaedb_tpu.engine.flush import Flusher
+
+    orig = Flusher.flush
+
+    def seed_flush(self):
+        th = threading.current_thread()
+        saved = th.name
+        th.name = "sst-io-seed-baseline"
+        try:
+            with self.table.serial_lock:
+                return orig(self)
+        finally:
+            th.name = saved
+
+    Flusher.flush = seed_flush
+    try:
+        yield
+    finally:
+        Flusher.flush = orig
+
+
+def _run_ingest_pass(background: bool) -> tuple[float, float, int]:
+    """(wall_seconds, p99_commit_ms, rows_written) for one full pass."""
+    import threading
+
+    from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+    from horaedb_tpu.common_types.schema import compute_tsid
+    from horaedb_tpu.engine.instance import EngineConfig, Instance
+    from horaedb_tpu.engine.options import TableOptions
+    from horaedb_tpu.utils.object_store import MemoryStore
+
+    schema = Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+    inst = Instance(
+        _LatencySstStore(MemoryStore(), INGEST_PUT_DELAY_S),
+        EngineConfig(
+            background_flush=background,
+            compaction_l0_trigger=10**9,  # isolate flush behavior
+            compaction_interval_s=0,
+            # The 1mb bench memtable is ~1/32 the default; scale the
+            # frozen-count bound accordingly so backpressure measures the
+            # pipeline, not the deliberately tiny buffer.
+            write_stall_immutable_count=64,
+        ),
+    )
+    table = inst.create_table(
+        0, 1, "ingest", schema,
+        TableOptions.from_kv(
+            {"segment_duration": "1h", "write_buffer_size": "1mb"}
+        ),
+    )
+    span_ms = INGEST_BUCKETS * 3_600_000
+    rng = np.random.default_rng(7)
+    names = np.array([f"host_{i}" for i in range(100)], dtype=object)
+
+    def make_batch(seed: int) -> RowGroup:
+        r = np.random.default_rng(seed)
+        idx = r.integers(0, len(names), INGEST_BATCH_ROWS)
+        tags = names[idx]
+        return RowGroup(
+            schema,
+            {
+                "tsid": compute_tsid([tags]),
+                "t": r.integers(0, span_ms, INGEST_BATCH_ROWS).astype(np.int64),
+                "name": tags,
+                "value": r.normal(10.0, 3.0, INGEST_BATCH_ROWS),
+            },
+        )
+
+    batches = [
+        [make_batch(w * INGEST_BATCHES + b) for b in range(INGEST_BATCHES)]
+        for w in range(INGEST_WRITERS)
+    ]
+    latencies: list[list[float]] = [[] for _ in range(INGEST_WRITERS)]
+    errors: list = []
+
+    def writer(w: int) -> None:
+        try:
+            for rows in batches[w]:
+                s = time.perf_counter()
+                inst.write(table, rows)
+                latencies[w].append(time.perf_counter() - s)
+        except Exception as e:  # a shed/stall surfacing here fails the run
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(w,)) for w in range(INGEST_WRITERS)
+    ]
+    ctx = (
+        contextlib.nullcontext() if background else _seed_flush_semantics()
+    )
+    with ctx:
+        s = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        inst.flush_table(table)  # drain: both passes end fully durable
+        wall = time.perf_counter() - s
+    inst.close()
+    if errors:
+        raise errors[0]
+    all_lat = np.concatenate([np.asarray(l) for l in latencies])
+    rows_written = INGEST_WRITERS * INGEST_BATCHES * INGEST_BATCH_ROWS
+    return wall, float(np.percentile(all_lat, 99) * 1000), rows_written
+
+
+def run_ingest_config() -> dict:
+    """Write-path A/B: pipelined background flush vs the seed baseline
+    (inline flush, serial_lock across the dump, serial bucket uploads),
+    same data, same latency-injected store. Pure host path (no kernels),
+    so no TPU/CPU labeling applies."""
+    config = "ingest"
+    base_s, base_p99_ms, n = _run_ingest_pass(background=False)
+    bg_s, bg_p99_ms, _ = _run_ingest_pass(background=True)
+    return {
+        "metric": f"{config}-{INGEST_WRITERS}w_rows_per_sec_background-flush",
+        "value": round(n / bg_s),
+        "unit": "rows/s",
+        "vs_baseline": round(base_s / bg_s, 3),
+        "p99_commit_ms": round(bg_p99_ms, 1),
+        "p99_commit_ms_baseline": round(base_p99_ms, 1),
+        "baseline_rows_per_sec": round(n / base_s),
+        "baseline": "seed-inline-flush-locked-dump",
+        "sst_put_delay_ms": round(INGEST_PUT_DELAY_S * 1000, 1),
+        "platform": "host",
+    }
+
+
 def _host_merge_permutation(tsid, ts, seq, dedup=True):
     """Vectorized-numpy merge baseline with the device kernel's exact
     semantics: sort (tsid, ts, seq desc, input-row desc), keep the first
@@ -522,7 +711,7 @@ def _emit(obj: dict) -> None:
 # final stdout line, and every config still gets its own line.
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
-    "compaction-64", "tsbs-5-8-1",
+    "compaction-64", "ingest", "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
 # builds the table twice for the device/host A-B and genuinely needs
@@ -670,6 +859,8 @@ def run_config(config: str) -> dict:
 
     if config == "compaction-64":
         return run_compaction_config()
+    if config == "ingest":
+        return run_ingest_config()
     builder = CONFIGS.get(config)
     if builder is None:
         return {"metric": f"{config}_error", "value": 0,

@@ -2,219 +2,61 @@
 (ref: analytic_engine/src/compaction/scheduler.rs — flush REQUESTS
 compaction; a background worker picks and runs it, keeping the k-way
 merge cost off the write path. The reference bounds concurrency with
-ScheduleRoom tokens; here a small dedicated pool plus per-table
-dedupe gives the same two properties: writes never block on a merge,
-and one table never has two merges racing).
+ScheduleRoom tokens; here a small worker pool plus per-table dedupe
+gives the same two properties: writes never block on a merge, and one
+table never has two merges racing — per-table dedupe stops a second
+merge from QUEUEING while one is queued, and ``Compactor.compact``'s
+``serial_lock`` serializes the rare re-queue that lands mid-run).
 
-The scheduler is deliberately tiny: pending-set dedupe (a table already
-queued is not queued again; a request landing mid-merge re-queues),
-error isolation (a failed compaction logs and the NEXT flush
-re-requests — the trigger condition still holds), and a drain-on-close
-so process shutdown never abandons a half-scheduled merge silently."""
+The scheduling mechanics (pending-set dedupe, failure backoff, periodic
+loop, drain-on-close, waiter futures) live in the shared
+``MaintenanceScheduler`` core — this module binds the compaction metric
+families and run function to it. The flush scheduler
+(flush_scheduler.py) binds the same core to the flush path."""
 
 from __future__ import annotations
 
-import logging
-import threading
-import time
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable
 
 from ..utils.metrics import REGISTRY
-
-logger = logging.getLogger("horaedb_tpu.engine.compaction")
+from .maintenance_scheduler import MaintenanceScheduler, SchedulerMetrics
 
 # Register at import so every series exists (as 0) from the first scrape;
 # a rate() over an absent series silently shows nothing instead of 0.
-_M_ACCEPTED = REGISTRY.counter(
-    "horaedb_compaction_requests_total",
-    "background compaction requests accepted",
-)
-_M_DEDUPED = REGISTRY.counter(
-    "horaedb_compaction_requests_deduped_total",
-    "compaction requests coalesced into an already-queued one",
-)
-_M_REJECTED_CLOSED = REGISTRY.counter(
-    "horaedb_compaction_requests_rejected_closed_total",
-    "compaction requests dropped because the scheduler was closed",
-)
-_M_FAILURES = REGISTRY.counter(
-    "horaedb_compaction_failures_total",
-    "background compactions that raised",
-)
-_M_BACKOFF = REGISTRY.counter(
-    "horaedb_compaction_requests_backoff_total",
-    "compaction requests suppressed by per-table failure backoff",
-)
-_M_DEPTH = REGISTRY.gauge(
-    "horaedb_compaction_queue_depth_total",
-    "background compactions queued or running",
+_METRICS = SchedulerMetrics(
+    accepted=REGISTRY.counter(
+        "horaedb_compaction_requests_total",
+        "background compaction requests accepted",
+    ),
+    deduped=REGISTRY.counter(
+        "horaedb_compaction_requests_deduped_total",
+        "compaction requests coalesced into an already-queued one",
+    ),
+    rejected_closed=REGISTRY.counter(
+        "horaedb_compaction_requests_rejected_closed_total",
+        "compaction requests dropped because the scheduler was closed",
+    ),
+    failures=REGISTRY.counter(
+        "horaedb_compaction_failures_total",
+        "background compactions that raised",
+    ),
+    backoff=REGISTRY.counter(
+        "horaedb_compaction_requests_backoff_total",
+        "compaction requests suppressed by per-table failure backoff",
+    ),
+    depth=REGISTRY.gauge(
+        "horaedb_compaction_queue_depth_total",
+        "background compactions queued or running",
+    ),
 )
 
 
-class CompactionScheduler:
-    def __init__(self, run_fn: Callable, workers: int = 1) -> None:
-        self._run_fn = run_fn
-        self._lock = threading.Lock()
-        self._pending: set[tuple[int, int]] = set()
-        self._running = 0
-        self._executor = ThreadPoolExecutor(
-            max_workers=workers, thread_name_prefix="compaction"
+class CompactionScheduler(MaintenanceScheduler):
+    def __init__(self, run_fn: Callable, workers: int = 2) -> None:
+        super().__init__(
+            run_fn,
+            _METRICS,
+            workers=workers,
+            thread_prefix="compaction",
+            kind="compaction",
         )
-        self._closed = False
-        self._stop = threading.Event()
-        self._periodic: threading.Thread | None = None
-        # Per-table failure backoff: without it the periodic loop would
-        # retry (and stack-trace-log) a durably failing table every tick
-        # forever. Exponential from 30s, capped at 1h; success clears.
-        self._backoff: dict[tuple[int, int], tuple[int, float]] = {}
-
-    def start_periodic(self, interval_s: float, scan_fn: Callable) -> None:
-        """Background picking loop (ref: scheduler.rs — the scheduler
-        wakes on its own, not only on flush requests): every
-        ``interval_s``, ``scan_fn`` inspects tables and request()s work;
-        a ``False`` return ends the loop (the instance-side weakref
-        wrapper returns it once its instance is collected). Idempotent;
-        the thread dies promptly on close(). The loop closure captures
-        ONLY the stop event — a strong ``self`` would chain thread ->
-        scheduler -> run_fn -> instance and pin an abandoned engine
-        forever."""
-        with self._lock:
-            if self._closed or self._periodic is not None:
-                return
-            stop = self._stop
-
-            def loop():
-                while not stop.wait(interval_s):
-                    try:
-                        if scan_fn() is False:
-                            return
-                    except Exception:
-                        logger.exception("periodic compaction scan failed")
-
-            self._periodic = threading.Thread(
-                target=loop, name="compaction-tick", daemon=True
-            )
-            self._periodic.start()
-
-    def _update_depth_locked(self) -> None:
-        _M_DEPTH.set(len(self._pending) + self._running)
-
-    def request(self, table) -> bool:
-        """Queue a compaction for ``table`` unless one is already queued
-        or running; returns True if newly queued."""
-        key = (table.space_id, table.table_id)
-        # Submit under the lock: close() sets _closed under the same lock
-        # before shutting the executor down, so a request that saw
-        # _closed=False cannot race submit against shutdown (which would
-        # raise RuntimeError into the flushing writer).
-        with self._lock:
-            if self._closed:
-                _M_REJECTED_CLOSED.inc()
-                return False
-            if key in self._pending:
-                _M_DEDUPED.inc()
-                return False
-            entry = self._backoff.get(key)
-            if entry is not None and time.monotonic() < entry[1]:
-                _M_BACKOFF.inc()
-                return False
-            self._pending.add(key)
-            self._update_depth_locked()
-            self._executor.submit(self._run, key, table)
-        _M_ACCEPTED.inc()
-        return True
-
-    def _run(self, key: tuple[int, int], table) -> None:
-        # Release the dedupe slot BEFORE running: a request that arrives
-        # while the merge runs re-queues (the merge may not cover files
-        # flushed after its pick). Discarding after the run instead
-        # would silently swallow that request — if it was the workload's
-        # last flush, the trigger condition persists with no merge ever
-        # scheduled. A re-queued no-op pick is cheap; a lost trigger is
-        # unbounded read amplification.
-        with self._lock:
-            self._pending.discard(key)
-            self._running += 1
-            self._update_depth_locked()
-        try:
-            self._run_fn(table)
-            with self._lock:
-                self._backoff.pop(key, None)
-        except Exception:
-            _M_FAILURES.inc()
-            # A table retired/dropped mid-merge gets no backoff entry: its
-            # forget() may already have run, and re-inserting here would
-            # recreate exactly the permanent stats() leak forget() fixes.
-            gone = getattr(table, "retired", False) or getattr(table, "dropped", False)
-            fails, delay = 1, 30.0
-            with self._lock:
-                if not gone:
-                    fails = self._backoff.get(key, (0, 0.0))[0] + 1
-                    delay = min(30.0 * (2 ** (fails - 1)), 3600.0)
-                    self._backoff[key] = (fails, time.monotonic() + delay)
-            logger.exception(
-                "background compaction failed for table %s (attempt %d; "
-                "suppressed for %.0fs)", table.name, fails, delay,
-            )
-        finally:
-            with self._lock:
-                self._running -= 1
-                self._update_depth_locked()
-
-    def forget(self, key: tuple[int, int]) -> None:
-        """Drop a table's failure-backoff entry when the table is dropped
-        or handed off — otherwise a durably-failing table leaves its entry
-        (and stats() row) behind forever."""
-        with self._lock:
-            self._backoff.pop(key, None)
-
-    @classmethod
-    def idle_stats(cls, closed: bool = False) -> dict:
-        """The no-scheduler-yet shape — ONE place defines the key schema
-        for both the live and idle answers of /debug/compaction."""
-        return {
-            "pending": [], "running": 0, "closed": closed,
-            "periodic": False, "backoff": {},
-        }
-
-    def stats(self) -> dict:
-        """Introspection for /debug/compaction and horaectl: what's
-        queued, what's running, which tables are in failure backoff."""
-        now = time.monotonic()
-        with self._lock:
-            return {
-                "pending": sorted(f"{s}/{t}" for s, t in self._pending),
-                "running": self._running,
-                "closed": self._closed,
-                # liveness, not object presence: a closed or weakref-dead
-                # loop must not report as running
-                "periodic": self._periodic is not None and self._periodic.is_alive(),
-                "backoff": {
-                    f"{s}/{t}": {
-                        "failures": fails,
-                        "retry_in_s": round(max(0.0, retry_at - now), 1),
-                    }
-                    for (s, t), (fails, retry_at) in self._backoff.items()
-                },
-            }
-
-    def close(self, wait: bool = True) -> None:
-        """Stop accepting requests and shut the worker down. ``wait``
-        drains everything queued; without it, queued-but-unstarted merges
-        are CANCELLED and only the one in flight is joined. Either way
-        close never returns with a worker still racing the next
-        instance's manifest appends."""
-        with self._lock:
-            self._closed = True
-            periodic = self._periodic
-        self._stop.set()
-        if periodic is not None:
-            periodic.join(timeout=5)
-        self._executor.shutdown(wait=True, cancel_futures=not wait)
-        with self._lock:
-            # Cancelled futures never ran _run; don't leave their pending
-            # entries pinned in the depth gauge forever.
-            self._pending.clear()
-            self._running = 0
-            self._update_depth_locked()

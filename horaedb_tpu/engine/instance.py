@@ -43,6 +43,11 @@ _M_WAL_REPLAY_SECONDS = REGISTRY.histogram(
 _M_WAL_REPLAY_ROWS = REGISTRY.counter(
     "horaedb_wal_replay_rows_total", "rows re-applied from the WAL at open"
 )
+_M_WRITE_STALL_SECONDS = REGISTRY.histogram(
+    "horaedb_write_stall_seconds",
+    "time writers spent blocked on the immutable-memtable backpressure "
+    "bound waiting for a background flush",
+)
 
 
 def _memtable_gauge(table: TableData):
@@ -75,6 +80,26 @@ class EngineConfig:
     # flush-triggered): a table that stops receiving writes must still
     # expire TTL data and fold accumulated L0. 0 disables.
     compaction_interval_s: float = 60.0
+    # Background compaction worker pool: >1 lets multi-table compactions
+    # overlap (per-table dedupe + the table serial lock prevent two
+    # merges racing on one table).
+    compaction_workers: int = 2
+    # Pipelined flush (the reference's flush scheduler model,
+    # flush_compaction.rs): the write leader freezes the memtable and
+    # REQUESTS a flush; a background worker dumps it to L0 while writes
+    # keep committing into the fresh mutable memtable. False = the old
+    # inline flush on the write leader (deterministic; some tests want
+    # it).
+    background_flush: bool = True
+    flush_workers: int = 2
+    # Write-stall backpressure: writers block once a table holds this
+    # many frozen memtables (or this many frozen bytes) awaiting flush,
+    # and shed with a retryable OverloadedError after the deadline
+    # (ref: RocksDB's max_write_buffer_number stall, and the admission
+    # discipline of wlm/ — HTTP 503, MySQL 1040, PG 53300).
+    write_stall_immutable_count: int = 8
+    write_stall_immutable_bytes: int = 1 << 30
+    write_stall_deadline_s: float = 30.0
 
 
 class Instance:
@@ -90,6 +115,7 @@ class Instance:
         self._tables: dict[tuple[int, int], TableData] = {}
         self._lock = threading.RLock()
         self._compactions = None  # lazy CompactionScheduler
+        self._flushes = None  # lazy FlushScheduler
         self._closed = False
 
     # ---- lifecycle -----------------------------------------------------
@@ -178,14 +204,14 @@ class Instance:
 
         The table is already visible in ``_tables`` when this runs, so a
         concurrent flush could be mid-write (SST persisted, manifest edit
-        not yet appended). Holding ``serial_lock`` excludes flushes for
-        THIS table (it is per-table, so other table opens don't serialize
-        behind the sweep), and listing the store before computing the
-        tracked set means anything written after the listing is invisible
-        to the sweep either way.
+        not yet appended). Holding ``flush_lock`` excludes DUMPS for THIS
+        table and ``serial_lock`` excludes installs (both are per-table,
+        so other table opens don't serialize behind the sweep), and
+        listing the store before computing the tracked set means anything
+        written after the listing is invisible to the sweep either way.
         """
         prefix = f"{table.space_id}/{table.table_id}/"
-        with table.serial_lock:
+        with table.flush_lock, table.serial_lock:
             listed = list(self.store.list(prefix))
             levels = table.version.levels
             # Purge-queued files are referenced (a pinned read may still
@@ -196,11 +222,15 @@ class Instance:
                     self.store.delete(path)
 
     def close_table(self, table: TableData, flush: bool = True) -> None:
-        # Lock order is always serial_lock -> _lock (flush_table takes the
-        # table's serial_lock); never hold _lock across a flush.
+        # Lock order is always flush_lock -> serial_lock -> _lock
+        # (flush_table takes the table's locks); never hold _lock across
+        # a flush.
         if flush:
+            # wait=True drains: a queued background flush for this table
+            # either runs before ours (flush_lock serializes dumps) or
+            # sees ``retired`` afterwards and bails.
             self.flush_table(table)
-        # Fence background compaction before the handle is released: the
+        # Fence background maintenance before the handle is released: the
         # close-time flush above may have QUEUED a merge. A merge already
         # running holds serial_lock, so acquiring it here blocks until
         # that merge completes; one not yet started sees ``retired`` and
@@ -208,13 +238,20 @@ class Instance:
         # the stale worker's manifest appends (the fuzz-seed-2 loss).
         with table.serial_lock:
             table.retired = True
+        table.notify_flush_waiters()
         with self._lock:
             self._tables.pop((table.space_id, table.table_id), None)
             if self._compactions is not None:
                 self._compactions.forget((table.space_id, table.table_id))
+            if self._flushes is not None:
+                self._flushes.forget((table.space_id, table.table_id))
 
     def drop_table(self, table: TableData) -> None:
-        with table.serial_lock:
+        # flush_lock first: a dump mid-flight would otherwise write SSTs
+        # AFTER the store prefix is cleared — its install re-check would
+        # abandon them, but a dropped table never reopens, so nothing
+        # would ever sweep those orphans.
+        with table.flush_lock, table.serial_lock:
             table.dropped = True
             for h in table.version.levels.all_files():
                 self.store.delete(h.path)
@@ -229,6 +266,9 @@ class Instance:
                 self._tables.pop((table.space_id, table.table_id), None)
                 if self._compactions is not None:
                     self._compactions.forget((table.space_id, table.table_id))
+                if self._flushes is not None:
+                    self._flushes.forget((table.space_id, table.table_id))
+        table.notify_flush_waiters()
 
     def open_tables(self) -> list[TableData]:
         with self._lock:
@@ -277,11 +317,13 @@ class Instance:
                         table.writer_active = False
                         break
                 if self._commit_write_group(table, batch):
-                    # Flush as soon as the buffer trips — sustained writer
-                    # pressure must not grow the memtable unboundedly while
-                    # the leader keeps draining (flush takes its own locks;
-                    # new writers keep queueing meanwhile).
-                    self.flush_table(table)
+                    # The buffer tripped: the leader REQUESTS a flush (the
+                    # memtable is already frozen when background flush is
+                    # on) and keeps draining — writes commit into the
+                    # fresh mutable memtable while the dump runs on the
+                    # flush scheduler. Inline mode flushes here, exactly
+                    # as before.
+                    self.request_flush(table)
         except BaseException:
             with table.pending_lock:
                 table.writer_active = False
@@ -301,6 +343,12 @@ class Instance:
         needs_flush = False
         for _, entries in groups.items():
             try:
+                # Backpressure BEFORE taking the serial lock: when frozen
+                # memtables pile past the bound, block (bounded) for the
+                # background flush to catch up, then shed retryably. The
+                # exception resolves this group's futures below — leaders
+                # and followers both see the typed OverloadedError.
+                self._stall_for_flush(table)
                 merged = (
                     entries[0][0]
                     if len(entries) == 1
@@ -331,7 +379,15 @@ class Instance:
                     _memtable_gauge(table).set(
                         table.version.total_memtable_bytes()
                     )
-                    needs_flush |= table.should_flush()
+                    if table.should_flush():
+                        if self.config.background_flush:
+                            # FREEZE here (a cheap pointer swap — the dump
+                            # happens on the flush scheduler): the next
+                            # group commits into a fresh mutable memtable
+                            # immediately instead of growing this one
+                            # while the flush request waits for a worker.
+                            table.version.switch_memtable()
+                        needs_flush = True
             except BaseException as e:
                 for _, fut in entries:
                     if not fut.done():
@@ -376,14 +432,142 @@ class Instance:
             )
 
     # ---- maintenance ---------------------------------------------------
-    def flush_table(self, table: TableData) -> FlushResult:
+    def flush_table(
+        self, table: TableData, wait: bool = True
+    ) -> Optional[FlushResult]:
+        """Flush ``table``. With ``wait`` (the default — tests, close and
+        ALTER depend on it) the call round-trips the whole completion:
+        manifest appended, version installed, WAL ``mark_flushed``
+        advanced. ``wait=False`` just queues a background request.
+
+        Background mode routes through the FlushScheduler so explicit
+        flushes and write-triggered ones share one per-table queue; the
+        waiter attaches to an already-queued request when one exists (its
+        freeze happens at run time, so it covers everything present now).
+        """
+        if self.config.background_flush:
+            scheduler = self._flush_scheduler()
+            if scheduler is not None:
+                if not wait:
+                    scheduler.request(table)
+                    return None
+                fut: cf.Future = cf.Future()
+                scheduler.request(table, waiter=fut)
+                from .maintenance_scheduler import SchedulerClosed
+
+                try:
+                    return fut.result()
+                except SchedulerClosed:
+                    # shutdown raced the request — run it inline; a
+                    # synchronous flush must never silently not happen
+                    return self._do_flush(table)
+        return self._do_flush(table)
+
+    def request_flush(self, table: TableData, urgent: bool = False) -> None:
+        """Fire-and-forget flush request (the write path's trigger).
+        ``urgent`` (the stall loop) bypasses failure backoff — a stalled
+        writer's re-request is the only path out of the stall."""
+        if self.config.background_flush:
+            scheduler = self._flush_scheduler()
+            if scheduler is not None:
+                scheduler.request(table, urgent=urgent)
+                return
+        self._do_flush(table)
+
+    def _do_flush(self, table: TableData) -> FlushResult:
+        """One complete flush: dump + the completion step (WAL
+        ``mark_flushed`` strictly after the manifest append inside
+        ``Flusher.flush`` — data before metadata before WAL truncation)."""
         result = Flusher(table).flush()
         if self.wal is not None and result.flushed_sequence:
             self.wal.mark_flushed(table.table_id, result.flushed_sequence)
         _memtable_gauge(table).set(table.version.total_memtable_bytes())
         self._purge(table)
         self.maybe_compact(table)
+        # The install step may have frozen a mid-dump mutable (first-flush
+        # PK reorder freezes rows written while the dump ran) — those
+        # frozen rows still need a dump of their own. A loop, not
+        # recursion: sustained writers can keep freezing while we dump.
+        # Always INLINE, never a re-queue: a flush_table(wait=True)
+        # waiter resolving while frozen memtables are merely re-queued
+        # would let close_table retire the table before the re-queued run
+        # starts — and with no WAL those acknowledged rows would be gone
+        # after a clean close.
+        while (
+            not (table.dropped or table.retired)
+            and table.version.immutable_stats()[0]
+        ):
+            more = Flusher(table).flush()
+            if self.wal is not None and more.flushed_sequence:
+                self.wal.mark_flushed(table.table_id, more.flushed_sequence)
+            result = FlushResult(
+                result.files_added + more.files_added,
+                result.rows_flushed + more.rows_flushed,
+                max(result.flushed_sequence, more.flushed_sequence),
+            )
         return result
+
+    def _flush_scheduler(self):
+        # An EXISTING scheduler is returned even when closed (its own
+        # request() rejects safely, and the close() drain path relies on
+        # reaching it); _closed only prevents lazy rebirth — a
+        # resurrected worker would race the next Instance.
+        with self._lock:
+            if self._flushes is not None:
+                return self._flushes
+            if self._closed:
+                return None
+            from .flush_scheduler import FlushScheduler
+
+            self._flushes = FlushScheduler(
+                self._do_flush, workers=self.config.flush_workers
+            )
+            return self._flushes
+
+    def _stall_for_flush(self, table: TableData) -> None:
+        """Write-stall backpressure: block while the table's frozen
+        memtables exceed the configured bound (count or bytes), then shed
+        with the typed retryable ``OverloadedError`` the protocol layers
+        already map (HTTP 503 + Retry-After, MySQL 1040, PG 53300)."""
+        cfg = self.config
+        if not cfg.background_flush:
+            return  # inline mode: the flush runs on this thread anyway
+        count, nbytes = table.version.immutable_stats()
+        if count < cfg.write_stall_immutable_count and \
+                nbytes < cfg.write_stall_immutable_bytes:
+            return
+        deadline = _time.monotonic() + cfg.write_stall_deadline_s
+        t0 = _time.perf_counter()
+        try:
+            while True:
+                if table.dropped or table.retired:
+                    return  # the commit below fails with the real reason
+                # ensure a dump is actually queued (deduped when one is;
+                # urgent so a transient failure's backoff cannot turn a
+                # blip into an unescapable deadline-long stall)
+                self.request_flush(table, urgent=True)
+                count, nbytes = table.version.immutable_stats()
+                if count < cfg.write_stall_immutable_count and \
+                        nbytes < cfg.write_stall_immutable_bytes:
+                    return
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    from ..wlm.admission import OverloadedError
+
+                    raise OverloadedError(
+                        f"write stall: table {table.name} holds {count} "
+                        f"frozen memtables ({nbytes} bytes) awaiting flush",
+                        reason="write_stall",
+                        retry_after_s=1.0,
+                    )
+                # short slices so a missed notify (or a failed flush that
+                # never retires) degrades to latency, never to a hang
+                with table.stall_cond:
+                    table.stall_cond.wait(min(0.25, remaining))
+        finally:
+            waited = _time.perf_counter() - t0
+            if waited > 0.001:
+                _M_WRITE_STALL_SECONDS.observe(waited)
 
     def maybe_compact(self, table: TableData) -> None:
         """Request compaction when some segment window accumulated enough
@@ -403,18 +587,24 @@ class Instance:
                 self.compact_table(table)
 
     def _compaction_scheduler(self):
+        # Same contract as _flush_scheduler: existing scheduler returned
+        # even when closed (the flush drain may still request merges);
+        # _closed only prevents lazy rebirth.
         with self._lock:
+            if self._compactions is not None:
+                return self._compactions
             if self._closed:
                 return None
-            if self._compactions is None:
-                from .compaction_scheduler import CompactionScheduler
+            from .compaction_scheduler import CompactionScheduler
 
-                self._compactions = CompactionScheduler(self.compact_table)
-                if self.config.compaction_interval_s > 0:
-                    self._compactions.start_periodic(
-                        self.config.compaction_interval_s,
-                        self._make_periodic_scan(),
-                    )
+            self._compactions = CompactionScheduler(
+                self.compact_table, workers=self.config.compaction_workers
+            )
+            if self.config.compaction_interval_s > 0:
+                self._compactions.start_periodic(
+                    self.config.compaction_interval_s,
+                    self._make_periodic_scan(),
+                )
             return self._compactions
 
     def _periodic_scan(self) -> None:
@@ -446,27 +636,55 @@ class Instance:
             return CompactionScheduler.idle_stats(closed=self._closed)
         return scheduler.stats()
 
-    def close(self, wait: bool = True) -> None:
-        """Stop background machinery; with ``wait`` drain queued
-        compactions first (a merge is never abandoned silently).
+    def flush_stats(self) -> dict:
+        """Flush scheduler introspection for /debug/flush (same key
+        schema as compaction_stats)."""
+        from .maintenance_scheduler import MaintenanceScheduler
 
-        Close is TERMINAL: maybe_compact after close is a no-op rather
-        than a lazy scheduler rebirth — a resurrected worker would race
-        the next Instance over the same manifests."""
+        with self._lock:
+            scheduler = self._flushes
+        if scheduler is None:
+            return MaintenanceScheduler.idle_stats(closed=self._closed)
+        return scheduler.stats()
+
+    def close(self, wait: bool = True) -> None:
+        """Stop background machinery; with ``wait`` drain queued flushes
+        and compactions first (neither is ever abandoned silently).
+        Flushes drain BEFORE the compaction scheduler closes — a draining
+        flush may still request a merge.
+
+        Close is TERMINAL: maybe_compact / request_flush after close fall
+        back to no-op / inline rather than a lazy scheduler rebirth — a
+        resurrected worker would race the next Instance over the same
+        manifests."""
         with self._lock:
             self._closed = True
+            flushes, self._flushes = self._flushes, None
+        if flushes is not None:
+            flushes.close(wait=wait)
+        # Detach the compaction scheduler only AFTER the flush drain: a
+        # draining flush's maybe_compact must still reach it (the
+        # accessors return a live scheduler even when closed — the
+        # _closed check only prevents lazy rebirth).
+        with self._lock:
             scheduler, self._compactions = self._compactions, None
         if scheduler is not None:
             scheduler.close(wait=wait)
 
     def alter_schema(self, table: TableData, schema: Schema) -> None:
-        with table.serial_lock:
+        # flush_lock FIRST (never after serial_lock): ALTER fences on a
+        # drained flush — an in-flight dump completes its install before
+        # the schema changes, and a queued background flush that starts
+        # later just dumps the post-ALTER state.
+        with table.flush_lock, table.serial_lock:
             if schema.version <= table.schema.version:
                 raise ValueError(
                     f"stale schema version {schema.version} <= {table.schema.version}"
                 )
-            # Freeze old-schema rows, flush them, then install the new schema.
-            self.flush_table(table)
+            # Freeze old-schema rows, flush them, then install the new
+            # schema — inline (both locks are reentrantly held), so no
+            # writer can interleave an old-schema row mid-ALTER.
+            self._do_flush(table)
             table.version.alter_schema(schema)
             table.manifest.append_edits([AlterSchema(schema)])
 

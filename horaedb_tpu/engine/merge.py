@@ -264,10 +264,19 @@ def _limited_append_scan(
                 store.prefetch([h.path for h in ssts[i + batch:i + 2 * batch]])
             if remote and len(chunk) > 1:
                 # io_pool, NOT scatter_pool — same nesting caveat as
-                # scan_sources
+                # scan_sources; contexts copied the same way too, so
+                # ledger/span records from pool threads survive the hop
+                # on the LIMIT fast path as well
+                import contextvars
+
                 from ..utils.runtime import io_pool
 
-                results = list(io_pool().map(read_one, chunk))
+                ctxs = [contextvars.copy_context() for _ in chunk]
+                results = list(
+                    io_pool().map(
+                        lambda cw: cw[0].run(read_one, cw[1]), zip(ctxs, chunk)
+                    )
+                )
             else:
                 results = [read_one(h) for h in chunk]
             if any(add(r) for r in results):

@@ -41,6 +41,19 @@ class TableData:
         self.manifest = manifest
         self.store = store
         self.serial_lock = threading.RLock()  # single-writer per table
+        # Serializes the SLOW flush phases (dump + install) plus ALTER and
+        # the orphan sweep, WITHOUT blocking writers: flush takes
+        # serial_lock only to freeze the memtable and to install the
+        # result. Lock order is always flush_lock -> serial_lock; never
+        # acquire flush_lock while holding serial_lock (except reentrantly
+        # on the same thread — ALTER holds both and runs its drain-flush
+        # inline).
+        self.flush_lock = threading.RLock()
+        # Write-stall backpressure: writers block here when frozen
+        # memtables pile past the configured bound; flush completion (and
+        # drop/retire) notify. Waits also use short timeout slices, so a
+        # missed notify degrades to latency, never to a hang.
+        self.stall_cond = threading.Condition(threading.Lock())
         # Pending-write queue: concurrent writers merge into one WAL batch
         # (ref: table/mod.rs:147-358 PendingWriteQueue).
         self.pending_lock = threading.Lock()
@@ -111,6 +124,12 @@ class TableData:
 
     def should_flush(self) -> bool:
         return self.version.mutable_bytes() >= self.options.write_buffer_size
+
+    def notify_flush_waiters(self) -> None:
+        """Wake writers stalled on the immutable-memtable bound (flush
+        completion retired memtables, or drop/retire made waiting moot)."""
+        with self.stall_cond:
+            self.stall_cond.notify_all()
 
     def metrics(self) -> dict:
         return {

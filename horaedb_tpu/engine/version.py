@@ -96,6 +96,16 @@ class TableVersion:
         with self._lock:
             return list(self._immutables)
 
+    def immutable_stats(self) -> tuple[int, int]:
+        """(count, bytes) of frozen memtables awaiting flush — the
+        write-stall backpressure signal (frozen data the background dump
+        hasn't made durable yet)."""
+        with self._lock:
+            return (
+                len(self._immutables),
+                sum(m.approx_bytes for m in self._immutables),
+            )
+
     def retire_immutables(self, memtable_ids: list[int], flushed_sequence: int) -> None:
         """Called after a successful flush persisted these memtables."""
         with self._lock:

@@ -113,9 +113,16 @@ class CachedTableScan:
 
     def values_for(self, names: list[str]):
         key = tuple(names)
-        if self._stacks is None:
-            self._stacks = {}
-        out = self._stacks.get(key)
+        # Work on a LOCAL reference: a concurrent _extend invalidates by
+        # setting self._stacks = None (it holds only ext_lock, which this
+        # hit path deliberately does not take), so re-reading the
+        # attribute between the None-check and the store below can crash
+        # a select. Stacks are per-name-tuple over add-only columns, so
+        # storing into a just-discarded dict is merely a lost cache fill.
+        stacks = self._stacks
+        if stacks is None:
+            stacks = self._stacks = {}
+        out = stacks.get(key)
         if out is None:
             if not names:
                 out = jnp.zeros((0, len(self.series_codes_dev)), dtype=jnp.float32)
@@ -126,7 +133,7 @@ class CachedTableScan:
                 import jax
 
                 out = jax.device_put(out, NamedSharding(self.mesh, P(None, "shard")))
-            self._stacks[key] = out
+            stacks[key] = out
         return out
 
     def session_for(self, gos: np.ndarray, allow: np.ndarray):

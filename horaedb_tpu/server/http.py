@@ -498,6 +498,13 @@ def create_app(
             n = await asyncio.get_running_loop().run_in_executor(None, do_write)
         except BlockedError as e:
             return web.json_response({"error": str(e)}, status=403)
+        except OverloadedError as e:
+            # write-stall shed (engine backpressure): healthy but full —
+            # same retryable contract as an admission shed
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
         except QuotaExceededError as e:
             return web.json_response(
                 {"error": str(e)}, status=429,
@@ -543,6 +550,11 @@ def create_app(
             return web.json_response({"error": str(e)}, status=400)
         except BlockedError as e:
             return web.json_response({"error": str(e)}, status=403)
+        except OverloadedError as e:
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
         except QuotaExceededError as e:
             return web.json_response(
                 {"error": str(e)}, status=429,
@@ -653,6 +665,11 @@ def create_app(
             return web.json_response({"error": str(e)}, status=400)
         except BlockedError as e:
             return web.json_response({"error": str(e)}, status=403)
+        except OverloadedError as e:
+            return web.json_response(
+                {"error": str(e)}, status=503,
+                headers={"Retry-After": str(max(1, int(round(e.retry_after_s))))},
+            )
         except QuotaExceededError as e:
             return web.json_response(
                 {"error": str(e)}, status=429,
@@ -810,6 +827,15 @@ def create_app(
                 "engine": {
                     "space_write_buffer_size": inst.config.space_write_buffer_size,
                     "compaction_l0_trigger": inst.config.compaction_l0_trigger,
+                    "compaction_workers": inst.config.compaction_workers,
+                    "background_flush": inst.config.background_flush,
+                    "flush_workers": inst.config.flush_workers,
+                    "write_stall_immutable_count":
+                        inst.config.write_stall_immutable_count,
+                    "write_stall_immutable_bytes":
+                        inst.config.write_stall_immutable_bytes,
+                    "write_stall_deadline_s":
+                        inst.config.write_stall_deadline_s,
                     "wal": type(inst.wal).__name__ if inst.wal else None,
                 },
                 "slow_threshold_s": proxy.slow_threshold_s,
@@ -914,6 +940,12 @@ def create_app(
         per-table failure backoff (ref model: the reference scheduler's
         ScheduleRoom/token visibility through its admin surface)."""
         return web.json_response(conn.instance.compaction_stats())
+
+    async def debug_flush(request: web.Request) -> web.Response:
+        """Background flush scheduler state (same shape as
+        /debug/compaction): queue, in-flight dumps, per-table failure
+        backoff — the pipelined-flush half of the maintenance surface."""
+        return web.json_response(conn.instance.flush_stats())
 
     async def debug_slow_log(request: web.Request) -> web.Response:
         """Recent slow queries (ref: the reference's slow-query log file)."""
@@ -1232,6 +1264,7 @@ def create_app(
     app.router.add_get("/debug/shards", debug_shards)
     app.router.add_get("/debug/wal_stats", debug_wal_stats)
     app.router.add_get("/debug/compaction", debug_compaction)
+    app.router.add_get("/debug/flush", debug_flush)
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_get("/debug/workload", debug_workload)
     app.router.add_post("/admin/flush", admin_flush)
@@ -1264,6 +1297,16 @@ def run_server(
         engine_cfg = EngineConfig(
             space_write_buffer_size=config.engine.space_write_buffer_size,
             compaction_l0_trigger=config.engine.compaction_l0_trigger,
+            compaction_workers=config.engine.compaction_workers,
+            background_flush=config.engine.background_flush,
+            flush_workers=config.engine.flush_workers,
+            write_stall_immutable_count=(
+                config.engine.write_stall_immutable_count
+            ),
+            write_stall_immutable_bytes=(
+                config.engine.write_stall_immutable_bytes
+            ),
+            write_stall_deadline_s=config.engine.write_stall_deadline_s,
         )
         slow_threshold = config.limits.slow_threshold_s
     host = host if host is not None else "127.0.0.1"

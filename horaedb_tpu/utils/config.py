@@ -14,6 +14,12 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     wal = true                      # false = disable_data_wal semantics
     space_write_buffer_size = "256mb"
     compaction_l0_trigger = 4
+    compaction_workers = 2          # background compaction pool size
+    background_flush = true         # false = inline flush on the writer
+    flush_workers = 2               # background flush pool size
+    write_stall_immutable_count = 8   # frozen-memtable backpressure bound
+    write_stall_immutable_bytes = "1gb"
+    write_stall_deadline = "30s"      # stall wait before shedding 503
 
     [limits]
     slow_threshold = "1s"
@@ -134,6 +140,13 @@ class EngineSection:
     wal_backend: str = "disk"  # "disk" | "object_store" | "shared_log"
     space_write_buffer_size: int = 256 << 20
     compaction_l0_trigger: int = 4
+    compaction_workers: int = 2
+    # pipelined background flush + write-stall backpressure (engine/flush)
+    background_flush: bool = True
+    flush_workers: int = 2
+    write_stall_immutable_count: int = 8
+    write_stall_immutable_bytes: int = 1 << 30
+    write_stall_deadline_s: float = 30.0
 
 
 @dataclass
@@ -208,6 +221,9 @@ _KNOWN = {
     "engine": {
         "data_dir", "wal", "wal_backend",
         "space_write_buffer_size", "compaction_l0_trigger",
+        "compaction_workers", "background_flush", "flush_workers",
+        "write_stall_immutable_count", "write_stall_immutable_bytes",
+        "write_stall_deadline",
     },
     "limits": {
         "slow_threshold", "admission_slots", "admission_queue_depth",
@@ -263,6 +279,26 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.engine.space_write_buffer_size = parse_size_bytes(e["space_write_buffer_size"])
     if "compaction_l0_trigger" in e:
         cfg.engine.compaction_l0_trigger = int(e["compaction_l0_trigger"])
+    if "compaction_workers" in e:
+        cfg.engine.compaction_workers = int(e["compaction_workers"])
+    if "background_flush" in e:
+        if not isinstance(e["background_flush"], bool):
+            raise ConfigError("engine.background_flush must be a boolean")
+        cfg.engine.background_flush = e["background_flush"]
+    if "flush_workers" in e:
+        cfg.engine.flush_workers = int(e["flush_workers"])
+    if "write_stall_immutable_count" in e:
+        cfg.engine.write_stall_immutable_count = int(
+            e["write_stall_immutable_count"]
+        )
+    if "write_stall_immutable_bytes" in e:
+        cfg.engine.write_stall_immutable_bytes = parse_size_bytes(
+            e["write_stall_immutable_bytes"]
+        )
+    if "write_stall_deadline" in e:
+        cfg.engine.write_stall_deadline_s = (
+            parse_duration_ms(e["write_stall_deadline"]) / 1000.0
+        )
     l = raw.get("limits", {})
     if "slow_threshold" in l:
         cfg.limits.slow_threshold_s = parse_duration_ms(l["slow_threshold"]) / 1000.0

@@ -606,8 +606,11 @@ class TestDedupPruningRegression:
         inst.write(t, RowGroup.from_rows(
             t.schema, [{"name": "h", "value": 1.0, "t": now - 7_200_000}]
         ))
-        inst.flush_table(t)
-        assert len(t.version.levels.files_at(0)) == 1
+        # Assert on the flush RESULT, not the live level state: the flush
+        # itself requests the TTL compaction, which can expire the file
+        # on its worker before this thread wakes from the completion.
+        res = inst.flush_table(t)
+        assert res.files_added == 1
         deadline = _time.monotonic() + 10
         while _time.monotonic() < deadline and t.version.levels.files_at(0):
             _time.sleep(0.02)

@@ -126,6 +126,14 @@ class TestWriteRead:
             env.write_rows(t, [
                 {"name": f"h{j}", "value": float(j), "t": i * 1000} for j in range(10)
             ])
+        # The tripped buffer REQUESTS a flush; the dump runs on the
+        # background scheduler — poll for its completion instead of
+        # asserting the L0 file into existence at write-return time.
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and not t.version.levels.files_at(0):
+            _time.sleep(0.02)
         assert len(t.version.levels.files_at(0)) > 0
 
 

@@ -1,0 +1,564 @@
+"""Pipelined background flush + write-stall backpressure
+(engine/flush.py freeze/dump/install split, engine/flush_scheduler.py,
+the maintenance-scheduler core, and the stall/shed path).
+
+Covers the PR's acceptance scenarios: writers make progress while a slow
+store flushes; the stall bound blocks then sheds with the retryable wire
+codes on all three protocols; a crash between SST write and manifest
+append loses no data and the orphan sweep collects the file; and
+close/ALTER/drop all drain pending flushes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.common_types import ColumnSchema, DatumKind, RowGroup, Schema
+from horaedb_tpu.engine.instance import EngineConfig, Instance
+from horaedb_tpu.engine.options import TableOptions
+from horaedb_tpu.engine.wal import LocalDiskWal
+from horaedb_tpu.utils.object_store import MemoryStore
+
+
+def demo_schema():
+    return Schema.build(
+        [
+            ColumnSchema("name", DatumKind.STRING, is_tag=True),
+            ColumnSchema("value", DatumKind.DOUBLE),
+            ColumnSchema("t", DatumKind.TIMESTAMP),
+        ],
+        timestamp_column="t",
+    )
+
+
+def rows_at(t0: int, n: int, base: float = 0.0):
+    return [
+        {"name": "h", "value": base + float(i), "t": t0 + i} for i in range(n)
+    ]
+
+
+class GatedSstStore:
+    """ObjectStore wrapper that blocks SST puts on an event — freezes a
+    flush mid-upload so tests can assert what happens around it.
+    Manifest/WAL objects pass through untouched."""
+
+    def __init__(self, inner, gate: threading.Event) -> None:
+        self._inner = inner
+        self._gate = gate
+        self.sst_put_started = threading.Event()
+        self.sst_puts = 0
+
+    def put(self, path, data):
+        if path.endswith(".sst"):
+            self.sst_put_started.set()
+            assert self._gate.wait(30), "test gate never released"
+            self.sst_puts += 1
+        self._inner.put(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class SlowSstStore:
+    """ObjectStore wrapper adding a fixed delay to SST puts."""
+
+    def __init__(self, inner, delay_s: float) -> None:
+        self._inner = inner
+        self._delay_s = delay_s
+
+    def put(self, path, data):
+        if path.endswith(".sst"):
+            time.sleep(self._delay_s)
+        self._inner.put(path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def make_instance(store, wal=None, **cfg):
+    defaults = dict(
+        background_flush=True,
+        compaction_l0_trigger=10**9,  # isolate flush behavior
+        compaction_interval_s=0,
+    )
+    defaults.update(cfg)
+    return Instance(store, EngineConfig(**defaults), wal=wal)
+
+
+def create_demo(inst, **opts):
+    return inst.create_table(
+        0, 1, "demo", demo_schema(),
+        TableOptions.from_kv({"segment_duration": "1h", **opts}),
+    )
+
+
+class TestWritersProgressDuringFlush:
+    def test_writes_commit_while_dump_blocked_on_upload(self):
+        """The tentpole property: with the dump frozen mid-upload,
+        writers keep committing into the fresh mutable memtable."""
+        gate = threading.Event()
+        store = GatedSstStore(MemoryStore(), gate)
+        inst = make_instance(store)
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 50)))
+            inst.request_flush(t)  # dump starts, blocks inside store.put
+            assert store.sst_put_started.wait(10)
+
+            # The flush is mid-upload. Writes must still complete fast.
+            done = threading.Event()
+
+            def write_more():
+                for k in range(5):
+                    inst.write(
+                        t,
+                        RowGroup.from_rows(
+                            t.schema, rows_at(2000 + 100 * k, 20, base=100.0)
+                        ),
+                    )
+                done.set()
+
+            w = threading.Thread(target=write_more)
+            w.start()
+            assert done.wait(10), "writers blocked behind the SST upload"
+            assert not gate.is_set()  # the upload genuinely never finished
+            gate.set()
+            w.join()
+            res = inst.flush_table(t)
+            assert res is not None
+            out = inst.read(t)
+            assert len(out) == 50 + 5 * 20
+        finally:
+            gate.set()
+            inst.close()
+
+    def test_concurrent_writers_all_land_with_slow_store(self):
+        store = SlowSstStore(MemoryStore(), 0.02)
+        inst = make_instance(store)
+        t = create_demo(inst, write_buffer_size="64kb")
+        errors = []
+
+        def writer(w):
+            try:
+                for b in range(5):
+                    inst.write(
+                        t,
+                        RowGroup.from_rows(
+                            t.schema,
+                            rows_at((w * 5 + b) * 10_000, 200, base=w * 1e4),
+                        ),
+                    )
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+        try:
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert not errors, errors
+            inst.flush_table(t)
+            assert len(inst.read(t)) == 4 * 5 * 200
+        finally:
+            inst.close()
+
+
+class TestWriteStall:
+    def test_stall_blocks_then_recovers_when_flush_completes(self):
+        gate = threading.Event()
+        store = GatedSstStore(MemoryStore(), gate)
+        inst = make_instance(
+            store,
+            write_stall_immutable_count=1,
+            write_stall_immutable_bytes=1,
+            write_stall_deadline_s=10.0,
+        )
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 10)))
+            t.version.switch_memtable()  # one frozen memtable: at the bound
+            inst.request_flush(t)
+            assert store.sst_put_started.wait(10)
+
+            # Next write stalls on the bound; releasing the gate lets the
+            # flush retire the frozen memtable and the write completes.
+            seq = []
+            w = threading.Thread(
+                target=lambda: seq.append(
+                    inst.write(t, RowGroup.from_rows(t.schema, rows_at(2000, 1)))
+                )
+            )
+            w.start()
+            time.sleep(0.3)
+            assert not seq, "write should be stalled while frozen >= bound"
+            gate.set()
+            w.join(timeout=10)
+            assert seq, "stalled write never completed after flush"
+
+            from horaedb_tpu.utils.metrics import REGISTRY
+
+            assert "horaedb_write_stall_seconds" in set(REGISTRY.families())
+        finally:
+            gate.set()
+            inst.close()
+
+    def test_stall_sheds_with_typed_overloaded_error(self):
+        from horaedb_tpu.wlm.admission import OverloadedError
+
+        gate = threading.Event()
+        store = GatedSstStore(MemoryStore(), gate)
+        inst = make_instance(
+            store,
+            write_stall_immutable_count=1,
+            write_stall_immutable_bytes=1,
+            write_stall_deadline_s=0.1,
+        )
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 10)))
+            t.version.switch_memtable()
+            with pytest.raises(OverloadedError) as ei:
+                inst.write(t, RowGroup.from_rows(t.schema, rows_at(2000, 1)))
+            assert ei.value.reason == "write_stall"
+            assert ei.value.retry_after_s > 0
+        finally:
+            gate.set()
+            inst.close()
+
+    def test_inline_mode_never_stalls(self):
+        # background_flush off: the flush runs on the writing thread, so
+        # the backpressure path must be a no-op (it would self-deadlock).
+        inst = make_instance(
+            MemoryStore(),
+            background_flush=False,
+            write_stall_immutable_count=0,
+            write_stall_immutable_bytes=0,
+        )
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 10)))
+            inst.flush_table(t)
+            assert len(inst.read(t)) == 10
+        finally:
+            inst.close()
+
+
+class TestStallWireCodes:
+    def test_shed_maps_to_retryable_codes_on_all_three_protocols(self):
+        import asyncio
+        import socket
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server import create_app
+        from horaedb_tpu.server.mysql import MysqlServer
+        from horaedb_tpu.server.postgres import PostgresServer
+        from test_wire_protocols import MyClient, PgClient
+        from test_workload import _mysql_raw_error
+
+        conn = horaedb_tpu.connect(None)
+        inst = conn.instance
+        gate = threading.Event()
+        # Swap in the gated store BEFORE the table exists: TableData
+        # captures the store reference at create time.
+        inst.store = GatedSstStore(inst.store, gate)
+        inst.config.background_flush = True
+        inst.config.write_stall_immutable_count = 1
+        inst.config.write_stall_immutable_bytes = 1
+        inst.config.write_stall_deadline_s = 0.05
+        conn.execute(
+            "CREATE TABLE stall_w (h string TAG, v double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        conn.execute("INSERT INTO stall_w (h, v, ts) VALUES ('a', 1.0, 100)")
+        td = next(t for t in inst.open_tables() if t.name == "stall_w")
+        td.version.switch_memtable()  # frozen >= bound; the dump will block
+        app = create_app(conn)
+        gw = app["sql_gateway"]
+        ins = "INSERT INTO stall_w (h, v, ts) VALUES ('b', 2.0, 200)"
+
+        def my_checks(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            errno, sqlstate, msg = _mysql_raw_error(c, ins)
+            assert (errno, sqlstate) == (1040, "08004"), (errno, sqlstate, msg)
+            assert "write stall" in msg
+            s.close()
+
+        def pg_checks(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            _, _, _, err = c.query(ins)
+            assert err is not None and "53300" in err, err
+            s.close()
+
+        async def body():
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                # HTTP SQL: shed -> 503 + Retry-After
+                resp = await client.post("/sql", json={"query": ins})
+                assert resp.status == 503, await resp.text()
+                assert "Retry-After" in resp.headers
+                # raw /write ingest: same retryable contract
+                resp = await client.post(
+                    "/write",
+                    json={
+                        "table": "stall_w",
+                        "rows": [{"h": "c", "v": 3.0, "ts": 300}],
+                    },
+                )
+                assert resp.status == 503, await resp.text()
+                assert "Retry-After" in resp.headers
+                await loop.run_in_executor(None, my_checks, my.port)
+                await loop.run_in_executor(None, pg_checks, pg.port)
+            finally:
+                await my.stop()
+                await pg.stop()
+                await client.close()
+
+        try:
+            asyncio.run(body())
+        finally:
+            gate.set()
+            conn.close()
+
+
+class TestCrashSafety:
+    def test_crash_between_sst_write_and_manifest_loses_nothing(self, tmp_path):
+        """Data before metadata: a flush that dies after the SST upload
+        but before the manifest append leaves orphans (swept at reopen)
+        and the rows replay from the WAL — no data loss, no ghost files."""
+        store = MemoryStore()
+        inst = make_instance(store, wal=LocalDiskWal(str(tmp_path)))
+        t = create_demo(inst)
+        inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 25)))
+
+        real_append = t.manifest.append_edits
+
+        def boom(edits):
+            raise RuntimeError("injected crash before manifest append")
+
+        t.manifest.append_edits = boom
+        with pytest.raises(RuntimeError, match="injected crash"):
+            inst.flush_table(t)
+        t.manifest.append_edits = real_append
+
+        orphans = [p for p in store.list("0/1/") if p.endswith(".sst")]
+        assert orphans, "the dump should have written SSTs before the crash"
+        # WAL must NOT have been marked flushed past the failed flush.
+        assert t.version.flushed_sequence == 0
+        inst.close(wait=False)
+
+        # "Reboot": fresh instance over the same store + WAL dir.
+        inst2 = make_instance(store, wal=LocalDiskWal(str(tmp_path)))
+        t2 = inst2.open_table(0, 1, "demo")
+        try:
+            out = inst2.read(t2)
+            assert len(out) == 25  # replayed from WAL — nothing lost
+            leftover = [p for p in store.list("0/1/") if p.endswith(".sst")]
+            assert not leftover, f"orphan sweep missed: {leftover}"
+            # And the table still flushes cleanly afterwards.
+            res = inst2.flush_table(t2)
+            assert res.rows_flushed == 25
+        finally:
+            inst2.close()
+
+    def test_wait_flush_round_trips_wal_mark(self, tmp_path):
+        store = MemoryStore()
+        wal = LocalDiskWal(str(tmp_path))
+        inst = make_instance(store, wal=wal)
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 10)))
+            res = inst.flush_table(t)
+            assert res.rows_flushed == 10 and res.flushed_sequence > 0
+            # mark_flushed happened (strictly after the manifest append):
+            # nothing newer than the flushed sequence remains to replay.
+            assert not list(wal.read_from(t.table_id, res.flushed_sequence + 1))
+        finally:
+            inst.close()
+
+
+class TestDrains:
+    def test_close_table_drains_pending_background_flush(self):
+        store = SlowSstStore(MemoryStore(), 0.05)
+        inst = make_instance(store)
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 30)))
+            inst.request_flush(t)  # queued in the background
+            inst.close_table(t)  # must drain + flush the rest durably
+            # No WAL here: rows can only come back from flushed SSTs.
+            t2 = inst.open_table(0, 1, "demo")
+            assert len(inst.read(t2)) == 30
+        finally:
+            inst.close()
+
+    def test_instance_close_drains_queued_flush(self):
+        store = SlowSstStore(MemoryStore(), 0.05)
+        inst = make_instance(store)
+        t = create_demo(inst)
+        inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 15)))
+        inst.request_flush(t)
+        inst.close(wait=True)  # drain, never abandon the queued dump
+        inst2 = make_instance(store)
+        try:
+            t2 = inst2.open_table(0, 1, "demo")
+            assert len(inst2.read(t2)) == 15
+        finally:
+            inst2.close()
+
+    def test_alter_fences_on_drained_flush(self):
+        gate = threading.Event()
+        store = GatedSstStore(MemoryStore(), gate)
+        inst = make_instance(store)
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 10)))
+            inst.request_flush(t)
+            assert store.sst_put_started.wait(10)  # dump is mid-upload
+            threading.Timer(0.2, gate.set).start()
+            # ALTER must wait for the in-flight dump, flush what's left,
+            # then install — never interleave old-schema rows after it.
+            new_schema = t.schema.with_added_column(
+                ColumnSchema("v2", DatumKind.DOUBLE)
+            )
+            inst.alter_schema(t, new_schema)
+            assert t.schema.version == new_schema.version
+            inst.write(
+                t,
+                RowGroup.from_rows(
+                    t.schema,
+                    [{"name": "h", "value": 9.0, "v2": 7.0, "t": 9000}],
+                ),
+            )
+            out = inst.read(t)
+            by_t = {r["t"]: r for r in out.to_pylist()}
+            assert len(out) == 11
+            assert by_t[9000]["v2"] == 7.0
+            assert by_t[1000]["v2"] is None  # pre-ALTER row, NULL-filled
+        finally:
+            gate.set()
+            inst.close()
+
+    def test_drop_table_with_inflight_flush_leaves_no_files(self):
+        gate = threading.Event()
+        store = GatedSstStore(MemoryStore(), gate)
+        inst = make_instance(store)
+        t = create_demo(inst)
+        try:
+            inst.write(t, RowGroup.from_rows(t.schema, rows_at(1000, 10)))
+            inst.request_flush(t)
+            assert store.sst_put_started.wait(10)
+            threading.Timer(0.2, gate.set).start()
+            inst.drop_table(t)  # blocks on flush_lock until the dump ends
+            assert t.dropped
+            leftover = [p for p in store.list("0/1/") if p.endswith(".sst")]
+            assert not leftover, leftover
+        finally:
+            gate.set()
+            inst.close()
+
+
+class TestSchedulerCore:
+    def _metrics(self):
+        from horaedb_tpu.engine.flush_scheduler import _METRICS
+
+        return _METRICS
+
+    def _table(self, sid=0, tid=1, name="t"):
+        class T:
+            space_id = sid
+            table_id = tid
+
+        T.name = name
+        return T()
+
+    def test_waiter_attaches_to_queued_entry(self):
+        import concurrent.futures as cf
+
+        from horaedb_tpu.engine.maintenance_scheduler import MaintenanceScheduler
+
+        started = threading.Event()
+        release = threading.Event()
+        runs = []
+
+        def run_fn(table):
+            started.set()
+            release.wait(10)
+            runs.append(table.table_id)
+            return len(runs)
+
+        s = MaintenanceScheduler(run_fn, self._metrics(), workers=1)
+        try:
+            t = self._table()
+            s.request(t)
+            assert started.wait(5)
+            # Worker busy: a new request queues; both waiters share it.
+            f1, f2 = cf.Future(), cf.Future()
+            assert s.request(t, waiter=f1) is True
+            assert s.request(t, waiter=f2) is False  # deduped, attached
+            release.set()
+            assert f1.result(10) == f2.result(10) == 2
+            assert runs == [1, 1]
+        finally:
+            release.set()
+            s.close()
+
+    def test_closed_scheduler_fails_waiters_typed(self):
+        import concurrent.futures as cf
+
+        from horaedb_tpu.engine.maintenance_scheduler import (
+            MaintenanceScheduler,
+            SchedulerClosed,
+        )
+
+        s = MaintenanceScheduler(lambda t: None, self._metrics(), workers=1)
+        s.close()
+        f = cf.Future()
+        assert s.request(self._table(), waiter=f) is False
+        with pytest.raises(SchedulerClosed):
+            f.result(1)
+
+    def test_failure_backoff_suppresses_only_waiterless_requests(self):
+        import concurrent.futures as cf
+
+        from horaedb_tpu.engine.maintenance_scheduler import MaintenanceScheduler
+
+        def run_fn(table):
+            raise RuntimeError("durable failure")
+
+        s = MaintenanceScheduler(run_fn, self._metrics(), workers=1)
+        try:
+            t = self._table()
+            f = cf.Future()
+            s.request(t, waiter=f)
+            with pytest.raises(RuntimeError):
+                f.result(10)
+            # Fire-and-forget is now suppressed by backoff...
+            assert s.request(t) is False
+            assert "0/1" in s.stats()["backoff"]
+            # ...but an explicit waiter still gets its attempt...
+            f2 = cf.Future()
+            assert s.request(t, waiter=f2) is True
+            with pytest.raises(RuntimeError):
+                f2.result(10)
+            # ...and so does an urgent request (a stalled writer's only
+            # way out is a retried flush — backoff must not trap it).
+            assert s.request(t) is False
+            assert s.request(t, urgent=True) is True
+        finally:
+            s.close(wait=False)

@@ -872,6 +872,57 @@ class TestMetricsNameLint:
                 missing.append(f"{field}: undocumented in docs/WORKLOAD.md")
         assert not missing, missing
 
+    def test_flush_pipeline_families_declared_and_documented(self):
+        """PR-4 lint extension (same contract as the admission registry):
+        every flush-pipeline family declared in
+        engine.flush_scheduler.FLUSH_PIPELINE_METRIC_FAMILIES must be (a)
+        registered live, (b) convention-clean, and (c) documented in
+        docs/OBSERVABILITY.md — and no stray horaedb_flush_* /
+        horaedb_write_stall_* family may exist outside the declared list.
+        The pipeline's config knobs must be documented in
+        docs/WORKLOAD.md."""
+        import os
+        import re
+
+        # Importing these registers every declared family (schedulers and
+        # flush register at module import; no workload needed).
+        import horaedb_tpu.engine.flush  # noqa: F401
+        import horaedb_tpu.engine.instance  # noqa: F401
+        from horaedb_tpu.engine.flush_scheduler import (
+            FLUSH_PIPELINE_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        missing = []
+        for fam in FLUSH_PIPELINE_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/OBSERVABILITY.md")
+        for fam in families:
+            if (
+                fam.startswith("horaedb_flush_")
+                or fam.startswith("horaedb_write_stall")
+            ) and fam not in FLUSH_PIPELINE_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        # The backpressure/scheduler knobs are operator surface: pin the
+        # WORKLOAD.md mention so the contract is discoverable.
+        for knob in (
+            "background_flush", "flush_workers", "compaction_workers",
+            "write_stall_immutable_count", "write_stall_immutable_bytes",
+            "write_stall_deadline",
+        ):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
         and horaedb_wal_* families after a flush+compaction cycle."""
