@@ -305,7 +305,13 @@ class Catalog:
             self.ddl_generation += 1
             self._persist_locked()
             self._open_tables[name] = table
-            return table
+        from ..utils.events import record_event
+
+        record_event(
+            "ddl_create_table", table=name,
+            partitions=(partition_info or {}).get("num_partitions", 0),
+        )
+        return table
 
     def drop_table(self, name: str, if_exists: bool = False) -> bool:
         # Unregister under the lock, drop storage AFTER releasing it:
@@ -346,6 +352,9 @@ class Catalog:
                     drop_remote = getattr(sub, "drop_remote", None)
                     if drop_remote is not None:
                         drop_remote()
+        from ..utils.events import record_event
+
+        record_event("ddl_drop_table", table=name)
         return True
 
     def close(self) -> None:

@@ -34,6 +34,14 @@ def _metrics():
     return REGISTRY
 
 
+def _record_event(kind: str, **attrs):
+    # Lazy: the cluster runtime must not pull the metrics/events modules
+    # at import (same reason _metrics() is deferred).
+    from ..utils.events import record_event
+
+    record_event(kind, **attrs)
+
+
 class ClusterImpl:
     def __init__(
         self,
@@ -174,6 +182,10 @@ class ClusterImpl:
                             "horaedb_cluster_shard_freezes_total",
                             "shards frozen by the lease watch",
                         ).inc()
+                        _record_event(
+                            "shard_freeze", shard_id=shard.shard_id,
+                            lapsed_s=round(now - deadline, 3),
+                        )
                         logger.warning(
                             "shard %d FROZEN: lease lapsed %.2fs ago",
                             shard.shard_id, now - deadline,
@@ -184,6 +196,7 @@ class ClusterImpl:
                             "horaedb_cluster_shard_thaws_total",
                             "shards thawed by the lease watch after renewal",
                         ).inc()
+                        _record_event("shard_thaw", shard_id=shard.shard_id)
                         logger.info(
                             "shard %d thawed: lease renewed", shard.shard_id
                         )
@@ -256,6 +269,7 @@ class ClusterImpl:
                             "horaedb_cluster_shard_thaws_total",
                             "shards thawed by the lease watch after renewal",
                         ).inc()
+                        _record_event("shard_thaw", shard_id=shard_id)
                     except ShardError:
                         pass
             else:

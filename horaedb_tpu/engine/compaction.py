@@ -237,12 +237,27 @@ class Compactor:
                         if not (skipped and consumed):
                             break
                     sp.set(tasks=result.tasks_run, rows=result.rows_written)
+            except Exception as e:
+                from ..utils.events import record_event
+
+                record_event(
+                    "compaction_failed", table=table.name, error=str(e)[:200]
+                )
+                raise
             finally:
                 _M_COMPACT_INFLIGHT.dec()
             if result.tasks_run:
                 _M_COMPACT_SECONDS.observe(time.perf_counter() - t0)
                 _M_COMPACT_TASKS.inc(result.tasks_run)
                 _M_COMPACT_ROWS.inc(result.rows_written)
+            if result.tasks_run or result.expired_dropped:
+                from ..utils.events import record_event
+
+                record_event(
+                    "compaction", table=table.name,
+                    tasks=result.tasks_run, rows=result.rows_written,
+                    expired_dropped=result.expired_dropped,
+                )
         return result
 
     @staticmethod

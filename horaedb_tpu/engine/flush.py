@@ -109,12 +109,22 @@ class Flusher:
                     ),
                     options=table.options,
                 )
+            from ..utils.events import record_event
             from ..utils.tracectx import span
 
+            record_event(
+                "flush_freeze", table=table.name, memtables=len(frozen)
+            )
             t0 = _perf_counter()
-            with span("flush", table=table.name) as sp:
-                result = self._dump_memtables(snap)
-                sp.set(rows=result.rows_flushed, files=result.files_added)
+            try:
+                with span("flush", table=table.name) as sp:
+                    result = self._dump_memtables(snap)
+                    sp.set(rows=result.rows_flushed, files=result.files_added)
+            except Exception as e:
+                record_event(
+                    "flush_failed", table=table.name, error=str(e)[:200]
+                )
+                raise
             _M_FLUSH_SECONDS.observe(_perf_counter() - t0)
             _M_FLUSH_ROWS.inc(result.rows_flushed)
         # Outside the locks: retiring memtables freed immutable budget —
@@ -236,11 +246,19 @@ class Flusher:
         file_edits: list[MetaEdit] = []
         new_handles: list[FileHandle] = []
         rows_flushed = 0
+        bytes_flushed = 0
         for meta, path, n in outs:
             file_edits.append(AddFile(0, meta, path))
             new_handles.append(FileHandle(meta, path, 0))
             rows_flushed += n
+            bytes_flushed += meta.size_bytes
             _M_FLUSH_BYTES.inc(meta.size_bytes)
+        from ..utils.events import record_event
+
+        record_event(
+            "flush_dump", table=table.name,
+            files=len(new_handles), rows=rows_flushed, bytes=int(bytes_flushed),
+        )
 
         # INSTALL: manifest append + version swap + retire, re-checking
         # dropped/retired under the lock — a table dropped or handed off
@@ -275,4 +293,8 @@ class Flusher:
             for h in new_handles:
                 table.version.levels.add_file(0, h)
             table.version.retire_immutables([m.id for m in memtables], max_seq)
+        record_event(
+            "flush_install", table=table.name,
+            files=len(new_handles), rows=rows_flushed, flushed_seq=int(max_seq),
+        )
         return FlushResult(len(new_handles), rows_flushed, max_seq)

@@ -11,14 +11,17 @@ lost on crash, SSTs are not).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import contextvars
 import threading
 import time as _time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from ..common_types.row_group import RowGroup
 from ..common_types.schema import Schema
 from ..table_engine.predicate import Predicate
+from ..utils.events import record_event
 from ..utils.metrics import REGISTRY
 from ..utils.object_store import ObjectStore
 from ..utils.tracectx import span
@@ -48,6 +51,26 @@ _M_WRITE_STALL_SECONDS = REGISTRY.histogram(
     "time writers spent blocked on the immutable-memtable backpressure "
     "bound waiting for a background flush",
 )
+
+
+# Writers that must never block behind the flush machinery they observe
+# (the self-monitoring recorder measuring that very flush): under this
+# flag the write-stall gate sheds IMMEDIATELY with the typed retryable
+# OverloadedError instead of waiting out the deadline.
+_NONBLOCKING_WRITES: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "horaedb_nonblocking_writes", default=False
+)
+
+
+@contextmanager
+def nonblocking_backpressure():
+    """Writes inside this context yield to write-stall backpressure:
+    at the bound they shed instantly (retryable) rather than block."""
+    token = _NONBLOCKING_WRITES.set(True)
+    try:
+        yield
+    finally:
+        _NONBLOCKING_WRITES.reset(token)
 
 
 def _memtable_gauge(table: TableData):
@@ -117,6 +140,11 @@ class Instance:
         self._compactions = None  # lazy CompactionScheduler
         self._flushes = None  # lazy FlushScheduler
         self._closed = False
+        # WAL-replay progress for the /debug/status readiness surface:
+        # plain ints mutated around each replay (reads are advisory).
+        self.wal_replays_inflight = 0
+        self.wal_replayed_tables = 0
+        self.wal_replayed_rows = 0
 
     # ---- lifecycle -----------------------------------------------------
     def create_table(
@@ -536,8 +564,26 @@ class Instance:
         if count < cfg.write_stall_immutable_count and \
                 nbytes < cfg.write_stall_immutable_bytes:
             return
+        if _NONBLOCKING_WRITES.get():
+            # A writer that must not block behind the flush it observes
+            # (the self-monitoring recorder): still nudge a dump onto the
+            # queue, then shed NOW — never the deadline wait.
+            self.request_flush(table, urgent=True)
+            from ..wlm.admission import OverloadedError
+
+            raise OverloadedError(
+                f"write stall (nonblocking): table {table.name} holds "
+                f"{count} frozen memtables ({nbytes} bytes) awaiting flush",
+                reason="write_stall",
+                retry_after_s=1.0,
+            )
         deadline = _time.monotonic() + cfg.write_stall_deadline_s
         t0 = _time.perf_counter()
+        record_event(
+            "write_stall_enter", table=table.name,
+            immutable_count=count, immutable_bytes=int(nbytes),
+        )
+        outcome = "resumed"
         try:
             while True:
                 if table.dropped or table.retired:
@@ -554,6 +600,7 @@ class Instance:
                 if remaining <= 0:
                     from ..wlm.admission import OverloadedError
 
+                    outcome = "shed"
                     raise OverloadedError(
                         f"write stall: table {table.name} holds {count} "
                         f"frozen memtables ({nbytes} bytes) awaiting flush",
@@ -568,6 +615,10 @@ class Instance:
             waited = _time.perf_counter() - t0
             if waited > 0.001:
                 _M_WRITE_STALL_SECONDS.observe(waited)
+            record_event(
+                "write_stall_exit", table=table.name,
+                outcome=outcome, waited_s=round(waited, 4),
+            )
 
     def maybe_compact(self, table: TableData) -> None:
         """Request compaction when some segment window accumulated enough
@@ -647,6 +698,39 @@ class Instance:
             return MaintenanceScheduler.idle_stats(closed=self._closed)
         return scheduler.stats()
 
+    def is_ready(self) -> bool:
+        """Cheap readiness inputs for the /health?ready=1 probe: not
+        closed, no WAL replay in flight — without the O(open tables)
+        walk ``status()`` pays (k8s probes fire every few seconds)."""
+        return not self._closed and self.wal_replays_inflight == 0
+
+    def status(self) -> dict:
+        """One-shot node-engine status for /debug/status: open tables,
+        memtable pressure, WAL-replay progress, and both background
+        schedulers' queue/backoff state."""
+        tables = self.open_tables()
+        memtable_bytes = 0
+        immutable_count = 0
+        for t in tables:
+            try:
+                memtable_bytes += t.version.total_memtable_bytes()
+                immutable_count += t.version.immutable_stats()[0]
+            except Exception:
+                pass  # a table closing mid-walk must not fail status
+        return {
+            "open_tables": len(tables),
+            "memtable_bytes": int(memtable_bytes),
+            "immutable_memtables": int(immutable_count),
+            "wal_backend": type(self.wal).__name__ if self.wal else None,
+            "wal_replay_done": self.wal_replays_inflight == 0,
+            "wal_replays_inflight": self.wal_replays_inflight,
+            "wal_replayed_tables": self.wal_replayed_tables,
+            "wal_replayed_rows": self.wal_replayed_rows,
+            "flush": self.flush_stats(),
+            "compaction": self.compaction_stats(),
+            "closed": self._closed,
+        }
+
     def close(self, wait: bool = True) -> None:
         """Stop background machinery; with ``wait`` drain queued flushes
         and compactions first (neither is ever abandoned silently).
@@ -697,17 +781,29 @@ class Instance:
         """
         t0 = _time.perf_counter()
         replayed = 0
-        with span("wal_replay", table=table.name) as sp:
-            for seq, batch in self.wal.read_from(
-                table.table_id, table.version.flushed_sequence + 1
-            ):
-                rows = RowGroup.from_arrow(table.schema, batch)
-                table.put_rows(rows, seq)
-                table.set_last_sequence(seq)
-                replayed += len(rows)
-            sp.set(rows=replayed)
-        _M_WAL_REPLAY_SECONDS.observe(_time.perf_counter() - t0)
+        self.wal_replays_inflight += 1
+        try:
+            with span("wal_replay", table=table.name) as sp:
+                for seq, batch in self.wal.read_from(
+                    table.table_id, table.version.flushed_sequence + 1
+                ):
+                    rows = RowGroup.from_arrow(table.schema, batch)
+                    table.put_rows(rows, seq)
+                    table.set_last_sequence(seq)
+                    replayed += len(rows)
+                sp.set(rows=replayed)
+        finally:
+            self.wal_replays_inflight -= 1
+        self.wal_replayed_tables += 1
+        self.wal_replayed_rows += replayed
+        elapsed = _time.perf_counter() - t0
+        _M_WAL_REPLAY_SECONDS.observe(elapsed)
         _M_WAL_REPLAY_ROWS.inc(replayed)
+        if replayed:
+            record_event(
+                "wal_replay", table=table.name,
+                rows=replayed, seconds=round(elapsed, 4),
+            )
 
     def _purge(self, table: TableData) -> None:
         for h in table.version.levels.drain_purge_queue():

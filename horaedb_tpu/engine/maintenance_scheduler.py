@@ -20,6 +20,7 @@ starts, which makes the distinction fall out of the data structure.
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import threading
 import time
@@ -30,6 +31,45 @@ from typing import Callable, Optional
 from ..utils.metrics import Counter, Gauge
 
 logger = logging.getLogger("horaedb_tpu.engine.maintenance")
+
+
+class PeriodicLoop:
+    """The background picking-loop core (ref: scheduler.rs — the
+    scheduler wakes on its own, not only on requests), shared by the
+    maintenance schedulers and the self-monitoring MetricsRecorder.
+
+    Every ``interval_s``, ``tick_fn`` runs; a ``False`` return ends the
+    loop (weakref wrappers return it once their owner is collected), an
+    exception is logged and the loop continues. The loop closure holds
+    ONLY the stop event and the tick function the caller passed — the
+    caller decides whether that closure may pin anything (the instance
+    schedulers pass weakref wrappers for exactly this reason)."""
+
+    def __init__(self, interval_s: float, tick_fn: Callable, name: str) -> None:
+        self._stop = threading.Event()
+        stop, nm = self._stop, name
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    if tick_fn() is False:
+                        return
+                except Exception:
+                    logger.exception("periodic %s tick failed", nm)
+
+        self._thread = threading.Thread(target=loop, name=f"{nm}-tick", daemon=True)
+
+    def start(self) -> "PeriodicLoop":
+        self._thread.start()
+        return self
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
 
 # Backoff: without it a periodic loop would retry (and stack-trace-log) a
 # durably failing table every tick forever. Exponential, success clears.
@@ -78,37 +118,24 @@ class MaintenanceScheduler:
             max_workers=max(1, workers), thread_name_prefix=thread_prefix
         )
         self._closed = False
-        self._stop = threading.Event()
-        self._periodic: threading.Thread | None = None
+        self._periodic: PeriodicLoop | None = None
         self._backoff: dict[tuple[int, int], tuple[int, float]] = {}
 
     def start_periodic(self, interval_s: float, scan_fn: Callable) -> None:
-        """Background picking loop (ref: scheduler.rs — the scheduler
-        wakes on its own, not only on requests): every ``interval_s``,
-        ``scan_fn`` inspects tables and request()s work; a ``False``
-        return ends the loop (the instance-side weakref wrapper returns
-        it once its instance is collected). Idempotent; the thread dies
-        promptly on close(). The loop closure captures ONLY the stop
-        event — a strong ``self`` would chain thread -> scheduler ->
-        run_fn -> instance and pin an abandoned engine forever."""
+        """Background picking loop on the shared ``PeriodicLoop`` core:
+        every ``interval_s``, ``scan_fn`` inspects tables and request()s
+        work; a ``False`` return ends the loop (the instance-side weakref
+        wrapper returns it once its instance is collected). Idempotent;
+        the thread dies promptly on close(). The loop closure captures
+        ONLY the stop event and scan_fn — a strong ``self`` would chain
+        thread -> scheduler -> run_fn -> instance and pin an abandoned
+        engine forever."""
         with self._lock:
             if self._closed or self._periodic is not None:
                 return
-            stop = self._stop
-            kind = self._kind
-
-            def loop():
-                while not stop.wait(interval_s):
-                    try:
-                        if scan_fn() is False:
-                            return
-                    except Exception:
-                        logger.exception("periodic %s scan failed", kind)
-
-            self._periodic = threading.Thread(
-                target=loop, name=f"{self._kind}-tick", daemon=True
-            )
-            self._periodic.start()
+            self._periodic = PeriodicLoop(
+                interval_s, scan_fn, self._kind
+            ).start()
 
     def _update_depth_locked(self) -> None:
         self._m.depth.set(len(self._pending) + self._running)
@@ -154,11 +181,22 @@ class MaintenanceScheduler:
                 return False
             self._pending[key] = [waiter] if waiter is not None else []
             self._update_depth_locked()
-            self._executor.submit(self._run, key, table)
+            # The requester's context rides to the worker: the run's
+            # spans, ledger records and journal events (flush_dump /
+            # flush_install) then carry the triggering request's
+            # trace_id — same pattern as the io-pool context copies in
+            # engine/flush.py. A periodic-loop request has an empty
+            # context; that's the honest answer (no request caused it).
+            self._executor.submit(
+                self._run, key, table, contextvars.copy_context()
+            )
         self._m.accepted.inc()
         return True
 
-    def _run(self, key: tuple[int, int], table) -> None:
+    def _run(
+        self, key: tuple[int, int], table,
+        ctx: contextvars.Context | None = None,
+    ) -> None:
         # Release the dedupe slot BEFORE running: a request that arrives
         # while the work runs re-queues (the run may not cover state that
         # changed after its snapshot). Discarding after the run instead
@@ -170,7 +208,10 @@ class MaintenanceScheduler:
             self._running += 1
             self._update_depth_locked()
         try:
-            result = self._run_fn(table)
+            if ctx is not None:
+                result = ctx.run(self._run_fn, table)
+            else:
+                result = self._run_fn(table)
             with self._lock:
                 self._backoff.pop(key, None)
             for f in waiters:
@@ -244,9 +285,8 @@ class MaintenanceScheduler:
         with self._lock:
             self._closed = True
             periodic = self._periodic
-        self._stop.set()
         if periodic is not None:
-            periodic.join(timeout=5)
+            periodic.close(timeout=5)
         self._executor.shutdown(wait=True, cancel_futures=not wait)
         with self._lock:
             # Cancelled futures never ran _run; don't leave their pending

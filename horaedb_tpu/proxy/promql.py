@@ -523,6 +523,103 @@ def sql_str_literal(v: str) -> str:
     return "'" + str(v).replace("'", "''") + "'"
 
 
+def resolves_to_samples(conn, metric: str) -> bool:
+    """True when a selector on ``metric`` will evaluate against the
+    self-monitoring history table — exported so HTTP prom routing uses
+    the SAME predicate as evaluation (``_metric_table``) and the two
+    can't drift on where a metric resolves."""
+    from ..engine.metrics_recorder import SAMPLES_TABLE
+
+    return (
+        conn.catalog.open(metric) is None
+        and conn.catalog.open(SAMPLES_TABLE) is not None
+    )
+
+
+def _metric_table(conn, pq: PromQuery):
+    """Resolve a selector's metric to a table: the table of that name
+    when one exists, else the self-monitoring history table
+    ``system_metrics.samples`` with a pushed ``name = <metric>`` matcher
+    (engine/metrics_recorder) — so ``rate(horaedb_flush_rows_total[5m])``
+    works over the node's own stored telemetry even though no table named
+    ``horaedb_flush_rows_total`` exists. Returns ``(pq, table, inner)``
+    — ``pq`` rewritten when the fallback applied — with ``table=None``
+    when neither resolves. ``inner`` holds the caller's matchers on the
+    ORIGINAL family's labels (e.g. ``{protocol="http"}``), which the
+    samples table folds into its ``labels`` string tag: they must
+    post-filter series via ``_inner_match``, not push into the scan."""
+    table = conn.catalog.open(pq.metric)
+    if table is not None:
+        return pq, table, []
+    from ..engine.metrics_recorder import SAMPLES_TABLE
+
+    samples = conn.catalog.open(SAMPLES_TABLE)
+    if samples is None:
+        return pq, None, []
+    import dataclasses
+
+    sample_tags = set(samples.schema.tag_names)
+    inner = [m for m in pq.matchers if m[0] not in sample_tags]
+    pq = dataclasses.replace(
+        pq,
+        metric=SAMPLES_TABLE,
+        matchers=[m for m in pq.matchers if m[0] in sample_tags]
+        + [("name", "=", pq.metric)],
+    )
+    return pq, samples, inner
+
+
+def _parse_rendered_labels(s: str) -> dict:
+    """Inverse of utils.metrics._render_labels for the samples table's
+    folded ``labels`` tag: ``''`` or ``{k="v",...}`` with backslash,
+    quote, and newline escaped inside values."""
+    out: dict = {}
+    for m in re.finditer(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"', s or ""):
+        # single-pass unescape: ordered str.replace would mis-decode a
+        # literal backslash before 'n' (\\n -> backslash+LF)
+        out[m.group(1)] = re.sub(
+            r"\\(.)",
+            lambda e: "\n" if e.group(1) == "n" else e.group(1),
+            m.group(2),
+        )
+    return out
+
+
+def _expand_folded_keys(per_series: dict) -> dict:
+    """Samples-table fallback: lift each series' folded ``labels``
+    string into first-class key labels (dropping the redundant ``name``
+    — ``__name__`` already carries it), so downstream machinery —
+    aggregation BY an original label, binary-op join matching,
+    ``_histogram_quantile``'s ``le`` pop — sees the family's own labels
+    exactly as it would over a live scrape."""
+    out = {}
+    for key, pts in per_series.items():
+        kd = dict(key)
+        folded = _parse_rendered_labels(kd.pop("labels", ""))
+        kd.pop("name", None)
+        for k, v in folded.items():
+            kd.setdefault(k, v)  # the samples node label wins a collision
+        out[tuple(sorted(kd.items()))] = pts
+    return out
+
+
+def _inner_match(labels: dict, matchers: list[tuple[str, str, str]]) -> bool:
+    """Prom matcher semantics over a series' expanded label dict: an
+    absent label is the empty string (so ``{k=""}`` matches series
+    WITHOUT ``k``, and ``!=``/``!~`` pass on absent labels)."""
+    for label, op, val in matchers:
+        current = str(labels.get(label, ""))
+        if op == "=" and current != val:
+            return False
+        if op == "!=" and current == val:
+            return False
+        if op == "=~" and re.fullmatch(val, current) is None:
+            return False
+        if op == "!~" and re.fullmatch(val, current) is not None:
+            return False
+    return True
+
+
 def _value_column(schema) -> str:
     if schema.has_column("value"):
         return "value"
@@ -579,7 +676,9 @@ def _range_series(
     already stamped back), keyed by ((label, value), ...)."""
     if pq.at_ms is not None:
         return _at_series(conn, pq, start_ms, end_ms, step_ms)
-    table = conn.catalog.open(pq.metric)
+    _orig_metric = pq.metric
+    pq, table, inner_matchers = _metric_table(conn, pq)
+    fallback = table is not None and pq.metric != _orig_metric
     if table is None:
         return {}
     schema = table.schema
@@ -666,6 +765,16 @@ def _range_series(
             for key, pts in per_series.items()
             if _regex_match(dict(key), regex_matchers)
         }
+    if fallback:
+        # Lift the folded labels into real key labels, then apply the
+        # matchers on the original family's own labels.
+        per_series = _expand_folded_keys(per_series)
+        if inner_matchers:
+            per_series = {
+                key: pts
+                for key, pts in per_series.items()
+                if _inner_match(dict(key), inner_matchers)
+            }
     combined = per_series
 
     if pq.offset_ms:
@@ -1410,7 +1519,9 @@ def evaluate_instant(conn, pq: PromQuery, time_ms: int) -> list[dict]:
 def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
     """One raw fold per series over exactly (t-range, t] (after @/offset) —
     Prometheus's left-open window, matching _raw_window_series."""
-    table = conn.catalog.open(pq.metric)
+    orig_metric = pq.metric  # the fallback rewrite must not leak into __name__
+    pq, table, inner_matchers = _metric_table(conn, pq)
+    fallback = table is not None and pq.metric != orig_metric
     if table is None:
         return []
     schema = table.schema
@@ -1431,16 +1542,26 @@ def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
             where.append(f"{_q(label)} {'=' if op == '=' else '!='} '{sval}'")
     regex_matchers = [m for m in pq.matchers if m[1] in ("=~", "!~")]
     series = _series_scan(conn, pq, where, schema, value_col, tag_names)
+    if regex_matchers:
+        series = {
+            key: tv for key, tv in series.items()
+            if _regex_match(dict(key), regex_matchers)
+        }
+    if fallback:
+        series = _expand_folded_keys(series)
+        if inner_matchers:
+            series = {
+                key: tv for key, tv in series.items()
+                if _inner_match(dict(key), inner_matchers)
+            }
     out = []
     for key, tv in sorted(series.items()):
-        if regex_matchers and not _regex_match(dict(key), regex_matchers):
-            continue
         v = _fold_window(pq.func, pq.param, tv)
         if v is None:
             continue  # e.g. delta over a single sample: no output point
         out.append(
             {
-                "metric": {"__name__": pq.metric, **{l: x for l, x in key}},
+                "metric": {"__name__": orig_metric, **{l: x for l, x in key}},
                 "value": [time_ms / 1000.0, repr(float(v))],
             }
         )

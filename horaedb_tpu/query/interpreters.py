@@ -885,6 +885,13 @@ class InterpreterFactory:
                 if key in new:
                     merged[key] = new[key]
             table.alter_options(TableOptions.from_dict(merged))
+        from ..utils.events import record_event
+
+        record_event(
+            "ddl_alter_table", table=plan.table,
+            added_columns=len(plan.add_columns or ()),
+            set_options=sorted(plan.set_options or ()),
+        )
         return AffectedRows(0)
 
 

@@ -9,13 +9,17 @@ config.rs:176):
     GET  /metrics        Prometheus text
     GET  /route/{table}  routing info (standalone: self)
     GET  /debug/config   engine + server config dump
+    GET  /debug/status   node status document (uptime, shards, replay,
+                         scheduler queues, memtables, admission slots)
+    GET  /debug/events   engine event journal (?kind=, ?limit=)
     GET  /debug/tables   per-table metrics (memtable/sst bytes, seqs)
     GET  /debug/hotspot  hottest tables by reads/writes
     GET  /debug/workload live admission/dedup/quota state (wlm)
     PUT  /debug/slow_threshold/{seconds}  live slow-log threshold
     POST /admin/block    {"tables": [...]} / DELETE to unblock
     GET/POST/DELETE /admin/quota  per-tenant/table token buckets
-    GET  /health         liveness
+    GET  /health         liveness (?ready=1 -> readiness gate, 503 until
+                         WAL replay done / a shard opened)
 """
 
 from __future__ import annotations
@@ -36,6 +40,16 @@ from ..query.interpreters import AffectedRows
 from ..utils.metrics import REGISTRY
 
 logger = logging.getLogger("horaedb_tpu.server")
+
+
+def _query_flag(request, name: str) -> bool:
+    """Boolean query parameter: ``?x=1``/``true``/bare presence enable,
+    but an explicit ``?x=0``/``false``/``no`` does NOT — plain string
+    truthiness would treat ``?x=0`` as on."""
+    val = request.query.get(name)
+    if val is None:
+        return False
+    return val.strip().lower() not in ("0", "false", "no")
 
 DEFAULT_HTTP_PORT = 5440  # ref: config.rs:176
 
@@ -351,12 +365,19 @@ async def _auth_middleware(request: web.Request, handler):
 
 def create_app(
     conn: Connection, router=None, cluster=None, auth_token: str = "",
-    limits=None,
+    limits=None, observability=None, node: str = "standalone",
 ) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
     adds the /meta_event endpoints, meta-driven DDL, and write fencing.
     ``limits``: a config LimitsConfig for the workload manager's knobs
-    (admission slots/queue/deadline/memory budget, dedup)."""
+    (admission slots/queue/deadline/memory budget, dedup).
+    ``observability``: a config ObservabilitySection; when its
+    ``self_scrape`` is on, the node runs the self-monitoring recorder
+    (engine/metrics_recorder) that periodically writes its own metrics
+    registry into ``system_metrics.samples`` through the normal write
+    path, rows labeled ``node``."""
+    import time as _time
+
     proxy = Proxy(conn, limits=limits)
     app = web.Application(middlewares=[_auth_middleware])
     app["auth_token"] = auth_token
@@ -364,7 +385,74 @@ def create_app(
     app["proxy"] = proxy
     app["router"] = router
     app["cluster"] = cluster
+    app["node"] = node
+    app["started_at"] = _time.time()
     app.on_cleanup.append(_close_client_session)
+
+    recorder = None
+    if (observability is not None and observability.self_scrape
+            and cluster is not None):
+        # Coordinator mode: every node's fallback route for an unknown
+        # table is "local", so each recorder would create the samples
+        # table in the SHARED store and the sequential table-id counters
+        # would collide (catalog's documented standalone limitation).
+        # Guarded HERE, at construction, so every create_app caller
+        # (tests, embedders) inherits it — not only run_server.
+        logger.info(
+            "self-monitoring recorder disabled in coordinator mode "
+            "(table-id allocation is not meta-serialized for it yet)"
+        )
+    elif observability is not None and observability.self_scrape:
+        from ..engine.metrics_recorder import MetricsRecorder
+
+        recorder = MetricsRecorder(
+            conn,
+            interval_s=observability.self_scrape_interval_s,
+            retention_s=observability.self_metrics_retention_s,
+            node=node,
+            router=router,
+        )
+
+        async def _start_recorder(app_):
+            recorder.start()
+
+        async def _stop_recorder(app_):
+            recorder.close()
+
+        app.on_startup.append(_start_recorder)
+        app.on_cleanup.append(_stop_recorder)
+    app["metrics_recorder"] = recorder
+
+    # Readiness warmup: tables open (and replay their WAL) lazily, so a
+    # fresh node would report wal_replay_done=True before any replay
+    # ever started — open every LOCALLY-OWNED registered table in the
+    # background and gate readiness on completion. Standalone owns
+    # everything; static-cluster warms only tables the router places
+    # here (opening unowned tables would replay another node's WAL);
+    # coordinator mode skips — its shard machinery opens owned tables
+    # eagerly on shard assignment.
+    app["warmup_done"] = cluster is not None
+    if not app["warmup_done"]:
+        _warm_names = [
+            n for n in conn.catalog.table_names()
+            if router is None or router.route(n).is_local
+        ]
+        if not _warm_names:
+            app["warmup_done"] = True
+        else:
+            import threading as _threading
+
+            def _warm(names=_warm_names):
+                for nm in names:
+                    try:
+                        conn.catalog.open(nm)
+                    except Exception:
+                        logger.exception("readiness warmup: open %r failed", nm)
+                app["warmup_done"] = True
+
+            _threading.Thread(
+                target=_warm, name="wal-warmup", daemon=True
+            ).start()
 
     async def _close_proxy(app_):
         app_["proxy"].close()
@@ -480,6 +568,12 @@ def create_app(
         if forwarded is not None:
             return forwarded
         conn_ = request.app["conn"]
+        # ?nonblocking=1: shed instantly at the write-stall bound instead
+        # of blocking out the stall deadline — the contract forwarded
+        # self-scrape writes need (engine/metrics_recorder._forward): the
+        # 503 below IS the owner's stall shed, and the owner must not tie
+        # up an executor thread for a telemetry round it would shed anyway.
+        nonblocking = _query_flag(request, "nonblocking")
 
         def do_write():
             proxy.limiter.check(table)
@@ -488,9 +582,14 @@ def create_app(
             if t is None:
                 raise ValueError(f"table not found: {table}")
             from ..common_types.row_group import RowGroup
+            from ..engine.instance import nonblocking_backpressure
 
             rg = RowGroup.from_rows(t.schema, rows)
-            t.write(rg)
+            if nonblocking:
+                with nonblocking_backpressure():
+                    t.write(rg)
+            else:
+                t.write(rg)
             proxy.hotspot.record(table, True)
             return len(rg)
 
@@ -711,13 +810,27 @@ def create_app(
         # Expressions route on their leaf metrics: forwarding applies when
         # every leaf lives on the same (remote) node; mixed-owner
         # expressions evaluate here over the forwarding SQL layer.
+        def _prom_route_key(m: str) -> str:
+            # Self-monitoring fallback: a metric with no table of its
+            # own evaluates against system_metrics.samples — route on
+            # where THAT lives, using the same predicate evaluation
+            # applies so routing and evaluation cannot disagree.
+            from ..engine.metrics_recorder import SAMPLES_TABLE
+            from ..proxy.promql import resolves_to_samples
+
+            if resolves_to_samples(conn, m):
+                return SAMPLES_TABLE
+            return m
+
         metrics = leaf_metrics(pq)
-        if len(set(metrics)) == 1:
-            forwarded = await _forward_if_remote(request, metrics[0])
+        if len({_prom_route_key(m) for m in metrics}) == 1:
+            forwarded = await _forward_if_remote(
+                request, _prom_route_key(metrics[0])
+            )
             if forwarded is not None:
                 return forwarded
         elif router is not None and any(
-            not router.route(m).is_local for m in set(metrics)
+            not router.route(_prom_route_key(m)).is_local for m in set(metrics)
         ):
             # A multi-metric expression whose leaves live on different
             # nodes would need a cross-node vector join — evaluating it
@@ -793,8 +906,82 @@ def create_app(
             },
         )
 
+    def _node_ready() -> bool:
+        """Ready = the engine can serve: startup warmup finished (lazy
+        table opens would otherwise report replay 'done' before it ever
+        started), no WAL replay in flight, not closed — and in cluster
+        mode at least one shard opened (a node with zero shards serves
+        reads/forwards but isn't "ready" as a write target yet). Cheap
+        on purpose: probes fire every few seconds."""
+        if not app["warmup_done"] or not conn.instance.is_ready():
+            return False
+        return cluster is None or bool(cluster.debug_shard_info())
+
+    def _node_status() -> dict:
+        """One JSON document an operator (or k8s probe) reads first:
+        uptime, identity, shard set, WAL-replay progress, background
+        scheduler queue/backoff state, memtable pressure, admission
+        slots, and the self-monitoring recorder's state."""
+        import time as _time
+
+        engine = conn.instance.status()
+        adm = proxy.wlm.admission.snapshot()
+        shards = cluster.debug_shard_info() if cluster is not None else []
+        ready = _node_ready()
+        rec = app["metrics_recorder"]
+        return {
+            "status": "ok",
+            "ready": ready,
+            "uptime_s": round(_time.time() - app["started_at"], 3),
+            "node": app["node"],
+            "role": "cluster" if cluster is not None else (
+                "static-cluster" if router is not None else "standalone"
+            ),
+            "shard_count": len(shards),
+            "engine": engine,
+            "admission": {
+                "units_in_use": adm["units_in_use"],
+                "total_units": adm["total_units"],
+                "queue_depth": adm["queue_depth"],
+            },
+            "self_monitoring": rec.stats() if rec is not None else None,
+        }
+
     async def health(request: web.Request) -> web.Response:
-        return web.json_response({"status": "ok"})
+        """Liveness by default; ``?ready=1`` adds the readiness gate a
+        k8s readinessProbe wants: 503 until WAL replay finished (and, in
+        cluster mode, at least one shard opened)."""
+        if not _query_flag(request, "ready"):
+            return web.json_response({"status": "ok"})
+        ready = await asyncio.get_running_loop().run_in_executor(
+            None, _node_ready
+        )
+        body = {"status": "ok" if ready else "not_ready", "ready": ready}
+        return web.json_response(body, status=200 if ready else 503)
+
+    async def debug_status(request: web.Request) -> web.Response:
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, _node_status
+        )
+        return web.Response(text=_dumps(out), content_type="application/json")
+
+    async def debug_events(request: web.Request) -> web.Response:
+        """The engine event journal (utils/events): newest-bounded ring
+        of typed lifecycle events, each carrying the trace_id of the
+        request that caused it. ?kind= filters, ?limit= tails."""
+        from ..utils.events import EVENT_STORE
+
+        kind = request.query.get("kind")
+        limit = None
+        if "limit" in request.query:
+            try:
+                limit = int(request.query["limit"])
+            except ValueError:
+                return web.json_response({"error": "bad 'limit'"}, status=400)
+        return web.Response(
+            text=_dumps({"events": EVENT_STORE.list(kind=kind, limit=limit)}),
+            content_type="application/json",
+        )
 
     async def route(request: web.Request) -> web.Response:
         """One payload shape in both modes:
@@ -1250,6 +1437,8 @@ def create_app(
     app.router.add_get("/health", health)
     app.router.add_get("/route/{table}", route)
     app.router.add_get("/debug/config", debug_config)
+    app.router.add_get("/debug/status", debug_status)
+    app.router.add_get("/debug/events", debug_events)
     app.router.add_get("/debug/tables", debug_tables)
     app.router.add_get("/debug/hotspot", debug_hotspot)
     app.router.add_get("/debug/queries", debug_queries)
@@ -1425,12 +1614,26 @@ def run_server(
 
         conn.catalog.sub_table_resolver = resolve_sub
 
+    observability = (
+        config.observability if config is not None else None
+    )
+    if observability is None:
+        from ..utils.config import ObservabilitySection
+
+        observability = ObservabilitySection()
+    node = (
+        config.cluster.self_endpoint
+        if config is not None and config.cluster.enabled
+        else "standalone"
+    )
     app = create_app(
         conn,
         router=router,
         cluster=cluster,
         auth_token=(config.server.auth_token if config is not None else ""),
         limits=(config.limits if config is not None else None),
+        observability=observability,
+        node=node,
     )
     app["proxy"].slow_threshold_s = slow_threshold
 

@@ -10,7 +10,7 @@ wire protocol (HTTP SQL, MySQL, PostgreSQL) — works on them unchanged.
 Reads materialize a fresh RowGroup on every scan (the listing IS the
 current state).
 
-Four tables:
+Five tables:
 
 - ``system.public.tables``      — the catalog registry
 - ``system.public.query_stats`` — the bounded ring of finalized per-query
@@ -22,6 +22,12 @@ Four tables:
   (admission slots/queues, dedup flights, quota buckets) plus every
   ``horaedb_admission_*`` counter, as (category, name, label, value)
   rows — the SQL face of /debug/workload
+- ``system.public.events``      — the engine event journal
+  (utils/events.EVENT_STORE): typed lifecycle events (flush freeze/dump/
+  install, compaction, write-stall enter/exit, sheds, WAL replay, DDL,
+  shard freeze/thaw), each carrying the trace_id of the request that
+  caused it — joinable against query_stats.request_id and the
+  /debug/trace store
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ TABLES_NAME = "system.public.tables"
 QUERY_STATS_NAME = "system.public.query_stats"
 METRICS_NAME = "system.public.metrics"
 WORKLOAD_NAME = "system.public.workload"
+EVENTS_NAME = "system.public.events"
 
 
 class _VirtualTable(Table):
@@ -356,6 +363,69 @@ class WorkloadTable(_VirtualTable):
         )
 
 
+_EVENTS_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("seq", DatumKind.UINT64, is_nullable=False),
+        ColumnSchema("kind", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("table_name", DatumKind.STRING),
+        ColumnSchema("trace_id", DatumKind.UINT64),
+        ColumnSchema("attrs", DatumKind.STRING),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "seq"],
+)
+
+
+class EventsTable(_VirtualTable):
+    """``system.public.events``: the engine event journal as rows.
+
+    ``attrs`` is the event's attribute dict rendered as sorted-key JSON
+    (utils/events.render_attrs); ``trace_id`` is 0 when the event fired
+    outside any traced request (periodic scans, lease watch)."""
+
+    @property
+    def name(self) -> str:
+        return EVENTS_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _EVENTS_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..utils.events import EVENT_STORE, render_attrs
+
+        entries = EVENT_STORE.list()
+
+        def tid(e) -> int:
+            # embedded callers may trace with non-integer ids; the
+            # UINT64 column coerces those to 0 rather than failing scans
+            try:
+                return int(e["trace_id"] or 0)
+            except (TypeError, ValueError):
+                return 0
+
+        return RowGroup(
+            _EVENTS_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [e["timestamp"] for e in entries], dtype=np.int64
+                ),
+                "seq": np.array([e["seq"] for e in entries], dtype=np.uint64),
+                "kind": np.array([e["kind"] for e in entries], dtype=object),
+                "table_name": np.array(
+                    [e["table"] for e in entries], dtype=object
+                ),
+                "trace_id": np.array(
+                    [tid(e) for e in entries], dtype=np.uint64
+                ),
+                "attrs": np.array(
+                    [render_attrs(e["attrs"]) for e in entries], dtype=object
+                ),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -368,4 +438,6 @@ def open_system_table(catalog, name: str):
         return MetricsTable()
     if low == WORKLOAD_NAME:
         return WorkloadTable()
+    if low == EVENTS_NAME:
+        return EventsTable()
     return None

@@ -14,6 +14,8 @@ Commands:
     config                  server config dump
     hotspot                 hottest tables by reads/writes
     diagnose                health + config + table summary in one shot
+    status                  node status document (/debug/status)
+    events tail [--kind K] [--limit N]   engine event journal
 
 Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
 
@@ -180,6 +182,41 @@ def cmd_procedures(ep: str, args) -> None:
     print(_get(args.meta, "/meta/v1/procedures"))
 
 
+def cmd_status(ep: str, args) -> None:
+    """The /debug/status document, flattened one key per line — the
+    first thing an operator reads on a node."""
+    data = json.loads(_get(ep, "/debug/status"))
+
+    def walk(prefix: str, v) -> None:
+        if isinstance(v, dict):
+            for k in sorted(v):
+                walk(f"{prefix}.{k}" if prefix else k, v[k])
+        else:
+            print(f"{prefix}: {v}")
+
+    walk("", data)
+
+
+def cmd_events(ep: str, args) -> None:
+    """Tail the engine event journal (/debug/events)."""
+    qs = f"?limit={args.limit}"
+    if args.kind:
+        qs += f"&kind={args.kind}"
+    data = json.loads(_get(ep, f"/debug/events{qs}"))
+    rows = [
+        {
+            "seq": e["seq"],
+            "timestamp": e["timestamp"],
+            "kind": e["kind"],
+            "table": e["table"],
+            "trace_id": e["trace_id"] if e["trace_id"] is not None else "",
+            "attrs": json.dumps(e["attrs"], sort_keys=True),
+        }
+        for e in data["events"]
+    ]
+    _print_rows(rows)
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -207,6 +244,11 @@ def main(argv=None) -> int:
     sub.add_parser("config")
     sub.add_parser("hotspot")
     sub.add_parser("diagnose")
+    sub.add_parser("status")
+    ev = sub.add_parser("events")
+    ev.add_argument("action", nargs="?", default="tail", choices=["tail"])
+    ev.add_argument("--kind", default=None)
+    ev.add_argument("--limit", type=int, default=20)
     sub.add_parser("shards")
     sub.add_parser("wal_stats")
     sub.add_parser("slow_log")

@@ -29,6 +29,11 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     admission_memory_budget = "1gb"  # working-set budget for admits
     dedup = true                      # single-flight identical reads
 
+    [observability]
+    self_scrape = true                # node scrapes its own registry
+    self_scrape_interval = "10s"      # into system_metrics.samples
+    self_metrics_retention = "24h"    # 0s = keep forever
+
 Env overrides: HORAEDB_HTTP_PORT, HORAEDB_HOST, HORAEDB_DATA_DIR.
 """
 
@@ -162,6 +167,18 @@ class LimitsConfig:
 
 
 @dataclass
+class ObservabilitySection:
+    """Self-monitoring (engine/metrics_recorder): the node periodically
+    snapshots its own metrics registry into the real time-series table
+    ``system_metrics.samples`` through the normal write path, bounded by
+    ``self_metrics_retention`` (0 = unbounded)."""
+
+    self_scrape: bool = True
+    self_scrape_interval_s: float = 10.0
+    self_metrics_retention_s: float = 24 * 3600.0
+
+
+@dataclass
 class ClusterSection:
     enabled: bool = False
     self_endpoint: str = ""
@@ -195,6 +212,9 @@ class Config:
     server: ServerConfig = field(default_factory=ServerConfig)
     engine: EngineSection = field(default_factory=EngineSection)
     limits: LimitsConfig = field(default_factory=LimitsConfig)
+    observability: ObservabilitySection = field(
+        default_factory=ObservabilitySection
+    )
     cluster: ClusterSection = field(default_factory=ClusterSection)
     s3: S3Section = field(default_factory=S3Section)
 
@@ -228,6 +248,9 @@ _KNOWN = {
     "limits": {
         "slow_threshold", "admission_slots", "admission_queue_depth",
         "admission_deadline", "admission_memory_budget", "dedup",
+    },
+    "observability": {
+        "self_scrape", "self_scrape_interval", "self_metrics_retention",
     },
     "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
     "s3": {
@@ -318,6 +341,23 @@ def _apply(cfg: Config, raw: dict) -> None:
         if not isinstance(l["dedup"], bool):
             raise ConfigError("limits.dedup must be a boolean")
         cfg.limits.dedup = l["dedup"]
+    o = raw.get("observability", {})
+    if "self_scrape" in o:
+        if not isinstance(o["self_scrape"], bool):
+            raise ConfigError("observability.self_scrape must be a boolean")
+        cfg.observability.self_scrape = o["self_scrape"]
+    if "self_scrape_interval" in o:
+        cfg.observability.self_scrape_interval_s = (
+            parse_duration_ms(o["self_scrape_interval"]) / 1000.0
+        )
+        if cfg.observability.self_scrape_interval_s <= 0:
+            raise ConfigError(
+                "observability.self_scrape_interval must be positive"
+            )
+    if "self_metrics_retention" in o:
+        cfg.observability.self_metrics_retention_s = (
+            parse_duration_ms(o["self_metrics_retention"]) / 1000.0
+        )
     s3 = raw.get("s3", {})
     if s3:
         for k in ("bucket", "endpoint", "region", "access_key", "secret_key",

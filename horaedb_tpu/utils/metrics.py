@@ -44,16 +44,23 @@ class Counter:
         with self._lock:
             self._value += amount
 
+    def snapshot(self) -> float:
+        """The value, read under the lock — the one way scrapes and the
+        self-monitoring recorder read a counter/gauge, so a read racing
+        ``inc()`` can never observe a torn update."""
+        with self._lock:
+            return self._value
+
     @property
     def value(self) -> float:
-        return self._value
+        return self.snapshot()
 
     def expose_parts(self) -> tuple[str, str]:
         header = (
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} {self.TYPE}\n"
         )
-        body = f"{self.name}{_render_labels(self.labels)} {self._value}\n"
+        body = f"{self.name}{_render_labels(self.labels)} {self.snapshot()}\n"
         return header, body
 
     def expose(self) -> str:
@@ -95,15 +102,21 @@ class Histogram:
             self._sum += v
             self._total += 1
 
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """``(bucket_counts, sum, count)`` under the lock — a consistent
+        view (buckets sum to count) for scrapes, the system tables, and
+        the self-monitoring recorder."""
+        with self._lock:
+            return list(self._counts), self._sum, self._total
+
     @property
     def count(self) -> int:
-        return self._total
+        with self._lock:
+            return self._total
 
     def expose_parts(self) -> tuple[str, str]:
-        with self._lock:  # consistent snapshot: buckets must sum to count
-            counts = list(self._counts)
-            total = self._total
-            sum_ = self._sum
+        # consistent snapshot: buckets must sum to count
+        counts, sum_, total = self.snapshot()
         header = (
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} histogram\n"

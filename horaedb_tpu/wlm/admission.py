@@ -274,6 +274,9 @@ class AdmissionController:
 
     def _shed(self, cls: str, reason: str, msg: str) -> OverloadedError:
         self._shed_counter(cls, reason).inc()
+        from ..utils.events import record_event
+
+        record_event("admission_shed", **{"class": cls, "reason": reason})
         return OverloadedError(msg, reason=reason, retry_after_s=1.0)
 
     @contextmanager

@@ -189,6 +189,13 @@ class QuotaManager:
 
         def reject(scope: str, name: str, wait: float) -> QuotaExceededError:
             self._m_rejected[kind].inc()
+            from ..utils.events import record_event
+
+            record_event(
+                "quota_reject",
+                table=name if scope == "table" else "",
+                scope=scope, name=name, quota_kind=kind,
+            )
             return QuotaExceededError(
                 f"{kind} quota exceeded for {scope} {name!r}; "
                 f"retry in {min(wait, 60.0):.2f}s",

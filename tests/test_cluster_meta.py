@@ -164,11 +164,22 @@ class TestMetaCluster:
         meta_port, (port_a, port_b), procs, spawn_node = cluster
 
         # --- shards spread over both nodes ---------------------------------
-        shards = wait_until(
-            lambda: shards_all_assigned(meta_port), desc="shard assignment"
-        )
+        # "all assigned" converges before "spread": when one node
+        # registers a beat earlier (common under full-suite load), the
+        # static scheduler gives it EVERY shard and the rebalance loop
+        # moves them over one tick at a time — so wait for the spread,
+        # not just for assignment.
+        expected = {f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"}
+
+        def spread():
+            shards = shards_all_assigned(meta_port)
+            if shards and {s["node"] for s in shards} == expected:
+                return shards
+            return None
+
+        shards = wait_until(spread, desc="shards spread over both nodes")
         nodes_used = {s["node"] for s in shards}
-        assert nodes_used == {f"127.0.0.1:{port_a}", f"127.0.0.1:{port_b}"}
+        assert nodes_used == expected
 
         # --- create tables through a data node (meta picks placement) ------
         for name in ("t0", "t1", "t2", "t3"):

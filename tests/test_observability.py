@@ -950,3 +950,123 @@ class TestMetricsNameLint:
         ):
             assert f"# TYPE {family}" in text, family
         assert REGISTRY.histogram("horaedb_wal_append_duration_seconds").count > 0
+
+
+class TestEventKindLint:
+    """PR-5 lint extension (same contract as the family registries):
+    every event kind declared in utils/events.EVENT_KINDS must (a) have
+    an eagerly-registered ``horaedb_events_total{kind=...}`` counter,
+    (b) round-trip through system.public.events, and (c) be documented
+    in docs/OBSERVABILITY.md — and every kind string at a
+    ``record_event("...")`` emit site anywhere in the source tree must
+    be declared (an undeclared kind also fails loudly at runtime)."""
+
+    def test_kinds_have_counters_rows_and_docs(self):
+        import os
+
+        from horaedb_tpu.table_engine.system import EventsTable
+        from horaedb_tpu.utils.events import (
+            EVENT_KINDS,
+            EVENT_STORE,
+            record_event,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        docs = open(
+            os.path.join(os.path.dirname(__file__), "..", "docs",
+                         "OBSERVABILITY.md")
+        ).read()
+        members = REGISTRY.families().get("horaedb_events_total", [])
+        labeled = {m.labels.get("kind") for m in members}
+        missing = []
+        for kind in EVENT_KINDS:
+            if kind not in labeled:
+                missing.append(f"{kind}: no horaedb_events_total counter")
+            if f"`{kind}`" not in docs:
+                missing.append(f"{kind}: undocumented in OBSERVABILITY.md")
+        # stray labeled counters (a kind removed from the registry but
+        # still minting a series) fail too
+        for kind in labeled - set(EVENT_KINDS):
+            missing.append(f"{kind}: counter live but kind undeclared")
+        assert "`horaedb_events_total`" in docs
+        assert not missing, missing
+
+        # every declared kind round-trips through the virtual table
+        EVENT_STORE.clear()
+        try:
+            for kind in EVENT_KINDS:
+                record_event(kind, table="lint")
+            rg = EventsTable()._materialize()
+            assert set(rg.columns["kind"]) == set(EVENT_KINDS)
+            assert list(rg.columns["table_name"]) == ["lint"] * len(EVENT_KINDS)
+        finally:
+            EVENT_STORE.clear()
+
+    def test_undeclared_kind_rejected(self):
+        from horaedb_tpu.utils.events import record_event
+
+        with pytest.raises(ValueError, match="undeclared event kind"):
+            record_event("not_a_kind", table="x")
+
+    def test_all_emit_sites_use_declared_kinds(self):
+        """Source scan: every literal first argument to record_event()
+        in the package must be a declared kind — a new emit site cannot
+        mint a category no dashboard knows about."""
+        import os
+        import re
+
+        from horaedb_tpu.utils.events import EVENT_KINDS
+
+        pkg = os.path.join(os.path.dirname(__file__), "..", "horaedb_tpu")
+        pat = re.compile(r"""record_event\(\s*["']([a-z_]+)["']""")
+        undeclared = []
+        for dirpath, _dirs, files in os.walk(pkg):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                src = open(os.path.join(dirpath, fn)).read()
+                for kind in pat.findall(src):
+                    if kind not in EVENT_KINDS:
+                        undeclared.append(f"{fn}: {kind}")
+        assert not undeclared, undeclared
+
+    def test_self_monitoring_families_declared_and_documented(self):
+        """The recorder's own families follow the same registry
+        discipline: declared in SELF_MONITORING_METRIC_FAMILIES,
+        registered live, convention-clean, documented — and no stray
+        horaedb_self_* family exists outside the declared list. The
+        [observability] knobs must be documented in WORKLOAD.md (the
+        operator-knob index) as well as OBSERVABILITY.md."""
+        import os
+        import re
+
+        from horaedb_tpu.engine.metrics_recorder import (
+            SELF_MONITORING_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        missing = []
+        for fam in SELF_MONITORING_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for fam in families:
+            if fam.startswith("horaedb_self_") and \
+                    fam not in SELF_MONITORING_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("self_scrape", "self_scrape_interval",
+                     "self_metrics_retention"):
+            for name, text in (("OBSERVABILITY.md", docs),
+                               ("WORKLOAD.md", wdocs)):
+                if f"`{knob}`" not in text:
+                    missing.append(f"{knob}: undocumented in {name}")
+        assert not missing, missing

@@ -1,0 +1,669 @@
+"""Self-monitoring pipeline tests (PR-5 acceptance): the recorder writes
+the node's own metrics registry into the REAL table
+``system_metrics.samples`` through the normal write path (SQL + PromQL
+queryable, retention-bounded), and the engine event journal surfaces as
+``system.public.events`` on all three wire protocols with trace_id
+cross-links — without ever deadlocking or stalling behind the flush
+machinery it measures."""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.db import Connection
+from horaedb_tpu.engine.instance import EngineConfig
+from horaedb_tpu.engine.metrics_recorder import SAMPLES_TABLE, MetricsRecorder
+from horaedb_tpu.proxy.promql import evaluate_instant, parse_promql
+from horaedb_tpu.server import create_app
+from horaedb_tpu.server.mysql import MysqlServer
+from horaedb_tpu.server.postgres import PostgresServer
+from horaedb_tpu.utils.events import EVENT_STORE
+from horaedb_tpu.utils.object_store import MemoryStore
+from horaedb_tpu.utils.tracectx import TRACE_STORE, finish_trace, start_trace
+
+# raw byte-level protocol clients + subprocess-node helpers
+from test_flush_pipeline import GatedSstStore
+from test_remote_engine import CPU_ENV, free_port, http, sql  # noqa: F401
+from test_wire_protocols import MyClient, PgClient
+
+
+class TestRecorderWritesRows:
+    """Leg 1: scrape rounds land as real rows, SQL- and PromQL-visible."""
+
+    @pytest.fixture()
+    def db(self):
+        conn = horaedb_tpu.connect(None)
+        yield conn
+        conn.close()
+
+    def test_two_rounds_sql_queryable(self, db):
+        rec = MetricsRecorder(db, interval_s=10.0, node="n1")
+        now = int(time.time() * 1000)
+        n1 = rec.run_once(now_ms=now - 1000)
+        n2 = rec.run_once(now_ms=now)
+        assert n1 > 0 and n2 > 0 and rec.rounds == 2
+
+        out = db.execute(
+            "SELECT ts, name, labels, node, value FROM system_metrics.samples "
+            "WHERE name = 'horaedb_self_scrape_rows_total'"
+        ).to_pylist()
+        assert len(out) == 2, out  # one row per scrape round
+        assert {r["node"] for r in out} == {"n1"}
+        assert {r["ts"] for r in out} == {now - 1000, now}
+        # the second round sees the first round's own write accounted
+        assert out[-1]["value"] >= 0.0
+
+    def test_histograms_decompose_into_bucket_sum_count(self, db):
+        rec = MetricsRecorder(db, interval_s=10.0, node="n1")
+        rec.run_once(now_ms=int(time.time() * 1000))
+        names = {
+            r["name"]
+            for r in db.execute(
+                "SELECT name FROM system_metrics.samples"
+            ).to_pylist()
+        }
+        fam = "horaedb_self_scrape_duration_seconds"
+        assert {f"{fam}_bucket", f"{fam}_sum", f"{fam}_count"} <= names
+        # bucket rows fold le into the label string; cumulative +Inf == count
+        buckets = db.execute(
+            f"SELECT labels, value FROM system_metrics.samples "
+            f"WHERE name = '{fam}_bucket'"
+        ).to_pylist()
+        inf = [r for r in buckets if 'le="+Inf"' in r["labels"]]
+        count = db.execute(
+            f"SELECT value FROM system_metrics.samples "
+            f"WHERE name = '{fam}_count'"
+        ).to_pylist()
+        assert inf and count and inf[0]["value"] == count[0]["value"]
+
+    def test_promql_resolves_family_against_samples_table(self, db):
+        """No table named horaedb_self_scrape_rows_total exists — the
+        selector falls back to system_metrics.samples with a pushed
+        name matcher, and __name__ stays the family."""
+        rec = MetricsRecorder(db, interval_s=10.0, node="n1")
+        now = int(time.time() * 1000)
+        rec.run_once(now_ms=now - 1000)
+        rec.run_once(now_ms=now)
+
+        res = evaluate_instant(
+            db, parse_promql("horaedb_self_scrape_rows_total"), now
+        )
+        assert res, "instant selector found no series in samples history"
+        assert res[0]["metric"]["__name__"] == "horaedb_self_scrape_rows_total"
+        assert res[0]["metric"]["node"] == "n1"
+
+        # >= 2 scrape rounds visible through a range fold
+        res = evaluate_instant(
+            db,
+            parse_promql("count_over_time(horaedb_self_scrape_rows_total[5m])"),
+            now,
+        )
+        assert res and float(res[0]["value"][1]) >= 2.0
+
+    def test_promql_matchers_on_folded_labels(self, db):
+        """Matchers on the ORIGINAL family's labels (folded into the
+        samples table's ``labels`` string) filter series instead of
+        raising 'unknown label': ``horaedb_events_total{kind=...}``
+        selects exactly the matching series over stored history."""
+        rec = MetricsRecorder(db, interval_s=10.0, node="n1")
+        now = int(time.time() * 1000)
+        rec.run_once(now_ms=now)
+
+        res = evaluate_instant(
+            db, parse_promql('horaedb_events_total{kind="flush_install"}'),
+            now,
+        )
+        assert res, "label-matched fallback selector found no series"
+        # folded labels are lifted into first-class output labels
+        assert all(r["metric"]["kind"] == "flush_install" for r in res)
+        assert res[0]["metric"]["__name__"] == "horaedb_events_total"
+
+        # regex matcher, same path
+        res = evaluate_instant(
+            db,
+            parse_promql('horaedb_events_total{kind=~"flush_.*"}'),
+            now,
+        )
+        kinds = {r["metric"]["kind"] for r in res}
+        assert kinds and all(k.startswith("flush_") for k in kinds)
+
+        # a label no series carries -> empty, not an error
+        assert evaluate_instant(
+            db, parse_promql('horaedb_events_total{kind="no_such_kind"}'),
+            now,
+        ) == []
+
+    def test_promql_histogram_quantile_over_history(self, db):
+        """The folded ``le`` lifts into a real label, so
+        histogram_quantile over stored _bucket rows works like it does
+        over a live scrape."""
+        rec = MetricsRecorder(db, interval_s=10.0, node="n1")
+        now = int(time.time() * 1000)
+        rec.run_once(now_ms=now - 1000)
+        rec.run_once(now_ms=now)  # the scrape histogram has 2 samples
+
+        from horaedb_tpu.proxy.promql import evaluate_expr_instant
+
+        res = evaluate_expr_instant(
+            db,
+            parse_promql(
+                "histogram_quantile(0.9, "
+                "horaedb_self_scrape_duration_seconds_bucket)"
+            ),
+            now,
+        )
+        assert res, "quantile over stored buckets returned no series"
+        assert float(res[0]["value"][1]) >= 0.0
+
+    def test_retention_config_change_wins_over_existing_table_ttl(self, db):
+        """A restart with a different self_metrics_retention must re-apply
+        the TTL to the already-created samples table — otherwise the knob
+        is silently ignored forever (including 0 = keep forever, which
+        must also stop the regular compaction's TTL drop)."""
+        rec = MetricsRecorder(db, interval_s=10.0, retention_s=3600.0,
+                              node="n1")
+        rec.run_once(now_ms=int(time.time() * 1000))
+        td = db.catalog.open(SAMPLES_TABLE).physical_datas()[0]
+        assert td.options.enable_ttl and td.options.ttl_ms == 3600_000
+
+        rec2 = MetricsRecorder(db, interval_s=10.0, retention_s=7200.0,
+                               node="n1")
+        rec2.run_once(now_ms=int(time.time() * 1000))
+        td = db.catalog.open(SAMPLES_TABLE).physical_datas()[0]
+        assert td.options.ttl_ms == 7200_000
+
+        rec3 = MetricsRecorder(db, interval_s=10.0, retention_s=0.0,
+                               node="n1")
+        rec3.run_once(now_ms=int(time.time() * 1000))
+        td = db.catalog.open(SAMPLES_TABLE).physical_datas()[0]
+        assert not td.options.enable_ttl
+
+    def test_parse_rendered_labels_roundtrip(self):
+        """The folded-labels parser must invert _render_labels exactly,
+        including a literal backslash before 'n' (ordered str.replace
+        would decode it to backslash+newline)."""
+        from horaedb_tpu.proxy.promql import _parse_rendered_labels
+        from horaedb_tpu.utils.metrics import _render_labels
+
+        for labels in (
+            {"path": "C:\\new"},
+            {"q": 'say "hi"', "nl": "a\nb"},
+            {"k": "plain", "z": ""},
+        ):
+            assert _parse_rendered_labels(_render_labels(labels)) == labels
+        assert _parse_rendered_labels("") == {}
+
+    def test_retention_prunes_expired_rows(self, db):
+        rec = MetricsRecorder(db, interval_s=10.0, retention_s=3600.0,
+                              node="n1")
+        t0 = int(time.time() * 1000)
+        rec.run_once(now_ms=t0)
+        assert db.execute(
+            "SELECT value FROM system_metrics.samples"
+        ).to_pylist()
+        # 12h later every SST bucket (2h segments, 1h ttl) is expired:
+        # the sweep flushes buffered rows then drops the files whole.
+        dropped = rec.enforce_retention(now_ms=t0 + 12 * 3600 * 1000)
+        assert dropped >= 1 and rec.retention_dropped == dropped
+        assert db.execute(
+            "SELECT value FROM system_metrics.samples"
+        ).to_pylist() == []
+        kinds = [e["kind"] for e in EVENT_STORE.list()]
+        assert "self_retention" in kinds
+
+
+class TestRecorderBackpressure:
+    """The recorder must never block behind (or deadlock) the flush it
+    measures: at the write-stall bound its writes shed IMMEDIATELY with
+    the typed retryable error, the loop backs off, and the next round
+    after the flush completes succeeds."""
+
+    def _stalled_conn(self, gate):
+        conn = Connection(
+            GatedSstStore(MemoryStore(), gate),
+            config=EngineConfig(
+                write_stall_immutable_count=1,
+                write_stall_immutable_bytes=1,
+                write_stall_deadline_s=10.0,
+                compaction_l0_trigger=10**9,
+                compaction_interval_s=0,
+            ),
+        )
+        return conn
+
+    def test_scrape_sheds_instantly_then_recovers(self):
+        from horaedb_tpu.wlm.admission import OverloadedError
+
+        gate = threading.Event()
+        conn = self._stalled_conn(gate)
+        try:
+            rec = MetricsRecorder(conn, interval_s=0.2, node="n1")
+            rec.run_once()  # creates the table, first round lands
+            table = conn.catalog.open(SAMPLES_TABLE)
+            td = table.physical_datas()[0]
+            td.version.switch_memtable()  # one frozen memtable: at bound
+            conn.instance.request_flush(td)
+            assert td.version.immutable_stats()[0] >= 1
+
+            # The stall deadline is 10s; a blocking writer would sit in
+            # the wait loop. The recorder's nonblocking write sheds NOW.
+            t0 = time.perf_counter()
+            with pytest.raises(OverloadedError) as ei:
+                rec.run_once()
+            elapsed = time.perf_counter() - t0
+            assert ei.value.reason == "write_stall"
+            assert elapsed < 5.0, (
+                f"nonblocking self-scrape write took {elapsed:.1f}s — it "
+                "blocked on the stall bound instead of shedding"
+            )
+
+            # tick() turns the shed into bookkeeping: skip + backoff +
+            # journal event, never an exception out of the loop.
+            rec.tick()
+            assert rec.skipped == 1
+            assert rec.stats()["backoff_s"] > 0
+            skips = [
+                e for e in EVENT_STORE.list(kind="self_scrape_skipped")
+                if e["attrs"].get("reason") == "write_stall"
+            ]
+            assert skips, "shed round not journaled"
+
+            # Release the flush the recorder was measuring: it completes
+            # (no deadlock), the bound clears, and the next round lands.
+            gate.set()
+            deadline = time.monotonic() + 15
+            while td.version.immutable_stats()[0] > 0:
+                assert time.monotonic() < deadline, "flush never completed"
+                time.sleep(0.05)
+            assert rec.run_once() > 0
+            assert rec.rounds == 2
+        finally:
+            gate.set()
+            conn.close()
+
+    def test_repeated_sheds_escalate_backoff(self):
+        """Sustained write stall: every shed round must GROW the backoff
+        (and skip the retention sweep — it would flush into the very
+        stall the write just shed from) instead of resetting to the
+        2x-interval floor forever."""
+        from horaedb_tpu.wlm.admission import OverloadedError
+
+        conn = horaedb_tpu.connect(None)
+        try:
+            rec = MetricsRecorder(conn, interval_s=0.2, node="n1")
+
+            def stalled(*a, **kw):
+                raise OverloadedError("stalled", reason="write_stall")
+
+            rec.run_once = stalled
+            sweeps = []
+            rec.enforce_retention = lambda *a, **kw: sweeps.append(1)
+            rec._last_retention = -10**9  # a sweep is overdue every tick
+            delays = []
+            for _ in range(4):
+                rec._backoff_until = 0.0  # admit the next tick
+                rec.tick()
+                delays.append(rec.stats()["backoff_s"])
+            assert rec._fails == 4 and rec.skipped == 4
+            assert delays == sorted(delays) and delays[-1] > delays[0], (
+                f"backoff never escalated: {delays}"
+            )
+            assert not sweeps, "retention swept during a shed round"
+        finally:
+            conn.close()
+
+    def test_tick_survives_write_failures_with_backoff(self):
+        conn = horaedb_tpu.connect(None)
+        try:
+            rec = MetricsRecorder(conn, interval_s=0.2, node="n1")
+            rec.run_once()
+            conn.catalog.drop_table(SAMPLES_TABLE)
+
+            def broken(*a, **kw):
+                raise RuntimeError("store unavailable")
+
+            rec._ensure_table = broken
+            rec.tick()  # must swallow, count, and back off
+            rec.tick()  # inside the backoff window: no second attempt
+            assert rec.skipped == 1
+            assert rec._fails == 1
+            assert rec.stats()["backoff_s"] > 0
+        finally:
+            conn.close()
+
+
+class TestEventsAllWires:
+    """system.public.events: a flush cycle's freeze/dump/install events,
+    with the requester's trace_id, visible over HTTP SQL, MySQL and PG."""
+
+    EVENTS_SQL = (
+        "SELECT kind, table_name, trace_id FROM system.public.events"
+    )
+    TRACE_ID = 271828
+
+    @pytest.fixture()
+    def db(self):
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE evt (h string TAG, v double, ts timestamp NOT "
+            "NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+            "WITH (segment_duration='1h')"
+        )
+        conn.execute("INSERT INTO evt (h, v, ts) VALUES ('a', 1.0, 100)")
+        # flush under an explicit trace: the scheduler copies the
+        # requester's context onto the worker, so freeze/dump/install
+        # all carry this trace_id and cross-link to the stored trace.
+        _trace, handle = start_trace(self.TRACE_ID, "flush-evt")
+        try:
+            conn.flush_all()
+        finally:
+            finish_trace(handle)
+        yield conn
+        conn.close()
+
+    def _check(self, dicts):
+        cycle = {
+            r["kind"]: r for r in dicts if r["table_name"] == "evt"
+        }
+        assert {"flush_freeze", "flush_dump", "flush_install"} <= set(cycle), (
+            f"flush cycle incomplete on this wire: {sorted(cycle)}"
+        )
+        for kind in ("flush_freeze", "flush_dump", "flush_install"):
+            assert int(cycle[kind]["trace_id"]) == self.TRACE_ID, cycle[kind]
+
+    def test_http_mysql_and_pg_see_flush_cycle(self, db):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        assert TRACE_STORE.get(self.TRACE_ID) is not None, (
+            "events' trace_id must link to a stored trace"
+        )
+
+        def my_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            kind, names, rows = c.query(self.EVENTS_SQL)
+            s.close()
+            assert kind == "rows", rows
+            self._check([dict(zip(names, r)) for r in rows])
+
+        def pg_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            names, rows, _complete, err = c.query(self.EVENTS_SQL)
+            s.close()
+            assert err is None, err
+            self._check([dict(zip(names, r)) for r in rows])
+
+        async def body():
+            app = create_app(db)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            gw = app["sql_gateway"]
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                out = await client.post(
+                    "/sql", json={"query": self.EVENTS_SQL}
+                )
+                assert out.status == 200
+                self._check((await out.json())["rows"])
+
+                # the /debug/events face of the same ring
+                out = await client.get(
+                    "/debug/events", params={"kind": "flush_install"}
+                )
+                assert out.status == 200
+                evs = (await out.json())["events"]
+                assert any(
+                    e["table"] == "evt" and e["trace_id"] == self.TRACE_ID
+                    for e in evs
+                )
+
+                await loop.run_in_executor(None, my_client, my.port)
+                await loop.run_in_executor(None, pg_client, pg.port)
+            finally:
+                await my.stop()
+                await pg.stop()
+                await client.close()
+
+        asyncio.run(body())
+
+
+class TestEventStoreBounds:
+    def test_limit_zero_returns_nothing(self):
+        """limit=0 must mean zero entries, not 'no limit' (out[-0:] is
+        the whole list)."""
+        from horaedb_tpu.utils.events import record_event
+
+        EVENT_STORE.clear()
+        try:
+            record_event("flush_freeze", table="b0")
+            assert EVENT_STORE.list(limit=0) == []
+            assert EVENT_STORE.list(limit=-1) == []  # clamped, not "all"
+            assert len(EVENT_STORE.list(limit=1)) == 1
+            assert len(EVENT_STORE.list()) == 1
+        finally:
+            EVENT_STORE.clear()
+
+
+class TestStatusAndReadiness:
+    def test_debug_status_document(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        conn = horaedb_tpu.connect(None)
+
+        async def body():
+            app = create_app(conn)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                out = await client.get("/debug/status")
+                assert out.status == 200
+                doc = await out.json()
+                assert doc["ready"] is True
+                assert doc["role"] == "standalone"
+                assert doc["uptime_s"] >= 0
+                assert doc["engine"]["wal_replay_done"] is True
+                assert "flush" in doc["engine"]
+                assert "compaction" in doc["engine"]
+                assert doc["admission"]["total_units"] > 0
+                # standalone create_app: no observability section passed,
+                # so no recorder — the key is still present (null)
+                assert doc["self_monitoring"] is None
+
+                # /health stays pure liveness; ?ready=1 gates
+                out = await client.get("/health")
+                assert out.status == 200
+                out = await client.get("/health", params={"ready": "1"})
+                assert out.status == 200
+                assert (await out.json())["ready"] is True
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+        conn.close()
+
+    def test_ready_flag_zero_means_liveness_only(self):
+        """?ready=0 must stay a plain liveness probe (string truthiness
+        would engage the readiness gate)."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        conn = horaedb_tpu.connect(None)
+
+        async def body():
+            app = create_app(conn)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                out = await client.get("/health", params={"ready": "0"})
+                assert out.status == 200
+                assert "ready" not in (await out.json())
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+        conn.close()
+
+    def test_readiness_waits_for_wal_warmup(self, tmp_path):
+        """Standalone restart: tables open (and replay WAL) lazily, so
+        readiness must be gated on the startup warmup actually opening
+        every registered table — not report 'replay done' before any
+        replay could have started. Ready => the table is open without a
+        single query having touched it."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        d = str(tmp_path / "db")
+        conn = horaedb_tpu.connect(d)
+        conn.execute(
+            "CREATE TABLE w (h string TAG, v double, ts timestamp NOT "
+            "NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        conn.execute("INSERT INTO w (h, v, ts) VALUES ('a', 1.0, 100)")
+        conn.close()
+
+        conn = horaedb_tpu.connect(d)
+        assert conn.instance.status()["open_tables"] == 0  # lazy so far
+
+        async def body():
+            app = create_app(conn)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                deadline = time.monotonic() + 30
+                while True:
+                    out = await client.get("/health", params={"ready": "1"})
+                    if out.status == 200:
+                        break
+                    assert time.monotonic() < deadline, "never became ready"
+                    await asyncio.sleep(0.05)
+                # ready implies the warmup opened (hence WAL-replayed)
+                # the registered table, with no query involved
+                assert conn.instance.status()["open_tables"] >= 1
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+        rows = conn.execute("SELECT v FROM w").to_pylist()
+        assert rows == [{"v": 1.0}]
+        conn.close()
+
+
+@pytest.fixture(scope="module")
+def selfscrape_cluster(tmp_path_factory):
+    """Two static-mode nodes over a shared store with a fast self-scrape
+    interval — the samples table routes to ONE owner; the other node
+    forwards its rounds over the ordinary /write path."""
+    import json as _json
+    import subprocess
+    import sys
+
+    tmp_path = tmp_path_factory.mktemp("selfscrape")
+    ports = [free_port(), free_port()]
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+    data_dir = str(tmp_path / "shared")
+    procs = []
+    for i, port in enumerate(ports):
+        cfg = tmp_path / f"n{i}.toml"
+        cfg.write_text(
+            f"""
+[server]
+host = "127.0.0.1"
+http_port = {port}
+
+[engine]
+data_dir = "{data_dir}"
+
+[observability]
+self_scrape_interval = "500ms"
+
+[cluster]
+self_endpoint = "{endpoints[i]}"
+endpoints = {_json.dumps(endpoints)}
+"""
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "horaedb_tpu.server",
+                 "--config", str(cfg)],
+                env=CPU_ENV,
+                stdout=open(tmp_path / f"n{i}.log", "wb"),
+                stderr=subprocess.STDOUT,
+            )
+        )
+    deadline = time.monotonic() + 60
+    for port in ports:
+        while True:
+            try:
+                if http("GET", f"http://127.0.0.1:{port}/health",
+                        timeout=2)[0] == 200:
+                    break
+            except Exception:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"node {port} never became healthy")
+            time.sleep(0.3)
+    yield ports, endpoints
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+class TestClusterSelfMonitoring:
+    def test_coordinator_sees_both_nodes_history(self, selfscrape_cluster):
+        ports, endpoints = selfscrape_cluster
+        q = (
+            "SELECT node, count(value) AS n FROM system_metrics.samples "
+            "WHERE name = 'horaedb_self_scrape_rounds_total' GROUP BY node"
+        )
+        deadline = time.monotonic() + 60
+        nodes: set = set()
+        while time.monotonic() < deadline:
+            status, out = sql(ports[0], q)
+            if status == 200 and out.get("rows"):
+                nodes = {r["node"] for r in out["rows"]}
+                if nodes >= set(endpoints):
+                    break
+            time.sleep(0.5)
+        assert nodes >= set(endpoints), (
+            f"only {nodes} of {endpoints} visible through the "
+            "distributed read path"
+        )
+        # same history from the OTHER node: forwarding is symmetric
+        status, out = sql(ports[1], q)
+        assert status == 200
+        assert {r["node"] for r in out["rows"]} >= set(endpoints)
+
+        # PromQL on the HTTP frontend resolves the family through the
+        # fallback and the ordinary routing layer
+        status, out = http(
+            "GET",
+            f"http://127.0.0.1:{ports[0]}/prom/v1/query"
+            "?query=horaedb_self_scrape_rounds_total",
+        )
+        assert status == 200, out
+        results = out["data"]["result"]
+        assert {r["metric"].get("node") for r in results} >= set(endpoints)
+
+        # and the status document knows the recorder is live
+        status, doc = http(
+            "GET", f"http://127.0.0.1:{ports[0]}/debug/status"
+        )
+        assert status == 200
+        assert doc["self_monitoring"] is not None
+        assert doc["self_monitoring"]["rounds"] >= 1
