@@ -19,7 +19,6 @@ are dropped without rewriting (ref: sst/manager.rs:100-118).
 
 from __future__ import annotations
 
-import os
 import time
 from dataclasses import dataclass, field
 
@@ -28,6 +27,7 @@ import numpy as np
 from ..common_types.row_group import RowGroup
 from ..common_types.time_range import TimeRange
 from ..ops import merge_dedup_permutation
+from ..utils.env import env_int
 from .manifest import AddFile, MetaEdit, RemoveFile
 from .merge import dedup_keep_mask
 from .options import UpdateMode
@@ -68,7 +68,7 @@ def merge_chunk_count(n_rows: int) -> int:
     One chunk below the target size (pipelining needs enough rows per
     chunk to amortize a kernel dispatch); capped so tiny chunks don't
     multiply jit cache keys."""
-    target = int(os.environ.get("HORAEDB_MERGE_CHUNK_ROWS", "4000000"))
+    target = env_int("HORAEDB_MERGE_CHUNK_ROWS", 4_000_000)
     if target <= 0:
         return 1
     return max(1, min(16, n_rows // target))
@@ -540,7 +540,7 @@ class Compactor:
 
         idxs = [np.flatnonzero(cid == c) for c in range(n_chunks)]
         # chunks in flight: bounds device memory, keeps overlap
-        window = max(1, int(os.environ.get("HORAEDB_MERGE_WINDOW", "2")))
+        window = max(1, env_int("HORAEDB_MERGE_WINDOW", 2))
         handles: dict[int, object] = {}
 
         def harvest(c: int):

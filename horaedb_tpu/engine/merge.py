@@ -19,7 +19,6 @@ concatenation).
 
 from __future__ import annotations
 
-import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -28,6 +27,7 @@ from ..common_types.dict_column import DictColumn
 from ..common_types.row_group import RowGroup
 from ..common_types.schema import Schema, project_schema
 from ..table_engine.predicate import Predicate
+from ..utils.env import env_int
 from ..utils.object_store import ObjectStore
 from .options import UpdateMode
 from .sst.reader import SstReader
@@ -42,12 +42,11 @@ DEFAULT_DEVICE_MERGE_MIN_ROWS = 200_000
 
 
 def device_merge_min_rows() -> int:
-    raw = os.environ.get("HORAEDB_DEVICE_MERGE_MIN_ROWS")
+    raw = env_int("HORAEDB_DEVICE_MERGE_MIN_ROWS", None)
     if raw is not None:
-        try:
-            return int(raw)
-        except ValueError:
-            pass
+        # any explicit value is honored, including negatives (force the
+        # device merge for every size) — only unset/malformed defaults
+        return raw
     import jax
 
     if jax.default_backend() == "cpu":

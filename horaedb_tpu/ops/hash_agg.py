@@ -1,0 +1,261 @@
+"""Hash-based segment aggregation — the third group-by kernel.
+
+Ground (arXiv 2411.13245, hash-vs-sort group-by): neither strategy
+dominates — the winner flips with group cardinality and skew. The fused
+kernels in ops/scan_agg.py reduce rows into a dense segment domain
+``n_seg = n_groups * n_buckets``, and both existing impls pay for the
+WHOLE domain: the MXU one-hot matmul does O(N * n_seg) work, and the
+scatter impl pays XLA's serialized per-row scatter four times
+(count/sum/min/max) plus n_seg-sized intermediates. When the rows
+present touch only D << n_seg segments — a selective dashboard query
+over a wide series->group map, sparse time buckets, heavy-hitter skew —
+both waste their effort on empty segments.
+
+This impl aggregates through a small hash table first:
+
+1. multiply-shift hash of the segment id into ``H = 2^b`` slots
+   (H chosen from the router's cardinality estimate, H << n_seg);
+2. on-device probe/insert: linear probing, UNROLLED to a small fixed
+   round count (HORAEDB_HASH_PROBE_ROUNDS, default 2 — scatter passes
+   are the expensive primitive on both TPU and XLA-CPU, so the probe
+   budget is a static cost cap, not a convergence loop). A round claims
+   slots with a scatter-min into EMPTY slots only, so a claimed slot is
+   immutable across rounds and same-round collisions break
+   deterministically (the smallest segment id wins; losers re-probe).
+3. per-slot aggregation with the one-hot matmul over H slots (O(N * H)
+   instead of O(N * n_seg));
+4. an H-row scatter of slot results into the n_seg output.
+
+Rows that fail to place within the probe budget (collision clustering,
+or more distinct segments present than the estimate promised) fall back
+to the exact scatter impl under ``lax.cond``, so the kernel is CORRECT
+for every input; it is merely slower when overflow triggers — bounded
+at roughly one scatter pass plus the probe budget — and the router
+observes that latency, so the shape stops routing to hash.
+
+Tiny inputs skip the device entirely: below
+``HORAEDB_HASH_HOST_MAX_ROWS`` valid rows a dispatch costs more than the
+aggregation, so :func:`host_scan_aggregate` computes the same monoid
+with exact f64 numpy on the host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .encoding import next_pow2
+
+# 2^32 / golden ratio (Knuth multiplicative / Fibonacci hashing): odd,
+# spreads consecutive dense segment ids across the high bits.
+_MULT = np.uint32(2654435769)
+
+# Slot-table bounds: the floor keeps the multiply-shift well-defined
+# (shift < 32); it is deliberately TINY — the one-hot matmul over H
+# slots is the hash impl's inner cost, and small H is the entire win —
+# while the cap bounds that O(N * H) work: past it, hash stops beating
+# scatter anyway.
+_MIN_SLOTS = 16
+_DEFAULT_MAX_SLOTS = 4096
+
+
+def default_hash_slots(n_seg: int) -> int:
+    """Deterministic slot count when the caller has no cardinality
+    estimate: the full domain up to the cap."""
+    return next_pow2(min(n_seg, _DEFAULT_MAX_SLOTS), floor=_MIN_SLOTS)
+
+
+def hash_slots_for(n_seg: int, est_distinct: int | None) -> int:
+    """Slot count from a cardinality estimate: 4x headroom (load factor
+    <= 0.25 in the expected case) so nearly every segment places within
+    the small fixed probe budget — headroom in the slot table is far
+    cheaper than a trip through the full-domain overflow fallback. NOT
+    clamped to n_seg: when the estimate approaches the domain a
+    same-size table would run at load 1.0 and push everything through
+    the fallback."""
+    from ..utils.env import env_int
+
+    cap = max(_MIN_SLOTS, env_int("HORAEDB_HASH_MAX_SLOTS", _DEFAULT_MAX_SLOTS))
+    if est_distinct is None or est_distinct <= 0:
+        return default_hash_slots(n_seg)
+    return next_pow2(min(4 * est_distinct, cap), floor=_MIN_SLOTS)
+
+
+def hash_segment_agg(seg_raw, m, agg_vals, n_seg: int, need_minmax: bool,
+                     n_slots: int):
+    """(counts, sums, mins, maxs) over flat segment ids, hash-table style.
+
+    Same contract as ``_mxu_segment_agg``/``_scatter_segment_agg`` in
+    ops/scan_agg.py — drop-in third arm of the impl branch there.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .scan_agg import _mxu_segment_agg, _scatter_segment_agg
+
+    from ..utils.env import env_int
+
+    H = int(n_slots)
+    assert H >= 2 and (H & (H - 1)) == 0, f"n_slots must be a power of 2, got {H}"
+    shift = np.uint32(32 - int(H).bit_length() + 1)  # 32 - log2(H)
+    empty = jnp.int32(2**31 - 1)  # sentinel; valid segment ids are < n_seg
+
+    seg = jnp.where(m, seg_raw, -1)
+    valid = seg >= 0
+    h0 = ((seg.astype(jnp.uint32) * _MULT) >> shift).astype(jnp.int32)
+
+    # Probe/insert, UNROLLED: scatter passes are the priced primitive
+    # (serialized on TPU, a serial loop on XLA-CPU — ~constant cost per
+    # pass regardless of table size), so the probe budget is a static
+    # cost cap, one scatter-min per round. Unplaced rows after the last
+    # round are handled exactly by the overflow fallback below — the
+    # budget bounds COST, never correctness.
+    rounds = min(H, max(1, env_int("HORAEDB_HASH_PROBE_ROUNDS", 2)))
+    slots = jnp.full((H,), empty, dtype=jnp.int32)
+    slot_of = jnp.zeros_like(seg)
+    placed = ~valid
+    for r in range(rounds):
+        cand = (h0 + r) & (H - 1)
+        cur = slots[cand]
+        mine = cur == seg  # slot already owned by my segment
+        try_claim = (~placed) & (cur == empty)
+        # Claim only EMPTY slots (mode="drop" discards non-claimers):
+        # an owned slot is immutable, so a smaller segment id arriving
+        # in a later round can never steal a slot rows already hold.
+        tgt = jnp.where(try_claim, cand, H)
+        slots = slots.at[tgt].min(seg, mode="drop")
+        won = try_claim & (slots[cand] == seg)
+        newly = (~placed) & (mine | won)
+        slot_of = jnp.where(newly, cand, slot_of)
+        placed = placed | newly
+
+    # Per-slot aggregation: the one-hot matmul over H slots — the whole
+    # point; H << n_seg is where hash beats the full-domain impls.
+    hash_m = placed & valid
+    counts_h, sums_h, mins_h, maxs_h = _mxu_segment_agg(
+        slot_of, hash_m, agg_vals, H, need_minmax
+    )
+
+    # Scatter slot results into the segment domain: H rows, not N.
+    slot_seg = jnp.where(slots == empty, n_seg, slots)  # empty -> dump
+    counts = (
+        jnp.zeros((n_seg + 1,), jnp.int32).at[slot_seg].add(counts_h)[:n_seg]
+    )
+    if agg_vals is not None:
+        F = agg_vals.shape[0]
+        sums = (
+            jnp.zeros((F, n_seg + 1), sums_h.dtype)
+            .at[:, slot_seg].add(sums_h)[:, :n_seg]
+        )
+        if need_minmax:
+            big = jnp.asarray(jnp.inf, dtype=mins_h.dtype)
+            mins = (
+                jnp.full((F, n_seg + 1), big)
+                .at[:, slot_seg].min(mins_h)[:, :n_seg]
+            )
+            maxs = (
+                jnp.full((F, n_seg + 1), -big)
+                .at[:, slot_seg].max(maxs_h)[:, :n_seg]
+            )
+        else:
+            mins = maxs = jnp.zeros_like(sums)
+    else:
+        sums = mins = maxs = None
+
+    # Overflow (D > H): the unplaced remainder goes through the exact
+    # scatter impl. lax.cond executes one branch at runtime, so the
+    # fallback costs nothing when the slot table held everything.
+    overflow = valid & ~placed
+
+    def with_overflow(_):
+        return _scatter_segment_agg(seg_raw, overflow, agg_vals, n_seg,
+                                    need_minmax)
+
+    def no_overflow(_):
+        zc = jnp.zeros((n_seg,), jnp.int32)
+        if agg_vals is None:
+            return zc, None, None, None
+        zs = jnp.zeros((agg_vals.shape[0], n_seg), sums.dtype)
+        if need_minmax:
+            big = jnp.asarray(jnp.inf, dtype=zs.dtype)
+            return zc, zs, jnp.full_like(zs, big), jnp.full_like(zs, -big)
+        return zc, zs, jnp.zeros_like(zs), jnp.zeros_like(zs)
+
+    oc, osums, omins, omaxs = jax.lax.cond(
+        overflow.any(), with_overflow, no_overflow, operand=None
+    )
+    counts = counts + oc
+    if agg_vals is not None:
+        sums = sums + osums
+        if need_minmax:
+            mins = jnp.minimum(mins, omins)
+            maxs = jnp.maximum(maxs, omaxs)
+    return counts, sums, mins, maxs
+
+
+# ---- host fallback for tiny inputs ----------------------------------------
+
+
+def host_segment_agg(seg: np.ndarray, m: np.ndarray, agg_vals,
+                     n_seg: int, need_minmax: bool):
+    """Exact f64 numpy twin of the device impls' (counts, sums, mins,
+    maxs) contract — the dispatch-free path for inputs too small to pay
+    a device round trip."""
+    idx = np.nonzero(m)[0]
+    s = np.asarray(seg)[idx].astype(np.int64)
+    counts = np.bincount(s, minlength=n_seg).astype(np.int32)
+    if agg_vals is None:
+        return counts, None, None, None
+    F = agg_vals.shape[0]
+    sums = np.zeros((F, n_seg))
+    mins = np.full((F, n_seg), np.inf)
+    maxs = np.full((F, n_seg), -np.inf)
+    for f in range(F):
+        v = np.asarray(agg_vals[f], dtype=np.float64)[idx]
+        sums[f] = np.bincount(s, weights=v, minlength=n_seg)
+        if need_minmax:
+            np.minimum.at(mins[f], s, v)
+            np.maximum.at(maxs[f], s, v)
+    if not need_minmax:
+        mins = np.zeros_like(sums)
+        maxs = np.zeros_like(sums)
+    return counts, sums, mins, maxs
+
+
+def host_scan_aggregate(batch, spec, filter_literals=()):
+    """AggState for one padded batch, computed entirely on host.
+
+    Applies the spec's numeric device filters with the same op codes the
+    kernel uses, then folds the aggregation monoid in exact f64 — the
+    "host fallback for tiny inputs" arm of the hash route.
+    """
+    from .scan_agg import _FILTER_OPS, AggState
+
+    m = np.asarray(batch.mask).copy()
+    values = np.asarray(batch.values)
+    lits = np.asarray(filter_literals, dtype=np.float32)
+    code_ops = {v: k for k, v in _FILTER_OPS.items()}
+    cmp = {
+        "=": np.equal, "!=": np.not_equal, "<": np.less, "<=": np.less_equal,
+        ">": np.greater, ">=": np.greater_equal,
+    }
+    for i, (field_idx, op) in enumerate(spec.numeric_filters):
+        op_str = op if isinstance(op, str) else code_ops[op]
+        m &= cmp[op_str](values[field_idx], lits[i])
+    n_seg = spec.n_groups * spec.n_buckets
+    seg = (
+        np.asarray(batch.group_codes).astype(np.int64) * spec.n_buckets
+        + np.asarray(batch.bucket_ids)
+    )
+    agg_vals = values[: spec.n_agg_fields] if spec.n_agg_fields else None
+    counts, sums, mins, maxs = host_segment_agg(
+        seg, m, agg_vals, n_seg, spec.need_minmax
+    )
+    G, B, F = spec.n_groups, spec.n_buckets, spec.n_agg_fields
+    counts = counts.reshape(G, B)
+    if F:
+        sums = sums.reshape(F, G, B)
+        mins = mins.reshape(F, G, B)
+        maxs = maxs.reshape(F, G, B)
+    else:
+        sums = mins = maxs = np.zeros((0, G, B))
+    return AggState(counts=counts, sums=sums, mins=mins, maxs=maxs)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.env import env_int
 from .encoding import PaddedBatch, next_pow2
 
 AGG_OPS = ("count", "sum", "min", "max", "avg")
@@ -42,25 +43,59 @@ AGG_OPS = ("count", "sum", "min", "max", "avg")
 # Numeric filter ops, by static code (part of the jit cache key).
 _FILTER_OPS = {"=": 0, "!=": 1, "<": 2, "<=": 3, ">": 4, ">=": 5}
 
-# Segment-reduction implementation. TPU scatter (segment_sum/min/max) is
-# serialized and slow (~10-20ms/M rows measured on v5e); for small-to-medium
-# segment counts a one-hot matmul rides the MXU and a fused masked
-# broadcast-reduce handles min/max — 5-100x faster. Above the threshold the
-# matmul's O(N*n_seg) work loses to scatter's O(N); measured crossover is
-# around 8-16k segments at 1M rows.
-_SEGMENT_IMPL = os.environ.get("HORAEDB_SEGMENT_IMPL", "auto")  # auto|scatter|mxu
-_MXU_MAX_SEGMENTS = int(os.environ.get("HORAEDB_MXU_MAX_SEGMENTS", "8192"))
+# The three segment-reduction implementations. TPU scatter
+# (segment_sum/min/max) is serialized and slow (~10-20ms/M rows measured
+# on v5e); for small-to-medium segment counts a one-hot matmul rides the
+# MXU and a fused masked broadcast-reduce handles min/max — 5-100x
+# faster. Above the threshold the matmul's O(N*n_seg) work loses to
+# scatter's O(N). The hash impl (ops/hash_agg.py) aggregates through a
+# small slot table first — the winner when the rows present touch far
+# fewer segments than the domain holds (low cardinality, heavy skew).
+# Which impl serves a query is decided per (plan shape, segment bucket)
+# by the learned router (query/path_router.KernelRouter); the spec's
+# ``segment_impl`` carries the choice into the jit cache key.
+SEGMENT_KERNELS = ("mxu", "scatter", "hash")
 # f32 one-hot counts are exact up to 2^24 rows per segment; beyond that the
 # count matvec runs in row chunks with int32 accumulation between chunks.
 _COUNT_CHUNK = 1 << 24
 
 
-def _use_mxu(n_seg: int) -> bool:
-    if _SEGMENT_IMPL == "mxu":
-        return True
-    if _SEGMENT_IMPL == "scatter":
-        return False
-    return jax.default_backend() == "tpu" and n_seg <= _MXU_MAX_SEGMENTS
+def pinned_segment_impl() -> str:
+    """The HORAEDB_SEGMENT_IMPL kill switch: pins ONE static impl for
+    every query shape (exists to bisect lowerings — the override must
+    cover every shape, including global aggregates). Empty string means
+    auto. Read per call so tests/operators can flip it live."""
+    v = os.environ.get("HORAEDB_SEGMENT_IMPL", "auto")
+    return v if v in SEGMENT_KERNELS else ""
+
+
+def mxu_max_segments() -> int:
+    """Static auto-heuristic crossover (measured ~8-16k segments at 1M
+    rows). Guarded: a malformed value degrades to the default instead of
+    aborting import."""
+    return env_int("HORAEDB_MXU_MAX_SEGMENTS", 8192)
+
+
+def resolve_segment_impl(n_seg: int, requested: str = "auto") -> str:
+    """Which impl a kernel trace will take for ``n_seg`` — "single",
+    "mxu", "scatter" or "hash". Host-side mirror of the in-trace branch
+    (deterministic: static args + backend only), so the router and the
+    ledger can name the kernel without re-deriving the rules."""
+    pinned = pinned_segment_impl()
+    if pinned:
+        return pinned
+    if n_seg == 1:
+        # Global aggregate: both scatter (4 scalarized segment_* ops)
+        # and MXU (a width-1 one-hot matmul) waste passes; four
+        # streaming reduces are the bandwidth floor.
+        return "single"
+    if requested in SEGMENT_KERNELS:
+        return requested
+    return (
+        "mxu"
+        if jax.default_backend() == "tpu" and n_seg <= mxu_max_segments()
+        else "scatter"
+    )
 
 
 @dataclass(frozen=True)
@@ -75,6 +110,15 @@ class ScanAggSpec:
     # False when no min/max aggregate is requested: the kernel skips the
     # min/max reductions entirely and returns zeros in their slots.
     need_minmax: bool = True
+    # Segment-reduction impl for this dispatch: "auto" (static
+    # heuristic) or one of SEGMENT_KERNELS as chosen by the learned
+    # router. Static jit arg — the chosen kernel IS part of the compile
+    # cache key, on the direct, cached, and shard_map dist paths alike.
+    segment_impl: str = "auto"
+    # Hash-impl slot-table size (power of 2; 0 = derive from n_seg).
+    # Sized from the router's cardinality estimate, bucketed to powers
+    # of two so it mints a bounded number of jit keys.
+    hash_slots: int = 0
 
     def padded(self) -> "ScanAggSpec":
         # Ungrouped specs (n_groups == 1) skip group padding entirely: the
@@ -89,6 +133,8 @@ class ScanAggSpec:
             n_agg_fields=self.n_agg_fields,
             numeric_filters=self.numeric_filters,
             need_minmax=self.need_minmax,
+            segment_impl=self.segment_impl,
+            hash_slots=self.hash_slots,
         )
 
 
@@ -200,6 +246,8 @@ def scan_agg_body(
     n_agg_fields: int,
     numeric_filters: tuple[tuple[int, int], ...] = (),
     need_minmax: bool = True,
+    segment_impl: str = "auto",
+    hash_slots: int = 0,
 ):
     """Pure kernel body — also the per-shard program inside shard_map
     (parallel/dist_agg.py wraps it with psum/pmin/pmax collectives)."""
@@ -223,13 +271,28 @@ def scan_agg_body(
     n_seg = n_groups * n_buckets
     seg_raw = group_codes * n_buckets + bucket_ids
     agg_vals = values[:n_agg_fields] if n_agg_fields else None
-    if n_seg == 1 and _SEGMENT_IMPL == "auto":
-        # Forcing scatter/mxu via HORAEDB_SEGMENT_IMPL stays exhaustive
-        # (it exists to bisect lowerings — the override must cover every
-        # query shape, including global aggregates).
+    # Dispatch entry points (scan_aggregate, the executor's cached-packed
+    # call, dist_agg's step builders) resolve the impl ON HOST and pass
+    # the concrete name as this static arg — so flipping the env pin /
+    # threshold mints a NEW jit key instead of silently reusing a warm
+    # trace. The in-body resolve below is only a safety net for callers
+    # that still pass "auto" (identity for concrete names).
+    impl_name = (
+        segment_impl
+        if segment_impl in ("single",) + SEGMENT_KERNELS
+        else resolve_segment_impl(n_seg, segment_impl)
+    )
+    if impl_name == "single":
         counts, sums, mins, maxs = _single_segment_agg(m, agg_vals, need_minmax)
+    elif impl_name == "hash":
+        from .hash_agg import default_hash_slots, hash_segment_agg
+
+        counts, sums, mins, maxs = hash_segment_agg(
+            seg_raw, m, agg_vals, n_seg, need_minmax,
+            hash_slots or default_hash_slots(n_seg),
+        )
     else:
-        impl = _mxu_segment_agg if _use_mxu(n_seg) else _scatter_segment_agg
+        impl = _mxu_segment_agg if impl_name == "mxu" else _scatter_segment_agg
         counts, sums, mins, maxs = impl(seg_raw, m, agg_vals, n_seg, need_minmax)
 
     counts = counts.reshape(n_groups, n_buckets)
@@ -247,7 +310,8 @@ def scan_agg_body(
 _fused_scan_agg = functools.partial(
     jax.jit,
     static_argnames=(
-        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters", "need_minmax",
+        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
+        "need_minmax", "segment_impl", "hash_slots",
     ),
 )(scan_agg_body)
 
@@ -269,6 +333,8 @@ def cached_scan_agg_body(
     n_agg_fields: int,
     numeric_filters: tuple[tuple[int, int], ...],
     need_minmax: bool = True,
+    segment_impl: str = "auto",
+    hash_slots: int = 0,
 ):
     """The steady-state serving kernel over HBM-resident columns.
 
@@ -300,13 +366,16 @@ def cached_scan_agg_body(
         n_agg_fields=n_agg_fields,
         numeric_filters=numeric_filters,
         need_minmax=need_minmax,
+        segment_impl=segment_impl,
+        hash_slots=hash_slots,
     )
 
 
 cached_scan_agg = functools.partial(
     jax.jit,
     static_argnames=(
-        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters", "need_minmax",
+        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
+        "need_minmax", "segment_impl", "hash_slots",
     ),
 )(cached_scan_agg_body)
 
@@ -314,7 +383,8 @@ cached_scan_agg = functools.partial(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters", "need_minmax",
+        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
+        "need_minmax", "segment_impl", "hash_slots",
     ),
 )
 def selective_cached_scan_agg(
@@ -335,6 +405,8 @@ def selective_cached_scan_agg(
     n_agg_fields: int,
     numeric_filters: tuple[tuple[int, int], ...],
     need_minmax: bool = True,
+    segment_impl: str = "auto",
+    hash_slots: int = 0,
 ):
     """Cached kernel over a GATHERED subset of the resident rows.
 
@@ -355,6 +427,8 @@ def selective_cached_scan_agg(
         n_agg_fields=n_agg_fields,
         numeric_filters=numeric_filters,
         need_minmax=need_minmax,
+        segment_impl=segment_impl,
+        hash_slots=hash_slots,
     )
 
 
@@ -418,7 +492,9 @@ def _packed_body(
     n_agg_fields: int,
     numeric_filters: tuple[tuple[int, int], ...],
     need_minmax: bool,
-    selective: bool,
+    segment_impl: str = "auto",
+    hash_slots: int = 0,
+    selective: bool = False,
 ):
     s1 = session.shape[0] // 2
     gos = session[:s1]
@@ -438,6 +514,8 @@ def _packed_body(
         n_agg_fields=n_agg_fields,
         numeric_filters=numeric_filters,
         need_minmax=need_minmax,
+        segment_impl=segment_impl,
+        hash_slots=hash_slots,
     )
     parts = [
         jax.lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
@@ -452,7 +530,7 @@ cached_scan_agg_packed = functools.partial(
     jax.jit,
     static_argnames=(
         "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
-        "need_minmax", "selective",
+        "need_minmax", "segment_impl", "hash_slots", "selective",
     ),
 )(_packed_body)
 
@@ -509,6 +587,27 @@ def scan_aggregate(
 
     from ..utils.querystats import note_kernel_dispatch
 
+    # Host-side impl resolution: the CONCRETE kernel name becomes the
+    # static jit arg, so a live flip of HORAEDB_SEGMENT_IMPL /
+    # HORAEDB_MXU_MAX_SEGMENTS re-keys (and re-traces) warm shapes
+    # instead of silently serving the stale compiled branch.
+    impl = resolve_segment_impl(
+        spec.n_groups * spec.n_buckets, spec.segment_impl
+    )
+
+    # Router-chosen hash route, tiny input: a device dispatch costs more
+    # than the aggregation — exact f64 numpy serves it instead. Never
+    # taken under the HORAEDB_SEGMENT_IMPL kill switch (pinning exists
+    # to bisect device lowerings, so it must actually run them).
+    if (
+        impl == "hash"
+        and not pinned_segment_impl()
+        and batch.n_valid <= env_int("HORAEDB_HASH_HOST_MAX_ROWS", 4096)
+    ):
+        from .hash_agg import host_scan_aggregate
+
+        return host_scan_aggregate(batch, spec, filter_literals)
+
     t0 = _time.perf_counter()
     counts, sums, mins, maxs = _fused_scan_agg(
         jnp.asarray(batch.group_codes),
@@ -521,6 +620,8 @@ def scan_aggregate(
         n_agg_fields=spec.n_agg_fields,
         numeric_filters=encode_filter_ops(spec.numeric_filters),
         need_minmax=spec.need_minmax,
+        segment_impl=impl,
+        hash_slots=spec.hash_slots,
     )
     state = state_to_host(counts, sums, mins, maxs)
     # Per-query compile accounting: a never-seen static shape's first
@@ -528,7 +629,8 @@ def scan_aggregate(
     # latency cliff needs attributed (ledger jit_* fields).
     note_kernel_dispatch(
         ("fused", batch.values.shape, spec.n_groups, spec.n_buckets,
-         spec.n_agg_fields, spec.numeric_filters, spec.need_minmax),
+         spec.n_agg_fields, spec.numeric_filters, spec.need_minmax,
+         impl, spec.hash_slots),
         _time.perf_counter() - t0,
     )
     return state

@@ -16,6 +16,7 @@ collective implementation; the program is identical from 1 to N devices.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Callable
 
 import jax
@@ -43,7 +44,18 @@ SHARD_AXIS = "shard"
 
 # Compiled steps keyed by (mesh, spec): jax.jit caches by function identity,
 # so rebuilding the shard_map closure per call would re-compile every time.
+# LRU-bounded with the same discipline (and the same bound) as
+# PathRouter.MAX_KEYS — distinct query shapes must not grow it without
+# limit over a server's lifetime; dict insertion order is the recency
+# order, re-inserting moves a key to the back.
 _STEP_CACHE: dict = {}
+_STEP_LOCK = threading.Lock()
+
+
+def _step_cache_max() -> int:
+    from ..query.path_router import MAX_KEYS
+
+    return MAX_KEYS
 
 
 def _combine(state):
@@ -57,12 +69,32 @@ def _combine(state):
     )
 
 
+def _resolved(spec: ScanAggSpec) -> ScanAggSpec:
+    """Resolve the segment impl ON HOST so the concrete kernel name is
+    what keys the step cache and the jit trace — a live flip of
+    HORAEDB_SEGMENT_IMPL / HORAEDB_MXU_MAX_SEGMENTS re-keys warm shapes
+    instead of silently serving the stale compiled branch."""
+    import dataclasses
+
+    from ..ops.scan_agg import resolve_segment_impl
+
+    impl = resolve_segment_impl(
+        spec.n_groups * spec.n_buckets, spec.segment_impl
+    )
+    if impl == spec.segment_impl:
+        return spec
+    return dataclasses.replace(spec, segment_impl=impl)
+
+
 def _build_step(mesh: Mesh, spec: ScanAggSpec, tag: str, body, in_specs) -> Callable:
     """shard_map(body)+combine, jitted and cached per (mesh, spec, tag)."""
+    spec = _resolved(spec)
     cache_key = (mesh, spec, tag)
-    cached = _STEP_CACHE.get(cache_key)
-    if cached is not None:
-        return cached
+    with _STEP_LOCK:
+        cached = _STEP_CACHE.pop(cache_key, None)
+        if cached is not None:
+            _STEP_CACHE[cache_key] = cached  # LRU touch
+            return cached
     static_filters = encode_filter_ops(spec.numeric_filters)
 
     def per_shard(*args):
@@ -74,13 +106,18 @@ def _build_step(mesh: Mesh, spec: ScanAggSpec, tag: str, body, in_specs) -> Call
                 n_agg_fields=spec.n_agg_fields,
                 numeric_filters=static_filters,
                 need_minmax=spec.need_minmax,
+                segment_impl=spec.segment_impl,
+                hash_slots=spec.hash_slots,
             )
         )
 
     step = jax.jit(
         shard_map(per_shard, mesh=mesh, in_specs=in_specs, out_specs=(P(), P(), P(), P()))
     )
-    _STEP_CACHE[cache_key] = step
+    with _STEP_LOCK:
+        while len(_STEP_CACHE) >= _step_cache_max():
+            _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
+        _STEP_CACHE[cache_key] = step
     return step
 
 

@@ -10,7 +10,6 @@ where the reference decides local vs distributed execution).
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Optional
 
@@ -24,10 +23,9 @@ DEFAULT_DIST_MIN_ROWS = 1 << 18
 
 
 def dist_min_rows() -> int:
-    try:
-        return int(os.environ.get("HORAEDB_DIST_MIN_ROWS", DEFAULT_DIST_MIN_ROWS))
-    except ValueError:
-        return DEFAULT_DIST_MIN_ROWS
+    from ..utils.env import env_int
+
+    return env_int("HORAEDB_DIST_MIN_ROWS", DEFAULT_DIST_MIN_ROWS)
 
 
 def serving_mesh(min_devices: int = 2) -> Optional["jax.sharding.Mesh"]:

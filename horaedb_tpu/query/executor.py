@@ -676,6 +676,96 @@ class Executor:
             m["partial_stages"] = stage_metrics
             return assemble_result(plan, combined, n_groups, spec)
 
+    # ---- learned kernel routing --------------------------------------------
+    def _route_kernel(self, plan: QueryPlan, spec, n_rows: int,
+                      est_distinct):
+        """Learned segment-impl choice for a padded spec (the "database
+        picks its own data structures" loop): seed from estimated group
+        cardinality + observed query_stats history, then serve the
+        measured winner with periodic re-probes. Returns (spec, token);
+        token is None when routing doesn't apply (n_seg == 1, pinned
+        HORAEDB_SEGMENT_IMPL, or router disabled)."""
+        from ..ops.scan_agg import pinned_segment_impl
+        from .path_router import (
+            KERNEL_ROUTER,
+            bootstrap_observed_segments,
+            candidate_kernels,
+            kernel_routing_enabled,
+            plan_shape_key,
+            seed_kernel,
+        )
+
+        n_seg = spec.n_groups * spec.n_buckets
+        if n_seg <= 1 or pinned_segment_impl() or not kernel_routing_enabled():
+            return spec, None
+        key = (plan_shape_key(plan), n_seg.bit_length())
+        obs = KERNEL_ROUTER.observed_segments(key)
+        if obs is None:
+            # never-seen key: the query_stats ring may remember how many
+            # live segments this SQL shape produced before (agg_segments)
+            ledger = querystats.current_ledger()
+            obs = bootstrap_observed_segments(ledger.sql if ledger else "")
+            if obs is not None:
+                KERNEL_ROUTER.note_segments(key, obs)
+        est = obs if obs is not None else est_distinct
+        if est is not None:
+            est = max(1, min(int(est), n_seg, max(int(n_rows), 1)))
+        import dataclasses
+
+        import jax
+
+        from ..ops.hash_agg import hash_slots_for
+
+        impl = KERNEL_ROUTER.choose(
+            key,
+            seed_kernel(n_seg, est, jax.default_backend()),
+            candidate_kernels(n_seg, n_rows, est),
+        )
+        spec = dataclasses.replace(
+            spec,
+            segment_impl=impl,
+            hash_slots=hash_slots_for(n_seg, est) if impl == "hash" else 0,
+        )
+        return spec, (key, impl)
+
+    def _finish_kernel(self, krec, spec, m: dict, state,
+                       seconds: float, n_valid=None) -> None:
+        """Close one aggregation dispatch: feed the router's EWMA and
+        observed-cardinality loop, stamp the metric tree, the ledger
+        ``kernel`` field, and the horaedb_agg_kernel_total family."""
+        from ..ops.scan_agg import (
+            pinned_segment_impl,
+            resolve_segment_impl,
+        )
+        from .path_router import KERNEL_ROUTER
+
+        n_seg = spec.n_groups * spec.n_buckets
+        impl = resolve_segment_impl(n_seg, spec.segment_impl)
+        live = int((state.counts > 0).sum())
+        if krec is not None and live > 0:
+            # Degenerate dispatches (empty time range, filter matching
+            # nothing) are excluded from BOTH feedback loops: their
+            # near-zero latency would make whichever impl served them
+            # look unbeatable under the min-biased estimator, and a
+            # live count of 0 would EWMA the cardinality estimate toward
+            # a tiny hash table the next real query overflows.
+            key, routed = krec
+            # the honest cost of CHOOSING this impl for the shape —
+            # including the tiny-input host fallback when hash took it
+            KERNEL_ROUTER.record(key, routed, seconds)
+            KERNEL_ROUTER.note_segments(key, live)
+        if (
+            impl == "hash"
+            and n_valid is not None
+            and not pinned_segment_impl()
+        ):
+            from ..utils.env import env_int
+
+            if n_valid <= env_int("HORAEDB_HASH_HOST_MAX_ROWS", 4096):
+                impl = "host"  # scan_aggregate's dispatch-free arm
+        m["kernel"] = impl
+        querystats.note_agg_kernel(impl, segments=live)
+
     # ---- device path -------------------------------------------------------
     def _agg_device_shape(self, plan: QueryPlan):
         """(tag_keys, bucket_key, agg_cols) when the aggregation shape fits
@@ -783,13 +873,25 @@ class Executor:
         ).padded()
         literals = [lit for _, _, lit in device_filters]
 
+        # Learned kernel choice. Group codes are dense (np.unique), so
+        # groups x buckets is an exact ceiling on live segments; bucket
+        # sparsity (and router history) can only pull it down.
+        spec, krec = self._route_kernel(
+            plan, spec, n_rows=n,
+            est_distinct=max(enc.num_groups, 1) * n_buckets,
+        )
+
         # Large scans shard over the device mesh (partial agg per device,
         # monoid combine via psum/pmin/pmax collectives); small ones stay
         # single-device where dispatch overhead dominates. SAME kernel
-        # body either way (parallel/dist_agg wraps ops/scan_agg).
+        # body either way (parallel/dist_agg wraps ops/scan_agg — the
+        # routed segment_impl rides the spec into the shard_map step).
         from ..parallel.mesh import dist_min_rows, serving_mesh
 
+        import time as _time
+
         mesh = serving_mesh()
+        t_kernel = _time.perf_counter()
         if mesh is not None and batch.n_valid >= dist_min_rows():
             from ..parallel.dist_agg import dist_scan_aggregate
 
@@ -798,6 +900,11 @@ class Executor:
                 m["mesh_devices"] = int(mesh.devices.size)
         else:
             state = scan_aggregate(batch, spec, literals)
+        if m is not None:
+            self._finish_kernel(
+                krec, spec, m, state,
+                _time.perf_counter() - t_kernel, n_valid=batch.n_valid,
+            )
 
         return self._assemble_agg_result(
             plan, tag_keys, enc.key_values, agg_cols, state,
@@ -900,6 +1007,19 @@ class Executor:
 
         filter_cols = [f[0] for f in device_filters]
         value_names = list(dict.fromkeys(agg_cols + filter_cols))
+
+        # Dtype auto-tuning feedback: which aggregates/filters touch each
+        # value column decides whether its resident copy may be bf16
+        # (HORAEDB_CACHE_DTYPE=auto) — see ScanCache.note_usage.
+        self.scan_cache.note_usage(
+            table.name,
+            value_names,
+            sum_cols={
+                a.column for a in plan.aggs
+                if a.column and a.func in ("sum", "avg")
+            },
+            filter_cols=set(filter_cols),
+        )
 
         entry, built, delta = self.scan_cache.get(
             table, value_names, read_rows=lambda: table.read(Predicate.all_time())
@@ -1005,6 +1125,34 @@ class Executor:
             need_minmax=_plan_needs_minmax(plan),
         ).padded()
 
+        # Learned kernel choice. Unlike the direct path, the cached
+        # domain spans EVERY group in the table while the allow-list may
+        # keep a handful of series — exactly the sparse regime where the
+        # hash impl beats full-domain scatter/MXU. Estimate live
+        # segments from the groups the allowed series can actually
+        # reach (exact on the group axis, ceiling on the bucket axis).
+        if scan_allowed.any():
+            active_groups = len(np.unique(series_group[scan_allowed]))
+        else:
+            active_groups = 1
+        spec, krec = self._route_kernel(
+            plan, spec, n_rows=entry.n_valid,
+            est_distinct=max(active_groups, 1) * n_buckets,
+        )
+        # Resolve "auto"/pin to the CONCRETE impl on host: it keys the
+        # packed jit call below, so flipping the env knobs re-traces warm
+        # shapes instead of silently reusing the stale compiled branch.
+        import dataclasses
+
+        from ..ops.scan_agg import resolve_segment_impl
+
+        spec = dataclasses.replace(
+            spec,
+            segment_impl=resolve_segment_impl(
+                spec.n_groups * spec.n_buckets, spec.segment_impl
+            ),
+        )
+
         gos = np.append(series_group, 0).astype(np.int32)  # pad series -> masked
         allow = np.append(allowed, False)  # delta fold: NO value pruning
         allow_scan = (
@@ -1024,6 +1172,7 @@ class Executor:
         kernel_key = (
             spec.n_groups, spec.n_buckets, spec.n_agg_fields,
             spec.numeric_filters, spec.need_minmax,
+            spec.segment_impl, spec.hash_slots,
         )
         import time as _time
 
@@ -1083,6 +1232,8 @@ class Executor:
                 n_agg_fields=spec.n_agg_fields,
                 numeric_filters=encode_filter_ops(spec.numeric_filters),
                 need_minmax=spec.need_minmax,
+                segment_impl=spec.segment_impl,
+                hash_slots=spec.hash_slots,
                 selective=row_idx is not None,
             )
             state = unpack_packed_state(packed, spec)
@@ -1090,6 +1241,9 @@ class Executor:
                 ("cached-packed", row_idx is not None, *kernel_key),
                 _time.perf_counter() - t_kernel,
             )
+        self._finish_kernel(
+            krec, spec, m, state, _time.perf_counter() - t_kernel
+        )
         if len(delta) and not empty_range:
             self._fold_delta(
                 state, delta, entry, plan.schema, gos, allow,

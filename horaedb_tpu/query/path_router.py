@@ -122,3 +122,177 @@ def adaptive_enabled() -> bool:
     import jax
 
     return jax.default_backend() != "cpu"
+
+
+# ---- learned segment-kernel routing ---------------------------------------
+#
+# The device group-by has three segment-reduction impls (ops/scan_agg.py:
+# mxu one-hot matmul, scatter segment_* ops, hash slot table) and the
+# winner flips with group cardinality and skew (arXiv 2411.13245) — a
+# static import-time threshold leaves a regime on the table on every
+# deployment. Same EWMA + periodic-reprobe machinery as PathRouter, one
+# level down: keyed by (plan shape, segment-count bucket), choosing the
+# IMPL the jitted kernel branches on instead of the device/host path.
+# The first call of a shape is seeded from estimated group cardinality
+# (sampler/exact group encoding + observed query_stats history), so it
+# already starts near the winner instead of probing blind.
+
+
+def kernel_routing_enabled() -> bool:
+    """Learned impl choice (default on — it matters on every backend;
+    scatter-vs-hash flips on CPU too). HORAEDB_SEGMENT_IMPL pinning
+    bypasses the router entirely regardless of this switch."""
+    return os.environ.get("HORAEDB_KERNEL_ROUTER", "1") not in (
+        "0", "off", "false",
+    )
+
+
+def candidate_kernels(n_seg: int, n_rows: int, est_distinct=None) -> tuple:
+    """Impls worth PROBING for this shape. Routing must never schedule a
+    probe that is catastrophically wrong by construction: the MXU one-hot
+    is O(N * n_seg) — beyond a bounded extrapolation of the static
+    crossover a single probe could cost seconds — and the hash table
+    cannot beat the direct impls when the domain is already tiny or the
+    live cardinality fills most of it (a near-full table just routes
+    everything through the overflow fallback)."""
+    import jax
+
+    from ..ops.scan_agg import mxu_max_segments
+
+    cands = ["scatter"]
+    if n_seg <= (
+        # the 4x extrapolation is MXU-calibrated; without a matrix unit
+        # the one-hot's O(N * n_seg) bites orders of magnitude sooner
+        4 * mxu_max_segments() if jax.default_backend() == "tpu" else 256
+    ):
+        cands.append("mxu")
+    if n_seg > 64 and (est_distinct is None or est_distinct * 4 <= n_seg):
+        cands.append("hash")
+    return tuple(cands)
+
+
+def seed_kernel(n_seg: int, est_distinct, backend: str) -> str:
+    """Cardinality-seeded starting impl for a never-measured shape."""
+    if (
+        est_distinct is not None
+        and n_seg > 512
+        and est_distinct * 8 <= n_seg
+    ):
+        # Sparse domain: most segments provably empty — hash territory.
+        return "hash"
+    from ..ops.scan_agg import mxu_max_segments
+
+    if backend == "tpu":
+        return "mxu" if n_seg <= mxu_max_segments() else "scatter"
+    return "scatter"
+
+
+class KernelRouter:
+    """Per-(plan-shape, segment-bucket) EWMA over the segment impls.
+
+    Same discipline as PathRouter: warm each candidate (dropping its
+    compile-tainted first sample), serve the measured winner, re-probe
+    the losers round-robin every PROBE_EVERY-th call so the choice
+    adapts when conditions change. Also remembers the observed live
+    segment count per key — the feedback that sizes the hash slot table
+    and corrects a bad seed estimate."""
+
+    def __init__(self) -> None:
+        self._stats: dict = {}
+        self._lock = threading.Lock()
+
+    def _touch(self, key) -> dict:
+        st = self._stats.pop(key, None)
+        if st is None:
+            st = {"calls": 0, "n": {}, "t": {}}
+            if len(self._stats) >= MAX_KEYS:
+                self._stats.pop(next(iter(self._stats)))
+        self._stats[key] = st
+        return st
+
+    def choose(self, key, seed: str, candidates: tuple) -> str:
+        """The impl to dispatch this call with."""
+        with self._lock:
+            st = self._touch(key)
+            st["calls"] += 1
+            samples, times = st["n"], st["t"]
+            order = [seed] + [k for k in candidates if k != seed]
+            for k in order:
+                # two samples each: the first pays jit trace+compile and
+                # is dropped by record() — judging needs a clean one
+                if k in candidates and samples.get(k, 0) < 2:
+                    return k
+            measured = {k: times[k] for k in candidates if k in times}
+            if not measured:
+                return seed if seed in candidates else candidates[0]
+            winner = min(measured, key=measured.get)
+            if st["calls"] % PROBE_EVERY == 0:
+                losers = [k for k in candidates if k != winner]
+                if losers:
+                    return losers[(st["calls"] // PROBE_EVERY) % len(losers)]
+            return winner
+
+    def record(self, key, kernel: str, seconds: float) -> None:
+        """Fold a dispatch latency in: adapt DOWN instantly, creep UP by
+        10% per sample; the first sample of each impl (compile-tainted)
+        only counts, never judges."""
+        with self._lock:
+            st = self._touch(key)
+            n = st["n"][kernel] = st["n"].get(kernel, 0) + 1
+            if n == 1:
+                return  # compile-tainted
+            prev = st["t"].get(kernel)
+            st["t"][kernel] = (
+                seconds if prev is None else min(seconds, prev * 1.1)
+            )
+
+    def note_segments(self, key, live: int) -> None:
+        """Observed live (group x bucket) cells — EWMA'd so the hash
+        slot table is sized from what the shape actually produces."""
+        with self._lock:
+            st = self._touch(key)
+            prev = st.get("segments")
+            st["segments"] = (
+                int(live) if prev is None else int(0.7 * prev + 0.3 * live)
+            )
+
+    def observed_segments(self, key):
+        with self._lock:
+            st = self._stats.get(key)
+            return None if st is None else st.get("segments")
+
+    def stats(self, key) -> dict:
+        with self._lock:
+            st = self._stats.get(key, {})
+            return {
+                k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in st.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+# One process-wide router: kernel latency is a property of the hardware
+# and the shape, not of any particular executor instance — every
+# consumer (direct device path, cached path, dist-agg step) folds into
+# and serves from the same history.
+KERNEL_ROUTER = KernelRouter()
+
+
+def bootstrap_observed_segments(sql: str):
+    """Seed a never-seen router key from query_stats history: the most
+    recent finalized ledger of the same normalized SQL shape carries the
+    live segment count its aggregation produced (``agg_segments``)."""
+    if not sql:
+        return None
+    from ..utils.querystats import STATS_STORE
+    from ..wlm.admission import normalize_shape
+
+    shape = normalize_shape(sql)
+    for row in reversed(STATS_STORE.list()):
+        segs = row.get("agg_segments")
+        if segs and normalize_shape(str(row.get("sql", ""))) == shape:
+            return int(segs)
+    return None

@@ -43,6 +43,19 @@ from ..table_engine.predicate import Predicate
 _I32_MAX = 2**31 - 1
 
 
+def _cache_dtype_mode() -> str:
+    """HORAEDB_CACHE_DTYPE: f32 (default, exact), bf16 (every value
+    column halved), or auto — the learned per-column mode: a column is
+    stored bf16 only while every query shape that touched it needs just
+    count/min/max of it (sums accumulate rounding; filters compare
+    against the resident values), and promotes back to f32 the moment a
+    sum/avg/filter usage appears (ScanCache.note_usage)."""
+    import os
+
+    v = os.environ.get("HORAEDB_CACHE_DTYPE", "f32")
+    return v if v in ("f32", "bf16", "auto") else "f32"
+
+
 @dataclass
 class CachedTableScan:
     """Device-resident state for one table fingerprint."""
@@ -67,6 +80,9 @@ class CachedTableScan:
     # the mesh the big arrays are sharded over (None = single device);
     # queries on a sharded entry MUST use the shard_map cached kernel.
     mesh: object = None
+    # owning table name — keys the cache's per-column usage map (dtype
+    # auto-tuning) from extend paths that only hold the entry.
+    table_name: str = ""
     # stacked (F, padded) value arrays per column tuple — stacking is a
     # device op, so reuse the result across steady-state queries.
     _stacks: dict = None
@@ -202,6 +218,12 @@ class ScanCache:
         # consecutive eligible queries (a write-heavy table would otherwise
         # rebuild — full read + upload — on every single query).
         self._candidate: dict[str, tuple] = {}
+        # per table -> per value column: how query shapes have USED it
+        # ({"sum": bool, "filter": bool}) — drives the auto dtype choice.
+        # Sticky by design: one sum/filter usage pins the column f32 for
+        # the cache's lifetime (a later min/max-only query must not
+        # demote a column some dashboard still sums).
+        self._usage: dict[str, dict[str, dict]] = {}
         self._lock = threading.Lock()
         self.max_entries = max_entries
         if max_bytes is not None:
@@ -210,10 +232,12 @@ class ScanCache:
             from .partial import _budget_bytes
 
             self.max_bytes = _budget_bytes("HORAEDB_SCAN_CACHE_MB")
+        from ..utils.env import env_int
+
         self.max_host_rows_bytes = (
             max_host_rows_bytes
             if max_host_rows_bytes is not None
-            else int(os.environ.get("HORAEDB_CACHE_HOST_ROWS_MB", "256")) << 20
+            else env_int("HORAEDB_CACHE_HOST_ROWS_MB", 256) << 20
         )
         self.hits = 0
         self.misses = 0
@@ -221,6 +245,72 @@ class ScanCache:
     def resident_bytes(self) -> int:
         with self._lock:
             return sum(e.total_bytes() for e in self._entries.values())
+
+    # ---- learned per-column dtype ---------------------------------------
+    def note_usage(
+        self,
+        table_name: str,
+        value_columns: list[str],
+        sum_cols=(),
+        filter_cols=(),
+    ) -> None:
+        """Record how this query shape touches each value column — the
+        feedback the HORAEDB_CACHE_DTYPE=auto mode tunes dtypes from
+        ("fine-tune the data structure to the workload", arXiv
+        2112.13099). Called by the executor BEFORE the cache lookup, so
+        the very first build of an entry already stores min/max-only
+        columns as bf16. A column already resident as bf16 whose usage
+        GROWS a sum/filter is promoted: its device copy is dropped here
+        and the ordinary extend path re-uploads it f32."""
+        promote: list[str] = []
+        with self._lock:
+            usage = self._usage.get(table_name)
+            if usage is None:
+                # bound tracked tables LRU-style (dict order = recency)
+                if len(self._usage) >= 512:
+                    self._usage.pop(next(iter(self._usage)))
+                usage = self._usage[table_name] = {}
+            else:
+                self._usage[table_name] = self._usage.pop(table_name)
+            for c in value_columns:
+                u = usage.setdefault(c, {"sum": False, "filter": False})
+                was_exact = u["sum"] or u["filter"]
+                u["sum"] |= c in sum_cols
+                u["filter"] |= c in filter_cols
+                if (u["sum"] or u["filter"]) and not was_exact:
+                    promote.append(c)
+            entry = self._entries.get(table_name)
+        if promote and entry is not None and _cache_dtype_mode() == "auto":
+            self._drop_bf16_columns(entry, promote)
+
+    def _column_dtype(self, table_name: str, column: str):
+        """Resident dtype for one value column under the current mode."""
+        mode = _cache_dtype_mode()
+        if mode == "bf16":
+            return jnp.bfloat16
+        if mode == "auto":
+            with self._lock:
+                u = self._usage.get(table_name, {}).get(column)
+            # unknown usage -> exact: auto must never guess lossy
+            if u is not None and not (u["sum"] or u["filter"]):
+                return jnp.bfloat16
+        return jnp.float32
+
+    @staticmethod
+    def _drop_bf16_columns(entry: CachedTableScan, columns) -> None:
+        """Evict now-stale bf16 device copies so the extend path
+        re-uploads them at f32 (may force an SST re-read if the host
+        rows were dropped — correctness over residency)."""
+        with entry.ext_lock:
+            for c in columns:
+                dev = entry.value_cols_dev.get(c)
+                if dev is None or dev.dtype != jnp.bfloat16:
+                    continue
+                entry.value_cols_dev.pop(c)
+                entry.device_bytes -= dev.nbytes
+                entry._stacks = None
+                if entry.series_value_stats is not None:
+                    entry.series_value_stats.pop(c, None)
 
     def _evict_over_budget_locked(self, keep: str) -> None:
         """Evict least-recently-used entries (never ``keep``) until both
@@ -323,7 +413,9 @@ class ScanCache:
         host_est = min(_rowgroup_bytes(rows), self.max_host_rows_bytes)
         if est + host_est > self.max_bytes:
             return None, False, None
-        entry = self._build(base_fp, rows, min_ts, max_ts, value_columns)
+        entry = self._build(
+            base_fp, rows, min_ts, max_ts, value_columns, table.name
+        )
         entry.built_seqs = seq_after
         with self._lock:
             self.misses += 1
@@ -349,7 +441,13 @@ class ScanCache:
         return rows.take(order), uniq, inverse[order]
 
     def _build(
-        self, fp, rows: RowGroup, min_ts: int, max_ts: int, value_columns: list[str]
+        self,
+        fp,
+        rows: RowGroup,
+        min_ts: int,
+        max_ts: int,
+        value_columns: list[str],
+        table_name: str = "",
     ) -> CachedTableScan:
         n = len(rows)
         schema = rows.schema
@@ -407,6 +505,7 @@ class ScanCache:
             ts_rel_dev=ts_dev,
             value_cols_dev={},
             mesh=mesh,
+            table_name=table_name,
             series_tsids=uniq,
             series_offsets=offsets,
         )
@@ -460,8 +559,6 @@ class ScanCache:
         read_rows=None,
         table=None,
     ) -> bool:
-        import os
-
         import jax
 
         missing = [c for c in value_columns if c not in entry.value_cols_dev]
@@ -502,20 +599,18 @@ class ScanCache:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             place = NamedSharding(entry.mesh, P("shard"))
-        # HORAEDB_CACHE_DTYPE=bf16 halves resident HBM for value columns
+        # HORAEDB_CACHE_DTYPE: bf16 halves resident HBM for value columns
         # (the kernels upcast to f32 for accumulation — on TPU the cast is
         # free on the vector units, the win is bandwidth/capacity). Costs
         # ~3 significant digits on stored samples, INCLUDING values that
         # numeric filters compare against — rows within bf16 rounding of
         # a filter threshold may classify differently than the host path.
-        # Default stays f32; opt in where approximate serving is fine.
-        dtype = (
-            jnp.bfloat16
-            if os.environ.get("HORAEDB_CACHE_DTYPE", "f32") == "bf16"
-            else jnp.float32
-        )
+        # Default stays f32; "bf16" opts every column in; "auto" tunes
+        # per column from observed usage (_column_dtype: min/max-only
+        # columns shrink, summed/filtered columns stay exact).
         for c in value_columns:
             if c not in entry.value_cols_dev:
+                dtype = self._column_dtype(entry.table_name, c)
                 # entry.rows is already in the sorted resident layout;
                 # dtype conversion happens on HOST so the sharded
                 # device_put transfers straight to each shard (no staging

@@ -149,6 +149,7 @@ def _query_stats_schema() -> Schema:
         ColumnSchema("request_id", DatumKind.UINT64, is_nullable=False),
         ColumnSchema("sql", DatumKind.STRING),
         ColumnSchema("route", DatumKind.STRING),
+        ColumnSchema("kernel", DatumKind.STRING),
         ColumnSchema("duration_ms", DatumKind.DOUBLE),
     ]
     cols += [ColumnSchema(f, DatumKind.INT64) for f in NUMERIC_FIELDS]
@@ -195,6 +196,9 @@ class QueryStatsTable(_VirtualTable):
             "request_id": ints("request_id").astype(np.uint64),
             "sql": np.array([str(e.get("sql", "")) for e in entries], dtype=object),
             "route": np.array([str(e.get("route", "")) for e in entries], dtype=object),
+            "kernel": np.array(
+                [str(e.get("kernel", "")) for e in entries], dtype=object
+            ),
             "duration_ms": np.array(
                 [float(e.get("duration_ms", 0.0)) for e in entries], dtype=np.float64
             ),

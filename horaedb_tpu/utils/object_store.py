@@ -343,7 +343,9 @@ class DiskCacheStore(ObjectStore):
             if self._pool is None:
                 from concurrent.futures import ThreadPoolExecutor
 
-                n = int(os.environ.get("HORAEDB_CACHE_FETCH_THREADS", "8"))
+                from .env import env_int
+
+                n = env_int("HORAEDB_CACHE_FETCH_THREADS", 8)
                 self._pool = ThreadPoolExecutor(
                     max_workers=n, thread_name_prefix="diskcache-fetch",
                 )

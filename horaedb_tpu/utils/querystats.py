@@ -62,6 +62,10 @@ NUMERIC_FIELDS: dict[str, str] = {
     # which side of the coalescing they were on
     "dedup_followers": "identical in-flight twins this leader execution served",
     "dedup_follower": "1 when this query awaited an identical in-flight leader",
+    # kernel-routing feedback: how many (group x bucket) cells the device
+    # aggregation actually produced — the cardinality truth the kernel
+    # router seeds from on the next sighting of the shape
+    "agg_segments": "live segment cells the device aggregation produced",
 }
 
 # wall-time costs; seconds, float.
@@ -94,6 +98,42 @@ def _route_counter(route: str):
     )
 
 
+# ---- aggregation-kernel accounting ----------------------------------------
+
+# Which segment-reduction impl served a device aggregation (the learned
+# kernel router's choice, or the static heuristic's). "single" is the
+# n_seg == 1 pure-reduction shape; "host" the tiny-input hash fallback.
+SEGMENT_KERNEL_LABELS = ("mxu", "scatter", "hash", "single", "host")
+
+# Registry discipline (lint-enforced like the admission/flush families):
+# declared here, registered eagerly, documented in docs/OBSERVABILITY.md,
+# and no stray horaedb_agg_* family may exist outside this tuple.
+AGG_KERNEL_METRIC_FAMILIES = ("horaedb_agg_kernel_total",)
+
+_AGG_KERNEL_COUNTERS = {
+    k: REGISTRY.counter(
+        "horaedb_agg_kernel_total",
+        "device aggregation dispatches by segment-reduction kernel",
+        labels={"kernel": k},
+    )
+    for k in SEGMENT_KERNEL_LABELS
+}
+
+
+def note_agg_kernel(kernel: str, segments: int = 0) -> None:
+    """Account one aggregation dispatch: bump the per-kernel family,
+    stamp the ledger's ``kernel`` field, and record the live segment
+    count the kernel router learns cardinality from."""
+    counter = _AGG_KERNEL_COUNTERS.get(kernel)
+    if counter is not None:
+        counter.inc()
+    ledger = _current_ledger.get()
+    if ledger is not None:
+        ledger.set_kernel(kernel)
+        if segments:
+            ledger.add(agg_segments=segments)
+
+
 # ---- ledger ---------------------------------------------------------------
 
 
@@ -101,12 +141,14 @@ class QueryLedger:
     """One request's accumulating cost counters. Thread-safe: the scatter
     pool and gRPC client callbacks add from several threads at once."""
 
-    __slots__ = ("request_id", "sql", "route", "counts", "started_at", "_lock")
+    __slots__ = ("request_id", "sql", "route", "kernel", "counts",
+                 "started_at", "_lock")
 
     def __init__(self, request_id=None, sql: str = "") -> None:
         self.request_id = request_id
         self.sql = sql
         self.route = ""  # last executor path taken (one of the six)
+        self.kernel = ""  # last segment-reduction impl dispatched
         self.counts: dict[str, float] = dict.fromkeys(LEDGER_FIELDS, 0)
         self.started_at = time.time()
         self._lock = threading.Lock()
@@ -120,6 +162,9 @@ class QueryLedger:
     def set_route(self, route: str) -> None:
         self.route = route
 
+    def set_kernel(self, kernel: str) -> None:
+        self.kernel = kernel
+
     def merge_remote(self, remote: Optional[dict]) -> None:
         """Fold a partition owner's shipped ledger into this one (numeric
         fields only — the owner's route is a sub-plan detail)."""
@@ -128,6 +173,9 @@ class QueryLedger:
         counts = remote.get("counts")
         if not isinstance(counts, dict):
             return
+        if not self.kernel and isinstance(remote.get("kernel"), str):
+            # partition owners ran the kernels; the coordinator did not
+            self.kernel = remote["kernel"]
         with self._lock:
             for k, v in counts.items():
                 if k in self.counts and isinstance(v, (int, float)):
@@ -136,7 +184,7 @@ class QueryLedger:
     def to_dict(self) -> dict:
         with self._lock:
             counts = dict(self.counts)
-        return {"route": self.route, "counts": counts}
+        return {"route": self.route, "kernel": self.kernel, "counts": counts}
 
     def nonzero(self) -> dict[str, float]:
         """Fields with activity — what EXPLAIN ANALYZE / slow log print."""
@@ -193,6 +241,7 @@ def finish_ledger(ledger: QueryLedger, token, duration_s: float,
         "request_id": ledger.request_id,
         "sql": ledger.sql[:200],
         "route": ledger.route,
+        "kernel": ledger.kernel,
         "duration_ms": round(duration_s * 1000, 3),
         **ledger.counts,
     }
@@ -293,6 +342,8 @@ def render_ledger(ledger: QueryLedger) -> str:
     parts = []
     if ledger.route:
         parts.append(f"route={ledger.route}")
+    if ledger.kernel:
+        parts.append(f"kernel={ledger.kernel}")
     for k, v in ledger.nonzero().items():
         if isinstance(v, float) and not v.is_integer():
             parts.append(f"{k}={v:.4f}")

@@ -923,6 +923,65 @@ class TestMetricsNameLint:
                 missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
         assert not missing, missing
 
+    def test_agg_kernel_family_declared_and_documented(self):
+        """PR-6 lint extension (same contract as the admission/flush
+        registries): the horaedb_agg_kernel_total family declared in
+        querystats.AGG_KERNEL_METRIC_FAMILIES must be (a) registered
+        live with every SEGMENT_KERNEL_LABELS label, (b)
+        convention-clean, (c) documented in docs/OBSERVABILITY.md along
+        with the `kernel` query_stats column — and no stray
+        horaedb_agg_* family may exist outside the declared registry.
+        The router/kernel knobs are operator surface: pinned to
+        docs/WORKLOAD.md."""
+        import os
+        import re
+
+        from horaedb_tpu.table_engine.system import _QUERY_STATS_SCHEMA
+        from horaedb_tpu.utils.metrics import REGISTRY
+        from horaedb_tpu.utils.querystats import (
+            AGG_KERNEL_METRIC_FAMILIES,
+            SEGMENT_KERNEL_LABELS,
+        )
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in AGG_KERNEL_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/OBSERVABILITY.md")
+        for kernel in SEGMENT_KERNEL_LABELS:
+            if f'kernel="{kernel}"' not in exposed:
+                missing.append(f"label kernel={kernel}: not eagerly registered")
+        for fam in families:
+            if fam.startswith("horaedb_agg_") and \
+                    fam not in AGG_KERNEL_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        # the kernel column + agg_segments field ride the query_stats
+        # schema; the `kernel` column is not a LEDGER_FIELD (string, not
+        # numeric) so pin it explicitly
+        columns = {c.name for c in _QUERY_STATS_SCHEMA.columns}
+        if "kernel" not in columns:
+            missing.append("kernel: no query_stats column")
+        if "`kernel`" not in docs:
+            missing.append("kernel: undocumented in docs/OBSERVABILITY.md")
+        for knob in (
+            "HORAEDB_SEGMENT_IMPL", "HORAEDB_KERNEL_ROUTER",
+            "HORAEDB_MXU_MAX_SEGMENTS", "HORAEDB_HASH_MAX_SLOTS",
+            "HORAEDB_HASH_PROBE_ROUNDS", "HORAEDB_HASH_HOST_MAX_ROWS",
+            "HORAEDB_CACHE_DTYPE",
+        ):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
         and horaedb_wal_* families after a flush+compaction cycle."""
