@@ -23,6 +23,7 @@ from .encoding import (
     shape_bucket,
 )
 from .scan_agg import AGG_OPS, ScanAggSpec, scan_aggregate
+from .scan_topk import RawScanSpec
 from .merge_dedup import merge_dedup_permutation
 
 __all__ = [
@@ -32,6 +33,7 @@ __all__ = [
     "shape_bucket",
     "AGG_OPS",
     "ScanAggSpec",
+    "RawScanSpec",
     "scan_aggregate",
     "merge_dedup_permutation",
 ]

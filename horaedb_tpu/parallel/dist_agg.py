@@ -86,39 +86,53 @@ def _resolved(spec: ScanAggSpec) -> ScanAggSpec:
     return dataclasses.replace(spec, segment_impl=impl)
 
 
-def _build_step(mesh: Mesh, spec: ScanAggSpec, tag: str, body, in_specs) -> Callable:
-    """shard_map(body)+combine, jitted and cached per (mesh, spec, tag)."""
-    spec = _resolved(spec)
-    cache_key = (mesh, spec, tag)
+def cached_step(cache_key, build) -> Callable:
+    """THE compiled-step LRU: get-or-build under the lock, bounded at
+    PathRouter.MAX_KEYS, dict insertion order = recency. One discipline
+    for every shard_map step cache (the agg steps here, the raw-read
+    steps in parallel/dist_raw) — distinct key spaces share one bound."""
     with _STEP_LOCK:
         cached = _STEP_CACHE.pop(cache_key, None)
         if cached is not None:
             _STEP_CACHE[cache_key] = cached  # LRU touch
             return cached
-    static_filters = encode_filter_ops(spec.numeric_filters)
-
-    def per_shard(*args):
-        return _combine(
-            body(
-                *args,
-                n_groups=spec.n_groups,
-                n_buckets=spec.n_buckets,
-                n_agg_fields=spec.n_agg_fields,
-                numeric_filters=static_filters,
-                need_minmax=spec.need_minmax,
-                segment_impl=spec.segment_impl,
-                hash_slots=spec.hash_slots,
-            )
-        )
-
-    step = jax.jit(
-        shard_map(per_shard, mesh=mesh, in_specs=in_specs, out_specs=(P(), P(), P(), P()))
-    )
+    step = build()
     with _STEP_LOCK:
         while len(_STEP_CACHE) >= _step_cache_max():
             _STEP_CACHE.pop(next(iter(_STEP_CACHE)))
         _STEP_CACHE[cache_key] = step
     return step
+
+
+def _build_step(mesh: Mesh, spec: ScanAggSpec, tag: str, body, in_specs) -> Callable:
+    """shard_map(body)+combine, jitted and cached per (mesh, spec, tag)."""
+    spec = _resolved(spec)
+
+    def build():
+        static_filters = encode_filter_ops(spec.numeric_filters)
+
+        def per_shard(*args):
+            return _combine(
+                body(
+                    *args,
+                    n_groups=spec.n_groups,
+                    n_buckets=spec.n_buckets,
+                    n_agg_fields=spec.n_agg_fields,
+                    numeric_filters=static_filters,
+                    need_minmax=spec.need_minmax,
+                    segment_impl=spec.segment_impl,
+                    hash_slots=spec.hash_slots,
+                )
+            )
+
+        return jax.jit(
+            shard_map(
+                per_shard, mesh=mesh, in_specs=in_specs,
+                out_specs=(P(), P(), P(), P()),
+            )
+        )
+
+    return cached_step((mesh, spec, tag), build)
 
 
 def make_dist_scan_agg(mesh: Mesh, spec: ScanAggSpec) -> Callable:

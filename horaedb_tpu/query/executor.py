@@ -509,6 +509,52 @@ class Executor:
                 return self._finish_metrics(m, t_start, "dist-plan", out)
         from ..utils.tracectx import span as _span
 
+        # Raw (non-aggregate) reads: the same HBM-serving treatment the
+        # aggregate paths got — fused filter + top-k / bounded selection
+        # over the scan cache, returning only row indices to gather.
+        # Routed by the SAME PathRouter learned discipline (probe device
+        # vs host per plan shape, serve the winner, re-probe).
+        raw_eligible = False
+        raw_attempted = False
+        if (
+            not plan.is_aggregate
+            and cache_on
+            and not hasattr(table, "sub_tables")
+            and table.physical_datas()
+        ):
+            raw_shape = self._raw_device_shape(plan)
+            # LIMIT-pushdown-safe plans (no residual, no ORDER BY) stop
+            # the host scan at LIMIT rows — near O(limit) by
+            # construction; the device path cannot beat that.
+            if raw_shape is not None and not self._limit_pushdown_safe(plan):
+                raw_eligible = True
+                from ..ops.scan_topk import raw_device_enabled
+
+                raw_route = None
+                if raw_device_enabled():
+                    # Unlike the aggregate paths (where the device kernel
+                    # wins on every backend and only the DISPATCH cost is
+                    # in question), raw device-vs-host is an empirical
+                    # race everywhere — the host path's early-exit scan
+                    # and the kernel's O(n) masked passes cross over with
+                    # table size, selectivity, and backend. Always route
+                    # through the learned PathRouter; only the explicit
+                    # HORAEDB_ADAPTIVE_PATH=0 override pins device-first.
+                    from .path_router import plan_shape_key, raw_adaptive_enabled
+
+                    if raw_adaptive_enabled():
+                        key = plan_shape_key(plan)
+                        raw_route = self.path_router.choose(key)
+                        m["_adaptive_key"] = key
+                        m["route"] = raw_route
+                    if raw_route != "host":
+                        raw_attempted = True
+                        out = self._try_raw_device(plan, table, raw_shape, m)
+                        if out is not None:
+                            return self._finish_metrics(
+                                m, t_start, "raw_device", out
+                            )
+
         t_scan = _time.perf_counter()
         projection = self._projection(plan)
         predicate = plan.predicate
@@ -543,8 +589,12 @@ class Executor:
                 out = self._execute_agg_host(plan, rows)
         else:
             path = "host"
+            if raw_eligible and not raw_attempted:
+                # eligible but never dispatched (kill switch or the
+                # router chose host): attribute the serve honestly
+                querystats.note_raw_scan("host")
             with _span("project"):
-                out = self._execute_projection(plan, rows)
+                out = self._execute_projection(plan, rows, m)
         return self._finish_metrics(m, t_start, path, out)
 
     def _finish_metrics(
@@ -559,9 +609,14 @@ class Executor:
         # served the request (the cost side of the span tree).
         querystats.set_route(path)
         akey = m.pop("_adaptive_key", None)
+        raw_fellback = bool(m.pop("_raw_fallback", False))
         if akey is not None and m.get("cache") != "build":
-            # one-off cache-build cost must not poison the device estimate
-            kind = "host" if path == "host" else "device"
+            # one-off cache-build cost must not poison the device estimate;
+            # a raw attempt that bounced to host charges the DEVICE arm
+            # (attempt + host serve — see _try_raw_device)
+            kind = (
+                "device" if raw_fellback or path != "host" else "host"
+            )
             self.path_router.record(akey, kind, _time.perf_counter() - t_start)
         out.metrics = m
         # Observability conveniences; atomic rebinds (read-only snapshots
@@ -685,86 +740,17 @@ class Executor:
         measured winner with periodic re-probes. Returns (spec, token);
         token is None when routing doesn't apply (n_seg == 1, pinned
         HORAEDB_SEGMENT_IMPL, or router disabled)."""
-        from ..ops.scan_agg import pinned_segment_impl
-        from .path_router import (
-            KERNEL_ROUTER,
-            bootstrap_observed_segments,
-            candidate_kernels,
-            kernel_routing_enabled,
-            plan_shape_key,
-            seed_kernel,
+        from .path_router import plan_shape_key
+
+        ledger = querystats.current_ledger()
+        return route_segment_kernel(
+            plan_shape_key(plan), spec, n_rows, est_distinct,
+            sql=ledger.sql if ledger else "",
         )
-
-        n_seg = spec.n_groups * spec.n_buckets
-        if n_seg <= 1 or pinned_segment_impl() or not kernel_routing_enabled():
-            return spec, None
-        key = (plan_shape_key(plan), n_seg.bit_length())
-        obs = KERNEL_ROUTER.observed_segments(key)
-        if obs is None:
-            # never-seen key: the query_stats ring may remember how many
-            # live segments this SQL shape produced before (agg_segments)
-            ledger = querystats.current_ledger()
-            obs = bootstrap_observed_segments(ledger.sql if ledger else "")
-            if obs is not None:
-                KERNEL_ROUTER.note_segments(key, obs)
-        est = obs if obs is not None else est_distinct
-        if est is not None:
-            est = max(1, min(int(est), n_seg, max(int(n_rows), 1)))
-        import dataclasses
-
-        import jax
-
-        from ..ops.hash_agg import hash_slots_for
-
-        impl = KERNEL_ROUTER.choose(
-            key,
-            seed_kernel(n_seg, est, jax.default_backend()),
-            candidate_kernels(n_seg, n_rows, est),
-        )
-        spec = dataclasses.replace(
-            spec,
-            segment_impl=impl,
-            hash_slots=hash_slots_for(n_seg, est) if impl == "hash" else 0,
-        )
-        return spec, (key, impl)
 
     def _finish_kernel(self, krec, spec, m: dict, state,
                        seconds: float, n_valid=None) -> None:
-        """Close one aggregation dispatch: feed the router's EWMA and
-        observed-cardinality loop, stamp the metric tree, the ledger
-        ``kernel`` field, and the horaedb_agg_kernel_total family."""
-        from ..ops.scan_agg import (
-            pinned_segment_impl,
-            resolve_segment_impl,
-        )
-        from .path_router import KERNEL_ROUTER
-
-        n_seg = spec.n_groups * spec.n_buckets
-        impl = resolve_segment_impl(n_seg, spec.segment_impl)
-        live = int((state.counts > 0).sum())
-        if krec is not None and live > 0:
-            # Degenerate dispatches (empty time range, filter matching
-            # nothing) are excluded from BOTH feedback loops: their
-            # near-zero latency would make whichever impl served them
-            # look unbeatable under the min-biased estimator, and a
-            # live count of 0 would EWMA the cardinality estimate toward
-            # a tiny hash table the next real query overflows.
-            key, routed = krec
-            # the honest cost of CHOOSING this impl for the shape —
-            # including the tiny-input host fallback when hash took it
-            KERNEL_ROUTER.record(key, routed, seconds)
-            KERNEL_ROUTER.note_segments(key, live)
-        if (
-            impl == "hash"
-            and n_valid is not None
-            and not pinned_segment_impl()
-        ):
-            from ..utils.env import env_int
-
-            if n_valid <= env_int("HORAEDB_HASH_HOST_MAX_ROWS", 4096):
-                impl = "host"  # scan_aggregate's dispatch-free arm
-        m["kernel"] = impl
-        querystats.note_agg_kernel(impl, segments=live)
+        finish_segment_kernel(krec, spec, m, state, seconds, n_valid)
 
     # ---- device path -------------------------------------------------------
     def _agg_device_shape(self, plan: QueryPlan):
@@ -1366,6 +1352,396 @@ class Executor:
             np.minimum.at(state.mins[fi], (g, b), v)
             np.maximum.at(state.maxs[fi], (g, b), v)
 
+    # ---- device raw reads (non-aggregate over the HBM scan cache) ----------
+    def _raw_device_shape(self, plan: QueryPlan) -> Optional[dict]:
+        """Shape descriptor when a non-aggregate plan fits the device
+        raw-read kernels, else None. Eligibility mirrors the cached agg
+        path: the residual WHERE must decompose into series-level
+        (tag-only) conjuncts + numeric float-field comparisons.
+
+        ``topk_ok`` marks the stricter sub-shape the top-k kernel can
+        serve (single ORDER BY key on ts or a float column, LIMIT
+        present, no DISTINCT/window — those need the complete row set);
+        everything else eligible runs as a bounded selection, whose
+        complete passing set makes ANY downstream projection exact."""
+        stmt = plan.select
+        if plan.is_aggregate or stmt.group_by or stmt.join is not None:
+            return None
+        schema = plan.schema
+        if schema.tsid_index is None:
+            return None
+        device_filters, other = self._split_residual_filters(plan)
+        tag_names = set(schema.tag_names)
+        series_filters: list = []
+        for conj in other:
+            if _is_series_conjunct(conj, tag_names):
+                series_filters.append(conj)
+            else:
+                return None
+        order = None  # (column, is_ts, ascending)
+        topk_ok = False
+        if len(stmt.order_by) == 1 and stmt.limit is not None:
+            o = stmt.order_by[0]
+            expr = o.expr
+            aliases = {
+                item.alias: item.expr for item in stmt.items if item.alias
+            }
+            if (
+                isinstance(expr, ast.Column)
+                and expr.name in aliases
+                and not schema.has_column(expr.name)
+            ):
+                expr = aliases[expr.name]
+            if isinstance(expr, ast.Column) and schema.has_column(expr.name):
+                name = expr.name
+                if name == schema.timestamp_name:
+                    order = (name, True, o.ascending)
+                elif schema.column(name).kind.is_float:
+                    order = (name, False, o.ascending)
+            if order is not None and not stmt.distinct:
+                from .planner import _walk
+
+                topk_ok = not any(
+                    isinstance(e, ast.WindowFunc)
+                    for item in stmt.items
+                    for e in _walk(item.expr)
+                )
+        return {
+            "device_filters": device_filters,
+            "series_filters": series_filters,
+            "order": order,
+            "topk_ok": topk_ok,
+        }
+
+    def _try_raw_device(
+        self, plan: QueryPlan, table, shape: dict, m: dict
+    ) -> Optional[ResultSet]:
+        out = self._try_raw_device_inner(plan, table, shape, m)
+        if out is None and "_adaptive_key" in m:
+            # A bounced attempt must still feed the router's DEVICE arm:
+            # the serve falls through to host, but recording it as a
+            # host sample would leave device_n < 2 forever — the router
+            # would stay in its probe phase and re-pay the failed
+            # attempt (cache lookup, per-series filters, eligibility)
+            # on every single query. Charged as device, the attempt+host
+            # total can only measure >= the pure host arm, so a shape
+            # that persistently bounces converges to the host route.
+            m["_raw_fallback"] = True
+        return out
+
+    def _try_raw_device_inner(
+        self, plan: QueryPlan, table, shape: dict, m: dict
+    ) -> Optional[ResultSet]:
+        """Serve a non-aggregate read from device-resident scan state,
+        or None (caller falls through to the host projection path).
+
+        The kernels return only ROW INDICES (<= k for top-k, <= the
+        HORAEDB_RAW_MAX_ROWS budget for selections); the host gathers
+        those rows from the entry's resident copy, folds the unflushed
+        memtable delta (filtered exactly on host), and runs the ordinary
+        projection machinery over the small candidate set — so ORDER BY
+        ties, NULL ranks, aliases and expressions behave exactly like
+        the host path."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.scan_agg import encode_filter_ops
+        from ..ops.scan_topk import (
+            RawScanSpec,
+            pack_raw_dyn,
+            padded_k,
+            padded_select_slots,
+            raw_max_rows,
+            raw_select_packed,
+            raw_topk_packed,
+            topk_key_bounds,
+        )
+        from ..utils.tracectx import span as _span
+
+        device_filters = shape["device_filters"]
+        series_filters = shape["series_filters"]
+        order = shape["order"]
+        stmt = plan.select
+
+        filter_cols = [f[0] for f in device_filters]
+        key_col = order[0] if order is not None and not order[1] else None
+        value_names = list(
+            dict.fromkeys(filter_cols + ([key_col] if key_col else []))
+        )
+        # Filters/sort keys compare against the RESIDENT values — bf16
+        # residency would reclassify rows near thresholds, so raw usage
+        # pins these columns f32 (same contract as agg filter columns).
+        self.scan_cache.note_usage(
+            table.name, value_names, sum_cols=(),
+            filter_cols=set(value_names),
+        )
+        entry, built, delta = self.scan_cache.get(
+            table, value_names,
+            read_rows=lambda: table.read(Predicate.all_time()),
+        )
+        if entry is None or delta is None:
+            querystats.record(cache_misses=1)
+            querystats.note_raw_scan("fallback")
+            return None
+        # The selected rows gather from the entry's HOST copy; entries
+        # whose host rows were dropped under the budget can't serve raw.
+        if entry.rows is None:
+            querystats.note_raw_scan("fallback")
+            return None
+        # NULLs in a filtered/sorted column: the resident column holds
+        # the fill value where the host path 3-value NULL-compares.
+        for c in value_names:
+            if not entry.all_valid.get(c, False):
+                querystats.note_raw_scan("fallback")
+                return None
+        if len(delta) and not self._raw_delta_sound(table, entry, delta):
+            querystats.note_raw_scan("fallback")
+            return None
+
+        # Series allow-list (tag filters, per series on host) + value-
+        # stat pruning. Unlike the agg path the pruned list IS the allow
+        # list: the delta never consults it (filtered exactly below).
+        S = entry.n_series
+        allowed = np.ones(S, dtype=bool)
+        for conj in series_filters:
+            v, valid = eval_expr(conj, entry.series_rows)
+            allowed &= np.asarray(as_values(v)).astype(bool) & valid
+        stats = entry.series_value_stats or {}
+        for col, op, lit in device_filters:
+            st = stats.get(col)
+            if st is None:
+                continue
+            could = _series_could_match(st[0], st[1], op, lit)
+            if could is not None:
+                allowed = allowed & could
+
+        tr = plan.predicate.time_range
+        lo = max(tr.inclusive_start, entry.min_ts)
+        hi = min(tr.exclusive_end, entry.max_ts + 1)
+        empty_range = hi <= lo or not allowed.any()
+        lo_rel = lo - entry.min_ts if not empty_range else 0
+        hi_rel = hi - entry.min_ts if not empty_range else 0
+
+        budget = raw_max_rows()
+        limit = stmt.limit
+        offset = stmt.offset or 0
+        estimate = None
+        if shape["topk_ok"] and limit + offset <= budget:
+            kind = "topk"
+        else:
+            estimate = (
+                self._raw_candidate_estimate(entry, allowed, lo_rel, hi_rel)
+                if not empty_range
+                else 0
+            )
+            if estimate > budget:
+                # deliberate selectivity-based route: the host serves
+                querystats.note_raw_scan("host")
+                return None
+            kind = "select"
+
+        # Eligibility confirmed — record cache facts (a bail-out above
+        # must not leave 'cache' lying in a host-path metric tree).
+        m["cache"] = "build" if built else ("hit+delta" if len(delta) else "hit")
+        m["rows_scanned"] = entry.n_valid + len(delta)
+        querystats.record(scan_rows=entry.n_valid + len(delta))
+        if built:
+            querystats.record(cache_misses=1)
+        else:
+            querystats.record(cache_hits=1, cache_bytes=entry.device_bytes)
+        if len(delta):
+            m["delta_rows"] = len(delta)
+            querystats.record(memtable_rows=len(delta))
+
+        literals = [lit for _, _, lit in device_filters]
+        nfilters = tuple(
+            (value_names.index(c), op) for c, op, _ in device_filters
+        )
+        idx = np.empty(0, dtype=np.int64)
+        t_kernel = _time.perf_counter()
+        if not empty_range:
+            values_dev = entry.values_for(value_names)
+            allow_arr = np.append(allowed, False)  # pad series masked
+            n_dev = int(entry.mesh.devices.size) if entry.mesh is not None else 1
+            if kind == "topk":
+                k = padded_k(entry.n_valid, limit + offset)
+                if entry.mesh is not None:
+                    # per-shard k is bounded by the shard length; a shard
+                    # smaller than k contributes ALL its rows — still a
+                    # superset of the global top-k
+                    k = min(k, len(entry.series_codes_dev) // n_dev)
+                spec = RawScanSpec(
+                    k=k,
+                    descending=not order[2],
+                    key_is_ts=order[1],
+                    numeric_filters=nfilters,
+                    key_field=(
+                        value_names.index(order[0]) if not order[1] else 0
+                    ),
+                )
+            else:
+                spec = RawScanSpec(
+                    select_slots=padded_select_slots(max(estimate or 1, 1)),
+                    numeric_filters=nfilters,
+                )
+            kernel_key = (
+                "raw", kind, n_dev, spec.k, spec.select_slots,
+                spec.descending, spec.key_is_ts, spec.key_field, nfilters,
+            )
+            key_lo = key_hi = 0
+            if kind == "topk":
+                key_lo, key_hi = topk_key_bounds(
+                    spec.descending, spec.key_is_ts, lo_rel, hi_rel
+                )
+            if entry.mesh is not None:
+                from ..parallel.dist_raw import dist_raw_select, dist_raw_topk
+
+                m["mesh_devices"] = n_dev
+                if kind == "topk":
+                    idx = dist_raw_topk(
+                        entry.mesh, spec, entry.series_codes_dev,
+                        entry.ts_rel_dev, values_dev,
+                        jnp.asarray(allow_arr), literals, lo_rel, hi_rel,
+                        key_lo, key_hi, need=limit + offset,
+                    )
+                else:
+                    idx, total = dist_raw_select(
+                        entry.mesh, spec, entry.series_codes_dev,
+                        entry.ts_rel_dev, values_dev,
+                        jnp.asarray(allow_arr), literals, lo_rel, hi_rel,
+                    )
+                    if total > len(idx):
+                        self._raw_bail(m)
+                        return None
+            else:
+                session_dev = entry.raw_session_for(allow_arr)
+                dyn = jnp.asarray(
+                    pack_raw_dyn(literals, lo_rel, hi_rel, key_lo, key_hi)
+                )
+                if kind == "topk":
+                    packed = raw_topk_packed(
+                        entry.series_codes_dev, entry.ts_rel_dev,
+                        values_dev, session_dev, dyn,
+                        k=spec.k, descending=spec.descending,
+                        key_is_ts=spec.key_is_ts, key_field=spec.key_field,
+                        numeric_filters=encode_filter_ops(nfilters),
+                    )
+                    got = np.asarray(jax.device_get(packed))
+                    idx = got[got >= 0]
+                else:
+                    packed = raw_select_packed(
+                        entry.series_codes_dev, entry.ts_rel_dev,
+                        values_dev, session_dev, dyn,
+                        select_slots=spec.select_slots,
+                        numeric_filters=encode_filter_ops(nfilters),
+                    )
+                    got = np.asarray(jax.device_get(packed))
+                    total = int(got[0])
+                    if total > spec.select_slots:
+                        self._raw_bail(m)
+                        return None
+                    idx = got[1 : 1 + total]
+            querystats.note_kernel_dispatch(
+                kernel_key, _time.perf_counter() - t_kernel
+            )
+
+        base = (
+            entry.rows.take(np.asarray(idx, dtype=np.int64))
+            if len(idx)
+            else entry.rows.slice(0, 0)
+        )
+        combined = base
+        if len(delta):
+            d_rows = self._raw_delta_rows(plan, delta)
+            if len(d_rows):
+                combined = RowGroup.concat([base, d_rows])
+        m["raw_kernel"] = kind
+        m["raw_candidates"] = int(len(idx))
+        with _span("raw_project", table=plan.table):
+            out = self._execute_projection(plan, combined, m)
+        querystats.note_raw_scan(
+            kind + ("_dist" if entry.mesh is not None else ""),
+            kernel="raw_" + kind,
+            rows=out.num_rows,
+        )
+        return out
+
+    @staticmethod
+    def _raw_bail(m: dict) -> None:
+        """A device attempt bounced AFTER the cache facts were stamped
+        (the can't-happen selection overflow): scrub them so the host
+        serve's metric tree doesn't claim a cache it didn't use."""
+        for k in ("cache", "rows_scanned", "delta_rows", "mesh_devices"):
+            m.pop(k, None)
+        querystats.note_raw_scan("fallback")
+
+    def _raw_candidate_estimate(
+        self, entry, allowed: np.ndarray, lo_rel: int, hi_rel: int
+    ) -> int:
+        """EXACT count of resident rows in allowed series within the
+        relative time range, ignoring numeric filters (which only
+        shrink it) — the bound that gates the selection buffer, so the
+        device compaction can never truncate. O(S log rows) host work
+        over the per-series sorted ranges."""
+        if not allowed.any():
+            return 0
+        ts_rel = entry.ts_rel_host
+        full_range = lo_rel <= 0 and (
+            len(ts_rel) == 0 or hi_rel > int(ts_rel.max())
+        )
+        if allowed.all() and full_range:
+            return entry.n_valid
+        offsets = entry.series_offsets
+        total = 0
+        for s in np.nonzero(allowed)[0]:
+            s0, s1 = int(offsets[s]), int(offsets[s + 1])
+            if full_range:
+                total += s1 - s0
+            else:
+                a = np.searchsorted(ts_rel[s0:s1], lo_rel, "left")
+                b = np.searchsorted(ts_rel[s0:s1], hi_rel, "left")
+                total += int(b - a)
+        return total
+
+    def _raw_delta_sound(self, table, entry, delta) -> bool:
+        """May the unflushed delta be UNIONED with the cached base for a
+        raw read? APPEND tables: always (duplicates are data). OVERWRITE
+        tables: only when no delta row can shadow a base row (strictly
+        newer timestamps) nor another delta row (unique keys within the
+        delta) — the union would otherwise return a stale base row
+        beside its overwrite. New series in the delta are fine: raw
+        reads filter the delta rows directly, no base mapping needed."""
+        from ..engine.options import UpdateMode
+
+        if table.options.update_mode is UpdateMode.APPEND:
+            return True
+        d_ts = delta.timestamps
+        if int(d_ts.min()) <= entry.max_ts:
+            return False
+        schema = delta.schema
+        tsid_name = schema.columns[schema.tsid_index].name
+        pairs = np.stack([
+            delta.columns[tsid_name].astype(np.int64),
+            d_ts.astype(np.int64),
+        ])
+        return np.unique(pairs, axis=1).shape[1] == len(delta)
+
+    def _raw_delta_rows(self, plan: QueryPlan, delta):
+        """Delta rows passing the query's time range + FULL residual
+        WHERE, evaluated exactly on host — the delta is one memtable's
+        worth at most, and exact evaluation also covers series the base
+        has never seen."""
+        tr = plan.predicate.time_range
+        d_ts = delta.timestamps
+        mask = (d_ts >= tr.inclusive_start) & (d_ts < tr.exclusive_end)
+        residual = self._residual_where(plan)
+        if residual is not None and len(delta):
+            v, valid = eval_expr(residual, delta)
+            mask &= np.asarray(as_values(v)).astype(bool) & valid
+        return delta if mask.all() else delta.filter(mask)
+
     # ---- host fallback -----------------------------------------------------
     def _execute_agg_host(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
         residual = self._residual_where(plan)
@@ -1479,11 +1855,13 @@ class Executor:
         result = ResultSet(names, columns, nulls or None)
         return _order_and_limit(result, plan)
 
-    def _execute_projection(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
+    def _execute_projection(
+        self, plan: QueryPlan, rows: RowGroup, m: dict | None = None
+    ) -> ResultSet:
         residual = self._residual_where(plan)
         if residual is not None and len(rows):
-            v, m = eval_expr(residual, rows)
-            rows = rows.filter(v.astype(bool) & m)
+            v, vm = eval_expr(residual, rows)
+            rows = rows.filter(v.astype(bool) & vm)
 
         # Sort BEFORE projecting: ORDER BY may reference any table column
         # or expression, not just select-list outputs. Select aliases are
@@ -1503,7 +1881,18 @@ class Executor:
                     kv = kv.sort_ranks()
                 keys.append(kv if o.ascending else _desc_key(kv))
                 keys.append(_null_rank(km, o))
-            rows = rows.take(np.lexsort(tuple(keys)))
+            # Rows already in the requested order skip the sort entirely:
+            # storage hands over presorted rows for the common dashboard
+            # shapes (ORDER BY ts within one series; ORDER BY matching
+            # the (series, ts) stored order; the raw device path's
+            # resident-order selections) and a stable sort of a sorted
+            # sequence is the identity — one O(n·k) adjacent-compare
+            # pass replaces the O(n log n) lexsort.
+            if _lex_presorted(keys):
+                if m is not None:
+                    m["sort_skipped"] = True
+            else:
+                rows = rows.take(np.lexsort(tuple(keys)))
         from .planner import _walk
 
         has_window = any(
@@ -1531,17 +1920,106 @@ class Executor:
                     if not vm.all():
                         nulls[c.name] = ~vm
                 continue
-            v, m = eval_expr(item.expr, rows)
+            v, vm = eval_expr(item.expr, rows)
             names.append(item.output_name)
             columns.append(as_values(v))
-            if not m.all():
-                nulls[item.output_name] = ~m
+            if not vm.all():
+                nulls[item.output_name] = ~vm
         result = ResultSet(names, columns, nulls or None)
         if stmt.distinct:
             result = _distinct_result(result)
         if (stmt.distinct or has_window) and (stmt.limit is not None or stmt.offset):
             result = _slice_result(result, stmt.offset, stmt.limit)
         return result
+
+
+def route_segment_kernel(shape_key, spec, n_rows: int, est_distinct,
+                         sql: str = ""):
+    """Module-level core of the learned segment-impl choice — shared by
+    the executor's direct/cached/dist paths AND the partial-agg
+    push-down (query/partial.py runs on partition owners with no
+    Executor instance in scope). Returns (spec, token); token is None
+    when routing doesn't apply (n_seg == 1, pinned HORAEDB_SEGMENT_IMPL,
+    or router disabled)."""
+    from ..ops.scan_agg import pinned_segment_impl
+    from .path_router import (
+        KERNEL_ROUTER,
+        bootstrap_observed_segments,
+        candidate_kernels,
+        kernel_routing_enabled,
+        seed_kernel,
+    )
+
+    n_seg = spec.n_groups * spec.n_buckets
+    if n_seg <= 1 or pinned_segment_impl() or not kernel_routing_enabled():
+        return spec, None
+    key = (shape_key, n_seg.bit_length())
+    obs = KERNEL_ROUTER.observed_segments(key)
+    if obs is None and sql:
+        # never-seen key: the query_stats ring may remember how many
+        # live segments this SQL shape produced before (agg_segments)
+        obs = bootstrap_observed_segments(sql)
+        if obs is not None:
+            KERNEL_ROUTER.note_segments(key, obs)
+    est = obs if obs is not None else est_distinct
+    if est is not None:
+        est = max(1, min(int(est), n_seg, max(int(n_rows), 1)))
+    import dataclasses
+
+    import jax
+
+    from ..ops.hash_agg import hash_slots_for
+
+    impl = KERNEL_ROUTER.choose(
+        key,
+        seed_kernel(n_seg, est, jax.default_backend()),
+        candidate_kernels(n_seg, n_rows, est),
+    )
+    spec = dataclasses.replace(
+        spec,
+        segment_impl=impl,
+        hash_slots=hash_slots_for(n_seg, est) if impl == "hash" else 0,
+    )
+    return spec, (key, impl)
+
+
+def finish_segment_kernel(krec, spec, m: dict, state,
+                          seconds: float, n_valid=None) -> None:
+    """Close one aggregation dispatch: feed the router's EWMA and
+    observed-cardinality loop, stamp the metric tree, the ledger
+    ``kernel`` field, and the horaedb_agg_kernel_total family."""
+    from ..ops.scan_agg import (
+        pinned_segment_impl,
+        resolve_segment_impl,
+    )
+    from .path_router import KERNEL_ROUTER
+
+    n_seg = spec.n_groups * spec.n_buckets
+    impl = resolve_segment_impl(n_seg, spec.segment_impl)
+    live = int((state.counts > 0).sum())
+    if krec is not None and live > 0:
+        # Degenerate dispatches (empty time range, filter matching
+        # nothing) are excluded from BOTH feedback loops: their
+        # near-zero latency would make whichever impl served them
+        # look unbeatable under the min-biased estimator, and a
+        # live count of 0 would EWMA the cardinality estimate toward
+        # a tiny hash table the next real query overflows.
+        key, routed = krec
+        # the honest cost of CHOOSING this impl for the shape —
+        # including the tiny-input host fallback when hash took it
+        KERNEL_ROUTER.record(key, routed, seconds)
+        KERNEL_ROUTER.note_segments(key, live)
+    if (
+        impl == "hash"
+        and n_valid is not None
+        and not pinned_segment_impl()
+    ):
+        from ..utils.env import env_int
+
+        if n_valid <= env_int("HORAEDB_HASH_HOST_MAX_ROWS", 4096):
+            impl = "host"  # scan_aggregate's dispatch-free arm
+    m["kernel"] = impl
+    querystats.note_agg_kernel(impl, segments=live)
 
 
 def _series_could_match(
@@ -1721,6 +2199,29 @@ def _host_agg(
         nullmask = cnt == 0
         return out, nullmask if nullmask.any() else None
     raise ExprError(f"unknown aggregate {a.func}")
+
+
+def _lex_presorted(keys: list) -> bool:
+    """True when rows are ALREADY in ``np.lexsort(keys)`` order, i.e.
+    the stable sort would be the identity permutation. One vectorized
+    adjacent-compare pass per key — O(n·k) against the sort's
+    O(n log n). Conservative: incomparable keys (mixed-type object
+    columns) and NaN pairs report unsorted and fall through to lexsort.
+    """
+    n = len(keys[0])
+    if n <= 1:
+        return True
+    strict = np.zeros(n - 1, dtype=bool)
+    eq = np.ones(n - 1, dtype=bool)
+    try:
+        for key in reversed(keys):  # np.lexsort: the LAST key is primary
+            key = np.asarray(key)
+            a, b = key[:-1], key[1:]
+            strict |= eq & (a < b)
+            eq &= a == b
+    except TypeError:
+        return False
+    return bool((strict | eq).all())
 
 
 def _desc_key(arr: np.ndarray) -> np.ndarray:

@@ -254,7 +254,33 @@ class InterpreterFactory:
                 path = "host"
             lines.append(f"  Execution: {path}")
         else:
-            lines.append("  Execution: projection scan (host)")
+            import os as _os
+
+            from ..ops.scan_topk import raw_device_enabled
+
+            # same gate as the executor: plain engine tables only
+            # (partitioned plans ship subtrees; raw serving happens on
+            # the owners), the scan cache + kill switch open, and never
+            # on limit-pushdown-safe plans (the host early-stop scan is
+            # unbeatable by construction)
+            raw_shape = (
+                self.executor._raw_device_shape(q)
+                if raw_device_enabled()
+                and _os.environ.get("HORAEDB_SCAN_CACHE", "1") != "0"
+                and not hasattr(table, "sub_tables")
+                and table.physical_datas()
+                and not self.executor._limit_pushdown_safe(q)
+                else None
+            )
+            if raw_shape is not None:
+                kind = "top-k" if raw_shape["topk_ok"] else "bounded selection"
+                lines.append(
+                    f"  Execution: raw device ({kind} over the HBM scan "
+                    "cache; host fallback when the cache or the "
+                    "HORAEDB_RAW_MAX_ROWS budget refuses)"
+                )
+            else:
+                lines.append("  Execution: projection scan (host)")
         from ..table_engine.partition import PartitionedTable
 
         if isinstance(table, PartitionedTable):

@@ -201,7 +201,7 @@ def _partial_on_rows(
     if m is not None:
         m["path"] = "kernel" if all_valid else "host"
     if all_valid:
-        return _partial_kernel(rows, mask, spec, t0)
+        return _partial_kernel(rows, mask, spec, t0, m)
     return _partial_host(rows, mask, spec, t0)
 
 
@@ -272,7 +272,9 @@ def _empty_projected(table, projection) -> RowGroup:
     )
 
 
-def _partial_kernel(rows, mask, spec, t0) -> tuple[list[str], list[np.ndarray]]:
+def _partial_kernel(
+    rows, mask, spec, t0, m: Optional[dict] = None
+) -> tuple[list[str], list[np.ndarray]]:
     group_tags = list(spec["group_tags"])
     agg_cols = list(spec["agg_cols"])
     bucket_ms = int(spec["bucket_ms"])
@@ -297,9 +299,33 @@ def _partial_kernel(rows, mask, spec, t0) -> tuple[list[str], list[np.ndarray]]:
         need_minmax=bool(spec.get("need_minmax", True)),
     ).padded()
 
+    # Learned segment-impl choice (ROADMAP item-3 remainder): the
+    # partial path rode the static HORAEDB_MXU_MAX_SEGMENTS heuristic
+    # long after the direct/cached/dist paths got the router. Keyed by
+    # the WIRE spec's shape (what the owner actually executes — the
+    # coordinator's plan never reaches this side of the RPC); group
+    # codes are dense here, so groups x buckets is an exact ceiling.
+    from .executor import finish_segment_kernel, route_segment_kernel
+
+    shape_key = (
+        "partial",
+        tuple(group_tags),
+        bucket_ms,
+        tuple(agg_cols),
+        tuple((c, op) for c, op, _ in spec["device_filters"]),
+        tuple((c, op) for c, op, _ in spec["exact_filters"]),
+    )
+    kspec, krec = route_segment_kernel(
+        shape_key, kspec, n_rows=batch.n_valid,
+        est_distinct=max(enc.num_groups, 1) * n_buckets,
+    )
+
+    import time as _time
+
     from ..parallel.mesh import dist_min_rows, serving_mesh
 
     mesh = serving_mesh()
+    t_kernel = _time.perf_counter()
     if mesh is not None and batch.n_valid >= dist_min_rows():
         from ..parallel.dist_agg import dist_scan_aggregate
 
@@ -308,6 +334,10 @@ def _partial_kernel(rows, mask, spec, t0) -> tuple[list[str], list[np.ndarray]]:
         )
     else:
         state = scan_aggregate(batch, kspec, [lit for _, _, lit in spec["device_filters"]])
+    finish_segment_kernel(
+        krec, kspec, m if m is not None else {}, state,
+        _time.perf_counter() - t_kernel, n_valid=batch.n_valid,
+    )
 
     G, B = max(enc.num_groups, 1), n_buckets
     counts = state.counts[:G, :B]

@@ -124,6 +124,16 @@ def adaptive_enabled() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def raw_adaptive_enabled() -> bool:
+    """Adaptive routing for RAW (non-aggregate) reads. Defaults ON for
+    every backend — unlike the aggregate kernels (where device wins and
+    "auto" only worries about dispatch latency), raw device-vs-host
+    genuinely flips with table size/selectivity on XLA-CPU too.
+    HORAEDB_ADAPTIVE_PATH=0 still pins routing off (device-first)."""
+    v = os.environ.get("HORAEDB_ADAPTIVE_PATH", "auto")
+    return v not in ("0", "off", "false")
+
+
 # ---- learned segment-kernel routing ---------------------------------------
 #
 # The device group-by has three segment-reduction impls (ops/scan_agg.py:

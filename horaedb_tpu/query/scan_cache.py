@@ -100,6 +100,9 @@ class CachedTableScan:
     # dashboard re-issuing the same query shape skips the upload entirely
     # (see ops.scan_agg packed serving path)
     _sessions: dict = None
+    # raw (non-aggregate) reads ship only the allow-list — their own
+    # content-keyed session cache (ops.scan_topk packed serving path)
+    _raw_sessions: dict = None
     # Derived host state that SURVIVES dropping ``rows`` (ref analog: the
     # reference's MemCacheStore keeps bounded bytes, mem_cache.rs:64-158):
     # one row per series (tags for group maps/filters), the int32
@@ -152,17 +155,13 @@ class CachedTableScan:
             stacks[key] = out
         return out
 
-    def session_for(self, gos: np.ndarray, allow: np.ndarray):
-        """Device handle for the packed [group map | allow list] upload,
-        keyed by CONTENT — repeats of a query shape (the dashboard steady
-        state) reuse the resident buffer and ship zero series-level bytes.
-        Bounded LRU; benign races just upload twice."""
-        from ..ops.scan_agg import pack_session
-
-        key = gos.tobytes() + allow.tobytes()
-        cache = self._sessions
+    def _session_lru(self, attr: str, key: bytes, build):
+        """Content-keyed bounded-LRU get-or-build shared by both session
+        caches; benign races just upload twice."""
+        cache = getattr(self, attr)
         if cache is None:
-            cache = self._sessions = {}
+            cache = {}
+            setattr(self, attr, cache)
         dev = cache.pop(key, None)
         if dev is None:
             if len(cache) >= 32:
@@ -170,9 +169,30 @@ class CachedTableScan:
                     cache.pop(next(iter(cache)), None)
                 except (StopIteration, RuntimeError):
                     pass
-            dev = jnp.asarray(pack_session(gos, allow))
+            dev = build()
         cache[key] = dev
         return dev
+
+    def session_for(self, gos: np.ndarray, allow: np.ndarray):
+        """Device handle for the packed [group map | allow list] upload,
+        keyed by CONTENT — repeats of a query shape (the dashboard steady
+        state) reuse the resident buffer and ship zero series-level bytes."""
+        from ..ops.scan_agg import pack_session
+
+        return self._session_lru(
+            "_sessions",
+            gos.tobytes() + allow.tobytes(),
+            lambda: jnp.asarray(pack_session(gos, allow)),
+        )
+
+    def raw_session_for(self, allow: np.ndarray):
+        """Device handle for a raw read's allow-list upload (raw reads
+        ship no group map), content-keyed like the aggregate sessions."""
+        return self._session_lru(
+            "_raw_sessions",
+            allow.tobytes(),
+            lambda: jnp.asarray(allow.astype(np.int32)),
+        )
 
 
 def _rowgroup_bytes(rows: RowGroup) -> int:

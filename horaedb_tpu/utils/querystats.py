@@ -66,6 +66,9 @@ NUMERIC_FIELDS: dict[str, str] = {
     # aggregation actually produced — the cardinality truth the kernel
     # router seeds from on the next sighting of the shape
     "agg_segments": "live segment cells the device aggregation produced",
+    # raw (non-aggregate) device reads: result rows the fused
+    # filter+top-k/selection path returned (0 for host-served raw reads)
+    "raw_rows_returned": "rows the device raw-read path returned",
 }
 
 # wall-time costs; seconds, float.
@@ -118,6 +121,48 @@ _AGG_KERNEL_COUNTERS = {
     )
     for k in SEGMENT_KERNEL_LABELS
 }
+
+
+# ---- raw-read accounting ---------------------------------------------------
+
+# Which serving shape a raw (non-aggregate) read took. "topk"/"select"
+# are the device kernels ("_dist" variants when the entry is sharded
+# over the mesh), "host" an ELIGIBLE query deliberately routed to the
+# host path (router choice, kill switch, selectivity over budget), and
+# "fallback" a device attempt the cache or eligibility checks bounced.
+RAW_SCAN_PATHS = (
+    "topk", "select", "topk_dist", "select_dist", "host", "fallback",
+)
+
+# Registry discipline (lint-enforced like the agg-kernel family):
+# declared here, registered eagerly, documented in docs/OBSERVABILITY.md,
+# and no stray horaedb_raw_* family may exist outside this tuple.
+RAW_SCAN_METRIC_FAMILIES = ("horaedb_raw_scan_total",)
+
+_RAW_SCAN_COUNTERS = {
+    p: REGISTRY.counter(
+        "horaedb_raw_scan_total",
+        "raw (non-aggregate) reads by serving path",
+        labels={"path": p},
+    )
+    for p in RAW_SCAN_PATHS
+}
+
+
+def note_raw_scan(path: str, kernel: str = "", rows=None) -> None:
+    """Account one raw read: bump the per-path family and — on the
+    device paths — stamp the ledger's ``kernel`` field and the
+    ``raw_rows_returned`` count, so ``system.public.query_stats`` covers
+    raw serving on every wire."""
+    counter = _RAW_SCAN_COUNTERS.get(path)
+    if counter is not None:
+        counter.inc()
+    ledger = _current_ledger.get()
+    if ledger is not None:
+        if kernel:
+            ledger.set_kernel(kernel)
+        if rows is not None:
+            ledger.add(raw_rows_returned=rows)
 
 
 def note_agg_kernel(kernel: str, segments: int = 0) -> None:

@@ -982,6 +982,51 @@ class TestMetricsNameLint:
                 missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
         assert not missing, missing
 
+    def test_raw_scan_family_declared_and_documented(self):
+        """PR-7 lint extension (same contract as the agg-kernel
+        registry): the horaedb_raw_scan_total family declared in
+        querystats.RAW_SCAN_METRIC_FAMILIES must be (a) registered live
+        with every RAW_SCAN_PATHS label, (b) convention-clean, (c)
+        documented in docs/OBSERVABILITY.md — and no stray
+        horaedb_raw_* family may exist outside the declared registry.
+        The raw knobs are operator surface: pinned to docs/WORKLOAD.md.
+        (The `raw_rows_returned` ledger field rides the PR-2 lint
+        automatically: column + family + docs mention.)"""
+        import os
+        import re
+
+        from horaedb_tpu.utils.metrics import REGISTRY
+        from horaedb_tpu.utils.querystats import (
+            RAW_SCAN_METRIC_FAMILIES,
+            RAW_SCAN_PATHS,
+        )
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in RAW_SCAN_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/OBSERVABILITY.md")
+        for path in RAW_SCAN_PATHS:
+            if f'path="{path}"' not in exposed:
+                missing.append(f"label path={path}: not eagerly registered")
+        for fam in families:
+            if fam.startswith("horaedb_raw_") and \
+                    fam not in RAW_SCAN_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("HORAEDB_RAW_DEVICE", "HORAEDB_RAW_MAX_ROWS"):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
         and horaedb_wal_* families after a flush+compaction cycle."""
