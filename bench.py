@@ -18,6 +18,13 @@ target list:
                         bounded selection over the HBM scan cache vs the
                         host-only path, selectivity 0.001 -> 1.0 x
                         LIMIT 10 -> 10k (ORDER BY ts DESC dashboards)
+    rollup              continuous-query A/B: dashboard range aggregate
+                        (time_bucket 5m x host x avg) served from the
+                        maintained 1m rollup (route=rollup) vs the same
+                        query forced onto the raw table
+                        (HORAEDB_ROLLUP=0), interleaved min-of-N; also
+                        times the PromQL range-query face of the same
+                        rewrite
 
 Every config runs the FULL query path (SQL -> plan -> merge read -> fused
 device kernel) against data ingested through the real engine (memtable ->
@@ -1043,6 +1050,170 @@ def run_compaction_config() -> dict:
     }
 
 
+# ---- rollup config (continuous-query rewrite A/B) -----------------------
+#
+# Dashboard-shaped range aggregation over a rollup-maintained table: the
+# SAME statement served from the 1m tier (route=rollup, pre-aggregated
+# partials + empty raw tail) vs forced onto the raw table with
+# HORAEDB_ROLLUP=0. Interleaved pairs (shared-host drift cancels),
+# min-of-N, results must agree numerically, and the gate is impl-aware:
+# the rollup arm must actually have served route=rollup.
+
+ROLLUP_ROWS = int(os.environ.get("BENCH_ROLLUP_ROWS", str((1 << 20) - 256)))
+ROLLUP_HOURS = 6
+ROLLUP_STEP_MS = 300_000  # the 5m dashboard step
+
+
+def _prom_matrices_agree(a, b, rtol: float = 2e-3) -> bool:
+    """Prom 'matrix' results from the two arms must agree series-for-
+    series, point-for-point (same tolerance as the SQL arm)."""
+    if a is None or b is None or len(a) != len(b):
+        return False
+    ka = sorted(a, key=lambda s: sorted(s["metric"].items()))
+    kb = sorted(b, key=lambda s: sorted(s["metric"].items()))
+    for sa, sb in zip(ka, kb):
+        if sa["metric"] != sb["metric"] or len(sa["values"]) != len(sb["values"]):
+            return False
+        for (ta, va), (tb, vb) in zip(sa["values"], sb["values"]):
+            if ta != tb or not np.isclose(
+                float(va), float(vb), rtol=rtol, atol=1e-3, equal_nan=True
+            ):
+                return False
+    return True
+
+
+def run_rollup_config() -> dict:
+    import jax
+
+    import horaedb_tpu
+    from horaedb_tpu.common_types import RowGroup
+    from horaedb_tpu.common_types.schema import compute_tsid
+    from horaedb_tpu.proxy.promql import evaluate_expr_range, parse_promql
+    from horaedb_tpu.rules import ROLLUPS, RuleEngine
+    from horaedb_tpu.utils.config import RulesSection
+
+    platform = jax.devices()[0].platform
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+    ROLLUPS.reset()
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE dash (host string TAG, value double, ts timestamp "
+        "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+        "WITH (segment_duration='2h', update_mode='append')"
+    )
+    n = ROLLUP_ROWS
+    end = (1_786_000_000_000 // 3_600_000) * 3_600_000  # hour-aligned
+    start = end - ROLLUP_HOURS * 3_600_000
+    rng = np.random.default_rng(42)
+    hosts = np.array(
+        [f"host_{i}" for i in rng.integers(0, 8, n)], dtype=object
+    )
+    schema = db.catalog.open("dash").schema
+    t = db.catalog.open("dash")
+    t.write(RowGroup(
+        schema,
+        {
+            "tsid": compute_tsid([hosts]),
+            "ts": rng.integers(start, end, n).astype(np.int64),
+            "host": hosts,
+            "value": rng.normal(10.0, 3.0, n),
+        },
+    ))
+    t.flush()
+
+    # one catch-up round builds the whole 1m + 1h ladder (untimed setup —
+    # maintenance is amortized background work at eval_interval cadence)
+    eng = RuleEngine(db, RulesSection(
+        rollup_tables=["dash"], grace_s=0, rollup_raw_ttl_s=0,
+    ))
+    eng.load()
+    s = time.perf_counter()
+    eng.run_once(now_ms=end)
+    maintain_s = time.perf_counter() - s
+
+    sql = (
+        f"SELECT time_bucket(ts, '5m') AS b, host, avg(value) AS v "
+        f"FROM dash WHERE ts >= {start} AND ts < {end} "
+        f"GROUP BY time_bucket(ts, '5m'), host"
+    )
+    pq = parse_promql("dash")
+
+    def run_sql():
+        s = time.perf_counter()
+        out = db.execute(sql)
+        return time.perf_counter() - s, out.to_pylist(), \
+            db.interpreters.executor.last_path
+
+    def run_prom():
+        s = time.perf_counter()
+        out = evaluate_expr_range(db, pq, start, end - 1, ROLLUP_STEP_MS)
+        return time.perf_counter() - s, out
+
+    @contextlib.contextmanager
+    def raw_forced():
+        os.environ["HORAEDB_ROLLUP"] = "0"
+        try:
+            yield
+        finally:
+            os.environ.pop("HORAEDB_ROLLUP", None)
+
+    # warm both arms (compile + scan-cache build are one-off costs)
+    run_sql(); run_prom()
+    with raw_forced():
+        run_sql(); run_prom()
+
+    roll_best = raw_best = proll_best = praw_best = np.inf
+    roll_rows = raw_rows = prows = praw_rows = None
+    roll_path = raw_path = prom_path = ""
+    for _ in range(max(REPEATS, 7)):
+        dt, rows, path = run_sql()
+        if dt < roll_best:
+            roll_best, roll_rows, roll_path = dt, rows, path
+        pdt, pr = run_prom()
+        if pdt < proll_best:
+            proll_best, prows = pdt, pr
+            prom_path = db.interpreters.executor.last_path
+        with raw_forced():
+            dt, rows, path = run_sql()
+            if dt < raw_best:
+                raw_best, raw_rows, raw_path = dt, rows, path
+            pdt, pr = run_prom()
+            if pdt < praw_best:
+                praw_best, praw_rows = pdt, pr
+
+    if roll_path != "rollup" or prom_path != "rollup":
+        return {"metric": f"rollup_error{suffix}", "value": 0,
+                "unit": f"rollup arm served sql={roll_path} "
+                        f"promql={prom_path}",
+                "vs_baseline": 0, "platform": platform}
+    # the raw arm rides f32 device kernels vs the rollup's f64 partials:
+    # the same 2e-3 tolerance the equivalence tests establish
+    if not _rows_agree(roll_rows, raw_rows, rtol=2e-3):
+        return {"metric": f"rollup_error{suffix}", "value": 0,
+                "unit": "rollup/raw result mismatch", "vs_baseline": 0,
+                "platform": platform}
+    if not _prom_matrices_agree(prows, praw_rows):
+        return {"metric": f"rollup_error{suffix}", "value": 0,
+                "unit": "rollup/raw PromQL result mismatch",
+                "vs_baseline": 0, "platform": platform}
+    speedup = raw_best / roll_best
+    return {
+        "metric": f"rollup_dashboard_rows_per_sec{suffix}",
+        "value": round(n / roll_best),
+        "unit": "rows/s",
+        # headline ratio: the raw-table path vs the rollup-served path
+        "vs_baseline": round(speedup, 3),
+        "promql_speedup": round(praw_best / proll_best, 3),
+        "never_worse": bool(roll_best <= raw_best * 1.05),
+        "target_3x": bool(speedup >= 3.0),
+        "rollup_ms": round(roll_best * 1000, 3),
+        "raw_ms": round(raw_best * 1000, 3),
+        "maintain_ms": round(maintain_s * 1000, 1),
+        "raw_path": raw_path,
+        "platform": platform,
+    }
+
+
 def time_arrow(db, table_name: str, arrow_fn) -> tuple[float, list]:
     """External anchor: the same query through pyarrow's Acero (an
     Arrow-native C++ vectorized engine — the closest runnable stand-in
@@ -1166,7 +1337,8 @@ def _emit(obj: dict) -> None:
 # final stdout line, and every config still gets its own line.
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
-    "compaction-64", "ingest", "groupby", "rawscan", "tsbs-5-8-1",
+    "compaction-64", "ingest", "groupby", "rawscan", "rollup",
+    "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
 # builds the table twice for the device/host A-B and genuinely needs
@@ -1322,6 +1494,8 @@ def run_config(config: str) -> dict:
         return run_groupby_config()
     if config == "rawscan":
         return run_rawscan_config()
+    if config == "rollup":
+        return run_rollup_config()
     builder = CONFIGS.get(config)
     if builder is None:
         return {"metric": f"{config}_error", "value": 0,

@@ -142,6 +142,40 @@ def snapshot_samples(now_ms: int, node: str, registry=REGISTRY) -> list[dict]:
     return rows
 
 
+def forward_rows(endpoint: str, table: str, rows: list[dict]) -> None:
+    """Cluster mode, non-owner: ship one round of rows to the owning
+    node's ordinary ``/write`` endpoint. ``nonblocking=1`` makes the
+    owner shed at ITS stall bound instead of blocking our timeout out
+    against its stall deadline; a 503/429 maps back to the same typed
+    retryable OverloadedError the local path raises. Shared by the
+    self-monitoring recorder and the rules engine (recording-rule and
+    rollup output forwarding)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{endpoint}/write?nonblocking=1",
+        json.dumps({"table": table, "rows": rows}).encode(),
+        {"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+    except urllib.error.HTTPError as e:
+        body = e.read().decode("utf-8", "replace")[:200]
+        if e.code in (503, 429):
+            from ..wlm.admission import OverloadedError
+
+            raise OverloadedError(
+                f"owner {endpoint} shed forwarded write to {table}: {body}",
+                reason="write_stall", retry_after_s=1.0,
+            ) from None
+        raise RuntimeError(
+            f"forward to {endpoint} for {table} failed ({e.code}): {body}"
+        ) from None
+
+
 def rows_to_rowgroup(schema, rows: list[dict]) -> "RowGroup":
     """Columnar RowGroup straight from sample dicts — the recorder fires
     every interval on the serving node, so it skips ``from_rows``'s
@@ -354,38 +388,10 @@ class MetricsRecorder:
         )
 
     def _forward(self, rows: list[dict]) -> None:
-        """Cluster mode, non-owner: ship this round to the owner's
-        ordinary ``/write`` endpoint (a 503 there is the owner's stall
-        shed — mapped back to the same retryable OverloadedError the
-        local path raises)."""
-        import json
-        import urllib.error
-        import urllib.request
-
-        endpoint = self.router.route(SAMPLES_TABLE).endpoint
-        # nonblocking=1: the owner sheds at its stall bound instead of
-        # blocking our 10s timeout out against its 30s stall deadline —
-        # without it the 503 contract below could never fire at defaults.
-        req = urllib.request.Request(
-            f"http://{endpoint}/write?nonblocking=1",
-            json.dumps({"table": SAMPLES_TABLE, "rows": rows}).encode(),
-            {"Content-Type": "application/json"},
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=10):
-                pass
-        except urllib.error.HTTPError as e:
-            body = e.read().decode("utf-8", "replace")[:200]
-            if e.code in (503, 429):
-                from ..wlm.admission import OverloadedError
-
-                raise OverloadedError(
-                    f"owner {endpoint} shed self-scrape write: {body}",
-                    reason="write_stall", retry_after_s=1.0,
-                ) from None
-            raise RuntimeError(
-                f"self-scrape forward to {endpoint} failed ({e.code}): {body}"
-            ) from None
+        """Cluster mode, non-owner: ship this round to the owner via the
+        shared ``forward_rows`` helper (503 there is the owner's stall
+        shed, mapped back to the retryable OverloadedError)."""
+        forward_rows(self.router.route(SAMPLES_TABLE).endpoint, SAMPLES_TABLE, rows)
 
     # ---- retention ------------------------------------------------------
 

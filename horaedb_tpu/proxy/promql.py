@@ -6,7 +6,8 @@ device kernels).
 
 Supported grammar:
 
-    expr     := addexpr
+    expr     := cmpexpr
+    cmpexpr  := addexpr (('>' | '<' | '>=' | '<=' | '==' | '!=') addexpr)*
     addexpr  := mulexpr (('+' | '-') mulexpr)*
     mulexpr  := unary (('*' | '/' | '%') unary)*
     unary    := number | '(' expr ')' | vector
@@ -42,6 +43,12 @@ Binary expressions follow prom's arithmetic semantics: scalar/scalar,
 vector/scalar (applied per sample), and vector/vector one-to-one
 matching on identical label sets (samples without a partner drop out;
 ``__name__`` is dropped from arithmetic results, like prom).
+Comparison operators (> < >= <= == !=) follow prom's FILTER semantics
+over vectors — samples for which the comparison is false drop out, the
+surviving samples keep their values (what alert rules are made of:
+``rate(errors_total[1m]) > 5`` yields the offending series). A
+scalar/scalar comparison yields 1.0/0.0 (the ``bool`` modifier is
+implied — this subset has no unmodified scalar comparison error).
 
 Semantics notes:
 - the metric name maps to a table; its single DOUBLE field (or a column
@@ -88,6 +95,9 @@ RANGE_FUNCS = _COUNTER_FUNCS | _RAW_FOLD_FUNCS | _SQL_FOLD_FUNCS
 _OPTIONAL_RANGE_FUNCS = frozenset(
     {"avg_over_time", "min_over_time", "max_over_time"}
 )
+# comparison/filter binary operators (prom semantics: false samples
+# drop out of the vector; the alert evaluator's threshold surface)
+COMPARE_OPS = frozenset({">", "<", ">=", "<=", "==", "!="})
 # funcs over a full evaluated vector (ref surface: promql/udf.rs:50-97 +
 # the IOx function table the reference inherits)
 VECTOR_FUNCS = {
@@ -135,10 +145,11 @@ class PromSubquery:
 
 @dataclass
 class PromBin:
-    """Arithmetic over sub-expressions: vector/scalar applies per sample,
-    vector/vector matches one-to-one on identical label sets."""
+    """Arithmetic or comparison over sub-expressions: vector/scalar
+    applies per sample, vector/vector matches one-to-one on identical
+    label sets. COMPARE_OPS members filter (false samples drop out)."""
 
-    op: str  # + - * / %
+    op: str  # + - * / % or COMPARE_OPS
     lhs: "PromExpr"
     rhs: "PromExpr"
 
@@ -177,7 +188,7 @@ _TOKENS = re.compile(
     | (?P<dur>\d+(?:ms|s|m|h|d))
     | (?P<number>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
     | (?P<string>'(?:[^'])*'|"(?:[^"])*")
-    | (?P<op>!=|=~|!~|[={{}}()\[\],+\-*/%@])
+    | (?P<op>!=|=~|!~|>=|<=|==|[<>={{}}()\[\],+\-*/%@])
     )""",
     re.VERBOSE,
 )
@@ -219,12 +230,20 @@ class _Parser:
             raise PromQLError(f"expected {text!r}, found {tok!r} in {self.q!r}")
 
     def parse(self) -> PromExpr:
-        pq = self.addexpr()
+        pq = self.cmpexpr()
         if self.peek()[0] is not None:
             raise PromQLError(f"trailing input after query: {self.q!r}")
         return pq
 
-    # precedence climbing: * / % bind tighter than + -
+    # precedence climbing: * / % bind tighter than + -, which bind
+    # tighter than the comparison/filter operators (prom's ladder)
+    def cmpexpr(self) -> PromExpr:
+        node = self.addexpr()
+        while self.peek()[0] == "op" and self.peek()[1] in COMPARE_OPS:
+            op = self.next()[1]
+            node = PromBin(op, node, self.addexpr())
+        return node
+
     def addexpr(self) -> PromExpr:
         node = self.mulexpr()
         while self.peek() == ("op", "+") or self.peek() == ("op", "-"):
@@ -252,7 +271,7 @@ class _Parser:
             return PromBin("*", PromScalar(-1.0), inner)
         if (kind, tok) == ("op", "("):
             self.next()
-            node = self.addexpr()
+            node = self.cmpexpr()
             self.expect(")")
             return self._maybe_subquery(node)
         return self._maybe_subquery(self.expr())
@@ -357,7 +376,7 @@ class _Parser:
             if tok in PARAM_AGGS:
                 param = self._number()
                 self.expect(",")
-            inner = self.addexpr()
+            inner = self.cmpexpr()
             self.expect(")")
             # suffix form: sum(...) by (x) / without (x)
             if by is None and without is None:
@@ -413,9 +432,9 @@ class _Parser:
         if name == "histogram_quantile":
             params.append(self._number())
             self.expect(",")
-            arg = self.addexpr()
+            arg = self.cmpexpr()
         elif name == "label_replace":
-            arg = self.addexpr()
+            arg = self.cmpexpr()
             for _ in range(4):  # dst, replacement, src, regex
                 self.expect(",")
                 params.append(self._string())
@@ -433,7 +452,7 @@ class _Parser:
                         f"${ref} but the regex has {compiled.groups}"
                     )
         elif name == "label_join":
-            arg = self.addexpr()
+            arg = self.cmpexpr()
             self.expect(",")
             params.append(self._string())  # dst
             self.expect(",")
@@ -442,16 +461,16 @@ class _Parser:
                 self.next()
                 params.append(self._string())  # source labels
         elif name in ("clamp_min", "clamp_max"):
-            arg = self.addexpr()
+            arg = self.cmpexpr()
             self.expect(",")
             params.append(self._number())
         elif name == "round":
-            arg = self.addexpr()
+            arg = self.cmpexpr()
             if self.peek()[1] == ",":
                 self.next()
                 params.append(self._number())
         else:  # abs / ceil / floor
-            arg = self.addexpr()
+            arg = self.cmpexpr()
         self.expect(")")
         return PromCall(name, arg, tuple(params))
 
@@ -542,22 +561,42 @@ def _metric_table(conn, pq: PromQuery):
     ``system_metrics.samples`` with a pushed ``name = <metric>`` matcher
     (engine/metrics_recorder) — so ``rate(horaedb_flush_rows_total[5m])``
     works over the node's own stored telemetry even though no table named
-    ``horaedb_flush_rows_total`` exists. Returns ``(pq, table, inner)``
-    — ``pq`` rewritten when the fallback applied — with ``table=None``
-    when neither resolves. ``inner`` holds the caller's matchers on the
-    ORIGINAL family's labels (e.g. ``{protocol="http"}``), which the
-    samples table folds into its ``labels`` string tag: they must
-    post-filter series via ``_inner_match``, not push into the scan."""
+    ``horaedb_flush_rows_total`` exists. Returns ``(pq, table, inner,
+    folded)`` — ``pq`` rewritten when the fallback applied — with
+    ``table=None`` when neither resolves. ``inner`` holds the caller's
+    matchers on the ORIGINAL family's labels (e.g. ``{protocol="http"}``),
+    which a samples-shaped table folds into its ``labels`` string tag:
+    they must post-filter series via ``_inner_match``, not push into the
+    scan. ``folded`` is True whenever the table stores series labels that
+    way — the samples fallback AND recording-rule output tables (rules/)
+    — telling callers to lift the folded labels back into first-class
+    keys via ``_expand_folded_keys``."""
+    import dataclasses
+
     table = conn.catalog.open(pq.metric)
     if table is not None:
-        return pq, table, []
+        tags = set(table.schema.tag_names)
+        # The EXACT samples shape only (a recording rule's output, or
+        # the samples table addressed by name): a user table that merely
+        # HAS a tag called "labels" alongside its own tags must keep
+        # plain-tag semantics — lifting would rewrite its series
+        # identity and silently collapse distinct series.
+        if "labels" in tags and tags <= {"name", "labels", "node"}:
+            # matchers on the result series' own (folded) labels
+            # post-filter after lifting
+            inner = [m for m in pq.matchers if m[0] not in tags]
+            if inner:
+                pq = dataclasses.replace(
+                    pq,
+                    matchers=[m for m in pq.matchers if m[0] in tags],
+                )
+            return pq, table, inner, True
+        return pq, table, [], False
     from ..engine.metrics_recorder import SAMPLES_TABLE
 
     samples = conn.catalog.open(SAMPLES_TABLE)
     if samples is None:
-        return pq, None, []
-    import dataclasses
-
+        return pq, None, [], False
     sample_tags = set(samples.schema.tag_names)
     inner = [m for m in pq.matchers if m[0] not in sample_tags]
     pq = dataclasses.replace(
@@ -566,7 +605,7 @@ def _metric_table(conn, pq: PromQuery):
         matchers=[m for m in pq.matchers if m[0] in sample_tags]
         + [("name", "=", pq.metric)],
     )
-    return pq, samples, inner
+    return pq, samples, inner, True
 
 
 def _parse_rendered_labels(s: str) -> dict:
@@ -676,9 +715,7 @@ def _range_series(
     already stamped back), keyed by ((label, value), ...)."""
     if pq.at_ms is not None:
         return _at_series(conn, pq, start_ms, end_ms, step_ms)
-    _orig_metric = pq.metric
-    pq, table, inner_matchers = _metric_table(conn, pq)
-    fallback = table is not None and pq.metric != _orig_metric
+    pq, table, inner_matchers, fallback = _metric_table(conn, pq)
     if table is None:
         return {}
     schema = table.schema
@@ -1088,6 +1125,57 @@ def _quantile(phi: float, vals: list) -> float:
 # ---- binary expressions --------------------------------------------------
 
 
+def _apply_cmp(op: str, a: float, b: float) -> bool:
+    """One comparison (filter) operator over two sample values."""
+    if op == ">":
+        return a > b
+    if op == "<":
+        return a < b
+    if op == ">=":
+        return a >= b
+    if op == "<=":
+        return a <= b
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    raise PromQLError(f"unsupported comparison {op!r}")
+
+
+def _compare_series(op: str, lk, lv, rk, rv):
+    """Prom filter semantics for ('scalar'|'vector') operand pairs in
+    RANGE space ({key: {bucket: value}}): the surviving samples keep the
+    LEFT side's values (vector OP scalar and vector OP vector), or the
+    right vector's values for scalar OP vector; empty series drop out."""
+    if lk == "scalar" and rk == "scalar":
+        return "scalar", 1.0 if _apply_cmp(op, lv, rv) else 0.0
+    if lk == "vector" and rk == "scalar":
+        out = {
+            key: {b: v for b, v in pts.items() if _apply_cmp(op, v, rv)}
+            for key, pts in lv.items()
+        }
+        return "vector", {k: p for k, p in out.items() if p}
+    if lk == "scalar" and rk == "vector":
+        out = {
+            key: {b: v for b, v in pts.items() if _apply_cmp(op, lv, v)}
+            for key, pts in rv.items()
+        }
+        return "vector", {k: p for k, p in out.items() if p}
+    out: dict = {}
+    for key, lpts in lv.items():
+        rpts = rv.get(key)
+        if rpts is None:
+            continue
+        pts = {
+            b: v
+            for b, v in lpts.items()
+            if b in rpts and _apply_cmp(op, v, rpts[b])
+        }
+        if pts:
+            out[key] = pts
+    return "vector", out
+
+
 def _apply_op(op: str, a: float, b: float) -> float:
     import math
 
@@ -1143,6 +1231,8 @@ def _eval_series(conn, node: PromExpr, start_ms: int, end_ms: int, step_ms: int)
     lk, lv = _eval_series(conn, node.lhs, start_ms, end_ms, step_ms)
     rk, rv = _eval_series(conn, node.rhs, start_ms, end_ms, step_ms)
     op = node.op
+    if op in COMPARE_OPS:
+        return _compare_series(op, lk, lv, rk, rv)
     if lk == "scalar" and rk == "scalar":
         return "scalar", _apply_op(op, lv, rv)
     if rk == "scalar":
@@ -1457,6 +1547,17 @@ def _instant_value(conn, node: PromExpr, time_ms: int):
     lk, lv = _instant_value(conn, node.lhs, time_ms)
     rk, rv = _instant_value(conn, node.rhs, time_ms)
     op = node.op
+    if op in COMPARE_OPS:
+        # reuse the range-space filter through a single synthetic bucket
+        as_pts = lambda vec: {key: {0: v} for key, v in vec.items()}
+        kind, out = _compare_series(
+            op,
+            lk, as_pts(lv) if lk == "vector" else lv,
+            rk, as_pts(rv) if rk == "vector" else rv,
+        )
+        if kind == "scalar":
+            return "scalar", out
+        return "vector", {key: pts[0] for key, pts in out.items()}
     if lk == "scalar" and rk == "scalar":
         return "scalar", _apply_op(op, lv, rv)
     if rk == "scalar":
@@ -1520,8 +1621,7 @@ def _instant_over_time(conn, pq: PromQuery, time_ms: int) -> list[dict]:
     """One raw fold per series over exactly (t-range, t] (after @/offset) —
     Prometheus's left-open window, matching _raw_window_series."""
     orig_metric = pq.metric  # the fallback rewrite must not leak into __name__
-    pq, table, inner_matchers = _metric_table(conn, pq)
-    fallback = table is not None and pq.metric != orig_metric
+    pq, table, inner_matchers, fallback = _metric_table(conn, pq)
     if table is None:
         return []
     schema = table.schema

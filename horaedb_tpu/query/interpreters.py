@@ -240,6 +240,17 @@ class InterpreterFactory:
                 for a in q.aggs
             )
             lines.append(f"  Aggregate: keys=[{keys}] aggs=[{aggs}]")
+            # same shared predicate the executor hook serves from — what
+            # this line promises is what execution does (route=rollup)
+            from ..rules.rewrite import rollup_decision_for
+
+            dec = rollup_decision_for(self.catalog, q)
+            if dec is not None:
+                lines.append(
+                    f"  Rollup: table={dec.rollup_table} tier={dec.suffix} "
+                    f"buckets<[{dec.cut}] served pre-aggregated, raw tail "
+                    f"[{dec.cut}, {dec.end}) from {q.table} (route=rollup)"
+                )
             shape = self.executor._agg_device_shape(q)
             if shape is not None:
                 path = "device (fused kernel; HBM-cached when table state is stable)"
@@ -337,7 +348,7 @@ class InterpreterFactory:
             try:
                 t0 = _time.perf_counter()
                 with span("analyze", table=q.table):
-                    out = self.executor.execute(q, table)
+                    out = self._execute_query(q, table)
                 elapsed = (_time.perf_counter() - t0) * 1000
                 lines.append(
                     f"  Analyzed: path={self.executor.last_path} "
@@ -379,6 +390,19 @@ class InterpreterFactory:
         table = self.catalog.open(plan.table)
         if table is None:
             raise InterpreterError(f"table not found: {plan.table}")
+        return self._execute_query(plan, table)
+
+    def _execute_query(self, plan: QueryPlan, table) -> ResultSet:
+        """One door to query execution (SELECT and EXPLAIN ANALYZE both
+        pass through): a step-compatible dashboard aggregate over a
+        rollup-maintained table serves from the tier tables
+        (rules/rewrite, ``route=rollup``); everything else takes the
+        executor's normal paths."""
+        from ..rules.rewrite import try_rollup_serve
+
+        out = try_rollup_serve(self, plan)
+        if out is not None:
+            return out
         return self.executor.execute(plan, table)
 
     @staticmethod

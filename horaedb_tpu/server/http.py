@@ -15,11 +15,14 @@ config.rs:176):
     GET  /debug/tables   per-table metrics (memtable/sst bytes, seqs)
     GET  /debug/hotspot  hottest tables by reads/writes
     GET  /debug/workload live admission/dedup/quota state (wlm)
+    GET  /debug/alerts   rule-engine alert state (pending/firing/resolved)
     PUT  /debug/slow_threshold/{seconds}  live slow-log threshold
     POST /admin/block    {"tables": [...]} / DELETE to unblock
     GET/POST/DELETE /admin/quota  per-tenant/table token buckets
+    GET/POST/DELETE /admin/rules  recording/alert rules (rules engine)
     GET  /health         liveness (?ready=1 -> readiness gate, 503 until
-                         WAL replay done / a shard opened)
+                         WAL replay done / a shard opened / rule state
+                         loaded)
 """
 
 from __future__ import annotations
@@ -366,6 +369,7 @@ async def _auth_middleware(request: web.Request, handler):
 def create_app(
     conn: Connection, router=None, cluster=None, auth_token: str = "",
     limits=None, observability=None, node: str = "standalone",
+    rules_cfg=None,
 ) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
     adds the /meta_event endpoints, meta-driven DDL, and write fencing.
@@ -375,7 +379,11 @@ def create_app(
     ``self_scrape`` is on, the node runs the self-monitoring recorder
     (engine/metrics_recorder) that periodically writes its own metrics
     registry into ``system_metrics.samples`` through the normal write
-    path, rows labeled ``node``."""
+    path, rows labeled ``node``.
+    ``rules_cfg``: a config RulesSection; when enabled the node runs the
+    continuous-query engine (rules/) — recording rules, tiered rollups
+    with transparent query rewriting, and the alert evaluator — with
+    /admin/rules and /debug/alerts as its control surface."""
     import time as _time
 
     proxy = Proxy(conn, limits=limits)
@@ -422,6 +430,32 @@ def create_app(
         app.on_startup.append(_start_recorder)
         app.on_cleanup.append(_stop_recorder)
     app["metrics_recorder"] = recorder
+
+    rule_engine = None
+    if rules_cfg is not None and rules_cfg.enabled and cluster is not None:
+        # Same table-id allocation caveat as the recorder: rule output
+        # tables are created through the local catalog, which coordinator
+        # mode does not meta-serialize yet.
+        logger.info(
+            "rules engine disabled in coordinator mode "
+            "(rule-output table allocation is not meta-serialized yet)"
+        )
+    elif rules_cfg is not None and rules_cfg.enabled:
+        from ..rules import RuleEngine
+
+        rule_engine = RuleEngine(
+            conn, rules_cfg, node=node, router=router,
+        )
+
+        async def _start_rules(app_):
+            rule_engine.start()
+
+        async def _stop_rules(app_):
+            rule_engine.close()
+
+        app.on_startup.append(_start_rules)
+        app.on_cleanup.append(_stop_rules)
+    app["rule_engine"] = rule_engine
 
     # Readiness warmup: tables open (and replay their WAL) lazily, so a
     # fresh node would report wal_replay_done=True before any replay
@@ -909,11 +943,16 @@ def create_app(
     def _node_ready() -> bool:
         """Ready = the engine can serve: startup warmup finished (lazy
         table opens would otherwise report replay 'done' before it ever
-        started), no WAL replay in flight, not closed — and in cluster
-        mode at least one shard opened (a node with zero shards serves
-        reads/forwards but isn't "ready" as a write target yet). Cheap
-        on purpose: probes fire every few seconds."""
+        started), no WAL replay in flight, not closed, rule state loaded
+        (a node serving before its runtime rules/watermarks load would
+        evaluate a stale rule set and re-derive rollup watermarks cold)
+        — and in cluster mode at least one shard opened (a node with
+        zero shards serves reads/forwards but isn't "ready" as a write
+        target yet). Cheap on purpose: probes fire every few seconds."""
         if not app["warmup_done"] or not conn.instance.is_ready():
+            return False
+        eng = app["rule_engine"]
+        if eng is not None and not eng.loaded:
             return False
         return cluster is None or bool(cluster.debug_shard_info())
 
@@ -945,6 +984,11 @@ def create_app(
                 "queue_depth": adm["queue_depth"],
             },
             "self_monitoring": rec.stats() if rec is not None else None,
+            "rules": (
+                app["rule_engine"].stats()
+                if app["rule_engine"] is not None
+                else None
+            ),
         }
 
     async def health(request: web.Request) -> web.Response:
@@ -1268,6 +1312,63 @@ def create_app(
             content_type="application/json",
         )
 
+    async def debug_alerts(request: web.Request) -> web.Response:
+        """The rule engine's alert state — the JSON face of
+        ``system.public.alerts`` (pending/firing live instances plus the
+        recently-resolved ring)."""
+        eng = request.app["rule_engine"]
+        if eng is None:
+            return web.json_response({"enabled": False, "alerts": []})
+        return web.Response(
+            text=_dumps({"enabled": True, "alerts": eng.alerts_snapshot()}),
+            content_type="application/json",
+        )
+
+    async def admin_rules(request: web.Request) -> web.Response:
+        """GET: loaded rules (config + runtime) with last errors.
+        POST: add a runtime rule {"kind": "recording"|"alert", "name":
+        ..., "expr": ..., "for"?: "30s", "labels"?: {...}} — validated
+        and persisted beside wlm_state.json. DELETE: {"name": ...}
+        removes a runtime rule (config rules refuse)."""
+        from ..rules import RuleError
+
+        eng = request.app["rule_engine"]
+        if eng is None:
+            return web.json_response(
+                {"error": "rules engine disabled on this node"}, status=400
+            )
+        if request.method == "GET":
+            return web.Response(
+                text=_dumps({"rules": eng.list_rules(),
+                             "rollup_tables": list(eng.rollup_sources)}),
+                content_type="application/json",
+            )
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        loop = asyncio.get_running_loop()
+        if request.method == "DELETE":
+            name = body.get("name") if isinstance(body, dict) else None
+            if not isinstance(name, str) or not name:
+                return web.json_response(
+                    {"error": "body must be {'name': ...}"}, status=400
+                )
+            try:
+                removed = await loop.run_in_executor(
+                    None, eng.remove_rule, name
+                )
+            except RuleError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            return web.json_response(
+                {"removed": removed, "rules": eng.list_rules()}
+            )
+        try:
+            rule = await loop.run_in_executor(None, eng.add_rule, body)
+        except RuleError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"added": rule.to_dict()})
+
     # ---- meta events (coordinator -> data node; ref: MetaEventService,
     # grpc/meta_event_service/mod.rs:638-696) ----------------------------
     async def meta_open_shard(request: web.Request) -> web.Response:
@@ -1456,12 +1557,16 @@ def create_app(
     app.router.add_get("/debug/flush", debug_flush)
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_get("/debug/workload", debug_workload)
+    app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)
     app.router.add_get("/admin/quota", admin_quota)
     app.router.add_post("/admin/quota", admin_quota)
     app.router.add_delete("/admin/quota", admin_quota)
+    app.router.add_get("/admin/rules", admin_rules)
+    app.router.add_post("/admin/rules", admin_rules)
+    app.router.add_delete("/admin/rules", admin_rules)
     return app
 
 
@@ -1634,6 +1739,7 @@ def run_server(
         limits=(config.limits if config is not None else None),
         observability=observability,
         node=node,
+        rules_cfg=(config.rules if config is not None else None),
     )
     app["proxy"].slow_threshold_s = slow_threshold
 

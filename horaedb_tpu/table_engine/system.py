@@ -28,6 +28,10 @@ Five tables:
   shard freeze/thaw), each carrying the trace_id of the request that
   caused it — joinable against query_stats.request_id and the
   /debug/trace store
+- ``system.public.alerts``      — the rule engine's alert state
+  (rules/engine.RuleEngine): one row per live pending/firing alert
+  series plus the recently-resolved ring, labels rendered in the
+  standard folded form — the SQL face of /debug/alerts on every wire
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ QUERY_STATS_NAME = "system.public.query_stats"
 METRICS_NAME = "system.public.metrics"
 WORKLOAD_NAME = "system.public.workload"
 EVENTS_NAME = "system.public.events"
+ALERTS_NAME = "system.public.alerts"
 
 
 class _VirtualTable(Table):
@@ -430,6 +435,79 @@ class EventsTable(_VirtualTable):
         )
 
 
+_ALERTS_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("rule", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("labels", DatumKind.STRING),
+        ColumnSchema("state", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("value", DatumKind.DOUBLE),
+        ColumnSchema("active_since", DatumKind.INT64),
+        ColumnSchema("fired_at", DatumKind.INT64),
+        ColumnSchema("resolved_at", DatumKind.INT64),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "rule", "labels"],
+)
+
+
+class AlertsTable(_VirtualTable):
+    """``system.public.alerts``: the rule engine's alert lifecycle state
+    as rows (pending/firing live, recently-resolved ring), summed over
+    every registered RuleEngine in the process. ``timestamp`` is the
+    instance's state-entry time (fired_at for firing, resolved_at for
+    resolved, active_since for pending) so dashboards sort naturally."""
+
+    @property
+    def name(self) -> str:
+        return ALERTS_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _ALERTS_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..rules import registered_engines
+        from ..utils.metrics import _render_labels
+
+        entries = []
+        for eng in registered_engines():
+            entries.extend(eng.alerts_snapshot())
+
+        def ts_of(e: dict) -> int:
+            if e["state"] == "resolved":
+                return e["resolved_at_ms"]
+            if e["state"] == "firing":
+                return e["fired_at_ms"]
+            return e["active_since_ms"]
+
+        return RowGroup(
+            _ALERTS_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [ts_of(e) for e in entries], dtype=np.int64
+                ),
+                "rule": np.array([e["rule"] for e in entries], dtype=object),
+                "labels": np.array(
+                    [_render_labels(e["labels"]) for e in entries], dtype=object
+                ),
+                "state": np.array([e["state"] for e in entries], dtype=object),
+                "value": np.array(
+                    [float(e["value"]) for e in entries], dtype=np.float64
+                ),
+                "active_since": np.array(
+                    [e["active_since_ms"] for e in entries], dtype=np.int64
+                ),
+                "fired_at": np.array(
+                    [e["fired_at_ms"] for e in entries], dtype=np.int64
+                ),
+                "resolved_at": np.array(
+                    [e["resolved_at_ms"] for e in entries], dtype=np.int64
+                ),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -444,4 +522,6 @@ def open_system_table(catalog, name: str):
         return WorkloadTable()
     if low == EVENTS_NAME:
         return EventsTable()
+    if low == ALERTS_NAME:
+        return AlertsTable()
     return None

@@ -16,6 +16,10 @@ Commands:
     diagnose                health + config + table summary in one shot
     status                  node status document (/debug/status)
     events tail [--kind K] [--limit N]   engine event journal
+    rules list              loaded recording/alert rules (+ rollups)
+    rules add NAME EXPR [--kind alert] [--for 30s]   add a runtime rule
+    rules rm NAME           remove a runtime rule
+    alerts                  alert state (pending/firing/resolved)
 
 Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
 
@@ -217,6 +221,59 @@ def cmd_events(ep: str, args) -> None:
     _print_rows(rows)
 
 
+def cmd_rules(ep: str, args) -> None:
+    """rules list|add|rm against /admin/rules (mirrors `events tail`)."""
+    if args.action == "list":
+        data = json.loads(_get(ep, "/admin/rules"))
+        rows = [
+            {
+                "name": r["name"],
+                "kind": r["kind"],
+                "for_s": r["for_s"],
+                "source": r["source"],
+                "expr": r["expr"],
+                "last_error": r.get("last_error", ""),
+            }
+            for r in data["rules"]
+        ]
+        _print_rows(rows)
+        if data.get("rollup_tables"):
+            print(f"rollup_tables: {', '.join(data['rollup_tables'])}")
+        return
+    if args.action == "add":
+        payload = {
+            "name": args.name,
+            "expr": " ".join(args.expr),
+            "kind": args.kind,
+        }
+        if getattr(args, "for_", None):
+            payload["for"] = args.for_
+        print(_post(ep, "/admin/rules", payload))
+        return
+    # rm
+    print(_post(ep, "/admin/rules", {"name": args.name}, method="DELETE"))
+
+
+def cmd_alerts(ep: str, args) -> None:
+    """Current alert state (/debug/alerts)."""
+    data = json.loads(_get(ep, "/debug/alerts"))
+    if not data.get("enabled", False):
+        print("(rules engine disabled on this node)")
+        return
+    rows = [
+        {
+            "rule": a["rule"],
+            "state": a["state"],
+            "value": a["value"],
+            "labels": json.dumps(a["labels"], sort_keys=True),
+            "active_since_ms": a["active_since_ms"],
+            "fired_at_ms": a["fired_at_ms"],
+        }
+        for a in data["alerts"]
+    ]
+    _print_rows(rows)
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -249,6 +306,19 @@ def main(argv=None) -> int:
     ev.add_argument("action", nargs="?", default="tail", choices=["tail"])
     ev.add_argument("--kind", default=None)
     ev.add_argument("--limit", type=int, default=20)
+    rl = sub.add_parser("rules")
+    rl_sub = rl.add_subparsers(dest="action", required=True)
+    rl_sub.add_parser("list")
+    rl_add = rl_sub.add_parser("add")
+    rl_add.add_argument("name")
+    rl_add.add_argument("expr", nargs="+", help="PromQL expression")
+    rl_add.add_argument("--kind", default="recording",
+                        choices=["recording", "alert"])
+    rl_add.add_argument("--for", dest="for_", default=None,
+                        help="alert for-duration, e.g. 30s")
+    rl_rm = rl_sub.add_parser("rm")
+    rl_rm.add_argument("name")
+    sub.add_parser("alerts")
     sub.add_parser("shards")
     sub.add_parser("wal_stats")
     sub.add_parser("slow_log")

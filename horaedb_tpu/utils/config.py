@@ -34,6 +34,18 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     self_scrape_interval = "10s"      # into system_metrics.samples
     self_metrics_retention = "24h"    # 0s = keep forever
 
+    [rules]
+    enabled = true                    # continuous-query engine (rules/)
+    eval_interval = "15s"             # rule + rollup evaluation cadence
+    grace = "5s"                      # rollup bucket close grace (late rows)
+    recording = ["error_rate := rate(errors_total[1m])"]
+    alerts = ["HighErrors := rate(errors_total[1m]) > 5 for 30s"]
+    rollup_tables = ["cpu"]           # maintain raw -> 1m -> 1h ladders
+    rollup_raw_ttl = "24h"            # applied to each source (0s = leave)
+    rollup_1m_ttl = "30d"
+    rollup_1h_ttl = "0s"              # 0s = keep forever
+    recording_ttl = "30d"             # recording-rule output tables
+
 Env overrides: HORAEDB_HTTP_PORT, HORAEDB_HOST, HORAEDB_DATA_DIR.
 """
 
@@ -179,6 +191,26 @@ class ObservabilitySection:
 
 
 @dataclass
+class RulesSection:
+    """Continuous queries (rules/): PromQL recording rules and alert
+    rules in the compact ``NAME := EXPR [for 30s]`` line form, plus the
+    tiered rollup ladder (raw -> 1m -> 1h with TTL laddering) for the
+    listed source tables. All evaluated on one periodic loop; runtime
+    additions via /admin/rules persist beside wlm_state.json."""
+
+    enabled: bool = True
+    eval_interval_s: float = 15.0
+    grace_s: float = 5.0
+    recording: list[str] = field(default_factory=list)
+    alerts: list[str] = field(default_factory=list)
+    rollup_tables: list[str] = field(default_factory=list)
+    rollup_raw_ttl_s: float = 24 * 3600.0
+    rollup_1m_ttl_s: float = 30 * 24 * 3600.0
+    rollup_1h_ttl_s: float = 0.0
+    recording_ttl_s: float = 30 * 24 * 3600.0
+
+
+@dataclass
 class ClusterSection:
     enabled: bool = False
     self_endpoint: str = ""
@@ -215,6 +247,7 @@ class Config:
     observability: ObservabilitySection = field(
         default_factory=ObservabilitySection
     )
+    rules: RulesSection = field(default_factory=RulesSection)
     cluster: ClusterSection = field(default_factory=ClusterSection)
     s3: S3Section = field(default_factory=S3Section)
 
@@ -251,6 +284,11 @@ _KNOWN = {
     },
     "observability": {
         "self_scrape", "self_scrape_interval", "self_metrics_retention",
+    },
+    "rules": {
+        "enabled", "eval_interval", "grace", "recording", "alerts",
+        "rollup_tables", "rollup_raw_ttl", "rollup_1m_ttl",
+        "rollup_1h_ttl", "recording_ttl",
     },
     "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
     "s3": {
@@ -358,6 +396,44 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.observability.self_metrics_retention_s = (
             parse_duration_ms(o["self_metrics_retention"]) / 1000.0
         )
+    ru = raw.get("rules", {})
+    if "enabled" in ru:
+        if not isinstance(ru["enabled"], bool):
+            raise ConfigError("rules.enabled must be a boolean")
+        cfg.rules.enabled = ru["enabled"]
+    if "eval_interval" in ru:
+        cfg.rules.eval_interval_s = parse_duration_ms(ru["eval_interval"]) / 1000.0
+        if cfg.rules.eval_interval_s <= 0:
+            raise ConfigError("rules.eval_interval must be positive")
+    if "grace" in ru:
+        cfg.rules.grace_s = parse_duration_ms(ru["grace"]) / 1000.0
+        if cfg.rules.grace_s < 0:
+            raise ConfigError("rules.grace must be >= 0")
+    for key in ("recording", "alerts", "rollup_tables"):
+        if key in ru:
+            v = ru[key]
+            if not isinstance(v, list) or not all(isinstance(x, str) for x in v):
+                raise ConfigError(f"rules.{key} must be a list of strings")
+            setattr(cfg.rules, key, list(v))
+    for key, attr in (
+        ("rollup_raw_ttl", "rollup_raw_ttl_s"),
+        ("rollup_1m_ttl", "rollup_1m_ttl_s"),
+        ("rollup_1h_ttl", "rollup_1h_ttl_s"),
+        ("recording_ttl", "recording_ttl_s"),
+    ):
+        if key in ru:
+            setattr(cfg.rules, attr, parse_duration_ms(ru[key]) / 1000.0)
+    if ru:
+        # rule lines fail HERE, at load, not at the first evaluation
+        from ..rules.model import RuleError, parse_rule_line
+
+        try:
+            for line in cfg.rules.recording:
+                parse_rule_line(line, "recording")
+            for line in cfg.rules.alerts:
+                parse_rule_line(line, "alert")
+        except RuleError as e:
+            raise ConfigError(f"[rules]: {e}") from None
     s3 = raw.get("s3", {})
     if s3:
         for k in ("bucket", "endpoint", "region", "access_key", "secret_key",

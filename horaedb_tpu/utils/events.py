@@ -51,6 +51,10 @@ EVENT_KINDS: dict[str, str] = {
     "shard_thaw": "a frozen shard thawed (lease renewed)",
     "self_scrape_skipped": "a self-monitoring scrape round was shed by backpressure",
     "self_retention": "self-monitoring retention dropped expired sample SSTs",
+    "alert_fired": "an alert rule's series transitioned pending -> firing",
+    "alert_resolved": "a firing alert series stopped matching and resolved",
+    "rule_eval_failed": "a rule/rollup evaluation raised (or a round was shed)",
+    "rollup_catchup": "a rollup tier advanced over a multi-bucket backlog (restart/backfill)",
 }
 
 _EVENTS_FAMILY = "horaedb_events_total"

@@ -1,0 +1,1066 @@
+"""Continuous-query subsystem tests (PR-8 acceptance): PromQL recording
+rules materialize as real tables, tiered rollups stay exactly equivalent
+to raw recomputation (including restart/WAL-replay watermark catch-up
+and TTL-boundary reads), step-compatible dashboard queries transparently
+serve from the rollup (``route=rollup`` in the ledger + EXPLAIN), and
+the alert evaluator drives pending -> firing -> resolved with typed
+trace-linked events and ``system.public.alerts`` on all three wires."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.proxy import Proxy
+from horaedb_tpu.proxy.promql import (
+    evaluate_expr_instant,
+    evaluate_expr_range,
+    parse_promql,
+)
+from horaedb_tpu.rules import (
+    ROLLUPS,
+    RuleEngine,
+    RuleError,
+    parse_rule_line,
+    rollup_table_name,
+    rule_from_dict,
+)
+from horaedb_tpu.server import create_app
+from horaedb_tpu.server.mysql import MysqlServer
+from horaedb_tpu.server.postgres import PostgresServer
+from horaedb_tpu.utils.config import Config, ConfigError, RulesSection
+from horaedb_tpu.utils.events import EVENT_STORE
+from horaedb_tpu.utils.querystats import STATS_STORE
+
+# raw byte-level protocol clients + subprocess-node helpers
+from test_remote_engine import CPU_ENV, free_port, http, sql  # noqa: F401
+from test_wire_protocols import MyClient, PgClient
+
+HOUR = 3_600_000
+MIN = 60_000
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rollup_registry():
+    """The rollup registry is process-global (like STATS_STORE): tests
+    must not see another module's — or test's — registrations."""
+    ROLLUPS.reset()
+    yield
+    ROLLUPS.reset()
+
+
+def _mk_source(db, name: str, n_hosts=3, hours=3, step_s=20, seed=11,
+               end=1_786_000_000_000):
+    """A dashboard-shaped source table: host TAG, value double, dense
+    samples over `hours` ending at the hour-aligned `end`."""
+    db.execute(
+        f"CREATE TABLE {name} (host string TAG, value double, ts timestamp "
+        "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+        "WITH (segment_duration='2h', update_mode='append')"
+    )
+    end = (end // HOUR) * HOUR
+    start = end - hours * HOUR
+    rng = np.random.default_rng(seed)
+    vals = []
+    for t in range(start, end, step_s * 1000):
+        for h in range(n_hosts):
+            vals.append(f"('h{h}', {rng.normal(10, 3):.6f}, {t})")
+    for i in range(0, len(vals), 1000):
+        db.execute(
+            f"INSERT INTO {name} (host, value, ts) VALUES "
+            + ",".join(vals[i:i + 1000])
+        )
+    return start, end
+
+
+def _rows_close(a: list, b: list, rtol=2e-3, atol=1e-3) -> bool:
+    """Order-insensitive approximate row comparison (the raw path rides
+    f32 device kernels; the rollup partials are f64)."""
+    if len(a) != len(b):
+        return False
+
+    def key(row):
+        return tuple(
+            (k, v if not isinstance(v, float) else round(v, 3))
+            for k, v in sorted(row.items())
+        )
+
+    for ra, rb in zip(sorted(a, key=key), sorted(b, key=key)):
+        if set(ra) != set(rb):
+            return False
+        for k in ra:
+            va, vb = ra[k], rb[k]
+            if isinstance(va, float) or isinstance(vb, float):
+                if not np.isclose(
+                    float(va), float(vb), rtol=rtol, atol=atol, equal_nan=True
+                ):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def _raw_forced(db, sql_text):
+    os.environ["HORAEDB_ROLLUP"] = "0"
+    try:
+        return db.execute(sql_text).to_pylist()
+    finally:
+        os.environ.pop("HORAEDB_ROLLUP", None)
+
+
+class TestRuleModel:
+    def test_parse_forms(self):
+        r = parse_rule_line("req_rate := rate(reqs[1m])", "recording")
+        assert (r.name, r.kind, r.for_s) == ("req_rate", "recording", 0.0)
+        a = parse_rule_line(
+            "HighRate := rate(reqs[1m]) > 5 for 30s", "alert"
+        )
+        assert (a.name, a.for_s) == ("HighRate", 30.0)
+        assert a.expr == "rate(reqs[1m]) > 5"
+        # for is optional on alerts
+        a0 = parse_rule_line("Now := reqs > 1", "alert")
+        assert a0.for_s == 0.0
+
+    def test_validation_errors(self):
+        with pytest.raises(RuleError, match="NAME := EXPR"):
+            parse_rule_line("no separator", "recording")
+        with pytest.raises(RuleError, match="bad expr"):
+            parse_rule_line("x := rate(", "recording")
+        with pytest.raises(RuleError, match="must match"):
+            parse_rule_line("bad-name := reqs", "recording")
+        with pytest.raises(RuleError, match="no for duration"):
+            rule_from_dict(
+                {"name": "x", "expr": "reqs", "kind": "recording",
+                 "for": "5s"}
+            )
+        r = rule_from_dict(
+            {"name": "x", "expr": "reqs > 1", "kind": "alert", "for": "2m"}
+        )
+        assert r.for_s == 120.0
+
+    def test_config_section_parses_and_validates(self, tmp_path):
+        cfg = tmp_path / "c.toml"
+        cfg.write_text(
+            """
+[rules]
+eval_interval = "1s"
+grace = "0s"
+recording = ["r1 := avg(cpu)"]
+alerts = ["A1 := cpu > 5 for 10s"]
+rollup_tables = ["cpu"]
+rollup_raw_ttl = "12h"
+"""
+        )
+        c = Config.load(str(cfg))
+        assert c.rules.eval_interval_s == 1.0
+        assert c.rules.rollup_tables == ["cpu"]
+        assert c.rules.rollup_raw_ttl_s == 12 * 3600.0
+        bad = tmp_path / "bad.toml"
+        bad.write_text('[rules]\nalerts = ["A1 := rate("]\n')
+        with pytest.raises(ConfigError, match="bad expr"):
+            Config.load(str(bad))
+        unk = tmp_path / "unk.toml"
+        unk.write_text("[rules]\nnope = 1\n")
+        with pytest.raises(ConfigError, match="unknown key"):
+            Config.load(str(unk))
+
+
+class TestPromqlComparisons:
+    """The alert evaluator's threshold surface: prom filter semantics."""
+
+    @pytest.fixture()
+    def db(self):
+        conn = horaedb_tpu.connect(None)
+        conn.execute(
+            "CREATE TABLE cmp (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        now = int(time.time() * 1000)
+        for i in range(10):
+            conn.execute(
+                f"INSERT INTO cmp (host, value, ts) VALUES "
+                f"('a', 100.0, {now - 60000 + i * 5000}), "
+                f"('b', 1.0, {now - 60000 + i * 5000})"
+            )
+        yield conn, now
+        conn.close()
+
+    def test_vector_scalar_filters(self, db):
+        conn, now = db
+        out = evaluate_expr_instant(conn, parse_promql("cmp > 50"), now)
+        assert [s["metric"]["host"] for s in out] == ["a"]
+        assert float(out[0]["value"][1]) == 100.0
+        out = evaluate_expr_instant(conn, parse_promql("cmp <= 50"), now)
+        assert [s["metric"]["host"] for s in out] == ["b"]
+        # scalar OP vector keeps the vector's values
+        out = evaluate_expr_instant(conn, parse_promql("50 < cmp"), now)
+        assert [s["metric"]["host"] for s in out] == ["a"]
+
+    def test_scalar_scalar_and_vector_vector(self, db):
+        conn, now = db
+        out = evaluate_expr_instant(conn, parse_promql("3 > 2"), now)
+        assert float(out[0]["value"][1]) == 1.0
+        out = evaluate_expr_instant(conn, parse_promql("2 > 3"), now)
+        assert float(out[0]["value"][1]) == 0.0
+        # vector/vector: lhs survives where both exist and cmp holds
+        out = evaluate_expr_instant(
+            conn, parse_promql("cmp >= cmp"), now
+        )
+        assert {s["metric"]["host"] for s in out} == {"a", "b"}
+
+    def test_range_filter_and_precedence(self, db):
+        conn, now = db
+        out = evaluate_expr_range(
+            conn, parse_promql("cmp > 2 + 40"), now - 30000, now, 10000
+        )
+        # + binds tighter than >: threshold is 42 -> only host a
+        assert {s["metric"]["host"] for s in out} == {"a"}
+        out = evaluate_expr_range(
+            conn, parse_promql("avg_over_time(cmp[1m]) == 1"),
+            now - 60000, now, 10000,
+        )
+        assert {s["metric"]["host"] for s in out} == {"b"}
+
+
+class TestRollupMaintenance:
+    def test_rollup_matches_exact_recompute_and_is_idempotent(self):
+        db = horaedb_tpu.connect(None)
+        start, end = _mk_source(db, "rm_src", hours=2)
+        eng = RuleEngine(
+            db,
+            RulesSection(rollup_tables=["rm_src"], grace_s=0,
+                         rollup_raw_ttl_s=0),
+        ).load()
+        eng.run_once(now_ms=end)
+        got = db.execute(
+            "SELECT ts, host, agg_sum, agg_count, agg_min, agg_max "
+            "FROM rm_src_rollup_1m"
+        ).to_pylist()
+        want = db.execute(
+            "SELECT time_bucket(ts, '1m') AS ts, host, sum(value) AS agg_sum, "
+            "count(value) AS agg_count, min(value) AS agg_min, "
+            "max(value) AS agg_max FROM rm_src "
+            f"WHERE ts < {end} GROUP BY time_bucket(ts, '1m'), host"
+        ).to_pylist()
+        assert len(got) == len(want) > 0
+        assert _rows_close(got, want)
+        # 1h tier folds the 1m tier
+        got_h = db.execute(
+            "SELECT ts, host, agg_sum, agg_count FROM rm_src_rollup_1h"
+        ).to_pylist()
+        assert len(got_h) == 2 * 3  # 2 hours x 3 hosts
+        # replaying the round cannot double-count (overwrite semantics +
+        # watermark): totals stay identical
+        st = ROLLUPS.get("rm_src")
+        st.set_watermark("1m", start)  # simulate a lost watermark
+        st.set_watermark("1h", start)
+        eng.run_once(now_ms=end)
+        again = db.execute(
+            "SELECT sum(agg_count) AS n FROM rm_src_rollup_1m"
+        ).to_pylist()
+        before = sum(r["agg_count"] for r in want)
+        assert again[0]["n"] == pytest.approx(before)
+        db.close()
+
+    def test_grace_keeps_open_buckets_out(self):
+        db = horaedb_tpu.connect(None)
+        start, end = _mk_source(db, "gr_src", hours=1)
+        eng = RuleEngine(
+            db,
+            RulesSection(rollup_tables=["gr_src"], grace_s=120.0,
+                         rollup_raw_ttl_s=0),
+        ).load()
+        eng.run_once(now_ms=end)
+        wm = ROLLUPS.get("gr_src").watermark("1m")
+        assert wm == ((end - 120_000) // MIN) * MIN
+        got = db.execute(
+            "SELECT max(ts) AS m FROM gr_src_rollup_1m"
+        ).to_pylist()
+        assert got[0]["m"] < wm
+        db.close()
+
+    def test_restart_and_wal_replay_catch_up(self, tmp_path):
+        """Kill the engine (and the process state: fresh registry), write
+        more rows, restart: catch-up recomputes forward from the persisted
+        watermark — no gaps, no double counts."""
+        path = str(tmp_path / "rr")
+        db = horaedb_tpu.connect(path)
+        start, end = _mk_source(db, "rs_src", hours=2)
+        sec = RulesSection(rollup_tables=["rs_src"], grace_s=0,
+                           rollup_raw_ttl_s=0)
+        eng = RuleEngine(db, sec).load()
+        eng.run_once(now_ms=end - HOUR)  # roll only the first hour
+        assert os.path.exists(os.path.join(path, "rules_state.json"))
+        db.close()
+        ROLLUPS.reset()  # process restart: registry is empty
+
+        db2 = horaedb_tpu.connect(path)  # WAL replay path
+        # late-arriving rows land in the UNROLLED tail (after the
+        # persisted watermark) — catch-up must include them
+        for t in range(end - HOUR, end, MIN // 2):
+            db2.execute(
+                f"INSERT INTO rs_src (host, value, ts) VALUES "
+                f"('late', 5.0, {t})"
+            )
+        eng2 = RuleEngine(db2, sec).load()
+        eng2.run_once(now_ms=end)
+        got = db2.execute(
+            "SELECT ts, host, agg_sum, agg_count, agg_min, agg_max "
+            "FROM rs_src_rollup_1m"
+        ).to_pylist()
+        want = db2.execute(
+            "SELECT time_bucket(ts, '1m') AS ts, host, sum(value) AS agg_sum, "
+            "count(value) AS agg_count, min(value) AS agg_min, "
+            "max(value) AS agg_max FROM rs_src "
+            f"WHERE ts < {end} GROUP BY time_bucket(ts, '1m'), host"
+        ).to_pylist()
+        assert _rows_close(got, want)
+        assert any(r["host"] == "late" for r in got)
+        # the multi-bucket advance journaled a catch-up event
+        assert any(
+            e["kind"] == "rollup_catchup" for e in EVENT_STORE.list()
+        )
+        db2.close()
+
+    def test_ttl_ladder_applied_to_source_and_tiers(self):
+        db = horaedb_tpu.connect(None)
+        _mk_source(db, "tt_src", hours=1)
+        eng = RuleEngine(
+            db,
+            RulesSection(
+                rollup_tables=["tt_src"], grace_s=0,
+                rollup_raw_ttl_s=24 * 3600.0,
+                rollup_1m_ttl_s=30 * 24 * 3600.0,
+                rollup_1h_ttl_s=0.0,
+            ),
+        ).load()
+        eng.run_once()
+        src_opts = db.catalog.open("tt_src").physical_datas()[0].options
+        assert src_opts.enable_ttl and src_opts.ttl_ms == 24 * 3600 * 1000
+        m_opts = db.catalog.open("tt_src_rollup_1m").physical_datas()[0].options
+        assert m_opts.enable_ttl and m_opts.ttl_ms == 30 * 24 * 3600 * 1000
+        h_opts = db.catalog.open("tt_src_rollup_1h").physical_datas()[0].options
+        assert not h_opts.enable_ttl  # kept forever
+        from horaedb_tpu.engine.options import UpdateMode
+
+        assert m_opts.update_mode is UpdateMode.OVERWRITE
+        db.close()
+
+
+class TestRollupRewrite:
+    @pytest.fixture()
+    def served(self):
+        db = horaedb_tpu.connect(None)
+        start, end = _mk_source(db, "rw_src", hours=3)
+        eng = RuleEngine(
+            db,
+            RulesSection(rollup_tables=["rw_src"], grace_s=0,
+                         rollup_raw_ttl_s=0),
+        ).load()
+        eng.run_once(now_ms=end)
+        yield db, start, end, eng
+        db.close()
+
+    def test_randomized_equivalence_property(self, served):
+        """THE acceptance property, end-to-end via Proxy.handle_sql (so
+        ledger rows populate): for random step/agg/filter/order shapes,
+        the rollup-served answer equals the exact raw recomputation."""
+        db, start, end, _ = served
+        proxy = Proxy(db)
+        rng = np.random.default_rng(3)
+        steps = ["1m", "5m", "15m", "1h"]
+        aggs = [
+            "sum(value) AS v", "count(value) AS v", "min(value) AS v",
+            "max(value) AS v", "avg(value) AS v",
+            "min(value) AS lo, max(value) AS hi, avg(value) AS v",
+        ]
+        checked_rollup = 0
+        for trial in range(12):
+            step = steps[rng.integers(0, len(steps))]
+            agg = aggs[rng.integers(0, len(aggs))]
+            where = [f"ts >= {start + int(rng.integers(0, 2 * HOUR))}"]
+            if rng.random() < 0.5:
+                where.append(f"ts < {end - int(rng.integers(0, HOUR))}")
+            if rng.random() < 0.4:
+                where.append("host != 'h1'")
+            tail = ""
+            if rng.random() < 0.4:
+                tail = " ORDER BY b, host LIMIT 40"
+            q = (
+                f"SELECT time_bucket(ts, '{step}') AS b, host, {agg} "
+                f"FROM rw_src WHERE {' AND '.join(where)} "
+                f"GROUP BY time_bucket(ts, '{step}'), host{tail}"
+            )
+            got = proxy.handle_sql(q).to_pylist()
+            path = db.interpreters.executor.last_path
+            want = _raw_forced(db, q)
+            assert _rows_close(got, want), f"trial {trial}: {q}"
+            if path == "rollup":
+                checked_rollup += 1
+        assert checked_rollup >= 8, "rollup route should serve most shapes"
+        # the ledger recorded the rewrite: route=rollup rows in
+        # query_stats for the proxied statements
+        routes = {
+            e["route"] for e in STATS_STORE.list()
+            if "rw_src" in e.get("sql", "")
+        }
+        assert "rollup" in routes
+
+    def test_open_tail_is_served_fresh(self, served):
+        """Rows newer than the watermark (the still-open bucket) must be
+        included via the raw tail — a dashboard's 'now' edge is never
+        stale."""
+        db, start, end, eng = served
+        for t in range(end, end + 90_000, 10_000):
+            db.execute(
+                f"INSERT INTO rw_src (host, value, ts) VALUES ('h0', 42.0, {t})"
+            )
+        q = (
+            "SELECT time_bucket(ts, '1m') AS b, host, sum(value) AS v "
+            f"FROM rw_src WHERE ts >= {start} GROUP BY "
+            "time_bucket(ts, '1m'), host"
+        )
+        got = db.execute(q)
+        assert db.interpreters.executor.last_path == "rollup"
+        m = got.metrics
+        assert m["raw_tail_rows"] > 0
+        assert _rows_close(got.to_pylist(), _raw_forced(db, q))
+
+    def test_explain_and_ledger_visibility(self, served):
+        db, start, end, _ = served
+        q = (
+            "SELECT time_bucket(ts, '5m') AS b, host, avg(value) AS v "
+            f"FROM rw_src WHERE ts >= {start} GROUP BY "
+            "time_bucket(ts, '5m'), host"
+        )
+        plan = "\n".join(
+            r["plan"] for r in db.execute(f"EXPLAIN {q}").to_pylist()
+        )
+        assert "Rollup: table=rw_src_rollup_1m" in plan
+        assert "route=rollup" in plan
+        analyzed = "\n".join(
+            r["plan"] for r in db.execute(f"EXPLAIN ANALYZE {q}").to_pylist()
+        )
+        assert "path=rollup" in analyzed
+        assert "route=rollup" in analyzed
+        # the kill switch pins the raw path AND removes the EXPLAIN claim
+        os.environ["HORAEDB_ROLLUP"] = "0"
+        try:
+            plan_off = "\n".join(
+                r["plan"] for r in db.execute(f"EXPLAIN {q}").to_pylist()
+            )
+            assert "Rollup:" not in plan_off
+        finally:
+            os.environ.pop("HORAEDB_ROLLUP", None)
+
+    def test_incompatible_shapes_refuse(self, served):
+        db, start, end, _ = served
+        compatible = (
+            "SELECT time_bucket(ts, '5m') AS b, host, avg(value) AS v "
+            f"FROM rw_src WHERE ts >= {start} "
+            "GROUP BY time_bucket(ts, '5m'), host"
+        )
+        db.execute(compatible)
+        assert db.interpreters.executor.last_path == "rollup"
+        refusals = [
+            # count(*) differs from count(value) under NULLs
+            "SELECT time_bucket(ts, '5m') AS b, count(1) AS v FROM rw_src "
+            "GROUP BY time_bucket(ts, '5m')",
+            # step not a multiple of any tier
+            "SELECT time_bucket(ts, '90s') AS b, avg(value) AS v FROM rw_src "
+            "GROUP BY time_bucket(ts, '90s')",
+            # residual WHERE on the value column
+            "SELECT time_bucket(ts, '5m') AS b, avg(value) AS v FROM rw_src "
+            "WHERE value > 5 GROUP BY time_bucket(ts, '5m')",
+            # HAVING
+            "SELECT time_bucket(ts, '5m') AS b, avg(value) AS v FROM rw_src "
+            "GROUP BY time_bucket(ts, '5m') HAVING avg(value) > 0",
+            # no time_bucket key at all
+            "SELECT host, avg(value) AS v FROM rw_src GROUP BY host",
+        ]
+        for q in refusals:
+            db.execute(q)
+            assert db.interpreters.executor.last_path != "rollup", q
+
+    def test_promql_range_query_rides_the_rewrite(self, served):
+        db, start, end, _ = served
+        pq = parse_promql("rw_src")
+        got = evaluate_expr_range(db, pq, start, end - 1, 5 * MIN)
+        assert db.interpreters.executor.last_path == "rollup"
+        os.environ["HORAEDB_ROLLUP"] = "0"
+        try:
+            want = evaluate_expr_range(db, pq, start, end - 1, 5 * MIN)
+        finally:
+            os.environ.pop("HORAEDB_ROLLUP", None)
+        assert len(got) == len(want) > 0
+        for gs, ws in zip(got, want):
+            assert gs["metric"] == ws["metric"]
+            assert len(gs["values"]) == len(ws["values"])
+            for (tb, gv), (_, wv) in zip(gs["values"], ws["values"]):
+                assert float(gv) == pytest.approx(float(wv), rel=2e-3)
+
+    def test_ttl_boundary_reads_serve_from_rollup(self):
+        """Raw SSTs older than the ladder's raw TTL drop WHOLE; the
+        rollup keeps answering for that range, equal to what raw said
+        before the drop."""
+        db = horaedb_tpu.connect(None)
+        start, end = _mk_source(db, "tb_src", hours=3)
+        eng = RuleEngine(
+            db,
+            RulesSection(rollup_tables=["tb_src"], grace_s=0,
+                         # raw keeps only the last hour
+                         rollup_raw_ttl_s=3600.0),
+        ).load()
+        eng.run_once(now_ms=end)
+        old_q = (
+            "SELECT time_bucket(ts, '5m') AS b, host, sum(value) AS v, "
+            "count(value) AS n FROM tb_src "
+            f"WHERE ts >= {start} AND ts < {start + HOUR} "
+            "GROUP BY time_bucket(ts, '5m'), host"
+        )
+        before = _raw_forced(db, old_q)  # raw truth before the drop
+        # flush + TTL compaction drops the expired SSTs whole
+        table = db.catalog.open("tb_src")
+        table.flush()
+        from horaedb_tpu.engine.compaction import Compactor
+
+        td = table.physical_datas()[0]
+        result = Compactor(td).compact(now_ms=end)
+        assert result.expired_dropped > 0
+        # raw can no longer answer the old range...
+        gone = _raw_forced(db, old_q)
+        assert len(gone) < len(before)
+        # ...but the rollup-served path still does, exactly
+        after = db.execute(old_q)
+        assert db.interpreters.executor.last_path == "rollup"
+        assert _rows_close(after.to_pylist(), before)
+        db.close()
+
+    def test_coarse_steps_use_the_1h_tier(self, served):
+        db, start, end, _ = served
+        q = (
+            "SELECT time_bucket(ts, '1h') AS b, host, max(value) AS v "
+            f"FROM rw_src WHERE ts >= {start} GROUP BY "
+            "time_bucket(ts, '1h'), host"
+        )
+        out = db.execute(q)
+        assert db.interpreters.executor.last_path == "rollup"
+        assert out.metrics["tier"] == "1h"
+        assert _rows_close(out.to_pylist(), _raw_forced(db, q))
+
+
+class TestRecordingRules:
+    def test_recording_writes_real_table_and_promql_reads_back(self):
+        db = horaedb_tpu.connect(None)
+        now = int(time.time() * 1000)
+        db.execute(
+            "CREATE TABLE reqs (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        for i in range(30):
+            db.execute(
+                f"INSERT INTO reqs (host, value, ts) VALUES "
+                f"('a', {float(i)}, {now - 30000 + i * 1000}), "
+                f"('b', 7.0, {now - 30000 + i * 1000})"
+            )
+        eng = RuleEngine(
+            db,
+            RulesSection(
+                recording=["req_avg := avg_over_time(reqs[1m])"],
+            ),
+        ).load()
+        eng.run_once(now_ms=now)
+        rows = db.execute(
+            "SELECT labels, node, value FROM req_avg"
+        ).to_pylist()
+        assert {r["labels"] for r in rows} == {'{host="a"}', '{host="b"}'}
+        assert all(r["node"] == "standalone" for r in rows)
+        # PromQL selector + matcher on the LIFTED label
+        out = evaluate_expr_instant(
+            db, parse_promql('req_avg{host="b"}'), now + 1000
+        )
+        assert len(out) == 1
+        assert out[0]["metric"]["host"] == "b"
+        assert float(out[0]["value"][1]) == pytest.approx(7.0)
+        db.close()
+
+    def test_user_table_with_labels_tag_keeps_plain_semantics(self):
+        """Only the EXACT samples shape gets folded-label lifting: a
+        user table that merely has a tag called 'labels' beside its own
+        tags must keep plain-tag series identity (lifting would parse
+        the values and collapse distinct series)."""
+        db = horaedb_tpu.connect(None)
+        now = int(time.time() * 1000)
+        db.execute(
+            "CREATE TABLE lbl (labels string TAG, region string TAG, "
+            "value double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+            "ENGINE=Analytic"
+        )
+        db.execute(
+            f"INSERT INTO lbl (labels, region, value, ts) VALUES "
+            f"('critical', 'eu', 1.0, {now - 1000}), "
+            f"('warning', 'eu', 2.0, {now - 1000})"
+        )
+        out = evaluate_expr_instant(db, parse_promql("lbl"), now)
+        assert {s["metric"]["labels"] for s in out} == {
+            "critical", "warning"
+        }
+        # an unknown label still errors (not silently post-filtered)
+        from horaedb_tpu.proxy.promql import PromQLError
+
+        with pytest.raises(PromQLError, match="unknown label"):
+            evaluate_expr_instant(
+                db, parse_promql('lbl{nope="x"}'), now
+            )
+        db.close()
+
+    def test_runtime_rules_persist_across_restart(self, tmp_path):
+        path = str(tmp_path / "rp")
+        db = horaedb_tpu.connect(path)
+        eng = RuleEngine(db, RulesSection()).load()
+        eng.add_rule(
+            {"name": "r_runtime", "expr": "avg(missing_metric)",
+             "kind": "recording"}
+        )
+        assert eng.rules["r_runtime"].source == "runtime"
+        db.close()
+        db2 = horaedb_tpu.connect(path)
+        eng2 = RuleEngine(db2, RulesSection()).load()
+        assert "r_runtime" in eng2.rules
+        assert eng2.remove_rule("r_runtime")
+        eng3 = RuleEngine(db2, RulesSection()).load()
+        assert "r_runtime" not in eng3.rules
+        db2.close()
+
+    def test_config_rules_cannot_be_removed_at_runtime(self):
+        db = horaedb_tpu.connect(None)
+        eng = RuleEngine(
+            db, RulesSection(recording=["cfg_rule := avg(x)"])
+        ).load()
+        with pytest.raises(RuleError, match="config-defined"):
+            eng.remove_rule("cfg_rule")
+        with pytest.raises(RuleError, match="config-defined"):
+            eng.add_rule(
+                {"name": "cfg_rule", "expr": "avg(y)", "kind": "recording"}
+            )
+        db.close()
+
+    def test_per_rule_errors_are_isolated(self):
+        """One broken rule (bad column shape) must not starve the rest."""
+        db = horaedb_tpu.connect(None)
+        now = int(time.time() * 1000)
+        db.execute(
+            "CREATE TABLE ok_src (value double, ts timestamp NOT NULL, "
+            "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            f"INSERT INTO ok_src (value, ts) VALUES (1.0, {now - 1000})"
+        )
+        # two-double-field table: _value_column raises at eval time
+        db.execute(
+            "CREATE TABLE bad_src (v1 double, v2 double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            f"INSERT INTO bad_src (v1, v2, ts) VALUES (1.0, 2.0, {now})"
+        )
+        eng = RuleEngine(
+            db,
+            RulesSection(recording=[
+                "r_bad := avg_over_time(bad_src[1m])",
+                "r_ok := avg_over_time(ok_src[1m])",
+            ]),
+        ).load()
+        eng.run_once(now_ms=now)
+        assert "r_bad" in eng.stats()["last_errors"]
+        assert db.execute("SELECT value FROM r_ok").to_pylist() == [
+            {"value": 1.0}
+        ]
+        assert any(
+            e["kind"] == "rule_eval_failed"
+            and e["attrs"].get("rule") == "r_bad"
+            for e in EVENT_STORE.list()
+        )
+        db.close()
+
+
+class TestAlertLifecycle:
+    def _mk_alert_db(self):
+        db = horaedb_tpu.connect(None)
+        db.execute(
+            "CREATE TABLE errs (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        return db
+
+    def _burst(self, db, now, value=99.0):
+        for i in range(12):
+            db.execute(
+                f"INSERT INTO errs (host, value, ts) VALUES "
+                f"('a', {value}, {now - 55000 + i * 5000})"
+            )
+
+    def test_pending_firing_resolved_with_events(self):
+        EVENT_STORE.clear()
+        db = self._mk_alert_db()
+        now = int(time.time() * 1000)
+        self._burst(db, now)
+        eng = RuleEngine(
+            db,
+            RulesSection(
+                alerts=["HotErrs := avg_over_time(errs[1m]) > 50 for 20s"],
+            ),
+        ).load()
+        eng.run_once(now_ms=now)
+        snap = eng.alerts_snapshot()
+        assert [a["state"] for a in snap] == ["pending"]
+        assert snap[0]["labels"]["alertname"] == "HotErrs"
+        # still matching at +21s: fires
+        self._burst(db, now + 21000)
+        eng.run_once(now_ms=now + 21000)
+        snap = eng.alerts_snapshot()
+        assert [a["state"] for a in snap] == ["firing"]
+        fired = [e for e in EVENT_STORE.list() if e["kind"] == "alert_fired"]
+        assert len(fired) == 1
+        assert fired[0]["attrs"]["rule"] == "HotErrs"
+        assert fired[0]["trace_id"]  # trace-linked
+        # the window drains -> no samples -> resolved
+        eng.run_once(now_ms=now + 600_000)
+        snap = eng.alerts_snapshot()
+        assert [a["state"] for a in snap] == ["resolved"]
+        resolved = [
+            e for e in EVENT_STORE.list() if e["kind"] == "alert_resolved"
+        ]
+        assert len(resolved) == 1
+        db.close()
+
+    def test_pending_resets_without_firing(self):
+        EVENT_STORE.clear()
+        db = self._mk_alert_db()
+        now = int(time.time() * 1000)
+        self._burst(db, now)
+        eng = RuleEngine(
+            db,
+            RulesSection(
+                alerts=["Flap := avg_over_time(errs[1m]) > 50 for 5m"],
+            ),
+        ).load()
+        eng.run_once(now_ms=now)
+        assert [a["state"] for a in eng.alerts_snapshot()] == ["pending"]
+        eng.run_once(now_ms=now + 600_000)  # window empty before for_s
+        assert eng.alerts_snapshot() == []
+        assert not any(
+            e["kind"].startswith("alert_") for e in EVENT_STORE.list()
+        )
+        db.close()
+
+    def test_for_zero_fires_immediately(self):
+        db = self._mk_alert_db()
+        now = int(time.time() * 1000)
+        self._burst(db, now)
+        eng = RuleEngine(
+            db,
+            RulesSection(alerts=["Now := avg_over_time(errs[1m]) > 50"]),
+        ).load()
+        eng.run_once(now_ms=now)
+        assert [a["state"] for a in eng.alerts_snapshot()] == ["firing"]
+        db.close()
+
+    def test_alerts_table_on_http_mysql_and_pg(self):
+        """system.public.alerts serves the live lifecycle on all three
+        wires (the acceptance's three-protocol face)."""
+        db = self._mk_alert_db()
+        now = int(time.time() * 1000)
+        self._burst(db, now)
+        sec = RulesSection(
+            alerts=["WireHot := avg_over_time(errs[1m]) > 50"],
+            eval_interval_s=3600,
+        )
+        ALERTS_SQL = (
+            "SELECT rule, state, value, labels FROM system.public.alerts"
+        )
+
+        def check(dicts):
+            rows = [r for r in dicts if r["rule"] == "WireHot"]
+            assert len(rows) == 1, dicts
+            assert rows[0]["state"] == "firing"
+            assert float(rows[0]["value"]) == pytest.approx(99.0)
+            assert 'host="a"' in rows[0]["labels"]
+
+        def my_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            kind, names, rows = c.query(ALERTS_SQL)
+            s.close()
+            assert kind == "rows", rows
+            check([dict(zip(names, r)) for r in rows])
+
+        def pg_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            names, rows, _complete, err = c.query(ALERTS_SQL)
+            s.close()
+            assert err is None, err
+            check([dict(zip(names, r)) for r in rows])
+
+        async def body():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = create_app(db, rules_cfg=sec)
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            eng = app["rule_engine"]
+            eng.run_once(now_ms=now)
+            gw = app["sql_gateway"]
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                out = await client.post("/sql", json={"query": ALERTS_SQL})
+                assert out.status == 200
+                check((await out.json())["rows"])
+                out = await client.get("/debug/alerts")
+                data = await out.json()
+                assert data["enabled"]
+                assert [a["state"] for a in data["alerts"]] == ["firing"]
+                assert data["alerts"][0]["labels"]["host"] == "a"
+                await loop.run_in_executor(None, my_client, my.port)
+                await loop.run_in_executor(None, pg_client, pg.port)
+            finally:
+                await my.stop()
+                await pg.stop()
+                await client.close()
+
+        asyncio.run(body())
+        db.close()
+
+
+class TestAdminSurfaceAndStatus:
+    def test_admin_rules_debug_status_and_readiness(self):
+        db = horaedb_tpu.connect(None)
+        sec = RulesSection(
+            recording=["adm_r := avg(missing)"], eval_interval_s=3600
+        )
+
+        async def body():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = create_app(db, rules_cfg=sec)
+            eng = app["rule_engine"]
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                # started -> loaded -> ready
+                r = await client.get("/health", params={"ready": "1"})
+                assert r.status == 200
+                r = await client.get("/debug/status")
+                doc = await r.json()
+                assert doc["rules"]["rules_loaded"] == 1
+                assert doc["rules"]["loaded"] is True
+                # add / list / rm
+                r = await client.post(
+                    "/admin/rules",
+                    json={"name": "adm_added", "expr": "avg(x)",
+                          "kind": "recording"},
+                )
+                assert r.status == 200, await r.text()
+                r = await client.get("/admin/rules")
+                names = [x["name"] for x in (await r.json())["rules"]]
+                assert names == ["adm_added", "adm_r"]
+                r = await client.post(
+                    "/admin/rules", json={"name": "bad(", "expr": "x"}
+                )
+                assert r.status == 400
+                r = await client.delete(
+                    "/admin/rules", json={"name": "adm_added"}
+                )
+                assert (await r.json())["removed"] is True
+                r = await client.delete(
+                    "/admin/rules", json={"name": "adm_r"}
+                )
+                assert r.status == 400  # config rule
+                # ctl subcommands against the live server
+                from horaedb_tpu.tools import ctl
+
+                ep = f"127.0.0.1:{client.server.port}"
+                loop = asyncio.get_running_loop()
+                assert await loop.run_in_executor(
+                    None, ctl.main, ["--endpoint", ep, "rules", "list"]
+                ) == 0
+                assert await loop.run_in_executor(
+                    None, ctl.main,
+                    ["--endpoint", ep, "rules", "add", "ctl_rule",
+                     "avg(x)"],
+                ) == 0
+                assert "ctl_rule" in eng.rules
+                assert await loop.run_in_executor(
+                    None, ctl.main,
+                    ["--endpoint", ep, "rules", "rm", "ctl_rule"],
+                ) == 0
+                assert "ctl_rule" not in eng.rules
+                assert await loop.run_in_executor(
+                    None, ctl.main, ["--endpoint", ep, "alerts"]
+                ) == 0
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+        db.close()
+
+    def test_readiness_gates_on_rule_state_load(self):
+        """A node whose rule engine exists but has not loaded its state
+        is NOT ready (it would evaluate a stale rule set)."""
+        db = horaedb_tpu.connect(None)
+        sec = RulesSection(eval_interval_s=3600)
+
+        async def body():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            app = create_app(db, rules_cfg=sec)
+            eng = app["rule_engine"]
+            # simulate the pre-startup window: engine exists, not loaded
+            app.on_startup.clear()
+            client = TestClient(TestServer(app))
+            await client.start_server()
+            try:
+                assert not eng.loaded
+                r = await client.get("/health", params={"ready": "1"})
+                assert r.status == 503
+                r = await client.get("/health")
+                assert r.status == 200  # liveness unaffected
+                eng.load()
+                r = await client.get("/health", params={"ready": "1"})
+                assert r.status == 200
+            finally:
+                await client.close()
+
+        asyncio.run(body())
+        db.close()
+
+
+@pytest.fixture(scope="module")
+def rules_cluster(tmp_path_factory):
+    """Two static-mode nodes sharing a store; the rules config is
+    IDENTICAL on both (fleet-config discipline) and pins the source
+    table to node 1 — eval-on-owner means exactly one node evaluates."""
+    import subprocess
+    import sys
+
+    tmp_path = tmp_path_factory.mktemp("rulescluster")
+    ports = [free_port(), free_port()]
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+    data_dir = str(tmp_path / "shared")
+    procs = []
+    for i, port in enumerate(ports):
+        cfg = tmp_path / f"n{i}.toml"
+        cfg.write_text(
+            f"""
+[server]
+host = "127.0.0.1"
+http_port = {port}
+
+[engine]
+data_dir = "{data_dir}"
+
+[observability]
+self_scrape = false
+
+[rules]
+eval_interval = "500ms"
+grace = "0s"
+recording = ["clus_rate := avg_over_time(clus_src[5m])"]
+
+[cluster]
+self_endpoint = "{endpoints[i]}"
+endpoints = {json.dumps(endpoints)}
+
+[cluster.rules]
+clus_src = "{endpoints[1]}"
+"""
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "horaedb_tpu.server",
+                 "--config", str(cfg)],
+                env=CPU_ENV,
+                stdout=open(tmp_path / f"n{i}.log", "wb"),
+                stderr=subprocess.STDOUT,
+            )
+        )
+    deadline = time.monotonic() + 60
+    for port in ports:
+        while True:
+            try:
+                if http("GET", f"http://127.0.0.1:{port}/health?ready=1",
+                        timeout=2)[0] == 200:
+                    break
+            except Exception:
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"node {port} never became ready")
+            time.sleep(0.3)
+    yield ports, endpoints
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+class TestClusterEvalOnOwner:
+    def test_rule_evaluates_only_on_owner(self, rules_cluster):
+        ports, endpoints = rules_cluster
+        status, _ = sql(
+            ports[0],
+            "CREATE TABLE clus_src (host string TAG, value double, "
+            "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic",
+        )
+        assert status == 200
+        now = int(time.time() * 1000)
+        values = ", ".join(
+            f"('a', 3.0, {now - 60000 + i * 5000})" for i in range(12)
+        )
+        status, _ = sql(
+            ports[0],
+            f"INSERT INTO clus_src (host, value, ts) VALUES {values}",
+        )
+        assert status == 200
+        # the owner's engine picks the rule up on its next rounds
+        deadline = time.monotonic() + 45
+        rows = []
+        while time.monotonic() < deadline:
+            status, out = sql(
+                ports[0], "SELECT node, value FROM clus_rate"
+            )
+            if status == 200 and out.get("rows"):
+                rows = out["rows"]
+                break
+            time.sleep(0.5)
+        assert rows, "recording rule output never appeared"
+        # eval-on-owner: every row was evaluated by the pinned owner
+        assert {r["node"] for r in rows} == {endpoints[1]}
+        assert all(r["value"] == pytest.approx(3.0) for r in rows)
+        # both nodes agree (distributed read path), and both report the
+        # rule loaded while only the owner accumulates evaluations
+        status, out = sql(ports[1], "SELECT node FROM clus_rate")
+        assert status == 200 and out["rows"]
+        for port in ports:
+            status, doc = http(
+                "GET", f"http://127.0.0.1:{port}/debug/status"
+            )
+            assert status == 200
+            assert doc["rules"]["rules_loaded"] == 1
