@@ -1174,3 +1174,75 @@ class TestEventKindLint:
                 if f"`{knob}`" not in text:
                     missing.append(f"{knob}: undocumented in {name}")
         assert not missing, missing
+
+
+class TestRulesRegistryLint:
+    """PR-8 lint extension (same contract as the self-monitoring
+    registry): every family declared in rules/engine.RULES_METRIC_FAMILIES
+    must be (a) registered live, (b) convention-clean, (c) documented in
+    docs/OBSERVABILITY.md — with the per-kind eval labels eagerly
+    registered — and no stray horaedb_rules_* / horaedb_alerts_* family
+    may exist outside the declared registry. The [rules] knobs and the
+    HORAEDB_ROLLUP kill switch are operator surface: pinned to
+    docs/WORKLOAD.md; the `rollup` route is pinned to the ledger docs."""
+
+    def test_rules_families_declared_and_documented(self):
+        import os
+        import re
+
+        from horaedb_tpu.rules.engine import (
+            RULE_EVAL_KINDS,
+            RULES_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in RULES_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for kind in RULE_EVAL_KINDS:
+            if f'kind="{kind}"' not in exposed:
+                missing.append(f"label kind={kind}: not eagerly registered")
+        for fam in families:
+            if (
+                fam.startswith("horaedb_rules_")
+                or fam.startswith("horaedb_alerts_")
+            ) and fam not in RULES_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in (
+            "enabled", "eval_interval", "grace", "recording", "alerts",
+            "rollup_tables", "rollup_raw_ttl", "rollup_1m_ttl",
+            "rollup_1h_ttl", "recording_ttl",
+        ):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        if "`HORAEDB_ROLLUP" not in wdocs:
+            missing.append("HORAEDB_ROLLUP: undocumented in docs/WORKLOAD.md")
+        # the rewrite's route is part of the documented ledger surface
+        if "`rollup`" not in docs:
+            missing.append("route=rollup: undocumented in OBSERVABILITY.md")
+        assert not missing, missing
+
+    def test_alerts_table_registered_in_system_catalog(self):
+        from horaedb_tpu.table_engine.system import (
+            ALERTS_NAME,
+            AlertsTable,
+            open_system_table,
+        )
+
+        t = open_system_table(None, ALERTS_NAME)
+        assert isinstance(t, AlertsTable)
+        cols = {c.name for c in t.schema.columns}
+        assert {"rule", "labels", "state", "value", "active_since",
+                "fired_at", "resolved_at"} <= cols
