@@ -18,6 +18,13 @@ target list:
                         bounded selection over the HBM scan cache vs the
                         host-only path, selectivity 0.001 -> 1.0 x
                         LIMIT 10 -> 10k (ORDER BY ts DESC dashboards)
+    follower            replicated follower reads: 1 meta + 3 data nodes
+                        (real processes, shared store, --read-replicas 2),
+                        hot-table read storm round-robin across all nodes
+                        (followers serve route=follower locally) vs the
+                        same storm pinned to the shard leader; gates on
+                        result agreement + followers actually serving +
+                        never-worse on the leader-only open-tail shape
     rollup              continuous-query A/B: dashboard range aggregate
                         (time_bucket 5m x host x avg) served from the
                         maintained 1m rollup (route=rollup) vs the same
@@ -1478,12 +1485,343 @@ def run_all() -> None:
         sys.stdout.flush()
 
 
+def run_follower_config() -> dict:
+    """Replicated follower reads: 1 meta (--read-replicas 2) + 3 data
+    nodes over one shared store (real processes), a hot table flushed and
+    replicated to both followers, then an interleaved A/B read storm:
+
+    - LEADER-ONLY arm: every request hits the shard leader (the
+      pre-replica serving model — one node answers the hot table);
+    - FOLLOWER arm: requests round-robin across all three nodes; the
+      followers serve the watermark-covered dashboard query locally
+      (route=follower), only the leader's share runs on the leader.
+
+    Gates carried in the emitted record: result agreement between
+    leader-served and follower-served reps (`agreement`), an impl-aware
+    check that the follower arm really served route=follower on BOTH
+    followers (`follower_served`), and a never-worse latency check on a
+    leader-only shape — the fresh open-tail query, which both arms must
+    serve from the leader (`tail_never_worse`, ratio with 1.5x noise
+    headroom: subprocess HTTP on a loaded host jitters).
+
+    ``value`` is the follower arm's aggregate qps; ``vs_baseline`` the
+    qps ratio over the leader-only arm. NB on a single-core host the
+    three node processes share one CPU, so the ratio measures protocol/
+    queueing relief only — the `cores` field labels that honestly (the
+    >=2x scale-out claim needs >=3 cores to be physically possible)."""
+    import json as _json
+    import os
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    duration_s = float(os.environ.get("BENCH_FOLLOWER_SECS", "4"))
+    workers = int(os.environ.get("BENCH_FOLLOWER_WORKERS", "6"))
+    # large enough that the per-query serving WORK (scan+group-by over
+    # the hot table) dominates the HTTP round-trip — the quantity that
+    # actually scales out when followers serve; a tiny table would
+    # benchmark socket overhead instead
+    n_rows = int(os.environ.get("BENCH_FOLLOWER_ROWS", "120000"))
+    passes = int(os.environ.get("BENCH_FOLLOWER_PASSES", "2"))
+
+    def free_port() -> int:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def http(method, url, payload=None, timeout=15.0, headers=None):
+        data = _json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            url, data=data,
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method=method,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, _json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, _json.loads(e.read().decode() or "{}")
+            except Exception:
+                return e.code, {}
+
+    def sql(port, query, timeout=15.0):
+        return http(
+            "POST", f"http://127.0.0.1:{port}/sql", {"query": query},
+            timeout=timeout,
+        )
+
+    def wait_until(fn, timeout=90.0, interval=0.2, desc="condition"):
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                last = fn()
+                if last:
+                    return last
+            except Exception as e:
+                last = e
+            time.sleep(interval)
+        raise TimeoutError(f"timed out waiting for {desc}: last={last}")
+
+    tmp = tempfile.mkdtemp(prefix="bench_follower_")
+    env = {
+        **{k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"},
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.path.dirname(os.path.abspath(__file__)),
+    }
+    meta_port = free_port()
+    node_ports = [free_port() for _ in range(3)]
+    procs = []
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "horaedb_tpu.meta",
+             "--port", str(meta_port),
+             "--data-dir", f"{tmp}/meta",
+             "--num-shards", "3",
+             "--read-replicas", "2",
+             "--lease-ttl", "2.0",
+             "--heartbeat-timeout", "3.0",
+             "--tick-interval", "0.25"],
+            env=env,
+            stdout=open(f"{tmp}/meta.log", "wb"), stderr=subprocess.STDOUT,
+        ))
+        for i, port in enumerate(node_ports):
+            cfg = f"{tmp}/node{i}.toml"
+            with open(cfg, "w") as f:
+                f.write(
+                    f"[server]\nhost = \"127.0.0.1\"\nhttp_port = {port}\n\n"
+                    f"[engine]\ndata_dir = \"{tmp}/store\"\n\n"
+                    f"[cluster]\nself_endpoint = \"127.0.0.1:{port}\"\n"
+                    f"meta_endpoints = [\"127.0.0.1:{meta_port}\"]\n"
+                )
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "horaedb_tpu.server", "--config", cfg],
+                env=env,
+                stdout=open(f"{tmp}/node{i}.log", "wb"),
+                stderr=subprocess.STDOUT,
+            ))
+        for port in (meta_port, *node_ports):
+            wait_until(
+                lambda p=port: http(
+                    "GET", f"http://127.0.0.1:{p}/health", timeout=2
+                )[0] == 200,
+                desc=f"port {port} health",
+            )
+
+        def shards_assigned():
+            s, body = http(
+                "GET", f"http://127.0.0.1:{meta_port}/meta/v1/shards",
+                timeout=2,
+            )
+            if s == 200 and body.get("shards") and all(
+                sh["node"] for sh in body["shards"]
+            ):
+                return True
+            return None
+
+        wait_until(shards_assigned, desc="shards assigned")
+        ddl = ("CREATE TABLE hot (host string TAG, v double, ts timestamp "
+               "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+               "WITH (segment_duration='2h')")
+        status, out = sql(node_ports[0], ddl)
+        assert status == 200, out
+        _, route = http(
+            "GET", f"http://127.0.0.1:{meta_port}/meta/v1/route/hot"
+        )
+        leader_port = int(route["node"].rsplit(":", 1)[1])
+        follower_ports = [p for p in node_ports if p != leader_port]
+
+        now_ms = int(time.time() * 1000)
+        rng = np.random.default_rng(42)
+        hosts = rng.integers(0, 16, n_rows)
+        vals = rng.normal(10.0, 3.0, n_rows)
+        tss = now_ms - 3_600_000 + rng.permutation(n_rows)
+        for lo in range(0, n_rows, 2000):
+            batch = [
+                {"host": f"h{hosts[i]}", "v": float(vals[i]),
+                 "ts": int(tss[i])}
+                for i in range(lo, min(lo + 2000, n_rows))
+            ]
+            status, out = http(
+                "POST", f"http://127.0.0.1:{leader_port}/write",
+                {"table": "hot", "rows": batch}, timeout=60,
+            )
+            assert status == 200, out
+        status, out = http(
+            "POST", f"http://127.0.0.1:{leader_port}/admin/flush?table=hot",
+            timeout=60,
+        )
+        assert status == 200, out
+        wm = int(tss.max()) + 1
+
+        def both_followers_ready():
+            for p in follower_ports:
+                s, out = http(
+                    "GET", f"http://127.0.0.1:{p}/debug/shards", timeout=2
+                )
+                if s != 200:
+                    return None
+                reps = [
+                    sh for sh in out.get("shards", [])
+                    if sh.get("role") == "replica"
+                    and (sh.get("watermarks_ms") or {}).get("hot", 0) >= wm
+                ]
+                if not reps:
+                    return None
+            return True
+
+        wait_until(both_followers_ready, desc="followers replicated")
+
+        # VARIED dashboard queries (per-host panels over shifting
+        # windows): identical texts would coalesce in the single-flight
+        # dedup and benchmark the dedup instead of the serving path
+        variants = []
+        for h in range(16):
+            for k in range(4):
+                q = (f"SELECT count(v) AS c, sum(v) AS s FROM hot WHERE "
+                     f"ts <= {wm - 1 - k} AND host = 'h{h}'")
+                s, ref = sql(leader_port, q, timeout=60)
+                assert s == 200, ref
+                variants.append((q, ref["rows"]))
+        tail_q = "SELECT count(v) AS c FROM hot"
+
+        def storm(ports, secs) -> tuple[float, int, int, int]:
+            stop = time.monotonic() + secs
+            served = [0]
+            mismatches = [0]
+            errors = [0]
+            lock = threading.Lock()
+
+            def worker(wid):
+                i = wid
+                while time.monotonic() < stop:
+                    port = ports[i % len(ports)]
+                    q, ref_rows = variants[(i * 7 + wid) % len(variants)]
+                    i += 1
+                    try:
+                        s, out = sql(port, q, timeout=30)
+                    except Exception:
+                        with lock:
+                            errors[0] += 1
+                        continue
+                    with lock:
+                        if s != 200:
+                            errors[0] += 1
+                        elif not _rows_agree(out.get("rows", []), ref_rows):
+                            mismatches[0] += 1
+                        else:
+                            served[0] += 1
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker, args=(w,))
+                for w in range(workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            return served[0] / elapsed, mismatches[0], errors[0], served[0]
+
+        # warmup (compile + cache both paths everywhere)
+        storm(node_ports, 1.0)
+        storm([leader_port], 1.0)
+
+        leader_qps, follower_qps = [], []
+        mismatch_total = error_total = 0
+        for _ in range(passes):
+            q, m, e, _n = storm([leader_port], duration_s)
+            leader_qps.append(q)
+            mismatch_total += m
+            error_total += e
+            q, m, e, _n = storm(node_ports, duration_s)
+            follower_qps.append(q)
+            mismatch_total += m
+            error_total += e
+
+        # impl-aware: BOTH followers must have served route=follower
+        follower_served = True
+        for p in follower_ports:
+            s, qs = http(
+                "GET", f"http://127.0.0.1:{p}/debug/query_stats", timeout=5
+            )
+            if s != 200 or not any(
+                row.get("route") == "follower"
+                for row in qs.get("queries", [])
+            ):
+                follower_served = False
+
+        # leader-only shape (fresh open tail): both arms serve it from
+        # the leader — the follower arm must not make it worse
+        def min_latency(port, q, n=5):
+            best = float("inf")
+            for _ in range(n):
+                t0 = time.perf_counter()
+                s, _out = sql(port, q, timeout=30)
+                if s == 200:
+                    best = min(best, time.perf_counter() - t0)
+            return best
+
+        # the follower path costs the fresh shape exactly one local
+        # staleness refusal + the forward hop any non-owner pays; the
+        # gate bounds that overhead (1.5x + one 10ms hop allowance)
+        # rather than pretending the hop is free
+        tail_leader = min_latency(leader_port, tail_q)
+        tail_via_follower = min_latency(follower_ports[0], tail_q)
+        tail_never_worse = tail_via_follower <= tail_leader * 1.5 + 0.010
+
+        best_leader = max(leader_qps)
+        best_follower = max(follower_qps)
+        # Honesty label (same convention as _CPU-FALLBACK): three node
+        # processes on fewer than 3 cores CANNOT express aggregate
+        # scale-out — the arms are work-conserving and the ratio measures
+        # scheduling overhead, not the serving architecture. The >=2x
+        # scaling claim is only meaningful un-suffixed.
+        cores = os.cpu_count() or 1
+        suffix = "" if cores >= 3 else f"_{cores}CORE-HOST"
+        return {
+            "metric": f"follower_agg_qps{suffix}",
+            "value": round(best_follower, 1),
+            "unit": "queries/s (3-node round-robin, hot-table read storm)",
+            "vs_baseline": round(best_follower / best_leader, 3)
+            if best_leader else 0,
+            "leader_only_qps": round(best_leader, 1),
+            "agreement": mismatch_total == 0,
+            "errors": error_total,
+            "follower_served": follower_served,
+            "tail_never_worse": tail_never_worse,
+            "tail_leader_ms": round(tail_leader * 1e3, 2),
+            "tail_via_follower_ms": round(tail_via_follower * 1e3, 2),
+            "cores": cores,
+            "rows": n_rows,
+            "platform": "cpu-subprocess",
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_config(config: str) -> dict:
     """Build + run one config against the CURRENT jax backend; returns the
     result dict (never raises for result-shape problems — errors come back
     as labeled `_error` records so callers always have a line to emit)."""
     import jax
 
+    if config == "follower":
+        return run_follower_config()
     if config == "compaction-64":
         return run_compaction_config()
     if config == "ingest":

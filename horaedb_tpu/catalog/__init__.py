@@ -219,6 +219,56 @@ class Catalog:
             self._open_tables[name] = table
             return table
 
+    def open_follower(self, name: str) -> Optional[Table]:
+        """Open a PLAIN table as a read-only follower replica: manifest
+        state from the shared object store, no WAL replay, no orphan
+        sweep, every mutation fenced (engine/instance.open_table_follower).
+        The handle is cached like a normal open, so the whole query layer
+        serves from it transparently. Partitioned tables are not
+        replicated (their sub-tables route per-shard); returns None for
+        them and for names not in the registry."""
+        with self._lock:
+            cached = self._open_tables.get(name)
+            if cached is not None:
+                datas = cached.physical_datas()
+                # a cached LEADER handle is a role conflict, not a
+                # follower handle — the caller resolves (release/reopen)
+                if datas and not datas[0].read_only:
+                    return None
+                return cached
+            e = self._entries.get(name)
+            if e is None or e.partition_info is not None:
+                return None
+            data = self.instance.open_table_follower(
+                e.space_id, e.table_id, name
+            )
+            if data is None:
+                return None
+            table = AnalyticTable(self.instance, data)
+            self._open_tables[name] = table
+            return table
+
+    def open_handle(self, name: str) -> Optional[Table]:
+        """The ALREADY-OPEN handle for a name, or None — never opens
+        (cluster code peeks at follower handles without triggering a
+        manifest load)."""
+        with self._lock:
+            return self._open_tables.get(name)
+
+    def release(self, name: str) -> None:
+        """Drop the OPEN HANDLE for a table without touching its registry
+        entry or storage (follower handle teardown; promotion to leader
+        re-opens through the normal path with WAL replay)."""
+        with self._lock:
+            self.ddl_generation += 1  # cached plans bound the old handle
+            t = self._open_tables.pop(name, None)
+        if t is not None:
+            for data in t.physical_datas():
+                try:
+                    self.instance.close_table(data, flush=False)
+                except Exception:
+                    logger.exception("releasing handle for %s", name)
+
     def open_sub_table(self, sub_name: str) -> Optional[Table]:
         """Open ONE partition of a partitioned table by its storage name
         (``__<table>_<index>``) as a local AnalyticTable.

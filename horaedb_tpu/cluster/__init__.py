@@ -15,13 +15,22 @@
                      lease-fenced write barrier
                      (ref: cluster_impl.rs, shard_lock_manager.rs);
 - HTTP forwarding in the server: a request for a table owned by another
-  node proxies to the owner with loop protection (ref: forward.rs).
+  node proxies to the owner with loop protection (ref: forward.rs);
+- ``replica``      — replicated follower reads: the typed retryable
+                     fencing/staleness refusals, the horaedb_replica_*
+                     metric registry, and the serving ContextVars that
+                     stamp route=follower into the ledger.
 
 The coordinator itself lives in ``horaedb_tpu.meta``.
 """
 
 from .cluster_impl import ClusterImpl
 from .meta_client import MetaClient, MetaError
+from .replica import (
+    REPLICA_METRIC_FAMILIES,
+    ReplicaFencedError,
+    ReplicaStaleError,
+)
 from .router import ClusterBasedRouter, Route, Router, RuleBasedRouter
 from .shard import Shard, ShardError, ShardSet, ShardState
 
@@ -30,6 +39,9 @@ __all__ = [
     "ClusterImpl",
     "MetaClient",
     "MetaError",
+    "REPLICA_METRIC_FAMILIES",
+    "ReplicaFencedError",
+    "ReplicaStaleError",
     "Route",
     "Router",
     "RuleBasedRouter",

@@ -59,11 +59,24 @@ class ClusterImpl:
         self._lease_deadline: dict[int, float] = {}  # shard id -> monotonic
         self._last_lease_ttl: Optional[float] = None  # learned from heartbeats
         self._order_applied_at: dict[int, float] = {}  # shard id -> monotonic
+        # ---- follower (read-replica) state -------------------------------
+        # Shards this node serves READ-ONLY: epoch (shard version) fences
+        # replica reads the same way versions fence leader orders, and the
+        # replica lease deadline (renewed by OUR heartbeat) bounds how
+        # stale our view of the topology can be before reads refuse.
+        self._replica_shards: dict[int, int] = {}  # shard id -> version
+        self._replica_tables: dict[str, int] = {}  # table name -> shard id
+        self._replica_deadline: dict[int, float] = {}
+        self._replica_applied_at: dict[int, float] = {}
+        # Replicas of shards this node LEADS (from leader orders) — the
+        # proxy sheds eligible reads here when the leader is overloaded.
+        self._shard_replicas: dict[int, tuple[str, ...]] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._poke = threading.Event()  # kick_heartbeat() wakes the loop
         self._thread: Optional[threading.Thread] = None
         self._watch_thread: Optional[threading.Thread] = None
+        self._tail_thread: Optional[threading.Thread] = None
 
     # ---- lifecycle ------------------------------------------------------
     def start(self) -> None:
@@ -82,6 +95,10 @@ class ClusterImpl:
             target=self._lease_watch_loop, daemon=True, name="lease-watch"
         )
         self._watch_thread.start()
+        self._tail_thread = threading.Thread(
+            target=self._manifest_tail_loop, daemon=True, name="replica-tail"
+        )
+        self._tail_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -90,6 +107,8 @@ class ClusterImpl:
             self._thread.join(timeout=5)
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5)
+        if self._tail_thread is not None:
+            self._tail_thread.join(timeout=5)
 
     def kick_heartbeat(self) -> None:
         """Wake the heartbeat loop NOW — called after a /meta_event push
@@ -147,6 +166,22 @@ class ClusterImpl:
             if applied_at > sent_at:
                 continue
             self.close_shard(shard.shard_id, version=None)
+        # Follower (read-replica) reconcile: same discipline, read side.
+        desired_reps = resp.get("desired_replicas", [])
+        rep_ids = {o["shard_id"] for o in desired_reps}
+        for order in desired_reps:
+            try:
+                self.apply_replica_order(order, granted_at=sent_at)
+            except ShardError as e:
+                logger.warning("replica order rejected: %s", e)
+        with self._lock:
+            stale_reps = [
+                sid for sid in self._replica_shards
+                if sid not in rep_ids
+                and self._replica_applied_at.get(sid, 0.0) <= sent_at
+            ]
+        for sid in stale_reps:
+            self.close_replica_shard(sid)
 
     def _lease_watch_loop(self) -> None:
         """The lock-loss WATCH (ref: shard_lock_manager.rs:23-60 — etcd
@@ -224,6 +259,12 @@ class ClusterImpl:
         ttl = float(order.get("lease_ttl_s", 5.0))
         tables = order.get("tables", [])
         with self._lock:
+            if shard_id in self._replica_shards:
+                # Promotion (follower -> leader): release the read-only
+                # follower handles FIRST so the leader open below goes
+                # through the normal path (WAL replay picks up the old
+                # leader's unflushed rows; writes unfence).
+                self._drop_replica_state_locked(shard_id)
             shard = self.shard_set.get(shard_id)
             if shard is None:
                 shard = Shard(ShardInfo(shard_id, version=0))
@@ -275,6 +316,9 @@ class ClusterImpl:
             else:
                 self._lease_deadline.setdefault(shard_id, 0.0)
             self._order_applied_at[shard_id] = now
+            # replica endpoints ride the (version-fenced) leader order —
+            # the shed-to-follower path reads them for shards we lead
+            self._shard_replicas[shard_id] = tuple(order.get("replicas", ()))
             ordered = {t["name"] for t in tables}
             # PRUNE names this shard no longer carries (dropped tables /
             # moved partitions) — an add-only map would leave the write
@@ -340,7 +384,206 @@ class ClusterImpl:
                 self._release_table(name)
             self._lease_deadline.pop(shard_id, None)
             self._order_applied_at.pop(shard_id, None)
+            self._shard_replicas.pop(shard_id, None)
             self.shard_set.remove(shard_id)
+
+    # ---- follower (read-replica) orders ---------------------------------
+    def apply_replica_order(
+        self, order: dict, granted_at: Optional[float] = None
+    ) -> None:
+        """Reconcile one follower order: open the shard's plain tables
+        READ-ONLY over the shared object store (manifest state, no WAL
+        replay) and record the epoch + replica lease. Same delivery
+        contract as leader orders: heartbeat replies carry a lease
+        (measured from request-send time), /meta_event pushes carry
+        membership only (the kicked heartbeat fetches the lease)."""
+        shard_id = int(order["shard_id"])
+        version = int(order["version"])
+        ttl = float(order.get("lease_ttl_s", 5.0))
+        tables = [t for t in order.get("tables", []) if not t.get("sub_of")]
+        with self._lock:
+            if self.shard_set.get(shard_id) is not None:
+                # We LEAD this shard; a replica order for it is stale
+                # (raced a promotion) — leadership wins.
+                return
+            cur = self._replica_shards.get(shard_id)
+            if cur is not None and version < cur:
+                raise ShardError(
+                    f"stale replica order for shard {shard_id}: "
+                    f"v{version} < v{cur}"
+                )
+            opened = self._open_follower_tables(tables)
+            ordered = {t["name"] for t in tables}
+            for name in [
+                n for n, sid in self._replica_tables.items()
+                if sid == shard_id and n not in ordered
+            ]:
+                self._replica_tables.pop(name, None)
+                self.conn.catalog.release(name)
+            # Only tables that actually OPENED read-only serve here: a
+            # name registered without a handle would take a doomed
+            # follower hop (fenced refusal) on every routed read. The
+            # not-yet-openable ones retry on the next heartbeat order.
+            for t in tables:
+                if t["name"] in opened:
+                    self._replica_tables[t["name"]] = shard_id
+            self._replica_shards[shard_id] = version
+            now = time.monotonic()
+            if granted_at is not None:
+                self._replica_deadline[shard_id] = max(
+                    self._replica_deadline.get(shard_id, 0.0), granted_at + ttl
+                )
+            else:
+                self._replica_deadline.setdefault(shard_id, 0.0)
+            self._replica_applied_at[shard_id] = now
+
+    def _open_follower_tables(self, tables: list[dict]) -> set[str]:
+        """Open each plain table read-only; returns the names that are
+        actually serving. Partitioned PARENTS are skipped silently (their
+        sub-tables route per-shard; replication doesn't cover them yet)."""
+        missing = [
+            t["name"] for t in tables
+            if not self.conn.catalog.exists(t["name"])
+        ]
+        if missing:
+            reload_fn = getattr(self.conn.catalog, "reload", None)
+            if reload_fn is not None:
+                reload_fn()
+        opened: set[str] = set()
+        for t in tables:
+            name = t["name"]
+            entry = self.conn.catalog.entry(name)
+            if entry is not None and entry.partition_info is not None:
+                continue  # parent of a partitioned table: not replicable
+            try:
+                if self.conn.catalog.open_follower(name) is not None:
+                    opened.add(name)
+                else:
+                    # registry entry or manifest not visible yet (create
+                    # in flight on the leader): next heartbeat retries
+                    logger.info("replica table %s not openable yet", name)
+            except Exception:
+                logger.exception("opening follower table %s", name)
+        return opened
+
+    def close_replica_shard(self, shard_id: int) -> None:
+        with self._lock:
+            self._drop_replica_state_locked(shard_id)
+
+    def _drop_replica_state_locked(self, shard_id: int) -> None:
+        for name in [
+            n for n, sid in self._replica_tables.items() if sid == shard_id
+        ]:
+            self._replica_tables.pop(name, None)
+            self.conn.catalog.release(name)
+        self._replica_shards.pop(shard_id, None)
+        self._replica_deadline.pop(shard_id, None)
+        self._replica_applied_at.pop(shard_id, None)
+
+    def _manifest_tail_loop(self) -> None:
+        """Follower freshness: periodically re-load each replica table's
+        manifest from the shared object store and install the delta
+        (files/schema/flushed-seq) into the read-only handle. Cadence
+        rides the lease TTL (~TTL/2, floor 0.25s) — freshness tighter
+        than the fencing bound buys nothing. Also publishes the worst
+        watermark lag to the horaedb_replica_watermark_lag_seconds
+        gauge."""
+        from .replica import set_watermark_lag
+
+        while not self._stop.wait(self._tail_interval()):
+            with self._lock:
+                names = list(self._replica_tables)
+            if not names:
+                # no replicas served: the gauge must read 0, not freeze
+                # at the last value from a role this node no longer has
+                set_watermark_lag(0.0)
+                continue
+            worst_lag = 0.0
+            refreshed = 0
+            now_ms = time.time() * 1000
+            for name in names:
+                data = self._follower_data(name)
+                if data is None:
+                    continue
+                try:
+                    data.refresh_from_manifest()
+                except Exception:
+                    logger.exception("manifest tail for %s", name)
+                    continue
+                refreshed += 1
+                wm = data.follower_watermark_ms()
+                if wm > 0:
+                    worst_lag = max(worst_lag, (now_ms - wm) / 1000.0)
+            if refreshed:
+                # all-failed rounds keep the last honest value instead of
+                # publishing a misleading 0
+                set_watermark_lag(worst_lag)
+
+    def _tail_interval(self) -> float:
+        ttl = self._last_lease_ttl
+        return max(0.25, (ttl / 2.0) if ttl else 1.0)
+
+    def _follower_data(self, table: str):
+        """The read-only TableData behind a replica-served table name
+        (None when the handle isn't open)."""
+        t = self.conn.catalog.open_handle(table)
+        if t is None:
+            return None
+        datas = t.physical_datas()
+        if not datas or not datas[0].read_only:
+            return None
+        return datas[0]
+
+    # ---- replica serving checks -----------------------------------------
+    def serves_replica(self, table: str) -> bool:
+        with self._lock:
+            return table in self._replica_tables
+
+    def replicas_of_table(self, table: str) -> tuple[str, ...]:
+        """Follower endpoints for a table this node LEADS (for
+        shed-to-follower on leader overload)."""
+        with self._lock:
+            sid = self._table_shard.get(table)
+            if sid is None:
+                return ()
+            return self._shard_replicas.get(sid, ())
+
+    def replica_read_state(self, table: str, expected_epoch: Optional[int] = None):
+        """Fencing gate for one follower read. Returns (epoch, TableData)
+        when this node may serve; raises the typed retryable
+        ``ReplicaFencedError`` when it may not: replica lease lapsed (we
+        are cut off from the coordinator — our topology view is
+        unbounded-stale) or our epoch trails a transfer the caller has
+        already observed."""
+        from .replica import ReplicaFencedError
+
+        with self._lock:
+            sid = self._replica_tables.get(table)
+            if sid is None:
+                raise ReplicaFencedError(
+                    f"table {table!r} not replicated on this node"
+                )
+            epoch = self._replica_shards.get(sid, 0)
+            deadline = self._replica_deadline.get(sid, 0.0)
+        if time.monotonic() > deadline:
+            raise ReplicaFencedError(
+                f"replica lease for shard {sid} lapsed — follower read "
+                "fenced (node cut off from coordinator)",
+                epoch=epoch,
+            )
+        if expected_epoch is not None and epoch < int(expected_epoch):
+            raise ReplicaFencedError(
+                f"replica epoch v{epoch} trails the observed transfer "
+                f"v{int(expected_epoch)} for shard {sid} — refusing to "
+                "serve a pre-fence view",
+                epoch=epoch,
+            )
+        data = self._follower_data(table)
+        if data is None:
+            raise ReplicaFencedError(
+                f"replica handle for {table!r} not open yet", epoch=epoch
+            )
+        return epoch, data
 
     def create_table_on_shard(self, shard_id: int, name: str, create_sql: str) -> dict:
         """Meta-dispatched DDL; returns catalog ids (idempotent)."""
@@ -407,11 +650,36 @@ class ClusterImpl:
                         "shard_id": shard.shard_id,
                         "state": shard.state.value,
                         "version": shard.version,
+                        "role": "leader",
+                        "replicas": list(
+                            self._shard_replicas.get(shard.shard_id, ())
+                        ),
                         "lease_remaining_s": round(max(0.0, deadline - now), 2),
                         "tables": sorted(
                             t for t, sid in self._table_shard.items()
                             if sid == shard.shard_id
                         ),
+                    }
+                )
+            for sid, version in sorted(self._replica_shards.items()):
+                deadline = self._replica_deadline.get(sid, 0.0)
+                names = sorted(
+                    t for t, s in self._replica_tables.items() if s == sid
+                )
+                watermarks = {}
+                for t in names:
+                    data = self._follower_data(t)
+                    if data is not None:
+                        watermarks[t] = data.follower_watermark_ms()
+                out.append(
+                    {
+                        "shard_id": sid,
+                        "state": "ready",
+                        "version": version,
+                        "role": "replica",
+                        "lease_remaining_s": round(max(0.0, deadline - now), 2),
+                        "tables": names,
+                        "watermarks_ms": watermarks,
                     }
                 )
         return out

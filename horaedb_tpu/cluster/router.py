@@ -20,7 +20,7 @@ import xxhash
 @dataclass(frozen=True)
 class Route:
     table: str
-    endpoint: str  # "host:port"
+    endpoint: str  # "host:port" — the shard LEADER (write target)
     is_local: bool
     # Where the answer came from — the write path treats these
     # differently (see server/http.py write fencing):
@@ -30,6 +30,12 @@ class Route:
     #   "static"       rule/hash config (static clustering, standalone)
     #   "fallback"     coordinator UNREACHABLE — not authoritative
     source: str = "static"
+    # Follower (read-replica) endpoints serving bounded-staleness reads,
+    # and the shard epoch (version) the route was learned at — forwarded
+    # replica reads carry the epoch so a follower trailing a transfer
+    # refuses instead of serving a pre-fence view.
+    replicas: tuple[str, ...] = ()
+    epoch: int = 0
 
 
 class Router(ABC):
@@ -86,9 +92,25 @@ class ClusterBasedRouter(Router):
     def self_endpoint(self) -> str:
         return self.cluster.self_endpoint
 
+    def pick_replica(self, route: Route, exclude: str = "") -> Optional[str]:
+        """Least-loaded follower for a replica-served read: a per-router
+        round-robin over the route's replica set (uniform spread is the
+        least-loaded policy available without follower load feedback),
+        skipping ``exclude`` (usually self)."""
+        candidates = [r for r in route.replicas if r and r != exclude]
+        if not candidates:
+            return None
+        import itertools
+
+        rr = self.__dict__.setdefault("_replica_rr", itertools.count())
+        return candidates[next(rr) % len(candidates)]
+
     def route(self, table: str) -> Route:
         if self.cluster.owns_table(table):
-            return Route(table, self.self_endpoint, True, source="owned")
+            return Route(
+                table, self.self_endpoint, True, source="owned",
+                replicas=self.cluster.replicas_of_table(table),
+            )
         now = self._time()
         hit = self._cache.get(table)
         if hit is not None:
@@ -112,7 +134,11 @@ class ClusterBasedRouter(Router):
             self._cache[table] = (now, r)
             return r
         ep = info["node"]
-        r = Route(table, ep, ep == self.self_endpoint, source="meta")
+        r = Route(
+            table, ep, ep == self.self_endpoint, source="meta",
+            replicas=tuple(info.get("replicas") or ()),
+            epoch=int(info.get("version") or 0),
+        )
         self._cache[table] = (now, r)
         return r
 

@@ -19,6 +19,7 @@ are dropped without rewriting (ref: sst/manager.rs:100-118).
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -84,6 +85,16 @@ class CompactionTask:
     @property
     def total_bytes(self) -> int:
         return sum(h.meta.size_bytes for h in self.inputs)
+
+
+@dataclass
+class _StagedTask:
+    """A merged-but-not-installed task: outputs finalized, uploads in
+    flight on the io pool, metadata untouched."""
+
+    task: CompactionTask
+    outputs: list  # [(SstMeta, path)] in window order
+    upload_futs: list  # concurrent.futures for the in-flight puts
 
 
 @dataclass
@@ -225,15 +236,38 @@ class Compactor:
                     while True:
                         consumed: set[tuple[int, int]] = set()
                         skipped = False
-                        for task in picker.pick(table):
-                            keys = {(h.level, h.file_id) for h in task.inputs}
-                            if keys & consumed:
-                                skipped = True
-                                continue
-                            _M_COMPACT_IN_BYTES.inc(task.total_bytes)
-                            self._run_task(task, result)
-                            consumed |= keys
-                            result.tasks_run += 1
+                        # One-deep task pipeline: task i's output-SST
+                        # uploads run on the io pool while task i+1's
+                        # device merge dispatches — the same dump/install
+                        # overlap the flush path already has. Install
+                        # (manifest append + version swap) stays on THIS
+                        # thread, in task order, after uploads complete
+                        # (data before metadata, as ever).
+                        pending = None
+                        try:
+                            for task in picker.pick(table):
+                                keys = {
+                                    (h.level, h.file_id) for h in task.inputs
+                                }
+                                if keys & consumed:
+                                    skipped = True
+                                    continue
+                                _M_COMPACT_IN_BYTES.inc(task.total_bytes)
+                                staged = self._stage_task(task)
+                                prev, pending = pending, None
+                                if prev is not None:
+                                    # if THIS install fails, `staged`'s
+                                    # uploaded outputs become orphans the
+                                    # open-time sweep collects — never a
+                                    # double install (pending is cleared
+                                    # before the attempt)
+                                    self._install_task(prev, result)
+                                pending = staged
+                                consumed |= keys
+                                result.tasks_run += 1
+                        finally:
+                            if pending is not None:
+                                self._install_task(pending, result)
                         if not (skipped and consumed):
                             break
                     sp.set(tasks=result.tasks_run, rows=result.rows_written)
@@ -324,7 +358,12 @@ class Compactor:
                 unique=ranked,
             )
 
-    def _run_task(self, task: CompactionTask, result: CompactionResult) -> None:
+    def _stage_task(self, task: CompactionTask) -> "_StagedTask":
+        """Read + merge one task's inputs into finalized per-window SSTs
+        and LAUNCH their uploads on the io pool. No metadata changes —
+        the caller installs later (``_install_task``), typically after
+        the NEXT task's device merge has been dispatched, so uploads
+        overlap merge compute the way flush's dump/install already do."""
         table = self.table
         schema = table.schema
 
@@ -339,8 +378,7 @@ class Compactor:
                     np.full(len(rows), h.meta.max_sequence, dtype=np.uint64)
                 )
             max_seq = max(max_seq, h.meta.max_sequence)
-        edits: list[MetaEdit] = []
-        new_handles: list[FileHandle] = []
+        finalized: list[tuple] = []  # (writer, meta, raw)
         if parts:
             from .sst.writer import SstStreamWriter
 
@@ -376,14 +414,52 @@ class Compactor:
                         writers[w_start] = w
                     w.append(w_rows, max_sequence=int(w_seq.max()))
             for _, w in sorted(writers.items()):
-                meta = w.close()
-                if meta is None:
-                    continue
-                edits.append(AddFile(1, meta, w.path))
-                new_handles.append(FileHandle(meta, w.path, 1))
-                result.rows_written += meta.num_rows
-                _M_COMPACT_OUT_BYTES.inc(meta.size_bytes)
-        for h in task.inputs:
+                out = w.finalize()
+                if out is not None:
+                    finalized.append((w, *out))
+        futs: list = []
+        if finalized and not threading.current_thread().name.startswith(
+            "sst-io"
+        ):
+            # io pool (shared with SST fetches and flush bucket writes):
+            # every window output uploads concurrently, and the whole
+            # batch overlaps the NEXT task's merge. Contexts copied so
+            # span/ledger records survive the hop; the thread-name guard
+            # keeps a compaction somehow running ON the pool from
+            # deadlocking against its own slots.
+            import contextvars
+
+            from ..utils.runtime import io_pool
+
+            for w, _meta, raw in finalized:
+                ctx = contextvars.copy_context()
+                futs.append(io_pool().submit(ctx.run, w.upload, raw))
+        else:
+            for w, _meta, raw in finalized:
+                w.upload(raw)
+        return _StagedTask(
+            task=task,
+            outputs=[(meta, w.path) for w, meta, _raw in finalized],
+            upload_futs=futs,
+        )
+
+    def _install_task(
+        self, staged: "_StagedTask", result: CompactionResult
+    ) -> None:
+        """Complete one staged task: wait out its uploads (data before
+        metadata — an upload failure aborts BEFORE any manifest edit),
+        append the manifest edits, and swap the file sets atomically."""
+        table = self.table
+        for f in staged.upload_futs:
+            f.result()
+        edits: list[MetaEdit] = []
+        new_handles: list[FileHandle] = []
+        for meta, path in staged.outputs:
+            edits.append(AddFile(1, meta, path))
+            new_handles.append(FileHandle(meta, path, 1))
+            result.rows_written += meta.num_rows
+            _M_COMPACT_OUT_BYTES.inc(meta.size_bytes)
+        for h in staged.task.inputs:
             edits.append(RemoveFile(h.level, h.file_id))
         table.manifest.append_edits(edits)
 
@@ -391,10 +467,10 @@ class Compactor:
         # must never see the L1 output AND the L0 inputs in one view.
         table.version.levels.swap_files(
             [(1, nh) for nh in new_handles],
-            [(h.level, h.file_id) for h in task.inputs],
+            [(h.level, h.file_id) for h in staged.task.inputs],
         )
         result.files_added += len(new_handles)
-        result.files_removed += len(task.inputs)
+        result.files_removed += len(staged.task.inputs)
         # Purge replaced objects.
         for h in table.version.levels.drain_purge_queue():
             table.store.delete(h.path)

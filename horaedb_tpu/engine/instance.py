@@ -200,6 +200,47 @@ class Instance:
         self._ensure_background()
         return table
 
+    def open_table_follower(
+        self, space_id: int, table_id: int, name: str
+    ) -> Optional[TableData]:
+        """Open a table READ-ONLY from its manifest in the shared object
+        store — the follower (read-replica) serving handle.
+
+        Differences from ``open_table``, all deliberate:
+        - no WAL replay (the leader owns the WAL; replaying it here
+          would double rows once the leader's flush installs them);
+        - no orphan sweep (an SST the LEADER is mid-flushing looks like
+          an orphan from here — sweeping would delete live data);
+        - no background flush/compaction (nothing to maintain; the
+          leader mutates storage, we tail its manifest);
+        - the handle is fenced: writes/flushes raise, refreshes come
+          from ``TableData.refresh_from_manifest``."""
+        with self._lock:
+            key = (space_id, table_id)
+            existing = self._tables.get(key)
+            if existing is not None:
+                if not existing.read_only:
+                    # already open as the LEADER handle: a role conflict
+                    # the caller must resolve (release then reopen) — a
+                    # writable handle must never be served as a follower
+                    return None
+                return existing
+            manifest = Manifest(self.store, space_id, table_id)
+            if not manifest.exists():
+                return None
+            state = manifest.load()
+            if state.schema is None:
+                return None
+            options = TableOptions.from_dict(state.options)
+            table = TableData(
+                space_id, table_id, name, state.schema, options, manifest,
+                self.store, recovered_state=state,
+            )
+            table.read_only = True
+            table._recompute_watermark_locked()
+            self._tables[key] = table
+            return table
+
     def _ensure_background(self) -> None:
         if self.config.background_compaction and self.config.compaction_interval_s > 0:
             self._compaction_scheduler()
@@ -275,6 +316,15 @@ class Instance:
                 self._flushes.forget((table.space_id, table.table_id))
 
     def drop_table(self, table: TableData) -> None:
+        if table.read_only:
+            # Follower handle: detach WITHOUT touching storage — the
+            # LEADER owns the objects (a follower deleting SSTs/manifest
+            # would destroy the table under the real owner).
+            with table.serial_lock:
+                table.dropped = True
+            with self._lock:
+                self._tables.pop((table.space_id, table.table_id), None)
+            return
         # flush_lock first: a dump mid-flight would otherwise write SSTs
         # AFTER the store prefix is cleared — its install re-check would
         # abandon them, but a dropped table never reopens, so nothing
@@ -314,6 +364,11 @@ class Instance:
         """
         if table.dropped:
             raise ValueError(f"table dropped: {table.name}")
+        if table.read_only:
+            raise ValueError(
+                f"table {table.name} is a read-only follower replica "
+                "(writes go to the shard leader)"
+            )
         if rows.schema.version != table.schema.version:
             if table.schema.same_columns(rows.schema):
                 # Metadata-only difference (the sampler's first-flush PK
@@ -473,6 +528,10 @@ class Instance:
         waiter attaches to an already-queued request when one exists (its
         freeze happens at run time, so it covers everything present now).
         """
+        if table.read_only:
+            # Follower handle: nothing to flush (no memtable mutations);
+            # a no-op result keeps close_table's drain path uniform.
+            return FlushResult(0, 0, table.version.flushed_sequence)
         if self.config.background_flush:
             scheduler = self._flush_scheduler()
             if scheduler is not None:
@@ -495,6 +554,8 @@ class Instance:
         """Fire-and-forget flush request (the write path's trigger).
         ``urgent`` (the stall loop) bypasses failure backoff — a stalled
         writer's re-request is the only path out of the stall."""
+        if table.read_only:
+            return
         if self.config.background_flush:
             scheduler = self._flush_scheduler()
             if scheduler is not None:
@@ -627,6 +688,8 @@ class Instance:
         — flush requests, the scheduler's worker runs)."""
         from .compaction import Compactor
 
+        if table.read_only:
+            return  # the leader owns compaction of this table's storage
         if Compactor.needs_work(table, self.config.compaction_l0_trigger):
             if self.config.background_compaction:
                 scheduler = self._compaction_scheduler()
@@ -667,7 +730,7 @@ class Instance:
         if scheduler is None:
             return
         for table in self.open_tables():
-            if table.dropped or table.retired:
+            if table.dropped or table.retired or table.read_only:
                 continue
             if Compactor.needs_work(table, self.config.compaction_l0_trigger):
                 scheduler.request(table)

@@ -180,8 +180,12 @@ class SstStreamWriter:
     def max_sequence(self) -> int:
         return self._max_seq
 
-    def close(self) -> SstMeta | None:
-        """Finalize + store; None when nothing was appended."""
+    def finalize(self) -> tuple[SstMeta, bytes] | None:
+        """Finish the parquet encode WITHOUT storing: returns the final
+        meta plus the serialized bytes, or None when nothing was
+        appended. ``upload`` (or ``close``) performs the store put —
+        split so the compaction pipeline can overlap uploads of task i's
+        outputs with task i+1's device merge on the io pool."""
         if self._writer is None:
             return None
         from ...common_types.time_range import TimeRange
@@ -204,18 +208,33 @@ class SstStreamWriter:
             }
         )
         self._writer.close()
+        self._writer = None
         raw = self._buf.getvalue()
-        self.store.put(self.path, raw)
-        return SstMeta(
-            file_id=meta.file_id,
-            time_range=meta.time_range,
-            max_sequence=meta.max_sequence,
-            num_rows=meta.num_rows,
-            size_bytes=len(raw),
-            schema_version=meta.schema_version,
-            column_ranges=meta.column_ranges,
-            row_group_filters=meta.row_group_filters,
+        return (
+            SstMeta(
+                file_id=meta.file_id,
+                time_range=meta.time_range,
+                max_sequence=meta.max_sequence,
+                num_rows=meta.num_rows,
+                size_bytes=len(raw),
+                schema_version=meta.schema_version,
+                column_ranges=meta.column_ranges,
+                row_group_filters=meta.row_group_filters,
+            ),
+            raw,
         )
+
+    def upload(self, raw: bytes) -> None:
+        self.store.put(self.path, raw)
+
+    def close(self) -> SstMeta | None:
+        """Finalize + store; None when nothing was appended."""
+        out = self.finalize()
+        if out is None:
+            return None
+        meta, raw = out
+        self.upload(raw)
+        return meta
 
 
 def _column_ranges(data: RowGroup) -> dict:

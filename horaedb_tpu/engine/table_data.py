@@ -86,6 +86,73 @@ class TableData:
         # writer's edits would be skipped on load while its purges
         # survive (referenced-SST loss).
         self.retired = False
+        # Follower (read-replica) handle: serves reads from the LEADER's
+        # manifest state, refreshed by refresh_from_manifest(). Writes,
+        # flushes, compactions, orphan sweeps and object deletions are
+        # all fenced off — the leader owns every mutation of this
+        # table's storage, including purges.
+        self.read_only = False
+        self._watermark_ms = 0
+
+    # ---- follower (read-replica) support --------------------------------
+    def follower_watermark_ms(self) -> int:
+        """Freshness watermark of a follower handle: the newest data
+        timestamp covered by INSTALLED (manifest-durable) SSTs — "last
+        installed flush". Rows newer than this live only in the leader's
+        memtable and must be served by the leader."""
+        return self._watermark_ms
+
+    def _recompute_watermark_locked(self) -> None:
+        files = self.version.levels.all_files()
+        self._watermark_ms = max(
+            (h.time_range.exclusive_end for h in files), default=0
+        )
+
+    def refresh_from_manifest(self) -> bool:
+        """Tail the leader's manifest: load the current state from the
+        shared object store and install any file/schema/options delta
+        into this read-only handle's version. Returns True when anything
+        changed.
+
+        Replaced files are NOT deleted here — the purge queue is drained
+        and DISCARDED: the leader owns object deletion (its compaction
+        already deletes swapped-out SSTs from the shared store; a
+        follower deleting them too would race the leader's deferred
+        purge discipline)."""
+        if not self.read_only:
+            raise RuntimeError(
+                f"refresh_from_manifest on a non-follower handle: {self.name}"
+            )
+        state = self.manifest.load()
+        changed = False
+        with self.serial_lock:
+            levels = self.version.levels
+            current = {(h.level, h.file_id): h for h in levels.all_files()}
+            fresh = {(h.level, h.file_id): h for h in state.levels.all_files()}
+            adds = [
+                (lvl, h)
+                for (lvl, _fid), h in fresh.items()
+                if (lvl, _fid) not in current
+            ]
+            removes = [k for k in current if k not in fresh]
+            if adds or removes:
+                levels.swap_files(adds, removes)
+                # Discard — never delete — objects the leader swapped out.
+                levels.drain_purge_queue()
+                changed = True
+            if state.flushed_sequence > self.version.flushed_sequence:
+                self.version.flushed_sequence = state.flushed_sequence
+                changed = True
+            if (state.schema is not None
+                    and state.schema.version > self.schema.version):
+                self.version.alter_schema(state.schema)
+                changed = True
+            new_opts = TableOptions.from_dict(state.options)
+            if new_opts.to_dict() != self.options.to_dict():
+                self.options = new_opts
+                self.version.set_options(new_opts)
+            self._recompute_watermark_locked()
+        return changed
 
     # ---- id / sequence allocation -------------------------------------
     def alloc_file_id(self) -> int:

@@ -168,6 +168,76 @@ class ReopenScheduler:
         return out
 
 
+@dataclass(frozen=True)
+class ReplicaChange:
+    shard_id: int
+    replicas: tuple[str, ...]
+    reason: str
+
+
+class ReplicaScheduler:
+    """Keep every ASSIGNED shard at ``read_replicas`` follower nodes
+    (scale-out serving for hot shards: followers open the shard
+    read-only over the shared object store and serve bounded-staleness
+    reads; writes stay single-leader).
+
+    Placement: existing healthy replicas are kept (placement stability —
+    a follower's tailed manifest state and warmed scan cache are worth
+    keeping); offline nodes and the current leader are dropped; gaps
+    fill least-loaded-first (replica-slots held across all shards) with
+    a deterministic per-(shard, node) hash tiebreak, so followers spread
+    instead of piling onto one node and placement is stable across meta
+    restarts."""
+
+    def __init__(self, topology: TopologyManager, read_replicas: int) -> None:
+        self.topology = topology
+        self.read_replicas = read_replicas
+
+    def schedule(self) -> list[ReplicaChange]:
+        if self.read_replicas <= 0:
+            return []
+        online = {n.endpoint for n in self.topology.online_nodes()}
+        if not online:
+            return []
+        # replica-slot load per node, across ALL shards (kept + planned)
+        load: dict[str, int] = {e: 0 for e in online}
+        shards = sorted(self.topology.shards(), key=lambda s: s.shard_id)
+        for s in shards:
+            for r in s.replicas:
+                if r in load:
+                    load[r] += 1
+        out: list[ReplicaChange] = []
+        for s in shards:
+            if s.node is None:
+                if s.replicas:
+                    out.append(ReplicaChange(s.shard_id, (), "leaderless"))
+                continue
+            keep = [r for r in s.replicas if r in online and r != s.node]
+            want = min(self.read_replicas, max(0, len(online - {s.node})))
+            if len(keep) < want:
+                candidates = sorted(online - {s.node} - set(keep))
+                while len(keep) < want and candidates:
+                    pick = min(
+                        candidates,
+                        key=lambda e: (
+                            load.get(e, 0),
+                            _hash64(f"replica/{s.shard_id}/{e}"),
+                        ),
+                    )
+                    keep.append(pick)
+                    load[pick] = load.get(pick, 0) + 1
+                    candidates.remove(pick)
+            elif len(keep) > want:
+                for r in keep[want:]:
+                    load[r] = max(0, load.get(r, 0) - 1)
+                keep = keep[:want]
+            if tuple(keep) != s.replicas:
+                out.append(
+                    ReplicaChange(s.shard_id, tuple(keep), "replica-maintain")
+                )
+        return out
+
+
 class RebalancedScheduler:
     """One move per tick from the most- to the least-loaded node when the
     skew exceeds one shard — with HYSTERESIS so churn can't oscillate

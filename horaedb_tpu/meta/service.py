@@ -41,6 +41,7 @@ from .scheduler import (
     NodeInspector,
     RebalancedScheduler,
     ReopenScheduler,
+    ReplicaScheduler,
     StaticScheduler,
     Transfer,
 )
@@ -78,11 +79,13 @@ class MetaServer:
         rebalance: bool = True,
         election=None,  # meta.election.FileLease — HA mode
         kv_factory=None,  # () -> LeaseKV over SHARED storage (HA mode)
+        read_replicas: int = 0,  # follower read-replicas per shard
     ) -> None:
         self.num_shards = num_shards
         self.lease_ttl_s = lease_ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.rebalance = rebalance
+        self.read_replicas = read_replicas
         self.election = election
         self.kv_factory = kv_factory
         # One mutation at a time: the reference gets global DDL ordering
@@ -115,6 +118,11 @@ class MetaServer:
         ]
         if self.rebalance:
             self.schedulers.append(RebalancedScheduler(self.topology))
+        self.replica_scheduler = (
+            ReplicaScheduler(self.topology, self.read_replicas)
+            if self.read_replicas > 0
+            else None
+        )
         self.procedures = ProcedureManager(
             kv,
             handlers={
@@ -198,7 +206,31 @@ class MetaServer:
                 "transfer_shard",
                 {"shard_id": tr.shard_id, "to_node": tr.to_node, "reason": tr.reason},
             )
+        if self.replica_scheduler is not None:
+            self._apply_replica_changes(self.replica_scheduler.schedule())
         self.procedures.tick()
+
+    def _apply_replica_changes(self, changes) -> None:
+        """Install follower sets decided by the ReplicaScheduler and push
+        replica orders to the new followers (best-effort: a missed push
+        heals on the follower's next heartbeat reconcile). Under the DDL
+        lock — a replica change racing a split/merge/transfer that
+        already snapshotted shard state would dispatch stale orders."""
+        for ch in changes:
+            with self._ddl_lock:
+                before = self.topology.shard(ch.shard_id)
+                if before is None:
+                    continue
+                view = self.topology.set_replicas(ch.shard_id, ch.replicas)
+                if view is None:
+                    continue
+                added = set(view.replicas) - set(before.replicas)
+            for ep in added:
+                try:
+                    _post(ep, "/meta_event/open_replica",
+                          self._shard_order(view, role="replica"))
+                except Exception:
+                    pass  # heartbeat reconcile delivers it
 
     # ---- procedure bodies ----------------------------------------------
     # The three shard-mutating procedure bodies take _ddl_lock THEMSELVES
@@ -436,13 +468,17 @@ class MetaServer:
         self.topology.drop_table(name)
 
     # ---- RPC bodies ------------------------------------------------------
-    def _shard_order(self, view) -> dict:
-        """The declarative per-shard order sent to a data node."""
+    def _shard_order(self, view, role: str = "leader") -> dict:
+        """The declarative per-shard order sent to a data node.
+        ``role="replica"`` marks a follower order: open the shard's
+        tables READ-ONLY and tail the leader's manifest."""
         return {
             "shard_id": view.shard_id,
             "version": view.version,
             "lease_id": view.lease_id,
             "lease_ttl_s": self.lease_ttl_s,
+            "role": role,
+            "replicas": list(view.replicas),
             "tables": [
                 {
                     "name": t.name,
@@ -474,7 +510,15 @@ class MetaServer:
                     continue  # moved elsewhere: not in this node's desired set
                 view = refreshed
             desired.append(self._shard_order(view))
-        return {"desired": desired, "lease_ttl_s": self.lease_ttl_s}
+        desired_replicas = [
+            self._shard_order(view, role="replica")
+            for view in self.topology.replica_shards_of_node(endpoint)
+        ]
+        return {
+            "desired": desired,
+            "desired_replicas": desired_replicas,
+            "lease_ttl_s": self.lease_ttl_s,
+        }
 
     def handle_create_table(self, name: str, create_sql: str) -> dict:
         self._ensure_leader()
@@ -653,6 +697,7 @@ class MetaServer:
             "node": shard.node,
             "shard_id": shard.shard_id,
             "version": shard.version,
+            "replicas": list(shard.replicas),
         }
 
 
@@ -844,6 +889,10 @@ def main() -> None:
         help="HA leader lease TTL seconds (failover latency bound)",
     )
     p.add_argument("--num-shards", type=int, default=8)
+    p.add_argument(
+        "--read-replicas", type=int, default=0,
+        help="follower read-replicas per shard (0 = no replicated reads)",
+    )
     p.add_argument("--lease-ttl", type=float, default=5.0)
     p.add_argument("--heartbeat-timeout", type=float, default=6.0)
     p.add_argument("--tick-interval", type=float, default=1.0)
@@ -861,6 +910,7 @@ def main() -> None:
             heartbeat_timeout_s=args.heartbeat_timeout,
             election=make_lease(target, advertise, ttl_s=args.election_ttl),
             kv_factory=lambda: FileKV(f"{args.ha_dir}/meta.kv"),
+            read_replicas=args.read_replicas,
         )
     else:
         kv = FileKV(f"{args.data_dir}/meta.kv") if args.data_dir else MemoryKV()
@@ -869,6 +919,7 @@ def main() -> None:
             num_shards=args.num_shards,
             lease_ttl_s=args.lease_ttl,
             heartbeat_timeout_s=args.heartbeat_timeout,
+            read_replicas=args.read_replicas,
         )
     server.start_loop(args.tick_interval)
     app = create_meta_app(server)

@@ -25,7 +25,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Optional, Sequence
 
 from .kv import LeaseKV
 
@@ -48,10 +48,13 @@ class NodeInfo:
 @dataclass
 class ShardView:
     shard_id: int
-    node: Optional[str]  # owning endpoint, None = unassigned
+    node: Optional[str]  # owning (leader) endpoint, None = unassigned
     version: int = 0
     table_ids: tuple[int, ...] = ()
     lease_id: int = 0  # fencing token handed to the owning node
+    # Read-replica (follower) endpoints: serve bounded-staleness reads
+    # from the shared object store; never the leader, never writable.
+    replicas: tuple[str, ...] = ()
 
     def to_dict(self) -> dict:
         return {
@@ -60,6 +63,7 @@ class ShardView:
             "version": self.version,
             "table_ids": list(self.table_ids),
             "lease_id": self.lease_id,
+            "replicas": list(self.replicas),
         }
 
     @staticmethod
@@ -70,6 +74,7 @@ class ShardView:
             version=int(d.get("version", 0)),
             table_ids=tuple(d.get("table_ids", ())),
             lease_id=int(d.get("lease_id", 0)),
+            replicas=tuple(d.get("replicas", ())),
         )
 
 
@@ -178,8 +183,38 @@ class TopologyManager:
             s.node = node
             s.version += 1
             s.lease_id = lease_id
+            if node is not None and node in s.replicas:
+                # A promoted follower stops being a replica: one endpoint
+                # must never hold both roles for a shard (the replica
+                # scheduler backfills a new follower on its next tick).
+                s.replicas = tuple(r for r in s.replicas if r != node)
             self.kv.put(f"{_K_SHARD}{shard_id}", s.to_dict())
             return ShardView(**vars(s))
+
+    def set_replicas(self, shard_id: int, replicas: Sequence[str]) -> Optional[ShardView]:
+        """Install the follower (read-replica) set for a shard; bumps the
+        version so stale replica orders are fenced like leader orders.
+        The leader endpoint is never a replica of its own shard."""
+        with self._lock:
+            s = self._shards.get(shard_id)
+            if s is None:
+                return None
+            clean = tuple(r for r in dict.fromkeys(replicas) if r != s.node)
+            if clean == s.replicas:
+                return ShardView(**vars(s))
+            s.replicas = clean
+            s.version += 1
+            self.kv.put(f"{_K_SHARD}{shard_id}", s.to_dict())
+            return ShardView(**vars(s))
+
+    def replica_shards_of_node(self, endpoint: str) -> list[ShardView]:
+        """Shards this endpoint serves as a READ REPLICA (follower)."""
+        with self._lock:
+            return [
+                ShardView(**vars(s))
+                for s in self._shards.values()
+                if endpoint in s.replicas
+            ]
 
     def assign_shard_if_owner(
         self, shard_id: int, expected_node: str, lease_id: int

@@ -255,6 +255,16 @@ class Proxy:
         finally:
             elapsed = time.perf_counter() - ctx.start
             self._m_latency.observe(elapsed)
+            # Follower-served statement (gateway replica path): the route
+            # truth is "follower" whatever executor path ran underneath,
+            # and the watermark lag rides the ledger so query_stats
+            # carries it on every wire.
+            from ..cluster.replica import replica_context
+
+            rc = replica_context()
+            if rc is not None:
+                ledger.set_route("follower")
+                ledger.add(replica_lag_ms=rc["lag_ms"])
             if ok and shape is not None and exec_elapsed[0] is not None:
                 # the EWMA only learns from completed LEADER executions —
                 # failures/sheds would teach it queries are "fast", and

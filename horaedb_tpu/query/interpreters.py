@@ -227,6 +227,16 @@ class InterpreterFactory:
         lines.append(
             f"  TimeRange: [{tr.inclusive_start}, {tr.exclusive_end})"
         )
+        # Follower-served EXPLAIN (gateway replica path): say so — the
+        # plan below describes LOCAL read-only state, not the leader's.
+        from ..cluster.replica import replica_context
+
+        _rc = replica_context()
+        if _rc is not None:
+            lines.append(
+                f"  Replica: route=follower epoch={_rc['epoch']} "
+                f"watermark_lag_ms={_rc['lag_ms']}"
+            )
         if q.predicate.filters:
             fs = ", ".join(
                 f"{f.column} {f.op.value} {f.value!r}" for f in q.predicate.filters

@@ -206,6 +206,9 @@ class RuleEngine:
         self.interval_s = max(0.05, float(self.section.eval_interval_s))
         self.rules: dict[str, Rule] = {}
         self._parsed: dict[str, object] = {}  # name -> PromExpr
+        # per-rule cadence bookkeeping (Rule.every_s): name -> last eval
+        # wall-clock ms; a rule is due when now - last >= every_s
+        self._rule_last_eval_ms: dict[str, int] = {}
         self.rollup_sources: list[str] = list(self.section.rollup_tables)
         self._maintainers: dict[str, RollupMaintainer] = {}
         self._wm_seed: dict[str, dict[str, int]] = {}  # source -> suffix -> ms
@@ -317,6 +320,7 @@ class RuleEngine:
             )
         del self.rules[name]
         self._parsed.pop(name, None)
+        self._rule_last_eval_ms.pop(name, None)
         with self._alerts_lock:
             self._alerts.pop(name, None)
         self.last_errors.pop(name, None)
@@ -461,12 +465,15 @@ class RuleEngine:
                 if parsed is None:
                     continue
                 try:
+                    if not self._rule_due(rule, now_ms):
+                        continue
                     if not self._rule_local(rule, parsed):
                         continue
                     if rule.kind == "recording":
                         self._eval_recording(rule, parsed, now_ms)
                     else:
                         self._eval_alert(rule, parsed, now_ms)
+                    self._rule_last_eval_ms[rule.name] = now_ms
                     _M_EVAL[rule.kind].inc()
                     self.last_errors.pop(rule.name, None)
                 except OverloadedError:
@@ -492,6 +499,17 @@ class RuleEngine:
             _M_EVAL_SECONDS.observe(time.perf_counter() - t0)
         if wm_dirty:
             self._save_state()
+
+    def _rule_due(self, rule: Rule, now_ms: int) -> bool:
+        """Per-rule cadence gate (Rule.every_s; 0 = every round). A tiny
+        epsilon absorbs loop-tick jitter so ``every = eval_interval``
+        still evaluates every round instead of every other one."""
+        if rule.every_s <= 0:
+            return True
+        last = self._rule_last_eval_ms.get(rule.name)
+        if last is None:
+            return True
+        return (now_ms - last) >= rule.every_s * 1000 - 50
 
     def _note_rule_error(self, name: str, kind: str, e: Exception) -> None:
         self.last_errors[name] = f"{type(e).__name__}: {e}"[:200]

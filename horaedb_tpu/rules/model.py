@@ -27,6 +27,7 @@ from ..proxy.promql import PromQLError, parse_promql
 _NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
 
 _FOR_TAIL = re.compile(r"\s+for\s+(\d+(?:ms|s|m|h|d))\s*$")
+_EVERY_TAIL = re.compile(r"\s+every\s+(\d+(?:ms|s|m|h|d))\s*$")
 
 
 class RuleError(ValueError):
@@ -39,6 +40,11 @@ class Rule:
 
     ``for_s`` (alerts only): how long the expression must keep returning
     a series before that series transitions pending -> firing.
+    ``every_s``: per-rule evaluation cadence — 0 means "every engine
+    round" ([rules] eval_interval); a larger value makes the engine skip
+    rounds until the interval elapses (an expensive daily recording rule
+    must not re-run every 15s). Effective cadence is therefore
+    max(eval_interval, every).
     ``source``: "config" rules reload from the config file each start and
     cannot be removed at runtime; "runtime" rules persist in the rules
     state file beside ``wlm_state.json``.
@@ -48,6 +54,7 @@ class Rule:
     expr: str
     kind: str = "recording"  # "recording" | "alert"
     for_s: float = 0.0
+    every_s: float = 0.0
     labels: dict[str, str] = field(default_factory=dict)
     source: str = "config"  # "config" | "runtime"
 
@@ -57,6 +64,7 @@ class Rule:
             "expr": self.expr,
             "kind": self.kind,
             "for_s": self.for_s,
+            "every_s": self.every_s,
             "labels": dict(self.labels),
             "source": self.source,
         }
@@ -73,6 +81,8 @@ def validate_rule(rule: Rule) -> Rule:
         )
     if rule.for_s < 0:
         raise RuleError(f"rule {rule.name!r}: negative for duration")
+    if rule.every_s < 0:
+        raise RuleError(f"rule {rule.name!r}: negative every interval")
     if rule.kind == "recording" and rule.for_s:
         raise RuleError(f"recording rule {rule.name!r} takes no for duration")
     try:
@@ -88,38 +98,49 @@ def validate_rule(rule: Rule) -> Rule:
 
 
 def parse_rule_line(line: str, kind: str, source: str = "config") -> Rule:
-    """``NAME := EXPR`` (recording) / ``NAME := EXPR [for 30s]`` (alert)
-    — the ``[rules]`` config form."""
+    """``NAME := EXPR [for 30s] [every 15s]`` — the ``[rules]`` config
+    line form (``for`` is alert-only; ``every`` sets the per-rule
+    evaluation cadence for either kind, trailing the ``for`` tail)."""
     name, sep, expr = line.partition(":=")
     if not sep:
         raise RuleError(
             f"bad rule line {line!r}: expected 'NAME := EXPR'"
         )
     name, expr = name.strip(), expr.strip()
+    every_s = 0.0
+    m = _EVERY_TAIL.search(expr)
+    if m is not None:
+        every_s = parse_duration_ms(m.group(1)) / 1000.0
+        expr = expr[: m.start()].rstrip()
     for_s = 0.0
     if kind == "alert":
         m = _FOR_TAIL.search(expr)
         if m is not None:
             for_s = parse_duration_ms(m.group(1)) / 1000.0
             expr = expr[: m.start()].rstrip()
-    return validate_rule(Rule(name, expr, kind=kind, for_s=for_s, source=source))
+    return validate_rule(
+        Rule(name, expr, kind=kind, for_s=for_s, every_s=every_s,
+             source=source)
+    )
 
 
 def rule_from_dict(d: dict, source: str = "runtime") -> Rule:
     """The /admin/rules POST body (and the persisted state-file form)."""
     if not isinstance(d, dict):
         raise RuleError("rule must be an object")
-    for_raw = d.get("for", d.get("for_s", 0))
-    if isinstance(for_raw, str):
-        for_s = parse_duration_ms(for_raw) / 1000.0
-    else:
-        for_s = float(for_raw or 0)
+    def _dur(key: str, alt: str) -> float:
+        raw = d.get(key, d.get(alt, 0))
+        if isinstance(raw, str):
+            return parse_duration_ms(raw) / 1000.0
+        return float(raw or 0)
+
     return validate_rule(
         Rule(
             name=str(d.get("name", "")),
             expr=str(d.get("expr", "")),
             kind=str(d.get("kind", "recording")),
-            for_s=for_s,
+            for_s=_dur("for", "for_s"),
+            every_s=_dur("every", "every_s"),
             labels=dict(d.get("labels") or {}),
             source=source,
         )

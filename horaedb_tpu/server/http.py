@@ -105,6 +105,14 @@ def _dumps(obj: Any) -> str:
 
 
 FORWARD_HEADER = "X-HoraeDB-Forwarded"
+# Replicated follower reads (cluster/replica): a forwarded read marked
+# with REPLICA_READ_HEADER asks the receiving node to serve from its
+# read-only follower handle; REPLICA_EPOCH_HEADER carries the shard
+# epoch the forwarder observed (a follower trailing it refuses);
+# STALENESS_HEADER is the per-request bounded-staleness opt-in.
+REPLICA_READ_HEADER = "X-HoraeDB-Replica-Read"
+REPLICA_EPOCH_HEADER = "X-HoraeDB-Replica-Epoch"
+STALENESS_HEADER = "X-HoraeDB-Read-Staleness"
 
 
 @functools.lru_cache(maxsize=None)
@@ -117,6 +125,38 @@ def latency_histogram(protocol: str):
         "front-end request latency by protocol",
         labels={"protocol": protocol},
     )
+
+
+def _follower_reads_enabled() -> bool:
+    """HORAEDB_FOLLOWER_READS=0 pins every read to the leader (kill
+    switch for the replicated follower serving path)."""
+    import os
+
+    return os.environ.get("HORAEDB_FOLLOWER_READS", "1") != "0"
+
+
+def _replica_select(stmt):
+    """The SELECT a follower replica may serve (plain SELECT, or EXPLAIN
+    over one), else None. Writes/DDL never touch replicas; joins, CTEs
+    and unions keep their existing leader-side handling."""
+    from ..query import ast as _ast
+
+    inner = stmt.inner if isinstance(stmt, _ast.Explain) else stmt
+    return inner if isinstance(inner, _ast.Select) else None
+
+
+def _parse_staleness(raw: Optional[str]) -> Optional[int]:
+    """X-HoraeDB-Read-Staleness header -> milliseconds (None = absent,
+    invalid values read as absent rather than failing the query)."""
+    if not raw:
+        return None
+    from ..engine.options import parse_duration_ms
+
+    try:
+        s = raw.strip()
+        return parse_duration_ms(s) if not s.isdigit() else int(s) * 1000
+    except Exception:
+        return None
 
 
 def _write_fence(cluster, router, table: str) -> Optional[tuple[int, str]]:
@@ -190,13 +230,20 @@ class SqlGateway:
         already_forwarded: bool = False,
         protocol: str | None = None,
         tenant: str = "default",
+        replica_read: bool = False,
+        staleness_ms: Optional[int] = None,
+        replica_epoch: Optional[int] = None,
     ):
         if protocol is not None:
             import time as _time
 
             t0 = _time.perf_counter()
             try:
-                return await self.execute(query, already_forwarded, tenant=tenant)
+                return await self.execute(
+                    query, already_forwarded, tenant=tenant,
+                    replica_read=replica_read, staleness_ms=staleness_ms,
+                    replica_epoch=replica_epoch,
+                )
             finally:
                 latency_histogram(protocol).observe(_time.perf_counter() - t0)
         app = self.app
@@ -249,6 +296,21 @@ class SqlGateway:
             if table is not None:
                 route = router.route(table)
                 if not route.is_local:
+                    # Scale-out read path: a node holding a READ REPLICA
+                    # of the shard serves eligible bounded-staleness
+                    # SELECTs locally instead of forwarding them all to
+                    # the one leader (cluster/replica).
+                    if (
+                        cluster is not None
+                        and _follower_reads_enabled()
+                        and _replica_select(stmt) is not None
+                    ):
+                        served = await self._try_replica_local(
+                            query, tenant, table, replica_read,
+                            staleness_ms, replica_epoch,
+                        )
+                        if served is not None:
+                            return served
                     if already_forwarded:
                         return "error", (
                             502,
@@ -257,7 +319,26 @@ class SqlGateway:
                             "it forwarded",
                             {},
                         )
+                    if (
+                        cluster is not None
+                        and route.replicas
+                        and not replica_read
+                        and _follower_reads_enabled()
+                        and _replica_select(stmt) is not None
+                    ):
+                        # offload to the least-loaded follower; a typed
+                        # refusal (stale/fenced) falls back to the leader
+                        served = await self._forward_replica(
+                            route, query, staleness_ms
+                        )
+                        if served is not None:
+                            return served
                     return await self._forward(route.endpoint, query)
+                local_route = route if route.replicas else None
+            else:
+                local_route = None
+        else:
+            local_route = None
         if query.lstrip()[:7].lower().startswith("select"):
             # tenant is part of the key: a follower must not skip ITS
             # tenant's quota charge by riding another tenant's flight
@@ -269,7 +350,10 @@ class SqlGateway:
                 # count into the wlm dedup family too so the workload
                 # table reflects gateway-level coalescing
                 self.app["proxy"].wlm.dedup.note_coalesced()
-                return await asyncio.shield(running)
+                out = await asyncio.shield(running)
+                return await self._maybe_shed_to_follower(
+                    out, local_route, query, staleness_ms, replica_read
+                )
             # ensure_future (not a bare await): the shared execution must
             # outlive a cancelled leader request so followers still get
             # their result
@@ -281,7 +365,10 @@ class SqlGateway:
                     self._inflight.pop(key, None)
 
             task.add_done_callback(_done)
-            return await asyncio.shield(task)
+            out = await asyncio.shield(task)
+            return await self._maybe_shed_to_follower(
+                out, local_route, query, staleness_ms, replica_read
+            )
         # any non-SELECT may change visible state: advance the epoch so
         # later reads start a fresh execution. Bumped AFTER the statement
         # runs (conservatively even when it fails) — bumping before
@@ -320,6 +407,212 @@ class SqlGateway:
         if isinstance(out, AffectedRows):
             return "affected", out.count
         return "rows", (list(out.names), out.to_pylist())
+
+    async def _try_replica_local(
+        self,
+        query: str,
+        tenant: str,
+        table: str,
+        replica_read: bool,
+        staleness_ms: Optional[int],
+        replica_epoch: Optional[int],
+    ):
+        """Serve an eligible SELECT from THIS node's read-only follower
+        handle. Returns a gateway result, or None meaning "not servable
+        here — route normally" (locally-received reads fall through to
+        the leader forward; a FORWARDED replica read instead gets the
+        typed retryable refusal so the origin performs the fallback)."""
+        from ..cluster.replica import (
+            REPLICA_RESPONSE,
+            ReplicaFencedError,
+            ReplicaStaleError,
+            note_replica_read,
+            replica_serving,
+        )
+
+        app = self.app
+        cluster = app["cluster"]
+        conn = app["conn"]
+        proxy = app["proxy"]
+        if cluster is None or not cluster.serves_replica(table):
+            if replica_read:
+                note_replica_read("fenced")
+                return "error", (
+                    503,
+                    f"table {table!r} not replicated on this node",
+                    {"kind": "replica_fenced", "retry_after_s": 1.0},
+                )
+            return None
+        if staleness_ms is None:
+            staleness_ms = app.get("read_staleness_ms") or 0
+
+        def serve():
+            import time as _time
+
+            epoch, data = cluster.replica_read_state(
+                table, expected_epoch=replica_epoch
+            )
+            from ..query import plan as plan_mod
+
+            plan = conn._cached_plan(query)
+            inner = (
+                plan.inner if isinstance(plan, plan_mod.ExplainPlan) else plan
+            )
+            if not isinstance(inner, plan_mod.QueryPlan) or inner.table != table:
+                raise ReplicaStaleError(
+                    "statement shape not replica-servable", epoch=epoch
+                )
+            end = inner.predicate.time_range.exclusive_end
+            wm = data.follower_watermark_ms()
+            if end > wm:
+                # opportunistic catch-up before refusing: the tail loop
+                # may simply not have run since the leader's last flush
+                try:
+                    data.refresh_from_manifest()
+                    wm = data.follower_watermark_ms()
+                except Exception:
+                    pass
+            now_ms = int(_time.time() * 1000)
+            lag_ms = max(0, now_ms - wm) if wm > 0 else now_ms
+            # Bounded-staleness predicate: the range must be entirely
+            # below the watermark, OR the caller opted into a staleness
+            # bound the follower currently satisfies. A fresh open-tail
+            # range on a lagging follower always refuses.
+            if end > wm and not (
+                staleness_ms and wm > 0 and lag_ms <= staleness_ms
+            ):
+                raise ReplicaStaleError(
+                    f"time range end {end} beyond follower watermark {wm} "
+                    f"for {table!r} (lag {lag_ms}ms)",
+                    epoch=epoch,
+                    watermark_ms=wm,
+                )
+            with replica_serving(table, epoch, lag_ms):
+                if tenant == "default":
+                    out = proxy.handle_sql(query)
+                else:
+                    out = proxy.handle_sql(query, tenant=tenant)
+            return out, epoch, lag_ms
+
+        loop = asyncio.get_running_loop()
+        try:
+            out, epoch, lag_ms = await loop.run_in_executor(None, serve)
+        except ReplicaStaleError as e:
+            if replica_read:
+                # the ORIGIN owns the leader fallback for forwarded reads
+                return "error", (
+                    503, str(e),
+                    {"kind": "replica_stale", "retry_after_s": e.retry_after_s},
+                )
+            note_replica_read("stale_fallback")
+            return None  # fall through to the leader forward
+        except ReplicaFencedError as e:
+            note_replica_read("fenced")
+            if replica_read:
+                return "error", (
+                    503, str(e),
+                    {"kind": "replica_fenced",
+                     "retry_after_s": e.retry_after_s},
+                )
+            return None
+        except BlockedError as e:
+            return "error", (403, str(e), {"kind": "blocked"})
+        except OverloadedError as e:
+            return "error", (
+                503, str(e),
+                {"kind": "overloaded", "retry_after_s": e.retry_after_s},
+            )
+        except QuotaExceededError as e:
+            return "error", (
+                429, str(e),
+                {"kind": "quota", "retry_after_s": e.retry_after_s},
+            )
+        except Exception as e:
+            return "error", (422, str(e), {})
+        note_replica_read("served")
+        # visible to the HTTP handler (same request task context): the
+        # response advertises the epoch + lag it was served at
+        REPLICA_RESPONSE.set({"epoch": epoch, "lag_ms": lag_ms})
+        if isinstance(out, AffectedRows):  # defensive: SELECTs only
+            return "affected", out.count
+        return "rows", (list(out.names), out.to_pylist())
+
+    async def _forward_replica(
+        self, route, query: str, staleness_ms: Optional[int]
+    ):
+        """Offload an eligible SELECT to one of the route's follower
+        replicas. Returns a gateway result, or None meaning "use the
+        leader" (no replica available, or the follower refused with the
+        typed stale/fenced error — the refusal is the follower telling
+        us the leader owns this read)."""
+        import aiohttp
+
+        from ..cluster.replica import note_replica_read
+
+        router = self.app["router"]
+        pick = getattr(router, "pick_replica", None)
+        target = (
+            pick(route, exclude=getattr(router, "self_endpoint", ""))
+            if pick is not None
+            else None
+        )
+        if target is None:
+            return None
+        headers = {
+            FORWARD_HEADER: "1",
+            REPLICA_READ_HEADER: "1",
+            REPLICA_EPOCH_HEADER: str(route.epoch),
+        }
+        if staleness_ms:
+            headers[STALENESS_HEADER] = f"{int(staleness_ms)}ms"
+        try:
+            session = await _client_session(self.app)
+            async with session.post(
+                f"http://{target}/sql",
+                json={"query": query},
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as resp:
+                body = await resp.json(content_type=None)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return None  # follower unreachable: the leader still can
+        if resp.status != 200:
+            if isinstance(body, dict) and body.get("replica"):
+                # typed stale/fenced refusal — fall back to the leader
+                note_replica_read("stale_fallback")
+            # ANY follower failure falls back: the leader is
+            # authoritative and could serve the read (a genuine query
+            # error reproduces there with the authoritative message) —
+            # surfacing a follower-side 502/422 would fail reads the
+            # pre-replica path served fine
+            return None
+        if "affected_rows" in body:
+            return "affected", body["affected_rows"]
+        rows = body.get("rows", [])
+        names = body.get("names") or (list(rows[0].keys()) if rows else [])
+        return "rows", (names, rows)
+
+    async def _maybe_shed_to_follower(
+        self, out, local_route, query: str,
+        staleness_ms: Optional[int], replica_read: bool,
+    ):
+        """Leader-overload relief: when the LOCAL leader shed an eligible
+        SELECT with the retryable OverloadedError and the shard has
+        follower replicas, try one replica before surfacing the shed to
+        the client. The follower still applies its own staleness/fencing
+        rules; a refusal returns the original shed error."""
+        if (
+            local_route is None
+            or replica_read
+            or not _follower_reads_enabled()
+            or not (isinstance(out, tuple) and out[0] == "error")
+        ):
+            return out
+        status, msg, extra = out[1]
+        if extra.get("kind") != "overloaded":
+            return out
+        served = await self._forward_replica(local_route, query, staleness_ms)
+        return served if served is not None else out
 
     async def _forward(self, endpoint: str, query: str):
         """Ship the statement to the owning node's /sql (ref: forward.rs)."""
@@ -369,7 +662,7 @@ async def _auth_middleware(request: web.Request, handler):
 def create_app(
     conn: Connection, router=None, cluster=None, auth_token: str = "",
     limits=None, observability=None, node: str = "standalone",
-    rules_cfg=None,
+    rules_cfg=None, read_staleness_s: float = 0.0,
 ) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
     adds the /meta_event endpoints, meta-driven DDL, and write fencing.
@@ -394,6 +687,9 @@ def create_app(
     app["router"] = router
     app["cluster"] = cluster
     app["node"] = node
+    # default bounded-staleness opt-in for follower reads ([cluster]
+    # read_staleness; per-request override via X-HoraeDB-Read-Staleness)
+    app["read_staleness_ms"] = int(max(0.0, read_staleness_s) * 1000)
     app["started_at"] = _time.time()
     app.on_cleanup.append(_close_client_session)
 
@@ -555,12 +851,27 @@ def create_app(
         query = body.get("query")
         if not isinstance(query, str) or not query.strip():
             return web.json_response({"error": "missing 'query'"}, status=400)
+        from ..cluster.replica import REPLICA_RESPONSE
+
+        # keep-alive connections reuse one handler task (one context):
+        # clear before executing or a later statement on the same
+        # connection would inherit the previous one's replica headers
+        REPLICA_RESPONSE.set(None)
         kind, payload = await gateway.execute(
             query,
             already_forwarded=bool(request.headers.get(FORWARD_HEADER)),
             protocol="http",
             # per-tenant quota scope (wlm/quota); absent -> "default"
             tenant=request.headers.get("X-HoraeDB-Tenant", "default"),
+            replica_read=bool(request.headers.get(REPLICA_READ_HEADER)),
+            staleness_ms=_parse_staleness(
+                request.headers.get(STALENESS_HEADER)
+            ),
+            replica_epoch=(
+                int(request.headers[REPLICA_EPOCH_HEADER])
+                if request.headers.get(REPLICA_EPOCH_HEADER, "").isdigit()
+                else None
+            ),
         )
         if kind == "error":
             status, msg, extra = payload
@@ -570,15 +881,27 @@ def create_app(
                 headers["Retry-After"] = str(
                     max(1, int(round(extra["retry_after_s"])))
                 )
-            return web.json_response(
-                {"error": msg}, status=status, headers=headers
-            )
+            body = {"error": msg}
+            if extra.get("kind") in ("replica_stale", "replica_fenced"):
+                # typed refusal marker: the forwarding origin falls back
+                # to the leader on it instead of failing the client
+                body["replica"] = extra["kind"]
+            return web.json_response(body, status=status, headers=headers)
+        headers = {}
+        rinfo = REPLICA_RESPONSE.get()
+        if rinfo is not None:
+            # follower-served: advertise the manifest epoch + lag
+            headers[REPLICA_EPOCH_HEADER] = str(rinfo["epoch"])
+            headers["X-HoraeDB-Replica-Lag-Ms"] = str(rinfo["lag_ms"])
         if kind == "affected":
-            return web.json_response({"affected_rows": payload})
+            return web.json_response(
+                {"affected_rows": payload}, headers=headers
+            )
         names, rows = payload
         return web.Response(
             text=_dumps({"rows": rows, "names": names}),
             content_type="application/json",
+            headers=headers,
         )
 
     async def write(request: web.Request) -> web.Response:
@@ -1427,7 +1750,23 @@ def create_app(
             return web.json_response({"error": str(e)}, status=422)
         return web.json_response({"ok": True})
 
+    async def meta_open_replica(request: web.Request) -> web.Response:
+        if cluster is None:
+            return web.json_response({"error": "not in cluster mode"}, status=400)
+        order = await request.json()
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, cluster.apply_replica_order, order
+            )
+        except Exception as e:
+            return web.json_response({"error": str(e)}, status=422)
+        # Like open_shard pushes: the replica lease arrives via the
+        # kicked heartbeat, not the (possibly stale) push itself.
+        cluster.kick_heartbeat()
+        return web.json_response({"ok": True})
+
     app.router.add_post("/meta_event/open_shard", meta_open_shard)
+    app.router.add_post("/meta_event/open_replica", meta_open_replica)
     app.router.add_post("/meta_event/close_shard", meta_close_shard)
     app.router.add_post("/meta_event/create_table_on_shard", meta_create_table)
     app.router.add_post("/meta_event/drop_table_on_shard", meta_drop_table)
@@ -1740,6 +2079,9 @@ def run_server(
         observability=observability,
         node=node,
         rules_cfg=(config.rules if config is not None else None),
+        read_staleness_s=(
+            config.cluster.read_staleness_s if config is not None else 0.0
+        ),
     )
     app["proxy"].slow_threshold_s = slow_threshold
 

@@ -107,6 +107,77 @@ def main(out_path: str) -> None:
     except Exception as e:
         emit({"stage": "bench_error", "err": repr(e)[:300]})
 
+    # ---- 2b. follower-read smoke ----------------------------------------
+    # The replicated-follower serving path (cluster/replica): a leader
+    # writes + flushes into a shared store, a second Connection opens the
+    # table READ-ONLY (manifest tail), and the same dashboard SELECT runs
+    # on both — the follower's numbers track what the scale-out serving
+    # path costs ON CHIP (its scan cache is its own HBM residency).
+    try:
+        import shutil
+        import tempfile
+
+        import horaedb_tpu
+        from horaedb_tpu.db import Connection
+        from horaedb_tpu.utils.object_store import LocalDiskStore
+
+        d = tempfile.mkdtemp(prefix="chip_follower_")
+        try:
+            leader = horaedb_tpu.connect(d)
+            leader.execute(
+                "CREATE TABLE fsmoke (host string TAG, v double, ts "
+                "timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+                "WITH (segment_duration='2h')"
+            )
+            rng = np.random.default_rng(7)
+            n = 2000
+            values = ", ".join(
+                f"('h{h}', {v:.3f}, {t})"
+                for h, v, t in zip(
+                    rng.integers(0, 32, n),
+                    rng.normal(10, 3, n),
+                    rng.integers(0, 3_600_000, n),
+                )
+            )
+            leader.execute(
+                f"INSERT INTO fsmoke (host, v, ts) VALUES {values}"
+            )
+            leader.catalog.open("fsmoke").flush()
+
+            follower = Connection(LocalDiskStore(d))
+            t_open0 = time.perf_counter()
+            ft = follower.catalog.open_follower("fsmoke")
+            open_ms = (time.perf_counter() - t_open0) * 1e3
+            q = ("SELECT host, avg(v) AS a FROM fsmoke WHERE ts < 3600000 "
+                 "GROUP BY host")
+            lead_rows = sorted(
+                map(tuple, (r.values() for r in leader.execute(q).to_pylist()))
+            )
+            fol_ms = round(timeit(
+                lambda: follower.execute(q), n=5, warmup=2) * 1e3, 3)
+            fol_rows = sorted(
+                map(tuple, (r.values() for r in follower.execute(q).to_pylist()))
+            )
+            agree = len(lead_rows) == len(fol_rows) and all(
+                a[0] == b[0] and abs(a[1] - b[1]) < 1e-3
+                for a, b in zip(lead_rows, fol_rows)
+            )
+            data = ft.physical_datas()[0]
+            emit({
+                "stage": "follower_smoke",
+                "open_ms": round(open_ms, 3),
+                "query_ms": fol_ms,
+                "groups": len(fol_rows),
+                "watermark_ms": data.follower_watermark_ms(),
+                "agree": bool(agree),
+            })
+            follower.close()
+            leader.close()
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    except Exception as e:
+        emit({"stage": "follower_smoke_error", "err": repr(e)[:300]})
+
     # ---- 3. segment-reduction A/B ---------------------------------------
     # (The hand-written pallas segment kernel was deleted in round 5 —
     # interpret-mode-only for three rounds with no chip session to lower

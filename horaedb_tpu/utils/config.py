@@ -219,6 +219,15 @@ class ClusterSection:
     rules: dict[str, str] = field(default_factory=dict)
     # coordinator mode: meta server endpoints (overrides static routing)
     meta_endpoints: list[str] = field(default_factory=list)
+    # follower read-replicas per shard (advisory on data nodes — the
+    # coordinator's --read-replicas flag is authoritative; documented so
+    # one config file can describe the whole deployment)
+    read_replicas: int = 0
+    # default bounded-staleness opt-in for follower reads: a query whose
+    # range reaches past a follower's watermark may still be served there
+    # when the follower lags by at most this much (0 = watermark-covered
+    # ranges only; per-request override: X-HoraeDB-Read-Staleness)
+    read_staleness_s: float = 0.0
 
 
 @dataclass
@@ -290,7 +299,10 @@ _KNOWN = {
         "rollup_tables", "rollup_raw_ttl", "rollup_1m_ttl",
         "rollup_1h_ttl", "recording_ttl",
     },
-    "cluster": {"self_endpoint", "endpoints", "rules", "meta_endpoints"},
+    "cluster": {
+        "self_endpoint", "endpoints", "rules", "meta_endpoints",
+        "read_replicas", "read_staleness",
+    },
     "s3": {
         "bucket", "endpoint", "region", "access_key", "secret_key", "prefix",
         "disk_cache_dir", "disk_cache_bytes", "mem_cache_bytes",
@@ -461,6 +473,16 @@ def _apply(cfg: Config, raw: dict) -> None:
         if not isinstance(meps, list) or not all(isinstance(e, str) for e in meps):
             raise ConfigError("cluster.meta_endpoints must be a list of strings")
         cfg.cluster.meta_endpoints = meps
+        if "read_replicas" in c:
+            cfg.cluster.read_replicas = int(c["read_replicas"])
+            if cfg.cluster.read_replicas < 0:
+                raise ConfigError("cluster.read_replicas must be >= 0")
+        if "read_staleness" in c:
+            cfg.cluster.read_staleness_s = (
+                parse_duration_ms(c["read_staleness"]) / 1000.0
+            )
+            if cfg.cluster.read_staleness_s < 0:
+                raise ConfigError("cluster.read_staleness must be >= 0")
         if not cfg.cluster.self_endpoint:
             raise ConfigError("cluster.self_endpoint is required in [cluster]")
         if not meps and not eps:

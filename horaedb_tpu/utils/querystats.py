@@ -69,6 +69,9 @@ NUMERIC_FIELDS: dict[str, str] = {
     # raw (non-aggregate) device reads: result rows the fused
     # filter+top-k/selection path returned (0 for host-served raw reads)
     "raw_rows_returned": "rows the device raw-read path returned",
+    # replicated follower reads (route=follower): how far the serving
+    # follower's freshness watermark trailed "now" at serve time
+    "replica_lag_ms": "follower watermark lag (ms) on replica-served reads",
 }
 
 # wall-time costs; seconds, float.

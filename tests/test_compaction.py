@@ -138,6 +138,90 @@ class TestCompaction:
         # windows don't overlap after compaction
         assert not l1[0].time_range.overlaps(l1[1].time_range)
 
+    def test_staged_pipeline_uploads_before_manifest_and_overlaps_tasks(self):
+        """PR-10 satellite: output-SST uploads run on the io pool and a
+        task's install is deferred past the NEXT task's merge — but the
+        manifest must never reference an object that is not yet durable
+        (data before metadata), and the final state must match what the
+        serial runner produced."""
+        inst, t = env()
+        # two windows -> two tasks (the one-deep pipeline actually runs)
+        for w in range(2):
+            for i in range(3):
+                write_flush(
+                    inst, t,
+                    [{"name": f"h{i}", "value": float(w * 10 + i),
+                      "t": w * HOUR + i}],
+                )
+        store = inst.store
+        real_put = store.put
+        puts: list[str] = []
+        appended_after: list[str] = []
+
+        def spy_put(path, data):
+            puts.append(path)
+            return real_put(path, data)
+
+        store.put = spy_put
+        real_append = t.manifest.append_edits
+
+        def spy_append(edits):
+            from horaedb_tpu.engine.manifest import AddFile
+
+            for e in edits:
+                if isinstance(e, AddFile) and e.path not in puts:
+                    appended_after.append(e.path)
+            return real_append(edits)
+
+        t.manifest.append_edits = spy_append
+        try:
+            res = Compactor(t).compact()
+        finally:
+            store.put = real_put
+            t.manifest.append_edits = real_append
+        assert res.tasks_run == 2
+        assert not appended_after, (
+            "manifest referenced an SST before its upload completed"
+        )
+        # every manifest-tracked file is durable and readable
+        for h in t.version.levels.all_files():
+            assert store.exists(h.path)
+        got = sorted(
+            (r["t"], r["value"]) for r in inst.read(t).to_pylist()
+        )
+        assert got == sorted(
+            (w * HOUR + i, float(w * 10 + i))
+            for w in range(2) for i in range(3)
+        )
+
+    def test_stream_writer_finalize_upload_split(self):
+        """finalize() encodes without storing; upload() makes it
+        durable; close() remains finalize+upload."""
+        from horaedb_tpu.engine.sst.reader import SstReader
+        from horaedb_tpu.engine.sst.writer import SstStreamWriter
+
+        store = MemoryStore()
+        schema = demo_schema()
+        w = SstStreamWriter(store, "0/9/1.sst", 1)
+        rows = RowGroup.from_rows(
+            schema,
+            [{"name": "h", "value": 1.0, "t": 100},
+             {"name": "h", "value": 2.0, "t": 200}],
+        )
+        w.append(rows, max_sequence=7)
+        out = w.finalize()
+        assert out is not None
+        meta, raw = out
+        assert meta.num_rows == 2 and meta.size_bytes == len(raw)
+        assert not store.exists("0/9/1.sst")  # finalize does NOT store
+        w.upload(raw)
+        assert store.exists("0/9/1.sst")
+        back = SstReader(store, "0/9/1.sst").read(schema)
+        assert len(back) == 2
+        # empty writer: finalize -> None, close -> None
+        w2 = SstStreamWriter(store, "0/9/2.sst", 2)
+        assert w2.finalize() is None and w2.close() is None
+
     def test_auto_compact_triggered_by_flush_inline(self):
         """background_compaction=False keeps the deterministic mode."""
         inst = Instance(

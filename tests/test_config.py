@@ -56,3 +56,33 @@ slow_threshold = "500ms"
     def test_bad_types_rejected(self, tmp_path):
         with pytest.raises(ConfigError, match="boolean"):
             Config.load(write(tmp_path, "[engine]\nwal = 'yes'\n"))
+
+    def test_cluster_replica_knobs(self, tmp_path):
+        cfg = Config.load(write(tmp_path, """
+[cluster]
+self_endpoint = "127.0.0.1:5440"
+meta_endpoints = ["127.0.0.1:2379"]
+read_replicas = 2
+read_staleness = "10s"
+"""))
+        assert cfg.cluster.read_replicas == 2
+        assert cfg.cluster.read_staleness_s == 10.0
+        # defaults: replicated reads off
+        cfg = Config.load(None)
+        assert cfg.cluster.read_replicas == 0
+        assert cfg.cluster.read_staleness_s == 0.0
+        with pytest.raises(ConfigError, match="read_replicas"):
+            Config.load(write(tmp_path, """
+[cluster]
+self_endpoint = "a:1"
+meta_endpoints = ["b:1"]
+read_replicas = -1
+"""))
+        # negative durations are rejected by the shared duration parser
+        with pytest.raises(ValueError, match="duration"):
+            Config.load(write(tmp_path, """
+[cluster]
+self_endpoint = "a:1"
+meta_endpoints = ["b:1"]
+read_staleness = "-5s"
+"""))

@@ -1246,3 +1246,64 @@ class TestRulesRegistryLint:
         cols = {c.name for c in t.schema.columns}
         assert {"rule", "labels", "state", "value", "active_since",
                 "fired_at", "resolved_at"} <= cols
+
+
+class TestReplicaRegistryLint:
+    """PR-10 lint extension (same contract as the rules registry) for the
+    replicated-follower-read families — see the method docstring."""
+
+    def test_replica_families_declared_and_documented(self):
+        """PR-10 lint extension (same contract as the rules registry):
+        every family declared in cluster/replica.REPLICA_METRIC_FAMILIES
+        must be (a) registered live, (b) convention-clean, (c) documented
+        in docs/OBSERVABILITY.md — with the per-outcome read labels
+        eagerly registered — and no stray horaedb_replica_* family may
+        exist outside the declared registry. The [cluster] replica knobs
+        are operator surface: pinned to docs/WORKLOAD.md; the `follower`
+        route and `replica_lag_ms` ledger field are pinned to the ledger
+        docs."""
+        import os
+        import re
+
+        from horaedb_tpu.cluster.replica import (
+            REPLICA_METRIC_FAMILIES,
+            REPLICA_READ_OUTCOMES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in REPLICA_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for outcome in REPLICA_READ_OUTCOMES:
+            if f'outcome="{outcome}"' not in exposed:
+                missing.append(
+                    f"label outcome={outcome}: not eagerly registered"
+                )
+        for fam in families:
+            if fam.startswith("horaedb_replica_") and \
+                    fam not in REPLICA_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("read_replicas", "read_staleness"):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        # the follower serving path is part of the documented ledger
+        # surface: the route value and the staleness headers
+        if "`follower`" not in docs:
+            missing.append("route=follower: undocumented in OBSERVABILITY.md")
+        if "X-HoraeDB-Read-Staleness" not in wdocs:
+            missing.append(
+                "X-HoraeDB-Read-Staleness: undocumented in docs/WORKLOAD.md"
+            )
+        assert not missing, missing

@@ -1,0 +1,822 @@
+"""Replicated follower reads (PR-10): lease-fenced scale-out serving.
+
+Covers the whole stack deterministically in-process — read-only follower
+open + manifest tailing + watermark (engine), replica scheduling (meta),
+epoch/lease fencing (cluster), the gateway's follower-local serving /
+replica offload / leader fallback with ``route=follower`` in
+``system.public.query_stats`` on all three wire protocols — plus one
+subprocess e2e: leader kill mid-storm -> followers refuse past-fence
+reads with the typed retryable error -> traffic re-converges on the
+promoted leader with the old leader's WAL rows intact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.cluster import ClusterImpl, MetaClient, ReplicaFencedError
+from horaedb_tpu.cluster.router import Route, Router
+from horaedb_tpu.db import Connection
+from horaedb_tpu.engine.wal import LocalDiskWal
+from horaedb_tpu.server import create_app
+from horaedb_tpu.utils.object_store import LocalDiskStore
+
+DDL = (
+    "CREATE TABLE hot (host string TAG, v double, ts timestamp NOT NULL, "
+    "TIMESTAMP KEY(ts)) ENGINE=Analytic WITH (segment_duration='2h')"
+)
+
+META_STUB = ["127.0.0.1:1"]  # never contacted: orders applied directly
+
+
+def _order(version: int, ttl: float = 30.0, tables=("hot",)) -> dict:
+    return {
+        "shard_id": 0,
+        "version": version,
+        "lease_ttl_s": ttl,
+        "role": "replica",
+        "tables": [
+            {"name": n, "table_id": 1, "create_sql": DDL} for n in tables
+        ],
+    }
+
+
+def _mk_follower(data_dir: str) -> tuple[Connection, ClusterImpl]:
+    conn = Connection(
+        LocalDiskStore(data_dir), wal=LocalDiskWal(f"{data_dir}/wal")
+    )
+    cl = ClusterImpl(conn, "127.0.0.1:2", MetaClient(META_STUB))
+    return conn, cl
+
+
+class TestFollowerEngine:
+    def test_follower_open_refresh_watermark_and_fences(self, tmp_path):
+        d = str(tmp_path / "store")
+        leader = horaedb_tpu.connect(d)
+        leader.execute(DDL)
+        leader.execute(
+            "INSERT INTO hot (host, v, ts) VALUES ('h1', 1.0, 1000), "
+            "('h2', 2.0, 2000)"
+        )
+        leader.catalog.open("hot").flush()
+
+        follower, cl = _mk_follower(d)
+        cl.apply_replica_order(_order(3), granted_at=time.monotonic())
+        assert cl.serves_replica("hot")
+        epoch, data = cl.replica_read_state("hot")
+        assert epoch == 3 and data.read_only
+        assert data.follower_watermark_ms() == 2001  # last installed flush
+
+        # reads serve the manifest snapshot
+        rows = follower.execute(
+            "SELECT host, v FROM hot WHERE ts <= 2000 ORDER BY ts"
+        ).to_pylist()
+        assert [r["v"] for r in rows] == [1.0, 2.0]
+
+        # writes are fenced on the follower handle
+        with pytest.raises(Exception, match="read-only follower"):
+            follower.execute(
+                "INSERT INTO hot (host, v, ts) VALUES ('x', 9.0, 9000)"
+            )
+
+        # manifest tailing: the leader's next flush becomes visible
+        leader.execute("INSERT INTO hot (host, v, ts) VALUES ('h3', 3.0, 3000)")
+        leader.catalog.open("hot").flush()
+        assert data.refresh_from_manifest() is True
+        assert data.follower_watermark_ms() == 3001
+        got = follower.execute(
+            "SELECT count(1) AS c FROM hot WHERE ts <= 3000"
+        ).to_pylist()
+        assert got[0]["c"] == 3
+        # idempotent when nothing changed
+        assert data.refresh_from_manifest() is False
+
+        # the follower never deletes shared objects: compaction-style
+        # swaps on the leader drop files from OUR view without a purge
+        before = {h.path for h in data.version.levels.all_files()}
+        for p in before:
+            assert leader.store.exists(p)
+        leader.close()
+        follower.close()
+
+    def test_epoch_and_lease_fencing(self, tmp_path):
+        d = str(tmp_path / "store")
+        leader = horaedb_tpu.connect(d)
+        leader.execute(DDL)
+        leader.catalog.open("hot").flush()
+        follower, cl = _mk_follower(d)
+        cl.apply_replica_order(_order(3), granted_at=time.monotonic())
+
+        # epoch trailing an observed transfer refuses
+        with pytest.raises(ReplicaFencedError, match="trails"):
+            cl.replica_read_state("hot", expected_epoch=9)
+        # a NEWER local epoch than the caller observed is fine
+        cl.replica_read_state("hot", expected_epoch=2)
+
+        # stale replica orders are version-fenced
+        from horaedb_tpu.cluster import ShardError
+
+        with pytest.raises(ShardError, match="stale replica order"):
+            cl.apply_replica_order(_order(2), granted_at=time.monotonic())
+
+        # lease lapse fences reads (typed, retryable)
+        cl._replica_deadline[0] = time.monotonic() - 1
+        with pytest.raises(ReplicaFencedError, match="lease"):
+            cl.replica_read_state("hot")
+        # a renewed heartbeat order unfences
+        cl.apply_replica_order(_order(4), granted_at=time.monotonic())
+        cl.replica_read_state("hot")
+        leader.close()
+        follower.close()
+
+    def test_promotion_reopens_with_wal_replay(self, tmp_path):
+        d = str(tmp_path / "store")
+        leader = horaedb_tpu.connect(d)
+        leader.execute(DDL)
+        leader.execute("INSERT INTO hot (host, v, ts) VALUES ('h1', 1.0, 1000)")
+        leader.catalog.open("hot").flush()
+        # unflushed rows: durable ONLY in the shared WAL
+        leader.execute("INSERT INTO hot (host, v, ts) VALUES ('h2', 2.0, 2000)")
+
+        follower, cl = _mk_follower(d)
+        cl.apply_replica_order(_order(3), granted_at=time.monotonic())
+        # follower serves the durable snapshot only
+        assert (
+            follower.execute("SELECT count(1) AS c FROM hot").to_pylist()[0]["c"]
+            == 1
+        )
+        # promotion: a LEADER order for the same shard releases the
+        # read-only handle and reopens through the normal path — the old
+        # leader's unflushed rows come back via WAL replay
+        cl.apply_shard_order(
+            {**_order(4), "role": "leader"}, granted_at=time.monotonic()
+        )
+        assert cl.owns_table("hot") and not cl.serves_replica("hot")
+        rows = follower.execute("SELECT v FROM hot ORDER BY ts").to_pylist()
+        assert [r["v"] for r in rows] == [1.0, 2.0]
+        follower.execute("INSERT INTO hot (host, v, ts) VALUES ('h3', 3.0, 3000)")
+        leader.close()
+        follower.close()
+
+    def test_leader_role_wins_replica_order_race(self, tmp_path):
+        d = str(tmp_path / "store")
+        leader = horaedb_tpu.connect(d)
+        leader.execute(DDL)
+        follower, cl = _mk_follower(d)
+        cl.apply_shard_order(
+            {**_order(4), "role": "leader"}, granted_at=time.monotonic()
+        )
+        # a stale replica order racing the promotion is ignored
+        cl.apply_replica_order(_order(5), granted_at=time.monotonic())
+        assert cl.owns_table("hot") and not cl.serves_replica("hot")
+        leader.close()
+        follower.close()
+
+
+class TestReplicaScheduler:
+    def _meta(self, read_replicas=2, nodes=("a:1", "b:1", "c:1"), shards=4):
+        from horaedb_tpu.meta.kv import MemoryKV
+        from horaedb_tpu.meta.service import MetaServer
+
+        ms = MetaServer(
+            MemoryKV(), num_shards=shards, read_replicas=read_replicas
+        )
+        for ep in nodes:
+            ms.topology.register_node(ep)
+        for sid in range(shards):
+            ms.topology.assign_shard(sid, nodes[0])
+        return ms
+
+    def test_assigns_non_leader_replicas_idempotently(self):
+        ms = self._meta()
+        changes = ms.replica_scheduler.schedule()
+        assert {c.shard_id for c in changes} == {0, 1, 2, 3}
+        for c in changes:
+            assert "a:1" not in c.replicas and len(c.replicas) == 2
+            ms.topology.set_replicas(c.shard_id, c.replicas)
+        assert ms.replica_scheduler.schedule() == []  # converged
+
+    def test_offline_replica_healed_and_leader_never_replica(self):
+        ms = self._meta(read_replicas=1)
+        for c in ms.replica_scheduler.schedule():
+            ms.topology.set_replicas(c.shard_id, c.replicas)
+        victim = ms.topology.shard(0).replicas[0]
+        ms.topology.mark_offline(victim)
+        changes = ms.replica_scheduler.schedule()
+        healed = {c.shard_id: c.replicas for c in changes}
+        for sid, reps in healed.items():
+            assert victim not in reps
+            assert ms.topology.shard(sid).node not in reps
+
+    def test_promotion_drops_replica_and_heartbeat_carries_orders(self):
+        ms = self._meta(read_replicas=1)
+        for c in ms.replica_scheduler.schedule():
+            ms.topology.set_replicas(c.shard_id, c.replicas)
+        rep = ms.topology.shard(0).replicas[0]
+        # promote the replica to leader: it must leave the replica set
+        ms.topology.assign_shard(0, rep)
+        assert rep not in ms.topology.shard(0).replicas
+        # heartbeat replies carry follower orders with role=replica
+        other = ms.topology.shard(1).replicas[0]
+        out = ms.handle_heartbeat(other)
+        roles = {
+            o["shard_id"]: o["role"] for o in out["desired_replicas"]
+        }
+        assert roles and all(r == "replica" for r in roles.values())
+
+    def test_replicas_cap_at_cluster_size(self):
+        ms = self._meta(read_replicas=5, nodes=("a:1", "b:1"), shards=2)
+        changes = ms.replica_scheduler.schedule()
+        for c in changes:
+            assert c.replicas == ("b:1",)  # only one non-leader exists
+
+
+class _FakeRouter(Router):
+    """Static routing for the in-process topology: ``hot`` lives on the
+    leader with a known replica set; everything else is local."""
+
+    def __init__(self, self_ep, leader_ep, replicas, epoch=3):
+        self.self_endpoint = self_ep
+        self.leader_ep = leader_ep
+        self.replicas = tuple(replicas)
+        self.epoch = epoch
+
+    def route(self, table: str) -> Route:
+        if table == "hot":
+            return Route(
+                table, self.leader_ep, self.leader_ep == self.self_endpoint,
+                source="meta", replicas=self.replicas, epoch=self.epoch,
+            )
+        return Route(table, self.self_endpoint, True, source="static")
+
+    def pick_replica(self, route, exclude: str = ""):
+        cands = [r for r in route.replicas if r != exclude]
+        return cands[0] if cands else None
+
+
+class TestFollowerGateway:
+    """Leader + follower + edge apps in one process over a shared store;
+    real HTTP between them (aiohttp test servers on real ports)."""
+
+    @pytest.fixture()
+    def stack(self, tmp_path):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        d = str(tmp_path / "store")
+        now_ms = int(time.time() * 1000)
+        leader = horaedb_tpu.connect(d)
+        leader.execute(DDL)
+        rows = ", ".join(
+            f"('h{i % 4}', {float(i)}, {now_ms - 60_000 + i})"
+            for i in range(64)
+        )
+        leader.execute(f"INSERT INTO hot (host, v, ts) VALUES {rows}")
+        leader.catalog.open("hot").flush()
+        wm = now_ms - 60_000 + 63 + 1  # last installed flush
+
+        follower_conn, fcl = _mk_follower(d)
+        edge_conn = Connection(LocalDiskStore(d))
+        ecl = ClusterImpl(edge_conn, "127.0.0.1:3", MetaClient(META_STUB))
+
+        state = {"now_ms": now_ms, "wm": wm}
+
+        async def build():
+            leader_app = create_app(leader)
+            leader_client = TestClient(TestServer(leader_app))
+            await leader_client.start_server()
+            leader_ep = f"127.0.0.1:{leader_client.server.port}"
+
+            follower_app = create_app(
+                follower_conn,
+                router=_FakeRouter("127.0.0.1:2", leader_ep, ()),
+                cluster=fcl,
+                node="follower",
+            )
+            follower_client = TestClient(TestServer(follower_app))
+            await follower_client.start_server()
+            follower_ep = f"127.0.0.1:{follower_client.server.port}"
+
+            edge_app = create_app(
+                edge_conn,
+                router=_FakeRouter("127.0.0.1:3", leader_ep, (follower_ep,)),
+                cluster=ecl,
+                node="edge",
+            )
+            edge_client = TestClient(TestServer(edge_app))
+            await edge_client.start_server()
+            fcl.apply_replica_order(_order(3), granted_at=time.monotonic())
+            return leader_client, follower_client, edge_client
+
+        state["build"] = build
+        state["conns"] = (leader, follower_conn, edge_conn)
+        state["fcl"] = fcl
+        yield state
+        for c in state["conns"]:
+            c.close()
+
+    def _run(self, state, body):
+        async def runner():
+            clients = await state["build"]()
+            try:
+                await body(*clients)
+            finally:
+                for c in clients:
+                    await c.close()
+
+        asyncio.run(runner())
+
+    def test_follower_serves_historical_reads_with_route_and_lag(self, stack):
+        wm = stack["wm"]
+        q = f"SELECT host, sum(v) AS s FROM hot WHERE ts <= {wm - 1} GROUP BY host ORDER BY host"
+
+        async def body(leader_c, follower_c, edge_c):
+            lead = await (await leader_c.post("/sql", json={"query": q})).json()
+            resp = await follower_c.post("/sql", json={"query": q})
+            assert resp.status == 200
+            assert resp.headers.get("X-HoraeDB-Replica-Epoch") == "3"
+            assert "X-HoraeDB-Replica-Lag-Ms" in resp.headers
+            got = await resp.json()
+            assert got["rows"] == lead["rows"]  # leader/follower agreement
+            stats = await (await follower_c.post(
+                "/sql",
+                json={"query": "SELECT sql, route, replica_lag_ms FROM "
+                      "system.public.query_stats"},
+            )).json()
+            mine = [r for r in stats["rows"] if r["sql"].startswith(q[:80])]
+            assert mine and mine[-1]["route"] == "follower"
+            assert mine[-1]["replica_lag_ms"] >= 0
+
+        self._run(stack, body)
+
+    def test_route_follower_on_mysql_and_pg_wires(self, stack):
+        from horaedb_tpu.server.mysql import MysqlServer
+        from horaedb_tpu.server.postgres import PostgresServer
+        from test_wire_protocols import MyClient, PgClient
+
+        wm = stack["wm"]
+        q_my = f"SELECT count(v) AS c FROM hot WHERE ts <= {wm - 1}"
+        q_pg = f"SELECT avg(v) AS a FROM hot WHERE ts <= {wm - 1}"
+        stats_sql = (
+            "SELECT sql, route, replica_lag_ms FROM system.public.query_stats"
+        )
+
+        def my_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = MyClient(s)
+            c.handshake()
+            assert c.query(q_my)[0] == "rows"
+            kind, names, rows = c.query(stats_sql)
+            s.close()
+            dicts = [dict(zip(names, r)) for r in rows]
+            mine = [r for r in dicts if r["sql"] == q_my]
+            assert mine and mine[-1]["route"] == "follower", dicts
+
+        def pg_client(port):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c = PgClient(s)
+            c.startup()
+            _, _, _, err = c.query(q_pg)
+            assert err is None, err
+            names, rows, _, err = c.query(stats_sql)
+            s.close()
+            assert err is None, err
+            dicts = [dict(zip(names, r)) for r in rows]
+            mine = [r for r in dicts if r["sql"] == q_pg]
+            assert mine and mine[-1]["route"] == "follower", dicts
+
+        async def body(leader_c, follower_c, edge_c):
+            gw = follower_c.server.app["sql_gateway"]
+            my = MysqlServer(gw, port=0)
+            pg = PostgresServer(gw, port=0)
+            await my.start()
+            await pg.start()
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(None, my_client, my.port)
+                await loop.run_in_executor(None, pg_client, pg.port)
+            finally:
+                await my.stop()
+                await pg.stop()
+
+        self._run(stack, body)
+
+    def test_fresh_open_tail_falls_back_to_leader(self, stack):
+        async def body(leader_c, follower_c, edge_c):
+            # a fresh row the leader has NOT flushed: only the leader
+            # (memtable) can serve it
+            now_ms = int(time.time() * 1000)
+            await leader_c.post("/sql", json={
+                "query": "INSERT INTO hot (host, v, ts) VALUES "
+                f"('fresh', 777.0, {now_ms})"
+            })
+            q = "SELECT count(v) AS c FROM hot WHERE v = 777"
+            resp = await follower_c.post("/sql", json={"query": q})
+            assert resp.status == 200
+            got = await resp.json()
+            # open tail -> leader served it, fresh row included
+            assert got["rows"][0]["c"] == 1
+            assert "X-HoraeDB-Replica-Epoch" not in resp.headers
+            stats = await (await follower_c.post(
+                "/sql",
+                json={"query": "SELECT sql, route FROM "
+                      "system.public.query_stats"},
+            )).json()
+            mine = [r for r in stats["rows"] if r["sql"] == q]
+            assert not [r for r in mine if r["route"] == "follower"]
+
+        self._run(stack, body)
+
+    def test_staleness_opt_in_serves_lagging_follower(self, stack):
+        q = "SELECT count(v) AS c FROM hot"
+
+        async def body(leader_c, follower_c, edge_c):
+            # open tail + generous staleness bound: the follower serves
+            # its (bounded-stale) snapshot — 64 flushed rows
+            resp = await follower_c.post(
+                "/sql", json={"query": q},
+                headers={"X-HoraeDB-Read-Staleness": "10m"},
+            )
+            assert resp.status == 200
+            assert (await resp.json())["rows"][0]["c"] == 64
+            assert resp.headers.get("X-HoraeDB-Replica-Epoch") == "3"
+            # a tiny bound a lagging follower cannot satisfy -> leader
+            resp = await follower_c.post(
+                "/sql", json={"query": q},
+                headers={"X-HoraeDB-Read-Staleness": "1ms"},
+            )
+            assert resp.status == 200
+            assert "X-HoraeDB-Replica-Epoch" not in resp.headers
+
+        self._run(stack, body)
+
+    def test_forwarded_replica_read_refusals_are_typed(self, stack):
+        wm = stack["wm"]
+
+        async def body(leader_c, follower_c, edge_c):
+            # epoch past the follower's view -> typed fenced refusal
+            resp = await follower_c.post(
+                "/sql",
+                json={"query": f"SELECT count(v) AS c FROM hot WHERE ts <= {wm - 1}"},
+                headers={
+                    "X-HoraeDB-Forwarded": "1",
+                    "X-HoraeDB-Replica-Read": "1",
+                    "X-HoraeDB-Replica-Epoch": "99",
+                },
+            )
+            assert resp.status == 503
+            body_ = await resp.json()
+            assert body_["replica"] == "replica_fenced"
+            assert "Retry-After" in resp.headers
+            # stale range -> typed stale refusal
+            resp = await follower_c.post(
+                "/sql",
+                json={"query": "SELECT count(v) AS c FROM hot"},
+                headers={
+                    "X-HoraeDB-Forwarded": "1",
+                    "X-HoraeDB-Replica-Read": "1",
+                },
+            )
+            assert resp.status == 503
+            assert (await resp.json())["replica"] == "replica_stale"
+
+        self._run(stack, body)
+
+    def test_edge_offloads_to_replica_and_falls_back(self, stack):
+        wm = stack["wm"]
+
+        async def body(leader_c, follower_c, edge_c):
+            # historical read from the EDGE node (neither leader nor
+            # replica): offloaded to the follower, served there
+            q = f"SELECT host, count(v) AS c FROM hot WHERE ts <= {wm - 1} GROUP BY host ORDER BY host"
+            lead = await (await leader_c.post("/sql", json={"query": q})).json()
+            resp = await edge_c.post("/sql", json={"query": q})
+            assert resp.status == 200
+            assert (await resp.json())["rows"] == lead["rows"]
+            # the FOLLOWER recorded the serve
+            fstats = await (await follower_c.post(
+                "/sql",
+                json={"query": "SELECT sql, route FROM system.public.query_stats"},
+            )).json()
+            assert [
+                r for r in fstats["rows"]
+                if r["sql"] == q and r["route"] == "follower"
+            ]
+            # fresh open-tail from the edge: follower refuses typed, the
+            # edge falls back to the leader transparently
+            now_ms = int(time.time() * 1000)
+            await leader_c.post("/sql", json={
+                "query": "INSERT INTO hot (host, v, ts) VALUES "
+                f"('edgefresh', 888.0, {now_ms})"
+            })
+            q2 = "SELECT count(v) AS c FROM hot WHERE v = 888"
+            resp = await edge_c.post("/sql", json={"query": q2})
+            assert resp.status == 200
+            assert (await resp.json())["rows"][0]["c"] == 1
+
+        self._run(stack, body)
+
+    def test_lease_lapse_falls_back_and_kill_switch_pins_leader(
+        self, stack, monkeypatch
+    ):
+        wm = stack["wm"]
+        q = f"SELECT count(v) AS c FROM hot WHERE ts <= {wm - 1}"
+
+        async def body(leader_c, follower_c, edge_c):
+            # lapse the follower's replica lease: local serving refuses
+            # (fenced) and the statement falls back to the leader
+            stack["fcl"]._replica_deadline[0] = time.monotonic() - 1
+            resp = await follower_c.post("/sql", json={"query": q})
+            assert resp.status == 200
+            assert (await resp.json())["rows"][0]["c"] == 64
+            assert "X-HoraeDB-Replica-Epoch" not in resp.headers
+            # renewed lease serves locally again
+            stack["fcl"]._replica_deadline[0] = time.monotonic() + 30
+            resp = await follower_c.post("/sql", json={"query": q})
+            assert resp.headers.get("X-HoraeDB-Replica-Epoch") == "3"
+            # kill switch pins the leader even for eligible reads
+            monkeypatch.setenv("HORAEDB_FOLLOWER_READS", "0")
+            try:
+                resp = await follower_c.post("/sql", json={"query": q})
+                assert resp.status == 200
+                assert "X-HoraeDB-Replica-Epoch" not in resp.headers
+            finally:
+                monkeypatch.delenv("HORAEDB_FOLLOWER_READS")
+
+        self._run(stack, body)
+
+    def test_explain_shows_replica_line(self, stack):
+        wm = stack["wm"]
+
+        async def body(leader_c, follower_c, edge_c):
+            resp = await follower_c.post("/sql", json={
+                "query": f"EXPLAIN SELECT count(v) AS c FROM hot WHERE ts <= {wm - 1}"
+            })
+            assert resp.status == 200
+            lines = [r["plan"] for r in (await resp.json())["rows"]]
+            rep = [l for l in lines if l.strip().startswith("Replica:")]
+            assert rep and "route=follower" in rep[0] and "epoch=3" in rep[0]
+
+        self._run(stack, body)
+
+
+class TestLeaderKillE2E:
+    """Real processes: 1 meta (--read-replicas 1) + 2 data nodes over a
+    shared store. A hot table replicates to the follower; a read storm
+    runs against the FOLLOWER while the leader is killed mid-storm. The
+    follower (a) keeps serving watermark-covered reads, (b) refuses a
+    past-fence read (epoch beyond its view) with the typed retryable
+    error — never a wrong answer — and (c) after the coordinator
+    promotes it, serves fresh reads INCLUDING the dead leader's
+    unflushed WAL rows (traffic re-converges on the new leader)."""
+
+    def test_leader_kill_fences_then_reconverges(self, tmp_path):
+        from test_cluster_meta import (
+            CPU_ENV, free_port, http, sql, wait_until,
+        )
+        import subprocess
+        import sys
+        import threading
+
+        meta_port = free_port()
+        node_ports = [free_port(), free_port()]
+        data_dir = str(tmp_path / "shared-store")
+        procs: dict[str, subprocess.Popen] = {}
+        try:
+            procs["meta"] = subprocess.Popen(
+                [
+                    sys.executable, "-m", "horaedb_tpu.meta",
+                    "--port", str(meta_port),
+                    "--data-dir", str(tmp_path / "meta"),
+                    "--num-shards", "2",
+                    "--read-replicas", "1",
+                    "--lease-ttl", "1.5",
+                    "--heartbeat-timeout", "2.0",
+                    "--tick-interval", "0.25",
+                ],
+                env=CPU_ENV,
+                stdout=open(tmp_path / "meta.log", "wb"),
+                stderr=subprocess.STDOUT,
+            )
+            for idx, port in enumerate(node_ports):
+                cfg = tmp_path / f"node{idx}.toml"
+                cfg.write_text(
+                    f"""
+[server]
+host = "127.0.0.1"
+http_port = {port}
+
+[engine]
+data_dir = "{data_dir}"
+
+[cluster]
+self_endpoint = "127.0.0.1:{port}"
+meta_endpoints = ["127.0.0.1:{meta_port}"]
+"""
+                )
+                procs[f"node{idx}"] = subprocess.Popen(
+                    [sys.executable, "-m", "horaedb_tpu.server",
+                     "--config", str(cfg)],
+                    env=CPU_ENV,
+                    stdout=open(tmp_path / f"node{idx}.log", "wb"),
+                    stderr=subprocess.STDOUT,
+                )
+
+            def healthy(port):
+                s, _ = http("GET", f"http://127.0.0.1:{port}/health", timeout=2)
+                return s == 200
+
+            wait_until(lambda: healthy(meta_port), desc="meta health")
+            for p in node_ports:
+                wait_until(lambda p=p: healthy(p), desc=f"node {p} health")
+
+            # DDL races the static scheduler's first assignment pass
+            # under load — create only once every shard has an owner
+            def shards_assigned():
+                s, body = http(
+                    "GET",
+                    f"http://127.0.0.1:{meta_port}/meta/v1/shards",
+                    timeout=2,
+                )
+                return (
+                    s == 200
+                    and body.get("shards")
+                    and all(sh["node"] for sh in body["shards"])
+                )
+
+            wait_until(shards_assigned, desc="shards assigned")
+            status, out = sql(node_ports[0], DDL)
+            assert status == 200, out
+            _, route = http(
+                "GET", f"http://127.0.0.1:{meta_port}/meta/v1/route/hot"
+            )
+            leader_port = int(route["node"].rsplit(":", 1)[1])
+            follower_port = (
+                node_ports[0] if leader_port == node_ports[1] else node_ports[1]
+            )
+            leader_key = (
+                "node0" if leader_port == node_ports[0] else "node1"
+            )
+
+            now_ms = int(time.time() * 1000)
+            rows = ", ".join(
+                f"('h{i % 4}', {float(i)}, {now_ms - 60_000 + i})"
+                for i in range(64)
+            )
+            status, out = sql(
+                leader_port, f"INSERT INTO hot (host, v, ts) VALUES {rows}"
+            )
+            assert status == 200, out
+            status, out = http(
+                "POST",
+                f"http://127.0.0.1:{leader_port}/admin/flush?table=hot",
+            )
+            assert status == 200, out
+            wm = now_ms - 60_000 + 63 + 1
+            hist_q = f"SELECT count(v) AS c FROM hot WHERE ts <= {wm - 1}"
+
+            # the follower picks up its replica order + tails the flush
+            def follower_serves():
+                s, out = http(
+                    "GET",
+                    f"http://127.0.0.1:{follower_port}/debug/shards",
+                    timeout=2,
+                )
+                if s != 200:
+                    return None
+                reps = [
+                    sh for sh in out.get("shards", [])
+                    if sh.get("role") == "replica"
+                    and "hot" in sh.get("tables", [])
+                ]
+                if not reps:
+                    return None
+                if (reps[0].get("watermarks_ms") or {}).get("hot", 0) < wm:
+                    return None
+                return reps[0]
+
+            rep = wait_until(
+                follower_serves, timeout=60, desc="follower replicates hot"
+            )
+            fence_epoch = int(rep["version"])
+
+            # storm against the FOLLOWER: watermark-covered reads; every
+            # response is either correct or typed-retryable — never wrong
+            stop = threading.Event()
+            bad: list = []
+            served = {"n": 0}
+
+            def storm():
+                import urllib.request
+
+                while not stop.is_set():
+                    try:
+                        s, out = sql(follower_port, hist_q)
+                    except Exception:
+                        time.sleep(0.05)
+                        continue
+                    if s == 200:
+                        if out.get("rows") != [{"c": 64}]:
+                            bad.append(out)
+                        served["n"] += 1
+                    elif s not in (503, 502, 429):
+                        bad.append((s, out))
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=storm)
+            t.start()
+
+            # verify route=follower is actually being recorded mid-storm
+            # (bounded wait: under full-suite CPU load the follower's
+            # replica lease can flap, bouncing early reads to the leader)
+            def follower_recorded():
+                s, qs = http(
+                    "GET",
+                    f"http://127.0.0.1:{follower_port}/debug/query_stats",
+                    timeout=5,
+                )
+                if s == 200 and any(
+                    q.get("route") == "follower"
+                    for q in qs.get("queries", [])
+                ):
+                    return True
+                return None
+
+            wait_until(
+                follower_recorded, timeout=30,
+                desc="follower-served reads recorded before the kill",
+            )
+
+            # unflushed rows on the leader (durable only in the WAL),
+            # then KILL it mid-storm
+            status, out = sql(
+                leader_port,
+                "INSERT INTO hot (host, v, ts) VALUES "
+                f"('walrow', 999.0, {now_ms})",
+            )
+            assert status == 200, out
+            procs[leader_key].kill()
+            procs[leader_key].wait(timeout=10)
+
+            # past-fence read: an origin that already observed the
+            # post-kill transfer (epoch beyond the follower's view) must
+            # get the TYPED retryable refusal, never data
+            import urllib.request as _ur
+            import json as _json
+
+            req = _ur.Request(
+                f"http://127.0.0.1:{follower_port}/sql",
+                data=_json.dumps({"query": hist_q}).encode(),
+                headers={
+                    "Content-Type": "application/json",
+                    "X-HoraeDB-Forwarded": "1",
+                    "X-HoraeDB-Replica-Read": "1",
+                    "X-HoraeDB-Replica-Epoch": str(fence_epoch + 10),
+                },
+                method="POST",
+            )
+            try:
+                with _ur.urlopen(req, timeout=10) as resp:
+                    fenced = (resp.status, _json.loads(resp.read().decode()))
+            except _ur.HTTPError as e:
+                fenced = (e.code, _json.loads(e.read().decode() or "{}"))
+            assert fenced[0] == 503, fenced
+            assert fenced[1].get("replica") == "replica_fenced", fenced
+
+            # re-convergence: the coordinator promotes the follower; the
+            # open-tail read now serves FRESH truth including the dead
+            # leader's WAL row
+            def reconverged():
+                s, out = sql(
+                    follower_port,
+                    "SELECT count(v) AS c FROM hot WHERE v = 999",
+                )
+                if s == 200 and out.get("rows") == [{"c": 1}]:
+                    return True
+                return None
+
+            wait_until(reconverged, timeout=60, desc="promotion + WAL replay")
+            stop.set()
+            t.join(timeout=10)
+            assert not bad, f"storm saw wrong/untyped answers: {bad[:3]}"
+            assert served["n"] > 0
+            # writes land on the promoted leader
+            status, out = sql(
+                follower_port,
+                "INSERT INTO hot (host, v, ts) VALUES "
+                f"('afterkill', 5.0, {now_ms + 1})",
+            )
+            assert status == 200, out
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.terminate()
+            for p in procs.values():
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()

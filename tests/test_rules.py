@@ -621,6 +621,72 @@ class TestRecordingRules:
             )
         db.close()
 
+    def test_per_rule_eval_interval_line_form_and_gating(self):
+        """PR-10 satellite: ``NAME := EXPR [for 30s] [every 15s]`` — a
+        rule with ``every`` evaluates once per interval, not once per
+        engine round (effective cadence max(eval_interval, every))."""
+        from horaedb_tpu.rules.model import RuleError, parse_rule_line
+
+        r = parse_rule_line("foo := avg(reqs) every 5m", "recording")
+        assert r.every_s == 300.0
+        r = parse_rule_line("bar := avg(reqs) > 1 for 30s every 10s", "alert")
+        assert r.for_s == 30.0 and r.every_s == 10.0
+        from horaedb_tpu.rules.model import Rule, validate_rule
+
+        with pytest.raises(RuleError, match="negative every"):
+            validate_rule(Rule("neg", "avg(reqs)", every_s=-1))
+
+        db = horaedb_tpu.connect(None)
+        now = int(time.time() * 1000)
+        db.execute(
+            "CREATE TABLE reqs (host string TAG, value double, ts timestamp "
+            "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+        )
+        db.execute(
+            f"INSERT INTO reqs (host, value, ts) VALUES ('a', 5.0, {now - 5000})"
+        )
+        eng = RuleEngine(
+            db,
+            RulesSection(
+                recording=[
+                    "every_round := avg_over_time(reqs[1m])",
+                    "hourly := avg_over_time(reqs[1m]) every 1h",
+                ],
+            ),
+        ).load()
+        assert eng.rules["hourly"].every_s == 3600.0
+        eng.run_once(now_ms=now)
+        eng.run_once(now_ms=now + 15_000)
+        eng.run_once(now_ms=now + 30_000)
+        n_every = len(db.execute("SELECT value FROM every_round").to_pylist())
+        n_hourly = len(db.execute("SELECT value FROM hourly").to_pylist())
+        assert n_every == 3  # every round
+        assert n_hourly == 1  # gated until the hour elapses
+        assert eng._rule_last_eval_ms["hourly"] == now
+        # once the interval elapses it evaluates again (fresh source rows
+        # so the 1m lookback window is non-empty at the new eval time)
+        later = now + 3_600_000 + 15_000
+        db.execute(
+            f"INSERT INTO reqs (host, value, ts) VALUES ('a', 9.0, {later - 5000})"
+        )
+        eng.run_once(now_ms=later)
+        assert eng._rule_last_eval_ms["hourly"] == later
+        assert len(db.execute("SELECT value FROM hourly").to_pylist()) == 2
+        db.close()
+
+    def test_every_field_on_admin_rules_roundtrip(self):
+        db = horaedb_tpu.connect(None)
+        eng = RuleEngine(db, RulesSection()).load()
+        rule = eng.add_rule(
+            {"name": "r_every", "expr": "avg(missing_metric)",
+             "kind": "recording", "every": "2m"}
+        )
+        assert rule.every_s == 120.0
+        assert eng.rules["r_every"].to_dict()["every_s"] == 120.0
+        listed = [r for r in eng.list_rules() if r["name"] == "r_every"]
+        assert listed and listed[0]["every_s"] == 120.0
+        db.close()
+
     def test_runtime_rules_persist_across_restart(self, tmp_path):
         path = str(tmp_path / "rp")
         db = horaedb_tpu.connect(path)
