@@ -74,31 +74,47 @@ class ClusterImpl:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._poke = threading.Event()  # kick_heartbeat() wakes the loop
+        # fault injection (tools/tenantsim lease flaps): while set in the
+        # future, the loop SKIPS renewals — leases lapse, the watch
+        # freezes shards, writes fence; resuming renewal thaws them
+        self._pause_until = 0.0
         self._thread: Optional[threading.Thread] = None
         self._watch_thread: Optional[threading.Thread] = None
         self._tail_thread: Optional[threading.Thread] = None
 
     # ---- lifecycle ------------------------------------------------------
     def start(self) -> None:
-        # Best-effort eager registration; a temporarily unreachable
-        # coordinator must not abort node startup (the loop keeps
-        # retrying — the node serves what it can meanwhile).
-        try:
-            self._heartbeat_once()
-        except MetaError as e:
-            logger.warning("initial heartbeat failed (will retry): %s", e)
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="cluster-heartbeat"
-        )
-        self._thread.start()
-        self._watch_thread = threading.Thread(
-            target=self._lease_watch_loop, daemon=True, name="lease-watch"
-        )
-        self._watch_thread.start()
-        self._tail_thread = threading.Thread(
-            target=self._manifest_tail_loop, daemon=True, name="replica-tail"
-        )
-        self._tail_thread.start()
+        # Restart-safe: a stop()ed impl can start fresh threads (the
+        # simulator kills and never restarts, but tests flap). Each of
+        # the THREE loops is checked independently — a stop() whose 5s
+        # join timed out can leave the heartbeat thread alive while the
+        # watch/tail loops (which saw _stop) already exited; an
+        # early-return on the heartbeat check alone would then renew
+        # leases forever without lease-lapse fencing or manifest tailing.
+        self._stop.clear()
+        if self._thread is None or not self._thread.is_alive():
+            # Best-effort eager registration; a temporarily unreachable
+            # coordinator must not abort node startup (the loop keeps
+            # retrying — the node serves what it can meanwhile).
+            try:
+                self._heartbeat_once()
+            except MetaError as e:
+                logger.warning("initial heartbeat failed (will retry): %s", e)
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="cluster-heartbeat"
+            )
+            self._thread.start()
+        if self._watch_thread is None or not self._watch_thread.is_alive():
+            self._watch_thread = threading.Thread(
+                target=self._lease_watch_loop, daemon=True, name="lease-watch"
+            )
+            self._watch_thread.start()
+        if self._tail_thread is None or not self._tail_thread.is_alive():
+            self._tail_thread = threading.Thread(
+                target=self._manifest_tail_loop, daemon=True,
+                name="replica-tail",
+            )
+            self._tail_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
@@ -116,12 +132,24 @@ class ClusterImpl:
         milliseconds instead of one renewal interval later."""
         self._poke.set()
 
+    def pause_heartbeats(self, seconds: float) -> None:
+        """Fault injection (the tenant simulator's replica-lease flaps):
+        suppress heartbeat renewal for ``seconds``. Leases lapse, the
+        lease watch freezes owned shards (writes fence with the typed
+        retryable error), replica reads refuse on their lapsed lease —
+        and everything thaws when renewal resumes. The node itself keeps
+        serving; only the *renewal* stops, exactly like a network
+        partition between node and coordinator."""
+        self._pause_until = time.monotonic() + max(0.0, float(seconds))
+
     def _loop(self) -> None:
         while True:
             if self._poke.wait(self._interval()):
                 self._poke.clear()
             if self._stop.is_set():
                 return
+            if time.monotonic() < self._pause_until:
+                continue
             try:
                 self._heartbeat_once()
             except MetaError as e:

@@ -142,6 +142,24 @@ def snapshot_samples(now_ms: int, node: str, registry=REGISTRY) -> list[dict]:
     return rows
 
 
+def ensure_meta_table(cluster, router, name: str, create_sql: str,
+                      ensured: set) -> None:
+    """Coordinator-serialized CREATE (idempotent — the coordinator
+    answers ``existed`` for known tables), once per ``ensured`` memo
+    lifetime; invalidates the route cache so the first forward after
+    creation sees the fresh owner instead of a cached meta-unknown
+    self-route. Shared by the self-monitoring recorder and the rules
+    engine — the meta-serialized-id + cache-invalidate protocol must
+    not fork (the reason local catalog creation is refused in
+    coordinator mode is exactly that two copies of this drift)."""
+    if name in ensured:
+        return
+    cluster.meta.create_table(name, create_sql)
+    if router is not None:
+        router.invalidate(name)
+    ensured.add(name)
+
+
 def forward_rows(endpoint: str, table: str, rows: list[dict]) -> None:
     """Cluster mode, non-owner: ship one round of rows to the owning
     node's ordinary ``/write`` endpoint. ``nonblocking=1`` makes the
@@ -216,12 +234,21 @@ class MetricsRecorder:
         retention_s: float = 24 * 3600.0,
         node: str = "standalone",
         router=None,
+        cluster=None,
     ) -> None:
+        """``cluster`` (coordinator mode): the samples table is created
+        through the COORDINATOR (``cluster.meta.create_table`` —
+        meta-serialized id allocation in the shared store; the reason
+        self-monitoring was disabled in this mode before), ownership asks
+        the live shard set, and non-owner rounds forward to the
+        meta-assigned owner like the static-cluster path always did."""
         self.conn = conn
         self.interval_s = max(0.05, float(interval_s))
         self.retention_s = float(retention_s)
         self.node = node
         self.router = router
+        self.cluster = cluster
+        self._meta_ensured: set[str] = set()
         self.started_at: Optional[float] = None
         self.rounds = 0
         self.rows_written = 0
@@ -331,6 +358,8 @@ class MetricsRecorder:
         t0 = time.perf_counter()
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         rows = snapshot_samples(now_ms, self.node)
+        if self.cluster is not None:
+            self._ensure_meta_table()
         if self._is_local():
             table = self._ensure_table()
             rg = rows_to_rowgroup(table.schema, rows)
@@ -348,15 +377,47 @@ class MetricsRecorder:
         return len(rows)
 
     def _is_local(self) -> bool:
+        if self.cluster is not None:
+            # the live shard set, NOT the router: the router's
+            # meta-unknown fallback answers is_local=True for a table
+            # that doesn't exist yet, which here would catalog-create it
+            # locally with a colliding id on every node
+            return self.cluster.owns_table(SAMPLES_TABLE)
         if self.router is None:
             return True
         return self.router.route(SAMPLES_TABLE).is_local
+
+    def _samples_create_sql(self) -> str:
+        """The meta-DDL form of samples_schema() + retention options —
+        what coordinator mode sends through cluster.meta.create_table."""
+        opts = "update_mode='append', segment_duration='2h'"
+        if self.retention_s > 0:
+            opts += f", enable_ttl='true', ttl='{max(1, int(self.retention_s))}s'"
+        return (
+            f"CREATE TABLE IF NOT EXISTS `{SAMPLES_TABLE}` ("
+            "name string TAG, labels string TAG, node string TAG, "
+            "value double, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+            f"ENGINE=Analytic WITH ({opts})"
+        )
+
+    def _ensure_meta_table(self) -> None:
+        ensure_meta_table(
+            self.cluster, self.router, SAMPLES_TABLE,
+            self._samples_create_sql(), self._meta_ensured,
+        )
 
     def _ensure_table(self):
         table = self.conn.catalog.open(SAMPLES_TABLE)
         if table is not None:
             self._sync_ttl(table)
             return table
+        if self.cluster is not None:
+            # never catalog-create in coordinator mode (colliding ids —
+            # see _ensure_meta_table); an open miss right after the meta
+            # DDL is a transient shard race: skip this round and retry
+            raise RuntimeError(
+                f"{SAMPLES_TABLE} not open yet (shard assignment in flight)"
+            )
         opts = {"update_mode": "append", "segment_duration": "2h"}
         if self.retention_s > 0:
             opts["ttl"] = f"{max(1, int(self.retention_s))}s"

@@ -27,6 +27,7 @@ from ..query.interpreters import AffectedRows, Output
 from ..query.plan import InsertPlan, QueryPlan
 from ..utils.metrics import REGISTRY
 from ..utils.runtime import PriorityRuntime
+from ..wlm.admission import CLASSES as ADMISSION_CLASSES
 from ..wlm import (
     BlockedError,
     COST_HISTORY,
@@ -48,6 +49,24 @@ __all__ = [
 ]
 
 logger = logging.getLogger("horaedb_tpu.proxy")
+
+# Per-admission-class end-to-end SELECT latency, eagerly registered (one
+# labeled histogram per class so the series — and their samples-table
+# history — exist from the first scrape). This is the SLO plane's
+# canonical indicator: "cheap-class p99 stays flat during an
+# expensive-scan storm" is only measurable when latency is bucketed by
+# the class admission chose. Declared + linted like the other family
+# registries (tests/test_observability.TestSloRegistryLint).
+QUERY_CLASS_METRIC_FAMILIES = ("horaedb_query_class_duration_seconds",)
+
+_M_CLASS_LATENCY = {
+    c: REGISTRY.histogram(
+        "horaedb_query_class_duration_seconds",
+        "end-to-end SELECT latency by admission class (queue wait included)",
+        labels={"class": c},
+    )
+    for c in ADMISSION_CLASSES
+}
 
 
 @dataclass
@@ -163,6 +182,7 @@ class Proxy:
         self._m_latency = REGISTRY.histogram(
             "horaedb_query_duration_seconds", "SQL statement latency"
         )
+        self._m_class_latency = _M_CLASS_LATENCY
 
     def close(self) -> None:
         self.runtime.shutdown()
@@ -187,6 +207,7 @@ class Proxy:
         ledger, ltoken = start_ledger(ctx.request_id, sql)
         shape = None  # set for executed SELECTs; feeds the EWMA history
         exec_elapsed: list = [None]  # leader execution seconds (EWMA input)
+        admission_class = None  # set for executed SELECTs (class latency)
         ok = False
         try:
             # The plan cache is what makes repeated dashboard text cheap
@@ -255,6 +276,12 @@ class Proxy:
         finally:
             elapsed = time.perf_counter() - ctx.start
             self._m_latency.observe(elapsed)
+            if ok and admission_class is not None:
+                # end-to-end latency AS THE TENANT SEES IT (queue wait
+                # included), bucketed by admission class — the SLO
+                # plane's "cheap p99 stays flat under an expensive
+                # storm" indicator reads this family's history
+                self._m_class_latency[admission_class].observe(elapsed)
             # Follower-served statement (gateway replica path): the route
             # truth is "follower" whatever executor path ran underneath,
             # and the watermark lag rides the ledger so query_stats

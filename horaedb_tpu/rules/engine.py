@@ -191,13 +191,25 @@ class RuleEngine:
         node: str = "standalone",
         router=None,
         state_path: Optional[str] = None,
+        cluster=None,
+        slo=None,
     ) -> None:
+        """``cluster`` (coordinator mode): output-table DDL is serialized
+        through the coordinator (``cluster.meta.create_table``) instead
+        of the local catalog — local creation would mint colliding table
+        ids in the shared store — and ownership questions ask the live
+        shard set, not the router's meta-unknown fallback.
+        ``slo``: an slo.SloEvaluator ticked at the end of every round —
+        the SLO plane rides THIS cadence by design (no second loop to
+        drift against the rules/alerts it judges)."""
         from ..utils.config import RulesSection
 
         self.conn = conn
         self.section = section if section is not None else RulesSection()
         self.node = node
         self.router = router
+        self.cluster = cluster
+        self.slo = slo
         if state_path is None:
             root = getattr(conn.store, "root", None)
             if root:
@@ -481,6 +493,16 @@ class RuleEngine:
                 except Exception as e:
                     self._note_rule_error(rule.name, rule.kind, e)
         finally:
+            if self.slo is not None and self._owns_samples():
+                # the SLO plane rides this cadence ON THE NODE OWNING the
+                # samples history its indicators read (eval-on-owner, the
+                # same discipline rules use — a non-owner's local view of
+                # system_metrics.samples is flushed-only, stale by up to
+                # the flush lag). evaluate_round only READS and isolates
+                # its own per-objective errors, so it runs even on rounds
+                # a rule write shed — the verdict must not pause because
+                # ingest stalled (that stall is exactly what it judges)
+                self.slo.evaluate_round(now_ms)
             finish_trace(handle)
             self.rounds += 1
             self.last_eval_ms = now_ms
@@ -524,7 +546,18 @@ class RuleEngine:
 
     # ---- ownership (eval-on-owner) --------------------------------------
 
+    def _owns_samples(self) -> bool:
+        from ..engine.metrics_recorder import SAMPLES_TABLE
+
+        return self._owns(SAMPLES_TABLE)
+
     def _owns(self, table: str) -> bool:
+        if self.cluster is not None:
+            # ask the live shard set, not the router: the router answers
+            # is_local=True for meta-UNKNOWN tables (standalone fallback),
+            # which here would make every node think it owns a
+            # not-yet-created output table
+            return self.cluster.owns_table(table)
         if self.router is None:
             return True
         return self.router.route(table).is_local
@@ -572,6 +605,22 @@ class RuleEngine:
         return m
 
     def _ensure_rollup_table(self, name: str, schema, options) -> None:
+        if self.cluster is not None:
+            # coordinator mode: the COORDINATOR places the table and
+            # allocates its id (local creation would mint colliding
+            # sequential ids in the shared store — the reason rules were
+            # disabled in this mode before the SLO plane needed them)
+            self._ensure_meta_table(name, _create_sql_for(name, schema, options))
+            if self._owns(name):
+                table = self.conn.catalog.open(name)
+                if table is not None:
+                    from .rollup import _sync_ttl
+
+                    _sync_ttl(
+                        table,
+                        (options.ttl_ms / 1000.0) if options.enable_ttl else 0.0,
+                    )
+            return
         if self._owns(name):
             table = self.conn.catalog.open(name)
             if table is None:
@@ -625,9 +674,23 @@ class RuleEngine:
             )
         if not rows:
             return
+        create_sql = _recording_create_sql(
+            rule.name, self.section.recording_ttl_s
+        )
+        if self.cluster is not None:
+            self._ensure_meta_table(rule.name, create_sql)
         if self._owns(rule.name):
             table = self.conn.catalog.open(rule.name)
             if table is None:
+                if self.cluster is not None:
+                    # never catalog-create here: coordinator-allocated
+                    # tables must come from the meta DDL above (a local
+                    # create would mint a colliding id); an open miss is
+                    # a transient shard race — isolate and retry next round
+                    raise RuntimeError(
+                        f"recording table {rule.name!r} not open yet "
+                        "(shard assignment in flight)"
+                    )
                 opts = {"update_mode": "append", "segment_duration": "2h"}
                 if self.section.recording_ttl_s > 0:
                     opts["ttl"] = f"{max(1, int(self.section.recording_ttl_s))}s"
@@ -641,15 +704,20 @@ class RuleEngine:
             with nonblocking_backpressure():
                 table.write(rg)
         else:
-            self._forward_sql(
-                rule.name,
-                _recording_create_sql(rule.name, self.section.recording_ttl_s),
-            )
+            if self.cluster is None:
+                self._forward_sql(rule.name, create_sql)
             forward_rows(
                 self.router.route(rule.name).endpoint, rule.name, rows
             )
         self.rows_written += len(rows)
         _M_ROWS.inc(len(rows))
+
+    def _ensure_meta_table(self, name: str, sql: str) -> None:
+        from ..engine.metrics_recorder import ensure_meta_table
+
+        ensure_meta_table(
+            self.cluster, self.router, name, sql, self._remote_ensured
+        )
 
     def _forward_sql(self, table: str, sql: str) -> None:
         """Idempotent DDL on the owning node over its /sql endpoint,

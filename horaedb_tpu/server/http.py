@@ -662,7 +662,7 @@ async def _auth_middleware(request: web.Request, handler):
 def create_app(
     conn: Connection, router=None, cluster=None, auth_token: str = "",
     limits=None, observability=None, node: str = "standalone",
-    rules_cfg=None, read_staleness_s: float = 0.0,
+    rules_cfg=None, slo_cfg=None, read_staleness_s: float = 0.0,
 ) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
     adds the /meta_event endpoints, meta-driven DDL, and write fencing.
@@ -676,7 +676,13 @@ def create_app(
     ``rules_cfg``: a config RulesSection; when enabled the node runs the
     continuous-query engine (rules/) — recording rules, tiered rollups
     with transparent query rewriting, and the alert evaluator — with
-    /admin/rules and /debug/alerts as its control surface."""
+    /admin/rules and /debug/alerts as its control surface.
+    ``slo_cfg``: a config SloSection; objectives make the node grade its
+    own service levels (slo/) — the evaluator rides the rules engine's
+    cadence and serves verdicts at /debug/slo and system.public.slo.
+    In coordinator mode the recorder and rules engine now run too:
+    their output tables are created through the coordinator's
+    meta-serialized DDL instead of the local catalog."""
     import time as _time
 
     proxy = Proxy(conn, limits=limits)
@@ -693,28 +699,29 @@ def create_app(
     app["started_at"] = _time.time()
     app.on_cleanup.append(_close_client_session)
 
+    if observability is not None:
+        # Bounded event-journal capacity ([observability] event_ring):
+        # applied to the process-global ring; drops are accounted in
+        # horaedb_events_dropped_total and surfaced in /debug/status.
+        from ..utils.events import EVENT_STORE
+
+        EVENT_STORE.resize(observability.event_ring)
+
     recorder = None
-    if (observability is not None and observability.self_scrape
-            and cluster is not None):
-        # Coordinator mode: every node's fallback route for an unknown
-        # table is "local", so each recorder would create the samples
-        # table in the SHARED store and the sequential table-id counters
-        # would collide (catalog's documented standalone limitation).
-        # Guarded HERE, at construction, so every create_app caller
-        # (tests, embedders) inherits it — not only run_server.
-        logger.info(
-            "self-monitoring recorder disabled in coordinator mode "
-            "(table-id allocation is not meta-serialized for it yet)"
-        )
-    elif observability is not None and observability.self_scrape:
+    if observability is not None and observability.self_scrape:
         from ..engine.metrics_recorder import MetricsRecorder
 
+        # Coordinator mode included: the recorder creates the samples
+        # table through the coordinator's meta-serialized DDL (the old
+        # colliding-table-id hazard of local creation) and forwards
+        # non-owner rounds to the meta-assigned owner.
         recorder = MetricsRecorder(
             conn,
             interval_s=observability.self_scrape_interval_s,
             retention_s=observability.self_metrics_retention_s,
             node=node,
             router=router,
+            cluster=cluster,
         )
 
         async def _start_recorder(app_):
@@ -727,20 +734,26 @@ def create_app(
         app.on_cleanup.append(_stop_recorder)
     app["metrics_recorder"] = recorder
 
+    slo_eval = None
+    if slo_cfg is not None and slo_cfg.objectives:
+        from ..slo import SloEvaluator
+
+        slo_eval = SloEvaluator(conn, slo_cfg, node=node)
+        if rules_cfg is None or not rules_cfg.enabled:
+            logger.warning(
+                "[slo] objectives configured but the rules engine is "
+                "disabled — the SLO evaluator rides its cadence and will "
+                "never tick"
+            )
+    app["slo"] = slo_eval
+
     rule_engine = None
-    if rules_cfg is not None and rules_cfg.enabled and cluster is not None:
-        # Same table-id allocation caveat as the recorder: rule output
-        # tables are created through the local catalog, which coordinator
-        # mode does not meta-serialize yet.
-        logger.info(
-            "rules engine disabled in coordinator mode "
-            "(rule-output table allocation is not meta-serialized yet)"
-        )
-    elif rules_cfg is not None and rules_cfg.enabled:
+    if rules_cfg is not None and rules_cfg.enabled:
         from ..rules import RuleEngine
 
         rule_engine = RuleEngine(
-            conn, rules_cfg, node=node, router=router,
+            conn, rules_cfg, node=node, router=router, cluster=cluster,
+            slo=slo_eval,
         )
 
         async def _start_rules(app_):
@@ -1312,6 +1325,12 @@ def create_app(
                 if app["rule_engine"] is not None
                 else None
             ),
+            "slo": (
+                app["slo"].stats() if app["slo"] is not None else None
+            ),
+            # journal bounds: a reader of system.public.events needs the
+            # drop count to tell "ring rolled" from "events lost"
+            "events": _event_store_stats(),
         }
 
     async def health(request: web.Request) -> web.Response:
@@ -1326,11 +1345,41 @@ def create_app(
         body = {"status": "ok" if ready else "not_ready", "ready": ready}
         return web.json_response(body, status=200 if ready else 503)
 
+    def _event_store_stats() -> dict:
+        from ..utils.events import EVENT_STORE
+
+        return EVENT_STORE.stats()
+
     async def debug_status(request: web.Request) -> web.Response:
         out = await asyncio.get_running_loop().run_in_executor(
             None, _node_status
         )
         return web.Response(text=_dumps(out), content_type="application/json")
+
+    async def debug_slo(request: web.Request) -> web.Response:
+        """The SLO plane's verdicts — the JSON face of
+        ``system.public.slo`` (per-objective state, current value, fast/
+        slow burn rates, breach history)."""
+        ev = request.app["slo"]
+        if ev is None:
+            return web.json_response(
+                {"enabled": False, "objectives": [], "breaches": []}
+            )
+
+        def collect():
+            # off the event loop: snapshot() takes the evaluator lock,
+            # which an in-flight evaluation round briefly holds
+            return {
+                "enabled": True,
+                "objectives": ev.snapshot(),
+                "breaches": ev.breach_history(),
+                "stats": ev.stats(),
+            }
+
+        out = await asyncio.get_running_loop().run_in_executor(None, collect)
+        return web.Response(
+            text=_dumps(out), content_type="application/json",
+        )
 
     async def debug_events(request: web.Request) -> web.Response:
         """The engine event journal (utils/events): newest-bounded ring
@@ -1897,6 +1946,7 @@ def create_app(
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_get("/debug/workload", debug_workload)
     app.router.add_get("/debug/alerts", debug_alerts)
+    app.router.add_get("/debug/slo", debug_slo)
     app.router.add_post("/admin/flush", admin_flush)
     app.router.add_post("/admin/block", admin_block)
     app.router.add_delete("/admin/block", admin_block)
@@ -2079,6 +2129,7 @@ def run_server(
         observability=observability,
         node=node,
         rules_cfg=(config.rules if config is not None else None),
+        slo_cfg=(config.slo if config is not None else None),
         read_staleness_s=(
             config.cluster.read_staleness_s if config is not None else 0.0
         ),

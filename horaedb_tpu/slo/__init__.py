@@ -1,0 +1,29 @@
+"""Service-level objectives: the database grades its own service levels
+over its self-monitoring history — see slo/evaluator.py for the
+subsystem overview."""
+
+from .evaluator import (
+    BURN_WINDOWS,
+    SLO_METRIC_FAMILIES,
+    SloEvaluator,
+    registered_evaluators,
+)
+from .model import (
+    SloError,
+    SloObjective,
+    complies,
+    parse_objective_line,
+    validate_objective,
+)
+
+__all__ = [
+    "BURN_WINDOWS",
+    "SLO_METRIC_FAMILIES",
+    "SloError",
+    "SloEvaluator",
+    "SloObjective",
+    "complies",
+    "parse_objective_line",
+    "registered_evaluators",
+    "validate_objective",
+]

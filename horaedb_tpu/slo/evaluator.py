@@ -1,0 +1,383 @@
+"""SLO evaluator — multi-window burn rates over the node's own telemetry
+(ref: the SRE-workbook multi-window multi-burn-rate method; the
+Compiler-First State Space Duality stance (PAPERS.md) of O(1) incremental
+window maintenance instead of recompute-per-query; StreamBox-HBM's
+continuous queries over the system's own stream).
+
+One ``SloEvaluator`` per node rides the rules engine's evaluation
+cadence (rules/engine.RuleEngine ticks it at the end of every round —
+the SLO plane deliberately has no second periodic loop to drift against
+the rules/alerts it judges). Each round, per objective:
+
+1. the indicator (PromQL over ``system_metrics.samples`` /
+   ``query_stats`` history — the PR-5 samples fallback) instant-evaluates
+   to a vector; the WORST series value is compared to the bound;
+2. the round's (duration, violated?) sample is pushed into two sliding
+   windows — fast (default 5m) and slow (default 1h) — maintained
+   INCREMENTALLY: a deque of round samples with running bad/total-time
+   sums, O(1) amortized per round, never a rescan of the history;
+3. burn rate = violation-time fraction / error budget (``1 - target``).
+   An objective starts BURNING when both windows' burn rates reach the
+   threshold (the fast window catches it now, the slow window proves it
+   is sustained — a blip cannot page); it RECOVERS when the fast window
+   comes back under. Transitions journal as typed ``slo_burn`` /
+   ``slo_recovered`` events (trace-linked, counted like every kind).
+
+Verdicts serve as ``system.public.slo`` on all three wire protocols
+(table_engine/system.SloTable) and as JSON at ``/debug/slo``; the
+``horaedb_slo_*`` families are eagerly registered (per-objective labels
+at load) under the standard registry-lint contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+from ..utils.events import record_event
+from ..utils.metrics import REGISTRY
+from .model import SloObjective, complies, parse_objective_line
+
+# Declared registry of the SLO metric families — tests/test_observability
+# TestSloRegistryLint checks each is registered live, convention-clean,
+# and documented, and that no stray horaedb_slo_* family exists.
+SLO_METRIC_FAMILIES = (
+    "horaedb_slo_objectives_total",
+    "horaedb_slo_evaluations_total",
+    "horaedb_slo_eval_failures_total",
+    "horaedb_slo_burning_total",
+    "horaedb_slo_burn_rate_ratio",
+    "horaedb_slo_breaches_total",
+)
+
+BURN_WINDOWS = ("fast", "slow")
+
+# Registered at import so the unlabeled families exist from the first
+# scrape; the per-objective labeled series register at evaluator load.
+_M_OBJECTIVES = REGISTRY.gauge(
+    "horaedb_slo_objectives_total", "SLO objectives currently loaded"
+)
+_M_EVALS = REGISTRY.counter(
+    "horaedb_slo_evaluations_total", "per-objective SLO evaluation rounds"
+)
+_M_FAILURES = REGISTRY.counter(
+    "horaedb_slo_eval_failures_total",
+    "objective evaluations that raised (isolated per round)",
+)
+_M_BURNING = REGISTRY.gauge(
+    "horaedb_slo_burning_total", "objectives currently burning"
+)
+
+# Evaluators register here so system.public.slo and /debug/slo can
+# materialize verdicts without a handle on the server (same discipline
+# as rules/engine._ENGINES).
+_EVALUATORS: "weakref.WeakSet[SloEvaluator]" = weakref.WeakSet()
+
+
+def registered_evaluators() -> list["SloEvaluator"]:
+    return list(_EVALUATORS)
+
+
+class _Window:
+    """One sliding window of per-round (ts, duration, violated) samples
+    with running sums — push is O(1) amortized (each sample enters and
+    leaves the deque exactly once); reading a burn rate is O(1) always.
+    This is the incremental-maintenance core: the alternative (re-folding
+    the samples history per round) rescans O(window / interval) rows for
+    every objective, every round, forever."""
+
+    __slots__ = ("span_ms", "_q", "total_ms", "bad_ms")
+
+    def __init__(self, span_ms: int) -> None:
+        self.span_ms = int(span_ms)
+        self._q: deque = deque()  # (ts_ms, dt_ms, bad_dt_ms)
+        self.total_ms = 0
+        self.bad_ms = 0
+
+    def push(self, ts_ms: int, dt_ms: int, bad: bool) -> None:
+        bad_dt = dt_ms if bad else 0
+        self._q.append((ts_ms, dt_ms, bad_dt))
+        self.total_ms += dt_ms
+        self.bad_ms += bad_dt
+        horizon = ts_ms - self.span_ms
+        while self._q and self._q[0][0] <= horizon:
+            _, dt, bad_dt = self._q.popleft()
+            self.total_ms -= dt
+            self.bad_ms -= bad_dt
+
+    def bad_fraction(self) -> float:
+        return self.bad_ms / self.total_ms if self.total_ms else 0.0
+
+
+class _ObjectiveState:
+    """One objective's live verdict + windows + breach history."""
+
+    def __init__(self, obj: SloObjective, fast_ms: int, slow_ms: int) -> None:
+        self.objective = obj
+        from ..proxy.promql import parse_promql
+
+        self.parsed = parse_promql(obj.expr)
+        self.fast = _Window(fast_ms)
+        self.slow = _Window(slow_ms)
+        self.state = "ok"  # "ok" | "burning"
+        self.value: Optional[float] = None
+        self.compliant: Optional[bool] = None
+        self.since_ms = 0  # current state's entry time
+        self.last_eval_ms = 0
+        self.rounds = 0
+        self.no_data_rounds = 0  # consecutive empty-vector evals
+        self.breach_count = 0
+        self.breaches: deque = deque(maxlen=64)  # breach history for ctl
+        self.last_error = ""
+
+
+class SloEvaluator:
+    """Maintains every objective's verdict; ticked by the rules engine."""
+
+    def __init__(
+        self,
+        conn,
+        section=None,
+        node: str = "standalone",
+    ) -> None:
+        from ..utils.config import SloSection
+
+        self.conn = conn
+        self.section = section if section is not None else SloSection()
+        self.node = node
+        self.burn_threshold = float(self.section.burn_threshold)
+        fast_ms = int(self.section.fast_window_s * 1000)
+        slow_ms = int(self.section.slow_window_s * 1000)
+        self._states: dict[str, _ObjectiveState] = {}
+        self._lock = threading.Lock()
+        self.rounds = 0
+        self.last_eval_ms = 0
+        self._m_burn: dict[tuple[str, str], object] = {}
+        self._m_breaches: dict[str, object] = {}
+        for line in self.section.objectives:
+            obj = parse_objective_line(line)
+            if obj.name in self._states:
+                from .model import SloError
+
+                raise SloError(
+                    f"duplicate objective name {obj.name!r} — a silent "
+                    "overwrite would drop a declared SLO"
+                )
+            self._states[obj.name] = _ObjectiveState(obj, fast_ms, slow_ms)
+            # eager per-objective series: the burn-rate gauge and the
+            # breach counter exist before the first round
+            for window in BURN_WINDOWS:
+                self._m_burn[(obj.name, window)] = REGISTRY.gauge(
+                    "horaedb_slo_burn_rate_ratio",
+                    "error-budget burn rate per objective and window",
+                    labels={"objective": obj.name, "window": window},
+                )
+            self._m_breaches[obj.name] = REGISTRY.counter(
+                "horaedb_slo_breaches_total",
+                "ok -> burning transitions per objective",
+                labels={"objective": obj.name},
+            )
+        _M_OBJECTIVES.set(len(self._states))
+        _EVALUATORS.add(self)
+
+    def __len__(self) -> int:
+        return len(self._states)
+
+    # ---- one round ------------------------------------------------------
+
+    def evaluate_round(self, now_ms: Optional[int] = None) -> None:
+        """Evaluate every objective once; per-objective errors are
+        isolated (a broken indicator must not take down the others).
+        Called by the rules engine at the end of each eval round —
+        backpressure sheds (OverloadedError) cannot arise here: the
+        evaluator only READS."""
+        if not self._states:
+            return
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        # the indicator reads (PromQL over the samples history — the slow
+        # part) run OUTSIDE the lock: snapshot()/stats() are called from
+        # serving paths, and holding the lock across a database read per
+        # objective would stall them for the whole round. Only one rules
+        # loop ticks this evaluator, so unlocked reads don't race each
+        # other; the cheap state mutation takes the lock per objective.
+        for state in list(self._states.values()):
+            try:
+                vals = self._indicator_values(state, now_ms)
+                with self._lock:
+                    self._apply_round(state, vals, now_ms)
+                    state.last_error = ""
+            except Exception as e:
+                with self._lock:
+                    state.last_error = f"{type(e).__name__}: {e}"[:200]
+                _M_FAILURES.inc()
+            _M_EVALS.inc()
+        with self._lock:
+            self.rounds += 1
+            self.last_eval_ms = now_ms
+            _M_BURNING.set(
+                sum(1 for s in self._states.values() if s.state == "burning")
+            )
+
+    def _indicator_values(
+        self, state: _ObjectiveState, now_ms: int
+    ) -> list[float]:
+        from ..proxy.promql import evaluate_expr_instant
+
+        vec = evaluate_expr_instant(self.conn, state.parsed, now_ms)
+        vals = []
+        for s in vec:
+            try:
+                v = float(s["value"][1])
+            except (TypeError, ValueError):
+                continue
+            if v == v:  # drop NaN (e.g. histogram_quantile over no traffic)
+                vals.append(v)
+        return vals
+
+    def _apply_round(
+        self, state: _ObjectiveState, vals: list[float], now_ms: int
+    ) -> None:
+        obj = state.objective
+        if vals:
+            # the WORST series decides the round: for an upper bound the
+            # max violates first, for a lower bound the min
+            worst = max(vals) if obj.op in ("<=", "<") else min(vals)
+            state.value = worst
+            state.compliant = complies(obj.op, worst, obj.bound)
+            state.no_data_rounds = 0
+        else:
+            # no data = no evidence of violation (counted as good time,
+            # surfaced as no_data_rounds — a freshness objective on the
+            # pipeline itself is the guard against a silent dead feed)
+            state.value = None
+            state.compliant = True
+            state.no_data_rounds += 1
+        state.rounds += 1
+        if state.last_eval_ms:
+            # the round's wall time, capped at the fast window: a paused
+            # process must not poison the windows with one giant sample
+            dt = min(
+                max(1, now_ms - state.last_eval_ms), state.fast.span_ms
+            )
+            bad = not state.compliant
+            state.fast.push(now_ms, dt, bad)
+            state.slow.push(now_ms, dt, bad)
+        state.last_eval_ms = now_ms
+        if state.since_ms == 0:
+            state.since_ms = now_ms
+        burn_fast = state.fast.bad_fraction() / obj.budget
+        burn_slow = state.slow.bad_fraction() / obj.budget
+        self._m_burn[(obj.name, "fast")].set(burn_fast)
+        self._m_burn[(obj.name, "slow")].set(burn_slow)
+        thr = self.burn_threshold
+        if (
+            state.state != "burning"
+            and burn_fast >= thr
+            and burn_slow >= thr
+        ):
+            state.state = "burning"
+            state.since_ms = now_ms
+            state.breach_count += 1
+            self._m_breaches[obj.name].inc()
+            state.breaches.append(
+                {
+                    "at_ms": now_ms,
+                    "value": state.value,
+                    "burn_fast": round(burn_fast, 4),
+                    "burn_slow": round(burn_slow, 4),
+                    "recovered_at_ms": 0,
+                }
+            )
+            record_event(
+                "slo_burn", table="",
+                objective=obj.name, value=state.value,
+                burn_fast=round(burn_fast, 4), burn_slow=round(burn_slow, 4),
+                target=obj.target,
+            )
+        elif state.state == "burning" and burn_fast < thr:
+            burned_s = round((now_ms - state.since_ms) / 1000.0, 3)
+            state.state = "ok"
+            state.since_ms = now_ms
+            if state.breaches:
+                state.breaches[-1]["recovered_at_ms"] = now_ms
+            record_event(
+                "slo_recovered", table="",
+                objective=obj.name, after_s=burned_s,
+                burn_fast=round(burn_fast, 4),
+            )
+
+    # ---- serving --------------------------------------------------------
+
+    def snapshot(self) -> list[dict]:
+        """One verdict row per objective — /debug/slo, system.public.slo,
+        and ``horaectl slo`` all read this."""
+        out = []
+        with self._lock:
+            for state in sorted(self._states.values(),
+                                key=lambda s: s.objective.name):
+                obj = state.objective
+                budget = obj.budget
+                visible_state = state.state
+                if state.state == "ok" and state.no_data_rounds > 0:
+                    visible_state = "no_data"
+                out.append(
+                    {
+                        "name": obj.name,
+                        "expr": f"{obj.expr} {obj.op} {obj.bound:g}",
+                        "target": obj.target,
+                        "state": visible_state,
+                        "value": state.value,
+                        "bound": obj.bound,
+                        "burn_fast": round(
+                            state.fast.bad_fraction() / budget, 4
+                        ),
+                        "burn_slow": round(
+                            state.slow.bad_fraction() / budget, 4
+                        ),
+                        "good_fast": round(1 - state.fast.bad_fraction(), 6),
+                        "good_slow": round(1 - state.slow.bad_fraction(), 6),
+                        "fast_window_s": state.fast.span_ms / 1000.0,
+                        "slow_window_s": state.slow.span_ms / 1000.0,
+                        "breaches": state.breach_count,
+                        "since_ms": state.since_ms,
+                        "last_eval_ms": state.last_eval_ms,
+                        "rounds": state.rounds,
+                        "no_data_rounds": state.no_data_rounds,
+                        "last_error": state.last_error,
+                        "node": self.node,
+                    }
+                )
+        return out
+
+    def breach_history(self) -> list[dict]:
+        """Every objective's recent ok -> burning transitions (newest
+        last), for ``horaectl slo`` and the simulator's post-mortem."""
+        out = []
+        with self._lock:
+            for state in self._states.values():
+                for b in state.breaches:
+                    out.append({"objective": state.objective.name, **b})
+        return sorted(out, key=lambda b: b["at_ms"])
+
+    def stats(self) -> dict:
+        with self._lock:
+            burning = sum(
+                1 for s in self._states.values() if s.state == "burning"
+            )
+            return {
+                "objectives": len(self._states),
+                "burning": burning,
+                "rounds": self.rounds,
+                "last_eval_ms": self.last_eval_ms,
+                "fast_window_s": self.section.fast_window_s,
+                "slow_window_s": self.section.slow_window_s,
+                "burn_threshold": self.burn_threshold,
+                "last_errors": {
+                    s.objective.name: s.last_error
+                    for s in self._states.values()
+                    if s.last_error
+                },
+            }

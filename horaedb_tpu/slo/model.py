@@ -1,0 +1,180 @@
+"""SLO objective definitions
+(ref: the Google SRE workbook's multi-window multi-burn-rate alerting,
+re-homed INSIDE the database — in the StreamBox-HBM stance (PAPERS.md)
+service-level verdicts are continuous queries over the node's own
+telemetry stream, not an external scraper's recomputation).
+
+One objective line declares a service-level *indicator* (a PromQL
+expression over the node's own ``system_metrics.samples`` history — the
+PR-5 fallback resolves any metric family against it), a *compliance
+bound* (the top-level comparison), and a *target* good-time fraction:
+
+    cheap_p99 := histogram_quantile(0.99,
+        rate(horaedb_query_class_duration_seconds_bucket{class="cheap"}[1m])
+    ) <= 0.5 target 99.9%
+
+Each evaluation round the indicator either complies or violates; the
+evaluator (slo/evaluator.py) turns the violation-time fraction over
+sliding fast/slow windows into burn rates against the error budget
+``1 - target``. The comparison is parsed HERE, not left to PromQL's
+filter semantics — a compliant round must still report its value (the
+current p99, the current ratio), which PromQL comparison filtering
+would drop.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..proxy.promql import PromQLError, parse_promql
+
+# Objective names surface as system.public.slo rows, event attrs, and
+# metric label values — same SQL-safe discipline as rule names.
+_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+_TARGET_TAIL = re.compile(r"\s+target\s+(\d+(?:\.\d+)?)\s*%\s*$")
+
+COMPARE_OPS = ("<=", ">=", "<", ">")
+
+
+class SloError(ValueError):
+    pass
+
+
+@dataclass
+class SloObjective:
+    """One service-level objective.
+
+    ``expr OP bound`` is the per-round compliance test; ``target`` is the
+    good-time fraction the objective promises (error budget =
+    ``1 - target``). ``source`` follows the rules convention ("config"
+    lines reload each start; nothing else mints objectives yet, but the
+    field keeps the persistence story symmetrical)."""
+
+    name: str
+    expr: str
+    op: str
+    bound: float
+    target: float = 0.99
+    labels: dict[str, str] = field(default_factory=dict)
+    source: str = "config"
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "expr": self.expr,
+            "op": self.op,
+            "bound": self.bound,
+            "target": self.target,
+            "source": self.source,
+        }
+
+
+def validate_objective(obj: SloObjective) -> SloObjective:
+    """Fail loudly at config load, not at the first evaluation round."""
+    if not _NAME_RE.match(obj.name or ""):
+        raise SloError(
+            f"objective name {obj.name!r} must match [A-Za-z_][A-Za-z0-9_]*"
+        )
+    if obj.op not in COMPARE_OPS:
+        raise SloError(
+            f"objective {obj.name!r}: comparison must be one of "
+            f"{', '.join(COMPARE_OPS)}"
+        )
+    if not (0.0 < obj.target < 1.0):
+        raise SloError(
+            f"objective {obj.name!r}: target must be in (0%, 100%) "
+            f"exclusive, got {obj.target * 100:g}%"
+        )
+    try:
+        parse_promql(obj.expr)
+    except PromQLError as e:
+        raise SloError(f"objective {obj.name!r}: bad expr: {e}") from None
+    return obj
+
+
+def _split_comparison(expr: str) -> tuple[str, str, float]:
+    """Split ``EXPR OP BOUND`` on the LAST depth-0 comparison operator.
+
+    Depth-0 means outside every (), [], {} and quoted string — a ``>``
+    inside a selector's regex matcher or a nested comparison inside
+    parens must not be mistaken for the objective's bound. The bound
+    side must be a bare number (objectives compare an indicator to a
+    constant; an expression bound belongs inside the indicator)."""
+    depth = 0
+    quote = None
+    split_at = None
+    i = 0
+    while i < len(expr):
+        ch = expr[i]
+        if quote:
+            if ch == "\\":
+                i += 2
+                continue
+            if ch == quote:
+                quote = None
+        elif ch in "'\"":
+            quote = ch
+        elif ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif depth == 0 and ch in "<>":
+            width = 2 if expr[i : i + 2] in ("<=", ">=") else 1
+            split_at = (i, width)
+            i += width
+            continue
+        i += 1
+    if split_at is None:
+        raise SloError(
+            f"objective needs a top-level comparison (EXPR {' | '.join(COMPARE_OPS)} BOUND): {expr!r}"
+        )
+    pos, width = split_at
+    lhs = expr[:pos].strip()
+    op = expr[pos : pos + width]
+    rhs = expr[pos + width :].strip()
+    try:
+        bound = float(rhs)
+    except ValueError:
+        raise SloError(
+            f"objective bound must be a number, got {rhs!r}"
+        ) from None
+    if not lhs:
+        raise SloError(f"objective has an empty indicator: {expr!r}")
+    return lhs, op, bound
+
+
+def parse_objective_line(line: str, source: str = "config") -> SloObjective:
+    """``NAME := EXPR OP BOUND [target 99.9%]`` — the ``[slo]`` config
+    line form (TOML-subset-friendly, like the [rules] lines)."""
+    name, sep, rest = line.partition(":=")
+    if not sep:
+        raise SloError(f"bad objective line {line!r}: expected 'NAME := EXPR'")
+    name, rest = name.strip(), rest.strip()
+    target = 0.99
+    m = _TARGET_TAIL.search(rest)
+    if m is not None:
+        target = float(m.group(1)) / 100.0
+        rest = rest[: m.start()].rstrip()
+    expr, op, bound = _split_comparison(rest)
+    return validate_objective(
+        SloObjective(
+            name=name, expr=expr, op=op, bound=bound, target=target,
+            source=source,
+        )
+    )
+
+
+def complies(op: str, value: float, bound: float) -> bool:
+    if op == "<=":
+        return value <= bound
+    if op == "<":
+        return value < bound
+    if op == ">=":
+        return value >= bound
+    return value > bound

@@ -10,7 +10,7 @@ wire protocol (HTTP SQL, MySQL, PostgreSQL) — works on them unchanged.
 Reads materialize a fresh RowGroup on every scan (the listing IS the
 current state).
 
-Five tables:
+The tables:
 
 - ``system.public.tables``      — the catalog registry
 - ``system.public.query_stats`` — the bounded ring of finalized per-query
@@ -32,6 +32,11 @@ Five tables:
   (rules/engine.RuleEngine): one row per live pending/firing alert
   series plus the recently-resolved ring, labels rendered in the
   standard folded form — the SQL face of /debug/alerts on every wire
+- ``system.public.slo``         — the SLO plane's verdicts
+  (slo/evaluator.SloEvaluator): one row per objective with its state
+  (ok|burning|no_data), current indicator value vs bound, fast/slow
+  burn rates over the sliding windows, and the breach count — the SQL
+  face of /debug/slo; the tenant simulator's acceptance gate reads it
 """
 
 from __future__ import annotations
@@ -48,6 +53,7 @@ METRICS_NAME = "system.public.metrics"
 WORKLOAD_NAME = "system.public.workload"
 EVENTS_NAME = "system.public.events"
 ALERTS_NAME = "system.public.alerts"
+SLO_NAME = "system.public.slo"
 
 
 class _VirtualTable(Table):
@@ -508,6 +514,95 @@ class AlertsTable(_VirtualTable):
         )
 
 
+_SLO_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("objective", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("node", DatumKind.STRING),
+        ColumnSchema("state", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("value", DatumKind.DOUBLE),
+        ColumnSchema("bound", DatumKind.DOUBLE),
+        ColumnSchema("target", DatumKind.DOUBLE),
+        ColumnSchema("burn_fast", DatumKind.DOUBLE),
+        ColumnSchema("burn_slow", DatumKind.DOUBLE),
+        ColumnSchema("good_fast", DatumKind.DOUBLE),
+        ColumnSchema("good_slow", DatumKind.DOUBLE),
+        ColumnSchema("breaches", DatumKind.INT64),
+        ColumnSchema("since", DatumKind.INT64),
+        ColumnSchema("expr", DatumKind.STRING),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "objective"],
+)
+
+
+class SloTable(_VirtualTable):
+    """``system.public.slo``: the SLO plane's verdicts as rows, summed
+    over every registered SloEvaluator in the process. ``timestamp`` is
+    the objective's last evaluation time; ``state`` is ok|burning|
+    no_data; ``value`` is the indicator's worst series at that round
+    (NaN while no data has ever arrived); burn rates are the sliding
+    fast/slow window burn rates against the error budget ``1-target``."""
+
+    @property
+    def name(self) -> str:
+        return SLO_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _SLO_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..slo import registered_evaluators
+
+        entries = []
+        for ev in registered_evaluators():
+            entries.extend(ev.snapshot())
+
+        def val(e) -> float:
+            return float("nan") if e["value"] is None else float(e["value"])
+
+        return RowGroup(
+            _SLO_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [e["last_eval_ms"] for e in entries], dtype=np.int64
+                ),
+                "objective": np.array(
+                    [e["name"] for e in entries], dtype=object
+                ),
+                "node": np.array([e["node"] for e in entries], dtype=object),
+                "state": np.array([e["state"] for e in entries], dtype=object),
+                "value": np.array([val(e) for e in entries], dtype=np.float64),
+                "bound": np.array(
+                    [float(e["bound"]) for e in entries], dtype=np.float64
+                ),
+                "target": np.array(
+                    [float(e["target"]) for e in entries], dtype=np.float64
+                ),
+                "burn_fast": np.array(
+                    [float(e["burn_fast"]) for e in entries], dtype=np.float64
+                ),
+                "burn_slow": np.array(
+                    [float(e["burn_slow"]) for e in entries], dtype=np.float64
+                ),
+                "good_fast": np.array(
+                    [float(e["good_fast"]) for e in entries], dtype=np.float64
+                ),
+                "good_slow": np.array(
+                    [float(e["good_slow"]) for e in entries], dtype=np.float64
+                ),
+                "breaches": np.array(
+                    [int(e["breaches"]) for e in entries], dtype=np.int64
+                ),
+                "since": np.array(
+                    [int(e["since_ms"]) for e in entries], dtype=np.int64
+                ),
+                "expr": np.array([e["expr"] for e in entries], dtype=object),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -524,4 +619,6 @@ def open_system_table(catalog, name: str):
         return EventsTable()
     if low == ALERTS_NAME:
         return AlertsTable()
+    if low == SLO_NAME:
+        return SloTable()
     return None

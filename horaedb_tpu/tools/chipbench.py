@@ -119,8 +119,18 @@ def main(out_path: str) -> None:
 
         import horaedb_tpu
         from horaedb_tpu.db import Connection
-        from horaedb_tpu.utils.object_store import LocalDiskStore
+        from horaedb_tpu.utils.env import env_float
+        from horaedb_tpu.utils.object_store import (
+            FaultInjectingStore,
+            LocalDiskStore,
+        )
 
+        # CHIPBENCH_STORE_LATENCY (seconds, default 0): wrap the
+        # follower's store in the shared fault layer so the smoke can
+        # measure manifest-tail open + serving under remote-store-like
+        # SST latency (the same FaultInjectingStore bench's ingest A/B
+        # and tools/tenantsim use).
+        store_latency_s = env_float("CHIPBENCH_STORE_LATENCY", 0.0)
         d = tempfile.mkdtemp(prefix="chip_follower_")
         try:
             leader = horaedb_tpu.connect(d)
@@ -144,7 +154,12 @@ def main(out_path: str) -> None:
             )
             leader.catalog.open("fsmoke").flush()
 
-            follower = Connection(LocalDiskStore(d))
+            fstore = LocalDiskStore(d)
+            if store_latency_s > 0:
+                fstore = FaultInjectingStore(
+                    fstore, get_latency_s=store_latency_s
+                )
+            follower = Connection(fstore)
             t_open0 = time.perf_counter()
             ft = follower.catalog.open_follower("fsmoke")
             open_ms = (time.perf_counter() - t_open0) * 1e3
@@ -170,6 +185,7 @@ def main(out_path: str) -> None:
                 "groups": len(fol_rows),
                 "watermark_ms": data.follower_watermark_ms(),
                 "agree": bool(agree),
+                "store_latency_s": store_latency_s,
             })
             follower.close()
             leader.close()

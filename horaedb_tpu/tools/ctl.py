@@ -20,6 +20,7 @@ Commands:
     rules add NAME EXPR [--kind alert] [--for 30s]   add a runtime rule
     rules rm NAME           remove a runtime rule
     alerts                  alert state (pending/firing/resolved)
+    slo                     SLO verdicts: objectives, burn rates, breaches
 
 Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
 
@@ -274,6 +275,47 @@ def cmd_alerts(ep: str, args) -> None:
     _print_rows(rows)
 
 
+def cmd_slo(ep: str, args) -> None:
+    """SLO verdicts (/debug/slo): one line per objective — state, the
+    current indicator value vs bound, fast/slow burn rates — then the
+    breach history (ok -> burning transitions, newest last)."""
+    data = json.loads(_get(ep, "/debug/slo"))
+    if not data.get("enabled", False):
+        print("(no SLO objectives on this node)")
+        return
+    rows = [
+        {
+            "objective": o["name"],
+            "state": o["state"],
+            "value": "" if o["value"] is None else round(o["value"], 6),
+            "bound": o["bound"],
+            "target": f"{o['target'] * 100:g}%",
+            "burn_fast": o["burn_fast"],
+            "burn_slow": o["burn_slow"],
+            "breaches": o["breaches"],
+            "last_error": o.get("last_error", ""),
+        }
+        for o in data["objectives"]
+    ]
+    _print_rows(rows)
+    breaches = data.get("breaches", [])
+    if breaches:
+        print(f"\nbreach history ({len(breaches)}):")
+        _print_rows(
+            [
+                {
+                    "objective": b["objective"],
+                    "at_ms": b["at_ms"],
+                    "value": b["value"],
+                    "burn_fast": b["burn_fast"],
+                    "burn_slow": b["burn_slow"],
+                    "recovered_at_ms": b["recovered_at_ms"] or "(burning)",
+                }
+                for b in breaches
+            ]
+        )
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -319,6 +361,7 @@ def main(argv=None) -> int:
     rl_rm = rl_sub.add_parser("rm")
     rl_rm.add_argument("name")
     sub.add_parser("alerts")
+    sub.add_parser("slo")
     sub.add_parser("shards")
     sub.add_parser("wal_stats")
     sub.add_parser("slow_log")

@@ -33,6 +33,7 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     self_scrape = true                # node scrapes its own registry
     self_scrape_interval = "10s"      # into system_metrics.samples
     self_metrics_retention = "24h"    # 0s = keep forever
+    event_ring = 512                  # bounded event-journal capacity
 
     [rules]
     enabled = true                    # continuous-query engine (rules/)
@@ -45,6 +46,12 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     rollup_1m_ttl = "30d"
     rollup_1h_ttl = "0s"              # 0s = keep forever
     recording_ttl = "30d"             # recording-rule output tables
+
+    [slo]
+    objectives = ["cheap_p99 := histogram_quantile(0.99, rate(horaedb_query_class_duration_seconds_bucket{class=\"cheap\"}[1m])) <= 0.5 target 99%"]
+    fast_window = "5m"                # fast burn-rate window
+    slow_window = "1h"                # slow burn-rate window
+    burn_threshold = 1.0              # burn on fast AND slow >= threshold
 
 Env overrides: HORAEDB_HTTP_PORT, HORAEDB_HOST, HORAEDB_DATA_DIR.
 """
@@ -188,6 +195,9 @@ class ObservabilitySection:
     self_scrape: bool = True
     self_scrape_interval_s: float = 10.0
     self_metrics_retention_s: float = 24 * 3600.0
+    # bounded event-journal (utils/events) ring capacity; drops are
+    # accounted in horaedb_events_dropped_total and /debug/status
+    event_ring: int = 512
 
 
 @dataclass
@@ -208,6 +218,21 @@ class RulesSection:
     rollup_1m_ttl_s: float = 30 * 24 * 3600.0
     rollup_1h_ttl_s: float = 0.0
     recording_ttl_s: float = 30 * 24 * 3600.0
+
+
+@dataclass
+class SloSection:
+    """Service-level objectives (slo/): each objective line declares a
+    PromQL indicator over the node's own telemetry history
+    (system_metrics.samples / query_stats) with a compliance bound and a
+    good-time target; the evaluator rides the [rules] eval cadence and
+    maintains fast/slow sliding burn-rate windows incrementally. Served
+    as ``system.public.slo`` on every wire and at ``/debug/slo``."""
+
+    objectives: list[str] = field(default_factory=list)
+    fast_window_s: float = 5 * 60.0
+    slow_window_s: float = 3600.0
+    burn_threshold: float = 1.0
 
 
 @dataclass
@@ -257,6 +282,7 @@ class Config:
         default_factory=ObservabilitySection
     )
     rules: RulesSection = field(default_factory=RulesSection)
+    slo: SloSection = field(default_factory=SloSection)
     cluster: ClusterSection = field(default_factory=ClusterSection)
     s3: S3Section = field(default_factory=S3Section)
 
@@ -293,11 +319,15 @@ _KNOWN = {
     },
     "observability": {
         "self_scrape", "self_scrape_interval", "self_metrics_retention",
+        "event_ring",
     },
     "rules": {
         "enabled", "eval_interval", "grace", "recording", "alerts",
         "rollup_tables", "rollup_raw_ttl", "rollup_1m_ttl",
         "rollup_1h_ttl", "recording_ttl",
+    },
+    "slo": {
+        "objectives", "fast_window", "slow_window", "burn_threshold",
     },
     "cluster": {
         "self_endpoint", "endpoints", "rules", "meta_endpoints",
@@ -408,6 +438,10 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.observability.self_metrics_retention_s = (
             parse_duration_ms(o["self_metrics_retention"]) / 1000.0
         )
+    if "event_ring" in o:
+        cfg.observability.event_ring = int(o["event_ring"])
+        if cfg.observability.event_ring < 1:
+            raise ConfigError("observability.event_ring must be >= 1")
     ru = raw.get("rules", {})
     if "enabled" in ru:
         if not isinstance(ru["enabled"], bool):
@@ -446,6 +480,39 @@ def _apply(cfg: Config, raw: dict) -> None:
                 parse_rule_line(line, "alert")
         except RuleError as e:
             raise ConfigError(f"[rules]: {e}") from None
+    sl = raw.get("slo", {})
+    if "objectives" in sl:
+        v = sl["objectives"]
+        if not isinstance(v, list) or not all(isinstance(x, str) for x in v):
+            raise ConfigError("slo.objectives must be a list of strings")
+        cfg.slo.objectives = list(v)
+    for key, attr in (
+        ("fast_window", "fast_window_s"),
+        ("slow_window", "slow_window_s"),
+    ):
+        if key in sl:
+            setattr(cfg.slo, attr, parse_duration_ms(sl[key]) / 1000.0)
+            if getattr(cfg.slo, attr) <= 0:
+                raise ConfigError(f"slo.{key} must be positive")
+    if "burn_threshold" in sl:
+        cfg.slo.burn_threshold = float(sl["burn_threshold"])
+        if cfg.slo.burn_threshold <= 0:
+            raise ConfigError("slo.burn_threshold must be positive")
+    if sl:
+        if cfg.slo.fast_window_s > cfg.slo.slow_window_s:
+            raise ConfigError("slo.fast_window must be <= slo.slow_window")
+        # objective lines fail HERE, at load, not at the first evaluation
+        from ..slo.model import SloError, parse_objective_line
+
+        try:
+            seen = set()
+            for line in cfg.slo.objectives:
+                obj = parse_objective_line(line)
+                if obj.name in seen:
+                    raise SloError(f"duplicate objective name {obj.name!r}")
+                seen.add(obj.name)
+        except SloError as e:
+            raise ConfigError(f"[slo]: {e}") from None
     s3 = raw.get("s3", {})
     if s3:
         for k in ("bucket", "endpoint", "region", "access_key", "secret_key",

@@ -55,9 +55,20 @@ EVENT_KINDS: dict[str, str] = {
     "alert_resolved": "a firing alert series stopped matching and resolved",
     "rule_eval_failed": "a rule/rollup evaluation raised (or a round was shed)",
     "rollup_catchup": "a rollup tier advanced over a multi-bucket backlog (restart/backfill)",
+    "slo_burn": "an SLO objective's fast+slow burn rates crossed the threshold",
+    "slo_recovered": "a burning SLO objective's fast window came back under threshold",
 }
 
 _EVENTS_FAMILY = "horaedb_events_total"
+
+# Ring overflow is ACCOUNTED, never silent: the journal's "no seq gaps"
+# invariant (tools/tenantsim asserts it from system.public.events) is
+# only falsifiable if drops are visible — min(seq) - 1 must equal the
+# dropped count. Sized by the [observability] event_ring knob.
+_M_DROPPED = REGISTRY.counter(
+    "horaedb_events_dropped_total",
+    "journal entries discarded by the bounded ring (oldest-first)",
+)
 
 # Eager registration: every kind's labeled counter exists from the first
 # scrape (and for the registry lint) even before the event ever fires —
@@ -76,18 +87,70 @@ class EventStore:
     """Bounded ring of event entries (plain dicts — readers never race a
     live mutation). One per process, like TRACE_STORE / STATS_STORE."""
 
-    def __init__(self, maxlen: int = 512) -> None:
+    DEFAULT_CAPACITY = 512
+
+    def __init__(self, maxlen: int = DEFAULT_CAPACITY) -> None:
         from collections import deque
 
         self._ring: "deque[dict]" = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self._seq = itertools.count(1)
+        self._issued = 0  # last seq handed out (survives clear())
+        self.dropped = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def resize(self, maxlen: int) -> None:
+        """Re-bound the ring ([observability] event_ring). Shrinking
+        discards oldest-first and ACCOUNTS the discards like any other
+        overflow; growing keeps everything."""
+        from collections import deque
+
+        maxlen = max(1, int(maxlen))
+        with self._lock:
+            if maxlen == self._ring.maxlen:
+                return
+            old = list(self._ring)
+            cut = max(0, len(old) - maxlen)
+            if cut:
+                self.dropped += cut
+                _M_DROPPED.inc(cut)
+            self._ring = deque(old[cut:], maxlen=maxlen)
 
     def record(self, entry: dict) -> dict:
         with self._lock:
-            entry["seq"] = next(self._seq)
+            entry["seq"] = self._issued = next(self._seq)
+            if len(self._ring) == self._ring.maxlen:
+                # deque(maxlen) evicts silently; the journal must not —
+                # an unaccounted drop would make a seq gap in the ring
+                # indistinguishable from a lost event
+                self.dropped += 1
+                _M_DROPPED.inc()
             self._ring.append(entry)
         return entry
+
+    def stats(self) -> dict:
+        # one consistent snapshot: dropped/issued read OUTSIDE the lock
+        # could tear against a concurrent evicting record(), breaking the
+        # documented `first_seq - 1 == dropped` invariant readers check
+        with self._lock:
+            size = len(self._ring)
+            first = self._ring[0]["seq"] if size else 0
+            last = self._ring[-1]["seq"] if size else 0
+            dropped = self.dropped
+            issued = self._issued
+        return {
+            "capacity": self.capacity,
+            "size": size,
+            "dropped": dropped,
+            "first_seq": first,
+            "last_seq": last,
+            # last seq ever handed out — unlike last_seq this survives
+            # clear(), so drop accounting across a clear stays exact
+            "issued": issued,
+        }
 
     def list(
         self, kind: Optional[str] = None, limit: Optional[int] = None

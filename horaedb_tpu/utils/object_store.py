@@ -453,6 +453,132 @@ class DiskCacheStore(ObjectStore):
         return self.inner.list(prefix)
 
 
+class InjectedFaultError(OSError):
+    """A fault the FaultInjectingStore raised on purpose — typed so test
+    assertions can tell injected chaos from real store failures."""
+
+
+class FaultInjectingStore(ObjectStore):
+    """Deterministic fault-injection wrapper over any store — the shared
+    chaos layer for bench (ingest A/B), chipbench, and the tenant-scale
+    production simulator (tools/tenantsim), promoted from bench.py's
+    ad-hoc latency-injected SST store.
+
+    Injection points:
+
+    - ``put_latency_s``   — synthetic upload delay per matching put (the
+      remote-store shape the pipelined flush exists for)
+    - ``get_latency_s``   — synthetic fetch delay per matching get/range
+    - ``error_rate``      — probability in [0, 1] that a matching op
+      raises ``InjectedFaultError`` (an OSError: the engine's retry/
+      backoff paths see exactly what a flaky store would produce)
+    - ``suffix``          — only paths ending with it are injected
+      (default ``".sst"``: manifest/WAL appends stay fast — the point is
+      the data-object cost); ``""`` injects everything
+
+    All knobs are plain attributes, adjustable mid-run under ``_lock``
+    (the simulator's fault schedule flips them live). The RNG is seeded
+    (``seed``) so a failing schedule replays identically. ``head``/
+    ``list``/``delete`` are never injected: they back bookkeeping the
+    engine must not lose, and the interesting failure shapes are data
+    reads/writes. ``local_path`` (mmap fast path) intentionally does NOT
+    pass through: a wrapped store must not let readers bypass injection.
+    """
+
+    def __init__(
+        self,
+        inner: ObjectStore,
+        put_latency_s: float = 0.0,
+        get_latency_s: float = 0.0,
+        error_rate: float = 0.0,
+        seed: int = 0,
+        suffix: str = ".sst",
+    ) -> None:
+        import random
+
+        self.inner = inner
+        self.put_latency_s = float(put_latency_s)
+        self.get_latency_s = float(get_latency_s)
+        self.error_rate = float(error_rate)
+        self.suffix = suffix
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected_errors = 0
+        self.delayed_ops = 0
+        # /metrics visibility: the simulator's SLO objectives and alert
+        # rules observe the chaos through the DATABASE's own telemetry
+        # (rate over the samples history), not harness-side bookkeeping
+        from .metrics import REGISTRY
+
+        self._m_errors = REGISTRY.counter(
+            "horaedb_object_store_injected_faults_total",
+            "operations failed on purpose by FaultInjectingStore",
+        )
+        self._m_delays = REGISTRY.counter(
+            "horaedb_object_store_injected_delays_total",
+            "operations delayed on purpose by FaultInjectingStore",
+        )
+
+    def _maybe_inject(self, path: str, latency_s: float, op: str) -> None:
+        if self.suffix and not path.endswith(self.suffix):
+            return
+        with self._lock:
+            rate = self.error_rate
+            fail = rate > 0 and self._rng.random() < rate
+            if fail:
+                self.injected_errors += 1
+                self._m_errors.inc()
+            elif latency_s > 0:
+                self.delayed_ops += 1
+                self._m_delays.inc()
+        if fail:
+            raise InjectedFaultError(f"injected {op} fault: {path}")
+        if latency_s > 0:
+            import time
+
+            time.sleep(latency_s)
+
+    def put(self, path: str, data: bytes) -> None:
+        self._maybe_inject(path, self.put_latency_s, "put")
+        self.inner.put(path, data)
+
+    def get(self, path: str) -> bytes:
+        self._maybe_inject(path, self.get_latency_s, "get")
+        return self.inner.get(path)
+
+    def get_range(self, path: str, start: int, end: int) -> bytes:
+        self._maybe_inject(path, self.get_latency_s, "get_range")
+        return self.inner.get_range(path, start, end)
+
+    def head(self, path: str) -> int:
+        return self.inner.head(path)
+
+    def delete(self, path: str) -> None:
+        self.inner.delete(path)
+
+    def list(self, prefix: str = "") -> Iterator[str]:
+        return self.inner.list(prefix)
+
+    def prefetch(self, paths: Sequence[str]) -> None:
+        self.inner.prefetch(paths)
+
+    def __getattr__(self, name: str):
+        # Forward everything else to the inner store (``root`` places the
+        # state files — rules_state.json / wlm_state.json — so hiding it
+        # would silently disable persistence on wrapped nodes). EXCEPT
+        # ``local_path``: the mmap fast path would let readers bypass
+        # injection entirely.
+        if name == "local_path":
+            raise AttributeError(
+                "FaultInjectingStore hides local_path (mmap would bypass "
+                "fault injection)"
+            )
+        inner = self.__dict__.get("inner")
+        if inner is None:  # mid-__init__ lookup: nothing to forward yet
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+
 class MemCacheStore(ObjectStore):
     """Read-through whole-object LRU cache over another store.
 

@@ -756,7 +756,9 @@ class TestMetricsNameLint:
     horaedb_-prefixed with a unit suffix — prevents the name drift the
     reference crates suffer from."""
 
-    SUFFIXES = ("_seconds", "_bytes", "_total", "_rows")
+    # _ratio: unitless level-valued gauges (e.g. SLO burn rates) — a
+    # counter-suffix (_total) on a gauge would invite rate() on a level
+    SUFFIXES = ("_seconds", "_bytes", "_total", "_rows", "_ratio")
 
     def test_registry_families_follow_convention(self, tmp_path):
         import re
@@ -1307,3 +1309,141 @@ class TestReplicaRegistryLint:
                 "X-HoraeDB-Read-Staleness: undocumented in docs/WORKLOAD.md"
             )
         assert not missing, missing
+
+
+class TestSloRegistryLint:
+    """PR-11 lint extension (same contract as the rules/replica
+    registries) for the SLO plane: every family declared in
+    slo/evaluator.SLO_METRIC_FAMILIES must be (a) registered live — the
+    per-objective burn-rate/breach series eagerly at evaluator load, with
+    both window labels — (b) convention-clean, (c) documented in
+    docs/OBSERVABILITY.md; no stray horaedb_slo_* family may exist
+    outside the declared registry. The per-class query-latency family
+    (proxy.QUERY_CLASS_METRIC_FAMILIES, the canonical SLO indicator) is
+    held to the same contract with every admission-class label live. The
+    [slo] knobs and the [observability] event_ring knob are operator
+    surface: pinned to docs/WORKLOAD.md. The event-journal drop counter
+    must be registered + documented (the "no seq gaps" invariant is only
+    falsifiable with drops accounted)."""
+
+    def test_slo_families_declared_and_documented(self):
+        import os
+        import re
+
+        import horaedb_tpu
+        from horaedb_tpu.slo import BURN_WINDOWS, SLO_METRIC_FAMILIES, SloEvaluator
+        from horaedb_tpu.utils.config import SloSection
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        db = horaedb_tpu.connect(None)
+        try:
+            # one loaded objective so the labeled series exist
+            ev = SloEvaluator(
+                db,
+                SloSection(objectives=["slo_lint_probe := 0 <= 1"]),
+            )
+            assert len(ev) == 1
+            here = os.path.dirname(__file__)
+            docs = open(
+                os.path.join(here, "..", "docs", "OBSERVABILITY.md")
+            ).read()
+            wdocs = open(
+                os.path.join(here, "..", "docs", "WORKLOAD.md")
+            ).read()
+            families = set(REGISTRY.families())
+            pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+            suffixes = TestMetricsNameLint.SUFFIXES
+            exposed = REGISTRY.expose()
+            missing = []
+            for fam in SLO_METRIC_FAMILIES:
+                if fam not in families:
+                    missing.append(f"{fam}: not registered")
+                if not pat.match(fam) or not fam.endswith(suffixes):
+                    missing.append(f"{fam}: violates naming lint")
+                if f"`{fam}`" not in docs:
+                    missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+            for window in BURN_WINDOWS:
+                if f'window="{window}"' not in exposed:
+                    missing.append(
+                        f"label window={window}: not eagerly registered"
+                    )
+            for fam in families:
+                if fam.startswith("horaedb_slo_") and \
+                        fam not in SLO_METRIC_FAMILIES:
+                    missing.append(f"{fam}: live but undeclared in registry")
+            for knob in ("objectives", "fast_window", "slow_window",
+                         "burn_threshold", "event_ring"):
+                if f"`{knob}`" not in wdocs:
+                    missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+            assert not missing, missing
+        finally:
+            db.close()
+
+    def test_query_class_family_declared_and_documented(self):
+        import os
+        import re
+
+        from horaedb_tpu.proxy import (
+            ADMISSION_CLASSES,
+            QUERY_CLASS_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in QUERY_CLASS_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for cls in ADMISSION_CLASSES:
+            if f'class="{cls}"' not in exposed:
+                missing.append(f"label class={cls}: not eagerly registered")
+        for fam in families:
+            if fam.startswith("horaedb_query_class_") and \
+                    fam not in QUERY_CLASS_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        assert not missing, missing
+
+    def test_event_drop_counter_registered_and_documented(self):
+        import os
+
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        assert "horaedb_events_dropped_total" in REGISTRY.families()
+        assert "`horaedb_events_dropped_total`" in docs
+
+    def test_slo_table_registered_in_system_catalog(self):
+        import horaedb_tpu
+        from horaedb_tpu.slo import SloEvaluator
+        from horaedb_tpu.table_engine.system import (
+            SLO_NAME,
+            SloTable,
+            open_system_table,
+        )
+        from horaedb_tpu.utils.config import SloSection
+
+        t = open_system_table(None, SLO_NAME)
+        assert isinstance(t, SloTable)
+        cols = {c.name for c in t.schema.columns}
+        assert {"objective", "state", "value", "bound", "target",
+                "burn_fast", "burn_slow", "breaches", "since"} <= cols
+        db = horaedb_tpu.connect(None)
+        try:
+            ev = SloEvaluator(
+                db, SloSection(objectives=["slo_lint_table := 0 <= 1"])
+            )
+            ev.evaluate_round()
+            rg = t._materialize()
+            assert "slo_lint_table" in list(rg.columns["objective"])
+        finally:
+            db.close()

@@ -1,0 +1,408 @@
+"""SLO plane unit tests: objective parsing, incremental burn-rate
+windows, burn/recover transitions + typed events, the system.public.slo
+/ /debug/slo serving faces, the FaultInjectingStore, and event-journal
+drop accounting (PR 11)."""
+
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.slo import (
+    SloError,
+    SloEvaluator,
+    parse_objective_line,
+)
+from horaedb_tpu.slo.evaluator import _Window
+from horaedb_tpu.utils.config import SloSection
+from horaedb_tpu.utils.events import EVENT_STORE, EventStore
+
+
+class TestObjectiveParsing:
+    def test_full_line(self):
+        o = parse_objective_line(
+            "cheap_p99 := histogram_quantile(0.99, "
+            'rate(horaedb_query_class_duration_seconds_bucket{class="cheap"}'
+            "[1m])) <= 0.5 target 99.9%"
+        )
+        assert o.name == "cheap_p99"
+        assert o.op == "<="
+        assert o.bound == 0.5
+        assert abs(o.target - 0.999) < 1e-9
+        assert abs(o.budget - 0.001) < 1e-9
+        assert "histogram_quantile" in o.expr
+
+    def test_default_target_and_ops(self):
+        for op in ("<=", "<", ">=", ">"):
+            o = parse_objective_line(f"x := some_metric {op} 3")
+            assert o.op == op and o.bound == 3.0 and o.target == 0.99
+
+    def test_comparison_inside_braces_not_split(self):
+        # a regex matcher containing '>' must not be mistaken for the
+        # bound comparison
+        o = parse_objective_line(
+            'weird := some_metric{path=~"a>b.*"} <= 1 target 90%'
+        )
+        assert o.op == "<=" and o.bound == 1.0
+        assert 'path=~"a>b.*"' in o.expr
+
+    def test_rejects(self):
+        with pytest.raises(SloError, match="top-level comparison"):
+            parse_objective_line("x := some_metric")
+        with pytest.raises(SloError, match="must be a number"):
+            parse_objective_line("x := a <= b")
+        with pytest.raises(SloError, match="target"):
+            parse_objective_line("x := a <= 1 target 100%")
+        with pytest.raises(SloError, match="target"):
+            parse_objective_line("x := a <= 1 target 0%")
+        with pytest.raises(SloError, match="name"):
+            parse_objective_line("bad-name := a <= 1")
+        with pytest.raises(SloError, match="bad expr"):
+            parse_objective_line("x := ,nope, <= 1")
+        with pytest.raises(SloError, match="NAME := EXPR"):
+            parse_objective_line("just an expression <= 1")
+
+    def test_config_section_validation(self):
+        import os
+        import tempfile
+
+        from horaedb_tpu.utils.config import Config, ConfigError
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "c.toml")
+            with open(path, "w") as f:
+                f.write(
+                    "[slo]\n"
+                    'objectives = ["ok := up <= 1 target 99%"]\n'
+                    'fast_window = "2m"\n'
+                    'slow_window = "30m"\n'
+                    "burn_threshold = 2.0\n"
+                )
+            cfg = Config.load(path)
+            assert cfg.slo.objectives and cfg.slo.fast_window_s == 120.0
+            assert cfg.slo.slow_window_s == 1800.0
+            assert cfg.slo.burn_threshold == 2.0
+
+            with open(path, "w") as f:
+                f.write('[slo]\nobjectives = ["nope"]\n')
+            with pytest.raises(ConfigError, match=r"\[slo\]"):
+                Config.load(path)
+
+            with open(path, "w") as f:
+                f.write('[slo]\nfast_window = "2h"\nslow_window = "1h"\n')
+            with pytest.raises(ConfigError, match="fast_window"):
+                Config.load(path)
+
+            with open(path, "w") as f:
+                f.write("[observability]\nevent_ring = 0\n")
+            with pytest.raises(ConfigError, match="event_ring"):
+                Config.load(path)
+
+
+class TestWindow:
+    def test_incremental_matches_naive(self):
+        """The O(1) running sums must equal a from-scratch refold at
+        every step (the incremental-maintenance correctness claim)."""
+        import random
+
+        rng = random.Random(3)
+        w = _Window(5_000)
+        samples = []
+        t = 1_000_000
+        for _ in range(300):
+            dt = rng.randrange(50, 900)
+            t += dt
+            bad = rng.random() < 0.3
+            samples.append((t, dt, dt if bad else 0))
+            w.push(t, dt, bad)
+            kept = [s for s in samples if s[0] > t - 5_000]
+            assert w.total_ms == sum(s[1] for s in kept)
+            assert w.bad_ms == sum(s[2] for s in kept)
+
+    def test_bad_fraction_empty(self):
+        assert _Window(1000).bad_fraction() == 0.0
+
+
+class TestEvaluator:
+    def _eval(self, db, objectives, fast=2.0, slow=8.0, thr=1.0):
+        return SloEvaluator(
+            db,
+            SloSection(
+                objectives=objectives, fast_window_s=fast, slow_window_s=slow,
+                burn_threshold=thr,
+            ),
+            node="unit",
+        )
+
+    def test_burn_and_recover_with_events(self):
+        db = horaedb_tpu.connect(None)
+        try:
+            ev = self._eval(db, ["slo_unit_bad := 2 <= 1 target 90%"])
+            before = EVENT_STORE.stats()["last_seq"]
+            now = int(time.time() * 1000)
+            for i in range(40):
+                ev.evaluate_round(now + i * 300)
+            (row,) = ev.snapshot()
+            assert row["state"] == "burning"
+            assert row["breaches"] == 1
+            assert row["burn_fast"] == pytest.approx(10.0)
+            # expression flips compliant -> the fast window drains ->
+            # recovery (the slow window still remembers)
+            ev._states["slo_unit_bad"].objective.bound = 5.0
+            for i in range(40, 60):
+                ev.evaluate_round(now + i * 300)
+            (row,) = ev.snapshot()
+            assert row["state"] == "ok"
+            assert row["burn_fast"] == 0.0
+            assert row["burn_slow"] > 0.0
+            kinds = [
+                e["kind"]
+                for e in EVENT_STORE.list()
+                if e["seq"] > before and e["kind"].startswith("slo_")
+            ]
+            assert kinds == ["slo_burn", "slo_recovered"]
+            hist = ev.breach_history()
+            assert len(hist) == 1 and hist[0]["recovered_at_ms"] > 0
+        finally:
+            db.close()
+
+    def test_multiwindow_blip_does_not_burn(self):
+        """A violation shorter than the slow window's budget share must
+        not page — that's the whole point of the slow window."""
+        db = horaedb_tpu.connect(None)
+        try:
+            ev = self._eval(
+                db, ["slo_unit_blip := 2 <= 5 target 50%"], fast=1.0, slow=60.0
+            )
+            state = ev._states["slo_unit_blip"]
+            now = int(time.time() * 1000)
+            # 20 good rounds, then 4 bad rounds (fills the 1s fast window
+            # but is a sliver of the 60s slow one)
+            for i in range(20):
+                ev.evaluate_round(now + i * 300)
+            state.objective.bound = 1.0  # still 2 <= 1 -> bad
+            for i in range(20, 24):
+                ev.evaluate_round(now + i * 300)
+            (row,) = ev.snapshot()
+            assert row["burn_fast"] >= 1.0  # fast window saturated...
+            assert row["state"] == "ok"  # ...but slow window vetoed
+            assert row["breaches"] == 0
+        finally:
+            db.close()
+
+    def test_no_data_state_and_error_isolation(self):
+        db = horaedb_tpu.connect(None)
+        try:
+            ev = self._eval(
+                db,
+                [
+                    "slo_unit_nodata := no_such_metric_xyz <= 1",
+                    "slo_unit_live := 0 <= 1",
+                ],
+            )
+            now = int(time.time() * 1000)
+            for i in range(3):
+                ev.evaluate_round(now + i * 300)
+            rows = {r["name"]: r for r in ev.snapshot()}
+            assert rows["slo_unit_nodata"]["state"] == "no_data"
+            assert rows["slo_unit_nodata"]["no_data_rounds"] == 3
+            assert rows["slo_unit_nodata"]["value"] is None
+            assert rows["slo_unit_live"]["state"] == "ok"
+            assert ev.stats()["objectives"] == 2
+        finally:
+            db.close()
+
+    def test_worst_series_direction(self):
+        """For an upper bound the MAX series decides; for a lower bound
+        the MIN — the worst series is the verdict."""
+        from horaedb_tpu.slo.model import SloObjective, complies
+
+        assert complies("<=", 1.0, 1.0) and not complies("<", 1.0, 1.0)
+        assert complies(">=", 1.0, 1.0) and not complies(">", 1.0, 1.0)
+        o = SloObjective("x", "m", "<=", 1.0)
+        assert o.budget == pytest.approx(0.01)
+
+    def test_sql_and_debug_faces(self):
+        """system.public.slo on the SQL wire + /debug/slo JSON, from the
+        same snapshot."""
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from horaedb_tpu.server import create_app
+
+        db = horaedb_tpu.connect(None)
+        try:
+            ev = self._eval(db, ["slo_unit_face := 2 <= 1 target 90%"])
+            now = int(time.time() * 1000)
+            for i in range(30):
+                ev.evaluate_round(now + i * 300)
+            out = db.execute(
+                "SELECT objective, state, breaches, burn_fast FROM "
+                "system.public.slo WHERE objective = 'slo_unit_face'"
+            )
+            (row,) = out.to_pylist()
+            assert row["state"] == "burning" and row["breaches"] == 1
+            assert row["burn_fast"] > 1.0
+
+            app = create_app(db)
+            app["slo"] = ev  # face an existing evaluator
+
+            async def body():
+                client = TestClient(TestServer(app))
+                await client.start_server()
+                try:
+                    resp = await client.get("/debug/slo")
+                    assert resp.status == 200
+                    doc = await resp.json()
+                    assert doc["enabled"] is True
+                    names = [o["name"] for o in doc["objectives"]]
+                    assert "slo_unit_face" in names
+                    assert doc["breaches"]
+                finally:
+                    await client.close()
+
+            asyncio.run(body())
+        finally:
+            db.close()
+
+    def test_rides_rule_engine_cadence(self):
+        """RuleEngine.run_once ticks the attached evaluator — the SLO
+        plane deliberately has no loop of its own."""
+        from horaedb_tpu.rules import RuleEngine
+        from horaedb_tpu.utils.config import RulesSection
+
+        db = horaedb_tpu.connect(None)
+        try:
+            ev = self._eval(db, ["slo_unit_ride := 0 <= 1"])
+            eng = RuleEngine(db, RulesSection(), node="unit", slo=ev)
+            assert ev.rounds == 0
+            eng.run_once()
+            eng.run_once()
+            assert ev.rounds == 2
+            (row,) = ev.snapshot()
+            assert row["rounds"] == 2
+        finally:
+            db.close()
+
+
+class TestFaultInjectingStore:
+    def test_latency_errors_and_determinism(self):
+        from horaedb_tpu.utils.object_store import (
+            FaultInjectingStore,
+            InjectedFaultError,
+            MemoryStore,
+        )
+
+        inner = MemoryStore()
+        st = FaultInjectingStore(inner, seed=42, suffix=".sst")
+        st.put("a/1.sst", b"x" * 10)
+        assert st.get("a/1.sst") == b"x" * 10
+        assert st.get_range("a/1.sst", 2, 5) == b"xxx"
+        assert st.head("a/1.sst") == 10
+
+        # suffix filter: non-matching paths are never injected
+        st.error_rate = 1.0
+        st.put("manifest/edit.json", b"{}")
+        with pytest.raises(InjectedFaultError):
+            st.put("a/2.sst", b"y")
+        assert st.injected_errors == 1
+        assert not inner.exists("a/2.sst")
+        st.error_rate = 0.0
+
+        # deterministic under a seed: same sequence, same failures
+        def failures(seed):
+            s = FaultInjectingStore(MemoryStore(), seed=seed, error_rate=0.5)
+            out = []
+            for i in range(30):
+                try:
+                    s.put(f"p/{i}.sst", b"z")
+                    out.append(True)
+                except InjectedFaultError:
+                    out.append(False)
+            return out
+
+        assert failures(7) == failures(7)
+        assert failures(7) != failures(8)
+
+        # latency knob actually delays (and is adjustable live)
+        st.put_latency_s = 0.05
+        t0 = time.perf_counter()
+        st.put("a/3.sst", b"z")
+        assert time.perf_counter() - t0 >= 0.04
+        assert st.delayed_ops >= 1
+
+    def test_injection_is_a_metric(self):
+        """The simulator's alerts/SLOs observe injected chaos through
+        the database's own telemetry — the counter must tick."""
+        from horaedb_tpu.utils.metrics import REGISTRY
+        from horaedb_tpu.utils.object_store import (
+            FaultInjectingStore,
+            InjectedFaultError,
+            MemoryStore,
+        )
+
+        c = REGISTRY.counter(
+            "horaedb_object_store_injected_faults_total", ""
+        )
+        before = c.value
+        st = FaultInjectingStore(MemoryStore(), seed=1, error_rate=1.0)
+        with pytest.raises(InjectedFaultError):
+            st.put("x.sst", b"d")
+        assert c.value == before + 1
+
+
+class TestEventRingAccounting:
+    def test_overflow_accounted_and_contiguous(self):
+        store = EventStore(maxlen=8)
+        for i in range(20):
+            store.record({"kind": "k", "n": i})
+        stats = store.stats()
+        assert stats["size"] == 8
+        assert stats["dropped"] == 12
+        seqs = [e["seq"] for e in store.list()]
+        assert seqs == list(range(13, 21))  # contiguous retained window
+        # the journal invariant the simulator asserts: every missing
+        # leading seq is an accounted drop
+        assert seqs[0] - 1 == stats["dropped"]
+
+    def test_resize_accounts_shrink_keeps_grow(self):
+        store = EventStore(maxlen=8)
+        for i in range(8):
+            store.record({"kind": "k", "n": i})
+        store.resize(4)
+        stats = store.stats()
+        assert stats["capacity"] == 4 and stats["size"] == 4
+        assert stats["dropped"] == 4
+        store.resize(16)
+        assert store.stats()["capacity"] == 16
+        assert store.stats()["dropped"] == 4  # growing drops nothing
+        for i in range(20):
+            store.record({"kind": "k"})
+        assert store.stats()["size"] == 16
+        # 4 kept + 20 new through a 16-ring = 8 more drops on top of the
+        # 4 the shrink accounted
+        assert store.stats()["dropped"] == 12
+
+    def test_global_store_resize_via_create_app(self):
+        """[observability] event_ring reaches the process-global ring
+        through create_app."""
+        from horaedb_tpu.server import create_app
+        from horaedb_tpu.utils.config import ObservabilitySection
+
+        db = horaedb_tpu.connect(None)
+        try:
+            old_cap = EVENT_STORE.capacity
+            try:
+                app = create_app(
+                    db,
+                    observability=ObservabilitySection(
+                        self_scrape=False, event_ring=old_cap + 64
+                    ),
+                )
+                assert EVENT_STORE.capacity == old_cap + 64
+                assert app["metrics_recorder"] is None
+            finally:
+                EVENT_STORE.resize(old_cap)
+        finally:
+            db.close()

@@ -1,0 +1,103 @@
+"""Multi-tenant production-simulator gates (PR 11, ROADMAP item 5).
+
+The tier-1 smoke drives a small but complete configuration — a real
+in-process 1-meta + 2-node cluster, tens of tenants, one latency burst,
+one store-error burst, one leader kill — and asserts the acceptance
+invariants FROM THE DATABASE'S OWN TABLES:
+
+- SLO verdicts read back from ``system.public.slo`` (evaluated over the
+  node's own ``system_metrics.samples`` history, not harness timing),
+  with the cheap-class p99 objective never burning;
+- zero wrong answers on any served read (frozen-range references);
+- a contiguous event-journal seq window with every drop accounted;
+- at least one alert observed firing AND resolving under the injected
+  faults;
+- acknowledged writes (incl. rows acked by the killed leader) readable
+  after recovery.
+
+The full-scale run (hundreds of tenants, 3 nodes, lease flap + rolling
+shard migration) is ``slow`` and also wired as ``BENCH_CONFIG=tenantsim``.
+"""
+
+import pytest
+
+from horaedb_tpu.tools.tenantsim import SimConfig, run_sim
+
+
+def _smoke_config() -> SimConfig:
+    return SimConfig(
+        nodes=2,
+        tenants=12,
+        tables=2,
+        duration_s=14.0,
+        seed=7,
+        workers=3,
+        ingest_workers=1,
+        rows_per_table=3000,
+        read_replicas=1,
+        scrape_interval_s=0.3,
+        eval_interval_s=0.3,
+        fast_window_s=3.0,
+        slow_window_s=10.0,
+        lease_ttl_s=2.0,
+        heartbeat_timeout_s=3.0,
+        storm_window=(0.15, 0.45),
+        latency_burst=(0.2, 0.4),
+        error_burst=(0.25, 0.5),
+        kill_at=0.65,
+        lease_flap_at=None,
+        shard_move_at=None,
+        settle_timeout_s=25.0,
+    )
+
+
+class TestTenantSimSmoke:
+    def test_smoke_invariants_hold(self):
+        report = run_sim(_smoke_config())
+        violations = report.violations()
+        detail = {
+            k: v
+            for k, v in report.to_dict().items()
+            if k not in ("config", "slo_rows")
+        }
+        assert not violations, f"{violations}\nreport: {detail}"
+        # beyond the gate: the run actually exercised the machinery
+        assert report.served > 100, detail
+        assert report.ingest_acked_rows > 0, detail
+        assert report.killed_node, detail
+        assert report.kill_recovered, detail
+        assert "StoreFaults" in report.alerts_fired, detail
+        # the SLO table carried every declared objective
+        names = {r["objective"] for r in report.slo_rows}
+        assert {"cheap_p99", "store_faults", "shed_ratio"} <= names, detail
+
+
+@pytest.mark.slow
+class TestTenantSimFullScale:
+    def test_full_scale(self):
+        cfg = SimConfig(
+            nodes=3,
+            tenants=200,
+            tables=3,
+            duration_s=45.0,
+            workers=6,
+            ingest_workers=2,
+            rows_per_table=30_000,
+            read_replicas=1,
+            lease_flap_at=0.72,
+            shard_move_at=0.8,
+            settle_timeout_s=40.0,
+        )
+        report = run_sim(cfg)
+        violations = report.violations()
+        detail = {
+            k: v
+            for k, v in report.to_dict().items()
+            if k not in ("config", "slo_rows")
+        }
+        assert not violations, f"{violations}\nreport: {detail}"
+        # at full scale the fault objective must complete a full
+        # burn -> recover cycle and followers must actually serve
+        assert "store_faults" in report.slo_burned_objectives, detail
+        assert "store_faults" in report.slo_recovered_objectives, detail
+        assert report.kill_recovered, detail
