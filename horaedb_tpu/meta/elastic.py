@@ -1,0 +1,980 @@
+"""Elastic shard management — a guarded, telemetry-fed control loop
+(ROADMAP item 3; ref: the HoraeMeta/PD scheduler half of the source
+paper, learned from the fleet's own telemetry instead of operator
+thresholds on static counts; StreamBox-HBM in PAPERS.md is the stance
+for the safety rails: capacity decisions react to *observed* pressure
+with hysteresis and never oscillate on a blip).
+
+PR 10 built every mechanism a self-balancing cluster needs — lease-
+fenced leader moves, a replica scheduler, typed fencing, watermark-lag
+metrics — and PR 11 built the proof harness. This module closes the
+loop: the coordinator periodically reads the fleet's own telemetry
+history (per-table query counts + admission queue wait from
+``system.public.query_stats``, asked of every node over the ordinary
+HTTP read path — each node answers for the statements IT finalized) and
+emits guarded actions through the existing machinery:
+
+- **scale up/down** per-shard read-replica counts (replacing the static
+  ``--read-replicas`` with a ``[cluster.elastic]`` policy): the FAST
+  load window alone triggers scale-out (a spike scales out *now*), but
+  scale-in needs BOTH the fast and the slow window under the down
+  threshold — the SLO burn-rate discipline applied to capacity;
+- **load-aware rebalancing**: move the hottest shard off the most-
+  loaded node, but only when the move strictly *reduces* the skew
+  (a single shard carrying all the load just flips the imbalance —
+  refused by construction), falling back to the old count-skew move
+  when loads are flat;
+- **pre-warmed cutover**: before a planned leader move the target opens
+  the shard follower-style (``open_table_follower`` via an ordinary
+  replica order) and tails the manifest until its watermark is fresh,
+  so the cutover serves from warm state instead of cratering p99.
+
+Robustness rails, all of them lint/regression-pinned:
+
+- per-shard cooldown + a global action budget per round;
+- hysteresis on both directions (the up/down threshold gap is validated
+  at config load);
+- a circuit breaker: ``quarantine_after`` failed/reverted moves opens
+  it (typed ``elastic_quarantined`` event); ``horaectl elastic release``
+  closes it;
+- ``dry_run``: decisions journal as typed events without acting;
+- degraded telemetry (no node answered, collection raised) ⇒ HOLD —
+  windows do not advance and nothing acts on missing data;
+- a flapping node (lease lapses, rejoins) never attracts replicas or
+  moves until it has been stably online ``node_stable_s``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..utils.metrics import REGISTRY
+
+logger = logging.getLogger("horaedb_tpu.meta.elastic")
+
+# ---------------------------------------------------------------------------
+# metric families (lint-enforced registry — tests/test_observability.py
+# TestElasticRegistryLint: registered live, convention-clean, documented,
+# no stray horaedb_elastic_* family outside this tuple)
+
+ELASTIC_METRIC_FAMILIES = (
+    "horaedb_elastic_actions_total",
+    "horaedb_elastic_round_duration_seconds",
+    "horaedb_elastic_telemetry_lag_seconds",
+)
+
+# every guarded action the loop can take, labeled on the actions family
+ELASTIC_ACTIONS = ("scale_up", "scale_down", "move", "prewarm", "quarantine")
+
+_M_ACTIONS = {
+    a: REGISTRY.counter(
+        "horaedb_elastic_actions_total",
+        "elastic control-loop actions applied, by kind",
+        labels={"action": a},
+    )
+    for a in ELASTIC_ACTIONS
+}
+_M_ROUND_S = REGISTRY.gauge(
+    "horaedb_elastic_round_duration_seconds",
+    "wall seconds the last elastic decision round took",
+)
+_M_TELEMETRY_LAG = REGISTRY.gauge(
+    "horaedb_elastic_telemetry_lag_seconds",
+    "age of the last successful fleet-telemetry collection (holds grow it)",
+)
+
+
+def _record_event(kind: str, **attrs) -> None:
+    from ..utils.events import record_event
+
+    try:
+        record_event(kind, **attrs)
+    except Exception:  # the journal must never fail a decision round
+        logger.exception("recording elastic event %s", kind)
+
+
+# ---------------------------------------------------------------------------
+# telemetry collection
+
+
+@dataclass
+class FleetLoad:
+    """One collection round's view of fleet load, aggregated per table."""
+
+    table_reads: dict = field(default_factory=dict)  # table -> statements
+    table_wait_s: dict = field(default_factory=dict)  # table -> queue wait
+    nodes_asked: int = 0
+    nodes_answered: int = 0
+
+
+class LoadInspector:
+    """Reads the fleet's own telemetry over the ordinary read path.
+
+    ``system.public.query_stats`` is per-node by design (system tables
+    answer about the node you asked), so the inspector asks EVERY online
+    node for the ledgers it finalized since the last round and sums them
+    client-side — that *is* the distributed read over the fleet's
+    history. System-table traffic (including these polls themselves) is
+    excluded by the ``system.`` prefix, and tables the topology does not
+    know (virtual tables, dropped tables) are ignored by the caller when
+    it folds tables onto shards.
+    """
+
+    def __init__(
+        self,
+        endpoints_fn: Callable[[], list],
+        timeout_s: float = 3.0,
+        sql_fn: Optional[Callable] = None,
+    ) -> None:
+        self.endpoints_fn = endpoints_fn
+        self.timeout_s = timeout_s
+        self._sql = sql_fn or self._http_sql
+        # per-node high-water mark of SUCCESSFULLY collected history: a
+        # node that missed a round is re-asked from its own last success,
+        # so its backlog arrives late instead of being dropped forever
+        self._last_ok_ms: dict = {}
+
+    # ledger sql prefixes that count as READ load (SELECT/EXPLAIN over
+    # any SQL wire, plus the protocol follower-serve ledgers)
+    _READ_PREFIXES = ("select", "explain", "promql:", "influxql:",
+                      "opentsdb:")
+
+    @classmethod
+    def _is_read(cls, sql) -> bool:
+        s = str(sql or "").lstrip().lower()
+        return s.startswith(cls._READ_PREFIXES)
+
+    def _http_sql(self, endpoint: str, query: str) -> list:
+        req = urllib.request.Request(
+            f"http://{endpoint}/sql",
+            data=json.dumps({"query": query}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            body = json.loads(resp.read().decode() or "{}")
+        return body.get("rows", [])
+
+    def collect(self, since_ms: int) -> Optional[FleetLoad]:
+        """Sum per-table statement counts + admission queue wait across
+        the fleet since ``since_ms``. Returns None — HOLD, never act —
+        when no node answered (degraded telemetry is not zero load)."""
+        endpoints = list(self.endpoints_fn())
+        load = FleetLoad(nodes_asked=len(endpoints))
+        poll_start_ms = int(time.time() * 1000)
+        for ep in endpoints:
+            ep_since = self._last_ok_ms.get(ep, int(since_ms))
+            query = (
+                "SELECT timestamp, table_name, sql, admission_wait_seconds "
+                "FROM system.public.query_stats "
+                f"WHERE timestamp >= {ep_since}"
+            )
+            try:
+                rows = self._sql(ep, query)
+            except Exception as e:
+                logger.warning("telemetry poll of %s failed: %s", ep, e)
+                # pin the node's mark at the since it still owes: the
+                # caller advances ITS mark on any successful round, and
+                # without the pin this node's un-collected backlog would
+                # silently fall off the load signal
+                self._last_ok_ms[ep] = ep_since
+                continue
+            # advance PAST the newest row actually received (rows can
+            # finalize between poll start and the server's evaluation —
+            # advancing only to poll start would re-count those next
+            # round; advancing to "now" would drop ones we never saw)
+            newest = max(
+                (int(r.get("timestamp") or 0) for r in rows),
+                default=0,
+            )
+            self._last_ok_ms[ep] = max(poll_start_ms, newest + 1)
+            load.nodes_answered += 1
+            for r in rows:
+                t = str(r.get("table_name") or "")
+                if not t or t.startswith("system."):
+                    continue
+                if not self._is_read(r.get("sql")):
+                    # the policy scales READ replicas: counting INSERT
+                    # ledgers as qps would mint followers (which cannot
+                    # serve writes) for ingest-only shards
+                    continue
+                load.table_reads[t] = load.table_reads.get(t, 0) + 1
+                w = r.get("admission_wait_seconds") or 0.0
+                if w:
+                    load.table_wait_s[t] = load.table_wait_s.get(t, 0.0) + float(w)
+        # forget nodes that left the topology (bounded state)
+        for ep in list(self._last_ok_ms):
+            if ep not in endpoints:
+                self._last_ok_ms.pop(ep, None)
+        if not load.nodes_answered:
+            # zero online nodes, or every poll failed: both are degraded
+            # telemetry (a full partition is NOT observed zero load)
+            return None
+        return load
+
+
+class _DualWindow:
+    """Fast/slow sliding load windows for one shard (the PR-11 SLO
+    burn-window discipline applied to load): one bounded deque of
+    samples, the slow sum maintained incrementally, the fast sum
+    refolded over the deque (bounded at slow_window / decide_interval
+    entries — a few dozen, never a history rescan)."""
+
+    __slots__ = ("fast_s", "slow_s", "_samples", "_fast_sum", "_slow_sum",
+                 "first_at")
+
+    def __init__(self, fast_s: float, slow_s: float) -> None:
+        self.fast_s = fast_s
+        self.slow_s = slow_s
+        # (t_mono, reads_for_fast, reads_for_slow, wait_s)
+        self._samples: deque = deque()
+        self._fast_sum = [0.0, 0.0]
+        self._slow_sum = [0.0, 0.0]
+        self.first_at: Optional[float] = None  # first sample ever seen
+
+    def covers_slow(self, now: float) -> bool:
+        """True once the window has observed a FULL slow span — before
+        that, a near-zero slow_qps means "no history", not "sustained
+        quiet", and scale-in must not act on it."""
+        return self.first_at is not None and now - self.first_at >= self.slow_s
+
+    def add(self, now: float, reads: float, wait_s: float,
+            span_s: float = 0.0) -> None:
+        """``span_s`` is the wall span the counts were collected over.
+        A sample spanning MORE than a window contributes only the
+        fraction that can lie inside it (evenly-spread assumption) —
+        otherwise the first collect after a telemetry outage would fold
+        the whole backlog into one instant and read as a fake spike
+        (scale-ups and moves on a shard that was never hot)."""
+        if self.first_at is None:
+            self.first_at = now
+        fast_r = reads
+        slow_r = reads
+        if span_s > self.fast_s:
+            fast_r = reads * self.fast_s / span_s
+        if span_s > self.slow_s:
+            slow_r = reads * self.slow_s / span_s
+        self._samples.append((now, fast_r, slow_r, wait_s))
+        self._fast_sum[0] += fast_r
+        self._fast_sum[1] += wait_s
+        self._slow_sum[0] += slow_r
+        self._slow_sum[1] += wait_s
+        self._expire(now)
+
+    def _expire(self, now: float) -> None:
+        # fast entries age into slow-only, then out entirely
+        while self._samples and self._samples[0][0] < now - self.slow_s:
+            _, _fr, sr, w = self._samples.popleft()
+            self._slow_sum[0] -= sr
+            self._slow_sum[1] -= w
+        fast_cut = now - self.fast_s
+        fr_sum = fw = 0.0
+        for t, fr, _sr, w in self._samples:
+            if t >= fast_cut:
+                fr_sum += fr
+                fw += w
+        self._fast_sum = [fr_sum, fw]
+
+    def fast_qps(self, now: float) -> float:
+        self._expire(now)
+        return self._fast_sum[0] / self.fast_s
+
+    def slow_qps(self, now: float) -> float:
+        self._expire(now)
+        return self._slow_sum[0] / self.slow_s
+
+    def fast_wait_rate(self, now: float) -> float:
+        """Admission queue-wait seconds per second over the fast window
+        (the node-pressure half of the load score)."""
+        self._expire(now)
+        return self._fast_sum[1] / self.fast_s
+
+
+@dataclass
+class _PendingMove:
+    shard_id: int
+    target: str
+    reason: str
+    started: float
+    deadline: float
+    prewarmed: bool  # target had (or was handed) a replica to tail
+    # True only when the prewarm INSTALLED a new replica for this move —
+    # only then does the shard need a +1 in desired_replicas (a target
+    # that was already an established replica is covered by the normal
+    # desired count; bumping would mint a spurious extra follower)
+    added: bool = False
+
+
+class ElasticController:
+    """The decision loop. Owns per-shard desired replica counts (the
+    ``ReplicaScheduler`` reads them through ``desired_replicas``),
+    schedules guarded moves, and keeps every rail's state.
+
+    Dependency-injected for tests and for the MetaServer wiring:
+
+    - ``inspector``      LoadInspector (or any .collect(since_ms))
+    - ``transfer``       fn(shard_id, to_node, reason) — raises on failure
+    - ``add_replica``    fn(shard_id, endpoint) — install a prewarm tail
+    - ``shard_watermarks`` fn(endpoint, shard_id) -> dict[table, wm_ms]
+                         or None (target's /debug/shards replica row)
+    """
+
+    def __init__(
+        self,
+        cfg,  # utils.config.ElasticSection
+        topology,
+        inspector,
+        *,
+        transfer: Optional[Callable] = None,
+        add_replica: Optional[Callable] = None,
+        shard_watermarks: Optional[Callable] = None,
+        now: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.cfg = cfg
+        self.topology = topology
+        self.inspector = inspector
+        self._transfer = transfer
+        self._add_replica = add_replica
+        self._shard_watermarks = shard_watermarks
+        self._now = now
+        self._lock = threading.RLock()
+        self._desired: dict[int, int] = {}
+        self._windows: dict[int, _DualWindow] = {}
+        self._last_action: dict[int, float] = {}
+        self._move_failures: dict[int, int] = {}
+        self._quarantined: dict[int, dict] = {}
+        self._pending: dict[int, _PendingMove] = {}
+        # (shard, target, verified-after) of the last applied move — a
+        # shard observed OFF its target on the next round counts as a
+        # reverted move toward the circuit breaker
+        self._verify: dict[int, tuple] = {}
+        self._last_round_at = 0.0
+        self._last_collect_at = 0.0
+        self._started_at = now()
+        self._last_move_at = -1e18  # GLOBAL move-cadence rail
+        self._round_thread: Optional[threading.Thread] = None
+        self._since_ms = int(time.time() * 1000)
+        self._rounds = 0
+        self._holds = 0
+        self._decisions: deque = deque(maxlen=32)
+
+    # ---- surface the meta server / scheduler read -----------------------
+
+    def desired_replicas(self) -> dict[int, int]:
+        """Per-shard follower counts for the ReplicaScheduler. Every
+        shard the controller has seen gets an entry, so the elastic
+        policy fully owns counts while enabled. A shard whose armed
+        move INSTALLED a prewarm replica counts one extra — the
+        scheduler must not strip the tailing target out from under the
+        cutover (a target that was already an established replica needs
+        no bump: it is covered by the ordinary desired count)."""
+        with self._lock:
+            out = dict(self._desired)
+            prewarming = [
+                sid for sid, pm in self._pending.items() if pm.added
+            ]
+        for s in self.topology.shards():
+            if s.shard_id not in out:
+                out[s.shard_id] = self._adopt_desired(s)
+        for sid in prewarming:
+            out[sid] = out.get(sid, 0) + 1
+        return out
+
+    def _adopt_desired(self, shard) -> int:
+        """First sight of a shard adopts its CURRENT replica count
+        (clamped into policy bounds) instead of yanking live replicas at
+        startup — scale-in happens only on sustained observed quiet."""
+        with self._lock:
+            got = self._desired.get(shard.shard_id)
+            if got is not None:
+                return got
+            adopted = max(
+                self.cfg.min_replicas,
+                min(self.cfg.max_replicas, len(shard.replicas)),
+            )
+            self._desired[shard.shard_id] = adopted
+            return adopted
+
+    def release(self, shard_id: int) -> bool:
+        """Close the circuit breaker for one shard (`horaectl elastic
+        release`): clears the quarantine AND the failure count."""
+        with self._lock:
+            q = self._quarantined.pop(int(shard_id), None)
+            self._move_failures.pop(int(shard_id), None)
+        if q is None:
+            return False
+        _record_event("elastic_released", shard_id=int(shard_id))
+        return True
+
+    def quarantined(self) -> dict[int, dict]:
+        with self._lock:
+            return dict(self._quarantined)
+
+    # ---- the decision round ---------------------------------------------
+
+    def maybe_run(self) -> bool:
+        """Kick one round if the cadence says so (called from the meta
+        tick). The round runs on its OWN daemon thread: telemetry
+        collection is serial blocking HTTP across the fleet (seconds
+        when nodes are down — exactly when the loop matters most), and
+        the tick thread also drives lease renewal / failover detection,
+        which must never stall behind it. At most one round runs at a
+        time. Never raises — a failed round logs and holds."""
+        now = self._now()
+        if now - self._last_round_at < self.cfg.decide_interval_s:
+            return False
+        t = self._round_thread
+        if t is not None and t.is_alive():
+            return False  # previous round still collecting: skip, no pile-up
+        self._last_round_at = now
+
+        def run():
+            try:
+                self.run_round()
+            except Exception:
+                logger.exception("elastic decision round failed (holding)")
+
+        t = threading.Thread(target=run, daemon=True, name="elastic-round")
+        self._round_thread = t
+        t.start()
+        return True
+
+    def run_round(self) -> list[dict]:
+        """One decision round. Returns the PLANNED actions (applied
+        unless dry_run)."""
+        t0 = self._now()
+        collect_from = self._since_ms
+        now_ms = int(time.time() * 1000)
+        load = None
+        try:
+            load = self.inspector.collect(collect_from)
+        except Exception as e:
+            logger.warning("telemetry collection raised: %s", e)
+        if load is None:
+            # degraded telemetry: HOLD — keep _since_ms so the next
+            # successful round sees the full gap, let the lag gauge grow,
+            # and touch nothing (never act on no data)
+            self._holds += 1
+            # age since the last SUCCESSFUL collection — a fleet that
+            # has never answered measures from controller start, so the
+            # most degraded state reads as the largest lag, never 0.0
+            base = self._last_collect_at or self._started_at
+            _M_TELEMETRY_LAG.set(max(0.0, self._now() - base))
+            _M_ROUND_S.set(self._now() - t0)
+            return []
+        self._since_ms = now_ms
+        self._last_collect_at = self._now()
+        _M_TELEMETRY_LAG.set(0.0)
+        self._rounds += 1
+
+        now = self._now()
+        span_s = max(0.001, (now_ms - collect_from) / 1000.0)
+        shard_qps, shard_slow, shard_wait = self._update_windows(
+            now, load, span_s
+        )
+        shards = {s.shard_id: s for s in self.topology.shards()}
+        planned: list[dict] = []
+        budget = [int(self.cfg.action_budget)]
+        # shards already acted on THIS round: a cutover planned at step 1
+        # must not be followed by a fresh decision for the same shard in
+        # steps 3/4 (the cooldown only lands when the plan APPLIES)
+        busy: set = set()
+
+        # 1) in-flight pre-warmed moves first — an armed cutover beats
+        #    starting anything new
+        self._advance_pending(now, planned, budget, busy)
+        # 2) revert detection feeds the breaker
+        self._check_reverts(now, shards)
+        # 3) replica-count policy (hysteresis + cooldown + budget)
+        self._decide_scaling(now, shards, shard_qps, shard_slow, planned,
+                             budget, busy)
+        # 4) load-aware rebalance (count-skew fallback)
+        if self.cfg.rebalance:
+            self._decide_move(now, shards, shard_qps, shard_wait, planned,
+                              budget, busy)
+
+        if planned:
+            _record_event(
+                "elastic_decision",
+                dry_run=bool(self.cfg.dry_run),
+                actions=[
+                    {k: v for k, v in p.items() if k != "apply"}
+                    for p in planned
+                ],
+                round=self._rounds,
+            )
+        for p in planned:
+            if self.cfg.dry_run:
+                continue  # journaled above, never acted on
+            apply = p.pop("apply", None)
+            if apply is None:
+                continue
+            try:
+                apply()
+            except Exception:
+                logger.exception("elastic action failed: %s", p)
+        self._decisions.append(
+            {
+                "at_ms": now_ms,
+                "actions": [
+                    {k: v for k, v in p.items() if k != "apply"}
+                    for p in planned
+                ],
+                "nodes_answered": load.nodes_answered,
+                "nodes_asked": load.nodes_asked,
+                "dry_run": bool(self.cfg.dry_run),
+            }
+        )
+        _M_ROUND_S.set(self._now() - t0)
+        return planned
+
+    # ---- round internals -------------------------------------------------
+
+    def _update_windows(self, now: float, load: FleetLoad,
+                        span_s: float = 0.0):
+        """Fold the per-table counts onto shards via the topology and
+        feed every shard's dual window (zero samples included — quiet
+        must decay the windows)."""
+        per_shard_reads: dict[int, float] = {}
+        per_shard_wait: dict[int, float] = {}
+        for tm in self.topology.tables():
+            r = load.table_reads.get(tm.name, 0)
+            w = load.table_wait_s.get(tm.name, 0.0)
+            if r or w:
+                per_shard_reads[tm.shard_id] = (
+                    per_shard_reads.get(tm.shard_id, 0.0) + r
+                )
+                per_shard_wait[tm.shard_id] = (
+                    per_shard_wait.get(tm.shard_id, 0.0) + w
+                )
+        fast: dict[int, float] = {}
+        slow: dict[int, float] = {}
+        wait: dict[int, float] = {}
+        with self._lock:
+            for s in self.topology.shards():
+                win = self._windows.get(s.shard_id)
+                if win is None:
+                    win = self._windows[s.shard_id] = _DualWindow(
+                        self.cfg.fast_window_s, self.cfg.slow_window_s
+                    )
+                win.add(
+                    now,
+                    per_shard_reads.get(s.shard_id, 0.0),
+                    per_shard_wait.get(s.shard_id, 0.0),
+                    span_s=span_s,
+                )
+                fast[s.shard_id] = win.fast_qps(now)
+                slow[s.shard_id] = win.slow_qps(now)
+                wait[s.shard_id] = win.fast_wait_rate(now)
+            # retired shards (merge) drop their window state
+            live = {s.shard_id for s in self.topology.shards()}
+            for sid in list(self._windows):
+                if sid not in live:
+                    self._windows.pop(sid, None)
+                    self._desired.pop(sid, None)
+        return fast, slow, wait
+
+    def _cooling(self, now: float, sid: int) -> bool:
+        return now - self._last_action.get(sid, -1e18) < self.cfg.cooldown_s
+
+    def _window_covers_slow(self, now: float, sid: int) -> bool:
+        with self._lock:
+            win = self._windows.get(sid)
+        return win is not None and win.covers_slow(now)
+
+    def _movable(self, sid: int) -> bool:
+        """The controller never moves the shard holding its OWN
+        telemetry source (the self-monitoring samples table): moving the
+        observer's history store under the loop that reads it is a
+        self-inflicted partition (mid-move holds). Operators can still
+        migrate it explicitly."""
+        return all(
+            not t.name.startswith("system_metrics")
+            for t in self.topology.tables_of_shard(sid)
+        )
+
+    def _stable_nodes(self, now: float) -> dict[str, float]:
+        """endpoint -> online_since for nodes stable long enough to
+        RECEIVE work (a flapping node pulls nothing until it has been
+        back ``node_stable_s``)."""
+        return {
+            n.endpoint: n.online_since
+            for n in self.topology.online_nodes()
+            if now - n.online_since >= self.cfg.node_stable_s
+        }
+
+    def _mark_action(self, sid: int, action: str) -> None:
+        self._last_action[sid] = self._now()
+        c = _M_ACTIONS.get(action)
+        if c is not None:
+            c.inc()
+
+    def _move_cooldown_s(self) -> float:
+        mc = self.cfg.move_cooldown_s
+        return mc if mc > 0 else self.cfg.slow_window_s
+
+    def _decide_scaling(self, now, shards, fast, slow, planned, budget, busy):
+        cfg = self.cfg
+        online = len(self.topology.online_nodes())
+        # hottest first: under a tight budget the worst shard wins
+        for sid in sorted(shards, key=lambda s: -fast.get(s, 0.0)):
+            if budget[0] <= 0:
+                break
+            shard = shards[sid]
+            if shard.node is None or sid in self._quarantined or sid in busy:
+                continue
+            if self._cooling(now, sid):
+                continue
+            desired = self._adopt_desired(shard)
+            ceiling = min(cfg.max_replicas, max(0, online - 1))
+            f, sl = fast.get(sid, 0.0), slow.get(sid, 0.0)
+            if f >= cfg.scale_up_qps and desired < ceiling:
+                planned.append(
+                    self._scale_plan(sid, desired, desired + 1, "scale_up",
+                                     f, sl)
+                )
+                busy.add(sid)
+                budget[0] -= 1
+            elif (
+                f <= cfg.scale_down_qps
+                and sl <= cfg.scale_down_qps
+                and desired > cfg.min_replicas
+                and self._window_covers_slow(now, sid)
+            ):
+                # scale-in needs BOTH windows quiet AND a full slow span
+                # of observation: a spike scales out now, calm must be
+                # sustained — and a freshly-(re)started controller has
+                # not yet observed anything to call "sustained"
+                planned.append(
+                    self._scale_plan(sid, desired, desired - 1, "scale_down",
+                                     f, sl)
+                )
+                busy.add(sid)
+                budget[0] -= 1
+
+    def _scale_plan(self, sid, from_n, to_n, action, fast_qps, slow_qps):
+        def apply():
+            with self._lock:
+                self._desired[sid] = to_n
+            self._mark_action(sid, action)
+            _record_event(
+                "elastic_action", action=action, shard_id=sid,
+                replicas_from=from_n, replicas_to=to_n,
+                fast_qps=round(fast_qps, 3), slow_qps=round(slow_qps, 3),
+            )
+
+        return {
+            "action": action, "shard_id": sid,
+            "replicas_from": from_n, "replicas_to": to_n,
+            "fast_qps": round(fast_qps, 3), "slow_qps": round(slow_qps, 3),
+            "apply": apply,
+        }
+
+    def _decide_move(self, now, shards, fast, wait, planned, budget, busy):
+        if budget[0] <= 0 or len(self.topology.online_nodes()) < 2:
+            return
+        cfg = self.cfg
+        if now - self._last_move_at < self._move_cooldown_s():
+            # global move cadence: at most one move per cooldown, however
+            # many shards look eligible — churn-proof by construction
+            return
+        if self._pending:
+            return  # one cutover in flight at a time
+        stable = self._stable_nodes(now)
+        if not stable:
+            return
+        # node score = served qps + queue-wait pressure (a node whose
+        # admission queues back up is hotter than its raw qps says)
+        score: dict[str, float] = {
+            n.endpoint: 0.0 for n in self.topology.online_nodes()
+        }
+        owner_shards: dict[str, list] = {}
+        for sid, s in shards.items():
+            if s.node in score:
+                score[s.node] += fast.get(sid, 0.0) + 10.0 * wait.get(sid, 0.0)
+                owner_shards.setdefault(s.node, []).append(sid)
+        hot_node = max(score, key=lambda e: (score[e], e))
+        cold_pool = [e for e in stable if e != hot_node]
+        if not cold_pool:
+            return
+        cold_node = min(cold_pool, key=lambda e: (score.get(e, 0.0), e))
+        diff = score[hot_node] - score.get(cold_node, 0.0)
+        candidates = sorted(
+            owner_shards.get(hot_node, ()),
+            key=lambda sid: -fast.get(sid, 0.0),
+        )
+        for sid in candidates:
+            q = fast.get(sid, 0.0)
+            if (
+                q >= cfg.min_move_qps
+                and q < diff  # the move must strictly REDUCE the skew —
+                # a lone shard carrying all the load would just flip it
+                and sid not in self._quarantined
+                and sid not in self._pending
+                and sid not in busy
+                and not self._cooling(now, sid)
+                and self._movable(sid)
+            ):
+                planned.append(
+                    self._move_plan(sid, hot_node, cold_node, q, "load")
+                )
+                busy.add(sid)
+                budget[0] -= 1
+                return
+        # count-skew fallback (the old RebalancedScheduler's job, kept
+        # here so enabling elastic never loses count balancing): when
+        # loads are flat, move the COLDEST shard off the biggest node
+        counts = {
+            n.endpoint: len(owner_shards.get(n.endpoint, ()))
+            for n in self.topology.online_nodes()
+        }
+        big = max(counts, key=lambda e: (counts[e], e))
+        small_pool = [e for e in stable if e != big]
+        if not small_pool:
+            return
+        small = min(small_pool, key=lambda e: (counts.get(e, 0), e))
+        if counts[big] - counts.get(small, 0) <= 1:
+            return
+        for sid in sorted(
+            owner_shards.get(big, ()), key=lambda s: (fast.get(s, 0.0), s)
+        ):
+            if (
+                sid not in self._quarantined
+                and sid not in self._pending
+                and sid not in busy
+                and not self._cooling(now, sid)
+                and self._movable(sid)
+            ):
+                planned.append(
+                    self._move_plan(sid, big, small, fast.get(sid, 0.0),
+                                    "count")
+                )
+                busy.add(sid)
+                budget[0] -= 1
+                return
+
+    def _move_plan(self, sid, from_node, to_node, qps, why):
+        cfg = self.cfg
+
+        def apply():
+            now = self._now()
+            self._last_move_at = now  # the DECISION starts the cadence
+            shard = self.topology.shard(sid)
+            if shard is None:
+                return
+            pm = _PendingMove(
+                sid, to_node, why, now, now + cfg.prewarm_timeout_s, False
+            )
+            # register the pending move BEFORE installing the prewarm
+            # replica: desired_replicas() reads _pending, and a
+            # ReplicaScheduler tick racing the install would otherwise
+            # see no +1 and strip the just-appended tailing target
+            with self._lock:
+                self._pending[sid] = pm
+            if cfg.prewarm:
+                if to_node in shard.replicas:
+                    pm.prewarmed = True  # already tailing the manifest
+                elif self._add_replica is not None:
+                    pm.prewarmed = pm.added = True  # visible to the
+                    # scheduler before the install lands
+                    try:
+                        self._add_replica(sid, to_node)
+                        self._mark_action(sid, "prewarm")
+                        _record_event(
+                            "elastic_action", action="prewarm", shard_id=sid,
+                            target=to_node, reason=why,
+                        )
+                    except Exception:
+                        pm.prewarmed = pm.added = False
+                        logger.exception("prewarm of shard %d failed", sid)
+            if not pm.prewarmed:
+                # no tail to wait for: cut over on the next round
+                pm.deadline = now
+
+        return {
+            "action": "move", "shard_id": sid, "from": from_node,
+            "to": to_node, "qps": round(qps, 3), "reason": why,
+            "prewarm": bool(cfg.prewarm), "apply": apply,
+        }
+
+    def _advance_pending(self, now, planned, budget, busy):
+        """Armed moves: cut over once the target's tailed watermark is
+        fresh (every table of the shard has an installed flush) or the
+        prewarm deadline passes — prewarm is an optimization, never a
+        gate that can wedge a move forever."""
+        for sid, pm in list(self._pending.items()):
+            busy.add(sid)  # no fresh decision for an armed shard
+            if sid in self._quarantined:
+                self._pending.pop(sid, None)
+                continue
+            shard = self.topology.shard(sid)
+            if shard is None or shard.node == pm.target:
+                self._pending.pop(sid, None)  # retired or already there
+                continue
+            ready = now >= pm.deadline
+            if not ready and pm.prewarmed and self._shard_watermarks:
+                try:
+                    wms = self._shard_watermarks(pm.target, sid)
+                except Exception:
+                    wms = None
+                if wms is not None:
+                    names = {
+                        t.name for t in self.topology.tables_of_shard(sid)
+                    }
+                    ready = bool(names) and all(
+                        wms.get(n, 0) > 0 for n in names
+                    )
+            if not ready:
+                continue
+            if budget[0] <= 0:
+                return
+            budget[0] -= 1
+            self._pending.pop(sid, None)
+            planned.append(self._cutover_plan(pm))
+
+    def _cutover_plan(self, pm: _PendingMove):
+        def apply():
+            try:
+                if self._transfer is not None:
+                    self._transfer(pm.shard_id, pm.target,
+                                   f"elastic-{pm.reason}")
+            except Exception as e:
+                logger.warning(
+                    "elastic move of shard %d -> %s failed: %s",
+                    pm.shard_id, pm.target, e,
+                )
+                self._note_move_failure(pm.shard_id, str(e))
+                return
+            self._mark_action(pm.shard_id, "move")
+            with self._lock:
+                self._move_failures.pop(pm.shard_id, None)
+                self._verify[pm.shard_id] = (pm.target, self._now())
+            _record_event(
+                "elastic_action", action="move", shard_id=pm.shard_id,
+                target=pm.target, reason=pm.reason,
+                prewarmed=pm.prewarmed,
+            )
+
+        return {
+            "action": "move", "shard_id": pm.shard_id, "to": pm.target,
+            "reason": pm.reason, "cutover": True, "apply": apply,
+        }
+
+    def _check_reverts(self, now, shards) -> None:
+        """A shard observed OFF the target we moved it to (failover or a
+        competing scheduler undid the move) counts toward the breaker —
+        repeatedly fighting the rest of the system is exactly the
+        oscillation the breaker exists to stop."""
+        for sid, (target, at) in list(self._verify.items()):
+            shard = shards.get(sid)
+            if shard is None:
+                self._verify.pop(sid, None)
+                continue
+            if now - at < self.cfg.decide_interval_s:
+                continue
+            self._verify.pop(sid, None)
+            if shard.node != target:
+                self._note_move_failure(
+                    sid, f"reverted: on {shard.node}, expected {target}"
+                )
+
+    def _note_move_failure(self, sid: int, why: str) -> None:
+        with self._lock:
+            n = self._move_failures.get(sid, 0) + 1
+            self._move_failures[sid] = n
+            self._last_action[sid] = self._now()  # failed moves cool too
+            opened = (
+                n >= self.cfg.quarantine_after
+                and sid not in self._quarantined
+            )
+            if opened:
+                self._quarantined[sid] = {
+                    "failures": n,
+                    "reason": why,
+                    "at_ms": int(time.time() * 1000),
+                }
+        if opened:
+            self._mark_action(sid, "quarantine")
+            _record_event(
+                "elastic_quarantined", shard_id=sid, failures=n, reason=why,
+            )
+            logger.warning(
+                "shard %d QUARANTINED after %d failed moves (%s) — "
+                "release with `horaectl elastic release %d`",
+                sid, n, why, sid,
+            )
+
+    # ---- introspection ---------------------------------------------------
+
+    def status(self) -> dict:
+        """The /meta/v1/elastic document (horaectl elastic)."""
+        now = self._now()
+        with self._lock:
+            shard_rows = []
+            for sid in sorted(self._windows):
+                win = self._windows[sid]
+                shard_rows.append(
+                    {
+                        "shard_id": sid,
+                        "fast_qps": round(win.fast_qps(now), 3),
+                        "slow_qps": round(win.slow_qps(now), 3),
+                        "wait_rate": round(win.fast_wait_rate(now), 4),
+                        "desired_replicas": self._desired.get(sid, 0),
+                        "cooldown_remaining_s": round(
+                            max(
+                                0.0,
+                                self.cfg.cooldown_s
+                                - (now - self._last_action.get(sid, -1e18)),
+                            ),
+                            2,
+                        ),
+                        "move_failures": self._move_failures.get(sid, 0),
+                        "quarantined": sid in self._quarantined,
+                        "pending_move": (
+                            self._pending[sid].target
+                            if sid in self._pending
+                            else None
+                        ),
+                    }
+                )
+            return {
+                "enabled": True,
+                "dry_run": bool(self.cfg.dry_run),
+                "rounds": self._rounds,
+                "holds": self._holds,
+                "policy": {
+                    "min_replicas": self.cfg.min_replicas,
+                    "max_replicas": self.cfg.max_replicas,
+                    "scale_up_qps": self.cfg.scale_up_qps,
+                    "scale_down_qps": self.cfg.scale_down_qps,
+                    "fast_window_s": self.cfg.fast_window_s,
+                    "slow_window_s": self.cfg.slow_window_s,
+                    "decide_interval_s": self.cfg.decide_interval_s,
+                    "cooldown_s": self.cfg.cooldown_s,
+                    "action_budget": self.cfg.action_budget,
+                    "quarantine_after": self.cfg.quarantine_after,
+                    "node_stable_s": self.cfg.node_stable_s,
+                    "rebalance": self.cfg.rebalance,
+                    "prewarm": self.cfg.prewarm,
+                    "move_cooldown_s": self._move_cooldown_s(),
+                },
+                "move_cooldown_remaining_s": round(
+                    max(
+                        0.0,
+                        self._move_cooldown_s() - (now - self._last_move_at),
+                    ),
+                    2,
+                ),
+                "shards": shard_rows,
+                "quarantined": {
+                    str(k): v for k, v in self._quarantined.items()
+                },
+                "recent_decisions": list(self._decisions),
+            }

@@ -176,10 +176,12 @@ class ReplicaChange:
 
 
 class ReplicaScheduler:
-    """Keep every ASSIGNED shard at ``read_replicas`` follower nodes
+    """Keep every ASSIGNED shard at its desired follower count
     (scale-out serving for hot shards: followers open the shard
     read-only over the shared object store and serve bounded-staleness
-    reads; writes stay single-leader).
+    reads; writes stay single-leader). The count is ``read_replicas``
+    globally, overridden per shard by ``desired_fn`` — the elastic
+    control loop (meta/elastic) owns that map when enabled.
 
     Placement: existing healthy replicas are kept (placement stability —
     a follower's tailed manifest state and warmed scan cache are worth
@@ -187,18 +189,40 @@ class ReplicaScheduler:
     fill least-loaded-first (replica-slots held across all shards) with
     a deterministic per-(shard, node) hash tiebreak, so followers spread
     instead of piling onto one node and placement is stable across meta
-    restarts."""
+    restarts. NEW picks additionally require the candidate node to have
+    been online ``min_candidate_online_s`` — a flapping node must not
+    attract replicas on every rejoin (kept replicas are exempt: an
+    established follower's warmed state outlives a blip)."""
 
-    def __init__(self, topology: TopologyManager, read_replicas: int) -> None:
+    def __init__(
+        self,
+        topology: TopologyManager,
+        read_replicas: int,
+        desired_fn=None,  # () -> dict[shard_id, count] (elastic policy)
+        min_candidate_online_s: float = 0.0,
+    ) -> None:
         self.topology = topology
         self.read_replicas = read_replicas
+        self.desired_fn = desired_fn
+        self.min_candidate_online_s = min_candidate_online_s
 
     def schedule(self) -> list[ReplicaChange]:
-        if self.read_replicas <= 0:
+        desired: dict[int, int] = {}
+        if self.desired_fn is not None:
+            desired = self.desired_fn() or {}
+        if self.read_replicas <= 0 and not desired:
             return []
+        # NB: a desired map with zeros still runs — shards scaled down
+        # to 0 need their existing replicas stripped
         online = {n.endpoint for n in self.topology.online_nodes()}
         if not online:
             return []
+        now = time.monotonic()
+        stable = {
+            n.endpoint
+            for n in self.topology.online_nodes()
+            if now - n.online_since >= self.min_candidate_online_s
+        }
         # replica-slot load per node, across ALL shards (kept + planned)
         load: dict[str, int] = {e: 0 for e in online}
         shards = sorted(self.topology.shards(), key=lambda s: s.shard_id)
@@ -213,9 +237,10 @@ class ReplicaScheduler:
                     out.append(ReplicaChange(s.shard_id, (), "leaderless"))
                 continue
             keep = [r for r in s.replicas if r in online and r != s.node]
-            want = min(self.read_replicas, max(0, len(online - {s.node})))
+            want_n = desired.get(s.shard_id, self.read_replicas)
+            want = min(max(0, want_n), max(0, len(online - {s.node})))
             if len(keep) < want:
-                candidates = sorted(online - {s.node} - set(keep))
+                candidates = sorted(stable - {s.node} - set(keep))
                 while len(keep) < want and candidates:
                     pick = min(
                         candidates,

@@ -80,12 +80,14 @@ class MetaServer:
         election=None,  # meta.election.FileLease — HA mode
         kv_factory=None,  # () -> LeaseKV over SHARED storage (HA mode)
         read_replicas: int = 0,  # follower read-replicas per shard
+        elastic=None,  # utils.config.ElasticSection — self-driving loop
     ) -> None:
         self.num_shards = num_shards
         self.lease_ttl_s = lease_ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.rebalance = rebalance
         self.read_replicas = read_replicas
+        self.elastic_cfg = elastic if (elastic and elastic.enabled) else None
         self.election = election
         self.kv_factory = kv_factory
         # One mutation at a time: the reference gets global DDL ordering
@@ -116,11 +118,53 @@ class MetaServer:
         self.schedulers = [
             ReopenScheduler(self.topology), StaticScheduler(self.topology),
         ]
-        if self.rebalance:
+        # The elastic controller's load-aware move subsumes the count-
+        # based rebalancer (it keeps count balancing as its flat-load
+        # fallback); running both would let the count scheduler undo an
+        # elastic move one tick later (ping-pong). A DRY-RUN controller
+        # never moves anything, so it must not displace the real
+        # rebalancer — previewing decisions must not change behavior.
+        elastic_rebalance = (
+            self.elastic_cfg is not None
+            and self.elastic_cfg.rebalance
+            and not self.elastic_cfg.dry_run
+        )
+        if self.rebalance and not elastic_rebalance:
             self.schedulers.append(RebalancedScheduler(self.topology))
+        self.elastic_controller = None
+        if self.elastic_cfg is not None:
+            from .elastic import ElasticController, LoadInspector
+
+            self.elastic_controller = ElasticController(
+                self.elastic_cfg,
+                self.topology,
+                LoadInspector(
+                    lambda: [
+                        n.endpoint for n in self.topology.online_nodes()
+                    ],
+                    timeout_s=self.elastic_cfg.telemetry_timeout_s,
+                ),
+                transfer=self._elastic_transfer,
+                add_replica=self._elastic_add_replica,
+                shard_watermarks=self._elastic_shard_watermarks,
+            )
+        desired_fn = (
+            self.elastic_controller.desired_replicas
+            if self.elastic_controller is not None
+            else None
+        )
         self.replica_scheduler = (
-            ReplicaScheduler(self.topology, self.read_replicas)
-            if self.read_replicas > 0
+            ReplicaScheduler(
+                self.topology,
+                self.read_replicas,
+                desired_fn=desired_fn,
+                min_candidate_online_s=(
+                    self.elastic_cfg.node_stable_s
+                    if self.elastic_cfg is not None
+                    else 0.0
+                ),
+            )
+            if self.read_replicas > 0 or self.elastic_controller is not None
             else None
         )
         self.procedures = ProcedureManager(
@@ -208,6 +252,9 @@ class MetaServer:
             )
         if self.replica_scheduler is not None:
             self._apply_replica_changes(self.replica_scheduler.schedule())
+        if self.elastic_controller is not None:
+            # cadence-gated internally; a failed round holds, never raises
+            self.elastic_controller.maybe_run()
         self.procedures.tick()
 
     def _apply_replica_changes(self, changes) -> None:
@@ -231,6 +278,59 @@ class MetaServer:
                           self._shard_order(view, role="replica"))
                 except Exception:
                     pass  # heartbeat reconcile delivers it
+
+    # ---- elastic actuators (meta/elastic.ElasticController deps) --------
+
+    def _elastic_transfer(self, shard_id: int, to_node: str, reason: str) -> None:
+        """Execute one elastic leader move; raises on failure (the
+        controller's circuit breaker counts it). _run_admin_proc
+        semantics on purpose: a failed elastic move must CANCEL its
+        queued background retry — the controller re-decides from fresh
+        telemetry instead of letting a stale decision keep retrying."""
+        online = {n.endpoint for n in self.topology.online_nodes()}
+        if to_node not in online:
+            raise RuntimeError(f"elastic target {to_node} not online")
+        self._run_admin_proc(
+            "transfer_shard",
+            {"shard_id": int(shard_id), "to_node": to_node, "reason": reason},
+        )
+
+    def _elastic_add_replica(self, shard_id: int, endpoint: str) -> None:
+        """Install a pre-warm follower on ``endpoint``: the ordinary
+        replica order (open read-only + manifest tail) delivered through
+        the same set_replicas/push path the ReplicaScheduler uses. The
+        controller raises the shard's desired count for the pending move,
+        so the scheduler will not strip the extra follower meanwhile."""
+        from .scheduler import ReplicaChange
+
+        shard = self.topology.shard(int(shard_id))
+        if shard is None:
+            raise RuntimeError(f"shard {shard_id} does not exist")
+        replicas = tuple(dict.fromkeys((*shard.replicas, endpoint)))
+        self._apply_replica_changes(
+            [ReplicaChange(int(shard_id), replicas, "elastic-prewarm")]
+        )
+
+    def _elastic_shard_watermarks(self, endpoint: str, shard_id: int):
+        """The pre-warm freshness probe: the target's /debug/shards
+        replica row carries per-table watermarks (ms of the last
+        installed flush). None = not tailing yet / unreachable."""
+        req = urllib.request.Request(f"http://{endpoint}/debug/shards")
+        try:
+            with urllib.request.urlopen(req, timeout=3.0) as resp:
+                body = json.loads(resp.read().decode() or "{}")
+        except Exception:
+            return None
+        for row in body.get("shards", []):
+            if (
+                row.get("shard_id") == int(shard_id)
+                and row.get("role") == "replica"
+            ):
+                return {
+                    str(k): int(v)
+                    for k, v in (row.get("watermarks_ms") or {}).items()
+                }
+        return None
 
     # ---- procedure bodies ----------------------------------------------
     # The three shard-mutating procedure bodies take _ddl_lock THEMSELVES
@@ -852,6 +952,32 @@ def create_meta_app(server: MetaServer) -> web.Application:
             {"status": "ok", "leader": server.is_leader}
         )
 
+    async def elastic_status(request: web.Request) -> web.Response:
+        ctl = getattr(server, "elastic_controller", None)
+        if ctl is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(ctl.status())
+
+    async def elastic_release(request: web.Request) -> web.Response:
+        ctl = getattr(server, "elastic_controller", None)
+        if ctl is None:
+            return web.json_response(
+                {"error": "elastic control loop not enabled"}, status=400
+            )
+        try:
+            body = await request.json()
+            shard_id = int(body["shard_id"])
+        except Exception as e:
+            return web.json_response(
+                {"error": f"body must be {{'shard_id': n}}: {e}"}, status=400
+            )
+        released = ctl.release(shard_id)
+        if not released:
+            return web.json_response(
+                {"error": f"shard {shard_id} is not quarantined"}, status=404
+            )
+        return web.json_response({"released": True, "shard_id": shard_id})
+
     app.router.add_post("/meta/v1/node/heartbeat", heartbeat)
     app.router.add_post("/meta/v1/table/create", create_table)
     app.router.add_post("/meta/v1/table/drop", drop_table)
@@ -863,6 +989,8 @@ def create_meta_app(server: MetaServer) -> web.Application:
     app.router.add_get("/meta/v1/nodes", nodes)
     app.router.add_get("/meta/v1/shards", shards)
     app.router.add_get("/meta/v1/procedures", procedures)
+    app.router.add_get("/meta/v1/elastic", elastic_status)
+    app.router.add_post("/meta/v1/elastic/release", elastic_release)
     app.router.add_get("/health", health)
     return app
 
@@ -891,7 +1019,22 @@ def main() -> None:
     p.add_argument("--num-shards", type=int, default=8)
     p.add_argument(
         "--read-replicas", type=int, default=0,
-        help="follower read-replicas per shard (0 = no replicated reads)",
+        help="follower read-replicas per shard (0 = no replicated reads; "
+             "superseded per shard by the [cluster.elastic] policy)",
+    )
+    p.add_argument(
+        "--config", default=None,
+        help="TOML config file; its [cluster.elastic] section enables the "
+             "self-driving elastic control loop",
+    )
+    p.add_argument(
+        "--elastic", action="store_true",
+        help="enable the elastic control loop with default policy knobs "
+             "(equivalent to [cluster.elastic] enabled = true)",
+    )
+    p.add_argument(
+        "--elastic-dry-run", action="store_true",
+        help="elastic loop journals decisions as events without acting",
     )
     p.add_argument("--lease-ttl", type=float, default=5.0)
     p.add_argument("--heartbeat-timeout", type=float, default=6.0)
@@ -899,6 +1042,19 @@ def main() -> None:
     p.add_argument("--log-level", default="info")
     args = p.parse_args()
     logging.basicConfig(level=args.log_level.upper())
+    elastic = None
+    if args.config:
+        from ..utils.config import Config
+
+        elastic = Config.load(args.config).cluster.elastic
+    if args.elastic or args.elastic_dry_run:
+        if elastic is None:
+            from ..utils.config import ElasticSection
+
+            elastic = ElasticSection()
+        elastic.enabled = True
+        if args.elastic_dry_run:
+            elastic.dry_run = True
     if args.ha_dir:
         from .lease import make_lease
 
@@ -911,6 +1067,7 @@ def main() -> None:
             election=make_lease(target, advertise, ttl_s=args.election_ttl),
             kv_factory=lambda: FileKV(f"{args.ha_dir}/meta.kv"),
             read_replicas=args.read_replicas,
+            elastic=elastic,
         )
     else:
         kv = FileKV(f"{args.data_dir}/meta.kv") if args.data_dir else MemoryKV()
@@ -920,6 +1077,7 @@ def main() -> None:
             lease_ttl_s=args.lease_ttl,
             heartbeat_timeout_s=args.heartbeat_timeout,
             read_replicas=args.read_replicas,
+            elastic=elastic,
         )
     server.start_loop(args.tick_interval)
     app = create_meta_app(server)

@@ -215,6 +215,7 @@ class Proxy:
             with span("parse_plan"):
                 plan = self.conn._cached_plan(sql)
             table = getattr(plan, "table", None)
+            ledger.set_table(table)
             self.limiter.check(table)
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))

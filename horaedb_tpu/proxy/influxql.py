@@ -979,6 +979,43 @@ def _apply_transforms(rows: list[list], flat: list, width) -> list[list]:
 # ---- evaluation -----------------------------------------------------------
 
 
+def replica_read_targets(query: str):
+    """(measurements, end_ms) when EVERY statement is a plain
+    measurement SELECT whose guaranteed (top-level AND) time conditions
+    include an upper bound — the historical shape a bounded-staleness
+    follower replica may serve; None otherwise (open-tail range, SHOW,
+    subqueries). ``end_ms`` is the exclusive end the follower's
+    watermark must cover — the LARGEST of the per-statement upper
+    bounds, each statement taking its TIGHTEST bound (any guaranteed
+    conjunct bounds every matching row)."""
+    try:
+        stmts = _split_statements(query)
+        if not stmts:
+            return None
+        tables: list[str] = []
+        ends: list[int] = []
+        for toks in stmts:
+            sel = _Parser(toks).parse()
+            if sel.sub is not None or not sel.measurement:
+                return None
+            upper = None
+            for _col, op, v in sel.guaranteed_time_conds():
+                if op == "<":
+                    end = int(v)
+                elif op in ("<=", "="):
+                    end = int(v) + 1
+                else:
+                    continue
+                upper = end if upper is None else min(upper, end)
+            if upper is None:
+                return None
+            tables.append(sel.measurement)
+            ends.append(upper)
+        return tables, max(ends)
+    except Exception:
+        return None  # unparseable here: the normal path reports it
+
+
 def evaluate(conn, query: str) -> dict:
     """Run InfluxQL -> the v1 /query response body (one results entry per
     ';'-separated statement, matching the wire contract)."""

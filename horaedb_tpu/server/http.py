@@ -987,6 +987,225 @@ def create_app(
         proxy.wlm.dedup.bump_epoch()
         return web.json_response({"affected_rows": n})
 
+    async def _follower_protocol(
+        request: web.Request,
+        tables: list,
+        end_ms: Optional[int],
+        proto: str,
+        run_local,
+        respond,
+    ) -> Optional[web.Response]:
+        """Follower routing for the non-SQL read wires (PromQL /
+        InfluxQL / OpenTSDB) — the same serve-locally / offload-to-
+        replica / fall-back-to-leader discipline the SQL gateway runs,
+        with ``route=follower`` stamped into ``system.public.query_stats``.
+
+        Returns a Response when a replica served (or typed-refused a
+        forwarded replica read), or None meaning "handle normally" —
+        local evaluation or the ordinary leader forward. ``end_ms`` is
+        the exclusive upper time bound the query needs covered;
+        ``run_local`` evaluates the query (worker thread), ``respond``
+        wraps its output into the protocol's response shape."""
+        from ..cluster.replica import (
+            ReplicaFencedError,
+            ReplicaStaleError,
+            note_replica_read,
+            replica_serving,
+        )
+
+        replica_read = bool(request.headers.get(REPLICA_READ_HEADER))
+        if (
+            router is None
+            or cluster is None
+            or not _follower_reads_enabled()
+            or not tables
+        ):
+            if replica_read:
+                # a forwarded replica read must get the TYPED refusal so
+                # the origin falls back to the leader, never a silent
+                # unfenced local evaluation
+                return web.json_response(
+                    {
+                        "error": f"{proto} read not replica-servable here",
+                        "replica": "replica_fenced",
+                    },
+                    status=503,
+                )
+            return None
+        staleness_ms = _parse_staleness(request.headers.get(STALENESS_HEADER))
+        if staleness_ms is None:
+            staleness_ms = request.app.get("read_staleness_ms") or 0
+        epoch_hdr = request.headers.get(REPLICA_EPOCH_HEADER, "")
+        expected_epoch = int(epoch_hdr) if epoch_hdr.isdigit() else None
+
+        if all(cluster.serves_replica(t) for t in tables):
+
+            def serve():
+                import time as _time
+
+                from ..utils.querystats import finish_ledger, start_ledger
+
+                worst_lag = 0
+                epoch0 = 0
+                for i, t in enumerate(tables):
+                    epoch, data = cluster.replica_read_state(
+                        t,
+                        expected_epoch=(
+                            expected_epoch if len(tables) == 1 else None
+                        ),
+                    )
+                    if i == 0:
+                        epoch0 = epoch
+                    wm = data.follower_watermark_ms()
+                    if end_ms is None or end_ms > wm:
+                        # opportunistic catch-up before refusing (the
+                        # tail loop may not have run since the last flush)
+                        try:
+                            data.refresh_from_manifest()
+                            wm = data.follower_watermark_ms()
+                        except Exception:
+                            pass
+                    now_ms = int(_time.time() * 1000)
+                    lag_ms = max(0, now_ms - wm) if wm > 0 else now_ms
+                    covered = end_ms is not None and end_ms <= wm
+                    if not covered and not (
+                        staleness_ms and wm > 0 and lag_ms <= staleness_ms
+                    ):
+                        raise ReplicaStaleError(
+                            f"{proto} read needs data beyond follower "
+                            f"watermark {wm} for {t!r} (lag {lag_ms}ms)",
+                            epoch=epoch,
+                            watermark_ms=wm,
+                        )
+                    worst_lag = max(worst_lag, lag_ms)
+                # one ledger per served statement, like the SQL proxy —
+                # query_stats carries route=follower + replica_lag_ms
+                ledger, tok = start_ledger(None, f"{proto}: {tables[0]}")
+                t0 = _time.perf_counter()
+                try:
+                    with replica_serving(tables[0], epoch0, worst_lag):
+                        out = run_local()
+                except BaseException:
+                    # a failed evaluation was NOT follower-served: close
+                    # the ledger without recording, or query_stats (and
+                    # the elastic load signal reading it) would carry a
+                    # phantom route=follower row for a query the normal
+                    # path re-runs
+                    finish_ledger(ledger, tok, 0.0, record_stats=False)
+                    raise
+                ledger.set_route("follower")
+                ledger.set_table(tables[0])
+                ledger.add(replica_lag_ms=worst_lag)
+                finish_ledger(ledger, tok, _time.perf_counter() - t0)
+                return out, epoch0, worst_lag
+
+            loop = asyncio.get_running_loop()
+            try:
+                out, epoch, lag_ms = await loop.run_in_executor(None, serve)
+            except ReplicaStaleError as e:
+                if replica_read:
+                    return web.json_response(
+                        {"error": str(e), "replica": "replica_stale"},
+                        status=503,
+                        headers={"Retry-After": "1"},
+                    )
+                note_replica_read("stale_fallback")
+                return None  # leader path serves it
+            except ReplicaFencedError as e:
+                note_replica_read("fenced")
+                if replica_read:
+                    return web.json_response(
+                        {"error": str(e), "replica": "replica_fenced"},
+                        status=503,
+                        headers={"Retry-After": "1"},
+                    )
+                return None
+            except Exception as e:
+                if replica_read:
+                    # ANY follower-side failure maps to the typed
+                    # fallback contract — a genuine query error
+                    # reproduces on the leader with the authoritative
+                    # message (same stance as _forward_replica)
+                    return web.json_response(
+                        {"error": str(e), "replica": "replica_stale"},
+                        status=503,
+                    )
+                return None
+            note_replica_read("served")
+            resp = respond(out)
+            resp.headers[REPLICA_EPOCH_HEADER] = str(epoch)
+            resp.headers["X-HoraeDB-Replica-Lag-Ms"] = str(lag_ms)
+            return resp
+
+        if replica_read:
+            # forwarded here as a replica read but we no longer serve
+            # these tables (replica set changed under the route cache)
+            note_replica_read("fenced")
+            return web.json_response(
+                {
+                    "error": f"{proto} tables not replicated on this node",
+                    "replica": "replica_fenced",
+                },
+                status=503,
+            )
+        if request.headers.get(FORWARD_HEADER):
+            return None  # one hop only, like _forward_if_remote
+        # offload: every target table routed to ONE remote leader whose
+        # shard has follower replicas -> try a replica before the leader
+        routes = {t: router.route(t) for t in set(tables)}
+        if len({r.endpoint for r in routes.values()}) != 1:
+            return None
+        route0 = next(iter(routes.values()))
+        if route0.is_local or not route0.replicas:
+            return None
+        pick = getattr(router, "pick_replica", None)
+        target = (
+            pick(route0, exclude=getattr(router, "self_endpoint", ""))
+            if pick is not None
+            else None
+        )
+        if target is None:
+            return None
+        import aiohttp
+
+        body = await request.read()
+        headers = {
+            FORWARD_HEADER: "1",
+            REPLICA_READ_HEADER: "1",
+            REPLICA_EPOCH_HEADER: str(route0.epoch),
+            "Content-Type": request.headers.get(
+                "Content-Type", "application/json"
+            ),
+        }
+        if staleness_ms:
+            headers[STALENESS_HEADER] = f"{int(staleness_ms)}ms"
+        try:
+            session = await _client_session(request.app)
+            async with session.request(
+                request.method,
+                f"http://{target}{request.path_qs}",
+                data=body,
+                headers=headers,
+                timeout=aiohttp.ClientTimeout(total=30),
+            ) as resp:
+                payload = await resp.read()
+                if resp.status == 200:
+                    out = web.Response(
+                        body=payload,
+                        status=200,
+                        content_type=resp.content_type,
+                    )
+                    for h in (REPLICA_EPOCH_HEADER, "X-HoraeDB-Replica-Lag-Ms"):
+                        if h in resp.headers:
+                            out.headers[h] = resp.headers[h]
+                    return out
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass  # follower unreachable: the leader still can
+        # typed refusal or any other follower failure: fall back to the
+        # normal path (leader forward / local evaluation)
+        note_replica_read("stale_fallback")
+        return None
+
     # ---- protocol front ends -------------------------------------------
     async def influx_write(request: web.Request) -> web.Response:
         from ..proxy.influxdb import LineProtocolError, parse_lines, write_points
@@ -1050,6 +1269,33 @@ def create_app(
             return web.json_response(
                 {"error": "missing query parameter 'q'"}, status=400
             )
+        if router is not None and cluster is not None:
+            # Replicated follower reads (PR-10 remainder): a historical
+            # statement (guaranteed upper time bound) serves from a
+            # follower replica — locally when this node replicates the
+            # measurements, else offloaded via pick_replica with leader
+            # fallback — with route=follower in query_stats.
+            from ..proxy.influxql import replica_read_targets
+
+            targets = replica_read_targets(q)
+            if targets is not None:
+                resp = await _follower_protocol(
+                    request, targets[0], targets[1], "influxql",
+                    run_local=lambda: evaluate(conn, q),
+                    respond=lambda data: web.Response(
+                        text=_dumps(data), content_type="application/json"
+                    ),
+                )
+                if resp is not None:
+                    return resp
+            elif request.headers.get(REPLICA_READ_HEADER):
+                # forwarded as a replica read but not an eligible shape
+                # here: typed refusal, the origin owns the fallback
+                return web.json_response(
+                    {"error": "influxql read not replica-servable",
+                     "replica": "replica_stale"},
+                    status=503,
+                )
         try:
             proxy._m_queries.inc()
             data = await asyncio.get_running_loop().run_in_executor(
@@ -1071,6 +1317,39 @@ def create_app(
             body = await request.json()
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
+        if router is not None and cluster is not None:
+            # historical query (explicit end bound) -> follower-eligible
+            targets = None
+            try:
+                if (
+                    isinstance(body, dict)
+                    and body.get("end") is not None
+                    and body.get("queries")
+                ):
+                    from ..proxy.opentsdb import _normalize_ts
+
+                    targets = (
+                        [str(sub["metric"]) for sub in body["queries"]],
+                        _normalize_ts(body["end"]) + 1,  # inclusive end
+                    )
+            except Exception:
+                targets = None
+            if targets is not None:
+                resp = await _follower_protocol(
+                    request, targets[0], targets[1], "opentsdb",
+                    run_local=lambda: evaluate_query(conn, body),
+                    respond=lambda data: web.Response(
+                        text=_dumps(data), content_type="application/json"
+                    ),
+                )
+                if resp is not None:
+                    return resp
+            elif request.headers.get(REPLICA_READ_HEADER):
+                return web.json_response(
+                    {"error": "opentsdb read not replica-servable",
+                     "replica": "replica_stale"},
+                    status=503,
+                )
         try:
             proxy._m_queries.inc()
             data = await asyncio.get_running_loop().run_in_executor(
@@ -1192,7 +1471,90 @@ def create_app(
                 return SAMPLES_TABLE
             return m
 
+        def run():
+            if is_range:
+                for p in ("start", "end"):
+                    if p not in params:
+                        raise PromQLError(f"missing parameter {p!r}")
+                start = int(float(params["start"]) * 1000)
+                end = int(float(params["end"]) * 1000)
+                step_raw = params.get("step", "60")
+                from ..engine.options import parse_duration_ms
+
+                step = (
+                    parse_duration_ms(step_raw)
+                    if not step_raw.replace(".", "").isdigit()
+                    else int(float(step_raw) * 1000)
+                )
+                if step <= 0:
+                    raise PromQLError("step must be positive")
+                result = evaluate_expr_range(conn, pq, start, end, step)
+                return {"resultType": "matrix", "result": result}
+            import time as _time
+
+            # Prometheus defaults the evaluation time to "now".
+            t = int(float(params.get("time", _time.time())) * 1000)
+            result = evaluate_expr_instant(conn, pq, t)
+            return {"resultType": "vector", "result": result}
+
         metrics = leaf_metrics(pq)
+        if router is not None and cluster is not None and metrics:
+            # Replicated follower reads (PR-10 remainder): route the
+            # evaluation through a follower replica of the leaf tables —
+            # locally when this node replicates them all, else offloaded
+            # via pick_replica with leader fallback. The evaluation end
+            # (explicit or "now") is the bound the follower's watermark
+            # (or a staleness opt-in) must cover.
+            import time as _time
+
+            end_raw = params.get("end") if is_range else params.get("time")
+            try:
+                prom_end_ms = (
+                    int(float(end_raw) * 1000) + 1
+                    if end_raw is not None
+                    else int(_time.time() * 1000) + 1
+                )
+            except (TypeError, ValueError):
+                end_raw = None
+                prom_end_ms = int(_time.time() * 1000) + 1
+            # an implicit "now" evaluation (the Grafana default) is never
+            # watermark-covered: engaging the follower path would pay an
+            # opportunistic manifest refresh per query just to fall back
+            # to the leader. Only an EXPLICIT end/time, a staleness
+            # opt-in, or a forwarded replica read (the origin owns the
+            # fallback) makes the attempt worthwhile.
+            eligible = (
+                end_raw is not None
+                or bool(_parse_staleness(request.headers.get(STALENESS_HEADER)))
+                or bool(request.app.get("read_staleness_ms"))
+                or bool(request.headers.get(REPLICA_READ_HEADER))
+            )
+            def run_checked():
+                # follower serving must keep the same gate the normal
+                # path applies: a blocked table is refused (the generic
+                # failure mapping bounces a non-forwarded request to the
+                # normal path, which raises the 403; a forwarded replica
+                # read falls back to the leader, which enforces it)
+                for m in set(metrics):
+                    proxy.limiter.check(m)
+                    proxy.hotspot.record(m, False)
+                proxy._m_queries.inc()
+                return run()
+
+            if eligible:
+                resp = await _follower_protocol(
+                    request,
+                    sorted({_prom_route_key(m) for m in metrics}),
+                    prom_end_ms,
+                    "promql",
+                    run_local=run_checked,
+                    respond=lambda data: web.Response(
+                        text=_dumps({"status": "success", "data": data}),
+                        content_type="application/json",
+                    ),
+                )
+                if resp is not None:
+                    return resp
         if len({_prom_route_key(m) for m in metrics}) == 1:
             forwarded = await _forward_if_remote(
                 request, _prom_route_key(metrics[0])
@@ -1219,33 +1581,6 @@ def create_app(
             for m in set(metrics):
                 proxy.limiter.check(m)
                 proxy.hotspot.record(m, False)
-
-            def run():
-                if is_range:
-                    for p in ("start", "end"):
-                        if p not in params:
-                            raise PromQLError(f"missing parameter {p!r}")
-                    start = int(float(params["start"]) * 1000)
-                    end = int(float(params["end"]) * 1000)
-                    step_raw = params.get("step", "60")
-                    from ..engine.options import parse_duration_ms
-
-                    step = (
-                        parse_duration_ms(step_raw)
-                        if not step_raw.replace(".", "").isdigit()
-                        else int(float(step_raw) * 1000)
-                    )
-                    if step <= 0:
-                        raise PromQLError("step must be positive")
-                    result = evaluate_expr_range(conn, pq, start, end, step)
-                    return {"resultType": "matrix", "result": result}
-                import time as _time
-
-                # Prometheus defaults the evaluation time to "now".
-                t = int(float(params.get("time", _time.time())) * 1000)
-                result = evaluate_expr_instant(conn, pq, t)
-                return {"resultType": "vector", "result": result}
-
             data = await asyncio.get_running_loop().run_in_executor(None, run)
         except BlockedError as e:
             proxy._m_errors.inc()

@@ -161,6 +161,7 @@ def _query_stats_schema() -> Schema:
         ColumnSchema("sql", DatumKind.STRING),
         ColumnSchema("route", DatumKind.STRING),
         ColumnSchema("kernel", DatumKind.STRING),
+        ColumnSchema("table_name", DatumKind.STRING),
         ColumnSchema("duration_ms", DatumKind.DOUBLE),
     ]
     cols += [ColumnSchema(f, DatumKind.INT64) for f in NUMERIC_FIELDS]
@@ -209,6 +210,9 @@ class QueryStatsTable(_VirtualTable):
             "route": np.array([str(e.get("route", "")) for e in entries], dtype=object),
             "kernel": np.array(
                 [str(e.get("kernel", "")) for e in entries], dtype=object
+            ),
+            "table_name": np.array(
+                [str(e.get("table_name", "")) for e in entries], dtype=object
             ),
             "duration_ms": np.array(
                 [float(e.get("duration_ms", 0.0)) for e in entries], dtype=np.float64

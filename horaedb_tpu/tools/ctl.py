@@ -29,6 +29,8 @@ Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
     migrate SHARD NODE                           move to a named node
     scatter [--max-moves N]                      re-place via hash ring
     procedures                                   coordinator queue state
+    elastic [status]                             elastic control-loop state
+    elastic release SHARD                        close a shard's circuit breaker
 """
 
 from __future__ import annotations
@@ -185,6 +187,34 @@ def cmd_scatter(ep: str, args) -> None:
 
 def cmd_procedures(ep: str, args) -> None:
     print(_get(args.meta, "/meta/v1/procedures"))
+
+
+def cmd_elastic(ep: str, args) -> None:
+    """Elastic control loop (meta/elastic): show the decision-loop state
+    or release a quarantined shard's circuit breaker."""
+    if args.action == "release":
+        if args.shard_id is None:
+            raise CtlError("elastic release needs a shard id")
+        print(_post(args.meta, "/meta/v1/elastic/release",
+                    {"shard_id": args.shard_id}))
+        return
+    data = json.loads(_get(args.meta, "/meta/v1/elastic"))
+    if not data.get("enabled", False):
+        print("(elastic control loop not enabled on this coordinator)")
+        return
+    print(
+        f"rounds: {data['rounds']}  holds: {data['holds']}  "
+        f"dry_run: {data['dry_run']}"
+    )
+    print(f"policy: {json.dumps(data['policy'], sort_keys=True)}")
+    _print_rows(data.get("shards", []))
+    if data.get("quarantined"):
+        print(f"\nquarantined: {json.dumps(data['quarantined'], sort_keys=True)}")
+    decisions = data.get("recent_decisions", [])
+    if decisions:
+        print(f"\nrecent decisions ({len(decisions)}):")
+        for d in decisions[-10:]:
+            print(f"  {json.dumps(d, sort_keys=True)}")
 
 
 def cmd_status(ep: str, args) -> None:
@@ -387,6 +417,11 @@ def main(argv=None) -> int:
     sc.add_argument("--meta", default=meta_default)
     pr = sub.add_parser("procedures")
     pr.add_argument("--meta", default=meta_default)
+    el = sub.add_parser("elastic")
+    el.add_argument("action", nargs="?", default="status",
+                    choices=["status", "release"])
+    el.add_argument("shard_id", nargs="?", type=int, default=None)
+    el.add_argument("--meta", default=meta_default)
     args = p.parse_args(argv)
     if args.token:
         global _TOKEN

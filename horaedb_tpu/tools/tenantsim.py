@@ -35,7 +35,12 @@ objectives observe the chaos through the database's own telemetry), a
 leader KILL (heartbeats stop, HTTP stops, tables close WITHOUT flush —
 unflushed rows survive only in the shared WAL for the new owner to
 replay), a replica-lease flap (pause_heartbeats: leases lapse, shards
-freeze, then thaw), and a rolling shard migration.
+freeze, then thaw), a rolling shard migration — and, with ``--elastic``,
+a HOT-TENANT SKEW phase: most dashboard traffic slams the tables
+co-owned by one node while the [cluster.elastic] control loop on the
+meta must scale replicas out, serve route=follower reads, execute a
+pre-warmed leader move, and scale back in after the storm — all
+asserted from ``system.public.events`` / ``query_stats``.
 
 What it asserts — from the database's own tables:
 
@@ -114,6 +119,23 @@ class SimConfig:
     kill_at: Optional[float] = 0.65
     lease_flap_at: Optional[float] = None  # needs >= 3 nodes to be gentle
     shard_move_at: Optional[float] = None
+    # hot-tenant skew phase: a window where most dashboard traffic slams
+    # the tables co-owned by ONE node — the elastic control loop's
+    # standing gate (scale-out during, move off the hot node, scale-in
+    # after the storm)
+    hot_phase: Optional[tuple] = None
+    hot_fraction: float = 0.75
+    # elastic shard management ([cluster.elastic] on the meta): the
+    # thresholds are in the inspector's units — query_stats rows per
+    # second summed across nodes (in-process every node answers the one
+    # shared ring, so counts read ~nodes x real qps)
+    elastic: bool = False
+    elastic_up_qps: float = 6.0
+    elastic_down_qps: float = 1.5
+    elastic_fast_window_s: float = 3.0
+    elastic_slow_window_s: float = 8.0
+    elastic_decide_s: float = 1.0
+    elastic_cooldown_s: float = 2.0
     # workload shape
     quota_tenants: int = 2  # tenants given a deliberately tiny read quota
     settle_timeout_s: float = 25.0
@@ -148,6 +170,16 @@ class SimReport:
     kill_recovered: bool = False
     acked_rows_checked: int = 0
     acked_rows_missing: int = -1
+    # elastic control loop (from system.public.events, the database's
+    # own journal of the meta's decisions)
+    elastic_scale_ups: int = 0
+    elastic_scale_downs: int = 0
+    elastic_moves: int = 0
+    elastic_prewarmed_moves: int = 0
+    elastic_prewarms: int = 0
+    elastic_quarantines: int = 0
+    elastic_move_expected: bool = False
+    hot_tables: list = field(default_factory=list)
     notes: list = field(default_factory=list)
 
     def violations(self) -> list[str]:
@@ -184,6 +216,27 @@ class SimReport:
             out.append(
                 "frozen-range reads did not recover after the leader kill"
             )
+        if self.config.get("elastic"):
+            # the elastic gates, all asserted from the database's own
+            # event journal: the hot phase must scale a hot shard OUT,
+            # followers must actually serve, the hot shard must move
+            # (when the skew made a skew-reducing move possible), and
+            # capacity must come back IN after the storm
+            if self.elastic_scale_ups < 1:
+                out.append("elastic: no scale-up under the hot-tenant skew")
+            if self.elastic_scale_downs < 1:
+                out.append("elastic: no scale-in after the storm")
+            if self.follower_served < 1:
+                out.append("elastic: no route=follower reads served")
+            if self.elastic_move_expected and self.elastic_moves < 1:
+                out.append(
+                    "elastic: hot shards co-owned by one node but no move"
+                )
+            if self.elastic_moves >= 1 and self.elastic_prewarmed_moves < 1:
+                out.append(
+                    "elastic: moves happened but none was pre-warmed "
+                    "(target never tailed the manifest before cutover)"
+                )
         if self.served == 0:
             out.append("no queries served at all")
         return out
@@ -367,11 +420,31 @@ class SimCluster:
         from ..meta.service import MetaServer, create_meta_app
 
         cfg = self.cfg
+        elastic = None
+        if cfg.elastic:
+            from ..utils.config import ElasticSection
+
+            elastic = ElasticSection(
+                enabled=True,
+                min_replicas=cfg.read_replicas,
+                max_replicas=max(cfg.read_replicas + 1, 2),
+                scale_up_qps=cfg.elastic_up_qps,
+                scale_down_qps=cfg.elastic_down_qps,
+                fast_window_s=cfg.elastic_fast_window_s,
+                slow_window_s=cfg.elastic_slow_window_s,
+                decide_interval_s=cfg.elastic_decide_s,
+                cooldown_s=cfg.elastic_cooldown_s,
+                node_stable_s=1.0,
+                min_move_qps=cfg.elastic_down_qps,
+                prewarm_timeout_s=8.0,
+                telemetry_timeout_s=2.0,
+            )
         self.meta_server = MetaServer(
             num_shards=cfg.num_shards or 2 * cfg.nodes,
             lease_ttl_s=cfg.lease_ttl_s,
             heartbeat_timeout_s=cfg.heartbeat_timeout_s,
             read_replicas=cfg.read_replicas,
+            elastic=elastic,
         )
         self.meta_server.start_loop(interval_s=cfg.meta_tick_s)
         self.meta_host = _AppHost("meta", executor_workers=8)
@@ -625,9 +698,11 @@ class TenantSim:
         self.rng = random.Random(cfg.seed)
         self._stop = threading.Event()
         self._storm = threading.Event()
+        self._hot = threading.Event()  # hot-tenant skew phase active
+        self._hot_refs: list = []  # reference queries on the hot tables
         self._lock = threading.Lock()
         self._acked: list[tuple[str, str, int, float]] = []  # table, tenant, ts, v
-        self._refs: list[tuple[str, str, list]] = []  # sql, kind, ref rows
+        self._refs: list[tuple[str, str, list]] = []  # sql, table, ref rows
         self.fence_ms = 0
         self._events_before: dict = {}
         self._t0_ms = 0
@@ -741,7 +816,7 @@ class TenantSim:
                     "POST", f"http://{eps[0]}/sql", {"query": q},
                     desc=f"reference query for t{t}",
                 )
-                self._refs.append((q, f"t{t}", out["rows"]))
+                self._refs.append((q, name, out["rows"]))
         # deliberately tiny read quota for a few tenants: quota_reject
         # events + 429s are part of the workload the plane must absorb
         for t in range(min(cfg.quota_tenants, cfg.tenants)):
@@ -768,7 +843,27 @@ class TenantSim:
             i += 1
             roll = rng.random()
             try:
-                if self._storm.is_set() and roll < 0.25:
+                if (
+                    self._hot.is_set()
+                    and self._hot_refs
+                    and roll < cfg.hot_fraction
+                ):
+                    # hot-tenant skew: most dashboard traffic slams the
+                    # tables co-owned by one node (known answers — the
+                    # elastic machinery must scale/move WITHOUT a single
+                    # wrong answer)
+                    q, _table, ref = self._hot_refs[
+                        (i * 13 + wid) % len(self._hot_refs)
+                    ]
+                    s, out = self._sql(ep, q, timeout=20)
+                    if s == 200:
+                        self._note_status(
+                            s, checked=True,
+                            ok=_rows_agree(out.get("rows", []), ref),
+                        )
+                    else:
+                        self._note_status(s, checked=False, ok=True)
+                elif self._storm.is_set() and roll < 0.25:
                     # expensive-scan storm: full-table multi-agg group-by
                     j = rng.randrange(cfg.tables)
                     q = (
@@ -952,6 +1047,9 @@ class TenantSim:
             events.append((cfg.lease_flap_at * D, "flap"))
         if cfg.shard_move_at is not None:
             events.append((cfg.shard_move_at * D, "move"))
+        if cfg.hot_phase is not None:
+            events += [(cfg.hot_phase[0] * D, "hot_on"),
+                       (cfg.hot_phase[1] * D, "hot_off")]
         events.sort()
         for when, what in events:
             delay = t0 + when - time.monotonic()
@@ -1005,6 +1103,30 @@ class TenantSim:
 
             moved = cl.migrate_some_shard({SAMPLES_TABLE})
             self.report.notes.append(f"migrated shard {moved}")
+        elif what == "hot_on":
+            self._resolve_hot_tables()
+            self._hot.set()
+        elif what == "hot_off":
+            self._hot.clear()
+
+    def _resolve_hot_tables(self) -> None:
+        """Pick the skew target: the sim tables co-owned by ONE node (the
+        most-loaded-node-to-be). With >= 2 co-owned tables a skew-
+        reducing elastic move is possible by construction, so the gate
+        may demand one; a fleet whose tables all live on different nodes
+        only gates scale-out/in."""
+        owners: dict[str, list] = {}
+        for j in range(self.cfg.tables):
+            name = self._table(j)
+            owners.setdefault(self._owner(name), []).append(name)
+        _ep, tables = max(owners.items(), key=lambda kv: (len(kv[1]), kv[0]))
+        hot = tables[:2]
+        self.report.hot_tables = hot
+        self.report.elastic_move_expected = (
+            bool(self.cfg.elastic) and len(hot) >= 2
+        )
+        self._hot_refs = [r for r in self._refs if r[1] in hot]
+        self.report.notes.append(f"hot tables: {hot}")
 
     def _pick_victim(self) -> Optional[SimNode]:
         """A node that leads shards but does NOT hold the samples table
@@ -1029,6 +1151,27 @@ class TenantSim:
         cfg = self.cfg
         deadline = time.monotonic() + cfg.settle_timeout_s
         need_alert_cycle = cfg.error_burst is not None
+        need_scale_in = bool(cfg.elastic)
+
+        def scale_in_done(ep) -> bool:
+            # scale-in must come from the CONTROLLER's own sustained-
+            # quiet decision (the workers stopped; both windows drain)
+            before = self._events_before.get("issued", 0)
+            s, out = self._sql(
+                ep,
+                "SELECT attrs FROM system.public.events WHERE "
+                f"seq > {before} AND kind = 'elastic_action'",
+                timeout=10,
+            )
+            if s != 200:
+                return False
+            for row in out.get("rows", []):
+                try:
+                    if json.loads(row["attrs"]).get("action") == "scale_down":
+                        return True
+                except Exception:
+                    continue
+            return False
 
         def done() -> bool:
             ep = self.cluster.alive_endpoints()[0]
@@ -1038,6 +1181,8 @@ class TenantSim:
                 timeout=10,
             )
             if not (s2 == 200 and out2.get("rows")):
+                return False
+            if need_scale_in and not scale_in_done(ep):
                 return False
             if not need_alert_cycle:
                 return True
@@ -1155,6 +1300,40 @@ class TenantSim:
             else:
                 self.report.event_drops_unaccounted = 0
 
+        # --- elastic control-loop actions, from the journal (the meta's
+        # decisions land in the same process-global ring the data nodes
+        # serve as system.public.events) ---
+        s, out = self._sql(
+            ep,
+            "SELECT kind, attrs FROM system.public.events WHERE "
+            f"seq > {before} AND (kind = 'elastic_action' "
+            "OR kind = 'elastic_quarantined')",
+            timeout=20,
+        )
+        if s == 200:
+            for row in out["rows"]:
+                if row["kind"] == "elastic_quarantined":
+                    self.report.elastic_quarantines += 1
+                    continue
+                try:
+                    attrs = json.loads(row["attrs"])
+                except Exception:
+                    attrs = {}
+                action = attrs.get("action", "")
+                if action == "scale_up":
+                    self.report.elastic_scale_ups += 1
+                elif action == "scale_down":
+                    self.report.elastic_scale_downs += 1
+                elif action == "move":
+                    self.report.elastic_moves += 1
+                    if attrs.get("prewarmed"):
+                        # the cutover target was tailing the manifest
+                        # (a replica it already held, or one installed
+                        # for the move) — the pre-warmed move proof
+                        self.report.elastic_prewarmed_moves += 1
+                elif action == "prewarm":
+                    self.report.elastic_prewarms += 1
+
         # --- follower serving (route=follower in query_stats; the ring
         # is process-global in-process, so one node answers for all —
         # informational, the correctness gate is the reference checks) ---
@@ -1235,6 +1414,12 @@ def main(argv=None) -> int:
     p.add_argument("--rows", type=int, default=30_000)
     p.add_argument("--read-replicas", type=int, default=1)
     p.add_argument("--no-kill", action="store_true")
+    p.add_argument(
+        "--elastic", action="store_true",
+        help="run the [cluster.elastic] control loop on the meta and add "
+             "the hot-tenant skew phase (gates: scale-out under skew, "
+             "route=follower serving, pre-warmed move, scale-in after)",
+    )
     p.add_argument("--json", action="store_true", help="emit the report as JSON")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -1246,10 +1431,13 @@ def main(argv=None) -> int:
         seed=args.seed,
         workers=args.workers,
         rows_per_table=args.rows,
-        read_replicas=args.read_replicas,
+        read_replicas=0 if args.elastic else args.read_replicas,
+        elastic=args.elastic,
+        hot_phase=(0.1, 0.45) if args.elastic else None,
         kill_at=None if args.no_kill else SimConfig.kill_at,
         lease_flap_at=0.72 if args.nodes >= 3 else None,
         shard_move_at=0.8 if args.nodes >= 3 else None,
+        settle_timeout_s=40.0 if args.elastic else SimConfig.settle_timeout_s,
     )
     report = run_sim(cfg)
     violations = report.violations()

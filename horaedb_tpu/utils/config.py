@@ -236,6 +236,53 @@ class SloSection:
 
 
 @dataclass
+class ElasticSection:
+    """Elastic shard management (meta/elastic): the coordinator reads the
+    fleet's own telemetry history (``system.public.query_stats`` over the
+    ordinary distributed read path) and emits guarded actions — per-shard
+    read-replica scale-up/-down, load-aware rebalancing of the hottest
+    shard off the most-loaded node with a pre-warmed cutover — through
+    the same lease-fenced machinery the admin APIs use. Every action is
+    railed: per-shard cooldown, a global per-round action budget,
+    hysteresis (fast window scales out now; scale-in needs the slow
+    window quiet too), a circuit breaker that quarantines a shard after
+    repeated failed moves, and degraded-telemetry hold (stale or missing
+    samples ⇒ no action). ``dry_run`` journals decisions as typed events
+    without acting."""
+
+    enabled: bool = False
+    dry_run: bool = False
+    # replica-count policy bounds (replaces the static --read-replicas)
+    min_replicas: int = 0
+    max_replicas: int = 2
+    # per-shard read QPS thresholds, with SLO-burn-style dual windows:
+    # scale-up triggers on the FAST window alone (a spike scales out
+    # now); scale-in requires BOTH windows under the down threshold
+    # (sustained quiet), so the two can never oscillate on a blip
+    scale_up_qps: float = 50.0
+    scale_down_qps: float = 5.0
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    # control-loop cadence + rails
+    decide_interval_s: float = 15.0
+    cooldown_s: float = 120.0  # per-shard: min time between actions
+    action_budget: int = 2  # max actions applied per decision round
+    quarantine_after: int = 3  # failed/reverted moves before the breaker opens
+    node_stable_s: float = 30.0  # a (re)joined node must be online this
+    # long before it attracts replicas or rebalance moves (flap guard)
+    rebalance: bool = True  # load-aware move of the hottest shard
+    min_move_qps: float = 1.0  # never move a shard colder than this
+    # GLOBAL move cadence: after any move decision, no new move for this
+    # long (<= 0 derives slow_window). Per-shard cooldowns alone cannot
+    # stop churn — a loop cycling through shards moves SOMETHING every
+    # round while each individual shard looks rested.
+    move_cooldown_s: float = 0.0
+    prewarm: bool = True  # target tails the manifest before cutover
+    prewarm_timeout_s: float = 30.0
+    telemetry_timeout_s: float = 3.0  # per-node query_stats poll timeout
+
+
+@dataclass
 class ClusterSection:
     enabled: bool = False
     self_endpoint: str = ""
@@ -253,6 +300,8 @@ class ClusterSection:
     # when the follower lags by at most this much (0 = watermark-covered
     # ranges only; per-request override: X-HoraeDB-Read-Staleness)
     read_staleness_s: float = 0.0
+    # [cluster.elastic] — the coordinator's self-driving control loop
+    elastic: ElasticSection = field(default_factory=ElasticSection)
 
 
 @dataclass
@@ -331,7 +380,7 @@ _KNOWN = {
     },
     "cluster": {
         "self_endpoint", "endpoints", "rules", "meta_endpoints",
-        "read_replicas", "read_staleness",
+        "read_replicas", "read_staleness", "elastic",
     },
     "s3": {
         "bucket", "endpoint", "region", "access_key", "secret_key", "prefix",
@@ -550,6 +599,8 @@ def _apply(cfg: Config, raw: dict) -> None:
             )
             if cfg.cluster.read_staleness_s < 0:
                 raise ConfigError("cluster.read_staleness must be >= 0")
+        if "elastic" in c:
+            _apply_elastic(cfg.cluster.elastic, c["elastic"])
         if not cfg.cluster.self_endpoint:
             raise ConfigError("cluster.self_endpoint is required in [cluster]")
         if not meps and not eps:
@@ -557,6 +608,73 @@ def _apply(cfg: Config, raw: dict) -> None:
                 "[cluster] needs either meta_endpoints (coordinator mode) "
                 "or endpoints (static mode)"
             )
+
+
+_ELASTIC_KEYS = {
+    "enabled", "dry_run", "min_replicas", "max_replicas", "scale_up_qps",
+    "scale_down_qps", "fast_window", "slow_window", "decide_interval",
+    "cooldown", "action_budget", "quarantine_after", "node_stable",
+    "rebalance", "min_move_qps", "prewarm", "prewarm_timeout",
+    "move_cooldown",
+}
+
+
+def _apply_elastic(es: ElasticSection, raw: Any) -> None:
+    """[cluster.elastic] — validated at load like every other section; a
+    typo'd knob or an oscillation-prone threshold pair fails HERE, not
+    at the first decision round."""
+    if not isinstance(raw, dict):
+        raise ConfigError("cluster.elastic must be a table")
+    unknown = set(raw) - _ELASTIC_KEYS
+    if unknown:
+        raise ConfigError(
+            f"unknown key(s) in [cluster.elastic]: {sorted(unknown)}"
+        )
+    for key in ("enabled", "dry_run", "rebalance", "prewarm"):
+        if key in raw:
+            if not isinstance(raw[key], bool):
+                raise ConfigError(f"cluster.elastic.{key} must be a boolean")
+            setattr(es, key, raw[key])
+    for key in ("min_replicas", "max_replicas", "action_budget",
+                "quarantine_after"):
+        if key in raw:
+            setattr(es, key, int(raw[key]))
+    for key, attr in (
+        ("fast_window", "fast_window_s"),
+        ("slow_window", "slow_window_s"),
+        ("decide_interval", "decide_interval_s"),
+        ("cooldown", "cooldown_s"),
+        ("node_stable", "node_stable_s"),
+        ("prewarm_timeout", "prewarm_timeout_s"),
+        ("move_cooldown", "move_cooldown_s"),
+    ):
+        if key in raw:
+            setattr(es, attr, parse_duration_ms(raw[key]) / 1000.0)
+    for key in ("scale_up_qps", "scale_down_qps", "min_move_qps"):
+        if key in raw:
+            setattr(es, key, float(raw[key]))
+    if es.min_replicas < 0:
+        raise ConfigError("cluster.elastic.min_replicas must be >= 0")
+    if es.max_replicas < es.min_replicas:
+        raise ConfigError(
+            "cluster.elastic.max_replicas must be >= min_replicas"
+        )
+    if es.scale_down_qps >= es.scale_up_qps:
+        # equal thresholds would let one borderline sample scale out and
+        # back in on alternating rounds — the hysteresis gap is mandatory
+        raise ConfigError(
+            "cluster.elastic.scale_down_qps must be < scale_up_qps"
+        )
+    if es.fast_window_s <= 0 or es.slow_window_s < es.fast_window_s:
+        raise ConfigError(
+            "cluster.elastic windows need 0 < fast_window <= slow_window"
+        )
+    if es.decide_interval_s <= 0:
+        raise ConfigError("cluster.elastic.decide_interval must be positive")
+    if es.action_budget < 1:
+        raise ConfigError("cluster.elastic.action_budget must be >= 1")
+    if es.quarantine_after < 1:
+        raise ConfigError("cluster.elastic.quarantine_after must be >= 1")
 
 
 def _apply_env(cfg: Config) -> None:

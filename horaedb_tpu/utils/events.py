@@ -57,6 +57,10 @@ EVENT_KINDS: dict[str, str] = {
     "rollup_catchup": "a rollup tier advanced over a multi-bucket backlog (restart/backfill)",
     "slo_burn": "an SLO objective's fast+slow burn rates crossed the threshold",
     "slo_recovered": "a burning SLO objective's fast window came back under threshold",
+    "elastic_decision": "the elastic control loop decided a round's actions (dry-run rounds journal here without acting)",
+    "elastic_action": "the elastic control loop applied one guarded action (scale_up/scale_down/move/prewarm)",
+    "elastic_quarantined": "the elastic circuit breaker quarantined a shard after repeated failed moves",
+    "elastic_released": "an operator released a quarantined shard (horaectl elastic release)",
 }
 
 _EVENTS_FAMILY = "horaedb_events_total"

@@ -189,14 +189,18 @@ class QueryLedger:
     """One request's accumulating cost counters. Thread-safe: the scatter
     pool and gRPC client callbacks add from several threads at once."""
 
-    __slots__ = ("request_id", "sql", "route", "kernel", "counts",
-                 "started_at", "_lock")
+    __slots__ = ("request_id", "sql", "route", "kernel", "table_name",
+                 "counts", "started_at", "_lock")
 
     def __init__(self, request_id=None, sql: str = "") -> None:
         self.request_id = request_id
         self.sql = sql
         self.route = ""  # last executor path taken (one of the six)
         self.kernel = ""  # last segment-reduction impl dispatched
+        # primary table the statement targeted — the elastic control
+        # loop's load signal (meta/elastic reads per-table query counts
+        # from system.public.query_stats over the distributed read path)
+        self.table_name = ""
         self.counts: dict[str, float] = dict.fromkeys(LEDGER_FIELDS, 0)
         self.started_at = time.time()
         self._lock = threading.Lock()
@@ -212,6 +216,10 @@ class QueryLedger:
 
     def set_kernel(self, kernel: str) -> None:
         self.kernel = kernel
+
+    def set_table(self, table: Optional[str]) -> None:
+        if table:
+            self.table_name = table
 
     def merge_remote(self, remote: Optional[dict]) -> None:
         """Fold a partition owner's shipped ledger into this one (numeric
@@ -290,6 +298,7 @@ def finish_ledger(ledger: QueryLedger, token, duration_s: float,
         "sql": ledger.sql[:200],
         "route": ledger.route,
         "kernel": ledger.kernel,
+        "table_name": ledger.table_name,
         "duration_ms": round(duration_s * 1000, 3),
         **ledger.counts,
     }

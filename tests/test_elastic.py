@@ -1,0 +1,637 @@
+"""Elastic shard management (PR-12): the guarded, telemetry-fed control
+loop (meta/elastic) and the per-shard-desired-count ReplicaScheduler.
+
+Every rail is pinned here with deterministic fakes: hysteresis (fast
+scales out, scale-in needs fast AND slow quiet), per-shard cooldown +
+per-round action budget + global move cadence, the skew-reduction move
+predicate (a lone hot shard never flips the imbalance), the circuit
+breaker (+ `horaectl elastic release`), dry-run journaling, the
+degraded-telemetry hold, the flapping-node guard, and the
+samples-shard pin. Config parsing/validation for `[cluster.elastic]`
+rides along.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from horaedb_tpu.meta.elastic import (
+    ElasticController,
+    FleetLoad,
+    LoadInspector,
+)
+from horaedb_tpu.meta.kv import MemoryKV
+from horaedb_tpu.meta.scheduler import ReplicaScheduler
+from horaedb_tpu.meta.topology import TopologyManager
+from horaedb_tpu.utils.config import ConfigError, ElasticSection
+
+
+def _topo(nodes=("a:1", "b:1"), shards=3, assign=None, tables=()):
+    topo = TopologyManager(MemoryKV(), num_shards=shards)
+    for ep in nodes:
+        topo.register_node(ep)
+        # registered "long ago": tests that need a FLAPPING node reset
+        # online_since themselves
+        topo._nodes[ep].online_since = time.monotonic() - 3600.0
+    assign = assign or {sid: nodes[sid % len(nodes)] for sid in range(shards)}
+    for sid, ep in assign.items():
+        topo.assign_shard(sid, ep)
+    for i, (name, sid) in enumerate(tables):
+        topo.add_table(name, i + 1, sid, "")
+    return topo
+
+
+class _FakeInspector:
+    """Scripted telemetry: pop one FleetLoad per collect; an empty
+    script keeps returning the last load (or zero load)."""
+
+    def __init__(self, *loads):
+        self.script = list(loads)
+        self.default = FleetLoad(nodes_asked=1, nodes_answered=1)
+
+    def push(self, table_reads):
+        self.script.append(
+            FleetLoad(dict(table_reads), {}, nodes_asked=1, nodes_answered=1)
+        )
+
+    def collect(self, since_ms):
+        if self.script:
+            return self.script.pop(0)
+        return self.default
+
+
+def _controller(topo, cfg=None, **kwargs):
+    cfg = cfg or ElasticSection(
+        enabled=True,
+        fast_window_s=0.2,
+        slow_window_s=0.4,
+        decide_interval_s=0.01,
+        cooldown_s=0.0,
+        move_cooldown_s=0.01,
+        node_stable_s=0.0,
+        scale_up_qps=5.0,
+        scale_down_qps=1.0,
+        min_move_qps=0.5,
+        prewarm=False,
+        prewarm_timeout_s=0.2,
+    )
+    insp = kwargs.pop("inspector", _FakeInspector())
+    return ElasticController(cfg, topo, insp, **kwargs), insp, cfg
+
+
+def _acts(planned):
+    return [
+        {k: v for k, v in p.items() if k != "apply"} for p in planned
+    ]
+
+
+class TestReplicaSchedulerDesired:
+    """Satellite: per-shard desired counts (the elastic policy's handle
+    into the PR-10 scheduler) with the old invariants pinned."""
+
+    def _sched(self, topo, read_replicas=0, desired=None, stable_s=0.0):
+        return ReplicaScheduler(
+            topo,
+            read_replicas,
+            desired_fn=(lambda: desired) if desired is not None else None,
+            min_candidate_online_s=stable_s,
+        )
+
+    def test_per_shard_desired_overrides_global(self):
+        topo = _topo(nodes=("a:1", "b:1", "c:1"), shards=3,
+                     assign={0: "a:1", 1: "a:1", 2: "a:1"})
+        sched = self._sched(topo, read_replicas=0, desired={0: 2, 1: 1})
+        changes = {c.shard_id: c.replicas for c in sched.schedule()}
+        assert len(changes[0]) == 2 and len(changes[1]) == 1
+        assert 2 not in changes  # absent key falls back to global (0)
+        for reps in changes.values():
+            assert "a:1" not in reps  # leader never a replica
+
+    def test_desired_zero_strips_existing_replicas(self):
+        topo = _topo(nodes=("a:1", "b:1"), shards=2,
+                     assign={0: "a:1", 1: "b:1"})
+        topo.set_replicas(0, ("b:1",))
+        sched = self._sched(topo, read_replicas=0, desired={0: 0, 1: 0})
+        changes = {c.shard_id: c.replicas for c in sched.schedule()}
+        assert changes[0] == ()
+
+    def test_deterministic_and_idempotent_with_desired(self):
+        topo = _topo(nodes=("a:1", "b:1", "c:1", "d:1"), shards=4,
+                     assign={s: "a:1" for s in range(4)})
+        desired = {0: 2, 1: 2, 2: 1, 3: 1}
+        first = self._sched(topo, desired=desired).schedule()
+        second = self._sched(topo, desired=desired).schedule()
+        assert first == second  # per-(shard,node) hash tiebreak is stable
+        for c in first:
+            topo.set_replicas(c.shard_id, c.replicas)
+        assert self._sched(topo, desired=desired).schedule() == []
+
+    def test_unstable_node_not_picked_but_kept(self):
+        topo = _topo(nodes=("a:1", "b:1", "c:1"), shards=2,
+                     assign={0: "a:1", 1: "a:1"})
+        # c:1 just (re)joined: new replicas must not land there...
+        topo._nodes["c:1"].online_since = time.monotonic()
+        sched = self._sched(topo, desired={0: 2, 1: 2}, stable_s=30.0)
+        for c in sched.schedule():
+            assert "c:1" not in c.replicas
+        # ...but an ESTABLISHED replica on it survives the flap guard
+        topo.set_replicas(0, ("b:1", "c:1"))
+        changes = {c.shard_id: c.replicas for c in sched.schedule()}
+        assert 0 not in changes or "c:1" in changes[0]
+
+
+class TestElasticScaling:
+    def test_scale_up_on_fast_spike(self):
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        insp.push({"t0": 10})
+        planned = _acts(ctl.run_round())
+        assert planned and planned[0]["action"] == "scale_up"
+        assert ctl.desired_replicas()[0] == 1
+
+    def test_budget_caps_actions_per_round(self):
+        topo = _topo(nodes=("a:1", "b:1", "c:1", "d:1"), shards=3,
+                     assign={0: "a:1", 1: "b:1", 2: "c:1"},
+                     tables=[("t0", 0), ("t1", 1), ("t2", 2)])
+        ctl, insp, cfg = _controller(topo)
+        cfg.action_budget = 2
+        cfg.rebalance = False
+        insp.push({"t0": 10, "t1": 10, "t2": 10})
+        planned = ctl.run_round()
+        assert len(planned) == 2  # three eligible, budget two
+        # hottest-first under the budget
+        assert {p["shard_id"] for p in planned} <= {0, 1, 2}
+
+    def test_scale_in_needs_both_windows_quiet(self):
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        cfg.rebalance = False
+        insp.push({"t0": 10})
+        ctl.run_round()
+        assert ctl.desired_replicas()[0] == 1
+        # immediately quiet: the fast window may drain, the slow window
+        # still carries the spike -> NO scale-in (blip hysteresis)
+        time.sleep(cfg.fast_window_s + 0.05)
+        insp.push({})
+        planned = _acts(ctl.run_round())
+        assert not [p for p in planned if p["action"] == "scale_down"]
+        assert ctl.desired_replicas()[0] == 1
+        # sustained quiet past the slow window -> scale-in
+        time.sleep(cfg.slow_window_s + 0.05)
+        insp.push({})
+        planned = _acts(ctl.run_round())
+        assert [p for p in planned if p["action"] == "scale_down"]
+        assert ctl.desired_replicas()[0] == 0
+
+    def test_cooldown_blocks_repeat_actions(self):
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        cfg.cooldown_s = 60.0
+        cfg.max_replicas = 3
+        insp.push({"t0": 10})
+        assert _acts(ctl.run_round())
+        insp.push({"t0": 50})
+        assert not ctl.run_round()  # shard is cooling
+
+    def test_ceiling_is_cluster_size_minus_leader(self):
+        topo = _topo(nodes=("a:1", "b:1"), shards=1, assign={0: "a:1"},
+                     tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        cfg.max_replicas = 5
+        insp.push({"t0": 10})
+        ctl.run_round()
+        assert ctl.desired_replicas()[0] == 1
+        insp.push({"t0": 10})
+        assert not ctl.run_round()  # only one non-leader node exists
+
+
+class TestElasticRails:
+    def test_hold_on_degraded_telemetry(self):
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        insp.script = [None]  # no node answered
+        assert ctl.run_round() == []
+        assert ctl._holds == 1 and ctl._rounds == 0
+        # a later good round acts normally
+        insp.push({"t0": 10})
+        assert ctl.run_round()
+
+    def test_dry_run_journals_but_never_acts(self):
+        from horaedb_tpu.utils.events import EVENT_STORE
+
+        topo = _topo(tables=[("t0", 0)])
+        moved = []
+        ctl, insp, cfg = _controller(topo, transfer=lambda *a: moved.append(a))
+        cfg.dry_run = True
+        before = EVENT_STORE.stats()["issued"]
+        insp.push({"t0": 10})
+        planned = _acts(ctl.run_round())
+        assert planned  # the decision exists...
+        assert ctl.desired_replicas()[0] == 0  # ...but nothing changed
+        assert not moved
+        decided = [
+            e for e in EVENT_STORE.list(kind="elastic_decision")
+            if e["seq"] > before
+        ]
+        assert decided and decided[-1]["attrs"]["dry_run"] is True
+
+    def test_flapping_node_attracts_no_move(self):
+        topo = _topo(nodes=("a:1", "b:1"), shards=2,
+                     assign={0: "a:1", 1: "a:1"},
+                     tables=[("t0", 0), ("t1", 1)])
+        # b:1 is flapping: rejoined just now
+        topo._nodes["b:1"].online_since = time.monotonic()
+        moved = []
+        ctl, insp, cfg = _controller(topo, transfer=lambda *a: moved.append(a))
+        cfg.node_stable_s = 30.0
+        cfg.rebalance = True
+        cfg.max_replicas = 0  # isolate the move path
+        for _ in range(3):
+            insp.push({"t0": 10, "t1": 4})
+            ctl.run_round()
+        assert not moved
+        assert not ctl._pending
+
+    def test_single_hot_shard_never_flips_the_skew(self):
+        topo = _topo(nodes=("a:1", "b:1"), shards=2,
+                     assign={0: "a:1", 1: "b:1"},
+                     tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        cfg.max_replicas = 0
+        for _ in range(3):
+            insp.push({"t0": 50})
+            planned = _acts(ctl.run_round())
+            assert not [p for p in planned if p["action"] == "move"]
+
+    def test_co_located_hot_shards_move_with_prewarm_then_cutover(self):
+        from horaedb_tpu.utils.events import EVENT_STORE
+
+        topo = _topo(nodes=("a:1", "b:1"), shards=3,
+                     assign={0: "a:1", 1: "a:1", 2: "b:1"},
+                     tables=[("t0", 0), ("t1", 1)])
+        moved, warmed = [], []
+        ctl, insp, cfg = _controller(
+            topo,
+            transfer=lambda sid, node, reason: moved.append((sid, node)),
+            add_replica=lambda sid, ep: warmed.append((sid, ep)),
+            shard_watermarks=lambda ep, sid: {"t0": 123, "t1": 123},
+        )
+        cfg.prewarm = True
+        cfg.max_replicas = 0  # isolate the move path
+        before = EVENT_STORE.stats()["issued"]
+        insp.push({"t0": 10, "t1": 4})
+        ctl.run_round()  # arms the move: prewarm replica installed
+        assert warmed == [(0, "b:1")]
+        assert 0 in ctl._pending and not moved
+        # the armed shard counts one extra desired replica (the tailing
+        # target must not be stripped by the ReplicaScheduler)
+        assert ctl.desired_replicas()[0] == 1
+        insp.push({"t0": 10, "t1": 4})
+        ctl.run_round()  # watermark fresh -> cutover
+        assert moved == [(0, "b:1")]
+        kinds = [
+            (e["attrs"].get("action"), e["attrs"].get("prewarmed"))
+            for e in EVENT_STORE.list(kind="elastic_action")
+            if e["seq"] > before
+        ]
+        assert ("prewarm", None) in kinds
+        assert ("move", True) in kinds
+
+    def test_global_move_cooldown_bounds_churn(self):
+        topo = _topo(nodes=("a:1", "b:1"), shards=4,
+                     assign={0: "a:1", 1: "a:1", 2: "a:1", 3: "b:1"},
+                     tables=[("t0", 0), ("t1", 1), ("t2", 2)])
+        moved = []
+        ctl, insp, cfg = _controller(
+            topo, transfer=lambda sid, node, reason: moved.append(sid)
+        )
+        cfg.max_replicas = 0
+        cfg.move_cooldown_s = 60.0
+        for _ in range(4):
+            insp.push({"t0": 10, "t1": 8, "t2": 6})
+            ctl.run_round()
+        assert len(moved) <= 1  # one move per cooldown, fleet-wide
+
+    def test_samples_shard_is_pinned(self):
+        topo = _topo(nodes=("a:1", "b:1"), shards=2,
+                     assign={0: "a:1", 1: "a:1"})
+        topo.add_table("system_metrics.samples", 1, 0, "")
+        topo.add_table("t1", 2, 1, "")
+        moved = []
+        ctl, insp, cfg = _controller(
+            topo, transfer=lambda sid, node, reason: moved.append(sid)
+        )
+        cfg.max_replicas = 0
+        for _ in range(3):
+            # the samples shard is the hottest — still never moves
+            insp.push({"system_metrics.samples": 20, "t1": 1})
+            ctl.run_round()
+        assert 0 not in moved
+
+    def test_circuit_breaker_quarantines_then_release_closes(self):
+        from horaedb_tpu.utils.events import EVENT_STORE
+
+        topo = _topo(nodes=("a:1", "b:1"), shards=3,
+                     assign={0: "a:1", 1: "a:1", 2: "b:1"},
+                     tables=[("t0", 0), ("t1", 1)])
+
+        def failing_transfer(sid, node, reason):
+            raise RuntimeError("injected move failure")
+
+        ctl, insp, cfg = _controller(topo, transfer=failing_transfer)
+        cfg.max_replicas = 0
+        cfg.quarantine_after = 2
+        before = EVENT_STORE.stats()["issued"]
+        for _ in range(12):
+            insp.push({"t0": 10, "t1": 4})
+            ctl.run_round()
+            if 0 in ctl.quarantined():
+                break
+            time.sleep(0.02)  # let the global move cadence expire
+        assert 0 in ctl.quarantined()
+        q_events = [
+            e for e in EVENT_STORE.list(kind="elastic_quarantined")
+            if e["seq"] > before
+        ]
+        assert q_events and q_events[-1]["attrs"]["shard_id"] == 0
+        # quarantined: no further actions for the shard, however hot
+        insp.push({"t0": 50, "t1": 4})
+        planned = _acts(ctl.run_round())
+        assert not [p for p in planned if p.get("shard_id") == 0]
+        # release closes the breaker and clears the failure count
+        assert ctl.release(0) is True
+        assert ctl.release(0) is False  # idempotent: already closed
+        assert 0 not in ctl.quarantined()
+        rel = [
+            e for e in EVENT_STORE.list(kind="elastic_released")
+            if e["seq"] > before
+        ]
+        assert rel and rel[-1]["attrs"]["shard_id"] == 0
+
+    def test_status_document(self):
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        insp.push({"t0": 10})
+        ctl.run_round()
+        doc = ctl.status()
+        assert doc["enabled"] and doc["rounds"] == 1
+        assert doc["policy"]["scale_up_qps"] == cfg.scale_up_qps
+        row = [s for s in doc["shards"] if s["shard_id"] == 0][0]
+        assert row["fast_qps"] > 0
+        assert row["desired_replicas"] == 1
+
+
+class TestLoadInspector:
+    def test_sums_across_nodes_and_excludes_system_tables(self):
+        rows_by_ep = {
+            "a:1": [
+                {"table_name": "t0", "sql": "SELECT 1",
+                 "admission_wait_seconds": 0.5},
+                {"table_name": "t0", "sql": "select 2",
+                 "admission_wait_seconds": 0},
+                {"table_name": "system.public.query_stats",
+                 "sql": "SELECT seq", "admission_wait_seconds": 0},
+                {"table_name": "", "sql": "SELECT 3",
+                 "admission_wait_seconds": 0},
+            ],
+            "b:1": [{"table_name": "t0", "sql": "promql: t0",
+                     "admission_wait_seconds": 0.25}],
+        }
+        insp = LoadInspector(
+            lambda: ["a:1", "b:1"],
+            sql_fn=lambda ep, q: rows_by_ep[ep],
+        )
+        load = insp.collect(0)
+        assert load.table_reads == {"t0": 3}
+        assert load.table_wait_s == {"t0": 0.75}
+        assert load.nodes_answered == 2
+
+    def test_write_statements_do_not_count_as_read_load(self):
+        # the policy scales READ replicas: INSERT ledgers must not mint
+        # followers for ingest-only shards
+        rows = [
+            {"table_name": "t0", "sql": "INSERT INTO t0 VALUES (1)",
+             "admission_wait_seconds": 0},
+            {"table_name": "t0", "sql": "  insert into t0 ...",
+             "admission_wait_seconds": 0},
+            {"table_name": "t0", "sql": "SELECT count(v) FROM t0",
+             "admission_wait_seconds": 0},
+        ]
+        insp = LoadInspector(lambda: ["a:1"], sql_fn=lambda ep, q: rows)
+        load = insp.collect(0)
+        assert load.table_reads == {"t0": 1}
+
+    def test_no_node_answered_is_a_hold_not_zero_load(self):
+        def boom(ep, q):
+            raise OSError("unreachable")
+
+        insp = LoadInspector(lambda: ["a:1"], sql_fn=boom)
+        assert insp.collect(0) is None
+
+    def test_partial_answers_are_accepted(self):
+        def flaky(ep, q):
+            if ep == "a:1":
+                raise OSError("unreachable")
+            return [{"table_name": "t0", "sql": "SELECT 1",
+                     "admission_wait_seconds": 0}]
+
+        insp = LoadInspector(lambda: ["a:1", "b:1"], sql_fn=flaky)
+        load = insp.collect(0)
+        assert load is not None and load.table_reads == {"t0": 1}
+        assert load.nodes_answered == 1
+
+    def test_mark_advances_past_newest_received_row(self):
+        # rows finalized between poll start and server evaluation must
+        # not be re-counted next round: the mark advances past the
+        # newest row actually received
+        future_ms = int(time.time() * 1000) + 60_000
+        rows = [{"timestamp": future_ms, "table_name": "t0",
+                 "sql": "SELECT 1", "admission_wait_seconds": 0}]
+        insp = LoadInspector(lambda: ["a:1"], sql_fn=lambda ep, q: rows)
+        insp.collect(0)
+        assert insp._last_ok_ms["a:1"] == future_ms + 1
+
+
+class TestElasticConfig:
+    def _load(self, tmp_path, elastic_lines):
+        from horaedb_tpu.utils.config import Config
+
+        body = "\n".join(
+            [
+                "[cluster]",
+                'self_endpoint = "n1:5440"',
+                'meta_endpoints = ["m1:2379"]',
+                "[cluster.elastic]",
+                *elastic_lines,
+            ]
+        )
+        p = tmp_path / "conf.toml"
+        p.write_text(body)
+        return Config.load(str(p))
+
+    def test_parse_and_defaults(self, tmp_path):
+        cfg = self._load(
+            tmp_path,
+            [
+                "enabled = true",
+                "max_replicas = 3",
+                "scale_up_qps = 20.0",
+                "scale_down_qps = 2.0",
+                'fast_window = "30s"',
+                'slow_window = "5m"',
+                'move_cooldown = "3m"',
+            ],
+        )
+        es = cfg.cluster.elastic
+        assert es.enabled and es.max_replicas == 3
+        assert es.fast_window_s == 30.0 and es.slow_window_s == 300.0
+        assert es.move_cooldown_s == 180.0
+        assert es.dry_run is False  # default
+
+    def test_hysteresis_gap_is_mandatory(self, tmp_path):
+        with pytest.raises(ConfigError, match="scale_down_qps"):
+            self._load(
+                tmp_path,
+                ["enabled = true", "scale_up_qps = 5.0",
+                 "scale_down_qps = 5.0"],
+            )
+
+    def test_unknown_key_rejected(self, tmp_path):
+        with pytest.raises(ConfigError, match="cluster.elastic"):
+            self._load(tmp_path, ["enbaled = true"])
+
+    def test_window_order_enforced(self, tmp_path):
+        with pytest.raises(ConfigError, match="window"):
+            self._load(
+                tmp_path,
+                ["enabled = true", 'fast_window = "10m"',
+                 'slow_window = "1m"'],
+            )
+
+
+class TestReviewHardening:
+    """Regression pins for the review findings (each was a live bug)."""
+
+    def test_fresh_controller_never_scales_in_without_history(self):
+        # a controller that just started sees a quiet shard with
+        # replicas: near-zero windows mean NO HISTORY, not sustained
+        # quiet — scale-in must wait out a full slow span
+        topo = _topo(tables=[("t0", 0)])
+        topo.set_replicas(0, ("b:1",))
+        ctl, insp, cfg = _controller(topo)
+        cfg.rebalance = False
+        cfg.slow_window_s = 60.0  # far longer than the test runs
+        insp.push({})
+        planned = _acts(ctl.run_round())
+        assert not planned
+        assert ctl.desired_replicas()[0] == 1  # adopted, not stripped
+
+    def test_zero_online_nodes_is_a_hold(self):
+        insp = LoadInspector(lambda: [], sql_fn=lambda ep, q: [])
+        assert insp.collect(0) is None
+
+    def test_missed_round_backlog_is_reread_not_dropped(self):
+        queries = []
+
+        def flaky(ep, q):
+            queries.append((ep, q))
+            if ep == "b:1" and len([x for x in queries if x[0] == "b:1"]) == 1:
+                raise OSError("unreachable this round")
+            return []
+
+        insp = LoadInspector(lambda: ["a:1", "b:1"], sql_fn=flaky)
+        assert insp.collect(1000) is not None  # a answered, b failed
+        insp.collect(999_999_999_999_999)  # caller advanced its mark
+        # b's second poll must re-ask from ITS OWN last success (the
+        # original since), not the caller's advanced mark
+        b_queries = [q for ep, q in queries if ep == "b:1"]
+        assert ">= 1000" in b_queries[-1]
+        a_queries = [q for ep, q in queries if ep == "a:1"]
+        assert ">= 1000" not in a_queries[-1]  # a DID advance
+
+    def test_prewarm_bump_only_when_replica_was_installed(self):
+        # the move target is ALREADY an established replica: the armed
+        # move must not mint an extra desired slot (the spurious new
+        # follower would survive the cutover as THE replica — cold)
+        topo = _topo(nodes=("a:1", "b:1"), shards=3,
+                     assign={0: "a:1", 1: "a:1", 2: "b:1"},
+                     tables=[("t0", 0), ("t1", 1)])
+        topo.set_replicas(0, ("b:1",))
+        warmed = []
+        ctl, insp, cfg = _controller(
+            topo,
+            transfer=lambda *a: None,
+            add_replica=lambda sid, ep: warmed.append((sid, ep)),
+            shard_watermarks=lambda ep, sid: {"t0": 1},
+        )
+        cfg.prewarm = True
+        cfg.max_replicas = 0
+        with ctl._lock:
+            ctl._desired[0] = 1  # policy already accounts for b:1
+        insp.push({"t0": 10, "t1": 4})
+        ctl.run_round()
+        assert 0 in ctl._pending and ctl._pending[0].prewarmed
+        assert not warmed  # no new replica installed...
+        assert ctl.desired_replicas()[0] == 1  # ...and no +1 bump
+
+    def test_dry_run_keeps_count_rebalancer(self):
+        from horaedb_tpu.meta.service import MetaServer
+        from horaedb_tpu.meta.scheduler import RebalancedScheduler
+
+        es = ElasticSection(enabled=True, dry_run=True)
+        ms = MetaServer(MemoryKV(), num_shards=2, elastic=es)
+        assert any(
+            isinstance(s, RebalancedScheduler) for s in ms.schedulers
+        ), "a dry-run (never-acting) controller must not displace the rebalancer"
+        es2 = ElasticSection(enabled=True)
+        ms2 = MetaServer(MemoryKV(), num_shards=2, elastic=es2)
+        assert not any(
+            isinstance(s, RebalancedScheduler) for s in ms2.schedulers
+        )
+
+
+class TestReviewHardeningRound2:
+    def test_backlog_after_hold_is_not_a_fake_spike(self):
+        # a telemetry outage keeps _since_ms; the first successful
+        # collect returns the WHOLE backlog. Spread over its span it is
+        # ordinary load — folded into one instant it would cross the
+        # scale-up threshold and mint replicas for a shard that was
+        # never hot
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        cfg.rebalance = False
+        # simulate a long outage: the controller's since mark is old
+        ctl._since_ms -= 600_000  # 10 minutes of backlog window
+        # steady 2 qps for 10 min = 1200 rows — a real spike would be
+        # 1200 rows in one fast window
+        insp.push({"t0": 1200})
+        planned = _acts(ctl.run_round())
+        assert not [p for p in planned if p["action"] == "scale_up"], planned
+
+    def test_promql_blocked_table_not_served_by_follower(self):
+        # covered end-to-end in test_replica_reads (SQL wire keeps the
+        # limiter via handle_sql); here pin the unit seam: the prom
+        # handler's follower run_local includes proxy.limiter.check —
+        # source-level guard against the check being dropped again
+        import inspect as _inspect
+
+        import horaedb_tpu.server.http as http_mod
+
+        src = _inspect.getsource(http_mod)
+        i = src.find("def run_checked")
+        assert i != -1
+        assert "limiter.check" in src[i:i + 600]
+
+    def test_telemetry_lag_gauge_grows_when_never_collected(self):
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        topo = _topo(tables=[("t0", 0)])
+        ctl, insp, cfg = _controller(topo)
+        ctl._started_at = ctl._now() - 42.0  # controller 42s old
+        insp.script = [None]
+        ctl.run_round()  # hold with no successful collection ever
+        fams = REGISTRY.families()["horaedb_elastic_telemetry_lag_seconds"]
+        value = fams[0].value
+        assert value >= 42.0

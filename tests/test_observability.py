@@ -1447,3 +1447,94 @@ class TestSloRegistryLint:
             assert "slo_lint_table" in list(rg.columns["objective"])
         finally:
             db.close()
+
+
+class TestElasticRegistryLint:
+    """PR-12 lint extension (same contract as the slo/replica/rules
+    registries) for the elastic control loop: every family declared in
+    meta/elastic.ELASTIC_METRIC_FAMILIES must be (a) registered live —
+    the per-action counter series eagerly at module import — (b)
+    convention-clean, (c) documented in docs/OBSERVABILITY.md; no stray
+    horaedb_elastic_* family may exist outside the declared registry.
+    The [cluster.elastic] knobs are operator surface: pinned to
+    docs/WORKLOAD.md. The elastic event kinds must be declared in
+    EVENT_KINDS (counters + docs ride the event-kind lint)."""
+
+    def test_elastic_families_declared_and_documented(self):
+        import os
+        import re
+
+        from horaedb_tpu.meta.elastic import (
+            ELASTIC_ACTIONS,
+            ELASTIC_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in ELASTIC_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for action in ELASTIC_ACTIONS:
+            if f'action="{action}"' not in exposed:
+                missing.append(f"label action={action}: not eagerly registered")
+        for fam in families:
+            if fam.startswith("horaedb_elastic_") and \
+                    fam not in ELASTIC_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("dry_run", "min_replicas", "max_replicas",
+                     "scale_up_qps", "scale_down_qps", "fast_window",
+                     "slow_window", "decide_interval", "cooldown",
+                     "move_cooldown", "action_budget", "quarantine_after",
+                     "node_stable", "min_move_qps", "prewarm",
+                     "prewarm_timeout"):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
+    def test_elastic_event_kinds_declared(self):
+        from horaedb_tpu.utils.events import EVENT_KINDS
+
+        assert {"elastic_decision", "elastic_action",
+                "elastic_quarantined", "elastic_released"} <= set(EVENT_KINDS)
+
+    def test_table_name_column_in_query_stats(self):
+        """The elastic load signal: the proxy stamps the statement's
+        primary table into the ledger, and query_stats serves it."""
+        import horaedb_tpu
+        from horaedb_tpu.table_engine.system import QueryStatsTable
+
+        cols = {c.name for c in QueryStatsTable().schema.columns}
+        assert "table_name" in cols
+        db = horaedb_tpu.connect(None)
+        try:
+            db.execute(
+                "CREATE TABLE lint_tn (v double, ts timestamp NOT NULL, "
+                "TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            from horaedb_tpu.proxy import Proxy
+
+            p = Proxy(db)
+            try:
+                p.handle_sql("SELECT count(v) AS c FROM lint_tn")
+            finally:
+                p.close()
+            from horaedb_tpu.utils.querystats import STATS_STORE
+
+            rows = [
+                e for e in STATS_STORE.list()
+                if e.get("table_name") == "lint_tn"
+            ]
+            assert rows, "no query_stats row carried table_name"
+        finally:
+            db.close()

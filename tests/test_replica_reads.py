@@ -20,6 +20,7 @@ import pytest
 
 import horaedb_tpu
 from horaedb_tpu.cluster import ClusterImpl, MetaClient, ReplicaFencedError
+from horaedb_tpu.server.http import REPLICA_EPOCH_HEADER
 from horaedb_tpu.cluster.router import Route, Router
 from horaedb_tpu.db import Connection
 from horaedb_tpu.engine.wal import LocalDiskWal
@@ -820,3 +821,142 @@ meta_endpoints = ["127.0.0.1:{meta_port}"]
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+class TestFollowerProtocolWires:
+    """PR-10 remainder (PR-12 satellite): follower routing for the
+    PromQL / InfluxQL / OpenTSDB read endpoints — eligible historical
+    reads serve from a replica (locally or offloaded via pick_replica
+    with leader fallback), stamped route=follower in query_stats."""
+
+    # the same leader+follower+edge topology the SQL-wire tests use
+    stack = TestFollowerGateway.__dict__["stack"]
+
+    @staticmethod
+    async def _follower_routes(client, proto: str):
+        stats = await (await client.post(
+            "/sql",
+            json={"query": "SELECT sql, route, replica_lag_ms FROM "
+                  "system.public.query_stats"},
+        )).json()
+        return [
+            r for r in stats["rows"]
+            if r["sql"].startswith(f"{proto}:") and r["route"] == "follower"
+        ]
+
+    def test_influxql_historical_served_by_follower(self, stack):
+        wm = stack["wm"]
+        q = f"SELECT sum(v) FROM hot WHERE time <= {wm - 1}ms"
+
+        async def body(leader_c, follower_c, edge_c):
+            lead = await (await leader_c.get(
+                "/influxdb/v1/query", params={"q": q}
+            )).json()
+            resp = await follower_c.get("/influxdb/v1/query", params={"q": q})
+            assert resp.status == 200
+            assert resp.headers.get(REPLICA_EPOCH_HEADER) == "3"
+            assert "X-HoraeDB-Replica-Lag-Ms" in resp.headers
+            assert (await resp.json()) == lead  # leader/follower agreement
+            mine = await self._follower_routes(follower_c, "influxql")
+            assert mine and mine[-1]["replica_lag_ms"] >= 0
+
+        _run_async(stack, body)
+
+    def test_influxql_open_tail_stays_off_the_follower_path(self, stack):
+        # no guaranteed upper time bound -> not follower-eligible; the
+        # statement must NOT be stamped route=follower
+        q = "SELECT sum(v) FROM hot"
+
+        async def body(leader_c, follower_c, edge_c):
+            # the stats ring is process-global: count deltas, not totals
+            before = len(await self._follower_routes(follower_c, "influxql"))
+            resp = await follower_c.get("/influxdb/v1/query", params={"q": q})
+            assert resp.status == 200
+            assert REPLICA_EPOCH_HEADER not in resp.headers
+            after = len(await self._follower_routes(follower_c, "influxql"))
+            assert after == before
+
+        _run_async(stack, body)
+
+    def test_opentsdb_historical_served_by_follower(self, stack):
+        wm = stack["wm"]
+        body_json = {
+            "start": 0,
+            "end": wm - 1,  # ms: an explicit historical end
+            "queries": [{"metric": "hot", "aggregator": "sum"}],
+        }
+
+        async def body(leader_c, follower_c, edge_c):
+            lead = await (await leader_c.post(
+                "/opentsdb/api/query", json=body_json
+            )).json()
+            resp = await follower_c.post("/opentsdb/api/query", json=body_json)
+            assert resp.status == 200
+            assert resp.headers.get(REPLICA_EPOCH_HEADER) == "3"
+            assert (await resp.json()) == lead
+            assert await self._follower_routes(follower_c, "opentsdb")
+
+        _run_async(stack, body)
+
+    def test_promql_instant_served_by_follower(self, stack):
+        wm = stack["wm"]
+        params = {"query": "sum(hot)", "time": str((wm - 1) / 1000.0)}
+
+        async def body(leader_c, follower_c, edge_c):
+            lead = await (await leader_c.get(
+                "/prom/v1/query", params=params
+            )).json()
+            resp = await follower_c.get("/prom/v1/query", params=params)
+            assert resp.status == 200, await resp.text()
+            assert resp.headers.get(REPLICA_EPOCH_HEADER) == "3"
+            got = await resp.json()
+            assert got["status"] == "success"
+            assert got["data"] == lead["data"]
+            assert await self._follower_routes(follower_c, "promql")
+
+        _run_async(stack, body)
+
+    def test_edge_offloads_influxql_to_replica(self, stack):
+        wm = stack["wm"]
+        q = f"SELECT count(v) FROM hot WHERE time <= {wm - 1}ms"
+
+        async def body(leader_c, follower_c, edge_c):
+            lead = await (await leader_c.get(
+                "/influxdb/v1/query", params={"q": q}
+            )).json()
+            # edge is neither leader nor replica: the request offloads to
+            # the follower, whose replica headers ride back through
+            resp = await edge_c.get("/influxdb/v1/query", params={"q": q})
+            assert resp.status == 200
+            assert resp.headers.get(REPLICA_EPOCH_HEADER) == "3"
+            assert (await resp.json()) == lead
+            # the follower (not the edge) recorded the serving
+            assert await self._follower_routes(follower_c, "influxql")
+
+        _run_async(stack, body)
+
+    def test_forwarded_replica_read_refused_when_not_replicated(self, stack):
+        # a replica-read-marked request for a table this node does not
+        # replicate gets the TYPED refusal (origin owns the fallback)
+        async def body(leader_c, follower_c, edge_c):
+            resp = await follower_c.get(
+                "/influxdb/v1/query",
+                params={"q": "SELECT sum(v) FROM cold WHERE time <= 5ms"},
+                headers={"X-HoraeDB-Replica-Read": "1"},
+            )
+            assert resp.status == 503
+            assert (await resp.json()).get("replica")
+
+        _run_async(stack, body)
+
+
+def _run_async(state, body):
+    async def runner():
+        clients = await state["build"]()
+        try:
+            await body(*clients)
+        finally:
+            for c in clients:
+                await c.close()
+
+    asyncio.run(runner())
