@@ -25,6 +25,12 @@ target list:
                         same storm pinned to the shard leader; gates on
                         result agreement + followers actually serving +
                         never-worse on the leader-only open-tail shape
+    flood               multi-query fused serving A/B: 100s of concurrent
+                        shape-identical dashboard aggregates (literals
+                        varied per query) through the proxy with cohort
+                        batching ([wlm.batch]) vs per-query dispatch;
+                        gates on dispatches-per-query reduction (>=4x
+                        once cohorts reach 8), emits p50/p99 both arms
     rollup              continuous-query A/B: dashboard range aggregate
                         (time_bucket 5m x host x avg) served from the
                         maintained 1m rollup (route=rollup) vs the same
@@ -950,6 +956,162 @@ def run_rawscan_config() -> dict:
         db.close()
 
 
+# ---- flood config (multi-query fused serving A/B) -------------------------
+
+
+def run_flood_config() -> dict:
+    """The dashboard flood (ROADMAP item 1): hundreds of concurrent
+    shape-identical aggregate SELECTs — same dashboard query, different
+    tenant/host/time literals — through the proxy, A/B-ing cohort
+    batching ([wlm.batch], wlm/batch.CohortBatcher + the vmapped
+    ops/scan_agg.cached_scan_agg_cohort kernel) against today's
+    per-query dispatch path.
+
+    The headline is DISPATCHES PER QUERY, counted from the database's
+    own ledger counters (horaedb_query_jit_compiles_total +
+    jit_cache_hits_total — every device-kernel dispatch feeds exactly
+    one of them): the fused arm must serve the flood with strictly
+    fewer device dispatches per query (>= 4x fewer once cohorts reach
+    8). p50/p99 per-query latency rides in the record for both arms
+    (on a tunneled accelerator the per-dispatch RTT saving is the
+    point; on XLA-CPU dispatch is cheap so latency parity is the bar)."""
+    import threading
+
+    from horaedb_tpu.proxy import Proxy
+    from horaedb_tpu.utils.config import BatchSection
+    from horaedb_tpu.utils.querystats import _FIELD_COUNTERS
+    from horaedb_tpu.utils.metrics import REGISTRY
+    import jax
+
+    platform = jax.devices()[0].platform
+    hosts = int(os.environ.get("BENCH_FLOOD_HOSTS", "48"))
+    rows_per_host = int(os.environ.get("BENCH_FLOOD_ROWS", "300"))
+    queries = int(os.environ.get("BENCH_FLOOD_QUERIES", "800"))
+    workers = int(os.environ.get("BENCH_FLOOD_WORKERS", "32"))
+    window_s = float(os.environ.get("BENCH_FLOOD_WINDOW_S", "0.005"))
+
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE dash (host string TAG, v double, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+    )
+    rng = np.random.default_rng(11)
+    t0 = 1_700_000_000_000
+    chunk = []
+    for h in range(hosts):
+        vs = rng.random(rows_per_host) * 100.0
+        for i in range(rows_per_host):
+            chunk.append(f"('h{h}', {vs[i]:.3f}, {t0 + i * 1000})")
+        if len(chunk) >= 4000 or h == hosts - 1:
+            db.execute(
+                "INSERT INTO dash (host, v, ts) VALUES " + ",".join(chunk)
+            )
+            chunk = []
+    db.flush_all()
+    span = rows_per_host * 1000
+
+    def sql_for(q: int) -> str:
+        # one plan shape, literals varied per query: sliding time range
+        # + a numeric filter literal (the dashboard-refresh pattern)
+        lo = t0 + (q % 64) * 1000
+        return (
+            f"SELECT host, count(v), sum(v), max(v) FROM dash "
+            f"WHERE ts >= {lo} AND ts < {t0 + span} AND v >= {q % 7}.5 "
+            f"GROUP BY host"
+        )
+
+    def dispatches() -> float:
+        return (
+            _FIELD_COUNTERS["jit_compiles"].value
+            + _FIELD_COUNTERS["jit_cache_hits"].value
+        )
+
+    def flood(proxy, n: int, record: list | None) -> None:
+        idx = iter(range(n))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    q = next(idx, None)
+                if q is None:
+                    return
+                t_q = time.perf_counter()
+                proxy.handle_sql(sql_for(q))
+                if record is not None:
+                    record.append(time.perf_counter() - t_q)
+
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    def arm(batch_cfg) -> dict:
+        proxy = Proxy(db, batch_cfg=batch_cfg)
+        try:
+            # warmup: build the scan cache, compile the kernels (and the
+            # cohort kernel's pow2 batch buckets in the fused arm) so
+            # the measured flood is steady-state serving
+            flood(proxy, min(128, queries), None)
+            lat: list = []
+            d0 = dispatches()
+            t_arm = time.perf_counter()
+            flood(proxy, queries, lat)
+            wall = time.perf_counter() - t_arm
+            d1 = dispatches()
+            lat.sort()
+            return {
+                "dispatches_per_query": round((d1 - d0) / queries, 4),
+                "p50_ms": round(lat[len(lat) // 2] * 1000, 3),
+                "p99_ms": round(lat[int(len(lat) * 0.99) - 1] * 1000, 3),
+                "qps": round(queries / max(wall, 1e-9), 1),
+            }
+        finally:
+            proxy.close()
+
+    try:
+        solo = arm(None)  # batching disabled: today's per-query path
+        fused = arm(
+            BatchSection(enabled=True, window_s=window_s, max_cohort=32)
+        )
+        # mean fused cohort size, from the database's own family
+        sizes = {"1": 1, "2": 2, "4": 3, "8": 6, "16": 12, "32+": 24}
+        cohorts = served = 0.0
+        for b, approx in sizes.items():
+            c = REGISTRY.counter(
+                "horaedb_batch_cohort_total",
+                "fused cohorts served, by cohort-size bucket",
+                labels={"size": b},
+            ).value
+            cohorts += c
+            served += c * approx
+        mean_cohort = round(served / cohorts, 2) if cohorts else 0.0
+        reduction = round(
+            solo["dispatches_per_query"]
+            / max(fused["dispatches_per_query"], 1e-9),
+            2,
+        )
+        suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+        return {
+            "metric": f"flood_dispatch_reduction{suffix}",
+            "value": reduction,
+            "unit": "solo dispatches-per-query / fused dispatches-per-query",
+            "vs_baseline": reduction,
+            "baseline": "per-query dispatch ([wlm.batch] enabled=false)",
+            "queries": queries,
+            "workers": workers,
+            "window_ms": window_s * 1000,
+            "mean_cohort": mean_cohort,
+            "reduction_ok": reduction >= 4.0 or mean_cohort < 8,
+            "solo": solo,
+            "fused": fused,
+            "platform": platform,
+        }
+    finally:
+        db.close()
+
+
 def _host_merge_permutation(tsid, ts, seq, dedup=True):
     """Vectorized-numpy merge baseline with the device kernel's exact
     semantics: sort (tsid, ts, seq desc, input-row desc), keep the first
@@ -1337,7 +1499,7 @@ def _emit(obj: dict) -> None:
 # final stdout line, and every config still gets its own line.
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
-    "compaction-64", "ingest", "groupby", "rawscan", "rollup",
+    "compaction-64", "ingest", "groupby", "rawscan", "rollup", "flood",
     "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
@@ -1886,6 +2048,8 @@ def run_config(config: str) -> dict:
         return run_groupby_config()
     if config == "rawscan":
         return run_rawscan_config()
+    if config == "flood":
+        return run_flood_config()
     if config == "rollup":
         return run_rollup_config()
     builder = CONFIGS.get(config)

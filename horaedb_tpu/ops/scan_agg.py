@@ -535,6 +535,53 @@ cached_scan_agg_packed = functools.partial(
 )(_packed_body)
 
 
+def _cohort_body(
+    series_codes,
+    ts_rel,
+    values,
+    sessions,  # int32[B, 2*(S+1)]: one packed session row per member
+    dyns,  # int32[B, n_f + 4]: one packed dyn row per member
+    *,
+    n_groups: int,
+    n_buckets: int,
+    n_agg_fields: int,
+    numeric_filters: tuple[tuple[int, int], ...],
+    need_minmax: bool,
+    segment_impl: str = "auto",
+    hash_slots: int = 0,
+):
+    """The multi-query fused serving kernel: ``_packed_body`` vmapped
+    over the QUERY axis. The big resident arrays (series codes, relative
+    timestamps, value columns) broadcast across the batch — HBM is read
+    by one compiled program serving B logical queries, instead of B
+    dispatches each paying its own device RTT. Selective row-gather is
+    per-query-variable-length and therefore excluded: cohort members
+    always run the full-scan kernel."""
+    one = functools.partial(
+        _packed_body,
+        n_groups=n_groups,
+        n_buckets=n_buckets,
+        n_agg_fields=n_agg_fields,
+        numeric_filters=numeric_filters,
+        need_minmax=need_minmax,
+        segment_impl=segment_impl,
+        hash_slots=hash_slots,
+        selective=False,
+    )
+    return jax.vmap(
+        lambda s, d: one(series_codes, ts_rel, values, s, d)
+    )(sessions, dyns)
+
+
+cached_scan_agg_cohort = functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
+        "need_minmax", "segment_impl", "hash_slots",
+    ),
+)(_cohort_body)
+
+
 def unpack_packed_state(packed, spec: "ScanAggSpec") -> "AggState":
     """ONE blocking device fetch -> writable host AggState.
 

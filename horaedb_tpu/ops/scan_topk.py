@@ -375,6 +375,44 @@ def raw_topk_packed(
 
 @functools.partial(
     jax.jit,
+    static_argnames=(
+        "k", "descending", "key_is_ts", "key_field", "numeric_filters",
+    ),
+)
+def raw_topk_cohort(
+    series_codes,
+    ts_rel,
+    values,
+    sessions,  # int32[B, S+1]: one allow-list row per member
+    dyns,  # int32[B, n_f + 4]: one packed dyn row per member
+    *,
+    k: int,
+    descending: bool,
+    key_is_ts: bool,
+    key_field: int,
+    numeric_filters: tuple[tuple[int, int], ...],
+):
+    """Multi-query fused top-k: ``raw_topk_packed``'s body vmapped over
+    the QUERY axis — B shape-identical dashboard ORDER-BY-LIMIT queries
+    (same k, differing allow-lists/time bounds/literals) share one
+    compiled program and one device round trip. -> int32[B, k] resident
+    row indices, -1 in slots with no passing row."""
+
+    def one(session, dyn):
+        literals, lo, hi, key_lo, key_hi = _unpack_dyn(dyn, numeric_filters)
+        _, idx = raw_topk_body(
+            series_codes, ts_rel, values, session != 0, literals, lo, hi,
+            key_lo, key_hi,
+            k=k, descending=descending, key_is_ts=key_is_ts,
+            key_field=key_field, numeric_filters=numeric_filters,
+        )
+        return idx
+
+    return jax.vmap(one)(sessions, dyns)
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("select_slots", "numeric_filters"),
 )
 def raw_select_packed(

@@ -150,6 +150,7 @@ class Proxy:
         slow_threshold_s: float = 1.0,
         limits=None,
         persist_path: Optional[str] = None,
+        batch_cfg=None,
     ) -> None:
         self.conn = conn
         if persist_path is None:
@@ -160,7 +161,9 @@ class Proxy:
             root = getattr(conn.store, "root", None)
             if root:
                 persist_path = os.path.join(root, "wlm_state.json")
-        self.wlm = WorkloadManager.from_limits(limits, persist_path=persist_path)
+        self.wlm = WorkloadManager.from_limits(
+            limits, persist_path=persist_path, batch_cfg=batch_cfg
+        )
         # the old Limiter surface (block/unblock/blocked/check) lives on,
         # served by the quota manager that subsumed it
         self.limiter = self.wlm.quota
@@ -246,7 +249,31 @@ class Proxy:
                             finally:
                                 exec_elapsed[0] = time.perf_counter() - t0
 
-                out = self.wlm.dedup.run(sql.strip(), run_leader)
+                def run_solo():
+                    return self.wlm.dedup.run(sql.strip(), run_leader)
+
+                batcher = self.wlm.batch
+                if batcher.enabled and batcher.eligible(plan, shape):
+                    # Cohort batching (wlm/batch): shape-identical
+                    # in-flight SELECTs with differing literals gather
+                    # for the micro-batching window and serve from ONE
+                    # fused device dispatch. The key carries the dedup
+                    # write epoch — a write landing mid-window fences
+                    # later members into a fresh cohort (read-your-
+                    # writes, same contract as the flight table).
+                    from ..wlm import batch_plan_key
+
+                    out = batcher.run(
+                        key=(self.wlm.dedup.epoch(), batch_plan_key(plan)),
+                        sql=sql.strip(),
+                        plan=plan,
+                        solo=run_solo,
+                        cohort_exec=lambda members: self._execute_cohort(
+                            members, admission_class, exec_elapsed
+                        ),
+                    )
+                else:
+                    out = run_solo()
                 self.recent_queries.append(
                     {
                         "request_id": ctx.request_id,
@@ -320,3 +347,43 @@ class Proxy:
                         "ledger": ledger.to_dict(),
                     }
                 )
+
+    def _execute_cohort(
+        self, members: list, admission_class: str, exec_elapsed=None
+    ) -> list:
+        """Execute a gathered cohort (wlm/batch) under ONE admission slot
+        — members coalesce onto the leader's slot exactly like dedup
+        followers — on the leader's priority lane. Returns one
+        Output-or-exception per member, positionally (the interpreter
+        isolates member failures). ``exec_elapsed[0]`` gets the
+        AMORTIZED per-member execution seconds so the leader's shape
+        keeps feeding the admission cost EWMA (the fused dispatch serves
+        B queries in one execution; per-member cost is what classifies
+        one query of the shape)."""
+        import contextvars
+
+        from ..utils.tracectx import span
+
+        lane = lane_for(admission_class)
+        plans = [plan for _, plan in members]
+        with self.wlm.admission.admit(admission_class):
+            with span(
+                "execute_cohort",
+                priority=lane,
+                admission=admission_class,
+                cohort=len(members),
+            ):
+                cctx = contextvars.copy_context()
+                t0 = time.perf_counter()
+                try:
+                    return self.runtime.run(
+                        lane,
+                        lambda: cctx.run(
+                            self.conn.interpreters.execute_cohort, plans
+                        ),
+                    )
+                finally:
+                    if exec_elapsed is not None:
+                        exec_elapsed[0] = (
+                            time.perf_counter() - t0
+                        ) / max(len(members), 1)

@@ -417,6 +417,55 @@ def _eval_func(e: ast.FuncCall, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]
 # ---- executor ------------------------------------------------------------
 
 
+@dataclass
+class CachedAggPrep:
+    """A fully-prepared cached-aggregate device dispatch — the output of
+    the "plan -> device spec" half (Executor.prepare_cached_agg) and the
+    input of the "spec -> dispatch" half. Everything per-query the
+    kernel needs is HERE (small host arrays + scalars), so shape-
+    identical preps can be MERGED into one batched dispatch before any
+    device work happens (Executor.dispatch_cached_agg_cohort)."""
+
+    plan: Any
+    m: dict
+    entry: Any  # scan-cache entry holding the HBM-resident columns
+    spec: Any  # padded ScanAggSpec with the CONCRETE segment impl
+    krec: Any  # kernel-router token (None when routing doesn't apply)
+    value_names: list
+    literals: list
+    device_filters: list
+    gos: np.ndarray  # series -> group map (+ pad slot)
+    allow: np.ndarray  # tag-filter allow-list (+ pad slot; delta fold)
+    allow_scan: np.ndarray  # allow AND value-stat pruning (scan only)
+    row_idx: Optional[np.ndarray]  # selective gather index, or None
+    lo: int
+    hi: int
+    t0: int
+    width: Optional[int]
+    n_buckets: int
+    empty_range: bool
+    lo_rel: int
+    hi_rel: int
+    t0_rel: int
+    width_i: int
+    kernel_key: tuple
+    tag_keys: list
+    key_values: tuple
+    agg_cols: list
+    num_groups: int
+    delta: Any
+
+    def fuse_key(self, i: int) -> tuple:
+        """Grouping key for cohort merging: preps agreeing on the cache
+        entry, the static spec, and the value-column layout share one
+        fused dispatch. Selective (gathered) and mesh-sharded dispatches
+        cannot ride the batched kernel — they stay solo (index-unique
+        key)."""
+        if self.row_idx is not None or self.entry.mesh is not None:
+            return ("solo", i)
+        return (id(self.entry), self.spec, tuple(self.value_names))
+
+
 class Executor:
     """Executes QueryPlans against Tables (AnalyticTable / PartitionedTable
     / MemoryTable — anything behind the table_engine.Table interface)."""
@@ -435,7 +484,13 @@ class Executor:
         self.path_router = PathRouter()
         self._adaptive: bool | None = None  # resolved lazily (imports jax)
 
-    def execute(self, plan: QueryPlan, table) -> ResultSet:
+    def execute(
+        self, plan: QueryPlan, table, _skip_cached_agg: bool = False
+    ) -> ResultSet:
+        """``_skip_cached_agg``: execute_cohort's fallback for a member
+        whose cached-path prepare already bailed — the bail is
+        deterministic for the same state, so retrying it here would
+        only double the prepare work and the cache_misses count."""
         import time as _time
 
         t_start = _time.perf_counter()
@@ -476,7 +531,10 @@ class Executor:
             bounded = bool(cap) and _scan_estimate_bytes(
                 table, plan.predicate, self._projection(plan)
             ) > cap
-        if plan.is_aggregate and cache_on and route != "host" and not bounded:
+        if (
+            plan.is_aggregate and cache_on and route != "host"
+            and not bounded and not _skip_cached_agg
+        ):
             cached = self._try_cached_agg(plan, table, m)
             if cached is not None:
                 path = "device-cached"
@@ -954,15 +1012,35 @@ class Executor:
         return _order_and_limit(result, plan)
 
     # ---- device-cached path (HBM-resident columns) ---------------------------
+    #
+    # Split into "plan -> device spec" (prepare_cached_agg: eligibility,
+    # cache entry, per-series filters, time math, kernel routing — pure
+    # host work producing a CachedAggPrep) and "spec -> dispatch"
+    # (dispatch_cached_agg / dispatch_cached_agg_cohort: the device
+    # call, delta fold, result assembly). The split is what lets cohort
+    # batching MERGE shape-identical specs into one fused dispatch
+    # (wlm/batch + ops/scan_agg.cached_scan_agg_cohort).
+
     def _try_cached_agg(self, plan: QueryPlan, table, m: dict) -> Optional[ResultSet]:
         """Serve an aggregate from device-resident scan state, or None.
 
         Ships only O(series)+O(1) data per query; see query/scan_cache.py.
         """
-        import jax.numpy as jnp
+        prep = self.prepare_cached_agg(plan, table, m)
+        if prep is None:
+            return None
+        return self.dispatch_cached_agg(prep)
 
-        from ..ops.scan_agg import coerce_literals, encode_filter_ops, state_to_host
-
+    def prepare_cached_agg(
+        self, plan: QueryPlan, table, m: dict, allow_selective: bool = True
+    ) -> Optional["CachedAggPrep"]:
+        """The "plan -> device spec" half: everything up to (but not
+        including) the kernel dispatch. Returns None exactly where the
+        cached path used to bail (caller falls through to the uncached
+        paths). ``allow_selective=False`` skips the gathered-subset
+        optimization so the resulting spec stays cohort-mergeable (the
+        batched kernel cannot vmap over per-query-variable row
+        indices)."""
         schema = plan.schema
         if schema.tsid_index is None or not table.physical_datas():
             return None
@@ -1149,7 +1227,6 @@ class Executor:
         if scan_allowed is not allowed:
             # value-stat prunes only — not series tag filters excluded
             m["series_pruned"] = int(allowed.sum() - scan_allowed.sum())
-        values_dev = entry.values_for(value_names)
         literals = [lit for _, _, lit in device_filters]
         lo_rel = lo - entry.min_ts
         hi_rel = hi - entry.min_ts
@@ -1160,6 +1237,39 @@ class Executor:
             spec.numeric_filters, spec.need_minmax,
             spec.segment_impl, spec.hash_slots,
         )
+        row_idx = None
+        if entry.mesh is None and allow_selective and not empty_range:
+            row_idx = self._selective_row_idx(entry, scan_allowed, lo, hi)
+            if row_idx is not None:
+                m["cache_rows"] = int((row_idx != entry.n_valid).sum())
+        return CachedAggPrep(
+            plan=plan, m=m, entry=entry, spec=spec, krec=krec,
+            value_names=value_names, literals=literals,
+            device_filters=device_filters,
+            gos=gos, allow=allow, allow_scan=allow_scan, row_idx=row_idx,
+            lo=lo, hi=hi, t0=t0, width=width, n_buckets=n_buckets,
+            empty_range=empty_range,
+            lo_rel=lo_rel, hi_rel=hi_rel, t0_rel=t0_rel, width_i=width_i,
+            kernel_key=kernel_key,
+            tag_keys=tag_keys, key_values=key_values, agg_cols=agg_cols,
+            num_groups=num_groups, delta=delta,
+        )
+
+    def dispatch_cached_agg(self, prep: "CachedAggPrep") -> ResultSet:
+        """The "spec -> dispatch" half for ONE prepared query: device
+        call (mesh shard_map or the RTT-minimized packed path), delta
+        fold, result assembly — exactly the pre-split cached path."""
+        import jax.numpy as jnp
+
+        from ..ops.scan_agg import coerce_literals, encode_filter_ops, state_to_host
+
+        plan, m, entry, spec = prep.plan, prep.m, prep.entry, prep.spec
+        value_names, literals = prep.value_names, prep.literals
+        lo_rel, hi_rel = prep.lo_rel, prep.hi_rel
+        t0_rel, width_i = prep.t0_rel, prep.width_i
+        gos, allow_scan = prep.gos, prep.allow_scan
+        row_idx, kernel_key = prep.row_idx, prep.kernel_key
+        values_dev = entry.values_for(value_names)
         import time as _time
 
         t_kernel = _time.perf_counter()
@@ -1198,13 +1308,6 @@ class Executor:
                 unpack_packed_state,
             )
 
-            row_idx = (
-                self._selective_row_idx(entry, scan_allowed, lo, hi)
-                if not empty_range
-                else None
-            )
-            if row_idx is not None:
-                m["cache_rows"] = int((row_idx != entry.n_valid).sum())
             session_dev = entry.session_for(gos, allow_scan)
             dyn = pack_dyn(literals, lo_rel, hi_rel, t0_rel, width_i, row_idx)
             packed = cached_scan_agg_packed(
@@ -1228,18 +1331,214 @@ class Executor:
                 _time.perf_counter() - t_kernel,
             )
         self._finish_kernel(
-            krec, spec, m, state, _time.perf_counter() - t_kernel
+            prep.krec, spec, m, state, _time.perf_counter() - t_kernel
         )
-        if len(delta) and not empty_range:
+        if len(prep.delta) and not prep.empty_range:
             self._fold_delta(
-                state, delta, entry, plan.schema, gos, allow,
-                agg_cols, value_names, device_filters,
-                lo, hi, t0, width, n_buckets,
+                state, prep.delta, entry, plan.schema, gos, prep.allow,
+                prep.agg_cols, value_names, prep.device_filters,
+                prep.lo, prep.hi, prep.t0, prep.width, prep.n_buckets,
             )
         return self._assemble_agg_result(
-            plan, tag_keys, key_values, agg_cols, state,
-            max(num_groups, 1), n_buckets, t0, width,
+            plan, prep.tag_keys, prep.key_values, prep.agg_cols, state,
+            max(prep.num_groups, 1), prep.n_buckets, prep.t0, prep.width,
         )
+
+    def dispatch_cached_agg_cohort(
+        self, preps: list["CachedAggPrep"]
+    ) -> list:
+        """ONE fused device dispatch serving every prep in ``preps``
+        (all sharing one cache entry and one static spec — the caller
+        groups by ``CachedAggPrep.fuse_key``). The per-query session and
+        dyn buffers stack into a ``[B, ...]`` batch axis and the vmapped
+        packed kernel serves the whole cohort in a single execute; each
+        member's state then demuxes, folds its own delta, and assembles
+        its own ResultSet. Returns one ResultSet-or-exception per prep,
+        positionally (error isolation: a member whose demux/assembly
+        fails poisons only its own slot)."""
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.encoding import next_pow2
+        from ..ops.scan_agg import (
+            cached_scan_agg_cohort,
+            encode_filter_ops,
+            pack_dyn,
+            pack_session,
+            unpack_packed_state,
+        )
+
+        p0 = preps[0]
+        entry, spec = p0.entry, p0.spec
+        sessions = np.stack(
+            [pack_session(p.gos, p.allow_scan) for p in preps]
+        )
+        dyns = np.stack(
+            [
+                pack_dyn(p.literals, p.lo_rel, p.hi_rel, p.t0_rel, p.width_i)
+                for p in preps
+            ]
+        )
+        B = len(preps)
+        # pow2-bucketed batch axis bounds the jit-key count; pad members
+        # replicate the last row and their outputs are discarded
+        Bp = next_pow2(B, floor=2)
+        if Bp > B:
+            sessions = np.concatenate(
+                [sessions, np.repeat(sessions[-1:], Bp - B, axis=0)]
+            )
+            dyns = np.concatenate([dyns, np.repeat(dyns[-1:], Bp - B, axis=0)])
+        values_dev = entry.values_for(p0.value_names)
+        t_kernel = _time.perf_counter()
+        packed = cached_scan_agg_cohort(
+            entry.series_codes_dev,
+            entry.ts_rel_dev,
+            values_dev,
+            jnp.asarray(sessions),
+            jnp.asarray(dyns),
+            n_groups=spec.n_groups,
+            n_buckets=spec.n_buckets,
+            n_agg_fields=spec.n_agg_fields,
+            numeric_filters=encode_filter_ops(spec.numeric_filters),
+            need_minmax=spec.need_minmax,
+            segment_impl=spec.segment_impl,
+            hash_slots=spec.hash_slots,
+        )
+        rows = np.asarray(jax.device_get(packed))
+        elapsed = _time.perf_counter() - t_kernel
+        querystats.note_kernel_dispatch(
+            ("cached-cohort", Bp, *p0.kernel_key), elapsed
+        )
+        outs: list = []
+        for j, p in enumerate(preps):
+            try:
+                state = unpack_packed_state(rows[j], spec)
+                # router/cardinality feedback once per DISPATCH (j == 0),
+                # with the elapsed AMORTIZED over the cohort — the
+                # router's per-shape EWMA mixes these with solo-dispatch
+                # samples, and a raw B-wide wall time would make the
+                # serving impl look up to Bx slower than it is per query
+                self._finish_kernel(
+                    p.krec if j == 0 else None, spec, p.m, state,
+                    elapsed / B,
+                )
+                p.m["batch_cohort"] = B
+                if len(p.delta) and not p.empty_range:
+                    self._fold_delta(
+                        state, p.delta, entry, p.plan.schema, p.gos, p.allow,
+                        p.agg_cols, p.value_names, p.device_filters,
+                        p.lo, p.hi, p.t0, p.width, p.n_buckets,
+                    )
+                outs.append(
+                    self._assemble_agg_result(
+                        p.plan, p.tag_keys, p.key_values, p.agg_cols, state,
+                        max(p.num_groups, 1), p.n_buckets, p.t0, p.width,
+                    )
+                )
+            except BaseException as e:
+                outs.append(e)
+        return outs
+
+    def execute_cohort(self, plans: list, table) -> list:
+        """Execute a cohort of shape-identical plans against one table,
+        fusing as many as possible into single batched device dispatches
+        (wlm/batch hands cohorts here via the interpreter). Returns one
+        ResultSet-or-exception per plan, positionally — error isolation
+        is per member. Members the cached path cannot serve (cache
+        bail-out, memory-bounded scans, selective/mesh entries) fall
+        back to the ordinary solo ``execute`` path."""
+        import os
+        import time as _time
+
+        outcomes: list = [None] * len(plans)
+        preps: list[tuple[int, CachedAggPrep, float]] = []
+        cache_on = os.environ.get("HORAEDB_SCAN_CACHE", "1") != "0"
+        fusable_table = not hasattr(table, "sub_tables")
+        for i, plan in enumerate(plans):
+            t_start = _time.perf_counter()
+            prep = None
+            tried_cached = False
+            if plan.is_aggregate and cache_on and fusable_table and table.physical_datas():
+                # mirror execute()'s memory bound: the cache build would
+                # materialize the whole table, so over-cap scans must
+                # take the partial machinery instead
+                from .partial import _agg_memory_cap_bytes, _scan_estimate_bytes
+
+                cap = _agg_memory_cap_bytes()
+                bounded = bool(cap) and _scan_estimate_bytes(
+                    table, plan.predicate, self._projection(plan)
+                ) > cap
+                if not bounded:
+                    m = {"table": plan.table}
+                    tried_cached = True
+                    try:
+                        prep = self.prepare_cached_agg(
+                            plan, table, m, allow_selective=False
+                        )
+                    except BaseException as e:
+                        outcomes[i] = e
+                        continue
+            if prep is None:
+                try:
+                    outcomes[i] = self.execute(
+                        plan, table, _skip_cached_agg=tried_cached
+                    )
+                except BaseException as e:
+                    outcomes[i] = e
+            else:
+                preps.append((i, prep, t_start))
+        groups: dict = {}
+        for i, prep, t_start in preps:
+            groups.setdefault(prep.fuse_key(i), []).append((i, prep, t_start))
+        for grp in groups.values():
+            if len(grp) == 1:
+                i, prep, t_start = grp[0]
+                try:
+                    if prep.row_idx is None and prep.entry.mesh is None \
+                            and not prep.empty_range:
+                        # a lone member pays no merge constraint:
+                        # restore the solo path's selective row-gather
+                        # that prepare skipped for cohort mergeability
+                        # (allow_scan minus the pad slot IS the pruned
+                        # series allow-list prepare derived it from)
+                        prep.row_idx = self._selective_row_idx(
+                            prep.entry, prep.allow_scan[:-1],
+                            prep.lo, prep.hi,
+                        )
+                        if prep.row_idx is not None:
+                            prep.m["cache_rows"] = int(
+                                (prep.row_idx != prep.entry.n_valid).sum()
+                            )
+                    out = self.dispatch_cached_agg(prep)
+                    outcomes[i] = self._finish_metrics(
+                        prep.m, t_start, "device-cached", out
+                    )
+                except BaseException as e:
+                    outcomes[i] = e
+                continue
+            try:
+                results = self.dispatch_cached_agg_cohort(
+                    [p for _, p, _ in grp]
+                )
+            except BaseException:
+                # wholesale fused failure: per-member solo fallback, so
+                # one bad cohort cannot take its members down with it
+                for i, prep, t_start in grp:
+                    try:
+                        outcomes[i] = self.execute(plans[i], table)
+                    except BaseException as e:
+                        outcomes[i] = e
+                continue
+            for (i, prep, t_start), r in zip(grp, results):
+                if isinstance(r, BaseException):
+                    outcomes[i] = r
+                else:
+                    outcomes[i] = self._finish_metrics(
+                        prep.m, t_start, "device-cached", r
+                    )
+        return outcomes
 
     def _selective_row_idx(
         self, entry, allowed: np.ndarray, lo: int, hi: int

@@ -402,6 +402,44 @@ class InterpreterFactory:
             raise InterpreterError(f"table not found: {plan.table}")
         return self._execute_query(plan, table)
 
+    def execute_cohort(self, plans: list) -> list:
+        """Execute a cohort of shape-identical SELECT plans, fusing as
+        many as possible into single batched device dispatches
+        (wlm/batch hands cohorts here through the proxy). Returns one
+        Output-or-exception per plan, positionally — a member whose
+        execution fails poisons only its own slot. Members needing
+        machinery the fused path cannot serve (subqueries, joins,
+        rollup rewrites, unknown tables) execute solo in place."""
+        outcomes: list = [None] * len(plans)
+        by_table: dict[str, list] = {}
+        for i, plan in enumerate(plans):
+            try:
+                rewritten = self._materialize_subqueries(plan)
+                p = rewritten if rewritten is not None else plan
+                if p.select.join is not None:
+                    outcomes[i] = self._select(p)
+                    continue
+                from ..rules.rewrite import try_rollup_serve
+
+                out = try_rollup_serve(self, p)
+                if out is not None:
+                    outcomes[i] = out
+                    continue
+                by_table.setdefault(p.table, []).append((i, p))
+            except BaseException as e:
+                outcomes[i] = e
+        for table_name, grp in by_table.items():
+            table = self.catalog.open(table_name)
+            if table is None:
+                err = InterpreterError(f"table not found: {table_name}")
+                for i, _ in grp:
+                    outcomes[i] = err
+                continue
+            results = self.executor.execute_cohort([p for _, p in grp], table)
+            for (i, _), r in zip(grp, results):
+                outcomes[i] = r
+        return outcomes
+
     def _execute_query(self, plan: QueryPlan, table) -> ResultSet:
         """One door to query execution (SELECT and EXPLAIN ANALYZE both
         pass through): a step-compatible dashboard aggregate over a

@@ -663,11 +663,16 @@ def create_app(
     conn: Connection, router=None, cluster=None, auth_token: str = "",
     limits=None, observability=None, node: str = "standalone",
     rules_cfg=None, slo_cfg=None, read_staleness_s: float = 0.0,
+    batch_cfg=None,
 ) -> web.Application:
     """``cluster``: a ClusterImpl when this node runs under a coordinator;
     adds the /meta_event endpoints, meta-driven DDL, and write fencing.
     ``limits``: a config LimitsConfig for the workload manager's knobs
     (admission slots/queue/deadline/memory budget, dedup).
+    ``batch_cfg``: a config [wlm.batch] BatchSection — when enabled, the
+    proxy gathers shape-identical in-flight SELECTs for a micro-batching
+    window and serves each cohort with one fused device dispatch
+    (wlm/batch); None/disabled reproduces the plain single-flight path.
     ``observability``: a config ObservabilitySection; when its
     ``self_scrape`` is on, the node runs the self-monitoring recorder
     (engine/metrics_recorder) that periodically writes its own metrics
@@ -685,7 +690,7 @@ def create_app(
     meta-serialized DDL instead of the local catalog."""
     import time as _time
 
-    proxy = Proxy(conn, limits=limits)
+    proxy = Proxy(conn, limits=limits, batch_cfg=batch_cfg)
     app = web.Application(middlewares=[_auth_middleware])
     app["auth_token"] = auth_token
     app["conn"] = conn
@@ -2468,6 +2473,7 @@ def run_server(
         read_staleness_s=(
             config.cluster.read_staleness_s if config is not None else 0.0
         ),
+        batch_cfg=(config.wlm.batch if config is not None else None),
     )
     app["proxy"].slow_threshold_s = slow_threshold
 

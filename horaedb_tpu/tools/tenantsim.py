@@ -139,6 +139,15 @@ class SimConfig:
     # workload shape
     quota_tenants: int = 2  # tenants given a deliberately tiny read quota
     settle_timeout_s: float = 25.0
+    # cohort batching ([wlm.batch] on every node): the dashboard flood —
+    # hundreds of tenants asking the same SELECT shape with different
+    # literals — gathers in micro-batching windows and serves as fused
+    # cohorts, so the standing multi-tenant gate exercises cohort
+    # serving under faults. Default ON; --no-batch reproduces the
+    # per-query dispatch path.
+    batch: bool = True
+    batch_window_s: float = 0.002
+    batch_max_cohort: int = 32
 
 
 @dataclass
@@ -537,6 +546,8 @@ class SimCluster:
                 self_scrape_interval_s=cfg.scrape_interval_s,
                 event_ring=cfg.event_ring,
             )
+        from ..utils.config import BatchSection
+
         app = create_app(
             conn,
             router=router,
@@ -546,6 +557,11 @@ class SimCluster:
             node=endpoint,
             rules_cfg=rules_cfg,
             slo_cfg=slo_cfg,
+            batch_cfg=BatchSection(
+                enabled=cfg.batch,
+                window_s=cfg.batch_window_s,
+                max_cohort=cfg.batch_max_cohort,
+            ),
         )
         return SimNode(
             endpoint, conn, cluster, router, app, fault_store,
@@ -1420,6 +1436,11 @@ def main(argv=None) -> int:
              "the hot-tenant skew phase (gates: scale-out under skew, "
              "route=follower serving, pre-warmed move, scale-in after)",
     )
+    p.add_argument(
+        "--no-batch", action="store_true",
+        help="disable [wlm.batch] cohort batching on the nodes (the "
+             "dashboard flood then pays one device dispatch per query)",
+    )
     p.add_argument("--json", action="store_true", help="emit the report as JSON")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -1434,6 +1455,7 @@ def main(argv=None) -> int:
         read_replicas=0 if args.elastic else args.read_replicas,
         elastic=args.elastic,
         hot_phase=(0.1, 0.45) if args.elastic else None,
+        batch=not args.no_batch,
         kill_at=None if args.no_kill else SimConfig.kill_at,
         lease_flap_at=0.72 if args.nodes >= 3 else None,
         shard_move_at=0.8 if args.nodes >= 3 else None,

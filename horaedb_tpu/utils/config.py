@@ -29,6 +29,14 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     admission_memory_budget = "1gb"  # working-set budget for admits
     dedup = true                      # single-flight identical reads
 
+    [wlm.batch]
+    enabled = false                   # cohort batching (wlm/batch)
+    window = "2ms"                    # micro-batching gather window
+    max_cohort = 32                   # fused dispatch width ceiling
+    shapes = []                       # substrings of normalized SQL
+                                      # shapes eligible ([] = any
+                                      # batchable aggregate SELECT)
+
     [observability]
     self_scrape = true                # node scrapes its own registry
     self_scrape_interval = "10s"      # into system_metrics.samples
@@ -186,6 +194,31 @@ class LimitsConfig:
 
 
 @dataclass
+class BatchSection:
+    """Cohort batching ([wlm.batch] — wlm/batch.CohortBatcher): in-flight
+    SELECTs sharing one normalized plan shape but differing literals
+    gather for a micro-batching window, then the whole cohort is served
+    by ONE fused device dispatch (vmap over the query axis of the packed
+    scan-agg kernel). Disabled by default: with ``enabled = false`` the
+    proxy read path is bit-for-bit the pre-batching single-flight path."""
+
+    enabled: bool = False
+    window_s: float = 0.002  # gather window before the fused dispatch
+    max_cohort: int = 32  # cohort width ceiling (vmap batch axis bound)
+    # substrings matched against the normalized (literal-stripped) SQL
+    # shape; non-empty restricts batching to the listed shapes
+    shapes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class WlmSection:
+    """Workload-manager extensions beyond [limits] (which predates this
+    section and keeps the admission/dedup knobs for compatibility)."""
+
+    batch: BatchSection = field(default_factory=BatchSection)
+
+
+@dataclass
 class ObservabilitySection:
     """Self-monitoring (engine/metrics_recorder): the node periodically
     snapshots its own metrics registry into the real time-series table
@@ -327,6 +360,7 @@ class Config:
     server: ServerConfig = field(default_factory=ServerConfig)
     engine: EngineSection = field(default_factory=EngineSection)
     limits: LimitsConfig = field(default_factory=LimitsConfig)
+    wlm: WlmSection = field(default_factory=WlmSection)
     observability: ObservabilitySection = field(
         default_factory=ObservabilitySection
     )
@@ -366,6 +400,7 @@ _KNOWN = {
         "slow_threshold", "admission_slots", "admission_queue_depth",
         "admission_deadline", "admission_memory_budget", "dedup",
     },
+    "wlm": {"batch"},
     "observability": {
         "self_scrape", "self_scrape_interval", "self_metrics_retention",
         "event_ring",
@@ -470,6 +505,9 @@ def _apply(cfg: Config, raw: dict) -> None:
         if not isinstance(l["dedup"], bool):
             raise ConfigError("limits.dedup must be a boolean")
         cfg.limits.dedup = l["dedup"]
+    w = raw.get("wlm", {})
+    if "batch" in w:
+        _apply_batch(cfg.wlm.batch, w["batch"])
     o = raw.get("observability", {})
     if "self_scrape" in o:
         if not isinstance(o["self_scrape"], bool):
@@ -675,6 +713,36 @@ def _apply_elastic(es: ElasticSection, raw: Any) -> None:
         raise ConfigError("cluster.elastic.action_budget must be >= 1")
     if es.quarantine_after < 1:
         raise ConfigError("cluster.elastic.quarantine_after must be >= 1")
+
+
+_BATCH_KEYS = {"enabled", "window", "max_cohort", "shapes"}
+
+
+def _apply_batch(bs: BatchSection, raw: Any) -> None:
+    """[wlm.batch] — validated at load like every other section."""
+    if not isinstance(raw, dict):
+        raise ConfigError("wlm.batch must be a table")
+    unknown = set(raw) - _BATCH_KEYS
+    if unknown:
+        raise ConfigError(f"unknown key(s) in [wlm.batch]: {sorted(unknown)}")
+    if "enabled" in raw:
+        if not isinstance(raw["enabled"], bool):
+            raise ConfigError("wlm.batch.enabled must be a boolean")
+        bs.enabled = raw["enabled"]
+    if "window" in raw:
+        bs.window_s = parse_duration_ms(raw["window"]) / 1000.0
+        if bs.window_s <= 0:
+            raise ConfigError("wlm.batch.window must be positive")
+    if "max_cohort" in raw:
+        bs.max_cohort = int(raw["max_cohort"])
+        if bs.max_cohort < 2:
+            # a 1-wide "cohort" is just the solo path plus a window wait
+            raise ConfigError("wlm.batch.max_cohort must be >= 2")
+    if "shapes" in raw:
+        v = raw["shapes"]
+        if not isinstance(v, list) or not all(isinstance(x, str) for x in v):
+            raise ConfigError("wlm.batch.shapes must be a list of strings")
+        bs.shapes = list(v)
 
 
 def _apply_env(cfg: Config) -> None:

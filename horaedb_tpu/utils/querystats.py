@@ -62,6 +62,12 @@ NUMERIC_FIELDS: dict[str, str] = {
     # which side of the coalescing they were on
     "dedup_followers": "identical in-flight twins this leader execution served",
     "dedup_follower": "1 when this query awaited an identical in-flight leader",
+    # cohort batching (wlm/batch): shape-identical in-flight queries
+    # served by one fused device dispatch record which side of the
+    # cohort they were on, and how wide it was
+    "batch_leader": "cohort size when this query led a fused cohort dispatch",
+    "batch_member": "1 when this query was served by a cohort leader's fused dispatch",
+    "batch_cohort": "fused cohort size for batch-served queries (leader and members)",
     # kernel-routing feedback: how many (group x bucket) cells the device
     # aggregation actually produced — the cardinality truth the kernel
     # router seeds from on the next sighting of the shape

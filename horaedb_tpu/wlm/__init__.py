@@ -33,6 +33,12 @@ from .admission import (  # noqa: F401  (re-exports: the subsystem surface)
     lane_for,
     normalize_shape,
 )
+from .batch import (  # noqa: F401  (re-exports: the subsystem surface)
+    BATCH_METRIC_FAMILIES,
+    COHORT_SIZE_BUCKETS,
+    CohortBatcher,
+    batch_plan_key,
+)
 from .dedup import ReadDeduper
 from .quota import BlockedError, QuotaExceededError, QuotaManager  # noqa: F401
 
@@ -107,6 +113,7 @@ class WorkloadManager:
         deadline_s: float = 5.0,
         dedup_enabled: bool = True,
         persist_path: Optional[str] = None,
+        batch_cfg=None,
     ) -> None:
         self.admission = AdmissionController(
             total_units=total_units,
@@ -116,12 +123,18 @@ class WorkloadManager:
         )
         self.dedup = ReadDeduper(enabled=dedup_enabled)
         self.quota = QuotaManager(persist_path=persist_path)
+        # cohort batching (wlm/batch): disabled unless [wlm.batch] says
+        # otherwise — with it off the read path is exactly the old one
+        self.batch = CohortBatcher.from_config(batch_cfg, deduper=self.dedup)
         _MANAGERS.add(self)
 
     @staticmethod
-    def from_limits(limits, persist_path: Optional[str] = None) -> "WorkloadManager":
+    def from_limits(
+        limits, persist_path: Optional[str] = None, batch_cfg=None
+    ) -> "WorkloadManager":
         """Build from a config ``[limits]`` section (utils/config
-        LimitsConfig) — or defaults when ``limits`` is None."""
+        LimitsConfig) — or defaults when ``limits`` is None — plus the
+        optional ``[wlm.batch]`` section for cohort batching."""
         g = lambda k, d: getattr(limits, k, d) if limits is not None else d  # noqa: E731
         return WorkloadManager(
             total_units=g("admission_slots", 8),
@@ -130,6 +143,7 @@ class WorkloadManager:
             deadline_s=g("admission_deadline_s", 5.0),
             dedup_enabled=g("dedup", True),
             persist_path=persist_path,
+            batch_cfg=batch_cfg,
         )
 
     def close(self) -> None:
@@ -141,4 +155,5 @@ class WorkloadManager:
             "admission": self.admission.snapshot(),
             "dedup": self.dedup.snapshot(),
             "quota": self.quota.snapshot(),
+            "batch": self.batch.snapshot(),
         }

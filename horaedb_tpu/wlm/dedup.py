@@ -62,6 +62,16 @@ class ReadDeduper:
         with self._lock:
             self._epoch += 1
 
+    def epoch(self) -> int:
+        """The current write epoch. Shared fencing truth for every
+        coalescing layer: the cohort batcher (wlm/batch) keys forming
+        cohorts by this value, so a write landing while a cohort gathers
+        fences later-arriving members into a fresh cohort — the same
+        read-your-writes contract the flight table gets from carrying
+        the epoch in its key."""
+        with self._lock:
+            return self._epoch
+
     def run(self, sql_key: str, fn: Callable[[], T]) -> T:
         """Execute ``fn`` single-flight per (epoch, sql_key). The leader
         runs it; concurrent twins block on the leader's result (or

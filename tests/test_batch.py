@@ -1,0 +1,365 @@
+"""Cohort batching (horaedb_tpu/wlm/batch + the executor's prepare/
+dispatch split): shape-identical in-flight SELECTs with differing
+literals gather in a micro-batching window and serve from ONE fused
+device dispatch, with per-query demux, per-member error isolation,
+epoch-fenced read-your-writes, and dedup of identical twins inside the
+cohort."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.proxy import Proxy
+from horaedb_tpu.utils.config import BatchSection
+from horaedb_tpu.utils.metrics import REGISTRY
+from horaedb_tpu.utils.querystats import STATS_STORE
+from horaedb_tpu.wlm.quota import QuotaExceededError
+
+
+def _counter(name: str, **labels) -> float:
+    return REGISTRY.counter(name, "", labels=labels or None).value
+
+
+def _dash_db(hosts: int = 6, rows: int = 40):
+    db = horaedb_tpu.connect(None)
+    db.execute(
+        "CREATE TABLE dash (host string TAG, v double, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+    )
+    values = []
+    for h in range(hosts):
+        for i in range(rows):
+            values.append(f"('h{h}', {h + i * 0.25}, {1000 + i * 10})")
+    db.execute("INSERT INTO dash (host, v, ts) VALUES " + ",".join(values))
+    db.flush_all()
+    return db
+
+
+def _batch_proxy(db, window_s=0.25, max_cohort=8, **kw) -> Proxy:
+    return Proxy(
+        db,
+        batch_cfg=BatchSection(
+            enabled=True, window_s=window_s, max_cohort=max_cohort, **kw
+        ),
+    )
+
+
+def _run_concurrent(proxy, sqls, tenants=None):
+    """Fire the statements concurrently; returns {sql: result-or-error}."""
+    out: dict = {}
+
+    def worker(sql, tenant):
+        try:
+            out[sql] = proxy.handle_sql(sql, tenant=tenant)
+        except BaseException as e:  # noqa: BLE001 — outcomes under test
+            out[sql] = e
+
+    threads = [
+        threading.Thread(
+            target=worker,
+            args=(s, tenants[i] if tenants else "default"),
+        )
+        for i, s in enumerate(sqls)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return out
+
+
+def _rows(result) -> list:
+    return sorted(tuple(r.values()) for r in result.to_pylist())
+
+
+class TestCohortFusion:
+    def test_flood_smoke_fused_and_correct(self):
+        """Tier-1 flood smoke: a burst of param-varied dashboard queries
+        through the batcher serves from ONE fused dispatch and every
+        member's answer matches its solo execution."""
+        db = _dash_db()
+        proxy = _batch_proxy(db, max_cohort=8)
+        try:
+            sqls = [
+                f"SELECT host, count(v), sum(v) FROM dash "
+                f"WHERE ts >= {1000 + i * 10} AND ts < 1400 GROUP BY host"
+                for i in range(8)
+            ]
+            expected = {s: _rows(proxy.handle_sql(s)) for s in sqls}
+            fused0 = _counter("horaedb_batch_dispatch_total", kind="fused")
+            out = _run_concurrent(proxy, sqls)
+            for s in sqls:
+                assert not isinstance(out[s], BaseException), out[s]
+                assert _rows(out[s]) == expected[s]
+            assert (
+                _counter("horaedb_batch_dispatch_total", kind="fused")
+                >= fused0 + 1
+            )
+            # ledger roles: one leader row carrying the cohort size,
+            # members carrying batch_member, all carrying batch_cohort
+            recent = [
+                r for r in STATS_STORE.list() if r.get("batch_cohort")
+            ]
+            assert any(r["batch_leader"] >= 2 for r in recent)
+            assert any(r["batch_member"] == 1 for r in recent)
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_mixed_limits_demux_per_member(self):
+        """Mixed LIMITs share one shape (LIMIT is masked in the cohort
+        key) and one fused dispatch; each member's LIMIT applies to ITS
+        demuxed result."""
+        db = _dash_db(hosts=6)
+        proxy = _batch_proxy(db, max_cohort=4)
+        try:
+            sqls = [
+                f"SELECT host, sum(v) FROM dash GROUP BY host "
+                f"ORDER BY host LIMIT {k}"
+                for k in (1, 2, 3, 4)
+            ]
+            for s in sqls:  # warm cache + solo answers
+                proxy.handle_sql(s)
+            fused0 = _counter("horaedb_batch_dispatch_total", kind="fused")
+            out = _run_concurrent(proxy, sqls)
+            for k, s in zip((1, 2, 3, 4), sqls):
+                assert not isinstance(out[s], BaseException), out[s]
+                assert out[s].num_rows == k
+                assert list(out[s].column("host")) == [
+                    f"h{i}" for i in range(k)
+                ]
+            assert (
+                _counter("horaedb_batch_dispatch_total", kind="fused")
+                == fused0 + 1
+            )
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_cohort_of_one_degenerates_to_solo_path(self):
+        """A window that gathers a single query runs today's dedup+
+        admission path: solo dispatch accounting, no fused dispatch, no
+        batch ledger roles."""
+        db = _dash_db()
+        proxy = _batch_proxy(db, window_s=0.01)
+        try:
+            sql = "SELECT host, count(v) FROM dash GROUP BY host"
+            fused0 = _counter("horaedb_batch_dispatch_total", kind="fused")
+            solo0 = _counter("horaedb_batch_dispatch_total", kind="solo")
+            out = proxy.handle_sql(sql)
+            assert out.num_rows == 6
+            assert _counter("horaedb_batch_dispatch_total", kind="fused") == fused0
+            assert _counter("horaedb_batch_dispatch_total", kind="solo") == solo0 + 1
+            row = STATS_STORE.list()[-1]
+            assert row["batch_cohort"] == 0 and row["batch_member"] == 0
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_identical_twins_coalesce_inside_cohort(self):
+        """Members with the SAME sql share one cohort slot (the dedup
+        contract survives inside the batch layer)."""
+        db = _dash_db()
+        proxy = _batch_proxy(db, max_cohort=3)
+        try:
+            twin = "SELECT host, sum(v) FROM dash GROUP BY host"
+            other = (
+                "SELECT host, sum(v) FROM dash WHERE ts >= 1100 GROUP BY host"
+            )
+            expected_twin = _rows(proxy.handle_sql(twin))
+            dedup0 = _counter(
+                "horaedb_admission_dedup_total", role="follower"
+            )
+            out: dict = {}
+
+            def worker(tag, sql):
+                out[tag] = proxy.handle_sql(sql)
+
+            threads = [
+                threading.Thread(target=worker, args=("a", twin)),
+                threading.Thread(target=worker, args=("b", twin)),
+                threading.Thread(target=worker, args=("c", other)),
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert _rows(out["a"]) == expected_twin
+            assert _rows(out["b"]) == expected_twin
+            assert (
+                _counter("horaedb_admission_dedup_total", role="follower")
+                >= dedup0 + 1
+            )
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_disabled_batcher_is_inert(self):
+        """[wlm.batch] enabled=false (the default) reproduces today's
+        behavior: no batch metrics move, no batch ledger roles."""
+        db = _dash_db()
+        proxy = Proxy(db)  # no batch_cfg: disabled
+        try:
+            fused0 = _counter("horaedb_batch_dispatch_total", kind="fused")
+            solo0 = _counter("horaedb_batch_dispatch_total", kind="solo")
+            sqls = [
+                f"SELECT host, count(v) FROM dash WHERE ts >= {1000 + i * 10} "
+                "GROUP BY host"
+                for i in range(4)
+            ]
+            out = _run_concurrent(proxy, sqls)
+            assert all(not isinstance(v, BaseException) for v in out.values())
+            assert _counter("horaedb_batch_dispatch_total", kind="fused") == fused0
+            assert _counter("horaedb_batch_dispatch_total", kind="solo") == solo0
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_shapes_filter_restricts_eligibility(self):
+        db = _dash_db()
+        proxy = _batch_proxy(db, shapes=["from other_table"])
+        try:
+            assert not proxy.wlm.batch.eligible(
+                db._cached_plan("SELECT host, sum(v) FROM dash GROUP BY host"),
+                "select host, sum(v) from dash group by host",
+            )
+        finally:
+            proxy.close()
+            db.close()
+
+
+class TestCorrectnessRails:
+    def test_write_mid_window_fences_fresh_cohort(self):
+        """Regression (read-your-writes across the window): a write
+        landing while a cohort is forming must fence later-arriving
+        members into a FRESH cohort — two fused size-2 cohorts, never
+        one of size 4 — and the post-write members must see the row."""
+        db = _dash_db()
+        proxy = _batch_proxy(db, window_s=0.6, max_cohort=2)
+        try:
+            pre = [
+                "SELECT host, count(v) FROM dash WHERE ts < 9000 GROUP BY host",
+                "SELECT host, count(v) FROM dash WHERE ts < 9100 GROUP BY host",
+            ]
+            post = [
+                "SELECT host, count(v) FROM dash WHERE ts < 9200 GROUP BY host",
+                "SELECT host, count(v) FROM dash WHERE ts < 9300 GROUP BY host",
+            ]
+            size2_0 = _counter("horaedb_batch_cohort_total", size="2")
+            size4_0 = _counter("horaedb_batch_cohort_total", size="4")
+            out: dict = {}
+
+            def worker(sql):
+                out[sql] = proxy.handle_sql(sql)
+
+            pre_threads = [
+                threading.Thread(target=worker, args=(s,)) for s in pre
+            ]
+            pre_threads[0].start()
+            time.sleep(0.1)  # the leader is mid-window
+            proxy.handle_sql(
+                "INSERT INTO dash (host, v, ts) VALUES ('hNEW', 1.0, 5000)"
+            )  # bumps the dedup epoch -> fences the forming key
+            post_threads = [
+                threading.Thread(target=worker, args=(s,)) for s in post
+            ]
+            pre_threads[1].start()  # joins whichever epoch is current
+            for t in post_threads:
+                t.start()
+            for t in pre_threads + post_threads:
+                t.join()
+            for s in post:
+                hosts = list(out[s].column("host"))
+                assert "hNEW" in hosts, "post-write member missed the write"
+            # fencing: the post-write members never shared the pre-write
+            # cohort — cohorts stayed at size <= 2, never merged into 4
+            assert _counter("horaedb_batch_cohort_total", size="4") == size4_0
+            assert _counter("horaedb_batch_cohort_total", size="2") >= size2_0 + 1
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_quota_exceeded_member_does_not_poison_cohort(self):
+        """A member shed by its tenant quota mid-window fails alone; the
+        rest of the cohort serves normally."""
+        db = _dash_db()
+        proxy = _batch_proxy(db, max_cohort=3)
+        try:
+            proxy.wlm.quota.set_quota("tenant", "starved", "read_qps", 0.001, burst=0)
+            sqls = [
+                f"SELECT host, sum(v) FROM dash WHERE ts >= {1000 + i * 10} "
+                "GROUP BY host"
+                for i in range(3)
+            ]
+            out = _run_concurrent(
+                proxy, sqls, tenants=["default", "default", "starved"]
+            )
+            assert isinstance(out[sqls[2]], QuotaExceededError)
+            for s in sqls[:2]:
+                assert not isinstance(out[s], BaseException), out[s]
+                assert out[s].num_rows == 6
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_error_isolation_one_bad_member(self, monkeypatch):
+        """A member whose demux/assembly fails inside the fused dispatch
+        poisons only its own slot."""
+        from horaedb_tpu.query.executor import Executor
+
+        db = _dash_db()
+        proxy = _batch_proxy(db, max_cohort=3)
+        try:
+            orig = Executor._assemble_agg_result
+
+            def poisoned(self, plan, *args, **kw):
+                if plan.select.limit == 13:
+                    raise RuntimeError("injected member failure")
+                return orig(self, plan, *args, **kw)
+
+            monkeypatch.setattr(Executor, "_assemble_agg_result", poisoned)
+            base = "SELECT host, sum(v) FROM dash GROUP BY host ORDER BY host"
+            sqls = [f"{base} LIMIT {k}" for k in (2, 13, 4)]
+            out = _run_concurrent(proxy, sqls)
+            bad = out[sqls[1]]
+            assert isinstance(bad, RuntimeError)
+            assert "injected member failure" in str(bad)
+            assert out[sqls[0]].num_rows == 2
+            assert out[sqls[2]].num_rows == 4
+        finally:
+            proxy.close()
+            db.close()
+
+    def test_batch_config_section_parses(self, tmp_path):
+        from horaedb_tpu.utils.config import Config, ConfigError
+
+        p = tmp_path / "c.toml"
+        p.write_text(
+            "[wlm.batch]\nenabled = true\nwindow = \"5ms\"\n"
+            "max_cohort = 16\nshapes = [\"from dash\"]\n"
+        )
+        cfg = Config.load(str(p))
+        assert cfg.wlm.batch.enabled is True
+        assert cfg.wlm.batch.window_s == pytest.approx(0.005)
+        assert cfg.wlm.batch.max_cohort == 16
+        assert cfg.wlm.batch.shapes == ["from dash"]
+        bad = tmp_path / "bad.toml"
+        bad.write_text("[wlm.batch]\nmax_cohort = 1\n")
+        with pytest.raises(ConfigError):
+            Config.load(str(bad))
+
+    def test_workload_snapshot_carries_batch_state(self):
+        db = horaedb_tpu.connect(None)
+        proxy = _batch_proxy(db, window_s=0.002, max_cohort=4)
+        try:
+            snap = proxy.wlm.snapshot()["batch"]
+            assert snap["enabled"] is True
+            assert snap["max_cohort"] == 4
+            assert snap["forming_cohorts"] == 0
+        finally:
+            proxy.close()
+            db.close()

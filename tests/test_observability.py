@@ -1029,6 +1029,54 @@ class TestMetricsNameLint:
                 missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
         assert not missing, missing
 
+    def test_batch_families_declared_and_documented(self):
+        """PR-13 lint extension (same contract as the admission/raw
+        registries): every horaedb_batch_* family declared in
+        wlm.BATCH_METRIC_FAMILIES must be (a) registered live (with
+        every kind/size label eagerly present), (b) convention-clean,
+        (c) documented in docs/WORKLOAD.md and docs/OBSERVABILITY.md —
+        and no stray horaedb_batch_* family may exist outside the
+        declared registry. (The batch_leader/batch_member/batch_cohort
+        ledger fields ride the PR-2 lint automatically: column + family
+        + docs mention.)"""
+        import os
+        import re
+
+        from horaedb_tpu.utils.metrics import REGISTRY
+        from horaedb_tpu.wlm import BATCH_METRIC_FAMILIES, COHORT_SIZE_BUCKETS
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in BATCH_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/OBSERVABILITY.md")
+            if f"`{fam}`" not in wdocs:
+                missing.append(f"{fam}: undocumented in docs/WORKLOAD.md")
+        for kind in ("fused", "solo"):
+            if f'kind="{kind}"' not in exposed:
+                missing.append(f"label kind={kind}: not eagerly registered")
+        for b in COHORT_SIZE_BUCKETS:
+            if f'size="{b}"' not in exposed:
+                missing.append(f"label size={b}: not eagerly registered")
+        for fam in families:
+            if fam.startswith("horaedb_batch_") and \
+                    fam not in BATCH_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        # the [wlm.batch] knobs are operator surface: pinned to WORKLOAD.md
+        for knob in ("enabled", "window", "max_cohort", "shapes"):
+            if knob not in wdocs:
+                missing.append(f"[wlm.batch] {knob}: undocumented")
+        assert not missing, missing
+
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
         and horaedb_wal_* families after a flush+compaction cycle."""

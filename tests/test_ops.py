@@ -307,3 +307,133 @@ class TestMergeDedupReady:
             md._ready.discard((shape_bucket(n), False))
             ready_false = (shape_bucket(n), False) in md._ready
         assert not ready_false
+
+
+class TestCohortKernels:
+    """Multi-query fused serving: the vmapped cohort kernels must be
+    row-for-row identical to dispatching the packed kernels per query."""
+
+    def _resident(self, n_series=5, rows_per=40, n_fields=2, seed=3):
+        rng = np.random.default_rng(seed)
+        codes = np.repeat(np.arange(n_series, dtype=np.int32), rows_per)
+        ts_rel = np.tile(
+            np.arange(rows_per, dtype=np.int32) * 10, n_series
+        )
+        values = rng.random((n_fields, n_series * rows_per)).astype(
+            np.float32
+        ) * 100.0
+        return codes, ts_rel, values
+
+    def test_cached_agg_cohort_matches_per_query_packed(self):
+        import jax
+        import jax.numpy as jnp
+
+        from horaedb_tpu.ops.scan_agg import (
+            ScanAggSpec,
+            cached_scan_agg_cohort,
+            cached_scan_agg_packed,
+            encode_filter_ops,
+            pack_dyn,
+            pack_session,
+            unpack_packed_state,
+        )
+
+        codes, ts_rel, values = self._resident()
+        S = 5
+        gos = np.append(np.arange(S, dtype=np.int32) % 3, 0)
+        spec = ScanAggSpec(
+            n_groups=3, n_buckets=4, n_agg_fields=2,
+            numeric_filters=((0, ">="),), need_minmax=True,
+            segment_impl="scatter",
+        ).padded()
+        nf = encode_filter_ops(spec.numeric_filters)
+        rng = np.random.default_rng(7)
+        members = []
+        for b in range(4):  # varied allow-lists, literals, time bounds
+            allow = np.append(rng.random(S) > 0.3, False)
+            lo, hi = 10 * b, 400 - 20 * b
+            members.append(
+                (
+                    pack_session(gos, allow),
+                    pack_dyn([float(5 * b)], lo, hi, 0, 100),
+                )
+            )
+        sessions = jnp.asarray(np.stack([m[0] for m in members]))
+        dyns = jnp.asarray(np.stack([m[1] for m in members]))
+        statics = dict(
+            n_groups=spec.n_groups, n_buckets=spec.n_buckets,
+            n_agg_fields=spec.n_agg_fields, numeric_filters=nf,
+            need_minmax=True, segment_impl="scatter",
+        )
+        batched = np.asarray(
+            jax.device_get(
+                cached_scan_agg_cohort(
+                    jnp.asarray(codes), jnp.asarray(ts_rel),
+                    jnp.asarray(values), sessions, dyns, **statics
+                )
+            )
+        )
+        for j, (sess, dyn) in enumerate(members):
+            solo = cached_scan_agg_packed(
+                jnp.asarray(codes), jnp.asarray(ts_rel),
+                jnp.asarray(values), jnp.asarray(sess), jnp.asarray(dyn),
+                selective=False, hash_slots=0, **statics
+            )
+            a = unpack_packed_state(batched[j], spec)
+            b = unpack_packed_state(solo, spec)
+            np.testing.assert_array_equal(a.counts, b.counts)
+            np.testing.assert_allclose(a.sums, b.sums, rtol=1e-6)
+            np.testing.assert_allclose(a.mins, b.mins, rtol=1e-6)
+            np.testing.assert_allclose(a.maxs, b.maxs, rtol=1e-6)
+
+    def test_raw_topk_cohort_matches_per_query_packed(self):
+        import jax
+        import jax.numpy as jnp
+
+        from horaedb_tpu.ops.scan_agg import encode_filter_ops
+        from horaedb_tpu.ops.scan_topk import (
+            pack_raw_dyn,
+            raw_topk_cohort,
+            raw_topk_packed,
+            topk_key_bounds,
+        )
+
+        codes, ts_rel, values = self._resident()
+        S = 5
+        nf = encode_filter_ops(((0, "<"),))
+        rng = np.random.default_rng(11)
+        members = []
+        for b in range(4):
+            allow = np.append(rng.random(S) > 0.25, False).astype(np.int32)
+            lo, hi = 5 * b, 390 - 10 * b
+            key_lo, key_hi = topk_key_bounds(True, True, lo, hi)
+            members.append(
+                (allow, pack_raw_dyn([80.0 - b], lo, hi, key_lo, key_hi))
+            )
+        sessions = jnp.asarray(np.stack([m[0] for m in members]))
+        dyns = jnp.asarray(np.stack([m[1] for m in members]))
+        statics = dict(
+            k=16, descending=True, key_is_ts=True, key_field=0,
+            numeric_filters=nf,
+        )
+        batched = np.asarray(
+            jax.device_get(
+                raw_topk_cohort(
+                    jnp.asarray(codes), jnp.asarray(ts_rel),
+                    jnp.asarray(values), sessions, dyns, **statics
+                )
+            )
+        )
+        for j, (allow, dyn) in enumerate(members):
+            solo = np.asarray(
+                jax.device_get(
+                    raw_topk_packed(
+                        jnp.asarray(codes), jnp.asarray(ts_rel),
+                        jnp.asarray(values), jnp.asarray(allow),
+                        jnp.asarray(dyn), **statics
+                    )
+                )
+            )
+            # slot order is unspecified within ties: compare as sets of
+            # selected row ids (the executor re-sorts gathered rows)
+            assert set(batched[j][batched[j] >= 0]) == set(solo[solo >= 0])
