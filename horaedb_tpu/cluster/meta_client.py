@@ -41,6 +41,12 @@ class MetaClient:
     ) -> dict:
         from collections import deque
 
+        # Deadline propagation: a meta hop issued while serving a query
+        # (meta-serialized DDL, route refreshes mid-statement) charges
+        # the query's remaining budget instead of burning the full
+        # fixed timeout per failover attempt.
+        from ..utils.deadline import cap_timeout, checkpoint
+
         last_err: Exception | None = None
         with self._lock:
             start = self._preferred
@@ -55,6 +61,7 @@ class MetaClient:
             hinted.add(leader_hint)
         while attempts:
             ep = attempts.popleft()
+            checkpoint("forward")  # typed raise once the budget is gone
             try:
                 data = json.dumps(payload).encode() if payload is not None else None
                 req = urllib.request.Request(
@@ -68,7 +75,9 @@ class MetaClient:
                 # dead-endpoint connect timeouts burned before the
                 # successful attempt must not be charged against the lease.
                 sent_at = time.monotonic()
-                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                with urllib.request.urlopen(
+                    req, timeout=cap_timeout(self.timeout_s)
+                ) as resp:
                     body = json.loads(resp.read().decode() or "{}")
                 with self._lock:
                     if ep in self.endpoints:

@@ -177,6 +177,12 @@ def _sst_read_fn(store, schema, predicate, projection):
     (shared by the full scan and the limited scan)."""
 
     def read_one(handle):
+        # per-SST checkpoint: the scan observes the query's budget /
+        # cancel flag between (possibly remote) object-store fetches —
+        # pool threads see it via the copied contexts
+        from ..utils.deadline import checkpoint
+
+        checkpoint("store")
         return SstReader(store, handle.path).read(
             schema, predicate, projection=projection
         )

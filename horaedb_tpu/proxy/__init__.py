@@ -164,6 +164,13 @@ class Proxy:
         self.wlm = WorkloadManager.from_limits(
             limits, persist_path=persist_path, batch_cfg=batch_cfg
         )
+        # default per-query time budget ([limits] query_timeout; 0 =
+        # unbounded) — the gateway's header/session knobs override it
+        # per request by passing an explicit Deadline
+        self.default_timeout_ms: float = (
+            getattr(limits, "query_timeout_s", 60.0) if limits is not None
+            else 60.0
+        ) * 1000.0
         # the old Limiter surface (block/unblock/blocked/check) lives on,
         # served by the quota manager that subsumed it
         self.limiter = self.wlm.quota
@@ -191,7 +198,9 @@ class Proxy:
         self.runtime.shutdown()
         self.wlm.close()
 
-    def handle_sql(self, sql: str, tenant: str = "default") -> Output:
+    def handle_sql(
+        self, sql: str, tenant: str = "default", deadline=None
+    ) -> Output:
         ctx = RequestContext(next(self._req_ids), sql)
         self._m_queries.inc()
         # The span tree travels by context: priority-pool threads run the
@@ -199,20 +208,55 @@ class Proxy:
         # (trace_id, parent_span_id) in their wire spec (utils/tracectx).
         import contextvars
 
+        from ..utils.deadline import (
+            QUERY_REGISTRY,
+            Deadline,
+            DeadlineExceeded,
+            QueryCancelled,
+            deadline_scope,
+            observe_budget,
+        )
         from ..utils.querystats import finish_ledger, start_ledger
         from ..utils.tracectx import finish_trace, span, start_trace
 
+        # The time budget opens HERE, at ingress, and rides the same
+        # ContextVar discipline as the trace/ledger — every layer below
+        # (admission, executor checkpoints, remote RPC envelopes,
+        # forwarding hops, store waits) charges the one object. The
+        # gateway installs its Deadline (header/session knob, a
+        # forwarded hop's remaining budget) into the calling context
+        # (utils/deadline.bind) so handle_sql keeps its historical
+        # signature; embedded callers get the [limits] query_timeout
+        # default.
+        if deadline is None:
+            from ..utils.deadline import current_deadline
+
+            deadline = current_deadline()
+        if deadline is None:
+            deadline = Deadline(self.default_timeout_ms)
+        observe_budget(deadline.budget_ms)
         trace, handle = start_trace(ctx.request_id, "sql", sql=sql[:200])
         # The cost ledger rides the same context: every stage the request
         # touches (scans, cache, kernels, remote fan-out) accounts into
         # it, and finalization feeds system.public.query_stats + the
         # horaedb_query_* metric families (utils/querystats).
         ledger, ltoken = start_ledger(ctx.request_id, sql)
+        ledger.add(deadline_ms=deadline.budget_ms or 0)
+        dtoken = None
+        live = QUERY_REGISTRY.register(
+            ctx.request_id, sql, tenant, deadline,
+            protocol=getattr(deadline, "proto", "sql"),
+        )
         shape = None  # set for executed SELECTs; feeds the EWMA history
         exec_elapsed: list = [None]  # leader execution seconds (EWMA input)
         admission_class = None  # set for executed SELECTs (class latency)
         ok = False
         try:
+            dtoken = deadline_scope(deadline)
+            dtoken.__enter__()
+            # refuse already-expired work before doing ANY of it (a
+            # forwarded hop may arrive with <= 0 remaining)
+            deadline.check("ingress")
             # The plan cache is what makes repeated dashboard text cheap
             # at serving latency — the gateway is its target workload.
             with span("parse_plan"):
@@ -228,12 +272,19 @@ class Proxy:
                 self.wlm.quota.charge_read(tenant, plan.table)
                 shape = normalize_shape(sql)
                 admission_class, est_ms = classify_plan(plan, shape=shape)
+                live.admission_class = admission_class
                 lane = lane_for(admission_class)
+                est_cost_s = (est_ms / 1000.0) if est_ms else None
 
                 def run_leader():
                     # admission wraps only the LEADER: followers coalesce
-                    # onto its slot instead of taking their own
-                    with self.wlm.admission.admit(admission_class):
+                    # onto its slot instead of taking their own; the
+                    # queue wait charges the time budget, and a budget
+                    # that cannot fit the shape's expected cost sheds
+                    # immediately (utils/deadline)
+                    with self.wlm.admission.admit(
+                        admission_class, est_cost_s=est_cost_s
+                    ):
                         with span(
                             "execute", priority=lane, admission=admission_class
                         ):
@@ -298,10 +349,39 @@ class Proxy:
                     return out
             finally:
                 self.wlm.dedup.bump_epoch()
+        except DeadlineExceeded as e:
+            # the ledger marks + typed journal event ARE the audit trail
+            # the tenantsim gates read from the database's own tables
+            ledger.add(timed_out=1)
+            from ..utils.events import record_event
+
+            record_event(
+                "query_timeout",
+                table=ledger.table_name or None,
+                stage=e.stage,
+                budget_ms=int(deadline.budget_ms or 0),
+            )
+            self._m_errors.inc()
+            raise
+        except QueryCancelled as e:
+            ledger.add(cancelled=1)
+            from ..utils.events import record_event
+
+            record_event(
+                "query_cancelled",
+                table=ledger.table_name or None,
+                source=e.source,
+                query_id=live.query_id,
+            )
+            self._m_errors.inc()
+            raise
         except Exception:
             self._m_errors.inc()
             raise
         finally:
+            QUERY_REGISTRY.deregister(live)
+            if dtoken is not None:
+                dtoken.__exit__(None, None, None)
             elapsed = time.perf_counter() - ctx.start
             self._m_latency.observe(elapsed)
             if ok and admission_class is not None:

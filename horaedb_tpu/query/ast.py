@@ -413,6 +413,14 @@ class Explain:
     analyze: bool = False
 
 
+@dataclass(frozen=True)
+class KillQuery:
+    """KILL QUERY <id> — cooperative cancellation of a live query (the
+    id from ``system.public.queries`` / ``/debug/queries?live=1``)."""
+
+    query_id: int
+
+
 Statement = (
     Select
     | UnionSelect
@@ -425,4 +433,5 @@ Statement = (
     | ExistsTable
     | AlterTableAddColumn
     | AlterTableSetOptions
+    | KillQuery
 )

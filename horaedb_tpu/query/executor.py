@@ -493,6 +493,13 @@ class Executor:
         only double the prepare work and the cache_misses count."""
         import time as _time
 
+        from ..utils.deadline import checkpoint as _deadline_checkpoint
+
+        # Cooperative checkpoint at executor entry (and again before
+        # each scan batch / window / device dispatch below): a cancelled
+        # or expired query unwinds HERE, with the admission slot
+        # released by the admit context manager's finally.
+        _deadline_checkpoint("executing")
         t_start = _time.perf_counter()
         # Per-call dict threaded through the stages and attached to the
         # RESULT — concurrent queries never share mutable metric state.
@@ -875,6 +882,10 @@ class Executor:
     def _execute_agg_device(
         self, plan: QueryPlan, rows: RowGroup, m: dict | None = None
     ) -> ResultSet:
+        from ..utils.deadline import checkpoint as _deadline_checkpoint
+
+        # last cheap exit before committing to a device dispatch
+        _deadline_checkpoint("dispatch")
         tag_keys, bucket_key, agg_cols = self._agg_device_shape(plan)
         # Numeric field filters -> device; the rest -> host row mask.
         device_filters, host_residue = self._split_residual_filters(plan)
@@ -1259,6 +1270,12 @@ class Executor:
         """The "spec -> dispatch" half for ONE prepared query: device
         call (mesh shard_map or the RTT-minimized packed path), delta
         fold, result assembly — exactly the pre-split cached path."""
+        from ..utils.deadline import checkpoint as _deadline_checkpoint
+
+        # last cheap exit before committing to the device dispatch
+        # (cohort dispatches intentionally skip this: a cohort carries
+        # MANY budgets; members observe their own at the batch layer)
+        _deadline_checkpoint("dispatch")
         import jax.numpy as jnp
 
         from ..ops.scan_agg import coerce_literals, encode_filter_ops, state_to_host
@@ -2043,6 +2060,9 @@ class Executor:
 
     # ---- host fallback -----------------------------------------------------
     def _execute_agg_host(self, plan: QueryPlan, rows: RowGroup) -> ResultSet:
+        from ..utils.deadline import checkpoint as _deadline_checkpoint
+
+        _deadline_checkpoint("executing")
         residual = self._residual_where(plan)
         if residual is not None and len(rows):
             v, m = eval_expr(residual, rows)
@@ -2157,6 +2177,9 @@ class Executor:
     def _execute_projection(
         self, plan: QueryPlan, rows: RowGroup, m: dict | None = None
     ) -> ResultSet:
+        from ..utils.deadline import checkpoint as _deadline_checkpoint
+
+        _deadline_checkpoint("executing")
         residual = self._residual_where(plan)
         if residual is not None and len(rows):
             v, vm = eval_expr(residual, rows)

@@ -132,6 +132,19 @@ class InterpreterFactory:
             return self._union(plan)
         if isinstance(plan, CTEPlan):
             return self._cte(plan)
+        from .plan import KillQueryPlan
+
+        if isinstance(plan, KillQueryPlan):
+            # cooperative kill: flip the cancel flag; the victim unwinds
+            # at its next checkpoint and releases every slot it holds
+            from ..utils.deadline import QUERY_REGISTRY
+
+            if not QUERY_REGISTRY.kill(plan.query_id, source="kill"):
+                raise InterpreterError(
+                    f"no live query with id {plan.query_id} "
+                    "(see system.public.queries)"
+                )
+            return AffectedRows(1)
         raise InterpreterError(f"no interpreter for {type(plan).__name__}")
 
     # ---- UNION / CTE -----------------------------------------------------

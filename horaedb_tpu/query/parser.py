@@ -210,6 +210,17 @@ class Parser:
             return ast.ExistsTable(self._ident())
         if self._at_kw("ALTER"):
             return self._alter()
+        if self._at_kw("KILL"):
+            # KILL [QUERY] <id> — cooperative cancellation; the id comes
+            # from system.public.queries (utils/deadline registry)
+            self.i += 1
+            self._eat_kw("QUERY")
+            t = self._next()
+            if t.kind != "number" or "." in t.text:
+                raise ParseError(
+                    "KILL QUERY expects an integer query id", t.pos, self.sql
+                )
+            return ast.KillQuery(int(t.text))
         t = self._peek()
         raise ParseError(f"unsupported statement start {t.text!r}", t.pos, self.sql)
 

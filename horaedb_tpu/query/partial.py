@@ -118,8 +118,14 @@ def compute_partial(
         windows = 0
         t_scan = _time.perf_counter()
         rows_seen = 0
+        from ..utils.deadline import checkpoint as _deadline_checkpoint
+
         with span("partial_windowed", table=table.name) as sp:
             for rows in table.read_windows(pred, projection=projection):
+                # per-window checkpoint: a long bounded aggregate is
+                # exactly the shape a KILL / tight budget must be able
+                # to stop mid-flight (the host-fallback chunk loop)
+                _deadline_checkpoint("executing")
                 windows += 1
                 rows_seen += len(rows)
                 names, arrays = _partial_on_rows(rows, spec)

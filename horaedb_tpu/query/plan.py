@@ -130,6 +130,14 @@ class AlterTablePlan:
 
 
 @dataclass(frozen=True)
+class KillQueryPlan:
+    """KILL QUERY <id>: flip the cancel flag on a live query in the
+    process-global registry (utils/deadline.QUERY_REGISTRY)."""
+
+    query_id: int
+
+
+@dataclass(frozen=True)
 class UnionPlan:
     """UNION [ALL]: branch plans executed independently, results aligned
     by position (names from the first branch), folded left-to-right —
@@ -168,4 +176,5 @@ Plan = (
     | ExplainPlan
     | UnionPlan
     | CTEPlan
+    | KillQueryPlan
 )

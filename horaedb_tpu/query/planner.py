@@ -115,6 +115,10 @@ class Planner:
             return ShowCreatePlan(stmt.table)
         if isinstance(stmt, ast.ExistsTable):
             return ExistsPlan(stmt.table)
+        if isinstance(stmt, ast.KillQuery):
+            from .plan import KillQueryPlan
+
+            return KillQueryPlan(stmt.query_id)
         if isinstance(stmt, ast.AlterTableAddColumn):
             schema = self._require_schema(stmt.table)
             cols = tuple(

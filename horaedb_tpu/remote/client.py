@@ -34,6 +34,17 @@ from .codec import (
 
 GRPC_PORT_OFFSET = 1000
 
+# Per-hop RPC ceiling, settable from [limits] forward_timeout at server
+# startup (run_server) — the effective per-call timeout is
+# min(this, remaining query budget) instead of a fixed constant.
+DEFAULT_TIMEOUT_S = 30.0
+
+
+def set_default_timeout(seconds: float) -> None:
+    global DEFAULT_TIMEOUT_S
+    if seconds and seconds > 0:
+        DEFAULT_TIMEOUT_S = float(seconds)
+
 
 def grpc_endpoint_for(http_endpoint: str, offset: int = GRPC_PORT_OFFSET) -> str:
     """Convention: a node's gRPC port = its HTTP port + offset.
@@ -62,9 +73,9 @@ class _ChannelPool:
 
 
 class RemoteEngineClient:
-    def __init__(self, endpoint: str, timeout_s: float = 30.0) -> None:
+    def __init__(self, endpoint: str, timeout_s: Optional[float] = None) -> None:
         self.endpoint = endpoint
-        self.timeout_s = timeout_s
+        self.timeout_s = DEFAULT_TIMEOUT_S if timeout_s is None else timeout_s
         self._channel = _ChannelPool.get(endpoint)
 
     def _call(self, method: str, payload: dict) -> dict:
@@ -72,6 +83,11 @@ class RemoteEngineClient:
             f"/horaedb.remote_engine/{method}",
             request_serializer=None,
             response_deserializer=None,
+        )
+        from ..utils.deadline import (
+            DEADLINE_MARKER,
+            DeadlineExceeded,
+            current_deadline,
         )
         from ..utils.querystats import merge_remote, record
         from ..wlm.admission import current_admission
@@ -83,11 +99,46 @@ class RemoteEngineClient:
             # the work on the matching PriorityRuntime lane and applies
             # its own gate (wlm/admission)
             payload["admission"] = adm
+        # Deadline propagation: the envelope ships the REMAINING budget
+        # (the owner refuses already-expired work and runs its own
+        # checkpoints under it) and the per-call timeout is
+        # min(per-hop cap, remaining) — a 25s-stale query can no longer
+        # burn a fresh 30s on every hop.
+        timeout_s = self.timeout_s
+        budget = current_deadline()
+        budget_bound = False
+        if budget is not None:
+            budget.check("remote")
+            rem = budget.remaining_s()
+            if rem is not None:
+                payload.setdefault("deadline_ms", max(1, int(rem * 1000)))
+                if rem < timeout_s:
+                    timeout_s = max(0.05, rem)
+                    budget_bound = True
         req = pack(payload)
         try:
-            raw = fn(req, timeout=self.timeout_s)
+            raw = fn(req, timeout=timeout_s)
         except grpc.RpcError as e:
             from ..wlm.admission import SHED_MARKER
+
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED and budget_bound:
+                # OUR budget set this call's timeout: surface the typed
+                # 504, not an opaque transport error
+                raise DeadlineExceeded(
+                    f"remote call to {self.endpoint} outlived the "
+                    "query's remaining budget",
+                    stage="remote",
+                    budget_ms=budget.budget_ms,
+                ) from e
+            if e.code() == grpc.StatusCode.DEADLINE_EXCEEDED and \
+                    DEADLINE_MARKER in (e.details() or ""):
+                # the owner refused/stopped the work against the SHIPPED
+                # budget — same typed error, one wire mapping
+                raise DeadlineExceeded(
+                    f"partition owner {self.endpoint} refused expired "
+                    f"work: {e.details()}",
+                    stage="remote",
+                ) from e
 
             if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED and \
                     SHED_MARKER in (e.details() or ""):

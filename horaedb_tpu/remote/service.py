@@ -359,10 +359,32 @@ class GrpcServer:
 
 def _unary(fn):
     def handler(raw: bytes, context: grpc.ServicerContext) -> bytes:
+        from ..utils.deadline import (
+            DEADLINE_MARKER,
+            DeadlineExceeded,
+            QueryCancelled,
+            serving_deadline,
+        )
+
         try:
-            return pack(fn(unpack(raw)))
+            req = unpack(raw)
+            # Deadline propagation: the envelope carries the origin's
+            # REMAINING budget. Already-expired work is refused before
+            # doing any of it, and the handler's scan/kernel
+            # checkpoints observe the budget while serving.
+            with serving_deadline(
+                req.get("deadline_ms") if isinstance(req, dict) else None
+            ):
+                return pack(fn(req))
         except _RpcError as e:
             context.abort(e.code, str(e))
+        except (DeadlineExceeded, QueryCancelled) as e:
+            # marked so the coordinator maps it back to ITS typed 504
+            # (grpc also mints DEADLINE_EXCEEDED for client-side
+            # timeouts; the marker distinguishes a deliberate refusal)
+            context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED, f"{DEADLINE_MARKER}: {e}"
+            )
         except KeyError as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"missing field {e}")
         except Exception as e:

@@ -105,6 +105,12 @@ def _dumps(obj: Any) -> str:
 
 
 FORWARD_HEADER = "X-HoraeDB-Forwarded"
+# Deadline propagation (utils/deadline): the client's per-request time
+# budget in milliseconds. Forwarding hops re-stamp it with the
+# REMAINING budget, so a multi-hop read decrements one budget instead
+# of burning a fresh fixed timeout per hop; a hop that receives <= 0
+# refuses the work on arrival (504).
+TIMEOUT_HEADER = "X-HoraeDB-Timeout-Ms"
 # Replicated follower reads (cluster/replica): a forwarded read marked
 # with REPLICA_READ_HEADER asks the receiving node to serve from its
 # read-only follower handle; REPLICA_EPOCH_HEADER carries the shard
@@ -143,6 +149,40 @@ def _replica_select(stmt):
 
     inner = stmt.inner if isinstance(stmt, _ast.Explain) else stmt
     return inner if isinstance(inner, _ast.Select) else None
+
+
+def _parse_timeout_ms(raw: Optional[str]) -> Optional[float]:
+    """X-HoraeDB-Timeout-Ms header -> milliseconds (None = absent;
+    invalid values read as absent rather than failing the query)."""
+    if not raw:
+        return None
+    try:
+        v = float(raw.strip())
+    except ValueError:
+        return None
+    return v if v == v else None  # NaN reads as absent
+
+
+def _forward_client_timeout(app, deadline=None):
+    """Per-call timeout for a forwarding hop: min([limits]
+    forward_timeout, the request's remaining budget) — replaces the old
+    fixed ClientTimeout(total=30) constants."""
+    import aiohttp
+
+    cap = app.get("forward_timeout_s") or 30.0
+    total = cap if deadline is None else deadline.cap_timeout(cap)
+    return aiohttp.ClientTimeout(total=total)
+
+
+def _budget_headers(deadline) -> dict:
+    """The remaining-budget header a forwarded hop carries (empty when
+    the request is unbounded)."""
+    if deadline is None:
+        return {}
+    rem = deadline.remaining_ms()
+    if rem is None:
+        return {}
+    return {TIMEOUT_HEADER: str(max(1, rem))}
 
 
 def _parse_staleness(raw: Optional[str]) -> Optional[int]:
@@ -233,6 +273,8 @@ class SqlGateway:
         replica_read: bool = False,
         staleness_ms: Optional[int] = None,
         replica_epoch: Optional[int] = None,
+        timeout_ms: Optional[float] = None,
+        wire: str = "http",
     ):
         if protocol is not None:
             import time as _time
@@ -242,11 +284,34 @@ class SqlGateway:
                 return await self.execute(
                     query, already_forwarded, tenant=tenant,
                     replica_read=replica_read, staleness_ms=staleness_ms,
-                    replica_epoch=replica_epoch,
+                    replica_epoch=replica_epoch, timeout_ms=timeout_ms,
+                    wire=protocol,
                 )
             finally:
                 latency_histogram(protocol).observe(_time.perf_counter() - t0)
         app = self.app
+        # The time budget starts HERE, at wire ingress: the client's
+        # X-HoraeDB-Timeout-Ms / session knob, else the [limits]
+        # query_timeout default. Already-expired work (a forwarded hop
+        # whose budget drained in flight) is refused before parsing.
+        from ..utils.deadline import Deadline
+
+        if timeout_ms is not None and timeout_ms <= 0:
+            # an explicit zero/negative budget IS "already expired":
+            # refuse the work on arrival instead of starting it
+            from ..utils.deadline import note_expired
+
+            note_expired("ingress")
+            return "error", (
+                504,
+                "request arrived with an exhausted time budget",
+                {"kind": "deadline", "retry_after_s": 1.0},
+            )
+        deadline = Deadline(
+            timeout_ms if timeout_ms is not None
+            else app.get("query_timeout_ms", 60_000.0),
+            proto=wire,
+        )
         conn: Connection = app["conn"]
         proxy: Proxy = app["proxy"]
         router = app["router"]
@@ -269,14 +334,27 @@ class SqlGateway:
                 # Cluster DDL goes through the coordinator: IT picks the
                 # owning shard/node and dispatches the actual create
                 # (ref: meta_based TableManipulator, write.rs:176-263).
+                # The request's budget rides into the meta hop: the
+                # meta client caps each failover attempt at
+                # min(its timeout, remaining) and refuses once drained.
                 def ddl():
-                    if isinstance(stmt, _ast.CreateTable):
-                        return cluster.meta.create_table(stmt.table, query)
-                    return cluster.meta.drop_table(stmt.table)
+                    from ..utils.deadline import deadline_scope
+
+                    with deadline_scope(deadline):
+                        if isinstance(stmt, _ast.CreateTable):
+                            return cluster.meta.create_table(stmt.table, query)
+                        return cluster.meta.drop_table(stmt.table)
 
                 try:
                     await loop.run_in_executor(None, ddl)
                 except Exception as e:
+                    from ..utils.deadline import DeadlineExceeded
+
+                    if isinstance(e, DeadlineExceeded):
+                        return "error", (
+                            504, str(e),
+                            {"kind": "deadline", "retry_after_s": 1.0},
+                        )
                     # The coordinator already implements IF NOT EXISTS /
                     # IF EXISTS leniency, so any error here is REAL —
                     # never report success for DDL that happened nowhere.
@@ -307,7 +385,7 @@ class SqlGateway:
                     ):
                         served = await self._try_replica_local(
                             query, tenant, table, replica_read,
-                            staleness_ms, replica_epoch,
+                            staleness_ms, replica_epoch, deadline,
                         )
                         if served is not None:
                             return served
@@ -329,11 +407,11 @@ class SqlGateway:
                         # offload to the least-loaded follower; a typed
                         # refusal (stale/fenced) falls back to the leader
                         served = await self._forward_replica(
-                            route, query, staleness_ms
+                            route, query, staleness_ms, deadline
                         )
                         if served is not None:
                             return served
-                    return await self._forward(route.endpoint, query)
+                    return await self._forward(route.endpoint, query, deadline)
                 local_route = route if route.replicas else None
             else:
                 local_route = None
@@ -350,14 +428,17 @@ class SqlGateway:
                 # count into the wlm dedup family too so the workload
                 # table reflects gateway-level coalescing
                 self.app["proxy"].wlm.dedup.note_coalesced()
-                out = await asyncio.shield(running)
+                out = await self._await_flight(running, deadline, leader=False)
                 return await self._maybe_shed_to_follower(
-                    out, local_route, query, staleness_ms, replica_read
+                    out, local_route, query, staleness_ms, replica_read,
+                    deadline,
                 )
             # ensure_future (not a bare await): the shared execution must
             # outlive a cancelled leader request so followers still get
             # their result
-            task = asyncio.ensure_future(self._run_local(proxy, query, tenant))
+            task = asyncio.ensure_future(
+                self._run_local(proxy, query, tenant, deadline)
+            )
             self._inflight[key] = task
 
             def _done(t, key=key):
@@ -365,9 +446,10 @@ class SqlGateway:
                     self._inflight.pop(key, None)
 
             task.add_done_callback(_done)
-            out = await asyncio.shield(task)
+            out = await self._await_flight(task, deadline, leader=True)
             return await self._maybe_shed_to_follower(
-                out, local_route, query, staleness_ms, replica_read
+                out, local_route, query, staleness_ms, replica_read,
+                deadline,
             )
         # any non-SELECT may change visible state: advance the epoch so
         # later reads start a fresh execution. Bumped AFTER the statement
@@ -375,11 +457,80 @@ class SqlGateway:
         # would let a post-commit SELECT join a pre-write flight that
         # became leader under the already-advanced epoch.
         try:
-            return await self._run_local(proxy, query, tenant)
+            return await self._run_local(proxy, query, tenant, deadline)
         finally:
             self._write_epoch += 1
 
-    async def _run_local(self, proxy, query: str, tenant: str = "default"):
+    async def _await_flight(self, task, deadline, leader: bool):
+        """Await a (shielded) gateway single-flight execution under the
+        caller's OWN budget. A follower whose budget drains answers its
+        typed 504 while the flight keeps running for everyone else; a
+        LEADER whose client disconnects — with nobody else coalesced on
+        the flight — flips the cancel flag so the worker-thread
+        execution unwinds at its next checkpoint and releases its
+        admission slot (the proxy-level dedup converts that into a
+        typed retryable error for any thread-level followers — never a
+        QueryCancelled for a query THEY didn't cancel)."""
+        if not leader:
+            task._hdb_followers = getattr(task, "_hdb_followers", 0) + 1
+        rem = deadline.remaining_s() if deadline is not None else None
+        try:
+            if rem is None:
+                out = await asyncio.shield(task)
+            else:
+                try:
+                    out = await asyncio.wait_for(asyncio.shield(task), rem)
+                except asyncio.TimeoutError:
+                    # the worker thread observes the SAME Deadline
+                    # object at its next checkpoint and unwinds with
+                    # the typed error + ledger marks + expiry counter
+                    # on its own; the gateway just answers now
+                    return "error", (
+                        504,
+                        f"query exceeded its {deadline.budget_ms:.0f}ms "
+                        "time budget",
+                        {"kind": "deadline", "retry_after_s": 1.0},
+                    )
+            if not leader and isinstance(out, tuple) and out[0] == "error":
+                # a coalesced follower never surfaces the LEADER's
+                # personal ending (its budget, its kill) — same
+                # contract as the proxy-level dedup/_member_error: a
+                # typed retryable overload instead, a retry starts a
+                # fresh flight
+                kind = out[1][2].get("kind")
+                if kind in ("deadline", "cancelled"):
+                    return "error", (
+                        503,
+                        "the in-flight leader serving this read "
+                        f"ended early ({kind}); retry starts a fresh "
+                        "execution",
+                        {"kind": "overloaded", "retry_after_s": 0.1},
+                    )
+            return out
+        except asyncio.CancelledError:
+            # client disconnect: cooperative cancel — the shielded task
+            # survives for coalesced followers; a leader with NO ONE
+            # else waiting cancels the in-flight execution instead of
+            # leaving it immortal
+            if (
+                leader
+                and deadline is not None
+                and not getattr(task, "_hdb_followers", 0)
+            ):
+                deadline.cancel("disconnect")
+                from ..utils.deadline import note_cancel
+
+                note_cancel("disconnect")
+            raise
+        finally:
+            if not leader:
+                task._hdb_followers = getattr(task, "_hdb_followers", 1) - 1
+
+    async def _run_local(
+        self, proxy, query: str, tenant: str = "default", deadline=None
+    ):
+        from ..utils.deadline import DeadlineExceeded, QueryCancelled, bind
+
         loop = asyncio.get_running_loop()
         if tenant == "default":
             # positional call keeps handle_sql wrappers/monkeypatches with
@@ -387,8 +538,21 @@ class SqlGateway:
             run = functools.partial(proxy.handle_sql, query)
         else:
             run = functools.partial(proxy.handle_sql, query, tenant=tenant)
+        # the request deadline rides a context COPY into the worker
+        # thread (handle_sql picks it up via current_deadline()) so the
+        # historical signature stays intact for wrappers/monkeypatches
+        ctx = bind(deadline)
         try:
-            out = await loop.run_in_executor(None, run)
+            out = await loop.run_in_executor(None, ctx.run, run)
+        except DeadlineExceeded as e:
+            return "error", (
+                504, str(e),
+                {"kind": "deadline", "retry_after_s": e.retry_after_s},
+            )
+        except QueryCancelled as e:
+            # 499-style: the nginx "client closed request" convention —
+            # the work was cooperatively stopped, not server-failed
+            return "error", (499, str(e), {"kind": "cancelled"})
         except BlockedError as e:
             return "error", (403, str(e), {"kind": "blocked"})
         except OverloadedError as e:
@@ -416,6 +580,7 @@ class SqlGateway:
         replica_read: bool,
         staleness_ms: Optional[int],
         replica_epoch: Optional[int],
+        deadline=None,
     ):
         """Serve an eligible SELECT from THIS node's read-only follower
         handle. Returns a gateway result, or None meaning "not servable
@@ -495,8 +660,13 @@ class SqlGateway:
             return out, epoch, lag_ms
 
         loop = asyncio.get_running_loop()
+        from ..utils.deadline import bind
+
+        ctx = bind(deadline)
         try:
-            out, epoch, lag_ms = await loop.run_in_executor(None, serve)
+            out, epoch, lag_ms = await loop.run_in_executor(
+                None, ctx.run, serve
+            )
         except ReplicaStaleError as e:
             if replica_read:
                 # the ORIGIN owns the leader fallback for forwarded reads
@@ -528,6 +698,15 @@ class SqlGateway:
                 {"kind": "quota", "retry_after_s": e.retry_after_s},
             )
         except Exception as e:
+            from ..utils.deadline import DeadlineExceeded, QueryCancelled
+
+            if isinstance(e, DeadlineExceeded):
+                return "error", (
+                    504, str(e),
+                    {"kind": "deadline", "retry_after_s": e.retry_after_s},
+                )
+            if isinstance(e, QueryCancelled):
+                return "error", (499, str(e), {"kind": "cancelled"})
             return "error", (422, str(e), {})
         note_replica_read("served")
         # visible to the HTTP handler (same request task context): the
@@ -538,7 +717,7 @@ class SqlGateway:
         return "rows", (list(out.names), out.to_pylist())
 
     async def _forward_replica(
-        self, route, query: str, staleness_ms: Optional[int]
+        self, route, query: str, staleness_ms: Optional[int], deadline=None
     ):
         """Offload an eligible SELECT to one of the route's follower
         replicas. Returns a gateway result, or None meaning "use the
@@ -562,6 +741,9 @@ class SqlGateway:
             FORWARD_HEADER: "1",
             REPLICA_READ_HEADER: "1",
             REPLICA_EPOCH_HEADER: str(route.epoch),
+            # the REMAINING budget rides the hop; the follower refuses
+            # already-expired work and charges the rest
+            **_budget_headers(deadline),
         }
         if staleness_ms:
             headers[STALENESS_HEADER] = f"{int(staleness_ms)}ms"
@@ -571,7 +753,7 @@ class SqlGateway:
                 f"http://{target}/sql",
                 json={"query": query},
                 headers=headers,
-                timeout=aiohttp.ClientTimeout(total=30),
+                timeout=_forward_client_timeout(self.app, deadline),
             ) as resp:
                 body = await resp.json(content_type=None)
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
@@ -594,7 +776,7 @@ class SqlGateway:
 
     async def _maybe_shed_to_follower(
         self, out, local_route, query: str,
-        staleness_ms: Optional[int], replica_read: bool,
+        staleness_ms: Optional[int], replica_read: bool, deadline=None,
     ):
         """Leader-overload relief: when the LOCAL leader shed an eligible
         SELECT with the retryable OverloadedError and the shard has
@@ -611,11 +793,18 @@ class SqlGateway:
         status, msg, extra = out[1]
         if extra.get("kind") != "overloaded":
             return out
-        served = await self._forward_replica(local_route, query, staleness_ms)
+        served = await self._forward_replica(
+            local_route, query, staleness_ms, deadline
+        )
         return served if served is not None else out
 
-    async def _forward(self, endpoint: str, query: str):
-        """Ship the statement to the owning node's /sql (ref: forward.rs)."""
+    async def _forward(self, endpoint: str, query: str, deadline=None):
+        """Ship the statement to the owning node's /sql (ref: forward.rs).
+
+        The per-call timeout is min([limits] forward_timeout, the
+        request's remaining budget) and the hop re-stamps the budget
+        header — a chain of forwards decrements ONE budget instead of
+        burning a fixed 30s per hop."""
         import aiohttp
 
         try:
@@ -623,17 +812,37 @@ class SqlGateway:
             async with session.post(
                 f"http://{endpoint}/sql",
                 json={"query": query},
-                headers={FORWARD_HEADER: "1"},
-                timeout=aiohttp.ClientTimeout(total=30),
+                headers={FORWARD_HEADER: "1", **_budget_headers(deadline)},
+                timeout=_forward_client_timeout(self.app, deadline),
             ) as resp:
                 body = await resp.json(content_type=None)
-        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
-            # ValueError covers non-JSON bodies; timeouts must map to the
+        except asyncio.TimeoutError:
+            if deadline is not None and deadline.expired():
+                from ..utils.deadline import note_expired
+
+                note_expired("forward")
+                return "error", (
+                    504,
+                    f"forward to {endpoint} outlived the query's "
+                    f"{deadline.budget_ms:.0f}ms time budget",
+                    {"kind": "deadline", "retry_after_s": 1.0},
+                )
+            return "error", (502, f"forward to {endpoint} timed out", {})
+        except (aiohttp.ClientError, ValueError) as e:
+            # ValueError covers non-JSON bodies; failures map to the
             # same 502 contract, not unwind wire-protocol sessions.
             return "error", (502, f"forward to {endpoint} failed: {e}", {})
         if resp.status != 200:
+            # a typed deadline/cancel ending on the remote hop keeps
+            # its kind so MySQL/PG map their native codes, not a
+            # generic internal error
+            extra: dict = {}
+            if resp.status == 504:
+                extra = {"kind": "deadline", "retry_after_s": 1.0}
+            elif resp.status == 499:
+                extra = {"kind": "cancelled"}
             return "error", (
-                resp.status, body.get("error", "forward failed"), {},
+                resp.status, body.get("error", "forward failed"), extra,
             )
         if "affected_rows" in body:
             return "affected", body["affected_rows"]
@@ -701,6 +910,17 @@ def create_app(
     # default bounded-staleness opt-in for follower reads ([cluster]
     # read_staleness; per-request override via X-HoraeDB-Read-Staleness)
     app["read_staleness_ms"] = int(max(0.0, read_staleness_s) * 1000)
+    # deadline plane (utils/deadline): the default per-query budget and
+    # the per-hop forwarding cap — X-HoraeDB-Timeout-Ms / the MySQL+PG
+    # session knobs override the budget per request
+    app["query_timeout_ms"] = (
+        getattr(limits, "query_timeout_s", 60.0) if limits is not None
+        else 60.0
+    ) * 1000.0
+    app["forward_timeout_s"] = (
+        getattr(limits, "forward_timeout_s", 30.0) if limits is not None
+        else 30.0
+    )
     app["started_at"] = _time.time()
     app.on_cleanup.append(_close_client_session)
 
@@ -831,8 +1051,24 @@ def create_app(
             )
         import aiohttp
 
+        from ..utils.deadline import Deadline
+
         body = await request.read()
         url = f"http://{route.endpoint}{request.path_qs}"
+        # a client-sent budget rides the hop (re-stamped with what
+        # remains) and caps the per-call timeout below [limits]
+        # forward_timeout; an explicit zero/negative budget is
+        # "already expired" — refuse it here like the /sql path does
+        raw_budget = _parse_timeout_ms(request.headers.get(TIMEOUT_HEADER))
+        if raw_budget is not None and raw_budget <= 0:
+            from ..utils.deadline import note_expired
+
+            note_expired("ingress")
+            return web.json_response(
+                {"error": "request arrived with an exhausted time budget"},
+                status=504,
+            )
+        fwd_deadline = Deadline(raw_budget)
         try:
             session = await _client_session(request.app)
             async with session.post(
@@ -843,8 +1079,9 @@ def create_app(
                     "Content-Type": request.headers.get(
                         "Content-Type", "application/json"
                     ),
+                    **_budget_headers(fwd_deadline),
                 },
-                timeout=aiohttp.ClientTimeout(total=30),
+                timeout=_forward_client_timeout(request.app, fwd_deadline),
             ) as resp:
                 payload = await resp.read()
                 return web.Response(
@@ -852,6 +1089,15 @@ def create_app(
                     status=resp.status,
                     content_type=resp.content_type,
                 )
+        except asyncio.TimeoutError:
+            # budget-capped hop timed out: with a client budget that is
+            # 504 (the work may finish on the owner, but the caller's
+            # time is gone); without one it is the ordinary 502
+            status = 504 if fwd_deadline.expired() else 502
+            return web.json_response(
+                {"error": f"forward to {route.endpoint} timed out"},
+                status=status,
+            )
         except aiohttp.ClientError as e:
             return web.json_response(
                 {"error": f"forward to {route.endpoint} failed: {e}"}, status=502
@@ -890,6 +1136,9 @@ def create_app(
                 if request.headers.get(REPLICA_EPOCH_HEADER, "").isdigit()
                 else None
             ),
+            # per-request time budget (forwarding hops re-stamp the
+            # remaining budget into the same header)
+            timeout_ms=_parse_timeout_ms(request.headers.get(TIMEOUT_HEADER)),
         )
         if kind == "error":
             status, msg, extra = payload
@@ -1173,7 +1422,20 @@ def create_app(
             return None
         import aiohttp
 
+        from ..utils.deadline import Deadline
+
         body = await request.read()
+        raw_budget = _parse_timeout_ms(request.headers.get(TIMEOUT_HEADER))
+        if raw_budget is not None and raw_budget <= 0:
+            # already expired on arrival: refuse like the /sql path
+            from ..utils.deadline import note_expired
+
+            note_expired("ingress")
+            return web.json_response(
+                {"error": "request arrived with an exhausted time budget"},
+                status=504,
+            )
+        fwd_deadline = Deadline(raw_budget)
         headers = {
             FORWARD_HEADER: "1",
             REPLICA_READ_HEADER: "1",
@@ -1181,6 +1443,7 @@ def create_app(
             "Content-Type": request.headers.get(
                 "Content-Type", "application/json"
             ),
+            **_budget_headers(fwd_deadline),
         }
         if staleness_ms:
             headers[STALENESS_HEADER] = f"{int(staleness_ms)}ms"
@@ -1191,7 +1454,7 @@ def create_app(
                 f"http://{target}{request.path_qs}",
                 data=body,
                 headers=headers,
-                timeout=aiohttp.ClientTimeout(total=30),
+                timeout=_forward_client_timeout(request.app, fwd_deadline),
             ) as resp:
                 payload = await resp.read()
                 if resp.status == 200:
@@ -1807,10 +2070,36 @@ def create_app(
         return web.json_response(proxy.hotspot.top())
 
     async def debug_queries(request: web.Request) -> web.Response:
-        """Recent per-query metric trees (ref: trace_metric surfaces)."""
+        """Recent per-query metric trees (ref: trace_metric surfaces).
+        ``?live=1`` returns the LIVE in-flight registry instead (the
+        same rows as ``system.public.queries``; DELETE
+        /debug/queries/{id} kills one)."""
+        if _query_flag(request, "live"):
+            from ..utils.deadline import QUERY_REGISTRY
+
+            return web.Response(
+                text=_dumps(QUERY_REGISTRY.list()),
+                content_type="application/json",
+            )
         return web.Response(
             text=_dumps(list(proxy.recent_queries)), content_type="application/json"
         )
+
+    async def debug_query_kill(request: web.Request) -> web.Response:
+        """Cooperative kill: flips the cancel flag on a live query; the
+        executor observes it at its next checkpoint and unwinds with the
+        typed QueryCancelled (admission slot, dedup flight and cohort
+        membership all released on the way out)."""
+        from ..utils.deadline import QUERY_REGISTRY
+
+        raw = request.match_info["query_id"]
+        if not raw.isdigit():
+            return web.json_response({"error": "bad query id"}, status=400)
+        if not QUERY_REGISTRY.kill(int(raw), source="kill"):
+            return web.json_response(
+                {"error": f"no live query {raw}"}, status=404
+            )
+        return web.json_response({"killed": int(raw)})
 
     async def slow_threshold(request: web.Request) -> web.Response:
         try:
@@ -2232,7 +2521,7 @@ def create_app(
                     f"http://{route.endpoint}/opentsdb/api/search/lookup",
                     json={"metric": metric, "tags": tag_filters, "limit": limit},
                     headers={FORWARD_HEADER: "1"},
-                    timeout=aiohttp.ClientTimeout(total=30),
+                    timeout=_forward_client_timeout(request.app),
                 ) as resp:
                     return web.json_response(
                         await resp.json(content_type=None), status=resp.status
@@ -2271,6 +2560,7 @@ def create_app(
     app.router.add_get("/debug/tables", debug_tables)
     app.router.add_get("/debug/hotspot", debug_hotspot)
     app.router.add_get("/debug/queries", debug_queries)
+    app.router.add_delete("/debug/queries/{query_id}", debug_query_kill)
     app.router.add_put("/debug/slow_threshold/{seconds}", slow_threshold)
     app.router.add_get("/debug/profile/cpu/{seconds}", debug_profile_cpu)
     app.router.add_get("/debug/profile/heap/{seconds}", debug_profile_heap)
@@ -2332,6 +2622,11 @@ def run_server(
             write_stall_deadline_s=config.engine.write_stall_deadline_s,
         )
         slow_threshold = config.limits.slow_threshold_s
+        # the remote-engine client's per-hop ceiling follows the same
+        # [limits] forward_timeout knob as the HTTP forwarding hops
+        from ..remote.client import set_default_timeout
+
+        set_default_timeout(config.limits.forward_timeout_s)
     host = host if host is not None else "127.0.0.1"
     port = port if port is not None else DEFAULT_HTTP_PORT
     if config is not None and config.s3.bucket and explicit_data_dir is not None:

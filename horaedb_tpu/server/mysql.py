@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import re
 import secrets
 import struct
 from typing import Optional
@@ -199,6 +200,9 @@ class _Conn:
         # persist so re-executes with new_params_bound=0 can decode)
         self._stmts: dict[int, dict] = {}
         self._next_stmt_id = 1
+        # per-session time budget (SET max_execution_time = <ms>, the
+        # MySQL knob); None = the server's [limits] query_timeout
+        self._timeout_ms: Optional[float] = None
 
     async def _read_packet(self) -> Optional[bytes]:
         # Reassemble multi-frame payloads: a frame of exactly 0xFFFFFF
@@ -266,6 +270,10 @@ class _Conn:
             self._error(msg, errno=1040, sqlstate="08004")
         elif kind == "blocked":
             self._error(msg, errno=1142, sqlstate="42000")
+        elif kind in ("deadline", "cancelled"):
+            # ER_QUERY_INTERRUPTED — the code mysql itself answers for
+            # both KILL QUERY and max_execution_time expiry
+            self._error(msg, errno=1317, sqlstate="70100")
         else:
             self._error(msg)
 
@@ -329,8 +337,23 @@ class _Conn:
                 self._error(f"unsupported command {cmd:#x}", errno=1047)
             await self.writer.drain()
 
+    _SET_TIMEOUT_RE = re.compile(
+        r"^\s*set\s+(?:session\s+)?max_execution_time\s*=\s*(\d+)\s*$",
+        re.IGNORECASE,
+    )
+
     async def _query(self, sql: str) -> None:
         q = sql.strip().rstrip(";")
+        # Session time budget (the MySQL knob): SET max_execution_time
+        # = <ms> applies to every later statement on this connection —
+        # 0 restores the server default. Intercepted BEFORE the
+        # federated chatter handler, which swallows SET generically.
+        m_timeout = self._SET_TIMEOUT_RE.match(q)
+        if m_timeout is not None:
+            ms = int(m_timeout.group(1))
+            self._timeout_ms = float(ms) if ms > 0 else None
+            self._ok()
+            return
         # Connector session chatter answers locally with canned shapes
         # (ref: federated.rs — real clients open with a probe burst and
         # refuse to connect if any of them errors).
@@ -346,7 +369,9 @@ class _Conn:
         # The shared gateway applies routing, fences, limiter, metrics —
         # wire traffic gets the same discipline as HTTP /sql (including
         # the per-protocol latency labelset).
-        kind, payload = await self.gateway.execute(q, protocol="mysql")
+        kind, payload = await self.gateway.execute(
+            q, protocol="mysql", timeout_ms=self._timeout_ms
+        )
         if kind == "error":
             self._gateway_error(payload)
         elif kind == "affected":
@@ -428,7 +453,8 @@ class _Conn:
         for pos, v in zip(reversed(spots), reversed(params)):
             sql = sql[:pos] + _sql_literal(v) + sql[pos + 1:]
         kind, payload = await self.gateway.execute(
-            sql.strip().rstrip(";"), protocol="mysql"
+            sql.strip().rstrip(";"), protocol="mysql",
+            timeout_ms=self._timeout_ms,
         )
         if kind == "error":
             self._gateway_error(payload)

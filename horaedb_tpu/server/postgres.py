@@ -56,12 +56,33 @@ class _ExtError(Exception):
         self.sqlstate = sqlstate
 
 
+_SET_TIMEOUT_RE = re.compile(
+    r"^\s*set\s+(?:session\s+)?statement_timeout\s*(?:=|\s+to)\s*"
+    r"'?(\d+)\s*(ms|s|min|h)?'?\s*$",
+    re.IGNORECASE,
+)
+
+_PG_TIMEOUT_UNITS = {None: 1.0, "ms": 1.0, "s": 1000.0,
+                     "min": 60_000.0, "h": 3_600_000.0}
+
+
+def _pg_timeout_ms(m: "re.Match") -> float:
+    """postgres semantics: a bare integer is milliseconds; quoted
+    values may carry a unit (ms/s/min/h)."""
+    unit = (m.group(2) or "").lower() or None
+    return float(m.group(1)) * _PG_TIMEOUT_UNITS[unit]
+
+
 def _sqlstate_for(extra: dict) -> str:
     """Native SQLSTATE for the gateway's typed errors: shed and quota
     rejections answer 53300 (too_many_connections — class 53,
     insufficient resources: retryable); blocked tables answer 42501
     (insufficient_privilege)."""
     kind = extra.get("kind")
+    if kind in ("deadline", "cancelled"):
+        # 57014 query_canceled — what postgres answers for both a
+        # statement_timeout expiry and pg_cancel_backend
+        return "57014"
     if kind in ("overloaded", "quota"):
         return "53300"
     if kind == "blocked":
@@ -79,6 +100,9 @@ class _Conn:
         self._stmts: dict[str, str] = {}
         self._portals: dict[str, tuple] = {}
         self._ext_error = False  # discard extended msgs until Sync
+        # per-session time budget (SET statement_timeout = <ms>);
+        # None = the server's [limits] query_timeout default
+        self._timeout_ms: Optional[float] = None
 
     async def run(self) -> None:
         if not await self._startup():
@@ -202,7 +226,8 @@ class _Conn:
         sql = _substitute(self._stmts[stmt], params)
         # run now so Describe(portal) can answer with the real row shape
         kind, payload = await self.gateway.execute(
-            sql.strip().rstrip(";"), protocol="postgres"
+            sql.strip().rstrip(";"), protocol="postgres",
+            timeout_ms=self._timeout_ms,
         )
         if kind == "error":
             raise _ExtError(payload[1], _sqlstate_for(payload[2]))
@@ -289,6 +314,14 @@ class _Conn:
         lowered = q.lower()
         word = lowered.split()[0] if lowered.split() else ""
         if word in ("set", "begin", "start", "commit", "rollback"):
+            # session time budget (the postgres knob): SET
+            # statement_timeout = <ms> applies to every later statement
+            # on this connection; 0 restores the server default. Other
+            # SETs stay swallowed chatter.
+            m_timeout = _SET_TIMEOUT_RE.match(q)
+            if m_timeout is not None:
+                ms = _pg_timeout_ms(m_timeout)
+                self._timeout_ms = ms if ms > 0 else None
             tag = {"set": "SET", "begin": "BEGIN", "start": "BEGIN",
                    "commit": "COMMIT", "rollback": "ROLLBACK"}[word]
             self.writer.write(_msg(b"C", _cstr(tag)))
@@ -296,7 +329,9 @@ class _Conn:
             return
         # The shared gateway applies routing, fences, limiter, metrics —
         # including the per-protocol latency labelset.
-        kind, payload = await self.gateway.execute(q, protocol="postgres")
+        kind, payload = await self.gateway.execute(
+            q, protocol="postgres", timeout_ms=self._timeout_ms
+        )
         if kind == "error":
             _status, msg, extra = payload
             self._error(msg, _sqlstate_for(extra))

@@ -54,6 +54,7 @@ WORKLOAD_NAME = "system.public.workload"
 EVENTS_NAME = "system.public.events"
 ALERTS_NAME = "system.public.alerts"
 SLO_NAME = "system.public.slo"
+QUERIES_NAME = "system.public.queries"
 
 
 class _VirtualTable(Table):
@@ -607,6 +608,90 @@ class SloTable(_VirtualTable):
         )
 
 
+_QUERIES_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("query_id", DatumKind.UINT64, is_nullable=False),
+        ColumnSchema("request_id", DatumKind.UINT64),
+        ColumnSchema("sql", DatumKind.STRING),
+        ColumnSchema("tenant", DatumKind.STRING),
+        ColumnSchema("protocol", DatumKind.STRING),
+        ColumnSchema("class", DatumKind.STRING),
+        ColumnSchema("state", DatumKind.STRING),
+        ColumnSchema("elapsed_ms", DatumKind.DOUBLE),
+        ColumnSchema("deadline_ms", DatumKind.INT64),
+        ColumnSchema("remaining_ms", DatumKind.INT64),
+        ColumnSchema("cancelled", DatumKind.INT64),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "query_id"],
+)
+
+
+class QueriesTable(_VirtualTable):
+    """``system.public.queries``: the live in-flight query registry
+    (utils/deadline.QUERY_REGISTRY) — one row per running statement with
+    its budget, remaining time, coarse state (running/queued/executing/
+    cancelled) and the ``query_id`` that ``KILL QUERY <id>`` /
+    ``horaectl query kill`` / ``DELETE /debug/queries/{id}`` target.
+    ``remaining_ms`` is -1 for unbounded queries. The statement reading
+    this table appears in it too (it is itself a live query)."""
+
+    @property
+    def name(self) -> str:
+        return QUERIES_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _QUERIES_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..utils.deadline import QUERY_REGISTRY
+
+        entries = QUERY_REGISTRY.list()
+        return RowGroup(
+            _QUERIES_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [int(e["started_ms"]) for e in entries], dtype=np.int64
+                ),
+                "query_id": np.array(
+                    [int(e["query_id"]) for e in entries], dtype=np.uint64
+                ),
+                "request_id": np.array(
+                    [int(e["request_id"] or 0) for e in entries],
+                    dtype=np.uint64,
+                ),
+                "sql": np.array([e["sql"] for e in entries], dtype=object),
+                "tenant": np.array(
+                    [e["tenant"] for e in entries], dtype=object
+                ),
+                "protocol": np.array(
+                    [e["protocol"] for e in entries], dtype=object
+                ),
+                "class": np.array(
+                    [e["class"] for e in entries], dtype=object
+                ),
+                "state": np.array(
+                    [e["state"] for e in entries], dtype=object
+                ),
+                "elapsed_ms": np.array(
+                    [float(e["elapsed_ms"]) for e in entries],
+                    dtype=np.float64,
+                ),
+                "deadline_ms": np.array(
+                    [int(e["deadline_ms"]) for e in entries], dtype=np.int64
+                ),
+                "remaining_ms": np.array(
+                    [int(e["remaining_ms"]) for e in entries], dtype=np.int64
+                ),
+                "cancelled": np.array(
+                    [int(e["cancelled"]) for e in entries], dtype=np.int64
+                ),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -625,4 +710,6 @@ def open_system_table(catalog, name: str):
         return AlertsTable()
     if low == SLO_NAME:
         return SloTable()
+    if low == QUERIES_NAME:
+        return QueriesTable()
     return None

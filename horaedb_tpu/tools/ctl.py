@@ -107,6 +107,17 @@ def cmd_tables(ep: str, args) -> None:
 
 
 def cmd_query(ep: str, args) -> None:
+    """``query <sql>`` runs a statement; ``query list`` shows the LIVE
+    in-flight registry (system.public.queries); ``query kill <id>``
+    cooperatively cancels one (DELETE /debug/queries/{id})."""
+    if args.sql == "list" and args.arg is None:
+        _print_rows(json.loads(_get(ep, "/debug/queries?live=1")))
+        return
+    if args.sql == "kill":
+        if args.arg is None or not str(args.arg).isdigit():
+            raise CtlError("usage: horaectl query kill <query_id>")
+        print(_post(ep, f"/debug/queries/{args.arg}", None, method="DELETE"))
+        return
     out = json.loads(_post(ep, "/sql", {"query": args.sql}))
     if "rows" in out:
         _print_rows(out["rows"])
@@ -362,7 +373,9 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="command", required=True)
     sub.add_parser("tables")
     q = sub.add_parser("query")
-    q.add_argument("sql")
+    q.add_argument("sql", help="SQL text, or the verbs 'list' / 'kill'")
+    q.add_argument("arg", nargs="?", default=None,
+                   help="query id for 'kill'")
     r = sub.add_parser("route")
     r.add_argument("table")
     b = sub.add_parser("block")

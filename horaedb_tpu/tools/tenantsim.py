@@ -112,6 +112,17 @@ class SimConfig:
     meta_tick_s: float = 0.25
     # fault schedule (fractions of duration_s; None disables)
     storm_window: Optional[tuple] = (0.15, 0.45)
+    # slow-storm-with-tight-deadlines phase (ISSUE 14): during the
+    # window a slice of the expensive-scan traffic carries a tight
+    # X-HoraeDB-Timeout-Ms budget while store latency is injected —
+    # expired queries must answer the typed 504 within budget + one
+    # checkpoint interval (generous slack for the contended 1-core
+    # host), admission slots must drain back to baseline after, and
+    # the cheap-class p99 objective must never burn through it
+    deadline_phase: Optional[tuple] = None
+    deadline_budget_ms: float = 150.0
+    deadline_fraction: float = 0.35
+    deadline_slack_s: float = 3.0
     latency_burst: Optional[tuple] = (0.2, 0.4)
     latency_burst_s: float = 0.03
     error_burst: Optional[tuple] = (0.3, 0.55)
@@ -175,6 +186,13 @@ class SimReport:
     event_drops_unaccounted: int = -1
     event_drops: int = 0
     follower_served: int = 0
+    # deadline-storm gates (ISSUE 14), from the database's own tables
+    deadline_sent: int = 0
+    deadline_expired: int = 0
+    deadline_overdue: int = 0
+    deadline_timeout_events: int = -1
+    deadline_timed_out_rows: int = -1
+    admission_units_after: int = -1
     killed_node: str = ""
     kill_recovered: bool = False
     acked_rows_checked: int = 0
@@ -245,6 +263,39 @@ class SimReport:
                 out.append(
                     "elastic: moves happened but none was pre-warmed "
                     "(target never tailed the manifest before cutover)"
+                )
+        if self.config.get("deadline_phase") is not None:
+            # the deadline plane's gates (ISSUE 14): expired queries
+            # answer the typed error within budget + one checkpoint
+            # interval, the database's own journal/stats carry the
+            # evidence, and the admission slots drain back to baseline
+            if self.deadline_sent < 1:
+                out.append("deadline storm never sent a budgeted query")
+            if self.config.get("latency_burst") is not None:
+                if self.deadline_expired < 1:
+                    out.append(
+                        "no query expired under the slow storm with "
+                        "tight deadlines"
+                    )
+                if self.deadline_timeout_events < 1:
+                    out.append(
+                        "no query_timeout event in system.public.events"
+                    )
+                if self.deadline_timed_out_rows < 1:
+                    out.append(
+                        "no timed_out row in system.public.query_stats"
+                    )
+            if self.deadline_overdue != 0:
+                out.append(
+                    f"{self.deadline_overdue} expired quer(ies) answered "
+                    "later than budget + checkpoint slack"
+                )
+            if self.admission_units_after > 1:
+                # <= 1: the workload-reading SELECT itself holds one
+                # cheap unit while it materializes the table
+                out.append(
+                    "admission slots leaked after the deadline storm "
+                    f"(units_in_use={self.admission_units_after})"
                 )
         if self.served == 0:
             out.append("no queries served at all")
@@ -715,6 +766,7 @@ class TenantSim:
         self._stop = threading.Event()
         self._storm = threading.Event()
         self._hot = threading.Event()  # hot-tenant skew phase active
+        self._deadline = threading.Event()  # tight-budget storm active
         self._hot_refs: list = []  # reference queries on the hot tables
         self._lock = threading.Lock()
         self._acked: list[tuple[str, str, int, float]] = []  # table, tenant, ts, v
@@ -729,11 +781,16 @@ class TenantSim:
         return f"tsim_cpu{j}"
 
     def _sql(self, endpoint: str, query: str, tenant: str = "default",
-             timeout: float = 20.0):
+             timeout: float = 20.0, timeout_ms: Optional[float] = None):
+        headers = {}
+        if tenant != "default":
+            headers["X-HoraeDB-Tenant"] = tenant
+        if timeout_ms is not None:
+            # the per-request time budget (deadline plane, ISSUE 14)
+            headers["X-HoraeDB-Timeout-Ms"] = str(int(timeout_ms))
         return _http(
             "POST", f"http://{endpoint}/sql", {"query": query},
-            timeout=timeout,
-            headers={"X-HoraeDB-Tenant": tenant} if tenant != "default" else {},
+            timeout=timeout, headers=headers,
         )
 
     def _owner(self, table: str) -> str:
@@ -859,7 +916,37 @@ class TenantSim:
             i += 1
             roll = rng.random()
             try:
-                if (
+                if self._deadline.is_set() and roll < cfg.deadline_fraction:
+                    # slow-storm-with-tight-deadlines: the SAME
+                    # expensive scan shape the storm runs, but carrying
+                    # a budget far below what it costs under injected
+                    # store latency — the typed 504 must come back
+                    # within budget + one checkpoint interval, and the
+                    # database's own journal/stats must show it
+                    j = rng.randrange(cfg.tables)
+                    q = (
+                        f"SELECT tenant, count(v) AS c, sum(v) AS s, "
+                        f"min(v) AS mn, max(v) AS mx FROM {self._table(j)} "
+                        "GROUP BY tenant"
+                    )
+                    t_send = time.monotonic()
+                    s, _ = self._sql(
+                        ep, q, tenant="storm", timeout=30,
+                        timeout_ms=cfg.deadline_budget_ms,
+                    )
+                    elapsed = time.monotonic() - t_send
+                    with self._lock:
+                        self.report.deadline_sent += 1
+                        if s == 504:
+                            self.report.deadline_expired += 1
+                            if elapsed > (
+                                cfg.deadline_budget_ms / 1000.0
+                                + cfg.deadline_slack_s
+                            ):
+                                self.report.deadline_overdue += 1
+                    if s != 504:
+                        self._note_status(s, checked=False, ok=True)
+                elif (
                     self._hot.is_set()
                     and self._hot_refs
                     and roll < cfg.hot_fraction
@@ -1066,6 +1153,9 @@ class TenantSim:
         if cfg.hot_phase is not None:
             events += [(cfg.hot_phase[0] * D, "hot_on"),
                        (cfg.hot_phase[1] * D, "hot_off")]
+        if cfg.deadline_phase is not None:
+            events += [(cfg.deadline_phase[0] * D, "deadline_on"),
+                       (cfg.deadline_phase[1] * D, "deadline_off")]
         events.sort()
         for when, what in events:
             delay = t0 + when - time.monotonic()
@@ -1124,6 +1214,28 @@ class TenantSim:
             self._hot.set()
         elif what == "hot_off":
             self._hot.clear()
+        elif what == "deadline_on":
+            self._deadline.set()
+        elif what == "deadline_off":
+            self._deadline.clear()
+            # sample the timed_out evidence NOW: the query_stats ring
+            # (256 rows) rolls over long before end-of-run collection,
+            # but the phase's rows are still in it at phase end
+            try:
+                eps = cl.alive_endpoints()
+                s, out = self._sql(
+                    eps[0],
+                    "SELECT count(timed_out) AS c FROM "
+                    "system.public.query_stats WHERE timed_out = 1 "
+                    f"AND timestamp >= {self._t0_ms}",
+                    timeout=10,
+                )
+                if s == 200 and out.get("rows"):
+                    self.report.deadline_timed_out_rows = int(
+                        out["rows"][0]["c"] or 0
+                    )
+            except Exception:
+                pass
 
     def _resolve_hot_tables(self) -> None:
         """Pick the skew target: the sim tables co-owned by ONE node (the
@@ -1350,6 +1462,47 @@ class TenantSim:
                 elif action == "prewarm":
                     self.report.elastic_prewarms += 1
 
+        # --- deadline plane (ISSUE 14), from the database's own tables:
+        # the journal carries typed query_timeout events, query_stats
+        # carries timed_out rows, and system.public.workload proves the
+        # admission slots drained back to baseline (<= the one cheap
+        # unit THIS reading query holds while it materializes) ---
+        if self.cfg.deadline_phase is not None:
+            s, out = self._sql(
+                ep,
+                "SELECT count(kind) AS c FROM system.public.events WHERE "
+                f"seq > {before} AND kind = 'query_timeout'",
+                timeout=10,
+            )
+            if s == 200 and out.get("rows"):
+                self.report.deadline_timeout_events = int(
+                    out["rows"][0]["c"] or 0
+                )
+            # "slots back at baseline" is a DRAIN gate, not an instant
+            # sample: straggler expensive scans (30s client timeouts)
+            # may still be finishing right after the workers stop —
+            # poll until the summed in-use units fall to <= 1 (the one
+            # cheap unit this reading query holds) or the bound passes,
+            # and record the LAST observed value either way
+            drain_bound = time.monotonic() + 20.0
+            while True:
+                s, out = self._sql(
+                    ep,
+                    "SELECT value FROM system.public.workload "
+                    "WHERE name = 'units_in_use'",
+                    timeout=10,
+                )
+                if s == 200 and out.get("rows"):
+                    self.report.admission_units_after = int(
+                        float(out["rows"][0]["value"] or 0)
+                    )
+                if (
+                    0 <= self.report.admission_units_after <= 1
+                    or time.monotonic() >= drain_bound
+                ):
+                    break
+                time.sleep(0.5)
+
         # --- follower serving (route=follower in query_stats; the ring
         # is process-global in-process, so one node answers for all —
         # informational, the correctness gate is the reference checks) ---
@@ -1441,6 +1594,12 @@ def main(argv=None) -> int:
         help="disable [wlm.batch] cohort batching on the nodes (the "
              "dashboard flood then pays one device dispatch per query)",
     )
+    p.add_argument(
+        "--no-deadline-storm", action="store_true",
+        help="skip the slow-storm-with-tight-deadlines phase (expired "
+             "queries answering the typed 504 within budget, admission "
+             "slots draining back to baseline)",
+    )
     p.add_argument("--json", action="store_true", help="emit the report as JSON")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -1456,6 +1615,9 @@ def main(argv=None) -> int:
         elastic=args.elastic,
         hot_phase=(0.1, 0.45) if args.elastic else None,
         batch=not args.no_batch,
+        deadline_phase=(
+            None if args.no_deadline_storm or args.elastic else (0.2, 0.45)
+        ),
         kill_at=None if args.no_kill else SimConfig.kill_at,
         lease_flap_at=0.72 if args.nodes >= 3 else None,
         shard_move_at=0.8 if args.nodes >= 3 else None,

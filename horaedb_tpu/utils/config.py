@@ -28,6 +28,11 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     admission_deadline = "5s"         # queue wait before shedding
     admission_memory_budget = "1gb"  # working-set budget for admits
     dedup = true                      # single-flight identical reads
+    query_timeout = "60s"             # default per-query time budget
+                                      # (0s = unbounded; header/session
+                                      # knobs override per request)
+    forward_timeout = "30s"           # per-hop cap for forwarded calls
+                                      # (effective = min(cap, remaining))
 
     [wlm.batch]
     enabled = false                   # cohort batching (wlm/batch)
@@ -191,6 +196,15 @@ class LimitsConfig:
     admission_deadline_s: float = 5.0
     admission_memory_budget: int = 1 << 30
     dedup: bool = True
+    # deadline propagation (utils/deadline): the default per-query time
+    # budget when the client sent no X-HoraeDB-Timeout-Ms / session
+    # knob (0 = unbounded); every layer charges it and forwarding hops
+    # ship the REMAINING budget
+    query_timeout_s: float = 60.0
+    # per-hop ceiling for forwarded HTTP calls and remote RPCs — the
+    # effective per-call timeout is min(forward_timeout, remaining
+    # budget) instead of the old fixed 30s constants
+    forward_timeout_s: float = 30.0
 
 
 @dataclass
@@ -399,6 +413,7 @@ _KNOWN = {
     "limits": {
         "slow_threshold", "admission_slots", "admission_queue_depth",
         "admission_deadline", "admission_memory_budget", "dedup",
+        "query_timeout", "forward_timeout",
     },
     "wlm": {"batch"},
     "observability": {
@@ -505,6 +520,18 @@ def _apply(cfg: Config, raw: dict) -> None:
         if not isinstance(l["dedup"], bool):
             raise ConfigError("limits.dedup must be a boolean")
         cfg.limits.dedup = l["dedup"]
+    if "query_timeout" in l:
+        cfg.limits.query_timeout_s = (
+            parse_duration_ms(l["query_timeout"]) / 1000.0
+        )
+        if cfg.limits.query_timeout_s < 0:
+            raise ConfigError("limits.query_timeout must be >= 0 (0 = unbounded)")
+    if "forward_timeout" in l:
+        cfg.limits.forward_timeout_s = (
+            parse_duration_ms(l["forward_timeout"]) / 1000.0
+        )
+        if cfg.limits.forward_timeout_s <= 0:
+            raise ConfigError("limits.forward_timeout must be positive")
     w = raw.get("wlm", {})
     if "batch" in w:
         _apply_batch(cfg.wlm.batch, w["batch"])

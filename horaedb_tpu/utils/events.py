@@ -61,6 +61,8 @@ EVENT_KINDS: dict[str, str] = {
     "elastic_action": "the elastic control loop applied one guarded action (scale_up/scale_down/move/prewarm)",
     "elastic_quarantined": "the elastic circuit breaker quarantined a shard after repeated failed moves",
     "elastic_released": "an operator released a quarantined shard (horaectl elastic release)",
+    "query_timeout": "a query exceeded its time budget and unwound at a checkpoint",
+    "query_cancelled": "a query was cooperatively cancelled (KILL QUERY / ctl / disconnect)",
 }
 
 _EVENTS_FAMILY = "horaedb_events_total"

@@ -302,7 +302,13 @@ class DiskCacheStore(ObjectStore):
                     my_event = threading.Event()
                     self._inflight[name] = my_event
                     break  # we are the leader
-            ev.wait(timeout=60)
+            # follower wait caps at min(op_cap, remaining budget): a
+            # query out of time observes it at the next checkpoint
+            # instead of riding a slow leader fetch to the 60s bound
+            from .deadline import cap_timeout, checkpoint
+
+            ev.wait(timeout=cap_timeout(60))
+            checkpoint("store")
         try:
             # Double-check as leader: our first cache miss may predate a
             # previous leader's write (we raced past its event) — a

@@ -78,6 +78,11 @@ NUMERIC_FIELDS: dict[str, str] = {
     # replicated follower reads (route=follower): how far the serving
     # follower's freshness watermark trailed "now" at serve time
     "replica_lag_ms": "follower watermark lag (ms) on replica-served reads",
+    # deadline propagation / cooperative cancellation (utils/deadline):
+    # the budget the request carried and how it ended
+    "deadline_ms": "time budget (ms) the request carried at ingress (0 = unbounded)",
+    "timed_out": "1 when the query died to its deadline (DeadlineExceeded)",
+    "cancelled": "1 when the query was cooperatively cancelled (KILL/disconnect)",
 }
 
 # wall-time costs; seconds, float.

@@ -280,15 +280,55 @@ class AdmissionController:
         return OverloadedError(msg, reason=reason, retry_after_s=1.0)
 
     @contextmanager
-    def admit(self, cls: str, deadline_s: Optional[float] = None):
+    def admit(self, cls: str, deadline_s: Optional[float] = None,
+              est_cost_s: Optional[float] = None):
         """Block until a slot frees (bounded queue + deadline), then run
         the body holding the slot. Records the queue wait into the
-        current query ledger (``admission_wait_seconds``)."""
+        current query ledger (``admission_wait_seconds``).
+
+        The request's time budget (utils/deadline) is CHARGED here:
+        queue wait never outlives the remaining budget, a budget that
+        cannot fit the shape's expected cost (``est_cost_s``, the
+        classifier's EWMA estimate) sheds immediately instead of
+        queueing doomed work, and a KILL observed while queued unwinds
+        without ever taking the slot. The slot-release invariant holds
+        by construction: the slot is only held inside this context
+        manager's try/finally, so a typed deadline/cancel raise from
+        the body always releases it."""
         if cls not in WEIGHTS:
             cls = "normal"
         units = WEIGHTS[cls]
         mem = MEM_ESTIMATES[cls]
         deadline_s = self.deadline_s if deadline_s is None else deadline_s
+        from ..utils.deadline import current_deadline
+
+        budget = current_deadline()
+        if budget is not None:
+            budget.check("queued")
+            rem = budget.remaining_s()
+            if rem is not None:
+                if est_cost_s is not None and rem < est_cost_s:
+                    # the remaining budget cannot fit the expected cost:
+                    # shed NOW — queueing (and then executing most of)
+                    # work that is provably going to time out only
+                    # burns the slot another query could use
+                    from ..utils.deadline import DeadlineExceeded
+                    from ..utils.events import record_event
+
+                    self._shed_counter(cls, "deadline_budget").inc()
+                    record_event(
+                        "admission_shed",
+                        **{"class": cls, "reason": "deadline_budget"},
+                    )
+                    raise DeadlineExceeded(
+                        f"remaining budget {rem * 1000:.0f}ms cannot fit "
+                        f"the expected {est_cost_s * 1000:.0f}ms cost of "
+                        f"this {cls} query",
+                        stage="queued",
+                        budget_ms=budget.budget_ms,
+                    )
+                deadline_s = min(deadline_s, rem)
+            budget.state = "queued"
         t0 = time.perf_counter()
         deadline = t0 + deadline_s
         with self._cv:
@@ -304,17 +344,29 @@ class AdmissionController:
                     while not self._fits_locked(cls, units, mem):
                         remaining = deadline - time.perf_counter()
                         if remaining <= 0:
+                            if budget is not None:
+                                # the BUDGET ran out first: the typed
+                                # 504, not a generic overload shed
+                                budget.check("queued")
                             raise self._shed(
                                 cls, "deadline",
-                                f"no admission slot for class {cls!r} query "
-                                f"within {deadline_s:.1f}s; retry later",
+                                f"no admission slot for class {cls!r} "
+                                f"query within {deadline_s:.1f}s; "
+                                "retry later",
                             )
-                        self._cv.wait(remaining)
+                        # sliced waits: a KILL while queued unwinds
+                        # within a checkpoint interval, not at the
+                        # admission deadline
+                        self._cv.wait(min(remaining, 0.25))
+                        if budget is not None:
+                            budget.check("queued")
                 finally:
                     self._waiting[cls] -= 1
             self._units_in_use += units
             self._mem_in_use += mem
             self._class_units[cls] += units
+        if budget is not None:
+            budget.state = "executing"
         waited = time.perf_counter() - t0
         self._wait_hist.observe(waited)
         self._admitted[cls].inc()

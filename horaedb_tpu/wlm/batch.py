@@ -91,6 +91,32 @@ def _register_families() -> None:
 _register_families()
 
 
+def _member_error(err: BaseException) -> BaseException:
+    """What non-leader members see for a wholesale cohort failure. A
+    leader cancelled (KILL/disconnect) or dead to ITS deadline is a
+    leader-personal ending — members who never cancelled and carry
+    their own budgets get a typed retryable overload instead (a retry
+    forms or joins a fresh cohort)."""
+    from ..utils.deadline import DeadlineExceeded, QueryCancelled
+    from .admission import OverloadedError
+
+    if isinstance(err, QueryCancelled):
+        return OverloadedError(
+            "the cohort leader serving this read was cancelled; retry "
+            "forms a fresh cohort",
+            reason="batch_leader_cancelled",
+            retry_after_s=0.1,
+        )
+    if isinstance(err, DeadlineExceeded):
+        return OverloadedError(
+            "the cohort leader serving this read exceeded ITS time "
+            "budget; retry forms a fresh cohort",
+            reason="batch_leader_timeout",
+            retry_after_s=0.1,
+        )
+    return err
+
+
 def batch_plan_key(plan) -> tuple:
     """Normalized plan-shape key for cohort grouping: the path router's
     literal-masked shape with LIMIT/OFFSET additionally masked (mixed
@@ -291,9 +317,13 @@ class CohortBatcher:
             outcomes = cohort_exec([(m.sql, m.plan) for m in members])
         except BaseException as e:
             # wholesale failure (admission shed, runtime teardown):
-            # every member sees the same error
+            # every member sees the same error — EXCEPT a leader-
+            # personal ending (its KILL, its deadline), which other
+            # members must not inherit: they get the typed retryable
+            # overload instead (same contract as dedup followers)
+            member_err = _member_error(e)
             for m in members:
-                m.error = e
+                m.error = e if m is members[0] else member_err
                 m.event.set()
             raise
         for m, out in zip(members, outcomes):
@@ -316,21 +346,36 @@ class CohortBatcher:
             self.deduper.note_coalesced()
             record(dedup_follower=1)
         # the leader always resolves every member in its finally; the
-        # long timeout is a defensive bound, not a protocol step
-        if not member.event.wait(300):
-            from .admission import OverloadedError
+        # long timeout is a defensive bound, not a protocol step.
+        # Sliced waits: a member observes ITS OWN deadline/cancel flag
+        # while the cohort gathers/dispatches — a cancelled or expired
+        # member demuxes out with its typed error and the cohort
+        # SURVIVES (the leader still resolves every other slot; this
+        # member's result is simply never consumed).
+        from ..utils.deadline import current_deadline
 
-            raise OverloadedError(
-                "cohort leader did not complete within 300s; retry",
-                reason="batch_timeout",
-                retry_after_s=1.0,
-            )
+        budget = current_deadline()
+        bound = time.monotonic() + 300
+        while not member.event.wait(0.25):
+            if budget is not None:
+                budget.check("executing")
+            if time.monotonic() >= bound:
+                from .admission import OverloadedError
+
+                raise OverloadedError(
+                    "cohort leader did not complete within 300s; retry",
+                    reason="batch_timeout",
+                    retry_after_s=1.0,
+                )
         waited = max(0.0, (cohort.closed_at or time.perf_counter()) - t_join)
         self._m_wait.observe(waited)
         if len(cohort.members) > 1:
             record(batch_member=1, batch_cohort=len(cohort.members))
         if member.error is not None:
-            raise member.error
+            # joiners (members and identical twins) never surface the
+            # LEADER's personal ending (its kill, its deadline) — the
+            # converter passes every other error through untouched
+            raise _member_error(member.error)
         return member.result
 
     def snapshot(self) -> dict:

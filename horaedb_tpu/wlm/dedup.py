@@ -94,17 +94,30 @@ class ReadDeduper:
             # the leader always resolves the flight in its finally; the
             # long timeout is a defensive bound, not a protocol step —
             # but if it ever fires, answer with a typed retryable error
-            # instead of handing back a None "result"
-            if not flight.event.wait(300):
-                from .admission import OverloadedError
+            # instead of handing back a None "result". Sliced waits:
+            # the follower observes ITS OWN deadline/cancel flag
+            # (utils/deadline) while the leader runs — a tight-budget
+            # follower unwinds with its typed error instead of riding
+            # a slower leader past its deadline.
+            import time as _time
 
-                raise OverloadedError(
-                    "in-flight twin did not complete within 300s; retry",
-                    reason="dedup_timeout",
-                    retry_after_s=1.0,
-                )
+            from ..utils.deadline import current_deadline
+
+            budget = current_deadline()
+            bound = _time.monotonic() + 300
+            while not flight.event.wait(0.25):
+                if budget is not None:
+                    budget.check("executing")
+                if _time.monotonic() >= bound:
+                    from .admission import OverloadedError
+
+                    raise OverloadedError(
+                        "in-flight twin did not complete within 300s; retry",
+                        reason="dedup_timeout",
+                        retry_after_s=1.0,
+                    )
             if flight.error is not None:
-                raise flight.error
+                raise self._follower_error(flight.error)
             return flight.result
         followers = 0
         try:
@@ -123,6 +136,36 @@ class ReadDeduper:
             if followers:
                 self._m_role["leader"].inc()
                 record(dedup_followers=followers)
+
+    @staticmethod
+    def _follower_error(err: BaseException) -> BaseException:
+        """The error a follower should surface for a leader-side
+        failure. A leader that was CANCELLED (KILL/disconnect) or died
+        to ITS deadline must not leak that personal ending to followers
+        who never cancelled and carry their own budgets — they get a
+        typed, retryable overload instead (a retry starts a fresh
+        flight)."""
+        from ..utils.deadline import DeadlineExceeded, QueryCancelled
+
+        if isinstance(err, QueryCancelled):
+            from .admission import OverloadedError
+
+            return OverloadedError(
+                "the in-flight leader serving this read was cancelled; "
+                "retry starts a fresh execution",
+                reason="dedup_leader_cancelled",
+                retry_after_s=0.1,
+            )
+        if isinstance(err, DeadlineExceeded):
+            from .admission import OverloadedError
+
+            return OverloadedError(
+                "the in-flight leader serving this read exceeded ITS "
+                "time budget; retry starts a fresh execution",
+                reason="dedup_leader_timeout",
+                retry_after_s=0.1,
+            )
+        return err
 
     def note_coalesced(self, n: int = 1) -> None:
         """An upstream single-flight layer (the gateway's asyncio dedup)

@@ -1106,6 +1106,102 @@ class TestMetricsNameLint:
         assert REGISTRY.histogram("horaedb_wal_append_duration_seconds").count > 0
 
 
+class TestDeadlineRegistryLint:
+    """ISSUE-14 lint extension (same contract as the admission/raw
+    registries): every family declared in
+    utils/deadline.DEADLINE_METRIC_FAMILIES / CANCEL_METRIC_FAMILIES
+    must be (a) registered live (stage/source labels eagerly present),
+    (b) convention-clean, (c) documented in docs/OBSERVABILITY.md — and
+    no stray horaedb_query_deadline_* / horaedb_query_cancel* family
+    may exist outside the declared registries. The deadline knobs and
+    the KILL surface are operator surface: pinned to docs/WORKLOAD.md.
+    (The deadline_ms/timed_out/cancelled ledger fields ride the PR-2
+    lint automatically: column + family + docs mention.)"""
+
+    def test_deadline_families_declared_and_documented(self):
+        import os
+        import re
+
+        from horaedb_tpu.utils.deadline import (
+            CANCEL_METRIC_FAMILIES,
+            CANCEL_SOURCES,
+            DEADLINE_METRIC_FAMILIES,
+            DEADLINE_STAGES,
+        )
+        from horaedb_tpu.utils.metrics import REGISTRY
+        import horaedb_tpu.utils.querystats  # noqa: F401  (ledger families)
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        exposed = REGISTRY.expose()
+        missing = []
+        declared = {**DEADLINE_METRIC_FAMILIES, **CANCEL_METRIC_FAMILIES}
+        for fam in declared:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/OBSERVABILITY.md")
+        for stage in DEADLINE_STAGES:
+            if f'stage="{stage}"' not in exposed:
+                missing.append(f"label stage={stage}: not eagerly registered")
+        for src in CANCEL_SOURCES:
+            if f'source="{src}"' not in exposed:
+                missing.append(f"label source={src}: not eagerly registered")
+        for fam in families:
+            if (
+                fam.startswith("horaedb_query_deadline_")
+                or fam.startswith("horaedb_query_cancel")
+            ) and fam not in declared:
+                missing.append(f"{fam}: live but undeclared in registry")
+        # operator surface: the knobs, the header, the session knobs,
+        # and the kill verbs are pinned to the workload doc
+        for knob in (
+            "query_timeout", "forward_timeout", "X-HoraeDB-Timeout-Ms",
+            "max_execution_time", "statement_timeout", "KILL QUERY",
+            "DELETE /debug/queries/{id}",
+        ):
+            if knob not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        # the system.public.queries schema is documented
+        if "system.public.queries" not in docs:
+            missing.append("system.public.queries: undocumented")
+        assert not missing, missing
+
+    def test_queries_table_registered_and_roundtrips(self):
+        """system.public.queries serves the live registry: a registered
+        entry appears as a row (with its budget) and vanishes on
+        deregister."""
+        from horaedb_tpu.table_engine.system import QueriesTable
+        from horaedb_tpu.utils.deadline import QUERY_REGISTRY, Deadline
+
+        d = Deadline(5000)
+        entry = QUERY_REGISTRY.register(7, "SELECT lint", "tlint", d)
+        try:
+            rg = QueriesTable()._materialize()
+            rows = {
+                int(q): (s, t) for q, s, t in zip(
+                    rg.columns["query_id"], rg.columns["sql"],
+                    rg.columns["tenant"],
+                )
+            }
+            assert entry.query_id in rows
+            assert rows[entry.query_id] == ("SELECT lint", "tlint")
+            got = rg.columns["deadline_ms"][
+                list(rows).index(entry.query_id)
+            ]
+            assert int(got) == 5000
+        finally:
+            QUERY_REGISTRY.deregister(entry)
+        rg = QueriesTable()._materialize()
+        assert entry.query_id not in {int(q) for q in rg.columns["query_id"]}
+
+
 class TestEventKindLint:
     """PR-5 lint extension (same contract as the family registries):
     every event kind declared in utils/events.EVENT_KINDS must (a) have

@@ -44,6 +44,12 @@ def _smoke_config() -> SimConfig:
         storm_window=(0.15, 0.45),
         latency_burst=(0.2, 0.4),
         error_burst=(0.25, 0.5),
+        # slow-storm-with-tight-deadlines (ISSUE 14): overlaps the
+        # store-latency burst so the expensive scans genuinely cannot
+        # fit the budget — the typed 504s, the journal evidence, and
+        # the admission-slot drain are all gated in violations()
+        deadline_phase=(0.2, 0.45),
+        deadline_budget_ms=150.0,
         kill_at=0.65,
         lease_flap_at=None,
         shard_move_at=None,
@@ -70,6 +76,15 @@ class TestTenantSimSmoke:
         # the SLO table carried every declared objective
         names = {r["objective"] for r in report.slo_rows}
         assert {"cheap_p99", "store_faults", "shed_ratio"} <= names, detail
+        # the deadline storm ran and the gates (typed 504 within
+        # budget + slack, journal evidence, slot drain, cheap p99
+        # flat) all held — violations() already enforced them; pin
+        # the concrete expectations here too
+        assert report.deadline_sent > 0, detail
+        assert report.deadline_expired >= 1, detail
+        assert report.deadline_overdue == 0, detail
+        assert report.deadline_timeout_events >= 1, detail
+        assert report.admission_units_after in (0, 1), detail
 
 
 def _elastic_config() -> SimConfig:
