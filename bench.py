@@ -31,6 +31,11 @@ target list:
                         batching ([wlm.batch]) vs per-query dispatch;
                         gates on dispatches-per-query reduction (>=4x
                         once cohorts reach 8), emits p50/p99 both arms
+    devicetel           device-telemetry overhead gate: the groupby and
+                        rawscan serving shapes with the device plane ON
+                        (default 1-in-8 sampled block_until_ready
+                        timing) vs HORAEDB_DEVICE_TELEMETRY=0,
+                        interleaved min-of-N; gate: overhead <= 2%
     rollup              continuous-query A/B: dashboard range aggregate
                         (time_bucket 5m x host x avg) served from the
                         maintained 1m rollup (route=rollup) vs the same
@@ -636,6 +641,82 @@ def run_selfscrape_config() -> dict:
         "scrape_rounds": rounds,
         "scrape_interval_s": SELFSCRAPE_INTERVAL_S,
         "platform": "host",
+    }
+
+
+# ---- devicetel config (device telemetry overhead gate) ----------------
+#
+# ISSUE-15 acceptance: the device telemetry plane ON (default sampling)
+# must stay within 2% of telemetry-off on the groupby- and rawscan-shaped
+# serving paths. Interleaved min-of-N pairs on one process (flip
+# HORAEDB_DEVICE_TELEMETRY between arms — every knob is read per
+# dispatch), so host noise cancels and the jit caches are shared.
+DEVICETEL_REPEATS = int(os.environ.get("BENCH_DEVICETEL_REPEATS", "7"))
+DEVICETEL_RUNS_PER_ARM = int(os.environ.get("BENCH_DEVICETEL_RUNS", "3"))
+
+
+def run_devicetel_config() -> dict:
+    import jax
+
+    platform = jax.devices()[0].platform
+    db, agg_sql, n_rows, _ = build_readme()
+    raw_sql = (
+        "SELECT name, value, t FROM demo WHERE value > 16.0 "
+        "ORDER BY t DESC LIMIT 100"
+    )
+    queries = {"groupby": agg_sql, "rawscan": raw_sql}
+
+    def run_arm(sql: str) -> float:
+        best = np.inf
+        for _ in range(DEVICETEL_RUNS_PER_ARM):
+            s = time.perf_counter()
+            db.execute(sql)
+            best = min(best, time.perf_counter() - s)
+        return best
+
+    prior = os.environ.get("HORAEDB_DEVICE_TELEMETRY")
+    try:
+        # warm both shapes fully (scan-cache candidate -> build -> hit,
+        # jit compiles) with telemetry ON so neither arm pays one-offs
+        os.environ["HORAEDB_DEVICE_TELEMETRY"] = "1"
+        for sql in queries.values():
+            for _ in range(4):
+                db.execute(sql)
+        off = {k: np.inf for k in queries}
+        on = {k: np.inf for k in queries}
+        for _ in range(DEVICETEL_REPEATS):
+            os.environ["HORAEDB_DEVICE_TELEMETRY"] = "0"
+            for k, sql in queries.items():
+                off[k] = min(off[k], run_arm(sql))
+            os.environ["HORAEDB_DEVICE_TELEMETRY"] = "1"
+            for k, sql in queries.items():
+                on[k] = min(on[k], run_arm(sql))
+    finally:
+        # restore the caller's setting, not the default (an operator
+        # running the whole config list with telemetry pinned off must
+        # not have later configs silently measured with it back on)
+        if prior is None:
+            os.environ.pop("HORAEDB_DEVICE_TELEMETRY", None)
+        else:
+            os.environ["HORAEDB_DEVICE_TELEMETRY"] = prior
+        db.close()
+    overhead = {
+        k: max(0.0, (on[k] - off[k]) / off[k] * 100.0) for k in queries
+    }
+    worst = max(overhead, key=overhead.get)
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+    return {
+        "metric": f"devicetel_overhead_pct{suffix}",
+        "value": round(overhead[worst], 2),
+        "unit": "%",
+        "vs_baseline": round(
+            min(off[k] / on[k] for k in queries), 3
+        ),
+        "within_2pct": all(v <= 2.0 for v in overhead.values()),
+        "overhead_pct": {k: round(v, 2) for k, v in overhead.items()},
+        "on_ms": {k: round(on[k] * 1000, 3) for k in queries},
+        "off_ms": {k: round(off[k] * 1000, 3) for k in queries},
+        "platform": platform,
     }
 
 
@@ -1500,7 +1581,7 @@ def _emit(obj: dict) -> None:
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
     "compaction-64", "ingest", "groupby", "rawscan", "rollup", "flood",
-    "tsbs-5-8-1",
+    "devicetel", "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
 # builds the table twice for the device/host A-B and genuinely needs
@@ -2044,6 +2125,8 @@ def run_config(config: str) -> dict:
         return run_ingest_config()
     if config == "selfscrape":
         return run_selfscrape_config()
+    if config == "devicetel":
+        return run_devicetel_config()
     if config == "groupby":
         return run_groupby_config()
     if config == "rawscan":

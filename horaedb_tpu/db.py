@@ -100,6 +100,17 @@ class Connection:
             self.instance.flush_table(t)
 
     def close(self) -> None:
+        # A closed database's scan cache must stop contributing to the
+        # process-wide device-residency inventory NOW, not whenever GC
+        # collects it (system.public.device merges live sources only).
+        try:
+            from .obs.device import unregister_occupancy_provider
+
+            unregister_occupancy_provider(
+                self.interpreters.executor.scan_cache
+            )
+        except Exception:
+            pass
         # Catalog close flushes every table, and those flushes may
         # REQUEST compactions — so the scheduler drain must come after,
         # or a close-time flush would resurrect a scheduler whose merge

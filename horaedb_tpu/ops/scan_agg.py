@@ -655,13 +655,16 @@ def scan_aggregate(
 
         return host_scan_aggregate(batch, spec, filter_literals)
 
-    t0 = _time.perf_counter()
-    counts, sums, mins, maxs = _fused_scan_agg(
+    from ..obs.device import cost_analysis, timed_dispatch
+
+    args = (
         jnp.asarray(batch.group_codes),
         jnp.asarray(batch.bucket_ids),
         jnp.asarray(batch.mask),
         jnp.asarray(batch.values),
         coerce_literals(filter_literals),
+    )
+    kwargs = dict(
         n_groups=spec.n_groups,
         n_buckets=spec.n_buckets,
         n_agg_fields=spec.n_agg_fields,
@@ -670,15 +673,23 @@ def scan_aggregate(
         segment_impl=impl,
         hash_slots=spec.hash_slots,
     )
+    t0 = _time.perf_counter()
+    counts, sums, mins, maxs = timed_dispatch(
+        "fused", lambda: _fused_scan_agg(*args, **kwargs)
+    )
     state = state_to_host(counts, sums, mins, maxs)
     # Per-query compile accounting: a never-seen static shape's first
     # dispatch pays the XLA compile — its wall time is the honest cost a
-    # latency cliff needs attributed (ledger jit_* fields).
+    # latency cliff needs attributed (ledger jit_* fields + the device
+    # plane's kernel_compile event; cost_fn adds XLA cost_analysis
+    # flops/bytes under HORAEDB_DEVICE_COST_ANALYSIS=1).
     note_kernel_dispatch(
         ("fused", batch.values.shape, spec.n_groups, spec.n_buckets,
          spec.n_agg_fields, spec.numeric_filters, spec.need_minmax,
          impl, spec.hash_slots),
         _time.perf_counter() - t0,
+        kind="fused",
+        cost_fn=lambda: cost_analysis(_fused_scan_agg, args, kwargs),
     )
     return state
 

@@ -208,11 +208,32 @@ def dist_scan_aggregate(
         mask = np.pad(mask, (0, extra))  # False fill
         values = np.pad(values, ((0, 0), (0, extra)))
     step = make_dist_scan_agg(mesh, spec)
-    counts, sums, mins, maxs = step(
-        jnp.asarray(group_codes),
-        jnp.asarray(bucket_ids),
-        jnp.asarray(mask),
-        jnp.asarray(values),
-        coerce_literals(filter_literals),
+    import time as _time
+
+    from ..obs.device import timed_dispatch
+    from ..utils.querystats import note_kernel_dispatch
+
+    t0 = _time.perf_counter()
+    counts, sums, mins, maxs = timed_dispatch(
+        "fused_dist",
+        lambda: step(
+            jnp.asarray(group_codes),
+            jnp.asarray(bucket_ids),
+            jnp.asarray(mask),
+            jnp.asarray(values),
+            coerce_literals(filter_literals),
+        ),
     )
-    return state_to_host(counts, sums, mins, maxs)
+    state = state_to_host(counts, sums, mins, maxs)
+    # Compile accounting for the sharded fused path — a first-sighting
+    # shard_map compile is a MULTI-SECOND stall on real chips and must
+    # journal/mark compile_hit like every other dispatch point (the
+    # single-device path accounts inside scan_aggregate; this wrapper is
+    # the dist equivalent). ``spec`` is the same static key that keys
+    # the step cache; ``values.shape`` carries the padded batch bucket.
+    note_kernel_dispatch(
+        ("fused-dist", int(n_dev), values.shape, spec),
+        _time.perf_counter() - t0,
+        kind="fused_dist",
+    )
+    return state

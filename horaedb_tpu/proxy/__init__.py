@@ -194,6 +194,23 @@ class Proxy:
         )
         self._m_class_latency = _M_CLASS_LATENCY
 
+    @property
+    def slow_threshold_s(self) -> float:
+        return self._slow_threshold_s
+
+    @slow_threshold_s.setter
+    def slow_threshold_s(self, seconds: float) -> None:
+        """The live slow-log threshold also drives the device plane's
+        always-time rule (obs/device): a query about to be slow-logged
+        must carry a measured device_ms whatever threshold the operator
+        dialed in at PUT /debug/slow_threshold — a sampled-out dispatch
+        would render the misleading ``device_ms=0`` this field exists
+        to prevent."""
+        self._slow_threshold_s = seconds
+        from ..obs.device import set_slow_candidate_s
+
+        set_slow_candidate_s(seconds)
+
     def close(self) -> None:
         self.runtime.shutdown()
         self.wlm.close()
@@ -410,9 +427,16 @@ class Proxy:
             finish_trace(handle, slow=slow)
             finish_ledger(ledger, ltoken, elapsed)
             if slow:
+                # device-plane facts at a glance: a compile-stall query
+                # (compile_hit>0, device_ms small) reads differently
+                # from a slow scan without opening the full ledger
+                device_ms = round(ledger.counts.get("device_ms", 0.0), 3)
+                compile_hit = int(ledger.counts.get("compile_hit", 0))
                 logger.warning(
-                    "slow query (request %d, %.3fs): %s",
-                    ctx.request_id, elapsed, sql[:500],
+                    "slow query (request %d, %.3fs, device_ms=%s"
+                    " compile_hit=%d): %s",
+                    ctx.request_id, elapsed, device_ms, compile_hit,
+                    sql[:500],
                 )
                 self.slow_queries.append(
                     {
@@ -420,6 +444,8 @@ class Proxy:
                         "elapsed_s": round(elapsed, 4),
                         "sql": sql[:500],
                         "at": time.time(),
+                        "device_ms": device_ms,
+                        "compile_hit": compile_hit,
                         # the request's whole span tree rides with the
                         # slow-log entry (ref: SlowTimer + trace_metric)
                         "trace": trace.to_dict(),

@@ -1296,24 +1296,30 @@ class Executor:
             # serving path; single-device deployments take the packed arm).
             from ..parallel.dist_agg import make_cached_dist_scan_agg
 
+            from ..obs.device import timed_dispatch
+
             step = make_cached_dist_scan_agg(entry.mesh, spec)
-            out = step(
-                entry.series_codes_dev,
-                entry.ts_rel_dev,
-                values_dev,
-                jnp.asarray(gos),
-                jnp.asarray(allow_scan),
-                coerce_literals(literals),
-                np.int32(lo_rel),
-                np.int32(hi_rel),
-                np.int32(t0_rel),
-                np.int32(width_i),
+            out = timed_dispatch(
+                "cached_dist",
+                lambda: step(
+                    entry.series_codes_dev,
+                    entry.ts_rel_dev,
+                    values_dev,
+                    jnp.asarray(gos),
+                    jnp.asarray(allow_scan),
+                    coerce_literals(literals),
+                    np.int32(lo_rel),
+                    np.int32(hi_rel),
+                    np.int32(t0_rel),
+                    np.int32(width_i),
+                ),
             )
             m["mesh_devices"] = int(entry.mesh.devices.size)
             state = state_to_host(*out)
             querystats.note_kernel_dispatch(
                 ("cached-dist", int(entry.mesh.devices.size), *kernel_key),
                 _time.perf_counter() - t_kernel,
+                kind="cached_dist",
             )
         else:
             # Single-device serving: the RTT-minimized packed path — one
@@ -1325,14 +1331,18 @@ class Executor:
                 unpack_packed_state,
             )
 
+            from ..obs.device import cost_analysis, timed_dispatch
+
             session_dev = entry.session_for(gos, allow_scan)
             dyn = pack_dyn(literals, lo_rel, hi_rel, t0_rel, width_i, row_idx)
-            packed = cached_scan_agg_packed(
+            pargs = (
                 entry.series_codes_dev,
                 entry.ts_rel_dev,
                 values_dev,
                 session_dev,
                 jnp.asarray(dyn),
+            )
+            pkwargs = dict(
                 n_groups=spec.n_groups,
                 n_buckets=spec.n_buckets,
                 n_agg_fields=spec.n_agg_fields,
@@ -1342,10 +1352,18 @@ class Executor:
                 hash_slots=spec.hash_slots,
                 selective=row_idx is not None,
             )
+            packed = timed_dispatch(
+                "cached_packed",
+                lambda: cached_scan_agg_packed(*pargs, **pkwargs),
+            )
             state = unpack_packed_state(packed, spec)
             querystats.note_kernel_dispatch(
                 ("cached-packed", row_idx is not None, *kernel_key),
                 _time.perf_counter() - t_kernel,
+                kind="cached_packed",
+                cost_fn=lambda: cost_analysis(
+                    cached_scan_agg_packed, pargs, pkwargs
+                ),
             )
         self._finish_kernel(
             prep.krec, spec, m, state, _time.perf_counter() - t_kernel
@@ -1408,25 +1426,31 @@ class Executor:
             )
             dyns = np.concatenate([dyns, np.repeat(dyns[-1:], Bp - B, axis=0)])
         values_dev = entry.values_for(p0.value_names)
+        from ..obs.device import timed_dispatch
+
         t_kernel = _time.perf_counter()
-        packed = cached_scan_agg_cohort(
-            entry.series_codes_dev,
-            entry.ts_rel_dev,
-            values_dev,
-            jnp.asarray(sessions),
-            jnp.asarray(dyns),
-            n_groups=spec.n_groups,
-            n_buckets=spec.n_buckets,
-            n_agg_fields=spec.n_agg_fields,
-            numeric_filters=encode_filter_ops(spec.numeric_filters),
-            need_minmax=spec.need_minmax,
-            segment_impl=spec.segment_impl,
-            hash_slots=spec.hash_slots,
+        packed = timed_dispatch(
+            "cached_cohort",
+            lambda: cached_scan_agg_cohort(
+                entry.series_codes_dev,
+                entry.ts_rel_dev,
+                values_dev,
+                jnp.asarray(sessions),
+                jnp.asarray(dyns),
+                n_groups=spec.n_groups,
+                n_buckets=spec.n_buckets,
+                n_agg_fields=spec.n_agg_fields,
+                numeric_filters=encode_filter_ops(spec.numeric_filters),
+                need_minmax=spec.need_minmax,
+                segment_impl=spec.segment_impl,
+                hash_slots=spec.hash_slots,
+            ),
         )
         rows = np.asarray(jax.device_get(packed))
         elapsed = _time.perf_counter() - t_kernel
         querystats.note_kernel_dispatch(
-            ("cached-cohort", Bp, *p0.kernel_key), elapsed
+            ("cached-cohort", Bp, *p0.kernel_key), elapsed,
+            kind="cached_cohort",
         )
         outs: list = []
         for j, p in enumerate(preps):
@@ -1911,22 +1935,32 @@ class Executor:
                 key_lo, key_hi = topk_key_bounds(
                     spec.descending, spec.key_is_ts, lo_rel, hi_rel
                 )
+            from ..obs.device import timed_dispatch
+
             if entry.mesh is not None:
                 from ..parallel.dist_raw import dist_raw_select, dist_raw_topk
 
                 m["mesh_devices"] = n_dev
                 if kind == "topk":
-                    idx = dist_raw_topk(
-                        entry.mesh, spec, entry.series_codes_dev,
-                        entry.ts_rel_dev, values_dev,
-                        jnp.asarray(allow_arr), literals, lo_rel, hi_rel,
-                        key_lo, key_hi, need=limit + offset,
+                    dkind = "raw_topk_dist"
+                    idx = timed_dispatch(
+                        dkind,
+                        lambda: dist_raw_topk(
+                            entry.mesh, spec, entry.series_codes_dev,
+                            entry.ts_rel_dev, values_dev,
+                            jnp.asarray(allow_arr), literals, lo_rel, hi_rel,
+                            key_lo, key_hi, need=limit + offset,
+                        ),
                     )
                 else:
-                    idx, total = dist_raw_select(
-                        entry.mesh, spec, entry.series_codes_dev,
-                        entry.ts_rel_dev, values_dev,
-                        jnp.asarray(allow_arr), literals, lo_rel, hi_rel,
+                    dkind = "raw_select_dist"
+                    idx, total = timed_dispatch(
+                        dkind,
+                        lambda: dist_raw_select(
+                            entry.mesh, spec, entry.series_codes_dev,
+                            entry.ts_rel_dev, values_dev,
+                            jnp.asarray(allow_arr), literals, lo_rel, hi_rel,
+                        ),
                     )
                     if total > len(idx):
                         self._raw_bail(m)
@@ -1937,21 +1971,30 @@ class Executor:
                     pack_raw_dyn(literals, lo_rel, hi_rel, key_lo, key_hi)
                 )
                 if kind == "topk":
-                    packed = raw_topk_packed(
-                        entry.series_codes_dev, entry.ts_rel_dev,
-                        values_dev, session_dev, dyn,
-                        k=spec.k, descending=spec.descending,
-                        key_is_ts=spec.key_is_ts, key_field=spec.key_field,
-                        numeric_filters=encode_filter_ops(nfilters),
+                    dkind = "raw_topk"
+                    packed = timed_dispatch(
+                        dkind,
+                        lambda: raw_topk_packed(
+                            entry.series_codes_dev, entry.ts_rel_dev,
+                            values_dev, session_dev, dyn,
+                            k=spec.k, descending=spec.descending,
+                            key_is_ts=spec.key_is_ts,
+                            key_field=spec.key_field,
+                            numeric_filters=encode_filter_ops(nfilters),
+                        ),
                     )
                     got = np.asarray(jax.device_get(packed))
                     idx = got[got >= 0]
                 else:
-                    packed = raw_select_packed(
-                        entry.series_codes_dev, entry.ts_rel_dev,
-                        values_dev, session_dev, dyn,
-                        select_slots=spec.select_slots,
-                        numeric_filters=encode_filter_ops(nfilters),
+                    dkind = "raw_select"
+                    packed = timed_dispatch(
+                        dkind,
+                        lambda: raw_select_packed(
+                            entry.series_codes_dev, entry.ts_rel_dev,
+                            values_dev, session_dev, dyn,
+                            select_slots=spec.select_slots,
+                            numeric_filters=encode_filter_ops(nfilters),
+                        ),
                     )
                     got = np.asarray(jax.device_get(packed))
                     total = int(got[0])
@@ -1960,7 +2003,7 @@ class Executor:
                         return None
                     idx = got[1 : 1 + total]
             querystats.note_kernel_dispatch(
-                kernel_key, _time.perf_counter() - t_kernel
+                kernel_key, _time.perf_counter() - t_kernel, kind=dkind
             )
 
         base = (

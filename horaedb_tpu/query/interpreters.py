@@ -384,6 +384,27 @@ class InterpreterFactory:
                 if detail:
                     lines.append(f"  Metrics: {detail}")
                 lines.append(f"  Ledger: {render_ledger(qledger)}")
+                # Device plane: EXPLAIN ANALYZE dispatches are always
+                # timed (obs/device forces sampling for explain runs),
+                # so device_ms is present whenever a kernel ran; compile
+                # events this run journaled render inline.
+                dd = int(qledger.counts.get("device_dispatches", 0))
+                if dd:
+                    lines.append(
+                        f"  Device: dispatches={dd} "
+                        f"device_ms={qledger.counts.get('device_ms', 0.0):.3f} "
+                        f"compile_hit={int(qledger.counts.get('compile_hit', 0))}"
+                    )
+                    from ..utils.events import EVENT_STORE
+
+                    for ev in EVENT_STORE.list(kind="kernel_compile"):
+                        if ev.get("trace_id") == trace.trace_id:
+                            a = ev.get("attrs", {})
+                            lines.append(
+                                f"  Compile: kernel={a.get('kernel')} "
+                                f"wall_ms={a.get('wall_ms')} "
+                                f"shape={a.get('shape')}"
+                            )
                 if handle is not None:
                     trace.root.finish()  # owned: closed before rendering
                 tree = trace.to_dict()["root"]

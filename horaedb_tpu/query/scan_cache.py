@@ -29,6 +29,7 @@ whose data span fits int32 relative milliseconds (~24 days).
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -120,6 +121,10 @@ class CachedTableScan:
     # resident-size accounting for the cache's byte budget
     device_bytes: int = 0
     host_bytes: int = 0
+    # last serve time (hit or build) — the device telemetry plane's
+    # "last-hit age" column; the usage recency the future livewindow
+    # eviction policy (ROADMAP item 2) reads
+    last_hit_at: float = 0.0
     # Serializes _extend against itself for THIS entry only: two hit-path
     # queries needing a missing value column must not both upload it and
     # double-count device_bytes. Per-entry, so unrelated tables' extends
@@ -261,10 +266,104 @@ class ScanCache:
         )
         self.hits = 0
         self.misses = 0
+        # per-table budget-eviction counts (survive the entry — the
+        # device telemetry plane reports them; bounded LRU-style)
+        self._evictions: dict[str, int] = {}
+        # the cache IS the HBM residency source: the device telemetry
+        # plane walks registered caches for system.public.device
+        from ..obs.device import register_occupancy_provider
+
+        register_occupancy_provider(self)
 
     def resident_bytes(self) -> int:
         with self._lock:
             return sum(e.total_bytes() for e in self._entries.values())
+
+    def occupancy_bytes(self) -> dict:
+        """Cheap component byte sums (no row materialization) — the
+        hot-path gauge refresh (obs/device.refresh_occupancy) reads this
+        instead of snapshot_device()."""
+        with self._lock:
+            entries = list(self._entries.values())
+        col = sess = stack = 0
+        for e in entries:
+            try:
+                col += e.device_bytes
+                for attr in ("_sessions", "_raw_sessions"):
+                    c = getattr(e, attr)
+                    if c:
+                        sess += sum(v.nbytes for v in list(c.values()))
+                s = e._stacks
+                if s:
+                    stack += sum(v.nbytes for v in list(s.values()))
+            except Exception:
+                continue  # a racing extend/evict: best-effort sums
+        return {"column": col, "session": sess, "stack": stack}
+
+    def snapshot_device(self) -> list[dict]:
+        """Per-(table, column, dtype) HBM residency rows for the device
+        telemetry plane (obs/device.device_inventory). ``component=
+        "column"`` rows sum EXACTLY to the entries' ``device_bytes``
+        accounting (the acceptance invariant); sessions/stacks — the
+        content-keyed query-shape uploads and stacked value views — are
+        reported beside them; evicted tables keep a zero-byte row
+        carrying their eviction count."""
+        with self._lock:
+            entries = list(self._entries.items())
+            evictions = dict(self._evictions)
+        now = time.time()
+        rows: list[dict] = []
+
+        def row(table: str, column: str, component: str, dtype: str,
+                nbytes: int, nrows: int, age_ms: int) -> dict:
+            return {
+                "table_name": table,
+                "column_name": column,
+                "component": component,
+                "dtype": dtype,
+                "bytes": int(nbytes),
+                "rows": int(nrows),
+                "last_hit_age_ms": age_ms,
+                "evictions": int(evictions.get(table, 0)),
+            }
+
+        for name, e in entries:
+            try:
+                age = (
+                    int((now - e.last_hit_at) * 1000)
+                    if e.last_hit_at else -1
+                )
+                rows.append(row(name, "__series_codes__", "column", "int32",
+                                e.series_codes_dev.nbytes, e.n_valid, age))
+                rows.append(row(name, "__ts_rel__", "column", "int32",
+                                e.ts_rel_dev.nbytes, e.n_valid, age))
+                for col, dev in list(e.value_cols_dev.items()):
+                    rows.append(row(name, col, "column", str(dev.dtype),
+                                    dev.nbytes, e.n_valid, age))
+                for attr, label in (("_sessions", "__sessions__"),
+                                    ("_raw_sessions", "__raw_sessions__")):
+                    cache = getattr(e, attr)
+                    if cache:
+                        vals = list(cache.values())
+                        rows.append(row(
+                            name, label, "session", "int32",
+                            sum(v.nbytes for v in vals), len(vals), age,
+                        ))
+                stacks = e._stacks
+                if stacks:
+                    vals = list(stacks.values())
+                    rows.append(row(
+                        name, "__stacks__", "stack",
+                        str(vals[0].dtype) if vals else "float32",
+                        sum(v.nbytes for v in vals), len(vals), age,
+                    ))
+            except Exception:
+                continue  # a racing extend/evict: skip this entry's rows
+        resident = {name for name, _ in entries}
+        for table, n in evictions.items():
+            if table not in resident and n:
+                rows.append(row(table, "", "evicted", "", 0, 0, -1))
+        return rows
 
     # ---- learned per-column dtype ---------------------------------------
     def note_usage(
@@ -302,6 +401,9 @@ class ScanCache:
             entry = self._entries.get(table_name)
         if promote and entry is not None and _cache_dtype_mode() == "auto":
             self._drop_bf16_columns(entry, promote)
+            from ..obs.device import refresh_occupancy
+
+            refresh_occupancy(force=True)  # bf16 drop freed device bytes
 
     def _column_dtype(self, table_name: str, column: str):
         """Resident dtype for one value column under the current mode."""
@@ -332,11 +434,13 @@ class ScanCache:
                 if entry.series_value_stats is not None:
                     entry.series_value_stats.pop(c, None)
 
-    def _evict_over_budget_locked(self, keep: str) -> None:
+    def _evict_over_budget_locked(self, keep: str) -> int:
         """Evict least-recently-used entries (never ``keep``) until both
         the entry-count and byte budgets hold — the ONE eviction policy;
         the insert path and the hit path (whose _extend uploads grow
-        entries) both call it."""
+        entries) both call it. Returns how many entries were evicted so
+        callers can force the occupancy-gauge refresh on mutation."""
+        evicted = 0
         while len(self._entries) > 1 and (
             len(self._entries) > self.max_entries
             or sum(e.total_bytes() for e in self._entries.values())
@@ -346,8 +450,18 @@ class ScanCache:
                 (k for k in self._entries if k != keep), None
             )
             if victim is None:
-                return
+                return evicted
             self._entries.pop(victim)
+            evicted += 1
+            # accounted eviction: the device plane reports per-table
+            # counts (the usage-map signal the layout tuner reads)
+            if len(self._evictions) >= 512 and victim not in self._evictions:
+                self._evictions.pop(next(iter(self._evictions)))
+            self._evictions[victim] = self._evictions.get(victim, 0) + 1
+            from ..obs.device import note_eviction
+
+            note_eviction()
+        return evicted
 
     def get(
         self,
@@ -399,18 +513,30 @@ class ScanCache:
             with self._lock:
                 if delta is not None and _base_fingerprint(table) == base_fp:
                     self.hits += 1
+                    entry.last_hit_at = time.time()
                     # LRU touch: reinsert at the tail
                     e = self._entries.pop(table.name, None)
                     if e is not None:
                         self._entries[table.name] = e
                     # _extend above may have grown this entry's device
                     # bytes — the budget holds on the hit path too.
-                    self._evict_over_budget_locked(keep=table.name)
-                    return entry, False, delta
-                # A flush raced the delta read (or the delta predates the
-                # entry inconsistently): serve nothing from cache.
-                self.misses += 1
+                    evicted = self._evict_over_budget_locked(keep=table.name)
+                else:
+                    # A flush raced the delta read (or the delta predates
+                    # the entry inconsistently): serve nothing from cache.
+                    self.misses += 1
+                    entry = None
+                    evicted = 0
+            # gauge refresh OUTSIDE the cache lock (snapshot_device
+            # re-takes it); _extend above may have changed residency.
+            # An eviction forces through the throttle — it may be the
+            # last touch for a while and must not park the gauge.
+            from ..obs.device import refresh_occupancy
+
+            refresh_occupancy(force=bool(evicted))
+            if entry is None:
                 return None, False, None
+            return entry, False, delta
         seq_before = {d.table_id: d.last_sequence for d in table.physical_datas()}
         rows = read_rows()
         seq_after = {d.table_id: d.last_sequence for d in table.physical_datas()}
@@ -437,11 +563,15 @@ class ScanCache:
             base_fp, rows, min_ts, max_ts, value_columns, table.name
         )
         entry.built_seqs = seq_after
+        entry.last_hit_at = time.time()
         with self._lock:
             self.misses += 1
             self._entries.pop(table.name, None)
             self._entries[table.name] = entry
             self._evict_over_budget_locked(keep=table.name)
+        from ..obs.device import refresh_occupancy
+
+        refresh_occupancy(force=True)  # a build is a residency mutation
         empty = entry.empty_rows
         return entry, True, empty
 
@@ -680,6 +810,13 @@ class ScanCache:
     def invalidate(self, table_name: str) -> None:
         with self._lock:
             self._entries.pop(table_name, None)
+        from ..obs.device import refresh_occupancy
+
+        # forced: an invalidation (DROP/ALTER) may be the last cache
+        # touch for a long time — a throttled skip would leave the
+        # resident-bytes gauges reporting the freed bytes until the
+        # next query, and the recorder would persist the stale value
+        refresh_occupancy(force=True)
 
 
 def _base_fingerprint(table) -> tuple:

@@ -15,6 +15,7 @@ config.rs:176):
     GET  /debug/tables   per-table metrics (memtable/sst bytes, seqs)
     GET  /debug/hotspot  hottest tables by reads/writes
     GET  /debug/workload live admission/dedup/quota state (wlm)
+    GET  /debug/device   device telemetry plane (HBM residency, compile stats)
     GET  /debug/alerts   rule-engine alert state (pending/firing/resolved)
     PUT  /debug/slow_threshold/{seconds}  live slow-log threshold
     POST /admin/block    {"tables": [...]} / DELETE to unblock
@@ -2274,6 +2275,26 @@ def create_app(
             text=_dumps(proxy.wlm.snapshot()), content_type="application/json"
         )
 
+    async def debug_device(request: web.Request) -> web.Response:
+        """The device telemetry plane (obs/device): HBM residency
+        inventory (the same rows served SQL-side by
+        ``system.public.device``), byte totals by component, per-kernel
+        compile-cache stats, and the sampling policy in force."""
+        from ..obs import device as obs_device
+
+        def collect():
+            rows = obs_device.device_inventory()
+            return {
+                "enabled": obs_device.device_telemetry_enabled(),
+                "sample_every": obs_device.sample_every(),
+                "inventory": rows,
+                "totals": obs_device.occupancy_totals(rows),
+                "compile": obs_device.compile_stats(),
+            }
+
+        out = await asyncio.get_running_loop().run_in_executor(None, collect)
+        return web.Response(text=_dumps(out), content_type="application/json")
+
     async def admin_quota(request: web.Request) -> web.Response:
         """GET: current quotas + block-list. POST: set a token bucket
         {"scope": "table"|"tenant", "name": ..., "kind":
@@ -2575,6 +2596,7 @@ def create_app(
     app.router.add_get("/debug/flush", debug_flush)
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_get("/debug/workload", debug_workload)
+    app.router.add_get("/debug/device", debug_device)
     app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_get("/debug/slo", debug_slo)
     app.router.add_post("/admin/flush", admin_flush)

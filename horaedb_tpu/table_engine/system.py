@@ -37,6 +37,12 @@ The tables:
   (ok|burning|no_data), current indicator value vs bound, fast/slow
   burn rates over the sliding windows, and the breach count — the SQL
   face of /debug/slo; the tenant simulator's acceptance gate reads it
+- ``system.public.device``      — the device telemetry plane's HBM
+  residency inventory (obs/device.device_inventory): one row per
+  (table, column, component) with dtype, resident bytes, rows,
+  last-hit age, and eviction counts; ``component='column'`` rows sum
+  exactly to the scan cache's own device_bytes accounting — the usage
+  map the dtype/layout auto-tuners read, the SQL face of /debug/device
 """
 
 from __future__ import annotations
@@ -55,6 +61,7 @@ EVENTS_NAME = "system.public.events"
 ALERTS_NAME = "system.public.alerts"
 SLO_NAME = "system.public.slo"
 QUERIES_NAME = "system.public.queries"
+DEVICE_NAME = "system.public.device"
 
 
 class _VirtualTable(Table):
@@ -692,6 +699,85 @@ class QueriesTable(_VirtualTable):
         )
 
 
+_DEVICE_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("table_name", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("column_name", DatumKind.STRING),
+        ColumnSchema("component", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("dtype", DatumKind.STRING),
+        ColumnSchema("bytes", DatumKind.INT64),
+        ColumnSchema("rows", DatumKind.INT64),
+        ColumnSchema("last_hit_age_ms", DatumKind.INT64),
+        ColumnSchema("evictions", DatumKind.INT64),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "table_name", "column_name", "component"],
+)
+
+
+class DeviceTable(_VirtualTable):
+    """``system.public.device``: per-(table, column, dtype) HBM residency
+    from the device telemetry plane (obs/device) — resident bytes, row
+    counts, last-hit age, per-table eviction counts. ``component``
+    distinguishes the scan cache's resident columns (whose bytes sum to
+    its internal ``device_bytes`` accounting) from session/stack uploads
+    and zero-byte rows for evicted tables. ``last_hit_age_ms`` is -1
+    when the entry was never served."""
+
+    @property
+    def name(self) -> str:
+        return DEVICE_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _DEVICE_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        import time
+
+        from ..obs.device import device_inventory
+
+        entries = device_inventory()
+        now = int(time.time() * 1000)
+        n = len(entries)
+        return RowGroup(
+            _DEVICE_SCHEMA,
+            {
+                "timestamp": np.full(n, now, dtype=np.int64),
+                "table_name": np.array(
+                    [str(e.get("table_name", "")) for e in entries],
+                    dtype=object,
+                ),
+                "column_name": np.array(
+                    [str(e.get("column_name", "")) for e in entries],
+                    dtype=object,
+                ),
+                "component": np.array(
+                    [str(e.get("component", "")) for e in entries],
+                    dtype=object,
+                ),
+                "dtype": np.array(
+                    [str(e.get("dtype", "")) for e in entries], dtype=object
+                ),
+                "bytes": np.array(
+                    [int(e.get("bytes", 0)) for e in entries], dtype=np.int64
+                ),
+                "rows": np.array(
+                    [int(e.get("rows", 0)) for e in entries], dtype=np.int64
+                ),
+                "last_hit_age_ms": np.array(
+                    [int(e.get("last_hit_age_ms", -1)) for e in entries],
+                    dtype=np.int64,
+                ),
+                "evictions": np.array(
+                    [int(e.get("evictions", 0)) for e in entries],
+                    dtype=np.int64,
+                ),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -712,4 +798,6 @@ def open_system_table(catalog, name: str):
         return SloTable()
     if low == QUERIES_NAME:
         return QueriesTable()
+    if low == DEVICE_NAME:
+        return DeviceTable()
     return None

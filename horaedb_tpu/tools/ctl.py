@@ -21,6 +21,7 @@ Commands:
     rules rm NAME           remove a runtime rule
     alerts                  alert state (pending/firing/resolved)
     slo                     SLO verdicts: objectives, burn rates, breaches
+    device                  device telemetry: HBM residency + compile stats
 
 Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
 
@@ -357,6 +358,45 @@ def cmd_slo(ep: str, args) -> None:
         )
 
 
+def cmd_device(ep: str, args) -> None:
+    """The device telemetry plane (/debug/device): per-(table, column)
+    HBM residency inventory, byte totals by component, and per-kernel
+    compile-cache stats — the CLI face of ``system.public.device``."""
+    data = json.loads(_get(ep, "/debug/device"))
+    if not data.get("enabled", True):
+        print("(device telemetry disabled: HORAEDB_DEVICE_TELEMETRY=0)")
+        return
+    rows = [
+        {
+            "table": r["table_name"],
+            "column": r["column_name"],
+            "component": r["component"],
+            "dtype": r["dtype"],
+            "bytes": r["bytes"],
+            "rows": r["rows"],
+            "last_hit_age_ms": r["last_hit_age_ms"],
+            "evictions": r["evictions"],
+        }
+        for r in data.get("inventory", [])
+    ]
+    _print_rows(rows)
+    totals = data.get("totals", {})
+    print(
+        "\ntotals: "
+        + "  ".join(f"{k}={v}" for k, v in sorted(totals.items()))
+        + f"  (sampling 1-in-{data.get('sample_every')})"
+    )
+    compile_stats = data.get("compile", {})
+    if compile_stats:
+        print("\ncompile cache (per kernel kind):")
+        _print_rows(
+            [
+                {"kernel": k, "compiles": v["compiles"], "hits": v["hits"]}
+                for k, v in sorted(compile_stats.items())
+            ]
+        )
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -405,6 +445,7 @@ def main(argv=None) -> int:
     rl_rm.add_argument("name")
     sub.add_parser("alerts")
     sub.add_parser("slo")
+    sub.add_parser("device")
     sub.add_parser("shards")
     sub.add_parser("wal_stats")
     sub.add_parser("slow_log")

@@ -63,6 +63,7 @@ EVENT_KINDS: dict[str, str] = {
     "elastic_released": "an operator released a quarantined shard (horaectl elastic release)",
     "query_timeout": "a query exceeded its time budget and unwound at a checkpoint",
     "query_cancelled": "a query was cooperatively cancelled (KILL QUERY / ctl / disconnect)",
+    "kernel_compile": "a device kernel shape compiled for the first time (XLA compile)",
 }
 
 _EVENTS_FAMILY = "horaedb_events_total"

@@ -33,10 +33,14 @@ def sample_cpu(seconds: float, interval_s: float = 0.01, top: int = 40) -> str:
     while time.monotonic() < deadline:
         for tid, frame in sys._current_frames().items():
             stack = traceback.extract_stack(frame)
-            if not stack:
+            # Filter the profiler's own frames from the WHOLE stack, not
+            # just the last two: the sampling thread is often caught
+            # deeper (inside extract_stack / Counter / sleep internals),
+            # where a 2-frame tail check misses it and the profiler
+            # pollutes its own hot-stack report.
+            if any("utils/profile" in f.filename for f in stack):
                 continue
-            # skip the profiler's own frames
-            if any("utils/profile" in f.filename for f in stack[-2:]):
+            if not stack:
                 continue
             leaf = stack[-1]
             frames[f"{leaf.filename}:{leaf.lineno} {leaf.name}"] += 1

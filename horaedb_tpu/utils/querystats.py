@@ -83,12 +83,19 @@ NUMERIC_FIELDS: dict[str, str] = {
     "deadline_ms": "time budget (ms) the request carried at ingress (0 = unbounded)",
     "timed_out": "1 when the query died to its deadline (DeadlineExceeded)",
     "cancelled": "1 when the query was cooperatively cancelled (KILL/disconnect)",
+    # device telemetry plane (obs/device): how much device work the
+    # query issued and whether it paid a compile stall
+    "device_dispatches": "device kernel dispatches the query issued",
+    "compile_hit": "device dispatches that paid a first-time XLA compile (compile-stall marker)",
 }
 
 # wall-time costs; seconds, float.
 FLOAT_FIELDS: dict[str, str] = {
     "jit_compile_seconds": "wall seconds spent compiling new kernel shapes",
     "admission_wait_seconds": "wall seconds waiting for an admission slot",
+    # sampled on-device dispatch wall (obs/device timed_dispatch):
+    # milliseconds for render friendliness — tiny kernels are sub-ms
+    "device_ms": "sampled on-device dispatch wall milliseconds (block_until_ready timing)",
 }
 
 LEDGER_FIELDS: dict[str, str] = {**NUMERIC_FIELDS, **FLOAT_FIELDS}
@@ -359,18 +366,40 @@ _seen_kernel_keys: set = set()
 _kernel_lock = threading.Lock()
 
 
-def note_kernel_dispatch(key, elapsed_s: float) -> None:
+def note_kernel_dispatch(key, elapsed_s: float, kind: str = "",
+                         cost_fn=None) -> None:
     """Account one device-kernel dispatch: a never-seen static ``key``
     counts as a compile (with its wall seconds); a seen one as a
-    compile-cache hit."""
+    compile-cache hit.
+
+    ``kind`` (a DEVICE_KERNEL_KINDS label) routes the outcome into the
+    device telemetry plane too: a first sighting journals a typed
+    ``kernel_compile`` event and marks the ledger's ``compile_hit``; a
+    repeat ticks the per-kernel compile-cache-hit counter. ``cost_fn``
+    (only called on a compile) may return an XLA cost_analysis dict to
+    ride the event (obs/device.cost_analysis)."""
     with _kernel_lock:
         first = key not in _seen_kernel_keys
         if first:
             _seen_kernel_keys.add(key)
     if first:
         record(jit_compiles=1, jit_compile_seconds=elapsed_s)
+        if kind:
+            from ..obs.device import note_compile
+
+            cost = None
+            if cost_fn is not None:
+                try:
+                    cost = cost_fn()
+                except Exception:
+                    cost = None
+            note_compile(kind, key, elapsed_s, cost)
     else:
         record(jit_cache_hits=1)
+        if kind:
+            from ..obs.device import note_compile_cache_hit
+
+            note_compile_cache_hit(kind)
 
 
 # ---- stats store ----------------------------------------------------------

@@ -1077,6 +1077,69 @@ class TestMetricsNameLint:
                 missing.append(f"[wlm.batch] {knob}: undocumented")
         assert not missing, missing
 
+    def test_device_families_declared_and_documented(self):
+        """PR-15 lint extension (same contract as the agg-kernel/raw
+        registries): every horaedb_device_* family declared in
+        obs.device.DEVICE_METRIC_FAMILIES must be (a) registered live —
+        with every DEVICE_KERNEL_KINDS label eagerly present on the
+        dispatch/compile families and both compile outcomes — (b)
+        convention-clean, (c) documented in docs/OBSERVABILITY.md — and
+        no stray horaedb_device_* family may exist outside the declared
+        registry. The device knobs are operator surface: pinned to
+        docs/WORKLOAD.md. (The device_ms/device_dispatches/compile_hit
+        ledger fields ride the PR-2 lint automatically: column + family
+        + docs mention.)"""
+        import os
+        import re
+
+        from horaedb_tpu.obs.device import (
+            DEVICE_KERNEL_KINDS,
+            DEVICE_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.table_engine.system import DEVICE_NAME
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in DEVICE_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(self.SUFFIXES):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in docs/OBSERVABILITY.md")
+        for kind in DEVICE_KERNEL_KINDS:
+            if f'kernel="{kind}"' not in exposed:
+                missing.append(f"label kernel={kind}: not eagerly registered")
+        for outcome in ("compile", "hit"):
+            if f'outcome="{outcome}"' not in exposed:
+                missing.append(
+                    f"label outcome={outcome}: not eagerly registered"
+                )
+        for fam in families:
+            if fam.startswith("horaedb_device_") and \
+                    fam not in DEVICE_METRIC_FAMILIES:
+                missing.append(f"{fam}: live but undeclared in registry")
+        # the system table + journal event kind are part of the contract
+        if f"`{DEVICE_NAME}`" not in docs:
+            missing.append(f"{DEVICE_NAME}: undocumented")
+        from horaedb_tpu.utils.events import EVENT_KINDS
+
+        if "kernel_compile" not in EVENT_KINDS:
+            missing.append("kernel_compile: not in EVENT_KINDS")
+        for knob in (
+            "HORAEDB_DEVICE_TELEMETRY", "HORAEDB_DEVICE_SAMPLE",
+            "HORAEDB_DEVICE_SLOW_MS", "HORAEDB_DEVICE_COST_ANALYSIS",
+        ):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
     def test_engine_families_live_after_flush(self, tmp_path):
         """Acceptance: /metrics exposes horaedb_flush_*, horaedb_compaction_*
         and horaedb_wal_* families after a flush+compaction cycle."""
