@@ -43,6 +43,16 @@ target list:
                         (HORAEDB_ROLLUP=0), interleaved min-of-N; also
                         times the PromQL range-query face of the same
                         rewrite
+    decisions           decision-plane overhead gate: the flood shape
+                        with the decision journal ON (kernel-router +
+                        admission record/resolve per query) vs
+                        HORAEDB_DECISIONS=0, interleaved min-of-N;
+                        gate: on within 2% of off
+
+An all-configs run (no BENCH_CONFIG) honours BENCH_WALL_BUDGET seconds:
+stages that no longer fit are skipped with an explicit emitted line and
+listed in the final record's ``stages_skipped`` (always present, [] when
+everything ran).
 
 Every config runs the FULL query path (SQL -> plan -> merge read -> fused
 device kernel) against data ingested through the real engine (memtable ->
@@ -1193,6 +1203,131 @@ def run_flood_config() -> dict:
         db.close()
 
 
+# ---- decisions config (decision-journal overhead A/B) ---------------------
+
+
+def run_decisions_config() -> dict:
+    """Decision-plane overhead gate: the flood's dashboard shape served
+    twice through the proxy — decision journal ON (every query records a
+    kernel-router pick and an admission cost prediction, and resolves
+    both) vs ``HORAEDB_DECISIONS=0`` (record returns 0, resolve is a
+    no-op). The journal is bookkeeping on the serving path, so the gate
+    is wall-clock parity: the on arm must land within 2% of off.
+
+    Arms are interleaved across reps and each arm's MINIMUM wall is
+    compared (min is robust to the one-off GC/compile hiccup a mean
+    would smear into a false overhead). The record carries the journal's
+    own accounting — recorded/resolved counts from DecisionJournal.stats()
+    — so a "0% overhead" line where the journal never actually recorded
+    anything is self-evidently vacuous."""
+    import threading
+
+    from horaedb_tpu.proxy import Proxy
+    from horaedb_tpu.obs.decisions import DECISION_JOURNAL
+    import jax
+
+    platform = jax.devices()[0].platform
+    hosts = int(os.environ.get("BENCH_DECISIONS_HOSTS", "32"))
+    rows_per_host = int(os.environ.get("BENCH_DECISIONS_ROWS", "200"))
+    queries = int(os.environ.get("BENCH_DECISIONS_QUERIES", "400"))
+    workers = int(os.environ.get("BENCH_DECISIONS_WORKERS", "8"))
+    reps = int(os.environ.get("BENCH_DECISIONS_REPS", "3"))
+
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE dash (host string TAG, v double, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+    )
+    rng = np.random.default_rng(13)
+    t0 = 1_700_000_000_000
+    chunk = []
+    for h in range(hosts):
+        vs = rng.random(rows_per_host) * 100.0
+        for i in range(rows_per_host):
+            chunk.append(f"('h{h}', {vs[i]:.3f}, {t0 + i * 1000})")
+        if len(chunk) >= 4000 or h == hosts - 1:
+            db.execute(
+                "INSERT INTO dash (host, v, ts) VALUES " + ",".join(chunk)
+            )
+            chunk = []
+    db.flush_all()
+    span = rows_per_host * 1000
+
+    def sql_for(q: int) -> str:
+        lo = t0 + (q % 64) * 1000
+        return (
+            f"SELECT host, count(v), sum(v), max(v) FROM dash "
+            f"WHERE ts >= {lo} AND ts < {t0 + span} AND v >= {q % 7}.5 "
+            f"GROUP BY host"
+        )
+
+    def flood(proxy, n: int) -> None:
+        idx = iter(range(n))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    q = next(idx, None)
+                if q is None:
+                    return
+                proxy.handle_sql(sql_for(q))
+
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    proxy = Proxy(db)
+    prior = os.environ.get("HORAEDB_DECISIONS")
+    try:
+        # warmup: scan cache + kernel compiles, with the journal ON so
+        # both code paths (record + resolve) are warm before timing
+        os.environ["HORAEDB_DECISIONS"] = "1"
+        flood(proxy, min(128, queries))
+        issued0 = DECISION_JOURNAL.stats()["issued"]
+        walls: dict = {"on": [], "off": []}
+        for rep in range(reps):
+            order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+            for arm in order:
+                os.environ["HORAEDB_DECISIONS"] = (
+                    "1" if arm == "on" else "0"
+                )
+                t_arm = time.perf_counter()
+                flood(proxy, queries)
+                walls[arm].append(time.perf_counter() - t_arm)
+        stats = DECISION_JOURNAL.stats()
+    finally:
+        if prior is None:
+            os.environ.pop("HORAEDB_DECISIONS", None)
+        else:
+            os.environ["HORAEDB_DECISIONS"] = prior
+        proxy.close()
+        db.close()
+
+    on_s, off_s = min(walls["on"]), min(walls["off"])
+    overhead_pct = round((on_s / max(off_s, 1e-9) - 1.0) * 100.0, 3)
+    resolved = sum(l["resolved"] for l in stats["loops"].values())
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+    return {
+        "metric": f"decisions_overhead_pct{suffix}",
+        "value": overhead_pct,
+        "unit": "% wall overhead, decision journal on vs HORAEDB_DECISIONS=0",
+        "vs_baseline": round(on_s / max(off_s, 1e-9), 4),
+        "baseline": "HORAEDB_DECISIONS=0 (journal off)",
+        "overhead_ok": on_s <= off_s * 1.02,
+        "on_s": round(on_s, 4),
+        "off_s": round(off_s, 4),
+        "reps": reps,
+        "queries": queries,
+        "workers": workers,
+        "decisions_recorded": stats["issued"] - issued0,
+        "decisions_resolved": resolved,
+        "platform": platform,
+    }
+
+
 def _host_merge_permutation(tsid, ts, seq, dedup=True):
     """Vectorized-numpy merge baseline with the device kernel's exact
     semantics: sort (tsid, ts, seq desc, input-row desc), keep the first
@@ -1581,12 +1716,20 @@ def _emit(obj: dict) -> None:
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
     "compaction-64", "ingest", "groupby", "rawscan", "rollup", "flood",
-    "devicetel", "tsbs-5-8-1",
+    "devicetel", "decisions", "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
 # builds the table twice for the device/host A-B and genuinely needs
 # ~20 min of 1-core wall; the query configs finish far inside it.
 PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "2400"))
+# Total wall budget for an all-configs run (0 = unbounded). When the
+# budget can no longer fit a stage, the stage is SKIPPED with an explicit
+# emitted line and listed in the final record's `stages_skipped` — a
+# truncated run must say what it didn't measure, never silently omit it.
+WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "0") or 0)
+# A stage that can't get at least this much wall isn't worth starting —
+# it would only burn the remaining budget into a timeout line.
+STAGE_FLOOR = float(os.environ.get("BENCH_STAGE_FLOOR", "60"))
 # TPU probe budget: attempts are spent before configs (until the chip
 # first answers), on mid-run wedge demotions, and before end-of-run chip
 # retries; each attempt is bounded so a wedged tunnel costs minutes, not
@@ -1619,7 +1762,17 @@ def run_all() -> None:
     happened."""
     import subprocess
 
-    def _run_one(config: str, force_cpu: bool) -> tuple[str, dict | None]:
+    t_run = time.monotonic()
+    stages_skipped: list[str] = []
+
+    def remaining() -> float:
+        if WALL_BUDGET <= 0:
+            return float("inf")
+        return WALL_BUDGET - (time.monotonic() - t_run)
+
+    def _run_one(
+        config: str, force_cpu: bool, timeout: float | None = None
+    ) -> tuple[str, dict | None]:
         env = dict(os.environ)
         env["BENCH_CONFIG"] = config
         if force_cpu:
@@ -1632,7 +1785,7 @@ def run_all() -> None:
                 [sys.executable, os.path.abspath(__file__)],
                 env=env,
                 capture_output=True,
-                timeout=PER_CONFIG_TIMEOUT,
+                timeout=min(timeout or PER_CONFIG_TIMEOUT, PER_CONFIG_TIMEOUT),
                 text=True,
             )
             for ln in reversed(p.stdout.strip().splitlines()):
@@ -1665,11 +1818,34 @@ def run_all() -> None:
     fallback_configs: list[str] = []
     results: dict[str, str] = {}
     last_printed = None
+    headline = ALL_CONFIGS[-1]
     for config in ALL_CONFIGS:
+        budget_s = remaining()
+        if config != headline and budget_s < STAGE_FLOOR:
+            # Wall budget exhausted: skip the stage EXPLICITLY (own line
+            # + listed in the headline's stages_skipped) and save what's
+            # left for the headline config.
+            stages_skipped.append(config)
+            line = json.dumps({
+                "metric": f"{config}_skipped", "value": 0,
+                "unit": "wall budget exhausted before stage", "vs_baseline": 0,
+                "platform": "none",
+            })
+            results[config] = line
+            print(line)
+            last_printed = line
+            sys.stdout.flush()
+            continue
         if not chip_up:
             chip_up = probe()
-        line, parsed = _run_one(config, force_cpu=not chip_up)
+        line, parsed = _run_one(
+            config, force_cpu=not chip_up, timeout=max(budget_s, STAGE_FLOOR)
+        )
         hung = parsed is None or parsed.get("unit") == "timeout or no output"
+        if hung and budget_s < PER_CONFIG_TIMEOUT:
+            # The stage was cut short by the RUN budget, not its own
+            # timeout — account it as skipped, not merely errored.
+            stages_skipped.append(config)
         too_slow_on_chip = False
         if chip_up and hung:
             # Either the chip/tunnel wedged mid-config, or the config is
@@ -1681,8 +1857,11 @@ def run_all() -> None:
             chip_up = probe()
             if chip_up:
                 too_slow_on_chip = True
-            else:
-                line2, parsed2 = _run_one(config, force_cpu=True)
+            elif remaining() >= STAGE_FLOOR:
+                line2, parsed2 = _run_one(
+                    config, force_cpu=True,
+                    timeout=max(remaining(), STAGE_FLOOR),
+                )
                 if parsed2 is not None:
                     line, parsed = line2, parsed2
         results[config] = line
@@ -1702,9 +1881,12 @@ def run_all() -> None:
     if fallback_configs:
         chip_up = probe()
         for config in fallback_configs:
-            if not chip_up:
+            if not chip_up or remaining() < STAGE_FLOOR:
                 break
-            line, parsed = _run_one(config, force_cpu=False)
+            line, parsed = _run_one(
+                config, force_cpu=False,
+                timeout=max(remaining(), STAGE_FLOOR),
+            )
             m = (parsed or {}).get("metric", "")
             if parsed is not None and "_error" not in m and "_CPU-FALLBACK" not in m:
                 results[config] = line
@@ -1713,11 +1895,24 @@ def run_all() -> None:
                 sys.stdout.flush()
             else:
                 chip_up = probe()
-    # Headline config's line must be LAST on stdout (the driver parses the
-    # final line); re-emit it if retries pushed other lines after it.
-    headline = ALL_CONFIGS[-1]
-    if last_printed != results[headline]:
-        print(results[headline])
+    # Headline config's line must be LAST on stdout (the driver parses
+    # the final line), and a budget-truncated run must carry the explicit
+    # skipped list — stages_skipped rides on the headline record (always
+    # present, [] when everything ran).
+    try:
+        hrec = json.loads(results[headline])
+        if not isinstance(hrec, dict):
+            raise ValueError(type(hrec).__name__)
+    except (json.JSONDecodeError, ValueError):
+        hrec = {
+            "metric": f"{headline}_error", "value": 0,
+            "unit": "no parseable headline line", "vs_baseline": 0,
+            "platform": "unknown",
+        }
+    hrec["stages_skipped"] = stages_skipped
+    final_line = json.dumps(hrec)
+    if last_printed != final_line:
+        print(final_line)
         sys.stdout.flush()
 
 
@@ -2133,6 +2328,8 @@ def run_config(config: str) -> dict:
         return run_rawscan_config()
     if config == "flood":
         return run_flood_config()
+    if config == "decisions":
+        return run_decisions_config()
     if config == "rollup":
         return run_rollup_config()
     builder = CONFIGS.get(config)

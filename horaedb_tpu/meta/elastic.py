@@ -363,7 +363,11 @@ class ElasticController:
         self._since_ms = int(time.time() * 1000)
         self._rounds = 0
         self._holds = 0
-        self._decisions: deque = deque(maxlen=32)
+        # rounds journal through the process-global DecisionJournal
+        # (obs/decisions) — ONE source of truth for horaectl elastic
+        # status, GET /meta/v1/elastic, and system.public.decisions;
+        # this is just the id of the round awaiting next-round grading
+        self._last_decision_id = 0
 
     # ---- surface the meta server / scheduler read -----------------------
 
@@ -479,6 +483,20 @@ class ElasticController:
         shard_qps, shard_slow, shard_wait = self._update_windows(
             now, load, span_s
         )
+        # Decision plane: the hot-shard pressure this round OBSERVED
+        # grades what last round PREDICTED (a persistence forecast,
+        # floored at 1 qps so quiet rounds don't divide by ~0) — hold
+        # predicts the pressure stays, an action predicts it too and the
+        # calibration shows how fast the world moves under the loop.
+        from ..obs.decisions import record_decision, resolve_decision
+
+        pressure = max(1.0, max(shard_qps.values(), default=0.0))
+        if self._last_decision_id:
+            resolve_decision(
+                self._last_decision_id, actual=pressure,
+                outcome="observed", loop="elastic",
+            )
+            self._last_decision_id = 0
         shards = {s.shard_id: s for s in self.topology.shards()}
         planned: list[dict] = []
         budget = [int(self.cfg.action_budget)]
@@ -520,17 +538,22 @@ class ElasticController:
                 apply()
             except Exception:
                 logger.exception("elastic action failed: %s", p)
-        self._decisions.append(
-            {
-                "at_ms": now_ms,
-                "actions": [
-                    {k: v for k, v in p.items() if k != "apply"}
-                    for p in planned
-                ],
+        actions = [
+            {k: v for k, v in p.items() if k != "apply"} for p in planned
+        ]
+        hot_sid = max(shard_qps, key=shard_qps.get) if shard_qps else -1
+        self._last_decision_id = record_decision(
+            "elastic",
+            key=f"shard:{hot_sid}",
+            choice=actions[0]["action"] if actions else "hold",
+            features={
+                "actions": actions,
                 "nodes_answered": load.nodes_answered,
                 "nodes_asked": load.nodes_asked,
                 "dry_run": bool(self.cfg.dry_run),
-            }
+                "round": self._rounds,
+            },
+            predicted=pressure,
         )
         _M_ROUND_S.set(self._now() - t0)
         return planned
@@ -976,5 +999,30 @@ class ElasticController:
                 "quarantined": {
                     str(k): v for k, v in self._quarantined.items()
                 },
-                "recent_decisions": list(self._decisions),
+                "recent_decisions": self.recent_decisions(),
             }
+
+    def recent_decisions(self, limit: int = 32) -> list[dict]:
+        """Round journal served FROM the decision plane (obs/decisions)
+        — the controller keeps no private ring, so this surface,
+        system.public.decisions, and horaectl decisions cannot drift."""
+        from ..obs.decisions import DECISION_JOURNAL
+
+        out = []
+        for e in DECISION_JOURNAL.list(loop="elastic", limit=limit):
+            f = e.get("features", {})
+            out.append(
+                {
+                    "at_ms": e["timestamp"],
+                    "actions": f.get("actions", []),
+                    "nodes_answered": f.get("nodes_answered"),
+                    "nodes_asked": f.get("nodes_asked"),
+                    "dry_run": bool(f.get("dry_run", False)),
+                    "decision_id": e["id"],
+                    "choice": e["choice"],
+                    "predicted_qps": e["predicted"],
+                    "observed_qps": e["actual"],
+                    "resolved": bool(e["resolved"]),
+                }
+            )
+        return out

@@ -267,6 +267,7 @@ class Proxy:
         shape = None  # set for executed SELECTs; feeds the EWMA history
         exec_elapsed: list = [None]  # leader execution seconds (EWMA input)
         admission_class = None  # set for executed SELECTs (class latency)
+        adm_decision = 0  # decision-plane id for the est_cost_s admit
         ok = False
         try:
             dtoken = deadline_scope(deadline)
@@ -292,6 +293,24 @@ class Proxy:
                 live.admission_class = admission_class
                 lane = lane_for(admission_class)
                 est_cost_s = (est_ms / 1000.0) if est_ms else None
+                if est_cost_s is not None:
+                    # Decision plane: the classifier predicted this
+                    # shape's cost and admission will act on it; the
+                    # finally below grades the prediction against the
+                    # leader's realized execution seconds (the same
+                    # sample the cost EWMA learns from).
+                    from ..obs.decisions import record_decision
+
+                    adm_decision = record_decision(
+                        "admission",
+                        key=shape,
+                        choice=admission_class,
+                        features={
+                            "est_ms": round(est_ms, 3),
+                            "budget_ms": int(deadline.budget_ms or 0),
+                        },
+                        predicted=est_cost_s,
+                    )
 
                 def run_leader():
                     # admission wraps only the LEADER: followers coalesce
@@ -300,7 +319,7 @@ class Proxy:
                     # that cannot fit the shape's expected cost sheds
                     # immediately (utils/deadline)
                     with self.wlm.admission.admit(
-                        admission_class, est_cost_s=est_cost_s
+                        admission_class, est_cost_s=est_cost_s, shape=shape
                     ):
                         with span(
                             "execute", priority=lane, admission=admission_class
@@ -423,6 +442,42 @@ class Proxy:
                 # queue or follower wait would teach cheap shapes they
                 # are "slow" under load (a self-sustaining demotion)
                 COST_HISTORY.observe(shape, exec_elapsed[0])
+                from ..obs.decisions import DECISION_JOURNAL, resolve_decision
+
+                resolve_decision(
+                    adm_decision, actual=exec_elapsed[0], outcome="ok",
+                    loop="admission",
+                )
+                # a completed same-shape execution grades any pending
+                # deadline_budget sheds of this shape: the shed was
+                # "doomed" if the realized cost really would not have
+                # fit the budget remaining at shed time, else premature
+                DECISION_JOURNAL.resolve_matching(
+                    "deadline",
+                    shape,
+                    actual=exec_elapsed[0],
+                    outcome=lambda e: (
+                        "doomed"
+                        if exec_elapsed[0]
+                        >= e["features"].get("remaining_s", 0.0)
+                        else "premature"
+                    ),
+                )
+            elif adm_decision:
+                # shed/failed/timed out before a leader execution
+                # completed: close the decision ungraded — a realized
+                # cost never arrived, so there is nothing to grade the
+                # estimator against (and "fast because it died" would
+                # poison the calibration the same way it would poison
+                # the EWMA)
+                from ..obs.decisions import resolve_decision
+
+                resolve_decision(
+                    adm_decision,
+                    outcome="failed" if exec_elapsed[0] is None else "aborted",
+                    loop="admission",
+                    calibrate=False,
+                )
             slow = elapsed >= self.slow_threshold_s
             finish_trace(handle, slow=slow)
             finish_ledger(ledger, ltoken, elapsed)

@@ -2335,17 +2335,37 @@ def route_segment_kernel(shape_key, spec, n_rows: int, est_distinct,
 
     from ..ops.hash_agg import hash_slots_for
 
+    candidates = candidate_kernels(n_seg, n_rows, est)
     impl = KERNEL_ROUTER.choose(
         key,
         seed_kernel(n_seg, est, jax.default_backend()),
-        candidate_kernels(n_seg, n_rows, est),
+        candidates,
     )
     spec = dataclasses.replace(
         spec,
         segment_impl=impl,
         hash_slots=hash_slots_for(n_seg, est) if impl == "hash" else 0,
     )
-    return spec, (key, impl)
+    # Decision plane: journal the pick with the EWMA's own prediction of
+    # what this impl costs for this shape (None until the impl has a
+    # clean sample — those picks resolve ungraded). The id rides the
+    # router token to finish_segment_kernel, where the same amortized
+    # dispatch seconds that feed the EWMA also grade the prediction.
+    from ..obs.decisions import record_decision
+
+    predicted = KERNEL_ROUTER.stats(key).get("t", {}).get(impl)
+    dec_id = record_decision(
+        "kernel_router",
+        key=f"{shape_key[0] if shape_key else ''}#b{n_seg.bit_length()}",
+        choice=impl,
+        features={
+            "n_seg": n_seg,
+            "est_segments": est,
+            "candidates": list(candidates),
+        },
+        predicted=predicted,
+    )
+    return spec, (key, impl, dec_id)
 
 
 def finish_segment_kernel(krec, spec, m: dict, state,
@@ -2362,18 +2382,32 @@ def finish_segment_kernel(krec, spec, m: dict, state,
     n_seg = spec.n_groups * spec.n_buckets
     impl = resolve_segment_impl(n_seg, spec.segment_impl)
     live = int((state.counts > 0).sum())
-    if krec is not None and live > 0:
-        # Degenerate dispatches (empty time range, filter matching
-        # nothing) are excluded from BOTH feedback loops: their
-        # near-zero latency would make whichever impl served them
-        # look unbeatable under the min-biased estimator, and a
-        # live count of 0 would EWMA the cardinality estimate toward
-        # a tiny hash table the next real query overflows.
-        key, routed = krec
-        # the honest cost of CHOOSING this impl for the shape —
-        # including the tiny-input host fallback when hash took it
-        KERNEL_ROUTER.record(key, routed, seconds)
-        KERNEL_ROUTER.note_segments(key, live)
+    if krec is not None:
+        from ..obs.decisions import resolve_decision
+
+        key, routed, dec_id = krec
+        if live > 0:
+            # Degenerate dispatches (empty time range, filter matching
+            # nothing) are excluded from BOTH feedback loops: their
+            # near-zero latency would make whichever impl served them
+            # look unbeatable under the min-biased estimator, and a
+            # live count of 0 would EWMA the cardinality estimate toward
+            # a tiny hash table the next real query overflows.
+            # the honest cost of CHOOSING this impl for the shape —
+            # including the tiny-input host fallback when hash took it
+            KERNEL_ROUTER.record(key, routed, seconds)
+            KERNEL_ROUTER.note_segments(key, live)
+            resolve_decision(
+                dec_id, actual=seconds, outcome="served",
+                loop="kernel_router",
+            )
+        else:
+            # degenerate: the decision closes (no leaked pending entry)
+            # but must not grade the EWMA's prediction
+            resolve_decision(
+                dec_id, actual=seconds, outcome="degenerate",
+                loop="kernel_router", calibrate=False,
+            )
     if (
         impl == "hash"
         and n_valid is not None

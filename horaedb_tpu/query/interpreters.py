@@ -405,6 +405,28 @@ class InterpreterFactory:
                                 f"wall_ms={a.get('wall_ms')} "
                                 f"shape={a.get('shape')}"
                             )
+                # Decision plane: any adaptive decision journaled under
+                # THIS run's trace (the kernel router's impl pick for a
+                # routed aggregation) renders with its prediction and —
+                # the run just finished, so the resolve landed — the
+                # realized seconds and relative error.
+                from ..obs.decisions import DECISION_JOURNAL
+
+                for de in DECISION_JOURNAL.list():
+                    if de.get("trace_id") == trace.trace_id:
+                        parts = [
+                            f"  Decision: loop={de['loop']} "
+                            f"choice={de['choice']}"
+                        ]
+                        if de["predicted"] is not None:
+                            parts.append(f"predicted={de['predicted']:.6f}")
+                        if de["actual"] is not None:
+                            parts.append(f"actual={de['actual']:.6f}")
+                        if de["error"] is not None:
+                            parts.append(f"error={de['error']:+.3f}")
+                        if de["outcome"]:
+                            parts.append(f"outcome={de['outcome']}")
+                        lines.append(" ".join(parts))
                 if handle is not None:
                     trace.root.finish()  # owned: closed before rendering
                 tree = trace.to_dict()["root"]

@@ -423,6 +423,8 @@ class ScanCache:
         """Evict now-stale bf16 device copies so the extend path
         re-uploads them at f32 (may force an SST re-read if the host
         rows were dropped — correctness over residency)."""
+        from ..obs.decisions import record_decision
+
         with entry.ext_lock:
             for c in columns:
                 dev = entry.value_cols_dev.get(c)
@@ -433,6 +435,18 @@ class ScanCache:
                 entry._stacks = None
                 if entry.series_value_stats is not None:
                     entry.series_value_stats.pop(c, None)
+                # Decision plane: the tuner chose to spend HBM for
+                # exactness. Predicted: the f32 re-upload doubles the
+                # dropped bf16 bytes; the extend path resolves with the
+                # bytes ACTUALLY uploaded (a grown pad bucket or raced
+                # rebuild shows up as calibration error).
+                record_decision(
+                    "dtype_tuner",
+                    key=f"{entry.table_name}:{c}",
+                    choice="promote_f32",
+                    features={"bf16_bytes": int(dev.nbytes)},
+                    predicted=float(dev.nbytes) * 2.0,
+                )
 
     def _evict_over_budget_locked(self, keep: str) -> int:
         """Evict least-recently-used entries (never ``keep``) until both
@@ -776,6 +790,18 @@ class ScanCache:
                 entry.value_cols_dev[c] = dev
                 entry.device_bytes += padded.nbytes
                 entry._stacks = None  # stale stacked views
+                if padded.dtype != np.dtype(jnp.bfloat16):
+                    # an exact upload closes any pending promote_f32
+                    # decision for this column (no match -> no-op: a
+                    # plain first upload decided nothing)
+                    from ..obs.decisions import DECISION_JOURNAL
+
+                    DECISION_JOURNAL.resolve_matching(
+                        "dtype_tuner",
+                        f"{entry.table_name}:{c}",
+                        actual=float(padded.nbytes),
+                        outcome="promoted",
+                    )
                 # Per-series min/max over the SAME values the kernel sees
                 # — the dtype-CAST values (bf16-resident columns compare
                 # rounded), with fills included and NaN samples ignored

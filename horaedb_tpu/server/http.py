@@ -932,6 +932,11 @@ def create_app(
         from ..utils.events import EVENT_STORE
 
         EVENT_STORE.resize(observability.event_ring)
+        # ...and the decision journal ([observability] decision_ring):
+        # same accounting contract, horaedb_decision_dropped_total.
+        from ..obs.decisions import DECISION_JOURNAL
+
+        DECISION_JOURNAL.resize(observability.decision_ring)
 
     recorder = None
     if observability is not None and observability.self_scrape:
@@ -2003,6 +2008,37 @@ def create_app(
             content_type="application/json",
         )
 
+    async def debug_decisions(request: web.Request) -> web.Response:
+        """The decision plane (obs/decisions): the journal's newest-
+        bounded ring plus per-loop calibration and the accounting
+        ledger. ?loop= filters, ?limit= tails — filter parity with
+        /debug/events."""
+        from ..obs.decisions import DECISION_JOURNAL, DECISION_LOOPS
+
+        loop = request.query.get("loop")
+        if loop is not None and loop not in DECISION_LOOPS:
+            return web.json_response(
+                {"error": f"unknown loop {loop!r} "
+                          f"(one of {', '.join(DECISION_LOOPS)})"},
+                status=400,
+            )
+        limit = None
+        if "limit" in request.query:
+            try:
+                limit = int(request.query["limit"])
+            except ValueError:
+                return web.json_response({"error": "bad 'limit'"}, status=400)
+        return web.Response(
+            text=_dumps(
+                {
+                    "decisions": DECISION_JOURNAL.list(loop=loop, limit=limit),
+                    "calibration": DECISION_JOURNAL.calibration(),
+                    "stats": DECISION_JOURNAL.stats(),
+                }
+            ),
+            content_type="application/json",
+        )
+
     async def route(request: web.Request) -> web.Response:
         """One payload shape in both modes:
         routes[i] = {endpoint, is_local, shard_id|null}."""
@@ -2578,6 +2614,7 @@ def create_app(
     app.router.add_get("/debug/config", debug_config)
     app.router.add_get("/debug/status", debug_status)
     app.router.add_get("/debug/events", debug_events)
+    app.router.add_get("/debug/decisions", debug_decisions)
     app.router.add_get("/debug/tables", debug_tables)
     app.router.add_get("/debug/hotspot", debug_hotspot)
     app.router.add_get("/debug/queries", debug_queries)

@@ -43,6 +43,15 @@ The tables:
   last-hit age, and eviction counts; ``component='column'`` rows sum
   exactly to the scan cache's own device_bytes accounting — the usage
   map the dtype/layout auto-tuners read, the SQL face of /debug/device
+- ``system.public.decisions``   — the decision plane's journal
+  (obs/decisions.DECISION_JOURNAL): one row per adaptive-loop decision
+  (kernel router, admission, elastic, dtype tuner, deadline sheds) with
+  its choice, features, predicted value, realized outcome, and relative
+  error; trace-linked like events — the SQL face of /debug/decisions
+- ``system.public.calibration`` — the decision plane's per-loop grading
+  (signed/abs relative-error EWMA + fast/slow windows) plus the exact
+  issued/resolved/expired/missed/unresolved accounting ledger — the
+  tenant simulator's reconciliation gate reads it
 """
 
 from __future__ import annotations
@@ -62,6 +71,8 @@ ALERTS_NAME = "system.public.alerts"
 SLO_NAME = "system.public.slo"
 QUERIES_NAME = "system.public.queries"
 DEVICE_NAME = "system.public.device"
+DECISIONS_NAME = "system.public.decisions"
+CALIBRATION_NAME = "system.public.calibration"
 
 
 class _VirtualTable(Table):
@@ -778,6 +789,192 @@ class DeviceTable(_VirtualTable):
         )
 
 
+_DECISIONS_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("id", DatumKind.UINT64, is_nullable=False),
+        ColumnSchema("loop", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("decision_key", DatumKind.STRING),
+        ColumnSchema("choice", DatumKind.STRING),
+        ColumnSchema("features", DatumKind.STRING),
+        ColumnSchema("predicted", DatumKind.DOUBLE),
+        ColumnSchema("resolved", DatumKind.BOOLEAN),
+        ColumnSchema("resolved_at", DatumKind.INT64),
+        ColumnSchema("actual", DatumKind.DOUBLE),
+        ColumnSchema("outcome", DatumKind.STRING),
+        ColumnSchema("error", DatumKind.DOUBLE),
+        ColumnSchema("trace_id", DatumKind.UINT64),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "id"],
+)
+
+
+class DecisionsTable(_VirtualTable):
+    """``system.public.decisions``: the decision journal as rows — one
+    per adaptive-loop decision with the choice, features-at-decision-
+    time (sorted-key JSON like events.attrs), the predicted value, and
+    — once resolved — the realized outcome and relative error. NULL
+    ``predicted``/``actual``/``error`` mean "not numeric-graded";
+    ``outcome='expired'`` rows aged out or were evicted unresolved."""
+
+    @property
+    def name(self) -> str:
+        return DECISIONS_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _DECISIONS_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..obs.decisions import DECISION_JOURNAL
+        from ..utils.events import render_attrs
+
+        entries = DECISION_JOURNAL.list()
+
+        def tid(e) -> int:
+            try:
+                return int(e["trace_id"] or 0)
+            except (TypeError, ValueError):
+                return 0
+
+        def opt(field) -> tuple[np.ndarray, np.ndarray]:
+            vals = np.array(
+                [
+                    0.0 if e[field] is None else float(e[field])
+                    for e in entries
+                ],
+                dtype=np.float64,
+            )
+            mask = np.array(
+                [e[field] is not None for e in entries], dtype=bool
+            )
+            return vals, mask
+
+        predicted, predicted_ok = opt("predicted")
+        actual, actual_ok = opt("actual")
+        error, error_ok = opt("error")
+        return RowGroup(
+            _DECISIONS_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [e["timestamp"] for e in entries], dtype=np.int64
+                ),
+                "id": np.array([e["id"] for e in entries], dtype=np.uint64),
+                "loop": np.array([e["loop"] for e in entries], dtype=object),
+                "decision_key": np.array(
+                    [e["key"] for e in entries], dtype=object
+                ),
+                "choice": np.array(
+                    [e["choice"] for e in entries], dtype=object
+                ),
+                "features": np.array(
+                    [render_attrs(e["features"]) for e in entries],
+                    dtype=object,
+                ),
+                "predicted": predicted,
+                "resolved": np.array(
+                    [bool(e["resolved"]) for e in entries], dtype=bool
+                ),
+                "resolved_at": np.array(
+                    [int(e["resolved_at"] or 0) for e in entries],
+                    dtype=np.int64,
+                ),
+                "actual": actual,
+                "outcome": np.array(
+                    [e["outcome"] for e in entries], dtype=object
+                ),
+                "error": error,
+                "trace_id": np.array(
+                    [tid(e) for e in entries], dtype=np.uint64
+                ),
+            },
+            validity={
+                "predicted": predicted_ok,
+                "actual": actual_ok,
+                "error": error_ok,
+            },
+        )
+
+
+_CALIBRATION_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("loop", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("samples", DatumKind.INT64),
+        ColumnSchema("ewma_signed", DatumKind.DOUBLE),
+        ColumnSchema("ewma_abs", DatumKind.DOUBLE),
+        ColumnSchema("fast_signed", DatumKind.DOUBLE),
+        ColumnSchema("fast_abs", DatumKind.DOUBLE),
+        ColumnSchema("fast_n", DatumKind.INT64),
+        ColumnSchema("slow_signed", DatumKind.DOUBLE),
+        ColumnSchema("slow_abs", DatumKind.DOUBLE),
+        ColumnSchema("slow_n", DatumKind.INT64),
+        ColumnSchema("miscalibrated", DatumKind.BOOLEAN),
+        ColumnSchema("issued", DatumKind.INT64),
+        ColumnSchema("resolved", DatumKind.INT64),
+        ColumnSchema("expired", DatumKind.INT64),
+        ColumnSchema("missed", DatumKind.INT64),
+        ColumnSchema("unresolved", DatumKind.INT64),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "loop"],
+)
+
+
+class CalibrationTable(_VirtualTable):
+    """``system.public.calibration``: one row per adaptive loop with the
+    decision plane's grading (relative-error EWMA + fast/slow window
+    means; NULL until the loop has a graded sample) and the exact
+    accounting ledger — ``issued == resolved + expired + unresolved``
+    holds on every read, the reconciliation the tenantsim gate asserts
+    from this table."""
+
+    @property
+    def name(self) -> str:
+        return CALIBRATION_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _CALIBRATION_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        import time
+
+        from ..obs.decisions import DECISION_JOURNAL
+
+        rows = DECISION_JOURNAL.calibration()
+        now = int(time.time() * 1000)
+        n = len(rows)
+
+        def opt(field) -> tuple[np.ndarray, np.ndarray]:
+            vals = np.array(
+                [
+                    0.0 if r[field] is None else float(r[field])
+                    for r in rows
+                ],
+                dtype=np.float64,
+            )
+            mask = np.array([r[field] is not None for r in rows], dtype=bool)
+            return vals, mask
+
+        cols: dict = {
+            "timestamp": np.full(n, now, dtype=np.int64),
+            "loop": np.array([r["loop"] for r in rows], dtype=object),
+            "miscalibrated": np.array(
+                [bool(r["miscalibrated"]) for r in rows], dtype=bool
+            ),
+        }
+        for f in ("samples", "fast_n", "slow_n", "issued", "resolved",
+                  "expired", "missed", "unresolved"):
+            cols[f] = np.array([int(r[f]) for r in rows], dtype=np.int64)
+        validity = {}
+        for f in ("ewma_signed", "ewma_abs", "fast_signed", "fast_abs",
+                  "slow_signed", "slow_abs"):
+            cols[f], validity[f] = opt(f)
+        return RowGroup(_CALIBRATION_SCHEMA, cols, validity=validity)
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -800,4 +997,8 @@ def open_system_table(catalog, name: str):
         return QueriesTable()
     if low == DEVICE_NAME:
         return DeviceTable()
+    if low == DECISIONS_NAME:
+        return DecisionsTable()
+    if low == CALIBRATION_NAME:
+        return CalibrationTable()
     return None

@@ -264,6 +264,75 @@ def cmd_events(ep: str, args) -> None:
     _print_rows(rows)
 
 
+def cmd_decisions(ep: str, args) -> None:
+    """The decision plane (/debug/decisions): journaled adaptive-loop
+    decisions (`decisions list`) or the per-loop calibration verdicts
+    and accounting ledger (`decisions calibration`)."""
+    if args.action == "calibration":
+        data = json.loads(_get(ep, "/debug/decisions?limit=0"))
+        rows = [
+            {
+                "loop": r["loop"],
+                "samples": r["samples"],
+                "ewma_signed": (
+                    round(r["ewma_signed"], 4)
+                    if r["ewma_signed"] is not None else ""
+                ),
+                "ewma_abs": (
+                    round(r["ewma_abs"], 4)
+                    if r["ewma_abs"] is not None else ""
+                ),
+                "fast_abs": (
+                    round(r["fast_abs"], 4)
+                    if r["fast_abs"] is not None else ""
+                ),
+                "slow_abs": (
+                    round(r["slow_abs"], 4)
+                    if r["slow_abs"] is not None else ""
+                ),
+                "miscalibrated": r["miscalibrated"],
+                "issued": r["issued"],
+                "resolved": r["resolved"],
+                "expired": r["expired"],
+                "missed": r["missed"],
+                "unresolved": r["unresolved"],
+            }
+            for r in data["calibration"]
+        ]
+        _print_rows(rows)
+        s = data["stats"]
+        print(
+            f"\nring: size={s['size']}/{s['capacity']}  "
+            f"dropped={s['dropped']}  issued={s['issued']}"
+        )
+        return
+    qs = f"?limit={args.limit}"
+    if args.loop:
+        qs += f"&loop={args.loop}"
+    data = json.loads(_get(ep, f"/debug/decisions{qs}"))
+    rows = [
+        {
+            "id": e["id"],
+            "timestamp": e["timestamp"],
+            "loop": e["loop"],
+            "key": e["key"][:48],
+            "choice": e["choice"],
+            "predicted": (
+                round(e["predicted"], 6) if e["predicted"] is not None else ""
+            ),
+            "actual": (
+                round(e["actual"], 6) if e["actual"] is not None else ""
+            ),
+            "error": (
+                round(e["error"], 4) if e["error"] is not None else ""
+            ),
+            "outcome": e["outcome"],
+        }
+        for e in data["decisions"]
+    ]
+    _print_rows(rows)
+
+
 def cmd_rules(ep: str, args) -> None:
     """rules list|add|rm against /admin/rules (mirrors `events tail`)."""
     if args.action == "list":
@@ -431,6 +500,11 @@ def main(argv=None) -> int:
     ev.add_argument("action", nargs="?", default="tail", choices=["tail"])
     ev.add_argument("--kind", default=None)
     ev.add_argument("--limit", type=int, default=20)
+    de = sub.add_parser("decisions")
+    de.add_argument("action", nargs="?", default="list",
+                    choices=["list", "calibration"])
+    de.add_argument("--loop", default=None)
+    de.add_argument("--limit", type=int, default=20)
     rl = sub.add_parser("rules")
     rl_sub = rl.add_subparsers(dest="action", required=True)
     rl_sub.add_parser("list")

@@ -159,6 +159,12 @@ class SimConfig:
     batch: bool = True
     batch_window_s: float = 0.002
     batch_max_cohort: int = 32
+    # decision plane (ISSUE 16): with dtype_auto the sim runs
+    # HORAEDB_CACHE_DTYPE=auto plus a dedicated panel table whose value
+    # column is only ever min/max'd by the workload — bf16-resident by
+    # the tuner's own choice — and a post-run sum forces the graded
+    # f32 PROMOTION the decision journal must carry
+    dtype_auto: bool = False
 
 
 @dataclass
@@ -207,6 +213,15 @@ class SimReport:
     elastic_quarantines: int = 0
     elastic_move_expected: bool = False
     hot_tables: list = field(default_factory=list)
+    # decision plane (ISSUE 16), from system.public.decisions +
+    # system.public.calibration: per active loop, >= 1 resolved decision
+    # row, a finite calibration verdict, and exact accounting
+    # (issued == resolved + expired + unresolved)
+    decision_active_loops: list = field(default_factory=list)
+    decision_resolved_counts: dict = field(default_factory=dict)
+    decision_counts: dict = field(default_factory=dict)
+    calibration_verdicts: dict = field(default_factory=dict)
+    decision_unaccounted: int = -1
     notes: list = field(default_factory=list)
 
     def violations(self) -> list[str]:
@@ -297,6 +312,26 @@ class SimReport:
                     "admission slots leaked after the deadline storm "
                     f"(units_in_use={self.admission_units_after})"
                 )
+        # the decision plane's standing gate (ISSUE 16): every ACTIVE
+        # adaptive loop shows decision rows and a finite calibration
+        # verdict from the database's own tables, and the journal's
+        # accounting reconciles exactly — zero unaccounted decisions
+        for loop in self.decision_active_loops:
+            if self.decision_resolved_counts.get(loop, 0) < 1:
+                out.append(
+                    f"decision plane: no resolved {loop} decision in "
+                    "system.public.decisions"
+                )
+            if not self.calibration_verdicts.get(loop):
+                out.append(
+                    f"decision plane: no finite {loop} calibration "
+                    "verdict in system.public.calibration"
+                )
+        if self.decision_active_loops and self.decision_unaccounted != 0:
+            out.append(
+                f"decision plane: {self.decision_unaccounted} decision(s) "
+                "unaccounted (issued != resolved + expired + unresolved)"
+            )
         if self.served == 0:
             out.append("no queries served at all")
         return out
@@ -780,6 +815,24 @@ class TenantSim:
     def _table(self, j: int) -> str:
         return f"tsim_cpu{j}"
 
+    def _dtype_table(self) -> str:
+        return "tsim_dstat"
+
+    def _dtype_minmax_sql(self) -> str:
+        # the dtype table's ONLY workload shape: min/max, never sum —
+        # under HORAEDB_CACHE_DTYPE=auto the tuner stores v bf16
+        return (
+            f"SELECT host, min(v) AS mn, max(v) AS mx FROM "
+            f"{self._dtype_table()} GROUP BY host"
+        )
+
+    def _dtype_sum_sql(self) -> str:
+        # the usage GROWTH that forces the graded f32 promotion
+        return (
+            f"SELECT host, sum(v) AS s, max(v) AS mx FROM "
+            f"{self._dtype_table()} GROUP BY host"
+        )
+
     def _sql(self, endpoint: str, query: str, tenant: str = "default",
              timeout: float = 20.0, timeout_ms: Optional[float] = None):
         headers = {}
@@ -890,6 +943,41 @@ class TenantSim:
                     desc=f"reference query for t{t}",
                 )
                 self._refs.append((q, name, out["rows"]))
+        if cfg.dtype_auto:
+            # the dtype-tuner panel table: seeded once, flushed, never
+            # ingested into (a stable base fingerprint so the scan cache
+            # can build), and only ever min/max'd by the workload
+            name = self._dtype_table()
+            self._seed_call(
+                "POST", f"http://{eps[0]}/sql",
+                {"query": (
+                    f"CREATE TABLE {name} (tenant string TAG, host string "
+                    "TAG, v double, ts timestamp NOT NULL, "
+                    "TIMESTAMP KEY(ts)) ENGINE=Analytic WITH "
+                    "(update_mode='append', segment_duration='2h', "
+                    "write_buffer_size='2mb')"
+                )},
+                desc=f"DDL {name}",
+            )
+            owner = self._owner(name)
+            drng = random.Random(cfg.seed + 31)
+            rows = [
+                {
+                    "tenant": f"t{i % cfg.tenants}",
+                    "host": f"h{i % 17}",
+                    "v": round(drng.gauss(10.0, 3.0), 4),
+                    "ts": base + i * 977,
+                }
+                for i in range(1500)
+            ]
+            self._seed_call(
+                "POST", f"http://{owner}/write",
+                {"table": name, "rows": rows}, desc=f"seed write {name}",
+            )
+            self._seed_call(
+                "POST", f"http://{owner}/admin/flush?table={name}", {},
+                desc=f"seed flush {name}",
+            )
         # deliberately tiny read quota for a few tenants: quota_reject
         # events + 429s are part of the workload the plane must absorb
         for t in range(min(cfg.quota_tenants, cfg.tenants)):
@@ -1010,6 +1098,13 @@ class TenantSim:
                     )
                     s, _ = self._sql(ep, q, tenant=f"t{t}", timeout=20)
                     self._note_status(s, checked=False, ok=True)
+                elif cfg.dtype_auto and roll >= 0.95:
+                    # min/max-only panel on the dtype table — the usage
+                    # the auto tuner learns bf16 from; the sum that
+                    # forces the graded promotion runs at collection
+                    s, _ = self._sql(ep, self._dtype_minmax_sql(),
+                                     timeout=20)
+                    self._note_status(s, checked=False, ok=True)
                 else:
                     # PromQL over the self-monitoring history
                     s, _ = _http(
@@ -1093,7 +1188,12 @@ class TenantSim:
         from ..utils.events import EVENT_STORE
 
         cfg = self.cfg
+        prior_dtype = os.environ.get("HORAEDB_CACHE_DTYPE")
         try:
+            if cfg.dtype_auto:
+                # the learned per-column dtype mode (the scan cache is
+                # process-global, so the env knob reaches every node)
+                os.environ["HORAEDB_CACHE_DTYPE"] = "auto"
             if self._own_cluster:
                 self.cluster.start()
             self._events_before = EVENT_STORE.stats()
@@ -1125,6 +1225,11 @@ class TenantSim:
             self._settle()
             self._collect()
         finally:
+            if cfg.dtype_auto:
+                if prior_dtype is None:
+                    os.environ.pop("HORAEDB_CACHE_DTYPE", None)
+                else:
+                    os.environ["HORAEDB_CACHE_DTYPE"] = prior_dtype
             if self._own_cluster:
                 self.cluster.close()
         return self.report
@@ -1537,6 +1642,11 @@ class TenantSim:
         self.report.acked_rows_checked = len(sample)
         self.report.acked_rows_missing = missing
 
+        # --- decision plane (ISSUE 16): every active adaptive loop must
+        # have journaled choices, realized outcomes, and a calibration
+        # verdict — all read back from the database's own tables ---
+        self._collect_decisions(ep)
+
         # --- post-kill recovery: frozen-range reads still agree.
         # "never answered" (still converging / unavailable) and "answered
         # WRONG" are different failures — only a 200 that disagrees is a
@@ -1564,6 +1674,93 @@ class TenantSim:
                             f"post-kill reference never answered: {q[:80]}"
                         )
             self.report.kill_recovered = recovered
+
+    def _collect_decisions(self, ep: str) -> None:
+        """Decision-plane gates (ISSUE 16), from the database's own
+        ``system.public.decisions`` / ``system.public.calibration``: per
+        ACTIVE loop >= 1 resolved decision and a finite calibration
+        verdict, and the journal's accounting must reconcile exactly
+        (issued == resolved + expired + unresolved per loop — the ring's
+        unresolved evictions and TTL expiries are both counted expired,
+        so nothing ever goes missing silently)."""
+        cfg = self.cfg
+        active = ["kernel_router", "admission"]
+        if cfg.deadline_phase is not None:
+            active.append("deadline")
+        if cfg.elastic:
+            active.append("elastic")
+        if cfg.dtype_auto:
+            active.append("dtype_tuner")
+        self.report.decision_active_loops = active
+
+        if cfg.dtype_auto:
+            # deterministic tuner activation: two sightings build the
+            # cache entry (v bf16-resident — its only observed usage is
+            # min/max), then the sum GROWS the usage and forces the
+            # promotion: decision recorded at the bf16 drop, resolved at
+            # the f32 re-upload inside the same serving call
+            for _ in range(3):
+                self._sql(ep, self._dtype_minmax_sql(), timeout=20)
+            self._sql(ep, self._dtype_sum_sql(), timeout=20)
+        # post-run refresh of the expensive dashboard shape, unbudgeted:
+        # a full multi-agg scan takes the segment-kernel route (the
+        # cohort batcher owns the cheap shapes, so this is what keeps
+        # the kernel-router loop exercised in every config), and when a
+        # deadline storm ran, its ok completion resolves still-pending
+        # shed decisions (graded doomed vs premature against realized
+        # cost) — the storm's shape must not dangle unresolved. Two
+        # passes: the first pick of a fresh shape has no router timing
+        # history (predicted=None, honest but ungradable); the second
+        # pick predicts from the first's recorded seconds and GRADES.
+        for _ in range(2):
+            for j in range(cfg.tables):
+                self._sql(
+                    ep,
+                    f"SELECT tenant, count(v) AS c, sum(v) AS s, "
+                    f"min(v) AS mn, max(v) AS mx FROM {self._table(j)} "
+                    "GROUP BY tenant",
+                    tenant="storm", timeout=30,
+                )
+
+        s, out = self._sql(
+            ep, "SELECT loop, resolved FROM system.public.decisions",
+            timeout=10,
+        )
+        if s == 200:
+            counts: dict = {}
+            for r in out.get("rows", []):
+                if r.get("resolved"):
+                    lp = r.get("loop", "?")
+                    counts[lp] = counts.get(lp, 0) + 1
+            self.report.decision_resolved_counts = counts
+
+        s, out = self._sql(
+            ep,
+            "SELECT loop, samples, ewma_abs, issued, resolved, expired, "
+            "missed, unresolved FROM system.public.calibration",
+            timeout=10,
+        )
+        if s == 200:
+            unaccounted = 0
+            for r in out.get("rows", []):
+                lp = r.get("loop", "?")
+                c = {
+                    k: int(r.get(k) or 0)
+                    for k in ("issued", "resolved", "expired", "missed",
+                              "unresolved")
+                }
+                self.report.decision_counts[lp] = c
+                unaccounted += abs(
+                    c["issued"] - c["resolved"] - c["expired"]
+                    - c["unresolved"]
+                )
+                e = r.get("ewma_abs")
+                self.report.calibration_verdicts[lp] = bool(
+                    int(r.get("samples") or 0) >= 1
+                    and e is not None
+                    and math.isfinite(float(e))
+                )
+            self.report.decision_unaccounted = unaccounted
 
 
 def run_sim(cfg: SimConfig) -> SimReport:

@@ -47,6 +47,7 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     self_scrape_interval = "10s"      # into system_metrics.samples
     self_metrics_retention = "24h"    # 0s = keep forever
     event_ring = 512                  # bounded event-journal capacity
+    decision_ring = 1024              # bounded decision-journal capacity
 
     [rules]
     enabled = true                    # continuous-query engine (rules/)
@@ -245,6 +246,10 @@ class ObservabilitySection:
     # bounded event-journal (utils/events) ring capacity; drops are
     # accounted in horaedb_events_dropped_total and /debug/status
     event_ring: int = 512
+    # bounded decision-journal (obs/decisions) ring capacity; drops are
+    # accounted in horaedb_decision_dropped_total and every eviction of
+    # an unresolved entry is a counted expiry
+    decision_ring: int = 1024
 
 
 @dataclass
@@ -418,7 +423,7 @@ _KNOWN = {
     "wlm": {"batch"},
     "observability": {
         "self_scrape", "self_scrape_interval", "self_metrics_retention",
-        "event_ring",
+        "event_ring", "decision_ring",
     },
     "rules": {
         "enabled", "eval_interval", "grace", "recording", "alerts",
@@ -556,6 +561,10 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.observability.event_ring = int(o["event_ring"])
         if cfg.observability.event_ring < 1:
             raise ConfigError("observability.event_ring must be >= 1")
+    if "decision_ring" in o:
+        cfg.observability.decision_ring = int(o["decision_ring"])
+        if cfg.observability.decision_ring < 1:
+            raise ConfigError("observability.decision_ring must be >= 1")
     ru = raw.get("rules", {})
     if "enabled" in ru:
         if not isinstance(ru["enabled"], bool):

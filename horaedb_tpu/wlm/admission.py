@@ -281,7 +281,8 @@ class AdmissionController:
 
     @contextmanager
     def admit(self, cls: str, deadline_s: Optional[float] = None,
-              est_cost_s: Optional[float] = None):
+              est_cost_s: Optional[float] = None,
+              shape: Optional[str] = None):
         """Block until a slot frees (bounded queue + deadline), then run
         the body holding the slot. Records the queue wait into the
         current query ledger (``admission_wait_seconds``).
@@ -319,6 +320,26 @@ class AdmissionController:
                     record_event(
                         "admission_shed",
                         **{"class": cls, "reason": "deadline_budget"},
+                    )
+                    # Decision plane: was this shed provably doomed?
+                    # Journaled with the predicted cost + remaining
+                    # budget; the proxy resolves it when a later
+                    # same-shape query completes (actual seconds >=
+                    # the remaining budget here -> "doomed", else the
+                    # shed was premature and the estimator is graded
+                    # by the signed error either way.
+                    from ..obs.decisions import record_decision
+
+                    record_decision(
+                        "deadline",
+                        key=shape if shape else cls,
+                        choice="shed",
+                        features={
+                            "class": cls,
+                            "remaining_s": round(rem, 6),
+                            "budget_ms": budget.budget_ms or 0,
+                        },
+                        predicted=est_cost_s,
                     )
                     raise DeadlineExceeded(
                         f"remaining budget {rem * 1000:.0f}ms cannot fit "

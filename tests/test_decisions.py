@@ -1,0 +1,259 @@
+"""Decision-journal semantics (ISSUE 16 tentpole).
+
+The journal's accounting contract is the whole point — a decision is
+always in exactly one of {resolved, expired, unresolved}, late resolves
+are counted misses, ring evictions of unresolved entries are counted
+expiries, and the incremental calibration windows must agree with a
+naive refold. These tests pin that contract on private journal
+instances (the process-global DECISION_JOURNAL is exercised end-to-end
+by the tenantsim gates)."""
+
+import math
+import random
+
+import pytest
+
+from horaedb_tpu.obs.decisions import (
+    DECISION_LOOPS,
+    DecisionJournal,
+    _ErrWindow,
+    _LoopCalibration,
+)
+
+
+def _reconciles(j: DecisionJournal) -> list:
+    """issued == resolved + expired + unresolved, per loop."""
+    bad = []
+    s = j.stats()
+    for loop, c in s["loops"].items():
+        if c["issued"] != c["resolved"] + c["expired"] + c["unresolved"]:
+            bad.append((loop, c))
+    return bad
+
+
+class TestJournalAccounting:
+    def test_record_resolve_roundtrip(self):
+        j = DecisionJournal(maxlen=8)
+        i = j.record("admission", key="shape-a", choice="cheap",
+                     features={"est_ms": 12.0}, predicted=0.012)
+        assert i > 0
+        assert j.resolve(i, actual=0.018, outcome="ok", loop="admission")
+        (e,) = j.list(loop="admission")
+        assert e["resolved"] and e["outcome"] == "ok"
+        assert e["error"] == pytest.approx((0.018 - 0.012) / 0.012)
+        assert not _reconciles(j)
+
+    def test_undeclared_loop_refused(self):
+        j = DecisionJournal(maxlen=8)
+        with pytest.raises(ValueError, match="undeclared decision loop"):
+            j.record("mystery", key="k", choice="c")
+
+    def test_ring_rollover_exact_drop_accounting(self):
+        """Overflow evicts oldest-first; every eviction ticks dropped,
+        and an UNRESOLVED victim is additionally counted expired — the
+        ledger reconciles exactly through the rollover."""
+        j = DecisionJournal(maxlen=4)
+        ids = [j.record("elastic", key=f"s{i}", choice="hold")
+               for i in range(10)]
+        # resolve two of the still-live tail so both kinds of victims
+        # (resolved and unresolved) roll off in later overflow
+        assert j.resolve(ids[6], actual=1.0, loop="elastic")
+        assert j.resolve(ids[7], actual=1.0, loop="elastic")
+        for i in range(10, 16):
+            j.record("elastic", key=f"s{i}", choice="hold")
+        s = j.stats()
+        assert s["size"] == 4
+        assert s["dropped"] == 12  # 16 issued, capacity 4
+        c = s["loops"]["elastic"]
+        assert c["issued"] == 16
+        assert c["resolved"] == 2
+        # every unresolved entry that rolled off is a counted expiry
+        assert c["expired"] == 10
+        assert c["unresolved"] == 4
+        assert not _reconciles(j)
+
+    def test_resolve_after_rollover_is_counted_miss(self):
+        """A resolve whose id already rolled off must be a counted miss
+        attributed to the caller's loop — never a KeyError, never a
+        silent nothing."""
+        j = DecisionJournal(maxlen=2)
+        first = j.record("deadline", key="shape", choice="shed")
+        for i in range(4):  # roll `first` off the ring
+            j.record("deadline", key=f"k{i}", choice="shed")
+        assert j.resolve(first, actual=1.0, loop="deadline") is False
+        assert j.stats()["loops"]["deadline"]["missed"] == 1
+        # a miss with no loop attribution is tolerated but unattributed
+        assert j.resolve(999_999) is False
+        assert j.stats()["loops"]["deadline"]["missed"] == 1
+        assert not _reconciles(j)
+
+    def test_unresolved_expiry_accounting(self, monkeypatch):
+        """Unresolved decisions past HORAEDB_DECISION_EXPIRE_MS are lazily
+        counted expired; their late resolve is then a miss."""
+        j = DecisionJournal(maxlen=8)
+        i = j.record("dtype_tuner", key="t:c", choice="promote_f32",
+                     predicted=100.0)
+        monkeypatch.setenv("HORAEDB_DECISION_EXPIRE_MS", "0.0001")
+        # any verb triggers the lazy head-expiry scan
+        s = j.stats()
+        c = s["loops"]["dtype_tuner"]
+        assert c["expired"] == 1 and c["unresolved"] == 0
+        monkeypatch.delenv("HORAEDB_DECISION_EXPIRE_MS")
+        assert j.resolve(i, actual=200.0, loop="dtype_tuner") is False
+        assert j.stats()["loops"]["dtype_tuner"]["missed"] == 1
+        (e,) = j.list(loop="dtype_tuner")
+        assert e["outcome"] == "expired" and not e["resolved"]
+        assert not _reconciles(j)
+
+    def test_resolve_matching_oldest_first_and_zero_match_is_not_miss(self):
+        j = DecisionJournal(maxlen=8)
+        a = j.record("deadline", key="shape", choice="shed", predicted=0.5,
+                     features={"remaining_s": 0.1})
+        b = j.record("deadline", key="shape", choice="shed", predicted=0.5,
+                     features={"remaining_s": 0.4})
+        n = j.resolve_matching(
+            "deadline", "shape", actual=0.2,
+            outcome=lambda e: (
+                "doomed" if 0.2 >= e["features"]["remaining_s"]
+                else "premature"
+            ),
+        )
+        assert n == 2
+        by_id = {e["id"]: e for e in j.list(loop="deadline")}
+        assert by_id[a]["outcome"] == "doomed"
+        assert by_id[b]["outcome"] == "premature"
+        # a completion with nothing pending resolves nothing and counts
+        # no miss — nothing was issued for it
+        assert j.resolve_matching("deadline", "shape", actual=0.2) == 0
+        assert j.stats()["loops"]["deadline"]["missed"] == 0
+        assert not _reconciles(j)
+
+    def test_resize_shrink_accounts_like_overflow(self):
+        j = DecisionJournal(maxlen=8)
+        ids = [j.record("admission", key=f"k{i}", choice="cheap")
+               for i in range(8)]
+        j.resolve(ids[0], actual=1.0, loop="admission")
+        j.resize(3)
+        s = j.stats()
+        assert s["capacity"] == 3 and s["size"] == 3
+        assert s["dropped"] == 5
+        c = s["loops"]["admission"]
+        assert c["expired"] == 4  # 5 discarded, 1 of them was resolved
+        assert not _reconciles(j)
+
+    def test_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("HORAEDB_DECISIONS", "0")
+        j = DecisionJournal(maxlen=8)
+        assert j.record("admission", key="k", choice="c") == 0
+        assert j.resolve(0) is False
+        s = j.stats()
+        assert s["issued"] == 0 and s["size"] == 0
+        assert s["loops"]["admission"]["missed"] == 0
+
+    def test_list_limit_zero_means_zero(self):
+        j = DecisionJournal(maxlen=8)
+        j.record("elastic", key="k", choice="hold")
+        assert j.list(limit=0) == []
+        assert len(j.list(limit=1)) == 1
+
+    def test_reconciliation_under_random_ops(self):
+        """Property: whatever interleaving of record / resolve /
+        resolve_matching / resize hits the journal, the per-loop ledger
+        reconciles exactly at every step."""
+        rng = random.Random(11)
+        j = DecisionJournal(maxlen=16)
+        live: list = []
+        for step in range(400):
+            op = rng.random()
+            if op < 0.55:
+                loop = rng.choice(DECISION_LOOPS)
+                live.append(
+                    (loop, j.record(loop, key=f"k{rng.randrange(6)}",
+                                    choice="c", predicted=rng.random()))
+                )
+            elif op < 0.8 and live:
+                loop, did = live.pop(rng.randrange(len(live)))
+                j.resolve(did, actual=rng.random(), loop=loop)
+            elif op < 0.9:
+                j.resolve_matching(
+                    rng.choice(DECISION_LOOPS), f"k{rng.randrange(6)}",
+                    actual=rng.random(),
+                )
+            else:
+                j.resize(rng.choice((4, 8, 16)))
+            assert not _reconciles(j), f"step {step}"
+
+
+class TestCalibrationWindows:
+    def test_incremental_window_matches_naive_refold(self):
+        """Property: the running-sums window equals a from-scratch refold
+        over the retained span at every push — no drift, no stale sums."""
+        rng = random.Random(7)
+        w = _ErrWindow(span_ms=1000.0)
+        pushed: list = []
+        now = 0.0
+        for _ in range(500):
+            now += rng.random() * 120.0
+            signed = rng.uniform(-3.0, 3.0)
+            w.push(now, signed, abs(signed))
+            pushed.append((now, signed))
+            got_signed, got_abs, got_n = w.means(now)
+            keep = [(t, s) for t, s in pushed if t > now - 1000.0]
+            assert got_n == len(keep)
+            naive_signed = sum(s for _, s in keep) / len(keep)
+            naive_abs = sum(abs(s) for _, s in keep) / len(keep)
+            assert got_signed == pytest.approx(naive_signed, abs=1e-9)
+            assert got_abs == pytest.approx(naive_abs, abs=1e-9)
+
+    def test_empty_window_means_none(self):
+        w = _ErrWindow(span_ms=10.0)
+        w.push(0.0, 1.0, 1.0)
+        assert w.means(1e6) == (None, None, 0)
+
+    def test_miscalibration_transition_and_recovery(self):
+        """loop_miscalibrated fires exactly on the transition into the
+        state (fast_n >= 8, both windows over 0.5 abs error) and the
+        state clears when the fast window does."""
+        cal = _LoopCalibration("admission", fast_ms=100.0, slow_ms=1e9)
+        now = 0.0
+        fired = []
+        for i in range(12):
+            now += 1.0
+            r = cal.push(now, 2.0)  # 200% error every sample
+            if r is not None:
+                fired.append((i, r))
+        assert len(fired) == 1, fired
+        assert fired[0][0] == 7  # the 8th sample crosses MIN_SAMPLES
+        assert fired[0][1]["loop"] == "admission"
+        assert cal.miscalibrated
+        # fast window drains past its span with good samples -> recover
+        now += 1000.0
+        assert cal.push(now, 0.0) is None
+        assert not cal.miscalibrated
+        # re-entering the state fires again
+        for i in range(10):
+            now += 1.0
+            cal.push(now, 2.0)
+        assert cal.miscalibrated
+
+    def test_calibration_rows_carry_ledger(self):
+        j = DecisionJournal(maxlen=8)
+        i = j.record("elastic", key="s", choice="hold", predicted=2.0)
+        j.resolve(i, actual=3.0, loop="elastic")
+        row = {r["loop"]: r for r in j.calibration()}["elastic"]
+        assert row["samples"] == 1
+        assert row["ewma_signed"] == pytest.approx(0.5)
+        assert row["ewma_abs"] == pytest.approx(0.5)
+        assert row["issued"] == 1 and row["resolved"] == 1
+        assert row["unresolved"] == 0 and row["expired"] == 0
+        assert math.isfinite(row["fast_abs"])
+
+    def test_uncalibrated_resolve_not_graded(self):
+        j = DecisionJournal(maxlen=8)
+        i = j.record("kernel_router", key="k", choice="mxu", predicted=0.1)
+        j.resolve(i, actual=9.9, outcome="degenerate", loop="kernel_router",
+                  calibrate=False)
+        row = {r["loop"]: r for r in j.calibration()}["kernel_router"]
+        assert row["samples"] == 0 and row["ewma_abs"] is None
+        (e,) = j.list(loop="kernel_router")
+        assert e["resolved"] and e["error"] is None

@@ -1656,6 +1656,101 @@ class TestSloRegistryLint:
             db.close()
 
 
+class TestDecisionRegistryLint:
+    """ISSUE-16 lint extension (same contract as the slo/elastic/replica
+    registries) for the decision plane: every family declared in
+    obs/decisions.DECISION_METRIC_FAMILIES and
+    CALIBRATION_METRIC_FAMILIES must be (a) registered live — the
+    per-loop series eagerly at module import for every declared loop,
+    the calibration error gauge with every window/kind label — (b)
+    convention-clean, (c) documented in docs/OBSERVABILITY.md; no stray
+    horaedb_decision_*/horaedb_calibration_* family may exist outside
+    the declared registries. The [observability] decision_ring knob and
+    the plane's env switches are operator surface: pinned to
+    docs/WORKLOAD.md. The decision event kinds must be declared in
+    EVENT_KINDS (counters + docs ride the event-kind lint)."""
+
+    def test_decision_families_declared_and_documented(self):
+        import os
+        import re
+
+        from horaedb_tpu.obs.decisions import (
+            CALIBRATION_ERROR_KINDS,
+            CALIBRATION_METRIC_FAMILIES,
+            CALIBRATION_WINDOWS,
+            DECISION_LOOPS,
+            DECISION_METRIC_FAMILIES,
+        )
+        from horaedb_tpu.utils.events import EVENT_KINDS
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        exposed = REGISTRY.expose()
+        missing = []
+        for fam in DECISION_METRIC_FAMILIES + CALIBRATION_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for loop in DECISION_LOOPS:
+            if f'loop="{loop}"' not in exposed:
+                missing.append(f"label loop={loop}: not eagerly registered")
+        for window in CALIBRATION_WINDOWS:
+            if f'window="{window}"' not in exposed:
+                missing.append(
+                    f"label window={window}: not eagerly registered"
+                )
+        for kind in CALIBRATION_ERROR_KINDS:
+            if f'kind="{kind}"' not in exposed:
+                missing.append(f"label kind={kind}: not eagerly registered")
+        for fam in families:
+            if (fam.startswith("horaedb_decision_")
+                    and fam not in DECISION_METRIC_FAMILIES) or \
+                    (fam.startswith("horaedb_calibration_")
+                     and fam not in CALIBRATION_METRIC_FAMILIES):
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("decision_ring", "HORAEDB_DECISIONS",
+                     "HORAEDB_DECISION_EXPIRE_MS",
+                     "HORAEDB_CALIBRATION_FAST_S"):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        for kind in ("decision_resolved", "loop_miscalibrated"):
+            if kind not in EVENT_KINDS:
+                missing.append(f"event kind {kind}: undeclared in EVENT_KINDS")
+        assert not missing, missing
+
+    def test_decision_tables_registered_in_system_catalog(self):
+        from horaedb_tpu.obs.decisions import DECISION_JOURNAL
+        from horaedb_tpu.table_engine.system import (
+            CALIBRATION_NAME,
+            DECISIONS_NAME,
+            open_system_table,
+        )
+
+        t = open_system_table(None, DECISIONS_NAME)
+        cols = {c.name for c in t.schema.columns}
+        assert {"id", "loop", "decision_key", "choice", "features",
+                "predicted", "resolved", "actual", "outcome",
+                "error", "trace_id"} <= cols
+        c = open_system_table(None, CALIBRATION_NAME)
+        ccols = {cc.name for cc in c.schema.columns}
+        assert {"loop", "samples", "ewma_signed", "ewma_abs",
+                "fast_abs", "slow_abs", "miscalibrated", "issued",
+                "resolved", "expired", "missed", "unresolved"} <= ccols
+        # one row per declared loop, always — the ledger is never absent
+        rg = c._materialize()
+        from horaedb_tpu.obs.decisions import DECISION_LOOPS
+        assert set(rg.columns["loop"]) == set(DECISION_LOOPS)
+        assert DECISION_JOURNAL.stats()["capacity"] > 0
+
+
 class TestElasticRegistryLint:
     """PR-12 lint extension (same contract as the slo/replica/rules
     registries) for the elastic control loop: every family declared in

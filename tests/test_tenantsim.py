@@ -50,6 +50,11 @@ def _smoke_config() -> SimConfig:
         # the admission-slot drain are all gated in violations()
         deadline_phase=(0.2, 0.45),
         deadline_budget_ms=150.0,
+        # decision plane (ISSUE 16): run the learned per-column dtype
+        # mode plus the min/max-only panel table so the dtype tuner's
+        # graded promotion joins the kernel-router / admission /
+        # deadline loops in system.public.decisions
+        dtype_auto=True,
         kill_at=0.65,
         lease_flap_at=None,
         shard_move_at=None,
@@ -85,6 +90,17 @@ class TestTenantSimSmoke:
         assert report.deadline_overdue == 0, detail
         assert report.deadline_timeout_events >= 1, detail
         assert report.admission_units_after in (0, 1), detail
+        # the decision plane's standing gate (ISSUE 16): every loop the
+        # smoke activates shows resolved decisions + a finite calibration
+        # verdict in the database's own tables, with exact accounting —
+        # violations() enforced it; pin the active-loop set here too
+        assert set(report.decision_active_loops) == {
+            "kernel_router", "admission", "deadline", "dtype_tuner",
+        }, detail
+        for loop in report.decision_active_loops:
+            assert report.decision_resolved_counts.get(loop, 0) >= 1, detail
+            assert report.calibration_verdicts.get(loop), detail
+        assert report.decision_unaccounted == 0, detail
 
 
 def _elastic_config() -> SimConfig:
@@ -144,6 +160,14 @@ class TestTenantSimElastic:
         # zero wrong answers and a flat cheap p99 THROUGH the moves
         assert report.wrong_answers == 0, detail
         assert report.cheap_objective_breaches == 0, detail
+        # the elastic loop's forecasts are journaled and graded: each
+        # round's persistence forecast of hot-shard pressure resolves
+        # against the NEXT round's realized qps (ISSUE 16 unified the
+        # controller's private ring onto the decision journal)
+        assert "elastic" in report.decision_active_loops, detail
+        assert report.decision_resolved_counts.get("elastic", 0) >= 1, detail
+        assert report.calibration_verdicts.get("elastic"), detail
+        assert report.decision_unaccounted == 0, detail
 
 
 @pytest.mark.slow
