@@ -48,6 +48,14 @@ target list:
                         admission record/resolve per query) vs
                         HORAEDB_DECISIONS=0, interleaved min-of-N;
                         gate: on within 2% of off
+    livewindow          steady-state dashboard-refresh latency under
+                        concurrent ingest: the open-tail (time_bucket
+                        1m x host) panel served from device ring state
+                        (route=livewindow) vs the same query forced
+                        raw (HORAEDB_LIVEWINDOW=0); equivalence
+                        checked with ingest quiesced; also times the
+                        PromQL increase() face (write-time folded
+                        counter partials vs the raw chain fold)
 
 An all-configs run (no BENCH_CONFIG) honours BENCH_WALL_BUDGET seconds:
 stages that no longer fit are skipped with an explicit emitted line and
@@ -1592,6 +1600,182 @@ def run_rollup_config() -> dict:
     }
 
 
+LIVEWINDOW_ROWS = int(os.environ.get("BENCH_LIVEWINDOW_ROWS", "300000"))
+
+
+def run_livewindow_config() -> dict:
+    """Steady-state dashboard-refresh latency under concurrent ingest:
+    the open-tail (time_bucket 1m x host) panel served from device ring
+    state (route=livewindow) vs the same query forced raw
+    (HORAEDB_LIVEWINDOW=0). Each arm measures with a live trickle
+    ingest running; equivalence is checked between arms with ingest
+    quiesced (state answers must equal the raw rescan). Also times the
+    PromQL increase() face of the same state (write-time folded counter
+    partials vs the raw host-side chain fold)."""
+    import threading
+
+    import jax
+
+    import horaedb_tpu
+    from horaedb_tpu.common_types import RowGroup
+    from horaedb_tpu.common_types.schema import compute_tsid
+    from horaedb_tpu.proxy.promql import evaluate_expr_range, parse_promql
+    from horaedb_tpu.state.livewindow import STORE
+
+    platform = jax.devices()[0].platform
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+    STORE.clear()
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE panel (host string TAG, value double NOT NULL, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+        "WITH (segment_duration='2h', update_mode='append')"
+    )
+    schema = db.catalog.open("panel").schema
+    t = db.catalog.open("panel")
+    rng = np.random.default_rng(7)
+    w = 60_000
+    live_start = (1_786_000_000_000 // w) * w
+    seed_start = live_start - 120 * w
+
+    def mk_batch(lo, hi, n):
+        hosts = np.array(
+            [f"host_{i}" for i in rng.integers(0, 8, n)], dtype=object
+        )
+        ts = np.sort(rng.integers(lo, hi, n).astype(np.int64))
+        return RowGroup(schema, {
+            "tsid": compute_tsid([hosts]),
+            "ts": ts,
+            "host": hosts,
+            "value": rng.normal(10.0, 3.0, n),
+        })
+
+    # older-than-the-panel history (below the promotion watermark)
+    t.write(mk_batch(seed_start, live_start, 20_000))
+
+    sql = (
+        f"SELECT time_bucket(ts, '1m') AS b, host, avg(value) AS v, "
+        f"count(value) AS c FROM panel WHERE ts >= {live_start} "
+        f"GROUP BY time_bucket(ts, '1m'), host"
+    )
+    for _ in range(3):  # usage-driven promotion (HORAEDB_LIVEWINDOW_PROMOTE)
+        db.execute(sql)
+    if not STORE.stats()["states"]:
+        return {"metric": f"livewindow_error{suffix}", "value": 0,
+                "unit": "shape did not promote", "vs_baseline": 0,
+                "platform": platform}
+
+    # the live bulk: ~90 buckets of open tail folded at write time in
+    # ONE committed batch, then a trickle keeps the tail moving during
+    # each measured arm
+    n_live = LIVEWINDOW_ROWS
+    t.write(mk_batch(live_start, live_start + 90 * w, n_live))
+    rows_written = [n_live]
+    cursor = [live_start + 90 * w]
+
+    def start_ingest():
+        stop = threading.Event()
+
+        def loop():
+            while not stop.is_set():
+                lo = cursor[0]
+                cursor[0] = lo + 15_000  # the open tail keeps advancing
+                t.write(mk_batch(lo, cursor[0], 500))
+                rows_written[0] += 500
+                time.sleep(0.02)
+
+        th = threading.Thread(target=loop, daemon=True)
+        th.start()
+        return th, stop
+
+    pq = parse_promql("increase(panel[1m])")
+
+    def run_sql():
+        s = time.perf_counter()
+        out = db.execute(sql)
+        return time.perf_counter() - s, out.to_pylist(), \
+            db.interpreters.executor.last_path
+
+    def run_prom():
+        s = time.perf_counter()
+        out = evaluate_expr_range(db, pq, live_start, cursor[0], w)
+        return time.perf_counter() - s, out
+
+    @contextlib.contextmanager
+    def raw_forced():
+        os.environ["HORAEDB_LIVEWINDOW"] = "0"
+        try:
+            yield
+        finally:
+            os.environ.pop("HORAEDB_LIVEWINDOW", None)
+
+    # ---- state arm (concurrent ingest running) ----
+    th, stop = start_ingest()
+    run_sql(); run_prom()  # warm (compile + first gather)
+    state_best = pstate_best = np.inf
+    state_path = ""
+    for _ in range(max(REPEATS, 7)):
+        dt, _rows, path = run_sql()
+        if dt < state_best:
+            state_best, state_path = dt, path
+        pdt, _pr = run_prom()
+        pstate_best = min(pstate_best, pdt)
+    n_at_state = rows_written[0]
+    stop.set(); th.join()
+
+    if state_path != "livewindow":
+        return {"metric": f"livewindow_error{suffix}", "value": 0,
+                "unit": f"state arm served path={state_path}",
+                "vs_baseline": 0, "platform": platform}
+
+    # ---- equivalence (ingest quiesced: no write, so the kill switch
+    # cannot drop the state while we read the raw reference) ----
+    _, state_rows, _ = run_sql()
+    _, state_prom = run_prom()
+    with raw_forced():
+        _, raw_rows, _ = run_sql()
+        _, raw_prom = run_prom()
+    # state partials accumulate in f32; the raw arm folds f64 — the same
+    # 2e-3 tolerance the equivalence tests establish
+    if not _rows_agree(state_rows, raw_rows, rtol=2e-3):
+        return {"metric": f"livewindow_error{suffix}", "value": 0,
+                "unit": "state/raw result mismatch", "vs_baseline": 0,
+                "platform": platform}
+    if not _prom_matrices_agree(state_prom, raw_prom):
+        return {"metric": f"livewindow_error{suffix}", "value": 0,
+                "unit": "state/raw PromQL result mismatch",
+                "vs_baseline": 0, "platform": platform}
+
+    # ---- raw arm (concurrent ingest running; the first write under the
+    # kill switch drops the state, which is the documented contract) ----
+    th, stop = start_ingest()
+    with raw_forced():
+        run_sql(); run_prom()
+        raw_best = praw_best = np.inf
+        for _ in range(max(REPEATS, 7)):
+            dt, _rows, _path = run_sql()
+            raw_best = min(raw_best, dt)
+            pdt, _pr = run_prom()
+            praw_best = min(praw_best, pdt)
+    stop.set(); th.join()
+
+    speedup = raw_best / state_best
+    return {
+        "metric": f"livewindow_refresh_rows_per_sec{suffix}",
+        "value": round(n_at_state / state_best),
+        "unit": "rows/s",
+        # headline ratio: the raw open-tail rescan vs the state gather
+        "vs_baseline": round(speedup, 3),
+        "promql_speedup": round(praw_best / pstate_best, 3),
+        "never_worse": bool(state_best <= raw_best * 1.05),
+        "target_3x": bool(speedup >= 3.0),
+        "state_ms": round(state_best * 1000, 3),
+        "raw_ms": round(raw_best * 1000, 3),
+        "live_rows": int(n_at_state),
+        "platform": platform,
+    }
+
+
 def time_arrow(db, table_name: str, arrow_fn) -> tuple[float, list]:
     """External anchor: the same query through pyarrow's Acero (an
     Arrow-native C++ vectorized engine — the closest runnable stand-in
@@ -1716,7 +1900,7 @@ def _emit(obj: dict) -> None:
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
     "compaction-64", "ingest", "groupby", "rawscan", "rollup", "flood",
-    "devicetel", "decisions", "tsbs-5-8-1",
+    "devicetel", "decisions", "livewindow", "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
 # builds the table twice for the device/host A-B and genuinely needs
@@ -1726,7 +1910,12 @@ PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "2400"))
 # budget can no longer fit a stage, the stage is SKIPPED with an explicit
 # emitted line and listed in the final record's `stages_skipped` — a
 # truncated run must say what it didn't measure, never silently omit it.
-WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "0") or 0)
+# The DEFAULT is bounded: an unbudgeted all-configs run that outlives the
+# caller's own timeout gets killed mid-stage (rc 124) with the headline
+# line never emitted — exactly the silent truncation the skip protocol
+# exists to prevent. 5400s fits every stage on CPU with slack; export
+# BENCH_WALL_BUDGET=0 for an explicitly unbounded run.
+WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "5400") or 0)
 # A stage that can't get at least this much wall isn't worth starting —
 # it would only burn the remaining budget into a timeout line.
 STAGE_FLOOR = float(os.environ.get("BENCH_STAGE_FLOOR", "60"))
@@ -2332,6 +2521,8 @@ def run_config(config: str) -> dict:
         return run_decisions_config()
     if config == "rollup":
         return run_rollup_config()
+    if config == "livewindow":
+        return run_livewindow_config()
     builder = CONFIGS.get(config)
     if builder is None:
         return {"metric": f"{config}_error", "value": 0,

@@ -478,6 +478,16 @@ class Instance:
                 continue
             for _, fut in entries:
                 fut.set_result(seq)
+            # Live-window fold rides the committed group (outside the
+            # serial lock — the state layer orders itself): cheap no-op
+            # when the table holds no promoted state, and a fold failure
+            # must never fail the write it observed.
+            try:
+                from ..state.livewindow import on_write as _lw_on_write
+
+                _lw_on_write(table, merged)
+            except Exception:
+                pass
         return needs_flush
 
     # ---- read path -----------------------------------------------------

@@ -55,7 +55,7 @@ from typing import Any, Callable, Optional, Union
 from ..utils.env import env_float
 from ..utils.metrics import REGISTRY
 
-# The five instrumented loops — the label set of every horaedb_decision_*
+# The instrumented loops — the label set of every horaedb_decision_*
 # / horaedb_calibration_* family (eagerly registered, lint-pinned like
 # DEVICE_KERNEL_KINDS).
 DECISION_LOOPS = (
@@ -64,6 +64,7 @@ DECISION_LOOPS = (
     "elastic",        # scale/move/hold control rounds
     "dtype_tuner",    # scan-cache bf16 -> f32 promotions
     "deadline",       # reason=deadline_budget sheds (provably doomed?)
+    "livewindow",     # live-window state promotions (predicted vs realized hits)
 )
 
 DECISION_METRIC_FAMILIES = (
@@ -171,6 +172,7 @@ _EVENT_SAMPLE = {
     "elastic": 1,
     "dtype_tuner": 1,
     "deadline": 1,
+    "livewindow": 1,
 }
 
 # miscalibration verdict: both windows' mean |relative error| over the

@@ -69,6 +69,7 @@ DEVICE_KERNEL_KINDS = (
     "raw_select",      # raw read: bounded selection
     "raw_topk_dist",   # sharded raw variants (parallel/dist_raw)
     "raw_select_dist",
+    "state_fold",      # live-window ring fold/gather (ops/livewindow)
 )
 
 # Occupancy row components: "column" rows sum to the scan cache's own
@@ -76,7 +77,7 @@ DEVICE_KERNEL_KINDS = (
 # are the content-keyed query-shape uploads and stacked value views the
 # cache keeps beside the columns; "evicted" rows carry eviction counts
 # for tables no longer resident.
-OCCUPANCY_COMPONENTS = ("column", "session", "stack", "evicted")
+OCCUPANCY_COMPONENTS = ("column", "session", "stack", "evicted", "state")
 
 # Registry discipline (lint-enforced like the agg-kernel/raw families):
 # declared here, registered eagerly, documented in docs/OBSERVABILITY.md,

@@ -1,0 +1,154 @@
+"""The fused live-window fold kernel: ingest batch -> ring partials.
+
+The live-window state (state/livewindow.py) keeps per-(table, window,
+group-set) partial aggregates in fixed-size device rings: one row per
+time bucket (slot = bucket_id % depth), one column per group. An ingest
+batch updates every ring array in ONE device dispatch — four scatter
+adds/mins/maxs plus the counter-increment scatter fused into a single
+jitted program — so write-time state maintenance costs one kernel
+launch, never per-row host work.
+
+Layout contract (prepared by the state layer on host):
+
+- ``slot``       int32[N]: ring slot per row; ``depth`` for rows that
+                 must not fold (padding, NULL values, below-tail late
+                 rows) — out-of-range scatter indices drop
+                 (``mode="drop"``), so masking costs nothing;
+- ``grp``        int32[N]: dense group index per row;
+- ``val``        f32[N]:   the value column;
+- ``pair_slot``/``pair_grp``/``pair_delta``: same encoding for the
+                 PromQL counter chain — one entry per consecutive
+                 same-series same-bucket sample pair, carrying the
+                 reset-adjusted increment attributed to the later
+                 sample's bucket;
+- ``reset_mask`` bool[depth]: ring slots a head advance reuses; they
+                 re-initialise inside the same dispatch (no separate
+                 clear kernel).
+
+Like scan_agg's monoid state, the ring cells are (count, sum, min, max)
+partials: any re-aggregation (query step == window here, so a read is a
+straight gather) stays exact up to f32 accumulation.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encoding import next_pow2
+
+
+@jax.jit
+def _fold_body(counts, sums, mins, maxs, inc,
+               reset_mask, slot, grp, val, pair_slot, pair_grp, pair_delta):
+    # Reused ring slots re-initialise first, then the batch folds in.
+    rm = reset_mask[:, None]
+    counts = jnp.where(rm, jnp.int32(0), counts)
+    sums = jnp.where(rm, jnp.float32(0.0), sums)
+    mins = jnp.where(rm, jnp.float32(jnp.inf), mins)
+    maxs = jnp.where(rm, jnp.float32(-jnp.inf), maxs)
+    inc = jnp.where(rm, jnp.float32(0.0), inc)
+    one = jnp.ones_like(val, dtype=jnp.int32)
+    counts = counts.at[slot, grp].add(one, mode="drop")
+    sums = sums.at[slot, grp].add(val, mode="drop")
+    mins = mins.at[slot, grp].min(val, mode="drop")
+    maxs = maxs.at[slot, grp].max(val, mode="drop")
+    inc = inc.at[pair_slot, pair_grp].add(pair_delta, mode="drop")
+    return counts, sums, mins, maxs, inc
+
+
+@jax.jit
+def _gather_body(counts, sums, mins, maxs, inc, slot_idx):
+    # One gather per array; stacked fetch = one host RTT for a read.
+    return (
+        counts[slot_idx],
+        sums[slot_idx],
+        mins[slot_idx],
+        maxs[slot_idx],
+        inc[slot_idx],
+    )
+
+
+def alloc_rings(depth: int, cap: int):
+    """Fresh device ring arrays for ``depth`` buckets x ``cap`` groups."""
+    return (
+        jnp.zeros((depth, cap), dtype=jnp.int32),
+        jnp.zeros((depth, cap), dtype=jnp.float32),
+        jnp.full((depth, cap), jnp.inf, dtype=jnp.float32),
+        jnp.full((depth, cap), -jnp.inf, dtype=jnp.float32),
+        jnp.zeros((depth, cap), dtype=jnp.float32),
+    )
+
+
+def rings_nbytes(depth: int, cap: int) -> int:
+    """Device bytes the five ring arrays occupy (4B cells)."""
+    return depth * cap * 4 * 5
+
+
+def _pad_rows(depth: int, slot, grp, val):
+    n = len(slot)
+    m = next_pow2(max(n, 1), floor=8)
+    if m == n:
+        return slot, grp, val
+    ps = np.full(m, depth, dtype=np.int32)  # OOB slot -> dropped
+    pg = np.zeros(m, dtype=np.int32)
+    pv = np.zeros(m, dtype=np.float32)
+    ps[:n], pg[:n], pv[:n] = slot, grp, val
+    return ps, pg, pv
+
+
+def fold_batch(rings, reset_mask, slot, grp, val,
+               pair_slot, pair_grp, pair_delta):
+    """Fold one prepared ingest batch into the rings; returns new rings.
+
+    Row arrays are padded to powers of two on host (stable jit keys);
+    padding rows carry slot == depth and drop inside the scatter.
+    """
+    from ..obs.device import cost_analysis, timed_dispatch
+    from ..utils.querystats import note_kernel_dispatch
+
+    depth = int(rings[0].shape[0])
+    cap = int(rings[0].shape[1])
+    slot, grp, val = _pad_rows(depth, slot, grp, val)
+    pair_slot, pair_grp, pair_delta = _pad_rows(
+        depth, pair_slot, pair_grp, pair_delta
+    )
+    args = (
+        *rings,
+        jnp.asarray(np.ascontiguousarray(reset_mask, dtype=np.bool_)),
+        jnp.asarray(slot.astype(np.int32)),
+        jnp.asarray(grp.astype(np.int32)),
+        jnp.asarray(val.astype(np.float32)),
+        jnp.asarray(pair_slot.astype(np.int32)),
+        jnp.asarray(pair_grp.astype(np.int32)),
+        jnp.asarray(pair_delta.astype(np.float32)),
+    )
+    t0 = _time.perf_counter()
+    out = timed_dispatch("state_fold", lambda: _fold_body(*args))
+    note_kernel_dispatch(
+        ("state_fold", depth, cap, len(slot), len(pair_slot)),
+        _time.perf_counter() - t0,
+        kind="state_fold",
+        cost_fn=lambda: cost_analysis(_fold_body, args, {}),
+    )
+    return out
+
+
+def gather_buckets(rings, slots):
+    """Read ``slots`` (list of ring slots) out of the rings — one gather
+    dispatch + one host fetch; returns host numpy arrays
+    (counts, sums, mins, maxs, inc), each [len(slots), cap]."""
+    from ..obs.device import timed_dispatch
+
+    n = len(slots)
+    m = next_pow2(max(n, 1), floor=8)
+    idx = np.zeros(m, dtype=np.int32)
+    idx[:n] = np.asarray(slots, dtype=np.int32)
+    out = timed_dispatch(
+        "state_fold", lambda: _gather_body(*rings, jnp.asarray(idx))
+    )
+    host = jax.device_get(out)  # one RTT for the whole read
+    return tuple(np.asarray(a)[:n] for a in host)

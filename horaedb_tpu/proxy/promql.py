@@ -762,7 +762,8 @@ def _range_series(
         # scan raw rows and fold host-side (samples per window are small
         # next to the table; the fused path keeps serving the rest).
         per_series = _counter_series(
-            conn, pq, where, schema, value_col, group_labels, step_ms, func
+            conn, pq, where, schema, value_col, group_labels, step_ms, func,
+            table=table, start_ms=start_ms, end_ms=end_ms,
         )
     elif func in _RAW_FOLD_FUNCS:
         # Raw folds evaluate per step over the SLIDING left-open
@@ -865,6 +866,7 @@ def _regex_match(labels: dict, matchers: list[tuple[str, str, str]]) -> bool:
 def _counter_series(
     conn, pq: PromQuery, where: list, schema, value_col: str,
     group_labels: list, step_ms: int, func: str,
+    table=None, start_ms=None, end_ms=None,
 ) -> dict:
     """Reset-aware rate/increase: fold raw samples per series.
 
@@ -873,26 +875,72 @@ def _counter_series(
     in-bucket samples of (vᵢ - vᵢ₋₁), with a reset contributing vᵢ (the
     counter re-accumulated from 0). rate = increase / step_seconds —
     min/max-based deltas would silently UNDERCOUNT across resets.
+
+    When live window state (state/livewindow) holds the open tail, the
+    resident complete buckets read write-time folded increments instead
+    of raw: the scan shrinks to the head ``ts < serve_lo`` plus the
+    partial-bucket tail ``ts >= tail_lo``, and the chain is stitched at
+    both boundaries — a boundary delta counts only when the raw side
+    has samples for the series, exactly the in-range pair rule above.
     """
-    samples = _series_scan(conn, pq, where, schema, value_col, group_labels)
+    state_part = None
+    if table is not None and start_ms is not None and end_ms is not None:
+        from ..state.livewindow import try_livewindow_counter
+
+        push = [m for m in pq.matchers if m[1] in ("=", "!=")]
+        state_part = try_livewindow_counter(
+            pq.metric, table, value_col, start_ms, end_ms, step_ms, push
+        )
+    scan_where = where
+    serve_lo = None
+    if state_part is not None:
+        serve_lo = state_part["serve_lo"]
+        tail_lo = state_part["tail_lo"]
+        ts_q = _q(schema.timestamp_name)
+        if tail_lo <= end_ms:
+            scan_where = where + [f"({ts_q} < {serve_lo} OR {ts_q} >= {tail_lo})"]
+        else:
+            scan_where = where + [f"{ts_q} < {serve_lo}"]
+    samples = _series_scan(
+        conn, pq, scan_where, schema, value_col, group_labels
+    )
+    st_series = state_part["series"] if state_part else {}
     out: dict[tuple, dict[int, float]] = {}
-    for key, pts in samples.items():
-        pts.sort()
+    for key in set(samples) | set(st_series):
+        pts = sorted(samples.get(key, ()))
         buckets: dict[int, float] = {}
         prev_v = None
-        for ts, v in pts:
-            if prev_v is not None:
-                delta = v - prev_v
-                if delta < 0:
-                    delta = v  # counter reset: it restarted from ~0
-                # every consecutive-sample delta counts ONCE, attributed
-                # to the later sample's bucket — a delta straddling a
-                # bucket boundary must not vanish (scrape intervals
-                # rarely align with steps). A single-sample bucket emits
-                # no point, like prom (two samples make an increase).
-                b = (ts // step_ms) * step_ms
-                buckets[b] = buckets.get(b, 0.0) + delta
-            prev_v = v
+
+        def _fold(seq):
+            nonlocal prev_v
+            for ts, v in seq:
+                if prev_v is not None:
+                    delta = v - prev_v
+                    if delta < 0:
+                        delta = v  # counter reset: it restarted from ~0
+                    # every consecutive-sample delta counts ONCE,
+                    # attributed to the later sample's bucket — a delta
+                    # straddling a bucket boundary must not vanish
+                    # (scrape intervals rarely align with steps). A
+                    # single-sample bucket emits no point, like prom
+                    # (two samples make an increase).
+                    b = (ts // step_ms) * step_ms
+                    buckets[b] = buckets.get(b, 0.0) + delta
+                prev_v = v
+
+        st = st_series.get(key)
+        head = pts if serve_lo is None else [p for p in pts if p[0] < serve_lo]
+        _fold(head)
+        if st is not None:
+            # head->state boundary pair, then the write-time folded
+            # increments, then the chain continues from the state's
+            # last sample into the partial-bucket tail
+            _fold([st["first"]])
+            for b, d in st["buckets"].items():
+                buckets[b] = buckets.get(b, 0.0) + d
+            prev_v = st["last"][1]
+        if serve_lo is not None:
+            _fold([p for p in pts if p[0] >= serve_lo])
         if func == "rate":
             buckets = {b: d / (step_ms / 1000.0) for b, d in buckets.items()}
         out[key] = buckets

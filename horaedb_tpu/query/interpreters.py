@@ -274,6 +274,18 @@ class InterpreterFactory:
                     f"buckets<[{dec.cut}] served pre-aggregated, raw tail "
                     f"[{dec.cut}, {dec.end}) from {q.table} (route=rollup)"
                 )
+            # live window state: again the ONE executor predicate, so the
+            # promise and the serve cannot drift (route=livewindow)
+            from ..state.livewindow import livewindow_decision_for
+
+            lw = livewindow_decision_for(self.catalog, q)
+            if lw is not None:
+                lines.append(
+                    f"  LiveWindow: window={lw.step_ms}ms "
+                    f"[{lw.s_lo}, {lw.s_hi}) served from device ring state "
+                    f"({lw.n_buckets} buckets), raw head [{lw.start}, "
+                    f"{lw.s_lo}) (route=livewindow)"
+                )
             shape = self.executor._agg_device_shape(q)
             if shape is not None:
                 path = "device (fused kernel; HBM-cached when table state is stable)"
@@ -476,8 +488,11 @@ class InterpreterFactory:
                     outcomes[i] = self._select(p)
                     continue
                 from ..rules.rewrite import try_rollup_serve
+                from ..state.livewindow import try_livewindow_serve
 
-                out = try_rollup_serve(self, p)
+                out = try_livewindow_serve(self, p)
+                if out is None:
+                    out = try_rollup_serve(self, p)
                 if out is not None:
                     outcomes[i] = out
                     continue
@@ -500,10 +515,16 @@ class InterpreterFactory:
         """One door to query execution (SELECT and EXPLAIN ANALYZE both
         pass through): a step-compatible dashboard aggregate over a
         rollup-maintained table serves from the tier tables
-        (rules/rewrite, ``route=rollup``); everything else takes the
-        executor's normal paths."""
+        (rules/rewrite, ``route=rollup``); an eligible open-tail window
+        aggregate serves head-from-rollup + tail-from-state
+        (state/livewindow, ``route=livewindow``); everything else takes
+        the executor's normal paths."""
         from ..rules.rewrite import try_rollup_serve
+        from ..state.livewindow import try_livewindow_serve
 
+        out = try_livewindow_serve(self, plan)
+        if out is not None:
+            return out
         out = try_rollup_serve(self, plan)
         if out is not None:
             return out

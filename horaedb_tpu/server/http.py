@@ -16,6 +16,7 @@ config.rs:176):
     GET  /debug/hotspot  hottest tables by reads/writes
     GET  /debug/workload live admission/dedup/quota state (wlm)
     GET  /debug/device   device telemetry plane (HBM residency, compile stats)
+    GET  /debug/livewindow  live window ring states (+ DELETE .../{key} evicts)
     GET  /debug/alerts   rule-engine alert state (pending/firing/resolved)
     PUT  /debug/slow_threshold/{seconds}  live slow-log threshold
     POST /admin/block    {"tables": [...]} / DELETE to unblock
@@ -2331,6 +2332,24 @@ def create_app(
         out = await asyncio.get_running_loop().run_in_executor(None, collect)
         return web.Response(text=_dumps(out), content_type="application/json")
 
+    async def debug_livewindow(request: web.Request) -> web.Response:
+        """Live window state plane (state/livewindow): resident ring
+        states (window, groups, bytes, head bucket, dirty counts, reads
+        served), shapes pending promotion, and the byte budget in
+        force. DELETE /debug/livewindow/{key} evicts one state."""
+        from ..state.livewindow import STORE
+
+        key = request.match_info.get("key")
+        if request.method == "DELETE":
+            if STORE.get(key) is None:
+                raise web.HTTPNotFound(text=f"no live window state {key!r}")
+            STORE.drop(key, outcome="evict")
+            return web.Response(
+                text=_dumps({"evicted": key}), content_type="application/json"
+            )
+        out = await asyncio.get_running_loop().run_in_executor(None, STORE.stats)
+        return web.Response(text=_dumps(out), content_type="application/json")
+
     async def admin_quota(request: web.Request) -> web.Response:
         """GET: current quotas + block-list. POST: set a token bucket
         {"scope": "table"|"tenant", "name": ..., "kind":
@@ -2634,6 +2653,8 @@ def create_app(
     app.router.add_get("/debug/remote_spans", debug_remote_spans)
     app.router.add_get("/debug/workload", debug_workload)
     app.router.add_get("/debug/device", debug_device)
+    app.router.add_get("/debug/livewindow", debug_livewindow)
+    app.router.add_delete("/debug/livewindow/{key}", debug_livewindow)
     app.router.add_get("/debug/alerts", debug_alerts)
     app.router.add_get("/debug/slo", debug_slo)
     app.router.add_post("/admin/flush", admin_flush)

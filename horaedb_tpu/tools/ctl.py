@@ -22,6 +22,7 @@ Commands:
     alerts                  alert state (pending/firing/resolved)
     slo                     SLO verdicts: objectives, burn rates, breaches
     device                  device telemetry: HBM residency + compile stats
+    livewindow [evict KEY]  live window ring states (state/livewindow)
 
 Shard operations go to the COORDINATOR (``--meta HOST:PORT``):
 
@@ -466,6 +467,47 @@ def cmd_device(ep: str, args) -> None:
         )
 
 
+def cmd_livewindow(ep: str, args) -> None:
+    """Live window state plane (/debug/livewindow): resident device ring
+    states, shapes pending promotion, and the byte budget — `livewindow
+    evict KEY` drops one state (journaled as an eviction)."""
+    if args.action == "evict":
+        if not args.key:
+            raise CtlError("livewindow evict needs a state KEY")
+        print(_post(ep, f"/debug/livewindow/{args.key}", {}, method="DELETE").strip())
+        return
+    data = json.loads(_get(ep, "/debug/livewindow"))
+    if not data.get("enabled", True):
+        print("(live window state disabled: HORAEDB_LIVEWINDOW=0)")
+        return
+    _print_rows(
+        [
+            {
+                "key": s["key"],
+                "table": s["table"],
+                "window_ms": s["window_ms"],
+                "depth": s["depth"],
+                "groups": s["groups"],
+                "bytes": s["bytes"],
+                "head_bucket": s["head_bucket"],
+                "dirty": s["dirty_buckets"],
+                "counter_dirty": s["counter_dirty"],
+                "reads_served": s["reads_served"],
+            }
+            for s in data.get("states", [])
+        ]
+    )
+    print(
+        f"\nresident {data.get('resident_bytes', 0)} / "
+        f"budget {data.get('budget_bytes', 0)} bytes"
+    )
+    pending = data.get("pending", {})
+    if pending:
+        print("pending promotion (shape: eligible reads seen):")
+        for k, n in sorted(pending.items()):
+            print(f"  {k}: {n}")
+
+
 def cmd_diagnose(ep: str, args) -> None:
     print("health:  ", _get(ep, "/health").strip())
     print("config:  ", _get(ep, "/debug/config").strip())
@@ -520,6 +562,10 @@ def main(argv=None) -> int:
     sub.add_parser("alerts")
     sub.add_parser("slo")
     sub.add_parser("device")
+    lw = sub.add_parser("livewindow")
+    lw.add_argument("action", nargs="?", default="list",
+                    choices=["list", "evict"])
+    lw.add_argument("key", nargs="?", default=None)
     sub.add_parser("shards")
     sub.add_parser("wal_stats")
     sub.add_parser("slow_log")

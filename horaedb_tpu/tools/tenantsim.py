@@ -165,6 +165,13 @@ class SimConfig:
     # the tuner's own choice — and a post-run sum forces the graded
     # f32 PROMOTION the decision journal must carry
     dtype_auto: bool = False
+    # live window state (ISSUE 18): the live open-tail panel becomes the
+    # ELIGIBLE shape (time_bucket + tenant grouping over the open tail),
+    # so hot panels promote to device-resident ring state under live
+    # ingest, and post-run collection drives the journaled
+    # promote -> serve -> equivalence -> evict walk as a standing gate;
+    # --no-livewindow reproduces the raw-rescan panel path
+    livewindow: bool = True
 
 
 @dataclass
@@ -222,6 +229,12 @@ class SimReport:
     decision_counts: dict = field(default_factory=dict)
     calibration_verdicts: dict = field(default_factory=dict)
     decision_unaccounted: int = -1
+    # live window state (ISSUE 18): open-tail panels must actually be
+    # served from ring state (route=livewindow in query_stats) and the
+    # state answer must agree with the kill-switch raw rescan
+    livewindow_served: int = 0
+    livewindow_equiv_checked: int = 0
+    livewindow_equiv_ok: int = 0
     notes: list = field(default_factory=list)
 
     def violations(self) -> list[str]:
@@ -326,6 +339,18 @@ class SimReport:
                 out.append(
                     f"decision plane: no finite {loop} calibration "
                     "verdict in system.public.calibration"
+                )
+        if self.config.get("livewindow"):
+            # live window state (ISSUE 18): the panel shape must have
+            # been served from ring state and checked against the raw
+            # rescan (a mismatch already counted as a wrong answer)
+            if self.livewindow_served < 1:
+                out.append(
+                    "live window state: no route=livewindow read served"
+                )
+            if self.livewindow_equiv_checked < 1:
+                out.append(
+                    "live window state: state/raw equivalence never checked"
                 )
         if self.decision_active_loops and self.decision_unaccounted != 0:
             out.append(
@@ -1089,13 +1114,22 @@ class TenantSim:
                     self._note_status(s, checked=False, ok=True)
                 elif roll < 0.9:
                     # live open-tail panel (no fixed reference; exercises
-                    # the leader-only path + follower refusal/fallback)
+                    # the leader-only path + follower refusal/fallback).
+                    # With livewindow on this is the ELIGIBLE shape —
+                    # time_bucket grouping over the open tail — so hot
+                    # panels promote to ring state under live ingest;
+                    # the tenant literal varies but the shape key does
+                    # not, so every worker's read counts toward the
+                    # promotion threshold
                     t = rng.randrange(cfg.tenants)
                     j = rng.randrange(cfg.tables)
-                    q = (
-                        f"SELECT count(v) AS c FROM {self._table(j)} "
-                        f"WHERE tenant = 't{t}'"
-                    )
+                    if cfg.livewindow:
+                        q = self._livewindow_panel_sql(j, tenant=t)
+                    else:
+                        q = (
+                            f"SELECT count(v) AS c FROM {self._table(j)} "
+                            f"WHERE tenant = 't{t}'"
+                        )
                     s, _ = self._sql(ep, q, tenant=f"t{t}", timeout=20)
                     self._note_status(s, checked=False, ok=True)
                 elif cfg.dtype_auto and roll >= 0.95:
@@ -1189,6 +1223,11 @@ class TenantSim:
 
         cfg = self.cfg
         prior_dtype = os.environ.get("HORAEDB_CACHE_DTYPE")
+        # the live-window store is process-global: start from a clean
+        # slate so promotions observed here are THIS run's promotions
+        from ..state.livewindow import STORE as _lw_store
+
+        _lw_store.clear()
         try:
             if cfg.dtype_auto:
                 # the learned per-column dtype mode (the scan cache is
@@ -1675,6 +1714,120 @@ class TenantSim:
                         )
             self.report.kill_recovered = recovered
 
+    def _livewindow_panel_sql(self, j: int, tenant: int = None) -> str:
+        """The eligible open-tail dashboard shape: time_bucket + tenant
+        grouping, no ts bound (the tenant filter, when present, pushes
+        into the state's group values and does not change the shape
+        key)."""
+        where = f"WHERE tenant = 't{tenant}' " if tenant is not None else ""
+        return (
+            f"SELECT time_bucket(ts, '60000ms') AS b, tenant, "
+            f"count(v) AS c, sum(v) AS s FROM {self._table(j)} "
+            f"{where}GROUP BY time_bucket(ts, '60000ms'), tenant"
+        )
+
+    def _drive_livewindow(self, ep: str) -> None:
+        """Deterministic promote -> serve -> equivalence -> evict walk
+        (ISSUE 18), graded through the decision journal: eligible
+        open-tail reads promote the panel shape, fresh rows through the
+        ordinary write path advance the ring head past valid_from, a
+        state-served read must agree with the HORAEDB_LIVEWINDOW=0 raw
+        rescan (ingest is quiesced here, so the kill-switch flip cannot
+        race a fold), and explicit evictions resolve every promote
+        decision against realized hits."""
+        name = self._table(0)
+        panel = self._livewindow_panel_sql(0)
+        # drop any states promoted by mid-run worker traffic first: their
+        # journal entries may already have rolled off the bounded
+        # decision ring (admission/kernel_router flood), and a late
+        # resolve grades calibration but leaves no resolved row in
+        # system.public.decisions — the promote reads below re-issue
+        # fresh entries that are still in-ring when the gate SELECTs
+        self._evict_livewindow_states(ep)
+        for _ in range(4):
+            self._sql(ep, panel, timeout=30)
+        # fresh rows strictly ABOVE the table max: valid_from was pinned
+        # one bucket past the max at promotion, so only buckets beyond
+        # it can be state-served
+        s, out = self._sql(ep, f"SELECT max(ts) AS m FROM {name}",
+                           timeout=20)
+        m = None
+        if s == 200 and out.get("rows"):
+            m = out["rows"][0].get("m")
+        # +3 buckets, not +1: a device-served max(ts) is f32-rounded
+        # (ulp at epoch-ms magnitude is ~131s, up to 2 buckets either
+        # way), and rows below valid_from fold but can never be
+        # state-served — the margin keeps the walk above the true max
+        base_ms = ((int(m) // 60_000) + 3) * 60_000 if m is not None \
+            else int(time.time() * 1000)
+        rows = [
+            {"tenant": f"t{k % 7}", "host": f"h{k % 3}",
+             "v": round(1.0 + 0.5 * k, 4), "ts": base_ms + k * 250}
+            for k in range(140)
+        ]
+        try:
+            owner = self._owner(name)
+        except Exception:
+            owner = ep
+        try:
+            _http("POST", f"http://{owner}/write",
+                  {"table": name, "rows": rows}, timeout=30)
+        except Exception:
+            pass
+        s1, out1 = self._sql(ep, panel, timeout=30)
+        prior = os.environ.get("HORAEDB_LIVEWINDOW")
+        os.environ["HORAEDB_LIVEWINDOW"] = "0"
+        try:
+            s2, out2 = self._sql(ep, panel, timeout=30)
+        finally:
+            if prior is None:
+                os.environ.pop("HORAEDB_LIVEWINDOW", None)
+            else:
+                os.environ["HORAEDB_LIVEWINDOW"] = prior
+        if s1 == 200 and s2 == 200:
+            def _key(r):
+                return (str(r.get("b")), str(r.get("tenant")))
+
+            a = sorted(out1.get("rows", []), key=_key)
+            b = sorted(out2.get("rows", []), key=_key)
+            with self._lock:
+                self.report.livewindow_equiv_checked += 1
+                # f32 device partials vs the f64 rescan
+                if _rows_agree(a, b, rtol=2e-3):
+                    self.report.livewindow_equiv_ok += 1
+                else:
+                    self.report.wrong_answers += 1
+                    self.report.notes.append(
+                        "livewindow state answer != raw rescan"
+                    )
+        # route=livewindow evidence from the database's own ledger
+        s, out = self._sql(
+            ep,
+            "SELECT count(route) AS c FROM system.public.query_stats "
+            "WHERE route = 'livewindow'",
+            timeout=10,
+        )
+        if s == 200 and out.get("rows"):
+            self.report.livewindow_served = int(out["rows"][0]["c"] or 0)
+        # explicit evictions: each resolves its promote decision with
+        # realized hits, so the loop's calibration verdict gets graded
+        # samples even if the byte budget never forced an eviction
+        self._evict_livewindow_states(ep)
+
+    def _evict_livewindow_states(self, ep: str) -> None:
+        try:
+            s, st = _http("GET", f"http://{ep}/debug/livewindow",
+                          timeout=10)
+            if s == 200:
+                for row in st.get("states", []):
+                    _http(
+                        "DELETE",
+                        f"http://{ep}/debug/livewindow/{row['key']}",
+                        timeout=10,
+                    )
+        except Exception:
+            pass
+
     def _collect_decisions(self, ep: str) -> None:
         """Decision-plane gates (ISSUE 16), from the database's own
         ``system.public.decisions`` / ``system.public.calibration``: per
@@ -1691,6 +1844,9 @@ class TenantSim:
             active.append("elastic")
         if cfg.dtype_auto:
             active.append("dtype_tuner")
+        if cfg.livewindow:
+            active.append("livewindow")
+            self._drive_livewindow(ep)
         self.report.decision_active_loops = active
 
         if cfg.dtype_auto:
@@ -1797,6 +1953,12 @@ def main(argv=None) -> int:
              "queries answering the typed 504 within budget, admission "
              "slots draining back to baseline)",
     )
+    p.add_argument(
+        "--no-livewindow", action="store_true",
+        help="issue the legacy count(v) open-tail panel instead of the "
+             "eligible time_bucket shape (disables the live-window "
+             "promote/serve/evict gate)",
+    )
     p.add_argument("--json", action="store_true", help="emit the report as JSON")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -1819,6 +1981,7 @@ def main(argv=None) -> int:
         lease_flap_at=0.72 if args.nodes >= 3 else None,
         shard_move_at=0.8 if args.nodes >= 3 else None,
         settle_timeout_s=40.0 if args.elastic else SimConfig.settle_timeout_s,
+        livewindow=not args.no_livewindow,
     )
     report = run_sim(cfg)
     violations = report.violations()

@@ -87,6 +87,9 @@ NUMERIC_FIELDS: dict[str, str] = {
     # query issued and whether it paid a compile stall
     "device_dispatches": "device kernel dispatches the query issued",
     "compile_hit": "device dispatches that paid a first-time XLA compile (compile-stall marker)",
+    # live window state (state/livewindow, route=livewindow): how many
+    # ring buckets the state-served tail of the query read
+    "state_buckets": "device ring buckets served from live window state",
 }
 
 # wall-time costs; seconds, float.

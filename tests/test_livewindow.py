@@ -1,0 +1,384 @@
+"""Live window state tests (ISSUE 18): device-resident ring-buffer
+partial aggregates for O(1) open-tail dashboard reads. The acceptance
+property is exact state==rescan equivalence under randomized interleaved
+ingest — in-order, late-but-in-ring, and older-than-tail rows — plus
+ring rollover, eviction mid-query, the ``HORAEDB_LIVEWINDOW=0`` kill
+switch, the PromQL counter fold over adjacent-bucket partials, the
+promote/evict decision-journal loop, and ledger/EXPLAIN parity
+(``route=livewindow`` + ``state_buckets`` from the ONE executor
+predicate)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.proxy import Proxy
+from horaedb_tpu.proxy.promql import evaluate_range, parse_promql
+from horaedb_tpu.state.livewindow import (
+    _M_READS_PROMQL,
+    STORE,
+    livewindow_enabled,
+)
+from horaedb_tpu.utils.querystats import STATS_STORE
+
+from test_rules import _rows_close
+
+MIN = 60_000
+END = (1_786_000_000_000 // MIN) * MIN
+
+
+@pytest.fixture(autouse=True)
+def _fresh_store():
+    """The live-window store is process-global (like STATS_STORE):
+    every test starts and ends with no resident states."""
+    STORE.clear()
+    yield
+    STORE.clear()
+
+
+def _create(db, name):
+    db.execute(
+        f"CREATE TABLE {name} (host string TAG, value double NOT NULL, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic "
+        "WITH (segment_duration='2h', update_mode='append')"
+    )
+
+
+def _insert(db, name, rows):
+    vals = ",".join(f"('{h}', {v!r}, {t})" for h, v, t in rows)
+    db.execute(f"INSERT INTO {name} (host, value, ts) VALUES {vals}")
+
+
+def _seed(db, name, minutes=200, step_s=20, n_hosts=3, seed=5):
+    _create(db, name)
+    rng = np.random.default_rng(seed)
+    start = END - minutes * MIN
+    rows = []
+    for t in range(start, END, step_s * 1000):
+        for h in range(n_hosts):
+            rows.append((f"h{h}", float(rng.normal(10, 3)), t))
+    _insert(db, name, rows)
+    return start
+
+
+def _panel(name, where=""):
+    w = f"WHERE {where} " if where else ""
+    return (
+        "SELECT time_bucket(ts, '1m') AS b, host, sum(value) AS s, "
+        f"count(value) AS c, min(value) AS mn, max(value) AS mx "
+        f"FROM {name} {w}GROUP BY time_bucket(ts, '1m'), host"
+    )
+
+
+def _promote(db, name, q=None):
+    q = q or _panel(name)
+    for _ in range(3):
+        db.execute(q)
+    keys = [s["key"] for s in STORE.stats()["states"]]
+    assert keys, "promotion did not fire after 3 eligible reads"
+    return keys[0]
+
+
+def _raw(db, q):
+    """The kill switch forces the raw rescan; flipping it between two
+    reads is safe as long as nothing writes in between."""
+    os.environ["HORAEDB_LIVEWINDOW"] = "0"
+    try:
+        return db.execute(q).to_pylist()
+    finally:
+        os.environ.pop("HORAEDB_LIVEWINDOW", None)
+
+
+class TestEquivalence:
+    """state == rescan, always — the answers contract."""
+
+    def test_randomized_interleaved_ingest(self):
+        """THE acceptance property: randomized rounds of ingest (fresh,
+        late-but-in-ring, older-than-tail) interleaved with panel reads
+        under random pushdown matchers; every answer must equal the
+        kill-switch rescan, and the state path must actually serve."""
+        db = horaedb_tpu.connect(None)
+        try:
+            _seed(db, "lw_rand")
+            key = _promote(db, "lw_rand")
+            rng = np.random.default_rng(17)
+            cursor = END
+            served = 0
+            for trial in range(24):
+                batch = []
+                for _ in range(int(rng.integers(5, 40))):
+                    cursor += int(rng.integers(1_000, 30_000))
+                    batch.append(
+                        (f"h{int(rng.integers(0, 3))}",
+                         float(rng.normal(10, 3)), cursor)
+                    )
+                if rng.random() < 0.5:
+                    # late but in-ring (depth 128 -> ~128 min span)
+                    batch.append(
+                        ("h1", float(rng.normal(10, 3)),
+                         cursor - int(rng.integers(2, 100)) * MIN)
+                    )
+                if rng.random() < 0.3:
+                    # older than the ring tail: poisons the bucket for
+                    # rescan, must never poison the answer
+                    batch.append(
+                        ("h0", float(rng.normal(10, 3)), cursor - 160 * MIN)
+                    )
+                _insert(db, "lw_rand", batch)
+                where = ["", "host = 'h1'", "host != 'h2'"][
+                    int(rng.integers(0, 3))
+                ]
+                q = _panel("lw_rand", where)
+                got = db.execute(q)
+                path = db.interpreters.executor.last_path
+                want = _raw(db, q)
+                assert _rows_close(got.to_pylist(), want), (
+                    f"trial {trial}: state != rescan for {q!r}"
+                )
+                if path == "livewindow":
+                    served += 1
+            assert served >= 8, f"state served only {served}/24 reads"
+            assert key in [s["key"] for s in STORE.stats()["states"]]
+        finally:
+            db.close()
+
+    def test_ring_rollover(self, monkeypatch):
+        """A tiny ring (depth 8) rolls over quickly: reused slots must
+        reset cleanly and older-than-tail late rows must rescan."""
+        monkeypatch.setenv("HORAEDB_LIVEWINDOW_DEPTH", "8")
+        db = horaedb_tpu.connect(None)
+        try:
+            _seed(db, "lw_roll", minutes=30)
+            _promote(db, "lw_roll")
+            cursor = END
+            for _ in range(30):  # ~30 buckets >> depth 8
+                cursor += MIN
+                _insert(db, "lw_roll", [("h0", 1.5, cursor),
+                                        ("h1", 2.5, cursor + 900)])
+            q = _panel("lw_roll")
+            got = db.execute(q)
+            assert db.interpreters.executor.last_path == "livewindow"
+            assert _rows_close(got.to_pylist(), _raw(db, q))
+            # now a late row that fell off the tail of the small ring
+            _insert(db, "lw_roll", [("h0", 99.0, cursor - 20 * MIN)])
+            got2 = db.execute(q)
+            assert _rows_close(got2.to_pylist(), _raw(db, q))
+        finally:
+            db.close()
+
+    def test_eviction_mid_query(self):
+        """A dropper thread evicts states continuously while the panel
+        is read: any individual read may fall back to raw, but no read
+        may ever answer wrong, and re-promotion must still work."""
+        db = horaedb_tpu.connect(None)
+        try:
+            _seed(db, "lw_evict", minutes=60)
+            _promote(db, "lw_evict")
+            _insert(db, "lw_evict", [("h0", 3.0, END + MIN),
+                                     ("h1", 4.0, END + 2 * MIN)])
+            q = _panel("lw_evict")
+            stop = threading.Event()
+
+            def dropper():
+                while not stop.is_set():
+                    for s in STORE.stats()["states"]:
+                        STORE.drop(s["key"], outcome="evict")
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=dropper, daemon=True)
+            th.start()
+            try:
+                for _ in range(30):
+                    got = db.execute(q).to_pylist()
+                    assert _rows_close(got, _raw(db, q))
+            finally:
+                stop.set()
+                th.join(timeout=5)
+            # with the dropper gone, the shape re-promotes and serves
+            key = _promote(db, "lw_evict")
+            _insert(db, "lw_evict", [("h2", 5.0, END + 3 * MIN)])
+            got = db.execute(q)
+            assert db.interpreters.executor.last_path == "livewindow"
+            assert _rows_close(got.to_pylist(), _raw(db, q))
+            assert key in [s["key"] for s in STORE.stats()["states"]]
+        finally:
+            db.close()
+
+    def test_kill_switch(self):
+        """HORAEDB_LIVEWINDOW=0 pins the raw path, removes the EXPLAIN
+        claim, and a write under the kill switch drops the table's
+        states (a re-enabled state can never backfill the fold gap)."""
+        db = horaedb_tpu.connect(None)
+        try:
+            _seed(db, "lw_kill", minutes=60)
+            _promote(db, "lw_kill")
+            _insert(db, "lw_kill", [("h0", 1.0, END + MIN)])
+            q = _panel("lw_kill")
+            db.execute(q)
+            assert db.interpreters.executor.last_path == "livewindow"
+            os.environ["HORAEDB_LIVEWINDOW"] = "0"
+            try:
+                assert not livewindow_enabled()
+                db.execute(q)
+                assert db.interpreters.executor.last_path != "livewindow"
+                plan = "\n".join(
+                    r["plan"]
+                    for r in db.execute(f"EXPLAIN {q}").to_pylist()
+                )
+                assert "LiveWindow:" not in plan
+                # the documented drop-on-write contract
+                _insert(db, "lw_kill", [("h0", 2.0, END + 2 * MIN)])
+                assert not STORE.stats()["states"]
+            finally:
+                os.environ.pop("HORAEDB_LIVEWINDOW", None)
+        finally:
+            db.close()
+
+
+class TestPromqlCounterFold:
+    def test_increase_and_rate_from_partials(self):
+        """rate()/increase() fold adjacent-bucket firsts/lasts + the
+        in-bucket increment ring instead of raw samples, bit-agreeing
+        with the kill-switch fold across counter resets."""
+        db = horaedb_tpu.connect(None)
+        try:
+            _create(db, "lw_ctr")
+            rows = []
+            t = END - 30 * MIN
+            while t < END:
+                for h, slope in (("h0", 2.0), ("h1", 5.0)):
+                    v = 100.0 + slope * ((t - (END - 30 * MIN)) // 10_000)
+                    if h == "h0" and t == END - 10 * MIN:
+                        v = 1.0  # counter reset
+                    rows.append((h, v, t))
+                t += 10_000
+            _insert(db, "lw_ctr", rows)
+            # the counter fold requires the all-tags grouped state
+            _promote(
+                db, "lw_ctr",
+                "SELECT time_bucket(ts, '1m') AS b, host, sum(value) AS s, "
+                "count(value) AS c FROM lw_ctr "
+                "GROUP BY time_bucket(ts, '1m'), host",
+            )
+            more = []
+            t = END
+            while t < END + 10 * MIN:
+                for h, slope in (("h0", 2.0), ("h1", 5.0)):
+                    more.append((h, 500.0 + slope * ((t - END) // 10_000), t))
+                t += 10_000
+            _insert(db, "lw_ctr", more)
+
+            def matrix(promql):
+                out = evaluate_range(
+                    db, parse_promql(promql), END - 20 * MIN,
+                    END + 10 * MIN, 2 * MIN,
+                )
+                return {
+                    tuple(sorted(s["metric"].items())):
+                        [(ts, float(v)) for ts, v in s["values"]]
+                    for s in out
+                }
+
+            for expr in ("increase(lw_ctr[2m])", "rate(lw_ctr[2m])",
+                         'increase(lw_ctr{host="h0"}[2m])'):
+                before = _M_READS_PROMQL.value
+                got = matrix(expr)
+                assert _M_READS_PROMQL.value > before, (
+                    f"{expr}: not served from state partials"
+                )
+                os.environ["HORAEDB_LIVEWINDOW"] = "0"
+                try:
+                    ref = matrix(expr)
+                finally:
+                    os.environ.pop("HORAEDB_LIVEWINDOW", None)
+                assert set(got) == set(ref)
+                for k in ref:
+                    assert len(got[k]) == len(ref[k]), (expr, k)
+                    for (t1, v1), (t2, v2) in zip(got[k], ref[k]):
+                        assert t1 == t2
+                        assert abs(v1 - v2) <= 1e-4 * max(1.0, abs(v2)), (
+                            expr, k, t1, v1, v2
+                        )
+        finally:
+            db.close()
+
+
+class TestDecisionJournal:
+    def test_promote_and_evict_are_journaled_and_graded(self):
+        """Promotion records a loop=livewindow decision with a predicted
+        hit count; eviction resolves it against realized hits, so the
+        calibration table grades the loop (PR-16 discipline)."""
+        db = horaedb_tpu.connect(None)
+        try:
+            _seed(db, "lw_jrnl", minutes=30)
+            key = _promote(db, "lw_jrnl")
+            rows = db.execute(
+                "SELECT loop, choice, resolved, outcome "
+                "FROM system.public.decisions"
+            ).to_pylist()
+            mine = [r for r in rows if r["loop"] == "livewindow"]
+            assert mine, "no livewindow decision journaled at promote"
+            assert any(r["choice"] == "promote" and not r["resolved"]
+                       for r in mine)
+            # serve a few reads, then evict: the decision resolves with
+            # the realized hit count
+            _insert(db, "lw_jrnl", [("h0", 1.0, END + MIN)])
+            q = _panel("lw_jrnl")
+            for _ in range(3):
+                db.execute(q)
+            STORE.drop(key, outcome="evict")
+            rows = db.execute(
+                "SELECT loop, choice, resolved, outcome "
+                "FROM system.public.decisions"
+            ).to_pylist()
+            done = [r for r in rows
+                    if r["loop"] == "livewindow" and r["resolved"]]
+            assert any(r["outcome"] == "evict" for r in done)
+            cal = db.execute(
+                "SELECT loop, samples FROM system.public.calibration"
+            ).to_pylist()
+            g = [r for r in cal if r["loop"] == "livewindow"]
+            assert g and int(g[0]["samples"]) >= 1, (
+                "livewindow eviction did not grade the calibration loop"
+            )
+        finally:
+            db.close()
+
+
+class TestLedgerAndExplain:
+    def test_route_and_state_buckets_parity(self):
+        """The ONE eligibility predicate drives EXPLAIN's promise and
+        the serve: ``LiveWindow:`` + route=livewindow in the plan text,
+        route=livewindow + state_buckets in the query_stats ledger."""
+        db = horaedb_tpu.connect(None)
+        proxy = Proxy(db)
+        try:
+            _seed(db, "lw_ledger", minutes=60)
+            for _ in range(3):
+                proxy.handle_sql(_panel("lw_ledger"))
+            _insert(db, "lw_ledger", [("h0", 7.0, END + MIN),
+                                      ("h1", 8.0, END + MIN + 500)])
+            q = _panel("lw_ledger")
+            plan = "\n".join(
+                r["plan"] for r in db.execute(f"EXPLAIN {q}").to_pylist()
+            )
+            assert "LiveWindow:" in plan
+            assert "route=livewindow" in plan
+            proxy.handle_sql(q)
+            assert db.interpreters.executor.last_path == "livewindow"
+            mine = [e for e in STATS_STORE.list()
+                    if "lw_ledger" in e.get("sql", "")]
+            assert any(e.get("route") == "livewindow" for e in mine)
+            served = [e for e in mine if e.get("route") == "livewindow"]
+            assert any(int(e.get("state_buckets") or 0) > 0
+                       for e in served), (
+                "route=livewindow row carries no state_buckets"
+            )
+        finally:
+            db.close()

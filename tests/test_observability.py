@@ -1840,3 +1840,56 @@ class TestElasticRegistryLint:
             assert rows, "no query_stats row carried table_name"
         finally:
             db.close()
+
+
+class TestLivewindowRegistryLint:
+    """ISSUE-18 lint extension (same contract as the decision/elastic
+    registries) for the live window state plane: every family declared
+    in state/livewindow.LIVEWINDOW_METRIC_FAMILIES must be (a)
+    registered live — eagerly at module import, so a node that never
+    promotes still exposes the plane as flat zeros — (b)
+    convention-clean, (c) documented in docs/OBSERVABILITY.md; no stray
+    horaedb_livewindow_* family may exist outside the declared
+    registry. The plane's env switches are operator surface: pinned to
+    docs/WORKLOAD.md."""
+
+    def test_livewindow_families_declared_and_documented(self):
+        import os
+        import re
+
+        from horaedb_tpu.state.livewindow import LIVEWINDOW_METRIC_FAMILIES
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(os.path.join(here, "..", "docs", "OBSERVABILITY.md")).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        suffixes = TestMetricsNameLint.SUFFIXES
+        missing = []
+        for fam in LIVEWINDOW_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for fam in families:
+            if (fam.startswith("horaedb_livewindow_")
+                    and fam not in LIVEWINDOW_METRIC_FAMILIES):
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("HORAEDB_LIVEWINDOW", "HORAEDB_LIVEWINDOW_BUDGET",
+                     "HORAEDB_LIVEWINDOW_DEPTH", "HORAEDB_LIVEWINDOW_PROMOTE",
+                     "HORAEDB_LIVEWINDOW_MAX_GROUPS"):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing
+
+    def test_livewindow_loop_declared_in_decision_plane(self):
+        from horaedb_tpu.obs.decisions import (
+            _EVENT_SAMPLE,
+            DECISION_LOOPS,
+        )
+
+        assert "livewindow" in DECISION_LOOPS
+        assert "livewindow" in _EVENT_SAMPLE

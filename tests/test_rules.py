@@ -509,9 +509,18 @@ class TestRollupRewrite:
     def test_ttl_boundary_reads_serve_from_rollup(self):
         """Raw SSTs older than the ladder's raw TTL drop WHOLE; the
         rollup keeps answering for that range, equal to what raw said
-        before the drop."""
+        before the drop.
+
+        The source range must sit AHEAD of the wall clock: background
+        flush-triggered compactions cut TTL at real `now`, and once the
+        calendar catches the fixed test epoch they race this test's
+        explicit `compact(now_ms=end)` for the expired files (observed
+        as a ~50% flake the week the epoch went stale)."""
         db = horaedb_tpu.connect(None)
-        start, end = _mk_source(db, "tb_src", hours=3)
+        fresh = ((int(time.time() * 1000) + 48 * HOUR) // HOUR) * HOUR
+        start, end = _mk_source(
+            db, "tb_src", hours=3, end=max(1_786_000_000_000, fresh)
+        )
         eng = RuleEngine(
             db,
             RulesSection(rollup_tables=["tb_src"], grace_s=0,

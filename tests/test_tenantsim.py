@@ -96,6 +96,7 @@ class TestTenantSimSmoke:
         # violations() enforced it; pin the active-loop set here too
         assert set(report.decision_active_loops) == {
             "kernel_router", "admission", "deadline", "dtype_tuner",
+            "livewindow",
         }, detail
         for loop in report.decision_active_loops:
             assert report.decision_resolved_counts.get(loop, 0) >= 1, detail
