@@ -220,10 +220,12 @@ class RowGroup:
             mask = self.validity.get(col.name)
             np_mask = None if mask is None else ~mask
             if isinstance(data, DictColumn):
+                # non-dictionary fields (e.g. a hinted float column frozen
+                # dictionary-coded) keep the FIELD's value type
                 arr = pa.DictionaryArray.from_arrays(
                     pa.array(data.codes, type=pa.int32(), mask=np_mask),
                     pa.array(list(data.values), type=f.type.value_type
-                             if pa.types.is_dictionary(f.type) else pa.string()),
+                             if pa.types.is_dictionary(f.type) else f.type),
                 )
                 if not pa.types.is_dictionary(f.type):
                     arr = arr.cast(f.type)

@@ -161,6 +161,42 @@ class FrozenSegment:
     max_seq: int
 
 
+def _dict_materialize_hinted(rows: RowGroup, table_name: str) -> RowGroup:
+    """Freeze low-cardinality float columns dictionary-coded.
+
+    The scan-cache layout tuner publishes per-(table, column) cardinality
+    observations; a frozen segment built for a hinted column carries
+    ``int32 codes + small vocabulary`` instead of a dense float column,
+    so the device cache (and the SST writer) start from the compact form.
+    Hints are advisory: any NaN or a vocabulary that outgrew the hint
+    falls back to the plain column.
+    """
+    if not table_name:
+        return rows
+    from ..common_types.dict_column import DictColumn
+    from ..common_types.layout_hints import low_cardinality_hint
+
+    out = None
+    for name, col in rows.columns.items():
+        if isinstance(col, DictColumn) or col.dtype not in (
+            np.float32,
+            np.float64,
+        ):
+            continue
+        hint = low_cardinality_hint(table_name, name)
+        if not hint or np.isnan(col).any():
+            continue
+        values, codes = np.unique(col, return_inverse=True)
+        if len(values) > max(2 * hint, 256):
+            continue
+        if out is None:
+            out = dict(rows.columns)
+        out[name] = DictColumn(codes.astype(np.int32), values)
+    if out is None:
+        return rows
+    return RowGroup(rows.schema, out, rows.validity)
+
+
 class LayeredMemTable:
     """Mutable head + immutable frozen segments
     (ref: analytic_engine/src/memtable/layered/ — a small mutable segment
@@ -176,11 +212,16 @@ class LayeredMemTable:
     """
 
     def __init__(
-        self, schema: Schema, id_: int = 0, switch_threshold: int = 4 << 20
+        self,
+        schema: Schema,
+        id_: int = 0,
+        switch_threshold: int = 4 << 20,
+        table_name: str = "",
     ) -> None:
         self.schema = schema
         self.id = id_
         self.switch_threshold = max(1, int(switch_threshold))
+        self.table_name = table_name
         self._lock = threading.Lock()
         self._head = ColumnarMemTable(schema)
         self._segments: list[FrozenSegment] = []
@@ -196,6 +237,7 @@ class LayeredMemTable:
         rows, seqs = self._head.scan(None)
         if len(rows) == 0:
             return
+        rows = _dict_materialize_hinted(rows, self.table_name)
         self._segments.append(
             FrozenSegment(
                 segment_id=next(_SEGMENT_IDS),
@@ -289,10 +331,15 @@ class LayeredMemTable:
 MemTable = ColumnarMemTable | LayeredMemTable
 
 
-def make_memtable(schema: Schema, id_: int, options) -> "MemTable":
+def make_memtable(
+    schema: Schema, id_: int, options, table_name: str = ""
+) -> "MemTable":
     """Factory honouring the table's ``memtable_type`` option."""
     if options is not None and getattr(options, "memtable_type", "columnar") == "layered":
         return LayeredMemTable(
-            schema, id_, getattr(options, "mutable_segment_switch_threshold", 4 << 20)
+            schema,
+            id_,
+            getattr(options, "mutable_segment_switch_threshold", 4 << 20),
+            table_name=table_name,
         )
     return ColumnarMemTable(schema, id_)

@@ -61,7 +61,9 @@ class TableData:
         self.writer_active = False
 
         if recovered_state is not None:
-            self.version = TableVersion(schema, recovered_state.levels, options=options)
+            self.version = TableVersion(
+                schema, recovered_state.levels, options=options, table_name=name
+            )
             self.version.flushed_sequence = recovered_state.flushed_sequence
             self._next_file_id = recovered_state.next_file_id
             self._last_sequence = max(
@@ -69,7 +71,7 @@ class TableData:
             )
             self.pk_sampler = None  # sampling covers the FIRST segment only
         else:
-            self.version = TableVersion(schema, options=options)
+            self.version = TableVersion(schema, options=options, table_name=name)
             self._next_file_id = 1
             self._last_sequence = 0
             # Brand-new table: sample key cardinalities until first flush

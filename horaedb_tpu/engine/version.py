@@ -39,12 +39,16 @@ class TableVersion:
         schema: Schema,
         levels: LevelsController | None = None,
         options=None,
+        table_name: str = "",
     ) -> None:
         self._lock = threading.RLock()
         self._schema = schema
         self._options = options  # drives memtable_type selection
+        self._table_name = table_name  # layout hints key freezes by table
         self._memtable_ids = itertools.count(1)
-        self._mutable = make_memtable(schema, next(self._memtable_ids), options)
+        self._mutable = make_memtable(
+            schema, next(self._memtable_ids), options, table_name
+        )
         self._immutables: list[MemTable] = []
         self.levels = levels if levels is not None else LevelsController()
         self.flushed_sequence = 0
@@ -70,7 +74,9 @@ class TableVersion:
             if not self._mutable.is_empty():
                 frozen = self._switch_memtable_locked()
             self._schema = schema
-            self._mutable = make_memtable(schema, next(self._memtable_ids), self._options)
+            self._mutable = make_memtable(
+                schema, next(self._memtable_ids), self._options, self._table_name
+            )
             return frozen
 
     # ---- memtables -----------------------------------------------------
@@ -89,7 +95,9 @@ class TableVersion:
     def _switch_memtable_locked(self) -> MemTable:
         frozen = self._mutable
         self._immutables.append(frozen)
-        self._mutable = make_memtable(self._schema, next(self._memtable_ids), self._options)
+        self._mutable = make_memtable(
+            self._schema, next(self._memtable_ids), self._options, self._table_name
+        )
         return frozen
 
     def immutables(self) -> list[MemTable]:

@@ -5,7 +5,7 @@ its own predictions.
 The engine runs five feedback loops (docs/OBSERVABILITY.md §Decision
 plane): the kernel router's per-shape impl EWMA, admission's cost-EWMA
 `est_cost_s`, the elastic controller's scale/move/hold rounds, the scan
-cache's dtype auto-tuner, and the deadline-budget shed check. Each one
+cache's layout auto-tuner, and the deadline-budget shed check. Each one
 predicts something, acts on it, and — before this module — discarded the
 prediction, so there was no way to tell a well-calibrated loop from a
 guessing one, and nothing for ROADMAP item 4's learned control plane to
@@ -62,7 +62,8 @@ DECISION_LOOPS = (
     "kernel_router",  # per-(plan shape, n_seg bucket) segment-impl EWMA
     "admission",      # est_cost_s admit/shed classification
     "elastic",        # scale/move/hold control rounds
-    "dtype_tuner",    # scan-cache bf16 -> f32 promotions
+    "layout_tuner",   # scan-cache per-column layouts (bf16/dict/delta),
+                      # absorbing the former dtype_tuner promotion loop
     "deadline",       # reason=deadline_budget sheds (provably doomed?)
     "livewindow",     # live-window state promotions (predicted vs realized hits)
 )
@@ -170,7 +171,7 @@ _EVENT_SAMPLE = {
     "kernel_router": 64,
     "admission": 16,
     "elastic": 1,
-    "dtype_tuner": 1,
+    "layout_tuner": 1,
     "deadline": 1,
     "livewindow": 1,
 }

@@ -16,8 +16,9 @@ the boundary where that impedance is resolved, all in vectorized numpy:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence
+from typing import Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..common_types.dict_column import as_values, unique_inverse
@@ -192,3 +193,284 @@ def build_padded_batch(
         mask=pad_to_bucket(mask.astype(np.bool_), n, fill=False),
         values=values,
     )
+
+
+# ---------------------------------------------------------------------------
+# Compressed device layouts (ISSUE 19)
+#
+# The scan cache stores columns in HBM; capacity, not kernel speed, bounds
+# how much of the working set gets device-path serving. These codecs trade
+# a few register-level ops per row for 4-8x fewer HBM bytes:
+#
+# - ``pack_bits``/``unpack_bits`` — a uint32 word stream holding fixed-width
+#   codes (1..16 bits). The device unpack is random-access (any gather index
+#   works), so the same stream serves full scans AND decode-on-gather.
+# - ``dict_encode`` — sorted-dictionary encoding for low-cardinality
+#   columns: bit-packed codes + a small pow2-padded dictionary. Sorted
+#   dictionaries let the executor pre-translate comparison literals into
+#   the code domain host-side (filters never decode).
+# - ``delta_for_encode`` — block frame-of-reference for sorted-ish int32
+#   streams (series codes, per-series relative timestamps): one int32 base
+#   per 128-row block + bit-packed offsets.
+#
+# All codecs are LOSSLESS and verified by bit-exact host roundtrip at
+# encode time; callers fall back to the raw layout on any mismatch (the
+# -0.0/0.0 collapse under np.unique is caught exactly this way).
+#
+# Layout descriptors are small hashable tuples that ride jit static args
+# (flipping a layout re-keys the trace — the PR-6 lesson):
+#
+#   value field:  ("raw",) | ("bf16",) | ("dict", width, full_decode)
+#   timestamps:   ("raw",) | ("dict", width) | ("delta", width)
+#   series codes: ("raw",) | ("delta", width)
+# ---------------------------------------------------------------------------
+
+RAW_LAYOUT = ("raw",)
+BF16_LAYOUT = ("bf16",)
+
+# Frame-of-reference block size. 128 divides every shape bucket (pow2 >=
+# 4096), and series codes — consecutive np.unique inverses, non-decreasing
+# — span at most 128 distinct values per block, so offsets always fit 8 bits.
+FOR_BLOCK = 128
+_FOR_SHIFT = 7
+
+_MAX_CODE_WIDTH = 16
+
+
+def _bit_width(max_value: int) -> int:
+    """Bits needed to store values in [0, max_value] (min 1)."""
+    return max(1, int(max_value).bit_length())
+
+
+def pack_bits(values: np.ndarray, width: int) -> np.ndarray:
+    """Pack unsigned ints (< 2**width) into a dense uint32 word stream.
+
+    One trailing safety word is appended so the device unpack may always
+    read ``words[wi + 1]`` without bounds checks.
+    """
+    if not 1 <= width <= _MAX_CODE_WIDTH:
+        raise ValueError(f"width must be in [1, {_MAX_CODE_WIDTH}], got {width}")
+    v = values.astype(np.uint64, copy=False)
+    n = len(v)
+    n_words = (n * width + 31) // 32 + 1
+    pos = np.arange(n, dtype=np.uint64) * np.uint64(width)
+    wi = (pos >> np.uint64(5)).astype(np.int64)
+    sh = pos & np.uint64(31)
+    shifted = v << sh  # width<=16, sh<=31 -> fits u64
+    words = np.zeros(n_words, dtype=np.uint64)
+    np.bitwise_or.at(words, wi, shifted & np.uint64(0xFFFFFFFF))
+    np.bitwise_or.at(words, wi + 1, shifted >> np.uint64(32))
+    return (words & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def unpack_bits_host(words: np.ndarray, width: int, n: int) -> np.ndarray:
+    """Host-side mirror of the device unpack (roundtrip verification)."""
+    w64 = words.astype(np.uint64)
+    pos = np.arange(n, dtype=np.uint64) * np.uint64(width)
+    wi = (pos >> np.uint64(5)).astype(np.int64)
+    sh = pos & np.uint64(31)
+    lo = w64[wi] >> sh
+    # shift-by-32 is undefined on fixed-width ints: guard the aligned case
+    hi = np.where(sh == np.uint64(0), np.uint64(0), w64[wi + 1] << (np.uint64(32) - sh))
+    return ((lo | hi) & np.uint64((1 << width) - 1)).astype(np.uint32)
+
+
+def unpack_bits(words, width: int, idx):
+    """Device random-access unpack: codes at row positions ``idx``.
+
+    ``words`` is the uint32 stream (with safety word); ``idx`` any int32
+    index array. Two gathers + shifts, all in registers — HBM traffic is
+    the packed words, never a decoded column.
+    """
+    p = idx.astype(jnp.uint32) * jnp.uint32(width)
+    wi = (p >> 5).astype(jnp.int32)
+    sh = p & jnp.uint32(31)
+    lo = words[wi] >> sh
+    # (32 - sh) & 31 keeps the shift in range; the sh==0 lane is masked off
+    hi = jnp.where(
+        sh == 0, jnp.uint32(0), words[wi + 1] << ((jnp.uint32(32) - sh) & jnp.uint32(31))
+    )
+    return (lo | hi) & jnp.uint32((1 << width) - 1)
+
+
+@dataclass(frozen=True)
+class DictEncoded:
+    """Sorted-dictionary encoding of one padded column."""
+
+    words: np.ndarray  # uint32 packed codes (+ safety word)
+    dictionary: np.ndarray  # sorted values, pow2-padded with the max value
+    dict_host: np.ndarray  # unpadded sorted dictionary (literal translation)
+    width: int  # bits per code
+    encoding: str  # "dict8" | "dict16"
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.words.nbytes + self.dictionary.nbytes)
+
+
+def dict_encode(padded: np.ndarray, max_cardinality: int) -> Optional[DictEncoded]:
+    """Dictionary-encode a padded f32/int32 column, or None if ineligible.
+
+    Eligible when the column is NaN-free and its cardinality fits both the
+    cap and a 16-bit code. The dictionary is sorted (np.unique), so code
+    order == value order and comparison literals translate host-side via
+    searchsorted. A bit-exact roundtrip is verified before accepting.
+    """
+    if padded.dtype.kind == "f" and np.isnan(padded).any():
+        return None
+    uniq = np.unique(padded)
+    if len(uniq) > max_cardinality or len(uniq) > (1 << _MAX_CODE_WIDTH):
+        return None
+    width = _bit_width(len(uniq) - 1) if len(uniq) > 1 else 1
+    codes = np.searchsorted(uniq, padded).astype(np.uint32)
+    words = pack_bits(codes, width)
+    decoded = uniq[unpack_bits_host(words, width, len(padded))]
+    # bitwise comparison: catches -0.0/0.0 collapse and any packing bug
+    if decoded.view(np.int32).tobytes() != padded.view(np.int32).tobytes():
+        return None
+    n_dict = next_pow2(len(uniq), floor=8)
+    dictionary = np.pad(uniq, (0, n_dict - len(uniq)), mode="edge")
+    return DictEncoded(
+        words=words,
+        dictionary=dictionary,
+        dict_host=uniq,
+        width=width,
+        encoding="dict8" if width <= 8 else "dict16",
+    )
+
+
+@dataclass(frozen=True)
+class DeltaEncoded:
+    """Block frame-of-reference encoding of one padded int32 column."""
+
+    words: np.ndarray  # uint32 packed offsets (+ safety word)
+    base: np.ndarray  # int32 per-block minima, len == n/FOR_BLOCK
+    width: int  # bits per offset
+    encoding: str = "delta"
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.words.nbytes + self.base.nbytes)
+
+
+def delta_for_encode(arr: np.ndarray, max_bits: int) -> Optional[DeltaEncoded]:
+    """Delta/FOR-encode a padded int32 column, or None if offsets overflow.
+
+    ``len(arr)`` must be a multiple of FOR_BLOCK (every shape bucket is).
+    The global offset width is the max block range — one scattered block
+    (e.g. a pad boundary) can reject the whole column, which is fine: the
+    tuner falls back to dict or raw.
+    """
+    if len(arr) % FOR_BLOCK:
+        return None
+    blocks = arr.astype(np.int64, copy=False).reshape(-1, FOR_BLOCK)
+    base = blocks.min(axis=1)
+    offsets = blocks - base[:, None]
+    width = _bit_width(int(offsets.max()) if len(arr) else 0)
+    if width > min(max_bits, _MAX_CODE_WIDTH):
+        return None
+    words = pack_bits(offsets.ravel().astype(np.uint32), width)
+    base32 = base.astype(np.int32)
+    decoded = base32[np.arange(len(arr)) >> _FOR_SHIFT] + unpack_bits_host(
+        words, width, len(arr)
+    ).astype(np.int32)
+    if not np.array_equal(decoded, arr):
+        return None
+    return DeltaEncoded(words=words, base=base32, width=width)
+
+
+# ---- device-side layout decode (shared by scan_agg / scan_topk) -----------
+
+
+def _iota(n_rows: int):
+    return jnp.arange(n_rows, dtype=jnp.int32)
+
+
+def decode_series(parts, layout, n_rows: int, idx=None):
+    """int32 series codes under ``layout`` — all rows (idx=None) or a gather.
+
+    ``parts`` is the device part tuple: ("raw",) -> (codes,);
+    ("delta", w) -> (words, base).
+    """
+    if layout[0] == "raw":
+        return parts[0] if idx is None else parts[0][idx]
+    words, base = parts
+    ix = _iota(n_rows) if idx is None else idx
+    return base[ix >> _FOR_SHIFT] + unpack_bits(words, layout[1], ix).astype(jnp.int32)
+
+
+def decode_ts(parts, layout, n_rows: int, idx=None):
+    """int32 relative timestamps under ``layout``."""
+    if layout[0] == "raw":
+        return parts[0] if idx is None else parts[0][idx]
+    ix = _iota(n_rows) if idx is None else idx
+    if layout[0] == "dict":
+        words, dictionary = parts
+        return dictionary[unpack_bits(words, layout[1], ix)]
+    words, base = parts
+    return base[ix >> _FOR_SHIFT] + unpack_bits(words, layout[1], ix).astype(jnp.int32)
+
+
+def decode_value(parts, layout, n_rows: int, idx=None):
+    """f32 values under a value-field layout.
+
+    ``("dict", w, False)`` (filter-only fields) returns the CODES as f32 —
+    the executor pre-translated the comparison literal into the code
+    domain, so the predicate never touches the dictionary.
+    """
+    if layout[0] in ("raw", "bf16"):
+        arr = parts[0] if idx is None else parts[0][idx]
+        return arr.astype(jnp.float32)
+    words, dictionary = parts
+    codes = unpack_bits(words, layout[1], _iota(n_rows) if idx is None else idx)
+    if len(layout) > 2 and not layout[2]:
+        return codes.astype(jnp.float32)
+    return dictionary[codes]
+
+
+def layout_rows(parts, layout) -> int:
+    """Static logical row count of one encoded/raw part tuple."""
+    if layout[0] == "delta":
+        return parts[1].shape[0] * FOR_BLOCK
+    return parts[0].shape[0]
+
+
+def _as_parts(x):
+    return x if isinstance(x, tuple) else (x,)
+
+
+def decode_layouts(
+    series_codes, ts_rel, values, series_layout, ts_layout, value_layouts, idx=None
+):
+    """Reconstruct kernel inputs from their resident layouts.
+
+    With ``idx`` given, only those row positions decode (decode-on-gather:
+    the selective path ships an M-row index and the device reads M encoded
+    rows, not N). Raw inputs pass through untouched — legacy callers
+    (dist paths, direct tests) never pay for the generality. Encoded
+    values come back as a LIST of per-field rows; the kernels stack only
+    what they aggregate.
+    """
+    if (
+        series_layout[0] == "raw"
+        and ts_layout[0] == "raw"
+        and not any(l[0] not in ("raw", "bf16") for l in value_layouts)
+        and not isinstance(values, tuple)
+    ):
+        if idx is None:
+            return _as_parts(series_codes)[0], _as_parts(ts_rel)[0], values
+        return (
+            _as_parts(series_codes)[0][idx],
+            _as_parts(ts_rel)[0][idx],
+            values[:, idx],
+        )
+    sc_parts = _as_parts(series_codes)
+    ts_parts = _as_parts(ts_rel)
+    n_rows = layout_rows(sc_parts, series_layout)
+    sc = decode_series(sc_parts, series_layout, n_rows, idx)
+    tr = decode_ts(ts_parts, ts_layout, n_rows, idx)
+    layouts = value_layouts or tuple(("raw",) for _ in values)
+    vals = [
+        decode_value(_as_parts(p), l, n_rows, idx) for p, l in zip(values, layouts)
+    ]
+    return sc, tr, vals

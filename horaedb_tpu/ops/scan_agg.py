@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.env import env_int
-from .encoding import PaddedBatch, next_pow2
+from .encoding import PaddedBatch, decode_layouts as _decode_layouts, next_pow2
 
 AGG_OPS = ("count", "sum", "min", "max", "avg")
 
@@ -119,6 +119,12 @@ class ScanAggSpec:
     # Sized from the router's cardinality estimate, bucketed to powers
     # of two so it mints a bounded number of jit keys.
     hash_slots: int = 0
+    # Compressed-layout descriptors (ops.encoding, ISSUE 19). Static and
+    # hashable: flipping a column's layout re-keys the trace, exactly like
+    # a segment-impl change. () / ("raw",) are the legacy dense layouts.
+    value_layouts: tuple = ()  # per-field, e.g. (("raw",), ("dict", 7, True))
+    ts_layout: tuple = ("raw",)
+    series_layout: tuple = ("raw",)
 
     def padded(self) -> "ScanAggSpec":
         # Ungrouped specs (n_groups == 1) skip group padding entirely: the
@@ -135,6 +141,9 @@ class ScanAggSpec:
             need_minmax=self.need_minmax,
             segment_impl=self.segment_impl,
             hash_slots=self.hash_slots,
+            value_layouts=self.value_layouts,
+            ts_layout=self.ts_layout,
+            series_layout=self.series_layout,
         )
 
 
@@ -270,7 +279,13 @@ def scan_agg_body(
 
     n_seg = n_groups * n_buckets
     seg_raw = group_codes * n_buckets + bucket_ids
-    agg_vals = values[:n_agg_fields] if n_agg_fields else None
+    # ``values`` may be a list of per-field rows (the encoded-layout decode
+    # produces one array per field): stack only the agg fields — fields
+    # referenced solely by filters never materialize a decoded column.
+    if isinstance(values, (list, tuple)):
+        agg_vals = jnp.stack(values[:n_agg_fields]) if n_agg_fields else None
+    else:
+        agg_vals = values[:n_agg_fields] if n_agg_fields else None
     # Dispatch entry points (scan_aggregate, the executor's cached-packed
     # call, dist_agg's step builders) resolve the impl ON HOST and pass
     # the concrete name as this static arg — so flipping the env pin /
@@ -302,7 +317,10 @@ def scan_agg_body(
         mins = mins.reshape(shape)
         maxs = maxs.reshape(shape)
     else:
-        zero = jnp.zeros((0, n_groups, n_buckets), dtype=values.dtype)
+        vdtype = (
+            jnp.float32 if isinstance(values, (list, tuple)) else values.dtype
+        )
+        zero = jnp.zeros((0, n_groups, n_buckets), dtype=vdtype)
         sums = mins = maxs = zero
     return counts, sums, mins, maxs
 
@@ -335,6 +353,9 @@ def cached_scan_agg_body(
     need_minmax: bool = True,
     segment_impl: str = "auto",
     hash_slots: int = 0,
+    value_layouts: tuple = (),
+    ts_layout: tuple = ("raw",),
+    series_layout: tuple = ("raw",),
 ):
     """The steady-state serving kernel over HBM-resident columns.
 
@@ -344,17 +365,28 @@ def cached_scan_agg_body(
     timestamps, value columns) stay on device across queries — uploads are
     O(series + scalars), not O(rows).
 
+    Compressed layouts (ISSUE 19): when the layout descriptors say so,
+    ``series_codes``/``ts_rel`` arrive as encoded part tuples and
+    ``values`` as a tuple of per-field part tuples. The decode below runs
+    in registers at the top of the fused program — HBM traffic is the
+    encoded bytes, and filter-only dict fields compare raw codes against
+    host-pre-translated literals without ever touching the dictionary.
+
     Pure body: also the per-shard program when the cache is sharded over a
     mesh (parallel/dist_agg.make_cached_dist_scan_agg wraps it with
-    psum/pmin/pmax collectives).
+    psum/pmin/pmax collectives — that path always runs the raw layout).
     """
+    series_codes, ts_rel, values = _decode_layouts(
+        series_codes, ts_rel, values, series_layout, ts_layout, value_layouts
+    )
     mask = allowed_series[series_codes]
     mask = mask & (ts_rel >= lo_rel) & (ts_rel < hi_rel)
     bucket = jnp.clip((ts_rel - t0_rel) // bucket_ms, 0, n_buckets - 1).astype(jnp.int32)
     group_codes = group_of_series[series_codes]
-    # bf16-resident value columns (HORAEDB_CACHE_DTYPE) upcast here:
-    # accumulation always runs in f32 (no-op when already f32)
-    values = values.astype(jnp.float32)
+    if not isinstance(values, (list, tuple)):
+        # bf16-resident value columns (HORAEDB_CACHE_DTYPE) upcast here:
+        # accumulation always runs in f32 (no-op when already f32)
+        values = values.astype(jnp.float32)
     return scan_agg_body(
         group_codes,
         bucket,
@@ -376,6 +408,7 @@ cached_scan_agg = functools.partial(
     static_argnames=(
         "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
         "need_minmax", "segment_impl", "hash_slots",
+        "value_layouts", "ts_layout", "series_layout",
     ),
 )(cached_scan_agg_body)
 
@@ -495,6 +528,9 @@ def _packed_body(
     segment_impl: str = "auto",
     hash_slots: int = 0,
     selective: bool = False,
+    value_layouts: tuple = (),
+    ts_layout: tuple = ("raw",),
+    series_layout: tuple = ("raw",),
 ):
     s1 = session.shape[0] // 2
     gos = session[:s1]
@@ -503,10 +539,14 @@ def _packed_body(
     literals = jax.lax.bitcast_convert_type(dyn[:n_f], jnp.float32)
     lo, hi, t0, width = dyn[n_f], dyn[n_f + 1], dyn[n_f + 2], dyn[n_f + 3]
     if selective:
+        # decode-on-gather: only the M shipped row positions are read from
+        # the encoded streams; the full columns never decode
         idx = dyn[n_f + 4 :]
-        series_codes = series_codes[idx]
-        ts_rel = ts_rel[idx]
-        values = values[:, idx]
+        series_codes, ts_rel, values = _decode_layouts(
+            series_codes, ts_rel, values, series_layout, ts_layout,
+            value_layouts, idx=idx,
+        )
+        value_layouts, ts_layout, series_layout = (), ("raw",), ("raw",)
     counts, sums, mins, maxs = cached_scan_agg_body(
         series_codes, ts_rel, values, gos, allow, literals, lo, hi, t0, width,
         n_groups=n_groups,
@@ -516,6 +556,9 @@ def _packed_body(
         need_minmax=need_minmax,
         segment_impl=segment_impl,
         hash_slots=hash_slots,
+        value_layouts=value_layouts,
+        ts_layout=ts_layout,
+        series_layout=series_layout,
     )
     parts = [
         jax.lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
@@ -531,6 +574,7 @@ cached_scan_agg_packed = functools.partial(
     static_argnames=(
         "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
         "need_minmax", "segment_impl", "hash_slots", "selective",
+        "value_layouts", "ts_layout", "series_layout",
     ),
 )(_packed_body)
 
@@ -549,14 +593,17 @@ def _cohort_body(
     need_minmax: bool,
     segment_impl: str = "auto",
     hash_slots: int = 0,
+    value_layouts: tuple = (),
+    ts_layout: tuple = ("raw",),
+    series_layout: tuple = ("raw",),
 ):
     """The multi-query fused serving kernel: ``_packed_body`` vmapped
     over the QUERY axis. The big resident arrays (series codes, relative
-    timestamps, value columns) broadcast across the batch — HBM is read
-    by one compiled program serving B logical queries, instead of B
-    dispatches each paying its own device RTT. Selective row-gather is
-    per-query-variable-length and therefore excluded: cohort members
-    always run the full-scan kernel."""
+    timestamps, value columns — raw or encoded part tuples alike)
+    broadcast across the batch — HBM is read by one compiled program
+    serving B logical queries, instead of B dispatches each paying its
+    own device RTT. Selective row-gather is per-query-variable-length and
+    therefore excluded: cohort members always run the full-scan kernel."""
     one = functools.partial(
         _packed_body,
         n_groups=n_groups,
@@ -567,6 +614,9 @@ def _cohort_body(
         segment_impl=segment_impl,
         hash_slots=hash_slots,
         selective=False,
+        value_layouts=value_layouts,
+        ts_layout=ts_layout,
+        series_layout=series_layout,
     )
     return jax.vmap(
         lambda s, d: one(series_codes, ts_rel, values, s, d)
@@ -578,6 +628,7 @@ cached_scan_agg_cohort = functools.partial(
     static_argnames=(
         "n_groups", "n_buckets", "n_agg_fields", "numeric_filters",
         "need_minmax", "segment_impl", "hash_slots",
+        "value_layouts", "ts_layout", "series_layout",
     ),
 )(_cohort_body)
 

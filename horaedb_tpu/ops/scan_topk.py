@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.env import env_int
-from .encoding import next_pow2
+from .encoding import decode_layouts, next_pow2
 
 _I32_MIN = -(2**31)
 
@@ -87,6 +87,13 @@ class RawScanSpec:
     key_field: int = 0  # row of ``values`` when key_is_ts is False
     numeric_filters: tuple[tuple[int, str], ...] = ()
     select_slots: int = 0
+    # Compressed-layout descriptors (ops.encoding, ISSUE 19) — static jit
+    # keys, same contract as ScanAggSpec. The sort-key field always fully
+    # decodes; filter-only dict fields stay in the code domain (the
+    # executor pre-translates their literals against the sorted dict).
+    value_layouts: tuple = ()
+    ts_layout: tuple = ("raw",)
+    series_layout: tuple = ("raw",)
 
 
 def padded_k(n_rows: int, limit_plus_offset: int) -> int:
@@ -347,6 +354,7 @@ def _unpack_dyn(dyn, numeric_filters):
     jax.jit,
     static_argnames=(
         "k", "descending", "key_is_ts", "key_field", "numeric_filters",
+        "value_layouts", "ts_layout", "series_layout",
     ),
 )
 def raw_topk_packed(
@@ -361,9 +369,15 @@ def raw_topk_packed(
     key_is_ts: bool,
     key_field: int,
     numeric_filters: tuple[tuple[int, int], ...],
+    value_layouts: tuple = (),
+    ts_layout: tuple = ("raw",),
+    series_layout: tuple = ("raw",),
 ):
     """-> int32[k] resident row indices, -1 in slots with no passing row."""
     literals, lo, hi, key_lo, key_hi = _unpack_dyn(dyn, numeric_filters)
+    series_codes, ts_rel, values = decode_layouts(
+        series_codes, ts_rel, values, series_layout, ts_layout, value_layouts
+    )
     _, idx = raw_topk_body(
         series_codes, ts_rel, values, session != 0, literals, lo, hi,
         key_lo, key_hi,
@@ -377,6 +391,7 @@ def raw_topk_packed(
     jax.jit,
     static_argnames=(
         "k", "descending", "key_is_ts", "key_field", "numeric_filters",
+        "value_layouts", "ts_layout", "series_layout",
     ),
 )
 def raw_topk_cohort(
@@ -391,12 +406,18 @@ def raw_topk_cohort(
     key_is_ts: bool,
     key_field: int,
     numeric_filters: tuple[tuple[int, int], ...],
+    value_layouts: tuple = (),
+    ts_layout: tuple = ("raw",),
+    series_layout: tuple = ("raw",),
 ):
     """Multi-query fused top-k: ``raw_topk_packed``'s body vmapped over
     the QUERY axis — B shape-identical dashboard ORDER-BY-LIMIT queries
     (same k, differing allow-lists/time bounds/literals) share one
     compiled program and one device round trip. -> int32[B, k] resident
     row indices, -1 in slots with no passing row."""
+    series_codes, ts_rel, values = decode_layouts(
+        series_codes, ts_rel, values, series_layout, ts_layout, value_layouts
+    )
 
     def one(session, dyn):
         literals, lo, hi, key_lo, key_hi = _unpack_dyn(dyn, numeric_filters)
@@ -413,7 +434,10 @@ def raw_topk_cohort(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("select_slots", "numeric_filters"),
+    static_argnames=(
+        "select_slots", "numeric_filters",
+        "value_layouts", "ts_layout", "series_layout",
+    ),
 )
 def raw_select_packed(
     series_codes,
@@ -424,9 +448,15 @@ def raw_select_packed(
     *,
     select_slots: int,
     numeric_filters: tuple[tuple[int, int], ...],
+    value_layouts: tuple = (),
+    ts_layout: tuple = ("raw",),
+    series_layout: tuple = ("raw",),
 ):
     """-> int32[1 + slots]: [passing count | row indices...]."""
     literals, lo, hi, _, _ = _unpack_dyn(dyn, numeric_filters)
+    series_codes, ts_rel, values = decode_layouts(
+        series_codes, ts_rel, values, series_layout, ts_layout, value_layouts
+    )
     out, count = raw_select_body(
         series_codes, ts_rel, values, session != 0, literals, lo, hi,
         select_slots=select_slots, numeric_filters=numeric_filters,

@@ -417,6 +417,28 @@ def _eval_func(e: ast.FuncCall, rows: RowGroup) -> tuple[np.ndarray, np.ndarray]
 # ---- executor ------------------------------------------------------------
 
 
+def _translate_code_literal(dict_host: np.ndarray, op: str, lit) -> float:
+    """Pre-translate a numeric filter literal into the CODE domain of a
+    dictionary-encoded column (ISSUE 19): the dictionary is sorted, so
+    code order == value order and every comparison op maps onto the same
+    op over code indices — the kernel filters bit-packed codes without
+    ever touching the dictionary. The op never changes (it is a static
+    jit key); only the literal moves, and literals ride the dynamic
+    buffer. Codes are < 2^16, exact in f32."""
+    lit32 = np.float32(lit) if dict_host.dtype.kind == "f" else lit
+    if op == "<" or op == ">=":
+        # value < lit  <=>  code < left;  value >= lit  <=>  code >= left
+        return float(np.searchsorted(dict_host, lit32, "left"))
+    if op == "<=" or op == ">":
+        # value <= lit <=> code <= right-1; value > lit <=> code > right-1
+        return float(np.searchsorted(dict_host, lit32, "right") - 1)
+    # "=" / "!=": the exact code, or a sentinel no code (>= 0) can equal
+    i = int(np.searchsorted(dict_host, lit32, "left"))
+    if i < len(dict_host) and dict_host[i] == lit32:
+        return float(i)
+    return -1.0
+
+
 @dataclass
 class CachedAggPrep:
     """A fully-prepared cached-aggregate device dispatch — the output of
@@ -454,6 +476,9 @@ class CachedAggPrep:
     agg_cols: list
     num_groups: int
     delta: Any
+    # static per-field layout descriptors (ISSUE 19) — jit-key fragments:
+    # a column re-encoding between preps must not share a traced kernel
+    value_layouts: tuple = ()
 
     def fuse_key(self, i: int) -> tuple:
         """Grouping key for cohort merging: preps agreeing on the cache
@@ -463,7 +488,10 @@ class CachedAggPrep:
         key)."""
         if self.row_idx is not None or self.entry.mesh is not None:
             return ("solo", i)
-        return (id(self.entry), self.spec, tuple(self.value_names))
+        return (
+            id(self.entry), self.spec, tuple(self.value_names),
+            self.value_layouts,
+        )
 
 
 class Executor:
@@ -915,7 +943,7 @@ class Executor:
 
         filter_cols = [f[0] for f in device_filters]
         value_names = list(dict.fromkeys(agg_cols + filter_cols))
-        value_arrays = [rows.column(c) for c in value_names]
+        value_arrays = [as_values(rows.column(c)) for c in value_names]
         batch = build_padded_batch(enc.codes, bucket_ids, mask, value_arrays)
         spec = ScanAggSpec(
             n_groups=max(enc.num_groups, 1),
@@ -1238,7 +1266,25 @@ class Executor:
         if scan_allowed is not allowed:
             # value-stat prunes only — not series tag filters excluded
             m["series_pruned"] = int(allowed.sum() - scan_allowed.sum())
-        literals = [lit for _, _, lit in device_filters]
+        # Compressed-layout routing (ISSUE 19): per-field static layout
+        # descriptors. Aggregated fields fully decode on device; a field
+        # only FILTERS touch stays in the bit-packed code domain — its
+        # literals pre-translate against the sorted dictionary here, so
+        # the kernel compares codes and never materializes the column.
+        agg_set = set(agg_cols)
+        value_layouts = tuple(
+            entry.value_layout(c, full_decode=(c in agg_set))
+            for c in value_names
+        )
+        literals = [
+            _translate_code_literal(
+                entry.value_cols_dev[col].dict_host, op, lit
+            )
+            if (lay := value_layouts[value_names.index(col)])[0] == "dict"
+            and not lay[2]
+            else lit
+            for col, op, lit in device_filters
+        ]
         lo_rel = lo - entry.min_ts
         hi_rel = hi - entry.min_ts
         t0_rel = max(t0 - entry.min_ts, -(2**31) + 1) if not empty_range else 0
@@ -1247,6 +1293,7 @@ class Executor:
             spec.n_groups, spec.n_buckets, spec.n_agg_fields,
             spec.numeric_filters, spec.need_minmax,
             spec.segment_impl, spec.hash_slots,
+            value_layouts, entry.ts_layout, entry.series_layout,
         )
         row_idx = None
         if entry.mesh is None and allow_selective and not empty_range:
@@ -1264,6 +1311,7 @@ class Executor:
             kernel_key=kernel_key,
             tag_keys=tag_keys, key_values=key_values, agg_cols=agg_cols,
             num_groups=num_groups, delta=delta,
+            value_layouts=value_layouts,
         )
 
     def dispatch_cached_agg(self, prep: "CachedAggPrep") -> ResultSet:
@@ -1336,8 +1384,8 @@ class Executor:
             session_dev = entry.session_for(gos, allow_scan)
             dyn = pack_dyn(literals, lo_rel, hi_rel, t0_rel, width_i, row_idx)
             pargs = (
-                entry.series_codes_dev,
-                entry.ts_rel_dev,
+                entry.series_parts,
+                entry.ts_parts,
                 values_dev,
                 session_dev,
                 jnp.asarray(dyn),
@@ -1351,6 +1399,9 @@ class Executor:
                 segment_impl=spec.segment_impl,
                 hash_slots=spec.hash_slots,
                 selective=row_idx is not None,
+                value_layouts=prep.value_layouts,
+                ts_layout=entry.ts_layout,
+                series_layout=entry.series_layout,
             )
             packed = timed_dispatch(
                 "cached_packed",
@@ -1432,8 +1483,8 @@ class Executor:
         packed = timed_dispatch(
             "cached_cohort",
             lambda: cached_scan_agg_cohort(
-                entry.series_codes_dev,
-                entry.ts_rel_dev,
+                entry.series_parts,
+                entry.ts_parts,
                 values_dev,
                 jnp.asarray(sessions),
                 jnp.asarray(dyns),
@@ -1444,6 +1495,9 @@ class Executor:
                 need_minmax=spec.need_minmax,
                 segment_impl=spec.segment_impl,
                 hash_slots=spec.hash_slots,
+                value_layouts=p0.value_layouts,
+                ts_layout=entry.ts_layout,
+                series_layout=entry.series_layout,
             ),
         )
         rows = np.asarray(jax.device_get(packed))
@@ -1895,7 +1949,22 @@ class Executor:
             m["delta_rows"] = len(delta)
             querystats.record(memtable_rows=len(delta))
 
-        literals = [lit for _, _, lit in device_filters]
+        # Compressed layouts (ISSUE 19): raw reads return ROW INDICES and
+        # gather from the host copy, so no field ever needs its decoded
+        # values on device — dictionary columns stay in the code domain
+        # even as the SORT KEY (the dictionary is sorted: code order ==
+        # value order, ties included), and filter literals pre-translate.
+        value_layouts = tuple(
+            entry.value_layout(c, full_decode=False) for c in value_names
+        )
+        literals = [
+            _translate_code_literal(
+                entry.value_cols_dev[col].dict_host, op, lit
+            )
+            if value_layouts[value_names.index(col)][0] == "dict"
+            else lit
+            for col, op, lit in device_filters
+        ]
         nfilters = tuple(
             (value_names.index(c), op) for c, op, _ in device_filters
         )
@@ -1911,7 +1980,7 @@ class Executor:
                     # per-shard k is bounded by the shard length; a shard
                     # smaller than k contributes ALL its rows — still a
                     # superset of the global top-k
-                    k = min(k, len(entry.series_codes_dev) // n_dev)
+                    k = min(k, entry.padded_rows // n_dev)
                 spec = RawScanSpec(
                     k=k,
                     descending=not order[2],
@@ -1929,6 +1998,7 @@ class Executor:
             kernel_key = (
                 "raw", kind, n_dev, spec.k, spec.select_slots,
                 spec.descending, spec.key_is_ts, spec.key_field, nfilters,
+                value_layouts, entry.ts_layout, entry.series_layout,
             )
             key_lo = key_hi = 0
             if kind == "topk":
@@ -1975,12 +2045,15 @@ class Executor:
                     packed = timed_dispatch(
                         dkind,
                         lambda: raw_topk_packed(
-                            entry.series_codes_dev, entry.ts_rel_dev,
+                            entry.series_parts, entry.ts_parts,
                             values_dev, session_dev, dyn,
                             k=spec.k, descending=spec.descending,
                             key_is_ts=spec.key_is_ts,
                             key_field=spec.key_field,
                             numeric_filters=encode_filter_ops(nfilters),
+                            value_layouts=value_layouts,
+                            ts_layout=entry.ts_layout,
+                            series_layout=entry.series_layout,
                         ),
                     )
                     got = np.asarray(jax.device_get(packed))
@@ -1990,10 +2063,13 @@ class Executor:
                     packed = timed_dispatch(
                         dkind,
                         lambda: raw_select_packed(
-                            entry.series_codes_dev, entry.ts_rel_dev,
+                            entry.series_parts, entry.ts_parts,
                             values_dev, session_dev, dyn,
                             select_slots=spec.select_slots,
                             numeric_filters=encode_filter_ops(nfilters),
+                            value_layouts=value_layouts,
+                            ts_layout=entry.ts_layout,
+                            series_layout=entry.series_layout,
                         ),
                     )
                     got = np.asarray(jax.device_get(packed))

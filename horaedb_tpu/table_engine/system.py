@@ -721,6 +721,11 @@ _DEVICE_SCHEMA = Schema.build(
         ColumnSchema("rows", DatumKind.INT64),
         ColumnSchema("last_hit_age_ms", DatumKind.INT64),
         ColumnSchema("evictions", DatumKind.INT64),
+        # compressed-layout inventory (ISSUE 19): the resident encoding
+        # (raw|bf16|dict8|dict16|delta) and the LOGICAL rows the encoded
+        # bytes serve — rows-per-HBM-byte reads straight off this table
+        ColumnSchema("encoding", DatumKind.STRING),
+        ColumnSchema("logical_rows", DatumKind.INT64),
     ],
     timestamp_column="timestamp",
     primary_key=["timestamp", "table_name", "column_name", "component"],
@@ -783,6 +788,14 @@ class DeviceTable(_VirtualTable):
                 ),
                 "evictions": np.array(
                     [int(e.get("evictions", 0)) for e in entries],
+                    dtype=np.int64,
+                ),
+                "encoding": np.array(
+                    [str(e.get("encoding", "")) for e in entries],
+                    dtype=object,
+                ),
+                "logical_rows": np.array(
+                    [int(e.get("logical_rows", 0)) for e in entries],
                     dtype=np.int64,
                 ),
             },

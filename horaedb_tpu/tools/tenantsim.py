@@ -1843,7 +1843,7 @@ class TenantSim:
         if cfg.elastic:
             active.append("elastic")
         if cfg.dtype_auto:
-            active.append("dtype_tuner")
+            active.append("layout_tuner")
         if cfg.livewindow:
             active.append("livewindow")
             self._drive_livewindow(ep)

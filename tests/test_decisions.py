@@ -91,17 +91,17 @@ class TestJournalAccounting:
         """Unresolved decisions past HORAEDB_DECISION_EXPIRE_MS are lazily
         counted expired; their late resolve is then a miss."""
         j = DecisionJournal(maxlen=8)
-        i = j.record("dtype_tuner", key="t:c", choice="promote_f32",
+        i = j.record("layout_tuner", key="t:c", choice="promote_f32",
                      predicted=100.0)
         monkeypatch.setenv("HORAEDB_DECISION_EXPIRE_MS", "0.0001")
         # any verb triggers the lazy head-expiry scan
         s = j.stats()
-        c = s["loops"]["dtype_tuner"]
+        c = s["loops"]["layout_tuner"]
         assert c["expired"] == 1 and c["unresolved"] == 0
         monkeypatch.delenv("HORAEDB_DECISION_EXPIRE_MS")
-        assert j.resolve(i, actual=200.0, loop="dtype_tuner") is False
-        assert j.stats()["loops"]["dtype_tuner"]["missed"] == 1
-        (e,) = j.list(loop="dtype_tuner")
+        assert j.resolve(i, actual=200.0, loop="layout_tuner") is False
+        assert j.stats()["loops"]["layout_tuner"]["missed"] == 1
+        (e,) = j.list(loop="layout_tuner")
         assert e["outcome"] == "expired" and not e["resolved"]
         assert not _reconciles(j)
 

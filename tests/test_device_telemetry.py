@@ -86,6 +86,29 @@ class TestOccupancy:
                 if r["table_name"] == pre + "0" and r["dtype"] == "float32"
             )
             assert vrow["rows"] == 64
+            # ISSUE 19: the summed bytes are the ENCODED bytes — the
+            # layout tuner (on by default) stores series/ts packed, so
+            # the inventory carries the encoding per column and at
+            # least one column is visibly compressed below 4 B/row
+            enc_rows = [
+                r for r in cache.snapshot_device()
+                if r["component"] == "column"
+            ]
+            assert all(
+                r["encoding"] in ("raw", "bf16", "dict8", "dict16", "delta")
+                for r in enc_rows
+            )
+            packed = [
+                r for r in enc_rows
+                if r["encoding"] in ("dict8", "dict16", "delta")
+            ]
+            assert packed, enc_rows
+            raw_padded = 4 * next(
+                iter(cache._entries.values())
+            ).padded_rows  # the bytes this column would cost unencoded
+            for r in packed:
+                assert r["logical_rows"] > 0
+                assert r["bytes"] < raw_padded, r
         finally:
             db.close()
 

@@ -1,0 +1,357 @@
+"""Compressed device-resident layouts (ISSUE 19).
+
+Codec properties, encoded-domain kernel equivalence against the
+``HORAEDB_CACHE_LAYOUT=raw`` arm, layout_tuner journaling (incl. the
+evicted-before-reupload promotion regression), and the memtable
+dictionary handoff.
+"""
+
+import numpy as np
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.ops.encoding import (
+    FOR_BLOCK,
+    DictEncoded,
+    delta_for_encode,
+    dict_encode,
+    pack_bits,
+    unpack_bits,
+)
+
+
+@pytest.fixture()
+def db():
+    conn = horaedb_tpu.connect(None)
+    yield conn
+    conn.close()
+
+
+DDL = (
+    "CREATE TABLE t (host string TAG, v double, ts timestamp KEY) "
+    "WITH (segment_duration='1h')"
+)
+
+
+def seed(db, n=200, t_base=1_700_000_000_000, card=8):
+    """Low-cardinality values: v cycles over `card` distinct floats."""
+    db.execute(DDL)
+    vals = ", ".join(
+        f"('h{i % 5}', {float(i % card)}, {t_base + i * 1000})"
+        for i in range(n)
+    )
+    db.execute(f"INSERT INTO t (host, v, ts) VALUES {vals}")
+    db.flush_all()
+
+
+def warm(db, sql):
+    db.execute(sql)
+    return db.execute(sql)
+
+
+class TestCodecs:
+    def test_pack_unpack_roundtrip_all_widths(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        for width in range(1, 17):
+            n = 256
+            vals = rng.integers(0, 1 << width, size=n).astype(np.uint32)
+            words = pack_bits(vals, width)
+            got = unpack_bits(
+                jnp.asarray(words), width, jnp.arange(n, dtype=jnp.int32)
+            )
+            assert np.array_equal(np.asarray(got), vals.astype(np.int32)), width
+
+    def test_dict_encode_bit_exact_roundtrip(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        base = np.asarray(
+            [-1.5, 0.0, 2.25, 1e30, -7.0, 3.3], dtype=np.float32
+        )
+        vals = base[rng.integers(0, len(base), size=512)]
+        enc = dict_encode(vals, 64)
+        assert isinstance(enc, DictEncoded)
+        codes = unpack_bits(
+            jnp.asarray(enc.words), enc.width,
+            jnp.arange(len(vals), dtype=jnp.int32),
+        )
+        dec = np.asarray(enc.dict_host)[np.asarray(codes)]
+        assert dec.tobytes() == vals.tobytes()  # bit-exact, not approx
+        # the dictionary is SORTED: code order == value order (this is
+        # what lets filters and sort keys run in the code domain)
+        assert np.all(np.diff(enc.dict_host) > 0)
+
+    def test_dict_encode_rejects_nan_and_high_cardinality(self):
+        vals = np.arange(100, dtype=np.float32)
+        assert dict_encode(vals, 64) is None  # 100 distinct > cap 64
+        with_nan = np.asarray([1.0, np.nan, 2.0], dtype=np.float32)
+        assert dict_encode(with_nan, 64) is None
+
+    def test_dict_encode_negative_zero_not_collapsed(self):
+        # -0.0 == 0.0 compares equal but has different bits; a lossless
+        # codec must refuse rather than silently canonicalize
+        vals = np.asarray([0.0, -0.0, 1.0] * 8, dtype=np.float32)
+        enc = dict_encode(vals, 64)
+        if enc is not None:
+            import jax.numpy as jnp
+
+            codes = unpack_bits(
+                jnp.asarray(enc.words), enc.width,
+                jnp.arange(len(vals), dtype=jnp.int32),
+            )
+            dec = np.asarray(enc.dict_host)[np.asarray(codes)]
+            assert dec.tobytes() == vals.tobytes()
+
+    def test_delta_for_roundtrip(self):
+        import jax.numpy as jnp
+
+        n = 4 * FOR_BLOCK
+        rng = np.random.default_rng(11)
+        vals = np.sort(rng.integers(0, 50_000, size=n)).astype(np.int32)
+        enc = delta_for_encode(vals, 16)
+        if enc is None:
+            pytest.skip("range too wide for this draw")
+        idx = jnp.arange(n, dtype=jnp.int32)
+        rel = unpack_bits(jnp.asarray(enc.words), enc.width, idx)
+        base = jnp.asarray(enc.base)[idx >> 7]
+        assert np.array_equal(np.asarray(rel + base), vals)
+
+    def test_delta_for_rejects_wide_ranges(self):
+        vals = np.arange(0, FOR_BLOCK * 100_000, 100_000, dtype=np.int32)
+        assert delta_for_encode(vals, 8) is None
+
+
+class TestLayoutEquivalence:
+    """The lossless contract: auto layouts return bit-identical results
+    to the raw arm, across groupby, time_bucket, filters in the code
+    domain, top-k and bounded selection."""
+
+    QUERIES = (
+        "SELECT host, count(*) AS c, sum(v) AS s, avg(v) AS a "
+        "FROM t GROUP BY host ORDER BY host",
+        "SELECT time_bucket(ts, '1m') AS b, count(*) AS c, sum(v) AS s "
+        "FROM t GROUP BY time_bucket(ts, '1m') ORDER BY b",
+        "SELECT host, count(*) AS c FROM t WHERE v > 2.5 GROUP BY host "
+        "ORDER BY host",
+        "SELECT host, sum(v) AS s FROM t WHERE v >= 3 AND v != 5 "
+        "GROUP BY host ORDER BY host",
+        "SELECT host, v, ts FROM t WHERE v = 3 ORDER BY ts DESC LIMIT 7",
+        "SELECT host, v, ts FROM t ORDER BY ts DESC LIMIT 9",
+        "SELECT host, v, ts FROM t WHERE v <= 1.5 ORDER BY ts LIMIT 11",
+    )
+
+    def _run_all(self, db):
+        seed(db)
+        return [warm(db, q).to_pylist() for q in self.QUERIES]
+
+    def test_encoded_matches_raw_arm(self, db, monkeypatch):
+        auto = self._run_all(db)
+        ex = db.interpreters.executor
+        entry = ex.scan_cache._entries["t"]
+        # the tuner really engaged: sorted series/ts packed, v dict-coded
+        assert entry.series_layout[0] == "delta"
+        assert entry.ts_layout[0] in ("delta", "dict")
+        assert entry.value_layout("v")[0] == "dict"
+
+        monkeypatch.setenv("HORAEDB_CACHE_LAYOUT", "raw")
+        raw_db = horaedb_tpu.connect(None)
+        try:
+            raw = self._run_all(raw_db)
+            raw_entry = raw_db.interpreters.executor.scan_cache._entries["t"]
+            assert raw_entry.series_layout == ("raw",)
+            assert raw_entry.value_layout("v") == ("raw",)
+            assert auto == raw
+        finally:
+            raw_db.close()
+
+    def test_literal_between_and_outside_dictionary(self, db):
+        """Translated literals that fall BETWEEN dictionary entries or
+        outside the value range must keep exact semantics."""
+        seed(db)
+        warm(db, "SELECT host, sum(v) AS s FROM t GROUP BY host")
+        ex = db.interpreters.executor
+        assert ex.scan_cache._entries["t"].value_layout("v")[0] == "dict"
+        cases = {
+            "v > 2.5": sum(1 for i in range(200) if i % 8 > 2.5),
+            "v < -1": 0,
+            "v >= 100": 0,
+            "v = 2.5": 0,  # not a dictionary member
+            "v != 2.5": 200,
+            "v <= 0": sum(1 for i in range(200) if i % 8 == 0),
+        }
+        for pred, want in cases.items():
+            out = db.execute(
+                f"SELECT count(*) AS c FROM t WHERE {pred}"
+            ).to_pylist()
+            assert out == [{"c": want}], pred
+
+    def test_high_cardinality_column_stays_raw_and_exact(self, db):
+        seed(db, n=300, card=10_000)  # v = i, 300 distinct... under cap
+        # force the dict cap below the cardinality so v stays raw
+        import os
+
+        os.environ["HORAEDB_CACHE_DICT_MAX"] = "16"
+        try:
+            sql = (
+                "SELECT host, sum(v) AS s FROM t WHERE v > 100 "
+                "GROUP BY host ORDER BY host"
+            )
+            out = warm(db, sql).to_pylist()
+            entry = db.interpreters.executor.scan_cache._entries["t"]
+            assert entry.value_layout("v") == ("raw",)
+            want = {
+                f"h{h}": sum(
+                    float(i) for i in range(300) if i % 5 == h and i > 100
+                )
+                for h in range(5)
+            }
+            got = {r["host"]: r["s"] for r in out}
+            assert got == pytest.approx(want)
+        finally:
+            os.environ.pop("HORAEDB_CACHE_DICT_MAX", None)
+
+
+class TestLayoutTunerJournal:
+    def test_encodes_are_journaled_and_resolved(self, db):
+        from horaedb_tpu.obs.decisions import DECISION_JOURNAL
+
+        before = (
+            DECISION_JOURNAL.stats()["loops"]
+            .get("layout_tuner", {})
+            .get("resolved", 0)
+        )
+        seed(db)
+        warm(db, "SELECT host, sum(v) AS s FROM t GROUP BY host")
+        stats = DECISION_JOURNAL.stats()["loops"]["layout_tuner"]
+        assert stats["resolved"] > before
+        ours = [
+            e for e in DECISION_JOURNAL.list(loop="layout_tuner")
+            if e["key"].startswith("t:")
+        ]
+        assert ours
+        for e in ours:
+            assert e["resolved"] and e["outcome"] == "encoded"
+            assert e["predicted"] and e["actual"]
+        # the realized encoded bytes for resident columns price the LRU
+        entry = db.interpreters.executor.scan_cache._entries["t"]
+        assert entry.device_bytes < 3 * 4 * entry.padded_rows
+
+    def test_promotion_decision_evicted_before_reupload_resolves(self, db, monkeypatch):
+        """Satellite regression: a bf16->f32 promotion whose column is
+        evicted before the re-upload must resolve outcome=evicted, never
+        dangle unresolved."""
+        from horaedb_tpu.obs.decisions import DECISION_JOURNAL
+
+        monkeypatch.setenv("HORAEDB_CACHE_DTYPE", "auto")
+        seed(db)
+        # count-only usage -> v resident bf16
+        warm(db, "SELECT host, count(*) AS c FROM t GROUP BY host")
+        cache = db.interpreters.executor.scan_cache
+        warm(db, "SELECT host, min(v) AS m FROM t GROUP BY host")
+        entry = cache._entries["t"]
+        import jax.numpy as jnp
+
+        assert entry.value_cols_dev["v"].dtype == jnp.bfloat16
+        # promotion decision fires, then the entry is evicted before any
+        # re-upload can resolve it
+        cache._drop_bf16_columns(entry, ["v"])
+        assert entry.pending_promotions == {"v"}
+        cache.invalidate("t")
+        evicted = [
+            e for e in DECISION_JOURNAL.list(loop="layout_tuner")
+            if e["key"] == "t:v" and e["choice"] == "promote_f32"
+        ]
+        assert evicted
+        assert evicted[-1]["resolved"]
+        assert evicted[-1]["outcome"] == "evicted"
+        stats = DECISION_JOURNAL.stats()["loops"]["layout_tuner"]
+        assert (
+            stats["issued"]
+            == stats["resolved"] + stats["expired"] + stats["unresolved"]
+        )
+
+    def test_promotion_through_reupload_resolves_promoted(self, db, monkeypatch):
+        from horaedb_tpu.obs.decisions import DECISION_JOURNAL
+
+        monkeypatch.setenv("HORAEDB_CACHE_DTYPE", "auto")
+        seed(db)
+        warm(db, "SELECT host, min(v) AS m FROM t GROUP BY host")
+        cache = db.interpreters.executor.scan_cache
+        import jax.numpy as jnp
+
+        assert cache._entries["t"].value_cols_dev["v"].dtype == jnp.bfloat16
+        # sum usage promotes: the re-upload resolves the journaled choice
+        warm(db, "SELECT host, sum(v) AS s FROM t GROUP BY host")
+        promos = [
+            e for e in DECISION_JOURNAL.list(loop="layout_tuner")
+            if e["key"] == "t:v" and e["choice"] == "promote_f32"
+        ]
+        assert promos and promos[-1]["resolved"]
+        assert promos[-1]["outcome"] == "promoted"
+        assert cache._entries["t"].pending_promotions in (None, set())
+
+
+class TestMemtableLayoutHandoff:
+    def test_hinted_columns_freeze_dictionary_coded(self):
+        from horaedb_tpu.common_types.dict_column import DictColumn
+        from horaedb_tpu.common_types.layout_hints import (
+            clear_hints,
+            low_cardinality_hint,
+            note_low_cardinality,
+        )
+
+        conn = horaedb_tpu.connect(None)
+        try:
+            conn.execute(
+                "CREATE TABLE lh (host string TAG, v double, ts timestamp "
+                "NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic WITH ("
+                "memtable_type='layered', "
+                "mutable_segment_switch_threshold='256b')"
+            )
+            clear_hints()
+            # the scan cache's dict encode publishes this observation;
+            # here the hint is planted directly to pin the handoff
+            note_low_cardinality("lh", "v", 4)
+            assert low_cardinality_hint("lh", "v") == 4
+            for i in range(64):
+                conn.execute(
+                    f"INSERT INTO lh (host, v, ts) VALUES "
+                    f"('h{i % 2}', {float(i % 4)}, {1000 + i})"
+                )
+            table = conn.catalog.open("lh")
+            mt = table.data.version.mutable
+            segs = mt.frozen_segments()
+            assert segs, "switch threshold never crossed"
+            assert any(
+                isinstance(s.rows.columns["v"], DictColumn) for s in segs
+            )
+            # reads through the dictionary-coded segments stay exact
+            out = conn.execute(
+                "SELECT host, sum(v) AS s FROM lh GROUP BY host ORDER BY host"
+            ).to_pylist()
+            assert out == [
+                {"host": "h0", "s": sum(float(i % 4) for i in range(0, 64, 2))},
+                {"host": "h1", "s": sum(float(i % 4) for i in range(1, 64, 2))},
+            ]
+        finally:
+            clear_hints()
+            conn.close()
+
+    def test_cache_dict_encode_publishes_hint(self, db):
+        from horaedb_tpu.common_types.layout_hints import (
+            clear_hints,
+            low_cardinality_hint,
+        )
+
+        clear_hints()
+        try:
+            seed(db)
+            warm(db, "SELECT host, sum(v) AS s FROM t GROUP BY host")
+            assert db.interpreters.executor.scan_cache._entries[
+                "t"
+            ].value_layout("v")[0] == "dict"
+            assert low_cardinality_hint("t", "v") == 8
+        finally:
+            clear_hints()

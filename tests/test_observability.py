@@ -1893,3 +1893,60 @@ class TestLivewindowRegistryLint:
 
         assert "livewindow" in DECISION_LOOPS
         assert "livewindow" in _EVENT_SAMPLE
+
+
+class TestLayoutRegistryLint:
+    """ISSUE-19 lint extension for the compressed-layout plane: the
+    layout knobs are operator surface (pinned to docs/WORKLOAD.md), the
+    layout_tuner loop is a first-class decision-plane citizen, and the
+    occupancy table's encoding/logical_rows columns exist in the
+    system-catalog schema AND in docs/OBSERVABILITY.md with the full
+    encoding vocabulary spelled out."""
+
+    KNOBS = (
+        "HORAEDB_CACHE_LAYOUT",
+        "HORAEDB_CACHE_DICT_MAX",
+        "HORAEDB_CACHE_DELTA_MAX_BITS",
+    )
+    ENCODINGS = ("raw", "bf16", "dict8", "dict16", "delta")
+
+    def test_layout_knobs_documented(self):
+        import os
+
+        here = os.path.dirname(__file__)
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        missing = [
+            k for k in self.KNOBS if f"`{k}`" not in wdocs
+        ]
+        assert not missing, f"undocumented in docs/WORKLOAD.md: {missing}"
+
+    def test_layout_loop_declared_in_decision_plane(self):
+        from horaedb_tpu.obs.decisions import (
+            _EVENT_SAMPLE,
+            DECISION_LOOPS,
+        )
+
+        assert "layout_tuner" in DECISION_LOOPS
+        assert "layout_tuner" in _EVENT_SAMPLE
+        # the former standalone loop is GONE — promotions resolve
+        # through layout_tuner now
+        assert "dtype_tuner" not in DECISION_LOOPS
+
+    def test_device_table_carries_encoding_columns(self):
+        import os
+
+        from horaedb_tpu.table_engine.system import (
+            DEVICE_NAME,
+            open_system_table,
+        )
+
+        t = open_system_table(None, DEVICE_NAME)
+        cols = {c.name for c in t.schema.columns}
+        assert {"encoding", "logical_rows"} <= cols
+        here = os.path.dirname(__file__)
+        docs = open(
+            os.path.join(here, "..", "docs", "OBSERVABILITY.md")
+        ).read()
+        assert "`encoding`" in docs and "`logical_rows`" in docs
+        for enc in self.ENCODINGS:
+            assert f"`{enc}`" in docs, f"encoding {enc} undocumented"

@@ -95,7 +95,7 @@ class TestTenantSimSmoke:
         # verdict in the database's own tables, with exact accounting —
         # violations() enforced it; pin the active-loop set here too
         assert set(report.decision_active_loops) == {
-            "kernel_router", "admission", "deadline", "dtype_tuner",
+            "kernel_router", "admission", "deadline", "layout_tuner",
             "livewindow",
         }, detail
         for loop in report.decision_active_loops:
