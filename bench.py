@@ -48,6 +48,11 @@ target list:
                         admission record/resolve per query) vs
                         HORAEDB_DECISIONS=0, interleaved min-of-N;
                         gate: on within 2% of off
+    profile             profile-plane overhead gate: the flood shape
+                        with the span-tree fold ON (every finish_trace
+                        folds into the streaming aggregator) vs
+                        HORAEDB_PROFILE=0, interleaved min-of-N;
+                        gate: on within 2% of off
     livewindow          steady-state dashboard-refresh latency under
                         concurrent ingest: the open-tail (time_bucket
                         1m x host) panel served from device ring state
@@ -1336,6 +1341,133 @@ def run_decisions_config() -> dict:
     }
 
 
+def run_profile_config() -> dict:
+    """Profile-plane overhead gate: the flood's dashboard shape served
+    twice through the proxy — profile fold ON (every finish_trace folds
+    its span tree into the streaming aggregator) vs ``HORAEDB_PROFILE=0``
+    (fold returns at the env check). The fold walks a finished tree
+    after the response is ready, so the gate is wall-clock parity: the
+    on arm must land within 2% of off.
+
+    Arms are interleaved across reps and each arm's MINIMUM wall is
+    compared (same discipline as the decisions gate). The record carries
+    the aggregator's own accounting — traces/spans folded during the on
+    arms from PROFILE.stats() — so a "0% overhead" line where nothing
+    actually folded is self-evidently vacuous."""
+    import threading
+
+    from horaedb_tpu.proxy import Proxy
+    from horaedb_tpu.obs.profile import PROFILE, flush as profile_flush
+    import jax
+
+    platform = jax.devices()[0].platform
+    hosts = int(os.environ.get("BENCH_PROFILE_HOSTS", "32"))
+    rows_per_host = int(os.environ.get("BENCH_PROFILE_ROWS", "200"))
+    queries = int(os.environ.get("BENCH_PROFILE_QUERIES", "400"))
+    workers = int(os.environ.get("BENCH_PROFILE_WORKERS", "8"))
+    reps = int(os.environ.get("BENCH_PROFILE_REPS", "3"))
+
+    db = _connect_mem()
+    db.execute(
+        "CREATE TABLE dash (host string TAG, v double, "
+        "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+    )
+    rng = np.random.default_rng(17)
+    t0 = 1_700_000_000_000
+    chunk = []
+    for h in range(hosts):
+        vs = rng.random(rows_per_host) * 100.0
+        for i in range(rows_per_host):
+            chunk.append(f"('h{h}', {vs[i]:.3f}, {t0 + i * 1000})")
+        if len(chunk) >= 4000 or h == hosts - 1:
+            db.execute(
+                "INSERT INTO dash (host, v, ts) VALUES " + ",".join(chunk)
+            )
+            chunk = []
+    db.flush_all()
+    span = rows_per_host * 1000
+
+    def sql_for(q: int) -> str:
+        lo = t0 + (q % 64) * 1000
+        return (
+            f"SELECT host, count(v), sum(v), max(v) FROM dash "
+            f"WHERE ts >= {lo} AND ts < {t0 + span} AND v >= {q % 7}.5 "
+            f"GROUP BY host"
+        )
+
+    def flood(proxy, n: int) -> None:
+        idx = iter(range(n))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    q = next(idx, None)
+                if q is None:
+                    return
+                proxy.handle_sql(sql_for(q))
+
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    proxy = Proxy(db)
+    prior = os.environ.get("HORAEDB_PROFILE")
+    try:
+        # warmup: scan cache + kernel compiles, with the fold ON so the
+        # aggregator's key rows exist before timing
+        os.environ["HORAEDB_PROFILE"] = "1"
+        flood(proxy, min(128, queries))
+        profile_flush(10.0)
+        traces0 = PROFILE.stats()["traces"]
+        walls: dict = {"on": [], "off": []}
+        for rep in range(reps):
+            order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+            for arm in order:
+                os.environ["HORAEDB_PROFILE"] = (
+                    "1" if arm == "on" else "0"
+                )
+                # the arm's wall includes draining the fold queue — the
+                # deferred fold is part of the plane's cost, so the on
+                # arm must pay it inside the timed window (the off arm's
+                # flush returns immediately: nothing queued)
+                t_arm = time.perf_counter()
+                flood(proxy, queries)
+                profile_flush(30.0)
+                walls[arm].append(time.perf_counter() - t_arm)
+        stats = PROFILE.stats()
+    finally:
+        if prior is None:
+            os.environ.pop("HORAEDB_PROFILE", None)
+        else:
+            os.environ["HORAEDB_PROFILE"] = prior
+        proxy.close()
+        db.close()
+
+    on_s, off_s = min(walls["on"]), min(walls["off"])
+    overhead_pct = round((on_s / max(off_s, 1e-9) - 1.0) * 100.0, 3)
+    suffix = "" if platform == "tpu" else "_CPU-FALLBACK"
+    return {
+        "metric": f"profile_overhead_pct{suffix}",
+        "value": overhead_pct,
+        "unit": "% wall overhead, profile fold on vs HORAEDB_PROFILE=0",
+        "vs_baseline": round(on_s / max(off_s, 1e-9), 4),
+        "baseline": "HORAEDB_PROFILE=0 (fold off)",
+        "overhead_ok": on_s <= off_s * 1.02,
+        "on_s": round(on_s, 4),
+        "off_s": round(off_s, 4),
+        "reps": reps,
+        "queries": queries,
+        "workers": workers,
+        "traces_folded": stats["traces"] - traces0,
+        "profile_keys": stats["keys"],
+        "untracked_ratio": stats["untracked_ratio"],
+        "platform": platform,
+    }
+
+
 def _host_merge_permutation(tsid, ts, seq, dedup=True):
     """Vectorized-numpy merge baseline with the device kernel's exact
     semantics: sort (tsid, ts, seq desc, input-row desc), keep the first
@@ -2073,7 +2205,8 @@ def _emit(obj: dict) -> None:
 ALL_CONFIGS = (
     "readme", "tsbs-1-1-1", "double-groupby-all", "high-cpu-all",
     "compaction-64", "ingest", "groupby", "rawscan", "rollup", "flood",
-    "devicetel", "decisions", "livewindow", "layout", "tsbs-5-8-1",
+    "devicetel", "decisions", "profile", "livewindow", "layout",
+    "tsbs-5-8-1",
 )
 # 2400s: the 100M-row compaction config (BASELINE blueprint scale)
 # builds the table twice for the device/host A-B and genuinely needs
@@ -2086,9 +2219,18 @@ PER_CONFIG_TIMEOUT = int(os.environ.get("BENCH_TIMEOUT", "2400"))
 # The DEFAULT is bounded: an unbudgeted all-configs run that outlives the
 # caller's own timeout gets killed mid-stage (rc 124) with the headline
 # line never emitted — exactly the silent truncation the skip protocol
-# exists to prevent. 5400s fits every stage on CPU with slack; export
-# BENCH_WALL_BUDGET=0 for an explicitly unbounded run.
-WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "5400") or 0)
+# exists to prevent. The old 5400s default still lost that race (the r05
+# round died at rc 124 with 4 of 15 stages on stdout: TPU probe attempts
+# alone can burn ~600s before the first config): the budget must fit
+# INSIDE the strictest caller window, not merely exist. 1200s does —
+# stages that don't fit skip explicitly and the final record's
+# stages_skipped says so. Export BENCH_WALL_BUDGET=0 for an explicitly
+# unbounded run.
+WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", "1200") or 0)
+# Wall held back from non-headline stages so the headline config (the
+# line the driver parses) always gets a real attempt instead of the
+# STAGE_FLOOR crumbs left after a slow middle stage.
+HEADLINE_RESERVE = float(os.environ.get("BENCH_HEADLINE_RESERVE", "240"))
 # A stage that can't get at least this much wall isn't worth starting —
 # it would only burn the remaining budget into a timeout line.
 STAGE_FLOOR = float(os.environ.get("BENCH_STAGE_FLOOR", "60"))
@@ -2183,6 +2325,11 @@ def run_all() -> None:
     headline = ALL_CONFIGS[-1]
     for config in ALL_CONFIGS:
         budget_s = remaining()
+        if config != headline and WALL_BUDGET > 0:
+            # Non-headline stages spend only what the headline reserve
+            # leaves over — the driver parses the FINAL line, so the
+            # headline must always get a real attempt.
+            budget_s = max(0.0, budget_s - HEADLINE_RESERVE)
         if config != headline and budget_s < STAGE_FLOOR:
             # Wall budget exhausted: skip the stage EXPLICITLY (own line
             # + listed in the headline's stages_skipped) and save what's
@@ -2692,6 +2839,8 @@ def run_config(config: str) -> dict:
         return run_flood_config()
     if config == "decisions":
         return run_decisions_config()
+    if config == "profile":
+        return run_profile_config()
     if config == "rollup":
         return run_rollup_config()
     if config == "livewindow":

@@ -227,12 +227,18 @@ class Compactor:
             # RemoveFile edit twice — skip any task touching an already
             # consumed input and RE-PICK until a pass completes without
             # skips (nothing else schedules a retry on an idle table).
-            from ..utils.tracectx import span
+            from ..utils.tracectx import owned_trace
 
             t0 = time.perf_counter()
             _M_COMPACT_INFLIGHT.inc()
             try:
-                with span("compaction", table=table.name) as sp:
+                # an OWNED trace round (profile route=compaction): merge
+                # and upload spans fold into obs/profile through the
+                # same machinery queries use
+                with owned_trace(
+                    "compaction", route="compaction", shape=table.name,
+                    table=table.name,
+                ) as sp:
                     while True:
                         consumed: set[tuple[int, int]] = set()
                         skipped = False

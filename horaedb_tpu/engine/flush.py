@@ -110,14 +110,20 @@ class Flusher:
                     options=table.options,
                 )
             from ..utils.events import record_event
-            from ..utils.tracectx import span
+            from ..utils.tracectx import owned_trace
 
             record_event(
                 "flush_freeze", table=table.name, memtables=len(frozen)
             )
             t0 = _perf_counter()
             try:
-                with span("flush", table=table.name) as sp:
+                # an OWNED trace round (profile route=flush): the dump's
+                # spans (SST encode, store puts) fold into obs/profile
+                # through the same machinery queries use
+                with owned_trace(
+                    "flush", route="flush", shape=table.name,
+                    table=table.name,
+                ) as sp:
                     result = self._dump_memtables(snap)
                     sp.set(rows=result.rows_flushed, files=result.files_added)
             except Exception as e:

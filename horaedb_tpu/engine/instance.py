@@ -389,24 +389,29 @@ class Instance:
                 follower = False
                 table.writer_active = True
         if follower:
-            return entry[1].result()
+            # group-commit follower: the wall here is the LEADER's WAL
+            # fsync + memtable insert — attributed so the profile plane
+            # sees coalesced-write wait, not untracked time
+            with span("write_wait", follower=1):
+                return entry[1].result()
 
         try:
-            while True:
-                with table.pending_lock:
-                    batch = table.pending_writes
-                    table.pending_writes = []
-                    if not batch:
-                        table.writer_active = False
-                        break
-                if self._commit_write_group(table, batch):
-                    # The buffer tripped: the leader REQUESTS a flush (the
-                    # memtable is already frozen when background flush is
-                    # on) and keeps draining — writes commit into the
-                    # fresh mutable memtable while the dump runs on the
-                    # flush scheduler. Inline mode flushes here, exactly
-                    # as before.
-                    self.request_flush(table)
+            with span("write_group"):
+                while True:
+                    with table.pending_lock:
+                        batch = table.pending_writes
+                        table.pending_writes = []
+                        if not batch:
+                            table.writer_active = False
+                            break
+                    if self._commit_write_group(table, batch):
+                        # The buffer tripped: the leader REQUESTS a flush
+                        # (the memtable is already frozen when background
+                        # flush is on) and keeps draining — writes commit
+                        # into the fresh mutable memtable while the dump
+                        # runs on the flush scheduler. Inline mode flushes
+                        # here, exactly as before.
+                        self.request_flush(table)
         except BaseException:
             with table.pending_lock:
                 table.writer_active = False
@@ -458,7 +463,8 @@ class Instance:
                             self.wal.append(table.table_id, seq, merged)
                         _M_WAL_APPEND_SECONDS.observe(_time.perf_counter() - t0)
                         _M_WAL_APPEND_ROWS.inc(len(merged))
-                    table.put_rows(merged, seq)
+                    with span("memtable_write", rows=len(merged)):
+                        table.put_rows(merged, seq)
                     _memtable_gauge(table).set(
                         table.version.total_memtable_bytes()
                     )

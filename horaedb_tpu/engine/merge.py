@@ -181,11 +181,15 @@ def _sst_read_fn(store, schema, predicate, projection):
         # cancel flag between (possibly remote) object-store fetches —
         # pool threads see it via the copied contexts
         from ..utils.deadline import checkpoint
+        from ..utils.tracectx import span
 
         checkpoint("store")
-        return SstReader(store, handle.path).read(
-            schema, predicate, projection=projection
-        )
+        with span("sst_read") as sp:
+            rows = SstReader(store, handle.path).read(
+                schema, predicate, projection=projection
+            )
+            sp.set(rows=len(rows))
+            return rows
 
     from ..utils.object_store import LocalDiskStore, MemoryStore
 

@@ -44,7 +44,12 @@ class SstReader:
             if isinstance(self.store, LocalDiskStore):
                 self._pf = pq.ParquetFile(self.store.local_path(self.path), memory_map=True)
             else:
-                self._pf = pq.ParquetFile(pa.BufferReader(self.store.get(self.path)))
+                from ...utils.tracectx import span
+
+                with span("store_get") as sp:
+                    raw = self.store.get(self.path)
+                    sp.set(bytes=len(raw))
+                self._pf = pq.ParquetFile(pa.BufferReader(raw))
         return self._pf
 
     def read_meta(self) -> SstMeta:

@@ -93,7 +93,10 @@ class SstWriter:
             write_statistics=True,
         )
         raw = buf.getvalue()
-        self.store.put(path, raw)
+        from ...utils.tracectx import span
+
+        with span("store_put", bytes=len(raw)):
+            self.store.put(path, raw)
         return SstMeta(
             file_id=meta.file_id,
             time_range=meta.time_range,
@@ -225,7 +228,10 @@ class SstStreamWriter:
         )
 
     def upload(self, raw: bytes) -> None:
-        self.store.put(self.path, raw)
+        from ...utils.tracectx import span
+
+        with span("store_put", bytes=len(raw)):
+            self.store.put(self.path, raw)
 
     def close(self) -> SstMeta | None:
         """Finalize + store; None when nothing was appended."""

@@ -234,7 +234,7 @@ class Proxy:
             observe_budget,
         )
         from ..utils.querystats import finish_ledger, start_ledger
-        from ..utils.tracectx import finish_trace, span, start_trace
+        from ..utils.tracectx import finish_trace, span, start_trace, tag_trace
 
         # The time budget opens HERE, at ingress, and rides the same
         # ContextVar discipline as the trace/ledger — every layer below
@@ -281,6 +281,14 @@ class Proxy:
                 plan = self.conn._cached_plan(sql)
             table = getattr(plan, "table", None)
             ledger.set_table(table)
+            # Profile-plane dimensions (obs/profile): the serving plane
+            # and — for SELECTs, below — the normalized plan-key class.
+            if isinstance(plan, InsertPlan):
+                tag_trace(route="ingest", shape=f"insert {plan.table}")
+            elif isinstance(plan, QueryPlan):
+                tag_trace(route="query")
+            else:
+                tag_trace(route="ddl")
             self.limiter.check(table)
             if table:
                 self.hotspot.record(table, isinstance(plan, InsertPlan))
@@ -289,6 +297,7 @@ class Proxy:
             if isinstance(plan, QueryPlan):
                 self.wlm.quota.charge_read(tenant, plan.table)
                 shape = normalize_shape(sql)
+                tag_trace(shape=shape[:160])
                 admission_class, est_ms = classify_plan(plan, shape=shape)
                 live.admission_class = admission_class
                 lane = lane_for(admission_class)

@@ -444,6 +444,14 @@ class InterpreterFactory:
                 tree = trace.to_dict()["root"]
                 lines.append(f"  Trace: request_id={trace.trace_id}")
                 lines.extend("    " + l for l in render_tree(tree, 0))
+                # Profile plane: the max-time chain through this run's
+                # tree — the hop where inclusive≈self is where the
+                # wall-clock actually went.
+                from ..obs.profile import render_critical_path
+
+                cp = render_critical_path(tree)
+                if cp:
+                    lines.append(f"  Critical path: {cp}")
             finally:
                 # an execute error must still reset the ContextVars — a
                 # leaked trace would swallow every later query's spans

@@ -444,21 +444,25 @@ class RuleEngine:
         alert events cross-link to a stored span tree). Per-rule errors
         are isolated; a backpressure shed (OverloadedError) propagates —
         ``tick`` owns that backoff policy."""
-        from ..utils.tracectx import finish_trace, start_trace
+        from ..utils.tracectx import finish_trace, start_trace, tag_trace
         from ..wlm.admission import OverloadedError
 
         t0 = time.perf_counter()
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         trace_id = next(self._trace_ids)
         _trace, handle = start_trace(trace_id, "rules-eval", node=self.node)
+        tag_trace(route="rules")
         wm_dirty = False
         try:
             for source in self.rollup_sources:
                 if not self._owns(source):
                     continue
                 try:
+                    from ..utils.tracectx import span as _span
+
                     m = self._maintainer(source)
-                    written = m.run_once(now_ms)
+                    with _span("rollup", source=source):
+                        written = m.run_once(now_ms)
                     if written:
                         self.rows_written += written
                         _M_ROWS.inc(written)
@@ -481,10 +485,13 @@ class RuleEngine:
                         continue
                     if not self._rule_local(rule, parsed):
                         continue
-                    if rule.kind == "recording":
-                        self._eval_recording(rule, parsed, now_ms)
-                    else:
-                        self._eval_alert(rule, parsed, now_ms)
+                    from ..utils.tracectx import span as _span
+
+                    with _span(rule.kind, rule=rule.name):
+                        if rule.kind == "recording":
+                            self._eval_recording(rule, parsed, now_ms)
+                        else:
+                            self._eval_alert(rule, parsed, now_ms)
                     self._rule_last_eval_ms[rule.name] = now_ms
                     _M_EVAL[rule.kind].inc()
                     self.last_errors.pop(rule.name, None)

@@ -938,6 +938,17 @@ def create_app(
         from ..obs.decisions import DECISION_JOURNAL
 
         DECISION_JOURNAL.resize(observability.decision_ring)
+        # ...and the profile plane ([observability] profile_keys) plus
+        # the finished-trace rings (trace_ring / trace_slow_ring):
+        # horaedb_profile_dropped_total accounts key evictions.
+        from ..obs.profile import PROFILE
+        from ..utils.tracectx import TRACE_STORE
+
+        PROFILE.resize(getattr(observability, "profile_keys", 1024))
+        TRACE_STORE.resize(
+            recent=getattr(observability, "trace_ring", 64),
+            slow=getattr(observability, "trace_slow_ring", 256),
+        )
 
     recorder = None
     if observability is not None and observability.self_scrape:
@@ -2040,6 +2051,34 @@ def create_app(
             content_type="application/json",
         )
 
+    async def debug_profile(request: web.Request) -> web.Response:
+        """The continuous profile plane (obs/profile): live (path,
+        route, shape) rows exclusive-heavy first, plus the aggregator's
+        fleetwide accounting header. ?path= filters by prefix, ?route=
+        by plane, ?limit= caps rows — filter parity with
+        /debug/decisions."""
+        from ..obs.profile import PROFILE
+
+        path = request.query.get("path")
+        route_q = request.query.get("route")
+        limit = 0
+        if "limit" in request.query:
+            try:
+                limit = int(request.query["limit"])
+            except ValueError:
+                return web.json_response({"error": "bad 'limit'"}, status=400)
+        return web.Response(
+            text=_dumps(
+                {
+                    "profile": PROFILE.list(
+                        path=path, route=route_q, limit=limit
+                    ),
+                    "stats": PROFILE.stats(),
+                }
+            ),
+            content_type="application/json",
+        )
+
     async def route(request: web.Request) -> web.Response:
         """One payload shape in both modes:
         routes[i] = {endpoint, is_local, shard_id|null}."""
@@ -2639,6 +2678,7 @@ def create_app(
     app.router.add_get("/debug/queries", debug_queries)
     app.router.add_delete("/debug/queries/{query_id}", debug_query_kill)
     app.router.add_put("/debug/slow_threshold/{seconds}", slow_threshold)
+    app.router.add_get("/debug/profile", debug_profile)
     app.router.add_get("/debug/profile/cpu/{seconds}", debug_profile_cpu)
     app.router.add_get("/debug/profile/heap/{seconds}", debug_profile_heap)
     app.router.add_put("/debug/log_level/{level}", debug_log_level)

@@ -52,6 +52,17 @@ The tables:
   (signed/abs relative-error EWMA + fast/slow windows) plus the exact
   issued/resolved/expired/missed/unresolved accounting ledger — the
   tenant simulator's reconciliation gate reads it
+- ``system.public.profile``     — the continuous profile plane
+  (obs/profile.PROFILE): one row per live (span path, route, shape)
+  key with count, total/exclusive milliseconds, EWMA + fast/slow
+  window means, and a last-exemplar trace_id linking to
+  /debug/trace/{id}; ``<root>/(untracked)`` rows carry the wall time
+  no child span covered — the coverage contract the tenantsim gate
+  asserts from this table
+- ``system.public.traces``      — the bounded trace store
+  (utils/tracectx.TRACE_STORE): one row per recent/slow finished
+  trace (trace_id, name, at, duration_ms, spans, slow) — the SQL face
+  of /debug/trace on every wire
 """
 
 from __future__ import annotations
@@ -73,6 +84,8 @@ QUERIES_NAME = "system.public.queries"
 DEVICE_NAME = "system.public.device"
 DECISIONS_NAME = "system.public.decisions"
 CALIBRATION_NAME = "system.public.calibration"
+PROFILE_NAME = "system.public.profile"
+TRACES_NAME = "system.public.traces"
 
 
 class _VirtualTable(Table):
@@ -988,6 +1001,156 @@ class CalibrationTable(_VirtualTable):
         return RowGroup(_CALIBRATION_SCHEMA, cols, validity=validity)
 
 
+_PROFILE_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("path", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("route", DatumKind.STRING),
+        ColumnSchema("shape", DatumKind.STRING),
+        ColumnSchema("count", DatumKind.INT64),
+        ColumnSchema("total_ms", DatumKind.DOUBLE),
+        ColumnSchema("exclusive_ms", DatumKind.DOUBLE),
+        ColumnSchema("ewma_ms", DatumKind.DOUBLE),
+        ColumnSchema("fast_ms", DatumKind.DOUBLE),
+        ColumnSchema("fast_n", DatumKind.INT64),
+        ColumnSchema("slow_ms", DatumKind.DOUBLE),
+        ColumnSchema("slow_n", DatumKind.INT64),
+        ColumnSchema("trace_id", DatumKind.STRING),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "path"],
+)
+
+
+class ProfileTable(_VirtualTable):
+    """``system.public.profile``: the streaming profile aggregator as
+    rows — one per live (path, route, shape) key, exclusive-heavy
+    first. ``timestamp`` is the key's last fold; ``trace_id`` the last
+    exemplar (join against system.public.traces or /debug/trace/{id}).
+    The ``<root>/(untracked)`` rows are the accounting remainder —
+    ``sum(exclusive_ms)`` over a root's non-root paths equals the
+    root's ``total_ms`` exactly (the fold invariant)."""
+
+    @property
+    def name(self) -> str:
+        return PROFILE_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _PROFILE_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..obs.profile import PROFILE
+
+        rows = PROFILE.list()
+
+        def opt(field) -> tuple[np.ndarray, np.ndarray]:
+            vals = np.array(
+                [0.0 if r[field] is None else float(r[field]) for r in rows],
+                dtype=np.float64,
+            )
+            mask = np.array([r[field] is not None for r in rows], dtype=bool)
+            return vals, mask
+
+        ewma, ewma_ok = opt("ewma_ms")
+        return RowGroup(
+            _PROFILE_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [int(r["last_at"] * 1000) for r in rows], dtype=np.int64
+                ),
+                "path": np.array([r["path"] for r in rows], dtype=object),
+                "route": np.array([r["route"] for r in rows], dtype=object),
+                "shape": np.array([r["shape"] for r in rows], dtype=object),
+                "count": np.array(
+                    [int(r["count"]) for r in rows], dtype=np.int64
+                ),
+                "total_ms": np.array(
+                    [float(r["total_ms"]) for r in rows], dtype=np.float64
+                ),
+                "exclusive_ms": np.array(
+                    [float(r["exclusive_ms"]) for r in rows],
+                    dtype=np.float64,
+                ),
+                "ewma_ms": ewma,
+                "fast_ms": np.array(
+                    [float(r["fast_ms"]) for r in rows], dtype=np.float64
+                ),
+                "fast_n": np.array(
+                    [int(r["fast_n"]) for r in rows], dtype=np.int64
+                ),
+                "slow_ms": np.array(
+                    [float(r["slow_ms"]) for r in rows], dtype=np.float64
+                ),
+                "slow_n": np.array(
+                    [int(r["slow_n"]) for r in rows], dtype=np.int64
+                ),
+                "trace_id": np.array(
+                    [str(r["last_trace_id"]) for r in rows], dtype=object
+                ),
+            },
+            validity={"ewma_ms": ewma_ok},
+        )
+
+
+_TRACES_SCHEMA = Schema.build(
+    [
+        ColumnSchema("timestamp", DatumKind.TIMESTAMP, is_nullable=False),
+        ColumnSchema("trace_id", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("name", DatumKind.STRING, is_nullable=False),
+        ColumnSchema("duration_ms", DatumKind.DOUBLE),
+        ColumnSchema("spans", DatumKind.INT64),
+        ColumnSchema("slow", DatumKind.BOOLEAN),
+    ],
+    timestamp_column="timestamp",
+    primary_key=["timestamp", "trace_id"],
+)
+
+
+class TracesTable(_VirtualTable):
+    """``system.public.traces``: the bounded in-process trace store as
+    rows (newest first in the underlying listing, dedup'd across the
+    recent and slow rings). ``timestamp`` is the trace's start;
+    ``trace_id`` joins /debug/trace/{id} and the profile plane's
+    exemplars."""
+
+    @property
+    def name(self) -> str:
+        return TRACES_NAME
+
+    @property
+    def schema(self) -> Schema:
+        return _TRACES_SCHEMA
+
+    def _materialize(self) -> RowGroup:
+        from ..utils.tracectx import TRACE_STORE
+
+        rows = TRACE_STORE.list()
+        return RowGroup(
+            _TRACES_SCHEMA,
+            {
+                "timestamp": np.array(
+                    [int(float(r["at"]) * 1000) for r in rows],
+                    dtype=np.int64,
+                ),
+                "trace_id": np.array(
+                    [str(r["trace_id"]) for r in rows], dtype=object
+                ),
+                "name": np.array([r["name"] for r in rows], dtype=object),
+                "duration_ms": np.array(
+                    [float(r["duration_ms"] or 0.0) for r in rows],
+                    dtype=np.float64,
+                ),
+                "spans": np.array(
+                    [int(r["spans"]) for r in rows], dtype=np.int64
+                ),
+                "slow": np.array(
+                    [bool(r["slow"]) for r in rows], dtype=bool
+                ),
+            },
+        )
+
+
 def open_system_table(catalog, name: str):
     """The catalog's virtual-table hook: a Table for system names, else
     None (regular resolution proceeds)."""
@@ -1014,4 +1177,8 @@ def open_system_table(catalog, name: str):
         return DecisionsTable()
     if low == CALIBRATION_NAME:
         return CalibrationTable()
+    if low == PROFILE_NAME:
+        return ProfileTable()
+    if low == TRACES_NAME:
+        return TracesTable()
     return None

@@ -334,6 +334,43 @@ def cmd_decisions(ep: str, args) -> None:
     _print_rows(rows)
 
 
+def cmd_profile(ep: str, args) -> None:
+    """The profile plane (/debug/profile): fleetwide wall-clock
+    attribution rows aggregated from the server's own span trees,
+    sorted by exclusive time."""
+    qs = f"?limit={args.limit}"
+    if args.path:
+        qs += f"&path={args.path}"
+    if args.route:
+        qs += f"&route={args.route}"
+    data = json.loads(_get(ep, f"/debug/profile{qs}"))
+    rows = [
+        {
+            "path": r["path"][:64],
+            "route": r["route"],
+            "shape": r["shape"][:32],
+            "count": r["count"],
+            "excl_ms": round(r["exclusive_ms"], 2),
+            "total_ms": round(r["total_ms"], 2),
+            "ewma_ms": (
+                round(r["ewma_ms"], 3) if r["ewma_ms"] is not None else ""
+            ),
+            "fast_ms": round(r["fast_ms"], 3),
+            "slow_ms": round(r["slow_ms"], 3),
+            "last_trace": r["last_trace_id"],
+        }
+        for r in data["profile"]
+    ]
+    _print_rows(rows)
+    s = data["stats"]
+    ratio = s["untracked_ratio"]
+    print(
+        f"\nkeys: {s['keys']}/{s['capacity']}  traces={s['traces']}  "
+        f"spans={s['spans']}  dropped={s['dropped']}  "
+        f"untracked_ratio={'' if ratio is None else round(ratio, 3)}"
+    )
+
+
 def cmd_rules(ep: str, args) -> None:
     """rules list|add|rm against /admin/rules (mirrors `events tail`)."""
     if args.action == "list":
@@ -547,6 +584,10 @@ def main(argv=None) -> int:
                     choices=["list", "calibration"])
     de.add_argument("--loop", default=None)
     de.add_argument("--limit", type=int, default=20)
+    pf = sub.add_parser("profile")
+    pf.add_argument("--path", default=None)
+    pf.add_argument("--route", default=None)
+    pf.add_argument("--limit", type=int, default=20)
     rl = sub.add_parser("rules")
     rl_sub = rl.add_subparsers(dest="action", required=True)
     rl_sub.add_parser("list")

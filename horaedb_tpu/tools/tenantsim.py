@@ -235,6 +235,11 @@ class SimReport:
     livewindow_served: int = 0
     livewindow_equiv_checked: int = 0
     livewindow_equiv_ok: int = 0
+    # profile plane (ISSUE 20), from system.public.profile: >= 1
+    # attribution row per exercised serving plane, and span coverage
+    # keeps the untracked fraction of root wall under the bound
+    profile_route_rows: dict = field(default_factory=dict)
+    profile_untracked_fraction: Optional[float] = None
     notes: list = field(default_factory=list)
 
     def violations(self) -> list[str]:
@@ -356,6 +361,23 @@ class SimReport:
             out.append(
                 f"decision plane: {self.decision_unaccounted} decision(s) "
                 "unaccounted (issued != resolved + expired + unresolved)"
+            )
+        # the profile plane's standing gate (ISSUE 20): the database
+        # attributes its own wall-clock — every serving plane the sim
+        # exercises shows attribution rows in system.public.profile, and
+        # span coverage keeps the untracked fraction of root wall small
+        # (a large fraction IS the signal a plane lost its spans)
+        for route in ("query", "ingest", "flush", "compaction", "rules"):
+            if self.profile_route_rows.get(route, 0) < 1:
+                out.append(
+                    "profile plane: no system.public.profile row for "
+                    f"route={route}"
+                )
+        if (self.profile_untracked_fraction is not None
+                and self.profile_untracked_fraction >= 0.40):
+            out.append(
+                "profile plane: untracked fraction "
+                f"{self.profile_untracked_fraction} >= 0.40 of root wall"
             )
         if self.served == 0:
             out.append("no queries served at all")
@@ -1686,6 +1708,10 @@ class TenantSim:
         # verdict — all read back from the database's own tables ---
         self._collect_decisions(ep)
 
+        # --- profile plane (ISSUE 20): wall-clock attribution rows for
+        # every exercised serving plane, untracked fraction bounded ---
+        self._collect_profile(ep)
+
         # --- post-kill recovery: frozen-range reads still agree.
         # "never answered" (still converging / unavailable) and "answered
         # WRONG" are different failures — only a 200 that disagrees is a
@@ -1827,6 +1853,70 @@ class TenantSim:
                     )
         except Exception:
             pass
+
+    def _collect_profile(self, ep: str) -> None:
+        """Profile-plane standing gate (ISSUE 20), from the database's
+        own ``system.public.profile``: every serving plane the sim
+        exercised (query/ingest/flush/compaction/rules) must show >= 1
+        attribution row, and the untracked fraction of root wall must
+        stay under the coverage bound. Compaction is made deterministic
+        first: trigger-level one-row flushes of table 0 accumulate the
+        L0 runs the background scheduler reacts to."""
+        name = self._table(0)
+        owner = self._owner(name)
+        ts0 = int(time.time() * 1000)
+        for k in range(5):
+            self._sql(
+                ep,
+                f"INSERT INTO {name} (tenant, host, v, ts) VALUES "
+                f"('profile', 'h0', {float(k)}, {ts0 + k})",
+                timeout=10,
+            )
+            _http(
+                "POST", f"http://{owner}/admin/flush?table={name}", {},
+                timeout=15,
+            )
+        # in-process nodes share the global aggregator: drain the fold
+        # queue, then poll until the background compaction round (and a
+        # rules-eval tick) have landed their rows
+        from ..obs.profile import flush as profile_flush
+
+        routes_needed = ("query", "ingest", "flush", "compaction", "rules")
+        rows: list = []
+        deadline = time.time() + 20.0
+        while True:
+            profile_flush(5.0)
+            s, out = self._sql(
+                ep,
+                "SELECT path, route, total_ms FROM system.public.profile",
+                timeout=10,
+            )
+            rows = out.get("rows", []) if s == 200 else []
+            seen = {r.get("route") for r in rows}
+            if all(r in seen for r in routes_needed):
+                break
+            if time.time() >= deadline:
+                break
+            time.sleep(0.25)
+        counts: dict = {}
+        root_ms: dict = {}
+        untracked_ms: dict = {}
+        for r in rows:
+            route = r.get("route", "")
+            counts[route] = counts.get(route, 0) + 1
+            path = r.get("path", "")
+            ms = float(r.get("total_ms") or 0.0)
+            if "/" not in path:
+                root_ms[route] = root_ms.get(route, 0.0) + ms
+            elif path.endswith("/" + "(untracked)"):
+                untracked_ms[route] = untracked_ms.get(route, 0.0) + ms
+        self.report.profile_route_rows = counts
+        total_root = sum(root_ms.values())
+        total_untracked = sum(max(0.0, v) for v in untracked_ms.values())
+        self.report.profile_untracked_fraction = (
+            round(total_untracked / total_root, 4)
+            if total_root > 0 else None
+        )
 
     def _collect_decisions(self, ep: str) -> None:
         """Decision-plane gates (ISSUE 16), from the database's own

@@ -48,6 +48,12 @@ overrides for the deployment-varying fields (ref: bin/horaedb-server.rs
     self_metrics_retention = "24h"    # 0s = keep forever
     event_ring = 512                  # bounded event-journal capacity
     decision_ring = 1024              # bounded decision-journal capacity
+    profile_keys = 1024               # profile-aggregator LRU key bound
+    trace_ring = 64                   # recent finished-trace ring
+    trace_slow_ring = 256             # slow finished-trace ring
+    slow_threshold = "1s"             # slow-trace/slow-log admission
+                                      # (promoted from [limits]; either
+                                      # location accepted, this one wins)
 
     [rules]
     enabled = true                    # continuous-query engine (rules/)
@@ -250,6 +256,14 @@ class ObservabilitySection:
     # accounted in horaedb_decision_dropped_total and every eviction of
     # an unresolved entry is a counted expiry
     decision_ring: int = 1024
+    # profile plane (obs/profile): LRU bound on live (path, route,
+    # shape) keys; evictions are exactly accounted in
+    # horaedb_profile_dropped_total + the aggregator's evicted totals
+    profile_keys: int = 1024
+    # finished-trace rings (utils/tracectx.TRACE_STORE): recent + slow,
+    # served as system.public.traces and /debug/trace
+    trace_ring: int = 64
+    trace_slow_ring: int = 256
 
 
 @dataclass
@@ -423,7 +437,8 @@ _KNOWN = {
     "wlm": {"batch"},
     "observability": {
         "self_scrape", "self_scrape_interval", "self_metrics_retention",
-        "event_ring", "decision_ring",
+        "event_ring", "decision_ring", "profile_keys",
+        "trace_ring", "trace_slow_ring", "slow_threshold",
     },
     "rules": {
         "enabled", "eval_interval", "grace", "recording", "alerts",
@@ -565,6 +580,27 @@ def _apply(cfg: Config, raw: dict) -> None:
         cfg.observability.decision_ring = int(o["decision_ring"])
         if cfg.observability.decision_ring < 1:
             raise ConfigError("observability.decision_ring must be >= 1")
+    if "profile_keys" in o:
+        cfg.observability.profile_keys = int(o["profile_keys"])
+        if cfg.observability.profile_keys < 1:
+            raise ConfigError("observability.profile_keys must be >= 1")
+    if "trace_ring" in o:
+        cfg.observability.trace_ring = int(o["trace_ring"])
+        if cfg.observability.trace_ring < 1:
+            raise ConfigError("observability.trace_ring must be >= 1")
+    if "trace_slow_ring" in o:
+        cfg.observability.trace_slow_ring = int(o["trace_slow_ring"])
+        if cfg.observability.trace_slow_ring < 1:
+            raise ConfigError("observability.trace_slow_ring must be >= 1")
+    if "slow_threshold" in o:
+        # promoted from [limits] (ISSUE 20 satellite): the proxy's slow
+        # trace/slow-log admission is an observability knob; when both
+        # sections set it, [observability] wins (applied after [limits])
+        cfg.limits.slow_threshold_s = (
+            parse_duration_ms(o["slow_threshold"]) / 1000.0
+        )
+        if cfg.limits.slow_threshold_s <= 0:
+            raise ConfigError("observability.slow_threshold must be positive")
     ru = raw.get("rules", {})
     if "enabled" in ru:
         if not isinstance(ru["enabled"], bool):

@@ -120,6 +120,11 @@ class Trace:
         self._lock = threading.Lock()
         self._ids = itertools.count(2)
         self.root = Span(1, None, name, attrs)
+        # profile-plane tags (obs/profile key dimensions): the serving
+        # plane (query/ingest/ddl/flush/compaction/rules) and the
+        # normalized plan-key class. Set via tag_trace once known.
+        self.route = ""
+        self.shape = ""
 
     def new_span(self, parent: Span, name: str,
                  attrs: Optional[dict] = None) -> Optional[Span]:
@@ -215,9 +220,25 @@ def start_trace(trace_id, name: str = "request", **attrs: Any):
     return trace, tokens
 
 
+def tag_trace(route: Optional[str] = None, shape: Optional[str] = None) -> None:
+    """Stamp the current trace's profile-plane dimensions (no-op outside
+    a trace). The proxy tags by plan kind after parse; background planes
+    tag at round start."""
+    trace = _current_trace.get()
+    if trace is None:
+        return
+    if route is not None:
+        trace.route = route
+    if shape is not None:
+        trace.shape = shape
+
+
 def finish_trace(handle, record: bool = True, slow: bool = False) -> None:
     """End the trace started with ``start_trace`` and (by default) record
-    its snapshot in the global TRACE_STORE."""
+    its snapshot in the global TRACE_STORE and fold it into the profile
+    aggregator (obs/profile). ``record=False`` (serving_trace) skips
+    BOTH: the subtree ships home and folds once, at the coordinator —
+    never double-counted fleetwide."""
     t_tok, s_tok, r_tok = handle
     trace = _current_trace.get()
     _current_trace.reset(t_tok)
@@ -227,7 +248,15 @@ def finish_trace(handle, record: bool = True, slow: bool = False) -> None:
         return
     trace.root.finish()
     if record:
-        TRACE_STORE.record(trace, slow=slow)
+        root = trace.to_dict()["root"]  # ONE locked walk per request
+        TRACE_STORE.record_snapshot(trace.trace_id, root, slow=slow)
+        try:
+            from ..obs.profile import fold_trace
+
+            fold_trace(trace.trace_id, root,
+                       route=trace.route, shape=trace.shape)
+        except Exception:
+            pass  # profiling must never fail the request
 
 
 @contextmanager
@@ -250,6 +279,31 @@ def span(name: str, **attrs: Any):
     finally:
         s.finish()
         _current_span.reset(token)
+
+
+_bg_trace_ids = itertools.count(1)
+
+
+@contextmanager
+def owned_trace(name: str, route: str = "", shape: str = "", **attrs: Any):
+    """A background plane's own trace round (flush, compaction, rules):
+    starts a trace so the plane's spans fold into the profile aggregator
+    through the SAME machinery as queries. If a trace is already active
+    (a foreground-requested flush inside a request), opens a child span
+    instead — never shadows the request's tree. Yields the root/child
+    span (supports ``.set``)."""
+    if _current_trace.get() is not None:
+        with span(name, **attrs) as s:
+            yield s
+        return
+    tid = f"{name}-{next(_bg_trace_ids)}"
+    trace, handle = start_trace(tid, name, **attrs)
+    trace.route = route or name
+    trace.shape = shape
+    try:
+        yield trace.root
+    finally:
+        finish_trace(handle)
 
 
 def annotate(**attrs: Any) -> None:
@@ -335,13 +389,18 @@ class TraceStore:
 
     def record(self, trace: Trace, slow: bool = False) -> None:
         trace.root.finish()
-        root = trace.to_dict()["root"]  # ONE locked walk per request
+        self.record_snapshot(trace.trace_id, trace.to_dict()["root"],
+                             slow=slow)
+
+    def record_snapshot(self, trace_id, root: dict, slow: bool = False) -> None:
+        """Record an already-serialized root (finish_trace snapshots once
+        and shares the walk with the profile fold)."""
 
         def count(node: dict) -> int:
             return 1 + sum(count(c) for c in node.get("children", ()))
 
         entry = {
-            "trace_id": trace.trace_id,
+            "trace_id": trace_id,
             "name": root["name"],
             "at": root["start_at"],
             "duration_ms": root["duration_ms"],
@@ -374,6 +433,21 @@ class TraceStore:
                 out.append({k: entry[k] for k in
                             ("trace_id", "name", "at", "duration_ms", "spans", "slow")})
             return out
+
+    def resize(self, recent: Optional[int] = None,
+               slow: Optional[int] = None) -> None:
+        """Apply the [observability] trace_ring / trace_slow_ring knobs;
+        shrinking drops oldest entries (deque maxlen semantics)."""
+        from collections import deque
+
+        with self._lock:
+            if recent is not None and recent != self._recent.maxlen:
+                self._recent = deque(self._recent, maxlen=max(1, int(recent)))
+            if slow is not None and slow != self._slow.maxlen:
+                self._slow = deque(self._slow, maxlen=max(1, int(slow)))
+
+    def sizes(self) -> tuple[int, int]:
+        return self._recent.maxlen or 0, self._slow.maxlen or 0
 
     def clear(self) -> None:
         with self._lock:

@@ -1,0 +1,382 @@
+"""Profile plane (ISSUE 20): the streaming fold of finished span trees.
+
+What is pinned here, in order of importance:
+
+- the ACCOUNTING CONTRACT: per folded trace, root_ms == sum of non-root
+  exclusive_ms (signed overlap and the first-class untracked row make
+  the telescope exact), and fleetwide, live rows + the evicted ledger
+  always reconcile to a naive refold of every tree ever folded — LRU
+  eviction under the profile_keys bound loses rows, never milliseconds;
+- COVERAGE: real dashboard + insert shapes served through the proxy must
+  leave the untracked fraction of root wall under the 40% bound (a
+  regression here means a serving stage lost its spans);
+- the registry lint for the horaedb_profile_* families and the
+  [observability] knobs (same contract as the decision-plane lint);
+- TraceStore.get returns the NEWEST snapshot on trace-id reuse.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import pytest
+
+import horaedb_tpu
+from horaedb_tpu.obs.profile import (
+    PROFILE,
+    UNTRACKED,
+    ProfileAggregator,
+    critical_path,
+    flush as profile_flush,
+)
+from horaedb_tpu.proxy import Proxy
+
+
+def _tree(name: str, dur: float, children=()) -> dict:
+    return {
+        "name": name,
+        "duration_ms": dur,
+        "children": [dict(c) for c in children],
+    }
+
+
+def _random_tree(rng: random.Random, depth: int = 0) -> dict:
+    """A plausible span tree: durations positive, children's sum MAY
+    exceed the parent (parallel spans) so signed exclusive is exercised."""
+    dur = rng.uniform(0.1, 50.0)
+    kids = []
+    if depth < 3:
+        for _ in range(rng.randrange(0, 4)):
+            kids.append(_random_tree(rng, depth + 1))
+    name = rng.choice(["parse", "execute", "scan", "kernel", "wal", "merge"])
+    return _tree(name, dur, kids)
+
+
+def _naive_rows(root: dict) -> list[tuple[str, float, float]]:
+    """Reference refold: the same telescoping walk, written naively."""
+    rows: list[tuple[str, float, float]] = []
+
+    def walk(node, path):
+        dur = float(node["duration_ms"])
+        child_sum = 0.0
+        for c in node.get("children") or ():
+            child_sum += walk(c, f"{path}/{c['name']}")
+        rows.append((path, dur, dur - child_sum))
+        return dur
+
+    name = root["name"]
+    walk(root, name)
+    path, total, excl = rows.pop()
+    rows.append((path, total, 0.0))
+    rows.append((f"{name}/{UNTRACKED}", excl, excl))
+    return rows
+
+
+class TestAccountingInvariant:
+    def test_root_equals_exclusive_sum_plus_untracked(self):
+        """The hard per-trace invariant, including signed overlap: two
+        parallel children longer than their parent drive the parent's
+        exclusive negative, and the telescope still closes exactly."""
+        agg = ProfileAggregator()
+        root = _tree("sql", 10.0, [
+            _tree("parse", 1.0),
+            _tree("execute", 8.0, [
+                # 5 + 5 > 8: overlapping (threaded) children
+                _tree("scan", 5.0),
+                _tree("kernel", 5.0),
+            ]),
+        ])
+        agg.fold("t1", root, route="query", shape="s")
+        rows = {r["path"]: r for r in agg.list()}
+        assert rows["sql"]["exclusive_ms"] == 0.0
+        assert rows["sql/execute"]["exclusive_ms"] == pytest.approx(-2.0)
+        assert rows[f"sql/{UNTRACKED}"]["exclusive_ms"] == pytest.approx(1.0)
+        non_root = sum(
+            r["exclusive_ms"] for p, r in rows.items() if "/" in p
+        )
+        assert non_root == pytest.approx(10.0)
+
+    def test_untracked_is_first_class_and_ratio_tracked(self):
+        agg = ProfileAggregator()
+        agg.fold("t1", _tree("req", 10.0, [_tree("work", 6.0)]),
+                 route="query", shape="s")
+        rows = {r["path"]: r for r in agg.list()}
+        assert rows[f"req/{UNTRACKED}"]["total_ms"] == pytest.approx(4.0)
+        assert agg.stats()["untracked_ratio"] == pytest.approx(0.4)
+
+    def test_random_ops_reconcile_with_naive_refold(self):
+        """The reconciliation property: after folding random trees into
+        a SMALL aggregator (so LRU eviction genuinely fires), live rows
+        plus the evicted ledger equal a naive refold of everything —
+        counts, total ms and exclusive ms, exactly accounted."""
+        rng = random.Random(20)
+        agg = ProfileAggregator(capacity=12)
+        naive_count = 0
+        naive_total = 0.0
+        naive_excl = 0.0
+        naive_spans = 0
+        for i in range(300):
+            root = _random_tree(rng)
+            route = rng.choice(["query", "ingest", "flush"])
+            agg.fold(f"t{i}", root, route=route, shape=f"s{i % 7}")
+            for _, total, excl in _naive_rows(root):
+                naive_count += 1
+                naive_total += total
+                naive_excl += excl
+            naive_spans += len(_naive_rows(root))
+        s = agg.stats()
+        assert s["traces"] == 300
+        assert s["spans"] == naive_spans
+        assert s["dropped"] > 0, "capacity 12 must have evicted keys"
+        assert s["keys"] <= 12
+        got_count = s["live"]["count"] + s["evicted"]["count"]
+        got_total = s["live"]["total_ms"] + s["evicted"]["total_ms"]
+        got_excl = s["live"]["exclusive_ms"] + s["evicted"]["exclusive_ms"]
+        assert got_count == naive_count
+        assert got_total == pytest.approx(naive_total, rel=1e-6)
+        assert got_excl == pytest.approx(naive_excl, rel=1e-6)
+
+    def test_resize_shrink_evicts_and_accounts(self):
+        agg = ProfileAggregator(capacity=64)
+        for i in range(20):
+            agg.fold(f"t{i}", _tree(f"req{i}", 5.0), route="query",
+                     shape="s")
+        before = agg.stats()
+        agg.resize(4)
+        after = agg.stats()
+        assert after["capacity"] == 4
+        assert after["keys"] <= 4
+        assert after["dropped"] > before["dropped"]
+        # nothing lost: the evicted ledger absorbed the shrink
+        assert (after["live"]["total_ms"] + after["evicted"]["total_ms"]
+                == pytest.approx(
+                    before["live"]["total_ms"]
+                    + before["evicted"]["total_ms"]))
+
+
+class TestKillSwitch:
+    def test_profile_env_disables_fold(self):
+        from horaedb_tpu.obs.profile import fold_trace
+
+        prior = os.environ.get("HORAEDB_PROFILE")
+        try:
+            profile_flush(5.0)  # drain strays before the clean-slate
+            PROFILE.clear()
+            os.environ["HORAEDB_PROFILE"] = "0"
+            fold_trace("t1", _tree("req", 5.0), route="query", shape="s")
+            profile_flush(5.0)
+            assert PROFILE.stats()["traces"] == 0
+            os.environ["HORAEDB_PROFILE"] = "1"
+            fold_trace("t2", _tree("req", 5.0), route="query", shape="s")
+            assert profile_flush(5.0)
+            assert PROFILE.stats()["traces"] == 1
+        finally:
+            if prior is None:
+                os.environ.pop("HORAEDB_PROFILE", None)
+            else:
+                os.environ["HORAEDB_PROFILE"] = prior
+
+
+class TestCriticalPath:
+    def test_descends_max_child(self):
+        root = _tree("sql", 10.0, [
+            _tree("parse", 1.0),
+            _tree("execute", 8.0, [
+                _tree("scan", 6.0), _tree("kernel", 1.0),
+            ]),
+        ])
+        hops = critical_path(root)
+        assert [h["name"] for h in hops] == ["sql", "execute", "scan"]
+        assert hops[1]["self_ms"] == pytest.approx(1.0)
+
+    def test_explain_analyze_emits_critical_path_line(self):
+        db = horaedb_tpu.connect(None)
+        try:
+            db.execute(
+                "CREATE TABLE cp (host string TAG, v double, "
+                "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            db.execute(
+                "INSERT INTO cp (host, v, ts) VALUES ('a', 1.0, 1000)"
+            )
+            text = "\n".join(
+                r["plan"]
+                for r in db.execute(
+                    "EXPLAIN ANALYZE SELECT host, sum(v) FROM cp "
+                    "GROUP BY host"
+                ).to_pylist()
+            )
+            assert "Critical path:" in text
+            assert "ms (self " in text
+        finally:
+            db.close()
+
+
+class TestServingCoverage:
+    """The coverage bound: REAL shapes through the proxy, then the
+    untracked fraction of root wall per route must stay under 40% — the
+    standing assertion that the serving stages keep their spans."""
+
+    def test_dashboard_and_insert_shapes_under_untracked_bound(self):
+        prior = os.environ.get("HORAEDB_PROFILE")
+        db = horaedb_tpu.connect(None)
+        try:
+            os.environ["HORAEDB_PROFILE"] = "1"
+            profile_flush(5.0)  # drain strays before the clean-slate
+            PROFILE.clear()
+            db.execute(
+                "CREATE TABLE dash (host string TAG, v double, "
+                "ts timestamp NOT NULL, TIMESTAMP KEY(ts)) ENGINE=Analytic"
+            )
+            proxy = Proxy(db)
+            t0 = 1_700_000_000_000
+            for i in range(8):
+                vals = ",".join(
+                    f"('h{h}', {h}.5, {t0 + i * 1000})" for h in range(4)
+                )
+                proxy.handle_sql(
+                    f"INSERT INTO dash (host, v, ts) VALUES {vals}"
+                )
+            db.flush_all()
+            for q in range(12):
+                proxy.handle_sql(
+                    f"SELECT host, count(v), sum(v) FROM dash WHERE "
+                    f"ts >= {t0 + (q % 4) * 1000} GROUP BY host"
+                )
+            assert profile_flush(10.0)
+            rows = PROFILE.list()
+            for route in ("query", "ingest"):
+                roots = sum(
+                    r["total_ms"] for r in rows
+                    if r["route"] == route and "/" not in r["path"]
+                )
+                untracked = sum(
+                    max(0.0, r["total_ms"]) for r in rows
+                    if r["route"] == route
+                    and r["path"].endswith("/" + UNTRACKED)
+                )
+                assert roots > 0, f"no {route} root rows: {rows}"
+                frac = untracked / roots
+                assert frac < 0.40, (
+                    f"route={route} untracked {frac:.1%} >= 40% — a "
+                    f"serving stage lost its spans: {rows}"
+                )
+            # exemplar linkage: rows point at a real stored trace
+            from horaedb_tpu.utils.tracectx import TRACE_STORE
+
+            top = [r for r in rows if r["route"] == "query"][0]
+            assert TRACE_STORE.get(top["last_trace_id"]) is not None
+        finally:
+            if prior is None:
+                os.environ.pop("HORAEDB_PROFILE", None)
+            else:
+                os.environ["HORAEDB_PROFILE"] = prior
+            db.close()
+
+
+class TestTraceStore:
+    def test_get_returns_newest_on_trace_id_reuse(self):
+        """Request ids recycle across restarts; /debug/trace/{id} and
+        the profile exemplar link must resolve to the LATEST tree."""
+        from horaedb_tpu.utils.tracectx import TraceStore
+
+        store = TraceStore()
+        store.record_snapshot(
+            7, {"name": "old", "duration_ms": 1.0, "start_at": 1.0,
+                "children": []}
+        )
+        store.record_snapshot(
+            7, {"name": "new", "duration_ms": 2.0, "start_at": 2.0,
+                "children": []}
+        )
+        got = store.get(7)
+        assert got is not None
+        assert got["root"]["name"] == "new"
+
+    def test_resize_applies_ring_knobs(self):
+        from horaedb_tpu.utils.tracectx import TraceStore
+
+        store = TraceStore()
+        for i in range(10):
+            store.record_snapshot(
+                i, {"name": "r", "duration_ms": 1.0, "start_at": float(i),
+                    "children": []}
+            )
+        store.resize(recent=3, slow=5)
+        assert store.sizes() == (3, 5)
+        assert len(store.list()) <= 3
+
+
+class TestSystemTables:
+    def test_profile_and_traces_tables_registered(self):
+        from horaedb_tpu.table_engine.system import (
+            PROFILE_NAME,
+            TRACES_NAME,
+            open_system_table,
+        )
+
+        t = open_system_table(None, PROFILE_NAME)
+        cols = {c.name for c in t.schema.columns}
+        assert {"path", "route", "shape", "count", "total_ms",
+                "exclusive_ms", "ewma_ms", "fast_ms", "slow_ms",
+                "trace_id"} <= cols
+        tr = open_system_table(None, TRACES_NAME)
+        tcols = {c.name for c in tr.schema.columns}
+        assert {"trace_id", "name", "duration_ms", "spans",
+                "slow"} <= tcols
+
+    def test_profile_rows_flow_to_table(self):
+        profile_flush(5.0)
+        PROFILE.clear()
+        PROFILE.fold("tx", _tree("req", 4.0, [_tree("work", 3.0)]),
+                     route="query", shape="s")
+        from horaedb_tpu.table_engine.system import (
+            PROFILE_NAME,
+            open_system_table,
+        )
+
+        rg = open_system_table(None, PROFILE_NAME)._materialize()
+        paths = set(rg.columns["path"])
+        assert {"req", "req/work", f"req/{UNTRACKED}"} <= paths
+
+
+class TestProfileRegistryLint:
+    """Same contract as the decision-plane registry lint: every family
+    in PROFILE_METRIC_FAMILIES live + convention-clean + documented in
+    docs/OBSERVABILITY.md, no stray horaedb_profile_* family, and the
+    plane's knobs pinned to docs/WORKLOAD.md."""
+
+    def test_profile_families_declared_and_documented(self):
+        import re
+
+        from horaedb_tpu.obs.profile import PROFILE_METRIC_FAMILIES
+        from horaedb_tpu.utils.metrics import REGISTRY
+
+        here = os.path.dirname(__file__)
+        docs = open(
+            os.path.join(here, "..", "docs", "OBSERVABILITY.md")
+        ).read()
+        wdocs = open(os.path.join(here, "..", "docs", "WORKLOAD.md")).read()
+        families = set(REGISTRY.families())
+        pat = re.compile(r"^horaedb_[a-z0-9_]+$")
+        from tests.test_observability import TestMetricsNameLint
+
+        suffixes = TestMetricsNameLint.SUFFIXES
+        missing = []
+        for fam in PROFILE_METRIC_FAMILIES:
+            if fam not in families:
+                missing.append(f"{fam}: not registered")
+            if not pat.match(fam) or not fam.endswith(suffixes):
+                missing.append(f"{fam}: violates naming lint")
+            if f"`{fam}`" not in docs:
+                missing.append(f"{fam}: undocumented in OBSERVABILITY.md")
+        for fam in families:
+            if (fam.startswith("horaedb_profile_")
+                    and fam not in PROFILE_METRIC_FAMILIES):
+                missing.append(f"{fam}: live but undeclared in registry")
+        for knob in ("profile_keys", "trace_ring", "trace_slow_ring",
+                     "slow_threshold", "HORAEDB_PROFILE"):
+            if f"`{knob}`" not in wdocs:
+                missing.append(f"{knob}: undocumented in docs/WORKLOAD.md")
+        assert not missing, missing

@@ -921,6 +921,70 @@ class TestAlertLifecycle:
         db.close()
 
 
+class TestAlertsThroughLivewindow:
+    """Satellite: eligible open-tail alert rules evaluate through the
+    live-window ring partials (``route=livewindow``) with second-level
+    freshness — memtable-only rows move the alert on the next round."""
+
+    def test_bare_selector_alert_promotes_then_serves_from_state(self):
+        from horaedb_tpu.state.livewindow import STORE, promote_reads
+
+        STORE.clear()
+        db = horaedb_tpu.connect(None)
+        try:
+            db.execute(
+                "CREATE TABLE lw_alert (host string TAG, value double NOT "
+                "NULL, ts timestamp NOT NULL, TIMESTAMP KEY(ts)) "
+                "ENGINE=Analytic WITH (segment_duration='2h', "
+                "update_mode='append')"
+            )
+            now = int(time.time() * 1000)
+            rows = ",".join(
+                f"('h{h}', 10.0, {now - k * 20000})"
+                for k in range(12) for h in range(2)
+            )
+            db.execute(f"INSERT INTO lw_alert (host, value, ts) VALUES {rows}")
+
+            eng = RuleEngine(
+                db, RulesSection(alerts=["LwHot := lw_alert > 50"])
+            ).load()
+            # A bare gauge selector is the livewindow-eligible shape: the
+            # promql range path lowers it to ONE time_bucket GROUP BY
+            # (``avg_over_time`` at instant eval takes the exact-window
+            # raw fold instead and never promotes). The eval instant sits
+            # a bucket ahead of the seed rows so the promoted state's
+            # valid_from bucket falls inside the query window; the
+            # open-tail predicate compares the range END against the real
+            # wall clock, so it must stay within two steps of now.
+            eval_at = now + 90_000
+            for i in range(promote_reads()):
+                eng.run_once(now_ms=eval_at + i)
+            states = STORE.stats()["states"]
+            assert [s["table"] for s in states] == ["lw_alert"], \
+                "alert evals did not promote the shape to live state"
+            assert eng.alerts_snapshot() == []  # baseline far below 50
+
+            # Freshness: an over-threshold burst into the first servable
+            # bucket, memtable-only (never flushed), must fire on the
+            # NEXT round — served from the ring partials, not a rescan.
+            burst_ts = (now // MIN + 1) * MIN + 1000
+            db.execute(
+                "INSERT INTO lw_alert (host, value, ts) VALUES "
+                f"('h0', 100.0, {burst_ts}), ('h1', 100.0, {burst_ts})"
+            )
+            eng.run_once(now_ms=eval_at + promote_reads())
+            assert db.interpreters.executor.last_path == "livewindow"
+            served = [s["reads_served"] for s in STORE.stats()["states"]]
+            assert served and served[0] >= 1, served
+            snap = eng.alerts_snapshot()
+            assert sorted(a["labels"]["host"] for a in snap) == ["h0", "h1"]
+            assert all(a["state"] == "firing" for a in snap)
+            assert all(float(a["value"]) == 100.0 for a in snap)
+        finally:
+            STORE.clear()
+            db.close()
+
+
 class TestAdminSurfaceAndStatus:
     def test_admin_rules_debug_status_and_readiness(self):
         db = horaedb_tpu.connect(None)
